@@ -1,0 +1,22 @@
+"""Test env: 8 virtual CPU devices so multi-chip sharding (mesh/shard_map)
+is exercised without TPU hardware — the analog of the reference's unistore
+mock cluster (BootstrapWithMultiRegions) giving multi-node semantics in one
+process (SURVEY.md §4.2)."""
+
+import os
+
+# Must run before jax is imported anywhere.  The driver env pins
+# JAX_PLATFORMS=axon (real TPU); tests always run on the virtual CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,65 @@
+"""Regression tests for review findings on the expression engine."""
+
+import numpy as np
+
+from tidb_tpu.chunk import Column
+from tidb_tpu.expr import builders as B
+from tidb_tpu.expr import ColumnRef, eval_expr, lower_strings
+from tidb_tpu.types import dtypes as dt
+from tidb_tpu.types import decimal as dec
+
+
+def pair(col):
+    return col.data, (True if col.validity.all() else col.validity)
+
+
+def test_in_with_null_item():
+    # 0 IN (1, NULL) must be NULL, not FALSE; 1 IN (1, NULL) is TRUE
+    a = Column.from_values(dt.bigint(), [0, 1])
+    e = B.in_list(ColumnRef(dt.bigint(), 0), [B.lit(1), B.lit(None)])
+    val, valid = eval_expr(np, e, [pair(a)])
+    assert list(np.asarray(valid)) == [False, True]
+    assert bool(np.asarray(val)[1]) is True
+
+
+def test_constant_operands_in_logic():
+    a = Column.from_values(dt.bigint(), [None, 1])
+    e = B.logic("and", B.lit(1), ColumnRef(dt.bigint(), 0))
+    val, valid = eval_expr(np, e, [pair(a)])
+    assert list(np.asarray(valid)) == [False, True]  # TRUE AND NULL = NULL
+    e2 = B.logic("not", B.lit(1))
+    v2, m2 = eval_expr(np, e2, [pair(a)])
+    assert not bool(v2)  # NOT TRUE = FALSE
+
+
+def test_cross_dictionary_string_compare():
+    c1 = Column.from_values(dt.varchar(), ["a", "b"])
+    c2 = Column.from_values(dt.varchar(), ["b", "z"])
+    assert c1.dictionary is not c2.dictionary
+    e = B.compare("eq", ColumnRef(dt.varchar(), 0), ColumnRef(dt.varchar(), 1))
+    e = lower_strings(e, {0: c1.dictionary, 1: c2.dictionary})
+    val, valid = eval_expr(np, e, [pair(c1), pair(c2)])
+    assert list(np.asarray(val)) == [False, False]
+    e = B.compare("lt", ColumnRef(dt.varchar(), 0), ColumnRef(dt.varchar(), 1))
+    e = lower_strings(e, {0: c1.dictionary, 1: c2.dictionary})
+    val, _ = eval_expr(np, e, [pair(c1), pair(c2)])
+    assert list(np.asarray(val)) == [True, True]  # 'a'<'b', 'b'<'z'
+
+
+def test_cast_to_unsigned():
+    a = Column.from_values(dt.bigint(), [-1, 5])
+    e = B.cast(ColumnRef(dt.bigint(), 0), dt.ubigint())
+    val, _ = eval_expr(np, e, [pair(a)])
+    assert val.dtype == np.uint64
+    assert int(val[0]) == 18446744073709551615
+
+
+def test_decimal_div_high_scale_stays_exact():
+    # dividend scale 13 > result scale cap 12: divisor must be rescaled,
+    # result must stay an exact integer
+    a = Column.from_values(dt.decimal(18, 13), ["1.0000000000000"])
+    e = B.arith("div", ColumnRef(dt.decimal(18, 13), 0), B.lit(3))
+    assert e.dtype.scale == 12
+    val, _ = eval_expr(np, e, [pair(a)])
+    assert np.issubdtype(val.dtype, np.integer)
+    assert int(val[0]) == 333333333333

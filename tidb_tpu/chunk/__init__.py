@@ -1,0 +1,3 @@
+from .column import StringDict, Column, Chunk
+
+__all__ = ["StringDict", "Column", "Chunk"]

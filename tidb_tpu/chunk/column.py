@@ -1,0 +1,218 @@
+"""Arrow-layout columnar data plane: Column / Chunk.
+
+Reference analog: pkg/util/chunk/column.go:71-81 (Column{nullBitmap, offsets,
+data}) and chunk.go — the unit of all data movement in the engine.  The TPU
+rebuild keeps the same contract (dense fixed-width buffer + validity bitmap)
+but stores the buffer as a numpy array ready for zero-copy device transfer,
+and replaces variable-length string buffers with sorted-dictionary codes
+(SURVEY.md §7): fixed-width on device, order-preserving for utf8mb4_bin.
+"""
+
+from __future__ import annotations
+
+import bisect
+import decimal as pydec
+from dataclasses import dataclass, field
+from typing import Any, Iterable, Optional, Sequence
+
+import numpy as np
+
+from ..types import dtypes as dt
+from ..types import decimal as dec
+from ..types import temporal as tmp
+
+
+class StringDict:
+    """Sorted, order-preserving string dictionary (code order == bin collation).
+
+    Replaces the reference's var-len data+offsets string columns
+    (chunk/column.go) and host-side collation compares (pkg/util/collate) —
+    sortkeys are materialized once at encode time, device compares ints.
+    """
+
+    __slots__ = ("values", "_index")
+
+    def __init__(self, values: Sequence[str] = ()):
+        self.values: list[str] = sorted(set(values))
+        self._index = {v: i for i, v in enumerate(self.values)}
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    def code_of(self, s: str) -> int:
+        """Exact code, or -1 if absent."""
+        return self._index.get(s, -1)
+
+    def lower_bound(self, s: str) -> int:
+        """Smallest code whose value >= s (for range predicates on strings)."""
+        return bisect.bisect_left(self.values, s)
+
+    def upper_bound(self, s: str) -> int:
+        return bisect.bisect_right(self.values, s)
+
+    def decode(self, code: int) -> str:
+        return self.values[code]
+
+    def encode_array(self, strings: Iterable[Optional[str]]) -> tuple[np.ndarray, np.ndarray]:
+        codes = np.empty(len(strings), dtype=np.int32)  # type: ignore[arg-type]
+        valid = np.ones(len(strings), dtype=bool)  # type: ignore[arg-type]
+        for i, s in enumerate(strings):
+            if s is None:
+                codes[i] = 0
+                valid[i] = False
+            else:
+                codes[i] = self._index[s]
+        return codes, valid
+
+    @classmethod
+    def build(cls, strings: Iterable[Optional[str]]) -> "StringDict":
+        return cls([s for s in strings if s is not None])
+
+
+@dataclass
+class Column:
+    """One column: dense representation + validity mask (True = non-NULL)."""
+
+    dtype: dt.DataType
+    data: np.ndarray
+    validity: np.ndarray  # bool, same length as data
+    dictionary: Optional[StringDict] = None
+
+    def __post_init__(self):
+        assert self.data.ndim == 1
+        assert self.validity.shape == self.data.shape
+
+    def __len__(self) -> int:
+        return len(self.data)
+
+    # ------------------------------------------------------------------ #
+
+    @classmethod
+    def from_values(cls, dtype: dt.DataType, values: Sequence[Any],
+                    dictionary: Optional[StringDict] = None) -> "Column":
+        """Build from python values (None = NULL), encoding per dtype."""
+        n = len(values)
+        valid = np.array([v is not None for v in values], dtype=bool)
+        kind = dtype.kind
+        if kind == dt.TypeKind.STRING:
+            d = dictionary or StringDict.build(values)
+            codes, valid = d.encode_array(list(values))
+            return cls(dtype, codes, valid, d)
+        out = np.zeros(n, dtype=dtype.np_dtype())
+        for i, v in enumerate(values):
+            if v is None:
+                continue
+            if kind == dt.TypeKind.DECIMAL:
+                out[i] = dec.encode(v, dtype.scale)
+            elif kind == dt.TypeKind.DATE:
+                out[i] = v if isinstance(v, (int, np.integer)) else tmp.parse_date(str(v))
+            elif kind == dt.TypeKind.DATETIME:
+                out[i] = v if isinstance(v, (int, np.integer)) else tmp.parse_datetime(str(v))
+            else:
+                out[i] = v
+        return cls(dtype, out, valid)
+
+    @classmethod
+    def from_numpy(cls, dtype: dt.DataType, data: np.ndarray,
+                   validity: Optional[np.ndarray] = None,
+                   dictionary: Optional[StringDict] = None) -> "Column":
+        if validity is None:
+            validity = np.ones(len(data), dtype=bool)
+        return cls(dtype, np.asarray(data, dtype=dtype.np_dtype()), validity, dictionary)
+
+    # ------------------------------------------------------------------ #
+
+    def to_python(self) -> list[Any]:
+        """Decode to python values (None for NULLs) — result-set surface."""
+        kind = self.dtype.kind
+        out: list[Any] = []
+        for i in range(len(self.data)):
+            if not self.validity[i]:
+                out.append(None)
+            elif kind == dt.TypeKind.DECIMAL:
+                out.append(dec.decode(int(self.data[i]), self.dtype.scale))
+            elif kind == dt.TypeKind.STRING:
+                out.append(self.dictionary.decode(int(self.data[i])))
+            elif kind == dt.TypeKind.DATE:
+                out.append(tmp.days_to_date(int(self.data[i])))
+            elif kind == dt.TypeKind.DATETIME:
+                out.append(tmp.datetime_to_string(int(self.data[i])))
+            elif kind in (dt.TypeKind.FLOAT64, dt.TypeKind.FLOAT32):
+                out.append(float(self.data[i]))
+            else:
+                out.append(int(self.data[i]))
+        return out
+
+    def take(self, idx: np.ndarray) -> "Column":
+        return Column(self.dtype, self.data[idx], self.validity[idx], self.dictionary)
+
+    def slice(self, start: int, stop: int) -> "Column":
+        return Column(self.dtype, self.data[start:stop], self.validity[start:stop],
+                      self.dictionary)
+
+    def pad_to(self, capacity: int) -> "Column":
+        """Pad with NULL rows to a fixed capacity (static-shape batching —
+        the TPU analog of the reference's 1024-row chunks,
+        exec/executor.go MaxChunkSize)."""
+        n = len(self.data)
+        if n == capacity:
+            return self
+        assert n < capacity
+        data = np.zeros(capacity, dtype=self.data.dtype)
+        data[:n] = self.data
+        valid = np.zeros(capacity, dtype=bool)
+        valid[:n] = self.validity
+        return Column(self.dtype, data, valid, self.dictionary)
+
+    @classmethod
+    def concat(cls, cols: Sequence["Column"]) -> "Column":
+        assert cols
+        # NOTE: assumes shared dictionary for string columns (true within a
+        # table snapshot; see store/columnar.py).
+        return cls(cols[0].dtype,
+                   np.concatenate([c.data for c in cols]),
+                   np.concatenate([c.validity for c in cols]),
+                   cols[0].dictionary)
+
+
+@dataclass
+class Chunk:
+    """A batch of rows as named columns (reference: chunk.Chunk)."""
+
+    names: list[str]
+    columns: list[Column]
+
+    def __post_init__(self):
+        assert len(self.names) == len(self.columns)
+        if self.columns:
+            n = len(self.columns[0])
+            assert all(len(c) == n for c in self.columns)
+
+    @property
+    def num_rows(self) -> int:
+        return len(self.columns[0]) if self.columns else 0
+
+    @property
+    def num_cols(self) -> int:
+        return len(self.columns)
+
+    def col(self, name: str) -> Column:
+        return self.columns[self.names.index(name)]
+
+    def to_rows(self) -> list[tuple]:
+        cols = [c.to_python() for c in self.columns]
+        return list(zip(*cols)) if cols else []
+
+    def take(self, idx: np.ndarray) -> "Chunk":
+        return Chunk(self.names, [c.take(idx) for c in self.columns])
+
+    @classmethod
+    def concat(cls, chunks: Sequence["Chunk"]) -> "Chunk":
+        assert chunks
+        names = chunks[0].names
+        cols = [Column.concat([ch.columns[i] for ch in chunks])
+                for i in range(len(names))]
+        return cls(names, cols)
+
+
+__all__ = ["StringDict", "Column", "Chunk"]

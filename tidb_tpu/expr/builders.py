@@ -1,0 +1,205 @@
+"""Type-inferring smart constructors for the expression IR.
+
+Reference analog: pkg/expression function-class construction
+(builtin.go:661 funcs registry) + type inference in newBaseBuiltinFunc.
+The planner builds all expressions through these so every IR node carries a
+resolved DataType (incl. decimal precision/scale per MySQL rules).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from ..types import dtypes as dt
+from ..types import decimal as dec
+from ..types import temporal as tmp
+from .ir import ColumnRef, Const, Expr, Func
+
+K = dt.TypeKind
+
+COMPARE_OPS = {"eq", "ne", "lt", "le", "gt", "ge"}
+LOGIC_OPS = {"and", "or", "not", "xor"}
+ARITH_OPS = {"add", "sub", "mul", "div", "intdiv", "mod"}
+
+
+def lit(value, dtype: dt.DataType | None = None) -> Const:
+    """Build a literal with device encoding."""
+    if value is None:
+        return Const(dt.null_type(), None)
+    if dtype is None:
+        if isinstance(value, bool):
+            dtype = dt.bigint(False)
+            value = int(value)
+        elif isinstance(value, int):
+            dtype = dt.bigint(False)
+        elif isinstance(value, float):
+            dtype = dt.double(False)
+        elif isinstance(value, str):
+            dtype = dt.varchar(False)
+        else:
+            raise TypeError(f"cannot infer literal type for {value!r}")
+    elif dtype.kind == K.DECIMAL and not isinstance(value, (int, np.integer)):
+        value = dec.encode(value, dtype.scale)
+    elif dtype.kind == K.DATE and isinstance(value, str):
+        value = tmp.parse_date(value)
+    elif dtype.kind == K.DATETIME and isinstance(value, str):
+        value = tmp.parse_datetime(value)
+    return Const(dtype.with_nullable(False), value)
+
+
+def decimal_lit(text: str) -> Const:
+    """Numeric literal with a decimal point → DECIMAL, MySQL-style."""
+    s = text.strip()
+    body = s.lstrip("+-")
+    if "." in body:
+        ip, fp = body.split(".", 1)
+    else:
+        ip, fp = body, ""
+    scale = len(fp)
+    prec = max(len(ip) + scale, 1)
+    d = dt.decimal(prec, scale, nullable=False)
+    return Const(d, dec.encode(s, scale))
+
+
+def _arith_result_type(op: str, a: dt.DataType, b: dt.DataType) -> dt.DataType:
+    nullable = a.nullable or b.nullable or op in ("div", "intdiv", "mod")
+    if op == "div":
+        # MySQL `/`: decimal out if both exact, else double
+        if (a.kind in (K.INT64, K.UINT64, K.DECIMAL)
+                and b.kind in (K.INT64, K.UINT64, K.DECIMAL)):
+            sa = a.scale if a.kind == K.DECIMAL else 0
+            return dt.decimal(dt.DECIMAL64_MAX_PRECISION,
+                              min(sa + dt.DIV_FRAC_INCR, 12), nullable)
+        return dt.double(nullable)
+    if op == "intdiv":
+        return dt.bigint(nullable)
+    t = dt.common_numeric_type(a, b)
+    if t.kind == K.DECIMAL:
+        sa = a.scale if a.kind == K.DECIMAL else 0
+        sb = b.scale if b.kind == K.DECIMAL else 0
+        scale = sa + sb if op == "mul" else max(sa, sb)
+        return dt.decimal(dt.DECIMAL64_MAX_PRECISION, scale, nullable)
+    return t.with_nullable(nullable)
+
+
+def arith(op: str, a: Expr, b: Expr) -> Func:
+    assert op in ARITH_OPS, op
+    return Func(_arith_result_type(op, a.dtype, b.dtype), op, (a, b))
+
+
+def neg(a: Expr) -> Func:
+    return Func(a.dtype, "neg", (a,))
+
+
+def compare(op: str, a: Expr, b: Expr) -> Func:
+    assert op in COMPARE_OPS, op
+    nullable = a.dtype.nullable or b.dtype.nullable
+    return Func(dt.bigint(nullable), op, (a, b))
+
+
+def logic(op: str, *args: Expr) -> Func:
+    assert op in LOGIC_OPS, op
+    nullable = any(a.dtype.nullable for a in args)
+    return Func(dt.bigint(nullable), op, tuple(args))
+
+
+def is_null(a: Expr) -> Func:
+    return Func(dt.bigint(False), "isnull", (a,))
+
+
+def if_(cond: Expr, then: Expr, els: Expr) -> Func:
+    t = _branch_type([then, els])
+    return Func(t, "if", (cond, then, els))
+
+
+def case_when(pairs: Sequence[tuple[Expr, Expr]], els: Expr | None) -> Func:
+    """CASE WHEN c1 THEN v1 ... ELSE e END; args flattened as
+    (c1, v1, c2, v2, ..., [else])."""
+    vals = [v for _, v in pairs] + ([els] if els is not None else [])
+    t = _branch_type(vals)
+    args: list[Expr] = []
+    for c, v in pairs:
+        args += [c, v]
+    if els is not None:
+        args.append(els)
+    return Func(t, "case", tuple(args))
+
+
+def coalesce(*args: Expr) -> Func:
+    t = _branch_type(list(args))
+    return Func(t.with_nullable(all(a.dtype.nullable for a in args)), "coalesce", args)
+
+
+def ifnull(a: Expr, b: Expr) -> Func:
+    return coalesce(a, b)
+
+
+def _branch_type(vals: Sequence[Expr]) -> dt.DataType:
+    t = vals[0].dtype
+    for v in vals[1:]:
+        if v.dtype.kind == K.NULL:
+            t = t.with_nullable(True)
+            continue
+        if t.kind == K.NULL:
+            t = v.dtype.with_nullable(True)
+            continue
+        if v.dtype.kind != t.kind or v.dtype.scale != t.scale:
+            if t.is_numeric and v.dtype.is_numeric:
+                c = dt.common_numeric_type(t, v.dtype)
+                if c.kind == K.DECIMAL:
+                    sa = t.scale if t.kind == K.DECIMAL else 0
+                    sb = v.dtype.scale if v.dtype.kind == K.DECIMAL else 0
+                    c = dt.decimal(dt.DECIMAL64_MAX_PRECISION, max(sa, sb))
+                t = c.with_nullable(t.nullable or v.dtype.nullable)
+            else:
+                t = t.with_nullable(t.nullable or v.dtype.nullable)
+        else:
+            t = t.with_nullable(t.nullable or v.dtype.nullable)
+    return t
+
+
+def cast(a: Expr, to: dt.DataType) -> Expr:
+    if a.dtype.kind == to.kind and a.dtype.scale == to.scale:
+        return a
+    return Func(to.with_nullable(a.dtype.nullable), "cast", (a,))
+
+
+def in_list(a: Expr, items: Sequence[Expr]) -> Func:
+    nullable = a.dtype.nullable or any(i.dtype.nullable for i in items)
+    return Func(dt.bigint(nullable), "in", (a, *items))
+
+
+def between(a: Expr, lo: Expr, hi: Expr) -> Func:
+    return logic("and", compare("ge", a, lo), compare("le", a, hi))
+
+
+def temporal_part(part: str, a: Expr) -> Func:
+    """YEAR(x)/MONTH(x)/DAYOFMONTH(x) etc. over DATE/DATETIME columns."""
+    return Func(dt.bigint(a.dtype.nullable), part, (a,))
+
+
+def dict_map(col: Expr, mapping: np.ndarray) -> Func:
+    """Integer code-translation gather: remaps one dictionary's codes into a
+    shared (merged) code space so string columns with different dictionaries
+    compare/join correctly (the analog of collation sortkey normalization)."""
+    return Func(col.dtype, "dict_map",
+                (col, Const(dt.bigint(False), mapping.astype(np.int32))))
+
+
+def dict_lut(col: Expr, lut: np.ndarray, nullable: bool | None = None) -> Func:
+    """Boolean lookup-table gather over dictionary codes — how LIKE / IN /
+    collation predicates on strings execute on device (SURVEY.md §7)."""
+    if nullable is None:
+        nullable = col.dtype.nullable
+    return Func(dt.bigint(nullable), "dict_lut",
+                (col, Const(dt.bigint(False), lut.astype(np.bool_))))
+
+
+__all__ = [
+    "COMPARE_OPS", "LOGIC_OPS", "ARITH_OPS",
+    "lit", "decimal_lit", "arith", "neg", "compare", "logic", "is_null",
+    "if_", "case_when", "coalesce", "ifnull", "cast", "in_list", "between",
+    "temporal_part", "dict_lut", "dict_map",
+]

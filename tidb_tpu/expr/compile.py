@@ -1,0 +1,495 @@
+"""Expression evaluator/compiler with MySQL NULL + decimal semantics.
+
+Reference analog: pkg/expression's vectorized builtins
+(builtin_*_vec.go, VectorizedExecute chunk_executor.go:99).  Instead of ~315
+hand-written Go loop kernels, one recursive compiler lowers the IR to array
+ops in a namespace `xp` that is either:
+
+- ``jax.numpy`` — traced inside the fused coprocessor jit program; XLA fuses
+  the whole predicate/projection tree into the scan kernel (the TPU analog of
+  the closure executor, unistore/cophandler/closure_exec.go:468), or
+- ``numpy`` — host-side evaluation for root-executor residue (expressions the
+  capability registry refuses to push down, SURVEY.md §A.1).
+
+Every node evaluates to a pair ``(value, valid)``:
+
+- value: array in device representation (scaled ints for DECIMAL, dict codes
+  for STRING, days/micros for temporal); comparisons/logic yield bool arrays.
+- valid: bool array, or the literal ``True`` meaning "all valid" (so
+  non-nullable columns never materialize a mask).
+
+Three-valued logic, NULL propagation, decimal rescaling, and MySQL rounding
+all live here, golden-tested against python Decimal in tests/test_expr.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import numpy as np
+
+from ..types import dtypes as dt
+from ..types import decimal as dec
+from .ir import ColumnRef, Const, Expr, Func
+
+K = dt.TypeKind
+
+Pair = tuple[Any, Any]  # (value, valid)
+
+
+def vand(a, b):
+    if a is True:
+        return b
+    if b is True:
+        return a
+    return a & b
+
+
+class Evaluator:
+    """Evaluate IR over columns. `xp` = numpy or jax.numpy."""
+
+    def __init__(self, xp):
+        self.xp = xp
+
+    # -- public entry ---------------------------------------------------- #
+
+    def eval(self, e: Expr, cols: Sequence[Pair], memo: dict | None = None) -> Pair:
+        if memo is None:
+            memo = {}
+        key = id(e)
+        if key in memo:
+            return memo[key]
+        out = self._eval(e, cols, memo)
+        memo[key] = out
+        return out
+
+    # -- dispatch -------------------------------------------------------- #
+
+    def _eval(self, e: Expr, cols, memo) -> Pair:
+        if isinstance(e, ColumnRef):
+            return cols[e.index]
+        if isinstance(e, Const):
+            if e.value is None:
+                return self.xp.int64(0), False
+            if isinstance(e.value, np.ndarray):
+                return self.xp.asarray(e.value), True
+            return e.value, True
+        assert isinstance(e, Func)
+        fn = getattr(self, f"op_{e.op}", None)
+        if fn is None:
+            raise NotImplementedError(f"op {e.op}")
+        return fn(e, cols, memo)
+
+    # -- helpers --------------------------------------------------------- #
+
+    def _num(self, a: Expr, cols, memo, as_kind: K | None = None):
+        """Evaluate a numeric operand; cast bool compare-results to int."""
+        v, m = self.eval(a, cols, memo)
+        if getattr(v, "dtype", None) is not None and v.dtype == bool:
+            v = v.astype(self.xp.int64)
+        elif isinstance(v, bool):
+            v = int(v)
+        return v, m
+
+    def _to_common(self, e: Func, cols, memo):
+        """Evaluate both operands and unify numeric representation."""
+        a, b = e.args
+        va, ma = self._num(a, cols, memo)
+        vb, mb = self._num(b, cols, memo)
+        ka, kb = a.dtype.kind, b.dtype.kind
+        if ka in (K.FLOAT64, K.FLOAT32) or kb in (K.FLOAT64, K.FLOAT32):
+            va = self._as_double(va, a.dtype)
+            vb = self._as_double(vb, b.dtype)
+            return va, ma, vb, mb, dt.double()
+        if ka == K.DECIMAL or kb == K.DECIMAL:
+            sa = a.dtype.scale if ka == K.DECIMAL else 0
+            sb = b.dtype.scale if kb == K.DECIMAL else 0
+            s = max(sa, sb)
+            if sa < s:
+                va = va * dec.pow10(s - sa)
+            if sb < s:
+                vb = vb * dec.pow10(s - sb)
+            return va, ma, vb, mb, dt.decimal(18, s)
+        return va, ma, vb, mb, a.dtype
+
+    def _as_double(self, v, t: dt.DataType):
+        xp = self.xp
+        if t.kind == K.DECIMAL:
+            return v.astype(xp.float64) / float(dec.pow10(t.scale)) \
+                if hasattr(v, "astype") else float(v) / dec.pow10(t.scale)
+        if hasattr(v, "astype"):
+            return v.astype(xp.float64)
+        return float(v)
+
+    def _truthy(self, e: Expr, cols, memo) -> Pair:
+        """MySQL truthiness: nonzero numeric = true.  Scalar results are
+        wrapped as xp.bool_ so ``~``/``&`` keep boolean semantics (a python
+        bool would turn ``~True`` into -2 and poison validity masks)."""
+        v, m = self.eval(e, cols, memo)
+        if getattr(v, "dtype", None) is not None and v.dtype == bool:
+            return v, m
+        if isinstance(v, (bool, int, float)):
+            return self.xp.bool_(v != 0), m
+        return v != 0, m
+
+    # -- arithmetic ------------------------------------------------------ #
+
+    def op_add(self, e, cols, memo):
+        va, ma, vb, mb, t = self._to_common(e, cols, memo)
+        return va + vb, vand(ma, mb)
+
+    def op_sub(self, e, cols, memo):
+        va, ma, vb, mb, t = self._to_common(e, cols, memo)
+        return va - vb, vand(ma, mb)
+
+    def op_mul(self, e, cols, memo):
+        a, b = e.args
+        if e.dtype.kind == K.DECIMAL:
+            # scales add: no rescale needed before the integer multiply
+            va, ma = self._num(a, cols, memo)
+            vb, mb = self._num(b, cols, memo)
+            return va * vb, vand(ma, mb)
+        va, ma, vb, mb, _ = self._to_common(e, cols, memo)
+        return va * vb, vand(ma, mb)
+
+    def op_div(self, e, cols, memo):
+        xp = self.xp
+        a, b = e.args
+        if e.dtype.kind == K.DECIMAL:
+            sa = a.dtype.scale if a.dtype.kind == K.DECIMAL else 0
+            sb = b.dtype.scale if b.dtype.kind == K.DECIMAL else 0
+            k = e.dtype.scale - sa + sb
+            va, ma = self._num(a, cols, memo)
+            vb, mb = self._num(b, cols, memo)
+            # k < 0 (result scale capped below dividend scale): scale the
+            # divisor instead — pow10 must stay integral to keep exactness.
+            if k >= 0:
+                num, den = va * dec.pow10(k), vb
+            else:
+                num, den = va, vb * dec.pow10(-k)
+            return (_round_div(xp, num, den), _div_valid(xp, ma, mb, vb))
+        va, ma = self._num(a, cols, memo)
+        vb, mb = self._num(b, cols, memo)
+        va = self._as_double(va, a.dtype)
+        vb = self._as_double(vb, b.dtype)
+        safe = xp.where(vb == 0, 1.0, vb)
+        return va / safe, _div_valid(xp, ma, mb, vb)
+
+    def op_intdiv(self, e, cols, memo):
+        xp = self.xp
+        va, ma, vb, mb, t = self._to_common(e, cols, memo)
+        if t.kind == K.FLOAT64:
+            safe = xp.where(vb == 0, 1.0, vb)
+            q = xp.trunc(va / safe).astype(xp.int64)
+        else:
+            q = _trunc_div(xp, va, vb)
+        return q, _div_valid(xp, ma, mb, vb)
+
+    def op_mod(self, e, cols, memo):
+        xp = self.xp
+        va, ma, vb, mb, t = self._to_common(e, cols, memo)
+        if t.kind == K.FLOAT64:
+            safe = xp.where(vb == 0, 1.0, vb)
+            r = va - xp.trunc(va / safe) * vb
+        else:
+            r = va - _trunc_div(xp, va, vb) * vb
+        return r, _div_valid(xp, ma, mb, vb)
+
+    def op_neg(self, e, cols, memo):
+        v, m = self._num(e.args[0], cols, memo)
+        return -v, m
+
+    def op_abs(self, e, cols, memo):
+        v, m = self._num(e.args[0], cols, memo)
+        return self.xp.abs(v), m
+
+    # -- comparisons ----------------------------------------------------- #
+
+    def _cmp(self, e, cols, memo, fn):
+        a, b = e.args
+        if a.dtype.is_string and b.dtype.is_string:
+            # post-lowering both sides are dict codes / code thresholds
+            va, ma = self.eval(a, cols, memo)
+            vb, mb = self.eval(b, cols, memo)
+            return fn(va, vb), vand(ma, mb)
+        va, ma, vb, mb, _ = self._to_common(e, cols, memo)
+        return fn(va, vb), vand(ma, mb)
+
+    def op_eq(self, e, cols, memo):
+        return self._cmp(e, cols, memo, lambda a, b: a == b)
+
+    def op_ne(self, e, cols, memo):
+        return self._cmp(e, cols, memo, lambda a, b: a != b)
+
+    def op_lt(self, e, cols, memo):
+        return self._cmp(e, cols, memo, lambda a, b: a < b)
+
+    def op_le(self, e, cols, memo):
+        return self._cmp(e, cols, memo, lambda a, b: a <= b)
+
+    def op_gt(self, e, cols, memo):
+        return self._cmp(e, cols, memo, lambda a, b: a > b)
+
+    def op_ge(self, e, cols, memo):
+        return self._cmp(e, cols, memo, lambda a, b: a >= b)
+
+    # -- three-valued logic ---------------------------------------------- #
+
+    def op_and(self, e, cols, memo):
+        va, ma = self._truthy(e.args[0], cols, memo)
+        vb, mb = self._truthy(e.args[1], cols, memo)
+        val = va & vb
+        # NULL AND FALSE = FALSE:  valid if both valid, or either side is a valid FALSE
+        valid = _or3(vand(ma, mb), vand(ma, ~va), vand(mb, ~vb))
+        return val, valid
+
+    def op_or(self, e, cols, memo):
+        va, ma = self._truthy(e.args[0], cols, memo)
+        vb, mb = self._truthy(e.args[1], cols, memo)
+        val = va | vb
+        valid = _or3(vand(ma, mb), vand(ma, va), vand(mb, vb))
+        return val, valid
+
+    def op_xor(self, e, cols, memo):
+        va, ma = self._truthy(e.args[0], cols, memo)
+        vb, mb = self._truthy(e.args[1], cols, memo)
+        return va ^ vb, vand(ma, mb)
+
+    def op_not(self, e, cols, memo):
+        v, m = self._truthy(e.args[0], cols, memo)
+        return ~v, m
+
+    # -- NULL handling ---------------------------------------------------- #
+
+    def op_isnull(self, e, cols, memo):
+        v, m = self.eval(e.args[0], cols, memo)
+        if m is True:
+            return _broadcast_false(self.xp, v), True
+        if m is False:
+            return True, True
+        return ~m, True
+
+    def op_if(self, e, cols, memo):
+        xp = self.xp
+        c, cm = self._truthy(e.args[0], cols, memo)
+        tv, tm = self._branch_val(e, e.args[1], cols, memo)
+        ev, em = self._branch_val(e, e.args[2], cols, memo)
+        cond = c if cm is True else (c & cm)  # NULL condition -> else branch
+        val = xp.where(cond, tv, ev)
+        valid = xp.where(cond, _mask_arr(xp, tm, tv), _mask_arr(xp, em, ev))
+        return val, valid
+
+    def op_case(self, e, cols, memo):
+        xp = self.xp
+        args = e.args
+        has_else = len(args) % 2 == 1
+        pairs = [(args[i], args[i + 1]) for i in range(0, len(args) - (1 if has_else else 0), 2)]
+        if has_else:
+            acc_val, acc_valid = self._branch_val(e, args[-1], cols, memo)
+        else:
+            acc_val, acc_valid = xp.int64(0), False
+        # fold from last WHEN to first
+        for c, v in reversed(pairs):
+            cv, cm = self._truthy(c, cols, memo)
+            cond = cv if cm is True else (cv & cm)
+            bv, bm = self._branch_val(e, v, cols, memo)
+            acc_val = xp.where(cond, bv, acc_val)
+            acc_valid = xp.where(cond, _mask_arr(xp, bm, bv), _mask_arr(xp, acc_valid, acc_val))
+        return acc_val, acc_valid
+
+    def op_coalesce(self, e, cols, memo):
+        xp = self.xp
+        val, valid = self._branch_val(e, e.args[-1], cols, memo)
+        for a in reversed(e.args[:-1]):
+            av, am = self._branch_val(e, a, cols, memo)
+            use_a = _mask_arr(xp, am, av)
+            val = xp.where(use_a, av, val)
+            valid = use_a | _mask_arr(xp, valid, val)
+        return val, valid
+
+    def _branch_val(self, parent: Func, a: Expr, cols, memo) -> Pair:
+        """Evaluate a CASE/IF branch, coercing to the parent's result type."""
+        v, m = self.eval(a, cols, memo)
+        pk = parent.dtype.kind
+        if getattr(v, "dtype", None) is not None and v.dtype == bool:
+            v = v.astype(self.xp.int64)
+        elif isinstance(v, bool):
+            v = int(v)
+        if pk in (K.FLOAT64, K.FLOAT32) and a.dtype.kind not in (K.FLOAT64, K.FLOAT32):
+            v = self._as_double(v, a.dtype)
+        elif pk == K.DECIMAL:
+            sa = a.dtype.scale if a.dtype.kind == K.DECIMAL else 0
+            if sa < parent.dtype.scale:
+                v = v * dec.pow10(parent.dtype.scale - sa)
+        return v, m
+
+    # -- IN -------------------------------------------------------------- #
+
+    def op_in(self, e, cols, memo):
+        xp = self.xp
+        target, items = e.args[0], e.args[1:]
+        tv, tm = self._num(target, cols, memo) if target.dtype.is_numeric \
+            else self.eval(target, cols, memo)
+        any_match = None
+        all_valid = tm
+        for it in items:
+            iv, im = self._num(it, cols, memo) if it.dtype.is_numeric \
+                else self.eval(it, cols, memo)
+            # unify decimal scales between target and item
+            if target.dtype.kind == K.DECIMAL or it.dtype.kind == K.DECIMAL:
+                st = target.dtype.scale if target.dtype.kind == K.DECIMAL else 0
+                si = it.dtype.scale if it.dtype.kind == K.DECIMAL else 0
+                s = max(st, si)
+                a = tv * dec.pow10(s - st) if st < s else tv
+                b = iv * dec.pow10(s - si) if si < s else iv
+                match = a == b
+            else:
+                match = tv == iv
+            if im is not True:  # NULL/invalid item can never be a match
+                match = match & im
+            any_match = match if any_match is None else (any_match | match)
+            all_valid = vand(all_valid, im)
+        # true if any valid match; null if no match and some operand null
+        valid = _or3(all_valid, vand(tm, any_match), False)
+        return any_match, valid
+
+    # -- strings (post-lowering) ----------------------------------------- #
+
+    def op_dict_lut(self, e, cols, memo):
+        xp = self.xp
+        cv, cm = self.eval(e.args[0], cols, memo)
+        lut, _ = self.eval(e.args[1], cols, memo)
+        codes = xp.clip(cv, 0, lut.shape[0] - 1)
+        return lut[codes], cm
+
+    def op_dict_map(self, e, cols, memo):
+        xp = self.xp
+        cv, cm = self.eval(e.args[0], cols, memo)
+        mapping, _ = self.eval(e.args[1], cols, memo)
+        codes = xp.clip(cv, 0, mapping.shape[0] - 1)
+        return mapping[codes], cm
+
+    # -- temporal --------------------------------------------------------- #
+
+    def _days_of(self, a: Expr, cols, memo):
+        from ..types.temporal import MICROS_PER_DAY
+        v, m = self.eval(a, cols, memo)
+        if a.dtype.kind == K.DATETIME:
+            v = self.xp.floor_divide(v, MICROS_PER_DAY)
+        return v, m
+
+    def _ymd(self, a: Expr, cols, memo):
+        from ..types.temporal import civil_from_days
+        days, m = self._days_of(a, cols, memo)
+        y, mo, d = civil_from_days(self.xp, days)
+        return y, mo, d, m
+
+    def op_year(self, e, cols, memo):
+        y, _, _, m = self._ymd(e.args[0], cols, memo)
+        return y, m
+
+    def op_month(self, e, cols, memo):
+        _, mo, _, m = self._ymd(e.args[0], cols, memo)
+        return mo, m
+
+    def op_dayofmonth(self, e, cols, memo):
+        _, _, d, m = self._ymd(e.args[0], cols, memo)
+        return d, m
+
+    # -- casts ------------------------------------------------------------ #
+
+    def op_cast(self, e, cols, memo):
+        xp = self.xp
+        a = e.args[0]
+        v, m = self._num(a, cols, memo)
+        src, dst = a.dtype, e.dtype
+        if dst.kind in (K.FLOAT64, K.FLOAT32):
+            out = self._as_double(v, src)
+            if dst.kind == K.FLOAT32 and hasattr(out, "astype"):
+                out = out.astype(xp.float32)
+            return out, m
+        if dst.kind == K.DECIMAL:
+            if src.kind == K.DECIMAL:
+                ds = dst.scale - src.scale
+                if ds >= 0:
+                    return v * dec.pow10(ds), m
+                return _round_div(xp, v, dec.pow10(-ds)), m
+            if src.is_float:
+                scaled = v * float(dec.pow10(dst.scale))
+                out = xp.where(scaled >= 0, xp.floor(scaled + 0.5),
+                               xp.ceil(scaled - 0.5)).astype(xp.int64)
+                return out, m
+            return v * dec.pow10(dst.scale), m  # int -> decimal
+        if dst.kind in (K.INT64, K.UINT64):
+            ity = xp.int64 if dst.kind == K.INT64 else xp.uint64
+            if src.kind == K.DECIMAL:
+                out = _round_div(xp, v, dec.pow10(src.scale))
+                return (out.astype(ity) if hasattr(out, "astype") else out), m
+            if src.is_float:
+                out = xp.where(v >= 0, xp.floor(v + 0.5), xp.ceil(v - 0.5))
+                return out.astype(ity), m
+            return (v.astype(ity) if hasattr(v, "astype") else int(v)), m
+        raise NotImplementedError(f"cast {src} -> {dst}")
+
+
+# ---------------------------------------------------------------------- #
+
+def _or3(a, b, c):
+    out = a
+    for x in (b, c):
+        if x is True:
+            return True
+        if x is False:
+            continue
+        out = x if out is False else (out | x)
+    return out
+
+
+def _mask_arr(xp, m, like):
+    """Validity as an array broadcastable with `like`."""
+    if m is True:
+        return _broadcast_true(xp, like)
+    if m is False:
+        return _broadcast_false(xp, like)
+    return m
+
+
+def _broadcast_true(xp, like):
+    if hasattr(like, "shape") and like.shape:
+        return xp.ones(like.shape, dtype=bool)
+    return True
+
+
+def _broadcast_false(xp, like):
+    if hasattr(like, "shape") and like.shape:
+        return xp.zeros(like.shape, dtype=bool)
+    return False
+
+
+def _trunc_div(xp, a, b):
+    """Integer division truncating toward zero (MySQL DIV), div-by-0-safe."""
+    safe = xp.where(b == 0, 1, b)
+    q = xp.floor_divide(xp.abs(a), xp.abs(safe))
+    sign = xp.where((a < 0) != (safe < 0), -1, 1)
+    return sign * q
+
+
+def _round_div(xp, a, b):
+    """Integer division rounding half away from zero (MySQL decimal div)."""
+    safe = xp.where(b == 0, 1, b)
+    absb = xp.abs(safe)
+    q = xp.floor_divide(xp.abs(a) + absb // 2, absb)
+    sign = xp.where((a < 0) != (safe < 0), -1, 1)
+    return sign * q
+
+
+def _div_valid(xp, ma, mb, vb):
+    nz = vb != 0
+    return vand(vand(ma, mb), nz)
+
+
+def eval_expr(xp, e: Expr, cols: Sequence[Pair]) -> Pair:
+    return Evaluator(xp).eval(e, cols, {})
+
+
+__all__ = ["Evaluator", "eval_expr", "vand"]

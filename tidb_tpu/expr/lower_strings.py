@@ -1,0 +1,144 @@
+"""Lower string predicates onto dictionary codes.
+
+Reference analog: pkg/util/collate (collation-aware compares) and the string
+builtins in pkg/expression/builtin_string_vec.go / builtin_like.go.  The TPU
+design dictionary-encodes strings at columnarization time with a *sorted*
+dictionary (chunk/column.py StringDict), so:
+
+- `col <cmp> 'literal'`  →  integer compare of codes against a threshold
+  resolved host-side via binary search (lower/upper bound),
+- `col LIKE 'pat%'`, `col IN (...)`  →  a boolean lookup table computed once
+  host-side over the (small) dictionary, gathered on device (`dict_lut`).
+
+This pass runs at plan-binding time, when the target table snapshot (and its
+dictionaries) is known — the analog of ToPB serialization binding a plan to
+a region (SURVEY.md §A.1).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..chunk.column import StringDict
+from ..types import dtypes as dt
+from . import builders as B
+from .ir import ColumnRef, Const, Expr, Func
+
+K = dt.TypeKind
+
+
+def like_to_regex(pattern: str, escape: str = "\\") -> re.Pattern:
+    out = []
+    i = 0
+    while i < len(pattern):
+        ch = pattern[i]
+        if ch == escape and i + 1 < len(pattern):
+            out.append(re.escape(pattern[i + 1]))
+            i += 2
+            continue
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+        i += 1
+    return re.compile("^" + "".join(out) + "$", re.DOTALL)
+
+
+def _dict_for(e: Expr, dicts: dict[int, StringDict]) -> Optional[StringDict]:
+    if isinstance(e, ColumnRef) and e.dtype.is_string:
+        return dicts.get(e.index)
+    return None
+
+
+def _const_str(e: Expr) -> Optional[str]:
+    if isinstance(e, Const) and isinstance(e.value, str):
+        return e.value
+    return None
+
+
+_CMP_SWAP = {"eq": "eq", "ne": "ne", "lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}
+
+
+def lower_strings(e: Expr, dicts: dict[int, StringDict]) -> Expr:
+    """Rewrite string predicates to code-space ops. Non-string nodes recurse."""
+    if not isinstance(e, Func):
+        return e
+    args = tuple(lower_strings(a, dicts) for a in e.args)
+    e = Func(e.dtype, e.op, args)
+
+    if e.op in B.COMPARE_OPS and len(args) == 2:
+        # column-vs-column string compare: if the two sides use different
+        # dictionaries, remap both into a merged sorted code space first
+        # (codes are only comparable within one dictionary).
+        da, db = _dict_for(args[0], dicts), _dict_for(args[1], dicts)
+        if da is not None and db is not None and da is not db:
+            merged = sorted(set(da.values) | set(db.values))
+            idx = {v: i for i, v in enumerate(merged)}
+            map_a = np.fromiter((idx[v] for v in da.values), dtype=np.int32,
+                                count=len(da)) if len(da) else np.zeros(1, np.int32)
+            map_b = np.fromiter((idx[v] for v in db.values), dtype=np.int32,
+                                count=len(db)) if len(db) else np.zeros(1, np.int32)
+            return Func(e.dtype, e.op,
+                        (B.dict_map(args[0], map_a), B.dict_map(args[1], map_b)))
+
+        col, s, op = None, None, e.op
+        d = _dict_for(args[0], dicts)
+        if d is not None and _const_str(args[1]) is not None:
+            col, s = args[0], _const_str(args[1])
+        else:
+            d = _dict_for(args[1], dicts)
+            if d is not None and _const_str(args[0]) is not None:
+                col, s, op = args[1], _const_str(args[0]), _CMP_SWAP[e.op]
+        if col is not None:
+            return _lower_cmp(e.dtype, op, col, s, d)
+
+    if e.op == "like":
+        d = _dict_for(args[0], dicts)
+        p = _const_str(args[1])
+        if d is not None and p is not None:
+            rx = like_to_regex(p)
+            lut = np.fromiter((rx.match(v) is not None for v in d.values),
+                              dtype=bool, count=len(d))
+            return B.dict_lut(args[0], _pad_lut(lut))
+
+    if e.op == "in" and _dict_for(args[0], dicts) is not None:
+        d = _dict_for(args[0], dicts)
+        items = [_const_str(a) for a in args[1:]]
+        if all(s is not None for s in items):
+            lut = np.zeros(max(len(d), 1), dtype=bool)
+            for s in items:
+                c = d.code_of(s)
+                if c >= 0:
+                    lut[c] = True
+            return B.dict_lut(args[0], _pad_lut(lut))
+
+    return e
+
+
+def _pad_lut(lut: np.ndarray) -> np.ndarray:
+    return lut if len(lut) else np.zeros(1, dtype=bool)
+
+
+def _lower_cmp(dtype: dt.DataType, op: str, col: Expr, s: str, d: StringDict) -> Expr:
+    ic = lambda code: Const(dt.bigint(False), int(code))
+    if op == "eq":
+        return Func(dtype, "eq", (col, ic(d.code_of(s))))
+    if op == "ne":
+        return Func(dtype, "ne", (col, ic(d.code_of(s))))
+    if op == "lt":
+        return Func(dtype, "lt", (col, ic(d.lower_bound(s))))
+    if op == "le":
+        return Func(dtype, "lt", (col, ic(d.upper_bound(s))))
+    if op == "gt":
+        return Func(dtype, "ge", (col, ic(d.upper_bound(s))))
+    if op == "ge":
+        return Func(dtype, "ge", (col, ic(d.lower_bound(s))))
+    raise AssertionError(op)
+
+
+__all__ = ["lower_strings", "like_to_regex"]

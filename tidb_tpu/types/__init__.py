@@ -1,0 +1,11 @@
+from . import dtypes, decimal, temporal
+from .dtypes import (
+    TypeKind, DataType, bigint, ubigint, double, decimal as decimal_type,
+    varchar, date, datetime, time, null_type, common_numeric_type,
+)
+
+__all__ = [
+    "dtypes", "decimal", "temporal", "TypeKind", "DataType", "bigint",
+    "ubigint", "double", "decimal_type", "varchar", "date", "datetime",
+    "time", "null_type", "common_numeric_type",
+]

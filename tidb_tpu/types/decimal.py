@@ -1,0 +1,80 @@
+"""Scaled-integer DECIMAL support ("decimal64").
+
+Reference analog: pkg/types/mydecimal.go (9-digit word representation with
+up to 65 digits).  The TPU rebuild bounds DECIMAL to 18 significant digits and
+represents values as ``int64`` scaled by ``10**scale`` — dense, fixed-width,
+and exact, with MySQL half-up rounding implemented on integers.
+
+Aggregation-overflow safety: SUM over billions of rows can exceed int64, so
+device kernels accumulate decimals as *two int64 limbs* (hi = v >> 32,
+lo = v & 0xffffffff); the exact 128-bit total is recombined host-side with
+Python integers (see copr/aggregate.py).  This mirrors the reference's
+partial-agg-state contract (SURVEY.md §A.4) where cop tasks return partial
+states as plain columns.
+"""
+
+from __future__ import annotations
+
+import decimal as pydec
+from typing import Union
+
+import numpy as np
+
+_POW10 = [10 ** i for i in range(19)]
+
+
+def pow10(n: int) -> int:
+    # Negative exponents would silently produce floats and break the exact
+    # scaled-int contract; callers must rescale the other operand instead.
+    assert n >= 0, f"pow10({n})"
+    return _POW10[n] if n < len(_POW10) else 10 ** n
+
+
+def encode(value: Union[str, int, float, pydec.Decimal], scale: int) -> int:
+    """Encode a python value into a scaled int with MySQL half-up rounding."""
+    d = pydec.Decimal(str(value)) if not isinstance(value, pydec.Decimal) else value
+    q = d.scaleb(scale).quantize(pydec.Decimal(1), rounding=pydec.ROUND_HALF_UP)
+    return int(q)
+
+
+def decode(scaled: int, scale: int) -> pydec.Decimal:
+    return pydec.Decimal(scaled).scaleb(-scale)
+
+
+def to_string(scaled: int, scale: int) -> str:
+    """MySQL-style textual form with exactly `scale` fraction digits."""
+    sign = "-" if scaled < 0 else ""
+    mag = abs(int(scaled))
+    if scale == 0:
+        return f"{sign}{mag}"
+    intpart, frac = divmod(mag, pow10(scale))
+    return f"{sign}{intpart}.{frac:0{scale}d}"
+
+
+def rescale_np(data: np.ndarray, from_scale: int, to_scale: int) -> np.ndarray:
+    """Rescale a scaled-int array, half-up rounding on downscale."""
+    if to_scale == from_scale:
+        return data
+    if to_scale > from_scale:
+        return data * pow10(to_scale - from_scale)
+    div = pow10(from_scale - to_scale)
+    # round-half-away-from-zero on integers
+    half = div // 2
+    adj = np.where(data >= 0, data + half, data - half)
+    return adj // div
+
+
+def split_limbs(total: int) -> tuple[int, int]:
+    """Split into (hi, lo) with lo in [0, 2^32) — the device accumulator form."""
+    return total >> 32, total & 0xFFFFFFFF
+
+
+def combine_limbs(hi: int, lo: int) -> int:
+    """Recombine device partial sums; exact in Python ints."""
+    return (int(hi) << 32) + int(lo)
+
+
+__all__ = [
+    "pow10", "encode", "decode", "to_string", "rescale_np",
+    "split_limbs", "combine_limbs",
+]

@@ -1,0 +1,201 @@
+"""MySQL-compatible data type system, mapped onto TPU-friendly device dtypes.
+
+Reference analog: pkg/types (types/field_type.go, Datum) — but re-designed
+columnar-first: every SQL type has a dense fixed-width device representation
+so entire columns are XLA arrays; variable-width data (strings) is
+dictionary-encoded at columnarization time (SURVEY.md §7 "strings on device").
+
+Device representations:
+
+==============  =====================  =========================================
+SQL type        device dtype           encoding
+==============  =====================  =========================================
+BIGINT          int64                  value
+BIGINT UNSIGNED uint64                 value
+DOUBLE          float64                value
+FLOAT           float32                value
+DECIMAL(p,s)    int64                  value * 10**s (scaled integer, p<=18)
+CHAR/VARCHAR    int32                  code into per-column sorted dictionary
+DATE            int32                  days since 1970-01-01
+DATETIME        int64                  microseconds since 1970-01-01 00:00:00
+TIME            int64                  signed microseconds (duration)
+==============  =====================  =========================================
+
+The sorted dictionary gives string columns the property that *code order ==
+collation order* (binary / utf8mb4_bin), so range predicates and ORDER BY on
+strings compile to integer compares on device.  NULLs ride in a separate
+validity bitmap exactly like the reference's Arrow-layout chunk columns
+(pkg/util/chunk/column.go:71-81).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field, replace
+
+import numpy as np
+
+
+class TypeKind(enum.Enum):
+    INT64 = "bigint"
+    UINT64 = "bigint unsigned"
+    FLOAT64 = "double"
+    FLOAT32 = "float"
+    DECIMAL = "decimal"
+    STRING = "varchar"
+    DATE = "date"
+    DATETIME = "datetime"
+    TIME = "time"
+    NULL = "null"  # type of the NULL literal before inference
+
+
+# MySQL's default scale increment for division (divPrecisionIncrement),
+# reference: pkg/expression/builtin_arithmetic.go / types/mydecimal.
+DIV_FRAC_INCR = 4
+
+# Max decimal digits representable in the scaled-int64 encoding.
+DECIMAL64_MAX_PRECISION = 18
+
+
+@dataclass(frozen=True)
+class DataType:
+    kind: TypeKind
+    nullable: bool = True
+    # DECIMAL precision/scale (flen/decimal in the reference's FieldType).
+    prec: int = -1
+    scale: int = -1
+
+    # ------------------------------------------------------------------ #
+
+    @property
+    def is_numeric(self) -> bool:
+        return self.kind in (
+            TypeKind.INT64,
+            TypeKind.UINT64,
+            TypeKind.FLOAT64,
+            TypeKind.FLOAT32,
+            TypeKind.DECIMAL,
+        )
+
+    @property
+    def is_integer(self) -> bool:
+        return self.kind in (TypeKind.INT64, TypeKind.UINT64)
+
+    @property
+    def is_float(self) -> bool:
+        return self.kind in (TypeKind.FLOAT64, TypeKind.FLOAT32)
+
+    @property
+    def is_string(self) -> bool:
+        return self.kind == TypeKind.STRING
+
+    @property
+    def is_temporal(self) -> bool:
+        return self.kind in (TypeKind.DATE, TypeKind.DATETIME, TypeKind.TIME)
+
+    def np_dtype(self) -> np.dtype:
+        """numpy dtype of the dense host/device representation."""
+        return np.dtype(_NP_DTYPES[self.kind])
+
+    def with_nullable(self, nullable: bool) -> "DataType":
+        return replace(self, nullable=nullable)
+
+    def __str__(self) -> str:
+        if self.kind == TypeKind.DECIMAL:
+            return f"decimal({self.prec},{self.scale})"
+        return self.kind.value
+
+
+_NP_DTYPES = {
+    TypeKind.INT64: np.int64,
+    TypeKind.UINT64: np.uint64,
+    TypeKind.FLOAT64: np.float64,
+    TypeKind.FLOAT32: np.float32,
+    TypeKind.DECIMAL: np.int64,
+    TypeKind.STRING: np.int32,
+    TypeKind.DATE: np.int32,
+    TypeKind.DATETIME: np.int64,
+    TypeKind.TIME: np.int64,
+    TypeKind.NULL: np.int64,
+}
+
+
+# Convenience constructors -------------------------------------------------- #
+
+def bigint(nullable: bool = True) -> DataType:
+    return DataType(TypeKind.INT64, nullable)
+
+
+def ubigint(nullable: bool = True) -> DataType:
+    return DataType(TypeKind.UINT64, nullable)
+
+
+def double(nullable: bool = True) -> DataType:
+    return DataType(TypeKind.FLOAT64, nullable)
+
+
+def decimal(prec: int, scale: int, nullable: bool = True) -> DataType:
+    if prec > DECIMAL64_MAX_PRECISION:
+        prec = DECIMAL64_MAX_PRECISION
+    return DataType(TypeKind.DECIMAL, nullable, prec=prec, scale=scale)
+
+
+def varchar(nullable: bool = True) -> DataType:
+    return DataType(TypeKind.STRING, nullable)
+
+
+def date(nullable: bool = True) -> DataType:
+    return DataType(TypeKind.DATE, nullable)
+
+
+def datetime(nullable: bool = True) -> DataType:
+    return DataType(TypeKind.DATETIME, nullable)
+
+
+def time(nullable: bool = True) -> DataType:
+    return DataType(TypeKind.TIME, nullable)
+
+
+def null_type() -> DataType:
+    return DataType(TypeKind.NULL, True)
+
+
+# Type inference for arithmetic --------------------------------------------- #
+
+_NUMERIC_RANK = {
+    TypeKind.INT64: 0,
+    TypeKind.UINT64: 1,
+    TypeKind.DECIMAL: 2,
+    TypeKind.FLOAT32: 3,
+    TypeKind.FLOAT64: 4,
+}
+
+
+def common_numeric_type(a: DataType, b: DataType) -> DataType:
+    """MySQL-style result type of a binary arithmetic over `a` op `b`.
+
+    Mirrors the aggregate-type logic in pkg/expression/builtin_arithmetic.go:
+    int op int -> int; anything with decimal -> decimal; anything with
+    float -> double.
+    """
+    if a.kind == TypeKind.NULL:
+        return b
+    if b.kind == TypeKind.NULL:
+        return a
+    ra, rb = _NUMERIC_RANK.get(a.kind), _NUMERIC_RANK.get(b.kind)
+    if ra is None or rb is None:
+        # non-numeric operands coerce to double (MySQL string->number)
+        return double()
+    hi = a if ra >= rb else b
+    if hi.kind == TypeKind.DECIMAL:
+        scale = max(a.scale if a.kind == TypeKind.DECIMAL else 0,
+                    b.scale if b.kind == TypeKind.DECIMAL else 0)
+        return decimal(DECIMAL64_MAX_PRECISION, scale)
+    return DataType(hi.kind)
+
+
+__all__ = [
+    "TypeKind", "DataType", "DIV_FRAC_INCR", "DECIMAL64_MAX_PRECISION",
+    "bigint", "ubigint", "double", "decimal", "varchar", "date", "datetime",
+    "time", "null_type", "common_numeric_type",
+]

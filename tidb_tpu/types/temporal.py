@@ -1,0 +1,98 @@
+"""Temporal type encoding/decoding.
+
+Reference analog: pkg/types/time.go (core time types Time/Duration).  Device
+encodings are epoch-relative integers (DATE = int32 days, DATETIME = int64
+microseconds, TIME = int64 signed microseconds) so temporal predicates and
+EXTRACT compile to integer arithmetic on the VPU.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+
+import numpy as np
+
+EPOCH = _dt.date(1970, 1, 1)
+MICROS_PER_SEC = 1_000_000
+MICROS_PER_DAY = 86_400 * MICROS_PER_SEC
+
+
+def date_to_days(y: int, m: int, d: int) -> int:
+    return (_dt.date(y, m, d) - EPOCH).days
+
+
+def parse_date(s: str) -> int:
+    s = s.strip()
+    y, m, d = s.split("-")
+    return date_to_days(int(y), int(m), int(d))
+
+
+def days_to_date(days: int) -> _dt.date:
+    return EPOCH + _dt.timedelta(days=int(days))
+
+
+def date_to_string(days: int) -> str:
+    return days_to_date(days).isoformat()
+
+
+def parse_datetime(s: str) -> int:
+    s = s.strip()
+    if " " in s or "T" in s:
+        sep = " " if " " in s else "T"
+        dpart, tpart = s.split(sep, 1)
+    else:
+        dpart, tpart = s, "00:00:00"
+    days = parse_date(dpart)
+    parts = tpart.split(":")
+    h = int(parts[0]); mi = int(parts[1]) if len(parts) > 1 else 0
+    sec = parts[2] if len(parts) > 2 else "0"
+    if "." in sec:
+        sp, fp = sec.split(".")
+        micros = int((fp + "000000")[:6])
+        s_int = int(sp)
+    else:
+        micros, s_int = 0, int(sec)
+    return (days * MICROS_PER_DAY
+            + ((h * 60 + mi) * 60 + s_int) * MICROS_PER_SEC + micros)
+
+
+def datetime_to_string(micros: int) -> str:
+    micros = int(micros)
+    days, rem = divmod(micros, MICROS_PER_DAY)
+    d = days_to_date(days)
+    sec, us = divmod(rem, MICROS_PER_SEC)
+    h, rem2 = divmod(sec, 3600)
+    mi, s = divmod(rem2, 60)
+    base = f"{d.isoformat()} {h:02d}:{mi:02d}:{s:02d}"
+    return f"{base}.{us:06d}" if us else base
+
+
+# --- vectorized calendar decomposition (host precompute for device LUTs) --- #
+
+def civil_from_days(xp, days):
+    """Vectorized civil-from-days (Howard Hinnant's algorithm) in an array
+    namespace `xp` (numpy or jax.numpy) — shared by host decoding and the
+    device expression compiler (expr/compile.py year/month/dayofmonth)."""
+    z = (days.astype(xp.int64) if hasattr(days, "astype") else days) + 719468
+    era = xp.floor_divide(z, 146097)
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = xp.where(mp < 10, mp + 3, mp - 9)
+    y = xp.where(m <= 2, y + 1, y)
+    return y, m, d
+
+
+def year_month_day_np(days: np.ndarray) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    y, m, d = civil_from_days(np, days)
+    return y.astype(np.int64), m.astype(np.int64), d.astype(np.int64)
+
+
+__all__ = [
+    "EPOCH", "MICROS_PER_SEC", "MICROS_PER_DAY",
+    "date_to_days", "parse_date", "days_to_date", "date_to_string",
+    "parse_datetime", "datetime_to_string", "year_month_day_np",
+]
