@@ -1,17 +1,26 @@
 """Test env: 8 virtual CPU devices so multi-chip sharding (mesh/shard_map)
 is exercised without TPU hardware — the analog of the reference's unistore
 mock cluster (BootstrapWithMultiRegions) giving multi-node semantics in one
-process (SURVEY.md §4.2)."""
+process (SURVEY.md §4.2).
+
+NOTE: the driver image's sitecustomize imports jax at interpreter boot with
+JAX_PLATFORMS=axon (real TPU), so env vars set here are too late for the
+platform choice — but backends initialize lazily, so jax.config.update
+still wins as long as no computation ran.  XLA_FLAGS must also be set
+before the CPU backend initializes."""
 
 import os
 
-# Must run before jax is imported anywhere.  The driver env pins
-# JAX_PLATFORMS=axon (real TPU); tests always run on the virtual CPU mesh.
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) == 8, jax.devices()
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,9 @@
+"""Shared test helpers."""
+
+from tidb_tpu.chunk import Column
+
+
+def col_pair(col: Column):
+    """Column -> (data, validity) pair in the evaluator's encoding
+    (literal True = all-valid fast path)."""
+    return col.data, (True if col.validity.all() else col.validity)

@@ -19,8 +19,7 @@ from tidb_tpu.types import dtypes as dt
 from tidb_tpu.types import decimal as dec
 
 
-def col_pair(col: Column):
-    return col.data, (True if col.validity.all() else col.validity)
+from tests.helpers import col_pair
 
 
 def results(e, cols):

@@ -9,8 +9,7 @@ from tidb_tpu.types import dtypes as dt
 from tidb_tpu.types import decimal as dec
 
 
-def pair(col):
-    return col.data, (True if col.validity.all() else col.validity)
+from tests.helpers import col_pair as pair
 
 
 def test_in_with_null_item():
@@ -63,3 +62,46 @@ def test_decimal_div_high_scale_stays_exact():
     val, _ = eval_expr(np, e, [pair(a)])
     assert np.issubdtype(val.dtype, np.integer)
     assert int(val[0]) == 333333333333
+
+
+def test_date_vs_datetime_compare():
+    c = Column.from_values(dt.date(), ["1994-01-01", "1994-01-02"])
+    rc = ColumnRef(dt.date(), 0)
+    e = B.compare("eq", rc, B.lit("1994-01-01", dt.datetime()))
+    val, _ = eval_expr(np, e, [pair(c)])
+    assert list(np.asarray(val)) == [True, False]
+    e = B.compare("lt", rc, B.lit("1994-01-01 12:00:00", dt.datetime()))
+    val, _ = eval_expr(np, e, [pair(c)])
+    assert list(np.asarray(val)) == [True, False]
+
+
+def test_signed_unsigned_compare_exact():
+    big = 2**63
+    a = Column.from_values(dt.bigint(), [-1, 5, 2**62])
+    b = Column.from_values(dt.ubigint(), [big, 5, 2**62 + 1])
+    ra, rb = ColumnRef(dt.bigint(), 0), ColumnRef(dt.ubigint(), 1)
+    val, _ = eval_expr(np, B.compare("lt", ra, rb), [pair(a), pair(b)])
+    assert list(np.asarray(val)) == [True, False, True]
+    val, _ = eval_expr(np, B.compare("eq", ra, rb), [pair(a), pair(b)])
+    assert list(np.asarray(val)) == [False, True, False]
+
+
+def test_decimal_precision_propagation():
+    t1 = dt.decimal(12, 2)
+    e = B.arith("mul", ColumnRef(t1, 0), ColumnRef(t1, 1))
+    assert e.dtype.scale == 4 and e.dtype.prec == 18  # 24 saturated to 18
+    lit = B.decimal_lit("0.05")
+    assert lit.dtype.prec == 3 and lit.dtype.scale == 2
+    e2 = B.arith("mul", ColumnRef(t1, 0), lit)
+    assert e2.dtype.prec == 15 and e2.dtype.scale == 4
+
+
+def test_string_in_with_null_item():
+    c = Column.from_values(dt.varchar(), ["AIR", "SHIP"])
+    rc = ColumnRef(dt.varchar(), 0)
+    e = B.in_list(rc, [B.lit("AIR"), B.lit(None)])
+    e = lower_strings(e, {0: c.dictionary})
+    val, valid = eval_expr(np, e, [pair(c)])
+    # AIR -> TRUE; SHIP -> NULL (because of the NULL item)
+    assert bool(np.asarray(valid)[0]) and bool(np.asarray(val)[0])
+    assert not bool(np.asarray(valid)[1])

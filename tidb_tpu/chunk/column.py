@@ -11,8 +11,7 @@ and replaces variable-length string buffers with sorted-dictionary codes
 from __future__ import annotations
 
 import bisect
-import decimal as pydec
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Iterable, Optional, Sequence
 
 import numpy as np

@@ -1,0 +1,12 @@
+from .dag import (AggDesc, AggFunc, Aggregation, CopNode, GroupStrategy,
+                  Limit, Projection, Selection, TableScan, TopN,
+                  output_dtypes)
+from .exec import CopProgram, DeviceBatch, get_program
+from .aggregate import GroupKeyMeta, finalize, merge_states, sum_out_dtype
+
+__all__ = [
+    "AggDesc", "AggFunc", "Aggregation", "CopNode", "GroupStrategy", "Limit",
+    "Projection", "Selection", "TableScan", "TopN", "output_dtypes",
+    "CopProgram", "DeviceBatch", "get_program", "GroupKeyMeta", "finalize",
+    "merge_states", "sum_out_dtype",
+]

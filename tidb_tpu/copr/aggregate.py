@@ -1,0 +1,167 @@
+"""Aggregation partial-state merge + finalize (host side).
+
+Reference analog: the root-side final HashAgg workers
+(pkg/executor/aggregate/agg_hash_final_worker.go) merging cop-side partial
+states, per the partial-state contract of SURVEY.md §A.4: partial states
+travel as plain named arrays; algebraic merges are sums/mins/maxs, so the
+SPMD path replaces this whole module with psum/pmin/pmax on-device
+(parallel/collectives.py) — this host path is used for single-shard results,
+uneven leftovers, and as the differential-testing oracle.
+
+Decimal SUM exactness: device partials are (hi, lo) int64 limb sums;
+recombination (hi<<32)+lo happens here in Python ints (arbitrary precision),
+then range-checks back into decimal64.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from ..chunk.column import Column, StringDict
+from ..types import dtypes as dt
+from . import dag as D
+
+K = dt.TypeKind
+
+
+@dataclass
+class GroupKeyMeta:
+    """How to decode one dense group-key radix back into values."""
+    dtype: dt.DataType
+    size: int                      # domain size incl. NULL slot if nullable
+    dictionary: Optional[StringDict] = None
+
+
+# --------------------------------------------------------------------- #
+# merge
+# --------------------------------------------------------------------- #
+
+_MERGE = {
+    "count": "sum", "sum": "sum", "hi": "sum", "lo": "sum", "cnt": "sum",
+    "min": "min", "max": "max", "__rows__": "sum",
+}
+
+
+def merge_field(name: str, a, b):
+    how = _MERGE[name]
+    if how == "sum":
+        return a + b
+    return np.minimum(a, b) if how == "min" else np.maximum(a, b)
+
+
+def merge_states(states_list: Sequence[dict]) -> dict:
+    """Merge per-shard partial states.  Sums are merged in object dtype so
+    limb totals can't overflow int64 across many shards."""
+    def promote(name, arr):
+        arr = np.asarray(arr)
+        if _MERGE[name] == "sum" and arr.dtype == np.int64:
+            return arr.astype(object)
+        return arr
+
+    out: dict = {}
+    for st in states_list:
+        for key, val in st.items():
+            if isinstance(val, dict):
+                tgt = out.setdefault(key, {})
+                for f, arr in val.items():
+                    arr = promote(f, arr)
+                    tgt[f] = arr if f not in tgt else merge_field(f, tgt[f], arr)
+            else:
+                arr = promote(key, val)
+                out[key] = arr if key not in out else merge_field(key, out[key], arr)
+    return out
+
+
+# --------------------------------------------------------------------- #
+# finalize
+# --------------------------------------------------------------------- #
+
+def finalize(agg: D.Aggregation, merged: dict,
+             key_meta: Sequence[GroupKeyMeta]) -> tuple[list[Column], list[Column]]:
+    """Turn merged states into (group_key_columns, agg_value_columns),
+    dropping empty dense groups (occupancy == 0)."""
+    rows = np.asarray(merged["__rows__"])
+    if agg.strategy == D.GroupStrategy.SCALAR:
+        live = np.array([0])  # single pseudo-group; SQL returns 1 row
+        rows = rows.reshape(1)
+    else:
+        live = np.nonzero(rows > 0)[0]
+
+    key_cols = _decode_group_keys(live, key_meta) \
+        if agg.strategy == D.GroupStrategy.DENSE else []
+
+    agg_cols: list[Column] = []
+    for i, a in enumerate(agg.aggs):
+        st = {f: np.asarray(v).reshape(-1)[live] for f, v in merged[f"a{i}"].items()}
+        agg_cols.append(_finalize_one(a, st))
+    return key_cols, agg_cols
+
+
+def _decode_group_keys(live: np.ndarray,
+                       key_meta: Sequence[GroupKeyMeta]) -> list[Column]:
+    """Invert the mixed-radix dense group id (exec._dense_group_ids)."""
+    cols: list[Column] = []
+    rem = live.astype(np.int64)
+    strides = []
+    s = 1
+    for m in reversed(key_meta):
+        strides.append(s)
+        s *= m.size
+    strides.reverse()
+    for m, stride in zip(key_meta, strides):
+        code = (rem // stride) % m.size
+        if m.dtype.nullable:
+            valid = code > 0
+            code = np.maximum(code - 1, 0)
+        else:
+            valid = np.ones(len(code), bool)
+        data = code.astype(m.dtype.np_dtype())
+        cols.append(Column(m.dtype, data, valid, m.dictionary))
+    return cols
+
+
+def _finalize_one(a: D.AggDesc, st: dict) -> Column:
+    n = len(next(iter(st.values())))
+    out_t = a.out_dtype
+    if a.func == D.AggFunc.COUNT:
+        return Column(out_t, np.asarray(st["count"], np.int64),
+                      np.ones(n, bool))
+    cnt = np.asarray(st["cnt"], dtype=object)
+    valid = (cnt > 0).astype(bool)
+    if a.func == D.AggFunc.SUM:
+        if "hi" in st:  # decimal limbs
+            total = (st["hi"].astype(object) << 32) + st["lo"].astype(object)
+            _check_decimal_range(total)
+            data = np.where(valid, total, 0).astype(np.int64)
+        else:
+            data = np.where(valid, st["sum"], 0)
+            data = data.astype(out_t.np_dtype())
+        return Column(out_t, data, valid)
+    if a.func in (D.AggFunc.MIN, D.AggFunc.MAX):
+        field = "min" if a.func == D.AggFunc.MIN else "max"
+        data = np.where(valid, st[field], 0).astype(out_t.np_dtype())
+        return Column(out_t, data, valid)
+    raise NotImplementedError(a.func)
+
+
+def _check_decimal_range(total: np.ndarray) -> None:
+    lim = 10 ** dt.DECIMAL64_MAX_PRECISION
+    bad = [int(t) for t in total.reshape(-1) if abs(int(t)) >= lim * 10]
+    if bad:
+        # MySQL raises ER_DATA_OUT_OF_RANGE on decimal overflow
+        raise OverflowError(f"DECIMAL sum out of range: {bad[0]}")
+
+
+def sum_out_dtype(arg_t: dt.DataType) -> dt.DataType:
+    """MySQL result type of SUM(arg) bounded to decimal64."""
+    if arg_t.kind == K.DECIMAL:
+        return dt.decimal(dt.DECIMAL64_MAX_PRECISION, arg_t.scale)
+    if arg_t.kind in (K.FLOAT32, K.FLOAT64):
+        return dt.double()
+    return dt.decimal(dt.DECIMAL64_MAX_PRECISION, 0)  # SUM(int) -> DECIMAL(x,0)
+
+
+__all__ = ["GroupKeyMeta", "merge_states", "finalize", "sum_out_dtype"]

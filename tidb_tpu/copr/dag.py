@@ -1,0 +1,164 @@
+"""Coprocessor DAG — the serialized pushdown plan.
+
+Reference analog: tipb.DAGRequest / tipb.Executor (the protobuf executor
+tree TiDB ships to TiKV/TiFlash coprocessors; see SURVEY.md §A.1 for the
+exact node set the in-repo engine handles: TableScan, Selection, Projection,
+Aggregation, StreamAgg, TopN, Limit, ExchangeSender/Receiver...).
+
+The TPU build keeps the same tree shape as the unit of pushdown, but the
+"coprocessor" compiles the whole tree into ONE fused XLA program per plan
+digest (the closure-executor analog, unistore/cophandler/closure_exec.go:468)
+instead of interpreting operators row-batch by row-batch.  Nodes are frozen
+dataclasses so a DAG hashes to a jit-cache key (analog of the cop cache,
+pkg/store/copr/coprocessor_cache.go).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+from ..expr.ir import Expr
+from ..types import dtypes as dt
+
+
+class AggFunc(enum.Enum):
+    COUNT = "count"          # COUNT(expr): non-null count; arg None = COUNT(*)
+    SUM = "sum"
+    MIN = "min"
+    MAX = "max"
+    FIRST = "first"          # group key passthrough
+    # AVG never reaches the coprocessor: the planner splits it into
+    # SUM + COUNT exactly like the reference (SURVEY.md §A.4).
+
+
+@dataclass(frozen=True)
+class AggDesc:
+    """Aggregate function descriptor (expression/aggregation analog)."""
+    func: AggFunc
+    arg: Optional[Expr]          # None only for COUNT(*)
+    out_dtype: dt.DataType
+
+    def __str__(self) -> str:
+        return f"{self.func.value}({self.arg if self.arg is not None else '*'})"
+
+
+@dataclass(frozen=True)
+class CopNode:
+    def children(self) -> Tuple["CopNode", ...]:
+        return ()
+
+
+@dataclass(frozen=True)
+class TableScan(CopNode):
+    """Reads columns of one shard (region analog).  `col_offsets` index into
+    the shard's stored column order; the scan's output schema is exactly
+    these columns in this order (tipb.TableScan carries ColumnInfos)."""
+    col_offsets: Tuple[int, ...]
+    col_dtypes: Tuple[dt.DataType, ...]
+
+
+@dataclass(frozen=True)
+class Selection(CopNode):
+    child: CopNode = None  # type: ignore[assignment]
+    conditions: Tuple[Expr, ...] = ()
+
+    def children(self):
+        return (self.child,)
+
+
+@dataclass(frozen=True)
+class Projection(CopNode):
+    child: CopNode = None  # type: ignore[assignment]
+    exprs: Tuple[Expr, ...] = ()
+
+    def children(self):
+        return (self.child,)
+
+
+class GroupStrategy(enum.Enum):
+    SCALAR = "scalar"    # no GROUP BY: one output row
+    DENSE = "dense"      # small known key domain -> dense group ids
+    SORT = "sort"        # device sort + segment reduce (high NDV)
+
+
+@dataclass(frozen=True)
+class Aggregation(CopNode):
+    """Partial (per-shard) hash aggregation.
+
+    DENSE strategy: every group-by item must have a known finite code domain
+    (dict-encoded string column, or planner-bounded int).  `domain_sizes[i]`
+    is that size **including** a NULL slot when nullable; the fused kernel
+    reduces into a dense (prod(domain_sizes),) state vector — the psum seam.
+    SORT strategy handles unbounded domains via sort+segment-reduce into a
+    fixed-capacity group table.
+    """
+    child: CopNode = None  # type: ignore[assignment]
+    group_by: Tuple[Expr, ...] = ()
+    aggs: Tuple[AggDesc, ...] = ()
+    strategy: GroupStrategy = GroupStrategy.SCALAR
+    domain_sizes: Tuple[int, ...] = ()   # DENSE only, aligned with group_by
+    group_capacity: int = 0              # SORT only: max distinct groups/shard
+
+    def children(self):
+        return (self.child,)
+
+    @property
+    def num_groups(self) -> int:
+        n = 1
+        for s in self.domain_sizes:
+            n *= s
+        return n
+
+
+@dataclass(frozen=True)
+class TopN(CopNode):
+    """Per-shard TopN (root merges shard tops, reference cophandler/topn.go).
+    `sort_key` is a single int-comparable expression (the planner packs
+    multi-column keys or falls back to root sort); `desc` flips order."""
+    child: CopNode = None  # type: ignore[assignment]
+    sort_key: Expr = None  # type: ignore[assignment]
+    desc: bool = False
+    limit: int = 0
+    nulls_last: bool = False  # MySQL: NULLs first ASC, last DESC
+
+    def children(self):
+        return (self.child,)
+
+
+@dataclass(frozen=True)
+class Limit(CopNode):
+    child: CopNode = None  # type: ignore[assignment]
+    limit: int = 0
+
+    def children(self):
+        return (self.child,)
+
+
+def output_dtypes(node: CopNode) -> Tuple[dt.DataType, ...]:
+    """Schema of a node's output batch/states."""
+    if isinstance(node, TableScan):
+        return node.col_dtypes
+    if isinstance(node, (Selection, Limit)):
+        return output_dtypes(node.child)
+    if isinstance(node, TopN):
+        return output_dtypes(node.child)
+    if isinstance(node, Projection):
+        return tuple(e.dtype for e in node.exprs)
+    if isinstance(node, Aggregation):
+        return tuple(a.out_dtype for a in node.aggs)
+    raise TypeError(node)
+
+
+def dag_digest(node: CopNode) -> int:
+    """Stable-ish digest used as the jit-compile cache key together with the
+    shard capacity bucket (SURVEY.md §A.6)."""
+    return hash(node)
+
+
+__all__ = [
+    "AggFunc", "AggDesc", "CopNode", "TableScan", "Selection", "Projection",
+    "GroupStrategy", "Aggregation", "TopN", "Limit", "output_dtypes",
+    "dag_digest",
+]

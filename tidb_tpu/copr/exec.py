@@ -1,0 +1,348 @@
+"""Fused coprocessor execution: DAG -> one jit-compiled XLA program.
+
+Reference analog: unistore/cophandler/closure_exec.go:468 — the fused
+scan→selection→agg/topN/limit single-pass "closure" executor that is the
+CPU hot loop the TPU kernels replace.  Where the reference builds a Go
+closure per DAG, we trace the DAG once into jnp ops and let XLA fuse the
+whole pipeline into a handful of HBM-bandwidth-bound kernels; programs are
+cached per (dag digest, shard capacity) like the cop cache keys on
+(region version, request digest) (coprocessor_cache.go, SURVEY.md §A.6).
+
+Execution model: static shapes only (XLA).  A shard is a fixed-capacity
+batch of columns; live rows are tracked with a selection mask `sel` instead
+of compaction (dynamic shapes).  Row-returning plans compact on device into
+a caller-chosen capacity via cumsum-scatter; if the result overflows, the
+dispatcher retries with a larger capacity — the paging analog
+(kv.Request.Paging, SURVEY.md §5.7).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..expr.compile import Evaluator, vand
+from ..ops.sortkeys import INT64_MAX, INT64_MIN, sortable_int64
+from ..types import dtypes as dt
+from . import dag as D
+
+K = dt.TypeKind
+
+# Dense grouped reduction: below this group count, reduce via broadcast
+# compare (VPU-friendly, fuses into the scan); above, scatter-add.
+DENSE_BROADCAST_MAX_GROUPS = 64
+
+
+@dataclass
+class DeviceBatch:
+    """Columns + live-row selection mask flowing between fused operators."""
+    cols: list  # list[(value, valid)]
+    sel: Any    # bool array | True
+
+
+def _ensure_array(v, n):
+    if hasattr(v, "shape") and v.shape:
+        return v
+    return jnp.full((n,), v)
+
+
+def _sel_array(sel, n):
+    return jnp.ones((n,), bool) if sel is True else sel
+
+
+# --------------------------------------------------------------------- #
+# Aggregation partial states (the psum seam, SURVEY.md §A.4)
+# --------------------------------------------------------------------- #
+
+def _reduce(vals, mask, gids, num_groups, how: str):
+    """Masked (optionally grouped) reduction.
+
+    how: 'sum' | 'min' | 'max'.  gids None => scalar reduction.
+    Grouped: dense (G,) output; broadcast-compare for small G (fuses into
+    the scan pass), scatter otherwise.
+    """
+    neutral = {"sum": 0, "min": _max_of(vals.dtype), "max": _min_of(vals.dtype)}[how]
+    v = jnp.where(mask, vals, jnp.asarray(neutral, vals.dtype))
+    if gids is None:
+        return getattr(jnp, how)(v)
+    if num_groups <= DENSE_BROADCAST_MAX_GROUPS:
+        onehot = gids[None, :] == jnp.arange(num_groups, dtype=gids.dtype)[:, None]
+        vv = jnp.where(onehot, v[None, :], jnp.asarray(neutral, vals.dtype))
+        return getattr(jnp, how)(vv, axis=1)
+    out = jnp.full((num_groups,), neutral, vals.dtype)
+    if how == "sum":
+        return out.at[gids].add(v, mode="drop")
+    return getattr(out.at[gids], how)(v, mode="drop")
+
+
+def _max_of(dtype):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.inf
+    return jnp.iinfo(dtype).max
+
+
+def _min_of(dtype):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return -jnp.inf
+    return jnp.iinfo(dtype).min
+
+
+def _agg_partial_states(agg: D.Aggregation, batch: DeviceBatch, ev: Evaluator,
+                        memo: dict):
+    """Compute the per-shard partial-state pytree for an Aggregation node.
+
+    Layout per AggDesc (all named arrays so psum/pmin/pmax merges are
+    mechanical — see parallel/collectives.py MERGE_SPECS):
+      count -> {count}
+      sum   -> decimal: {hi, lo, cnt} (int64 limb split, exact 128-bit when
+               recombined host-side); int: {sum, cnt}; float: {sum, cnt}
+      min   -> {min, cnt};  max -> {max, cnt}
+    plus '__rows__' (COUNT(*) per group) for occupancy.
+    """
+    n = len(batch.cols[0][0]) if batch.cols else 0
+    sel = _sel_array(batch.sel, n)
+
+    gids = None
+    num_groups = 1
+    if agg.strategy == D.GroupStrategy.DENSE:
+        gids = _dense_group_ids(agg, batch, ev, memo)
+        num_groups = agg.num_groups
+
+    states: dict[str, Any] = {}
+    states["__rows__"] = _reduce(sel.astype(jnp.int64), sel, gids, num_groups, "sum")
+
+    for i, a in enumerate(agg.aggs):
+        key = f"a{i}"
+        if a.func == D.AggFunc.COUNT and a.arg is None:
+            states[key] = {"count": states["__rows__"]}
+            continue
+        av, am = ev.eval(a.arg, batch.cols, memo)
+        av = _ensure_array(av, n)
+        mask = sel if am is True else (sel & am)
+        if a.func == D.AggFunc.COUNT:
+            states[key] = {"count": _reduce(mask.astype(jnp.int64), mask, gids,
+                                            num_groups, "sum")}
+            continue
+        cnt = _reduce(mask.astype(jnp.int64), mask, gids, num_groups, "sum")
+        if a.func == D.AggFunc.SUM:
+            kind = a.arg.dtype.kind
+            if kind == K.DECIMAL:
+                v = av.astype(jnp.int64)
+                hi = _reduce(v >> 32, mask, gids, num_groups, "sum")
+                lo = _reduce(v & 0xFFFFFFFF, mask, gids, num_groups, "sum")
+                states[key] = {"hi": hi, "lo": lo, "cnt": cnt}
+            elif kind in (K.FLOAT64, K.FLOAT32):
+                states[key] = {"sum": _reduce(av.astype(jnp.float64), mask, gids,
+                                              num_groups, "sum"), "cnt": cnt}
+            else:
+                if av.dtype == bool:
+                    av = av.astype(jnp.int64)
+                states[key] = {"sum": _reduce(av.astype(jnp.int64), mask, gids,
+                                              num_groups, "sum"), "cnt": cnt}
+        elif a.func == D.AggFunc.MIN:
+            states[key] = {"min": _reduce(av, mask, gids, num_groups, "min"),
+                           "cnt": cnt}
+        elif a.func == D.AggFunc.MAX:
+            states[key] = {"max": _reduce(av, mask, gids, num_groups, "max"),
+                           "cnt": cnt}
+        else:
+            raise NotImplementedError(a.func)
+    return states
+
+
+def _dense_group_ids(agg: D.Aggregation, batch: DeviceBatch, ev: Evaluator,
+                     memo: dict):
+    """Mixed-radix dense group id from the group-by key codes.
+
+    Key domain [0, size_i); nullable keys get slot 0 for NULL and codes
+    shifted by one (domain_sizes already include the NULL slot)."""
+    n = len(batch.cols[0][0])
+    gid = jnp.zeros((n,), jnp.int32)
+    for e, size in zip(agg.group_by, agg.domain_sizes):
+        v, m = ev.eval(e, batch.cols, memo)
+        v = _ensure_array(v, n).astype(jnp.int32)
+        if e.dtype.nullable:
+            code = v + 1 if m is True else jnp.where(m, v + 1, 0)
+        else:
+            code = v
+        gid = gid * jnp.int32(size) + code
+    return gid
+
+
+# --------------------------------------------------------------------- #
+# Row output: device-side compaction (paging analog)
+# --------------------------------------------------------------------- #
+
+def compact(batch: DeviceBatch, capacity: int):
+    """Pack live rows to the front of fixed-size output buffers via
+    cumsum-scatter.  Returns (cols, count); rows past `capacity` are
+    dropped — callers compare count vs capacity and re-run bigger."""
+    n = len(batch.cols[0][0]) if batch.cols else 0
+    sel = _sel_array(batch.sel, n)
+    pos = jnp.cumsum(sel) - 1
+    idx = jnp.where(sel, pos, capacity)  # out-of-bounds => dropped
+    out_cols = []
+    for v, m in batch.cols:
+        v = _ensure_array(v, n)
+        if v.dtype == bool:
+            v = v.astype(jnp.int64)
+        data = jnp.zeros((capacity,), v.dtype).at[idx].set(v, mode="drop")
+        valid = jnp.zeros((capacity,), bool).at[idx].set(
+            _sel_array(m, n) if m is not True else jnp.ones((n,), bool),
+            mode="drop")
+        out_cols.append((data, valid))
+    return out_cols, jnp.sum(sel)
+
+
+# --------------------------------------------------------------------- #
+# Node execution (traced)
+# --------------------------------------------------------------------- #
+
+def _exec_node(node: D.CopNode, scan_cols: Sequence, row_count, ev: Evaluator):
+    if isinstance(node, D.TableScan):
+        cols = [scan_cols[off] for off in node.col_offsets]
+        n = len(cols[0][0]) if cols else 0
+        if getattr(row_count, "ndim", 0) == 0:
+            sel = jnp.arange(n) < row_count
+        else:
+            # caller supplied a precomputed live-row mask (e.g. several
+            # flattened shards with per-shard row counts, parallel/spmd.py)
+            sel = row_count
+        return DeviceBatch(list(cols), sel)
+
+    if isinstance(node, D.Selection):
+        batch = _exec_node(node.child, scan_cols, row_count, ev)
+        memo: dict = {}
+        sel = batch.sel
+        n = len(batch.cols[0][0])
+        for cond in node.conditions:
+            v, m = ev.eval(cond, batch.cols, memo)
+            v = _ensure_array(v, n)
+            if v.dtype != bool:
+                v = v != 0
+            keep = v if m is True else (v & m)  # NULL -> filtered out
+            sel = keep if sel is True else (sel & keep)
+        return DeviceBatch(batch.cols, sel)
+
+    if isinstance(node, D.Projection):
+        batch = _exec_node(node.child, scan_cols, row_count, ev)
+        memo = {}
+        n = len(batch.cols[0][0])
+        cols = []
+        for e in node.exprs:
+            v, m = ev.eval(e, batch.cols, memo)
+            cols.append((_ensure_array(v, n), m))
+        return DeviceBatch(cols, batch.sel)
+
+    if isinstance(node, D.Limit):
+        batch = _exec_node(node.child, scan_cols, row_count, ev)
+        n = len(batch.cols[0][0])
+        sel = _sel_array(batch.sel, n)
+        keep = sel & (jnp.cumsum(sel) <= node.limit)
+        return DeviceBatch(batch.cols, keep)
+
+    if isinstance(node, D.TopN):
+        batch = _exec_node(node.child, scan_cols, row_count, ev)
+        return _exec_topn(node, batch, ev)
+
+    raise TypeError(node)
+
+
+def _exec_topn(node: D.TopN, batch: DeviceBatch, ev: Evaluator) -> DeviceBatch:
+    """Per-shard TopN: order-preserving int64 key + lax.top_k + gather.
+
+    MySQL NULL ordering: NULLs first ASC, last DESC — i.e. NULL is the
+    smallest value in both cases, so mapping NULL->(INT64_MIN+1) is correct
+    for either direction; dead rows use INT64_MIN so they always lose."""
+    memo: dict = {}
+    n = len(batch.cols[0][0])
+    sel = _sel_array(batch.sel, n)
+    v, m = ev.eval(node.sort_key, batch.cols, memo)
+    v = _ensure_array(v, n)
+    kd = node.sort_key.dtype
+    key = sortable_int64(jnp, v, kd.is_float, kd.kind == K.UINT64)
+    # rank r: top_k picks LARGEST r first.  Valid keys clamped to
+    # [INT64_MIN+2, INT64_MAX] so the sentinels below stay unique and
+    # negation can't overflow.
+    key = jnp.maximum(key, INT64_MIN + 2)
+    if node.desc:
+        r = key
+        null_rank = INT64_MIN + 1   # MySQL: NULLs last in DESC
+    else:
+        r = -key                    # ascending: smallest key wins
+        null_rank = INT64_MAX       # MySQL: NULLs first in ASC
+    if m is not True:
+        r = jnp.where(m, r, null_rank)
+    r = jnp.where(sel, r, INT64_MIN)  # dead rows always lose
+    k = min(node.limit, n)
+    _, idx = lax.top_k(r, k)
+    live = jnp.sum(sel)
+    out_sel = jnp.arange(k) < jnp.minimum(live, k)
+    cols = []
+    for cv, cm in batch.cols:
+        cv = _ensure_array(cv, n)
+        cols.append((cv[idx],
+                     (cm[idx] if cm is not True else True)))
+    return DeviceBatch(cols, out_sel)
+
+
+# --------------------------------------------------------------------- #
+# Program build + cache
+# --------------------------------------------------------------------- #
+
+class CopProgram:
+    """A compiled coprocessor program for one DAG shape.
+
+    kind == 'agg': __call__(scan_cols, row_count) -> partial-state pytree
+    kind == 'rows': -> (cols, count) compacted to `row_capacity`
+    """
+
+    def __init__(self, dag_root: D.CopNode, row_capacity: int = 0):
+        self.root = dag_root
+        self.row_capacity = row_capacity
+        self.agg = _find_agg(dag_root)
+        self.kind = "agg" if self.agg is not None else "rows"
+        self._fn = jax.jit(self._trace)
+
+    def _trace(self, scan_cols, row_count):
+        # At the jit boundary "all valid" is encoded as None (a pytree node,
+        # hence static structure); inside the trace it becomes the literal
+        # True the Evaluator's fast paths key on.
+        scan_cols = [(v, True if m is None else m) for v, m in scan_cols]
+        ev = Evaluator(jnp)
+        if self.agg is not None:
+            batch = _exec_node(self.agg.child, scan_cols, row_count, ev)
+            return _agg_partial_states(self.agg, batch, ev, {})
+        batch = _exec_node(self.root, scan_cols, row_count, ev)
+        return compact(batch, self.row_capacity)
+
+    def __call__(self, scan_cols, row_count):
+        return self._fn(scan_cols, row_count)
+
+
+def _find_agg(node: D.CopNode) -> Optional[D.Aggregation]:
+    """The pushdown DAG holds at most one Aggregation, as the root
+    (mirrors tipb: agg is the final pushed executor)."""
+    if isinstance(node, D.Aggregation):
+        return node
+    return None
+
+
+@functools.lru_cache(maxsize=256)
+def _cached_program(dag_root: D.CopNode, row_capacity: int) -> CopProgram:
+    return CopProgram(dag_root, row_capacity)
+
+
+def get_program(dag_root: D.CopNode, row_capacity: int = 0) -> CopProgram:
+    """jit-program cache keyed on (dag digest, capacity) — the analog of the
+    coprocessor cache + plan-digest jit cache (SURVEY.md §A.6)."""
+    return _cached_program(dag_root, row_capacity)
+
+
+__all__ = ["DeviceBatch", "CopProgram", "get_program", "compact"]

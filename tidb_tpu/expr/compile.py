@@ -93,6 +93,7 @@ class Evaluator:
 
     def _to_common(self, e: Func, cols, memo):
         """Evaluate both operands and unify numeric representation."""
+        xp = self.xp
         a, b = e.args
         va, ma = self._num(a, cols, memo)
         vb, mb = self._num(b, cols, memo)
@@ -110,7 +111,22 @@ class Evaluator:
             if sb < s:
                 vb = vb * dec.pow10(s - sb)
             return va, ma, vb, mb, dt.decimal(18, s)
-        return va, ma, vb, mb, a.dtype
+        # DATE (days) vs DATETIME (micros): coerce DATE up, MySQL-style
+        if {ka, kb} == {K.DATE, K.DATETIME}:
+            from ..types.temporal import MICROS_PER_DAY
+            if ka == K.DATE:
+                va = _as_i64(xp, va) * MICROS_PER_DAY
+            else:
+                vb = _as_i64(xp, vb) * MICROS_PER_DAY
+            return va, ma, vb, mb, dt.datetime()
+        # mixed signed/unsigned BIGINT: numpy would silently promote to
+        # float64 (lossy past 2^53); compute in uint64 two's complement and
+        # let _cmp fix up sign-aware comparisons
+        if {ka, kb} == {K.INT64, K.UINT64}:
+            va = va.astype(xp.uint64) if hasattr(va, "astype") else xp.uint64(va)
+            vb = vb.astype(xp.uint64) if hasattr(vb, "astype") else xp.uint64(vb)
+            return va, ma, vb, mb, dt.ubigint()
+        return va, ma, vb, mb, (a.dtype if ka != K.NULL else b.dtype)
 
     def _as_double(self, v, t: dt.DataType):
         xp = self.xp
@@ -206,12 +222,26 @@ class Evaluator:
     # -- comparisons ----------------------------------------------------- #
 
     def _cmp(self, e, cols, memo, fn):
+        xp = self.xp
         a, b = e.args
         if a.dtype.is_string and b.dtype.is_string:
             # post-lowering both sides are dict codes / code thresholds
             va, ma = self.eval(a, cols, memo)
             vb, mb = self.eval(b, cols, memo)
             return fn(va, vb), vand(ma, mb)
+        if {a.dtype.kind, b.dtype.kind} == {K.INT64, K.UINT64}:
+            # sign-aware signed-vs-unsigned compare: a negative signed value
+            # orders below every unsigned value; otherwise compare in uint64.
+            va, ma = self._num(a, cols, memo)
+            vb, mb = self._num(b, cols, memo)
+            ua = _as_u64(xp, va)
+            ub = _as_u64(xp, vb)
+            res = fn(ua, ub)
+            if a.dtype.kind == K.INT64:
+                res = xp.where(va < 0, fn(xp.int64(-1), xp.int64(0)), res)
+            else:
+                res = xp.where(vb < 0, fn(xp.int64(0), xp.int64(-1)), res)
+            return res, vand(ma, mb)
         va, ma, vb, mb, _ = self._to_common(e, cols, memo)
         return fn(va, vb), vand(ma, mb)
 
@@ -362,12 +392,8 @@ class Evaluator:
         codes = xp.clip(cv, 0, lut.shape[0] - 1)
         return lut[codes], cm
 
-    def op_dict_map(self, e, cols, memo):
-        xp = self.xp
-        cv, cm = self.eval(e.args[0], cols, memo)
-        mapping, _ = self.eval(e.args[1], cols, memo)
-        codes = xp.clip(cv, 0, mapping.shape[0] - 1)
-        return mapping[codes], cm
+    # same clip+gather body: code translation reuses the LUT machinery
+    op_dict_map = op_dict_lut
 
     # -- temporal --------------------------------------------------------- #
 
@@ -452,6 +478,14 @@ def _mask_arr(xp, m, like):
     if m is False:
         return _broadcast_false(xp, like)
     return m
+
+
+def _as_i64(xp, v):
+    return v.astype(xp.int64) if hasattr(v, "astype") else xp.int64(v)
+
+
+def _as_u64(xp, v):
+    return v.astype(xp.uint64) if hasattr(v, "astype") else xp.uint64(v)
 
 
 def _broadcast_true(xp, like):

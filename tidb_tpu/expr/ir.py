@@ -13,8 +13,8 @@ decimal nodes carry (prec, scale).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import Any, Optional, Tuple
+from dataclasses import dataclass
+from typing import Any, Tuple
 
 import numpy as np
 

@@ -108,14 +108,20 @@ def lower_strings(e: Expr, dicts: dict[int, StringDict]) -> Expr:
 
     if e.op == "in" and _dict_for(args[0], dicts) is not None:
         d = _dict_for(args[0], dicts)
-        items = [_const_str(a) for a in args[1:]]
+        has_null = any(isinstance(a, Const) and a.value is None for a in args[1:])
+        items = [_const_str(a) for a in args[1:]
+                 if not (isinstance(a, Const) and a.value is None)]
         if all(s is not None for s in items):
             lut = np.zeros(max(len(d), 1), dtype=bool)
             for s in items:
                 c = d.code_of(s)
                 if c >= 0:
                     lut[c] = True
-            return B.dict_lut(args[0], _pad_lut(lut))
+            match = B.dict_lut(args[0], _pad_lut(lut))
+            if has_null:
+                # x IN (..., NULL): TRUE on match, else NULL
+                return B.case_when([(match, B.lit(1))], None)
+            return match
 
     return e
 

@@ -1,0 +1,3 @@
+from .sortkeys import float_sortable, sortable_int64, INT64_MIN, INT64_MAX
+
+__all__ = ["float_sortable", "sortable_int64", "INT64_MIN", "INT64_MAX"]

@@ -1,0 +1,39 @@
+"""Order-preserving int64 sort-key encodings for device sort/top-k.
+
+Reference analog: pkg/util/codec's memcomparable encodings (ints with
+sign-bit flip, etc.) — the same idea applied on-device: every orderable SQL
+value maps to an int64 whose natural order equals SQL order, so TopN/sort
+lower to `lax.top_k`/`lax.sort` on one int64 array.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+INT64_MIN = -(2 ** 63)
+INT64_MAX = 2 ** 63 - 1
+
+
+def float_sortable(v):
+    """Bijective IEEE754 double -> int64 with the same total order
+    (standard radix-sort transform; -NaN sorts lowest, +NaN highest).
+
+    Positive floats keep their bit pattern (already ordered); negative
+    floats need order reversal: s = INT64_MIN - 1 - b, computed as
+    -(b+1) + INT64_MIN to stay inside int64 range."""
+    b = lax.bitcast_convert_type(v.astype(jnp.float64), jnp.int64)
+    return jnp.where(b < 0, -(b + 1) + INT64_MIN, b)
+
+
+def sortable_int64(xp, val, kind_is_float: bool, kind_is_unsigned: bool = False):
+    """Map a device value array to order-preserving int64."""
+    if kind_is_float:
+        return float_sortable(val)
+    if kind_is_unsigned:
+        # uint64 order as int64: subtract 2^63 (sign-bit flip)
+        return (val.astype(jnp.int64) + INT64_MIN)
+    return val.astype(jnp.int64)
+
+
+__all__ = ["float_sortable", "sortable_int64", "INT64_MIN", "INT64_MAX"]

@@ -1,0 +1,5 @@
+from .mesh import SHARD_AXIS, get_mesh, sharded, replicated
+from .spmd import ShardedCopProgram, get_sharded_program
+
+__all__ = ["SHARD_AXIS", "get_mesh", "sharded", "replicated",
+           "ShardedCopProgram", "get_sharded_program"]

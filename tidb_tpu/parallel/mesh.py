@@ -1,0 +1,49 @@
+"""Device mesh management.
+
+Reference analog: the TiKV store topology + region placement that
+pkg/store/copr fans cop tasks out over.  On TPU the "cluster" is a
+jax.sharding.Mesh; shards (region analogs) are assigned to devices by
+position along the 'shard' axis, and the fan-out (copr worker pool) becomes
+one SPMD program (SURVEY.md §2.10 P1).
+
+The mesh is 1-D for the data-parallel scan path; MPP-style repartition
+joins reuse the same axis with all_to_all (P7).  Multi-host: jax.devices()
+spans all hosts under jax.distributed, so the same code scales from one
+chip to a pod — DCN only carries control traffic, ICI the collectives.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SHARD_AXIS = "shard"
+
+
+@functools.lru_cache(maxsize=8)
+def get_mesh(n_devices: Optional[int] = None) -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (SHARD_AXIS,))
+
+
+def shard_spec() -> P:
+    return P(SHARD_AXIS)
+
+
+def sharded(mesh: Mesh) -> NamedSharding:
+    """Sharding for (n_shards, capacity) stacked column arrays: shards are
+    split across devices, each shard contiguous in its device's HBM."""
+    return NamedSharding(mesh, P(SHARD_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+__all__ = ["SHARD_AXIS", "get_mesh", "shard_spec", "sharded", "replicated"]

@@ -1,0 +1,4 @@
+from .columnar import ColumnarSnapshot, snapshot_from_columns
+from .client import CopClient, CopResult
+
+__all__ = ["ColumnarSnapshot", "snapshot_from_columns", "CopClient", "CopResult"]

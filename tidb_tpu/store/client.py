@@ -1,0 +1,102 @@
+"""CopClient: dispatch coprocessor DAGs over the shard store.
+
+Reference analog: pkg/store/copr CopClient.Send → buildCopTasks →
+copIterator worker pool → per-region RPCs, with backoff/paging/retry
+(coprocessor.go:83-1353).  Here the fan-out is one SPMD program
+(parallel/spmd.py); what remains of the client is:
+
+- program-cache lookup per (dag digest, shard layout) — the cop cache seam,
+- the paging loop for row-returning plans: run with a capacity guess,
+  check reported true counts, double and re-run on overflow
+  (kv.Request.Paging grow-from-min analog, SURVEY.md §5.7),
+- epoch validation: snapshots carry an epoch; a concurrent write bumps it
+  and the device cache invalidates (region epoch-not-match analog).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import numpy as np
+
+from ..chunk.column import Column
+from ..copr import dag as D
+from ..copr.aggregate import GroupKeyMeta, finalize, merge_states
+from ..parallel.spmd import get_sharded_program
+from .columnar import ColumnarSnapshot
+
+# initial fraction of table rows assumed to survive a row-returning plan
+INITIAL_SELECTIVITY = 4  # capacity = max(rows/shards/4, 1024)
+
+
+@dataclass
+class CopResult:
+    """Decoded result of one pushdown: either agg groups or row columns."""
+    columns: list[Column]
+    key_columns: list[Column]
+
+
+class CopClient:
+    def __init__(self, mesh):
+        self.mesh = mesh
+
+    # ------------------------------------------------------------- #
+
+    def execute_agg(self, agg: D.Aggregation, snap: ColumnarSnapshot,
+                    key_meta: list[GroupKeyMeta]) -> CopResult:
+        cols, counts = snap.device_cols(self.mesh)
+        prog = get_sharded_program(agg, self.mesh)
+        states = prog(cols, counts)
+        states = jax.device_get(states)
+        merged = merge_states([states])
+        key_cols, agg_cols = finalize(agg, merged, key_meta)
+        return CopResult(agg_cols, key_cols)
+
+    # ------------------------------------------------------------- #
+
+    def execute_rows(self, root: D.CopNode, snap: ColumnarSnapshot,
+                     out_dtypes, dictionaries=None) -> list[Column]:
+        """Row-returning plan with the paging loop."""
+        n_dev = len(self.mesh.devices.reshape(-1))
+        is_topn = isinstance(root, D.TopN)
+        is_limit = isinstance(root, D.Limit)
+        if is_topn or is_limit:
+            cap = max(root.limit, 16)
+        else:
+            per_shard = -(-snap.num_rows // max(snap.n_shards, 1)) if snap.num_rows else 1
+            cap = max(_pow2(per_shard // INITIAL_SELECTIVITY), 1024)
+
+        cols, counts = snap.device_cols(self.mesh)
+        for _ in range(8):  # paging: grow until fits
+            prog = get_sharded_program(root, self.mesh, row_capacity=cap)
+            out_cols, out_counts = prog(cols, counts)
+            out_counts = np.asarray(jax.device_get(out_counts))
+            if is_topn or is_limit or (out_counts <= cap).all():
+                break
+            cap = _pow2(int(out_counts.max()))
+        else:
+            raise RuntimeError("paging loop did not converge")
+
+        out_cols = jax.device_get(out_cols)
+        per_dev_take = np.minimum(out_counts, cap)
+        result = []
+        for j, t in enumerate(out_dtypes):
+            data = np.concatenate([np.asarray(out_cols[j][0])[d, :per_dev_take[d]]
+                                   for d in range(n_dev)])
+            valid = np.concatenate([np.asarray(out_cols[j][1])[d, :per_dev_take[d]]
+                                    for d in range(n_dev)])
+            dic = dictionaries.get(j) if dictionaries else None
+            result.append(Column(t, data.astype(t.np_dtype()), valid, dic))
+        return result
+
+
+def _pow2(n: int) -> int:
+    c = 1
+    while c < max(n, 1):
+        c <<= 1
+    return c
+
+
+__all__ = ["CopClient", "CopResult"]

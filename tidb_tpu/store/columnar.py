@@ -1,0 +1,128 @@
+"""Columnar shard store: the TPU-resident table representation.
+
+Reference analog: a TiKV region holds a key range of rows; the coprocessor
+scans rows from the badger LSM per request (unistore/tikv/dbreader).  The
+TPU design columnarizes once at snapshot build time (the TiFlash
+raft-learner columnarization role, SURVEY.md §7 "hard parts" #6): a table
+snapshot is S shards of fixed capacity C, stored as stacked (S, C) numpy
+arrays (host) and cached on-device as sharded jax arrays keyed by epoch —
+the region-cache analog: epoch bumps invalidate device state
+(pkg/store/copr/region_cache.go).
+
+Shard boundaries are row-id ranges (the memcomparable ordering contract of
+SURVEY.md §A.2 reduces to row order here; range shards by key come with the
+KV path).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..chunk.column import Column, StringDict
+from ..types import dtypes as dt
+from ..parallel.mesh import sharded
+
+
+def _pow2_at_least(n: int) -> int:
+    c = 1
+    while c < n:
+        c <<= 1
+    return c
+
+
+@dataclass
+class ColumnarSnapshot:
+    """Immutable columnar snapshot of one table at an epoch."""
+    names: list[str]
+    dtypes: list[dt.DataType]
+    columns: list[Column]              # full-length host columns
+    epoch: int = 0
+    n_shards: int = 8
+    min_capacity: int = 1024
+
+    _device_cache: dict = field(default_factory=dict, repr=False)
+
+    @property
+    def num_rows(self) -> int:
+        return len(self.columns[0]) if self.columns else 0
+
+    @property
+    def dictionaries(self) -> dict[int, StringDict]:
+        return {i: c.dictionary for i, c in enumerate(self.columns)
+                if c.dictionary is not None}
+
+    # ---------------- shard plan ---------------- #
+
+    def shard_layout(self) -> tuple[int, int, np.ndarray]:
+        """(n_shards, capacity, counts[n_shards]).  Rows are split evenly;
+        capacity is a power-of-two bucket so jit programs recompile only on
+        bucket changes (padding buckets, SURVEY.md §7 hard part #3)."""
+        s = self.n_shards
+        n = self.num_rows
+        per = -(-n // s) if n else 0
+        cap = max(_pow2_at_least(per), self.min_capacity)
+        counts = np.minimum(np.maximum(n - np.arange(s) * per, 0), per)
+        return s, cap, counts.astype(np.int64)
+
+    def stacked_host(self) -> tuple[list, np.ndarray]:
+        """Stacked (S, C) host arrays [(data, validity|None), ...] + counts."""
+        s, cap, counts = self.shard_layout()
+        per = -(-self.num_rows // s) if self.num_rows else 0
+        cols = []
+        for c in self.columns:
+            data = np.zeros((s, cap), dtype=c.data.dtype)
+            valid = np.zeros((s, cap), dtype=bool)
+            for i in range(s):
+                lo = i * per
+                hi = min(lo + per, self.num_rows)
+                data[i, : hi - lo] = c.data[lo:hi]
+                valid[i, : hi - lo] = c.validity[lo:hi]
+            live = np.arange(cap)[None, :] < counts[:, None]
+            all_valid = bool(valid[live].all())
+            cols.append((data, None if all_valid else valid))
+        return cols, counts
+
+    # ---------------- device cache (region cache analog) ------------- #
+
+    def device_cols(self, mesh) -> tuple[list, Any]:
+        key = (id(mesh), self.epoch)
+        if key in self._device_cache:
+            return self._device_cache[key]
+        host_cols, counts = self.stacked_host()
+        # the shard axis must divide the mesh: pad with empty shards
+        # (count 0) so any shard plan runs on any mesh size
+        n_dev = mesh.devices.size
+        s = len(counts)
+        s_pad = -(-s // n_dev) * n_dev
+        if s_pad != s:
+            counts = np.concatenate([counts, np.zeros(s_pad - s, np.int64)])
+            host_cols = [
+                (np.concatenate([d, np.zeros((s_pad - s, d.shape[1]), d.dtype)]),
+                 None if v is None else
+                 np.concatenate([v, np.zeros((s_pad - s, v.shape[1]), bool)]))
+                for d, v in host_cols]
+        sh = sharded(mesh)
+        dev = []
+        for data, valid in host_cols:
+            d = jax.device_put(data, sh)
+            v = None if valid is None else jax.device_put(valid, sh)
+            dev.append((d, v))
+        dev_counts = jax.device_put(counts, sh)
+        self._device_cache.clear()     # one epoch resident at a time
+        self._device_cache[key] = (dev, dev_counts)
+        return self._device_cache[key]
+
+
+def snapshot_from_columns(names: Sequence[str], cols: Sequence[Column],
+                          n_shards: int = 8, epoch: int = 0,
+                          min_capacity: int = 1024) -> ColumnarSnapshot:
+    return ColumnarSnapshot(list(names), [c.dtype for c in cols], list(cols),
+                            epoch=epoch, n_shards=n_shards,
+                            min_capacity=min_capacity)
+
+
+__all__ = ["ColumnarSnapshot", "snapshot_from_columns"]

@@ -1,0 +1,127 @@
+"""TPC-H lineitem/part generator (numpy, vectorized).
+
+Distribution-faithful for the columns Q1/Q6/Q19 touch (quantity, discount,
+tax, shipdate ranges, returnflag/linestatus derivation); other columns are
+uniform fillers.  SF=1 ≈ 6M lineitem rows, as in the spec.
+
+Reference analog: the reference benchmarks against TPC-H via external
+tooling (BASELINE.md); this in-repo generator plays the role of the
+reference's benchdb data loaders (cmd/benchdb).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..chunk.column import Column, StringDict
+from ..types import dtypes as dt
+from ..types.temporal import parse_date
+
+DEC2 = dt.decimal(15, 2)
+
+LINEITEM_NAMES = [
+    "l_orderkey", "l_partkey", "l_suppkey", "l_linenumber", "l_quantity",
+    "l_extendedprice", "l_discount", "l_tax", "l_returnflag", "l_linestatus",
+    "l_shipdate", "l_commitdate", "l_receiptdate", "l_shipinstruct",
+    "l_shipmode",
+]
+
+SHIPMODES = ["AIR", "FOB", "MAIL", "RAIL", "REG AIR", "SHIP", "TRUCK"]
+SHIPINSTRUCT = ["COLLECT COD", "DELIVER IN PERSON", "NONE", "TAKE BACK RETURN"]
+
+_STARTDATE = parse_date("1992-01-01")
+_CURRENTDATE = parse_date("1995-06-17")
+_ENDDATE = parse_date("1998-12-01")
+
+
+def gen_lineitem(sf: float = 1.0, seed: int = 0,
+                 columns: list[str] | None = None) -> tuple[list[str], list[Column]]:
+    """Generate lineitem columns; `columns` restricts output (saves RAM)."""
+    n = int(6_000_000 * sf)
+    rng = np.random.default_rng(seed)
+    want = set(columns or LINEITEM_NAMES)
+    out_names, out_cols = [], []
+
+    def emit(name, col):
+        if name in want:
+            out_names.append(name)
+            out_cols.append(col)
+
+    orderkey = np.sort(rng.integers(1, max(int(1_500_000 * sf), 1) * 4 + 1, n))
+    emit("l_orderkey", Column.from_numpy(dt.bigint(False), orderkey))
+    partkey = rng.integers(1, max(int(200_000 * sf), 1) + 1, n)
+    emit("l_partkey", Column.from_numpy(dt.bigint(False), partkey))
+    emit("l_suppkey", Column.from_numpy(dt.bigint(False),
+                                        rng.integers(1, max(int(10_000 * sf), 1) + 1, n)))
+    emit("l_linenumber", Column.from_numpy(dt.bigint(False),
+                                           rng.integers(1, 8, n)))
+
+    qty = rng.integers(1, 51, n)
+    emit("l_quantity", Column.from_numpy(DEC2, qty * 100))
+
+    # extendedprice = qty * p_retailprice(partkey); retail ~ 90000+partkey%...
+    retail = (90000 + (partkey % 20001) + 100 * (partkey % 1000)) // 1  # cents
+    emit("l_extendedprice", Column.from_numpy(DEC2, qty * retail))
+
+    emit("l_discount", Column.from_numpy(DEC2, rng.integers(0, 11, n)))
+    emit("l_tax", Column.from_numpy(DEC2, rng.integers(0, 9, n)))
+
+    ship = _STARTDATE + rng.integers(1, 122 + 2406, n)  # orderdate+1..121 span
+    if {"l_returnflag", "l_linestatus", "l_shipdate", "l_commitdate",
+            "l_receiptdate"} & want:
+        receipt = ship + rng.integers(1, 31, n)
+        # returnflag: R or A (50/50) if receipt <= currentdate else N
+        returned = receipt <= _CURRENTDATE
+        ra = rng.random(n) < 0.5
+        flag = np.where(returned, np.where(ra, "R", "A"), "N")
+        fdict = StringDict(["A", "N", "R"])
+        codes, _ = fdict.encode_array(list(flag))
+        emit("l_returnflag", Column(dt.varchar(False), codes,
+                                    np.ones(n, bool), fdict))
+        status = np.where(ship > _CURRENTDATE, "O", "F")
+        sdict = StringDict(["F", "O"])
+        scodes, _ = sdict.encode_array(list(status))
+        emit("l_linestatus", Column(dt.varchar(False), scodes,
+                                    np.ones(n, bool), sdict))
+        emit("l_shipdate", Column.from_numpy(dt.date(False), ship))
+        emit("l_commitdate", Column.from_numpy(dt.date(False),
+                                               ship + rng.integers(-30, 31, n)))
+        emit("l_receiptdate", Column.from_numpy(dt.date(False), receipt))
+
+    if "l_shipinstruct" in want:
+        d = StringDict(SHIPINSTRUCT)
+        emit("l_shipinstruct",
+             Column(dt.varchar(False), rng.integers(0, len(d), n).astype(np.int32),
+                    np.ones(n, bool), d))
+    if "l_shipmode" in want:
+        d = StringDict(SHIPMODES)
+        emit("l_shipmode",
+             Column(dt.varchar(False), rng.integers(0, len(d), n).astype(np.int32),
+                    np.ones(n, bool), d))
+    return out_names, out_cols
+
+
+PART_NAMES = ["p_partkey", "p_brand", "p_size", "p_container"]
+
+CONTAINERS = [f"{a} {b}" for a in ["SM", "LG", "MED", "JUMBO", "WRAP"]
+              for b in ["CASE", "BOX", "BAG", "JAR", "PKG", "PACK", "CAN", "DRUM"]]
+
+
+def gen_part(sf: float = 1.0, seed: int = 1) -> tuple[list[str], list[Column]]:
+    n = int(200_000 * sf)
+    rng = np.random.default_rng(seed)
+    partkey = np.arange(1, n + 1)
+    bdict = StringDict([f"Brand#{i}{j}" for i in range(1, 6) for j in range(1, 6)])
+    brands = rng.integers(0, len(bdict), n).astype(np.int32)
+    cdict = StringDict(CONTAINERS)
+    containers = rng.integers(0, len(cdict), n).astype(np.int32)
+    cols = [
+        Column.from_numpy(dt.bigint(False), partkey),
+        Column(dt.varchar(False), brands, np.ones(n, bool), bdict),
+        Column.from_numpy(dt.bigint(False), rng.integers(1, 51, n)),
+        Column(dt.varchar(False), containers, np.ones(n, bool), cdict),
+    ]
+    return PART_NAMES, cols
+
+
+__all__ = ["gen_lineitem", "gen_part", "LINEITEM_NAMES", "PART_NAMES", "DEC2"]
