@@ -93,8 +93,9 @@ def main():
         res = client.execute_agg(agg, snap, meta)
         times.append(time.time() - t)
     q1_t = float(np.median(times))
-    q1_rps = n_rows / q1_t
-    log(f"TPU Q1: {q1_t*1e3:.1f} ms  {q1_rps/1e6:.1f} M rows/s")
+    n_chips = len(jax.devices())
+    q1_rps = n_rows / q1_t / n_chips
+    log(f"TPU Q1: {q1_t*1e3:.1f} ms  {q1_rps/1e6:.1f} M rows/s/chip ({n_chips} chips)")
 
     # correctness spot-check vs numpy
     exp = np_q1(cols, ix)
@@ -102,13 +103,10 @@ def main():
     assert got_counts == sorted(v[4] for v in exp.values()), "Q1 mismatch"
 
     # Q6 via the same path
-    from tests.test_copr import q6_dag  # reuse DAG builder
-    # NOTE: q6_dag assumes test column order; build inline instead
     from tidb_tpu import copr
     from tidb_tpu.copr import dag as D
     from tidb_tpu.expr import ColumnRef, builders as B
     from tidb_tpu.types import dtypes as dt
-    DEC2 = cols[ix["l_quantity"]].dtype
     r = lambda n: ColumnRef(cols[ix[n]].dtype, ix[n], n)
     scan = D.TableScan(tuple(range(len(names))), tuple(c.dtype for c in cols))
     sel = D.Selection(scan, (

@@ -228,3 +228,47 @@ def test_row_return_overflow_paging():
     out_cols, count = prog2(dev_cols(cols[:1]), jnp.int64(300))
     assert int(count) == 300
     np.testing.assert_array_equal(np.asarray(out_cols[0][0])[:300], cols[0].data)
+
+
+def test_topn_extreme_key_values():
+    """Review regression: extreme int64/uint64 keys must keep distinct ranks
+    at the limit boundary (the old clamp collapsed them)."""
+    import jax.numpy as jnp
+    imin = -(2**63)
+    c = Column.from_values(dt.bigint(), [imin + 2, imin, 5, imin + 1])
+    scan = D.TableScan((0,), (dt.bigint(),))
+    r = ColumnRef(dt.bigint(), 0)
+    prog = copr.get_program(D.TopN(scan, sort_key=r, desc=False, limit=2),
+                            row_capacity=4)
+    out_cols, cnt = prog(dev_cols([c]), jnp.int64(4))
+    got = [int(out_cols[0][0][i]) for i in range(2)]
+    assert got == [imin, imin + 1]
+
+    cu = Column.from_values(dt.ubigint(), [2, 0, 2**64 - 1, 1])
+    scanu = D.TableScan((0,), (dt.ubigint(),))
+    ru = ColumnRef(dt.ubigint(), 0)
+    prog = copr.get_program(D.TopN(scanu, sort_key=ru, desc=False, limit=2),
+                            row_capacity=4)
+    out_cols, cnt = prog(dev_cols([cu]), jnp.int64(4))
+    got = [int(out_cols[0][0][i]) for i in range(2)]
+    assert got == [0, 1]
+    prog = copr.get_program(D.TopN(scanu, sort_key=ru, desc=True, limit=1),
+                            row_capacity=4)
+    out_cols, cnt = prog(dev_cols([cu]), jnp.int64(4))
+    assert int(out_cols[0][0][0]) == 2**64 - 1
+
+
+def test_decimal_sum_overflow_raises():
+    big = 10**17
+    c = Column.from_numpy(dt.decimal(18, 0), np.full(20, big))
+    scan = D.TableScan((0,), (dt.decimal(18, 0),))
+    agg = D.Aggregation(scan, (), (D.AggDesc(
+        D.AggFunc.SUM, ColumnRef(dt.decimal(18, 0), 0),
+        copr.sum_out_dtype(dt.decimal(18, 0))),), D.GroupStrategy.SCALAR)
+    import jax.numpy as jnp
+    import pytest
+    prog = copr.get_program(agg)
+    states = prog(dev_cols([c]), jnp.int64(20))
+    merged = copr.merge_states([states])
+    with pytest.raises(OverflowError):
+        copr.finalize(agg, merged, [])

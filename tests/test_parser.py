@@ -1,0 +1,155 @@
+"""Parser tests over TPC-H-class SQL (pkg/parser test-style)."""
+
+import pytest
+
+from tidb_tpu.sql import ast as A
+from tidb_tpu.sql import parse_one, parse_sql, ParseError
+
+Q1 = """
+select l_returnflag, l_linestatus, sum(l_quantity) as sum_qty,
+  sum(l_extendedprice) as sum_base_price,
+  sum(l_extendedprice * (1 - l_discount)) as sum_disc_price,
+  sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as sum_charge,
+  avg(l_quantity) as avg_qty, avg(l_extendedprice) as avg_price,
+  avg(l_discount) as avg_disc, count(*) as count_order
+from lineitem
+where l_shipdate <= date '1998-12-01' - interval '90' day
+group by l_returnflag, l_linestatus
+order by l_returnflag, l_linestatus;
+"""
+
+Q6 = """
+select sum(l_extendedprice * l_discount) as revenue from lineitem
+where l_shipdate >= date '1994-01-01'
+  and l_shipdate < date '1994-01-01' + interval '1' year
+  and l_discount between 0.05 and 0.07 and l_quantity < 24;
+"""
+
+Q19 = """
+select sum(l_extendedprice* (1 - l_discount)) as revenue
+from lineitem, part
+where ( p_partkey = l_partkey and p_brand = 'Brand#12'
+    and p_container in ('SM CASE', 'SM BOX', 'SM PACK', 'SM PKG')
+    and l_quantity >= 1 and l_quantity <= 1 + 10 and p_size between 1 and 5
+    and l_shipmode in ('AIR', 'AIR REG')
+    and l_shipinstruct = 'DELIVER IN PERSON' )
+  or ( p_partkey = l_partkey and p_brand = 'Brand#23'
+    and p_container in ('MED BAG', 'MED BOX', 'MED PKG', 'MED PACK')
+    and l_quantity >= 10 and l_quantity <= 10 + 10 and p_size between 1 and 10
+    and l_shipmode in ('AIR', 'AIR REG')
+    and l_shipinstruct = 'DELIVER IN PERSON' );
+"""
+
+
+def test_q1_shape():
+    s = parse_one(Q1)
+    assert isinstance(s, A.SelectStmt)
+    assert len(s.items) == 10
+    assert s.items[2].alias == "sum_qty"
+    assert len(s.group_by) == 2 and len(s.order_by) == 2
+    assert isinstance(s.where, A.Binary) and s.where.op == "<="
+    rhs = s.where.right
+    assert isinstance(rhs, A.Binary) and rhs.op == "-"
+    assert rhs.right.kind == "interval" and rhs.right.unit == "DAY"
+
+
+def test_q6_shape():
+    s = parse_one(Q6)
+    assert isinstance(s.where, A.Binary) and s.where.op == "AND"
+    # find the BETWEEN
+    found = []
+    def walk(n):
+        if isinstance(n, A.BetweenExpr):
+            found.append(n)
+        for f in vars(n).values() if hasattr(n, "__dict__") else []:
+            if isinstance(f, A.Node):
+                walk(f)
+            elif isinstance(f, (list, tuple)):
+                for x in f:
+                    if isinstance(x, A.Node):
+                        walk(x)
+    walk(s.where)
+    assert len(found) == 1
+    assert found[0].low.kind == "decimal" and found[0].low.value == "0.05"
+
+
+def test_q19_shape():
+    s = parse_one(Q19)
+    assert isinstance(s.from_, A.Join) and s.from_.kind == "cross"
+    assert isinstance(s.where, A.Binary) and s.where.op == "OR"
+
+
+def test_joins():
+    s = parse_one("select * from a join b on a.x = b.y left join c on b.z = c.z")
+    j = s.from_
+    assert isinstance(j, A.Join) and j.kind == "left"
+    assert isinstance(j.left, A.Join) and j.left.kind == "inner"
+    s = parse_one("select * from a join b using (k1, k2)")
+    assert s.from_.using == ["k1", "k2"]
+
+
+def test_create_table():
+    s = parse_one("""
+      create table if not exists t (
+        id bigint primary key auto_increment,
+        name varchar(64) not null default 'x',
+        price decimal(15,2),
+        qty int unsigned,
+        ship date,
+        primary key (id),
+        key idx_name (name)
+      ) engine=innodb charset=utf8mb4""")
+    assert isinstance(s, A.CreateTable) and s.if_not_exists
+    assert [c.name for c in s.columns] == ["id", "name", "price", "qty", "ship"]
+    assert s.columns[2].type_name == "DECIMAL" and s.columns[2].prec == 15
+    assert s.columns[3].type_name == "INT UNSIGNED"
+    assert s.primary_key == ["id"]
+    assert s.columns[0].auto_increment
+
+
+def test_insert_update_delete():
+    s = parse_one("insert into t (a, b) values (1, 'x'), (2, null)")
+    assert isinstance(s, A.Insert) and len(s.rows) == 2
+    assert s.rows[1][1].kind == "null"
+    s = parse_one("update t set a = a + 1, b = 'y' where id = 3")
+    assert isinstance(s, A.Update) and len(s.assignments) == 2
+    s = parse_one("delete from t where a < 5")
+    assert isinstance(s, A.Delete)
+
+
+def test_case_in_subquery_from():
+    s = parse_one("""
+      select case when a = 1 then 'one' when a = 2 then 'two' else 'many' end
+      from (select a from t) sub order by 1 limit 5 offset 2""")
+    assert isinstance(s.items[0].expr, A.CaseExpr)
+    assert isinstance(s.from_, A.SubqueryRef) and s.from_.alias == "sub"
+    assert s.limit == 5 and s.offset == 2
+
+
+def test_operator_precedence():
+    s = parse_one("select 1 + 2 * 3 = 7 and not 0")
+    e = s.items[0].expr
+    assert e.op == "AND"
+    assert e.left.op == "="
+
+
+def test_misc_statements():
+    stmts = parse_sql("""
+      begin; commit; rollback;
+      use test; show tables; show databases;
+      set session tidb_distsql_scan_concurrency = 15;
+      explain select 1;
+      drop table if exists t1, t2;
+      truncate table t;
+    """)
+    kinds = [type(x).__name__ for x in stmts]
+    assert kinds == ["TxnStmt", "TxnStmt", "TxnStmt", "UseDatabase",
+                     "ShowStmt", "ShowStmt", "SetStmt", "Explain",
+                     "DropTable", "TruncateTable"]
+
+
+def test_errors():
+    with pytest.raises(ParseError):
+        parse_one("select from where")
+    with pytest.raises(ParseError):
+        parse_one("select * frm t")

@@ -1,0 +1,185 @@
+"""End-to-end SQL tests (testkit-style, SURVEY.md §4.2): full
+parse->plan->fused-TPU-kernel->result pipeline over the 8-device CPU mesh.
+"""
+
+import decimal as pydec
+
+import numpy as np
+import pytest
+
+from tidb_tpu.session import Domain, Session
+from tidb_tpu.session.catalog import TableInfo
+from tidb_tpu.testing.tpch import gen_lineitem, gen_part
+from tidb_tpu.types import dtypes as dt
+
+
+@pytest.fixture(scope="module")
+def tpch_session():
+    dom = Domain()
+    s = Session(dom)
+    names, cols = gen_lineitem(sf=0.002, seed=42)   # 12k rows
+    tbl = TableInfo("lineitem", names, [c.dtype for c in cols])
+    tbl.register_columns(cols)
+    dom.catalog.create_table("test", tbl)
+    pn, pc = gen_part(sf=0.01, seed=7)              # 2k parts
+    pt = TableInfo("part", pn, [c.dtype for c in pc])
+    pt.register_columns(pc)
+    dom.catalog.create_table("test", pt)
+    return s
+
+
+def test_tpch_q6(tpch_session):
+    s = tpch_session
+    rows = s.must_query("""
+      select sum(l_extendedprice * l_discount) as revenue from lineitem
+      where l_shipdate >= date '1994-01-01'
+        and l_shipdate < date '1994-01-01' + interval '1' year
+        and l_discount between 0.05 and 0.07 and l_quantity < 24""")
+    # numpy oracle
+    snap = s.domain.catalog.get_table("test", "lineitem").snapshot()
+    g = {n: c for n, c in zip(snap.names, snap.columns)}
+    m = ((g["l_shipdate"].data >= 8766) & (g["l_shipdate"].data < 9131)
+         & (g["l_discount"].data >= 5) & (g["l_discount"].data <= 7)
+         & (g["l_quantity"].data < 2400))
+    exp = int(np.sum(g["l_extendedprice"].data[m].astype(object)
+                     * g["l_discount"].data[m].astype(object)))
+    assert rows[0][0] == pydec.Decimal(exp).scaleb(-4)
+
+
+def test_tpch_q1(tpch_session):
+    s = tpch_session
+    rows = s.must_query("""
+      select l_returnflag, l_linestatus, sum(l_quantity) as sum_qty,
+        sum(l_extendedprice) as sum_base_price,
+        sum(l_extendedprice * (1 - l_discount)) as sum_disc_price,
+        sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as sum_charge,
+        avg(l_quantity) as avg_qty, count(*) as count_order
+      from lineitem where l_shipdate <= date '1998-12-01' - interval '90' day
+      group by l_returnflag, l_linestatus
+      order by l_returnflag, l_linestatus""")
+    assert len(rows) == 4  # A/F, N/F, N/O, R/F
+    assert [(r[0], r[1]) for r in rows] == [("A", "F"), ("N", "F"),
+                                            ("N", "O"), ("R", "F")]
+    snap = s.domain.catalog.get_table("test", "lineitem").snapshot()
+    g = {n: c for n, c in zip(snap.names, snap.columns)}
+    mask = g["l_shipdate"].data <= 10471
+    fvals = np.array(g["l_returnflag"].to_python())
+    svals = np.array(g["l_linestatus"].to_python())
+    for r in rows:
+        gm = mask & (fvals == r[0]) & (svals == r[1])
+        qty = g["l_quantity"].data
+        price = g["l_extendedprice"].data.astype(object)
+        disc = g["l_discount"].data.astype(object)
+        tax = g["l_tax"].data.astype(object)
+        assert r[2] == pydec.Decimal(int(qty[gm].sum())).scaleb(-2)
+        assert r[3] == pydec.Decimal(int(price[gm].sum())).scaleb(-2)
+        dp = (price[gm] * (100 - disc[gm])).sum()
+        assert r[4] == pydec.Decimal(int(dp)).scaleb(-4)
+        ch = (price[gm] * (100 - disc[gm]) * (100 + tax[gm])).sum()
+        assert r[5] == pydec.Decimal(int(ch)).scaleb(-6)
+        assert r[7] == int(gm.sum())
+        # avg = sum/count with MySQL scale s+4
+        exp_avg = (pydec.Decimal(int(qty[gm].sum())).scaleb(-2)
+                   / int(gm.sum())).quantize(pydec.Decimal("0.000001"),
+                                             rounding=pydec.ROUND_HALF_UP)
+        assert r[6] == exp_avg
+
+
+def test_tpch_q19_join(tpch_session):
+    s = tpch_session
+    rows = s.must_query("""
+      select sum(l_extendedprice * (1 - l_discount)) as revenue
+      from lineitem, part
+      where p_partkey = l_partkey and p_brand = 'Brand#12'
+        and l_quantity >= 1 and p_size between 1 and 25""")
+    snap = s.domain.catalog.get_table("test", "lineitem").snapshot()
+    psnap = s.domain.catalog.get_table("test", "part").snapshot()
+    li = {n: c for n, c in zip(snap.names, snap.columns)}
+    pa = {n: c for n, c in zip(psnap.names, psnap.columns)}
+    brand = np.array(pa["p_brand"].to_python())
+    pm = (brand == "Brand#12") & (pa["p_size"].data >= 1) & (pa["p_size"].data <= 25)
+    goodkeys = set(pa["p_partkey"].data[pm].tolist())
+    lm = np.array([k in goodkeys for k in li["l_partkey"].data]) \
+        & (li["l_quantity"].data >= 100)
+    exp = int((li["l_extendedprice"].data[lm].astype(object)
+               * (100 - li["l_discount"].data[lm].astype(object))).sum())
+    got = rows[0][0]
+    if exp == 0:
+        assert got is None
+    else:
+        assert got == pydec.Decimal(exp).scaleb(-4)
+
+
+def test_dml_roundtrip():
+    s = Session()
+    s.execute("create table acct (id bigint primary key, bal decimal(10,2), "
+              "name varchar(20))")
+    s.execute("insert into acct values (1, '10.00', 'alice'), "
+              "(2, '20.50', 'bob'), (3, null, null)")
+    assert s.execute("select count(*) from acct").scalar() == 3
+    s.execute("update acct set bal = bal + 5 where id <= 2")
+    rows = s.must_query("select id, bal from acct order by id")
+    assert str(rows[0][1]) == "15.00" and str(rows[1][1]) == "25.50"
+    assert rows[2][1] is None
+    s.execute("delete from acct where bal > 20")
+    assert s.execute("select count(*) from acct").scalar() == 2
+    # NULL bal row must survive (NULL > 20 is not TRUE)
+    assert s.must_query("select id from acct order by id") == [(1,), (3,)]
+
+
+def test_order_limit_distinct_having():
+    s = Session()
+    s.execute("create table t (a bigint, b bigint)")
+    s.execute("insert into t values (1,1),(1,2),(2,3),(2,4),(3,5),(3,6),(3,7)")
+    assert s.must_query("select distinct a from t order by a") == [(1,), (2,), (3,)]
+    rows = s.must_query(
+        "select a, count(*) c, sum(b) from t group by a having c >= 2 "
+        "order by a desc limit 2")
+    assert rows == [(3, 3, pydec.Decimal(18)), (2, 2, pydec.Decimal(7))]
+    assert s.must_query("select b from t order by b desc limit 2 offset 1") \
+        == [(6,), (5,)]
+
+
+def test_joins_outer():
+    s = Session()
+    s.execute("create table l (id bigint, v varchar(8))")
+    s.execute("create table r (id bigint, w bigint)")
+    s.execute("insert into l values (1,'a'),(2,'b'),(3,'c')")
+    s.execute("insert into r values (2,20),(3,30),(3,31),(4,40)")
+    rows = s.must_query("select l.id, v, w from l join r on l.id = r.id "
+                        "order by l.id, w")
+    assert rows == [(2, "b", 20), (3, "c", 30), (3, "c", 31)]
+    rows = s.must_query("select l.id, w from l left join r on l.id = r.id "
+                        "order by l.id, w")
+    assert rows == [(1, None), (2, 20), (3, 30), (3, 31)]
+    rows = s.must_query("select r.id, v from l right join r on l.id = r.id "
+                        "order by r.id")
+    assert rows == [(2, "b"), (3, "c"), (3, "c"), (4, None)]
+
+
+def test_explain_shows_coptask():
+    s = Session()
+    s.execute("create table t (a bigint, b varchar(4))")
+    s.execute("insert into t values (1,'x')")
+    rows = s.must_query("explain select b, count(*) from t where a > 0 group by b")
+    text = "\n".join(r[0] for r in rows)
+    assert "CopTask[agg]" in text and "TPU" in text
+
+
+def test_string_predicates_pushdown():
+    s = Session()
+    s.execute("create table t (a bigint, m varchar(10))")
+    s.execute("insert into t values (1,'AIR'),(2,'MAIL'),(3,'SHIP'),(4,null)")
+    assert s.must_query("select a from t where m = 'MAIL'") == [(2,)]
+    assert s.must_query("select a from t where m like '%AI%' order by a") \
+        == [(1,), (2,)]
+    assert s.must_query("select a from t where m in ('AIR','SHIP') order by a") \
+        == [(1,), (3,)]
+    assert s.must_query("select a from t where m is null") == [(4,)]
+    assert s.must_query("select min(m), max(m) from t") == [("AIR", "SHIP")]
+
+
+def test_scalar_no_from():
+    s = Session()
+    assert s.must_query("select 1 + 1, 'x'") == [(2, "x")]
+    assert s.must_query("select case when 1=1 then 2 else 3 end") == [(2,)]

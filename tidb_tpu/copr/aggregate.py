@@ -138,6 +138,8 @@ def _finalize_one(a: D.AggDesc, st: dict) -> Column:
             data = np.where(valid, total, 0).astype(np.int64)
         else:
             data = np.where(valid, st["sum"], 0)
+            if data.dtype == object and out_t.kind != K.FLOAT64:
+                _check_decimal_range(data)
             data = data.astype(out_t.np_dtype())
         return Column(out_t, data, valid)
     if a.func in (D.AggFunc.MIN, D.AggFunc.MAX):
@@ -148,11 +150,13 @@ def _finalize_one(a: D.AggDesc, st: dict) -> Column:
 
 
 def _check_decimal_range(total: np.ndarray) -> None:
+    # decimal64 holds at most DECIMAL64_MAX_PRECISION (18) digits; MySQL
+    # raises ER_DATA_OUT_OF_RANGE on decimal overflow
     lim = 10 ** dt.DECIMAL64_MAX_PRECISION
-    bad = [int(t) for t in total.reshape(-1) if abs(int(t)) >= lim * 10]
+    bad = [int(t) for t in np.asarray(total).reshape(-1) if abs(int(t)) >= lim]
     if bad:
-        # MySQL raises ER_DATA_OUT_OF_RANGE on decimal overflow
-        raise OverflowError(f"DECIMAL sum out of range: {bad[0]}")
+        raise OverflowError(
+            f"DECIMAL sum out of range (> {dt.DECIMAL64_MAX_PRECISION} digits): {bad[0]}")
 
 
 def sum_out_dtype(arg_t: dt.DataType) -> dt.DataType:

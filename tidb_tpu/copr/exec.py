@@ -113,6 +113,10 @@ def _agg_partial_states(agg: D.Aggregation, batch: DeviceBatch, ev: Evaluator,
     if agg.strategy == D.GroupStrategy.DENSE:
         gids = _dense_group_ids(agg, batch, ev, memo)
         num_groups = agg.num_groups
+    elif agg.strategy != D.GroupStrategy.SCALAR:
+        # SORT (high-NDV sort+segment-reduce) is not implemented yet; the
+        # planner routes such plans to the host aggregator instead
+        raise NotImplementedError(f"GroupStrategy.{agg.strategy.name}")
 
     states: dict[str, Any] = {}
     states["__rows__"] = _reduce(sel.astype(jnp.int64), sel, gids, num_groups, "sum")
@@ -255,11 +259,14 @@ def _exec_node(node: D.CopNode, scan_cols: Sequence, row_count, ev: Evaluator):
 
 
 def _exec_topn(node: D.TopN, batch: DeviceBatch, ev: Evaluator) -> DeviceBatch:
-    """Per-shard TopN: order-preserving int64 key + lax.top_k + gather.
+    """Per-shard TopN via a stable multi-key lax.sort + head-k gather.
 
-    MySQL NULL ordering: NULLs first ASC, last DESC — i.e. NULL is the
-    smallest value in both cases, so mapping NULL->(INT64_MIN+1) is correct
-    for either direction; dead rows use INT64_MIN so they always lose."""
+    Sort keys, ascending, in priority order: (1) dead-row flag so filtered
+    rows always sort last, (2) NULL flag encoding MySQL ordering (NULLs
+    first ASC, last DESC), (3) the order-preserving int64 key — bitwise-NOT
+    for DESC, an exact overflow-free order reversal.  No clamping: every
+    distinct key value keeps its rank (review finding: clamping collapsed
+    the extreme key values at the limit boundary)."""
     memo: dict = {}
     n = len(batch.cols[0][0])
     sel = _sel_array(batch.sel, n)
@@ -267,21 +274,18 @@ def _exec_topn(node: D.TopN, batch: DeviceBatch, ev: Evaluator) -> DeviceBatch:
     v = _ensure_array(v, n)
     kd = node.sort_key.dtype
     key = sortable_int64(jnp, v, kd.is_float, kd.kind == K.UINT64)
-    # rank r: top_k picks LARGEST r first.  Valid keys clamped to
-    # [INT64_MIN+2, INT64_MAX] so the sentinels below stay unique and
-    # negation can't overflow.
-    key = jnp.maximum(key, INT64_MIN + 2)
     if node.desc:
-        r = key
-        null_rank = INT64_MIN + 1   # MySQL: NULLs last in DESC
+        key = ~key               # exact descending order, no overflow
+    dead = (~sel).astype(jnp.int32)
+    if m is True:
+        nullflag = jnp.zeros(n, jnp.int32)
     else:
-        r = -key                    # ascending: smallest key wins
-        null_rank = INT64_MAX       # MySQL: NULLs first in ASC
-    if m is not True:
-        r = jnp.where(m, r, null_rank)
-    r = jnp.where(sel, r, INT64_MIN)  # dead rows always lose
+        # NULL sorts first in ASC, last in DESC
+        nullflag = jnp.where(m, 1, 0).astype(jnp.int32) if not node.desc \
+            else jnp.where(m, 0, 1).astype(jnp.int32)
+    *_, idx = lax.sort((dead, nullflag, key, jnp.arange(n)), num_keys=3)
     k = min(node.limit, n)
-    _, idx = lax.top_k(r, k)
+    idx = idx[:k]
     live = jnp.sum(sel)
     out_sel = jnp.arange(k) < jnp.minimum(live, k)
     cols = []

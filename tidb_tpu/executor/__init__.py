@@ -1,0 +1,2 @@
+from .physical import ExecContext, ResultChunk, PhysOp
+from .plan import to_physical

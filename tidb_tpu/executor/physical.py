@@ -1,0 +1,565 @@
+"""Physical planning (pushdown split) + host root executors.
+
+Reference analog: pkg/planner/core physicalOptimize's engine split (what
+goes to the coprocessor vs stays in root executors, SURVEY.md §A.1
+pushdown contract + capability registry) and pkg/executor's root operators
+(HashAgg final, Sort, HashJoin, Projection, Limit).
+
+Design: a maximal DataSource-[Selection]-[Projection]-[Agg|TopN|Limit]
+chain over one table becomes a CopTask — ONE fused XLA program fanned out
+via shard_map (parallel/spmd.py).  Everything else (joins, generic group
+keys, HAVING residue, multi-key sorts) runs here on host numpy chunks —
+the root-executor role.  Each host operator materializes its whole input
+(tables are memory-resident columnar snapshots; streaming chunks come with
+the paging/spill work).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from ..chunk.column import Column, StringDict
+from ..copr import dag as D
+from ..copr.aggregate import GroupKeyMeta, sum_out_dtype
+from ..expr.compile import eval_expr
+from ..expr.ir import ColumnRef, Const, Expr, Func, referenced_columns
+from ..expr.lower_strings import lower_strings
+from ..planner.logical import (AggItem, DataSource, LogicalAggregate,
+                               LogicalJoin, LogicalLimit, LogicalPlan,
+                               LogicalProjection, LogicalSelection,
+                               LogicalSort, LogicalTopN)
+from ..planner.build import DualSource
+from ..types import dtypes as dt
+
+K = dt.TypeKind
+
+# capability registry: ops the device evaluator implements — the analog of
+# scalarExprSupportedByTiKV/Flash whitelists (expression/infer_pushdown.go).
+DEVICE_OPS = {
+    "add", "sub", "mul", "div", "intdiv", "mod", "neg", "abs",
+    "eq", "ne", "lt", "le", "gt", "ge", "and", "or", "xor", "not",
+    "isnull", "if", "case", "coalesce", "in", "dict_lut", "dict_map",
+    "year", "month", "dayofmonth", "cast",
+}
+
+
+def _device_supported(e: Expr) -> bool:
+    if isinstance(e, Func):
+        if e.op not in DEVICE_OPS:
+            return False
+        return all(_device_supported(a) for a in e.args)
+    if isinstance(e, Const):
+        # raw string consts must have been lowered to codes/LUTs
+        return not isinstance(e.value, str)
+    return True
+
+
+# --------------------------------------------------------------------- #
+# execution context + result chunks
+# --------------------------------------------------------------------- #
+
+@dataclass
+class ExecContext:
+    client: Any            # store.CopClient
+    sysvars: Any = None
+
+
+@dataclass
+class ResultChunk:
+    names: list[str]
+    columns: list[Column]
+
+    @property
+    def num_rows(self):
+        return len(self.columns[0]) if self.columns else 0
+
+    def col_pairs(self):
+        return [(c.data, (True if c.validity.all() else c.validity))
+                for c in self.columns]
+
+
+class PhysOp:
+    out_names: list[str]
+    out_dtypes: list[dt.DataType]
+
+    def execute(self, ctx: ExecContext) -> ResultChunk:
+        raise NotImplementedError
+
+    def explain(self, indent=0):
+        pad = "  " * indent
+        lines = [pad + self.describe()]
+        for c in getattr(self, "children", []):
+            lines.append(c.explain(indent + 1))
+        return "\n".join(lines)
+
+    def describe(self):
+        return type(self).__name__
+
+
+# --------------------------------------------------------------------- #
+# CopTask: the pushed program
+# --------------------------------------------------------------------- #
+
+@dataclass
+class CopTaskExec(PhysOp):
+    """Fan one fused DAG out over the table's shards (TableReader analog,
+    executor/table_reader.go + distsql fan-out collapsed into SPMD)."""
+    dag: D.CopNode
+    table: Any
+    out_names: list[str] = field(default_factory=list)
+    out_dtypes: list = field(default_factory=list)
+    key_meta: list = field(default_factory=list)
+    out_dicts: dict = field(default_factory=dict)
+    children: list = field(default_factory=list)
+
+    def describe(self):
+        kind = "agg" if isinstance(self.dag, D.Aggregation) else "rows"
+        return f"CopTask[{kind}] table={self.table.name} -> TPU"
+
+    def execute(self, ctx: ExecContext) -> ResultChunk:
+        snap = self.table.snapshot()
+        if isinstance(self.dag, D.Aggregation):
+            res = ctx.client.execute_agg(self.dag, snap, self.key_meta)
+            cols = res.key_columns + res.columns
+            for j, d in self.out_dicts.items():
+                if cols[j].dictionary is None:
+                    cols[j].dictionary = d
+        else:
+            cols = ctx.client.execute_rows(self.dag, snap,
+                                           tuple(self.out_dtypes),
+                                           self.out_dicts)
+        return ResultChunk(list(self.out_names), cols)
+
+
+# --------------------------------------------------------------------- #
+# host operators
+# --------------------------------------------------------------------- #
+
+def _eval_to_column(e: Expr, chunk: ResultChunk) -> Column:
+    n = chunk.num_rows
+    v, m = eval_expr(np, e, chunk.col_pairs())
+    v = np.broadcast_to(np.asarray(v), (n,)).copy() if np.ndim(v) == 0 \
+        else np.asarray(v)
+    if v.dtype == bool:
+        v = v.astype(np.int64)
+    if m is True:
+        mv = np.ones(n, bool)
+    elif m is False:
+        mv = np.zeros(n, bool)
+    else:
+        mv = np.broadcast_to(np.asarray(m), (n,)).copy()
+    dic = _expr_dict(e, chunk)
+    if e.dtype.is_string and v.dtype.kind in ("U", "S", "O"):
+        # string-literal-producing expression (e.g. CASE ... THEN 'x'):
+        # dictionary-encode the result values host-side
+        vals = [str(x) for x in v]
+        d = StringDict(sorted({x for x, ok in zip(vals, mv) if ok}))
+        codes = np.fromiter((d.code_of(x) if ok else 0
+                             for x, ok in zip(vals, mv)), np.int32, count=n)
+        return Column(e.dtype, codes, mv, d)
+    return Column(e.dtype, v.astype(e.dtype.np_dtype()), mv, dic)
+
+
+def _expr_dict(e: Expr, chunk: ResultChunk) -> Optional[StringDict]:
+    """Propagate the dictionary for passthrough string columns."""
+    if isinstance(e, ColumnRef) and e.dtype.is_string:
+        return chunk.columns[e.index].dictionary
+    return None
+
+
+@dataclass
+class HostSelection(PhysOp):
+    child: PhysOp
+    conditions: list[Expr]
+
+    def __post_init__(self):
+        self.children = [self.child]
+        self.out_names = self.child.out_names
+        self.out_dtypes = self.child.out_dtypes
+
+    def execute(self, ctx):
+        chunk = self.child.execute(ctx)
+        keep = np.ones(chunk.num_rows, bool)
+        pairs = chunk.col_pairs()
+        for c in self.conditions:
+            v, m = eval_expr(np, c, pairs)
+            v = np.broadcast_to(np.asarray(v), (chunk.num_rows,))
+            if v.dtype != bool:
+                v = v != 0
+            if m is not True:
+                m = np.broadcast_to(np.asarray(m), (chunk.num_rows,))
+                v = v & m
+            keep &= v
+        idx = np.nonzero(keep)[0]
+        return ResultChunk(chunk.names, [c.take(idx) for c in chunk.columns])
+
+
+@dataclass
+class HostProjection(PhysOp):
+    child: PhysOp
+    exprs: list[Expr]
+    out_names: list[str] = field(default_factory=list)
+
+    def __post_init__(self):
+        self.children = [self.child]
+        self.out_dtypes = [e.dtype for e in self.exprs]
+
+    def execute(self, ctx):
+        chunk = self.child.execute(ctx)
+        cols = [_eval_to_column(e, chunk) for e in self.exprs]
+        return ResultChunk(list(self.out_names), cols)
+
+
+@dataclass
+class HostLimit(PhysOp):
+    child: PhysOp
+    limit: int
+    offset: int = 0
+
+    def __post_init__(self):
+        self.children = [self.child]
+        self.out_names = self.child.out_names
+        self.out_dtypes = self.child.out_dtypes
+
+    def execute(self, ctx):
+        chunk = self.child.execute(ctx)
+        lo, hi = self.offset, self.offset + self.limit
+        return ResultChunk(chunk.names, [c.slice(lo, min(hi, len(c)))
+                                         for c in chunk.columns])
+
+
+def _sort_keys_matrix(chunk: ResultChunk, keys) -> list[np.ndarray]:
+    """Per key: (null_rank, value_rank) arrays for lexsort; MySQL NULLs
+    sort first ASC / last DESC."""
+    out = []
+    for e, desc in keys:
+        v, m = eval_expr(np, e, chunk.col_pairs())
+        v = np.broadcast_to(np.asarray(v), (chunk.num_rows,))
+        if v.dtype == bool:
+            v = v.astype(np.int64)
+        if v.dtype == np.float64 or v.dtype == np.float32:
+            rank = v.astype(np.float64)
+            nullv = -np.inf
+        else:
+            rank = v.astype(np.int64)
+            nullv = np.iinfo(np.int64).min
+        if m is not True:
+            m = np.broadcast_to(np.asarray(m), (chunk.num_rows,))
+            rank = np.where(m, rank, nullv)
+        if desc:
+            rank = -rank if rank.dtype != np.float64 else -rank
+            if m is not True:
+                rank = np.where(m, rank, np.inf if rank.dtype == np.float64
+                                else np.iinfo(np.int64).max)
+        out.append(rank)
+    return out
+
+
+@dataclass
+class HostSort(PhysOp):
+    child: PhysOp
+    keys: list  # [(Expr, desc)]
+
+    def __post_init__(self):
+        self.children = [self.child]
+        self.out_names = self.child.out_names
+        self.out_dtypes = self.child.out_dtypes
+
+    def execute(self, ctx):
+        chunk = self.child.execute(ctx)
+        ranks = _sort_keys_matrix(chunk, self.keys)
+        idx = np.lexsort(tuple(reversed(ranks))) if ranks else np.arange(chunk.num_rows)
+        return ResultChunk(chunk.names, [c.take(idx) for c in chunk.columns])
+
+
+@dataclass
+class HostTopN(PhysOp):
+    child: PhysOp
+    keys: list
+    limit: int
+    offset: int = 0
+
+    def __post_init__(self):
+        self.children = [self.child]
+        self.out_names = self.child.out_names
+        self.out_dtypes = self.child.out_dtypes
+
+    def execute(self, ctx):
+        chunk = HostSort(self.child, self.keys).execute(ctx)
+        lo, hi = self.offset, self.offset + self.limit
+        return ResultChunk(chunk.names, [c.slice(lo, min(hi, len(c)))
+                                         for c in chunk.columns])
+
+
+@dataclass
+class HostHashJoin(PhysOp):
+    """Host hash join (join/hash_join_v2.go analog, numpy build+probe)."""
+    kind: str
+    left: PhysOp = None
+    right: PhysOp = None
+    eq_keys: list = field(default_factory=list)
+    other_conds: list = field(default_factory=list)
+    out_names: list = field(default_factory=list)
+    out_dtypes: list = field(default_factory=list)
+
+    def __post_init__(self):
+        self.children = [self.left, self.right]
+
+    def describe(self):
+        return f"HostHashJoin[{self.kind}] keys={len(self.eq_keys)}"
+
+    def execute(self, ctx):
+        lc = self.left.execute(ctx)
+        rc = self.right.execute(ctx)
+        li, ri = self._match(lc, rc)
+        cols = ([c.take(li) for c in lc.columns]
+                + [_take_nullable(c, ri) for c in rc.columns]) \
+            if self.kind == "left" else (
+                [_take_nullable(c, li) for c in lc.columns]
+                + [c.take(ri) for c in rc.columns]) \
+            if self.kind == "right" else (
+                [c.take(li) for c in lc.columns]
+                + [c.take(ri) for c in rc.columns])
+        chunk = ResultChunk(lc.names + rc.names, cols)
+        if self.other_conds:
+            # residual filter; for outer joins: matched rows only semantics
+            chunk = _filter_chunk(chunk, self.other_conds, self.kind,
+                                  len(lc.columns), li if self.kind == "right" else ri)
+        return chunk
+
+    def _match(self, lc: ResultChunk, rc: ResultChunk):
+        nl, nr = lc.num_rows, rc.num_rows
+        if not self.eq_keys:  # cartesian
+            li = np.repeat(np.arange(nl), nr)
+            ri = np.tile(np.arange(nr), nl)
+            return self._outer_fix(li, ri, nl, nr)
+        lkeys, rkeys = [], []
+        for lk, rk in self.eq_keys:
+            a, b = _join_key_arrays(lc.columns[lk], rc.columns[rk])
+            lkeys.append(a)
+            rkeys.append(b)
+        lpack = _pack_rows(lkeys)
+        rpack = _pack_rows(rkeys)
+        # build on right, probe left (numpy sort-merge on packed keys)
+        order = np.argsort(rpack, kind="stable")
+        rsorted = rpack[order]
+        lo = np.searchsorted(rsorted, lpack, "left")
+        hi = np.searchsorted(rsorted, lpack, "right")
+        counts = hi - lo
+        li = np.repeat(np.arange(nl), counts)
+        ri = order[_ragged_ranges(lo, counts)]
+        return self._outer_fix(li, ri, nl, nr, counts)
+
+    def _outer_fix(self, li, ri, nl, nr, counts=None):
+        if self.kind == "left":
+            miss = (np.nonzero(counts == 0)[0] if counts is not None
+                    else np.array([], np.int64))
+            li = np.concatenate([li, miss])
+            ri = np.concatenate([ri, np.full(len(miss), -1, np.int64)])
+        elif self.kind == "right":
+            matched = np.zeros(nr, bool)
+            matched[ri] = True
+            miss = np.nonzero(~matched)[0]
+            li = np.concatenate([li, np.full(len(miss), -1, np.int64)])
+            ri = np.concatenate([ri, miss])
+        return li, ri
+
+
+def _join_key_arrays(a: Column, b: Column):
+    """Key columns as comparable int64 arrays; cross-dictionary strings are
+    remapped into a merged code space; NULL keys get a sentinel that never
+    matches (inner-join semantics for NULL = NULL)."""
+    av, bv = a.data.astype(np.int64, copy=True), b.data.astype(np.int64, copy=True)
+    if a.dtype.is_string and b.dtype.is_string and a.dictionary is not b.dictionary:
+        merged = {v: i for i, v in enumerate(
+            sorted(set(a.dictionary.values) | set(b.dictionary.values)))}
+        am = np.array([merged[v] for v in a.dictionary.values] or [0])
+        bm = np.array([merged[v] for v in b.dictionary.values] or [0])
+        av = am[np.clip(a.data, 0, len(am) - 1)]
+        bv = bm[np.clip(b.data, 0, len(bm) - 1)]
+    if a.dtype.kind == K.DECIMAL or b.dtype.kind == K.DECIMAL:
+        sa = a.dtype.scale if a.dtype.kind == K.DECIMAL else 0
+        sb = b.dtype.scale if b.dtype.kind == K.DECIMAL else 0
+        s = max(sa, sb)
+        av *= 10 ** (s - sa)
+        bv *= 10 ** (s - sb)
+    if a.dtype.is_float or b.dtype.is_float:
+        raise NotImplementedError("float join keys")
+    av = np.where(a.validity, av, np.iinfo(np.int64).min)
+    bv = np.where(b.validity, bv, np.iinfo(np.int64).min + 1)
+    return av, bv
+
+
+def _pack_rows(keys: list[np.ndarray]) -> np.ndarray:
+    if len(keys) == 1:
+        return keys[0]
+    # stable structured pack via void view
+    m = np.stack(keys, axis=1)
+    return np.ascontiguousarray(m).view([("", np.int64)] * m.shape[1]).reshape(-1)
+
+
+def _ragged_ranges(starts: np.ndarray, counts: np.ndarray) -> np.ndarray:
+    """Concatenate [starts[i], ..., starts[i]+counts[i]-1] for all i."""
+    total = int(counts.sum())
+    if total == 0:
+        return np.array([], np.int64)
+    rep_starts = np.repeat(starts, counts)
+    begins = np.cumsum(counts) - counts
+    offsets = np.arange(total) - np.repeat(begins, counts)
+    return rep_starts + offsets
+
+
+def _take_nullable(c: Column, idx: np.ndarray) -> Column:
+    """take() that maps index -1 to NULL (outer-join padding)."""
+    safe = np.where(idx >= 0, idx, 0)
+    out = c.take(safe)
+    out.validity = np.where(idx >= 0, out.validity, False)
+    out.dtype = out.dtype.with_nullable(True)
+    return out
+
+
+def _filter_chunk(chunk: ResultChunk, conds, kind, n_left, outer_idx):
+    pairs = chunk.col_pairs()
+    keep = np.ones(chunk.num_rows, bool)
+    for c in conds:
+        v, m = eval_expr(np, c, pairs)
+        v = np.broadcast_to(np.asarray(v), (chunk.num_rows,))
+        if v.dtype != bool:
+            v = v != 0
+        if m is not True:
+            v = v & np.broadcast_to(np.asarray(m), (chunk.num_rows,))
+        keep &= v
+    if kind in ("left", "right") and outer_idx is not None:
+        keep = keep | (np.asarray(outer_idx) < 0)  # keep null-extended rows
+    idx = np.nonzero(keep)[0]
+    return ResultChunk(chunk.names, [c.take(idx) for c in chunk.columns])
+
+
+@dataclass
+class HostAgg(PhysOp):
+    """Generic host aggregation (root HashAgg analog) for group keys the
+    dense device path can't bound; uses np.unique group ids."""
+    child: PhysOp
+    group_exprs: list
+    aggs: list  # AggItem
+    out_names: list = field(default_factory=list)
+    out_dtypes: list = field(default_factory=list)
+
+    def __post_init__(self):
+        self.children = [self.child]
+
+    def execute(self, ctx):
+        chunk = self.child.execute(ctx)
+        n = chunk.num_rows
+        pairs = chunk.col_pairs()
+        gcols = [_eval_to_column(g, chunk) for g in self.group_exprs]
+        if gcols:
+            mats = []
+            for c in gcols:
+                mats.append(np.where(c.validity, c.data.astype(np.int64),
+                                     np.iinfo(np.int64).min))
+                mats.append((~c.validity).astype(np.int64))
+            packed = np.stack(mats, axis=1)
+            uniq, inverse = np.unique(packed, axis=0, return_inverse=True)
+            g = len(uniq)
+            first = np.full(g, max(n - 1, 0), np.int64)
+            np.minimum.at(first, inverse, np.arange(n))
+            key_cols = [c.take(first) for c in gcols]
+        else:
+            g = 1
+            inverse = np.zeros(n, np.int64)
+            key_cols = []
+            if n == 0:
+                # SQL: aggregate over empty input with no GROUP BY = 1 row
+                pass
+        agg_cols = [self._agg_one(a, chunk, inverse, g, n) for a in self.aggs]
+        return ResultChunk(list(self.out_names), key_cols + agg_cols)
+
+    def _agg_one(self, a: AggItem, chunk, inverse, g, n) -> Column:
+        if a.arg is None:   # COUNT(*)
+            cnt = np.bincount(inverse, minlength=g).astype(np.int64)
+            return Column(a.out_dtype, cnt, np.ones(g, bool))
+        c = _eval_to_column(a.arg, chunk)
+        valid = c.validity
+        if a.distinct:
+            pack = np.stack([inverse[valid], c.data[valid].astype(np.int64)],
+                            axis=1)
+            uniq = np.unique(pack, axis=0)
+            if a.func == D.AggFunc.COUNT:
+                cnt = np.bincount(uniq[:, 0], minlength=g).astype(np.int64)
+                return Column(a.out_dtype, cnt, np.ones(g, bool))
+            if a.func == D.AggFunc.SUM:
+                out = np.zeros(g, dtype=object)
+                np.add.at(out, uniq[:, 0], uniq[:, 1].astype(object))
+                cnt = np.bincount(uniq[:, 0], minlength=g)
+                return _sum_col(a, out, cnt)
+            raise NotImplementedError("DISTINCT " + a.func.value)
+        if a.func == D.AggFunc.COUNT:
+            cnt = np.bincount(inverse[valid], minlength=g).astype(np.int64)
+            return Column(a.out_dtype, cnt, np.ones(g, bool))
+        cnt = np.bincount(inverse[valid], minlength=g)
+        if a.func == D.AggFunc.SUM:
+            if a.arg.dtype.kind in (K.FLOAT64, K.FLOAT32):
+                out = np.zeros(g, np.float64)
+                np.add.at(out, inverse[valid], c.data[valid].astype(np.float64))
+                return Column(a.out_dtype, np.where(cnt > 0, out, 0.0),
+                              cnt > 0)
+            out = np.zeros(g, dtype=object)
+            np.add.at(out, inverse[valid], c.data[valid].astype(object))
+            return _sum_col(a, out, cnt)
+        if a.func in (D.AggFunc.MIN, D.AggFunc.MAX):
+            isf = a.arg.dtype.is_float
+            ninf = -np.inf if isf else np.iinfo(np.int64).min
+            pinf = np.inf if isf else np.iinfo(np.int64).max
+            init = pinf if a.func == D.AggFunc.MIN else ninf
+            out = np.full(g, init, np.float64 if isf else np.int64)
+            op = np.minimum if a.func == D.AggFunc.MIN else np.maximum
+            op.at(out, inverse[valid], c.data[valid].astype(out.dtype))
+            col = Column(a.out_dtype,
+                         np.where(cnt > 0, out, 0).astype(a.out_dtype.np_dtype()),
+                         cnt > 0, c.dictionary)
+            return col
+        raise NotImplementedError(a.func)
+
+
+def _sum_col(a: AggItem, out_obj: np.ndarray, cnt: np.ndarray) -> Column:
+    vals = np.array([int(x) for x in out_obj], dtype=np.int64)
+    return Column(a.out_dtype, vals, cnt > 0)
+
+
+@dataclass
+class DualExec(PhysOp):
+    exprs: list = field(default_factory=list)
+    out_names: list = field(default_factory=list)
+
+    def __post_init__(self):
+        self.out_dtypes = [e.dtype for e in self.exprs]
+        self.children = []
+
+    def execute(self, ctx):
+        cols = []
+        for e in self.exprs:
+            v, m = eval_expr(np, e, [])
+            val = v.item() if hasattr(v, "item") else v
+            valid = bool(m) if isinstance(m, bool) else True
+            if e.dtype.is_string:
+                d = StringDict([str(val)] if valid else [])
+                cols.append(Column(e.dtype,
+                                   np.zeros(1, np.int32),
+                                   np.asarray([valid]), d))
+                continue
+            vals = np.asarray([int(val) if isinstance(val, bool) else
+                               (val if valid else 0)])
+            cols.append(Column(e.dtype, vals.astype(e.dtype.np_dtype()),
+                               np.asarray([valid])))
+        return ResultChunk(list(self.out_names), cols)
+
+
+__all__ = [
+    "ExecContext", "ResultChunk", "PhysOp", "CopTaskExec", "HostSelection",
+    "HostProjection", "HostLimit", "HostSort", "HostTopN", "HostHashJoin",
+    "HostAgg", "DualExec", "DEVICE_OPS",
+]

@@ -1,0 +1,206 @@
+"""Logical plan -> physical plan with pushdown split.
+
+Reference analog: the engine-choice half of physicalOptimize
+(core/find_best_task.go deciding cop vs root) + executorBuilder
+(executor/builder.go).  A maximal
+DataSource-[Selection]-[Projection]-[Agg|TopN|Limit] suffix that passes the
+capability checks becomes a single CopTaskExec (fused device program);
+anything else lowers to host operators whose children are recursively
+planned — so the scan/filter still runs on TPU under a host join/sort.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..copr import dag as D
+from ..copr.aggregate import GroupKeyMeta
+from ..expr.ir import ColumnRef, Expr
+from ..expr.lower_strings import lower_strings
+from ..planner.build import DualSource
+from ..planner.logical import (DataSource, LogicalAggregate, LogicalJoin,
+                               LogicalLimit, LogicalPlan, LogicalProjection,
+                               LogicalSelection, LogicalSort, LogicalTopN)
+from ..types import dtypes as dt
+from .physical import (CopTaskExec, DualExec, HostAgg, HostHashJoin,
+                       HostLimit, HostProjection, HostSelection, HostSort,
+                       HostTopN, PhysOp, _device_supported)
+
+K = dt.TypeKind
+
+MAX_DENSE_GROUPS = 1_000_000
+
+
+def to_physical(p: LogicalPlan) -> PhysOp:
+    if isinstance(p, LogicalProjection) and isinstance(p.child, DualSource):
+        return DualExec(list(p.exprs), out_names=p.schema.names())
+
+    cop = _try_cop(p)
+    if cop is not None:
+        return cop
+
+    if isinstance(p, LogicalSelection):
+        return HostSelection(to_physical(p.child), list(p.conditions))
+    if isinstance(p, LogicalProjection):
+        return HostProjection(to_physical(p.child), list(p.exprs),
+                              out_names=p.schema.names())
+    if isinstance(p, LogicalAggregate):
+        return HostAgg(to_physical(p.child), list(p.group_exprs),
+                       list(p.aggs), out_names=p.schema.names(),
+                       out_dtypes=[c.dtype for c in p.schema.cols])
+    if isinstance(p, LogicalJoin):
+        return HostHashJoin(p.kind, to_physical(p.left), to_physical(p.right),
+                            list(p.eq_keys), list(p.other_conds),
+                            out_names=p.schema.names(),
+                            out_dtypes=[c.dtype for c in p.schema.cols])
+    if isinstance(p, LogicalSort):
+        return HostSort(to_physical(p.child), list(p.keys))
+    if isinstance(p, LogicalTopN):
+        return HostTopN(to_physical(p.child), list(p.keys), p.limit, p.offset)
+    if isinstance(p, LogicalLimit):
+        return HostLimit(to_physical(p.child), p.limit, p.offset)
+    if isinstance(p, DataSource):
+        raise AssertionError("DataSource should fuse into a CopTask")
+    raise NotImplementedError(type(p).__name__)
+
+
+# --------------------------------------------------------------------- #
+
+def _try_cop(p: LogicalPlan) -> Optional[PhysOp]:
+    """Fuse the subtree rooted at p into one CopTask if possible."""
+    top = None          # Aggregation | TopN | Limit at the root
+    mids: list = []     # Selection / Projection chain
+    cur = p
+    if isinstance(cur, (LogicalAggregate, LogicalTopN, LogicalLimit)):
+        top = cur
+        cur = cur.child
+    while isinstance(cur, (LogicalSelection, LogicalProjection)):
+        mids.append(cur)
+        cur = cur.child
+    if not isinstance(cur, DataSource):
+        return None
+    ds = cur
+
+    snap = ds.table.snapshot()
+    dicts = {}
+    for i, off in enumerate(ds.col_offsets):
+        c = snap.columns[off]
+        if c.dictionary is not None:
+            dicts[i] = c.dictionary
+
+    # bind + lower the chain bottom-up
+    node: D.CopNode = D.TableScan(tuple(ds.col_offsets),
+                                  tuple(c.dtype for c in ds.schema.cols))
+    cur_dicts = dict(dicts)
+    out_dtypes = [c.dtype for c in ds.schema.cols]
+    out_names = ds.schema.names()
+    out_dicts = dict(cur_dicts)
+    for m in reversed(mids):
+        if isinstance(m, LogicalSelection):
+            conds = tuple(lower_strings(c, cur_dicts) for c in m.conditions)
+            if not all(_device_supported(c) for c in conds):
+                return None
+            node = D.Selection(node, conds)
+        else:
+            exprs = tuple(lower_strings(e, cur_dicts) for e in m.exprs)
+            if not all(_device_supported(e) for e in exprs):
+                return None
+            node = D.Projection(node, exprs)
+            new_dicts = {}
+            for j, e in enumerate(exprs):
+                if isinstance(e, ColumnRef) and e.index in cur_dicts:
+                    new_dicts[j] = cur_dicts[e.index]
+            cur_dicts = new_dicts
+            out_dicts = dict(new_dicts)
+            out_dtypes = [e.dtype for e in exprs]
+            out_names = m.schema.names()
+
+    key_meta: list[GroupKeyMeta] = []
+    if top is None:
+        pass
+    elif isinstance(top, LogicalAggregate):
+        agg_dicts: dict[int, object] = {}
+        agg_node = _bind_agg(top, node, cur_dicts, key_meta, agg_dicts)
+        if agg_node is None:
+            # aggregation itself not pushable: fuse the scan part only and
+            # aggregate on host
+            child_exec = CopTaskExec(node, ds.table, out_names=out_names,
+                                     out_dtypes=out_dtypes,
+                                     out_dicts=out_dicts)
+            return HostAgg(child_exec, list(top.group_exprs), list(top.aggs),
+                           out_names=top.schema.names(),
+                           out_dtypes=[c.dtype for c in top.schema.cols])
+        node = agg_node
+        out_names = top.schema.names()
+        out_dtypes = [c.dtype for c in top.schema.cols]
+        out_dicts = {i: m.dictionary for i, m in enumerate(key_meta)
+                     if m.dictionary is not None}
+        for i, d in agg_dicts.items():   # MIN/MAX over dict-encoded strings
+            out_dicts[len(key_meta) + i] = d
+    elif isinstance(top, LogicalTopN):
+        if len(top.keys) != 1:
+            return None  # multi-key TopN: host sort over the fused scan
+        key, desc = top.keys[0]
+        key = lower_strings(key, cur_dicts)
+        if not _device_supported(key):
+            return None
+        node = D.TopN(node, sort_key=key, desc=desc,
+                      limit=top.limit + top.offset)
+        exec_ = CopTaskExec(node, ds.table, out_names=out_names,
+                            out_dtypes=out_dtypes, out_dicts=out_dicts)
+        # root merge of per-device tops
+        return HostTopN(exec_, list(top.keys), top.limit, top.offset)
+    elif isinstance(top, LogicalLimit):
+        node = D.Limit(node, limit=top.limit + top.offset)
+        exec_ = CopTaskExec(node, ds.table, out_names=out_names,
+                            out_dtypes=out_dtypes, out_dicts=out_dicts)
+        return HostLimit(exec_, top.limit, top.offset)
+
+    return CopTaskExec(node, ds.table, out_names=out_names,
+                       out_dtypes=out_dtypes, key_meta=key_meta,
+                       out_dicts=out_dicts)
+
+
+def _bind_agg(agg: LogicalAggregate, child: D.CopNode, dicts,
+              key_meta_out: list, agg_dicts_out: dict) -> Optional[D.Aggregation]:
+    """Bind a LogicalAggregate to a device Aggregation (DENSE/SCALAR), or
+    None if it must stay on host (generic keys / distinct)."""
+    if any(a.distinct for a in agg.aggs):
+        return None
+    descs = []
+    for i, a in enumerate(agg.aggs):
+        arg = lower_strings(a.arg, dicts) if a.arg is not None else None
+        if arg is not None and not _device_supported(arg):
+            return None
+        if a.func not in (D.AggFunc.SUM, D.AggFunc.COUNT, D.AggFunc.MIN,
+                          D.AggFunc.MAX):
+            return None
+        if (a.func in (D.AggFunc.MIN, D.AggFunc.MAX)
+                and isinstance(arg, ColumnRef) and arg.index in dicts):
+            agg_dicts_out[i] = dicts[arg.index]
+        descs.append(D.AggDesc(a.func, arg, a.out_dtype))
+
+    if not agg.group_exprs:
+        return D.Aggregation(child, (), tuple(descs), D.GroupStrategy.SCALAR)
+
+    sizes = []
+    metas = []
+    total = 1
+    for g in agg.group_exprs:
+        if not (isinstance(g, ColumnRef) and g.dtype.is_string
+                and g.index in dicts):
+            return None
+        d = dicts[g.index]
+        size = len(d) + (1 if g.dtype.nullable else 0)
+        size = max(size, 1)
+        sizes.append(size)
+        metas.append(GroupKeyMeta(g.dtype, size, d))
+        total *= size
+    if total > MAX_DENSE_GROUPS:
+        return None
+    key_meta_out.extend(metas)
+    return D.Aggregation(child, tuple(agg.group_exprs), tuple(descs),
+                         D.GroupStrategy.DENSE, domain_sizes=tuple(sizes))
+
+
+__all__ = ["to_physical"]

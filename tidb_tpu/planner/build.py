@@ -1,0 +1,629 @@
+"""AST -> logical plan builder (name/type resolution).
+
+Reference analog: pkg/planner/core/logical_plan_builder.go (PlanBuilder) —
+resolves identifiers against child schemas, types every expression (into
+expr/ir.py IR), splits AVG into SUM/COUNT (SURVEY.md §A.4), rewrites
+aggregate queries into LogicalAggregate + projection over its output, and
+resolves ORDER BY against aliases/positions/underlying columns with hidden
+columns, like the reference's havingWindowAndOrderbyExprResolver.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from ..copr.dag import AggFunc
+from ..expr import builders as B
+from ..expr.ir import ColumnRef, Const, Expr, Func
+from ..sql import ast as A
+from ..types import dtypes as dt
+from ..types import temporal as tmp
+from ..copr.aggregate import sum_out_dtype
+from .logical import (AggItem, DataSource, LogicalAggregate, LogicalJoin,
+                      LogicalLimit, LogicalPlan, LogicalProjection,
+                      LogicalSelection, LogicalSort, LogicalTopN, Schema,
+                      SchemaCol)
+
+K = dt.TypeKind
+
+AGG_FUNCS = {"SUM", "COUNT", "AVG", "MIN", "MAX"}
+
+_CMP = {"=": "eq", "<>": "ne", "<": "lt", "<=": "le", ">": "gt", ">=": "ge"}
+_ARITH = {"+": "add", "-": "sub", "*": "mul", "/": "div", "DIV": "intdiv",
+          "%": "mod"}
+
+
+class PlanError(ValueError):
+    pass
+
+
+# --------------------------------------------------------------------- #
+# expression building over a schema
+# --------------------------------------------------------------------- #
+
+class ExprBuilder:
+    """AST expression -> typed IR over `schema`.  Aggregate calls are
+    rejected unless an agg_resolver intercepts them (select-list path)."""
+
+    def __init__(self, schema: Schema, agg_resolver=None):
+        self.schema = schema
+        self.agg_resolver = agg_resolver
+
+    def build(self, n: A.Node) -> Expr:
+        m = getattr(self, f"_b_{type(n).__name__.lower()}", None)
+        if m is None:
+            raise PlanError(f"unsupported expression {type(n).__name__}")
+        return m(n)
+
+    # ---- leaves ---- #
+
+    def _b_ident(self, n: A.Ident) -> Expr:
+        if len(n.parts) == 1:
+            q, name = None, n.parts[0]
+        else:
+            q, name = n.parts[-2], n.parts[-1]
+        hits = self.schema.find(name, q)
+        if not hits:
+            hits = self.schema.find(name, None)
+        if not hits:
+            raise PlanError(f"unknown column {'.'.join(n.parts)!r}")
+        if len(hits) > 1:
+            raise PlanError(f"ambiguous column {name!r}")
+        return self.schema.ref(hits[0])
+
+    def _b_lit(self, n: A.Lit) -> Expr:
+        if n.kind == "int":
+            return B.lit(int(n.value))
+        if n.kind == "bool":
+            return B.lit(int(n.value))
+        if n.kind == "decimal":
+            return B.decimal_lit(str(n.value))
+        if n.kind == "float":
+            return B.lit(float(n.value))
+        if n.kind == "str":
+            return B.lit(str(n.value))
+        if n.kind == "null":
+            return B.lit(None)
+        if n.kind == "date":
+            return B.lit(str(n.value), dt.date())
+        if n.kind == "datetime":
+            return B.lit(str(n.value), dt.datetime())
+        if n.kind == "interval":
+            raise PlanError("INTERVAL only valid in +/- with a date")
+        raise PlanError(f"unknown literal kind {n.kind}")
+
+    # ---- operators ---- #
+
+    def _b_binary(self, n: A.Binary) -> Expr:
+        op = n.op
+        if op in ("AND", "OR", "XOR"):
+            return B.logic(op.lower(), self.build(n.left), self.build(n.right))
+        if op in _CMP:
+            a, b = self.build(n.left), self.build(n.right)
+            a, b = _coerce_compare(a, b)
+            return B.compare(_CMP[op], a, b)
+        if op in _ARITH:
+            # temporal interval arithmetic
+            if isinstance(n.right, A.Lit) and n.right.kind == "interval":
+                return self._interval_arith(n)
+            return B.arith(_ARITH[op], self.build(n.left), self.build(n.right))
+        raise PlanError(f"unsupported operator {op}")
+
+    def _interval_arith(self, n: A.Binary) -> Expr:
+        base = self.build(n.left)
+        iv: A.Lit = n.right
+        amt_e = ExprBuilder(self.schema).build(iv.value) \
+            if isinstance(iv.value, A.Node) else B.lit(int(iv.value))
+        if not isinstance(amt_e, Const):
+            raise PlanError("INTERVAL amount must be constant")
+        amount = int(str(amt_e.value)) if not isinstance(amt_e.value, int) \
+            else amt_e.value
+        if n.op == "-":
+            amount = -amount
+        unit = iv.unit
+        if base.dtype.kind not in (K.DATE, K.DATETIME):
+            raise PlanError("INTERVAL arithmetic needs a date operand")
+        if isinstance(base, Const):
+            return _fold_interval_const(base, amount, unit)
+        if unit == "DAY" and base.dtype.kind == K.DATE:
+            return Func(base.dtype, "add", (base, Const(dt.bigint(False), amount)))
+        raise PlanError(f"non-constant INTERVAL {unit} not supported yet")
+
+    def _b_unary(self, n: A.Unary) -> Expr:
+        if n.op == "NOT":
+            return B.logic("not", self.build(n.arg))
+        if n.op == "-":
+            a = self.build(n.arg)
+            if isinstance(a, Const) and a.dtype.is_numeric:
+                return Const(a.dtype, -a.value)
+            return B.neg(a)
+        raise PlanError(f"unsupported unary {n.op}")
+
+    def _b_inexpr(self, n: A.InExpr) -> Expr:
+        if any(isinstance(i, A.SubqueryExpr) for i in n.items):
+            raise PlanError("IN (subquery) not supported yet")
+        t = self.build(n.target)
+        items = [_coerce_to(t.dtype, self.build(i)) for i in n.items]
+        e = B.in_list(t, items)
+        return B.logic("not", e) if n.negated else e
+
+    def _b_betweenexpr(self, n: A.BetweenExpr) -> Expr:
+        t = self.build(n.target)
+        lo = _coerce_to(t.dtype, self.build(n.low))
+        hi = _coerce_to(t.dtype, self.build(n.high))
+        e = B.between(t, lo, hi)
+        return B.logic("not", e) if n.negated else e
+
+    def _b_likeexpr(self, n: A.LikeExpr) -> Expr:
+        t = self.build(n.target)
+        p = self.build(n.pattern)
+        e = Func(dt.bigint(t.dtype.nullable), "like", (t, p))
+        return B.logic("not", e) if n.negated else e
+
+    def _b_isnullexpr(self, n: A.IsNullExpr) -> Expr:
+        e = B.is_null(self.build(n.target))
+        return B.logic("not", e) if n.negated else e
+
+    def _b_caseexpr(self, n: A.CaseExpr) -> Expr:
+        if n.operand is not None:
+            op = self.build(n.operand)
+            pairs = []
+            for c, v in n.branches:
+                cv = _coerce_to(op.dtype, self.build(c))
+                pairs.append((B.compare("eq", op, cv), self.build(v)))
+        else:
+            pairs = [(self.build(c), self.build(v)) for c, v in n.branches]
+        els = self.build(n.else_) if n.else_ is not None else None
+        return B.case_when(pairs, els)
+
+    def _b_castexpr(self, n: A.CastExpr) -> Expr:
+        a = self.build(n.arg)
+        tn = n.type_name.upper()
+        if tn in ("SIGNED", "SIGNED INTEGER", "INT", "BIGINT"):
+            to = dt.bigint()
+        elif tn in ("UNSIGNED", "UNSIGNED INTEGER"):
+            to = dt.ubigint()
+        elif tn in ("DOUBLE", "REAL", "FLOAT"):
+            to = dt.double()
+        elif tn == "DECIMAL":
+            to = dt.decimal(n.prec if n.prec > 0 else 10,
+                            n.scale if n.scale >= 0 else 0)
+        elif tn == "DATE":
+            to = dt.date()
+        elif tn in ("DATETIME", "TIMESTAMP"):
+            to = dt.datetime()
+        else:
+            raise PlanError(f"unsupported CAST target {tn}")
+        return B.cast(a, to)
+
+    def _b_funccall(self, n: A.FuncCall) -> Expr:
+        name = n.name
+        if name in AGG_FUNCS:
+            if self.agg_resolver is None:
+                raise PlanError(f"aggregate {name} not allowed here")
+            return self.agg_resolver(n)
+        args = [self.build(a) for a in n.args
+                if not isinstance(a, A.Star)]
+        if name in ("YEAR", "MONTH"):
+            return B.temporal_part(name.lower(), args[0])
+        if name in ("DAY", "DAYOFMONTH"):
+            return B.temporal_part("dayofmonth", args[0])
+        if name == "ABS":
+            return Func(args[0].dtype, "abs", tuple(args))
+        if name == "IF":
+            return B.if_(args[0], args[1], args[2])
+        if name == "IFNULL":
+            return B.ifnull(args[0], args[1])
+        if name == "COALESCE":
+            return B.coalesce(*args)
+        if name == "NULLIF":
+            return B.if_(B.compare("eq", args[0], args[1]), B.lit(None), args[0])
+        if name == "DATE":
+            return B.cast(args[0], dt.date())
+        raise PlanError(f"unsupported function {name}")
+
+    def _b_star(self, n: A.Star) -> Expr:
+        raise PlanError("* only valid as a top-level select item")
+
+    def _b_subqueryexpr(self, n: A.SubqueryExpr) -> Expr:
+        raise PlanError("scalar subquery not supported yet")
+
+    def _b_existsexpr(self, n: A.ExistsExpr) -> Expr:
+        raise PlanError("EXISTS not supported yet")
+
+
+def _fold_interval_const(base: Const, amount: int, unit: str) -> Const:
+    if base.dtype.kind == K.DATE:
+        days = int(base.value)
+        if unit == "DAY":
+            return Const(base.dtype, days + amount)
+        if unit in ("MONTH", "YEAR"):
+            import datetime as _dt
+            d = tmp.days_to_date(days)
+            months = amount * (12 if unit == "YEAR" else 1)
+            mi = d.year * 12 + (d.month - 1) + months
+            y, m = divmod(mi, 12)
+            import calendar
+            day = min(d.day, calendar.monthrange(y, m + 1)[1])
+            return Const(base.dtype, tmp.date_to_days(y, m + 1, day))
+    raise PlanError(f"INTERVAL {unit} on {base.dtype} not supported")
+
+
+def _coerce_compare(a: Expr, b: Expr) -> tuple[Expr, Expr]:
+    """MySQL-ish implicit casts for comparisons: string literal vs
+    temporal/decimal/numeric column resolves at plan time."""
+    def conv(s: Expr, target: dt.DataType) -> Expr:
+        assert isinstance(s, Const)
+        v = s.value
+        if target.kind == K.DATE:
+            return Const(dt.date(False), tmp.parse_date(str(v)))
+        if target.kind == K.DATETIME:
+            return Const(dt.datetime(False), tmp.parse_datetime(str(v)))
+        if target.kind == K.DECIMAL:
+            return B.decimal_lit(str(v))
+        if target.kind in (K.INT64, K.UINT64, K.FLOAT64, K.FLOAT32):
+            return B.lit(float(v))
+        return s
+
+    if isinstance(a, Const) and a.dtype.is_string and not b.dtype.is_string:
+        return conv(a, b.dtype), b
+    if isinstance(b, Const) and b.dtype.is_string and not a.dtype.is_string:
+        return a, conv(b, a.dtype)
+    return a, b
+
+
+def _coerce_to(target: dt.DataType, e: Expr) -> Expr:
+    if isinstance(e, Const) and e.dtype.is_string and not target.is_string:
+        return _coerce_compare(e, ColumnRef(target, 0))[0]
+    return e
+
+
+# --------------------------------------------------------------------- #
+# SELECT building
+# --------------------------------------------------------------------- #
+
+@dataclass
+class BuiltSelect:
+    plan: LogicalPlan
+    output_names: list[str]
+
+
+def build_select(sel: A.SelectStmt, catalog, default_db: str) -> BuiltSelect:
+    if sel.from_ is None:
+        return _build_no_table(sel)
+    child = _build_from(sel.from_, catalog, default_db)
+
+    if sel.where is not None:
+        cond = ExprBuilder(child.schema).build(sel.where)
+        child = LogicalSelection(child, _split_cnf(cond))
+
+    # expand stars
+    items: list[A.SelectItem] = []
+    for it in sel.items:
+        if isinstance(it.expr, A.Star):
+            q = it.expr.table
+            for i, c in enumerate(child.schema.cols):
+                if q is None or (c.qualifier or "").lower() == q.lower():
+                    items.append(A.SelectItem(A.Ident((c.qualifier, c.name)
+                                                      if c.qualifier else (c.name,)),
+                                              c.name))
+        else:
+            items.append(it)
+
+    has_aggs = sel.group_by or _contains_agg(items, sel.having, sel.order_by)
+    if has_aggs:
+        plan, names = _build_agg_select(sel, items, child)
+    else:
+        eb = ExprBuilder(child.schema)
+        exprs = [eb.build(it.expr) for it in items]
+        names = [_item_name(it) for it in items]
+        plan = _project(child, exprs, names)
+        if sel.having is not None:
+            raise PlanError("HAVING without GROUP BY not supported")
+        plan = _attach_order_limit(sel, plan, names, child)
+
+    if has_aggs:
+        plan = _attach_order_limit(sel, plan, names,
+                                   plan.children[0] if plan.children else plan,
+                                   agg_mode=True)
+
+    if sel.distinct:
+        plan = LogicalAggregate(plan, [plan.schema.ref(i)
+                                       for i in range(len(plan.schema))], [],
+                                Schema(list(plan.schema.cols)))
+    return BuiltSelect(plan, names)
+
+
+def _build_no_table(sel: A.SelectStmt) -> BuiltSelect:
+    from .logical import DataSource  # dual table: 1 row, no cols
+    eb = ExprBuilder(Schema([]))
+    exprs = [eb.build(it.expr) for it in sel.items]
+    names = [_item_name(it) for it in sel.items]
+    plan = LogicalProjection(DualSource(), exprs,
+                             Schema([SchemaCol(n, e.dtype)
+                                     for n, e in zip(names, exprs)]))
+    return BuiltSelect(plan, names)
+
+
+class DualSource(LogicalPlan):
+    """SELECT without FROM: one row, zero columns."""
+
+    def __init__(self):
+        self.schema = Schema([])
+        self.children = []
+
+
+def _item_name(it: A.SelectItem) -> str:
+    if it.alias:
+        return it.alias
+    if isinstance(it.expr, A.Ident):
+        return it.expr.parts[-1]
+    if isinstance(it.expr, A.FuncCall):
+        return f"{it.expr.name.lower()}(...)" if it.expr.args else f"{it.expr.name.lower()}()"
+    return "expr"
+
+
+def _split_cnf(e: Expr) -> list[Expr]:
+    if isinstance(e, Func) and e.op == "and":
+        return _split_cnf(e.args[0]) + _split_cnf(e.args[1])
+    return [e]
+
+
+def _contains_agg(items, having, order_by) -> bool:
+    found = False
+
+    def walk(n):
+        nonlocal found
+        if isinstance(n, A.FuncCall) and n.name in AGG_FUNCS:
+            found = True
+        for v in vars(n).values() if hasattr(n, "__dict__") else []:
+            if isinstance(v, A.Node):
+                walk(v)
+            elif isinstance(v, (list, tuple)):
+                for x in v:
+                    if isinstance(x, A.Node):
+                        walk(x)
+                    elif isinstance(x, tuple):
+                        for y in x:
+                            if isinstance(y, A.Node):
+                                walk(y)
+
+    for it in items:
+        walk(it.expr)
+    if having is not None:
+        walk(having)
+    for e, _ in order_by or []:
+        walk(e)
+    return found
+
+
+def _build_agg_select(sel: A.SelectStmt, items, child) -> tuple[LogicalPlan, list[str]]:
+    """GROUP BY / aggregate query: LogicalAggregate + projection on top."""
+    ceb = ExprBuilder(child.schema)
+    # MySQL: GROUP BY may reference select aliases (and positions)
+    group_asts = []
+    for g in (sel.group_by or []):
+        if isinstance(g, A.Lit) and g.kind == "int":
+            pos = int(g.value) - 1
+            if not (0 <= pos < len(items)):
+                raise PlanError(f"GROUP BY position {pos+1} out of range")
+            g = items[pos].expr
+        else:
+            g = _subst_aliases(g, items)
+        group_asts.append(g)
+    group_irs = [ceb.build(g) for g in group_asts]
+
+    agg_items: list[AggItem] = []
+    agg_cache: dict = {}          # dedup identical agg calls
+
+    def resolve_agg(fc: A.FuncCall) -> Expr:
+        """Called by ExprBuilder when it hits SUM/COUNT/...; returns a
+        placeholder ColumnRef into the agg output schema."""
+        key = repr(fc)
+        if key in agg_cache:
+            return agg_cache[key]
+        name = fc.name
+        star = len(fc.args) == 1 and isinstance(fc.args[0], A.Star)
+        arg = None if star else ceb.build(fc.args[0])
+        if name == "AVG":
+            s = _add_agg(agg_items, AggFunc.SUM, arg, fc.distinct)
+            c = _add_agg(agg_items, AggFunc.COUNT, arg, fc.distinct)
+            sref = _AggRef(s, agg_items[s].out_dtype)
+            cref = _AggRef(c, agg_items[c].out_dtype)
+            out = B.arith("div", sref, cref)
+        elif name == "COUNT":
+            i = _add_agg(agg_items, AggFunc.COUNT, arg, fc.distinct)
+            out = _AggRef(i, agg_items[i].out_dtype)
+        else:
+            f = {"SUM": AggFunc.SUM, "MIN": AggFunc.MIN, "MAX": AggFunc.MAX}[name]
+            if arg is None:
+                raise PlanError(f"{name} needs an argument")
+            i = _add_agg(agg_items, f, arg, fc.distinct)
+            out = _AggRef(i, agg_items[i].out_dtype)
+        agg_cache[key] = out
+        return out
+
+    eb = ExprBuilder(child.schema, agg_resolver=resolve_agg)
+    raw_items = [eb.build(it.expr) for it in items]
+    names = [_item_name(it) for it in items]
+    having_ast = _subst_aliases(sel.having, items) if sel.having is not None \
+        else None
+    raw_having = eb.build(having_ast) if having_ast is not None else None
+
+    # aggregate node schema: group cols then agg cols
+    gcols = [SchemaCol(_expr_name(g, child.schema), g.dtype) for g in group_irs]
+    acols = [SchemaCol(f"agg#{i}", a.out_dtype) for i, a in enumerate(agg_items)]
+    agg_schema = Schema(gcols + acols)
+    agg_plan = LogicalAggregate(child, group_irs, agg_items, agg_schema)
+
+    n_group = len(group_irs)
+
+    def remap(e: Expr) -> Expr:
+        if isinstance(e, _AggRef):
+            return ColumnRef(e.dtype, n_group + e.agg_index, f"agg#{e.agg_index}")
+        for gi, g in enumerate(group_irs):
+            if e == g:
+                return ColumnRef(e.dtype, gi, agg_schema.cols[gi].name)
+        if isinstance(e, ColumnRef):
+            raise PlanError(
+                f"column {e.name!r} must appear in GROUP BY or an aggregate")
+        if isinstance(e, Func):
+            return Func(e.dtype, e.op, tuple(remap(a) for a in e.args))
+        return e
+
+    final_exprs = [remap(e) for e in raw_items]
+    plan: LogicalPlan = agg_plan
+    if raw_having is not None:
+        plan = LogicalSelection(plan, _split_cnf(remap(raw_having)))
+    plan = _project(plan, final_exprs, names)
+    # stash for ORDER BY resolution against agg schema
+    plan._agg_remap = remap          # type: ignore[attr-defined]
+    plan._agg_eb = eb                # type: ignore[attr-defined]
+    return plan, names
+
+
+def _subst_aliases(n: A.Node, items: list[A.SelectItem]) -> A.Node:
+    """MySQL HAVING/ORDER BY may reference select aliases: substitute the
+    aliased expression AST for bare idents matching an alias."""
+    aliases = {it.alias.lower(): it.expr for it in items if it.alias}
+    import copy
+
+    def go(x):
+        if isinstance(x, A.Ident) and len(x.parts) == 1 \
+                and x.parts[0].lower() in aliases:
+            return copy.deepcopy(aliases[x.parts[0].lower()])
+        if isinstance(x, A.Node):
+            for f, v in vars(x).items():
+                if isinstance(v, A.Node):
+                    setattr(x, f, go(v))
+                elif isinstance(v, list):
+                    setattr(x, f, [go(i) if isinstance(i, A.Node) else i
+                                   for i in v])
+            return x
+        return x
+
+    return go(copy.deepcopy(n))
+
+
+class _AggRef(ColumnRef):
+    """Placeholder for an aggregate output during select-list building."""
+
+    def __init__(self, agg_index: int, dtype: dt.DataType):
+        super().__init__(dtype, 100000 + agg_index, f"agg#{agg_index}")
+        object.__setattr__(self, "agg_index", agg_index)
+
+
+def _add_agg(agg_items: list[AggItem], func: AggFunc, arg, distinct: bool) -> int:
+    if func == AggFunc.COUNT:
+        out_t = dt.bigint(False)
+    elif func == AggFunc.SUM:
+        out_t = sum_out_dtype(arg.dtype)
+    else:
+        out_t = arg.dtype
+    agg_items.append(AggItem(func, arg, distinct, out_t))
+    return len(agg_items) - 1
+
+
+def _expr_name(e: Expr, schema: Schema) -> str:
+    if isinstance(e, ColumnRef):
+        return e.name or f"col#{e.index}"
+    return "expr"
+
+
+def _project(child: LogicalPlan, exprs: list[Expr], names: list[str]) -> LogicalProjection:
+    sch = Schema([SchemaCol(n, e.dtype) for n, e in zip(names, exprs)])
+    return LogicalProjection(child, exprs, sch)
+
+
+def _attach_order_limit(sel: A.SelectStmt, plan: LogicalPlan,
+                        names: list[str], pre_child: LogicalPlan,
+                        agg_mode: bool = False) -> LogicalPlan:
+    """ORDER BY: aliases > positions > projection names > underlying cols
+    (hidden column appended and trimmed by the executor via output_names)."""
+    if sel.order_by:
+        assert isinstance(plan, LogicalProjection)
+        keys = []
+        for e_ast, desc in sel.order_by:
+            idx = None
+            if isinstance(e_ast, A.Lit) and e_ast.kind == "int":
+                idx = int(e_ast.value) - 1
+                if not (0 <= idx < len(names)):
+                    raise PlanError(f"ORDER BY position {idx+1} out of range")
+            elif isinstance(e_ast, A.Ident) and len(e_ast.parts) == 1:
+                matches = [i for i, n in enumerate(names)
+                           if n.lower() == e_ast.parts[0].lower()]
+                if matches:
+                    idx = matches[0]
+            if idx is None:
+                # build over the pre-projection schema; append hidden col
+                if agg_mode:
+                    remap = plan._agg_remap if hasattr(plan, "_agg_remap") else None
+                    eb = plan._agg_eb if hasattr(plan, "_agg_eb") else None
+                    if eb is None:
+                        raise PlanError("cannot resolve ORDER BY expression")
+                    ir = remap(eb.build(e_ast))
+                else:
+                    ir = ExprBuilder(pre_child.schema).build(e_ast)
+                plan.exprs.append(ir)
+                plan.schema.cols.append(SchemaCol(f"__order#{len(plan.exprs)}",
+                                                  ir.dtype))
+                idx = len(plan.exprs) - 1
+            keys.append((plan.schema.ref(idx), desc))
+        if sel.limit is not None:
+            plan = LogicalTopN(plan, keys, sel.limit, sel.offset or 0)
+        else:
+            plan = LogicalSort(plan, keys)
+    elif sel.limit is not None:
+        plan = LogicalLimit(plan, sel.limit, sel.offset or 0)
+    return plan
+
+
+# --------------------------------------------------------------------- #
+# FROM clause
+# --------------------------------------------------------------------- #
+
+def _build_from(node: A.Node, catalog, default_db: str) -> LogicalPlan:
+    if isinstance(node, A.TableName):
+        tbl = catalog.get_table(node.db or default_db, node.name)
+        alias = node.alias or node.name
+        sch = Schema([SchemaCol(n, t, alias)
+                      for n, t in zip(tbl.col_names, tbl.col_types)])
+        return DataSource(tbl, alias, sch, list(range(len(tbl.col_names))))
+    if isinstance(node, A.SubqueryRef):
+        built = build_select(node.select, catalog, default_db)
+        sub = built.plan
+        sch = Schema([SchemaCol(n, c.dtype, node.alias)
+                      for n, c in zip(built.output_names,
+                                      sub.schema.cols[:len(built.output_names)])])
+        sub.schema = sch
+        return sub
+    if isinstance(node, A.Join):
+        left = _build_from(node.left, catalog, default_db)
+        right = _build_from(node.right, catalog, default_db)
+        sch = Schema(list(left.schema.cols) + list(right.schema.cols))
+        join = LogicalJoin(node.kind, left, right, [], [], sch)
+        conds: list[Expr] = []
+        if node.using:
+            for k in node.using:
+                li = left.schema.find(k)
+                ri = right.schema.find(k)
+                if not li or not ri:
+                    raise PlanError(f"USING column {k!r} not found")
+                join.eq_keys.append((li[0], ri[0]))
+            if join.kind == "cross":
+                join.kind = "inner"
+        if node.on is not None:
+            cond = ExprBuilder(sch).build(node.on)
+            conds = _split_cnf(cond)
+            if join.kind == "cross":
+                join.kind = "inner"
+        join.other_conds = conds
+        return join
+    raise PlanError(f"unsupported FROM clause {type(node).__name__}")
+
+
+__all__ = ["ExprBuilder", "PlanError", "BuiltSelect", "build_select",
+           "DualSource"]

@@ -1,0 +1,182 @@
+"""Logical plan operators.
+
+Reference analog: pkg/planner/core logical operators (LogicalSelection,
+LogicalProjection, LogicalAggregation, LogicalJoin, LogicalSort, ...).
+Schemas are ordered lists of named, typed output columns; expression IR
+ColumnRefs index into the child's schema by position, exactly like the
+reference's column offsets.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from ..copr.dag import AggFunc
+from ..expr.ir import ColumnRef, Expr
+from ..types import dtypes as dt
+
+
+@dataclass
+class SchemaCol:
+    name: str                      # output name
+    dtype: dt.DataType
+    qualifier: Optional[str] = None  # table alias for resolution
+
+
+@dataclass
+class Schema:
+    cols: list[SchemaCol] = field(default_factory=list)
+
+    def __len__(self):
+        return len(self.cols)
+
+    def find(self, name: str, qualifier: Optional[str] = None) -> list[int]:
+        name = name.lower()
+        out = []
+        for i, c in enumerate(self.cols):
+            if c.name.lower() != name:
+                continue
+            if qualifier is not None and (c.qualifier or "").lower() != qualifier.lower():
+                continue
+            out.append(i)
+        return out
+
+    def ref(self, i: int) -> ColumnRef:
+        c = self.cols[i]
+        return ColumnRef(c.dtype, i, c.name)
+
+    def names(self) -> list[str]:
+        return [c.name for c in self.cols]
+
+
+class LogicalPlan:
+    schema: Schema
+    children: list["LogicalPlan"]
+
+
+@dataclass
+class DataSource(LogicalPlan):
+    """Scan of one stored table (reference: logical DataSource)."""
+    table: object                  # session.catalog.TableInfo
+    alias: str
+    schema: Schema = None
+    col_offsets: list[int] = None  # into the table's stored columns
+
+    def __post_init__(self):
+        self.children = []
+
+
+@dataclass
+class LogicalSelection(LogicalPlan):
+    child: LogicalPlan
+    conditions: list[Expr]
+
+    def __post_init__(self):
+        self.schema = self.child.schema
+        self.children = [self.child]
+
+
+@dataclass
+class LogicalProjection(LogicalPlan):
+    child: LogicalPlan
+    exprs: list[Expr]
+    schema: Schema = None
+
+    def __post_init__(self):
+        self.children = [self.child]
+
+
+@dataclass
+class AggItem:
+    func: AggFunc
+    arg: Optional[Expr]
+    distinct: bool
+    out_dtype: dt.DataType
+
+
+@dataclass
+class LogicalAggregate(LogicalPlan):
+    child: LogicalPlan
+    group_exprs: list[Expr]
+    aggs: list[AggItem]
+    schema: Schema = None          # group cols then agg cols
+
+    def __post_init__(self):
+        self.children = [self.child]
+
+
+@dataclass
+class LogicalJoin(LogicalPlan):
+    kind: str                      # 'inner' | 'left' | 'right' | 'cross'
+    left: LogicalPlan = None
+    right: LogicalPlan = None
+    # equi-join keys as (left_index, right_index) into child schemas
+    eq_keys: list[tuple[int, int]] = field(default_factory=list)
+    # residual conditions over the concatenated schema
+    other_conds: list[Expr] = field(default_factory=list)
+    schema: Schema = None
+
+    def __post_init__(self):
+        self.children = [self.left, self.right]
+
+
+@dataclass
+class LogicalSort(LogicalPlan):
+    child: LogicalPlan
+    keys: list[tuple[Expr, bool]]  # (expr over child schema, desc)
+
+    def __post_init__(self):
+        self.schema = self.child.schema
+        self.children = [self.child]
+
+
+@dataclass
+class LogicalLimit(LogicalPlan):
+    child: LogicalPlan
+    limit: int
+    offset: int = 0
+
+    def __post_init__(self):
+        self.schema = self.child.schema
+        self.children = [self.child]
+
+
+@dataclass
+class LogicalTopN(LogicalPlan):
+    child: LogicalPlan
+    keys: list[tuple[Expr, bool]]
+    limit: int
+    offset: int = 0
+
+    def __post_init__(self):
+        self.schema = self.child.schema
+        self.children = [self.child]
+
+
+def explain_logical(p: LogicalPlan, indent: int = 0) -> str:
+    pad = "  " * indent
+    name = type(p).__name__
+    extra = ""
+    if isinstance(p, LogicalSelection):
+        extra = " " + ", ".join(map(str, p.conditions))
+    elif isinstance(p, LogicalProjection):
+        extra = " " + ", ".join(map(str, p.exprs))
+    elif isinstance(p, LogicalAggregate):
+        extra = (" group=[" + ", ".join(map(str, p.group_exprs)) + "] aggs=["
+                 + ", ".join(f"{a.func.value}({a.arg})" for a in p.aggs) + "]")
+    elif isinstance(p, DataSource):
+        extra = f" table={p.alias}"
+    elif isinstance(p, LogicalJoin):
+        extra = f" {p.kind} keys={p.eq_keys}"
+    out = [pad + name + extra]
+    for c in getattr(p, "children", []):
+        out.append(explain_logical(c, indent + 1))
+    return "\n".join(out)
+
+
+__all__ = [
+    "SchemaCol", "Schema", "LogicalPlan", "DataSource", "LogicalSelection",
+    "LogicalProjection", "AggItem", "LogicalAggregate", "LogicalJoin",
+    "LogicalSort", "LogicalLimit", "LogicalTopN", "explain_logical",
+]

@@ -1,0 +1,2 @@
+from .catalog import Catalog, TableInfo, CatalogError, type_from_sql
+from .session import Session, Domain, ResultSet

@@ -1,0 +1,215 @@
+"""Catalog + table storage.
+
+Reference analog: pkg/meta (catalog) + pkg/infoschema (cached schema) +
+the TiKV-row-store/TiFlash-columnar split: writes land in a host-side row
+buffer (the row store / membuffer analog), reads columnarize lazily into a
+ColumnarSnapshot whose epoch bumps on every write — the raft-learner
+columnarization role of TiFlash (SURVEY.md §7 hard part #6).  When the C++
+KV engine lands, the row buffer moves behind the MVCC store and snapshots
+carry read timestamps.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import numpy as np
+
+from ..chunk.column import Column, StringDict
+from ..store.columnar import ColumnarSnapshot, snapshot_from_columns
+from ..types import dtypes as dt
+
+K = dt.TypeKind
+
+
+class CatalogError(ValueError):
+    pass
+
+
+TYPE_MAP = {
+    "BIGINT": dt.bigint, "INT": dt.bigint, "INTEGER": dt.bigint,
+    "SMALLINT": dt.bigint, "TINYINT": dt.bigint, "MEDIUMINT": dt.bigint,
+    "DOUBLE": dt.double, "REAL": dt.double, "FLOAT": dt.double,
+    "DATE": dt.date, "DATETIME": dt.datetime, "TIMESTAMP": dt.datetime,
+    "TIME": dt.time,
+    "VARCHAR": dt.varchar, "CHAR": dt.varchar, "TEXT": dt.varchar,
+    "STRING": dt.varchar,
+}
+
+
+def type_from_sql(name: str, prec: int, scale: int, not_null: bool) -> dt.DataType:
+    base = name.split(" ")[0]
+    unsigned = "UNSIGNED" in name
+    if base in ("DECIMAL", "NUMERIC"):
+        p = prec if prec > 0 else 10
+        s = scale if scale >= 0 else 0
+        return dt.decimal(p, s, nullable=not not_null)
+    fn = TYPE_MAP.get(base)
+    if fn is None:
+        raise CatalogError(f"unsupported column type {name}")
+    t = fn(nullable=not not_null)
+    if unsigned and t.kind == K.INT64:
+        t = dt.ubigint(nullable=not not_null)
+    return t
+
+
+@dataclass
+class TableInfo:
+    """One table: schema + row-buffer writes + cached columnar snapshot."""
+    name: str
+    col_names: list[str]
+    col_types: list[dt.DataType]
+    primary_key: list[str] = field(default_factory=list)
+    auto_inc_col: Optional[str] = None
+
+    _base_cols: Optional[list[Column]] = None   # bulk-registered columns
+    _pending: list[tuple] = field(default_factory=list)  # python-value rows
+    _snapshot: Optional[ColumnarSnapshot] = None
+    _epoch: int = 0
+    _auto_inc: int = 0
+    n_shards: int = 8
+
+    # ---------------- write path ---------------- #
+
+    def insert_rows(self, rows: list[tuple]) -> int:
+        for r in rows:
+            if len(r) != len(self.col_names):
+                raise CatalogError(
+                    f"column count mismatch: got {len(r)}, want {len(self.col_names)}")
+        fixed = []
+        ai_idx = (self.col_names.index(self.auto_inc_col)
+                  if self.auto_inc_col else -1)
+        for r in rows:
+            r = list(r)
+            if ai_idx >= 0 and r[ai_idx] is None:
+                self._auto_inc += 1
+                r[ai_idx] = self._auto_inc
+            elif ai_idx >= 0 and isinstance(r[ai_idx], int):
+                self._auto_inc = max(self._auto_inc, r[ai_idx])
+            for i, t in enumerate(self.col_types):
+                if r[i] is None and not t.nullable:
+                    raise CatalogError(
+                        f"column {self.col_names[i]!r} cannot be null")
+            fixed.append(tuple(r))
+        self._pending.extend(fixed)
+        self._invalidate()
+        return len(fixed)
+
+    def delete_where(self, keep_mask: np.ndarray) -> int:
+        """Replace contents with rows where keep_mask (aligned with the
+        current snapshot row order)."""
+        snap = self.snapshot()
+        idx = np.nonzero(keep_mask)[0]
+        deleted = snap.num_rows - len(idx)
+        self._base_cols = [c.take(idx) for c in snap.columns]
+        self._pending = []
+        self._invalidate()
+        return deleted
+
+    def replace_columns(self, cols: list[Column]) -> None:
+        self._base_cols = cols
+        self._pending = []
+        self._invalidate()
+
+    def truncate(self):
+        self._base_cols = None
+        self._pending = []
+        self._invalidate()
+
+    def register_columns(self, cols: list[Column]):
+        """Bulk load pre-built columns (benchmarks, tests)."""
+        self._base_cols = cols
+        self._invalidate()
+
+    def _invalidate(self):
+        self._snapshot = None
+        self._epoch += 1
+
+    # ---------------- read path (columnarize) ---------------- #
+
+    @property
+    def num_rows(self) -> int:
+        n = len(self._base_cols[0]) if self._base_cols else 0
+        return n + len(self._pending)
+
+    def snapshot(self) -> ColumnarSnapshot:
+        if self._snapshot is not None:
+            return self._snapshot
+        cols = self._columnarize()
+        self._snapshot = snapshot_from_columns(
+            self.col_names, cols, n_shards=self.n_shards, epoch=self._epoch)
+        return self._snapshot
+
+    def _columnarize(self) -> list[Column]:
+        base = self._base_cols or [
+            Column.from_values(t, []) for t in self.col_types]
+        if not self._pending:
+            return base
+        out = []
+        for i, t in enumerate(self.col_types):
+            vals = [r[i] for r in self._pending]
+            if t.kind == K.STRING:
+                # rebuild a merged sorted dictionary, re-encode both parts
+                old = base[i]
+                old_vals = old.to_python() if len(old) else []
+                d = StringDict.build(list(old_vals) + vals)
+                newc = Column.from_values(t, list(old_vals) + vals, d)
+                out.append(newc)
+            else:
+                newc = Column.from_values(t, vals)
+                out.append(Column.concat([base[i], newc]) if len(base[i])
+                           else newc)
+        return out
+
+
+class Catalog:
+    """In-memory catalog of databases/tables (infoschema analog)."""
+
+    def __init__(self):
+        self.databases: dict[str, dict[str, TableInfo]] = {"test": {},
+                                                           "mysql": {}}
+
+    def create_database(self, name: str, if_not_exists=False):
+        if name in self.databases:
+            if if_not_exists:
+                return
+            raise CatalogError(f"database {name!r} exists")
+        self.databases[name] = {}
+
+    def drop_database(self, name: str, if_exists=False):
+        if name not in self.databases:
+            if if_exists:
+                return
+            raise CatalogError(f"unknown database {name!r}")
+        del self.databases[name]
+
+    def create_table(self, db: str, tbl: TableInfo, if_not_exists=False):
+        d = self._db(db)
+        if tbl.name in d:
+            if if_not_exists:
+                return
+            raise CatalogError(f"table {tbl.name!r} exists")
+        d[tbl.name] = tbl
+
+    def drop_table(self, db: str, name: str, if_exists=False):
+        d = self._db(db)
+        if name not in d:
+            if if_exists:
+                return
+            raise CatalogError(f"unknown table {name!r}")
+        del d[name]
+
+    def get_table(self, db: str, name: str) -> TableInfo:
+        d = self._db(db)
+        if name not in d:
+            raise CatalogError(f"table {db}.{name} doesn't exist")
+        return d[name]
+
+    def _db(self, db: str) -> dict:
+        if db not in self.databases:
+            raise CatalogError(f"unknown database {db!r}")
+        return self.databases[db]
+
+
+__all__ = ["Catalog", "TableInfo", "CatalogError", "type_from_sql"]

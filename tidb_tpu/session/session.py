@@ -1,0 +1,328 @@
+"""Session: statement lifecycle.
+
+Reference analog: pkg/session (session.ExecuteStmt, session.go:2112) —
+parse -> plan -> execute, returning a RecordSet.  The Domain analog (shared
+catalog + mesh + cop client per process) is session.domain.Domain.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import numpy as np
+
+from ..executor.physical import ExecContext, ResultChunk
+from ..executor.plan import to_physical
+from ..parallel.mesh import get_mesh
+from ..planner.build import PlanError, build_select
+from ..planner.logical import explain_logical
+from ..planner.optimize import optimize_plan
+from ..sql import ast as A
+from ..sql.parser import parse_sql
+from ..store.client import CopClient
+from ..types import dtypes as dt
+from .catalog import Catalog, CatalogError, TableInfo, type_from_sql
+
+
+@dataclass
+class ResultSet:
+    """RecordSet analog: column names + decoded python rows."""
+    names: list[str] = field(default_factory=list)
+    rows: list[tuple] = field(default_factory=list)
+    affected: int = 0
+
+    def scalar(self):
+        return self.rows[0][0] if self.rows else None
+
+
+class Domain:
+    """Per-process singleton state (pkg/domain analog): catalog + mesh +
+    cop client + sysvars."""
+
+    def __init__(self, mesh=None):
+        self.catalog = Catalog()
+        self.mesh = mesh if mesh is not None else get_mesh()
+        self.client = CopClient(self.mesh)
+        self.sysvars: dict[str, Any] = {
+            "tidb_distsql_scan_concurrency": 15,
+            "tidb_max_chunk_size": 1024,
+            "tidb_enable_vectorized_expression": 1,
+        }
+
+
+class Session:
+    def __init__(self, domain: Optional[Domain] = None, db: str = "test"):
+        self.domain = domain or Domain()
+        self.db = db
+        self.vars: dict[str, Any] = {}
+        self.in_txn = False
+
+    # ------------------------------------------------------------- #
+
+    def execute(self, sql: str) -> ResultSet:
+        out = ResultSet()
+        for stmt in parse_sql(sql):
+            out = self._exec_stmt(stmt)
+        return out
+
+    def must_query(self, sql: str) -> list[tuple]:
+        """testkit MustQuery analog."""
+        return self.execute(sql).rows
+
+    # ------------------------------------------------------------- #
+
+    def _exec_stmt(self, stmt: A.Node) -> ResultSet:
+        if isinstance(stmt, A.SelectStmt):
+            return self._exec_select(stmt)
+        if isinstance(stmt, A.Explain):
+            return self._exec_explain(stmt)
+        if isinstance(stmt, A.CreateTable):
+            return self._exec_create_table(stmt)
+        if isinstance(stmt, A.DropTable):
+            for n in stmt.names:
+                self.domain.catalog.drop_table(self.db, n, stmt.if_exists)
+            return ResultSet()
+        if isinstance(stmt, A.CreateDatabase):
+            self.domain.catalog.create_database(stmt.name, stmt.if_not_exists)
+            return ResultSet()
+        if isinstance(stmt, A.DropDatabase):
+            self.domain.catalog.drop_database(stmt.name, stmt.if_exists)
+            return ResultSet()
+        if isinstance(stmt, A.UseDatabase):
+            if stmt.name not in self.domain.catalog.databases:
+                raise CatalogError(f"unknown database {stmt.name!r}")
+            self.db = stmt.name
+            return ResultSet()
+        if isinstance(stmt, A.Insert):
+            return self._exec_insert(stmt)
+        if isinstance(stmt, A.Update):
+            return self._exec_update(stmt)
+        if isinstance(stmt, A.Delete):
+            return self._exec_delete(stmt)
+        if isinstance(stmt, A.TruncateTable):
+            self.domain.catalog.get_table(self.db, stmt.name).truncate()
+            return ResultSet()
+        if isinstance(stmt, A.ShowStmt):
+            return self._exec_show(stmt)
+        if isinstance(stmt, A.SetStmt):
+            for name, val in stmt.assignments:
+                v = val.value if isinstance(val, A.Lit) else None
+                (self.domain.sysvars if stmt.scope == "global"
+                 else self.vars)[name.lower()] = v
+            return ResultSet()
+        if isinstance(stmt, A.TxnStmt):
+            # single-statement autocommit engine for now; BEGIN/COMMIT are
+            # accepted and the txn layer arrives with the C++ KV store
+            self.in_txn = stmt.kind == "begin"
+            return ResultSet()
+        if isinstance(stmt, A.AnalyzeTable):
+            self.domain.catalog.get_table(self.db, stmt.name).snapshot()
+            return ResultSet()
+        raise PlanError(f"unsupported statement {type(stmt).__name__}")
+
+    # ------------------------------------------------------------- #
+
+    def _plan_select(self, stmt: A.SelectStmt):
+        built = build_select(stmt, self.domain.catalog, self.db)
+        plan = optimize_plan(built.plan)
+        phys = to_physical(plan)
+        return built, phys
+
+    def _exec_select(self, stmt: A.SelectStmt) -> ResultSet:
+        built, phys = self._plan_select(stmt)
+        ctx = ExecContext(self.domain.client, self.domain.sysvars)
+        chunk = phys.execute(ctx)
+        n_out = len(built.output_names)
+        cols = chunk.columns[:n_out]  # trim hidden ORDER BY columns
+        rows = list(zip(*[c.to_python() for c in cols])) if cols else []
+        return ResultSet(built.output_names, rows)
+
+    def _exec_explain(self, stmt: A.Explain) -> ResultSet:
+        if not isinstance(stmt.stmt, A.SelectStmt):
+            raise PlanError("EXPLAIN supports SELECT only")
+        built, phys = self._plan_select(stmt.stmt)
+        text = phys.explain()
+        return ResultSet(["plan"], [(line,) for line in text.split("\n")])
+
+    def _exec_create_table(self, stmt: A.CreateTable) -> ResultSet:
+        names, types = [], []
+        auto_inc = None
+        for c in stmt.columns:
+            names.append(c.name)
+            not_null = c.not_null or c.name in stmt.primary_key
+            types.append(type_from_sql(c.type_name, c.prec, c.scale, not_null))
+            if c.auto_increment:
+                auto_inc = c.name
+        tbl = TableInfo(stmt.name, names, types, stmt.primary_key, auto_inc)
+        self.domain.catalog.create_table(self.db, tbl, stmt.if_not_exists)
+        return ResultSet()
+
+    def _exec_insert(self, stmt: A.Insert) -> ResultSet:
+        tbl = self.domain.catalog.get_table(self.db, stmt.table)
+        if stmt.select is not None:
+            res = self._exec_select(stmt.select)
+            rows = [tuple(_unwrap(v) for v in r) for r in res.rows]
+        else:
+            rows = [tuple(self._literal_value(v) for v in r)
+                    for r in stmt.rows]
+        if stmt.columns:
+            idx = {n: i for i, n in enumerate(stmt.columns)}
+            full = []
+            for r in rows:
+                if len(r) != len(stmt.columns):
+                    raise PlanError("column count mismatch")
+                full.append(tuple(
+                    r[idx[n]] if n in idx else None for n in tbl.col_names))
+            rows = full
+        n = tbl.insert_rows(rows)
+        return ResultSet(affected=n)
+
+    def _where_mask(self, tbl: TableInfo, where: Optional[A.Node]) -> np.ndarray:
+        """Evaluate WHERE over the table snapshot -> bool mask (NULL=false)."""
+        snap = tbl.snapshot()
+        n = snap.num_rows
+        if where is None:
+            return np.ones(n, bool)
+        from ..expr.compile import eval_expr
+        from ..expr.lower_strings import lower_strings
+        from ..planner.build import ExprBuilder
+        from ..planner.logical import Schema, SchemaCol
+        sch = Schema([SchemaCol(nm, c.dtype)
+                      for nm, c in zip(tbl.col_names, snap.columns)])
+        ir = ExprBuilder(sch).build(where)
+        ir = lower_strings(ir, snap.dictionaries)
+        pairs = [(c.data, (True if c.validity.all() else c.validity))
+                 for c in snap.columns]
+        v, m = eval_expr(np, ir, pairs)
+        v = np.broadcast_to(np.asarray(v), (n,))
+        if v.dtype != bool:
+            v = v != 0
+        if m is not True:
+            v = v & np.broadcast_to(np.asarray(m), (n,))
+        return v
+
+    def _exec_update(self, stmt: A.Update) -> ResultSet:
+        tbl = self.domain.catalog.get_table(self.db, stmt.table)
+        snap = tbl.snapshot()
+        mask = self._where_mask(tbl, stmt.where)
+        n_aff = int(mask.sum())
+        if n_aff == 0:
+            return ResultSet(affected=0)
+        from ..expr.compile import eval_expr
+        from ..expr.lower_strings import lower_strings
+        from ..planner.build import ExprBuilder
+        from ..planner.logical import Schema, SchemaCol
+        sch = Schema([SchemaCol(nm, c.dtype)
+                      for nm, c in zip(tbl.col_names, snap.columns)])
+        pairs = [(c.data, (True if c.validity.all() else c.validity))
+                 for c in snap.columns]
+        ci = {n: i for i, n in enumerate(tbl.col_names)}
+        rows = [list(r) for r in zip(*[c.to_python() for c in snap.columns])] \
+            if snap.num_rows else []
+        midx = np.nonzero(mask)[0]
+        for col, expr_ast in stmt.assignments:
+            if col not in ci:
+                raise PlanError(f"unknown column {col!r}")
+            t = tbl.col_types[ci[col]]
+            if isinstance(expr_ast, A.Lit):
+                val = self._literal_value(expr_ast)
+                for i in midx:
+                    rows[i][ci[col]] = val
+                continue
+            ir = lower_strings(ExprBuilder(sch).build(expr_ast),
+                               snap.dictionaries)
+            if ir.dtype.is_string:
+                raise PlanError("computed string UPDATE not supported yet")
+            v, m = eval_expr(np, ir, pairs)
+            v = np.broadcast_to(np.asarray(v), (snap.num_rows,))
+            for i in midx:
+                ok = True if m is True else bool(np.broadcast_to(
+                    np.asarray(m), (snap.num_rows,))[i])
+                rows[i][ci[col]] = _decode_val(v[i], ir.dtype) if ok else None
+        new_rows = [tuple(_unwrap(x) for x in r) for r in rows]
+        tbl.replace_columns(_rows_to_columns(tbl, new_rows))
+        return ResultSet(affected=n_aff)
+
+    def _exec_delete(self, stmt: A.Delete) -> ResultSet:
+        tbl = self.domain.catalog.get_table(self.db, stmt.table)
+        if stmt.where is None:
+            n = tbl.num_rows
+            tbl.truncate()
+            return ResultSet(affected=n)
+        mask = self._where_mask(tbl, stmt.where)
+        n = tbl.delete_where(~mask)
+        return ResultSet(affected=n)
+
+    def _exec_show(self, stmt: A.ShowStmt) -> ResultSet:
+        cat = self.domain.catalog
+        if stmt.kind == "tables":
+            return ResultSet([f"Tables_in_{self.db}"],
+                             [(n,) for n in sorted(cat.databases[self.db])])
+        if stmt.kind == "databases":
+            return ResultSet(["Database"],
+                             [(n,) for n in sorted(cat.databases)])
+        if stmt.kind == "columns":
+            t = cat.get_table(self.db, stmt.target)
+            return ResultSet(["Field", "Type", "Null"],
+                             [(n, str(ty), "YES" if ty.nullable else "NO")
+                              for n, ty in zip(t.col_names, t.col_types)])
+        if stmt.kind == "variables":
+            vs = {**self.domain.sysvars, **self.vars}
+            return ResultSet(["Variable_name", "Value"],
+                             sorted((k, str(v)) for k, v in vs.items()))
+        raise PlanError(f"unsupported SHOW {stmt.kind}")
+
+    def _literal_value(self, node: A.Node):
+        if isinstance(node, A.Lit):
+            if node.kind in ("int", "bool"):
+                return int(node.value)
+            if node.kind == "float":
+                return float(node.value)
+            if node.kind == "decimal":
+                return str(node.value)
+            return node.value
+        if isinstance(node, A.Unary) and node.op == "-":
+            v = self._literal_value(node.arg)
+            return -v if not isinstance(v, str) else "-" + v
+        raise PlanError("INSERT values must be literals")
+
+
+def _unwrap(v):
+    import decimal as pydec
+    import datetime as pydt
+    if isinstance(v, pydec.Decimal):
+        return str(v)
+    if isinstance(v, pydt.date):
+        return v.isoformat()
+    return v
+
+
+
+def _rows_to_columns(tbl: TableInfo, rows: list[tuple]):
+    from ..chunk.column import Column
+    cols = []
+    for i, t in enumerate(tbl.col_types):
+        cols.append(Column.from_values(t, [r[i] for r in rows]))
+    return cols
+
+
+
+def _decode_val(v, t: dt.DataType):
+    from ..types import decimal as dec, temporal as tmp
+    k = t.kind
+    if k == dt.TypeKind.DECIMAL:
+        return dec.to_string(int(v), t.scale)
+    if k == dt.TypeKind.DATE:
+        return tmp.date_to_string(int(v))
+    if k == dt.TypeKind.DATETIME:
+        return tmp.datetime_to_string(int(v))
+    if k in (dt.TypeKind.FLOAT64, dt.TypeKind.FLOAT32):
+        return float(v)
+    return int(v)
+
+
+
+
+__all__ = ["Session", "Domain", "ResultSet"]

@@ -1,0 +1,255 @@
+"""Untyped SQL AST.
+
+Reference analog: pkg/parser/ast (StmtNode/ExprNode hierarchy).  The planner
+(planner/build.py) resolves names and types, turning these into the typed
+expression IR (expr/ir.py) — same two-stage design as the reference's
+ast.ExprNode -> expression.Expression conversion.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence
+
+
+class Node:
+    pass
+
+
+# ---------------- expressions ---------------- #
+
+@dataclass
+class Ident(Node):
+    parts: tuple[str, ...]          # (col,) or (table, col) or (db, table, col)
+
+
+@dataclass
+class Star(Node):
+    table: Optional[str] = None     # t.* support
+
+
+@dataclass
+class Lit(Node):
+    value: Any                      # python value
+    kind: str                       # 'int' | 'decimal' | 'float' | 'str' | 'null' | 'bool' | 'date' | 'datetime' | 'interval'
+    unit: Optional[str] = None      # interval unit
+
+
+@dataclass
+class Unary(Node):
+    op: str                         # '-' | 'NOT' | '+' | '~'
+    arg: Node = None
+
+
+@dataclass
+class Binary(Node):
+    op: str                         # '+','-','*','/','DIV','%','=','<>','<','<=','>','>=','AND','OR','XOR'
+    left: Node = None
+    right: Node = None
+
+
+@dataclass
+class FuncCall(Node):
+    name: str                       # uppercased
+    args: list[Node] = field(default_factory=list)
+    distinct: bool = False          # COUNT(DISTINCT x)
+
+
+@dataclass
+class CaseExpr(Node):
+    operand: Optional[Node]
+    branches: list[tuple[Node, Node]] = field(default_factory=list)
+    else_: Optional[Node] = None
+
+
+@dataclass
+class InExpr(Node):
+    target: Node
+    items: list[Node] = field(default_factory=list)
+    negated: bool = False
+
+
+@dataclass
+class BetweenExpr(Node):
+    target: Node
+    low: Node = None
+    high: Node = None
+    negated: bool = False
+
+
+@dataclass
+class LikeExpr(Node):
+    target: Node
+    pattern: Node = None
+    negated: bool = False
+
+
+@dataclass
+class IsNullExpr(Node):
+    target: Node
+    negated: bool = False
+
+
+@dataclass
+class CastExpr(Node):
+    arg: Node
+    type_name: str                  # 'SIGNED','UNSIGNED','DOUBLE','DECIMAL(p,s)','CHAR','DATE','DATETIME'
+    prec: int = -1
+    scale: int = -1
+
+
+@dataclass
+class SubqueryExpr(Node):
+    select: "SelectStmt" = None
+    # scalar subquery / IN (subquery) contexts resolved by planner
+
+
+@dataclass
+class ExistsExpr(Node):
+    select: "SelectStmt" = None
+    negated: bool = False
+
+
+# ---------------- table refs ---------------- #
+
+@dataclass
+class TableName(Node):
+    name: str
+    db: Optional[str] = None
+    alias: Optional[str] = None
+
+
+@dataclass
+class SubqueryRef(Node):
+    select: "SelectStmt" = None
+    alias: str = ""
+
+
+@dataclass
+class Join(Node):
+    kind: str                       # 'inner' | 'left' | 'right' | 'cross'
+    left: Node = None
+    right: Node = None
+    on: Optional[Node] = None
+    using: Optional[list[str]] = None
+
+
+# ---------------- statements ---------------- #
+
+@dataclass
+class SelectItem(Node):
+    expr: Node
+    alias: Optional[str] = None
+
+
+@dataclass
+class SelectStmt(Node):
+    items: list[SelectItem] = field(default_factory=list)
+    from_: Optional[Node] = None
+    where: Optional[Node] = None
+    group_by: list[Node] = field(default_factory=list)
+    having: Optional[Node] = None
+    order_by: list[tuple[Node, bool]] = field(default_factory=list)  # (expr, desc)
+    limit: Optional[int] = None
+    offset: Optional[int] = None
+    distinct: bool = False
+
+
+@dataclass
+class ColumnDef(Node):
+    name: str
+    type_name: str                  # normalized, e.g. 'BIGINT','DECIMAL','VARCHAR'
+    prec: int = -1
+    scale: int = -1
+    not_null: bool = False
+    primary_key: bool = False
+    default: Optional[Node] = None
+    auto_increment: bool = False
+
+
+@dataclass
+class CreateTable(Node):
+    name: str
+    columns: list[ColumnDef] = field(default_factory=list)
+    primary_key: list[str] = field(default_factory=list)
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropTable(Node):
+    names: list[str] = field(default_factory=list)
+    if_exists: bool = False
+
+
+@dataclass
+class CreateDatabase(Node):
+    name: str = ""
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropDatabase(Node):
+    name: str = ""
+    if_exists: bool = False
+
+
+@dataclass
+class UseDatabase(Node):
+    name: str = ""
+
+
+@dataclass
+class Insert(Node):
+    table: str = ""
+    columns: list[str] = field(default_factory=list)
+    rows: list[list[Node]] = field(default_factory=list)
+    select: Optional[SelectStmt] = None
+
+
+@dataclass
+class Update(Node):
+    table: str = ""
+    assignments: list[tuple[str, Node]] = field(default_factory=list)
+    where: Optional[Node] = None
+
+
+@dataclass
+class Delete(Node):
+    table: str = ""
+    where: Optional[Node] = None
+
+
+@dataclass
+class Explain(Node):
+    stmt: Node = None
+    analyze: bool = False
+
+
+@dataclass
+class ShowStmt(Node):
+    kind: str = ""                  # 'tables' | 'databases' | 'variables' | 'columns'
+    target: Optional[str] = None
+
+
+@dataclass
+class SetStmt(Node):
+    scope: str = "session"
+    assignments: list[tuple[str, Node]] = field(default_factory=list)
+
+
+@dataclass
+class TxnStmt(Node):
+    kind: str = ""                  # 'begin' | 'commit' | 'rollback'
+
+
+@dataclass
+class AnalyzeTable(Node):
+    name: str = ""
+
+
+@dataclass
+class TruncateTable(Node):
+    name: str = ""
+
+
+__all__ = [n for n in dir() if n[0].isupper()]

@@ -1,0 +1,768 @@
+"""Recursive-descent SQL parser (MySQL-dialect subset).
+
+Reference analog: pkg/parser (goyacc grammar parser.y, 17k lines).  The TPU
+rebuild uses a hand-written Pratt/recursive-descent parser over the subset
+the engine executes: SELECT (joins/group/having/order/limit, subqueries in
+FROM), INSERT/UPDATE/DELETE, CREATE/DROP TABLE/DATABASE, EXPLAIN [ANALYZE],
+SHOW, SET, BEGIN/COMMIT/ROLLBACK, TRUNCATE, ANALYZE TABLE.
+
+Operator precedence mirrors MySQL: OR < XOR < AND < NOT < comparison/IN/
+BETWEEN/LIKE/IS < bitor < bitand < shift < add < mul < unary.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from . import ast as A
+from .lexer import Token, tokenize
+
+
+class ParseError(ValueError):
+    def __init__(self, msg: str, tok: Token):
+        super().__init__(f"{msg} near {tok.text!r} (pos {tok.pos})")
+        self.tok = tok
+
+
+class Parser:
+    def __init__(self, sql: str):
+        self.toks = tokenize(sql)
+        self.i = 0
+
+    # ---------------- token helpers ---------------- #
+
+    @property
+    def cur(self) -> Token:
+        return self.toks[self.i]
+
+    def advance(self) -> Token:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def at_kw(self, *kws: str) -> bool:
+        return self.cur.kind == "kw" and self.cur.text in kws
+
+    def at_op(self, *ops: str) -> bool:
+        return self.cur.kind == "op" and self.cur.text in ops
+
+    def accept_kw(self, *kws: str) -> bool:
+        if self.at_kw(*kws):
+            self.advance()
+            return True
+        return False
+
+    def accept_op(self, *ops: str) -> bool:
+        if self.at_op(*ops):
+            self.advance()
+            return True
+        return False
+
+    def expect_kw(self, kw: str) -> Token:
+        if not self.at_kw(kw):
+            raise ParseError(f"expected {kw}", self.cur)
+        return self.advance()
+
+    def expect_op(self, op: str) -> Token:
+        if not self.at_op(op):
+            raise ParseError(f"expected {op!r}", self.cur)
+        return self.advance()
+
+    def ident(self) -> str:
+        t = self.cur
+        if t.kind == "ident":
+            return self.advance().text
+        # non-reserved keywords usable as identifiers
+        if t.kind == "kw" and t.text in _NONRESERVED:
+            return self.advance().text
+        raise ParseError("expected identifier", t)
+
+    # ---------------- entry ---------------- #
+
+    def parse(self) -> list[A.Node]:
+        stmts = []
+        while self.cur.kind != "eof":
+            if self.accept_op(";"):
+                continue
+            stmts.append(self.statement())
+            if self.cur.kind != "eof":
+                self.expect_op(";")
+        return stmts
+
+    def statement(self) -> A.Node:
+        if self.at_kw("SELECT"):
+            return self.select_stmt()
+        if self.at_kw("EXPLAIN", "DESCRIBE"):
+            self.advance()
+            analyze = self.accept_kw("ANALYZE")
+            return A.Explain(self.statement(), analyze)
+        if self.at_kw("CREATE"):
+            return self.create_stmt()
+        if self.at_kw("DROP"):
+            return self.drop_stmt()
+        if self.at_kw("INSERT"):
+            return self.insert_stmt()
+        if self.at_kw("UPDATE"):
+            return self.update_stmt()
+        if self.at_kw("DELETE"):
+            return self.delete_stmt()
+        if self.at_kw("USE"):
+            self.advance()
+            return A.UseDatabase(self.ident())
+        if self.at_kw("SHOW"):
+            return self.show_stmt()
+        if self.at_kw("SET"):
+            return self.set_stmt()
+        if self.at_kw("BEGIN"):
+            self.advance()
+            return A.TxnStmt("begin")
+        if self.at_kw("START"):
+            self.advance()
+            self.expect_kw("TRANSACTION")
+            return A.TxnStmt("begin")
+        if self.at_kw("COMMIT"):
+            self.advance()
+            return A.TxnStmt("commit")
+        if self.at_kw("ROLLBACK"):
+            self.advance()
+            return A.TxnStmt("rollback")
+        if self.at_kw("TRUNCATE"):
+            self.advance()
+            self.accept_kw("TABLE")
+            return A.TruncateTable(self.ident())
+        if self.at_kw("ANALYZE"):
+            self.advance()
+            self.expect_kw("TABLE")
+            return A.AnalyzeTable(self.ident())
+        raise ParseError("unsupported statement", self.cur)
+
+    # ---------------- SELECT ---------------- #
+
+    def select_stmt(self) -> A.SelectStmt:
+        self.expect_kw("SELECT")
+        s = A.SelectStmt()
+        if self.accept_kw("DISTINCT"):
+            s.distinct = True
+        else:
+            self.accept_kw("ALL")
+        while True:
+            s.items.append(self.select_item())
+            if not self.accept_op(","):
+                break
+        if self.accept_kw("FROM"):
+            s.from_ = self.table_refs()
+        if self.accept_kw("WHERE"):
+            s.where = self.expr()
+        if self.at_kw("GROUP"):
+            self.advance()
+            self.expect_kw("BY")
+            while True:
+                s.group_by.append(self.expr())
+                if not self.accept_op(","):
+                    break
+        if self.accept_kw("HAVING"):
+            s.having = self.expr()
+        if self.at_kw("ORDER"):
+            self.advance()
+            self.expect_kw("BY")
+            while True:
+                e = self.expr()
+                desc = False
+                if self.accept_kw("DESC"):
+                    desc = True
+                else:
+                    self.accept_kw("ASC")
+                s.order_by.append((e, desc))
+                if not self.accept_op(","):
+                    break
+        if self.accept_kw("LIMIT"):
+            a = self._int_lit()
+            if self.accept_op(","):
+                s.offset, s.limit = a, self._int_lit()
+            else:
+                s.limit = a
+                if self.accept_kw("OFFSET"):
+                    s.offset = self._int_lit()
+        return s
+
+    def _int_lit(self) -> int:
+        t = self.cur
+        if t.kind != "int":
+            raise ParseError("expected integer", t)
+        self.advance()
+        return int(t.text)
+
+    def select_item(self) -> A.SelectItem:
+        if self.at_op("*"):
+            self.advance()
+            return A.SelectItem(A.Star())
+        # t.* lookahead
+        if (self.cur.kind == "ident" and self.toks[self.i + 1].kind == "op"
+                and self.toks[self.i + 1].text == "."
+                and self.toks[self.i + 2].kind == "op"
+                and self.toks[self.i + 2].text == "*"):
+            t = self.advance().text
+            self.advance()
+            self.advance()
+            return A.SelectItem(A.Star(table=t))
+        e = self.expr()
+        alias = None
+        if self.accept_kw("AS"):
+            alias = self._alias_name()
+        elif self.cur.kind == "ident" or (self.cur.kind == "kw"
+                                          and self.cur.text in _NONRESERVED):
+            alias = self.ident()
+        elif self.cur.kind == "str":
+            alias = self.advance().text
+        return A.SelectItem(e, alias)
+
+    def _alias_name(self) -> str:
+        if self.cur.kind == "str":
+            return self.advance().text
+        return self.ident()
+
+    # ---------------- FROM / joins ---------------- #
+
+    def table_refs(self) -> A.Node:
+        left = self.table_ref()
+        while True:
+            if self.accept_op(","):
+                right = self.table_ref()
+                left = A.Join("cross", left, right, None)
+                continue
+            kind = None
+            if self.at_kw("JOIN", "INNER", "CROSS"):
+                if self.accept_kw("INNER") or self.accept_kw("CROSS"):
+                    pass
+                self.expect_kw("JOIN")
+                kind = "inner"
+            elif self.at_kw("LEFT", "RIGHT"):
+                side = self.advance().text.lower()
+                self.accept_kw("OUTER")
+                self.expect_kw("JOIN")
+                kind = side
+            else:
+                break
+            right = self.table_ref()
+            on = None
+            using = None
+            if self.accept_kw("ON"):
+                on = self.expr()
+            elif self.accept_kw("USING"):
+                self.expect_op("(")
+                using = [self.ident()]
+                while self.accept_op(","):
+                    using.append(self.ident())
+                self.expect_op(")")
+            if kind == "inner" and on is None and using is None:
+                kind = "cross"
+            left = A.Join(kind, left, right, on, using)
+        return left
+
+    def table_ref(self) -> A.Node:
+        if self.accept_op("("):
+            if self.at_kw("SELECT"):
+                sub = self.select_stmt()
+                self.expect_op(")")
+                self.accept_kw("AS")
+                return A.SubqueryRef(sub, self.ident())
+            inner = self.table_refs()
+            self.expect_op(")")
+            return inner
+        name = self.ident()
+        db = None
+        if self.accept_op("."):
+            db, name = name, self.ident()
+        alias = None
+        if self.accept_kw("AS"):
+            alias = self.ident()
+        elif self.cur.kind == "ident":
+            alias = self.ident()
+        return A.TableName(name, db, alias)
+
+    # ---------------- DDL ---------------- #
+
+    def create_stmt(self) -> A.Node:
+        self.expect_kw("CREATE")
+        if self.accept_kw("DATABASE"):
+            ine = self._if_not_exists()
+            return A.CreateDatabase(self.ident(), ine)
+        self.expect_kw("TABLE")
+        ine = self._if_not_exists()
+        name = self.ident()
+        if self.accept_op("."):
+            name = self.ident()  # db-qualified; db ignored round 1
+        self.expect_op("(")
+        ct = A.CreateTable(name, if_not_exists=ine)
+        while True:
+            if self.at_kw("PRIMARY"):
+                self.advance()
+                self.expect_kw("KEY")
+                self.expect_op("(")
+                ct.primary_key = [self.ident()]
+                while self.accept_op(","):
+                    ct.primary_key.append(self.ident())
+                self.expect_op(")")
+            elif self.at_kw("UNIQUE", "INDEX", "KEY"):
+                # secondary index definitions: parsed and ignored round 1
+                self._skip_index_def()
+            else:
+                ct.columns.append(self.column_def())
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        # table options (ENGINE=..., CHARSET=...): skip to end
+        while not (self.at_op(";") or self.cur.kind == "eof"):
+            self.advance()
+        for c in ct.columns:
+            if c.primary_key and c.name not in ct.primary_key:
+                ct.primary_key.append(c.name)
+        return ct
+
+    def _skip_index_def(self):
+        depth = 0
+        while True:
+            if self.at_op("(") :
+                depth += 1
+            elif self.at_op(")"):
+                if depth == 0:
+                    return
+                depth -= 1
+            elif self.at_op(",") and depth == 0:
+                return
+            elif self.cur.kind == "eof":
+                raise ParseError("unterminated index definition", self.cur)
+            self.advance()
+
+    def _if_not_exists(self) -> bool:
+        if self.accept_kw("IF"):
+            self.expect_kw("NOT")
+            self.expect_kw("EXISTS")
+            return True
+        return False
+
+    def column_def(self) -> A.ColumnDef:
+        name = self.ident()
+        tname, prec, scale = self.type_name()
+        cd = A.ColumnDef(name, tname, prec, scale)
+        while True:
+            if self.accept_kw("NOT"):
+                self.expect_kw("NULL")
+                cd.not_null = True
+            elif self.accept_kw("NULL"):
+                pass
+            elif self.at_kw("PRIMARY"):
+                self.advance()
+                self.expect_kw("KEY")
+                cd.primary_key = True
+                cd.not_null = True
+            elif self.accept_kw("UNIQUE"):
+                self.accept_kw("KEY")
+            elif self.accept_kw("DEFAULT"):
+                cd.default = self.expr()
+            elif self.accept_kw("AUTO_INCREMENT"):
+                cd.auto_increment = True
+            elif self.accept_kw("COMMENT"):
+                self.advance()  # string
+            elif self.at_kw("CHARACTER"):
+                self.advance()
+                self.expect_kw("SET")
+                self.ident()
+            elif self.accept_kw("COLLATE"):
+                self.ident()
+            else:
+                break
+        return cd
+
+    def type_name(self) -> tuple[str, int, int]:
+        t = self.cur
+        if t.kind not in ("ident", "kw"):
+            raise ParseError("expected type name", t)
+        self.advance()
+        name = t.text.upper()
+        prec = scale = -1
+        if self.accept_op("("):
+            prec = self._int_lit()
+            if self.accept_op(","):
+                scale = self._int_lit()
+            self.expect_op(")")
+        # UNSIGNED / ZEROFILL modifiers
+        while self.cur.kind == "ident" and self.cur.text.upper() in (
+                "UNSIGNED", "ZEROFILL", "SIGNED"):
+            name += " " + self.advance().text.upper()
+        return name, prec, scale
+
+    # ---------------- DML ---------------- #
+
+    def drop_stmt(self) -> A.Node:
+        self.expect_kw("DROP")
+        if self.accept_kw("DATABASE"):
+            ie = self.accept_kw("IF") and self.expect_kw("EXISTS") is not None
+            return A.DropDatabase(self.ident(), ie)
+        self.expect_kw("TABLE")
+        ie = False
+        if self.accept_kw("IF"):
+            self.expect_kw("EXISTS")
+            ie = True
+        names = [self.ident()]
+        while self.accept_op(","):
+            names.append(self.ident())
+        return A.DropTable(names, ie)
+
+    def insert_stmt(self) -> A.Insert:
+        self.expect_kw("INSERT")
+        self.expect_kw("INTO")
+        name = self.ident()
+        if self.accept_op("."):
+            name = self.ident()
+        ins = A.Insert(name)
+        if self.accept_op("("):
+            ins.columns = [self.ident()]
+            while self.accept_op(","):
+                ins.columns.append(self.ident())
+            self.expect_op(")")
+        if self.at_kw("SELECT"):
+            ins.select = self.select_stmt()
+            return ins
+        self.expect_kw("VALUES")
+        while True:
+            self.expect_op("(")
+            row = [self.expr()]
+            while self.accept_op(","):
+                row.append(self.expr())
+            self.expect_op(")")
+            ins.rows.append(row)
+            if not self.accept_op(","):
+                break
+        return ins
+
+    def update_stmt(self) -> A.Update:
+        self.expect_kw("UPDATE")
+        name = self.ident()
+        self.expect_kw("SET")
+        u = A.Update(name)
+        while True:
+            col = self.ident()
+            self.expect_op("=")
+            u.assignments.append((col, self.expr()))
+            if not self.accept_op(","):
+                break
+        if self.accept_kw("WHERE"):
+            u.where = self.expr()
+        return u
+
+    def delete_stmt(self) -> A.Delete:
+        self.expect_kw("DELETE")
+        self.expect_kw("FROM")
+        d = A.Delete(self.ident())
+        if self.accept_kw("WHERE"):
+            d.where = self.expr()
+        return d
+
+    def show_stmt(self) -> A.ShowStmt:
+        self.expect_kw("SHOW")
+        if self.accept_kw("TABLES"):
+            return A.ShowStmt("tables")
+        if self.accept_kw("DATABASES"):
+            return A.ShowStmt("databases")
+        if self.accept_kw("COLUMNS"):
+            self.expect_kw("FROM")
+            return A.ShowStmt("columns", self.ident())
+        if self.accept_kw("VARIABLES"):
+            return A.ShowStmt("variables")
+        if self.accept_kw("GLOBAL", "SESSION"):
+            self.expect_kw("VARIABLES")
+            return A.ShowStmt("variables")
+        raise ParseError("unsupported SHOW", self.cur)
+
+    def set_stmt(self) -> A.SetStmt:
+        self.expect_kw("SET")
+        scope = "session"
+        if self.accept_kw("GLOBAL"):
+            scope = "global"
+        elif self.accept_kw("SESSION"):
+            scope = "session"
+        st = A.SetStmt(scope)
+        while True:
+            if self.accept_op("@"):
+                self.accept_op("@")
+                if self.cur.kind == "kw":
+                    self.advance()
+                    self.expect_op(".")
+            name = self.ident()
+            if not self.accept_op("=") and not self.accept_op(":="):
+                raise ParseError("expected =", self.cur)
+            st.assignments.append((name, self.expr()))
+            if not self.accept_op(","):
+                break
+        return st
+
+    # ---------------- expressions (precedence climbing) ---------------- #
+
+    def expr(self) -> A.Node:
+        return self.or_expr()
+
+    def or_expr(self) -> A.Node:
+        left = self.xor_expr()
+        while self.at_kw("OR") or self.at_op("||"):
+            self.advance()
+            left = A.Binary("OR", left, self.xor_expr())
+        return left
+
+    def xor_expr(self) -> A.Node:
+        left = self.and_expr()
+        while self.accept_kw("XOR"):
+            left = A.Binary("XOR", left, self.and_expr())
+        return left
+
+    def and_expr(self) -> A.Node:
+        left = self.not_expr()
+        while self.at_kw("AND") or self.at_op("&&"):
+            self.advance()
+            left = A.Binary("AND", left, self.not_expr())
+        return left
+
+    def not_expr(self) -> A.Node:
+        if self.accept_kw("NOT"):
+            return A.Unary("NOT", self.not_expr())
+        return self.predicate()
+
+    def predicate(self) -> A.Node:
+        left = self.bit_or()
+        while True:
+            if self.at_op("=", "<>", "!=", "<", "<=", ">", ">=", "<=>"):
+                op = self.advance().text
+                if op == "!=":
+                    op = "<>"
+                right = self.bit_or()
+                left = A.Binary(op, left, right)
+                continue
+            negated = False
+            save = self.i
+            if self.accept_kw("NOT"):
+                negated = True
+            if self.accept_kw("IN"):
+                self.expect_op("(")
+                if self.at_kw("SELECT"):
+                    sub = self.select_stmt()
+                    self.expect_op(")")
+                    left = A.InExpr(left, [A.SubqueryExpr(sub)], negated)
+                else:
+                    items = [self.expr()]
+                    while self.accept_op(","):
+                        items.append(self.expr())
+                    self.expect_op(")")
+                    left = A.InExpr(left, items, negated)
+                continue
+            if self.accept_kw("BETWEEN"):
+                low = self.bit_or()
+                self.expect_kw("AND")
+                high = self.bit_or()
+                left = A.BetweenExpr(left, low, high, negated)
+                continue
+            if self.accept_kw("LIKE"):
+                left = A.LikeExpr(left, self.bit_or(), negated)
+                continue
+            if negated:
+                self.i = save
+                break
+            if self.accept_kw("IS"):
+                neg = self.accept_kw("NOT")
+                if self.accept_kw("NULL"):
+                    left = A.IsNullExpr(left, neg)
+                elif self.accept_kw("TRUE"):
+                    e = A.Binary("<>", left, A.Lit(0, "int"))
+                    left = A.Unary("NOT", e) if neg else e
+                elif self.accept_kw("FALSE"):
+                    e = A.Binary("=", left, A.Lit(0, "int"))
+                    left = A.Unary("NOT", e) if neg else e
+                else:
+                    raise ParseError("expected NULL/TRUE/FALSE after IS", self.cur)
+                continue
+            break
+        return left
+
+    def bit_or(self) -> A.Node:
+        left = self.bit_and()
+        while self.at_op("|"):
+            self.advance()
+            left = A.Binary("|", left, self.bit_and())
+        return left
+
+    def bit_and(self) -> A.Node:
+        left = self.shift()
+        while self.at_op("&"):
+            self.advance()
+            left = A.Binary("&", left, self.shift())
+        return left
+
+    def shift(self) -> A.Node:
+        left = self.additive()
+        while self.at_op("<<", ">>"):
+            op = self.advance().text
+            left = A.Binary(op, left, self.additive())
+        return left
+
+    def additive(self) -> A.Node:
+        left = self.multiplicative()
+        while self.at_op("+", "-"):
+            op = self.advance().text
+            left = A.Binary(op, left, self.multiplicative())
+        return left
+
+    def multiplicative(self) -> A.Node:
+        left = self.unary()
+        while True:
+            if self.at_op("*", "/", "%"):
+                op = self.advance().text
+                left = A.Binary(op, left, self.unary())
+            elif self.at_kw("DIV"):
+                self.advance()
+                left = A.Binary("DIV", left, self.unary())
+            elif self.at_kw("MOD"):
+                self.advance()
+                left = A.Binary("%", left, self.unary())
+            else:
+                break
+        return left
+
+    def unary(self) -> A.Node:
+        if self.at_op("-"):
+            self.advance()
+            return A.Unary("-", self.unary())
+        if self.at_op("+"):
+            self.advance()
+            return self.unary()
+        if self.at_op("~"):
+            self.advance()
+            return A.Unary("~", self.unary())
+        return self.primary()
+
+    def primary(self) -> A.Node:
+        t = self.cur
+        if t.kind == "int":
+            self.advance()
+            return A.Lit(int(t.text), "int")
+        if t.kind == "decimal":
+            self.advance()
+            return A.Lit(t.text, "decimal")
+        if t.kind == "float":
+            self.advance()
+            return A.Lit(float(t.text), "float")
+        if t.kind == "str":
+            self.advance()
+            return A.Lit(t.text, "str")
+        if self.accept_kw("NULL"):
+            return A.Lit(None, "null")
+        if self.accept_kw("TRUE"):
+            return A.Lit(1, "bool")
+        if self.accept_kw("FALSE"):
+            return A.Lit(0, "bool")
+        if self.at_kw("DATE") and self.toks[self.i + 1].kind == "str":
+            self.advance()
+            return A.Lit(self.advance().text, "date")
+        if self.at_kw("TIMESTAMP") and self.toks[self.i + 1].kind == "str":
+            self.advance()
+            return A.Lit(self.advance().text, "datetime")
+        if self.accept_kw("INTERVAL"):
+            val = self.expr()
+            unit = self.advance().text.upper()
+            return A.Lit(val, "interval", unit)
+        if self.at_kw("CASE"):
+            return self.case_expr()
+        if self.at_kw("CAST", "CONVERT"):
+            return self.cast_expr()
+        if self.accept_kw("EXISTS"):
+            self.expect_op("(")
+            sub = self.select_stmt()
+            self.expect_op(")")
+            return A.ExistsExpr(sub)
+        if self.accept_op("("):
+            if self.at_kw("SELECT"):
+                sub = self.select_stmt()
+                self.expect_op(")")
+                return A.SubqueryExpr(sub)
+            e = self.expr()
+            self.expect_op(")")
+            return e
+        # function call or identifier
+        if t.kind == "ident" or (t.kind == "kw" and t.text in _FUNC_KEYWORDS
+                                 ) or (t.kind == "kw" and t.text in _NONRESERVED):
+            name = self.advance().text
+            if self.at_op("("):
+                return self.func_call(name)
+            parts = [name]
+            while self.at_op(".") and self.toks[self.i + 1].kind in ("ident", "kw"):
+                self.advance()
+                parts.append(self.ident())
+            return A.Ident(tuple(parts))
+        raise ParseError("unexpected token in expression", t)
+
+    def case_expr(self) -> A.CaseExpr:
+        self.expect_kw("CASE")
+        operand = None
+        if not self.at_kw("WHEN"):
+            operand = self.expr()
+        branches = []
+        while self.accept_kw("WHEN"):
+            c = self.expr()
+            self.expect_kw("THEN")
+            branches.append((c, self.expr()))
+        else_ = None
+        if self.accept_kw("ELSE"):
+            else_ = self.expr()
+        self.expect_kw("END")
+        return A.CaseExpr(operand, branches, else_)
+
+    def cast_expr(self) -> A.CastExpr:
+        self.advance()  # CAST | CONVERT
+        self.expect_op("(")
+        arg = self.expr()
+        if not self.accept_kw("AS"):
+            self.expect_op(",")  # CONVERT(x, type)
+        tname, prec, scale = self.type_name()
+        self.expect_op(")")
+        return A.CastExpr(arg, tname, prec, scale)
+
+    def func_call(self, name: str) -> A.Node:
+        self.expect_op("(")
+        fc = A.FuncCall(name.upper())
+        if self.at_op("*"):
+            self.advance()
+            self.expect_op(")")
+            fc.args = [A.Star()]
+            return fc
+        if self.accept_kw("DISTINCT"):
+            fc.distinct = True
+        if not self.at_op(")"):
+            fc.args.append(self.expr())
+            while self.accept_op(","):
+                fc.args.append(self.expr())
+        self.expect_op(")")
+        return fc
+
+
+# keywords that can also start function calls (YEAR(x), DATE(x), IF(...))
+_FUNC_KEYWORDS = {"YEAR", "MONTH", "DAY", "HOUR", "MINUTE", "SECOND", "IF",
+                  "DATE", "TIME", "SUBSTRING", "TRUNCATE"}
+
+# keywords allowed as plain identifiers (column/table names)
+_NONRESERVED = {"YEAR", "MONTH", "DAY", "HOUR", "MINUTE", "SECOND", "DATE",
+                "TIME", "TIMESTAMP", "COMMENT", "ENGINE", "CHARSET",
+                "DATABASES", "TABLES", "VARIABLES", "COLUMNS", "GLOBAL",
+                "SESSION", "KEY", "DEFAULT", "ADMIN", "CHECK", "BEGIN",
+                "TRANSACTION", "TRUNCATE"}
+
+
+def parse_sql(sql: str) -> list[A.Node]:
+    return Parser(sql).parse()
+
+
+def parse_one(sql: str) -> A.Node:
+    stmts = parse_sql(sql)
+    if len(stmts) != 1:
+        raise ValueError(f"expected one statement, got {len(stmts)}")
+    return stmts[0]
+
+
+__all__ = ["Parser", "ParseError", "parse_sql", "parse_one"]

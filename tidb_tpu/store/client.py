@@ -25,7 +25,7 @@ from ..chunk.column import Column
 from ..copr import dag as D
 from ..copr.aggregate import GroupKeyMeta, finalize, merge_states
 from ..parallel.spmd import get_sharded_program
-from .columnar import ColumnarSnapshot
+from .columnar import ColumnarSnapshot, _pow2_at_least
 
 # initial fraction of table rows assumed to survive a row-returning plan
 INITIAL_SELECTIVITY = 4  # capacity = max(rows/shards/4, 1024)
@@ -66,7 +66,7 @@ class CopClient:
             cap = max(root.limit, 16)
         else:
             per_shard = -(-snap.num_rows // max(snap.n_shards, 1)) if snap.num_rows else 1
-            cap = max(_pow2(per_shard // INITIAL_SELECTIVITY), 1024)
+            cap = max(_pow2_at_least(max(per_shard // INITIAL_SELECTIVITY, 1)), 1024)
 
         cols, counts = snap.device_cols(self.mesh)
         for _ in range(8):  # paging: grow until fits
@@ -75,7 +75,7 @@ class CopClient:
             out_counts = np.asarray(jax.device_get(out_counts))
             if is_topn or is_limit or (out_counts <= cap).all():
                 break
-            cap = _pow2(int(out_counts.max()))
+            cap = _pow2_at_least(int(out_counts.max()))
         else:
             raise RuntimeError("paging loop did not converge")
 
@@ -90,13 +90,6 @@ class CopClient:
             dic = dictionaries.get(j) if dictionaries else None
             result.append(Column(t, data.astype(t.np_dtype()), valid, dic))
         return result
-
-
-def _pow2(n: int) -> int:
-    c = 1
-    while c < max(n, 1):
-        c <<= 1
-    return c
 
 
 __all__ = ["CopClient", "CopResult"]

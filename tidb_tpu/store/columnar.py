@@ -77,10 +77,11 @@ class ColumnarSnapshot:
             data = np.zeros((s, cap), dtype=c.data.dtype)
             valid = np.zeros((s, cap), dtype=bool)
             for i in range(s):
-                lo = i * per
+                lo = min(i * per, self.num_rows)
                 hi = min(lo + per, self.num_rows)
-                data[i, : hi - lo] = c.data[lo:hi]
-                valid[i, : hi - lo] = c.validity[lo:hi]
+                if hi > lo:
+                    data[i, : hi - lo] = c.data[lo:hi]
+                    valid[i, : hi - lo] = c.validity[lo:hi]
             live = np.arange(cap)[None, :] < counts[:, None]
             all_valid = bool(valid[live].all())
             cols.append((data, None if all_valid else valid))
