@@ -1,0 +1,284 @@
+// Percolator-model MVCC key-value engine.
+//
+// Reference analog: the in-process storage engine
+// pkg/store/mockstore/unistore/tikv/mvcc.go (MVCCStore over badger +
+// lockstore) and, behind it, TiKV's txn model: three logical column
+// families —
+//   data:  (key, start_ts)  -> row value
+//   lock:  key              -> {start_ts, primary, op}
+//   write: (key, commit_ts) -> {start_ts, op}
+// with the 2PC protocol: Prewrite (lock + stage data), Commit (write
+// record + unlock), Rollback, and snapshot reads that see the latest
+// commit <= read_ts and fail on conflicting locks.
+//
+// This is a fresh C++17 implementation designed for the TPU framework's
+// host runtime: an ordered std::map keyed by user key holding per-key
+// version chains (newest-first vectors), guarded by a shared_mutex.  It is
+// the transactional row store whose snapshots feed columnarization
+// (store/columnar.py); the C ABI below is consumed via ctypes
+// (tidb_tpu/store/kv.py).  Scan results are returned through a per-call
+// arena so no allocation contracts cross the FFI.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <shared_mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+enum ErrCode : int32_t {
+  OK = 0,
+  ERR_LOCKED = 1,          // conflicting lock -> caller backs off
+  ERR_WRITE_CONFLICT = 2,  // newer commit than start_ts
+  ERR_NOT_FOUND = 3,
+  ERR_TXN_MISMATCH = 4,    // commit/rollback without matching lock
+  ERR_ALREADY_ROLLED_BACK = 5,
+};
+
+enum Op : uint8_t { OP_PUT = 0, OP_DELETE = 1, OP_ROLLBACK = 2 };
+
+struct Lock {
+  uint64_t start_ts = 0;
+  std::string primary;
+  Op op = OP_PUT;
+  std::string value;  // staged data
+  bool present = false;
+};
+
+struct WriteRec {
+  uint64_t commit_ts;
+  uint64_t start_ts;
+  Op op;
+};
+
+struct VersionChain {
+  Lock lock;
+  // newest-first by commit_ts
+  std::vector<WriteRec> writes;
+  // staged/committed values keyed by start_ts
+  std::map<uint64_t, std::string> data;
+};
+
+struct Store {
+  std::map<std::string, VersionChain> keys;
+  mutable std::shared_mutex mu;
+  uint64_t ts_counter = 1;  // simple TSO for embedded use (PD analog)
+};
+
+struct Arena {
+  std::vector<std::string> bufs;
+  const char* push(const std::string& s) {
+    bufs.push_back(s);
+    return bufs.back().data();
+  }
+};
+
+// thread-local: each OS thread gets its own result buffer, so a kv_get
+// pointer stays valid until the *same* thread's next kv_get — the ctypes
+// caller copies immediately after return on that thread.
+thread_local std::string g_err;
+
+int32_t check_lock_conflict(const VersionChain& vc, uint64_t read_ts,
+                            uint64_t caller_start_ts) {
+  if (!vc.lock.present) return OK;
+  if (vc.lock.start_ts == caller_start_ts) return OK;  // own lock
+  if (vc.lock.start_ts <= read_ts) return ERR_LOCKED;
+  return OK;  // lock from a future txn doesn't block this snapshot
+}
+
+const WriteRec* latest_write_le(const VersionChain& vc, uint64_t ts) {
+  for (const auto& w : vc.writes) {
+    if (w.commit_ts <= ts && w.op != OP_ROLLBACK) return &w;
+  }
+  return nullptr;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* kv_open() { return new Store(); }
+
+void kv_close(void* h) { delete static_cast<Store*>(h); }
+
+uint64_t kv_alloc_ts(void* h) {
+  auto* s = static_cast<Store*>(h);
+  std::unique_lock lk(s->mu);
+  return ++s->ts_counter;
+}
+
+// Prewrite one mutation. op: 0=put, 1=delete.
+int32_t kv_prewrite(void* h, const char* key, int32_t klen, const char* val,
+                    int32_t vlen, const char* primary, int32_t plen,
+                    uint64_t start_ts, uint8_t op) {
+  auto* s = static_cast<Store*>(h);
+  std::unique_lock lk(s->mu);
+  std::string k(key, klen);
+  auto& vc = s->keys[k];
+  if (vc.lock.present && vc.lock.start_ts != start_ts) {
+    return ERR_LOCKED;
+  }
+  // write conflict: any commit (or rollback of us) after start_ts
+  for (const auto& w : vc.writes) {
+    if (w.commit_ts > start_ts) {
+      if (w.op == OP_ROLLBACK && w.start_ts != start_ts) continue;
+      return w.op == OP_ROLLBACK ? ERR_ALREADY_ROLLED_BACK
+                                 : ERR_WRITE_CONFLICT;
+    }
+    break;  // writes are newest-first; older ones can't conflict
+  }
+  // rollback record for this exact start_ts => txn was aborted
+  for (const auto& w : vc.writes) {
+    if (w.op == OP_ROLLBACK && w.start_ts == start_ts) {
+      return ERR_ALREADY_ROLLED_BACK;
+    }
+  }
+  vc.lock.present = true;
+  vc.lock.start_ts = start_ts;
+  vc.lock.primary.assign(primary, plen);
+  vc.lock.op = static_cast<Op>(op);
+  vc.lock.value.assign(val ? val : "", val ? vlen : 0);
+  return OK;
+}
+
+int32_t kv_commit(void* h, const char* key, int32_t klen, uint64_t start_ts,
+                  uint64_t commit_ts) {
+  auto* s = static_cast<Store*>(h);
+  std::unique_lock lk(s->mu);
+  auto it = s->keys.find(std::string(key, klen));
+  if (it == s->keys.end()) return ERR_TXN_MISMATCH;
+  auto& vc = it->second;
+  if (!vc.lock.present || vc.lock.start_ts != start_ts) {
+    // idempotent commit: already committed?
+    for (const auto& w : vc.writes) {
+      if (w.start_ts == start_ts && w.op != OP_ROLLBACK) return OK;
+    }
+    return ERR_TXN_MISMATCH;
+  }
+  if (vc.lock.op == OP_PUT) {
+    vc.data[start_ts] = std::move(vc.lock.value);
+  }
+  vc.writes.insert(vc.writes.begin(),
+                   WriteRec{commit_ts, start_ts, vc.lock.op});
+  vc.lock = Lock{};
+  return OK;
+}
+
+int32_t kv_rollback(void* h, const char* key, int32_t klen,
+                    uint64_t start_ts) {
+  auto* s = static_cast<Store*>(h);
+  std::unique_lock lk(s->mu);
+  auto& vc = s->keys[std::string(key, klen)];
+  if (vc.lock.present && vc.lock.start_ts == start_ts) {
+    vc.lock = Lock{};
+  }
+  // tombstone so a late prewrite of the same txn fails
+  vc.writes.insert(vc.writes.begin(),
+                   WriteRec{start_ts, start_ts, OP_ROLLBACK});
+  vc.data.erase(start_ts);
+  return OK;
+}
+
+// Snapshot point get.  out/out_len point into a thread-local buffer valid
+// until the next kv_get on the same thread.
+int32_t kv_get(void* h, const char* key, int32_t klen, uint64_t ts,
+               const char** out, int32_t* out_len) {
+  auto* s = static_cast<Store*>(h);
+  std::shared_lock lk(s->mu);
+  auto it = s->keys.find(std::string(key, klen));
+  if (it == s->keys.end()) return ERR_NOT_FOUND;
+  const auto& vc = it->second;
+  int32_t lc = check_lock_conflict(vc, ts, 0);
+  if (lc != OK) return lc;
+  const WriteRec* w = latest_write_le(vc, ts);
+  if (w == nullptr || w->op == OP_DELETE) return ERR_NOT_FOUND;
+  auto dit = vc.data.find(w->start_ts);
+  if (dit == vc.data.end()) return ERR_NOT_FOUND;
+  g_err = dit->second;
+  *out = g_err.data();
+  *out_len = static_cast<int32_t>(g_err.size());
+  return OK;
+}
+
+// Snapshot range scan [start, end).  Returns number of pairs (<= limit),
+// or the negative error code on lock conflict.  Results are written as
+// length-prefixed records into the caller-provided buffer:
+//   [u32 klen][key][u32 vlen][value] ...
+// If the buffer is too small, returns what fits and sets *truncated=1 with
+// *resume_key of the next key (paging analog).
+int32_t kv_scan(void* h, const char* start, int32_t slen, const char* end,
+                int32_t elen, uint64_t ts, int32_t limit, char* buf,
+                int64_t buf_cap, int64_t* used, uint8_t* truncated) {
+  auto* s = static_cast<Store*>(h);
+  std::shared_lock lk(s->mu);
+  std::string sk(start, slen), ek(end, elen);
+  auto it = s->keys.lower_bound(sk);
+  int32_t n = 0;
+  int64_t off = 0;
+  *truncated = 0;
+  for (; it != s->keys.end() && n < limit; ++it) {
+    if (!ek.empty() && it->first >= ek) break;
+    const auto& vc = it->second;
+    if (check_lock_conflict(vc, ts, 0) != OK) return -ERR_LOCKED;
+    const WriteRec* w = latest_write_le(vc, ts);
+    if (w == nullptr || w->op == OP_DELETE) continue;
+    auto dit = vc.data.find(w->start_ts);
+    if (dit == vc.data.end()) continue;
+    int64_t need = 8 + static_cast<int64_t>(it->first.size())
+                   + static_cast<int64_t>(dit->second.size());
+    if (off + need > buf_cap) {
+      *truncated = 1;
+      break;
+    }
+    uint32_t kl = it->first.size(), vl = dit->second.size();
+    std::memcpy(buf + off, &kl, 4); off += 4;
+    std::memcpy(buf + off, it->first.data(), kl); off += kl;
+    std::memcpy(buf + off, &vl, 4); off += 4;
+    std::memcpy(buf + off, dit->second.data(), vl); off += vl;
+    ++n;
+  }
+  *used = off;
+  return n;
+}
+
+// MVCC garbage collection: drop versions not visible at safepoint
+// (gcworker analog, pkg/store/gcworker/gc_worker.go).
+int64_t kv_gc(void* h, uint64_t safepoint) {
+  auto* s = static_cast<Store*>(h);
+  std::unique_lock lk(s->mu);
+  int64_t dropped = 0;
+  for (auto it = s->keys.begin(); it != s->keys.end();) {
+    auto& vc = it->second;
+    const WriteRec* keep = latest_write_le(vc, safepoint);
+    std::vector<WriteRec> nw;
+    for (const auto& w : vc.writes) {
+      bool live = w.commit_ts > safepoint || (keep && w.commit_ts == keep->commit_ts);
+      if (live) {
+        nw.push_back(w);
+      } else {
+        vc.data.erase(w.start_ts);
+        ++dropped;
+      }
+    }
+    vc.writes = std::move(nw);
+    if (vc.writes.empty() && !vc.lock.present && vc.data.empty()) {
+      it = s->keys.erase(it);
+    } else {
+      ++it;
+    }
+  }
+  return dropped;
+}
+
+int64_t kv_num_keys(void* h) {
+  auto* s = static_cast<Store*>(h);
+  std::shared_lock lk(s->mu);
+  return static_cast<int64_t>(s->keys.size());
+}
+
+}  // extern "C"

@@ -54,25 +54,38 @@ def type_from_sql(name: str, prec: int, scale: int, not_null: bool) -> dt.DataTy
     return t
 
 
-@dataclass
+@dataclass(eq=False)  # identity semantics: tables are stateful singletons
 class TableInfo:
-    """One table: schema + row-buffer writes + cached columnar snapshot."""
+    """One table: schema + KV-backed row store + cached columnar snapshot.
+
+    Two storage modes:
+    - KV mode (default when a store is attached): rows live in the native
+      MVCC engine under record keys t{id}_r{handle} (SURVEY.md §A.2);
+      writes go through percolator transactions; snapshots scan at a read
+      ts and decode once into columns.
+    - bulk mode (register_columns): pre-built columns bypass the row store
+      — the TiFlash-style bulk-load path used by benchmarks.
+    """
     name: str
     col_names: list[str]
     col_types: list[dt.DataType]
     primary_key: list[str] = field(default_factory=list)
     auto_inc_col: Optional[str] = None
+    table_id: int = 0
+    kv: Any = None                              # store.kv.KVStore
 
     _base_cols: Optional[list[Column]] = None   # bulk-registered columns
-    _pending: list[tuple] = field(default_factory=list)  # python-value rows
+    _pending: list = field(default_factory=list)  # bulk-mode write buffer
     _snapshot: Optional[ColumnarSnapshot] = None
     _epoch: int = 0
     _auto_inc: int = 0
+    _next_handle: int = 0
     n_shards: int = 8
 
     # ---------------- write path ---------------- #
 
-    def insert_rows(self, rows: list[tuple]) -> int:
+    def insert_rows(self, rows: list[tuple], txn=None) -> int:
+        from .codec_io import encode_table_row  # local import, avoids cycle
         for r in rows:
             if len(r) != len(self.col_names):
                 raise CatalogError(
@@ -92,34 +105,82 @@ class TableInfo:
                     raise CatalogError(
                         f"column {self.col_names[i]!r} cannot be null")
             fixed.append(tuple(r))
-        self._pending.extend(fixed)
+        if self.kv is not None:
+            own = txn is None
+            t = txn or self.kv.begin()
+            for r in fixed:
+                self._next_handle += 1
+                key, val = encode_table_row(self.table_id, self._next_handle,
+                                            r, self.col_types)
+                t.put(key, val)
+            if own:
+                t.commit()
+        else:
+            self._pending.extend(fixed)
         self._invalidate()
         return len(fixed)
 
     def delete_where(self, keep_mask: np.ndarray) -> int:
-        """Replace contents with rows where keep_mask (aligned with the
-        current snapshot row order)."""
+        """Delete rows where ~keep_mask (aligned with snapshot row order)."""
         snap = self.snapshot()
         idx = np.nonzero(keep_mask)[0]
         deleted = snap.num_rows - len(idx)
-        self._base_cols = [c.take(idx) for c in snap.columns]
-        self._pending = []
+        if self.kv is not None:
+            handles = self._snapshot_handles
+            t = self.kv.begin()
+            from ..store.codec import record_key
+            drop = np.nonzero(~np.asarray(keep_mask))[0]
+            for i in drop:
+                t.delete(record_key(self.table_id, int(handles[i])))
+            t.commit()
+        else:
+            self._base_cols = [c.take(idx) for c in snap.columns]
         self._invalidate()
         return deleted
 
     def replace_columns(self, cols: list[Column]) -> None:
+        """Full rewrite (UPDATE path, round 1)."""
+        if self.kv is not None:
+            # rewrite through the row store to keep MVCC history coherent
+            t = self.kv.begin()
+            from ..store.codec import record_key, record_prefix, record_prefix_end
+            for k, _ in self.kv.scan(record_prefix(self.table_id),
+                                     record_prefix_end(self.table_id),
+                                     t.start_ts):
+                t.delete(k)
+            t.commit()
+            self._base_cols = None
+            rows = list(zip(*[c.to_python() for c in cols])) if cols and len(cols[0]) else []
+            self._invalidate()
+            self.insert_rows([tuple(plainify(v) for v in r) for r in rows])
+            return
         self._base_cols = cols
-        self._pending = []
         self._invalidate()
 
-    def truncate(self):
+    def truncate(self) -> int:
+        n = 0
+        if self.kv is not None:
+            t = self.kv.begin()
+            from ..store.codec import record_prefix, record_prefix_end
+            for k, _ in self.kv.scan(record_prefix(self.table_id),
+                                     record_prefix_end(self.table_id),
+                                     t.start_ts):
+                t.delete(k)
+                n += 1
+            t.commit()
+        elif self._base_cols or self._pending:
+            n = (len(self._base_cols[0]) if self._base_cols else 0) + len(self._pending)
         self._base_cols = None
         self._pending = []
         self._invalidate()
+        return n
 
     def register_columns(self, cols: list[Column]):
-        """Bulk load pre-built columns (benchmarks, tests)."""
+        """Bulk load pre-built columns (benchmarks; TiFlash bulk ingest
+        analog) — bypasses the row store."""
         self._base_cols = cols
+        self._pending = []
+        self.kv = None
         self._invalidate()
 
     def _invalidate(self):
@@ -130,8 +191,12 @@ class TableInfo:
 
     @property
     def num_rows(self) -> int:
-        n = len(self._base_cols[0]) if self._base_cols else 0
-        return n + len(self._pending)
+        if self._snapshot is not None:
+            return self._snapshot.num_rows
+        if self.kv is None:
+            base = len(self._base_cols[0]) if self._base_cols else 0
+            return base + len(self._pending)
+        return self.snapshot().num_rows
 
     def snapshot(self) -> ColumnarSnapshot:
         if self._snapshot is not None:
@@ -141,26 +206,51 @@ class TableInfo:
             self.col_names, cols, n_shards=self.n_shards, epoch=self._epoch)
         return self._snapshot
 
+    _snapshot_handles: Any = None
+
     def _columnarize(self) -> list[Column]:
+        if self.kv is not None:
+            from .codec_io import scan_table_rows
+            ts = self.kv.alloc_ts()
+            handles, rows = scan_table_rows(self.kv, self.table_id, ts,
+                                            self.col_types)
+            self._snapshot_handles = handles
+            return [Column.from_values(t, [r[i] for r in rows])
+                    for i, t in enumerate(self.col_types)]
+        if self._pending:
+            self._base_cols = self._columnarize_append(self._pending)
+            self._pending = []
+        return self._base_cols or [Column.from_values(t, [])
+                                   for t in self.col_types]
+
+    def _columnarize_append(self, new_rows: list[tuple]) -> list[Column]:
         base = self._base_cols or [
             Column.from_values(t, []) for t in self.col_types]
-        if not self._pending:
-            return base
         out = []
         for i, t in enumerate(self.col_types):
-            vals = [r[i] for r in self._pending]
+            vals = [r[i] for r in new_rows]
             if t.kind == K.STRING:
-                # rebuild a merged sorted dictionary, re-encode both parts
                 old = base[i]
                 old_vals = old.to_python() if len(old) else []
                 d = StringDict.build(list(old_vals) + vals)
-                newc = Column.from_values(t, list(old_vals) + vals, d)
-                out.append(newc)
+                out.append(Column.from_values(t, list(old_vals) + vals, d))
             else:
                 newc = Column.from_values(t, vals)
                 out.append(Column.concat([base[i], newc]) if len(base[i])
                            else newc)
         return out
+
+
+def plainify(v):
+    """Normalize result-surface values (Decimal/date) back to plain
+    encodable python values — shared by INSERT-SELECT and UPDATE paths."""
+    import decimal as pydec
+    import datetime as pydt
+    if isinstance(v, pydec.Decimal):
+        return str(v)
+    if isinstance(v, pydt.date):
+        return v.isoformat()
+    return v
 
 
 class Catalog:

@@ -23,7 +23,8 @@ from ..sql import ast as A
 from ..sql.parser import parse_sql
 from ..store.client import CopClient
 from ..types import dtypes as dt
-from .catalog import Catalog, CatalogError, TableInfo, type_from_sql
+from .catalog import (Catalog, CatalogError, TableInfo, plainify,
+                      type_from_sql)
 
 
 @dataclass
@@ -42,14 +43,21 @@ class Domain:
     cop client + sysvars."""
 
     def __init__(self, mesh=None):
+        from ..store.kv import KVStore
         self.catalog = Catalog()
         self.mesh = mesh if mesh is not None else get_mesh()
         self.client = CopClient(self.mesh)
+        self.kv = KVStore()          # native C++ MVCC row store
+        self._next_table_id = 100
         self.sysvars: dict[str, Any] = {
             "tidb_distsql_scan_concurrency": 15,
             "tidb_max_chunk_size": 1024,
             "tidb_enable_vectorized_expression": 1,
         }
+
+    def alloc_table_id(self) -> int:
+        self._next_table_id += 1
+        return self._next_table_id
 
 
 class Session:
@@ -57,7 +65,8 @@ class Session:
         self.domain = domain or Domain()
         self.db = db
         self.vars: dict[str, Any] = {}
-        self.in_txn = False
+        self.txn = None              # active explicit transaction
+        self._txn_tables: set = set()
 
     # ------------------------------------------------------------- #
 
@@ -102,8 +111,8 @@ class Session:
         if isinstance(stmt, A.Delete):
             return self._exec_delete(stmt)
         if isinstance(stmt, A.TruncateTable):
-            self.domain.catalog.get_table(self.db, stmt.name).truncate()
-            return ResultSet()
+            n = self.domain.catalog.get_table(self.db, stmt.name).truncate()
+            return ResultSet(affected=n)
         if isinstance(stmt, A.ShowStmt):
             return self._exec_show(stmt)
         if isinstance(stmt, A.SetStmt):
@@ -113,10 +122,7 @@ class Session:
                  else self.vars)[name.lower()] = v
             return ResultSet()
         if isinstance(stmt, A.TxnStmt):
-            # single-statement autocommit engine for now; BEGIN/COMMIT are
-            # accepted and the txn layer arrives with the C++ KV store
-            self.in_txn = stmt.kind == "begin"
-            return ResultSet()
+            return self._exec_txn(stmt)
         if isinstance(stmt, A.AnalyzeTable):
             self.domain.catalog.get_table(self.db, stmt.name).snapshot()
             return ResultSet()
@@ -146,6 +152,47 @@ class Session:
         text = phys.explain()
         return ResultSet(["plan"], [(line,) for line in text.split("\n")])
 
+    def _exec_txn(self, stmt: A.TxnStmt) -> ResultSet:
+        """Explicit transactions over the native MVCC store.
+
+        Round-1 scope: INSERTs inside BEGIN...COMMIT buffer in one
+        percolator txn (atomic, conflict-checked 2PC at COMMIT); reads see
+        the last committed snapshot (union-scan of own writes comes with
+        the distsql-over-KV path); UPDATE/DELETE inside a txn autocommit."""
+        if stmt.kind == "begin":
+            if self.txn is not None:
+                self._finish_txn(commit=True)
+            self.txn = self.domain.kv.begin()
+            self._txn_tables = set()
+        elif stmt.kind == "commit":
+            self._finish_txn(commit=True)
+        else:  # rollback
+            self._finish_txn(commit=False)
+        return ResultSet()
+
+    def _finish_txn(self, commit: bool):
+        """End the active txn; on commit failure roll back and clear state
+        so the session isn't wedged (review finding)."""
+        txn, self.txn = self.txn, None
+        if txn is None:
+            return
+        if not commit:
+            txn.rollback()
+            self._txn_tables = set()
+            return
+        try:
+            txn.commit()
+            self._invalidate_txn_tables()
+        except Exception:
+            txn.rollback()
+            self._txn_tables = set()
+            raise
+
+    def _invalidate_txn_tables(self):
+        for t in self._txn_tables:
+            t._invalidate()
+        self._txn_tables = set()
+
     def _exec_create_table(self, stmt: A.CreateTable) -> ResultSet:
         names, types = [], []
         auto_inc = None
@@ -155,7 +202,9 @@ class Session:
             types.append(type_from_sql(c.type_name, c.prec, c.scale, not_null))
             if c.auto_increment:
                 auto_inc = c.name
-        tbl = TableInfo(stmt.name, names, types, stmt.primary_key, auto_inc)
+        tbl = TableInfo(stmt.name, names, types, stmt.primary_key, auto_inc,
+                        table_id=self.domain.alloc_table_id(),
+                        kv=self.domain.kv)
         self.domain.catalog.create_table(self.db, tbl, stmt.if_not_exists)
         return ResultSet()
 
@@ -163,7 +212,7 @@ class Session:
         tbl = self.domain.catalog.get_table(self.db, stmt.table)
         if stmt.select is not None:
             res = self._exec_select(stmt.select)
-            rows = [tuple(_unwrap(v) for v in r) for r in res.rows]
+            rows = [tuple(plainify(v) for v in r) for r in res.rows]
         else:
             rows = [tuple(self._literal_value(v) for v in r)
                     for r in stmt.rows]
@@ -176,7 +225,9 @@ class Session:
                 full.append(tuple(
                     r[idx[n]] if n in idx else None for n in tbl.col_names))
             rows = full
-        n = tbl.insert_rows(rows)
+        n = tbl.insert_rows(rows, txn=self.txn)
+        if self.txn is not None:
+            self._txn_tables.add(tbl)
         return ResultSet(affected=n)
 
     def _where_mask(self, tbl: TableInfo, where: Optional[A.Node]) -> np.ndarray:
@@ -241,16 +292,14 @@ class Session:
                 ok = True if m is True else bool(np.broadcast_to(
                     np.asarray(m), (snap.num_rows,))[i])
                 rows[i][ci[col]] = _decode_val(v[i], ir.dtype) if ok else None
-        new_rows = [tuple(_unwrap(x) for x in r) for r in rows]
+        new_rows = [tuple(plainify(x) for x in r) for r in rows]
         tbl.replace_columns(_rows_to_columns(tbl, new_rows))
         return ResultSet(affected=n_aff)
 
     def _exec_delete(self, stmt: A.Delete) -> ResultSet:
         tbl = self.domain.catalog.get_table(self.db, stmt.table)
         if stmt.where is None:
-            n = tbl.num_rows
-            tbl.truncate()
-            return ResultSet(affected=n)
+            return ResultSet(affected=tbl.truncate())
         mask = self._where_mask(tbl, stmt.where)
         n = tbl.delete_where(~mask)
         return ResultSet(affected=n)
@@ -287,16 +336,6 @@ class Session:
             v = self._literal_value(node.arg)
             return -v if not isinstance(v, str) else "-" + v
         raise PlanError("INSERT values must be literals")
-
-
-def _unwrap(v):
-    import decimal as pydec
-    import datetime as pydt
-    if isinstance(v, pydec.Decimal):
-        return str(v)
-    if isinstance(v, pydt.date):
-        return v.isoformat()
-    return v
 
 
 

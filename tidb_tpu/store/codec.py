@@ -1,0 +1,159 @@
+"""Key and row codecs — the storage contract.
+
+Reference analog (SURVEY.md §A.2):
+- record key layout t{tableID}_r{handle} with memcomparable encodings
+  (pkg/tablecodec/tablecodec.go:50-103, pkg/util/codec: ints with sign-bit
+  flip big-endian so byte order == numeric order)
+- row value: versioned compact binary (rowcodec v2 analog,
+  pkg/util/rowcodec: ver byte + null bitmap + per-column payloads), decoded
+  straight into columns at columnarization time (decode once per snapshot,
+  not per query).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Optional, Sequence
+
+from ..types import dtypes as dt
+from ..types import decimal as dec
+from ..types import temporal as tmp
+
+K = dt.TypeKind
+
+SIGN_FLIP = 1 << 63
+
+
+# ---------------- memcomparable keys ---------------- #
+
+def encode_int_key(v: int) -> bytes:
+    """int64 -> 8 bytes, big-endian with sign bit flipped (byte order ==
+    numeric order; util/codec EncodeIntToCmpUint analog)."""
+    return struct.pack(">Q", (v + (1 << 63)) & 0xFFFFFFFFFFFFFFFF)
+
+
+def decode_int_key(b: bytes) -> int:
+    return struct.unpack(">Q", b)[0] - (1 << 63)
+
+
+def record_key(table_id: int, handle: int) -> bytes:
+    return b"t" + encode_int_key(table_id) + b"_r" + encode_int_key(handle)
+
+
+def record_prefix(table_id: int) -> bytes:
+    return b"t" + encode_int_key(table_id) + b"_r"
+
+
+def record_prefix_end(table_id: int) -> bytes:
+    return b"t" + encode_int_key(table_id) + b"_s"  # '_r' + 1
+
+
+def decode_record_key(key: bytes) -> tuple[int, int]:
+    assert key[:1] == b"t" and key[9:11] == b"_r", key
+    return decode_int_key(key[1:9]), decode_int_key(key[11:19])
+
+
+def index_key(table_id: int, index_id: int, *parts: bytes) -> bytes:
+    out = b"t" + encode_int_key(table_id) + b"_i" + encode_int_key(index_id)
+    for p in parts:
+        out += p
+    return out
+
+
+# ---------------- row values ---------------- #
+
+ROW_VERSION = 1
+_NULL = 0xFF
+
+
+def encode_row(values: Sequence[Any], types: Sequence[dt.DataType]) -> bytes:
+    """values are python-level (str/int/Decimal-string/None)."""
+    out = bytearray([ROW_VERSION])
+    out += struct.pack("<H", len(values))
+    for v, t in zip(values, types):
+        if v is None:
+            out.append(_NULL)
+            continue
+        k = t.kind
+        if k in (K.INT64, K.UINT64):
+            out.append(0)
+            out += struct.pack("<q" if k == K.INT64 else "<Q", int(v))
+        elif k in (K.FLOAT64, K.FLOAT32):
+            out.append(1)
+            out += struct.pack("<d", float(v))
+        elif k == K.DECIMAL:
+            out.append(2)
+            out += struct.pack("<q", dec.encode(v, t.scale))
+        elif k == K.STRING:
+            b = str(v).encode()
+            out.append(3)
+            out += struct.pack("<I", len(b)) + b
+        elif k == K.DATE:
+            out.append(4)
+            out += struct.pack("<i", v if isinstance(v, int)
+                               else tmp.parse_date(str(v)))
+        elif k == K.DATETIME:
+            out.append(5)
+            out += struct.pack("<q", v if isinstance(v, int)
+                               else tmp.parse_datetime(str(v)))
+        elif k == K.TIME:
+            out.append(6)
+            out += struct.pack("<q", int(v))
+        else:
+            raise ValueError(f"cannot encode {t}")
+    return bytes(out)
+
+
+def decode_row(data: bytes, types: Sequence[dt.DataType]) -> list[Any]:
+    """Decode to python-level values (Decimal as string, DATE as iso str)."""
+    assert data[0] == ROW_VERSION
+    (n,) = struct.unpack_from("<H", data, 1)
+    off = 3
+    out: list[Any] = []
+    for i in range(n):
+        tag = data[off]
+        off += 1
+        if tag == _NULL:
+            out.append(None)
+            continue
+        t = types[i]
+        if tag == 0:
+            fmt = "<q" if t.kind == K.INT64 else "<Q"
+            (v,) = struct.unpack_from(fmt, data, off)
+            off += 8
+            out.append(int(v))
+        elif tag == 1:
+            (v,) = struct.unpack_from("<d", data, off)
+            off += 8
+            out.append(float(v))
+        elif tag == 2:
+            (v,) = struct.unpack_from("<q", data, off)
+            off += 8
+            out.append(dec.to_string(v, t.scale))
+        elif tag == 3:
+            (ln,) = struct.unpack_from("<I", data, off)
+            off += 4
+            out.append(data[off:off + ln].decode())
+            off += ln
+        elif tag == 4:
+            (v,) = struct.unpack_from("<i", data, off)
+            off += 4
+            out.append(tmp.date_to_string(v))
+        elif tag == 5:
+            (v,) = struct.unpack_from("<q", data, off)
+            off += 8
+            out.append(tmp.datetime_to_string(v))
+        elif tag == 6:
+            (v,) = struct.unpack_from("<q", data, off)
+            off += 8
+            out.append(int(v))
+        else:
+            raise ValueError(f"bad tag {tag}")
+    return out
+
+
+__all__ = [
+    "encode_int_key", "decode_int_key", "record_key", "record_prefix",
+    "record_prefix_end", "decode_record_key", "index_key",
+    "encode_row", "decode_row", "ROW_VERSION",
+]

@@ -1,0 +1,230 @@
+"""ctypes bindings for the native C++ MVCC KV engine + txn client.
+
+Reference analog: the client side of tikv/client-go/v2 (2PC driver, TSO) +
+pkg/kv interfaces (kv.Storage / kv.Transaction / kv.Snapshot, kv/kv.go:218,
+657, 693).  The engine itself is tidb_tpu/native/kvstore.cpp (built on
+first use with make/g++); this module is the Go-interface analog:
+
+- KVStore: open/scan/get at a ts (kv.Snapshot)
+- Txn: buffered writes (MemBuffer analog) + percolator 2PC commit
+  (prewrite all keys primary-first, allocate commit ts, commit primary
+  then secondaries — client-go twoPhaseCommitter analog)
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), os.pardir, "native")
+_LIB_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libtpukv.so"))
+_build_lock = threading.Lock()
+_lib = None
+
+
+class KVError(RuntimeError):
+    def __init__(self, code: int, msg: str = ""):
+        super().__init__(f"kv error {code}: {msg or ERR_NAMES.get(code, '?')}")
+        self.code = code
+
+
+ERR_NAMES = {1: "locked", 2: "write conflict", 3: "not found",
+             4: "txn mismatch", 5: "already rolled back"}
+ERR_LOCKED, ERR_WRITE_CONFLICT, ERR_NOT_FOUND = 1, 2, 3
+
+
+def _load_lib():
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _build_lock:
+        if _lib is not None:
+            return _lib
+        src = os.path.join(_NATIVE_DIR, "kvstore.cpp")
+        if (not os.path.exists(_LIB_PATH)
+                or os.path.getmtime(_LIB_PATH) < os.path.getmtime(src)):
+            subprocess.run(["make", "-C", os.path.abspath(_NATIVE_DIR)],
+                           check=True, capture_output=True)
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.kv_open.restype = ctypes.c_void_p
+        lib.kv_close.argtypes = [ctypes.c_void_p]
+        lib.kv_alloc_ts.restype = ctypes.c_uint64
+        lib.kv_alloc_ts.argtypes = [ctypes.c_void_p]
+        lib.kv_prewrite.restype = ctypes.c_int32
+        lib.kv_prewrite.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32,
+            ctypes.c_char_p, ctypes.c_int32, ctypes.c_char_p, ctypes.c_int32,
+            ctypes.c_uint64, ctypes.c_uint8]
+        lib.kv_commit.restype = ctypes.c_int32
+        lib.kv_commit.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_int32, ctypes.c_uint64,
+                                  ctypes.c_uint64]
+        lib.kv_rollback.restype = ctypes.c_int32
+        lib.kv_rollback.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_int32, ctypes.c_uint64]
+        lib.kv_get.restype = ctypes.c_int32
+        lib.kv_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_int32, ctypes.c_uint64,
+                               ctypes.POINTER(ctypes.c_char_p),
+                               ctypes.POINTER(ctypes.c_int32)]
+        lib.kv_scan.restype = ctypes.c_int32
+        lib.kv_scan.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32,
+            ctypes.c_char_p, ctypes.c_int32, ctypes.c_uint64, ctypes.c_int32,
+            ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_uint8)]
+        lib.kv_gc.restype = ctypes.c_int64
+        lib.kv_gc.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.kv_num_keys.restype = ctypes.c_int64
+        lib.kv_num_keys.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    return _lib
+
+
+class KVStore:
+    """kv.Storage analog over the native engine (embedded TSO)."""
+
+    def __init__(self):
+        self._lib = _load_lib()
+        self._h = ctypes.c_void_p(self._lib.kv_open())
+
+    def close(self):
+        if self._h:
+            self._lib.kv_close(self._h)
+            self._h = None
+
+    def alloc_ts(self) -> int:
+        """TSO allocation (PD analog)."""
+        return int(self._lib.kv_alloc_ts(self._h))
+
+    def begin(self) -> "Txn":
+        return Txn(self, self.alloc_ts())
+
+    # -- snapshot reads ------------------------------------------------ #
+
+    def get(self, key: bytes, ts: int) -> Optional[bytes]:
+        out = ctypes.c_char_p()
+        out_len = ctypes.c_int32()
+        rc = self._lib.kv_get(self._h, key, len(key), ts,
+                              ctypes.byref(out), ctypes.byref(out_len))
+        if rc == ERR_NOT_FOUND:
+            return None
+        if rc != 0:
+            raise KVError(rc)
+        return ctypes.string_at(out, out_len.value)
+
+    def scan(self, start: bytes, end: bytes, ts: int,
+             limit: int = 1 << 30, page_bytes: int = 1 << 20
+             ) -> Iterator[tuple[bytes, bytes]]:
+        """Paged snapshot scan (the kv paging analog, SURVEY.md §5.7)."""
+        buf = ctypes.create_string_buffer(page_bytes)
+        cur = start
+        remaining = limit
+        while remaining > 0:
+            used = ctypes.c_int64()
+            trunc = ctypes.c_uint8()
+            rc = self._lib.kv_scan(self._h, cur, len(cur), end, len(end), ts,
+                                   min(remaining, 1 << 20), buf, page_bytes,
+                                   ctypes.byref(used), ctypes.byref(trunc))
+            if rc < 0:
+                raise KVError(-rc)
+            if rc == 0 and trunc.value:
+                # a single record exceeds the page: grow and retry
+                page_bytes *= 4
+                buf = ctypes.create_string_buffer(page_bytes)
+                continue
+            data = buf.raw[: used.value]
+            off = 0
+            last_key = None
+            for _ in range(rc):
+                klen = int.from_bytes(data[off:off + 4], "little"); off += 4
+                k = data[off:off + klen]; off += klen
+                vlen = int.from_bytes(data[off:off + 4], "little"); off += 4
+                v = data[off:off + vlen]; off += vlen
+                last_key = k
+                yield k, v
+                remaining -= 1
+            if not trunc.value or last_key is None:
+                return
+            cur = last_key + b"\x00"
+
+    def gc(self, safepoint: int) -> int:
+        return int(self._lib.kv_gc(self._h, safepoint))
+
+    def num_keys(self) -> int:
+        return int(self._lib.kv_num_keys(self._h))
+
+
+@dataclass
+class Txn:
+    """Optimistic transaction: membuffer + percolator 2PC on commit
+    (client-go twoPhaseCommitter analog)."""
+    store: KVStore
+    start_ts: int
+    mutations: dict = field(default_factory=dict)  # key -> value|None(delete)
+    committed: bool = False
+
+    def put(self, key: bytes, value: bytes):
+        self.mutations[key] = value
+
+    def delete(self, key: bytes):
+        self.mutations[key] = None
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        if key in self.mutations:
+            return self.mutations[key]
+        return self.store.get(key, self.start_ts)
+
+    def scan(self, start: bytes, end: bytes, **kw):
+        """Union-scan analog: merge membuffer over the snapshot."""
+        snap = dict(self.store.scan(start, end, self.start_ts, **kw))
+        for k, v in self.mutations.items():
+            if start <= k < (end or k + b"\x00"):
+                if v is None:
+                    snap.pop(k, None)
+                else:
+                    snap[k] = v
+        for k in sorted(snap):
+            yield k, snap[k]
+
+    def commit(self) -> int:
+        if not self.mutations:
+            self.committed = True
+            return self.start_ts
+        lib = self.store._lib
+        h = self.store._h
+        keys = sorted(self.mutations)
+        primary = keys[0]
+        prewritten = []
+        for k in keys:
+            v = self.mutations[k]
+            op = 1 if v is None else 0
+            rc = lib.kv_prewrite(h, k, len(k), v or b"", len(v or b""),
+                                 primary, len(primary), self.start_ts, op)
+            if rc != 0:
+                for pk in prewritten:
+                    lib.kv_rollback(h, pk, len(pk), self.start_ts)
+                raise KVError(rc, f"prewrite {k!r}")
+            prewritten.append(k)
+        commit_ts = self.store.alloc_ts()
+        # commit primary first: the txn is durable once the primary commits
+        for k in [primary] + [k for k in keys if k != primary]:
+            rc = lib.kv_commit(h, k, len(k), self.start_ts, commit_ts)
+            if rc != 0:
+                raise KVError(rc, f"commit {k!r}")
+        self.committed = True
+        return commit_ts
+
+    def rollback(self):
+        lib = self.store._lib
+        h = self.store._h
+        for k in self.mutations:
+            lib.kv_rollback(h, k, len(k), self.start_ts)
+        self.mutations.clear()
+
+
+__all__ = ["KVStore", "Txn", "KVError", "ERR_LOCKED", "ERR_WRITE_CONFLICT"]
