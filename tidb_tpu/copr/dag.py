@@ -136,6 +136,29 @@ class Limit(CopNode):
         return (self.child,)
 
 
+@dataclass(frozen=True)
+class LookupJoin(CopNode):
+    """Broadcast lookup join against a small unique-keyed build side.
+
+    Reference analog: the MPP broadcast join (ExchangeType_Broadcast +
+    HashJoinProbeExec, cophandler/mpp_exec.go) specialized to the
+    FK->unique-PK case: each probe row matches at most one build row, so
+    the join is a sorted-lookup gather with NO output expansion — static
+    shapes, MXU/VPU-friendly (SURVEY.md §2.10 P3).
+
+    The build side arrives as auxiliary program inputs (host-materialized,
+    replicated to every device): aux[0] = sorted build keys (int64),
+    aux[1] = permutation into build rows, aux[2:] = build columns.
+    Output schema = probe schema ++ build columns; `kind` inner|left."""
+    child: CopNode = None  # type: ignore[assignment]
+    probe_key: Expr = None  # type: ignore[assignment]
+    kind: str = "inner"
+    build_dtypes: Tuple[dt.DataType, ...] = ()
+
+    def children(self):
+        return (self.child,)
+
+
 def output_dtypes(node: CopNode) -> Tuple[dt.DataType, ...]:
     """Schema of a node's output batch/states."""
     if isinstance(node, TableScan):
@@ -148,6 +171,8 @@ def output_dtypes(node: CopNode) -> Tuple[dt.DataType, ...]:
         return tuple(e.dtype for e in node.exprs)
     if isinstance(node, Aggregation):
         return tuple(a.out_dtype for a in node.aggs)
+    if isinstance(node, LookupJoin):
+        return output_dtypes(node.child) + node.build_dtypes
     raise TypeError(node)
 
 

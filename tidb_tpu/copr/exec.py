@@ -208,7 +208,8 @@ def compact(batch: DeviceBatch, capacity: int):
 # Node execution (traced)
 # --------------------------------------------------------------------- #
 
-def _exec_node(node: D.CopNode, scan_cols: Sequence, row_count, ev: Evaluator):
+def _exec_node(node: D.CopNode, scan_cols: Sequence, row_count, ev: Evaluator,
+               aux: Sequence = ()):
     if isinstance(node, D.TableScan):
         cols = [scan_cols[off] for off in node.col_offsets]
         n = len(cols[0][0]) if cols else 0
@@ -221,7 +222,7 @@ def _exec_node(node: D.CopNode, scan_cols: Sequence, row_count, ev: Evaluator):
         return DeviceBatch(list(cols), sel)
 
     if isinstance(node, D.Selection):
-        batch = _exec_node(node.child, scan_cols, row_count, ev)
+        batch = _exec_node(node.child, scan_cols, row_count, ev, aux)
         memo: dict = {}
         sel = batch.sel
         n = len(batch.cols[0][0])
@@ -235,7 +236,7 @@ def _exec_node(node: D.CopNode, scan_cols: Sequence, row_count, ev: Evaluator):
         return DeviceBatch(batch.cols, sel)
 
     if isinstance(node, D.Projection):
-        batch = _exec_node(node.child, scan_cols, row_count, ev)
+        batch = _exec_node(node.child, scan_cols, row_count, ev, aux)
         memo = {}
         n = len(batch.cols[0][0])
         cols = []
@@ -245,17 +246,48 @@ def _exec_node(node: D.CopNode, scan_cols: Sequence, row_count, ev: Evaluator):
         return DeviceBatch(cols, batch.sel)
 
     if isinstance(node, D.Limit):
-        batch = _exec_node(node.child, scan_cols, row_count, ev)
+        batch = _exec_node(node.child, scan_cols, row_count, ev, aux)
         n = len(batch.cols[0][0])
         sel = _sel_array(batch.sel, n)
         keep = sel & (jnp.cumsum(sel) <= node.limit)
         return DeviceBatch(batch.cols, keep)
 
     if isinstance(node, D.TopN):
-        batch = _exec_node(node.child, scan_cols, row_count, ev)
+        batch = _exec_node(node.child, scan_cols, row_count, ev, aux)
         return _exec_topn(node, batch, ev)
 
+    if isinstance(node, D.LookupJoin):
+        batch = _exec_node(node.child, scan_cols, row_count, ev, aux)
+        return _exec_lookup_join(node, batch, ev, aux)
+
     raise TypeError(node)
+
+
+def _exec_lookup_join(node: D.LookupJoin, batch: DeviceBatch, ev: Evaluator,
+                      aux) -> DeviceBatch:
+    """Sorted-lookup gather join (see dag.LookupJoin).  aux layout:
+    aux[0]=(sorted build keys,), aux[1]=(perm,), aux[2:]=build columns."""
+    n = len(batch.cols[0][0])
+    sorted_keys = aux[0][0]
+    perm = aux[1][0]
+    build_cols = aux[2:]
+    kv, km = ev.eval(node.probe_key, batch.cols, {})
+    kv = _ensure_array(kv, n).astype(jnp.int64)
+    idx = jnp.searchsorted(sorted_keys, kv)
+    idxc = jnp.clip(idx, 0, sorted_keys.shape[0] - 1)
+    matched = sorted_keys[idxc] == kv
+    if km is not True:
+        matched = matched & km
+    brow = perm[idxc]
+    out_cols = list(batch.cols)
+    for bv, bm in build_cols:
+        gv = bv[brow]
+        gm = matched if bm is True else (bm[brow] & matched)
+        out_cols.append((gv, gm))
+    sel = batch.sel
+    if node.kind == "inner":
+        sel = matched if sel is True else (sel & matched)
+    return DeviceBatch(out_cols, sel)
 
 
 def _exec_topn(node: D.TopN, batch: DeviceBatch, ev: Evaluator) -> DeviceBatch:
@@ -314,20 +346,22 @@ class CopProgram:
         self.kind = "agg" if self.agg is not None else "rows"
         self._fn = jax.jit(self._trace)
 
-    def _trace(self, scan_cols, row_count):
+    def _trace(self, scan_cols, row_count, aux_cols=()):
         # At the jit boundary "all valid" is encoded as None (a pytree node,
         # hence static structure); inside the trace it becomes the literal
         # True the Evaluator's fast paths key on.
         scan_cols = [(v, True if m is None else m) for v, m in scan_cols]
+        aux_cols = tuple((v, True if m is None else m) for v, m in aux_cols)
         ev = Evaluator(jnp)
         if self.agg is not None:
-            batch = _exec_node(self.agg.child, scan_cols, row_count, ev)
+            batch = _exec_node(self.agg.child, scan_cols, row_count, ev,
+                               aux_cols)
             return _agg_partial_states(self.agg, batch, ev, {})
-        batch = _exec_node(self.root, scan_cols, row_count, ev)
+        batch = _exec_node(self.root, scan_cols, row_count, ev, aux_cols)
         return compact(batch, self.row_capacity)
 
-    def __call__(self, scan_cols, row_count):
-        return self._fn(scan_cols, row_count)
+    def __call__(self, scan_cols, row_count, aux_cols=()):
+        return self._fn(scan_cols, row_count, aux_cols)
 
 
 def _find_agg(node: D.CopNode) -> Optional[D.Aggregation]:

@@ -134,6 +134,118 @@ class CopTaskExec(PhysOp):
         return ResultChunk(list(self.out_names), cols)
 
 
+@dataclass
+class CopJoinTaskExec(PhysOp):
+    """Broadcast lookup join fused into the device program.
+
+    Materializes the (small) build side host-side via its own physical
+    plan, prepares sorted-key/permutation/column aux arrays, and runs the
+    probe-side fused DAG (which contains a D.LookupJoin) over the sharded
+    probe table with the aux inputs replicated to every device — the MPP
+    broadcast-join analog.  When build keys turn out non-unique (decided at
+    runtime, like the reference's NDV-based join choice), delegates to the
+    prebuilt host fallback plan."""
+    dag: Any
+    table: Any                     # probe-side TableInfo
+    build_exec: PhysOp = None
+    build_key_index: int = 0
+    build_key_dict: Any = None     # probe-side StringDict for string keys
+    probe_key_dtype: Any = None    # for decimal scale alignment
+    join_kind: str = "inner"
+    n_probe: int = 0
+    out_names: list = field(default_factory=list)
+    out_dtypes: list = field(default_factory=list)
+    key_meta: list = field(default_factory=list)
+    out_dicts: dict = field(default_factory=dict)
+    fallback: PhysOp = None
+    children: list = field(default_factory=list)
+
+    def __post_init__(self):
+        self.children = [self.build_exec]
+
+    def describe(self):
+        kind = "agg" if isinstance(self.dag, D.Aggregation) else "rows"
+        return (f"CopJoinTask[{kind},{self.join_kind}] probe={self.table.name}"
+                f" broadcast-build -> TPU")
+
+    def execute(self, ctx: ExecContext) -> ResultChunk:
+        import jax.numpy as jnp
+        bchunk = self.build_exec.execute(ctx)
+        kcol = bchunk.columns[self.build_key_index]
+        keys, ok = self._build_keys(kcol)
+        rows_idx = np.nonzero(ok)[0]           # NULL keys never join
+        keys = keys[rows_idx]
+        if len(np.unique(keys)) != len(keys):
+            return self.fallback.execute(ctx)
+        if len(keys) == 0:
+            return self._empty_build_result(ctx, bchunk)
+        order = np.argsort(keys, kind="stable")
+        sorted_keys = keys[order]
+        perm = np.arange(len(keys), dtype=np.int64)[order]
+        aux = [(jnp.asarray(sorted_keys), None),
+               (jnp.asarray(perm), None)]
+        for c in bchunk.columns:
+            data = c.data[rows_idx]
+            valid = c.validity[rows_idx]
+            aux.append((jnp.asarray(data),
+                        None if valid.all() else jnp.asarray(valid)))
+        snap = self.table.snapshot()
+        if isinstance(self.dag, D.Aggregation):
+            res = ctx.client.execute_agg(self.dag, snap, self.key_meta,
+                                         aux_cols=tuple(aux))
+            cols = res.key_columns + res.columns
+        else:
+            cols = ctx.client.execute_rows(self.dag, snap,
+                                           tuple(self.out_dtypes),
+                                           self.out_dicts,
+                                           aux_cols=tuple(aux))
+        for j, d in self.out_dicts.items():
+            if j < len(cols) and cols[j].dictionary is None:
+                cols[j].dictionary = d
+        # build-side output columns keep their own dictionaries
+        if not isinstance(self.dag, D.Aggregation):
+            for j, c in enumerate(cols):
+                if c.dtype.is_string and c.dictionary is None:
+                    bj = j - self.n_probe
+                    if 0 <= bj < len(bchunk.columns):
+                        c.dictionary = bchunk.columns[bj].dictionary
+        return ResultChunk(list(self.out_names), cols)
+
+    def _build_keys(self, kcol: Column) -> tuple[np.ndarray, np.ndarray]:
+        """Build-side key column -> (int64 keys comparable with the probe
+        key expr, validity)."""
+        ok = kcol.validity.copy()
+        if kcol.dtype.is_string:
+            # remap build codes into the probe dictionary's code space
+            if self.build_key_dict is None or kcol.dictionary is None:
+                return kcol.data.astype(np.int64), ok
+            mapping = np.fromiter(
+                (self.build_key_dict.code_of(v) for v in kcol.dictionary.values),
+                np.int64, count=len(kcol.dictionary)) \
+                if len(kcol.dictionary) else np.zeros(1, np.int64)
+            keys = mapping[np.clip(kcol.data, 0, len(mapping) - 1)]
+            ok = ok & (keys >= 0)          # absent from probe dict: no match
+            return keys, ok
+        keys = kcol.data.astype(np.int64)
+        pt = self.probe_key_dtype
+        if pt is not None and (kcol.dtype.kind == K.DECIMAL
+                               or pt.kind == K.DECIMAL):
+            sb = kcol.dtype.scale if kcol.dtype.kind == K.DECIMAL else 0
+            sp = pt.scale if pt.kind == K.DECIMAL else 0
+            if sp > sb:
+                keys = keys * 10 ** (sp - sb)
+            elif sb > sp:
+                q, r = np.divmod(keys, 10 ** (sb - sp))
+                ok = ok & (r == 0)     # non-representable: can't match
+                keys = q
+        return keys, ok
+
+    def _empty_build_result(self, ctx, bchunk) -> ResultChunk:
+        # empty build side: inner join produces nothing; left join keeps all
+        # probe rows with NULL build cols — both simplest via the fallback
+        return self.fallback.execute(ctx)
+
+
 # --------------------------------------------------------------------- #
 # host operators
 # --------------------------------------------------------------------- #
@@ -314,28 +426,42 @@ class HostHashJoin(PhysOp):
     def execute(self, ctx):
         lc = self.left.execute(ctx)
         rc = self.right.execute(ctx)
-        li, ri = self._match(lc, rc)
-        cols = ([c.take(li) for c in lc.columns]
-                + [_take_nullable(c, ri) for c in rc.columns]) \
-            if self.kind == "left" else (
-                [_take_nullable(c, li) for c in lc.columns]
-                + [c.take(ri) for c in rc.columns]) \
-            if self.kind == "right" else (
-                [c.take(li) for c in lc.columns]
-                + [c.take(ri) for c in rc.columns])
-        chunk = ResultChunk(lc.names + rc.names, cols)
+        nl, nr = lc.num_rows, rc.num_rows
+        li, ri = self._match_pairs(lc, rc)
         if self.other_conds:
-            # residual filter; for outer joins: matched rows only semantics
-            chunk = _filter_chunk(chunk, self.other_conds, self.kind,
-                                  len(lc.columns), li if self.kind == "right" else ri)
-        return chunk
+            # ON residual conditions filter the CANDIDATE pairs before
+            # null-extension: an outer-join row whose pairs all fail the ON
+            # clause is kept null-extended, not dropped (ON != WHERE).
+            cand = ResultChunk(lc.names + rc.names,
+                               [c.take(li) for c in lc.columns]
+                               + [c.take(ri) for c in rc.columns])
+            keep = _conds_mask(cand, self.other_conds)
+            li, ri = li[keep], ri[keep]
+        # outer null-extension for probe rows with no surviving pair
+        if self.kind == "left":
+            matched = np.zeros(nl, bool)
+            matched[li] = True
+            miss = np.nonzero(~matched)[0]
+            li = np.concatenate([li, miss])
+            ri = np.concatenate([ri, np.full(len(miss), -1, np.int64)])
+        elif self.kind == "right":
+            matched = np.zeros(nr, bool)
+            matched[ri] = True
+            miss = np.nonzero(~matched)[0]
+            li = np.concatenate([li, np.full(len(miss), -1, np.int64)])
+            ri = np.concatenate([ri, miss])
+        lcols = ([_take_nullable(c, li) for c in lc.columns]
+                 if self.kind == "right" else [c.take(li) for c in lc.columns])
+        rcols = ([_take_nullable(c, ri) for c in rc.columns]
+                 if self.kind == "left" else [c.take(ri) for c in rc.columns])
+        return ResultChunk(lc.names + rc.names, lcols + rcols)
 
-    def _match(self, lc: ResultChunk, rc: ResultChunk):
+    def _match_pairs(self, lc: ResultChunk, rc: ResultChunk):
+        """All key-equal candidate pairs (no outer extension)."""
         nl, nr = lc.num_rows, rc.num_rows
         if not self.eq_keys:  # cartesian
-            li = np.repeat(np.arange(nl), nr)
-            ri = np.tile(np.arange(nr), nl)
-            return self._outer_fix(li, ri, nl, nr)
+            return (np.repeat(np.arange(nl), nr),
+                    np.tile(np.arange(nr), nl))
         lkeys, rkeys = [], []
         for lk, rk in self.eq_keys:
             a, b = _join_key_arrays(lc.columns[lk], rc.columns[rk])
@@ -351,20 +477,6 @@ class HostHashJoin(PhysOp):
         counts = hi - lo
         li = np.repeat(np.arange(nl), counts)
         ri = order[_ragged_ranges(lo, counts)]
-        return self._outer_fix(li, ri, nl, nr, counts)
-
-    def _outer_fix(self, li, ri, nl, nr, counts=None):
-        if self.kind == "left":
-            miss = (np.nonzero(counts == 0)[0] if counts is not None
-                    else np.array([], np.int64))
-            li = np.concatenate([li, miss])
-            ri = np.concatenate([ri, np.full(len(miss), -1, np.int64)])
-        elif self.kind == "right":
-            matched = np.zeros(nr, bool)
-            matched[ri] = True
-            miss = np.nonzero(~matched)[0]
-            li = np.concatenate([li, np.full(len(miss), -1, np.int64)])
-            ri = np.concatenate([ri, miss])
         return li, ri
 
 
@@ -421,7 +533,8 @@ def _take_nullable(c: Column, idx: np.ndarray) -> Column:
     return out
 
 
-def _filter_chunk(chunk: ResultChunk, conds, kind, n_left, outer_idx):
+def _conds_mask(chunk: ResultChunk, conds) -> np.ndarray:
+    """AND of conditions over a chunk (NULL = false)."""
     pairs = chunk.col_pairs()
     keep = np.ones(chunk.num_rows, bool)
     for c in conds:
@@ -432,10 +545,7 @@ def _filter_chunk(chunk: ResultChunk, conds, kind, n_left, outer_idx):
         if m is not True:
             v = v & np.broadcast_to(np.asarray(m), (chunk.num_rows,))
         keep &= v
-    if kind in ("left", "right") and outer_idx is not None:
-        keep = keep | (np.asarray(outer_idx) < 0)  # keep null-extended rows
-    idx = np.nonzero(keep)[0]
-    return ResultChunk(chunk.names, [c.take(idx) for c in chunk.columns])
+    return keep
 
 
 @dataclass

@@ -31,34 +31,37 @@ K = dt.TypeKind
 MAX_DENSE_GROUPS = 1_000_000
 
 
-def to_physical(p: LogicalPlan) -> PhysOp:
+def to_physical(p: LogicalPlan, no_device_join: bool = False) -> PhysOp:
     if isinstance(p, LogicalProjection) and isinstance(p.child, DualSource):
         return DualExec(list(p.exprs), out_names=p.schema.names())
 
-    cop = _try_cop(p)
+    cop = _try_cop(p, no_device_join)
     if cop is not None:
         return cop
 
+    ndj = no_device_join
     if isinstance(p, LogicalSelection):
-        return HostSelection(to_physical(p.child), list(p.conditions))
+        return HostSelection(to_physical(p.child, ndj), list(p.conditions))
     if isinstance(p, LogicalProjection):
-        return HostProjection(to_physical(p.child), list(p.exprs),
+        return HostProjection(to_physical(p.child, ndj), list(p.exprs),
                               out_names=p.schema.names())
     if isinstance(p, LogicalAggregate):
-        return HostAgg(to_physical(p.child), list(p.group_exprs),
+        return HostAgg(to_physical(p.child, ndj), list(p.group_exprs),
                        list(p.aggs), out_names=p.schema.names(),
                        out_dtypes=[c.dtype for c in p.schema.cols])
     if isinstance(p, LogicalJoin):
-        return HostHashJoin(p.kind, to_physical(p.left), to_physical(p.right),
+        return HostHashJoin(p.kind, to_physical(p.left, ndj),
+                            to_physical(p.right, ndj),
                             list(p.eq_keys), list(p.other_conds),
                             out_names=p.schema.names(),
                             out_dtypes=[c.dtype for c in p.schema.cols])
     if isinstance(p, LogicalSort):
-        return HostSort(to_physical(p.child), list(p.keys))
+        return HostSort(to_physical(p.child, ndj), list(p.keys))
     if isinstance(p, LogicalTopN):
-        return HostTopN(to_physical(p.child), list(p.keys), p.limit, p.offset)
+        return HostTopN(to_physical(p.child, ndj), list(p.keys), p.limit,
+                        p.offset)
     if isinstance(p, LogicalLimit):
-        return HostLimit(to_physical(p.child), p.limit, p.offset)
+        return HostLimit(to_physical(p.child, ndj), p.limit, p.offset)
     if isinstance(p, DataSource):
         raise AssertionError("DataSource should fuse into a CopTask")
     raise NotImplementedError(type(p).__name__)
@@ -66,7 +69,7 @@ def to_physical(p: LogicalPlan) -> PhysOp:
 
 # --------------------------------------------------------------------- #
 
-def _try_cop(p: LogicalPlan) -> Optional[PhysOp]:
+def _try_cop(p: LogicalPlan, no_device_join: bool = False) -> Optional[PhysOp]:
     """Fuse the subtree rooted at p into one CopTask if possible."""
     top = None          # Aggregation | TopN | Limit at the root
     mids: list = []     # Selection / Projection chain
@@ -77,6 +80,8 @@ def _try_cop(p: LogicalPlan) -> Optional[PhysOp]:
     while isinstance(cur, (LogicalSelection, LogicalProjection)):
         mids.append(cur)
         cur = cur.child
+    if isinstance(cur, LogicalJoin) and not no_device_join:
+        return _try_cop_join(p, top, mids, cur)
     if not isinstance(cur, DataSource):
         return None
     ds = cur
@@ -159,6 +164,184 @@ def _try_cop(p: LogicalPlan) -> Optional[PhysOp]:
     return CopTaskExec(node, ds.table, out_names=out_names,
                        out_dtypes=out_dtypes, key_meta=key_meta,
                        out_dicts=out_dicts)
+
+
+BROADCAST_BUILD_MAX_ROWS = 1 << 22     # broadcast-join build-side cap
+
+
+def _try_cop_join(p: LogicalPlan, top, mids, join: LogicalJoin) -> Optional[PhysOp]:
+    """Device broadcast-lookup join: probe chain (left) stays sharded on
+    device; a small build side (right) materializes host-side, replicates,
+    and joins via sorted-lookup gather inside the SAME fused program as the
+    downstream selection/projection/aggregation (MPP broadcast-join analog,
+    SURVEY.md P3/P7).  Falls back to the host hash join at runtime when the
+    build keys turn out non-unique."""
+    from .physical import CopJoinTaskExec
+
+    if join.kind not in ("inner", "left") or len(join.eq_keys) != 1:
+        return None
+    li, ri = join.eq_keys[0]
+
+    # probe = left subtree: Selection/Projection chain over a DataSource
+    pm: list = []
+    cur = join.left
+    while isinstance(cur, (LogicalSelection, LogicalProjection)):
+        pm.append(cur)
+        cur = cur.child
+    if not isinstance(cur, DataSource):
+        return None
+    ds = cur
+    if not isinstance(join.right, (DataSource, LogicalSelection,
+                                   LogicalProjection)):
+        return None
+    # build side must be small enough to broadcast
+    bcur = join.right
+    while isinstance(bcur, (LogicalSelection, LogicalProjection)):
+        bcur = bcur.child
+    if not isinstance(bcur, DataSource) \
+            or bcur.table.num_rows > BROADCAST_BUILD_MAX_ROWS:
+        return None
+
+    snap = ds.table.snapshot()
+    probe_dicts = {}
+    for i, off in enumerate(ds.col_offsets):
+        c = snap.columns[off]
+        if c.dictionary is not None:
+            probe_dicts[i] = c.dictionary
+
+    # bind probe chain
+    node: D.CopNode = D.TableScan(tuple(ds.col_offsets),
+                                  tuple(c.dtype for c in ds.schema.cols))
+    cur_dicts = dict(probe_dicts)
+    for m in reversed(pm):
+        if isinstance(m, LogicalSelection):
+            conds = tuple(lower_strings(c, cur_dicts) for c in m.conditions)
+            if not all(_device_supported(c) for c in conds):
+                return None
+            node = D.Selection(node, conds)
+        else:
+            exprs = tuple(lower_strings(e, cur_dicts) for e in m.exprs)
+            if not all(_device_supported(e) for e in exprs):
+                return None
+            node = D.Projection(node, exprs)
+            cur_dicts = {j: cur_dicts[e.index] for j, e in enumerate(exprs)
+                         if isinstance(e, ColumnRef) and e.index in cur_dicts}
+    n_probe = len(join.left.schema)
+
+    # build side: its own (recursive) physical plan, host-materialized
+    build_exec = to_physical(join.right)
+    bsch = join.right.schema
+    build_out_dicts = _chain_output_dicts(join.right)
+
+    probe_key = lower_strings(join.left.schema.ref(li), cur_dicts)
+    key_dict = cur_dicts.get(li) if probe_key.dtype.is_string else None
+    jnode = D.LookupJoin(node, probe_key=probe_key, kind=join.kind,
+                         build_dtypes=tuple(
+                             c.dtype.with_nullable(True) if join.kind == "left"
+                             else c.dtype for c in bsch.cols))
+
+    # post-join conds/projections + optional top over the concat schema
+    all_dicts = dict(cur_dicts)
+    for j, d in (build_out_dicts or {}).items():
+        all_dicts[n_probe + j] = d
+    out_names = join.schema.names()
+    out_dtypes = [c.dtype for c in join.schema.cols]
+    out_dicts = {i: d for i, d in all_dicts.items()}
+    nodew: D.CopNode = jnode
+    if join.other_conds:
+        if join.kind == "left":
+            # residual ON conditions on an outer join are match conditions,
+            # not filters: a failed condition must null-extend, not drop the
+            # probe row.  The host join implements this; the fused device
+            # Selection would wrongly filter (review finding).
+            return None
+        conds = tuple(lower_strings(c, all_dicts) for c in join.other_conds)
+        if not all(_device_supported(c) for c in conds):
+            return None
+        nodew = D.Selection(nodew, conds)
+    for m in reversed(mids):
+        if isinstance(m, LogicalSelection):
+            conds = tuple(lower_strings(c, all_dicts) for c in m.conditions)
+            if not all(_device_supported(c) for c in conds):
+                return None
+            nodew = D.Selection(nodew, conds)
+        else:
+            exprs = tuple(lower_strings(e, all_dicts) for e in m.exprs)
+            if not all(_device_supported(e) for e in exprs):
+                return None
+            nodew = D.Projection(nodew, exprs)
+            all_dicts = {j: all_dicts[e.index] for j, e in enumerate(exprs)
+                         if isinstance(e, ColumnRef) and e.index in all_dicts}
+            out_names = m.schema.names()
+            out_dtypes = [e.dtype for e in exprs]
+            out_dicts = dict(all_dicts)
+
+    key_meta: list[GroupKeyMeta] = []
+    host_top = None
+    if top is not None:
+        if isinstance(top, LogicalAggregate):
+            agg_dicts: dict[int, object] = {}
+            agg_node = _bind_agg(top, nodew, all_dicts, key_meta, agg_dicts)
+            if agg_node is None:
+                return None  # generic path handles host agg over host join
+            nodew = agg_node
+            out_names = top.schema.names()
+            out_dtypes = [c.dtype for c in top.schema.cols]
+            out_dicts = {i: m.dictionary for i, m in enumerate(key_meta)
+                         if m.dictionary is not None}
+            for i, d in agg_dicts.items():
+                out_dicts[len(key_meta) + i] = d
+        elif isinstance(top, LogicalTopN) and len(top.keys) == 1:
+            key, desc = top.keys[0]
+            key = lower_strings(key, all_dicts)
+            if not _device_supported(key):
+                return None
+            nodew = D.TopN(nodew, sort_key=key, desc=desc,
+                           limit=top.limit + top.offset)
+            host_top = ("topn", top)
+        elif isinstance(top, LogicalLimit):
+            nodew = D.Limit(nodew, limit=top.limit + top.offset)
+            host_top = ("limit", top)
+        else:
+            return None
+
+    fallback = to_physical(p, no_device_join=True)
+    exec_ = CopJoinTaskExec(
+        nodew, ds.table, build_exec=build_exec, build_key_index=ri,
+        build_key_dict=key_dict, probe_key_dtype=probe_key.dtype,
+        join_kind=join.kind, n_probe=n_probe,
+        out_names=out_names, out_dtypes=out_dtypes, key_meta=key_meta,
+        out_dicts=out_dicts, fallback=fallback)
+    if host_top is not None and host_top[0] == "topn":
+        return HostTopN(exec_, list(host_top[1].keys), host_top[1].limit,
+                        host_top[1].offset)
+    if host_top is not None:
+        return HostLimit(exec_, host_top[1].limit, host_top[1].offset)
+    return exec_
+
+
+def _chain_output_dicts(plan: LogicalPlan) -> dict:
+    """Output-position -> StringDict for a Selection/Projection chain over a
+    DataSource (identity for Selection; ColumnRef passthrough for
+    Projection)."""
+    chain = []
+    cur = plan
+    while isinstance(cur, (LogicalSelection, LogicalProjection)):
+        chain.append(cur)
+        cur = cur.child
+    if not isinstance(cur, DataSource):
+        return {}
+    snap = cur.table.snapshot()
+    dicts = {}
+    for i, off in enumerate(cur.col_offsets):
+        c = snap.columns[off]
+        if c.dictionary is not None:
+            dicts[i] = c.dictionary
+    for m in reversed(chain):
+        if isinstance(m, LogicalProjection):
+            dicts = {j: dicts[e.index] for j, e in enumerate(m.exprs)
+                     if isinstance(e, ColumnRef) and e.index in dicts}
+    return dicts
 
 
 def _bind_agg(agg: LogicalAggregate, child: D.CopNode, dicts,

@@ -1,0 +1,102 @@
+"""MPP exchange operators: repartition/broadcast over the mesh.
+
+Reference analog: the MPP exchange layer — plan Fragments cut at
+PhysicalExchangeSender(Broadcast|HashPartition|PassThrough)
+(core/operator/physicalop/physical_exchange_sender.go:34,:109) executed as
+gRPC chunk streams between TiFlash nodes (unistore analog
+cophandler/mpp_exec.go exchSenderExec/exchRecvExec).
+
+TPU redesign (SURVEY.md §2.10 P7): fragments are one shard_map program and
+exchanges are ICI collectives —
+- HashPartition  -> lax.all_to_all of fixed-capacity hash buckets
+- Broadcast      -> lax.all_gather
+- PassThrough    -> identity sharding
+No serialization, no sockets: rows move as dense column arrays over the
+interconnect.  Fixed bucket capacity keeps shapes static; overflow is
+reported per device so the dispatcher can retry bigger (the paging
+discipline again).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .mesh import SHARD_AXIS
+
+# Knuth multiplicative hashing over int64 keys (device-side hash partition)
+_HASH_MULT = jnp.uint64(0x9E3779B97F4A7C15)
+
+
+def hash_partition_ids(keys, n_parts: int):
+    """keys: int64 array -> partition id in [0, n_parts)."""
+    h = keys.astype(jnp.uint64) * _HASH_MULT
+    return (h >> jnp.uint64(33)).astype(jnp.int64) % n_parts
+
+
+def all_to_all_exchange(cols: Sequence, valid, keys, n_dev: int,
+                        capacity: int, axis: str = SHARD_AXIS):
+    """HashPartition exchange inside a shard_map program.
+
+    Each device buckets its local rows by hash(key) into a (n_dev,
+    capacity) send buffer per column, then lax.all_to_all swaps bucket d of
+    every device to device d.  Returns (recv_cols, recv_valid, overflow)
+    where recv_* hold n_dev*capacity rows (concatenated incoming buckets)
+    and overflow is the per-device count of rows dropped for capacity.
+    """
+    if valid is True:
+        valid = jnp.ones(keys.shape[0], bool)
+    pid = hash_partition_ids(keys, n_dev)
+    pid = jnp.where(valid, pid, n_dev)           # dead rows -> dropped
+    # position of each row within its destination bucket
+    onehot = pid[:, None] == jnp.arange(n_dev)[None, :]
+    pos_in_bucket = jnp.cumsum(onehot, axis=0) - 1
+    pos = jnp.take_along_axis(pos_in_bucket,
+                              jnp.clip(pid, 0, n_dev - 1)[:, None],
+                              axis=1)[:, 0]
+    sent = valid & (pos < capacity)
+    flat_idx = jnp.where(sent, jnp.clip(pid, 0, n_dev - 1) * capacity + pos,
+                         n_dev * capacity)      # OOB -> dropped
+    counts = jnp.sum(onehot & valid[:, None], axis=0)
+    overflow = jnp.sum(jnp.maximum(counts - capacity, 0))
+
+    def scatter(v):
+        buf = jnp.zeros((n_dev * capacity,), v.dtype)
+        return buf.at[flat_idx].set(v, mode="drop").reshape(n_dev, capacity)
+
+    send_valid = jnp.zeros((n_dev * capacity,), bool).at[flat_idx].set(
+        sent, mode="drop").reshape(n_dev, capacity)
+    recv_valid = lax.all_to_all(send_valid, axis, split_axis=0,
+                                concat_axis=0, tiled=False).reshape(-1)
+    out_cols = []
+    for v, m in cols:
+        rv = lax.all_to_all(scatter(v), axis, split_axis=0, concat_axis=0,
+                            tiled=False)
+        if m is True:
+            rm = recv_valid      # reuse: identical to the send_valid swap
+        else:
+            sm = jnp.zeros((n_dev * capacity,), bool).at[flat_idx].set(
+                sent & m, mode="drop").reshape(n_dev, capacity)
+            rm = lax.all_to_all(sm, axis, split_axis=0, concat_axis=0,
+                                tiled=False).reshape(-1)
+        out_cols.append((rv.reshape(-1), rm))
+    return out_cols, recv_valid, overflow
+
+
+def broadcast_gather(cols: Sequence, valid, axis: str = SHARD_AXIS):
+    """Broadcast exchange: every device receives all rows (lax.all_gather),
+    the TPU analog of ExchangeType_Broadcast for small build sides."""
+    out = []
+    for v, m in cols:
+        gv = lax.all_gather(v, axis).reshape(-1)
+        gm = (lax.all_gather(m, axis).reshape(-1) if m is not True
+              else True)
+        out.append((gv, gm))
+    gvalid = lax.all_gather(valid, axis).reshape(-1)
+    return out, gvalid
+
+
+__all__ = ["hash_partition_ids", "all_to_all_exchange", "broadcast_gather"]

@@ -76,7 +76,7 @@ class ShardedCopProgram:
         self.agg = dag_root if isinstance(dag_root, D.Aggregation) else None
         self.kind = "agg" if self.agg is not None else "rows"
 
-        in_specs = (P(SHARD_AXIS), P(SHARD_AXIS))
+        in_specs = (P(SHARD_AXIS), P(SHARD_AXIS), P())  # aux replicated
         if self.kind == "agg":
             out_specs = P()          # replicated after psum
         else:
@@ -86,23 +86,24 @@ class ShardedCopProgram:
             self._device_fn, mesh=mesh, in_specs=in_specs,
             out_specs=out_specs, check_vma=False))
 
-    def _device_fn(self, cols, counts):
+    def _device_fn(self, cols, counts, aux):
         cols = [(v, m) for v, m in cols]
         flat, base_sel = _flatten_block(cols, counts)
         flat = [(v, True if m is None else m) for v, m in flat]
+        aux = tuple((v, True if m is None else m) for v, m in aux)
         ev = Evaluator(jnp)
         if self.agg is not None:
-            batch = _exec_node(self.agg.child, flat, base_sel, ev)
+            batch = _exec_node(self.agg.child, flat, base_sel, ev, aux)
             states = _agg_partial_states(self.agg, batch, ev, {})
             return _collective_merge(states, SHARD_AXIS)
-        batch = _exec_node(self.root, flat, base_sel, ev)
+        batch = _exec_node(self.root, flat, base_sel, ev, aux)
         out_cols, n = compact(batch, self.row_capacity)
         # keep a leading per-device axis so out_specs can shard it
         out_cols = [(v[None], m[None]) for v, m in out_cols]
         return out_cols, n[None]
 
-    def __call__(self, stacked_cols: Sequence, counts):
-        return self._fn(tuple(stacked_cols), counts)
+    def __call__(self, stacked_cols: Sequence, counts, aux_cols=()):
+        return self._fn(tuple(stacked_cols), counts, tuple(aux_cols))
 
 
 @functools.lru_cache(maxsize=256)

@@ -66,8 +66,56 @@ def _map_exprs(p: LogicalPlan, fn) -> None:
 def fold_constants(p: LogicalPlan) -> LogicalPlan:
     for c in p.children:
         fold_constants(c)
-    _map_exprs(p, _fold_expr)
+    fn = lambda e: _extract_or_common(_fold_expr(e))
+    _map_exprs(p, fn)
+    # factor extraction can surface new conjuncts: re-split CNF lists
+    if isinstance(p, LogicalSelection):
+        p.conditions = [c2 for c in p.conditions for c2 in _split_cnf(c)]
+    elif isinstance(p, LogicalJoin):
+        p.other_conds = [c2 for c in p.other_conds for c2 in _split_cnf(c)]
     return p
+
+
+def _extract_or_common(e: Expr) -> Expr:
+    """(A AND B) OR (A AND C) -> A AND (B OR C) — extractCommonFactors
+    analog (expression/util.go); distributivity holds in Kleene 3VL.
+    Without this, Q19-style DNF predicates hide their equi-join keys from
+    predicate pushdown."""
+    if not (isinstance(e, Func) and e.op == "or"):
+        if isinstance(e, Func):
+            return Func(e.dtype, e.op,
+                        tuple(_extract_or_common(a) for a in e.args))
+        return e
+    branches = _split_dnf(e)
+    conj = [_split_cnf(b) for b in branches]
+    common = [c for c in conj[0] if all(c in cs for cs in conj[1:])]
+    if not common:
+        return e
+    residuals = []
+    for cs in conj:
+        rest = [c for c in cs if c not in common]
+        if not rest:
+            return _and_all(common)   # one branch fully covered => OR true
+        residuals.append(_and_all(rest))
+    out = residuals[0]
+    from ..expr import builders as B
+    for r in residuals[1:]:
+        out = B.logic("or", out, r)
+    return _and_all(common + [out])
+
+
+def _split_dnf(e: Expr) -> list[Expr]:
+    if isinstance(e, Func) and e.op == "or":
+        return _split_dnf(e.args[0]) + _split_dnf(e.args[1])
+    return [e]
+
+
+def _and_all(conds: list[Expr]) -> Expr:
+    from ..expr import builders as B
+    out = conds[0]
+    for c in conds[1:]:
+        out = B.logic("and", out, c)
+    return out
 
 
 # --------------------------------------------------------------------- #

@@ -45,10 +45,10 @@ class CopClient:
     # ------------------------------------------------------------- #
 
     def execute_agg(self, agg: D.Aggregation, snap: ColumnarSnapshot,
-                    key_meta: list[GroupKeyMeta]) -> CopResult:
+                    key_meta: list[GroupKeyMeta], aux_cols=()) -> CopResult:
         cols, counts = snap.device_cols(self.mesh)
         prog = get_sharded_program(agg, self.mesh)
-        states = prog(cols, counts)
+        states = prog(cols, counts, aux_cols)
         states = jax.device_get(states)
         merged = merge_states([states])
         key_cols, agg_cols = finalize(agg, merged, key_meta)
@@ -57,7 +57,7 @@ class CopClient:
     # ------------------------------------------------------------- #
 
     def execute_rows(self, root: D.CopNode, snap: ColumnarSnapshot,
-                     out_dtypes, dictionaries=None) -> list[Column]:
+                     out_dtypes, dictionaries=None, aux_cols=()) -> list[Column]:
         """Row-returning plan with the paging loop."""
         n_dev = len(self.mesh.devices.reshape(-1))
         is_topn = isinstance(root, D.TopN)
@@ -71,7 +71,7 @@ class CopClient:
         cols, counts = snap.device_cols(self.mesh)
         for _ in range(8):  # paging: grow until fits
             prog = get_sharded_program(root, self.mesh, row_capacity=cap)
-            out_cols, out_counts = prog(cols, counts)
+            out_cols, out_counts = prog(cols, counts, aux_cols)
             out_counts = np.asarray(jax.device_get(out_counts))
             if is_topn or is_limit or (out_counts <= cap).all():
                 break
