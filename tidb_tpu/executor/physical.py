@@ -668,8 +668,415 @@ class DualExec(PhysOp):
         return ResultChunk(list(self.out_names), cols)
 
 
+# --------------------------------------------------------------------- #
+# set operations (UNION / EXCEPT / INTERSECT)
+# --------------------------------------------------------------------- #
+
+def _canon_val(v, t: dt.DataType):
+    """Python value -> canonical hashable value matching the column's
+    internal representation (scaled int for DECIMAL, days for DATE, ...)."""
+    from ..types import decimal as dec, temporal as tmp
+    if v is None:
+        return None
+    k = t.kind
+    if k == K.DECIMAL:
+        return dec.encode(v, t.scale)
+    if k == K.DATE:
+        return v if isinstance(v, (int, np.integer)) \
+            else tmp.parse_date(str(v))
+    if k == K.DATETIME:
+        return v if isinstance(v, (int, np.integer)) \
+            else tmp.parse_datetime(str(v))
+    if k in (K.FLOAT64, K.FLOAT32):
+        return float(v)
+    if k == K.STRING:
+        return str(v)
+    return int(v)
+
+
+def _canon_rows(chunk: ResultChunk, dtypes) -> list[tuple]:
+    cols = []
+    for c, t in zip(chunk.columns[:len(dtypes)], dtypes):
+        cols.append([_canon_val(v, t) for v in c.to_python()])
+    return list(zip(*cols)) if cols else []
+
+
+def _chunk_from_canon(rows: list[tuple], dtypes, names) -> ResultChunk:
+    cols = []
+    for i, t in enumerate(dtypes):
+        vals = [r[i] for r in rows]
+        if t.kind == K.STRING:
+            cols.append(Column.from_values(t, vals))
+        else:
+            data = np.array([0 if v is None else v for v in vals],
+                            dtype=t.np_dtype())
+            valid = np.array([v is not None for v in vals], bool)
+            cols.append(Column(t, data, valid))
+    return ResultChunk(list(names), cols)
+
+
+@dataclass
+class HostSetOp(PhysOp):
+    """UNION/EXCEPT/INTERSECT over canonicalized row tuples (reference:
+    UnionExec executor/union… + set-op rewrites).  Both inputs convert to
+    the unified output dtypes first."""
+    kind: str
+    all: bool = False
+    left: PhysOp = None
+    right: PhysOp = None
+    out_names: list = field(default_factory=list)
+    out_dtypes: list = field(default_factory=list)
+
+    def __post_init__(self):
+        self.children = [self.left, self.right]
+
+    def describe(self):
+        return f"HostSetOp[{self.kind}{' all' if self.all else ''}]"
+
+    def execute(self, ctx):
+        from collections import Counter
+        lrows = _canon_rows(self.left.execute(ctx), self.out_dtypes)
+        rrows = _canon_rows(self.right.execute(ctx), self.out_dtypes)
+        if self.kind == "union":
+            rows = lrows + rrows if self.all \
+                else list(dict.fromkeys(lrows + rrows))
+        elif self.kind == "except":
+            if self.all:
+                rcnt = Counter(rrows)
+                rows = []
+                for r in lrows:
+                    if rcnt[r] > 0:
+                        rcnt[r] -= 1
+                    else:
+                        rows.append(r)
+            else:
+                rset = set(rrows)
+                rows = list(dict.fromkeys(r for r in lrows if r not in rset))
+        else:  # intersect
+            if self.all:
+                rcnt = Counter(rrows)
+                rows = []
+                for r in lrows:
+                    if rcnt[r] > 0:
+                        rcnt[r] -= 1
+                        rows.append(r)
+            else:
+                rset = set(rrows)
+                rows = list(dict.fromkeys(r for r in lrows if r in rset))
+        return _chunk_from_canon(rows, self.out_dtypes, self.out_names)
+
+
+# --------------------------------------------------------------------- #
+# window functions
+# --------------------------------------------------------------------- #
+
+@dataclass
+class HostWindow(PhysOp):
+    """Window functions (reference: executor/window.go WindowExec +
+    pipelined_window.go).  Output = child columns + one column per item,
+    in the CHILD's row order (values computed in partition/order-sorted
+    space, scattered back)."""
+    child: PhysOp
+    items: list = field(default_factory=list)   # planner WindowItem
+    out_names: list = field(default_factory=list)
+    out_dtypes: list = field(default_factory=list)
+
+    def __post_init__(self):
+        self.children = [self.child]
+
+    def describe(self):
+        return "HostWindow[" + ",".join(i.func for i in self.items) + "]"
+
+    def execute(self, ctx):
+        chunk = self.child.execute(ctx)
+        cols = list(chunk.columns)
+        for item in self.items:
+            cols.append(_window_column(item, chunk))
+        return ResultChunk(list(self.out_names), cols)
+
+
+def _window_column(item, chunk: ResultChunk) -> Column:
+    n = chunk.num_rows
+    t = item.out_dtype
+    if n == 0:
+        return Column(t, np.zeros(0, t.np_dtype()), np.zeros(0, bool))
+
+    # sort by (partition, order); boundary detection reuses the same rank
+    # arrays — equality of ranks is invariant under the desc sign flip
+    sort_keys = [(e, False) for e in item.partition] + list(item.order)
+    ranks = _sort_keys_matrix(chunk, sort_keys)
+    sidx = (np.lexsort(tuple(reversed(ranks))) if ranks
+            else np.arange(n))
+
+    n_part = len(item.partition)
+    new_part = np.zeros(n, bool)
+    new_part[0] = True
+    for r in ranks[:n_part]:
+        rs = r[sidx]
+        new_part[1:] |= rs[1:] != rs[:-1]
+    new_peer = new_part.copy()
+    for r in ranks[n_part:]:
+        rs = r[sidx]
+        new_peer[1:] |= rs[1:] != rs[:-1]
+
+    idx = np.arange(n)
+    part_id = np.cumsum(new_part) - 1
+    ps = np.maximum.accumulate(np.where(new_part, idx, 0))      # part start
+    starts = np.flatnonzero(new_part)
+    sizes = np.diff(np.append(starts, n))
+    sz = sizes[part_id]
+    pe = ps + sz - 1                                            # part end
+    pos = idx - ps
+    pstart = np.maximum.accumulate(np.where(new_peer, idx, 0))  # peer start
+    peer_id = np.cumsum(new_peer) - 1
+    peer_starts = np.flatnonzero(new_peer)
+    peer_sizes = np.diff(np.append(peer_starts, n))
+    peer_end = peer_starts[peer_id] + peer_sizes[peer_id] - 1
+
+    f = item.func
+    if f in ("row_number", "rank", "dense_rank", "ntile"):
+        if f == "row_number":
+            vals = pos + 1
+        elif f == "rank":
+            vals = pstart - ps + 1
+        elif f == "dense_rank":
+            d = np.cumsum(new_peer)
+            vals = d - d[ps] + 1
+        else:  # ntile(k)
+            k = int(item.args[0].value)
+            if k <= 0:
+                raise ValueError("NTILE argument must be positive")
+            q, r = sz // k, sz % k
+            big = r * (q + 1)
+            vals = np.where(pos < big, pos // np.maximum(q + 1, 1),
+                            r + (pos - big) // np.maximum(q, 1)) + 1
+        out = np.empty(n, np.int64)
+        out[sidx] = vals
+        return Column(t, out.astype(t.np_dtype()), np.ones(n, bool))
+
+    # value-bearing functions
+    src = _eval_to_column(item.args[0], chunk) if item.args else None
+    v = src.data[sidx] if src is not None else np.zeros(n, np.int64)
+    m = src.validity[sidx] if src is not None else np.ones(n, bool)
+    dictionary = src.dictionary if src is not None else None
+
+    if f in ("lag", "lead"):
+        off = int(item.args[1].value) if len(item.args) > 1 else 1
+        default = item.args[2].value if len(item.args) > 2 else None
+        srcpos = idx - off if f == "lag" else idx + off
+        inside = (srcpos >= ps) & (srcpos <= pe)
+        srcpos = np.clip(srcpos, 0, n - 1)
+        vals = v[srcpos]
+        valid = m[srcpos] & inside
+        if default is not None:
+            if t.is_string:
+                # rebuild the dictionary with the default and remap codes
+                # (codes are sorted-order-preserving, so insertion shifts)
+                nd = StringDict(list(dictionary.values) + [str(default)])
+                remap = np.array([nd.code_of(x) for x in dictionary.values]
+                                 or [0], np.int32)
+                vals = remap[np.clip(vals, 0, max(len(dictionary) - 1, 0))]
+                dval = nd.code_of(str(default))
+                dictionary = nd
+            else:
+                dval = _canon_val(default, t)
+            vals = np.where(inside, vals, dval)
+            valid = valid | ~inside
+        out = np.empty(n, vals.dtype)
+        out[sidx] = vals
+        ov = np.empty(n, bool)
+        ov[sidx] = valid
+        return Column(t, out.astype(t.np_dtype()), ov, dictionary)
+
+    # frame computation (sorted coordinates, inclusive [flo, fhi])
+    flo, fhi, empty = _frame_bounds(item, idx, ps, pe, pstart, peer_end,
+                                    bool(item.order))
+
+    if f == "first_value" or f == "last_value":
+        at = np.clip(np.where(f == "first_value", flo, fhi), 0, n - 1)
+        vals = v[at]
+        valid = m[at] & ~empty
+        out = np.empty(n, vals.dtype)
+        out[sidx] = vals
+        ov = np.empty(n, bool)
+        ov[sidx] = valid
+        return Column(t, out.astype(t.np_dtype()), ov, dictionary)
+
+    is_float = src is not None and src.dtype.kind in (K.FLOAT64, K.FLOAT32)
+    cm = np.concatenate([[0], np.cumsum(m.astype(np.int64))])
+    cnt = cm[np.clip(fhi + 1, 0, n)] - cm[np.clip(flo, 0, n)]
+    cnt = np.where(empty, 0, cnt)
+
+    if f == "count":
+        if src is None:                      # COUNT(*)
+            cnt = np.where(empty, 0, fhi - flo + 1)
+        out = np.empty(n, np.int64)
+        out[sidx] = cnt
+        return Column(t, out, np.ones(n, bool))
+
+    if f in ("sum", "avg"):
+        acc = np.where(m, v, 0).astype(np.float64 if is_float or f == "avg"
+                                       else np.int64)
+        if f == "avg" and src.dtype.kind == K.DECIMAL:
+            acc = acc / (10 ** src.dtype.scale)
+        cs = np.concatenate([[0], np.cumsum(acc)])
+        s = cs[np.clip(fhi + 1, 0, n)] - cs[np.clip(flo, 0, n)]
+        if f == "avg":
+            vals = np.where(cnt > 0, s / np.maximum(cnt, 1), 0.0)
+        else:
+            vals = s
+        valid = cnt > 0
+        out = np.empty(n, vals.dtype)
+        out[sidx] = vals
+        ov = np.empty(n, bool)
+        ov[sidx] = valid
+        return Column(t, out.astype(t.np_dtype()), ov)
+
+    # min / max over the frame: int64 sentinel path for exact integer /
+    # decimal / temporal values (float64 would corrupt > 2^53)
+    assert f in ("min", "max")
+    if is_float:
+        fv = v.astype(np.float64)
+        pad = np.inf if f == "min" else -np.inf
+    else:
+        fv = v.astype(np.int64)
+        pad = np.iinfo(np.int64).max if f == "min" else np.iinfo(np.int64).min
+    fv = np.where(m, fv, pad)
+    if (flo == ps).all():
+        run = np.empty(n, fv.dtype)
+        ends = np.append(starts[1:], n)
+        for s0, e0 in zip(starts, ends):
+            seg = fv[s0:e0]
+            run[s0:e0] = (np.minimum.accumulate(seg) if f == "min"
+                          else np.maximum.accumulate(seg))
+        vals = run[np.clip(fhi, 0, n - 1)]
+    else:
+        vals = np.empty(n, fv.dtype)
+        for i in range(n):
+            if empty[i]:
+                vals[i] = pad
+                continue
+            seg = fv[flo[i]:fhi[i] + 1]
+            vals[i] = seg.min() if f == "min" else seg.max()
+    valid = cnt > 0
+    vals = np.where(valid, vals, 0)
+    out = np.empty(n, vals.dtype)
+    out[sidx] = vals
+    ov = np.empty(n, bool)
+    ov[sidx] = valid
+    return Column(t, out.astype(t.np_dtype()), ov)
+
+
+def _frame_bounds(item, idx, ps, pe, pstart, peer_end, has_order):
+    """Per-row inclusive frame [lo, hi] in sorted coordinates plus an
+    `empty` mask.  Emptiness is decided on the UNCLAMPED bounds — a frame
+    entirely outside the partition (e.g. ROWS BETWEEN UNBOUNDED PRECEDING
+    AND 1 PRECEDING on the first row) is empty, not one-row.  Default
+    frame: RANGE UNBOUNDED PRECEDING..CURRENT ROW with ORDER BY (peers
+    included), whole partition without."""
+    n = len(idx)
+    if item.frame is None:
+        none_empty = np.zeros(n, bool)
+        if has_order:
+            return ps, peer_end, none_empty
+        return ps, pe, none_empty
+    unit, (lok, lon), (hik, hin) = item.frame
+
+    def bound(kind, nv, is_lo):
+        if kind == "unbounded_preceding":
+            return ps
+        if kind == "unbounded_following":
+            return pe
+        if kind == "current":
+            if unit == "range":
+                return pstart if is_lo else peer_end
+            return idx
+        if kind == "preceding":
+            return idx - nv
+        return idx + nv     # following
+
+    lo_raw = bound(lok, lon, True)
+    hi_raw = bound(hik, hin, False)
+    empty = (lo_raw > hi_raw) | (lo_raw > pe) | (hi_raw < ps)
+    lo = np.clip(lo_raw, ps, pe)
+    hi = np.clip(hi_raw, ps, pe)
+    return lo, hi, empty
+
+
+# --------------------------------------------------------------------- #
+# recursive CTEs
+# --------------------------------------------------------------------- #
+
+@dataclass
+class CTEScanExec(PhysOp):
+    """Scan of a recursive CTE's working table (inside the recursive part)
+    or materialized result (reference: executor/cte.go CTEExec +
+    CTETableReaderExec)."""
+    storage: Any
+    role: str
+    out_names: list = field(default_factory=list)
+    out_dtypes: list = field(default_factory=list)
+    children: list = field(default_factory=list)
+
+    def describe(self):
+        return f"CTEScan[{self.storage.name},{self.role}]"
+
+    def execute(self, ctx):
+        st = self.storage
+        if self.role == "working":
+            ch = st.working
+            if ch is None:
+                return _chunk_from_canon([], self.out_dtypes, self.out_names)
+            return ResultChunk(list(self.out_names), list(ch.columns))
+        if st.result is None:
+            _compute_recursive_cte(st, ctx)
+        return ResultChunk(list(self.out_names), list(st.result.columns))
+
+
+def _compute_recursive_cte(st, ctx):
+    """Iterate seed -> recursive parts until no new rows (UNION DISTINCT)
+    or an empty delta (UNION ALL); cap at st.max_depth like
+    cte_max_recursion_depth."""
+    from .plan import to_physical
+    if st.seed_phys is None:
+        st.seed_phys = to_physical(st.seed_logical)
+        st.rec_phys = [to_physical(r) for r in st.rec_logicals]
+    dtypes = [c.dtype for c in st.schema.cols]
+    names = st.schema.names()
+    rows = _canon_rows(st.seed_phys.execute(ctx), dtypes)
+    if st.distinct:
+        rows = list(dict.fromkeys(rows))
+    seen = set(rows)
+    all_rows = list(rows)
+    working = rows
+    depth = 0
+    while working:
+        depth += 1
+        if depth > st.max_depth:
+            raise RuntimeError(
+                f"recursive CTE {st.name!r} exceeded max recursion depth "
+                f"{st.max_depth} (cte_max_recursion_depth)")
+        st.working = _chunk_from_canon(working, dtypes, names)
+        new = []
+        for p in st.rec_phys:
+            new.extend(_canon_rows(p.execute(ctx), dtypes))
+        if st.distinct:
+            fresh = []
+            for r in new:
+                if r not in seen:
+                    seen.add(r)
+                    fresh.append(r)
+            working = fresh
+        else:
+            working = new
+        all_rows.extend(working)
+    st.working = None
+    st.result = _chunk_from_canon(all_rows, dtypes, names)
+
+
 __all__ = [
     "ExecContext", "ResultChunk", "PhysOp", "CopTaskExec", "HostSelection",
     "HostProjection", "HostLimit", "HostSort", "HostTopN", "HostHashJoin",
-    "HostAgg", "DualExec", "DEVICE_OPS",
+    "HostAgg", "DualExec", "HostSetOp", "HostWindow", "CTEScanExec",
+    "DEVICE_OPS",
 ]

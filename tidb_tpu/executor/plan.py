@@ -18,13 +18,16 @@ from ..copr.aggregate import GroupKeyMeta
 from ..expr.ir import ColumnRef, Expr
 from ..expr.lower_strings import lower_strings
 from ..planner.build import DualSource
-from ..planner.logical import (DataSource, LogicalAggregate, LogicalJoin,
-                               LogicalLimit, LogicalPlan, LogicalProjection,
-                               LogicalSelection, LogicalSort, LogicalTopN)
+from ..planner.logical import (DataSource, LogicalAggregate, LogicalCTEScan,
+                               LogicalJoin, LogicalLimit, LogicalPlan,
+                               LogicalProjection, LogicalSelection,
+                               LogicalSetOp, LogicalSort, LogicalTopN,
+                               LogicalWindow)
 from ..types import dtypes as dt
-from .physical import (CopTaskExec, DualExec, HostAgg, HostHashJoin,
-                       HostLimit, HostProjection, HostSelection, HostSort,
-                       HostTopN, PhysOp, _device_supported)
+from .physical import (CopTaskExec, CTEScanExec, DualExec, HostAgg,
+                       HostHashJoin, HostLimit, HostProjection, HostSelection,
+                       HostSetOp, HostSort, HostTopN, HostWindow, PhysOp,
+                       _device_supported)
 
 K = dt.TypeKind
 
@@ -62,6 +65,22 @@ def to_physical(p: LogicalPlan, no_device_join: bool = False) -> PhysOp:
                         p.offset)
     if isinstance(p, LogicalLimit):
         return HostLimit(to_physical(p.child, ndj), p.limit, p.offset)
+    if isinstance(p, LogicalSetOp):
+        # read children[0/1], not left/right: predicate pushdown may have
+        # wrapped a child in a Selection via the generic children list
+        return HostSetOp(p.kind, p.all,
+                         to_physical(p.children[0], ndj),
+                         to_physical(p.children[1], ndj),
+                         out_names=p.schema.names(),
+                         out_dtypes=[c.dtype for c in p.schema.cols])
+    if isinstance(p, LogicalWindow):
+        return HostWindow(to_physical(p.children[0], ndj), list(p.items),
+                          out_names=p.schema.names(),
+                          out_dtypes=[c.dtype for c in p.schema.cols])
+    if isinstance(p, LogicalCTEScan):
+        return CTEScanExec(p.storage, p.role,
+                           out_names=p.schema.names(),
+                           out_dtypes=[c.dtype for c in p.schema.cols])
     if isinstance(p, DataSource):
         raise AssertionError("DataSource should fuse into a CopTask")
     raise NotImplementedError(type(p).__name__)

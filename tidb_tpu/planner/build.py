@@ -22,10 +22,11 @@ from ..sql import ast as A
 from ..types import dtypes as dt
 from ..types import temporal as tmp
 from ..copr.aggregate import sum_out_dtype
-from .logical import (AggItem, DataSource, LogicalAggregate, LogicalJoin,
-                      LogicalLimit, LogicalPlan, LogicalProjection,
-                      LogicalSelection, LogicalSort, LogicalTopN, Schema,
-                      SchemaCol)
+from .logical import (AggItem, CTEStorage, DataSource, LogicalAggregate,
+                      LogicalCTEScan, LogicalJoin, LogicalLimit, LogicalPlan,
+                      LogicalProjection, LogicalSelection, LogicalSetOp,
+                      LogicalSort, LogicalTopN, LogicalWindow, Schema,
+                      SchemaCol, WindowItem)
 
 K = dt.TypeKind
 
@@ -46,11 +47,14 @@ class PlanError(ValueError):
 
 class ExprBuilder:
     """AST expression -> typed IR over `schema`.  Aggregate calls are
-    rejected unless an agg_resolver intercepts them (select-list path)."""
+    rejected unless an agg_resolver intercepts them (select-list path);
+    window calls likewise require a window_resolver."""
 
-    def __init__(self, schema: Schema, agg_resolver=None):
+    def __init__(self, schema: Schema, agg_resolver=None,
+                 window_resolver=None):
         self.schema = schema
         self.agg_resolver = agg_resolver
+        self.window_resolver = window_resolver
 
     def build(self, n: A.Node) -> Expr:
         m = getattr(self, f"_b_{type(n).__name__.lower()}", None)
@@ -201,6 +205,10 @@ class ExprBuilder:
 
     def _b_funccall(self, n: A.FuncCall) -> Expr:
         name = n.name
+        if n.over is not None:
+            if self.window_resolver is None:
+                raise PlanError(f"window function {name} not allowed here")
+            return self.window_resolver(n)
         if name in AGG_FUNCS:
             if self.agg_resolver is None:
                 raise PlanError(f"aggregate {name} not allowed here")
@@ -291,10 +299,40 @@ class BuiltSelect:
     output_names: list[str]
 
 
-def build_select(sel: A.SelectStmt, catalog, default_db: str) -> BuiltSelect:
+@dataclass
+class CTEEntry:
+    """One WITH-list binding visible while building a query."""
+    name: str
+    columns: list[str]
+    select: A.Node                       # defining AST (non-recursive)
+    def_ctes: dict = None                # CTEs visible at definition site
+    storage: Optional[CTEStorage] = None  # set for recursive CTEs
+    building: bool = False               # inside the recursive part?
+
+
+def build_query(stmt: A.Node, catalog, default_db: str,
+                ctes: Optional[dict] = None) -> BuiltSelect:
+    """Entry: SELECT or set operation, with WITH-list handling
+    (reference: PlanBuilder.buildSelect / buildSetOpr / buildWith)."""
+    ctes = dict(ctes or {})
+    for c in getattr(stmt, "ctes", None) or []:
+        key = c.name.lower()
+        if getattr(stmt, "recursive", False) and _references_cte(c.select, c.name):
+            ctes[key] = _build_recursive_cte(c, catalog, default_db, ctes)
+        else:
+            ctes[key] = CTEEntry(c.name, list(c.columns), c.select,
+                                 def_ctes=dict(ctes))
+    if isinstance(stmt, A.SetOpStmt):
+        return _build_setop(stmt, catalog, default_db, ctes)
+    return build_select(stmt, catalog, default_db, ctes)
+
+
+def build_select(sel: A.SelectStmt, catalog, default_db: str,
+                 ctes: Optional[dict] = None) -> BuiltSelect:
+    ctes = ctes or {}
     if sel.from_ is None:
         return _build_no_table(sel)
-    child = _build_from(sel.from_, catalog, default_db)
+    child = _build_from(sel.from_, catalog, default_db, ctes)
 
     if sel.where is not None:
         cond = ExprBuilder(child.schema).build(sel.where)
@@ -314,8 +352,16 @@ def build_select(sel: A.SelectStmt, catalog, default_db: str) -> BuiltSelect:
             items.append(it)
 
     has_aggs = sel.group_by or _contains_agg(items, sel.having, sel.order_by)
+    has_windows = _contains_window(items)
+    if has_aggs and has_windows:
+        raise PlanError("window functions over GROUP BY not supported yet")
     if has_aggs:
         plan, names = _build_agg_select(sel, items, child)
+    elif has_windows:
+        plan, names, wplan = _build_window_select(sel, items, child)
+        if sel.having is not None:
+            raise PlanError("HAVING without GROUP BY not supported")
+        plan = _attach_order_limit(sel, plan, names, wplan)
     else:
         eb = ExprBuilder(child.schema)
         exprs = [eb.build(it.expr) for it in items]
@@ -372,32 +418,42 @@ def _split_cnf(e: Expr) -> list[Expr]:
     return [e]
 
 
-def _contains_agg(items, having, order_by) -> bool:
-    found = False
-
-    def walk(n):
-        nonlocal found
-        if isinstance(n, A.FuncCall) and n.name in AGG_FUNCS:
-            found = True
-        for v in vars(n).values() if hasattr(n, "__dict__") else []:
+def _walk_ast(n: A.Node, prune=None):
+    """Yield every A.Node reachable from n (depth-first, incl. n itself);
+    `prune(x)` true stops descent below x (x itself is still yielded)."""
+    stack = [n]
+    while stack:
+        x = stack.pop()
+        if not isinstance(x, A.Node):
+            continue
+        yield x
+        if prune is not None and prune(x):
+            continue
+        for v in vars(x).values():
             if isinstance(v, A.Node):
-                walk(v)
+                stack.append(v)
             elif isinstance(v, (list, tuple)):
-                for x in v:
-                    if isinstance(x, A.Node):
-                        walk(x)
-                    elif isinstance(x, tuple):
-                        for y in x:
-                            if isinstance(y, A.Node):
-                                walk(y)
+                for i in v:
+                    if isinstance(i, A.Node):
+                        stack.append(i)
+                    elif isinstance(i, tuple):
+                        stack.extend(y for y in i if isinstance(y, A.Node))
 
-    for it in items:
-        walk(it.expr)
+
+def _is_window_call(x) -> bool:
+    return isinstance(x, A.FuncCall) and x.over is not None
+
+
+def _contains_agg(items, having, order_by) -> bool:
+    roots = [it.expr for it in items]
     if having is not None:
-        walk(having)
-    for e, _ in order_by or []:
-        walk(e)
-    return found
+        roots.append(having)
+    roots.extend(e for e, _ in order_by or [])
+    return any(
+        isinstance(x, A.FuncCall) and x.over is None and x.name in AGG_FUNCS
+        for r in roots
+        # a window call is not an aggregate (SUM(x) OVER ...): skip subtree
+        for x in _walk_ast(r, prune=_is_window_call))
 
 
 def _build_agg_select(sel: A.SelectStmt, items, child) -> tuple[LogicalPlan, list[str]]:
@@ -582,18 +638,311 @@ def _attach_order_limit(sel: A.SelectStmt, plan: LogicalPlan,
 
 
 # --------------------------------------------------------------------- #
+# window functions
+# --------------------------------------------------------------------- #
+
+WINDOW_FUNCS = {"ROW_NUMBER", "RANK", "DENSE_RANK", "NTILE", "LAG", "LEAD",
+                "FIRST_VALUE", "LAST_VALUE", "SUM", "COUNT", "AVG", "MIN",
+                "MAX"}
+
+
+def _contains_window(items) -> bool:
+    return any(_is_window_call(x) for it in items
+               for x in _walk_ast(it.expr))
+
+
+class _WinRef(ColumnRef):
+    """Placeholder for a window output during select-list building."""
+
+    def __init__(self, win_index: int, dtype: dt.DataType):
+        super().__init__(dtype, 200000 + win_index, f"win#{win_index}")
+        object.__setattr__(self, "win_index", win_index)
+
+
+def _build_window_select(sel: A.SelectStmt, items, child):
+    """Window query (no GROUP BY): LogicalWindow over child + projection.
+    Reference: buildWindowFunctions (planner/core/logical_plan_builder.go)."""
+    witems: list[WindowItem] = []
+    wcache: dict = {}
+
+    def resolve_window(fc: A.FuncCall) -> Expr:
+        key = repr(fc)
+        if key in wcache:
+            return wcache[key]
+        item = _build_window_item(fc, child.schema)
+        witems.append(item)
+        ref = _WinRef(len(witems) - 1, item.out_dtype)
+        wcache[key] = ref
+        return ref
+
+    eb = ExprBuilder(child.schema, window_resolver=resolve_window)
+    raw = [eb.build(it.expr) for it in items]
+    names = [_item_name(it) for it in items]
+    n_child = len(child.schema)
+    wschema = Schema(list(child.schema.cols)
+                     + [SchemaCol(f"win#{i}", w.out_dtype)
+                        for i, w in enumerate(witems)])
+    wplan = LogicalWindow(child, witems, wschema)
+
+    def remap(e: Expr) -> Expr:
+        if isinstance(e, _WinRef):
+            return ColumnRef(e.dtype, n_child + e.win_index, e.name)
+        if isinstance(e, Func):
+            return Func(e.dtype, e.op, tuple(remap(a) for a in e.args))
+        return e
+
+    exprs = [remap(e) for e in raw]
+    return _project(wplan, exprs, names), names, wplan
+
+
+def _build_window_item(fc: A.FuncCall, schema: Schema) -> WindowItem:
+    name = fc.name
+    if name not in WINDOW_FUNCS:
+        raise PlanError(f"unsupported window function {name}")
+    if fc.distinct:
+        raise PlanError("DISTINCT in window functions not supported")
+    ceb = ExprBuilder(schema)
+    star = any(isinstance(a, A.Star) for a in fc.args)
+    args = [ceb.build(a) for a in fc.args if not isinstance(a, A.Star)]
+    spec = fc.over
+    partition = [ceb.build(p) for p in spec.partition_by]
+    order = [(ceb.build(e), desc) for e, desc in spec.order_by]
+    frame = spec.frame
+    if frame is not None and frame[0] == "range":
+        for kind, _ in (frame[1], frame[2]):
+            if kind in ("preceding", "following"):
+                raise PlanError("RANGE frames with numeric offsets "
+                                "not supported (use ROWS)")
+    fl = name.lower()
+    if fl in ("row_number", "rank", "dense_rank"):
+        out = dt.bigint(False)
+    elif fl == "ntile":
+        if not (args and isinstance(args[0], Const)):
+            raise PlanError("NTILE needs a constant argument")
+        out = dt.bigint(True)
+    elif fl == "count":
+        out = dt.bigint(False)
+        if star:
+            args = []
+    elif fl == "sum":
+        if not args or not args[0].dtype.is_numeric:
+            raise PlanError("SUM window needs a numeric argument")
+        out = sum_out_dtype(args[0].dtype).with_nullable(True)
+    elif fl == "avg":
+        if not args or not args[0].dtype.is_numeric:
+            raise PlanError("AVG window needs a numeric argument")
+        out = dt.double(True)
+    elif fl in ("min", "max"):
+        if not args:
+            raise PlanError(f"{name} needs an argument")
+        if args[0].dtype.is_string:
+            raise PlanError(f"{name} over strings not supported in windows")
+        out = args[0].dtype.with_nullable(True)
+    else:  # lag/lead/first_value/last_value
+        if not args:
+            raise PlanError(f"{name} needs an argument")
+        if fl in ("lag", "lead"):
+            for extra in args[1:]:
+                if not isinstance(extra, Const):
+                    raise PlanError(f"{name} offset/default must be constant")
+        out = args[0].dtype.with_nullable(True)
+    return WindowItem(fl, args, partition, order, frame, out)
+
+
+# --------------------------------------------------------------------- #
+# set operations
+# --------------------------------------------------------------------- #
+
+def _build_setop(stmt: A.SetOpStmt, catalog, default_db: str,
+                 ctes: dict) -> BuiltSelect:
+    lb = build_query(stmt.left, catalog, default_db, ctes)
+    rb = build_query(stmt.right, catalog, default_db, ctes)
+    if len(lb.output_names) != len(rb.output_names):
+        raise PlanError("set operation operands have different column counts")
+    lplan = _trim_to_outputs(lb)
+    rplan = _trim_to_outputs(rb)
+    names = list(lb.output_names)
+    out_cols = []
+    for i, nm in enumerate(names):
+        t = _unify_dtype(lplan.schema.cols[i].dtype, rplan.schema.cols[i].dtype)
+        out_cols.append(SchemaCol(nm, t))
+    schema = Schema(out_cols)
+    plan: LogicalPlan = LogicalSetOp(stmt.kind, stmt.all, lplan, rplan, schema)
+
+    if stmt.order_by:
+        keys = []
+        for e_ast, desc in stmt.order_by:
+            idx = None
+            if isinstance(e_ast, A.Lit) and e_ast.kind == "int":
+                idx = int(e_ast.value) - 1
+                if not (0 <= idx < len(names)):
+                    raise PlanError(f"ORDER BY position {idx+1} out of range")
+            elif isinstance(e_ast, A.Ident) and len(e_ast.parts) == 1:
+                m = [i for i, n in enumerate(names)
+                     if n.lower() == e_ast.parts[0].lower()]
+                if m:
+                    idx = m[0]
+            if idx is None:
+                raise PlanError("set-operation ORDER BY must reference an "
+                                "output column name or position")
+            keys.append((schema.ref(idx), desc))
+        if stmt.limit is not None:
+            plan = LogicalTopN(plan, keys, stmt.limit, stmt.offset or 0)
+        else:
+            plan = LogicalSort(plan, keys)
+    elif stmt.limit is not None:
+        plan = LogicalLimit(plan, stmt.limit, stmt.offset or 0)
+    return BuiltSelect(plan, names)
+
+
+def _trim_to_outputs(built: BuiltSelect) -> LogicalPlan:
+    """Drop hidden ORDER BY columns so the plan's schema == output names."""
+    p = built.plan
+    n = len(built.output_names)
+    if len(p.schema) == n:
+        return p
+    exprs = [p.schema.ref(i) for i in range(n)]
+    return _project(p, exprs, list(built.output_names))
+
+
+_NUMERIC_KINDS = {K.INT64, K.UINT64, K.FLOAT64, K.FLOAT32, K.DECIMAL}
+
+
+def _unify_dtype(a: dt.DataType, b: dt.DataType) -> dt.DataType:
+    """Result type of a set-operation column (MySQL aggregate_2Fields
+    analog, simplified)."""
+    nullable = a.nullable or b.nullable
+    if a.kind == b.kind:
+        if a.kind == K.DECIMAL:
+            scale = max(a.scale, b.scale)
+            ip = max(a.precision - a.scale, b.precision - b.scale)
+            return dt.decimal(min(ip + scale, 65), scale, nullable)
+        return a.with_nullable(nullable)
+    if a.kind in _NUMERIC_KINDS and b.kind in _NUMERIC_KINDS:
+        ks = {a.kind, b.kind}
+        if ks & {K.FLOAT64, K.FLOAT32}:
+            return dt.double(nullable)
+        if K.DECIMAL in ks:
+            d = a if a.kind == K.DECIMAL else b
+            return dt.decimal(max(d.precision, 20 + d.scale), d.scale, nullable)
+        return dt.bigint(nullable)     # int64 + uint64
+    if {a.kind, b.kind} == {K.DATE, K.DATETIME}:
+        return dt.datetime(nullable)
+    raise PlanError(f"cannot unify set-operation column types {a} and {b}")
+
+
+# --------------------------------------------------------------------- #
+# recursive CTEs
+# --------------------------------------------------------------------- #
+
+def _references_cte(n: A.Node, name: str) -> bool:
+    name = name.lower()
+    return any(isinstance(x, A.TableName) and x.db is None
+               and x.name.lower() == name for x in _walk_ast(n))
+
+
+def _flatten_union(n: A.Node) -> list[tuple[A.Node, bool]]:
+    """Left-deep UNION chain -> [(operand, all_flag_joining_previous)];
+    the first operand's flag is unused."""
+    if isinstance(n, A.SetOpStmt):
+        if n.kind != "union":
+            raise PlanError("recursive CTE must combine parts with UNION")
+        if n.order_by or n.limit is not None:
+            raise PlanError("ORDER BY/LIMIT not allowed in a recursive CTE body")
+        return _flatten_union(n.left) + [(n.right, n.all)]
+    return [(n, True)]
+
+
+def _build_recursive_cte(c: A.CTE, catalog, default_db: str,
+                         ctes: dict) -> CTEEntry:
+    ops = _flatten_union(c.select)
+    is_rec = [_references_cte(ast, c.name) for ast, _ in ops]
+    if not any(is_rec):
+        return CTEEntry(c.name, list(c.columns), c.select, def_ctes=dict(ctes))
+    first_rec = is_rec.index(True)
+    if first_rec == 0:
+        raise PlanError(f"recursive CTE {c.name!r} needs a non-recursive "
+                        "seed SELECT first")
+    if not all(is_rec[first_rec:]):
+        raise PlanError(f"recursive CTE {c.name!r}: seed parts must precede "
+                        "recursive parts")
+    # UNION DISTINCT anywhere in the chain => dedup semantics
+    distinct = any(not flag for _, flag in ops[1:])
+    storage = CTEStorage(c.name, distinct)
+
+    seed_ops = ops[:first_rec]
+    seed_ast = seed_ops[0][0]
+    for ast, flag in seed_ops[1:]:
+        seed_ast = A.SetOpStmt("union", flag, seed_ast, ast)
+    sb = build_query(seed_ast, catalog, default_db, ctes)
+    names = list(c.columns) if c.columns else list(sb.output_names)
+    if len(names) != len(sb.output_names):
+        raise PlanError(f"CTE {c.name!r} column list count mismatch")
+    seed_plan = _trim_to_outputs(sb)
+    storage.schema = Schema([
+        SchemaCol(nm, col.dtype.with_nullable(True))
+        for nm, col in zip(names, seed_plan.schema.cols)])
+    storage.seed_logical = seed_plan
+
+    entry = CTEEntry(c.name, names, c.select, def_ctes=dict(ctes),
+                     storage=storage, building=True)
+    rec_ctes = dict(ctes)
+    rec_ctes[c.name.lower()] = entry
+    for ast, _ in ops[first_rec:]:
+        rb = build_query(ast, catalog, default_db, rec_ctes)
+        if len(rb.output_names) != len(names):
+            raise PlanError(f"recursive part of CTE {c.name!r} has wrong "
+                            "column count")
+        rplan = _trim_to_outputs(rb)
+        for sc, rc in zip(storage.schema.cols, rplan.schema.cols):
+            try:
+                _unify_dtype(sc.dtype, rc.dtype)
+            except PlanError:
+                raise PlanError(
+                    f"recursive part of CTE {c.name!r} column {sc.name!r}: "
+                    f"type {rc.dtype} incompatible with seed type {sc.dtype}")
+        storage.rec_logicals.append(rplan)
+    entry.building = False
+    return entry
+
+
+def _build_cte_ref(entry: CTEEntry, alias: str, catalog,
+                   default_db: str) -> LogicalPlan:
+    if entry.storage is not None:
+        st = entry.storage
+        role = "working" if entry.building else "result"
+        sch = Schema([SchemaCol(col.name, col.dtype, alias)
+                      for col in st.schema.cols])
+        return LogicalCTEScan(st, role, sch)
+    built = build_query(entry.select, catalog, default_db,
+                        entry.def_ctes or {})
+    names = entry.columns or built.output_names
+    if len(names) != len(built.output_names):
+        raise PlanError(f"CTE {entry.name!r} column list count mismatch")
+    sub = _trim_to_outputs(built)
+    sub.schema = Schema([SchemaCol(nm, col.dtype, alias)
+                         for nm, col in zip(names, sub.schema.cols)])
+    return sub
+
+
+# --------------------------------------------------------------------- #
 # FROM clause
 # --------------------------------------------------------------------- #
 
-def _build_from(node: A.Node, catalog, default_db: str) -> LogicalPlan:
+def _build_from(node: A.Node, catalog, default_db: str,
+                ctes: Optional[dict] = None) -> LogicalPlan:
+    ctes = ctes or {}
     if isinstance(node, A.TableName):
-        tbl = catalog.get_table(node.db or default_db, node.name)
         alias = node.alias or node.name
+        if node.db is None and node.name.lower() in ctes:
+            return _build_cte_ref(ctes[node.name.lower()], alias, catalog,
+                                  default_db)
+        tbl = catalog.get_table(node.db or default_db, node.name)
         sch = Schema([SchemaCol(n, t, alias)
                       for n, t in zip(tbl.col_names, tbl.col_types)])
         return DataSource(tbl, alias, sch, list(range(len(tbl.col_names))))
     if isinstance(node, A.SubqueryRef):
-        built = build_select(node.select, catalog, default_db)
+        built = build_query(node.select, catalog, default_db, ctes)
         sub = built.plan
         sch = Schema([SchemaCol(n, c.dtype, node.alias)
                       for n, c in zip(built.output_names,
@@ -601,8 +950,8 @@ def _build_from(node: A.Node, catalog, default_db: str) -> LogicalPlan:
         sub.schema = sch
         return sub
     if isinstance(node, A.Join):
-        left = _build_from(node.left, catalog, default_db)
-        right = _build_from(node.right, catalog, default_db)
+        left = _build_from(node.left, catalog, default_db, ctes)
+        right = _build_from(node.right, catalog, default_db, ctes)
         sch = Schema(list(left.schema.cols) + list(right.schema.cols))
         join = LogicalJoin(node.kind, left, right, [], [], sch)
         conds: list[Expr] = []
@@ -626,4 +975,4 @@ def _build_from(node: A.Node, catalog, default_db: str) -> LogicalPlan:
 
 
 __all__ = ["ExprBuilder", "PlanError", "BuiltSelect", "build_select",
-           "DualSource"]
+           "build_query", "DualSource", "CTEEntry"]

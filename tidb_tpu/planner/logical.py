@@ -154,6 +154,79 @@ class LogicalTopN(LogicalPlan):
         self.children = [self.child]
 
 
+@dataclass
+class LogicalSetOp(LogicalPlan):
+    """UNION / EXCEPT / INTERSECT (reference: LogicalUnionAll + the set-op
+    rewrites in logical_plan_builder.go buildSetOpr)."""
+    kind: str                      # 'union' | 'except' | 'intersect'
+    all: bool = False
+    left: LogicalPlan = None
+    right: LogicalPlan = None
+    schema: Schema = None          # unified output (left names, joined types)
+
+    def __post_init__(self):
+        self.children = [self.left, self.right]
+
+
+@dataclass
+class WindowItem:
+    """One window function call bound to its OVER spec (reference:
+    planner/core WindowFuncDesc + WindowFrame)."""
+    func: str                      # row_number|rank|dense_rank|ntile|lag|...
+    args: list                     # [Expr] over the window child's schema
+    partition: list = field(default_factory=list)    # [Expr]
+    order: list = field(default_factory=list)        # [(Expr, desc)]
+    frame: Optional[tuple] = None  # parsed frame or None (default frame)
+    out_dtype: dt.DataType = None
+
+
+@dataclass
+class LogicalWindow(LogicalPlan):
+    """Window functions over child rows; output schema = child columns then
+    one column per item, in the child's row order (reference:
+    LogicalWindow, executor/window.go)."""
+    child: LogicalPlan
+    items: list[WindowItem] = field(default_factory=list)
+    schema: Schema = None
+
+    def __post_init__(self):
+        self.children = [self.child]
+
+
+class CTEStorage:
+    """Shared state of one CTE (reference: util/cteutil.Storage).
+
+    Non-recursive CTEs are inlined at build time and never use this.  A
+    recursive CTE materializes here: `seed_logical` + `rec_logicals` are
+    lowered lazily by the physical planner; the executor iterates
+    seed -> recursive parts (which read `working`) until fixpoint, capping
+    at `max_depth` (cte_max_recursion_depth analog, executor/cte.go)."""
+
+    def __init__(self, name: str, distinct: bool, max_depth: int = 1000):
+        self.name = name
+        self.distinct = distinct
+        self.max_depth = max_depth
+        self.schema: Schema = None
+        self.seed_logical: LogicalPlan = None
+        self.rec_logicals: list[LogicalPlan] = []
+        self.seed_phys = None
+        self.rec_phys: list = []
+        self.working = None        # ResultChunk: rows of the last iteration
+        self.result = None         # ResultChunk: full materialized result
+
+
+@dataclass
+class LogicalCTEScan(LogicalPlan):
+    """Scan of a recursive CTE: the working table inside the recursive
+    part, or the materialized result outside it."""
+    storage: CTEStorage
+    role: str                      # 'working' | 'result'
+    schema: Schema = None
+
+    def __post_init__(self):
+        self.children = []
+
+
 def explain_logical(p: LogicalPlan, indent: int = 0) -> str:
     pad = "  " * indent
     name = type(p).__name__
@@ -178,5 +251,7 @@ def explain_logical(p: LogicalPlan, indent: int = 0) -> str:
 __all__ = [
     "SchemaCol", "Schema", "LogicalPlan", "DataSource", "LogicalSelection",
     "LogicalProjection", "AggItem", "LogicalAggregate", "LogicalJoin",
-    "LogicalSort", "LogicalLimit", "LogicalTopN", "explain_logical",
+    "LogicalSort", "LogicalLimit", "LogicalTopN", "LogicalSetOp",
+    "WindowItem", "LogicalWindow", "CTEStorage", "LogicalCTEScan",
+    "explain_logical",
 ]

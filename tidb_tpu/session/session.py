@@ -16,7 +16,7 @@ import numpy as np
 from ..executor.physical import ExecContext, ResultChunk
 from ..executor.plan import to_physical
 from ..parallel.mesh import get_mesh
-from ..planner.build import PlanError, build_select
+from ..planner.build import PlanError, build_query
 from ..planner.logical import explain_logical
 from ..planner.optimize import optimize_plan
 from ..sql import ast as A
@@ -83,7 +83,7 @@ class Session:
     # ------------------------------------------------------------- #
 
     def _exec_stmt(self, stmt: A.Node) -> ResultSet:
-        if isinstance(stmt, A.SelectStmt):
+        if isinstance(stmt, (A.SelectStmt, A.SetOpStmt)):
             return self._exec_select(stmt)
         if isinstance(stmt, A.Explain):
             return self._exec_explain(stmt)
@@ -130,13 +130,13 @@ class Session:
 
     # ------------------------------------------------------------- #
 
-    def _plan_select(self, stmt: A.SelectStmt):
-        built = build_select(stmt, self.domain.catalog, self.db)
+    def _plan_select(self, stmt):
+        built = build_query(stmt, self.domain.catalog, self.db)
         plan = optimize_plan(built.plan)
         phys = to_physical(plan)
         return built, phys
 
-    def _exec_select(self, stmt: A.SelectStmt) -> ResultSet:
+    def _exec_select(self, stmt) -> ResultSet:
         built, phys = self._plan_select(stmt)
         ctx = ExecContext(self.domain.client, self.domain.sysvars)
         chunk = phys.execute(ctx)
@@ -146,7 +146,7 @@ class Session:
         return ResultSet(built.output_names, rows)
 
     def _exec_explain(self, stmt: A.Explain) -> ResultSet:
-        if not isinstance(stmt.stmt, A.SelectStmt):
+        if not isinstance(stmt.stmt, (A.SelectStmt, A.SetOpStmt)):
             raise PlanError("EXPLAIN supports SELECT only")
         built, phys = self._plan_select(stmt.stmt)
         text = phys.explain()

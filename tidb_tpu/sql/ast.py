@@ -49,10 +49,22 @@ class Binary(Node):
 
 
 @dataclass
+class WindowSpec(Node):
+    """OVER (...) clause (reference: ast.WindowSpec, pkg/parser)."""
+    partition_by: list[Node] = field(default_factory=list)
+    order_by: list[tuple[Node, bool]] = field(default_factory=list)
+    # frame: None | ('rows', (lo_kind, lo_n), (hi_kind, hi_n)) with kinds
+    # 'unbounded_preceding' | 'preceding' | 'current' | 'following' |
+    # 'unbounded_following'
+    frame: Optional[tuple] = None
+
+
+@dataclass
 class FuncCall(Node):
     name: str                       # uppercased
     args: list[Node] = field(default_factory=list)
     distinct: bool = False          # COUNT(DISTINCT x)
+    over: Optional[WindowSpec] = None  # window function call
 
 
 @dataclass
@@ -153,6 +165,31 @@ class SelectStmt(Node):
     limit: Optional[int] = None
     offset: Optional[int] = None
     distinct: bool = False
+    ctes: list["CTE"] = field(default_factory=list)
+    recursive: bool = False         # WITH RECURSIVE
+
+
+@dataclass
+class SetOpStmt(Node):
+    """UNION / EXCEPT / INTERSECT of two queries (reference:
+    ast.SetOprStmt).  Chains are left-deep trees of SetOpStmt."""
+    kind: str                       # 'union' | 'except' | 'intersect'
+    all: bool = False               # UNION ALL vs DISTINCT
+    left: Node = None               # SelectStmt | SetOpStmt
+    right: Node = None
+    order_by: list[tuple[Node, bool]] = field(default_factory=list)
+    limit: Optional[int] = None
+    offset: Optional[int] = None
+    ctes: list["CTE"] = field(default_factory=list)
+    recursive: bool = False
+
+
+@dataclass
+class CTE(Node):
+    """One WITH-list element (reference: ast.CommonTableExpression)."""
+    name: str
+    columns: list[str] = field(default_factory=list)
+    select: Node = None             # SelectStmt | SetOpStmt
 
 
 @dataclass

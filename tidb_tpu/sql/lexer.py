@@ -26,7 +26,9 @@ KEYWORDS = {
     "DESCRIBE", "DESC", "BEGIN", "COMMIT", "ROLLBACK", "START",
     "TRANSACTION", "DEFAULT", "AUTO_INCREMENT", "COMMENT", "ENGINE",
     "CHARSET", "COLLATE", "CHARACTER", "SUBSTRING", "TRUNCATE", "GLOBAL",
-    "SESSION", "VARIABLES", "COLUMNS", "ADMIN", "CHECK",
+    "SESSION", "VARIABLES", "COLUMNS", "ADMIN", "CHECK", "WITH",
+    "RECURSIVE", "OVER", "PARTITION", "ROWS", "RANGE", "UNBOUNDED",
+    "PRECEDING", "FOLLOWING", "CURRENT", "ROW", "WINDOW",
 }
 
 # multi-char operators first (maximal munch)

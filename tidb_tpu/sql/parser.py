@@ -90,8 +90,8 @@ class Parser:
         return stmts
 
     def statement(self) -> A.Node:
-        if self.at_kw("SELECT"):
-            return self.select_stmt()
+        if self.at_kw("SELECT", "WITH") or self.at_op("("):
+            return self.select_query()
         if self.at_kw("EXPLAIN", "DESCRIBE"):
             self.advance()
             analyze = self.accept_kw("ANALYZE")
@@ -136,7 +136,126 @@ class Parser:
             return A.AnalyzeTable(self.ident())
         raise ParseError("unsupported statement", self.cur)
 
-    # ---------------- SELECT ---------------- #
+    # ---------------- SELECT / set operations / WITH ---------------- #
+
+    def select_query(self) -> A.Node:
+        """Full query: [WITH [RECURSIVE] ...] select-expr with UNION/
+        EXCEPT/INTERSECT chains (INTERSECT binds tighter, like MySQL 8)."""
+        ctes: list[A.CTE] = []
+        recursive = False
+        if self.at_kw("WITH"):
+            ctes, recursive = self.with_clause()
+        node = self._set_op_expr()
+        if ctes:  # don't clobber a parenthesized inner query's own WITH list
+            node.ctes = ctes + node.ctes
+            node.recursive = recursive or node.recursive
+        return node
+
+    def with_clause(self) -> tuple[list[A.CTE], bool]:
+        self.expect_kw("WITH")
+        recursive = self.accept_kw("RECURSIVE")
+        ctes = []
+        while True:
+            name = self.ident()
+            cols: list[str] = []
+            if self.accept_op("("):
+                cols.append(self.ident())
+                while self.accept_op(","):
+                    cols.append(self.ident())
+                self.expect_op(")")
+            self.expect_kw("AS")
+            self.expect_op("(")
+            sel = self.select_query()
+            self.expect_op(")")
+            ctes.append(A.CTE(name, cols, sel))
+            if not self.accept_op(","):
+                break
+        return ctes, recursive
+
+    def _set_op_expr(self) -> A.Node:
+        """UNION/EXCEPT level (lowest precedence).  A trailing ORDER BY /
+        LIMIT consumed by the last non-parenthesized operand is hoisted to
+        the whole set operation (MySQL semantics); an intermediate operand
+        carrying one is an error ("incorrect usage of UNION and ORDER BY")."""
+        left, leaf = self._intersect_chain()
+        while self.at_kw("UNION", "EXCEPT"):
+            kind = self.advance().text.lower()
+            all_ = self.accept_kw("ALL")
+            if not all_:
+                self.accept_kw("DISTINCT")
+            self._no_trailing(leaf)
+            right, leaf = self._intersect_chain()
+            left = A.SetOpStmt(kind, all_, left, right)
+        if isinstance(left, A.SetOpStmt):
+            if leaf is not None and (leaf.order_by or leaf.limit is not None):
+                left.order_by, leaf.order_by = leaf.order_by, []
+                left.limit, left.offset = leaf.limit, leaf.offset
+                leaf.limit = leaf.offset = None
+            self._trailing_order_limit(left)
+        elif leaf is None:
+            # single parenthesized select: (SELECT ...) ORDER BY ... LIMIT n
+            self._trailing_order_limit(left)
+        return left
+
+    def _intersect_chain(self):
+        left, leaf = self._set_operand()
+        while self.at_kw("INTERSECT"):
+            self.advance()
+            all_ = self.accept_kw("ALL")
+            if not all_:
+                self.accept_kw("DISTINCT")
+            self._no_trailing(leaf)
+            right, leaf = self._set_operand()
+            left = A.SetOpStmt("intersect", all_, left, right)
+        return left, leaf
+
+    def _set_operand(self):
+        """One operand: a SELECT, or a parenthesized query (whose ORDER BY/
+        LIMIT stay local).  Returns (node, hoistable_leaf_or_None)."""
+        if self.accept_op("("):
+            inner = self.select_query()
+            self.expect_op(")")
+            return inner, None
+        sel = self.select_stmt()
+        return sel, sel
+
+    def _no_trailing(self, leaf):
+        if leaf is not None and (leaf.order_by or leaf.limit is not None):
+            raise ParseError("incorrect usage of UNION and ORDER BY/LIMIT "
+                             "(parenthesize the operand)", self.cur)
+
+    def _order_by_list(self) -> list[tuple[A.Node, bool]]:
+        """expr [ASC|DESC] {, ...} — caller consumed ORDER BY."""
+        out = []
+        while True:
+            e = self.expr()
+            desc = False
+            if self.accept_kw("DESC"):
+                desc = True
+            else:
+                self.accept_kw("ASC")
+            out.append((e, desc))
+            if not self.accept_op(","):
+                break
+        return out
+
+    def _limit_clause(self) -> tuple[int, Optional[int]]:
+        """n | off, n | n OFFSET off — caller consumed LIMIT."""
+        a = self._int_lit()
+        if self.accept_op(","):
+            return self._int_lit(), a
+        if self.accept_kw("OFFSET"):
+            return a, self._int_lit()
+        return a, None
+
+    def _trailing_order_limit(self, node: A.Node):
+        """ORDER BY / LIMIT after a parenthesized final operand."""
+        if self.at_kw("ORDER") and not node.order_by:
+            self.advance()
+            self.expect_kw("BY")
+            node.order_by = self._order_by_list()
+        if node.limit is None and self.accept_kw("LIMIT"):
+            node.limit, node.offset = self._limit_clause()
 
     def select_stmt(self) -> A.SelectStmt:
         self.expect_kw("SELECT")
@@ -165,24 +284,9 @@ class Parser:
         if self.at_kw("ORDER"):
             self.advance()
             self.expect_kw("BY")
-            while True:
-                e = self.expr()
-                desc = False
-                if self.accept_kw("DESC"):
-                    desc = True
-                else:
-                    self.accept_kw("ASC")
-                s.order_by.append((e, desc))
-                if not self.accept_op(","):
-                    break
+            s.order_by = self._order_by_list()
         if self.accept_kw("LIMIT"):
-            a = self._int_lit()
-            if self.accept_op(","):
-                s.offset, s.limit = a, self._int_lit()
-            else:
-                s.limit = a
-                if self.accept_kw("OFFSET"):
-                    s.offset = self._int_lit()
+            s.limit, s.offset = self._limit_clause()
         return s
 
     def _int_lit(self) -> int:
@@ -261,8 +365,8 @@ class Parser:
 
     def table_ref(self) -> A.Node:
         if self.accept_op("("):
-            if self.at_kw("SELECT"):
-                sub = self.select_stmt()
+            if self.at_kw("SELECT", "WITH"):
+                sub = self.select_query()
                 self.expect_op(")")
                 self.accept_kw("AS")
                 return A.SubqueryRef(sub, self.ident())
@@ -421,8 +525,8 @@ class Parser:
             while self.accept_op(","):
                 ins.columns.append(self.ident())
             self.expect_op(")")
-        if self.at_kw("SELECT"):
-            ins.select = self.select_stmt()
+        if self.at_kw("SELECT", "WITH"):
+            ins.select = self.select_query()
             return ins
         self.expect_kw("VALUES")
         while True:
@@ -543,8 +647,8 @@ class Parser:
                 negated = True
             if self.accept_kw("IN"):
                 self.expect_op("(")
-                if self.at_kw("SELECT"):
-                    sub = self.select_stmt()
+                if self.at_kw("SELECT", "WITH"):
+                    sub = self.select_query()
                     self.expect_op(")")
                     left = A.InExpr(left, [A.SubqueryExpr(sub)], negated)
                 else:
@@ -674,12 +778,12 @@ class Parser:
             return self.cast_expr()
         if self.accept_kw("EXISTS"):
             self.expect_op("(")
-            sub = self.select_stmt()
+            sub = self.select_query()
             self.expect_op(")")
             return A.ExistsExpr(sub)
         if self.accept_op("("):
-            if self.at_kw("SELECT"):
-                sub = self.select_stmt()
+            if self.at_kw("SELECT", "WITH"):
+                sub = self.select_query()
                 self.expect_op(")")
                 return A.SubqueryExpr(sub)
             e = self.expr()
@@ -731,15 +835,60 @@ class Parser:
             self.advance()
             self.expect_op(")")
             fc.args = [A.Star()]
-            return fc
-        if self.accept_kw("DISTINCT"):
-            fc.distinct = True
-        if not self.at_op(")"):
-            fc.args.append(self.expr())
-            while self.accept_op(","):
+        else:
+            if self.accept_kw("DISTINCT"):
+                fc.distinct = True
+            if not self.at_op(")"):
                 fc.args.append(self.expr())
-        self.expect_op(")")
+                while self.accept_op(","):
+                    fc.args.append(self.expr())
+            self.expect_op(")")
+        if self.accept_kw("OVER"):
+            fc.over = self.window_spec()
         return fc
+
+    def window_spec(self) -> A.WindowSpec:
+        self.expect_op("(")
+        ws = A.WindowSpec()
+        if self.accept_kw("PARTITION"):
+            self.expect_kw("BY")
+            ws.partition_by.append(self.expr())
+            while self.accept_op(","):
+                ws.partition_by.append(self.expr())
+        if self.at_kw("ORDER"):
+            self.advance()
+            self.expect_kw("BY")
+            ws.order_by = self._order_by_list()
+        if self.at_kw("ROWS", "RANGE"):
+            unit = self.advance().text.lower()
+            ws.frame = (unit,) + self._frame_bounds()
+        self.expect_op(")")
+        return ws
+
+    def _frame_bounds(self) -> tuple:
+        if self.accept_kw("BETWEEN"):
+            lo = self._frame_bound()
+            self.expect_kw("AND")
+            hi = self._frame_bound()
+        else:
+            lo = self._frame_bound()
+            hi = ("current", 0)
+        return lo, hi
+
+    def _frame_bound(self) -> tuple[str, int]:
+        if self.accept_kw("UNBOUNDED"):
+            if self.accept_kw("PRECEDING"):
+                return ("unbounded_preceding", 0)
+            self.expect_kw("FOLLOWING")
+            return ("unbounded_following", 0)
+        if self.accept_kw("CURRENT"):
+            self.expect_kw("ROW")
+            return ("current", 0)
+        n = self._int_lit()
+        if self.accept_kw("PRECEDING"):
+            return ("preceding", n)
+        self.expect_kw("FOLLOWING")
+        return ("following", n)
 
 
 # keywords that can also start function calls (YEAR(x), DATE(x), IF(...))
@@ -751,7 +900,9 @@ _NONRESERVED = {"YEAR", "MONTH", "DAY", "HOUR", "MINUTE", "SECOND", "DATE",
                 "TIME", "TIMESTAMP", "COMMENT", "ENGINE", "CHARSET",
                 "DATABASES", "TABLES", "VARIABLES", "COLUMNS", "GLOBAL",
                 "SESSION", "KEY", "DEFAULT", "ADMIN", "CHECK", "BEGIN",
-                "TRANSACTION", "TRUNCATE"}
+                "TRANSACTION", "TRUNCATE", "ROW", "ROWS", "RANGE", "OVER",
+                "PARTITION", "CURRENT", "WINDOW", "RECURSIVE", "PRECEDING",
+                "FOLLOWING", "UNBOUNDED"}
 
 
 def parse_sql(sql: str) -> list[A.Node]:
