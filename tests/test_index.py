@@ -1,0 +1,238 @@
+"""Secondary indexes: codec round-trips, PointGet/IndexLookUp planning,
+uniqueness enforcement, maintenance across DML.
+
+Reference analogs: pkg/tablecodec + util/codec (memcomparable keys),
+executor/point_get.go, executor/distsql.go IndexLookUpExecutor,
+util/ranger (predicate -> range extraction).
+"""
+
+import pytest
+
+from tidb_tpu.session import Domain, Session
+from tidb_tpu.session.catalog import DuplicateKeyError
+from tidb_tpu.store import codec as C
+from tidb_tpu.types import dtypes as dt
+
+
+# ---------------- codec ---------------- #
+
+def test_bytes_key_order_preserving():
+    vals = ["", "a", "ab", "abcdefgh", "abcdefghi", "abd", "b", "ba"]
+    encs = [C.encode_bytes_key(v.encode()) for v in vals]
+    assert encs == sorted(encs)
+    assert sorted(vals) == vals  # sanity
+
+
+def test_float_key_order():
+    vals = [-1e9, -2.5, -0.0, 0.0, 1e-9, 2.5, 1e9]
+    encs = [C.encode_float_key(v) for v in vals]
+    assert encs == sorted(encs)
+
+
+def test_int_key_order():
+    vals = [-(1 << 62), -5, 0, 5, 1 << 62]
+    encs = [C.encode_int_key(v) for v in vals]
+    assert encs == sorted(encs)
+
+
+def test_index_entry_roundtrip():
+    t = dt.bigint()
+    k, v = C.encode_index_entry(5, 1, [42], [t], 99, unique=True)
+    assert C.decode_index_handle(k, v) == 99
+    k2, v2 = C.encode_index_entry(5, 1, [42], [t], 99, unique=False)
+    assert v2 == b"" and C.decode_index_handle(k2, v2) == 99
+
+
+# ---------------- e2e ---------------- #
+
+@pytest.fixture()
+def s():
+    sess = Session(Domain())
+    sess.execute("""create table users (
+        id bigint primary key, email varchar(64), region varchar(16),
+        age bigint, key idx_region_age (region, age),
+        unique key uk_email (email))""")
+    sess.execute("""insert into users values
+        (1,'a@x.com','us',30), (2,'b@x.com','us',40),
+        (3,'c@x.com','eu',25), (4,'d@x.com','eu',35),
+        (5,'e@x.com','ap',50)""")
+    return sess
+
+
+def test_point_get_by_pk(s):
+    rows = s.must_query("select email from users where id = 3")
+    assert rows == [("c@x.com",)]
+    plan = "\n".join(r[0] for r in
+                     s.must_query("explain select email from users where id = 3"))
+    assert "PointGet" in plan
+
+
+def test_point_get_by_unique(s):
+    rows = s.must_query("select id from users where email = 'd@x.com'")
+    assert rows == [(4,)]
+    plan = "\n".join(r[0] for r in s.must_query(
+        "explain select id from users where email = 'd@x.com'"))
+    assert "PointGet" in plan
+
+
+def test_point_get_miss(s):
+    assert s.must_query("select id from users where id = 99") == []
+
+
+def test_index_lookup_eq_prefix(s):
+    rows = s.must_query(
+        "select id, age from users where region = 'us' order by id")
+    assert rows == [(1, 30), (2, 40)]
+    plan = "\n".join(r[0] for r in s.must_query(
+        "explain select id from users where region = 'us'"))
+    assert "IndexLookUp" in plan
+
+
+def test_index_lookup_eq_plus_range(s):
+    rows = s.must_query(
+        "select id from users where region = 'eu' and age > 30")
+    assert rows == [(4,)]
+    rows = s.must_query(
+        "select id from users where region = 'eu' and age <= 25")
+    assert rows == [(3,)]
+
+
+def test_index_residual_conditions(s):
+    rows = s.must_query(
+        "select id from users where region = 'us' and email = 'b@x.com'")
+    assert rows == [(2,)]
+
+
+def test_no_index_falls_back_to_scan(s):
+    # age alone isn't a usable prefix of (region, age)
+    rows = s.must_query("select id from users where age > 35 order by id")
+    assert rows == [(2,), (5,)]
+    plan = "\n".join(r[0] for r in s.must_query(
+        "explain select id from users where age > 35"))
+    assert "IndexLookUp" not in plan and "PointGet" not in plan
+
+
+def test_unique_violation_insert(s):
+    with pytest.raises(DuplicateKeyError):
+        s.execute("insert into users values (9,'a@x.com','us',1)")
+    # txn rolled back: row 9 absent
+    assert s.must_query("select id from users where id = 9") == []
+
+
+def test_pk_violation(s):
+    with pytest.raises(DuplicateKeyError):
+        s.execute("insert into users values (1,'z@x.com','us',1)")
+
+
+def test_index_maintained_on_delete(s):
+    s.execute("delete from users where id = 2")
+    assert s.must_query("select id from users where region = 'us'") == [(1,)]
+    # unique slot freed
+    s.execute("insert into users values (6,'b@x.com','us',41)")
+    assert s.must_query("select id from users where email = 'b@x.com'") == [(6,)]
+
+
+def test_index_maintained_on_update(s):
+    s.execute("update users set region = 'eu' where id = 1")
+    assert s.must_query("select id from users where region = 'us'") == [(2,)]
+    got = s.must_query("select id from users where region = 'eu' order by id")
+    assert got == [(1,), (3,), (4,)]
+
+
+def test_create_index_backfill_and_drop(s):
+    s.execute("create index idx_age on users (age)")
+    rows = s.must_query("select id from users where age = 50")
+    assert rows == [(5,)]
+    plan = "\n".join(r[0] for r in s.must_query(
+        "explain select id from users where age = 50"))
+    assert "idx_age" in plan
+    s.execute("drop index idx_age on users")
+    plan = "\n".join(r[0] for r in s.must_query(
+        "explain select id from users where age = 50"))
+    assert "idx_age" not in plan
+
+
+def test_create_unique_index_dup_fails(s):
+    with pytest.raises(DuplicateKeyError):
+        s.execute("create unique index uk_region on users (region)")
+    assert s.domain.catalog.get_table("test", "users") \
+        .index_by_name("uk_region") is None
+
+
+def test_alter_table_add_drop_index(s):
+    s.execute("alter table users add index idx_a (age)")
+    assert "idx_a" in [r[1] for r in s.must_query("show index from users")]
+    s.execute("alter table users drop index idx_a")
+    assert "idx_a" not in [r[1] for r in s.must_query("show index from users")]
+
+
+def test_alter_table_add_drop_column(s):
+    s.execute("alter table users add column score bigint default 7")
+    rows = s.must_query("select score from users where id = 1")
+    assert rows == [(7,)]
+    s.execute("alter table users drop column score")
+    with pytest.raises(Exception):
+        s.must_query("select score from users where id = 1")
+
+
+def test_unique_allows_multiple_nulls(s):
+    s.execute("create table n1 (a bigint, b varchar(8), unique key uk (b))")
+    s.execute("insert into n1 values (1, null), (2, null), (3, 'x')")
+    assert s.must_query("select count(*) from n1") == [(3,)]
+    with pytest.raises(DuplicateKeyError):
+        s.execute("insert into n1 values (4, 'x')")
+
+
+def test_decimal_index_int_literal(s):
+    # integer literal against a DECIMAL index column must rescale
+    s.execute("create table pd (d decimal(10,2), v bigint, key kd (d))")
+    s.execute("insert into pd values ('2.00', 1), ('0.02', 2)")
+    assert s.must_query("select v from pd where d = 2") == [(1,)]
+
+
+def test_float_index_decimal_literal(s):
+    s.execute("create table pf (x double, v bigint, key kx (x))")
+    s.execute("insert into pf values (1.1, 2), (2.5, 3)")
+    assert s.must_query("select v from pf where x = 1.1") == [(2,)]
+
+
+def test_int_index_decimal_literal(s):
+    # 1.50 can never equal an integer: index path must not mis-match
+    assert s.must_query("select email from users where id = 1.50") == []
+    assert s.must_query("select email from users where id = 3.0") == [("c@x.com",)]
+
+
+def test_alter_add_column_failure_leaves_table_intact(s):
+    s.execute("create table ac (a bigint)")
+    s.execute("insert into ac values (1)")
+    with pytest.raises(Exception):
+        s.execute("alter table ac add column b bigint default 'xyz'")
+    assert s.must_query("select * from ac") == [(1,)]
+
+
+def test_alter_add_not_null_column(s):
+    s.execute("create table an (a bigint)")
+    s.execute("insert into an values (1)")
+    from tidb_tpu.session.catalog import CatalogError
+    with pytest.raises(CatalogError):
+        s.execute("alter table an add column b bigint not null")
+    s.execute("alter table an add column b bigint not null default 5")
+    assert s.must_query("select b from an") == [(5,)]
+    with pytest.raises(CatalogError):
+        s.execute("insert into an values (2, null)")
+
+
+def test_create_table_index_options_parse(s):
+    s.execute("create table io1 (a bigint, b varchar(8), "
+              "key k1 (a) using btree, key k2 (b(4) desc) comment 'x')")
+    names = [r[1] for r in s.must_query("show index from io1")]
+    assert "k1" in names and "k2" in names
+
+
+def test_string_point_lookup_via_index_types(s):
+    s.execute("create table px (d decimal(10,2), v bigint, key kd (d))")
+    s.execute("insert into px values ('1.50', 1), ('2.25', 2), ('1.49', 3)")
+    assert s.must_query("select v from px where d = 1.50") == [(1,)]
+    plan = "\n".join(r[0] for r in s.must_query(
+        "explain select v from px where d = 1.50"))
+    assert "IndexLookUp" in plan

@@ -294,18 +294,7 @@ class HostSelection(PhysOp):
 
     def execute(self, ctx):
         chunk = self.child.execute(ctx)
-        keep = np.ones(chunk.num_rows, bool)
-        pairs = chunk.col_pairs()
-        for c in self.conditions:
-            v, m = eval_expr(np, c, pairs)
-            v = np.broadcast_to(np.asarray(v), (chunk.num_rows,))
-            if v.dtype != bool:
-                v = v != 0
-            if m is not True:
-                m = np.broadcast_to(np.asarray(m), (chunk.num_rows,))
-                v = v & m
-            keep &= v
-        idx = np.nonzero(keep)[0]
+        idx = np.nonzero(_conds_mask(chunk, self.conditions))[0]
         return ResultChunk(chunk.names, [c.take(idx) for c in chunk.columns])
 
 
@@ -533,11 +522,15 @@ def _take_nullable(c: Column, idx: np.ndarray) -> Column:
     return out
 
 
-def _conds_mask(chunk: ResultChunk, conds) -> np.ndarray:
-    """AND of conditions over a chunk (NULL = false)."""
+def _conds_mask(chunk: ResultChunk, conds, dicts=None) -> np.ndarray:
+    """AND of conditions over a chunk (NULL = false) — the one shared
+    filter-semantics implementation.  `dicts` lowers string consts onto
+    the chunk's dictionaries first."""
     pairs = chunk.col_pairs()
     keep = np.ones(chunk.num_rows, bool)
     for c in conds:
+        if dicts is not None:
+            c = lower_strings(c, dicts)
         v, m = eval_expr(np, c, pairs)
         v = np.broadcast_to(np.asarray(v), (chunk.num_rows,))
         if v.dtype != bool:
@@ -666,6 +659,94 @@ class DualExec(PhysOp):
             cols.append(Column(e.dtype, vals.astype(e.dtype.np_dtype()),
                                np.asarray([valid])))
         return ResultChunk(list(self.out_names), cols)
+
+
+# --------------------------------------------------------------------- #
+# index access (PointGet / IndexLookUp)
+# --------------------------------------------------------------------- #
+
+def _prefix_succ(b: bytes) -> bytes:
+    """Smallest key strictly greater than every key with prefix b."""
+    ba = bytearray(b)
+    for i in reversed(range(len(ba))):
+        if ba[i] != 0xFF:
+            ba[i] += 1
+            return bytes(ba[: i + 1])
+    return bytes(b) + b"\xff"
+
+
+@dataclass
+class IndexLookUpExec(PhysOp):
+    """Serve a query from a secondary index: scan the pinned-prefix key
+    range, decode handles, fetch + decode rows, filter residuals.
+
+    Reference analog: PointGetExec (executor/point_get.go) when the access
+    pins a full unique prefix, IndexLookUpExecutor (executor/distsql.go:457
+    indexWorker/tableWorker pipeline) otherwise — collapsed to a
+    synchronous scan+batchget against the native MVCC engine."""
+    table: Any
+    access: Any                    # planner.ranger.IndexAccess
+    col_offsets: list = field(default_factory=list)
+    conditions: list = field(default_factory=list)   # residual (unlowered)
+    out_names: list = field(default_factory=list)
+    out_dtypes: list = field(default_factory=list)
+    children: list = field(default_factory=list)
+
+    def describe(self):
+        ix = self.access.index
+        kind = "PointGet" if self.access.is_point else "IndexLookUp"
+        rng = f" range[{self.access.range_col}]" if self.access.range_col else ""
+        return (f"{kind}[{self.table.name}.{ix.name}] "
+                f"eq={self.access.eq_values}{rng}")
+
+    def execute(self, ctx):
+        from ..store import codec as C
+        tbl, acc = self.table, self.access
+        ix = acc.index
+        kv = tbl.kv
+        ts = kv.alloc_ts()
+        offs = [tbl.col_names.index(c) for c in ix.columns]
+        types = [tbl.col_types[i] for i in offs]
+        parts = [C.encode_index_value(v, t)
+                 for v, t in zip(acc.eq_values, types)]
+        handles: list[int] = []
+        if acc.is_point:
+            key = C.index_key(tbl.table_id, ix.index_id, *parts)
+            val = kv.get(key, ts)
+            if val is not None:
+                handles = [C.decode_index_handle(key, val)]
+        else:
+            base = C.index_key(tbl.table_id, ix.index_id, *parts)
+            start, end = base, _prefix_succ(base)
+            if acc.range_col is not None:
+                rt = types[len(acc.eq_values)]
+                if acc.low is not None:
+                    lo = base + C.encode_index_value(acc.low, rt)
+                    start = lo if acc.low_incl else _prefix_succ(lo)
+                else:
+                    # bounded above only: skip NULL entries (flag 0x00) —
+                    # col < x is never true for NULL
+                    start = base + b"\x01"
+                if acc.high is not None:
+                    hi = base + C.encode_index_value(acc.high, rt)
+                    end = _prefix_succ(hi) if acc.high_incl else hi
+            for k, v in kv.scan(start, end, ts):
+                handles.append(C.decode_index_handle(k, v))
+        rows = []
+        for h in handles:
+            rv = kv.get(C.record_key(tbl.table_id, h), ts)
+            if rv is not None:
+                rows.append(C.decode_row(rv, tbl.col_types))
+        cols = [Column.from_values(tbl.col_types[off],
+                                   [r[off] for r in rows])
+                for off in self.col_offsets]
+        chunk = ResultChunk(list(self.out_names), cols)
+        if not self.conditions or chunk.num_rows == 0:
+            return chunk
+        dicts = {i: c.dictionary for i, c in enumerate(cols)
+                 if c.dictionary is not None}
+        idx = np.nonzero(_conds_mask(chunk, self.conditions, dicts))[0]
+        return ResultChunk(chunk.names, [c.take(idx) for c in chunk.columns])
 
 
 # --------------------------------------------------------------------- #
@@ -1078,5 +1159,5 @@ __all__ = [
     "ExecContext", "ResultChunk", "PhysOp", "CopTaskExec", "HostSelection",
     "HostProjection", "HostLimit", "HostSort", "HostTopN", "HostHashJoin",
     "HostAgg", "DualExec", "HostSetOp", "HostWindow", "CTEScanExec",
-    "DEVICE_OPS",
+    "IndexLookUpExec", "DEVICE_OPS",
 ]

@@ -24,10 +24,11 @@ from ..planner.logical import (DataSource, LogicalAggregate, LogicalCTEScan,
                                LogicalSetOp, LogicalSort, LogicalTopN,
                                LogicalWindow)
 from ..types import dtypes as dt
+from ..planner.ranger import LogicalIndexScan
 from .physical import (CopTaskExec, CTEScanExec, DualExec, HostAgg,
                        HostHashJoin, HostLimit, HostProjection, HostSelection,
-                       HostSetOp, HostSort, HostTopN, HostWindow, PhysOp,
-                       _device_supported)
+                       HostSetOp, HostSort, HostTopN, HostWindow,
+                       IndexLookUpExec, PhysOp, _device_supported)
 
 K = dt.TypeKind
 
@@ -43,6 +44,19 @@ def to_physical(p: LogicalPlan, no_device_join: bool = False) -> PhysOp:
         return cop
 
     ndj = no_device_join
+    if isinstance(p, LogicalIndexScan):
+        return IndexLookUpExec(p.ds.table, p.access, list(p.ds.col_offsets),
+                               out_names=p.schema.names(),
+                               out_dtypes=[c.dtype for c in p.schema.cols])
+    if isinstance(p, LogicalSelection) and isinstance(p.children[0],
+                                                      LogicalIndexScan):
+        # fuse residual filters into the lookup so string consts lower
+        # against the freshly built per-query dictionaries
+        s = p.children[0]
+        return IndexLookUpExec(s.ds.table, s.access, list(s.ds.col_offsets),
+                               conditions=list(p.conditions),
+                               out_names=s.schema.names(),
+                               out_dtypes=[c.dtype for c in s.schema.cols])
     if isinstance(p, LogicalSelection):
         return HostSelection(to_physical(p.child, ndj), list(p.conditions))
     if isinstance(p, LogicalProjection):

@@ -75,10 +75,17 @@ class ShardedCopProgram:
         self.row_capacity = row_capacity
         self.agg = dag_root if isinstance(dag_root, D.Aggregation) else None
         self.kind = "agg" if self.agg is not None else "rows"
+        # MIN/MAX partials merge host-side: some TPU runtimes (axon AOT)
+        # lower only Sum all-reduce, so pmin/pmax can't go in-program.
+        # Sums/counts still psum over ICI — the seam BASELINE.json names.
+        self.host_merge = self.agg is not None and any(
+            a.func in (D.AggFunc.MIN, D.AggFunc.MAX) for a in self.agg.aggs)
 
         in_specs = (P(SHARD_AXIS), P(SHARD_AXIS), P())  # aux replicated
         if self.kind == "agg":
-            out_specs = P()          # replicated after psum
+            # per-device states when min/max present; replicated post-psum
+            # otherwise
+            out_specs = P(SHARD_AXIS) if self.host_merge else P()
         else:
             out_specs = (P(SHARD_AXIS), P(SHARD_AXIS))
 
@@ -95,6 +102,9 @@ class ShardedCopProgram:
         if self.agg is not None:
             batch = _exec_node(self.agg.child, flat, base_sel, ev, aux)
             states = _agg_partial_states(self.agg, batch, ev, {})
+            if self.host_merge:
+                # add a leading per-device axis; host reduces across it
+                return jax.tree_util.tree_map(lambda a: a[None], states)
             return _collective_merge(states, SHARD_AXIS)
         batch = _exec_node(self.root, flat, base_sel, ev, aux)
         out_cols, n = compact(batch, self.row_capacity)

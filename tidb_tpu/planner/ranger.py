@@ -1,0 +1,230 @@
+"""Predicate -> index range extraction + index-path plan rewrite.
+
+Reference analog: pkg/util/ranger (predicates on index prefixes ->
+[start,end) key ranges) and the point-get fast path
+(executor/point_get.go, adapter.go:339).  Round-1 scope: equality-prefix
+access — an index is usable when the WHERE conjuncts pin a prefix of its
+columns with constants; a full pin of a unique index becomes a PointGet,
+any other prefix becomes an IndexLookUp range scan.  Inequality ranges on
+the first unpinned column extend the scan bounds.  Everything else stays
+on the columnar TPU scan path (which is the right default for analytic
+predicates — the index path exists for OLTP-selective queries).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..expr.ir import ColumnRef, Const, Expr, Func
+from ..types import dtypes as dt
+from .logical import (DataSource, LogicalPlan, LogicalSelection, Schema,
+                      SchemaCol)
+
+K = dt.TypeKind
+
+_CMP_OPS = {"eq", "lt", "le", "gt", "ge"}
+
+
+@dataclass
+class IndexAccess:
+    """A chosen index access path."""
+    index: object                       # catalog.IndexInfo
+    eq_values: list = field(default_factory=list)   # python values, prefix
+    # optional range on the first unpinned column: (low, low_incl, high,
+    # high_incl) — None bound = unbounded
+    range_col: Optional[str] = None
+    low: object = None
+    low_incl: bool = True
+    high: object = None
+    high_incl: bool = True
+    residual: list = field(default_factory=list)    # unconsumed conditions
+    is_point: bool = False              # full unique prefix => <=1 row
+
+
+def _const_for(col_type: dt.DataType, c: Const):
+    """Const IR value -> python value encodable for this column, or None
+    if the types don't line up (None = index unusable for this conjunct,
+    always safe).  Must mirror the scan path's const coercions
+    (expr/compile.py) or the index would return different rows — decimal
+    consts carry SCALED ints at the const's own scale, so every cross-type
+    pairing rescales explicitly."""
+    from ..types import decimal as dec
+    v = c.value
+    if v is None:
+        return None
+    k = col_type.kind
+    ck = c.dtype.kind
+    if ck == K.DECIMAL and isinstance(v, int):
+        # v is scaled by 10^c.dtype.scale
+        fs = c.dtype.scale
+        if k == K.DECIMAL:
+            ts = col_type.scale
+            if ts >= fs:
+                return v * dec.pow10(ts - fs)
+            div = dec.pow10(fs - ts)
+            return v // div if v % div == 0 else None
+        if k in (K.INT64, K.UINT64):
+            div = dec.pow10(fs)
+            return v // div if v % div == 0 else None
+        if k == K.FLOAT64:
+            return v / dec.pow10(fs)
+        return None
+    if k in (K.INT64, K.UINT64):
+        if isinstance(v, (int, bool)):
+            return int(v)
+        if isinstance(v, float):
+            return int(v) if v == int(v) else None
+        return None
+    if k == K.FLOAT64:
+        return float(v) if isinstance(v, (int, float)) else None
+    if k == K.FLOAT32:
+        return None       # float32 storage rounding vs f64 consts: unsafe
+    if k == K.DECIMAL:
+        if isinstance(v, int):      # integer literal
+            return v * dec.pow10(col_type.scale)
+        return None
+    if k in (K.DATE, K.DATETIME):
+        return int(v) if ck == k and isinstance(v, int) else None
+    if k == K.STRING:
+        return str(v) if isinstance(v, str) else None
+    return None
+
+
+def _cmp_parts(cond: Expr):
+    """cond as (op, col_index, const) with the column on the left, or
+    None."""
+    if not (isinstance(cond, Func) and cond.op in _CMP_OPS):
+        return None
+    a, b = cond.args
+    flip = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le", "eq": "eq"}
+    if isinstance(a, ColumnRef) and isinstance(b, Const):
+        return cond.op, a.index, b
+    if isinstance(b, ColumnRef) and isinstance(a, Const):
+        return flip[cond.op], b.index, a
+    return None
+
+
+def match_index(conditions: list[Expr], ds: DataSource,
+                index) -> Optional[IndexAccess]:
+    """Try to serve `conditions` (CNF over ds.schema) with `index`."""
+    tbl = ds.table
+    name_of = {i: c.name.lower() for i, c in enumerate(ds.schema.cols)}
+    # collect eq and range conds per column name
+    eqs: dict[str, object] = {}
+    ranges: dict[str, list] = {}
+    consumed: dict[int, str] = {}       # condition position -> col name
+    for pos, cond in enumerate(conditions):
+        p = _cmp_parts(cond)
+        if p is None:
+            continue
+        op, ci, cst = p
+        col = name_of[ci]
+        col_type = tbl.col_types[tbl.col_names.index(ds.schema.cols[ci].name)]
+        v = _const_for(col_type, cst)
+        if v is None:
+            continue
+        if op == "eq" and col not in eqs:
+            eqs[col] = v
+            consumed[pos] = col
+        elif op != "eq":
+            ranges.setdefault(col, []).append((op, v, pos))
+
+    prefix = []
+    for col in index.columns:
+        cl = col.lower()
+        if cl in eqs:
+            prefix.append(eqs[cl])
+        else:
+            break
+    if not prefix:
+        return None
+    acc = IndexAccess(index, prefix)
+    used_cols = {c.lower() for c in index.columns[:len(prefix)]}
+    acc.is_point = index.unique and len(prefix) == len(index.columns)
+
+    # range on the next index column
+    if len(prefix) < len(index.columns):
+        nxt = index.columns[len(prefix)].lower()
+        for op, v, pos in ranges.get(nxt, []):
+            if op in ("gt", "ge"):
+                if acc.low is None or v > acc.low:
+                    acc.low, acc.low_incl = v, op == "ge"
+            else:
+                if acc.high is None or v < acc.high:
+                    acc.high, acc.high_incl = v, op == "le"
+        if acc.low is not None or acc.high is not None:
+            acc.range_col = nxt
+
+    # residual = everything except consumed eq conds on used columns
+    # (range conds stay as residuals — cheap to re-check, keeps bounds
+    # logic simple and NULL-safe)
+    acc.residual = [c for pos, c in enumerate(conditions)
+                    if not (pos in consumed and consumed[pos] in used_cols)]
+    return acc
+
+
+def choose_index(conditions: list[Expr], ds: DataSource) -> Optional[IndexAccess]:
+    """Pick the best access path: point gets beat longer prefixes beat
+    shorter ones (the reference's heuristic before real stats)."""
+    tbl = ds.table
+    if getattr(tbl, "kv", None) is None:
+        return None
+    best: Optional[IndexAccess] = None
+    for ix in getattr(tbl, "indexes", []):
+        if ix.state != "public":
+            continue
+        acc = match_index(conditions, ds, ix)
+        if acc is None:
+            continue
+        if best is None or _score(acc) > _score(best):
+            best = acc
+    return best
+
+
+def _score(acc: IndexAccess) -> tuple:
+    return (acc.is_point, len(acc.eq_values), acc.range_col is not None)
+
+
+# ------------------------------------------------------------------ #
+# plan rewrite
+# ------------------------------------------------------------------ #
+
+@dataclass
+class LogicalIndexScan(LogicalPlan):
+    """Index-served scan of a KV table (IndexLookUp / PointGet analog)."""
+    ds: DataSource
+    access: IndexAccess
+    schema: Schema = None
+
+    def __post_init__(self):
+        self.children = []
+        if self.schema is None:
+            self.schema = self.ds.schema
+
+
+def apply_index_paths(p: LogicalPlan) -> LogicalPlan:
+    """Replace Selection-over-DataSource with an index access when the
+    predicates pin an index prefix (run after optimize_plan so predicate
+    pushdown has collected conditions at the scan)."""
+    for i, c in enumerate(p.children):
+        nc = apply_index_paths(c)
+        p.children[i] = nc
+        if getattr(p, "child", None) is c:
+            p.child = nc
+        if getattr(p, "left", None) is c:
+            p.left = nc
+        if getattr(p, "right", None) is c:
+            p.right = nc
+    if isinstance(p, LogicalSelection) and isinstance(p.child, DataSource):
+        acc = choose_index(p.conditions, p.child)
+        if acc is not None:
+            scan = LogicalIndexScan(p.child, acc)
+            if acc.residual:
+                return LogicalSelection(scan, acc.residual)
+            return scan
+    return p
+
+
+__all__ = ["IndexAccess", "match_index", "choose_index", "LogicalIndexScan",
+           "apply_index_paths"]

@@ -27,6 +27,23 @@ class CatalogError(ValueError):
     pass
 
 
+class DuplicateKeyError(CatalogError):
+    """MySQL error 1062 analog."""
+
+
+@dataclass
+class IndexInfo:
+    """Secondary (or PRIMARY) index metadata (reference: meta/model
+    IndexInfo)."""
+    name: str
+    index_id: int
+    columns: list[str]
+    unique: bool = False
+    # online-DDL visibility state (F1 states, ddl/index.go:880): round-1
+    # indexes are created synchronously straight to 'public'
+    state: str = "public"
+
+
 TYPE_MAP = {
     "BIGINT": dt.bigint, "INT": dt.bigint, "INTEGER": dt.bigint,
     "SMALLINT": dt.bigint, "TINYINT": dt.bigint, "MEDIUMINT": dt.bigint,
@@ -74,13 +91,101 @@ class TableInfo:
     table_id: int = 0
     kv: Any = None                              # store.kv.KVStore
 
+    indexes: list[IndexInfo] = field(default_factory=list)
+
     _base_cols: Optional[list[Column]] = None   # bulk-registered columns
     _pending: list = field(default_factory=list)  # bulk-mode write buffer
     _snapshot: Optional[ColumnarSnapshot] = None
     _epoch: int = 0
     _auto_inc: int = 0
     _next_handle: int = 0
+    _next_index_id: int = 0
     n_shards: int = 8
+
+    # ---------------- index helpers ---------------- #
+
+    def index_by_name(self, name: str) -> Optional[IndexInfo]:
+        for ix in self.indexes:
+            if ix.name.lower() == name.lower():
+                return ix
+        return None
+
+    def _index_cols(self, ix: IndexInfo) -> list[int]:
+        return [self.col_names.index(c) for c in ix.columns]
+
+    def _index_entry(self, ix: IndexInfo, row: tuple, handle: int):
+        from ..store.codec import encode_index_entry
+        offs = self._index_cols(ix)
+        vals = [row[i] for i in offs]
+        types = [self.col_types[i] for i in offs]
+        return encode_index_entry(self.table_id, ix.index_id, vals, types,
+                                  handle, ix.unique)
+
+    def _put_index_entry(self, txn, ix: IndexInfo, row: tuple, handle: int):
+        """Write one index entry, enforcing uniqueness (shared by the
+        insert path and CREATE INDEX backfill)."""
+        key, val = self._index_entry(ix, row, handle)
+        if ix.unique and val and txn.get(key) is not None:
+            raise DuplicateKeyError(
+                f"Duplicate entry for key '{self.name}.{ix.name}'")
+        txn.put(key, val)
+
+    def _write_index_entries(self, txn, row: tuple, handle: int):
+        for ix in self.indexes:
+            self._put_index_entry(txn, ix, row, handle)
+
+    def _delete_index_entries(self, txn, row: tuple, handle: int):
+        for ix in self.indexes:
+            key, _ = self._index_entry(ix, row, handle)
+            txn.delete(key)
+
+    def create_index(self, name: str, columns: list[str], unique: bool,
+                     if_not_exists: bool = False) -> IndexInfo:
+        """Create + synchronously backfill a secondary index (the round-1
+        stand-in for the online-DDL write-reorg backfill)."""
+        if self.index_by_name(name) is not None:
+            if if_not_exists:
+                return self.index_by_name(name)
+            raise CatalogError(f"index {name!r} already exists")
+        for c in columns:
+            if c not in self.col_names:
+                raise CatalogError(f"unknown column {c!r} in index {name!r}")
+        if self.kv is None:
+            raise CatalogError(
+                "indexes require a KV-backed table (bulk-loaded snapshots "
+                "are scan-only)")
+        self._next_index_id += 1
+        ix = IndexInfo(name, self._next_index_id, list(columns), unique)
+        # backfill existing rows before publishing
+        from .codec_io import scan_table_rows
+        ts = self.kv.alloc_ts()
+        handles, rows = scan_table_rows(self.kv, self.table_id, ts,
+                                        self.col_types)
+        txn = self.kv.begin()
+        try:
+            for h, r in zip(handles, rows):
+                self._put_index_entry(txn, ix, tuple(r), int(h))
+            txn.commit()
+        except Exception:
+            txn.rollback()
+            raise
+        self.indexes.append(ix)
+        return ix
+
+    def drop_index(self, name: str, if_exists: bool = False):
+        ix = self.index_by_name(name)
+        if ix is None:
+            if if_exists:
+                return
+            raise CatalogError(f"unknown index {name!r}")
+        from ..store.codec import index_prefix, index_prefix_end
+        txn = self.kv.begin()
+        for k, _ in self.kv.scan(index_prefix(self.table_id, ix.index_id),
+                                 index_prefix_end(self.table_id, ix.index_id),
+                                 txn.start_ts):
+            txn.delete(k)
+        txn.commit()
+        self.indexes.remove(ix)
 
     # ---------------- write path ---------------- #
 
@@ -108,13 +213,20 @@ class TableInfo:
         if self.kv is not None:
             own = txn is None
             t = txn or self.kv.begin()
-            for r in fixed:
-                self._next_handle += 1
-                key, val = encode_table_row(self.table_id, self._next_handle,
-                                            r, self.col_types)
-                t.put(key, val)
-            if own:
-                t.commit()
+            try:
+                for r in fixed:
+                    self._next_handle += 1
+                    key, val = encode_table_row(self.table_id,
+                                                self._next_handle,
+                                                r, self.col_types)
+                    t.put(key, val)
+                    self._write_index_entries(t, r, self._next_handle)
+                if own:
+                    t.commit()
+            except Exception:
+                if own:
+                    t.rollback()
+                raise
         else:
             self._pending.extend(fixed)
         self._invalidate()
@@ -130,8 +242,17 @@ class TableInfo:
             t = self.kv.begin()
             from ..store.codec import record_key
             drop = np.nonzero(~np.asarray(keep_mask))[0]
-            for i in drop:
-                t.delete(record_key(self.table_id, int(handles[i])))
+            # materialize ONLY the dropped rows for index-entry removal
+            drop_rows = None
+            if self.indexes and len(drop):
+                dropped = [c.take(drop) for c in snap.columns]
+                drop_rows = list(zip(*[c.to_python() for c in dropped]))
+            for j, i in enumerate(drop):
+                h = int(handles[i])
+                t.delete(record_key(self.table_id, h))
+                if drop_rows is not None:
+                    self._delete_index_entries(
+                        t, tuple(plainify(v) for v in drop_rows[j]), h)
             t.commit()
         else:
             self._base_cols = [c.take(idx) for c in snap.columns]
@@ -141,18 +262,31 @@ class TableInfo:
     def replace_columns(self, cols: list[Column]) -> None:
         """Full rewrite (UPDATE path, round 1)."""
         if self.kv is not None:
-            # rewrite through the row store to keep MVCC history coherent
+            # rewrite through the row store in ONE txn so a failed rewrite
+            # (e.g. a duplicate-key error on re-insert) leaves the table
+            # untouched, keeping MVCC history coherent
             t = self.kv.begin()
-            from ..store.codec import record_key, record_prefix, record_prefix_end
+            from ..store.codec import (index_prefix, index_prefix_end,
+                                       record_prefix, record_prefix_end)
             for k, _ in self.kv.scan(record_prefix(self.table_id),
                                      record_prefix_end(self.table_id),
                                      t.start_ts):
                 t.delete(k)
-            t.commit()
+            for k, _ in self.kv.scan(index_prefix(self.table_id),
+                                     index_prefix_end(self.table_id),
+                                     t.start_ts):
+                t.delete(k)
             self._base_cols = None
             rows = list(zip(*[c.to_python() for c in cols])) if cols and len(cols[0]) else []
-            self._invalidate()
-            self.insert_rows([tuple(plainify(v) for v in r) for r in rows])
+            try:
+                self.insert_rows([tuple(plainify(v) for v in r)
+                                  for r in rows], txn=t)
+                t.commit()
+            except Exception:
+                t.rollback()
+                raise
+            finally:
+                self._invalidate()
             return
         self._base_cols = cols
         self._invalidate()
@@ -161,12 +295,17 @@ class TableInfo:
         n = 0
         if self.kv is not None:
             t = self.kv.begin()
-            from ..store.codec import record_prefix, record_prefix_end
+            from ..store.codec import (index_prefix, index_prefix_end,
+                                       record_prefix, record_prefix_end)
             for k, _ in self.kv.scan(record_prefix(self.table_id),
                                      record_prefix_end(self.table_id),
                                      t.start_ts):
                 t.delete(k)
                 n += 1
+            for k, _ in self.kv.scan(index_prefix(self.table_id),
+                                     index_prefix_end(self.table_id),
+                                     t.start_ts):
+                t.delete(k)
             t.commit()
         elif self._base_cols or self._pending:
             n = (len(self._base_cols[0]) if self._base_cols else 0) + len(self._pending)
@@ -302,4 +441,5 @@ class Catalog:
         return self.databases[db]
 
 
-__all__ = ["Catalog", "TableInfo", "CatalogError", "type_from_sql"]
+__all__ = ["Catalog", "TableInfo", "IndexInfo", "CatalogError",
+           "DuplicateKeyError", "type_from_sql"]

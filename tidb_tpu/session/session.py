@@ -104,6 +104,17 @@ class Session:
                 raise CatalogError(f"unknown database {stmt.name!r}")
             self.db = stmt.name
             return ResultSet()
+        if isinstance(stmt, A.CreateIndex):
+            tbl = self.domain.catalog.get_table(self.db, stmt.table)
+            tbl.create_index(stmt.name, stmt.columns, stmt.unique,
+                             stmt.if_not_exists)
+            return ResultSet()
+        if isinstance(stmt, A.DropIndex):
+            tbl = self.domain.catalog.get_table(self.db, stmt.table)
+            tbl.drop_index(stmt.name, stmt.if_exists)
+            return ResultSet()
+        if isinstance(stmt, A.AlterTable):
+            return self._exec_alter(stmt)
         if isinstance(stmt, A.Insert):
             return self._exec_insert(stmt)
         if isinstance(stmt, A.Update):
@@ -131,8 +142,10 @@ class Session:
     # ------------------------------------------------------------- #
 
     def _plan_select(self, stmt):
+        from ..planner.ranger import apply_index_paths
         built = build_query(stmt, self.domain.catalog, self.db)
         plan = optimize_plan(built.plan)
+        plan = apply_index_paths(plan)
         phys = to_physical(plan)
         return built, phys
 
@@ -206,7 +219,80 @@ class Session:
                         table_id=self.domain.alloc_table_id(),
                         kv=self.domain.kv)
         self.domain.catalog.create_table(self.db, tbl, stmt.if_not_exists)
+        created = self.domain.catalog.get_table(self.db, stmt.name)
+        if created is tbl:
+            # implicit PRIMARY index gives PK uniqueness + the point-get
+            # path (the reference's clustered-handle role, tablecodec)
+            if stmt.primary_key:
+                tbl.create_index("PRIMARY", list(stmt.primary_key), True)
+            for i, (iname, cols, uniq) in enumerate(stmt.indexes):
+                tbl.create_index(iname or f"idx_{i+1}_" + "_".join(cols),
+                                 cols, uniq)
         return ResultSet()
+
+    def _exec_alter(self, stmt: A.AlterTable) -> ResultSet:
+        tbl = self.domain.catalog.get_table(self.db, stmt.table)
+        for act in stmt.actions:
+            if act[0] == "add_index":
+                _, iname, cols, uniq = act
+                tbl.create_index(iname or "idx_" + "_".join(cols), cols, uniq)
+            elif act[0] == "drop_index":
+                tbl.drop_index(act[1])
+            elif act[0] == "add_column":
+                self._alter_add_column(tbl, act[1])
+            elif act[0] == "drop_column":
+                self._alter_drop_column(tbl, act[1])
+            else:
+                raise PlanError(f"unsupported ALTER action {act[0]}")
+        return ResultSet()
+
+    def _alter_add_column(self, tbl, cd) -> None:
+        if cd.name in tbl.col_names:
+            raise CatalogError(f"column {cd.name!r} already exists")
+        t = type_from_sql(cd.type_name, cd.prec, cd.scale, cd.not_null)
+        default = None
+        if cd.default is not None:
+            default = self._literal_value(cd.default)
+        snap = tbl.snapshot()
+        if cd.not_null and default is None and snap.num_rows:
+            raise CatalogError(
+                f"cannot add NOT NULL column {cd.name!r} without a DEFAULT "
+                "to a non-empty table")
+        rows = [tuple(plainify(v) for v in r)
+                for r in zip(*[c.to_python() for c in snap.columns])] \
+            if snap.num_rows else []
+        new_rows = [r + (default,) for r in rows]
+        self._rewrite_with_schema(tbl, tbl.col_names + [cd.name],
+                                  tbl.col_types + [t], new_rows)
+
+    def _alter_drop_column(self, tbl, name: str) -> None:
+        if name not in tbl.col_names:
+            raise CatalogError(f"unknown column {name!r}")
+        for ix in tbl.indexes:
+            if name in ix.columns:
+                raise CatalogError(
+                    f"cannot drop column {name!r}: used by index {ix.name!r}")
+        i = tbl.col_names.index(name)
+        snap = tbl.snapshot()
+        rows = [tuple(plainify(v) for j, v in enumerate(r) if j != i)
+                for r in zip(*[c.to_python() for c in snap.columns])] \
+            if snap.num_rows else []
+        self._rewrite_with_schema(tbl,
+                                  [n for n in tbl.col_names if n != name],
+                                  [t for j, t in enumerate(tbl.col_types)
+                                   if j != i], rows)
+
+    def _rewrite_with_schema(self, tbl, names, types, rows) -> None:
+        """Swap in a new column schema + rewritten rows; restore the old
+        schema if the rewrite fails so catalog and storage never diverge."""
+        old_names, old_types = tbl.col_names, tbl.col_types
+        tbl.col_names, tbl.col_types = list(names), list(types)
+        try:
+            tbl.replace_columns(_rows_to_columns(tbl, rows))
+        except Exception:
+            tbl.col_names, tbl.col_types = old_names, old_types
+            tbl._invalidate()
+            raise
 
     def _exec_insert(self, stmt: A.Insert) -> ResultSet:
         tbl = self.domain.catalog.get_table(self.db, stmt.table)
@@ -317,6 +403,12 @@ class Session:
             return ResultSet(["Field", "Type", "Null"],
                              [(n, str(ty), "YES" if ty.nullable else "NO")
                               for n, ty in zip(t.col_names, t.col_types)])
+        if stmt.kind == "index":
+            t = cat.get_table(self.db, stmt.target)
+            return ResultSet(
+                ["Table", "Key_name", "Non_unique", "Column_name"],
+                [(t.name, ix.name, int(not ix.unique), ",".join(ix.columns))
+                 for ix in t.indexes])
         if stmt.kind == "variables":
             vs = {**self.domain.sysvars, **self.vars}
             return ResultSet(["Variable_name", "Value"],

@@ -210,6 +210,33 @@ class CreateTable(Node):
     columns: list[ColumnDef] = field(default_factory=list)
     primary_key: list[str] = field(default_factory=list)
     if_not_exists: bool = False
+    # inline index defs: (name_or_None, [cols], unique)
+    indexes: list[tuple] = field(default_factory=list)
+
+
+@dataclass
+class CreateIndex(Node):
+    name: str
+    table: str
+    columns: list[str] = field(default_factory=list)
+    unique: bool = False
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropIndex(Node):
+    name: str
+    table: str
+    if_exists: bool = False
+
+
+@dataclass
+class AlterTable(Node):
+    """Round-1 actions: ('add_index', name, cols, unique) |
+    ('drop_index', name) | ('add_column', ColumnDef) |
+    ('drop_column', name)."""
+    table: str
+    actions: list[tuple] = field(default_factory=list)
 
 
 @dataclass

@@ -26,7 +26,8 @@ KEYWORDS = {
     "DESCRIBE", "DESC", "BEGIN", "COMMIT", "ROLLBACK", "START",
     "TRANSACTION", "DEFAULT", "AUTO_INCREMENT", "COMMENT", "ENGINE",
     "CHARSET", "COLLATE", "CHARACTER", "SUBSTRING", "TRUNCATE", "GLOBAL",
-    "SESSION", "VARIABLES", "COLUMNS", "ADMIN", "CHECK", "WITH",
+    "SESSION", "VARIABLES", "COLUMNS", "ADMIN", "CHECK", "WITH", "ALTER",
+    "ADD", "KEYS", "COLUMN",
     "RECURSIVE", "OVER", "PARTITION", "ROWS", "RANGE", "UNBOUNDED",
     "PRECEDING", "FOLLOWING", "CURRENT", "ROW", "WINDOW",
 }

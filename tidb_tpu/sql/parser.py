@@ -98,6 +98,8 @@ class Parser:
             return A.Explain(self.statement(), analyze)
         if self.at_kw("CREATE"):
             return self.create_stmt()
+        if self.at_kw("ALTER"):
+            return self.alter_stmt()
         if self.at_kw("DROP"):
             return self.drop_stmt()
         if self.at_kw("INSERT"):
@@ -391,6 +393,18 @@ class Parser:
         if self.accept_kw("DATABASE"):
             ine = self._if_not_exists()
             return A.CreateDatabase(self.ident(), ine)
+        unique = self.accept_kw("UNIQUE")
+        if self.accept_kw("INDEX") or (unique and self.accept_kw("KEY")):
+            ine = self._if_not_exists()
+            name = self.ident()
+            self.expect_kw("ON")
+            table = self.ident()
+            if self.accept_op("."):
+                table = self.ident()
+            cols = self._paren_name_list()
+            return A.CreateIndex(name, table, cols, unique, ine)
+        if unique:
+            raise ParseError("expected INDEX after CREATE UNIQUE", self.cur)
         self.expect_kw("TABLE")
         ine = self._if_not_exists()
         name = self.ident()
@@ -408,8 +422,14 @@ class Parser:
                     ct.primary_key.append(self.ident())
                 self.expect_op(")")
             elif self.at_kw("UNIQUE", "INDEX", "KEY"):
-                # secondary index definitions: parsed and ignored round 1
-                self._skip_index_def()
+                uniq = self.accept_kw("UNIQUE")
+                if not self.accept_kw("INDEX"):
+                    self.accept_kw("KEY")
+                iname = None
+                if self.cur.kind == "ident":
+                    iname = self.ident()
+                cols = self._paren_name_list()
+                ct.indexes.append((iname, cols, uniq))
             else:
                 ct.columns.append(self.column_def())
             if not self.accept_op(","):
@@ -423,20 +443,65 @@ class Parser:
                 ct.primary_key.append(c.name)
         return ct
 
-    def _skip_index_def(self):
-        depth = 0
+    def _paren_name_list(self) -> list[str]:
+        """Index column list; prefix lengths col(10) and ASC/DESC are
+        accepted and ignored, as are trailing index options."""
+        self.expect_op("(")
+        out = []
         while True:
-            if self.at_op("(") :
-                depth += 1
-            elif self.at_op(")"):
-                if depth == 0:
-                    return
-                depth -= 1
-            elif self.at_op(",") and depth == 0:
+            out.append(self.ident())
+            if self.accept_op("("):        # prefix length
+                self._int_lit()
+                self.expect_op(")")
+            if not self.accept_kw("DESC"):
+                self.accept_kw("ASC")
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        self._skip_index_options()
+        return out
+
+    def _skip_index_options(self):
+        """USING BTREE|HASH, COMMENT '...', VISIBLE/INVISIBLE."""
+        while True:
+            if self.accept_kw("USING"):
+                self.ident()
+            elif self.accept_kw("COMMENT"):
+                self.advance()             # string literal
+            elif self.cur.kind == "ident" and self.cur.text.upper() in (
+                    "VISIBLE", "INVISIBLE", "BTREE", "HASH"):
+                self.advance()
+            else:
                 return
-            elif self.cur.kind == "eof":
-                raise ParseError("unterminated index definition", self.cur)
-            self.advance()
+
+    def alter_stmt(self) -> A.Node:
+        self.expect_kw("ALTER")
+        self.expect_kw("TABLE")
+        table = self.ident()
+        if self.accept_op("."):
+            table = self.ident()
+        at = A.AlterTable(table)
+        while True:
+            if self.accept_kw("ADD"):
+                uniq = self.accept_kw("UNIQUE")
+                if self.accept_kw("INDEX") or self.accept_kw("KEY") or uniq:
+                    iname = self.ident() if self.cur.kind == "ident" else None
+                    cols = self._paren_name_list()
+                    at.actions.append(("add_index", iname, cols, uniq))
+                else:
+                    self.accept_kw("COLUMN")
+                    at.actions.append(("add_column", self.column_def()))
+            elif self.accept_kw("DROP"):
+                if self.accept_kw("INDEX") or self.accept_kw("KEY"):
+                    at.actions.append(("drop_index", self.ident()))
+                else:
+                    self.accept_kw("COLUMN")
+                    at.actions.append(("drop_column", self.ident()))
+            else:
+                raise ParseError("unsupported ALTER TABLE action", self.cur)
+            if not self.accept_op(","):
+                break
+        return at
 
     def _if_not_exists(self) -> bool:
         if self.accept_kw("IF"):
@@ -503,6 +568,17 @@ class Parser:
         if self.accept_kw("DATABASE"):
             ie = self.accept_kw("IF") and self.expect_kw("EXISTS") is not None
             return A.DropDatabase(self.ident(), ie)
+        if self.accept_kw("INDEX"):
+            ie = False
+            if self.accept_kw("IF"):
+                self.expect_kw("EXISTS")
+                ie = True
+            name = self.ident()
+            self.expect_kw("ON")
+            table = self.ident()
+            if self.accept_op("."):
+                table = self.ident()
+            return A.DropIndex(name, table, ie)
         self.expect_kw("TABLE")
         ie = False
         if self.accept_kw("IF"):
@@ -577,6 +653,9 @@ class Parser:
         if self.accept_kw("GLOBAL", "SESSION"):
             self.expect_kw("VARIABLES")
             return A.ShowStmt("variables")
+        if self.accept_kw("INDEX", "KEYS"):
+            self.expect_kw("FROM")
+            return A.ShowStmt("index", self.ident())
         raise ParseError("unsupported SHOW", self.cur)
 
     def set_stmt(self) -> A.SetStmt:

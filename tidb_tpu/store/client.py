@@ -50,7 +50,16 @@ class CopClient:
         prog = get_sharded_program(agg, self.mesh)
         states = prog(cols, counts, aux_cols)
         states = jax.device_get(states)
-        merged = merge_states([states])
+        if prog.host_merge:
+            # min/max partials come back per-device (leading axis); the
+            # final merge is the host's root-worker role
+            n_dev = len(self.mesh.devices.reshape(-1))
+            per_dev = [jax.tree_util.tree_map(lambda a: np.asarray(a)[d],
+                                              states)
+                       for d in range(n_dev)]
+            merged = merge_states(per_dev)
+        else:
+            merged = merge_states([states])
         key_cols, agg_cols = finalize(agg, merged, key_meta)
         return CopResult(agg_cols, key_cols)
 

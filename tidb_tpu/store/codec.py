@@ -60,6 +60,99 @@ def index_key(table_id: int, index_id: int, *parts: bytes) -> bytes:
     return out
 
 
+def index_prefix(table_id: int, index_id: Optional[int] = None) -> bytes:
+    p = b"t" + encode_int_key(table_id) + b"_i"
+    if index_id is not None:
+        p += encode_int_key(index_id)
+    return p
+
+
+def index_prefix_end(table_id: int, index_id: Optional[int] = None) -> bytes:
+    if index_id is None:
+        return b"t" + encode_int_key(table_id) + b"_j"  # '_i' + 1
+    return index_prefix(table_id, index_id + 1)
+
+
+# ---------------- memcomparable index values ---------------- #
+#
+# Reference: pkg/util/codec — ints big-endian with sign flip, floats with
+# sign-bit manipulation, bytes in 8-byte groups with pad-count markers so
+# byte order == value order; NULLs get a 0x00 flag (sort first), non-NULL
+# values a 0x01 flag (tablecodec index key layout).
+
+def encode_bytes_key(b: bytes) -> bytes:
+    """Order-preserving var-length bytes: 8-byte groups padded with \\x00,
+    each followed by a marker 0xF7 + count of real bytes in the group
+    (util/codec EncodeBytes analog)."""
+    out = bytearray()
+    for i in range(0, len(b) + 1, 8):
+        group = b[i:i + 8]
+        out += group + b"\x00" * (8 - len(group))
+        out.append(0xF7 + len(group))
+        if len(group) < 8:
+            break
+    return bytes(out)
+
+
+def encode_float_key(v: float) -> bytes:
+    bits = struct.unpack(">Q", struct.pack(">d", float(v)))[0]
+    if bits & SIGN_FLIP:
+        bits ^= 0xFFFFFFFFFFFFFFFF    # negative: flip all
+    else:
+        bits |= SIGN_FLIP             # positive: flip sign bit
+    return struct.pack(">Q", bits)
+
+
+def encode_index_value(v: Any, t: dt.DataType) -> bytes:
+    """One python-level column value -> memcomparable bytes incl. the NULL
+    flag byte."""
+    if v is None:
+        return b"\x00"
+    k = t.kind
+    if k in (K.INT64, K.UINT64):
+        return b"\x01" + encode_int_key(int(v))
+    if k in (K.FLOAT64, K.FLOAT32):
+        return b"\x01" + encode_float_key(float(v))
+    if k == K.DECIMAL:
+        scaled = v if isinstance(v, int) else dec.encode(v, t.scale)
+        return b"\x01" + encode_int_key(scaled)
+    if k == K.DATE:
+        d = v if isinstance(v, int) else tmp.parse_date(str(v))
+        return b"\x01" + encode_int_key(d)
+    if k == K.DATETIME:
+        d = v if isinstance(v, int) else tmp.parse_datetime(str(v))
+        return b"\x01" + encode_int_key(d)
+    if k == K.STRING:
+        return b"\x01" + encode_bytes_key(str(v).encode())
+    if k == K.TIME:
+        return b"\x01" + encode_int_key(int(v))
+    raise ValueError(f"cannot index {t}")
+
+
+def encode_index_entry(table_id: int, index_id: int, values: Sequence[Any],
+                       types: Sequence[dt.DataType], handle: int,
+                       unique: bool) -> tuple[bytes, bytes]:
+    """Index KV pair.  Unique: key = prefix+values, value = handle.
+    Non-unique: key = prefix+values+handle, value = empty (the reference's
+    tablecodec layout, SURVEY.md §A.2)."""
+    parts = [encode_index_value(v, t) for v, t in zip(values, types)]
+    has_null = any(v is None for v in values)
+    if unique and not has_null:
+        return (index_key(table_id, index_id, *parts),
+                struct.pack(">q", handle))
+    # NULL-containing unique entries degrade to non-unique form (MySQL
+    # allows many NULLs in a unique index)
+    parts.append(encode_int_key(handle))
+    return index_key(table_id, index_id, *parts), b""
+
+
+def decode_index_handle(key: bytes, value: bytes) -> int:
+    """Handle from an index entry (tail of key, or the value for unique)."""
+    if value:
+        return struct.unpack(">q", value)[0]
+    return decode_int_key(key[-8:])
+
+
 # ---------------- row values ---------------- #
 
 ROW_VERSION = 1
@@ -154,6 +247,8 @@ def decode_row(data: bytes, types: Sequence[dt.DataType]) -> list[Any]:
 
 __all__ = [
     "encode_int_key", "decode_int_key", "record_key", "record_prefix",
-    "record_prefix_end", "decode_record_key", "index_key",
+    "record_prefix_end", "decode_record_key", "index_key", "index_prefix",
+    "index_prefix_end", "encode_bytes_key", "encode_float_key",
+    "encode_index_value", "encode_index_entry", "decode_index_handle",
     "encode_row", "decode_row", "ROW_VERSION",
 ]
