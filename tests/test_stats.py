@@ -1,0 +1,140 @@
+"""Statistics subsystem: device-built ANALYZE, estimation, cost-based
+access paths (reference: pkg/statistics + pkg/planner/cardinality)."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.session.session import Domain, Session
+from tidb_tpu.stats.build import build_column_stats, sortable_f64
+from tidb_tpu.stats.histogram import Histogram
+from tidb_tpu.stats.sketch import FMSketch, TopN
+
+
+def make_session():
+    return Session(Domain())
+
+
+def test_kernel_counts_ndv_nulls(rng):
+    x = rng.integers(0, 1000, size=5000)
+    valid = rng.random(5000) > 0.1
+    out = build_column_stats(x.astype(np.int64), valid)
+    assert int(out["count"]) == int(valid.sum())
+    assert int(out["null_count"]) == int((~valid).sum())
+    assert int(out["ndv"]) == len(np.unique(x[valid]))
+
+
+def test_kernel_topn_exact(rng):
+    # skewed: value 7 appears 3000 times, rest uniform
+    x = np.concatenate([np.full(3000, 7), rng.integers(100, 200, 2000)])
+    out = build_column_stats(x.astype(np.int64), np.ones(len(x), bool))
+    top = dict(zip(out["top_vals"].tolist(), out["top_counts"].tolist()))
+    assert top[7] == 3000
+
+
+def test_histogram_range_estimates(rng):
+    x = rng.integers(0, 10000, size=20000).astype(np.int64)
+    out = build_column_stats(x, np.ones(len(x), bool))
+    h = Histogram(out["bounds"], out["cum_counts"], out["repeats"],
+                  ndv=int(out["ndv"]))
+    true_lt = int((x < 2500).sum())
+    est = h.less_row_count(2500)
+    assert abs(est - true_lt) / len(x) < 0.02
+    true_rng = int(((x >= 1000) & (x <= 3000)).sum())
+    est = h.range_row_count(1000, True, 3000, True)
+    assert abs(est - true_rng) / len(x) < 0.03
+
+
+def test_float_encoding_order(rng):
+    a = rng.normal(size=1000) * 100
+    enc = sortable_f64(a)
+    assert np.array_equal(np.argsort(enc, kind="stable"),
+                          np.argsort(a, kind="stable"))
+
+
+def test_fmsketch_ndv(rng):
+    x = rng.integers(0, 50000, size=100000).astype(np.int64)
+    out = build_column_stats(x, np.ones(len(x), bool))
+    est = FMSketch(out["kmv"].astype(np.uint64)).ndv()
+    true = len(np.unique(x))
+    assert abs(est - true) / true < 0.35   # KMV with k=64
+
+
+def test_analyze_and_show(rng):
+    s = make_session()
+    s.execute("create table t (a bigint, b double, c varchar(10))")
+    vals = ",".join(f"({i % 7}, {i * 0.5}, 'v{i % 3}')" for i in range(500))
+    s.execute(f"insert into t values {vals}")
+    s.execute("analyze table t")
+    meta = s.must_query("show stats_meta")
+    assert ("test", "t", 0, 500) in meta
+    hist = s.must_query("show stats_histograms")
+    row = [r for r in hist if r[2] == "a"][0]
+    assert row[3] == 7          # ndv of a
+    topn = s.must_query("show stats_topn")
+    assert any(r[2] == "a" for r in topn)
+
+
+def test_selectivity_drives_index_choice(rng):
+    """After ANALYZE, a non-selective predicate should NOT use the index
+    (full device scan is cheaper than 50% random lookups)."""
+    from tidb_tpu.planner.ranger import choose_index
+    s = make_session()
+    s.execute("create table t (a bigint, b bigint)")
+    rows = ",".join(f"({i % 2}, {i})" for i in range(2000))
+    s.execute(f"insert into t values {rows}")
+    s.execute("create index ia on t (a)")
+    s.execute("analyze table t")
+
+    from tidb_tpu.planner.build import build_query
+    from tidb_tpu.planner.logical import DataSource
+    from tidb_tpu.planner.optimize import optimize_plan
+    from tidb_tpu.planner.ranger import apply_index_paths, LogicalIndexScan
+    from tidb_tpu.sql.parser import parse_sql
+
+    def planned_access(sql):
+        built = build_query(parse_sql(sql)[0], s.domain.catalog, s.db)
+        plan = optimize_plan(built.plan)
+        plan = apply_index_paths(plan, s.domain.stats)
+        found = []
+        stack = [plan]
+        while stack:
+            p = stack.pop()
+            stack.extend(p.children)
+            if isinstance(p, LogicalIndexScan):
+                found.append(p)
+        return found
+
+    # a = 0 matches ~1000 of 2000 rows -> index rejected by cost
+    assert planned_access("select b from t where a = 0") == []
+    # correctness either way
+    assert s.must_query("select count(*) from t where a = 0") == [(1000,)]
+
+
+def test_selective_index_still_used(rng):
+    s = make_session()
+    s.execute("create table t (a bigint, b bigint)")
+    rows = ",".join(f"({i}, {i})" for i in range(2000))
+    s.execute(f"insert into t values {rows}")
+    s.execute("create index ia on t (a)")
+    s.execute("analyze table t")
+    assert s.must_query("select b from t where a = 77") == [(77,)]
+
+
+def test_auto_analyze_triggers(rng):
+    s = make_session()
+    s.execute("create table t (a bigint)")
+    rows = ",".join(f"({i})" for i in range(1500))
+    s.execute(f"insert into t values {rows}")
+    # planning any select should auto-analyze (>= 1000 rows, no stats)
+    s.execute("select count(*) from t where a > 10")
+    assert s.domain.stats.get(s.domain.catalog.get_table("test", "t")) is not None
+
+
+def test_topn_merge_and_fms_merge():
+    t1 = TopN({1: 10, 2: 5})
+    t2 = TopN({2: 7, 3: 1})
+    m = t1.merge(t2)
+    assert m.values[2] == 12
+    f1 = FMSketch(np.array([1, 5, 9], np.uint64))
+    f2 = FMSketch(np.array([5, 7], np.uint64))
+    assert f1.merge(f2).ndv() == 4

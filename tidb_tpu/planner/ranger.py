@@ -164,9 +164,13 @@ def match_index(conditions: list[Expr], ds: DataSource,
     return acc
 
 
-def choose_index(conditions: list[Expr], ds: DataSource) -> Optional[IndexAccess]:
-    """Pick the best access path: point gets beat longer prefixes beat
-    shorter ones (the reference's heuristic before real stats)."""
+def choose_index(conditions: list[Expr], ds: DataSource,
+                 stats=None) -> Optional[IndexAccess]:
+    """Pick the best access path.  Without stats: point gets beat longer
+    prefixes beat shorter ones (the reference's heuristic).  With stats
+    (post-ANALYZE): cost-based — index double-read (seek + per-row random
+    fetch, plan_cost_ver2's scan+net factors) vs full vectorized device
+    scan; skip the index when the predicate isn't selective enough."""
     tbl = ds.table
     if getattr(tbl, "kv", None) is None:
         return None
@@ -179,7 +183,50 @@ def choose_index(conditions: list[Expr], ds: DataSource) -> Optional[IndexAccess
             continue
         if best is None or _score(acc) > _score(best):
             best = acc
-    return best
+    if best is None or best.is_point or stats is None:
+        return best
+    cost_idx = _index_cost(best, ds, stats)
+    cost_scan = tbl.num_rows * SCAN_ROW_COST
+    return best if cost_idx < cost_scan else None
+
+
+# cost factors (plan_cost_ver2 analog, calibrated for the TPU split:
+# device scans stream whole columns through XLA, index lookups do
+# host-side KV seeks + row decodes)
+SCAN_ROW_COST = 1.0
+IDX_LOOKUP_ROW_COST = 20.0
+IDX_SEEK_COST = 30.0
+
+
+def _index_cost(acc: IndexAccess, ds: DataSource, stats) -> float:
+    from .cardinality import cond_selectivity
+
+    tbl = ds.table
+    n = tbl.num_rows
+    sel = 1.0
+    name_to_schema = {c.name.lower(): i for i, c in enumerate(ds.schema.cols)}
+    # selectivity of the consumed prefix eq conds + range cond, from stats
+    for col, v in zip(acc.index.columns, acc.eq_values):
+        ci = name_to_schema.get(col.lower())
+        if ci is None:
+            continue
+        ref = ds.schema.ref(ci)
+        sel *= cond_selectivity(stats, Func(ref.dtype, "eq",
+                                            (ref, Const(ref.dtype, v))), ds)
+    if acc.range_col is not None:
+        ci = name_to_schema.get(acc.range_col)
+        if ci is not None:
+            ref = ds.schema.ref(ci)
+            if acc.low is not None:
+                sel *= cond_selectivity(
+                    stats, Func(ref.dtype, "ge" if acc.low_incl else "gt",
+                                (ref, Const(ref.dtype, acc.low))), ds)
+            if acc.high is not None:
+                sel *= cond_selectivity(
+                    stats, Func(ref.dtype, "le" if acc.high_incl else "lt",
+                                (ref, Const(ref.dtype, acc.high))), ds)
+    est_rows = max(n * sel, 1.0)
+    return IDX_SEEK_COST + est_rows * IDX_LOOKUP_ROW_COST
 
 
 def _score(acc: IndexAccess) -> tuple:
@@ -203,12 +250,13 @@ class LogicalIndexScan(LogicalPlan):
             self.schema = self.ds.schema
 
 
-def apply_index_paths(p: LogicalPlan) -> LogicalPlan:
+def apply_index_paths(p: LogicalPlan, stats_handle=None) -> LogicalPlan:
     """Replace Selection-over-DataSource with an index access when the
     predicates pin an index prefix (run after optimize_plan so predicate
-    pushdown has collected conditions at the scan)."""
+    pushdown has collected conditions at the scan).  stats_handle, when
+    given, enables the cost-based index-vs-scan decision."""
     for i, c in enumerate(p.children):
-        nc = apply_index_paths(c)
+        nc = apply_index_paths(c, stats_handle)
         p.children[i] = nc
         if getattr(p, "child", None) is c:
             p.child = nc
@@ -217,7 +265,9 @@ def apply_index_paths(p: LogicalPlan) -> LogicalPlan:
         if getattr(p, "right", None) is c:
             p.right = nc
     if isinstance(p, LogicalSelection) and isinstance(p.child, DataSource):
-        acc = choose_index(p.conditions, p.child)
+        stats = (stats_handle.get(p.child.table)
+                 if stats_handle is not None else None)
+        acc = choose_index(p.conditions, p.child, stats)
         if acc is not None:
             scan = LogicalIndexScan(p.child, acc)
             if acc.residual:

@@ -43,11 +43,13 @@ class Domain:
     cop client + sysvars."""
 
     def __init__(self, mesh=None):
+        from ..stats.handle import StatsHandle
         from ..store.kv import KVStore
         self.catalog = Catalog()
         self.mesh = mesh if mesh is not None else get_mesh()
         self.client = CopClient(self.mesh)
         self.kv = KVStore()          # native C++ MVCC row store
+        self.stats = StatsHandle()   # pkg/statistics/handle analog
         self._next_table_id = 100
         self.sysvars: dict[str, Any] = {
             "tidb_distsql_scan_concurrency": 15,
@@ -135,7 +137,8 @@ class Session:
         if isinstance(stmt, A.TxnStmt):
             return self._exec_txn(stmt)
         if isinstance(stmt, A.AnalyzeTable):
-            self.domain.catalog.get_table(self.db, stmt.name).snapshot()
+            tbl = self.domain.catalog.get_table(self.db, stmt.name)
+            self.domain.stats.analyze_table(tbl)
             return ResultSet()
         raise PlanError(f"unsupported statement {type(stmt).__name__}")
 
@@ -144,10 +147,27 @@ class Session:
     def _plan_select(self, stmt):
         from ..planner.ranger import apply_index_paths
         built = build_query(stmt, self.domain.catalog, self.db)
+        self._maybe_auto_analyze(built.plan)
         plan = optimize_plan(built.plan)
-        plan = apply_index_paths(plan)
+        plan = apply_index_paths(plan, self.domain.stats)
         phys = to_physical(plan)
         return built, phys
+
+    def _maybe_auto_analyze(self, plan):
+        """Refresh stale stats before planning (handle/autoanalyze.go
+        analog, run inline instead of in a background worker)."""
+        merged = {**self.domain.sysvars, **self.vars}
+        if not int(merged.get("tidb_enable_auto_analyze", 1)):
+            return
+        from ..planner.logical import DataSource
+        stack, seen = [plan], set()
+        while stack:
+            p = stack.pop()
+            stack.extend(p.children)
+            if isinstance(p, DataSource) and id(p.table) not in seen:
+                seen.add(id(p.table))
+                if self.domain.stats.needs_auto_analyze(p.table):
+                    self.domain.stats.analyze_table(p.table)
 
     def _exec_select(self, stmt) -> ResultSet:
         built, phys = self._plan_select(stmt)
@@ -314,6 +334,7 @@ class Session:
         n = tbl.insert_rows(rows, txn=self.txn)
         if self.txn is not None:
             self._txn_tables.add(tbl)
+        self.domain.stats.note_modify(tbl, n)
         return ResultSet(affected=n)
 
     def _where_mask(self, tbl: TableInfo, where: Optional[A.Node]) -> np.ndarray:
@@ -380,14 +401,18 @@ class Session:
                 rows[i][ci[col]] = _decode_val(v[i], ir.dtype) if ok else None
         new_rows = [tuple(plainify(x) for x in r) for r in rows]
         tbl.replace_columns(_rows_to_columns(tbl, new_rows))
+        self.domain.stats.note_modify(tbl, n_aff, delta=0)
         return ResultSet(affected=n_aff)
 
     def _exec_delete(self, stmt: A.Delete) -> ResultSet:
         tbl = self.domain.catalog.get_table(self.db, stmt.table)
         if stmt.where is None:
-            return ResultSet(affected=tbl.truncate())
+            n = tbl.truncate()
+            self.domain.stats.note_modify(tbl, n, delta=-n)
+            return ResultSet(affected=n)
         mask = self._where_mask(tbl, stmt.where)
         n = tbl.delete_where(~mask)
+        self.domain.stats.note_modify(tbl, n, delta=-n)
         return ResultSet(affected=n)
 
     def _exec_show(self, stmt: A.ShowStmt) -> ResultSet:
@@ -409,11 +434,47 @@ class Session:
                 ["Table", "Key_name", "Non_unique", "Column_name"],
                 [(t.name, ix.name, int(not ix.unique), ",".join(ix.columns))
                  for ix in t.indexes])
+        if stmt.kind in ("stats_meta", "stats_histograms", "stats_topn"):
+            return self._exec_show_stats(stmt.kind)
         if stmt.kind == "variables":
             vs = {**self.domain.sysvars, **self.vars}
             return ResultSet(["Variable_name", "Value"],
                              sorted((k, str(v)) for k, v in vs.items()))
         raise PlanError(f"unsupported SHOW {stmt.kind}")
+
+    def _exec_show_stats(self, kind: str) -> ResultSet:
+        """SHOW STATS_META / STATS_HISTOGRAMS / STATS_TOPN (reference:
+        executor/show_stats.go)."""
+        cat = self.domain.catalog
+        rows = []
+        for db, tables in sorted(cat.databases.items()):
+            for name in sorted(tables):
+                tbl = tables[name]
+                ts = self.domain.stats.get(tbl)
+                if ts is None:
+                    continue
+                if kind == "stats_meta":
+                    rows.append((db, name, ts.modify_count,
+                                 ts.realtime_count))
+                elif kind == "stats_histograms":
+                    for cn, cs in sorted(ts.cols.items()):
+                        rows.append((db, name, cn, cs.ndv, cs.null_count,
+                                     len(cs.hist.bounds)))
+                else:
+                    for cn, cs in sorted(ts.cols.items()):
+                        for v, c in sorted(cs.topn.values.items(),
+                                           key=lambda kv: -kv[1]):
+                            rows.append((db, name, cn, v, c))
+        headers = {
+            "stats_meta": ["Db_name", "Table_name", "Modify_count",
+                           "Row_count"],
+            "stats_histograms": ["Db_name", "Table_name", "Column_name",
+                                 "Distinct_count", "Null_count",
+                                 "Bucket_count"],
+            "stats_topn": ["Db_name", "Table_name", "Column_name", "Value",
+                           "Count"],
+        }[kind]
+        return ResultSet(headers, rows)
 
     def _literal_value(self, node: A.Node):
         if isinstance(node, A.Lit):

@@ -656,6 +656,11 @@ class Parser:
         if self.accept_kw("INDEX", "KEYS"):
             self.expect_kw("FROM")
             return A.ShowStmt("index", self.ident())
+        if self.cur.kind == "ident" and self.cur.text.upper() in (
+                "STATS_META", "STATS_HISTOGRAMS", "STATS_TOPN"):
+            kind = self.cur.text.lower()
+            self.advance()
+            return A.ShowStmt(kind)
         raise ParseError("unsupported SHOW", self.cur)
 
     def set_stmt(self) -> A.SetStmt:

@@ -1,0 +1,17 @@
+"""Statistics subsystem.
+
+Reference analog: pkg/statistics/ (histogram.go:64 Histogram,
+cmsketch.go:56/501 CMSketch+TopN, fmsketch.go:65 FMSketch) and
+pkg/statistics/handle/ (load/save/cache, auto-analyze).  TPU-first design:
+ANALYZE builds every per-column statistic in ONE fused XLA program — sort,
+run-length encode, segment-sum, top_k — instead of the reference's
+row-at-a-time sampling collectors (SURVEY.md §7 step 9: "histogram/TopN
+built on-device via sort+segment-sum").
+"""
+
+from .histogram import Histogram
+from .sketch import CMSketch, FMSketch, TopN
+from .handle import ColumnStats, StatsHandle, TableStats
+
+__all__ = ["Histogram", "CMSketch", "FMSketch", "TopN", "ColumnStats",
+           "TableStats", "StatsHandle"]
