@@ -1,0 +1,74 @@
+"""EXPLAIN ANALYZE runtime stats, TRACE spans, statement summary
+(reference: util/execdetails, util/tracing, util/stmtsummary)."""
+
+from tidb_tpu.session.session import Domain, Session
+from tidb_tpu.utils.stmtsummary import normalize_sql
+
+
+def make_session():
+    s = Session(Domain())
+    s.execute("create table t (a bigint, b bigint)")
+    rows = ",".join(f"({i}, {i * 2})" for i in range(100))
+    s.execute(f"insert into t values {rows}")
+    return s
+
+
+def test_explain_analyze_reports_rows():
+    s = make_session()
+    res = s.execute("explain analyze select a, sum(b) from t "
+                    "where a < 50 group by a")
+    assert res.names == ["operator", "actRows", "time", "loops"]
+    # root operator produced 50 groups
+    assert res.rows[0][1] == 50
+    assert all(r[3] == 1 for r in res.rows if r[3] is not None)
+    assert any("CopTask" in r[0] for r in res.rows)
+
+
+def test_explain_analyze_join_tree():
+    s = make_session()
+    s.execute("create table u (a bigint, c bigint)")
+    s.execute("insert into u values (1, 10), (2, 20)")
+    res = s.execute(
+        "explain analyze select t.a, u.c from t join u on t.a = u.a")
+    assert res.rows[0][1] == 2          # two joined rows
+    assert len(res.rows) >= 2           # tree has children
+
+
+def test_trace_spans():
+    s = make_session()
+    res = s.execute("trace select count(*) from t")
+    names = [r[0].strip() for r in res.rows]
+    assert "session.ExecuteStmt" in names
+    assert "planner.Optimize" in names
+    assert "executor.Run" in names
+    # nested spans are indented under the root
+    assert res.rows[1][0].startswith("  ")
+    # durations are sane (root >= children)
+    root = res.rows[0][2]
+    assert all(root >= r[2] - 1e-6 for r in res.rows[1:])
+
+
+def test_statement_summary_aggregates():
+    s = make_session()
+    s.must_query("select count(*) from t where a < 10")
+    s.must_query("select count(*) from t where a < 99")
+    rows = s.must_query("show statements_summary")
+    by_digest = {r[0]: r for r in rows}
+    d = normalize_sql("select count(*) from t where a < 10")
+    assert d in by_digest
+    assert by_digest[d][1] == 2          # both executions share the digest
+
+
+def test_slow_query_log_threshold():
+    s = make_session()
+    s.domain.stmt_summary.slow_threshold_ms = 0.0   # everything is slow
+    s.must_query("select count(*) from t")
+    slow = s.must_query("show slow_queries")
+    assert any("count(*)" in r[0] for r in slow)
+
+
+def test_normalize_sql():
+    assert normalize_sql("SELECT * FROM t WHERE a = 5") == \
+        normalize_sql("select  *  from t where a = 123")
+    assert normalize_sql("select 'x' from t") == \
+        normalize_sql("select 'yy' from t")

@@ -50,6 +50,8 @@ class Domain:
         self.client = CopClient(self.mesh)
         self.kv = KVStore()          # native C++ MVCC row store
         self.stats = StatsHandle()   # pkg/statistics/handle analog
+        from ..utils.stmtsummary import StmtSummary
+        self.stmt_summary = StmtSummary()   # util/stmtsummary analog
         self._next_table_id = 100
         self.sysvars: dict[str, Any] = {
             "tidb_distsql_scan_concurrency": 15,
@@ -61,10 +63,27 @@ class Domain:
         self._next_table_id += 1
         return self._next_table_id
 
+    def register_session(self, sess) -> int:
+        """Connection registry for SHOW PROCESSLIST (server's
+        SessionManager analog)."""
+        import weakref
+        if not hasattr(self, "_sessions"):
+            self._sessions = weakref.WeakValueDictionary()
+            self._next_conn_id = 0
+        self._next_conn_id += 1
+        self._sessions[self._next_conn_id] = sess
+        return self._next_conn_id
+
+    def sessions(self):
+        if not hasattr(self, "_sessions"):
+            return []
+        return sorted(self._sessions.items())
+
 
 class Session:
     def __init__(self, domain: Optional[Domain] = None, db: str = "test"):
         self.domain = domain or Domain()
+        self.conn_id = self.domain.register_session(self)
         self.db = db
         self.vars: dict[str, Any] = {}
         self.txn = None              # active explicit transaction
@@ -75,7 +94,12 @@ class Session:
     def execute(self, sql: str) -> ResultSet:
         out = ResultSet()
         for stmt in parse_sql(sql):
+            t0 = time.perf_counter_ns()
             out = self._exec_stmt(stmt)
+            span = getattr(stmt, "text_span", None)
+            text = sql[span[0]:span[1]].strip() if span else sql
+            self.domain.stmt_summary.record(
+                text, time.perf_counter_ns() - t0, len(out.rows))
         return out
 
     def must_query(self, sql: str) -> list[tuple]:
@@ -89,6 +113,8 @@ class Session:
             return self._exec_select(stmt)
         if isinstance(stmt, A.Explain):
             return self._exec_explain(stmt)
+        if isinstance(stmt, A.TraceStmt):
+            return self._exec_trace(stmt)
         if isinstance(stmt, A.CreateTable):
             return self._exec_create_table(stmt)
         if isinstance(stmt, A.DropTable):
@@ -182,8 +208,36 @@ class Session:
         if not isinstance(stmt.stmt, (A.SelectStmt, A.SetOpStmt)):
             raise PlanError("EXPLAIN supports SELECT only")
         built, phys = self._plan_select(stmt.stmt)
+        if stmt.analyze:
+            from ..utils.execdetails import (RuntimeStatsColl,
+                                             explain_analyze_text,
+                                             instrument_tree)
+            coll = RuntimeStatsColl()
+            instrument_tree(phys, coll)
+            ctx = ExecContext(self.domain.client, self.domain.sysvars)
+            phys.execute(ctx)
+            return ResultSet(["operator", "actRows", "time", "loops"],
+                             explain_analyze_text(phys, coll))
         text = phys.explain()
         return ResultSet(["plan"], [(line,) for line in text.split("\n")])
+
+    def _exec_trace(self, stmt: A.TraceStmt) -> ResultSet:
+        """TRACE <stmt>: span tree of the statement's phases
+        (executor/trace.go analog)."""
+        from ..utils.tracing import Tracer
+        tracer = Tracer()
+        with tracer.region("session.ExecuteStmt"):
+            if isinstance(stmt.stmt, (A.SelectStmt, A.SetOpStmt)):
+                with tracer.region("planner.Optimize"):
+                    built, phys = self._plan_select(stmt.stmt)
+                with tracer.region("executor.Run"):
+                    ctx = ExecContext(self.domain.client, self.domain.sysvars)
+                    phys.execute(ctx)
+            else:
+                with tracer.region("executor.Run"):
+                    self._exec_stmt(stmt.stmt)
+        return ResultSet(["operation", "startTS_us", "duration_us"],
+                         tracer.rows())
 
     def _exec_txn(self, stmt: A.TxnStmt) -> ResultSet:
         """Explicit transactions over the native MVCC store.
@@ -436,6 +490,20 @@ class Session:
                  for ix in t.indexes])
         if stmt.kind in ("stats_meta", "stats_histograms", "stats_topn"):
             return self._exec_show_stats(stmt.kind)
+        if stmt.kind == "statements_summary":
+            return ResultSet(
+                ["Digest_text", "Exec_count", "Avg_latency_ms",
+                 "Max_latency_ms", "Sum_rows", "Sample_sql"],
+                self.domain.stmt_summary.summary_rows())
+        if stmt.kind == "slow_queries":
+            return ResultSet(["Query", "Latency_ms", "Rows"],
+                             self.domain.stmt_summary.slow_rows())
+        if stmt.kind == "processlist":
+            return ResultSet(
+                ["Id", "db", "Command", "State"],
+                [(sid, sess.db, "Sleep" if sess is not self else "Query",
+                  "autocommit" if sess.txn is None else "in transaction")
+                 for sid, sess in self.domain.sessions()])
         if stmt.kind == "variables":
             vs = {**self.domain.sysvars, **self.vars}
             return ResultSet(["Variable_name", "Value"],

@@ -290,6 +290,11 @@ class Explain(Node):
 
 
 @dataclass
+class TraceStmt(Node):
+    stmt: Node = None
+
+
+@dataclass
 class ShowStmt(Node):
     kind: str = ""                  # 'tables' | 'databases' | 'variables' | 'columns'
     target: Optional[str] = None

@@ -84,7 +84,11 @@ class Parser:
         while self.cur.kind != "eof":
             if self.accept_op(";"):
                 continue
-            stmts.append(self.statement())
+            start = self.cur.pos
+            node = self.statement()
+            end = self.cur.pos        # pos of ';' or eof token
+            node.text_span = (start, end)
+            stmts.append(node)
             if self.cur.kind != "eof":
                 self.expect_op(";")
         return stmts
@@ -92,6 +96,12 @@ class Parser:
     def statement(self) -> A.Node:
         if self.at_kw("SELECT", "WITH") or self.at_op("("):
             return self.select_query()
+        # TRACE is non-reserved (MySQL-compatible): match contextually,
+        # only when followed by a statement-starting keyword
+        if (self.cur.kind == "ident" and self.cur.text.upper() == "TRACE"
+                and self.toks[self.i + 1].kind == "kw"):
+            self.advance()
+            return A.TraceStmt(self.statement())
         if self.at_kw("EXPLAIN", "DESCRIBE"):
             self.advance()
             analyze = self.accept_kw("ANALYZE")
@@ -657,7 +667,8 @@ class Parser:
             self.expect_kw("FROM")
             return A.ShowStmt("index", self.ident())
         if self.cur.kind == "ident" and self.cur.text.upper() in (
-                "STATS_META", "STATS_HISTOGRAMS", "STATS_TOPN"):
+                "STATS_META", "STATS_HISTOGRAMS", "STATS_TOPN",
+                "STATEMENTS_SUMMARY", "SLOW_QUERIES", "PROCESSLIST"):
             kind = self.cur.text.lower()
             self.advance()
             return A.ShowStmt(kind)

@@ -1,0 +1,4 @@
+"""Shared utilities: runtime stats, tracing, statement summary, memory.
+
+Reference analog: pkg/util/{execdetails,tracing,stmtsummary,memory}.
+"""

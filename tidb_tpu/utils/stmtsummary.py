@@ -1,0 +1,94 @@
+"""Statement summary + slow query log.
+
+Reference analog: pkg/util/stmtsummary (per-digest aggregated workload
+stats behind information_schema.statements_summary) and the slow-query
+log (executor/adapter_slow_log.go, slow_query.go).  Digest = the SQL text
+with literals normalized out, like pkg/parser/digester.go.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+from dataclasses import dataclass, field
+
+_NUM = re.compile(r"\b\d+(?:\.\d+)?\b")
+_STR = re.compile(r"'(?:[^'\\]|\\.)*'")
+_WS = re.compile(r"\s+")
+_IN_LIST = re.compile(r"\(\s*\?(?:\s*,\s*\?)+\s*\)")
+
+
+def normalize_sql(sql: str) -> str:
+    """Literal-free normalized form (digester.go analog)."""
+    s = _STR.sub("?", sql)
+    s = _NUM.sub("?", s)
+    s = _WS.sub(" ", s).strip().lower()
+    s = _IN_LIST.sub("(...)", s)   # collapse IN/VALUES lists
+    return s
+
+
+@dataclass
+class StmtStats:
+    digest: str
+    sample_sql: str
+    exec_count: int = 0
+    sum_latency_ns: int = 0
+    max_latency_ns: int = 0
+    sum_rows: int = 0
+    first_seen: float = 0.0
+    last_seen: float = 0.0
+
+    @property
+    def avg_latency_ms(self) -> float:
+        return self.sum_latency_ns / max(self.exec_count, 1) / 1e6
+
+
+@dataclass
+class SlowQuery:
+    sql: str
+    latency_ms: float
+    ts: float
+    rows: int
+
+
+class StmtSummary:
+    """Per-Domain workload summary + slow log ring."""
+
+    def __init__(self, slow_threshold_ms: float = 300.0, max_slow: int = 256):
+        self._stats: dict[str, StmtStats] = {}
+        self._slow: list[SlowQuery] = []
+        self._lock = threading.Lock()
+        self.slow_threshold_ms = slow_threshold_ms
+        self.max_slow = max_slow
+
+    def record(self, sql: str, latency_ns: int, rows: int):
+        digest = normalize_sql(sql)
+        now = time.time()
+        with self._lock:
+            st = self._stats.get(digest)
+            if st is None:
+                st = StmtStats(digest, sql, first_seen=now)
+                self._stats[digest] = st
+            st.exec_count += 1
+            st.sum_latency_ns += latency_ns
+            st.max_latency_ns = max(st.max_latency_ns, latency_ns)
+            st.sum_rows += rows
+            st.last_seen = now
+            if latency_ns / 1e6 >= self.slow_threshold_ms:
+                self._slow.append(SlowQuery(sql, latency_ns / 1e6, now, rows))
+                if len(self._slow) > self.max_slow:
+                    self._slow.pop(0)
+
+    def summary_rows(self) -> list[tuple]:
+        with self._lock:
+            return [(s.digest, s.exec_count, round(s.avg_latency_ms, 3),
+                     round(s.max_latency_ns / 1e6, 3), s.sum_rows,
+                     s.sample_sql)
+                    for s in sorted(self._stats.values(),
+                                    key=lambda x: -x.sum_latency_ns)]
+
+    def slow_rows(self) -> list[tuple]:
+        with self._lock:
+            return [(q.sql, round(q.latency_ms, 3), q.rows)
+                    for q in self._slow]

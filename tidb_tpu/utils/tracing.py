@@ -1,0 +1,53 @@
+"""Intra-statement tracing: named regions -> span tree.
+
+Reference analog: pkg/util/tracing (StartRegionEx wrapping opentracing
+spans at every major phase — session.go:2114, adapter, copr) and the
+TRACE statement renderer (executor/trace.go).
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class Span:
+    name: str
+    start_ns: int
+    end_ns: int = 0
+    depth: int = 0
+
+    @property
+    def duration_us(self) -> float:
+        return (self.end_ns - self.start_ns) / 1e3
+
+
+class Tracer:
+    """Per-statement span collector.  Regions nest via a depth counter —
+    single-threaded statement execution, so no context propagation needed."""
+
+    def __init__(self):
+        self.spans: list[Span] = []
+        self._depth = 0
+        self._t0 = time.perf_counter_ns()
+
+    @contextmanager
+    def region(self, name: str):
+        sp = Span(name, time.perf_counter_ns(), depth=self._depth)
+        self.spans.append(sp)
+        self._depth += 1
+        try:
+            yield sp
+        finally:
+            self._depth -= 1
+            sp.end_ns = time.perf_counter_ns()
+
+    def rows(self) -> list[tuple]:
+        """(span, start_us_rel, duration_us) rows, indented by depth."""
+        return [("  " * sp.depth + sp.name,
+                 round((sp.start_ns - self._t0) / 1e3, 1),
+                 round(sp.duration_us, 1))
+                for sp in self.spans]
