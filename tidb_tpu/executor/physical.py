@@ -65,6 +65,33 @@ def _device_supported(e: Expr) -> bool:
 class ExecContext:
     client: Any            # store.CopClient
     sysvars: Any = None
+    mem_tracker: Any = None    # utils.memory.Tracker (statement root)
+    spills: int = 0            # spill events this statement
+
+    def track(self, nbytes: int):
+        """Charge bytes to the statement quota (may raise
+        MemoryExceededError through the tracker's action chain)."""
+        if self.mem_tracker is not None:
+            self.mem_tracker.consume(nbytes)
+
+    def release(self, nbytes: int):
+        """Return an operator's transient working-set charge (the
+        reference releases on executor Close)."""
+        if self.mem_tracker is not None:
+            self.mem_tracker.release(nbytes)
+
+    def remaining_quota(self):
+        """Bytes left before tidb_mem_quota_query, or None if unlimited."""
+        t = self.mem_tracker
+        if t is None or t.limit < 0:
+            return None
+        return max(t.limit - t.consumed, 0)
+
+    @property
+    def spill_enabled(self) -> bool:
+        from ..utils.memory import sysvar_bool
+        sv = self.sysvars or {}
+        return sysvar_bool(sv.get("tidb_enable_tmp_storage_on_oom"), True)
 
 
 @dataclass
@@ -131,6 +158,10 @@ class CopTaskExec(PhysOp):
             cols = ctx.client.execute_rows(self.dag, snap,
                                            tuple(self.out_dtypes),
                                            self.out_dicts)
+        # NOTE: scan output is NOT charged to the statement quota — the
+        # columns are the device-resident data plane (HBM residency is the
+        # TPU analog of the reference's paging, SURVEY.md §5.7); the quota
+        # governs host-side operator working memory.
         return ResultChunk(list(self.out_names), cols)
 
 
@@ -372,7 +403,22 @@ class HostSort(PhysOp):
     def execute(self, ctx):
         chunk = self.child.execute(ctx)
         ranks = _sort_keys_matrix(chunk, self.keys)
-        idx = np.lexsort(tuple(reversed(ranks))) if ranks else np.arange(chunk.num_rows)
+        if not ranks:
+            return chunk
+        n = chunk.num_rows
+        extra = sum(r.nbytes for r in ranks) + 8 * n
+        remaining = ctx.remaining_quota()
+        if (remaining is not None and extra > remaining
+                and ctx.spill_enabled and n > 1):
+            # external sort: bounded blocks, disk runs, k-way merge
+            from ..utils.rowcontainer import external_sort_index, spill_dir
+            ctx.spills += 1
+            with spill_dir() as d:
+                idx = external_sort_index(ranks, d, max(n // 8, 1024))
+        else:
+            ctx.track(extra)
+            idx = np.lexsort(tuple(reversed(ranks)))
+            ctx.release(extra)
         return ResultChunk(chunk.names, [c.take(idx) for c in chunk.columns])
 
 
@@ -415,6 +461,65 @@ class HostHashJoin(PhysOp):
     def execute(self, ctx):
         lc = self.left.execute(ctx)
         rc = self.right.execute(ctx)
+        if self.eq_keys and min(lc.num_rows, rc.num_rows) > 1:
+            remaining = ctx.remaining_quota()
+            from ..utils.memory import nbytes_of
+            extra = nbytes_of(lc.columns) + nbytes_of(rc.columns)
+            if (remaining is not None and extra > remaining
+                    and ctx.spill_enabled):
+                return self._execute_spilled(ctx, lc, rc)
+            ctx.track(extra)
+            try:
+                return self._join(lc, rc)
+            finally:
+                ctx.release(extra)
+        return self._join(lc, rc)
+
+    def _execute_spilled(self, ctx, lc, rc):
+        """hash_join_spill.go analog: partition both sides by join-key
+        hash to disk; equal keys meet in the same partition, so the join
+        is the concatenation of P independent sub-joins."""
+        from ..utils.rowcontainer import partition_to_disk, spill_dir
+        ctx.spills += 1
+        P = 8
+
+        def part_of(keys):
+            h = np.zeros(len(keys[0]), np.uint64)
+            for k in keys:
+                h = h * np.uint64(0x9E3779B97F4A7C15) + k.astype(np.uint64)
+            return (h % np.uint64(P)).astype(np.int64)
+
+        lkeys, rkeys = self._key_arrays(lc, rc)
+        lpart, rpart = part_of(lkeys), part_of(rkeys)
+        pieces = []
+        with spill_dir() as d:
+            lps = partition_to_disk(lc.columns, lpart, P, d, "jl")
+            rps = partition_to_disk(rc.columns, rpart, P, d, "jr")
+            for p in range(P):
+                # inner joins skip one-sided partitions; outer joins must
+                # keep the preserved side's unmatched rows
+                if lps[p] is None and rps[p] is None:
+                    continue
+                if lps[p] is None and self.kind != "right":
+                    continue
+                if rps[p] is None and self.kind != "left":
+                    continue
+                lcols = lps[p].read() if lps[p] is not None else \
+                    [c.slice(0, 0) for c in lc.columns]
+                rcols = rps[p].read() if rps[p] is not None else \
+                    [c.slice(0, 0) for c in rc.columns]
+                pieces.append(self._join(ResultChunk(lc.names, lcols),
+                                         ResultChunk(rc.names, rcols)))
+        if not pieces:
+            return self._join(ResultChunk(lc.names,
+                                          [c.slice(0, 0) for c in lc.columns]),
+                              ResultChunk(rc.names,
+                                          [c.slice(0, 0) for c in rc.columns]))
+        out = [Column.concat([p.columns[i] for p in pieces])
+               for i in range(len(pieces[0].columns))]
+        return ResultChunk(pieces[0].names, out)
+
+    def _join(self, lc, rc):
         nl, nr = lc.num_rows, rc.num_rows
         li, ri = self._match_pairs(lc, rc)
         if self.other_conds:
@@ -445,19 +550,25 @@ class HostHashJoin(PhysOp):
                  if self.kind == "left" else [c.take(ri) for c in rc.columns])
         return ResultChunk(lc.names + rc.names, lcols + rcols)
 
+    def _key_arrays(self, lc: ResultChunk, rc: ResultChunk):
+        lkeys, rkeys = [], []
+        for lk, rk in self.eq_keys:
+            a, b = _join_key_arrays(lc.columns[lk], rc.columns[rk])
+            lkeys.append(a)
+            rkeys.append(b)
+        return lkeys, rkeys
+
+    def _packed_keys(self, lc: ResultChunk, rc: ResultChunk):
+        lkeys, rkeys = self._key_arrays(lc, rc)
+        return _pack_rows(lkeys), _pack_rows(rkeys)
+
     def _match_pairs(self, lc: ResultChunk, rc: ResultChunk):
         """All key-equal candidate pairs (no outer extension)."""
         nl, nr = lc.num_rows, rc.num_rows
         if not self.eq_keys:  # cartesian
             return (np.repeat(np.arange(nl), nr),
                     np.tile(np.arange(nr), nl))
-        lkeys, rkeys = [], []
-        for lk, rk in self.eq_keys:
-            a, b = _join_key_arrays(lc.columns[lk], rc.columns[rk])
-            lkeys.append(a)
-            rkeys.append(b)
-        lpack = _pack_rows(lkeys)
-        rpack = _pack_rows(rkeys)
+        lpack, rpack = self._packed_keys(lc, rc)
         # build on right, probe left (numpy sort-merge on packed keys)
         order = np.argsort(rpack, kind="stable")
         rsorted = rpack[order]
@@ -557,7 +668,51 @@ class HostAgg(PhysOp):
     def execute(self, ctx):
         chunk = self.child.execute(ctx)
         n = chunk.num_rows
-        pairs = chunk.col_pairs()
+        if self.group_exprs and n > 1:
+            remaining = ctx.remaining_quota()
+            # group-by working set ~ packed keys + inverse + outputs
+            extra = n * 8 * (2 * len(self.group_exprs) + 2)
+            if (remaining is not None and extra > remaining
+                    and ctx.spill_enabled):
+                return self._execute_spilled(ctx, chunk)
+            ctx.track(extra)
+            try:
+                return self._agg_chunk(chunk)
+            finally:
+                ctx.release(extra)
+        return self._agg_chunk(chunk)
+
+    def _execute_spilled(self, ctx, chunk):
+        """agg_spill.go analog: hash-partition rows by group key to disk,
+        aggregate each partition independently, concatenate results —
+        peak memory = 1/P of the input's group working set."""
+        from ..utils.rowcontainer import partition_to_disk, spill_dir
+        ctx.spills += 1
+        P = 8
+        gcols = [_eval_to_column(g, chunk) for g in self.group_exprs]
+        h = np.zeros(chunk.num_rows, np.uint64)
+        for c in gcols:
+            v = np.where(c.validity, c.data.astype(np.int64),
+                         np.iinfo(np.int64).min).astype(np.uint64)
+            h = h * np.uint64(0x9E3779B97F4A7C15) + v
+        part_of = (h % np.uint64(P)).astype(np.int64)
+        pieces = []
+        with spill_dir() as d:
+            parts = partition_to_disk(chunk.columns, part_of, P, d, "agg")
+            for sp in parts:
+                if sp is None:
+                    continue
+                sub = ResultChunk(chunk.names, sp.read())
+                sp.delete()
+                pieces.append(self._agg_chunk(sub))
+        if not pieces:
+            return self._agg_chunk(chunk)     # all-empty: fall through
+        out_cols = [Column.concat([p.columns[i] for p in pieces])
+                    for i in range(len(pieces[0].columns))]
+        return ResultChunk(list(self.out_names), out_cols)
+
+    def _agg_chunk(self, chunk):
+        n = chunk.num_rows
         gcols = [_eval_to_column(g, chunk) for g in self.group_exprs]
         if gcols:
             mats = []

@@ -195,9 +195,20 @@ class Session:
                 if self.domain.stats.needs_auto_analyze(p.table):
                     self.domain.stats.analyze_table(p.table)
 
+    def _exec_ctx(self) -> ExecContext:
+        """Statement-scoped execution context with a fresh memory tracker
+        rooted at tidb_mem_quota_query (util/memory Tracker analog)."""
+        from ..utils.memory import Tracker
+        merged = {**self.domain.sysvars, **self.vars}
+        quota = int(merged.get("tidb_mem_quota_query", 1 << 30))
+        if quota <= 0:
+            quota = -1       # TiDB semantics: 0/negative = unlimited
+        return ExecContext(self.domain.client, merged,
+                           mem_tracker=Tracker("query", quota))
+
     def _exec_select(self, stmt) -> ResultSet:
         built, phys = self._plan_select(stmt)
-        ctx = ExecContext(self.domain.client, self.domain.sysvars)
+        ctx = self._exec_ctx()
         chunk = phys.execute(ctx)
         n_out = len(built.output_names)
         cols = chunk.columns[:n_out]  # trim hidden ORDER BY columns
@@ -214,7 +225,7 @@ class Session:
                                              instrument_tree)
             coll = RuntimeStatsColl()
             instrument_tree(phys, coll)
-            ctx = ExecContext(self.domain.client, self.domain.sysvars)
+            ctx = self._exec_ctx()
             phys.execute(ctx)
             return ResultSet(["operator", "actRows", "time", "loops"],
                              explain_analyze_text(phys, coll))
@@ -231,7 +242,7 @@ class Session:
                 with tracer.region("planner.Optimize"):
                     built, phys = self._plan_select(stmt.stmt)
                 with tracer.region("executor.Run"):
-                    ctx = ExecContext(self.domain.client, self.domain.sysvars)
+                    ctx = self._exec_ctx()
                     phys.execute(ctx)
             else:
                 with tracer.region("executor.Run"):

@@ -691,7 +691,16 @@ class Parser:
             name = self.ident()
             if not self.accept_op("=") and not self.accept_op(":="):
                 raise ParseError("expected =", self.cur)
-            st.assignments.append((name, self.expr()))
+            # MySQL boolean sysvar forms: ON/OFF are keywords, not exprs
+            if self.at_kw("ON"):
+                self.advance()
+                st.assignments.append((name, A.Lit(1, "int")))
+            elif (self.cur.kind == "ident"
+                  and self.cur.text.upper() == "OFF"):
+                self.advance()
+                st.assignments.append((name, A.Lit(0, "int")))
+            else:
+                st.assignments.append((name, self.expr()))
             if not self.accept_op(","):
                 break
         return st

@@ -1,0 +1,116 @@
+"""Disk-backed chunk container + external (spilling) algorithms.
+
+Reference analog: pkg/util/chunk/row_container.go + chunk_in_disk.go (the
+spill containers) and the per-operator spill paths (sortexec
+parallel_sort_spill_helper.go, aggregate/agg_spill.go,
+join/hash_join_spill.go) — SURVEY.md §5.7.  Partitions are written as
+compressed .npz files (dense numpy buffers — the same buffers the device
+path zero-copies, so spill/restore is cheap and exact).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from dataclasses import dataclass
+
+import numpy as np
+
+
+class SpilledPartition:
+    """One partition of columns on disk."""
+
+    def __init__(self, path: str, dtypes, dictionaries):
+        self.path = path
+        self.dtypes = dtypes
+        self.dictionaries = dictionaries
+
+    @classmethod
+    def write(cls, tmpdir: str, tag: str, columns) -> "SpilledPartition":
+        arrays = {}
+        for i, c in enumerate(columns):
+            arrays[f"d{i}"] = c.data
+            arrays[f"v{i}"] = c.validity
+        path = os.path.join(tmpdir, f"{tag}.npz")
+        np.savez(path, **arrays)
+        return cls(path, [c.dtype for c in columns],
+                   [c.dictionary for c in columns])
+
+    def read(self):
+        from ..chunk.column import Column
+        with np.load(self.path, allow_pickle=False) as z:
+            return [Column(t, z[f"d{i}"], z[f"v{i}"], d)
+                    for i, (t, d) in enumerate(zip(self.dtypes,
+                                                   self.dictionaries))]
+
+    def delete(self):
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+def partition_to_disk(columns, part_of: np.ndarray, n_parts: int,
+                      tmpdir: str, tag: str):
+    """Split rows by partition id, spill each partition; returns the list
+    of SpilledPartitions (empty partitions omitted, index kept)."""
+    parts = []
+    for p in range(n_parts):
+        idx = np.nonzero(part_of == p)[0]
+        if len(idx) == 0:
+            parts.append(None)
+            continue
+        parts.append(SpilledPartition.write(
+            tmpdir, f"{tag}-{p}", [c.take(idx) for c in columns]))
+    return parts
+
+
+def external_sort_index(ranks, tmpdir: str, block_rows: int) -> np.ndarray:
+    """Row order for lexsort(ranks) computed run-at-a-time: each block is
+    sorted independently (bounded working set) and spilled as raw .npy
+    files; the k-way merge streams the runs back through memory-mapped
+    views, so peak RAM stays O(block) + the output index, never the full
+    rank matrix (sortexec/multi_way_merge.go analog)."""
+    n = len(ranks[0]) if ranks else 0
+    if n == 0:
+        return np.arange(0)
+    nk = len(ranks)
+    runs = []     # list of per-run dirs holding k0..k{nk-1}.npy + idx.npy
+    for start in range(0, n, block_rows):
+        sl = slice(start, min(start + block_rows, n))
+        blk = [r[sl] for r in ranks]
+        order = np.lexsort(tuple(reversed(blk)))
+        rd = os.path.join(tmpdir, f"run-{len(runs)}")
+        os.makedirs(rd)
+        for i, k in enumerate(blk):
+            np.save(os.path.join(rd, f"k{i}.npy"), k[order])
+        np.save(os.path.join(rd, "idx.npy"),
+                np.arange(sl.start, sl.stop, dtype=np.int64)[order])
+        runs.append(rd)
+    if len(runs) == 1:
+        return np.load(os.path.join(runs[0], "idx.npy"))
+    # k-way merge over memmapped runs (OS pages blocks in and out)
+    import heapq
+    keys = [[np.load(os.path.join(rd, f"k{i}.npy"), mmap_mode="r")
+             for i in range(nk)] for rd in runs]
+    idxs = [np.load(os.path.join(rd, "idx.npy"), mmap_mode="r")
+            for rd in runs]
+    heap = [(tuple(k[0].item() for k in keys[r]), r)
+            for r in range(len(runs)) if len(idxs[r])]
+    heapq.heapify(heap)
+    out = np.empty(n, np.int64)
+    pos = [0] * len(runs)
+    w = 0
+    while heap:
+        _, r = heapq.heappop(heap)
+        out[w] = idxs[r][pos[r]]
+        w += 1
+        pos[r] += 1
+        if pos[r] < len(idxs[r]):
+            heapq.heappush(
+                heap, (tuple(k[pos[r]].item() for k in keys[r]), r))
+    return out
+
+
+def spill_dir() -> tempfile.TemporaryDirectory:
+    return tempfile.TemporaryDirectory(prefix="tidb-tpu-spill-")
