@@ -134,12 +134,14 @@ def _finalize_one(a: D.AggDesc, st: dict) -> Column:
     if a.func == D.AggFunc.SUM:
         if "hi" in st:  # decimal limbs
             total = (st["hi"].astype(object) << 32) + st["lo"].astype(object)
-            _check_decimal_range(total)
-            data = np.where(valid, total, 0).astype(np.int64)
+            data = np.where(valid, total, 0)
         else:
             data = np.where(valid, st["sum"], 0)
-            if data.dtype == object and out_t.kind != K.FLOAT64:
-                _check_decimal_range(data)
+        if out_t.kind != K.FLOAT64:
+            _check_decimal_range(data, out_t.prec)
+        if out_t.np_dtype() == object:
+            data = np.array([int(x) for x in data], dtype=object)
+        else:
             data = data.astype(out_t.np_dtype())
         return Column(out_t, data, valid)
     if a.func in (D.AggFunc.MIN, D.AggFunc.MAX):
@@ -149,23 +151,28 @@ def _finalize_one(a: D.AggDesc, st: dict) -> Column:
     raise NotImplementedError(a.func)
 
 
-def _check_decimal_range(total: np.ndarray) -> None:
-    # decimal64 holds at most DECIMAL64_MAX_PRECISION (18) digits; MySQL
-    # raises ER_DATA_OUT_OF_RANGE on decimal overflow
-    lim = 10 ** dt.DECIMAL64_MAX_PRECISION
+def _check_decimal_range(total: np.ndarray, prec: int) -> None:
+    # MySQL raises ER_DATA_OUT_OF_RANGE when a decimal result exceeds its
+    # declared precision (mydecimal.go overflow)
+    if prec <= 0:
+        prec = dt.DECIMAL_MAX_PRECISION
+    lim = 10 ** prec
     bad = [int(t) for t in np.asarray(total).reshape(-1) if abs(int(t)) >= lim]
     if bad:
         raise OverflowError(
-            f"DECIMAL sum out of range (> {dt.DECIMAL64_MAX_PRECISION} digits): {bad[0]}")
+            f"DECIMAL sum out of range (> {prec} digits): {bad[0]}")
 
 
 def sum_out_dtype(arg_t: dt.DataType) -> dt.DataType:
-    """MySQL result type of SUM(arg) bounded to decimal64."""
+    """MySQL result type of SUM(arg): decimals widen by 22 digits
+    (reference: expression/aggregation typeinfer, DECIMAL(min(p+22,65),s))
+    bounded to the 38-digit exact limb representation."""
     if arg_t.kind == K.DECIMAL:
-        return dt.decimal(dt.DECIMAL64_MAX_PRECISION, arg_t.scale)
+        p = arg_t.prec if arg_t.prec > 0 else dt.DECIMAL64_MAX_PRECISION
+        return dt.decimal_wide(p + 22, arg_t.scale)
     if arg_t.kind in (K.FLOAT32, K.FLOAT64):
         return dt.double()
-    return dt.decimal(dt.DECIMAL64_MAX_PRECISION, 0)  # SUM(int) -> DECIMAL(x,0)
+    return dt.decimal_wide(dt.DECIMAL_MAX_PRECISION, 0)  # SUM(int)
 
 
 __all__ = ["GroupKeyMeta", "merge_states", "finalize", "sum_out_dtype"]

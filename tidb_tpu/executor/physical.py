@@ -375,6 +375,12 @@ def _sort_keys_matrix(chunk: ResultChunk, keys) -> list[np.ndarray]:
         if v.dtype == np.float64 or v.dtype == np.float32:
             rank = v.astype(np.float64)
             nullv = -np.inf
+        elif v.dtype == object:
+            # wide-decimal values: exact dense ranks via python-int sort
+            # (values may exceed int64)
+            uniq = {x: i for i, x in enumerate(sorted({int(x) for x in v}))}
+            rank = np.array([uniq[int(x)] for x in v], dtype=np.int64)
+            nullv = np.iinfo(np.int64).min
         else:
             rank = v.astype(np.int64)
             nullv = np.iinfo(np.int64).min
@@ -784,7 +790,9 @@ class HostAgg(PhysOp):
 
 
 def _sum_col(a: AggItem, out_obj: np.ndarray, cnt: np.ndarray) -> Column:
-    vals = np.array([int(x) for x in out_obj], dtype=np.int64)
+    wide = a.out_dtype.np_dtype() == object
+    vals = np.array([int(x) for x in out_obj],
+                    dtype=object if wide else np.int64)
     return Column(a.out_dtype, vals, cnt > 0)
 
 

@@ -82,13 +82,18 @@ def _arith_result_type(op: str, a: dt.DataType, b: dt.DataType) -> dt.DataType:
     scalar-op overflow detection is a TODO — the benchmark schemas stay well
     inside 18 digits)."""
     nullable = a.nullable or b.nullable or op in ("div", "intdiv", "mod")
+    # arithmetic over a wide (aggregation-result) decimal stays wide: the
+    # host object-int representation is exact past 18 digits
+    wide = (a.kind == K.DECIMAL and a.prec > dt.DECIMAL64_MAX_PRECISION) or \
+           (b.kind == K.DECIMAL and b.prec > dt.DECIMAL64_MAX_PRECISION)
+    mk = dt.decimal_wide if wide else dt.decimal
+    cap = dt.DECIMAL_MAX_PRECISION if wide else dt.DECIMAL64_MAX_PRECISION
     if op == "div":
         # MySQL `/`: decimal out if both exact, else double
         if (a.kind in (K.INT64, K.UINT64, K.DECIMAL)
                 and b.kind in (K.INT64, K.UINT64, K.DECIMAL)):
             _, sa = _dec_ps(a)
-            return dt.decimal(dt.DECIMAL64_MAX_PRECISION,
-                              min(sa + dt.DIV_FRAC_INCR, 12), nullable)
+            return mk(cap, min(sa + dt.DIV_FRAC_INCR, 12), nullable)
         return dt.double(nullable)
     if op == "intdiv":
         return dt.bigint(nullable)
@@ -100,9 +105,9 @@ def _arith_result_type(op: str, a: dt.DataType, b: dt.DataType) -> dt.DataType:
         else:
             scale = max(sa, sb)
             prec = max(pa - sa, pb - sb) + 1 + scale
-        prec = min(prec, dt.DECIMAL64_MAX_PRECISION)
+        prec = min(prec, cap)
         scale = min(scale, prec)
-        return dt.decimal(prec, scale, nullable)
+        return mk(prec, scale, nullable)
     return t.with_nullable(nullable)
 
 

@@ -56,6 +56,12 @@ DIV_FRAC_INCR = 4
 # Max decimal digits representable in the scaled-int64 encoding.
 DECIMAL64_MAX_PRECISION = 18
 
+# Max digits of a "wide" decimal (host-side aggregation results).  Mirrors
+# the reference's SUM result widening (expression/aggregation: SUM over
+# DECIMAL(p,s) -> DECIMAL(min(p+22,65),s), mydecimal.go) bounded to 38 so
+# the exact value always fits the device's two-int64-limb partial states.
+DECIMAL_MAX_PRECISION = 38
+
 
 @dataclass(frozen=True)
 class DataType:
@@ -95,6 +101,11 @@ class DataType:
 
     def np_dtype(self) -> np.dtype:
         """numpy dtype of the dense host/device representation."""
+        if (self.kind == TypeKind.DECIMAL
+                and self.prec > DECIMAL64_MAX_PRECISION):
+            # wide decimal: host-only representation as python ints (exact);
+            # never shipped to device — produced by aggregation finalize
+            return np.dtype(object)
         return np.dtype(_NP_DTYPES[self.kind])
 
     def with_nullable(self, nullable: bool) -> "DataType":
@@ -137,6 +148,13 @@ def double(nullable: bool = True) -> DataType:
 def decimal(prec: int, scale: int, nullable: bool = True) -> DataType:
     if prec > DECIMAL64_MAX_PRECISION:
         prec = DECIMAL64_MAX_PRECISION
+    return DataType(TypeKind.DECIMAL, nullable, prec=prec, scale=scale)
+
+
+def decimal_wide(prec: int, scale: int, nullable: bool = True) -> DataType:
+    """Aggregation-result decimal, up to 38 digits (object-backed on host)."""
+    if prec > DECIMAL_MAX_PRECISION:
+        prec = DECIMAL_MAX_PRECISION
     return DataType(TypeKind.DECIMAL, nullable, prec=prec, scale=scale)
 
 
@@ -196,6 +214,7 @@ def common_numeric_type(a: DataType, b: DataType) -> DataType:
 
 __all__ = [
     "TypeKind", "DataType", "DIV_FRAC_INCR", "DECIMAL64_MAX_PRECISION",
-    "bigint", "ubigint", "double", "decimal", "varchar", "date", "datetime",
-    "time", "null_type", "common_numeric_type",
+    "DECIMAL_MAX_PRECISION", "bigint", "ubigint", "double", "decimal",
+    "decimal_wide", "varchar", "date", "datetime", "time", "null_type",
+    "common_numeric_type",
 ]
