@@ -61,6 +61,11 @@ def np_q6(cols, ix):
 def main():
     import jax
 
+    # honor JAX_PLATFORMS even when a sitecustomize imported jax at boot
+    # (env alone is too late then; config.update still wins pre-compute)
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
     platform = jax.devices()[0].platform
     sf = float(os.environ.get("BENCH_SF", "10" if platform != "cpu" else "0.1"))
     iters = int(os.environ.get("BENCH_ITERS", "5"))

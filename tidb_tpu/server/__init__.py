@@ -1,0 +1,4 @@
+from .mysql_server import MySQLServer
+from .status import StatusServer
+
+__all__ = ["MySQLServer", "StatusServer"]

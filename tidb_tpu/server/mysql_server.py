@@ -1,0 +1,410 @@
+"""MySQL wire protocol server.
+
+Reference analog: pkg/server — Server.Run accept loop (server.go),
+clientConn.Run dispatch loop (conn.go:1048,:1289), prepared statements
+(conn_stmt.go).  One thread per connection (the goroutine-per-conn
+analog), all connections sharing one Domain; each gets its own Session.
+
+Supports: handshake v10 + mysql_native_password auth, COM_QUERY (text
+resultsets, multi-statement), COM_INIT_DB, COM_PING, COM_FIELD_LIST,
+COM_STMT_PREPARE/EXECUTE/RESET/CLOSE (binary protocol), graceful
+shutdown draining live connections.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..session.session import Domain, Session
+from . import packet as P
+
+SERVER_VERSION = "8.0.11-tidb-tpu-0.1"
+
+ER_ACCESS_DENIED = 1045
+ER_UNKNOWN = 1105
+ER_PARSE = 1064
+ER_DUP_ENTRY = 1062
+
+
+class PacketIO:
+    """Length-prefixed packet framing with sequence ids (conn.go
+    readPacket/writePacket analog)."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.seq = 0
+
+    def read(self) -> bytes:
+        header = self._read_n(4)
+        length = int.from_bytes(header[:3], "little")
+        self.seq = (header[3] + 1) & 0xFF
+        payload = self._read_n(length)
+        while length == 0xFFFFFF:  # multi-packet payload
+            header = self._read_n(4)
+            length = int.from_bytes(header[:3], "little")
+            self.seq = (header[3] + 1) & 0xFF
+            payload += self._read_n(length)
+        return payload
+
+    def write(self, payload: bytes):
+        data = payload
+        while True:
+            chunk, data = data[:0xFFFFFF], data[0xFFFFFF:]
+            self.sock.sendall(len(chunk).to_bytes(3, "little")
+                              + bytes([self.seq]) + chunk)
+            self.seq = (self.seq + 1) & 0xFF
+            if len(chunk) < 0xFFFFFF:
+                break
+
+    def reset_seq(self):
+        self.seq = 0
+
+    def _read_n(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            got = self.sock.recv(n - len(buf))
+            if not got:
+                raise ConnectionError("client closed")
+            buf += got
+        return buf
+
+
+@dataclass
+class PreparedStmt:
+    stmt_id: int
+    sql: str
+    n_params: int
+    param_types: Optional[list] = None
+
+
+class ClientConn:
+    """One connection: auth handshake then the dispatch loop."""
+
+    def __init__(self, server: "MySQLServer", sock: socket.socket):
+        self.server = server
+        self.io = PacketIO(sock)
+        self.sock = sock
+        self.session = Session(server.domain)
+        self.stmts: dict[int, PreparedStmt] = {}
+        self._next_stmt_id = 0
+        self.user = ""
+
+    # -------------------------------------------------------------- #
+
+    def run(self):
+        try:
+            if not self._handshake():
+                return
+            while not self.server._closing:
+                self.io.reset_seq()
+                try:
+                    payload = self.io.read()
+                except ConnectionError:
+                    return
+                if not payload:
+                    continue
+                cmd, body = payload[0], payload[1:]
+                if cmd == P.COM_QUIT:
+                    return
+                try:
+                    self._dispatch(cmd, body)
+                except ConnectionError:
+                    return
+                except Exception as e:  # statement errors -> ERR packet
+                    self.io.write(P.err_packet(_errno_for(e), str(e)))
+        finally:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            self.server._conn_done(self)
+
+    def _handshake(self) -> bool:
+        salt = os.urandom(20).replace(b"\x00", b"\x01")
+        self.io.write(P.handshake_v10(self.session.conn_id, salt,
+                                      SERVER_VERSION))
+        resp = P.parse_handshake_response(self.io.read())
+        self.user = resp["user"]
+        ok, err = self.server.authenticate(resp["user"], resp["auth"], salt)
+        if not ok:
+            self.io.write(P.err_packet(
+                ER_ACCESS_DENIED,
+                err or f"Access denied for user '{resp['user']}'",
+                "28000"))
+            return False
+        if resp["db"]:
+            try:
+                self.session.execute(f"USE {resp['db']}")
+            except Exception as e:
+                self.io.write(P.err_packet(ER_UNKNOWN, str(e)))
+                return False
+        self.session.user = resp["user"]
+        self.io.write(P.ok_packet(status=self._status()))
+        return True
+
+    def _status(self) -> int:
+        st = P.SERVER_STATUS_AUTOCOMMIT
+        if self.session.txn is not None:
+            st |= P.SERVER_STATUS_IN_TRANS
+        return st
+
+    # -------------------------------------------------------------- #
+
+    def _dispatch(self, cmd: int, body: bytes):
+        if cmd == P.COM_PING:
+            self.io.write(P.ok_packet(status=self._status()))
+        elif cmd == P.COM_INIT_DB:
+            self.session.execute(f"USE {body.decode()}")
+            self.io.write(P.ok_packet(status=self._status()))
+        elif cmd == P.COM_QUERY:
+            self._handle_query(body.decode())
+        elif cmd == P.COM_FIELD_LIST:
+            self._handle_field_list(body)
+        elif cmd == P.COM_STMT_PREPARE:
+            self._handle_stmt_prepare(body.decode())
+        elif cmd == P.COM_STMT_EXECUTE:
+            self._handle_stmt_execute(body)
+        elif cmd == P.COM_STMT_RESET:
+            self.io.write(P.ok_packet(status=self._status()))
+        elif cmd == P.COM_STMT_CLOSE:
+            self.stmts.pop(struct.unpack_from("<I", body, 0)[0], None)
+            # COM_STMT_CLOSE sends no response
+        else:
+            self.io.write(P.err_packet(ER_UNKNOWN,
+                                       f"unsupported command {cmd:#x}"))
+
+    def _handle_query(self, sql: str):
+        rs = self.session.execute(sql)
+        if rs.names:
+            self._write_resultset(rs, binary=False)
+        else:
+            self.io.write(P.ok_packet(rs.affected, rs.last_insert_id,
+                                      status=self._status()))
+
+    def _handle_field_list(self, body: bytes):
+        table = body.split(b"\x00", 1)[0].decode()
+        tbl = self.session.domain.catalog.get_table(self.session.db, table)
+        for name, t in zip(tbl.col_names, tbl.col_types):
+            self.io.write(P.column_def(name, t, self.session.db, table))
+        self.io.write(P.eof_packet(self._status()))
+
+    def _write_resultset(self, rs, binary: bool):
+        dtypes = rs.dtypes or [None] * len(rs.names)
+        self.io.write(P.put_lenenc_int(len(rs.names)))
+        for name, t in zip(rs.names, dtypes):
+            self.io.write(P.column_def(name, t, self.session.db))
+        self.io.write(P.eof_packet(self._status()))
+        for row in rs.rows:
+            self.io.write(P.binary_row(row, dtypes) if binary
+                          else P.text_row(row))
+        self.io.write(P.eof_packet(self._status()))
+
+    # ---------------- prepared statements ---------------- #
+
+    def _handle_stmt_prepare(self, sql: str):
+        from ..sql.parser import parse_sql
+        parse_sql(_strip_placeholders(sql))  # syntax check at prepare time
+        n_params = _count_placeholders(sql)
+        self._next_stmt_id += 1
+        st = PreparedStmt(self._next_stmt_id, sql, n_params)
+        self.stmts[st.stmt_id] = st
+        head = (b"\x00" + struct.pack("<I", st.stmt_id)
+                + struct.pack("<H", 0)            # column count (deferred)
+                + struct.pack("<H", n_params)
+                + b"\x00" + struct.pack("<H", 0))
+        self.io.write(head)
+        if n_params:
+            for i in range(n_params):
+                self.io.write(P.column_def(f"?{i}", None))
+            self.io.write(P.eof_packet(self._status()))
+
+    def _handle_stmt_execute(self, body: bytes):
+        stmt_id = struct.unpack_from("<I", body, 0)[0]
+        st = self.stmts.get(stmt_id)
+        if st is None:
+            self.io.write(P.err_packet(ER_UNKNOWN, "unknown statement"))
+            return
+        pos = 4 + 1 + 4  # stmt id, flags, iteration count
+        params, st.param_types = P.parse_binary_params(
+            body, pos, st.n_params, st.param_types)
+        sql = _bind_placeholders(st.sql, params)
+        rs = self.session.execute(sql)
+        if rs.names:
+            self._write_resultset(rs, binary=True)
+        else:
+            self.io.write(P.ok_packet(rs.affected, rs.last_insert_id,
+                                      status=self._status()))
+
+
+def _errno_for(e: Exception) -> int:
+    name = type(e).__name__
+    if "Duplicate" in name or "Duplicate entry" in str(e):
+        return ER_DUP_ENTRY
+    if "Parse" in name:
+        return ER_PARSE
+    return ER_UNKNOWN
+
+
+def _count_placeholders(sql: str) -> int:
+    return sum(1 for ch, in_s in _scan_sql(sql) if ch == "?" and not in_s)
+
+
+def _strip_placeholders(sql: str) -> str:
+    out = []
+    for ch, in_s in _scan_sql(sql):
+        out.append("0" if ch == "?" and not in_s else ch)
+    return "".join(out)
+
+
+def _bind_placeholders(sql: str, params: list) -> str:
+    out = []
+    it = iter(params)
+    for ch, in_s in _scan_sql(sql):
+        if ch == "?" and not in_s:
+            out.append(_sql_literal(next(it)))
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+def _scan_sql(sql: str):
+    """Yield (char, masked) where masked chars are inside string literals,
+    backtick identifiers, or comments — a '?' there is not a placeholder
+    (mirrors the lexer's string/comment handling)."""
+    i, n = 0, len(sql)
+    while i < n:
+        ch = sql[i]
+        if ch in ("'", '"', "`"):
+            quote = ch
+            yield ch, True
+            i += 1
+            while i < n:
+                yield sql[i], True
+                if sql[i] == "\\" and quote != "`" and i + 1 < n:
+                    i += 1
+                    yield sql[i], True
+                elif sql[i] == quote:
+                    i += 1
+                    break
+                i += 1
+            continue
+        if ch == "#" or (ch == "-" and sql[i:i + 2] == "--"):
+            while i < n and sql[i] != "\n":
+                yield sql[i], True
+                i += 1
+            continue
+        if ch == "/" and sql[i:i + 2] == "/*":
+            end = sql.find("*/", i + 2)
+            end = n if end < 0 else end + 2
+            while i < end:
+                yield sql[i], True
+                i += 1
+            continue
+        yield ch, False
+        i += 1
+
+
+def _sql_literal(v) -> str:
+    if v is None:
+        return "NULL"
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, (int, float)):
+        return repr(v)
+    s = str(v).replace("\\", "\\\\").replace("'", "\\'")
+    return f"'{s}'"
+
+
+class MySQLServer:
+    """Accept loop + connection registry (server.go Server analog)."""
+
+    def __init__(self, domain: Optional[Domain] = None, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.domain = domain or Domain()
+        self.host = host
+        self.port = port
+        self._listener: Optional[socket.socket] = None
+        self._conns: set[ClientConn] = set()
+        self._lock = threading.Lock()
+        self._closing = False
+        self._thread: Optional[threading.Thread] = None
+        # user -> SHA1(SHA1(password)) (mysql.user authentication_string)
+        self.users: dict[str, bytes] = {"root": P.native_password_hash("")}
+
+    # -------------------------------------------------------------- #
+
+    def authenticate(self, user: str, auth: bytes, salt: bytes):
+        priv = getattr(self.domain, "privileges", None)
+        if priv is not None:
+            return priv.authenticate(user, auth, salt)
+        stored = self.users.get(user)
+        if stored is None:
+            return False, None
+        return P.check_scramble(auth, salt, stored), None
+
+    def start(self) -> int:
+        """Bind + start the accept thread; returns the bound port."""
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((self.host, self.port))
+        self._listener.listen(64)
+        self.port = self._listener.getsockname()[1]
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        name="mysql-accept", daemon=True)
+        self._thread.start()
+        return self.port
+
+    def _accept_loop(self):
+        while not self._closing:
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            if self._closing:
+                sock.close()
+                return
+            conn = ClientConn(self, sock)
+            with self._lock:
+                self._conns.add(conn)
+            threading.Thread(target=conn.run, daemon=True).start()
+
+    def _conn_done(self, conn: ClientConn):
+        with self._lock:
+            self._conns.discard(conn)
+
+    def close(self, timeout: float = 5.0):
+        """Graceful shutdown: stop accepting, wait for live conns
+        (server.go graceful shutdown analog)."""
+        self._closing = True
+        if self._listener is not None:
+            # shutdown() interrupts a thread blocked in accept() — close()
+            # alone leaves the kernel socket alive via the in-syscall ref
+            try:
+                self._listener.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        import time
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with self._lock:
+                if not self._conns:
+                    break
+            time.sleep(0.02)
+        with self._lock:
+            for c in list(self._conns):
+                try:
+                    c.sock.close()
+                except OSError:
+                    pass
+
+
+__all__ = ["MySQLServer", "ClientConn", "SERVER_VERSION"]

@@ -1,0 +1,116 @@
+"""HTTP status/admin API.
+
+Reference analog: pkg/server http_handler.go + handler/ — /status,
+/schema, /stats, /settings endpoints on the status port, plus a
+Prometheus-text /metrics endpoint (pkg/metrics scrape surface).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..session.session import Domain
+
+
+class StatusServer:
+    def __init__(self, domain: Domain, host: str = "127.0.0.1", port: int = 0):
+        self.domain = domain
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_GET(self):
+                try:
+                    body, ctype = outer._route_retry(self.path)
+                except KeyError:
+                    self.send_error(404)
+                    return
+                except Exception as e:
+                    self.send_error(500, str(e))
+                    return
+                data = body.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> int:
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="status-http", daemon=True)
+        self._thread.start()
+        return self.port
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # -------------------------------------------------------------- #
+
+    def _route_retry(self, path: str) -> tuple[str, str]:
+        """Retry on 'dict changed size during iteration': routes read
+        shared Domain state concurrently mutated by connection threads."""
+        for _ in range(4):
+            try:
+                return self._route(path)
+            except RuntimeError:
+                continue
+        return self._route(path)
+
+    def _route(self, path: str) -> tuple[str, str]:
+        path = path.split("?")[0].rstrip("/") or "/status"
+        if path == "/status":
+            from .mysql_server import SERVER_VERSION
+            return json.dumps({
+                "version": SERVER_VERSION,
+                "connections": len(self.domain.sessions()),
+            }), "application/json"
+        if path == "/schema":
+            out = {db: sorted(tables)
+                   for db, tables in self.domain.catalog.databases.items()}
+            return json.dumps(out), "application/json"
+        if path.startswith("/schema/"):
+            parts = path.split("/")[2:]
+            db = parts[0]
+            tables = self.domain.catalog.databases.get(db)
+            if tables is None:
+                raise KeyError(db)
+            if len(parts) == 1:
+                return json.dumps(sorted(tables)), "application/json"
+            tbl = tables.get(parts[1])
+            if tbl is None:
+                raise KeyError(parts[1])
+            return json.dumps({
+                "name": tbl.name, "table_id": tbl.table_id,
+                "columns": [{"name": n, "type": str(t)}
+                            for n, t in zip(tbl.col_names, tbl.col_types)],
+                "indexes": [{"name": ix.name, "columns": ix.columns,
+                             "unique": ix.unique, "state": ix.state}
+                            for ix in tbl.indexes],
+            }), "application/json"
+        if path == "/stats":
+            rows = []
+            for db, tables in self.domain.catalog.databases.items():
+                for name, tbl in tables.items():
+                    ts = self.domain.stats.get(tbl)
+                    if ts is not None:
+                        rows.append({"db": db, "table": name,
+                                     "rows": ts.realtime_count,
+                                     "modify_count": ts.modify_count})
+            return json.dumps(rows), "application/json"
+        if path == "/metrics":
+            from ..utils.metrics import global_registry
+            return global_registry().prometheus_text(), "text/plain"
+        raise KeyError(path)
+
+
+__all__ = ["StatusServer"]

@@ -33,6 +33,10 @@ class ResultSet:
     names: list[str] = field(default_factory=list)
     rows: list[tuple] = field(default_factory=list)
     affected: int = 0
+    # column dtypes when known (SELECT paths) — the wire protocol layer
+    # maps these to MySQL column types; None entries mean "infer"
+    dtypes: Optional[list] = None
+    last_insert_id: int = 0
 
     def scalar(self):
         return self.rows[0][0] if self.rows else None
@@ -63,6 +67,19 @@ class Domain:
         self._next_table_id += 1
         return self._next_table_id
 
+    def query_metrics(self):
+        """Cached (counter, histogram) pair for the statement hot path."""
+        m = getattr(self, "_query_metrics", None)
+        if m is None:
+            from ..utils.metrics import global_registry
+            reg = global_registry()
+            m = self._query_metrics = (
+                reg.counter("tidb_tpu_query_total", "statements executed",
+                            labels=("type",)),
+                reg.histogram("tidb_tpu_query_duration_seconds",
+                              "statement latency"))
+        return m
+
     def register_session(self, sess) -> int:
         """Connection registry for SHOW PROCESSLIST (server's
         SessionManager analog)."""
@@ -92,14 +109,21 @@ class Session:
     # ------------------------------------------------------------- #
 
     def execute(self, sql: str) -> ResultSet:
+        qcnt, qdur = self.domain.query_metrics()
         out = ResultSet()
         for stmt in parse_sql(sql):
             t0 = time.perf_counter_ns()
-            out = self._exec_stmt(stmt)
+            try:
+                out = self._exec_stmt(stmt)
+            except Exception:
+                qcnt.inc(type="error")
+                raise
+            dt_ns = time.perf_counter_ns() - t0
+            qcnt.inc(type=type(stmt).__name__)
+            qdur.observe(dt_ns / 1e9)
             span = getattr(stmt, "text_span", None)
             text = sql[span[0]:span[1]].strip() if span else sql
-            self.domain.stmt_summary.record(
-                text, time.perf_counter_ns() - t0, len(out.rows))
+            self.domain.stmt_summary.record(text, dt_ns, len(out.rows))
         return out
 
     def must_query(self, sql: str) -> list[tuple]:
@@ -213,7 +237,8 @@ class Session:
         n_out = len(built.output_names)
         cols = chunk.columns[:n_out]  # trim hidden ORDER BY columns
         rows = list(zip(*[c.to_python() for c in cols])) if cols else []
-        return ResultSet(built.output_names, rows)
+        return ResultSet(built.output_names, rows,
+                         dtypes=[c.dtype for c in cols])
 
     def _exec_explain(self, stmt: A.Explain) -> ResultSet:
         if not isinstance(stmt.stmt, (A.SelectStmt, A.SetOpStmt)):

@@ -1,0 +1,140 @@
+"""Metrics registry: counters, gauges, histograms + Prometheus text.
+
+Reference analog: pkg/metrics (metrics.go RegisterMetrics; per-subsystem
+counter/histogram vectors scraped from the status port).  A tiny
+label-aware registry; updates take a per-metric lock (connection threads
+bump concurrently — read-modify-write is not GIL-atomic).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Optional, Sequence
+
+
+class Counter:
+    def __init__(self, name: str, help_: str, label_names: Sequence[str] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(label_names)
+        self.values: dict[tuple, float] = {}
+        self._mu = threading.Lock()
+
+    def inc(self, n: float = 1, **labels):
+        key = tuple(labels.get(ln, "") for ln in self.label_names)
+        with self._mu:
+            self.values[key] = self.values.get(key, 0) + n
+
+    def get(self, **labels) -> float:
+        key = tuple(labels.get(ln, "") for ln in self.label_names)
+        return self.values.get(key, 0)
+
+
+class Gauge(Counter):
+    def set(self, v: float, **labels):
+        key = tuple(labels.get(ln, "") for ln in self.label_names)
+        with self._mu:
+            self.values[key] = v
+
+
+class Histogram:
+    DEFAULT_BUCKETS = (0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5,
+                       10, 60)
+
+    def __init__(self, name: str, help_: str,
+                 buckets: Optional[Sequence[float]] = None):
+        self.name = name
+        self.help = help_
+        self.buckets = tuple(buckets or self.DEFAULT_BUCKETS)
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.total = 0.0
+        self.n = 0
+        self._mu = threading.Lock()
+
+    def observe(self, v: float):
+        with self._mu:
+            self.counts[bisect.bisect_left(self.buckets, v)] += 1
+            self.total += v
+            self.n += 1
+
+    def quantile(self, q: float) -> float:
+        """Approximate quantile from bucket upper bounds."""
+        if self.n == 0:
+            return 0.0
+        target = q * self.n
+        acc = 0
+        for i, c in enumerate(self.counts[:-1]):
+            acc += c
+            if acc >= target:
+                return self.buckets[i]
+        return self.buckets[-1]
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.metrics: dict[str, object] = {}
+
+    def counter(self, name: str, help_: str = "",
+                labels: Sequence[str] = ()) -> Counter:
+        return self._get_or_make(name, lambda: Counter(name, help_, labels))
+
+    def gauge(self, name: str, help_: str = "",
+              labels: Sequence[str] = ()) -> Gauge:
+        return self._get_or_make(name, lambda: Gauge(name, help_, labels))
+
+    def histogram(self, name: str, help_: str = "",
+                  buckets: Optional[Sequence[float]] = None) -> Histogram:
+        return self._get_or_make(name, lambda: Histogram(name, help_, buckets))
+
+    def _get_or_make(self, name, make):
+        with self._lock:
+            m = self.metrics.get(name)
+            if m is None:
+                m = self.metrics[name] = make()
+            return m
+
+    def prometheus_text(self) -> str:
+        out = []
+        for name in sorted(self.metrics):
+            m = self.metrics[name]
+            if isinstance(m, Histogram):
+                out.append(f"# TYPE {name} histogram")
+                with m._mu:
+                    counts, total, n = list(m.counts), m.total, m.n
+                acc = 0
+                for ub, c in zip(m.buckets, counts):
+                    acc += c
+                    out.append(f'{name}_bucket{{le="{ub}"}} {acc}')
+                out.append(f'{name}_bucket{{le="+Inf"}} {n}')
+                out.append(f"{name}_sum {total}")
+                out.append(f"{name}_count {n}")
+            else:
+                kind = "gauge" if isinstance(m, Gauge) else "counter"
+                out.append(f"# TYPE {name} {kind}")
+                with m._mu:
+                    values = dict(m.values)
+                if not values:
+                    out.append(f"{name} 0")
+                for key, v in sorted(values.items()):
+                    if m.label_names:
+                        lbl = ",".join(f'{ln}="{kv}"' for ln, kv
+                                       in zip(m.label_names, key))
+                        out.append(f"{name}{{{lbl}}} {v}")
+                    else:
+                        out.append(f"{name} {v}")
+        return "\n".join(out) + "\n"
+
+
+_global: Optional[Registry] = None
+
+
+def global_registry() -> Registry:
+    global _global
+    if _global is None:
+        _global = Registry()
+    return _global
+
+
+__all__ = ["Registry", "Counter", "Gauge", "Histogram", "global_registry"]
