@@ -1,0 +1,78 @@
+"""Plan cache + PREPARE/EXECUTE/user-variable tests (reference:
+core/plan_cache_test.go, session prepared-statement tests)."""
+
+import pytest
+
+from tidb_tpu.planner.build import PlanError
+from tidb_tpu.session.session import Domain, Session
+
+
+@pytest.fixture()
+def sess():
+    s = Session(Domain())
+    s.execute("create table pc (a bigint, b bigint)")
+    s.execute("insert into pc values (1,10),(2,20),(3,30)")
+    return s
+
+
+def test_repeated_select_hits_cache(sess):
+    cache = sess.domain.plan_cache
+    h0, m0 = cache.hits, cache.misses
+    assert sess.must_query("select a from pc where b > 15 order by a") == \
+        [(2,), (3,)]
+    assert sess.must_query("select a from pc where b > 15 order by a") == \
+        [(2,), (3,)]
+    assert cache.hits == h0 + 1
+    assert cache.misses >= m0 + 1
+
+
+def test_write_invalidates_cached_plan(sess):
+    sess.must_query("select count(*) from pc")
+    h0 = sess.domain.plan_cache.hits
+    sess.execute("insert into pc values (4,40)")
+    # epoch bumped -> fingerprint mismatch -> replan, correct count
+    assert sess.must_query("select count(*) from pc") == [(4,)]
+    assert sess.domain.plan_cache.hits == h0
+
+
+def test_ddl_invalidates_cached_plan(sess):
+    assert sess.must_query("select * from pc where a = 1") == [(1, 10)]
+    sess.execute("alter table pc add column c bigint default 7")
+    rows = sess.must_query("select * from pc where a = 1")
+    assert rows == [(1, 10, 7)]
+
+
+def test_prepare_execute_using(sess):
+    sess.execute("prepare q from 'select b from pc where a = ?'")
+    sess.execute("set @x = 2")
+    assert sess.must_query("execute q using @x") == [(20,)]
+    sess.execute("set @x = 3")
+    assert sess.must_query("execute q using @x") == [(30,)]
+    # wrong arity
+    with pytest.raises(PlanError):
+        sess.execute("execute q")
+    sess.execute("deallocate prepare q")
+    with pytest.raises(PlanError):
+        sess.execute("execute q using @x")
+
+
+def test_prepare_validates_syntax(sess):
+    with pytest.raises(Exception):
+        sess.execute("prepare bad from 'selct 1'")
+
+
+def test_user_var_expression(sess):
+    sess.execute("set @v = 1 + 2 * 3")
+    sess.execute("prepare p from 'select a from pc where a = ?'")
+    # @v = 7 -> no row
+    assert sess.must_query("execute p using @v") == []
+    sess.execute("set @v = 7 - 6")
+    assert sess.must_query("execute p using @v") == [(1,)]
+
+
+def test_string_param_binding(sess):
+    sess.execute("create table pcs (s varchar(10), n bigint)")
+    sess.execute("insert into pcs values ('it''s', 1), ('plain', 2)")
+    sess.execute("prepare sp from 'select n from pcs where s = ?'")
+    sess.execute("set @s = 'plain'")
+    assert sess.must_query("execute sp using @s") == [(2,)]

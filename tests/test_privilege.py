@@ -1,0 +1,175 @@
+"""Privilege subsystem tests (reference: pkg/privilege/privileges tests —
+grant levels, auth, SHOW GRANTS)."""
+
+import pytest
+
+from tidb_tpu.privilege import PrivilegeError
+from tidb_tpu.server import MySQLServer
+from tidb_tpu.server.client import Client, MySQLError
+from tidb_tpu.session.session import Domain, Session
+
+
+@pytest.fixture()
+def dom():
+    return Domain()
+
+
+def _sess(dom, user):
+    return Session(dom, user=user)
+
+
+def test_create_user_grant_revoke_levels(dom):
+    root = _sess(dom, "root")
+    root.execute("create user 'alice'@'%' identified by 'secret'")
+    root.execute("create database privdb")
+    root.execute("use privdb")
+    root.execute("create table t (a bigint)")
+    root.execute("insert into t values (1),(2)")
+
+    alice = Session(dom, db="privdb", user="alice")
+    with pytest.raises(PrivilegeError):
+        alice.execute("select * from t")
+    # table-level grant
+    root.execute("grant select on privdb.t to 'alice'@'%'")
+    assert alice.must_query("select count(*) from t") == [(2,)]
+    with pytest.raises(PrivilegeError):
+        alice.execute("insert into t values (3)")
+    # db-level grant
+    root.execute("grant insert on privdb.* to 'alice'@'%'")
+    alice.execute("insert into t values (3)")
+    # revoke
+    root.execute("revoke select on privdb.t from 'alice'@'%'")
+    with pytest.raises(PrivilegeError):
+        alice.execute("select * from t")
+    # global grant covers everything
+    root.execute("grant select on *.* to 'alice'@'%'")
+    assert alice.must_query("select count(*) from t") == [(3,)]
+
+
+def test_show_grants(dom):
+    root = _sess(dom, "root")
+    root.execute("create user bob identified by 'pw'")
+    root.execute("grant select, insert on test.* to bob")
+    rows = root.must_query("show grants for bob")
+    assert any("INSERT, SELECT ON test.*" in r[0] for r in rows)
+    rows = root.must_query("show grants")
+    assert any("ALL PRIVILEGES" in r[0] for r in rows)
+
+
+def test_create_user_requires_privilege(dom):
+    root = _sess(dom, "root")
+    root.execute("create user carol")
+    carol = _sess(dom, "carol")
+    with pytest.raises(PrivilegeError):
+        carol.execute("create user mallory")
+    with pytest.raises(PrivilegeError):
+        carol.execute("grant select on *.* to carol")
+
+
+def test_drop_and_alter_user(dom):
+    root = _sess(dom, "root")
+    root.execute("create user dave identified by 'old'")
+    root.execute("alter user dave identified by 'new'")
+    from tidb_tpu.utils.auth import native_password_hash
+    rec = dom.privileges.users[("dave", "%")]
+    assert rec.auth_hash == native_password_hash("new")
+    root.execute("drop user dave")
+    assert ("dave", "%") not in dom.privileges.users
+    root.execute("drop user if exists dave")
+    with pytest.raises(PrivilegeError):
+        root.execute("drop user dave")
+
+
+def test_wire_auth_with_password(dom):
+    srv = MySQLServer(dom)
+    srv.start()
+    try:
+        root = Client("127.0.0.1", srv.port)
+        root.execute("create user eve identified by 's3cret'")
+        root.execute("create table wire_t (x bigint)")
+        root.execute("insert into wire_t values (5)")
+        root.execute("grant select on test.wire_t to eve")
+        # wrong password rejected
+        with pytest.raises(MySQLError):
+            Client("127.0.0.1", srv.port, user="eve", password="nope")
+        eve = Client("127.0.0.1", srv.port, user="eve", password="s3cret")
+        assert eve.query("select x from wire_t") == [("5",)]
+        # denied table -> ERR packet, connection stays alive
+        root.execute("create table wire_u (y bigint)")
+        with pytest.raises(MySQLError):
+            eve.query("select * from wire_u")
+        assert eve.query("select x from wire_t") == [("5",)]
+        eve.close()
+        root.close()
+    finally:
+        srv.close()
+
+
+def test_insert_select_checks_source(dom):
+    root = _sess(dom, "root")
+    root.execute("create user frank")
+    root.execute("create table src (a bigint)")
+    root.execute("create table dst (a bigint)")
+    root.execute("insert into src values (9)")
+    root.execute("grant insert on test.dst to frank")
+    frank = _sess(dom, "frank")
+    with pytest.raises(PrivilegeError):
+        frank.execute("insert into dst select a from src")
+    root.execute("grant select on test.src to frank")
+    frank.execute("insert into dst select a from src")
+    assert root.must_query("select a from dst") == [(9,)]
+
+
+def test_host_specific_user(dom):
+    """Users created @host (not '%') still resolve for auth + checks."""
+    root = _sess(dom, "root")
+    root.execute("create user 'hana'@'localhost' identified by 'pw'")
+    root.execute("grant select on test.* to 'hana'@'localhost'")
+    root.execute("create table ht (x bigint)")
+    hana = _sess(dom, "hana")
+    assert hana.must_query("select count(*) from ht") == [(0,)]
+    rows = root.must_query("show grants for 'hana'@'localhost'")
+    assert any("test.*" in r[0] for r in rows)
+
+
+def test_set_uservar_subquery_checks_privileges(dom):
+    root = _sess(dom, "root")
+    root.execute("create table sec (v bigint)")
+    root.execute("insert into sec values (99)")
+    root.execute("create user snoop")
+    snoop = _sess(dom, "snoop")
+    with pytest.raises(PrivilegeError):
+        snoop.execute("set @x = (select v from sec)")
+
+
+def test_cte_reference_not_privilege_checked_as_table(dom):
+    root = _sess(dom, "root")
+    root.execute("create table cte_src (a bigint)")
+    root.execute("insert into cte_src values (5)")
+    root.execute("create user walker")
+    root.execute("grant select on test.cte_src to walker")
+    w = _sess(dom, "walker")
+    assert w.must_query(
+        "with c as (select a from cte_src) select * from c") == [(5,)]
+
+
+def test_grant_create_user_privilege(dom):
+    root = _sess(dom, "root")
+    root.execute("create user deputy")
+    root.execute("grant create user on *.* to deputy")
+    deputy = _sess(dom, "deputy")
+    deputy.execute("create user minion")
+    assert ("minion", "%") in dom.privileges.users
+
+
+def test_unqualified_grant_level_uses_current_db(dom):
+    root = _sess(dom, "root")
+    root.execute("create table uq (x bigint)")
+    root.execute("create user delegator")
+    root.execute("grant select on test.* to delegator")
+    d = _sess(dom, "delegator")
+    root.execute("create user grantee")
+    # unqualified table name resolves against the current db for the
+    # granter's own privilege check
+    d.execute("grant select on uq to grantee")
+    assert dom.privileges.check("grantee", "SELECT", "test", "uq")

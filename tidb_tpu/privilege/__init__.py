@@ -1,0 +1,3 @@
+from .manager import PrivilegeError, PrivilegeManager
+
+__all__ = ["PrivilegeManager", "PrivilegeError"]

@@ -21,6 +21,10 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from ..session.session import Domain, Session
+# placeholder binding is shared with the SQL-level PREPARE/EXECUTE path
+from ..sql.bind import (bind_placeholders as _bind_placeholders,
+                        count_placeholders as _count_placeholders,
+                        strip_placeholders as _strip_placeholders)
 from . import packet as P
 
 SERVER_VERSION = "8.0.11-tidb-tpu-0.1"
@@ -250,74 +254,6 @@ def _errno_for(e: Exception) -> int:
     return ER_UNKNOWN
 
 
-def _count_placeholders(sql: str) -> int:
-    return sum(1 for ch, in_s in _scan_sql(sql) if ch == "?" and not in_s)
-
-
-def _strip_placeholders(sql: str) -> str:
-    out = []
-    for ch, in_s in _scan_sql(sql):
-        out.append("0" if ch == "?" and not in_s else ch)
-    return "".join(out)
-
-
-def _bind_placeholders(sql: str, params: list) -> str:
-    out = []
-    it = iter(params)
-    for ch, in_s in _scan_sql(sql):
-        if ch == "?" and not in_s:
-            out.append(_sql_literal(next(it)))
-        else:
-            out.append(ch)
-    return "".join(out)
-
-
-def _scan_sql(sql: str):
-    """Yield (char, masked) where masked chars are inside string literals,
-    backtick identifiers, or comments — a '?' there is not a placeholder
-    (mirrors the lexer's string/comment handling)."""
-    i, n = 0, len(sql)
-    while i < n:
-        ch = sql[i]
-        if ch in ("'", '"', "`"):
-            quote = ch
-            yield ch, True
-            i += 1
-            while i < n:
-                yield sql[i], True
-                if sql[i] == "\\" and quote != "`" and i + 1 < n:
-                    i += 1
-                    yield sql[i], True
-                elif sql[i] == quote:
-                    i += 1
-                    break
-                i += 1
-            continue
-        if ch == "#" or (ch == "-" and sql[i:i + 2] == "--"):
-            while i < n and sql[i] != "\n":
-                yield sql[i], True
-                i += 1
-            continue
-        if ch == "/" and sql[i:i + 2] == "/*":
-            end = sql.find("*/", i + 2)
-            end = n if end < 0 else end + 2
-            while i < end:
-                yield sql[i], True
-                i += 1
-            continue
-        yield ch, False
-        i += 1
-
-
-def _sql_literal(v) -> str:
-    if v is None:
-        return "NULL"
-    if isinstance(v, bool):
-        return "1" if v else "0"
-    if isinstance(v, (int, float)):
-        return repr(v)
-    s = str(v).replace("\\", "\\\\").replace("'", "\\'")
-    return f"'{s}'"
 
 
 class MySQLServer:

@@ -54,6 +54,10 @@ class Domain:
         self.client = CopClient(self.mesh)
         self.kv = KVStore()          # native C++ MVCC row store
         self.stats = StatsHandle()   # pkg/statistics/handle analog
+        from ..privilege import PrivilegeManager
+        self.privileges = PrivilegeManager()   # pkg/privilege Handle analog
+        from ..planner.plan_cache import PlanCache
+        self.plan_cache = PlanCache()          # instance plan cache
         from ..utils.stmtsummary import StmtSummary
         self.stmt_summary = StmtSummary()   # util/stmtsummary analog
         self._next_table_id = 100
@@ -98,13 +102,18 @@ class Domain:
 
 
 class Session:
-    def __init__(self, domain: Optional[Domain] = None, db: str = "test"):
+    def __init__(self, domain: Optional[Domain] = None, db: str = "test",
+                 user: str = "root"):
         self.domain = domain or Domain()
         self.conn_id = self.domain.register_session(self)
         self.db = db
+        self.user = user
         self.vars: dict[str, Any] = {}
+        self.user_vars: dict[str, Any] = {}      # SET @x = ...
+        self.prepared: dict[str, tuple[str, int]] = {}  # name -> (sql, n_params)
         self.txn = None              # active explicit transaction
         self._txn_tables: set = set()
+        self._cur_sql: Optional[str] = None      # text of the running stmt
 
     # ------------------------------------------------------------- #
 
@@ -113,16 +122,19 @@ class Session:
         out = ResultSet()
         for stmt in parse_sql(sql):
             t0 = time.perf_counter_ns()
+            span = getattr(stmt, "text_span", None)
+            text = sql[span[0]:span[1]].strip() if span else sql
+            self._cur_sql = text
             try:
                 out = self._exec_stmt(stmt)
             except Exception:
                 qcnt.inc(type="error")
                 raise
+            finally:
+                self._cur_sql = None
             dt_ns = time.perf_counter_ns() - t0
             qcnt.inc(type=type(stmt).__name__)
             qdur.observe(dt_ns / 1e9)
-            span = getattr(stmt, "text_span", None)
-            text = sql[span[0]:span[1]].strip() if span else sql
             self.domain.stmt_summary.record(text, dt_ns, len(out.rows))
         return out
 
@@ -133,6 +145,10 @@ class Session:
     # ------------------------------------------------------------- #
 
     def _exec_stmt(self, stmt: A.Node) -> ResultSet:
+        self._check_privileges(stmt)
+        if isinstance(stmt, (A.CreateUser, A.AlterUser, A.DropUser,
+                             A.GrantStmt, A.RevokeStmt, A.FlushStmt)):
+            return self._exec_user_admin(stmt)
         if isinstance(stmt, (A.SelectStmt, A.SetOpStmt)):
             return self._exec_select(stmt)
         if isinstance(stmt, A.Explain):
@@ -183,31 +199,199 @@ class Session:
                 v = val.value if isinstance(val, A.Lit) else None
                 (self.domain.sysvars if stmt.scope == "global"
                  else self.vars)[name.lower()] = v
+            for name, val in stmt.user_vars:
+                self.user_vars[name.lower()] = self._eval_scalar(val)
             return ResultSet()
         if isinstance(stmt, A.TxnStmt):
             return self._exec_txn(stmt)
+        if isinstance(stmt, A.PrepareStmt):
+            from ..sql.bind import count_placeholders, strip_placeholders
+            parse_sql(strip_placeholders(stmt.sql))  # validate syntax now
+            self.prepared[stmt.name] = (stmt.sql,
+                                        count_placeholders(stmt.sql))
+            return ResultSet()
+        if isinstance(stmt, A.ExecutePrepared):
+            return self._exec_prepared(stmt)
+        if isinstance(stmt, A.DeallocateStmt):
+            if stmt.name not in self.prepared:
+                raise PlanError(f"unknown prepared statement {stmt.name!r}")
+            del self.prepared[stmt.name]
+            return ResultSet()
         if isinstance(stmt, A.AnalyzeTable):
             tbl = self.domain.catalog.get_table(self.db, stmt.name)
             self.domain.stats.analyze_table(tbl)
             return ResultSet()
         raise PlanError(f"unsupported statement {type(stmt).__name__}")
 
+    # ---------------- privileges ---------------- #
+
+    # statement class -> required privilege on its target tables
+    _STMT_PRIVS = {
+        "Insert": "INSERT", "Update": "UPDATE", "Delete": "DELETE",
+        "TruncateTable": "DROP", "CreateTable": "CREATE",
+        "DropTable": "DROP", "CreateIndex": "INDEX", "DropIndex": "INDEX",
+        "AlterTable": "ALTER", "CreateDatabase": "CREATE",
+        "DropDatabase": "DROP", "AnalyzeTable": "INSERT",
+    }
+
+    def _check_privileges(self, stmt: A.Node) -> None:
+        """Statement-level privilege verification (reference:
+        planner/core/planbuilder.go visitInfo + privilege.Handle
+        RequestVerification)."""
+        priv = self.domain.privileges
+        if isinstance(stmt, (A.SelectStmt, A.SetOpStmt)):
+            for db, tbl in self._referenced_tables(stmt):
+                priv.require(self.user, "SELECT", db or self.db, tbl)
+            return
+        if isinstance(stmt, (A.Explain, A.TraceStmt)):
+            return self._check_privileges(stmt.stmt)
+        if isinstance(stmt, (A.CreateUser, A.AlterUser, A.DropUser)):
+            return priv.require(self.user, "CREATE USER")
+        if isinstance(stmt, (A.GrantStmt, A.RevokeStmt)):
+            # MySQL requires the granter to hold the privileges granted;
+            # unqualified table level ('' db) means the current database
+            db = "" if stmt.db == "*" else (stmt.db or self.db)
+            table = "" if stmt.table == "*" else stmt.table
+            for p in stmt.privs:
+                priv.require(self.user, p if p != "ALL" else "SUPER",
+                             db, table)
+            return
+        kind = type(stmt).__name__
+        need = self._STMT_PRIVS.get(kind)
+        if need is None:
+            return
+        if isinstance(stmt, A.Insert) and stmt.select is not None:
+            self._check_privileges(stmt.select)
+        target = getattr(stmt, "table", None) or getattr(stmt, "name", "")
+        if isinstance(stmt, A.DropTable):
+            for n in stmt.names:
+                priv.require(self.user, need, self.db, n)
+            return
+        if isinstance(stmt, (A.CreateDatabase, A.DropDatabase)):
+            return priv.require(self.user, need, stmt.name)
+        priv.require(self.user, need, self.db, target)
+
+    def _referenced_tables(self, node: A.Node) -> list[tuple]:
+        """All (db, table) names a query reads — walks FROM clauses,
+        joins, subqueries, CTE bodies (skipping CTE self-references)."""
+        out: list[tuple] = []
+        cte_names: set = set()
+
+        def walk(n):
+            if n is None or not isinstance(n, A.Node):
+                return
+            if isinstance(n, A.TableName):
+                if n.name not in cte_names:
+                    out.append((n.db, n.name))
+                return
+            if isinstance(n, A.CTE):
+                cte_names.add(n.name)
+            # register CTE names BEFORE visiting FROM clauses that
+            # reference them (dataclass field order puts from_ first)
+            for cte in getattr(n, "ctes", ()):
+                walk(cte)
+            for f in getattr(n, "__dataclass_fields__", {}):
+                if f == "ctes":
+                    continue
+                v = getattr(n, f, None)
+                if isinstance(v, A.Node):
+                    walk(v)
+                elif isinstance(v, (list, tuple)):
+                    for x in v:
+                        if isinstance(x, A.Node):
+                            walk(x)
+                        elif isinstance(x, tuple):
+                            for y in x:
+                                if isinstance(y, A.Node):
+                                    walk(y)
+        walk(node)
+        return out
+
+    def _exec_user_admin(self, stmt: A.Node) -> ResultSet:
+        priv = self.domain.privileges
+        if isinstance(stmt, A.CreateUser):
+            for spec, pwd in stmt.users:
+                priv.create_user(spec.user, spec.host, pwd,
+                                 stmt.if_not_exists)
+        elif isinstance(stmt, A.AlterUser):
+            for spec, pwd in stmt.users:
+                priv.alter_user(spec.user, spec.host, pwd)
+        elif isinstance(stmt, A.DropUser):
+            for spec in stmt.users:
+                priv.drop_user(spec.user, spec.host, stmt.if_exists)
+        elif isinstance(stmt, A.GrantStmt):
+            db = self.db if stmt.db == "" else stmt.db
+            for spec in stmt.users:
+                priv.grant(stmt.privs, db, stmt.table, spec.user, spec.host)
+        elif isinstance(stmt, A.RevokeStmt):
+            db = self.db if stmt.db == "" else stmt.db
+            for spec in stmt.users:
+                priv.revoke(stmt.privs, db, stmt.table, spec.user, spec.host)
+        # FLUSH PRIVILEGES: no-op — the manager is authoritative
+        return ResultSet()
+
+    def _eval_scalar(self, expr: A.Node):
+        """Evaluate a scalar expression (SET @x = ...); subqueries inside
+        the expression still pass privilege checks."""
+        if isinstance(expr, A.Lit):
+            return self._literal_value(expr)
+        sel = A.SelectStmt(items=[A.SelectItem(expr)])
+        self._check_privileges(sel)
+        return self._exec_select(sel).scalar()
+
+    def _exec_prepared(self, stmt: A.ExecutePrepared) -> ResultSet:
+        from ..sql.bind import bind_placeholders
+        ent = self.prepared.get(stmt.name)
+        if ent is None:
+            raise PlanError(f"unknown prepared statement {stmt.name!r}")
+        sql, n_params = ent
+        if len(stmt.using) != n_params:
+            raise PlanError(
+                f"prepared statement {stmt.name!r} needs {n_params} "
+                f"parameters, got {len(stmt.using)}")
+        params = []
+        for uv in stmt.using:
+            if uv.lower() not in self.user_vars:
+                raise PlanError(f"user variable @{uv} is not set")
+            params.append(self.user_vars[uv.lower()])
+        return self.execute(bind_placeholders(sql, params))
+
     # ------------------------------------------------------------- #
 
-    def _plan_select(self, stmt):
+    def _plan_select(self, stmt, cache_sql: Optional[str] = None):
+        from ..planner.plan_cache import PlanCacheEntry, table_fingerprint
         from ..planner.ranger import apply_index_paths
+        cache = self.domain.plan_cache
+        merged = {**self.domain.sysvars, **self.vars}
+        use_cache = (cache_sql is not None
+                     and _flag_on(merged, "tidb_enable_plan_cache"))
+        if use_cache:
+            e = cache.get(cache_sql, self.db, merged, self.domain.catalog)
+            if e is not None:
+                return e.built, e.phys
         built = build_query(stmt, self.domain.catalog, self.db)
         self._maybe_auto_analyze(built.plan)
         plan = optimize_plan(built.plan)
         plan = apply_index_paths(plan, self.domain.stats)
         phys = to_physical(plan)
+        if use_cache:
+            keys = {}
+            for db, name in self._referenced_tables(stmt):
+                tdb = db or self.db
+                try:
+                    tbl = self.domain.catalog.get_table(tdb, name)
+                except Exception:
+                    continue
+                keys[(tdb, name)] = table_fingerprint(tbl)
+            cache.put(cache_sql, self.db, merged,
+                      PlanCacheEntry(built, phys, keys))
         return built, phys
 
     def _maybe_auto_analyze(self, plan):
         """Refresh stale stats before planning (handle/autoanalyze.go
         analog, run inline instead of in a background worker)."""
         merged = {**self.domain.sysvars, **self.vars}
-        if not int(merged.get("tidb_enable_auto_analyze", 1)):
+        if not _flag_on(merged, "tidb_enable_auto_analyze"):
             return
         from ..planner.logical import DataSource
         stack, seen = [plan], set()
@@ -231,7 +415,9 @@ class Session:
                            mem_tracker=Tracker("query", quota))
 
     def _exec_select(self, stmt) -> ResultSet:
-        built, phys = self._plan_select(stmt)
+        cache_sql = self._cur_sql
+        self._cur_sql = None  # inner selects (INSERT..SELECT) don't cache
+        built, phys = self._plan_select(stmt, cache_sql)
         ctx = self._exec_ctx()
         chunk = phys.execute(ctx)
         n_out = len(built.output_names)
@@ -540,6 +726,14 @@ class Session:
                 [(sid, sess.db, "Sleep" if sess is not self else "Query",
                   "autocommit" if sess.txn is None else "in transaction")
                  for sid, sess in self.domain.sessions()])
+        if stmt.kind == "grants":
+            if stmt.target:
+                user, _, host = stmt.target.partition("@")
+            else:
+                user, host = self.user, "%"
+            return ResultSet([f"Grants for {user}@{host}"],
+                             [(g,) for g in
+                              self.domain.privileges.show_grants(user, host)])
         if stmt.kind == "variables":
             vs = {**self.domain.sysvars, **self.vars}
             return ResultSet(["Variable_name", "Value"],
@@ -594,6 +788,17 @@ class Session:
             return -v if not isinstance(v, str) else "-" + v
         raise PlanError("INSERT values must be literals")
 
+
+
+def _flag_on(merged: dict, name: str, default: bool = True) -> bool:
+    """Boolean sysvar semantics tolerant of ON/OFF/1/0/None values."""
+    v = merged.get(name)
+    if v is None:
+        return default
+    try:
+        return int(v) != 0
+    except (TypeError, ValueError):
+        return str(v).strip().lower() in ("on", "true", "1", "yes")
 
 
 def _rows_to_columns(tbl: TableInfo, rows: list[tuple]):

@@ -304,6 +304,27 @@ class ShowStmt(Node):
 class SetStmt(Node):
     scope: str = "session"
     assignments: list[tuple[str, Node]] = field(default_factory=list)
+    # SET @name = expr (user-defined variables, reference: ast.VariableAssignment IsSystem=false)
+    user_vars: list[tuple[str, Node]] = field(default_factory=list)
+
+
+@dataclass
+class PrepareStmt(Node):
+    """PREPARE name FROM 'sql' (reference: ast.PrepareStmt)."""
+    name: str = ""
+    sql: str = ""
+
+
+@dataclass
+class ExecutePrepared(Node):
+    """EXECUTE name [USING @a, @b] (reference: ast.ExecuteStmt)."""
+    name: str = ""
+    using: list[str] = field(default_factory=list)
+
+
+@dataclass
+class DeallocateStmt(Node):
+    name: str = ""
 
 
 @dataclass
@@ -319,6 +340,53 @@ class AnalyzeTable(Node):
 @dataclass
 class TruncateTable(Node):
     name: str = ""
+
+
+# ---------------- users & privileges (reference: ast/misc.go
+# CreateUserStmt/GrantStmt, pkg/privilege) ---------------- #
+
+@dataclass
+class UserSpec(Node):
+    user: str = ""
+    host: str = "%"
+
+
+@dataclass
+class CreateUser(Node):
+    users: list[tuple[UserSpec, Optional[str]]] = field(default_factory=list)
+    if_not_exists: bool = False     # (spec, password)
+
+
+@dataclass
+class AlterUser(Node):
+    users: list[tuple[UserSpec, Optional[str]]] = field(default_factory=list)
+
+
+@dataclass
+class DropUser(Node):
+    users: list[UserSpec] = field(default_factory=list)
+    if_exists: bool = False
+
+
+@dataclass
+class GrantStmt(Node):
+    privs: list[str] = field(default_factory=list)  # 'SELECT'... | 'ALL'
+    db: str = "*"
+    table: str = "*"
+    users: list[UserSpec] = field(default_factory=list)
+
+
+@dataclass
+class RevokeStmt(Node):
+    privs: list[str] = field(default_factory=list)
+    db: str = "*"
+    table: str = "*"
+    users: list[UserSpec] = field(default_factory=list)
+
+
+@dataclass
+class FlushStmt(Node):
+    what: str = "privileges"
 
 
 __all__ = [n for n in dir() if n[0].isupper()]

@@ -30,6 +30,8 @@ KEYWORDS = {
     "ADD", "KEYS", "COLUMN",
     "RECURSIVE", "OVER", "PARTITION", "ROWS", "RANGE", "UNBOUNDED",
     "PRECEDING", "FOLLOWING", "CURRENT", "ROW", "WINDOW",
+    "USER", "GRANT", "REVOKE", "GRANTS", "IDENTIFIED", "PRIVILEGES", "TO",
+    "FLUSH", "PASSWORD", "FOR",
 }
 
 # multi-char operators first (maximal munch)

@@ -146,7 +146,124 @@ class Parser:
             self.advance()
             self.expect_kw("TABLE")
             return A.AnalyzeTable(self.ident())
+        if self.cur.kind == "ident" and self.cur.text.upper() in (
+                "PREPARE", "EXECUTE", "DEALLOCATE"):
+            return self._prepare_family()
+        if self.at_kw("GRANT"):
+            return self.grant_stmt()
+        if self.at_kw("REVOKE"):
+            return self.revoke_stmt()
+        if self.at_kw("FLUSH"):
+            self.advance()
+            self.expect_kw("PRIVILEGES")
+            return A.FlushStmt("privileges")
         raise ParseError("unsupported statement", self.cur)
+
+    def _prepare_family(self) -> A.Node:
+        word = self.advance().text.upper()
+        if word == "PREPARE":
+            name = self.ident()
+            self.expect_kw("FROM")
+            t = self.cur
+            if t.kind != "str":
+                raise ParseError("expected statement string", t)
+            self.advance()
+            return A.PrepareStmt(name, t.text)
+        if word == "EXECUTE":
+            name = self.ident()
+            using: list[str] = []
+            if self.at_kw("USING"):
+                self.advance()
+                while True:
+                    self.expect_op("@")
+                    using.append(self.ident())
+                    if not self.accept_op(","):
+                        break
+            return A.ExecutePrepared(name, using)
+        # DEALLOCATE PREPARE name
+        if self.cur.kind == "ident" and self.cur.text.upper() == "PREPARE":
+            self.advance()
+        return A.DeallocateStmt(self.ident())
+
+    # ---------------- users & privileges ---------------- #
+
+    def _user_spec(self) -> A.UserSpec:
+        t = self.cur
+        if t.kind == "str":
+            name = self.advance().text
+        else:
+            name = self.ident()
+        host = "%"
+        if self.accept_op("@"):
+            t = self.cur
+            host = self.advance().text if t.kind == "str" else self.ident()
+        return A.UserSpec(name, host)
+
+    def _user_password_list(self):
+        out = []
+        while True:
+            spec = self._user_spec()
+            pwd = None
+            if self.accept_kw("IDENTIFIED"):
+                self.expect_kw("BY")
+                t = self.cur
+                if t.kind != "str":
+                    raise ParseError("expected password string", t)
+                pwd = self.advance().text
+            out.append((spec, pwd))
+            if not self.accept_op(","):
+                return out
+
+    def _priv_list(self) -> list[str]:
+        privs = []
+        if self.accept_kw("ALL"):
+            self.accept_kw("PRIVILEGES")
+            return ["ALL"]
+        while True:
+            t = self.cur
+            if t.kind not in ("kw", "ident"):
+                raise ParseError("expected privilege", t)
+            name = self.advance().text.upper()
+            if name == "CREATE" and self.accept_kw("USER"):
+                name = "CREATE USER"
+            privs.append(name)
+            if not self.accept_op(","):
+                return privs
+
+    def _priv_level(self) -> tuple[str, str]:
+        """db.table | db.* | *.* | table"""
+        if self.accept_op("*"):
+            if self.accept_op("."):
+                self.expect_op("*")
+            return "*", "*"
+        name = self.ident()
+        if self.accept_op("."):
+            if self.accept_op("*"):
+                return name, "*"
+            return name, self.ident()
+        return "", name      # current-db table
+
+    def grant_stmt(self) -> A.GrantStmt:
+        self.expect_kw("GRANT")
+        privs = self._priv_list()
+        self.expect_kw("ON")
+        db, table = self._priv_level()
+        self.expect_kw("TO")
+        users = [self._user_spec()]
+        while self.accept_op(","):
+            users.append(self._user_spec())
+        return A.GrantStmt(privs, db, table, users)
+
+    def revoke_stmt(self) -> A.RevokeStmt:
+        self.expect_kw("REVOKE")
+        privs = self._priv_list()
+        self.expect_kw("ON")
+        db, table = self._priv_level()
+        self.expect_kw("FROM")
+        users = [self._user_spec()]
+        while self.accept_op(","):
+            users.append(self._user_spec())
+        return A.RevokeStmt(privs, db, table, users)
 
     # ---------------- SELECT / set operations / WITH ---------------- #
 
@@ -403,6 +520,9 @@ class Parser:
         if self.accept_kw("DATABASE"):
             ine = self._if_not_exists()
             return A.CreateDatabase(self.ident(), ine)
+        if self.accept_kw("USER"):
+            ine = self._if_not_exists()
+            return A.CreateUser(self._user_password_list(), ine)
         unique = self.accept_kw("UNIQUE")
         if self.accept_kw("INDEX") or (unique and self.accept_kw("KEY")):
             ine = self._if_not_exists()
@@ -486,6 +606,8 @@ class Parser:
 
     def alter_stmt(self) -> A.Node:
         self.expect_kw("ALTER")
+        if self.accept_kw("USER"):
+            return A.AlterUser(self._user_password_list())
         self.expect_kw("TABLE")
         table = self.ident()
         if self.accept_op("."):
@@ -575,6 +697,15 @@ class Parser:
 
     def drop_stmt(self) -> A.Node:
         self.expect_kw("DROP")
+        if self.accept_kw("USER"):
+            ie = False
+            if self.accept_kw("IF"):
+                self.expect_kw("EXISTS")
+                ie = True
+            users = [self._user_spec()]
+            while self.accept_op(","):
+                users.append(self._user_spec())
+            return A.DropUser(users, ie)
         if self.accept_kw("DATABASE"):
             ie = self.accept_kw("IF") and self.expect_kw("EXISTS") is not None
             return A.DropDatabase(self.ident(), ie)
@@ -666,6 +797,11 @@ class Parser:
         if self.accept_kw("INDEX", "KEYS"):
             self.expect_kw("FROM")
             return A.ShowStmt("index", self.ident())
+        if self.accept_kw("GRANTS"):
+            if self.accept_kw("FOR"):
+                spec = self._user_spec()
+                return A.ShowStmt("grants", f"{spec.user}@{spec.host}")
+            return A.ShowStmt("grants")
         if self.cur.kind == "ident" and self.cur.text.upper() in (
                 "STATS_META", "STATS_HISTOGRAMS", "STATS_TOPN",
                 "STATEMENTS_SUMMARY", "SLOW_QUERIES", "PROCESSLIST"):
@@ -683,24 +819,28 @@ class Parser:
             scope = "session"
         st = A.SetStmt(scope)
         while True:
+            user_var = False
             if self.accept_op("@"):
-                self.accept_op("@")
-                if self.cur.kind == "kw":
-                    self.advance()
-                    self.expect_op(".")
+                if self.accept_op("@"):    # @@[scope.]sysvar
+                    if self.cur.kind == "kw":
+                        self.advance()
+                        self.expect_op(".")
+                else:                      # @uservar
+                    user_var = True
             name = self.ident()
             if not self.accept_op("=") and not self.accept_op(":="):
                 raise ParseError("expected =", self.cur)
             # MySQL boolean sysvar forms: ON/OFF are keywords, not exprs
             if self.at_kw("ON"):
                 self.advance()
-                st.assignments.append((name, A.Lit(1, "int")))
+                val = A.Lit(1, "int")
             elif (self.cur.kind == "ident"
                   and self.cur.text.upper() == "OFF"):
                 self.advance()
-                st.assignments.append((name, A.Lit(0, "int")))
+                val = A.Lit(0, "int")
             else:
-                st.assignments.append((name, self.expr()))
+                val = self.expr()
+            (st.user_vars if user_var else st.assignments).append((name, val))
             if not self.accept_op(","):
                 break
         return st
@@ -1006,7 +1146,8 @@ _NONRESERVED = {"YEAR", "MONTH", "DAY", "HOUR", "MINUTE", "SECOND", "DATE",
                 "SESSION", "KEY", "DEFAULT", "ADMIN", "CHECK", "BEGIN",
                 "TRANSACTION", "TRUNCATE", "ROW", "ROWS", "RANGE", "OVER",
                 "PARTITION", "CURRENT", "WINDOW", "RECURSIVE", "PRECEDING",
-                "FOLLOWING", "UNBOUNDED"}
+                "FOLLOWING", "UNBOUNDED", "USER", "GRANTS", "PRIVILEGES",
+                "PASSWORD", "FLUSH", "IDENTIFIED"}
 
 
 def parse_sql(sql: str) -> list[A.Node]:

@@ -1,0 +1,33 @@
+"""mysql_native_password auth primitives (shared by the wire protocol
+layer and the privilege manager; reference: pkg/util/hack + auth pkg)."""
+
+from __future__ import annotations
+
+import hashlib
+
+
+def native_password_hash(password: str) -> bytes:
+    """SHA1(SHA1(password)) — what mysql.user stores."""
+    return hashlib.sha1(hashlib.sha1(password.encode()).digest()).digest()
+
+
+def scramble_password(password: str, salt: bytes) -> bytes:
+    """Client-side: SHA1(pwd) XOR SHA1(salt + SHA1(SHA1(pwd)))."""
+    if not password:
+        return b""
+    h1 = hashlib.sha1(password.encode()).digest()
+    h2 = hashlib.sha1(h1).digest()
+    mix = hashlib.sha1(salt + h2).digest()
+    return bytes(a ^ b for a, b in zip(h1, mix))
+
+
+def check_scramble(scrambled: bytes, salt: bytes, stored_hash: bytes) -> bool:
+    """Server-side verify: recover SHA1(pwd-hash) and compare."""
+    if not scrambled:
+        return stored_hash == native_password_hash("")
+    mix = hashlib.sha1(salt + stored_hash).digest()
+    h1 = bytes(a ^ b for a, b in zip(scrambled, mix))
+    return hashlib.sha1(h1).digest() == stored_hash
+
+
+__all__ = ["native_password_hash", "scramble_password", "check_scramble"]
