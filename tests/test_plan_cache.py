@@ -76,3 +76,18 @@ def test_string_param_binding(sess):
     sess.execute("prepare sp from 'select n from pcs where s = ?'")
     sess.execute("set @s = 'plain'")
     assert sess.must_query("execute sp using @s") == [(2,)]
+
+
+def test_recursive_cte_not_cached(sess):
+    sql = ("with recursive r(n) as (select 1 union all "
+           "select n+1 from r where n < 4) select n from r order by n")
+    assert sess.must_query(sql) == [(1,), (2,), (3,), (4,)]
+    assert sess.must_query(sql) == [(1,), (2,), (3,), (4,)]
+
+
+def test_grant_bare_star_is_current_db_level():
+    from tidb_tpu.sql.parser import parse_one
+    g = parse_one("grant select on * to u")
+    assert (g.db, g.table) == ("", "*")
+    g2 = parse_one("grant select on *.* to u")
+    assert (g2.db, g2.table) == ("*", "*")

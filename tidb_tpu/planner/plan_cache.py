@@ -36,7 +36,7 @@ def table_fingerprint(tbl) -> tuple:
     """Schema + data-epoch fingerprint of one referenced table."""
     return (tbl.table_id, tuple(tbl.col_names),
             tuple(str(t) for t in tbl.col_types),
-            tuple((ix.name, tuple(ix.columns), ix.unique)
+            tuple((ix.name, tuple(ix.columns), ix.unique, ix.state)
                   for ix in tbl.indexes),
             tbl._epoch)
 

@@ -101,6 +101,15 @@ class TableInfo:
     _next_handle: int = 0
     _next_index_id: int = 0
     n_shards: int = 8
+    # schema gate: writers hold read side per statement; online-DDL state
+    # transitions take the write side to drain in-flight writers (the F1
+    # schema-lease wait analog, utils/rwlock.py)
+    schema_gate: Any = None
+
+    def __post_init__(self):
+        if self.schema_gate is None:
+            from ..utils.rwlock import RWLock
+            self.schema_gate = RWLock()
 
     # ---------------- index helpers ---------------- #
 
@@ -132,10 +141,16 @@ class TableInfo:
 
     def _write_index_entries(self, txn, row: tuple, handle: int):
         for ix in self.indexes:
+            # F1 online-DDL contract (ddl/index.go): an index in 'none' or
+            # 'delete only' does not receive new entries from inserts
+            if ix.state in ("none", "delete only"):
+                continue
             self._put_index_entry(txn, ix, row, handle)
 
     def _delete_index_entries(self, txn, row: tuple, handle: int):
         for ix in self.indexes:
+            if ix.state == "none":
+                continue
             key, _ = self._index_entry(ix, row, handle)
             txn.delete(key)
 
@@ -212,21 +227,22 @@ class TableInfo:
             fixed.append(tuple(r))
         if self.kv is not None:
             own = txn is None
-            t = txn or self.kv.begin()
-            try:
-                for r in fixed:
-                    self._next_handle += 1
-                    key, val = encode_table_row(self.table_id,
-                                                self._next_handle,
-                                                r, self.col_types)
-                    t.put(key, val)
-                    self._write_index_entries(t, r, self._next_handle)
-                if own:
-                    t.commit()
-            except Exception:
-                if own:
-                    t.rollback()
-                raise
+            with self.schema_gate.read():
+                t = txn or self.kv.begin()
+                try:
+                    for r in fixed:
+                        self._next_handle += 1
+                        key, val = encode_table_row(self.table_id,
+                                                    self._next_handle,
+                                                    r, self.col_types)
+                        t.put(key, val)
+                        self._write_index_entries(t, r, self._next_handle)
+                    if own:
+                        t.commit()
+                except Exception:
+                    if own:
+                        t.rollback()
+                    raise
         else:
             self._pending.extend(fixed)
         self._invalidate()
@@ -239,23 +255,30 @@ class TableInfo:
         deleted = snap.num_rows - len(idx)
         if self.kv is not None:
             handles = self._snapshot_handles
-            t = self.kv.begin()
-            from ..store.codec import record_key
-            drop = np.nonzero(~np.asarray(keep_mask))[0]
-            # materialize ONLY the dropped rows for index-entry removal
-            drop_rows = None
-            if self.indexes and len(drop):
-                dropped = [c.take(drop) for c in snap.columns]
-                drop_rows = list(zip(*[c.to_python() for c in dropped]))
-            for j, i in enumerate(drop):
-                h = int(handles[i])
-                t.delete(record_key(self.table_id, h))
-                if drop_rows is not None:
-                    self._delete_index_entries(
-                        t, tuple(plainify(v) for v in drop_rows[j]), h)
-            t.commit()
+            with self.schema_gate.read():
+                return self._delete_rows_locked(snap, keep_mask, handles,
+                                                deleted)
         else:
             self._base_cols = [c.take(idx) for c in snap.columns]
+        self._invalidate()
+        return deleted
+
+    def _delete_rows_locked(self, snap, keep_mask, handles, deleted) -> int:
+        t = self.kv.begin()
+        from ..store.codec import record_key
+        drop = np.nonzero(~np.asarray(keep_mask))[0]
+        # materialize ONLY the dropped rows for index-entry removal
+        drop_rows = None
+        if self.indexes and len(drop):
+            dropped = [c.take(drop) for c in snap.columns]
+            drop_rows = list(zip(*[c.to_python() for c in dropped]))
+        for j, i in enumerate(drop):
+            h = int(handles[i])
+            t.delete(record_key(self.table_id, h))
+            if drop_rows is not None:
+                self._delete_index_entries(
+                    t, tuple(plainify(v) for v in drop_rows[j]), h)
+        t.commit()
         self._invalidate()
         return deleted
 

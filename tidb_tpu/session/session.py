@@ -58,6 +58,8 @@ class Domain:
         self.privileges = PrivilegeManager()   # pkg/privilege Handle analog
         from ..planner.plan_cache import PlanCache
         self.plan_cache = PlanCache()          # instance plan cache
+        self.schema_version = 1                # bumped per DDL transition
+        self._ddl = None
         from ..utils.stmtsummary import StmtSummary
         self.stmt_summary = StmtSummary()   # util/stmtsummary analog
         self._next_table_id = 100
@@ -65,7 +67,16 @@ class Domain:
             "tidb_distsql_scan_concurrency": 15,
             "tidb_max_chunk_size": 1024,
             "tidb_enable_vectorized_expression": 1,
+            "tidb_ddl_reorg_worker_cnt": 4,
         }
+
+    @property
+    def ddl(self):
+        """Lazily-started online-DDL owner (pkg/ddl analog)."""
+        if self._ddl is None:
+            from ..ddl import DDLExecutor
+            self._ddl = DDLExecutor(self)
+        return self._ddl
 
     def alloc_table_id(self) -> int:
         self._next_table_id += 1
@@ -173,13 +184,15 @@ class Session:
             self.db = stmt.name
             return ResultSet()
         if isinstance(stmt, A.CreateIndex):
-            tbl = self.domain.catalog.get_table(self.db, stmt.table)
-            tbl.create_index(stmt.name, stmt.columns, stmt.unique,
-                             stmt.if_not_exists)
+            self.domain.catalog.get_table(self.db, stmt.table)  # exist check
+            self.domain.ddl.run_job("add index", self.db, stmt.table, {
+                "name": stmt.name, "columns": list(stmt.columns),
+                "unique": stmt.unique, "if_not_exists": stmt.if_not_exists})
             return ResultSet()
         if isinstance(stmt, A.DropIndex):
-            tbl = self.domain.catalog.get_table(self.db, stmt.table)
-            tbl.drop_index(stmt.name, stmt.if_exists)
+            self.domain.catalog.get_table(self.db, stmt.table)
+            self.domain.ddl.run_job("drop index", self.db, stmt.table, {
+                "name": stmt.name, "if_exists": stmt.if_exists})
             return ResultSet()
         if isinstance(stmt, A.AlterTable):
             return self._exec_alter(stmt)
@@ -221,6 +234,8 @@ class Session:
             tbl = self.domain.catalog.get_table(self.db, stmt.name)
             self.domain.stats.analyze_table(tbl)
             return ResultSet()
+        if isinstance(stmt, A.AdminStmt):
+            return self._exec_admin(stmt)
         raise PlanError(f"unsupported statement {type(stmt).__name__}")
 
     # ---------------- privileges ---------------- #
@@ -255,6 +270,15 @@ class Session:
             for p in stmt.privs:
                 priv.require(self.user, p if p != "ALL" else "SUPER",
                              db, table)
+            return
+        if isinstance(stmt, A.AdminStmt):
+            # reference gates ADMIN behind SUPER (planbuilder.go)
+            return priv.require(self.user, "SUPER")
+        if isinstance(stmt, A.ShowStmt) and stmt.kind == "grants":
+            if stmt.target:
+                user = stmt.target.partition("@")[0]
+                if user != self.user:
+                    return priv.require(self.user, "SUPER")
             return
         kind = type(stmt).__name__
         need = self._STMT_PRIVS.get(kind)
@@ -374,7 +398,7 @@ class Session:
         plan = optimize_plan(built.plan)
         plan = apply_index_paths(plan, self.domain.stats)
         phys = to_physical(plan)
-        if use_cache:
+        if use_cache and _plan_cacheable(phys):
             keys = {}
             for db, name in self._referenced_tables(stmt):
                 tdb = db or self.db
@@ -473,6 +497,7 @@ class Session:
                 self._finish_txn(commit=True)
             self.txn = self.domain.kv.begin()
             self._txn_tables = set()
+            self._txn_schema_ver = self.domain.schema_version
         elif stmt.kind == "commit":
             self._finish_txn(commit=True)
         else:  # rollback
@@ -489,6 +514,18 @@ class Session:
             txn.rollback()
             self._txn_tables = set()
             return
+        if getattr(self, "_txn_schema_ver", None) not in (
+                None, self.domain.schema_version):
+            # a DDL state transition happened mid-transaction: committing
+            # could miss index entries written under the old schema state
+            # (reference: ErrInfoSchemaChanged at commit, domain
+            # SchemaValidator)
+            txn.rollback()
+            self._txn_tables = set()
+            raise CatalogError(
+                "Information schema is changed during the execution of "
+                "the statement (DDL ran concurrently); transaction rolled "
+                "back, please retry")
         try:
             txn.commit()
             self._invalidate_txn_tables()
@@ -531,9 +568,12 @@ class Session:
         for act in stmt.actions:
             if act[0] == "add_index":
                 _, iname, cols, uniq = act
-                tbl.create_index(iname or "idx_" + "_".join(cols), cols, uniq)
+                self.domain.ddl.run_job("add index", self.db, tbl.name, {
+                    "name": iname or "idx_" + "_".join(cols),
+                    "columns": list(cols), "unique": uniq})
             elif act[0] == "drop_index":
-                tbl.drop_index(act[1])
+                self.domain.ddl.run_job("drop index", self.db, tbl.name,
+                                        {"name": act[1]})
             elif act[0] == "add_column":
                 self._alter_add_column(tbl, act[1])
             elif act[0] == "drop_column":
@@ -774,6 +814,49 @@ class Session:
         }[kind]
         return ResultSet(headers, rows)
 
+    def _exec_admin(self, stmt: A.AdminStmt) -> ResultSet:
+        if stmt.kind == "show ddl jobs":
+            rows = []
+            for j in self.domain.ddl.storage.all_jobs():
+                rows.append((j.job_id, j.job_type, j.db, j.table,
+                             j.schema_state, j.state, j.rows_backfilled,
+                             j.error))
+            return ResultSet(
+                ["Job_id", "Type", "Db", "Table", "Schema_state", "State",
+                 "Row_count", "Error"], rows)
+        if stmt.kind == "check table":
+            return self._admin_check_table(stmt.target)
+        raise PlanError(f"unsupported ADMIN {stmt.kind}")
+
+    def _admin_check_table(self, name: str) -> ResultSet:
+        """Row <-> index consistency check (executor/check_table_index.go
+        analog): recompute every index entry from rows and compare with
+        the stored index keyspace."""
+        tbl = self.domain.catalog.get_table(self.db, name)
+        if tbl.kv is None:
+            return ResultSet()   # bulk snapshots carry no indexes
+        from ..session.codec_io import scan_table_rows
+        from ..store.codec import index_prefix, index_prefix_end
+        ts = tbl.kv.alloc_ts()
+        handles, rows = scan_table_rows(tbl.kv, tbl.table_id, ts,
+                                        tbl.col_types)
+        for ix in tbl.indexes:
+            if ix.state != "public":
+                continue
+            want = set()
+            for h, r in zip(handles, rows):
+                key, _ = tbl._index_entry(ix, tuple(r), int(h))
+                want.add(key)
+            got = {k for k, _ in tbl.kv.scan(
+                index_prefix(tbl.table_id, ix.index_id),
+                index_prefix_end(tbl.table_id, ix.index_id), ts)}
+            if want != got:
+                raise CatalogError(
+                    f"admin check table {name}: index {ix.name!r} "
+                    f"inconsistent (missing {len(want - got)}, "
+                    f"orphan {len(got - want)})")
+        return ResultSet()
+
     def _literal_value(self, node: A.Node):
         if isinstance(node, A.Lit):
             if node.kind in ("int", "bool"):
@@ -788,6 +871,20 @@ class Session:
             return -v if not isinstance(v, str) else "-" + v
         raise PlanError("INSERT values must be literals")
 
+
+
+def _plan_cacheable(phys) -> bool:
+    """A cached plan must hold no materialized row state: CTE scans carry
+    a shared storage (executor CTEScanExec.storage) that memoizes results
+    and races across sessions — exclude them (the reference likewise
+    skips caching for non-deterministic/stateful plans)."""
+    stack = [phys]
+    while stack:
+        p = stack.pop()
+        if hasattr(p, "storage"):
+            return False
+        stack.extend(getattr(p, "children", ()))
+    return True
 
 
 def _flag_on(merged: dict, name: str, default: bool = True) -> bool:

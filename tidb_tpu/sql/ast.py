@@ -389,4 +389,12 @@ class FlushStmt(Node):
     what: str = "privileges"
 
 
+@dataclass
+class AdminStmt(Node):
+    """ADMIN SHOW DDL JOBS | ADMIN CHECK TABLE t (reference:
+    ast.AdminStmt)."""
+    kind: str = ""                  # 'show ddl jobs' | 'check table'
+    target: Optional[str] = None
+
+
 __all__ = [n for n in dir() if n[0].isupper()]

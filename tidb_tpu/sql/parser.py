@@ -149,6 +149,8 @@ class Parser:
         if self.cur.kind == "ident" and self.cur.text.upper() in (
                 "PREPARE", "EXECUTE", "DEALLOCATE"):
             return self._prepare_family()
+        if self.at_kw("ADMIN"):
+            return self.admin_stmt()
         if self.at_kw("GRANT"):
             return self.grant_stmt()
         if self.at_kw("REVOKE"):
@@ -158,6 +160,23 @@ class Parser:
             self.expect_kw("PRIVILEGES")
             return A.FlushStmt("privileges")
         raise ParseError("unsupported statement", self.cur)
+
+    def admin_stmt(self) -> A.AdminStmt:
+        self.expect_kw("ADMIN")
+        if self.accept_kw("SHOW"):
+            # ADMIN SHOW DDL JOBS
+            t = self.cur
+            if t.kind == "ident" and t.text.upper() == "DDL":
+                self.advance()
+                t2 = self.cur
+                if t2.kind == "ident" and t2.text.upper() == "JOBS":
+                    self.advance()
+                return A.AdminStmt("show ddl jobs")
+            raise ParseError("unsupported ADMIN SHOW", t)
+        if self.accept_kw("CHECK"):
+            self.expect_kw("TABLE")
+            return A.AdminStmt("check table", self.ident())
+        raise ParseError("unsupported ADMIN", self.cur)
 
     def _prepare_family(self) -> A.Node:
         word = self.advance().text.upper()
@@ -231,11 +250,13 @@ class Parser:
                 return privs
 
     def _priv_level(self) -> tuple[str, str]:
-        """db.table | db.* | *.* | table"""
+        """db.table | db.* | *.* | * (current db) | table"""
         if self.accept_op("*"):
             if self.accept_op("."):
                 self.expect_op("*")
-            return "*", "*"
+                return "*", "*"
+            # bare '*' is MySQL's current-database level, NOT global
+            return "", "*"
         name = self.ident()
         if self.accept_op("."):
             if self.accept_op("*"):
