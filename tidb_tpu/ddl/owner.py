@@ -54,6 +54,8 @@ class DDLExecutor:
         # owner-failover resume (reorg.go analog): re-queue jobs that were
         # queued/running when the previous owner stopped; their reorg
         # checkpoint makes the backfill skip completed subtask ranges
+        for done_job in self.storage.history():
+            self._next_job_id = max(self._next_job_id, done_job.job_id)
         for job in self.storage.pending():
             self._next_job_id = max(self._next_job_id, job.job_id)
             self._queue.put(job)
@@ -158,7 +160,11 @@ class DDLExecutor:
             self._bump_schema(job, "public")
             tbl._invalidate()
         except Exception:
-            tbl.indexes.remove(ix)
+            # rollback under the write gate: writers iterating
+            # tbl.indexes must not observe the removal mid-statement, and
+            # none may still write entries when the wipe scans
+            with tbl.schema_gate.write():
+                tbl.indexes.remove(ix)
             self._wipe_index(tbl, ix)
             raise
 
@@ -186,6 +192,7 @@ class DDLExecutor:
                 batch = chunk[off:off + BATCH]
                 for attempt in range(5):
                     txn = kv.begin()
+                    written = 0
                     try:
                         for i, h in batch:
                             # recheck row existence at this txn's snapshot:
@@ -194,6 +201,7 @@ class DDLExecutor:
                             if txn.get(record_key(tbl.table_id, h)) is None:
                                 continue
                             tbl._put_index_entry(txn, ix, tuple(rows[i]), h)
+                            written += 1
                         txn.commit()
                         break
                     except DuplicateKeyError:
@@ -206,9 +214,9 @@ class DDLExecutor:
                         if attempt == 4:
                             raise
                         time.sleep(0.002 * (attempt + 1))
-                done += len(batch)
+                done += written
                 with self._mu:
-                    job.rows_backfilled += len(batch)
+                    job.rows_backfilled += written
             return done
 
         with ThreadPoolExecutor(max_workers=max(workers, 1),

@@ -106,10 +106,15 @@ class TableInfo:
     # schema-lease wait analog, utils/rwlock.py)
     schema_gate: Any = None
 
+    _alloc_mu: Any = None
+
     def __post_init__(self):
+        import threading
         if self.schema_gate is None:
             from ..utils.rwlock import RWLock
             self.schema_gate = RWLock()
+        if self._alloc_mu is None:
+            self._alloc_mu = threading.Lock()
 
     # ---------------- index helpers ---------------- #
 
@@ -213,30 +218,35 @@ class TableInfo:
         fixed = []
         ai_idx = (self.col_names.index(self.auto_inc_col)
                   if self.auto_inc_col else -1)
-        for r in rows:
-            r = list(r)
-            if ai_idx >= 0 and r[ai_idx] is None:
-                self._auto_inc += 1
-                r[ai_idx] = self._auto_inc
-            elif ai_idx >= 0 and isinstance(r[ai_idx], int):
-                self._auto_inc = max(self._auto_inc, r[ai_idx])
-            for i, t in enumerate(self.col_types):
-                if r[i] is None and not t.nullable:
-                    raise CatalogError(
-                        f"column {self.col_names[i]!r} cannot be null")
-            fixed.append(tuple(r))
+        with self._alloc_mu:
+            # handle/auto-inc allocation is a critical section: concurrent
+            # inserters hold the schema gate's READ side together, so the
+            # counters need their own lock (autoid allocator analog)
+            for r in rows:
+                r = list(r)
+                if ai_idx >= 0 and r[ai_idx] is None:
+                    self._auto_inc += 1
+                    r[ai_idx] = self._auto_inc
+                elif ai_idx >= 0 and isinstance(r[ai_idx], int):
+                    self._auto_inc = max(self._auto_inc, r[ai_idx])
+                for i, t in enumerate(self.col_types):
+                    if r[i] is None and not t.nullable:
+                        raise CatalogError(
+                            f"column {self.col_names[i]!r} cannot be null")
+                fixed.append(tuple(r))
+            first_handle = self._next_handle + 1
+            self._next_handle += len(fixed)
         if self.kv is not None:
             own = txn is None
             with self.schema_gate.read():
                 t = txn or self.kv.begin()
                 try:
-                    for r in fixed:
-                        self._next_handle += 1
-                        key, val = encode_table_row(self.table_id,
-                                                    self._next_handle,
+                    for j, r in enumerate(fixed):
+                        h = first_handle + j
+                        key, val = encode_table_row(self.table_id, h,
                                                     r, self.col_types)
                         t.put(key, val)
-                        self._write_index_entries(t, r, self._next_handle)
+                        self._write_index_entries(t, r, h)
                     if own:
                         t.commit()
                 except Exception:

@@ -60,6 +60,8 @@ class Domain:
         self.plan_cache = PlanCache()          # instance plan cache
         self.schema_version = 1                # bumped per DDL transition
         self._ddl = None
+        import threading
+        self._ddl_mu = threading.Lock()
         from ..utils.stmtsummary import StmtSummary
         self.stmt_summary = StmtSummary()   # util/stmtsummary analog
         self._next_table_id = 100
@@ -74,8 +76,10 @@ class Domain:
     def ddl(self):
         """Lazily-started online-DDL owner (pkg/ddl analog)."""
         if self._ddl is None:
-            from ..ddl import DDLExecutor
-            self._ddl = DDLExecutor(self)
+            with self._ddl_mu:
+                if self._ddl is None:
+                    from ..ddl import DDLExecutor
+                    self._ddl = DDLExecutor(self)
         return self._ddl
 
     def alloc_table_id(self) -> int:
@@ -155,8 +159,18 @@ class Session:
 
     # ------------------------------------------------------------- #
 
+    # statements that implicitly commit an open transaction first
+    _IMPLICIT_COMMIT = ("CreateTable", "DropTable", "CreateIndex",
+                        "DropIndex", "AlterTable", "TruncateTable",
+                        "CreateDatabase", "DropDatabase", "CreateUser",
+                        "AlterUser", "DropUser", "GrantStmt", "RevokeStmt")
+
     def _exec_stmt(self, stmt: A.Node) -> ResultSet:
         self._check_privileges(stmt)
+        if (self.txn is not None
+                and type(stmt).__name__ in self._IMPLICIT_COMMIT):
+            # MySQL semantics: DDL implicitly commits the open transaction
+            self._finish_txn(commit=True)
         if isinstance(stmt, (A.CreateUser, A.AlterUser, A.DropUser,
                              A.GrantStmt, A.RevokeStmt, A.FlushStmt)):
             return self._exec_user_admin(stmt)

@@ -171,7 +171,8 @@ class Parser:
                 t2 = self.cur
                 if t2.kind == "ident" and t2.text.upper() == "JOBS":
                     self.advance()
-                return A.AdminStmt("show ddl jobs")
+                    return A.AdminStmt("show ddl jobs")
+                raise ParseError("expected JOBS after ADMIN SHOW DDL", t2)
             raise ParseError("unsupported ADMIN SHOW", t)
         if self.accept_kw("CHECK"):
             self.expect_kw("TABLE")
