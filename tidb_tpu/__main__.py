@@ -30,6 +30,7 @@ def cmd_serve(args) -> int:
     import time
     from .server import MySQLServer, StatusServer
     dom = _domain()
+    dom.start_background()
     srv = MySQLServer(dom, host=args.host, port=args.port)
     port = srv.start()
     st = StatusServer(dom, host=args.host, port=args.status_port)

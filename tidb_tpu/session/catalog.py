@@ -101,6 +101,10 @@ class TableInfo:
     _next_handle: int = 0
     _next_index_id: int = 0
     n_shards: int = 8
+    # row TTL (pkg/ttl): rows with ttl_col older than now-interval expire
+    ttl_col: Optional[str] = None
+    ttl_interval_sec: int = 0
+    ttl_enable: bool = True
     # schema gate: writers hold read side per statement; online-DDL state
     # transitions take the write side to drain in-flight writers (the F1
     # schema-lease wait analog, utils/rwlock.py)

@@ -82,6 +82,40 @@ class Domain:
                     self._ddl = DDLExecutor(self)
         return self._ddl
 
+    def start_background(self):
+        """Start the domain's background workers (domain.go:146 Init
+        analog): GC, TTL, auto-analyze on the timer framework."""
+        if getattr(self, "timers", None) is not None:
+            return self.timers
+        from ..store.gcworker import GCWorker
+        from ..timer import TimerFramework
+        from ..ttl import run_ttl_sweep
+        life = float(self.sysvars.get("tidb_gc_life_time_sec", 600))
+        self.gc_worker = GCWorker(self.kv, life)
+        self.timers = TimerFramework()
+        self.timers.register(
+            "gc", float(self.sysvars.get("tidb_gc_run_interval_sec", 60)),
+            self.gc_worker.run_once)
+        self.timers.register(
+            "ttl", float(self.sysvars.get("tidb_ttl_job_interval_sec", 60)),
+            lambda: run_ttl_sweep(self))
+        self.timers.register("auto-analyze", 30.0, self._auto_analyze_sweep)
+        self.timers.start()
+        return self.timers
+
+    def _auto_analyze_sweep(self):
+        """Background auto-analyze (handle/autoanalyze.go worker)."""
+        for db, tables in list(self.catalog.databases.items()):
+            for tbl in list(tables.values()):
+                if self.stats.needs_auto_analyze(tbl):
+                    self.stats.analyze_table(tbl)
+
+    def close(self):
+        if getattr(self, "timers", None) is not None:
+            self.timers.close()
+        if self._ddl is not None:
+            self._ddl.close()
+
     def alloc_table_id(self) -> int:
         self._next_table_id += 1
         return self._next_table_id
@@ -565,6 +599,16 @@ class Session:
         tbl = TableInfo(stmt.name, names, types, stmt.primary_key, auto_inc,
                         table_id=self.domain.alloc_table_id(),
                         kv=self.domain.kv)
+        if stmt.ttl is not None:
+            if stmt.ttl.column not in names:
+                raise CatalogError(
+                    f"unknown TTL column {stmt.ttl.column!r}")
+            t = types[names.index(stmt.ttl.column)]
+            if t.kind not in (dt.TypeKind.DATE, dt.TypeKind.DATETIME):
+                raise CatalogError("TTL column must be DATE or DATETIME")
+            tbl.ttl_col = stmt.ttl.column
+            tbl.ttl_interval_sec = stmt.ttl.interval_sec
+            tbl.ttl_enable = stmt.ttl.enable
         self.domain.catalog.create_table(self.db, tbl, stmt.if_not_exists)
         created = self.domain.catalog.get_table(self.db, stmt.name)
         if created is tbl:
@@ -661,7 +705,11 @@ class Session:
                 full.append(tuple(
                     r[idx[n]] if n in idx else None for n in tbl.col_names))
             rows = full
-        n = tbl.insert_rows(rows, txn=self.txn)
+        if self.txn is None:
+            n = self._retry_write_conflict(
+                lambda: tbl.insert_rows(rows, txn=None))
+        else:
+            n = tbl.insert_rows(rows, txn=self.txn)
         if self.txn is not None:
             self._txn_tables.add(tbl)
         self.domain.stats.note_modify(tbl, n)
@@ -691,7 +739,24 @@ class Session:
             v = v & np.broadcast_to(np.asarray(m), (n,))
         return v
 
+    def _retry_write_conflict(self, fn, attempts: int = 8):
+        """Re-run an autocommit DML on optimistic write conflict / lock
+        (session doCommitWithRetry analog, session.go:798): the statement
+        recomputes against a fresh snapshot each attempt."""
+        import time as _t
+        from ..store.kv import KVError
+        for a in range(attempts):
+            try:
+                return fn()
+            except KVError as e:
+                if e.code not in (1, 2) or a == attempts - 1:
+                    raise
+                _t.sleep(0.002 * (a + 1))
+
     def _exec_update(self, stmt: A.Update) -> ResultSet:
+        return self._retry_write_conflict(lambda: self._do_update(stmt))
+
+    def _do_update(self, stmt: A.Update) -> ResultSet:
         tbl = self.domain.catalog.get_table(self.db, stmt.table)
         snap = tbl.snapshot()
         mask = self._where_mask(tbl, stmt.where)
@@ -735,6 +800,9 @@ class Session:
         return ResultSet(affected=n_aff)
 
     def _exec_delete(self, stmt: A.Delete) -> ResultSet:
+        return self._retry_write_conflict(lambda: self._do_delete(stmt))
+
+    def _do_delete(self, stmt: A.Delete) -> ResultSet:
         tbl = self.domain.catalog.get_table(self.db, stmt.table)
         if stmt.where is None:
             n = tbl.truncate()

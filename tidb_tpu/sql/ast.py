@@ -205,6 +205,14 @@ class ColumnDef(Node):
 
 
 @dataclass
+class TTLOption(Node):
+    """TTL = col + INTERVAL n unit (reference: ast.TableOption TTL)."""
+    column: str = ""
+    interval_sec: int = 0
+    enable: bool = True
+
+
+@dataclass
 class CreateTable(Node):
     name: str
     columns: list[ColumnDef] = field(default_factory=list)
@@ -212,6 +220,7 @@ class CreateTable(Node):
     if_not_exists: bool = False
     # inline index defs: (name_or_None, [cols], unique)
     indexes: list[tuple] = field(default_factory=list)
+    ttl: Optional[TTLOption] = None
 
 
 @dataclass

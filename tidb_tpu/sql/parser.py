@@ -587,9 +587,36 @@ class Parser:
             if not self.accept_op(","):
                 break
         self.expect_op(")")
-        # table options (ENGINE=..., CHARSET=...): skip to end
+        # table options: TTL is honored, the rest (ENGINE=, CHARSET=...)
+        # are accepted and ignored
         while not (self.at_op(";") or self.cur.kind == "eof"):
-            self.advance()
+            if self.cur.kind == "ident" and self.cur.text.upper() == "TTL":
+                self.advance()
+                self.expect_op("=")
+                col = self.ident()
+                self.expect_op("+")
+                self.expect_kw("INTERVAL")
+                n = self._int_lit()
+                unit = self.advance().text.upper()
+                secs = {"SECOND": 1, "MINUTE": 60, "HOUR": 3600,
+                        "DAY": 86400, "WEEK": 7 * 86400,
+                        "MONTH": 30 * 86400, "YEAR": 365 * 86400}.get(unit)
+                if secs is None:
+                    raise ParseError("bad TTL unit", self.cur)
+                if ct.ttl is None:
+                    ct.ttl = A.TTLOption(col, n * secs)
+                else:
+                    ct.ttl.column, ct.ttl.interval_sec = col, n * secs
+            elif (self.cur.kind == "ident"
+                  and self.cur.text.upper() == "TTL_ENABLE"):
+                self.advance()
+                self.expect_op("=")
+                t = self.advance()   # 'ON' / 'OFF' string literal
+                if ct.ttl is None:
+                    ct.ttl = A.TTLOption()
+                ct.ttl.enable = t.text.upper() != "OFF"
+            else:
+                self.advance()
         for c in ct.columns:
             if c.primary_key and c.name not in ct.primary_key:
                 ct.primary_key.append(c.name)
