@@ -1,0 +1,62 @@
+"""Driver entry-point contracts (round 1 regression: BENCH_r01 crash,
+MULTICHIP_r01 timeout — both were backend-init fragility, not logic).
+
+These run the real files in fresh subprocesses with the default (possibly
+hanging-TPU) environment to prove:
+  - dryrun_multichip never touches the TPU backend and finishes fast
+  - bench.py always emits one JSON line even when the default backend hangs
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _clean_env():
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # simulate driver default env
+    env.pop("XLA_FLAGS", None)
+    return env
+
+
+def test_dryrun_multichip_cpu_only_and_fast():
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"],
+        cwd=REPO, env=_clean_env(), capture_output=True, text=True,
+        timeout=60)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "dryrun_multichip(8)" in out.stdout
+
+
+def test_entry_compiles_single_chip():
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+         "from __graft_entry__ import entry\n"
+         "fn, args = entry()\n"
+         "res = jax.jit(fn)(*args)\n"
+         "print('compiled', len(res))"],
+        cwd=REPO, env=_clean_env(), capture_output=True, text=True,
+        timeout=120)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "compiled" in out.stdout
+
+
+def test_bench_emits_json_even_when_default_backend_hangs():
+    # BENCH_TEST_HANG forces the non-cpu child to hang, deterministically
+    # exercising the timeout -> killpg -> CPU-fallback path on any host.
+    env = _clean_env()
+    env.update(BENCH_SF="0.01", BENCH_ITERS="1", BENCH_TPU_TIMEOUT="15",
+               BENCH_CPU_TIMEOUT="200", BENCH_TEST_HANG="1")
+    out = subprocess.run(
+        [sys.executable, "bench.py"], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=280)
+    assert out.returncode == 0, (out.stdout, out.stderr[-2000:])
+    line = [l for l in out.stdout.splitlines() if l.strip().startswith("{")][-1]
+    rec = json.loads(line)
+    assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
+    assert rec["value"] > 0
