@@ -173,3 +173,47 @@ def test_unqualified_grant_level_uses_current_db(dom):
     # granter's own privilege check
     d.execute("grant select on uq to grantee")
     assert dom.privileges.check("grantee", "SELECT", "test", "uq")
+
+
+def test_use_database_requires_access(dom):
+    """ADVICE r1 (low): USE checks db visibility."""
+    root = _sess(dom, "root")
+    root.execute("create database hidden_db")
+    root.execute("create database open_db")
+    root.execute("use open_db")
+    root.execute("create table seen (a bigint)")
+    root.execute("create user peeker")
+    root.execute("grant select on open_db.seen to peeker")
+    p = _sess(dom, "peeker")
+    p.execute("use open_db")          # table-level grant gives visibility
+    with pytest.raises(PrivilegeError):
+        p.execute("use hidden_db")
+
+
+def test_show_processlist_requires_process_priv(dom):
+    root = _sess(dom, "root")
+    root.execute("create user watcher")
+    root.execute("grant select on *.* to watcher")
+    w = _sess(dom, "watcher")
+    rows = w.must_query("show processlist")
+    own = {sid for sid, s in dom.sessions() if s.user == "watcher"}
+    assert rows and {r[0] for r in rows} == own  # only own sessions
+    root.execute("grant process on *.* to watcher")
+    rows_all = w.must_query("show processlist")
+    assert len(rows_all) >= 2  # root's sessions now visible too
+
+
+def test_update_delete_with_where_require_select(dom):
+    root = _sess(dom, "root")
+    root.execute("create table audit_t (a bigint, b bigint)")
+    root.execute("insert into audit_t values (1, 2)")
+    root.execute("create user blindwriter")
+    root.execute("grant update, delete on test.audit_t to blindwriter")
+    b = _sess(dom, "blindwriter")
+    with pytest.raises(PrivilegeError):
+        b.execute("update audit_t set b = 3 where a = 1")
+    with pytest.raises(PrivilegeError):
+        b.execute("delete from audit_t where a = 1")
+    root.execute("grant select on test.audit_t to blindwriter")
+    b.execute("update audit_t set b = 3 where a = 1")
+    b.execute("delete from audit_t where a = 1")

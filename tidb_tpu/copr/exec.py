@@ -136,19 +136,23 @@ def _agg_partial_states(agg: D.Aggregation, batch: DeviceBatch, ev: Evaluator,
         cnt = _reduce(mask.astype(jnp.int64), mask, gids, num_groups, "sum")
         if a.func == D.AggFunc.SUM:
             kind = a.arg.dtype.kind
-            if kind == K.DECIMAL:
+            if kind in (K.FLOAT64, K.FLOAT32):
+                states[key] = {"sum": _reduce(av.astype(jnp.float64), mask, gids,
+                                              num_groups, "sum"), "cnt": cnt}
+            else:
+                # decimal AND integer sums accumulate as (hi, lo) int64
+                # limbs.  Exactness argument (types/decimal.py): per row
+                # |hi| < 2^32 and lo < 2^32, so with n < 2^31 rows per
+                # batch neither limb sum can wrap int64; recombination is
+                # exact.  n is a static shape, so this fence is free.
+                if n >= 2 ** 31:
+                    raise OverflowError(
+                        f"shard batch of {n} rows exceeds the 2^31 limb-"
+                        "exact SUM bound; use more/smaller shards")
                 v = av.astype(jnp.int64)
                 hi = _reduce(v >> 32, mask, gids, num_groups, "sum")
                 lo = _reduce(v & 0xFFFFFFFF, mask, gids, num_groups, "sum")
                 states[key] = {"hi": hi, "lo": lo, "cnt": cnt}
-            elif kind in (K.FLOAT64, K.FLOAT32):
-                states[key] = {"sum": _reduce(av.astype(jnp.float64), mask, gids,
-                                              num_groups, "sum"), "cnt": cnt}
-            else:
-                if av.dtype == bool:
-                    av = av.astype(jnp.int64)
-                states[key] = {"sum": _reduce(av.astype(jnp.int64), mask, gids,
-                                              num_groups, "sum"), "cnt": cnt}
         elif a.func == D.AggFunc.MIN:
             states[key] = {"min": _reduce(av, mask, gids, num_groups, "min"),
                            "cnt": cnt}

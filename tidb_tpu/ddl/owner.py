@@ -76,6 +76,12 @@ class DDLExecutor:
         self.storage.save(job)
         self._queue.put(job)
         if not ev.wait(timeout):
+            # deregister the waiter so the eventually-finishing job doesn't
+            # leak _events/_excs entries; the job itself keeps running and
+            # its completion lands in history (ADMIN SHOW DDL JOBS)
+            with self._mu:
+                self._events.pop(job.job_id, None)
+                self._excs.pop(job.job_id, None)
             raise DDLError(f"DDL job {job.job_id} timed out")
         with self._mu:
             del self._events[job.job_id]
@@ -102,7 +108,8 @@ class DDLExecutor:
                 job.state = "failed"
                 job.error = f"{type(e).__name__}: {e}"
                 with self._mu:
-                    self._excs[job.job_id] = e
+                    if job.job_id in self._events:  # waiter still present
+                        self._excs[job.job_id] = e
             job.finish_time = time.time()
             job.schema_state = ("public" if job.state == "done"
                                 and job.job_type.startswith("add")
@@ -173,13 +180,14 @@ class DDLExecutor:
         entries in parallel subtask ranges (DXF); the checkpoint only
         advances over the contiguous completed prefix of subtasks, so a
         resumed job never skips an unfinished range."""
-        from ..session.codec_io import scan_table_rows
-        from ..store.codec import record_key
+        from ..store.codec import (decode_record_key, decode_row, record_key,
+                                   record_prefix, record_prefix_end)
         kv = tbl.kv
         ts = kv.alloc_ts()
-        handles, rows = scan_table_rows(kv, tbl.table_id, ts, tbl.col_types)
+        handles = [decode_record_key(k)[1] for k, _ in kv.scan(
+            record_prefix(tbl.table_id), record_prefix_end(tbl.table_id), ts)]
         start = job.reorg_handle          # resume point
-        todo = [(i, int(h)) for i, h in enumerate(handles) if h > start]
+        todo = [int(h) for h in handles if h > start]
         if not todo:
             return
         workers = int(self.domain.sysvars.get(
@@ -194,13 +202,24 @@ class DDLExecutor:
                     txn = kv.begin()
                     written = 0
                     try:
-                        for i, h in batch:
-                            # recheck row existence at this txn's snapshot:
-                            # a concurrent DELETE/UPDATE must not leave an
-                            # orphan entry from the stale scan
-                            if txn.get(record_key(tbl.table_id, h)) is None:
+                        for h in batch:
+                            # re-read the row at this txn's snapshot (not
+                            # the stale scan): a concurrent DELETE must not
+                            # leave an orphan entry, and a concurrent
+                            # UPDATE's values must win.  Re-putting the
+                            # record key forces a write-write conflict at
+                            # commit with any racing row mutation (the
+                            # reference locks the row key during backfill),
+                            # so a mutation that lands between this read
+                            # and commit aborts the batch instead of
+                            # silently racing it.
+                            rk = record_key(tbl.table_id, h)
+                            rv = txn.get(rk)
+                            if rv is None:
                                 continue
-                            tbl._put_index_entry(txn, ix, tuple(rows[i]), h)
+                            txn.put(rk, rv)
+                            row = decode_row(rv, tbl.col_types)
+                            tbl._put_index_entry(txn, ix, tuple(row), h)
                             written += 1
                         txn.commit()
                         break
@@ -226,7 +245,7 @@ class DDLExecutor:
             # last handle (per-subtask durability, DXF subtask states)
             for k, _n in enumerate(pool.map(run_subtask, subtasks)):
                 with self._mu:
-                    job.reorg_handle = subtasks[k][-1][1]
+                    job.reorg_handle = subtasks[k][-1]
                     self.storage.save(job)
 
     # ---------------- DROP INDEX ---------------- #

@@ -80,6 +80,15 @@ class ShardedCopProgram:
         # Sums/counts still psum over ICI — the seam BASELINE.json names.
         self.host_merge = self.agg is not None and any(
             a.func in (D.AggFunc.MIN, D.AggFunc.MAX) for a in self.agg.aggs)
+        # int/decimal SUMs produce (hi, lo) limb states whose in-program
+        # psum is int64-exact only below 2^31 global rows; float sums,
+        # counts, and host-merged (object-int) programs are exempt
+        from ..types.dtypes import TypeKind as _K
+        self._psum_limb_fence = (
+            self.agg is not None and not self.host_merge and any(
+                a.func == D.AggFunc.SUM and a.arg is not None
+                and a.arg.dtype.kind not in (_K.FLOAT64, _K.FLOAT32)
+                for a in self.agg.aggs))
 
         in_specs = (P(SHARD_AXIS), P(SHARD_AXIS), P())  # aux replicated
         if self.kind == "agg":
@@ -113,6 +122,15 @@ class ShardedCopProgram:
         return out_cols, n[None]
 
     def __call__(self, stacked_cols: Sequence, counts, aux_cols=()):
+        if self._psum_limb_fence and stacked_cols:
+            s, c = stacked_cols[0][0].shape[:2]
+            # limb-exactness fence at the psum seam: the in-program psum of
+            # (hi, lo) SUM limbs stays int64-exact only while the global
+            # row capacity is < 2^31 (see copr/exec._agg_partial_states)
+            if s * c >= 2 ** 31:
+                raise OverflowError(
+                    f"global capacity {s}x{c} exceeds the 2^31 limb-exact "
+                    "SUM bound for in-program psum merge")
         return self._fn(tuple(stacked_cols), counts, tuple(aux_cols))
 
 

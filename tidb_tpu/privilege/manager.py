@@ -142,6 +142,19 @@ class PrivilegeManager:
             return True
         return False
 
+    def has_db_access(self, user: str, db: str) -> bool:
+        """USE/COM_INIT_DB check: any privilege at global, db, or
+        any-table-in-db level grants visibility (mysql checkGrantDB)."""
+        rec = self._match(user)
+        if rec is None:
+            return False
+        if rec.global_privs:
+            return True
+        if rec.db_privs.get(db):
+            return True
+        return any(d == db and privs
+                   for (d, _t), privs in rec.table_privs.items())
+
     def require(self, user: str, priv: str, db: str = "", table: str = ""):
         if not self.check(user, priv, db, table):
             target = f"table '{db}.{table}'" if table else (

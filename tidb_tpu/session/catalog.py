@@ -148,12 +148,15 @@ class TableInfo:
                 f"Duplicate entry for key '{self.name}.{ix.name}'")
         txn.put(key, val)
 
+    def writable_indexes(self):
+        """F1 online-DDL contract (ddl/index.go): an index in 'none' or
+        'delete only' does not receive new entries from inserts.  Single
+        source of truth for every write path (DML, backfill, bulk import)."""
+        return [ix for ix in self.indexes
+                if ix.state not in ("none", "delete only")]
+
     def _write_index_entries(self, txn, row: tuple, handle: int):
-        for ix in self.indexes:
-            # F1 online-DDL contract (ddl/index.go): an index in 'none' or
-            # 'delete only' does not receive new entries from inserts
-            if ix.state in ("none", "delete only"):
-                continue
+        for ix in self.writable_indexes():
             self._put_index_entry(txn, ix, row, handle)
 
     def _delete_index_entries(self, txn, row: tuple, handle: int):

@@ -322,6 +322,13 @@ class Session:
         if isinstance(stmt, A.AdminStmt):
             # reference gates ADMIN behind SUPER (planbuilder.go)
             return priv.require(self.user, "SUPER")
+        if isinstance(stmt, A.UseDatabase):
+            from ..privilege.manager import PrivilegeError
+            if not priv.has_db_access(self.user, stmt.name):
+                raise PrivilegeError(
+                    f"Access denied for user '{self.user}' to database "
+                    f"'{stmt.name}'")
+            return
         if isinstance(stmt, A.ShowStmt) and stmt.kind == "grants":
             if stmt.target:
                 user = stmt.target.partition("@")[0]
@@ -334,6 +341,15 @@ class Session:
             return
         if isinstance(stmt, A.Insert) and stmt.select is not None:
             self._check_privileges(stmt.select)
+        if isinstance(stmt, (A.Update, A.Delete)):
+            # reading columns (WHERE clause, or non-literal SET exprs)
+            # additionally requires SELECT (planbuilder visitInfo)
+            reads = getattr(stmt, "where", None) is not None or any(
+                not isinstance(e, A.Lit)
+                for _c, e in getattr(stmt, "assignments", ()))
+            if reads:
+                priv.require(self.user, "SELECT", self.db,
+                             getattr(stmt, "table", ""))
         target = getattr(stmt, "table", None) or getattr(stmt, "name", "")
         if isinstance(stmt, A.DropTable):
             for n in stmt.names:
@@ -843,11 +859,15 @@ class Session:
             return ResultSet(["Query", "Latency_ms", "Rows"],
                              self.domain.stmt_summary.slow_rows())
         if stmt.kind == "processlist":
+            # without PROCESS, only the caller's own sessions are visible
+            # (mysql semantics; reference executor/show.go)
+            see_all = self.domain.privileges.check(self.user, "PROCESS")
             return ResultSet(
                 ["Id", "db", "Command", "State"],
                 [(sid, sess.db, "Sleep" if sess is not self else "Query",
                   "autocommit" if sess.txn is None else "in transaction")
-                 for sid, sess in self.domain.sessions()])
+                 for sid, sess in self.domain.sessions()
+                 if see_all or sess.user == self.user])
         if stmt.kind == "grants":
             if stmt.target:
                 user, _, host = stmt.target.partition("@")

@@ -72,29 +72,35 @@ def import_csv(domain, db: str, table: str, path: str,
         ci, chunk = arg
         if ci in done:
             return 0
-        pairs = []
-        handle = starts[ci]
-        for raw in chunk:
-            if len(raw) != len(tbl.col_names):
-                raise ValueError(
-                    f"row width {len(raw)} != {len(tbl.col_names)} "
-                    f"columns: {raw!r}")
-            vals = tuple(to_value(c, t)
-                         for c, t in zip(raw, tbl.col_types))
-            for i, t in enumerate(tbl.col_types):
-                if vals[i] is None and not t.nullable:
+        # hold the schema gate across the chunk: a concurrent online DDL
+        # transition (or its rollback wipe) must not interleave with this
+        # ingest, and index entries are written only for indexes whose F1
+        # state accepts writes ('none'/'delete only' must NOT receive
+        # inserts — mirrors catalog._write_index_entries)
+        with tbl.schema_gate.read():
+            pairs = []
+            handle = starts[ci]
+            for raw in chunk:
+                if len(raw) != len(tbl.col_names):
                     raise ValueError(
-                        f"NULL in NOT NULL column {tbl.col_names[i]!r}")
-            handle += 1
-            pairs.append(encode_table_row(tbl.table_id, handle, vals,
-                                          tbl.col_types))
-            for ix in tbl.indexes:
-                pairs.append(tbl._index_entry(ix, vals, handle))
-        pairs.sort(key=lambda kv: kv[0])   # sorted ingest (SST build)
-        txn = tbl.kv.begin()
-        for k, v in pairs:
-            txn.put(k, v)
-        txn.commit()
+                        f"row width {len(raw)} != {len(tbl.col_names)} "
+                        f"columns: {raw!r}")
+                vals = tuple(to_value(c, t)
+                             for c, t in zip(raw, tbl.col_types))
+                for i, t in enumerate(tbl.col_types):
+                    if vals[i] is None and not t.nullable:
+                        raise ValueError(
+                            f"NULL in NOT NULL column {tbl.col_names[i]!r}")
+                handle += 1
+                pairs.append(encode_table_row(tbl.table_id, handle, vals,
+                                              tbl.col_types))
+                for ix in tbl.writable_indexes():
+                    pairs.append(tbl._index_entry(ix, vals, handle))
+            pairs.sort(key=lambda kv: kv[0])   # sorted ingest (SST build)
+            txn = tbl.kv.begin()
+            for k, v in pairs:
+                txn.put(k, v)
+            txn.commit()
         return len(chunk)
 
     total = 0

@@ -58,8 +58,13 @@ DECIMAL64_MAX_PRECISION = 18
 
 # Max digits of a "wide" decimal (host-side aggregation results).  Mirrors
 # the reference's SUM result widening (expression/aggregation: SUM over
-# DECIMAL(p,s) -> DECIMAL(min(p+22,65),s), mydecimal.go) bounded to 38 so
-# the exact value always fits the device's two-int64-limb partial states.
+# DECIMAL(p,s) -> DECIMAL(min(p+22,65),s), mydecimal.go), bounded to 38.
+# Exactness: per-row |value| < 10^19 (decimal64/int64), so limb splits have
+# |hi|,lo < 2^32; batches are fenced to < 2^31 rows (copr/exec.py), keeping
+# int64 limb sums wrap-free, and cross-shard merges are exact (object ints
+# host-side; the psum path is fenced to < 2^31 global rows in
+# parallel/spmd.py).  Attainable sums are therefore always exact; 38 is the
+# declared-type ceiling, not an exactness claim beyond those fences.
 DECIMAL_MAX_PRECISION = 38
 
 
