@@ -1,0 +1,195 @@
+"""SORT-strategy device group-by (high/arbitrary NDV) tests.
+
+Reference analog: the parallel HashAgg over arbitrary key domains
+(pkg/executor/aggregate/agg_hash_executor.go:94) — redesigned as device
+sort + segment-reduce (SURVEY.md §7 hard part 4).  VERDICT r1 item 2.
+"""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.chunk.column import Column, StringDict
+from tidb_tpu.session import Domain, Session
+from tidb_tpu.session.catalog import TableInfo
+from tidb_tpu.types import dtypes as dt
+
+
+def _table(dom, name, cols):
+    names = [c[0] for c in cols]
+    columns = [c[1] for c in cols]
+    ti = TableInfo(name, names, [c.dtype for c in columns])
+    ti.register_columns(columns)
+    dom.catalog.create_table("test", ti)
+    return ti
+
+
+@pytest.fixture()
+def dom():
+    return Domain()
+
+
+def _explain_has_coptask(sess, sql):
+    plan = "\n".join(r[0] for r in sess.must_query("explain " + sql))
+    return "CopTask[agg]" in plan
+
+
+def test_high_ndv_int_group_by_on_device(dom):
+    sess = Session(dom)
+    rng = np.random.default_rng(1)
+    n = 60_000
+    k = rng.integers(0, 40_000, n).astype(np.int64)
+    v = rng.integers(-500, 500, n).astype(np.int64)
+    _table(dom, "g1", [
+        ("k", Column(dt.bigint(), k, np.ones(n, bool))),
+        ("v", Column(dt.bigint(), v, np.ones(n, bool)))])
+    sql = "select k, count(*), sum(v) from g1 group by k"
+    assert _explain_has_coptask(sess, sql)
+    rows = sess.must_query(sql)
+    uk, inv = np.unique(k, return_inverse=True)
+    assert len(rows) == len(uk)
+    cnt = np.bincount(inv)
+    sv = np.bincount(inv, weights=v).astype(np.int64)
+    exp = {int(u): (int(c), int(s)) for u, c, s in zip(uk, cnt, sv)}
+    for rk, rc, rs in rows:
+        assert exp[rk] == (rc, int(rs))
+
+
+def test_million_ndv_matches_oracle(dom):
+    """VERDICT done-criterion: 1M-NDV int key agg matches the numpy
+    oracle through the device SORT path."""
+    sess = Session(dom)
+    rng = np.random.default_rng(2)
+    n = 1_000_000
+    k = rng.integers(0, 1_000_000, n).astype(np.int64)
+    _table(dom, "gm", [("k", Column(dt.bigint(), k, np.ones(n, bool)))])
+    sql = "select k, count(*) from gm group by k"
+    assert _explain_has_coptask(sess, sql)
+    rows = sess.must_query(sql)
+    uk, cnt = np.unique(k, return_counts=True)
+    assert len(rows) == len(uk)
+    got = dict(rows)
+    for i in range(0, len(uk), 104729):
+        assert got[int(uk[i])] == int(cnt[i])
+    assert sum(got.values()) == n
+
+
+def test_group_by_nullable_key_groups_nulls_together(dom):
+    sess = Session(dom)
+    sess.execute("create table gn (k bigint, v bigint)")
+    sess.execute("insert into gn values (1, 10), (null, 5), (1, 1), "
+                 "(null, 7), (2, 3)")
+    rows = sess.must_query(
+        "select k, sum(v), count(*) from gn group by k")
+    by_key = {r[0]: (int(r[1]), r[2]) for r in rows}
+    assert by_key[None] == (12, 2)
+    assert by_key[1] == (11, 2)
+    assert by_key[2] == (3, 1)
+    # NULL key group distinct from value-0 group
+    sess.execute("insert into gn values (0, 100)")
+    rows = sess.must_query("select k, sum(v) from gn group by k")
+    by_key = {r[0]: int(r[1]) for r in rows}
+    assert by_key[0] == 100 and by_key[None] == 12
+
+
+def test_multi_key_int_and_float(dom):
+    sess = Session(dom)
+    rng = np.random.default_rng(3)
+    n = 5_000
+    a = rng.integers(0, 50, n).astype(np.int64)
+    b = rng.integers(0, 40, n).astype(np.float64) / 4.0
+    v = rng.integers(0, 100, n).astype(np.int64)
+    _table(dom, "g2", [
+        ("a", Column(dt.bigint(), a, np.ones(n, bool))),
+        ("b", Column(dt.double(), b, np.ones(n, bool))),
+        ("v", Column(dt.bigint(), v, np.ones(n, bool)))])
+    rows = sess.must_query(
+        "select a, b, sum(v), max(v) from g2 group by a, b")
+    exp = {}
+    for i in range(n):
+        key = (int(a[i]), float(b[i]))
+        s, m = exp.get(key, (0, -1))
+        exp[key] = (s + int(v[i]), max(m, int(v[i])))
+    assert len(rows) == len(exp)
+    for ra, rb, rs, rm in rows:
+        assert exp[(ra, rb)] == (int(rs), rm)
+
+
+def test_string_dict_key_falls_to_sort_when_domain_large(dom):
+    """A dict-encoded string key beyond MAX_DENSE_GROUPS still runs on
+    device via SORT and decodes back through the dictionary."""
+    sess = Session(dom)
+    n = 20_000
+    rng = np.random.default_rng(4)
+    codes = rng.integers(0, 5_000, n).astype(np.int64)
+    words = [f"w{i:05d}" for i in range(5_000)]
+    sd = StringDict(words)
+    _table(dom, "g3", [
+        ("s", Column(dt.varchar(), codes, np.ones(n, bool), sd)),
+        ("v", Column(dt.bigint(), np.ones(n, np.int64), np.ones(n, bool)))])
+    rows = sess.must_query("select s, count(*) from g3 group by s")
+    uk, cnt = np.unique(codes, return_counts=True)
+    got = dict(rows)
+    assert len(got) == len(uk)
+    assert got[words[int(uk[0])]] == int(cnt[0])
+
+
+def test_decimal_sum_group_by_high_ndv_exact(dom):
+    sess = Session(dom)
+    sess.execute("create table gd (k bigint, d decimal(12,2))")
+    vals = [(i % 700, f"{(i * 7 % 1000)}.{i % 100:02d}") for i in range(3000)]
+    for off in range(0, len(vals), 500):
+        sess.execute("insert into gd values " + ",".join(
+            f"({k}, {d})" for k, d in vals[off:off + 500]))
+    rows = sess.must_query("select k, sum(d) from gd group by k")
+    import decimal
+    exp = {}
+    for k, d in vals:
+        exp[k] = exp.get(k, decimal.Decimal(0)) + decimal.Decimal(d)
+    assert len(rows) == len(exp)
+    for rk, rs in rows:
+        assert decimal.Decimal(str(rs)) == exp[rk], (rk, rs, exp[rk])
+
+
+def test_group_capacity_regrow(dom):
+    """More distinct groups than the initial capacity triggers the regrow
+    loop (paging analog) and still returns every group."""
+    from tidb_tpu.store import client as client_mod
+    sess = Session(dom)
+    n = 30_000
+    k = np.arange(n, dtype=np.int64)  # all distinct
+    _table(dom, "g4", [("k", Column(dt.bigint(), k, np.ones(n, bool)))])
+    old = client_mod.DEFAULT_GROUP_CAPACITY
+    client_mod.DEFAULT_GROUP_CAPACITY = 64
+    try:
+        rows = sess.must_query("select k, count(*) from g4 group by k")
+    finally:
+        client_mod.DEFAULT_GROUP_CAPACITY = old
+    assert len(rows) == n
+    assert all(c == 1 for _, c in rows)
+
+
+def test_min_max_date_group_by(dom):
+    """Regression: MIN/MAX sentinel must be built in the state array's own
+    dtype (int64 sentinel astype int32 wraps to -1 and wins every min)."""
+    sess = Session(dom)
+    sess.execute("create table gdt (k bigint, d date)")
+    sess.execute("insert into gdt values (1, '2020-05-01'), "
+                 "(1, '2021-06-02'), (1, '1999-01-03'), (2, '2010-07-04')")
+    import datetime
+    rows = sess.must_query("select k, min(d), max(d) from gdt group by k")
+    by_key = {r[0]: (r[1], r[2]) for r in rows}
+    assert by_key[1] == (datetime.date(1999, 1, 3), datetime.date(2021, 6, 2))
+    assert by_key[2] == (datetime.date(2010, 7, 4),) * 2
+
+
+def test_negative_zero_groups_with_zero(dom):
+    """Regression: -0.0 and +0.0 are SQL-equal and must form one group."""
+    sess = Session(dom)
+    n = 4
+    b = np.array([0.0, -0.0, 0.0, -0.0])
+    _table(dom, "gz", [
+        ("b", Column(dt.double(), b, np.ones(n, bool))),
+        ("v", Column(dt.bigint(), np.arange(n, dtype=np.int64),
+                     np.ones(n, bool)))])
+    rows = sess.must_query("select b, count(*) from gz group by b")
+    assert len(rows) == 1 and rows[0][1] == 4

@@ -75,6 +75,106 @@ def merge_states(states_list: Sequence[dict]) -> dict:
     return out
 
 
+def _np_key_code(val: np.ndarray, valid: np.ndarray,
+                 dtype: dt.DataType) -> np.ndarray:
+    """Bit-stable int64 representation of group-key values for host-side
+    equality grouping (floats via the order-preserving bitcast so NaN
+    groups with NaN; NULLs zeroed — the null flag column disambiguates)."""
+    v = np.asarray(val)
+    if dtype.is_float:
+        f = v.astype(np.float64)
+        f = np.where(f == 0, 0.0, f)  # -0.0 groups with +0.0 (SQL equality)
+        b = np.ascontiguousarray(f).view(np.int64)
+        c = np.where(b < 0, -(b + 1) + (-2 ** 63), b)
+    else:
+        c = v.astype(np.int64)
+    return np.where(np.asarray(valid), c, 0)
+
+
+def merge_sorted_states(agg: D.Aggregation,
+                        per_dev: Sequence[dict]) -> dict:
+    """Merge SORT-strategy per-device group tables: trim each to its live
+    group count, concatenate, and re-group by key equality (np.unique) —
+    the root-side final-HashAgg-worker role for unbounded key domains.
+    Sums merge in object ints (exact)."""
+    k = len(agg.group_by)
+    tables: list[dict] = []
+    for st in per_dev:
+        g = int(st["__ngroups__"])
+        trimmed = {name: {f: np.asarray(a)[:g] for f, a in v.items()}
+                   if isinstance(v, dict) else np.asarray(v)[:g]
+                   for name, v in st.items() if name != "__ngroups__"}
+        tables.append(trimmed)
+
+    def cat(path):
+        parts = []
+        for t in tables:
+            v = t
+            for p in path:
+                v = v[p]
+            parts.append(v)
+        return np.concatenate(parts) if parts else np.empty(0)
+
+    mat = np.empty((len(cat(("__rows__",))), 2 * k), np.int64)
+    key_vals, key_valids = [], []
+    for j, e in enumerate(agg.group_by):
+        val = cat((f"k{j}", "val"))
+        valid = cat((f"k{j}", "valid")).astype(bool)
+        key_vals.append(val)
+        key_valids.append(valid)
+        mat[:, 2 * j] = (~valid).astype(np.int64)
+        mat[:, 2 * j + 1] = _np_key_code(val, valid, e.dtype)
+
+    uniq, first_idx, inv = np.unique(mat, axis=0, return_index=True,
+                                     return_inverse=True)
+    ng = len(uniq)
+
+    def regroup(name, arr):
+        how = _MERGE[name]
+        arr = np.asarray(arr)
+        if how == "sum":
+            if arr.dtype == np.int64:
+                arr = arr.astype(object)  # exact limb/count merge
+            out = np.zeros(ng, dtype=arr.dtype)
+            np.add.at(out, inv, arr)
+            return out
+        if arr.dtype.kind == "f":
+            sentinel = np.inf if how == "min" else -np.inf
+        else:
+            info = np.iinfo(arr.dtype)  # sentinel in the ARRAY's dtype —
+            sentinel = info.max if how == "min" else info.min
+        init = np.full(ng, sentinel, arr.dtype)
+        (np.minimum if how == "min" else np.maximum).at(init, inv, arr)
+        return init
+
+    merged: dict = {"__rows__": regroup("__rows__", cat(("__rows__",)))}
+    for j in range(k):
+        merged[f"k{j}"] = {"val": key_vals[j][first_idx],
+                           "valid": key_valids[j][first_idx]}
+    for i in range(len(agg.aggs)):
+        name = f"a{i}"
+        merged[name] = {f: regroup(f, cat((name, f)))
+                        for f in tables[0][name]} if tables else {}
+    return merged
+
+
+def finalize_sorted(agg: D.Aggregation, merged: dict,
+                    key_meta: Sequence[GroupKeyMeta]
+                    ) -> tuple[list[Column], list[Column]]:
+    """(group_key_columns, agg_value_columns) for SORT-strategy results."""
+    key_cols = []
+    for j, m in enumerate(key_meta):
+        val = merged[f"k{j}"]["val"]
+        valid = merged[f"k{j}"]["valid"]
+        npdt = m.dtype.np_dtype()
+        data = (np.array([int(x) for x in val], dtype=object)
+                if npdt == object else val.astype(npdt))
+        key_cols.append(Column(m.dtype, data, valid, m.dictionary))
+    agg_cols = [_finalize_one(a, merged[f"a{i}"])
+                for i, a in enumerate(agg.aggs)]
+    return key_cols, agg_cols
+
+
 # --------------------------------------------------------------------- #
 # finalize
 # --------------------------------------------------------------------- #

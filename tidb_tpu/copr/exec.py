@@ -93,18 +93,64 @@ def _min_of(dtype):
     return jnp.iinfo(dtype).min
 
 
+def _one_agg_state(a: D.AggDesc, av, am, sel, gids, num_groups, n) -> dict:
+    """Partial state for one AggDesc over (possibly grouped) rows.
+
+    Layout (all named arrays so psum/pmin/pmax merges are mechanical —
+    see parallel/collectives.py MERGE_SPECS):
+      count -> {count}
+      sum   -> decimal/int: {hi, lo, cnt} (int64 limb split, exact when
+               recombined host-side); float: {sum, cnt}
+      min   -> {min, cnt};  max -> {max, cnt}
+    """
+    av = _ensure_array(av, n)
+    mask = sel if am is True else (sel & am)
+    if a.func == D.AggFunc.COUNT:
+        return {"count": _reduce(mask.astype(jnp.int64), mask, gids,
+                                 num_groups, "sum")}
+    cnt = _reduce(mask.astype(jnp.int64), mask, gids, num_groups, "sum")
+    if a.func == D.AggFunc.SUM:
+        kind = a.arg.dtype.kind
+        if kind in (K.FLOAT64, K.FLOAT32):
+            return {"sum": _reduce(av.astype(jnp.float64), mask, gids,
+                                   num_groups, "sum"), "cnt": cnt}
+        # decimal AND integer sums accumulate as (hi, lo) int64 limbs.
+        # Exactness argument (types/decimal.py): per row |hi| < 2^32 and
+        # lo < 2^32, so with n < 2^31 rows per batch neither limb sum can
+        # wrap int64; recombination is exact.  n is a static shape, so
+        # this fence is free.
+        if n >= 2 ** 31:
+            raise OverflowError(
+                f"shard batch of {n} rows exceeds the 2^31 limb-exact "
+                "SUM bound; use more/smaller shards")
+        v = av.astype(jnp.int64)
+        hi = _reduce(v >> 32, mask, gids, num_groups, "sum")
+        lo = _reduce(v & 0xFFFFFFFF, mask, gids, num_groups, "sum")
+        return {"hi": hi, "lo": lo, "cnt": cnt}
+    if a.func == D.AggFunc.MIN:
+        return {"min": _reduce(av, mask, gids, num_groups, "min"),
+                "cnt": cnt}
+    if a.func == D.AggFunc.MAX:
+        return {"max": _reduce(av, mask, gids, num_groups, "max"),
+                "cnt": cnt}
+    raise NotImplementedError(a.func)
+
+
 def _agg_partial_states(agg: D.Aggregation, batch: DeviceBatch, ev: Evaluator,
                         memo: dict):
-    """Compute the per-shard partial-state pytree for an Aggregation node.
+    """Per-shard partial-state pytree for an Aggregation node.
 
-    Layout per AggDesc (all named arrays so psum/pmin/pmax merges are
-    mechanical — see parallel/collectives.py MERGE_SPECS):
-      count -> {count}
-      sum   -> decimal: {hi, lo, cnt} (int64 limb split, exact 128-bit when
-               recombined host-side); int: {sum, cnt}; float: {sum, cnt}
-      min   -> {min, cnt};  max -> {max, cnt}
-    plus '__rows__' (COUNT(*) per group) for occupancy.
+    SCALAR/DENSE: fixed group domain, psum-mergeable across shards.
+    SORT: unbounded key domain via sort + segment-reduce into a
+    fixed-capacity group table (host merge across shards) — the TPU
+    answer to the reference's high-NDV parallel HashAgg
+    (pkg/executor/aggregate/agg_hash_executor.go:94); hash tables lose to
+    sort+segment ops on TPU (SURVEY.md §7 hard part 4).
+    Adds '__rows__' (COUNT(*) per group) for occupancy.
     """
+    if agg.strategy == D.GroupStrategy.SORT:
+        return _agg_sort_states(agg, batch, ev, memo)
+
     n = len(batch.cols[0][0]) if batch.cols else 0
     sel = _sel_array(batch.sel, n)
 
@@ -113,54 +159,86 @@ def _agg_partial_states(agg: D.Aggregation, batch: DeviceBatch, ev: Evaluator,
     if agg.strategy == D.GroupStrategy.DENSE:
         gids = _dense_group_ids(agg, batch, ev, memo)
         num_groups = agg.num_groups
-    elif agg.strategy != D.GroupStrategy.SCALAR:
-        # SORT (high-NDV sort+segment-reduce) is not implemented yet; the
-        # planner routes such plans to the host aggregator instead
-        raise NotImplementedError(f"GroupStrategy.{agg.strategy.name}")
 
     states: dict[str, Any] = {}
     states["__rows__"] = _reduce(sel.astype(jnp.int64), sel, gids, num_groups, "sum")
-
     for i, a in enumerate(agg.aggs):
-        key = f"a{i}"
         if a.func == D.AggFunc.COUNT and a.arg is None:
-            states[key] = {"count": states["__rows__"]}
+            states[f"a{i}"] = {"count": states["__rows__"]}
             continue
         av, am = ev.eval(a.arg, batch.cols, memo)
-        av = _ensure_array(av, n)
-        mask = sel if am is True else (sel & am)
-        if a.func == D.AggFunc.COUNT:
-            states[key] = {"count": _reduce(mask.astype(jnp.int64), mask, gids,
-                                            num_groups, "sum")}
+        states[f"a{i}"] = _one_agg_state(a, av, am, sel, gids, num_groups, n)
+    return states
+
+
+def _agg_sort_states(agg: D.Aggregation, batch: DeviceBatch, ev: Evaluator,
+                     memo: dict):
+    """SORT-strategy grouped aggregation: one multi-key lax.sort, segment
+    boundaries by key change, scatter-reduce into a (group_capacity,)
+    state table.
+
+    Per key j the states carry {'val', 'valid'} gathered from the group's
+    rows (NULL values zeroed so all NULLs share one group), plus
+    '__ngroups__' — the TRUE distinct-group count, so the dispatcher can
+    regrow capacity and re-run when it exceeds group_capacity (the paging
+    analog, SURVEY.md §5.7)."""
+    G = agg.group_capacity
+    assert G > 0, "SORT aggregation needs group_capacity"
+    n = len(batch.cols[0][0]) if batch.cols else 0
+    sel = _sel_array(batch.sel, n)
+
+    keyinfo = []
+    for e in agg.group_by:
+        v, m = ev.eval(e, batch.cols, memo)
+        v = _ensure_array(v, n)
+        if v.dtype == bool:
+            v = v.astype(jnp.int64)
+        nullf = (jnp.zeros(n, jnp.int32) if m is True
+                 else (~m).astype(jnp.int32))
+        vz = v if m is True else jnp.where(m, v, jnp.zeros((), v.dtype))
+        if e.dtype.is_float:
+            # -0.0 must group with +0.0 (SQL equality, not bit equality)
+            vz = jnp.where(vz == 0, jnp.zeros((), vz.dtype), vz)
+        code = sortable_int64(jnp, vz, e.dtype.is_float,
+                              e.dtype.kind == K.UINT64)
+        keyinfo.append((vz, m, nullf, code))
+
+    dead = (~sel).astype(jnp.int32)
+    ops: list = [dead]
+    for _vz, _m, nullf, code in keyinfo:
+        ops += [nullf, code]
+    ops.append(jnp.arange(n))
+    *sorted_keys, idx = lax.sort(tuple(ops), num_keys=1 + 2 * len(keyinfo))
+    sel_s = sel[idx]
+
+    # group boundary: live row whose key tuple differs from the previous
+    diff = jnp.arange(n) == 0
+    for j in range(len(keyinfo)):
+        nf_s, cd_s = sorted_keys[1 + 2 * j], sorted_keys[2 + 2 * j]
+        diff = diff | (nf_s != jnp.roll(nf_s, 1)) | (cd_s != jnp.roll(cd_s, 1))
+    newgrp = sel_s & diff
+    gid = jnp.cumsum(newgrp.astype(jnp.int64)) - 1
+    ngroups = jnp.sum(newgrp.astype(jnp.int64))
+    gids = jnp.where(sel_s, gid, G)        # dead rows -> dropped scatter
+
+    states: dict[str, Any] = {"__ngroups__": ngroups}
+    states["__rows__"] = _reduce(sel_s.astype(jnp.int64), sel_s, gids, G, "sum")
+    for j, (vz, m, _nf, _cd) in enumerate(keyinfo):
+        val = jnp.zeros((G,), vz.dtype).at[gids].set(vz[idx], mode="drop")
+        valid = jnp.zeros((G,), bool).at[gids].set(
+            jnp.ones(n, bool)[idx] if m is True else m[idx], mode="drop")
+        states[f"k{j}"] = {"val": val, "valid": valid}
+
+    # aggregate over the PERMUTED batch so arg rows line up with gids
+    pcols = [(_ensure_array(v, n)[idx],
+              True if m is True else m[idx]) for v, m in batch.cols]
+    pmemo: dict = {}
+    for i, a in enumerate(agg.aggs):
+        if a.func == D.AggFunc.COUNT and a.arg is None:
+            states[f"a{i}"] = {"count": states["__rows__"]}
             continue
-        cnt = _reduce(mask.astype(jnp.int64), mask, gids, num_groups, "sum")
-        if a.func == D.AggFunc.SUM:
-            kind = a.arg.dtype.kind
-            if kind in (K.FLOAT64, K.FLOAT32):
-                states[key] = {"sum": _reduce(av.astype(jnp.float64), mask, gids,
-                                              num_groups, "sum"), "cnt": cnt}
-            else:
-                # decimal AND integer sums accumulate as (hi, lo) int64
-                # limbs.  Exactness argument (types/decimal.py): per row
-                # |hi| < 2^32 and lo < 2^32, so with n < 2^31 rows per
-                # batch neither limb sum can wrap int64; recombination is
-                # exact.  n is a static shape, so this fence is free.
-                if n >= 2 ** 31:
-                    raise OverflowError(
-                        f"shard batch of {n} rows exceeds the 2^31 limb-"
-                        "exact SUM bound; use more/smaller shards")
-                v = av.astype(jnp.int64)
-                hi = _reduce(v >> 32, mask, gids, num_groups, "sum")
-                lo = _reduce(v & 0xFFFFFFFF, mask, gids, num_groups, "sum")
-                states[key] = {"hi": hi, "lo": lo, "cnt": cnt}
-        elif a.func == D.AggFunc.MIN:
-            states[key] = {"min": _reduce(av, mask, gids, num_groups, "min"),
-                           "cnt": cnt}
-        elif a.func == D.AggFunc.MAX:
-            states[key] = {"max": _reduce(av, mask, gids, num_groups, "max"),
-                           "cnt": cnt}
-        else:
-            raise NotImplementedError(a.func)
+        av, am = ev.eval(a.arg, pcols, pmemo)
+        states[f"a{i}"] = _one_agg_state(a, av, am, sel_s, gids, G, n)
     return states
 
 

@@ -399,24 +399,47 @@ def _bind_agg(agg: LogicalAggregate, child: D.CopNode, dicts,
     if not agg.group_exprs:
         return D.Aggregation(child, (), tuple(descs), D.GroupStrategy.SCALAR)
 
-    sizes = []
+    # DENSE when every key is a small-domain dict-encoded string: the psum
+    # seam merges aligned state vectors in-program (SURVEY.md §2.10 P2)
+    if all(isinstance(g, ColumnRef) and g.dtype.is_string and g.index in dicts
+           for g in agg.group_exprs):
+        sizes = []
+        metas = []
+        total = 1
+        for g in agg.group_exprs:
+            d = dicts[g.index]
+            size = max(len(d) + (1 if g.dtype.nullable else 0), 1)
+            sizes.append(size)
+            metas.append(GroupKeyMeta(g.dtype, size, d))
+            total *= size
+        if total <= MAX_DENSE_GROUPS:
+            key_meta_out.extend(metas)
+            return D.Aggregation(child, tuple(agg.group_exprs), tuple(descs),
+                                 D.GroupStrategy.DENSE,
+                                 domain_sizes=tuple(sizes))
+
+    # SORT for everything else orderable: device sort + segment-reduce
+    # handles arbitrary NDV (the reference's high-NDV parallel HashAgg,
+    # agg_hash_executor.go:94, re-designed for TPU — SURVEY.md §7 hard
+    # part 4: sort-based group-by beats hashing on TPU)
     metas = []
-    total = 1
+    lowered = []
     for g in agg.group_exprs:
-        if not (isinstance(g, ColumnRef) and g.dtype.is_string
-                and g.index in dicts):
+        lg = lower_strings(g, dicts)
+        if not _device_supported(lg):
             return None
-        d = dicts[g.index]
-        size = len(d) + (1 if g.dtype.nullable else 0)
-        size = max(size, 1)
-        sizes.append(size)
-        metas.append(GroupKeyMeta(g.dtype, size, d))
-        total *= size
-    if total > MAX_DENSE_GROUPS:
-        return None
+        d = None
+        if lg.dtype.is_string:
+            # only dict-coded column refs can decode back to strings
+            if isinstance(g, ColumnRef) and g.index in dicts:
+                d = dicts[g.index]
+            else:
+                return None
+        metas.append(GroupKeyMeta(g.dtype, 0, d))
+        lowered.append(lg)
     key_meta_out.extend(metas)
-    return D.Aggregation(child, tuple(agg.group_exprs), tuple(descs),
-                         D.GroupStrategy.DENSE, domain_sizes=tuple(sizes))
+    return D.Aggregation(child, tuple(lowered), tuple(descs),
+                         D.GroupStrategy.SORT)
 
 
 __all__ = ["to_physical"]

@@ -78,8 +78,13 @@ class ShardedCopProgram:
         # MIN/MAX partials merge host-side: some TPU runtimes (axon AOT)
         # lower only Sum all-reduce, so pmin/pmax can't go in-program.
         # Sums/counts still psum over ICI — the seam BASELINE.json names.
-        self.host_merge = self.agg is not None and any(
-            a.func in (D.AggFunc.MIN, D.AggFunc.MAX) for a in self.agg.aggs)
+        # SORT-strategy group tables also merge host-side: per-device group
+        # sets aren't aligned, so there is no elementwise collective merge
+        # (the repartition-exchange path is the in-program alternative).
+        self.host_merge = self.agg is not None and (
+            self.agg.strategy == D.GroupStrategy.SORT or any(
+                a.func in (D.AggFunc.MIN, D.AggFunc.MAX)
+                for a in self.agg.aggs))
         # int/decimal SUMs produce (hi, lo) limb states whose in-program
         # psum is int64-exact only below 2^31 global rows; float sums,
         # counts, and host-merged (object-int) programs are exempt

@@ -23,12 +23,17 @@ import numpy as np
 
 from ..chunk.column import Column
 from ..copr import dag as D
-from ..copr.aggregate import GroupKeyMeta, finalize, merge_states
+from ..copr.aggregate import (GroupKeyMeta, finalize, finalize_sorted,
+                              merge_sorted_states, merge_states)
 from ..parallel.spmd import get_sharded_program
 from .columnar import ColumnarSnapshot, _pow2_at_least
 
 # initial fraction of table rows assumed to survive a row-returning plan
 INITIAL_SELECTIVITY = 4  # capacity = max(rows/shards/4, 1024)
+
+# SORT-agg group-table sizing: first guess when the planner supplies no
+# NDV estimate, and the regrow ceiling
+DEFAULT_GROUP_CAPACITY = 4096
 
 
 @dataclass
@@ -47,20 +52,47 @@ class CopClient:
     def execute_agg(self, agg: D.Aggregation, snap: ColumnarSnapshot,
                     key_meta: list[GroupKeyMeta], aux_cols=()) -> CopResult:
         cols, counts = snap.device_cols(self.mesh)
+        if agg.strategy == D.GroupStrategy.SORT:
+            return self._execute_sort_agg(agg, cols, counts, key_meta,
+                                          aux_cols)
         prog = get_sharded_program(agg, self.mesh)
         states = prog(cols, counts, aux_cols)
         states = jax.device_get(states)
         if prog.host_merge:
             # min/max partials come back per-device (leading axis); the
             # final merge is the host's root-worker role
-            n_dev = len(self.mesh.devices.reshape(-1))
-            per_dev = [jax.tree_util.tree_map(lambda a: np.asarray(a)[d],
-                                              states)
-                       for d in range(n_dev)]
+            per_dev = self._split_devices(states)
             merged = merge_states(per_dev)
         else:
             merged = merge_states([states])
         key_cols, agg_cols = finalize(agg, merged, key_meta)
+        return CopResult(agg_cols, key_cols)
+
+    def _split_devices(self, states):
+        n_dev = len(self.mesh.devices.reshape(-1))
+        return [jax.tree_util.tree_map(lambda a: np.asarray(a)[d], states)
+                for d in range(n_dev)]
+
+    def _execute_sort_agg(self, agg, cols, counts, key_meta,
+                          aux_cols) -> CopResult:
+        """High-NDV group-by: per-device sort+segment-reduce group tables,
+        regrown when a device sees more distinct groups than capacity
+        (the paging grow-from-min analog), then host final merge."""
+        import dataclasses
+        cap = agg.group_capacity or DEFAULT_GROUP_CAPACITY
+        for _ in range(8):
+            sized = dataclasses.replace(agg, group_capacity=cap)
+            prog = get_sharded_program(sized, self.mesh)
+            states = jax.device_get(prog(cols, counts, aux_cols))
+            true_ng = int(np.max(np.asarray(states["__ngroups__"])))
+            if true_ng <= cap:
+                break
+            cap = _pow2_at_least(true_ng)
+        else:
+            raise RuntimeError("group-capacity regrow did not converge")
+        per_dev = self._split_devices(states)
+        merged = merge_sorted_states(sized, per_dev)
+        key_cols, agg_cols = finalize_sorted(sized, merged, key_meta)
         return CopResult(agg_cols, key_cols)
 
     # ------------------------------------------------------------- #
