@@ -138,25 +138,61 @@ class Limit(CopNode):
 
 @dataclass(frozen=True)
 class LookupJoin(CopNode):
-    """Broadcast lookup join against a small unique-keyed build side.
+    """Broadcast lookup join against a host-materialized build side.
 
     Reference analog: the MPP broadcast join (ExchangeType_Broadcast +
-    HashJoinProbeExec, cophandler/mpp_exec.go) specialized to the
-    FK->unique-PK case: each probe row matches at most one build row, so
-    the join is a sorted-lookup gather with NO output expansion — static
-    shapes, MXU/VPU-friendly (SURVEY.md §2.10 P3).
+    HashJoinProbeExec, cophandler/mpp_exec.go).  Two device strategies:
+
+    - `unique=True` (FK->unique-PK): each probe row matches at most one
+      build row, so the join is a sorted-lookup gather with NO output
+      expansion — static shapes, MXU/VPU-friendly (SURVEY.md §2.10 P3).
+    - `unique=False` (m:n): sorted-range lookup (lo/hi searchsorted) +
+      cumsum slot assignment expands matches into an `out_capacity`-row
+      batch; the true output size is reported in the program's extras so
+      the dispatcher can regrow and retry (the paging discipline,
+      SURVEY.md §5.7).  This replaces the reference's multi-match hash
+      probe (join/hash_join_v2.go) — range-gather beats hash tables on TPU.
 
     The build side arrives as auxiliary program inputs (host-materialized,
     replicated to every device): aux[0] = sorted build keys (int64),
     aux[1] = permutation into build rows, aux[2:] = build columns.
-    Output schema = probe schema ++ build columns; `kind` inner|left."""
+    Output schema = probe schema ++ build columns (probe schema only for
+    semi/anti); `kind` inner|left|semi|anti."""
     child: CopNode = None  # type: ignore[assignment]
     probe_key: Expr = None  # type: ignore[assignment]
     kind: str = "inner"
     build_dtypes: Tuple[dt.DataType, ...] = ()
+    unique: bool = True
+    out_capacity: int = 0          # unique=False only
 
     def children(self):
         return (self.child,)
+
+
+@dataclass(frozen=True)
+class ShuffleJoinSpec:
+    """Cross-device repartition (shuffle) hash join program spec.
+
+    Reference analog: the MPP HashPartition exchange + hash join
+    (physicalop/physical_exchange_sender.go:109, executor/shuffle.go:86).
+    TPU redesign: both sides' scan chains run per device, rows hash-
+    partition over the mesh via lax.all_to_all (parallel/exchange.py), then
+    each device runs the sorted-range expand join on its partition and the
+    `top` chain (selection/projection/agg/topn/limit) over the join output
+    — all inside ONE shard_map program, so exchange bytes ride ICI.
+
+    `left`/`right` are CopNode chains rooted at their own TableScans;
+    `left_key`/`right_key` are int64-comparable exprs over each chain's
+    output.  `top`'s leaf TableScan reads the joined schema
+    (left_dtypes ++ right_dtypes; probe side only for semi/anti)."""
+    left: CopNode
+    right: CopNode
+    left_key: Expr
+    right_key: Expr
+    kind: str                       # inner | left | semi | anti
+    left_dtypes: Tuple[dt.DataType, ...]
+    right_dtypes: Tuple[dt.DataType, ...]
+    top: CopNode
 
 
 def output_dtypes(node: CopNode) -> Tuple[dt.DataType, ...]:
@@ -172,8 +208,55 @@ def output_dtypes(node: CopNode) -> Tuple[dt.DataType, ...]:
     if isinstance(node, Aggregation):
         return tuple(a.out_dtype for a in node.aggs)
     if isinstance(node, LookupJoin):
+        if node.kind in ("semi", "anti"):
+            return output_dtypes(node.child)
         return output_dtypes(node.child) + node.build_dtypes
     raise TypeError(node)
+
+
+def find_expand_join(node: CopNode):
+    """The (at most one) non-unique LookupJoin in a pushed DAG, or None —
+    programs containing one report true join output size via extras."""
+    if isinstance(node, LookupJoin) and not node.unique \
+            and node.kind in ("inner", "left"):
+        return node
+    for c in node.children():
+        found = find_expand_join(c)
+        if found is not None:
+            return found
+    return None
+
+
+def to_multimatch(node: CopNode, out_capacity: int) -> CopNode:
+    """Rebuild the DAG with its LookupJoin switched to the non-unique
+    (expanding) strategy — the dispatcher's runtime answer to discovering
+    duplicate build keys (the reference decides hash-probe shape from NDV
+    the same way, join/hash_join_v2.go build-side stats)."""
+    import dataclasses
+    if isinstance(node, LookupJoin):
+        return dataclasses.replace(node, unique=False,
+                                   out_capacity=out_capacity)
+    if not node.children():
+        return node
+    kids = tuple(to_multimatch(c, out_capacity) for c in node.children())
+    if isinstance(node, (Selection, Projection, Limit, TopN, Aggregation)):
+        return dataclasses.replace(node, child=kids[0])
+    return node
+
+
+def rewrite_expand_capacity(node: CopNode, new_cap: int) -> CopNode:
+    """Rebuild the DAG with the non-unique LookupJoin's out_capacity
+    replaced (the dispatcher's regrow-and-retry step)."""
+    import dataclasses
+    if isinstance(node, LookupJoin) and not node.unique:
+        return dataclasses.replace(node, out_capacity=new_cap)
+    if not node.children():
+        return node
+    kids = tuple(rewrite_expand_capacity(c, new_cap) for c in node.children())
+    if isinstance(node, (Selection, Projection, Limit, TopN, Aggregation,
+                         LookupJoin)):
+        return dataclasses.replace(node, child=kids[0])
+    return node
 
 
 def dag_digest(node: CopNode) -> int:
@@ -184,6 +267,7 @@ def dag_digest(node: CopNode) -> int:
 
 __all__ = [
     "AggFunc", "AggDesc", "CopNode", "TableScan", "Selection", "Projection",
-    "GroupStrategy", "Aggregation", "TopN", "Limit", "output_dtypes",
-    "dag_digest",
+    "GroupStrategy", "Aggregation", "TopN", "Limit", "LookupJoin",
+    "ShuffleJoinSpec", "output_dtypes", "dag_digest", "find_expand_join",
+    "rewrite_expand_capacity",
 ]

@@ -41,9 +41,18 @@ DENSE_BROADCAST_MAX_GROUPS = 64
 
 @dataclass
 class DeviceBatch:
-    """Columns + live-row selection mask flowing between fused operators."""
+    """Columns + live-row selection mask flowing between fused operators.
+
+    `extras` carries named traced scalars that must surface to the
+    dispatcher alongside the result — today the true output size of an
+    expanding join, so the paging loop can regrow its capacity."""
     cols: list  # list[(value, valid)]
     sel: Any    # bool array | True
+    extras: dict = None  # type: ignore[assignment]
+
+    def __post_init__(self):
+        if self.extras is None:
+            self.extras = {}
 
 
 def _ensure_array(v, n):
@@ -315,7 +324,7 @@ def _exec_node(node: D.CopNode, scan_cols: Sequence, row_count, ev: Evaluator,
                 v = v != 0
             keep = v if m is True else (v & m)  # NULL -> filtered out
             sel = keep if sel is True else (sel & keep)
-        return DeviceBatch(batch.cols, sel)
+        return DeviceBatch(batch.cols, sel, batch.extras)
 
     if isinstance(node, D.Projection):
         batch = _exec_node(node.child, scan_cols, row_count, ev, aux)
@@ -325,14 +334,14 @@ def _exec_node(node: D.CopNode, scan_cols: Sequence, row_count, ev: Evaluator,
         for e in node.exprs:
             v, m = ev.eval(e, batch.cols, memo)
             cols.append((_ensure_array(v, n), m))
-        return DeviceBatch(cols, batch.sel)
+        return DeviceBatch(cols, batch.sel, batch.extras)
 
     if isinstance(node, D.Limit):
         batch = _exec_node(node.child, scan_cols, row_count, ev, aux)
         n = len(batch.cols[0][0])
         sel = _sel_array(batch.sel, n)
         keep = sel & (jnp.cumsum(sel) <= node.limit)
-        return DeviceBatch(batch.cols, keep)
+        return DeviceBatch(batch.cols, keep, batch.extras)
 
     if isinstance(node, D.TopN):
         batch = _exec_node(node.child, scan_cols, row_count, ev, aux)
@@ -347,7 +356,7 @@ def _exec_node(node: D.CopNode, scan_cols: Sequence, row_count, ev: Evaluator,
 
 def _exec_lookup_join(node: D.LookupJoin, batch: DeviceBatch, ev: Evaluator,
                       aux) -> DeviceBatch:
-    """Sorted-lookup gather join (see dag.LookupJoin).  aux layout:
+    """Sorted-lookup join (see dag.LookupJoin).  aux layout:
     aux[0]=(sorted build keys,), aux[1]=(perm,), aux[2:]=build columns."""
     n = len(batch.cols[0][0])
     sorted_keys = aux[0][0]
@@ -355,21 +364,41 @@ def _exec_lookup_join(node: D.LookupJoin, batch: DeviceBatch, ev: Evaluator,
     build_cols = aux[2:]
     kv, km = ev.eval(node.probe_key, batch.cols, {})
     kv = _ensure_array(kv, n).astype(jnp.int64)
-    idx = jnp.searchsorted(sorted_keys, kv)
-    idxc = jnp.clip(idx, 0, sorted_keys.shape[0] - 1)
-    matched = sorted_keys[idxc] == kv
-    if km is not True:
-        matched = matched & km
-    brow = perm[idxc]
-    out_cols = list(batch.cols)
-    for bv, bm in build_cols:
-        gv = bv[brow]
-        gm = matched if bm is True else (bm[brow] & matched)
-        out_cols.append((gv, gm))
-    sel = batch.sel
-    if node.kind == "inner":
-        sel = matched if sel is True else (sel & matched)
-    return DeviceBatch(out_cols, sel)
+
+    if node.unique and node.kind in ("inner", "left"):
+        idx = jnp.searchsorted(sorted_keys, kv)
+        idxc = jnp.clip(idx, 0, sorted_keys.shape[0] - 1)
+        matched = sorted_keys[idxc] == kv
+        if km is not True:
+            matched = matched & km
+        brow = perm[idxc]
+        out_cols = list(batch.cols)
+        for bv, bm in build_cols:
+            gv = bv[brow]
+            gm = matched if bm is True else (bm[brow] & matched)
+            out_cols.append((gv, gm))
+        sel = batch.sel
+        if node.kind == "inner":
+            sel = matched if sel is True else (sel & matched)
+        return DeviceBatch(out_cols, sel, batch.extras)
+
+    from .join import gather_expand, match_ranges
+    sel = _sel_array(batch.sel, n)
+    key_ok = sel if km is True else (sel & km)
+    lo, _hi, cnt = match_ranges(sorted_keys, sorted_keys.shape[0], kv, key_ok)
+
+    if node.kind in ("semi", "anti"):
+        keep = (cnt > 0) if node.kind == "semi" else (cnt == 0)
+        return DeviceBatch(batch.cols, sel & keep, batch.extras)
+
+    oc = node.out_capacity
+    assert oc > 0, "non-unique LookupJoin needs out_capacity"
+    probe = [(_ensure_array(v, n), m) for v, m in batch.cols]
+    out_cols, out_sel, total = gather_expand(
+        probe, sel, key_ok, list(build_cols), perm, lo, cnt, node.kind, oc)
+    extras = dict(batch.extras)
+    extras["join_total"] = total
+    return DeviceBatch(out_cols, out_sel, extras)
 
 
 def _exec_topn(node: D.TopN, batch: DeviceBatch, ev: Evaluator) -> DeviceBatch:
@@ -407,7 +436,7 @@ def _exec_topn(node: D.TopN, batch: DeviceBatch, ev: Evaluator) -> DeviceBatch:
         cv = _ensure_array(cv, n)
         cols.append((cv[idx],
                      (cm[idx] if cm is not True else True)))
-    return DeviceBatch(cols, out_sel)
+    return DeviceBatch(cols, out_sel, batch.extras)
 
 
 # --------------------------------------------------------------------- #
@@ -426,6 +455,9 @@ class CopProgram:
         self.row_capacity = row_capacity
         self.agg = _find_agg(dag_root)
         self.kind = "agg" if self.agg is not None else "rows"
+        # programs containing an expanding join return an extras dict
+        # (true join output size) after the result, for the regrow loop
+        self.has_extras = D.find_expand_join(dag_root) is not None
         self._fn = jax.jit(self._trace)
 
     def _trace(self, scan_cols, row_count, aux_cols=()):
@@ -438,9 +470,11 @@ class CopProgram:
         if self.agg is not None:
             batch = _exec_node(self.agg.child, scan_cols, row_count, ev,
                                aux_cols)
-            return _agg_partial_states(self.agg, batch, ev, {})
+            states = _agg_partial_states(self.agg, batch, ev, {})
+            return (states, batch.extras) if self.has_extras else states
         batch = _exec_node(self.root, scan_cols, row_count, ev, aux_cols)
-        return compact(batch, self.row_capacity)
+        cols, cnt = compact(batch, self.row_capacity)
+        return (cols, cnt, batch.extras) if self.has_extras else (cols, cnt)
 
     def __call__(self, scan_cols, row_count, aux_cols=()):
         return self._fn(scan_cols, row_count, aux_cols)

@@ -1,0 +1,94 @@
+"""Device-side m:n join expansion: sorted-range lookup + cumsum slots.
+
+Reference analog: the multi-match hash probe of the parallel hash join
+(pkg/executor/join/hash_join_v2.go — partitioned build, concurrent probe
+workers chasing hash-bucket chains).  Hash tables with chained buckets are
+hostile to TPU (data-dependent loops, scatter-heavy); the TPU redesign
+keeps the build side SORTED by key so a probe is two `searchsorted` ops
+(lo/hi) giving each probe row's match count, and output rows are assigned
+by cumsum — every step a dense vector op with static shapes.
+
+The output batch has a fixed `out_capacity`; the true required size is
+returned so the dispatcher can regrow and retry (kv.Request.Paging
+grow-from-min analog, SURVEY.md §5.7).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def match_ranges(sorted_keys, n_live, probe_keys, probe_ok):
+    """Per-probe-row match ranges against a sorted build-key array.
+
+    sorted_keys: (B,) int64, live keys sorted ascending in the first
+    `n_live` slots (the rest arbitrary — callers park dead rows at the end
+    with an INT64_MAX fill).  n_live: traced scalar or python int.
+    probe_ok: bool mask (False = NULL/dead probe key -> matches nothing).
+    Returns (lo, hi, cnt): int32/int64 arrays, cnt == matches per row.
+    Clamping lo/hi to n_live keeps sentinel-valued dead slots out of the
+    ranges even when a live key equals INT64_MAX.
+    """
+    lo = jnp.searchsorted(sorted_keys, probe_keys, side="left")
+    hi = jnp.searchsorted(sorted_keys, probe_keys, side="right")
+    lo = jnp.minimum(lo, n_live)
+    hi = jnp.minimum(hi, n_live)
+    cnt = jnp.where(probe_ok, hi - lo, 0)
+    return lo, hi, cnt
+
+
+def expand_slots(sel, cnt, kind: str, out_capacity: int):
+    """Assign output slots for an inner/left expand join.
+
+    sel: live probe rows; cnt: matches per probe row (0 where dead).
+    Left joins give every live-but-unmatched probe row one null-extension
+    slot.  Returns (probe_idx, offset, valid_out, is_ext, total):
+      probe_idx (OC,) — which probe row fills each output slot,
+      offset    (OC,) — 0-based index into that row's match range,
+      valid_out (OC,) — slot holds a real output row,
+      is_ext    (OC,) — slot is a left-join null extension,
+      total     ()    — true output size (compare vs out_capacity).
+    """
+    n = cnt.shape[0]
+    if kind == "left":
+        cnt_ext = jnp.where(sel & (cnt == 0), 1, cnt)
+    else:
+        cnt_ext = cnt
+    cum = jnp.cumsum(cnt_ext)
+    starts = cum - cnt_ext
+    total = cum[-1] if n else jnp.int64(0)
+    j = jnp.arange(out_capacity, dtype=cum.dtype)
+    pi = jnp.clip(jnp.searchsorted(cum, j, side="right"), 0, max(n - 1, 0))
+    offset = j - starts[pi]
+    valid_out = j < total
+    is_ext = valid_out & (cnt[pi] == 0)
+    return pi, offset, valid_out, is_ext, total
+
+
+def gather_expand(batch_cols, sel, probe_key_ok, build_cols, perm,
+                  lo, cnt, kind: str, out_capacity: int):
+    """Materialize the expanded join output.
+
+    batch_cols: probe [(value, mask|True)]; build_cols likewise (already
+    row-aligned with `perm`'s target space); perm: sorted-order ->
+    original-build-row permutation; lo/cnt from match_ranges.
+    Returns (out_cols, out_sel, total) where out_cols = probe ++ build.
+    """
+    pi, offset, valid_out, is_ext, total = expand_slots(
+        sel, cnt, kind, out_capacity)
+    out_cols = []
+    for v, m in batch_cols:
+        gv = v[pi]
+        gm = True if m is True else m[pi]
+        out_cols.append((gv, gm))
+    b = perm.shape[0]
+    brow = perm[jnp.clip(lo[pi] + offset, 0, max(b - 1, 0))]
+    bvalid_base = ~is_ext
+    for v, m in build_cols:
+        gv = v[brow]
+        gm = bvalid_base if m is True else (m[brow] & bvalid_base)
+        out_cols.append((gv, gm))
+    return out_cols, valid_out, total
+
+
+__all__ = ["match_ranges", "expand_slots", "gather_expand"]

@@ -174,8 +174,10 @@ class CopJoinTaskExec(PhysOp):
     probe-side fused DAG (which contains a D.LookupJoin) over the sharded
     probe table with the aux inputs replicated to every device — the MPP
     broadcast-join analog.  When build keys turn out non-unique (decided at
-    runtime, like the reference's NDV-based join choice), delegates to the
-    prebuilt host fallback plan."""
+    runtime, like the reference's NDV-based join choice), the DAG is
+    rewritten to the expanding multi-match strategy (copr/join.py) and the
+    m:n join still runs on device; the host fallback remains only for the
+    empty-build edge."""
     dag: Any
     table: Any                     # probe-side TableInfo
     build_exec: PhysOp = None
@@ -206,10 +208,22 @@ class CopJoinTaskExec(PhysOp):
         keys, ok = self._build_keys(kcol)
         rows_idx = np.nonzero(ok)[0]           # NULL keys never join
         keys = keys[rows_idx]
-        if len(np.unique(keys)) != len(keys):
-            return self.fallback.execute(ctx)
         if len(keys) == 0:
             return self._empty_build_result(ctx, bchunk)
+        dag = self.dag
+        n_uniq = len(np.unique(keys))
+        if n_uniq != len(keys):
+            # duplicate build keys: switch to the expanding multi-match
+            # strategy on device (reference: NDV-driven join shape choice).
+            # Initial capacity: per-device probe rows x average duplication,
+            # grown by the dispatcher if the real output overflows.
+            snap0 = self.table.snapshot()
+            n_dev = len(ctx.client.mesh.devices.reshape(-1))
+            per_dev = -(-max(snap0.num_rows, 1) // n_dev)
+            avg_dup = len(keys) / max(n_uniq, 1)
+            from ..store.columnar import _pow2_at_least
+            cap = _pow2_at_least(max(int(per_dev * avg_dup), 1024))
+            dag = D.to_multimatch(dag, cap)
         order = np.argsort(keys, kind="stable")
         sorted_keys = keys[order]
         perm = np.arange(len(keys), dtype=np.int64)[order]
@@ -221,12 +235,12 @@ class CopJoinTaskExec(PhysOp):
             aux.append((jnp.asarray(data),
                         None if valid.all() else jnp.asarray(valid)))
         snap = self.table.snapshot()
-        if isinstance(self.dag, D.Aggregation):
-            res = ctx.client.execute_agg(self.dag, snap, self.key_meta,
+        if isinstance(dag, D.Aggregation):
+            res = ctx.client.execute_agg(dag, snap, self.key_meta,
                                          aux_cols=tuple(aux))
             cols = res.key_columns + res.columns
         else:
-            cols = ctx.client.execute_rows(self.dag, snap,
+            cols = ctx.client.execute_rows(dag, snap,
                                            tuple(self.out_dtypes),
                                            self.out_dicts,
                                            aux_cols=tuple(aux))

@@ -95,6 +95,10 @@ class ShardedCopProgram:
                 and a.arg.dtype.kind not in (_K.FLOAT64, _K.FLOAT32)
                 for a in self.agg.aggs))
 
+        # programs containing an expanding join also return a per-device
+        # extras dict (true join output size) for the dispatcher's regrow
+        self.has_extras = D.find_expand_join(dag_root) is not None
+
         in_specs = (P(SHARD_AXIS), P(SHARD_AXIS), P())  # aux replicated
         if self.kind == "agg":
             # per-device states when min/max present; replicated post-psum
@@ -102,6 +106,8 @@ class ShardedCopProgram:
             out_specs = P(SHARD_AXIS) if self.host_merge else P()
         else:
             out_specs = (P(SHARD_AXIS), P(SHARD_AXIS))
+        if self.has_extras:
+            out_specs = (out_specs, P(SHARD_AXIS))
 
         self._fn = jax.jit(shard_map(
             self._device_fn, mesh=mesh, in_specs=in_specs,
@@ -118,13 +124,18 @@ class ShardedCopProgram:
             states = _agg_partial_states(self.agg, batch, ev, {})
             if self.host_merge:
                 # add a leading per-device axis; host reduces across it
-                return jax.tree_util.tree_map(lambda a: a[None], states)
-            return _collective_merge(states, SHARD_AXIS)
-        batch = _exec_node(self.root, flat, base_sel, ev, aux)
-        out_cols, n = compact(batch, self.row_capacity)
-        # keep a leading per-device axis so out_specs can shard it
-        out_cols = [(v[None], m[None]) for v, m in out_cols]
-        return out_cols, n[None]
+                out = jax.tree_util.tree_map(lambda a: a[None], states)
+            else:
+                out = _collective_merge(states, SHARD_AXIS)
+        else:
+            batch = _exec_node(self.root, flat, base_sel, ev, aux)
+            out_cols, n = compact(batch, self.row_capacity)
+            # keep a leading per-device axis so out_specs can shard it
+            out = ([(v[None], m[None]) for v, m in out_cols], n[None])
+        if self.has_extras:
+            extras = {k: jnp.asarray(v)[None] for k, v in batch.extras.items()}
+            return out, extras
+        return out
 
     def __call__(self, stacked_cols: Sequence, counts, aux_cols=()):
         if self._psum_limb_fence and stacked_cols:

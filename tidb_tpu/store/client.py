@@ -55,9 +55,19 @@ class CopClient:
         if agg.strategy == D.GroupStrategy.SORT:
             return self._execute_sort_agg(agg, cols, counts, key_meta,
                                           aux_cols)
-        prog = get_sharded_program(agg, self.mesh)
-        states = prog(cols, counts, aux_cols)
-        states = jax.device_get(states)
+        for _ in range(8):
+            prog = get_sharded_program(agg, self.mesh)
+            out = prog(cols, counts, aux_cols)
+            if prog.has_extras:
+                out, extras = out
+                grown = self._grown_join_dag(agg, extras)
+                if grown is not None:
+                    agg = grown
+                    continue
+            states = jax.device_get(out)
+            break
+        else:
+            raise RuntimeError("join-capacity regrow did not converge")
         if prog.host_merge:
             # min/max partials come back per-device (leading axis); the
             # final merge is the host's root-worker role
@@ -67,6 +77,16 @@ class CopClient:
             merged = merge_states([states])
         key_cols, agg_cols = finalize(agg, merged, key_meta)
         return CopResult(agg_cols, key_cols)
+
+    def _grown_join_dag(self, dag, extras) -> Optional[D.CopNode]:
+        """If the expanding join overflowed its capacity, return the DAG
+        rebuilt with a big-enough capacity; None when it fits (the join
+        half of the paging grow-from-min discipline)."""
+        need = int(np.max(np.asarray(jax.device_get(extras["join_total"]))))
+        node = D.find_expand_join(dag)
+        if node is not None and need > node.out_capacity:
+            return D.rewrite_expand_capacity(dag, _pow2_at_least(need))
+        return None
 
     def _split_devices(self, states):
         n_dev = len(self.mesh.devices.reshape(-1))
@@ -80,12 +100,20 @@ class CopClient:
         (the paging grow-from-min analog), then host final merge."""
         import dataclasses
         cap = agg.group_capacity or DEFAULT_GROUP_CAPACITY
-        for _ in range(8):
+        for _ in range(10):
             sized = dataclasses.replace(agg, group_capacity=cap)
             prog = get_sharded_program(sized, self.mesh)
-            states = jax.device_get(prog(cols, counts, aux_cols))
+            out = prog(cols, counts, aux_cols)
+            if prog.has_extras:
+                out, extras = out
+                grown = self._grown_join_dag(sized, extras)
+                if grown is not None:
+                    agg = grown
+                    continue
+            states = jax.device_get(out)
             true_ng = int(np.max(np.asarray(states["__ngroups__"])))
             if true_ng <= cap:
+                sized = dataclasses.replace(agg, group_capacity=cap)
                 break
             cap = _pow2_at_least(true_ng)
         else:
@@ -110,9 +138,16 @@ class CopClient:
             cap = max(_pow2_at_least(max(per_shard // INITIAL_SELECTIVITY, 1)), 1024)
 
         cols, counts = snap.device_cols(self.mesh)
-        for _ in range(8):  # paging: grow until fits
+        for _ in range(10):  # paging: grow until fits
             prog = get_sharded_program(root, self.mesh, row_capacity=cap)
-            out_cols, out_counts = prog(cols, counts, aux_cols)
+            out = prog(cols, counts, aux_cols)
+            if prog.has_extras:
+                out, extras = out
+                grown = self._grown_join_dag(root, extras)
+                if grown is not None:
+                    root = grown
+                    continue
+            out_cols, out_counts = out
             out_counts = np.asarray(jax.device_get(out_counts))
             if is_topn or is_limit or (out_counts <= cap).all():
                 break
