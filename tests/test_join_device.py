@@ -211,7 +211,7 @@ def test_exchange_all_to_all_and_broadcast():
 
     def fn(k, v):
         k, v = k.reshape(-1), v.reshape(-1)
-        cols, recv_valid, overflow = all_to_all_exchange(
+        cols, recv_valid, overflow, _maxc = all_to_all_exchange(
             [(k, True), (v, True)], True, k, n_dev, capacity=n_per * 2)
         rk, rkm = cols[0]
         rv, _ = cols[1]
@@ -245,3 +245,111 @@ def test_exchange_all_to_all_and_broadcast():
     sums = g(keys.reshape(n_dev, n_per))
     # every device received ALL rows
     assert all(int(x) == int(keys.sum()) for x in np.asarray(sums))
+
+
+# ------------------------------------------------------------------ #
+# cross-device repartition (shuffle) join — VERDICT round-1 item #3
+# ------------------------------------------------------------------ #
+
+@pytest.fixture()
+def shuffle_forced(monkeypatch):
+    """Force the repartition path by shrinking the broadcast threshold."""
+    from tidb_tpu.executor import plan as planmod
+    monkeypatch.setattr(planmod, "BROADCAST_BUILD_MAX_ROWS", 0)
+
+
+def _mk_fact_dim(seed=11, n=20000, m=3000, kdom=400):
+    from tidb_tpu.chunk.column import Column
+    from tidb_tpu.types import dtypes as dt
+    dom = Domain()
+    s = Session(dom)
+    rng = np.random.default_rng(seed)
+    fk = rng.integers(0, kdom, n)
+    fv = rng.integers(0, 1000, n)
+    dk = rng.integers(0, kdom + kdom // 4, m)   # dups + misses
+    dw = rng.integers(0, 1000, m)
+    ft = TableInfo("fact", ["k", "v"], [dt.bigint(), dt.bigint()])
+    ft.register_columns([Column(dt.bigint(), fk.astype(np.int64),
+                                np.ones(n, bool)),
+                         Column(dt.bigint(), fv.astype(np.int64),
+                                np.ones(n, bool))])
+    dom.catalog.create_table("test", ft)
+    dtb = TableInfo("dim", ["k", "w"], [dt.bigint(), dt.bigint()])
+    dtb.register_columns([Column(dt.bigint(), dk.astype(np.int64),
+                                 np.ones(m, bool)),
+                          Column(dt.bigint(), dw.astype(np.int64),
+                                 np.ones(m, bool))])
+    dom.catalog.create_table("test", dtb)
+    return s, (fk, fv, dk, dw)
+
+
+def _join_oracle(fk, fv, dk, dw):
+    from collections import defaultdict
+    dmap = defaultdict(list)
+    for k, w in zip(dk.tolist(), dw.tolist()):
+        dmap[k].append(w)
+    return dmap
+
+
+def test_shuffle_join_agg(shuffle_forced):
+    """Non-unique m:n join runs via all_to_all repartition at 8 devices."""
+    s, (fk, fv, dk, dw) = _mk_fact_dim()
+    q = "select count(*), sum(v + w) from fact join dim on fact.k = dim.k"
+    plan = "\n".join(r[0] for r in s.must_query("explain " + q))
+    assert "CopShuffleJoin[agg,inner]" in plan, plan
+    got = s.must_query(q)[0]
+    dmap = _join_oracle(fk, fv, dk, dw)
+    total = vsum = 0
+    for k, v in zip(fk.tolist(), fv.tolist()):
+        for w in dmap.get(k, ()):
+            total += 1
+            vsum += v + w
+    assert got == (total, vsum)
+
+
+def test_shuffle_join_rows_and_filter(shuffle_forced):
+    s, (fk, fv, dk, dw) = _mk_fact_dim(n=2000, m=500)
+    q = ("select fact.k, v, w from fact join dim on fact.k = dim.k "
+         "where v < 100 and w < 500")
+    plan = "\n".join(r[0] for r in s.must_query("explain " + q))
+    assert "CopShuffleJoin[rows,inner]" in plan, plan
+    got = sorted(s.must_query(q))
+    dmap = _join_oracle(fk, fv, dk, dw)
+    exp = sorted((k, v, w)
+                 for k, v in zip(fk.tolist(), fv.tolist()) if v < 100
+                 for w in dmap.get(k, ()) if w < 500)
+    assert got == exp
+
+
+def test_shuffle_left_join(shuffle_forced):
+    s, (fk, fv, dk, dw) = _mk_fact_dim(n=3000, m=400, kdom=600)
+    q = ("select count(*), count(w) from fact "
+         "left join dim on fact.k = dim.k")
+    plan = "\n".join(r[0] for r in s.must_query("explain " + q))
+    assert "CopShuffleJoin[agg,left]" in plan, plan
+    got = s.must_query(q)[0]
+    dmap = _join_oracle(fk, fv, dk, dw)
+    total = matched = 0
+    for k in fk.tolist():
+        c = len(dmap.get(k, ()))
+        total += max(c, 1)
+        matched += c
+    assert got == (total, matched)
+
+
+def test_shuffle_join_groupby(shuffle_forced):
+    """GROUP BY on top of the repartition join (SORT strategy group-by)."""
+    s, (fk, fv, dk, dw) = _mk_fact_dim(n=5000, m=800)
+    q = ("select fact.k, count(*), sum(w) from fact "
+         "join dim on fact.k = dim.k group by fact.k")
+    plan = "\n".join(r[0] for r in s.must_query("explain " + q))
+    assert "CopShuffleJoin[agg,inner]" in plan, plan
+    got = {r[0]: (r[1], r[2]) for r in s.must_query(q)}
+    dmap = _join_oracle(fk, fv, dk, dw)
+    from collections import defaultdict
+    exp = defaultdict(lambda: [0, 0])
+    for k in fk.tolist():
+        for w in dmap.get(k, ()):
+            exp[k][0] += 1
+            exp[k][1] += w
+    assert got == {k: (c, sw) for k, (c, sw) in exp.items()}

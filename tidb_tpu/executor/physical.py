@@ -291,6 +291,46 @@ class CopJoinTaskExec(PhysOp):
         return self.fallback.execute(ctx)
 
 
+@dataclass
+class CopShuffleJoinExec(PhysOp):
+    """Cross-device repartition (shuffle) hash join — both sides stay
+    sharded on device; rows hash-partition over the mesh via all_to_all
+    and each device joins its partition (parallel/shuffle.py).  The MPP
+    HashPartition-exchange join analog
+    (physicalop/physical_exchange_sender.go:109, executor/shuffle.go:86):
+    chosen when the build side is too big to broadcast."""
+    spec: Any                      # D.ShuffleJoinSpec
+    left_table: Any
+    right_table: Any
+    out_names: list = field(default_factory=list)
+    out_dtypes: list = field(default_factory=list)
+    key_meta: list = field(default_factory=list)
+    out_dicts: dict = field(default_factory=dict)
+    children: list = field(default_factory=list)
+
+    def describe(self):
+        kind = "agg" if isinstance(self.spec.top, D.Aggregation) else "rows"
+        return (f"CopShuffleJoin[{kind},{self.spec.kind}] "
+                f"{self.left_table.name} x {self.right_table.name} "
+                f"all_to_all -> TPU")
+
+    def execute(self, ctx: ExecContext) -> ResultChunk:
+        lsnap = self.left_table.snapshot()
+        rsnap = self.right_table.snapshot()
+        if isinstance(self.spec.top, D.Aggregation):
+            res = ctx.client.execute_shuffle_agg(self.spec, lsnap, rsnap,
+                                                 self.key_meta)
+            cols = res.key_columns + res.columns
+        else:
+            cols = ctx.client.execute_shuffle_rows(
+                self.spec, lsnap, rsnap, tuple(self.out_dtypes),
+                self.out_dicts)
+        for j, d in self.out_dicts.items():
+            if j < len(cols) and cols[j].dictionary is None:
+                cols[j].dictionary = d
+        return ResultChunk(list(self.out_names), cols)
+
+
 # --------------------------------------------------------------------- #
 # host operators
 # --------------------------------------------------------------------- #

@@ -215,50 +215,21 @@ def _try_cop_join(p: LogicalPlan, top, mids, join: LogicalJoin) -> Optional[Phys
         return None
     li, ri = join.eq_keys[0]
 
-    # probe = left subtree: Selection/Projection chain over a DataSource
-    pm: list = []
-    cur = join.left
-    while isinstance(cur, (LogicalSelection, LogicalProjection)):
-        pm.append(cur)
-        cur = cur.child
-    if not isinstance(cur, DataSource):
-        return None
-    ds = cur
-    if not isinstance(join.right, (DataSource, LogicalSelection,
-                                   LogicalProjection)):
-        return None
-    # build side must be small enough to broadcast
+    # build side must be a chain over a DataSource; small enough to
+    # broadcast, else the cross-device repartition join takes it
     bcur = join.right
     while isinstance(bcur, (LogicalSelection, LogicalProjection)):
         bcur = bcur.child
-    if not isinstance(bcur, DataSource) \
-            or bcur.table.num_rows > BROADCAST_BUILD_MAX_ROWS:
+    if not isinstance(bcur, DataSource):
         return None
+    if bcur.table.num_rows > BROADCAST_BUILD_MAX_ROWS:
+        return _try_shuffle_join(p, top, mids, join)
 
-    snap = ds.table.snapshot()
-    probe_dicts = {}
-    for i, off in enumerate(ds.col_offsets):
-        c = snap.columns[off]
-        if c.dictionary is not None:
-            probe_dicts[i] = c.dictionary
-
-    # bind probe chain
-    node: D.CopNode = D.TableScan(tuple(ds.col_offsets),
-                                  tuple(c.dtype for c in ds.schema.cols))
-    cur_dicts = dict(probe_dicts)
-    for m in reversed(pm):
-        if isinstance(m, LogicalSelection):
-            conds = tuple(lower_strings(c, cur_dicts) for c in m.conditions)
-            if not all(_device_supported(c) for c in conds):
-                return None
-            node = D.Selection(node, conds)
-        else:
-            exprs = tuple(lower_strings(e, cur_dicts) for e in m.exprs)
-            if not all(_device_supported(e) for e in exprs):
-                return None
-            node = D.Projection(node, exprs)
-            cur_dicts = {j: cur_dicts[e.index] for j, e in enumerate(exprs)
-                         if isinstance(e, ColumnRef) and e.index in cur_dicts}
+    # probe = left subtree: Selection/Projection chain over a DataSource
+    lchain = _bind_scan_chain(join.left)
+    if lchain is None:
+        return None
+    node, cur_dicts, ds = lchain
     n_probe = len(join.left.schema)
 
     # build side: its own (recursive) physical plan, host-materialized
@@ -277,15 +248,43 @@ def _try_cop_join(p: LogicalPlan, top, mids, join: LogicalJoin) -> Optional[Phys
     all_dicts = dict(cur_dicts)
     for j, d in (build_out_dicts or {}).items():
         all_dicts[n_probe + j] = d
+    bound = _bind_post_join(top, mids, join, jnode, all_dicts)
+    if bound is None:
+        return None  # generic path handles host agg over host join
+    nodew, out_names, out_dtypes, out_dicts, key_meta, host_top = bound
+
+    fallback = to_physical(p, no_device_join=True)
+    exec_ = CopJoinTaskExec(
+        nodew, ds.table, build_exec=build_exec, build_key_index=ri,
+        build_key_dict=key_dict, probe_key_dtype=probe_key.dtype,
+        join_kind=join.kind, n_probe=n_probe,
+        out_names=out_names, out_dtypes=out_dtypes, key_meta=key_meta,
+        out_dicts=out_dicts, fallback=fallback)
+    if host_top is not None and host_top[0] == "topn":
+        return HostTopN(exec_, list(host_top[1].keys), host_top[1].limit,
+                        host_top[1].offset)
+    if host_top is not None:
+        return HostLimit(exec_, host_top[1].limit, host_top[1].offset)
+    return exec_
+
+
+def _bind_post_join(top, mids, join: LogicalJoin, start: D.CopNode,
+                    all_dicts: dict):
+    """Bind the post-join chain — ON-residue Selection, mid
+    Selection/Projections, and the top Agg/TopN/Limit — over the joined
+    schema, shared by the broadcast and repartition join planners.
+    Returns (node, out_names, out_dtypes, out_dicts, key_meta, host_top)
+    or None when something must stay on host."""
+    all_dicts = dict(all_dicts)
     out_names = join.schema.names()
     out_dtypes = [c.dtype for c in join.schema.cols]
-    out_dicts = {i: d for i, d in all_dicts.items()}
-    nodew: D.CopNode = jnode
+    out_dicts = dict(all_dicts)
+    nodew: D.CopNode = start
     if join.other_conds:
         if join.kind == "left":
             # residual ON conditions on an outer join are match conditions,
             # not filters: a failed condition must null-extend, not drop the
-            # probe row.  The host join implements this; the fused device
+            # probe row.  The host join implements this; a fused device
             # Selection would wrongly filter (review finding).
             return None
         conds = tuple(lower_strings(c, all_dicts) for c in join.other_conds)
@@ -316,7 +315,7 @@ def _try_cop_join(p: LogicalPlan, top, mids, join: LogicalJoin) -> Optional[Phys
             agg_dicts: dict[int, object] = {}
             agg_node = _bind_agg(top, nodew, all_dicts, key_meta, agg_dicts)
             if agg_node is None:
-                return None  # generic path handles host agg over host join
+                return None
             nodew = agg_node
             out_names = top.schema.names()
             out_dtypes = [c.dtype for c in top.schema.cols]
@@ -337,14 +336,117 @@ def _try_cop_join(p: LogicalPlan, top, mids, join: LogicalJoin) -> Optional[Phys
             host_top = ("limit", top)
         else:
             return None
+    return nodew, out_names, out_dtypes, out_dicts, key_meta, host_top
 
-    fallback = to_physical(p, no_device_join=True)
-    exec_ = CopJoinTaskExec(
-        nodew, ds.table, build_exec=build_exec, build_key_index=ri,
-        build_key_dict=key_dict, probe_key_dtype=probe_key.dtype,
-        join_kind=join.kind, n_probe=n_probe,
-        out_names=out_names, out_dtypes=out_dtypes, key_meta=key_meta,
-        out_dicts=out_dicts, fallback=fallback)
+
+def _bind_scan_chain(plan: LogicalPlan):
+    """Bind a Selection/Projection chain over a DataSource into a device
+    CopNode chain.  Returns (node, output_dicts, datasource) or None."""
+    mids: list = []
+    cur = plan
+    while isinstance(cur, (LogicalSelection, LogicalProjection)):
+        mids.append(cur)
+        cur = cur.child
+    if not isinstance(cur, DataSource):
+        return None
+    ds = cur
+    snap = ds.table.snapshot()
+    cur_dicts = {}
+    for i, off in enumerate(ds.col_offsets):
+        c = snap.columns[off]
+        if c.dictionary is not None:
+            cur_dicts[i] = c.dictionary
+    node: D.CopNode = D.TableScan(tuple(ds.col_offsets),
+                                  tuple(c.dtype for c in ds.schema.cols))
+    for m in reversed(mids):
+        if isinstance(m, LogicalSelection):
+            conds = tuple(lower_strings(c, cur_dicts) for c in m.conditions)
+            if not all(_device_supported(c) for c in conds):
+                return None
+            node = D.Selection(node, conds)
+        else:
+            exprs = tuple(lower_strings(e, cur_dicts) for e in m.exprs)
+            if not all(_device_supported(e) for e in exprs):
+                return None
+            node = D.Projection(node, exprs)
+            cur_dicts = {j: cur_dicts[e.index] for j, e in enumerate(exprs)
+                         if isinstance(e, ColumnRef) and e.index in cur_dicts}
+    return node, cur_dicts, ds
+
+
+# int64-comparable key kinds for the repartition join (equality compare +
+# hash partition over raw int64 representation is exact for these)
+_SHUFFLE_KEY_KINDS = {K.INT64, K.UINT64, K.DATE, K.DATETIME, K.TIME}
+
+
+def _try_shuffle_join(p: LogicalPlan, top, mids,
+                      join: LogicalJoin) -> Optional[PhysOp]:
+    """Cross-device repartition hash join: both sides' scan chains stay
+    sharded; rows hash-partition over the mesh (lax.all_to_all) and each
+    device joins its partition, with the post-join chain fused in the same
+    program (parallel/shuffle.py).  The MPP HashPartition exchange analog
+    (physical_exchange_sender.go:109)."""
+    import numpy as np
+
+    from ..expr import builders as B
+    from .physical import CopShuffleJoinExec
+
+    if join.kind not in ("inner", "left"):
+        return None
+    li, ri = join.eq_keys[0]
+    lchain = _bind_scan_chain(join.left)
+    rchain = _bind_scan_chain(join.right)
+    if lchain is None or rchain is None:
+        return None
+    lnode, ldicts, lds = lchain
+    rnode, rdicts, rds = rchain
+
+    left_key = lower_strings(join.left.schema.ref(li), ldicts)
+    right_key = lower_strings(join.right.schema.ref(ri), rdicts)
+    if not (_device_supported(left_key) and _device_supported(right_key)):
+        return None
+    lt, rt = left_key.dtype, right_key.dtype
+    if lt.is_string or rt.is_string:
+        if not (lt.is_string and rt.is_string):
+            return None
+        ld, rd = ldicts.get(li), rdicts.get(ri)
+        if ld is None or rd is None:
+            return None
+        # remap build codes into the probe dictionary's code space; values
+        # absent from the probe dict map to -1 and can never match
+        mapping = np.fromiter((ld.code_of(v) for v in rd.values),
+                              np.int64, count=len(rd)) \
+            if len(rd) else np.zeros(1, np.int64)
+        right_key = B.dict_map(right_key, mapping)
+    elif lt.kind == K.DECIMAL or rt.kind == K.DECIMAL:
+        if lt.kind != K.DECIMAL or rt.kind != K.DECIMAL \
+                or lt.scale != rt.scale:
+            return None
+    elif lt.kind not in _SHUFFLE_KEY_KINDS or rt.kind not in _SHUFFLE_KEY_KINDS:
+        return None
+
+    n_l = len(join.left.schema)
+    joined_dtypes = tuple(c.dtype for c in join.schema.cols)
+    all_dicts = dict(ldicts)
+    for j, d in rdicts.items():
+        all_dicts[n_l + j] = d
+
+    leaf: D.CopNode = D.TableScan(tuple(range(len(joined_dtypes))),
+                                  joined_dtypes)
+    bound = _bind_post_join(top, mids, join, leaf, all_dicts)
+    if bound is None:
+        return None
+    nodew, out_names, out_dtypes, out_dicts, key_meta, host_top = bound
+
+    spec = D.ShuffleJoinSpec(
+        left=lnode, right=rnode, left_key=left_key, right_key=right_key,
+        kind=join.kind,
+        left_dtypes=tuple(c.dtype for c in join.left.schema.cols),
+        right_dtypes=tuple(c.dtype for c in join.right.schema.cols),
+        top=nodew)
+    exec_ = CopShuffleJoinExec(spec, lds.table, rds.table,
+                               out_names=out_names, out_dtypes=out_dtypes,
+                               key_meta=key_meta, out_dicts=out_dicts)
     if host_top is not None and host_top[0] == "topn":
         return HostTopN(exec_, list(host_top[1].keys), host_top[1].limit,
                         host_top[1].offset)

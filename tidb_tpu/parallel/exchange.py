@@ -43,9 +43,11 @@ def all_to_all_exchange(cols: Sequence, valid, keys, n_dev: int,
 
     Each device buckets its local rows by hash(key) into a (n_dev,
     capacity) send buffer per column, then lax.all_to_all swaps bucket d of
-    every device to device d.  Returns (recv_cols, recv_valid, overflow)
-    where recv_* hold n_dev*capacity rows (concatenated incoming buckets)
-    and overflow is the per-device count of rows dropped for capacity.
+    every device to device d.  Returns (recv_cols, recv_valid, overflow,
+    max_count) where recv_* hold n_dev*capacity rows (concatenated incoming
+    buckets), overflow is the per-device count of rows dropped for
+    capacity, and max_count is the largest send-bucket size (what the
+    dispatcher must regrow capacity to).
     """
     if valid is True:
         valid = jnp.ones(keys.shape[0], bool)
@@ -62,6 +64,7 @@ def all_to_all_exchange(cols: Sequence, valid, keys, n_dev: int,
                          n_dev * capacity)      # OOB -> dropped
     counts = jnp.sum(onehot & valid[:, None], axis=0)
     overflow = jnp.sum(jnp.maximum(counts - capacity, 0))
+    max_count = jnp.max(counts)
 
     def scatter(v):
         buf = jnp.zeros((n_dev * capacity,), v.dtype)
@@ -83,7 +86,7 @@ def all_to_all_exchange(cols: Sequence, valid, keys, n_dev: int,
             rm = lax.all_to_all(sm, axis, split_axis=0, concat_axis=0,
                                 tiled=False).reshape(-1)
         out_cols.append((rv.reshape(-1), rm))
-    return out_cols, recv_valid, overflow
+    return out_cols, recv_valid, overflow, max_count
 
 
 def broadcast_gather(cols: Sequence, valid, axis: str = SHARD_AXIS):

@@ -1,0 +1,176 @@
+"""Repartition (shuffle) hash join: one shard_map program over the mesh.
+
+Reference analog: the MPP HashPartition plan cut + distributed hash join —
+PhysicalExchangeSender(HashPartition) (core/operator/physicalop/
+physical_exchange_sender.go:109), executed as gRPC chunk streams between
+TiFlash nodes, plus the intra-node ShuffleExec (executor/shuffle.go:86).
+
+TPU redesign (SURVEY.md §2.10 P3/P4/P7): the whole fragment graph —
+  scan(left) -> filter -> exchange(hash k) ──┐
+  scan(right) -> filter -> exchange(hash k) ─┴─ join -> top chain -> merge
+is ONE jit-compiled shard_map program.  Exchanges are lax.all_to_all over
+the ICI mesh axis (parallel/exchange.py); the per-partition join is the
+sorted-range expand join (copr/join.py); partial aggregates still merge
+via psum.  No RPC, no serialization: rows cross chips as dense columns.
+
+Static shapes: exchange buckets, the join output, and group tables all have
+fixed capacities; every true size is reported via extras so the dispatcher
+can regrow and retry (the paging discipline, SURVEY.md §5.7).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..copr import dag as D
+from ..copr.exec import (DeviceBatch, _agg_partial_states, _ensure_array,
+                         _exec_node, _sel_array, compact)
+from ..copr.join import gather_expand, match_ranges
+from ..expr.compile import Evaluator
+from ..ops.sortkeys import INT64_MAX
+from .exchange import all_to_all_exchange
+from .mesh import SHARD_AXIS
+from .spmd import _collective_merge, _flatten_block
+
+
+@dataclass(frozen=True)
+class ShuffleCaps:
+    """Static capacities of one compiled shuffle-join program (part of the
+    jit cache key; regrown by the dispatcher on overflow)."""
+    left: int          # exchange send-bucket rows per (device, dest)
+    right: int
+    out: int           # join output rows per device
+    rows: int = 0      # compacted result rows per device (rows-kind only)
+
+
+class ShardedShuffleJoinProgram:
+    """Compiled repartition-join program over a mesh.
+
+    kind 'agg':  __call__ -> (merged/per-device states, extras)
+    kind 'rows': __call__ -> ((cols, counts), extras) per device
+    extras: per-device {'lmax','rmax','join_total'} true sizes.
+    """
+
+    def __init__(self, spec: D.ShuffleJoinSpec, mesh, caps: ShuffleCaps):
+        self.spec = spec
+        self.mesh = mesh
+        self.caps = caps
+        self.n_dev = len(mesh.devices.reshape(-1))
+        self.agg = spec.top if isinstance(spec.top, D.Aggregation) else None
+        self.kind = "agg" if self.agg is not None else "rows"
+        # same host-merge policy as ShardedCopProgram (see spmd.py): SORT
+        # group tables and MIN/MAX partials merge on host
+        self.host_merge = self.agg is not None and (
+            self.agg.strategy == D.GroupStrategy.SORT or any(
+                a.func in (D.AggFunc.MIN, D.AggFunc.MAX)
+                for a in self.agg.aggs))
+
+        in_specs = (P(SHARD_AXIS), P(SHARD_AXIS),
+                    P(SHARD_AXIS), P(SHARD_AXIS), P())
+        if self.kind == "agg":
+            out_specs = P(SHARD_AXIS) if self.host_merge else P()
+        else:
+            out_specs = (P(SHARD_AXIS), P(SHARD_AXIS))
+        self._fn = jax.jit(shard_map(
+            self._device_fn, mesh=mesh, in_specs=in_specs,
+            out_specs=(out_specs, P(SHARD_AXIS)), check_vma=False))
+
+    # ------------------------------------------------------------- #
+
+    def _side(self, chain, key_expr, cols, counts, aux, ev, cap,
+              drop_null_keys: bool):
+        """Scan chain + key eval + hash-partition exchange for one side.
+        Returns (recv_cols, recv_valid, recv_keys, recv_key_ok, max_count)."""
+        flat, base_sel = _flatten_block([(v, m) for v, m in cols], counts)
+        flat = [(v, True if m is None else m) for v, m in flat]
+        batch = _exec_node(chain, flat, base_sel, ev, aux)
+        n = len(batch.cols[0][0]) if batch.cols else 0
+        sel = _sel_array(batch.sel, n)
+        kv, km = ev.eval(key_expr, batch.cols, {})
+        kv = _ensure_array(kv, n).astype(jnp.int64)
+        key_ok = sel if km is True else (sel & km)
+        live = key_ok if drop_null_keys else sel
+        send = [( _ensure_array(v, n), True if m is True else m)
+                for v, m in batch.cols]
+        send.append((kv, key_ok))
+        out_cols, recv_valid, _ovf, max_count = all_to_all_exchange(
+            send, live, jnp.where(key_ok, kv, 0), self.n_dev, cap)
+        rkeys, rkey_ok = out_cols[-1]
+        return out_cols[:-1], recv_valid, rkeys, rkey_ok, max_count
+
+    def _device_fn(self, lcols, lcounts, rcols, rcounts, aux):
+        ev = Evaluator(jnp)
+        aux = tuple((v, True if m is None else m) for v, m in aux)
+        spec, caps = self.spec, self.caps
+        semi = spec.kind in ("semi", "anti")
+
+        pcols, pvalid, pkeys, pkey_ok, lmax = self._side(
+            spec.left, spec.left_key, lcols, lcounts, aux, ev, caps.left,
+            drop_null_keys=(spec.kind == "inner" or spec.kind == "semi"))
+        bcols, bvalid, bkeys, bkey_ok, rmax = self._side(
+            spec.right, spec.right_key, rcols, rcounts, aux, ev, caps.right,
+            drop_null_keys=True)
+
+        # sort build partition by key; dead rows park at the end with an
+        # INT64_MAX fill so match_ranges' n_live clamp excludes them
+        nb = bkeys.shape[0]
+        bdead = (~(bvalid & bkey_ok)).astype(jnp.int32)
+        _sdead, skey, perm = lax.sort(
+            (bdead, bkeys, jnp.arange(nb)), num_keys=2)
+        n_live = jnp.sum(1 - bdead)
+        skey = jnp.where(jnp.arange(nb) < n_live, skey, INT64_MAX)
+
+        probe_ok = pvalid & pkey_ok
+        lo, _hi, cnt = match_ranges(skey, n_live, pkeys, probe_ok)
+
+        if semi:
+            keep = (cnt > 0) if spec.kind == "semi" else (cnt == 0)
+            joined = DeviceBatch(list(pcols), pvalid & keep,
+                                 {"join_total": jnp.sum(pvalid & keep)})
+        else:
+            probe = [(v, True if m is True else m) for v, m in pcols]
+            build = [(v, True if m is True else m) for v, m in bcols]
+            out_cols, out_sel, total = gather_expand(
+                probe, pvalid, probe_ok, build, perm, lo, cnt,
+                spec.kind, caps.out)
+            joined = DeviceBatch(out_cols, out_sel, {"join_total": total})
+
+        njoin = len(joined.cols[0][0]) if joined.cols else 0
+        sel_mask = _sel_array(joined.sel, njoin)
+        extras = {"lmax": lmax[None], "rmax": rmax[None],
+                  "join_total": jnp.asarray(joined.extras["join_total"])[None]}
+
+        if self.agg is not None:
+            batch = _exec_node(self.agg.child, joined.cols, sel_mask, ev, aux)
+            states = _agg_partial_states(self.agg, batch, ev, {})
+            if self.host_merge:
+                out = jax.tree_util.tree_map(lambda a: a[None], states)
+            else:
+                out = _collective_merge(states, SHARD_AXIS)
+            return out, extras
+        batch = _exec_node(spec.top, joined.cols, sel_mask, ev, aux)
+        out_cols, n = compact(batch, caps.rows)
+        return ([(v[None], m[None]) for v, m in out_cols], n[None]), extras
+
+    def __call__(self, lcols, lcounts, rcols, rcounts, aux_cols=()):
+        return self._fn(tuple(lcols), lcounts, tuple(rcols), rcounts,
+                        tuple(aux_cols))
+
+
+@functools.lru_cache(maxsize=128)
+def _cached(spec, mesh, caps):
+    return ShardedShuffleJoinProgram(spec, mesh, caps)
+
+
+def get_shuffle_program(spec: D.ShuffleJoinSpec, mesh,
+                        caps: ShuffleCaps) -> ShardedShuffleJoinProgram:
+    return _cached(spec, mesh, caps)
+
+
+__all__ = ["ShuffleCaps", "ShardedShuffleJoinProgram", "get_shuffle_program"]

@@ -124,6 +124,127 @@ class CopClient:
         return CopResult(agg_cols, key_cols)
 
     # ------------------------------------------------------------- #
+    # repartition (shuffle) join — parallel/shuffle.py
+    # ------------------------------------------------------------- #
+
+    def _shuffle_initial_caps(self, lsnap, rsnap, row_cap: int):
+        from ..parallel.shuffle import ShuffleCaps
+        n_dev = len(self.mesh.devices.reshape(-1))
+        # expected send-bucket rows under a uniform hash: local/n_dev;
+        # 2x headroom, grown from the reported true maxima on overflow
+        lcap = _pow2_at_least(
+            max(2 * lsnap.num_rows // max(n_dev * n_dev, 1) + 1, 1024))
+        rcap = _pow2_at_least(
+            max(2 * rsnap.num_rows // max(n_dev * n_dev, 1) + 1, 1024))
+        ocap = _pow2_at_least(max(2 * lsnap.num_rows // n_dev + 1, 1024))
+        return ShuffleCaps(lcap, rcap, ocap, row_cap)
+
+    def _run_shuffle(self, spec: D.ShuffleJoinSpec, lsnap, rsnap, aux_cols,
+                     row_cap: int = 0):
+        """Run the shuffle program, regrowing whichever static capacity
+        (exchange buckets / join output / group table / row output) the
+        extras report as overflowed — the paging discipline."""
+        import dataclasses
+
+        from ..parallel.shuffle import ShuffleCaps, get_shuffle_program
+        lcols, lcounts = lsnap.device_cols(self.mesh)
+        rcols, rcounts = rsnap.device_cols(self.mesh)
+        caps = self._shuffle_initial_caps(lsnap, rsnap, row_cap)
+        agg = spec.top if isinstance(spec.top, D.Aggregation) else None
+        if agg is not None and agg.strategy == D.GroupStrategy.SORT \
+                and not agg.group_capacity:
+            spec = dataclasses.replace(spec, top=dataclasses.replace(
+                agg, group_capacity=DEFAULT_GROUP_CAPACITY))
+        for _ in range(12):
+            prog = get_shuffle_program(spec, self.mesh, caps)
+            out, extras = prog(lcols, lcounts, rcols, rcounts, aux_cols)
+            extras = {k: np.asarray(jax.device_get(v))
+                      for k, v in extras.items()}
+            grew = False
+            need_l = int(extras["lmax"].max())
+            if need_l > caps.left:
+                caps = dataclasses.replace(caps,
+                                           left=_pow2_at_least(need_l))
+                grew = True
+            need_r = int(extras["rmax"].max())
+            if need_r > caps.right:
+                caps = dataclasses.replace(caps,
+                                           right=_pow2_at_least(need_r))
+                grew = True
+            need_j = int(extras["join_total"].max())
+            if spec.kind in ("inner", "left") and need_j > caps.out:
+                caps = dataclasses.replace(caps, out=_pow2_at_least(need_j))
+                grew = True
+            if grew:
+                continue
+            agg = spec.top if isinstance(spec.top, D.Aggregation) else None
+            if agg is not None and agg.strategy == D.GroupStrategy.SORT:
+                true_ng = int(np.max(np.asarray(
+                    jax.device_get(out["__ngroups__"]))))
+                if true_ng > agg.group_capacity:
+                    spec = dataclasses.replace(spec, top=dataclasses.replace(
+                        agg, group_capacity=_pow2_at_least(true_ng)))
+                    continue
+            if agg is None:
+                _cols, counts = out
+                counts = np.asarray(jax.device_get(counts))
+                if (counts > caps.rows).any():
+                    caps = dataclasses.replace(
+                        caps, rows=_pow2_at_least(int(counts.max())))
+                    continue
+            return prog, out
+        raise RuntimeError("shuffle capacity regrow did not converge")
+
+    def execute_shuffle_agg(self, spec: D.ShuffleJoinSpec, lsnap, rsnap,
+                            key_meta: list[GroupKeyMeta],
+                            aux_cols=()) -> CopResult:
+        prog, out = self._run_shuffle(spec, lsnap, rsnap, aux_cols)
+        agg = prog.spec.top
+        states = jax.device_get(out)
+        if prog.host_merge:
+            per_dev = self._split_devices(states)
+            if agg.strategy == D.GroupStrategy.SORT:
+                merged = merge_sorted_states(agg, per_dev)
+                key_cols, agg_cols = finalize_sorted(agg, merged, key_meta)
+                return CopResult(agg_cols, key_cols)
+            merged = merge_states(per_dev)
+        else:
+            merged = merge_states([states])
+        key_cols, agg_cols = finalize(agg, merged, key_meta)
+        return CopResult(agg_cols, key_cols)
+
+    def execute_shuffle_rows(self, spec: D.ShuffleJoinSpec, lsnap, rsnap,
+                             out_dtypes, dictionaries=None,
+                             aux_cols=()) -> list[Column]:
+        n_dev = len(self.mesh.devices.reshape(-1))
+        if isinstance(spec.top, (D.TopN, D.Limit)):
+            row_cap = max(spec.top.limit, 16)
+        else:
+            row_cap = _pow2_at_least(
+                max(2 * lsnap.num_rows // max(n_dev, 1) + 1, 1024))
+        prog, out = self._run_shuffle(spec, lsnap, rsnap, aux_cols, row_cap)
+        out_cols, out_counts = out
+        return self._assemble_rows(out_cols, out_counts, prog.caps.rows,
+                                   out_dtypes, dictionaries)
+
+    def _assemble_rows(self, out_cols, out_counts, cap, out_dtypes,
+                       dictionaries) -> list[Column]:
+        """Concatenate per-device compacted outputs into host Columns."""
+        n_dev = len(self.mesh.devices.reshape(-1))
+        out_counts = np.asarray(jax.device_get(out_counts))
+        out_cols = jax.device_get(out_cols)
+        per_dev_take = np.minimum(out_counts, cap)
+        result = []
+        for j, t in enumerate(out_dtypes):
+            data = np.concatenate([np.asarray(out_cols[j][0])[d, :per_dev_take[d]]
+                                   for d in range(n_dev)])
+            valid = np.concatenate([np.asarray(out_cols[j][1])[d, :per_dev_take[d]]
+                                    for d in range(n_dev)])
+            dic = dictionaries.get(j) if dictionaries else None
+            result.append(Column(t, data.astype(t.np_dtype()), valid, dic))
+        return result
+
+    # ------------------------------------------------------------- #
 
     def execute_rows(self, root: D.CopNode, snap: ColumnarSnapshot,
                      out_dtypes, dictionaries=None, aux_cols=()) -> list[Column]:
@@ -155,17 +276,8 @@ class CopClient:
         else:
             raise RuntimeError("paging loop did not converge")
 
-        out_cols = jax.device_get(out_cols)
-        per_dev_take = np.minimum(out_counts, cap)
-        result = []
-        for j, t in enumerate(out_dtypes):
-            data = np.concatenate([np.asarray(out_cols[j][0])[d, :per_dev_take[d]]
-                                   for d in range(n_dev)])
-            valid = np.concatenate([np.asarray(out_cols[j][1])[d, :per_dev_take[d]]
-                                    for d in range(n_dev)])
-            dic = dictionaries.get(j) if dictionaries else None
-            result.append(Column(t, data.astype(t.np_dtype()), valid, dic))
-        return result
+        return self._assemble_rows(out_cols, out_counts, cap, out_dtypes,
+                                   dictionaries)
 
 
 __all__ = ["CopClient", "CopResult"]
