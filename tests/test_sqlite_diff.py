@@ -112,6 +112,22 @@ CORPUS = [
     "select coalesce(b, 0) + 1 from t order by 1 limit 10",
     "select l.c, count(*) from t l left join t r on l.b = r.b and l.a = r.a "
     "  group by l.c order by l.c",
+    # string functions (lowered onto dict codes; sqlite shares these)
+    "select upper(c), lower(c) from t order by a, b, c, d limit 25",
+    "select length(c) from t order by a, b, c, d limit 25",
+    "select substr(c, 2, 2) from t order by a, b, c, d limit 25",
+    "select substr(c, 2) from t order by a, b, c, d limit 25",
+    "select replace(c, 'e', '3') from t order by a, b, c, d limit 25",
+    "select ltrim(c), rtrim(c), trim(c) from t order by a, b, c, d limit 25",
+    "select instr(c, 'e') from t order by a, b, c, d limit 25",
+    "select count(*) from t where length(c) = 4",
+    "select c, count(*) from t where upper(c) in ('RED', 'BLUE') "
+    "  group by c order by c",
+    "select count(*) from t where substr(c, 1, 1) = 'g'",
+    # math functions both engines share
+    "select round(a + 0.5) from t order by a, b, c, d limit 20",
+    "select sign(a) from t order by a, b, c, d limit 20",
+    "select min(b), max(b), count(*) from t where abs(a) < 10",
 ]
 
 

@@ -38,11 +38,23 @@ K = dt.TypeKind
 
 # capability registry: ops the device evaluator implements — the analog of
 # scalarExprSupportedByTiKV/Flash whitelists (expression/infer_pushdown.go).
+# String functions (upper/concat/substring/...) are NOT here: they lower to
+# dict_map/dict_lut at plan binding (expr/lower_strings.py); one left
+# unlowered is exactly a pushdown-blacklist hit and stays on host.
 DEVICE_OPS = {
     "add", "sub", "mul", "div", "intdiv", "mod", "neg", "abs",
     "eq", "ne", "lt", "le", "gt", "ge", "and", "or", "xor", "not",
     "isnull", "if", "case", "coalesce", "in", "dict_lut", "dict_map",
-    "year", "month", "dayofmonth", "cast",
+    "cast",
+    # math (builtin_math_vec.go analogs)
+    "ceil", "floor", "round", "truncate", "sqrt", "pow", "exp", "ln",
+    "log", "log2", "log10", "sign", "greatest", "least", "sin", "cos",
+    "tan", "cot", "asin", "acos", "atan", "atan2", "radians", "degrees",
+    # temporal (builtin_time_vec.go analogs)
+    "year", "month", "dayofmonth", "dayofweek", "weekday", "dayofyear",
+    "quarter", "hour", "minute", "second", "microsecond", "datediff",
+    "dateadd_days", "dateadd_months", "dateadd_micros", "last_day",
+    "to_days", "from_days", "unix_timestamp",
 }
 
 
@@ -335,8 +347,17 @@ class CopShuffleJoinExec(PhysOp):
 # host operators
 # --------------------------------------------------------------------- #
 
+def _chunk_dicts(chunk: ResultChunk) -> dict:
+    return {i: c.dictionary for i, c in enumerate(chunk.columns)
+            if c.dictionary is not None}
+
+
 def _eval_to_column(e: Expr, chunk: ResultChunk) -> Column:
     n = chunk.num_rows
+    # lower string predicates/functions onto the chunk's dictionaries so
+    # host residue evaluates the same code-space ops as the device
+    dicts = _chunk_dicts(chunk)
+    e = lower_strings(e, dicts)
     v, m = eval_expr(np, e, chunk.col_pairs())
     v = np.broadcast_to(np.asarray(v), (n,)).copy() if np.ndim(v) == 0 \
         else np.asarray(v)
@@ -361,10 +382,11 @@ def _eval_to_column(e: Expr, chunk: ResultChunk) -> Column:
 
 
 def _expr_dict(e: Expr, chunk: ResultChunk) -> Optional[StringDict]:
-    """Propagate the dictionary for passthrough string columns."""
+    """Propagate the dictionary for passthrough string columns and for
+    derived dictionaries from string-function lowering."""
     if isinstance(e, ColumnRef) and e.dtype.is_string:
         return chunk.columns[e.index].dictionary
-    return None
+    return getattr(e, "_derived_dict", None)
 
 
 @dataclass
@@ -699,9 +721,10 @@ def _conds_mask(chunk: ResultChunk, conds, dicts=None) -> np.ndarray:
     the chunk's dictionaries first."""
     pairs = chunk.col_pairs()
     keep = np.ones(chunk.num_rows, bool)
+    if dicts is None:
+        dicts = _chunk_dicts(chunk)
     for c in conds:
-        if dicts is not None:
-            c = lower_strings(c, dicts)
+        c = lower_strings(c, dicts)
         v, m = eval_expr(np, c, pairs)
         v = np.broadcast_to(np.asarray(v), (chunk.num_rows,))
         if v.dtype != bool:

@@ -16,7 +16,7 @@ from typing import Optional
 from ..copr import dag as D
 from ..copr.aggregate import GroupKeyMeta
 from ..expr.ir import ColumnRef, Expr
-from ..expr.lower_strings import lower_strings
+from ..expr.lower_strings import expr_out_dict, lower_strings
 from ..planner.build import DualSource
 from ..planner.logical import (DataSource, LogicalAggregate, LogicalCTEScan,
                                LogicalJoin, LogicalLimit, LogicalPlan,
@@ -146,8 +146,9 @@ def _try_cop(p: LogicalPlan, no_device_join: bool = False) -> Optional[PhysOp]:
             node = D.Projection(node, exprs)
             new_dicts = {}
             for j, e in enumerate(exprs):
-                if isinstance(e, ColumnRef) and e.index in cur_dicts:
-                    new_dicts[j] = cur_dicts[e.index]
+                d = expr_out_dict(e, cur_dicts)
+                if d is not None:
+                    new_dicts[j] = d
             cur_dicts = new_dicts
             out_dicts = dict(new_dicts)
             out_dtypes = [e.dtype for e in exprs]
@@ -302,8 +303,8 @@ def _bind_post_join(top, mids, join: LogicalJoin, start: D.CopNode,
             if not all(_device_supported(e) for e in exprs):
                 return None
             nodew = D.Projection(nodew, exprs)
-            all_dicts = {j: all_dicts[e.index] for j, e in enumerate(exprs)
-                         if isinstance(e, ColumnRef) and e.index in all_dicts}
+            all_dicts = {j: d for j, e in enumerate(exprs)
+                         if (d := expr_out_dict(e, all_dicts)) is not None}
             out_names = m.schema.names()
             out_dtypes = [e.dtype for e in exprs]
             out_dicts = dict(all_dicts)
@@ -369,8 +370,8 @@ def _bind_scan_chain(plan: LogicalPlan):
             if not all(_device_supported(e) for e in exprs):
                 return None
             node = D.Projection(node, exprs)
-            cur_dicts = {j: cur_dicts[e.index] for j, e in enumerate(exprs)
-                         if isinstance(e, ColumnRef) and e.index in cur_dicts}
+            cur_dicts = {j: d for j, e in enumerate(exprs)
+                         if (d := expr_out_dict(e, cur_dicts)) is not None}
     return node, cur_dicts, ds
 
 
@@ -474,8 +475,8 @@ def _chain_output_dicts(plan: LogicalPlan) -> dict:
             dicts[i] = c.dictionary
     for m in reversed(chain):
         if isinstance(m, LogicalProjection):
-            dicts = {j: dicts[e.index] for j, e in enumerate(m.exprs)
-                     if isinstance(e, ColumnRef) and e.index in dicts}
+            dicts = {j: d for j, e in enumerate(m.exprs)
+                     if (d := expr_out_dict(e, dicts)) is not None}
     return dicts
 
 

@@ -1,10 +1,10 @@
 from .ir import Expr, ColumnRef, Const, Func, walk, referenced_columns, map_column_indices
 from . import builders
 from .compile import Evaluator, eval_expr
-from .lower_strings import lower_strings, like_to_regex
+from .lower_strings import expr_out_dict, lower_strings, like_to_regex
 
 __all__ = [
     "Expr", "ColumnRef", "Const", "Func", "walk", "referenced_columns",
     "map_column_indices", "builders", "Evaluator", "eval_expr",
-    "lower_strings", "like_to_regex",
+    "lower_strings", "like_to_regex", "expr_out_dict",
 ]

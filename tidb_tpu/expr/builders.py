@@ -207,6 +207,119 @@ def temporal_part(part: str, a: Expr) -> Func:
     return Func(dt.bigint(a.dtype.nullable), part, (a,))
 
 
+# ------------------------------------------------------------------ #
+# string functions — generic Func nodes here; expr/lower_strings.py
+# rewrites them onto dictionary codes at plan-binding time (the TPU
+# answer to pkg/expression/builtin_string_vec.go: per-distinct-value
+# compute host-side, per-row gather on device)
+# ------------------------------------------------------------------ #
+
+STRING_VALUED_FUNCS = {"upper", "lower", "trim", "ltrim", "rtrim", "reverse",
+                       "substring", "replace", "concat", "left", "right",
+                       "lpad", "rpad"}
+STRING_INT_FUNCS = {"length", "char_length", "ascii", "locate", "instr"}
+
+
+def str_func(name: str, *args: Expr) -> Func:
+    nullable = any(a.dtype.nullable for a in args)
+    if name == "concat" and len(args) > 2:
+        # n-ary CONCAT folds to a binary tree so lowering only ever sees
+        # pairs (each level's derived dictionary feeds the next)
+        out = args[0]
+        for a in args[1:]:
+            out = str_func("concat", out, a)
+        return out
+    if name in STRING_INT_FUNCS:
+        return Func(dt.bigint(nullable), name, tuple(args))
+    assert name in STRING_VALUED_FUNCS, name
+    return Func(dt.varchar(nullable), name, tuple(args))
+
+
+# ------------------------------------------------------------------ #
+# math functions (builtin_math_vec.go analogs)
+# ------------------------------------------------------------------ #
+
+_DOUBLE_FUNCS = {"sqrt", "exp", "ln", "log2", "log10", "sin", "cos", "tan",
+                 "asin", "acos", "atan", "radians", "degrees", "cot"}
+
+
+def math_func(name: str, *args: Expr) -> Func:
+    nullable = any(a.dtype.nullable for a in args)
+    if name in ("ceil", "floor"):
+        a = args[0]
+        out = dt.double(nullable) if a.dtype.is_float else dt.bigint(nullable)
+        return Func(out, name, args)
+    if name == "sign":
+        return Func(dt.bigint(nullable), name, args)
+    if name in ("pow", "atan2", "log") or name in _DOUBLE_FUNCS:
+        # domain errors (sqrt of negative, log of <=0) yield NULL
+        return Func(dt.double(True), name, tuple(args))
+    raise AssertionError(name)
+
+
+def round_func(a: Expr, d: int, truncate: bool = False) -> Func:
+    """ROUND(a, d) / TRUNCATE(a, d) with MySQL result typing."""
+    op = "truncate" if truncate else "round"
+    darg = Const(dt.bigint(False), d)
+    if a.dtype.is_float:
+        return Func(dt.double(a.dtype.nullable), op, (a, darg))
+    if a.dtype.kind == K.DECIMAL:
+        s = max(min(d, a.dtype.scale), 0)
+        out = dt.decimal(max(a.dtype.prec - (a.dtype.scale - s), 1), s,
+                         a.dtype.nullable)
+        return Func(out, op, (a, darg))
+    return Func(a.dtype, op, (a, darg))   # int: d<0 rounds powers of ten
+
+
+def greatest_least(name: str, args: Sequence[Expr]) -> Func:
+    if any(a.dtype.is_string for a in args):
+        if not all(a.dtype.is_string for a in args):
+            raise ValueError(f"{name.upper()} over mixed string/non-string "
+                             "arguments is not supported")
+    t = _branch_type(list(args))
+    nullable = any(a.dtype.nullable for a in args)  # MySQL: NULL if any NULL
+    return Func(t.with_nullable(nullable), name, tuple(args))
+
+
+# ------------------------------------------------------------------ #
+# temporal functions (builtin_time_vec.go analogs)
+# ------------------------------------------------------------------ #
+
+def datediff(a: Expr, b: Expr) -> Func:
+    return Func(dt.bigint(a.dtype.nullable or b.dtype.nullable),
+                "datediff", (a, b))
+
+
+def date_add(base: Expr, amount: Expr, unit: str) -> Expr:
+    """DATE_ADD/DATE_SUB with a runtime (non-constant) base.
+
+    DAY/WEEK lower to integer day arithmetic; MONTH/QUARTER/YEAR to civil
+    decompose-add-clamp (dateadd_months); sub-day units promote DATE to
+    DATETIME (MySQL semantics) and add scaled microseconds."""
+    unit = unit.upper()
+    nullable = base.dtype.nullable or amount.dtype.nullable
+    if unit in ("DAY", "WEEK"):
+        n = arith("mul", amount, lit(7)) if unit == "WEEK" else amount
+        return Func(base.dtype.with_nullable(nullable), "dateadd_days",
+                    (base, n))
+    if unit in ("MONTH", "QUARTER", "YEAR"):
+        mult = {"MONTH": 1, "QUARTER": 3, "YEAR": 12}[unit]
+        n = arith("mul", amount, lit(mult)) if mult != 1 else amount
+        return Func(base.dtype.with_nullable(nullable), "dateadd_months",
+                    (base, n))
+    if unit in ("HOUR", "MINUTE", "SECOND", "MICROSECOND"):
+        mult = {"HOUR": 3_600_000_000, "MINUTE": 60_000_000,
+                "SECOND": 1_000_000, "MICROSECOND": 1}[unit]
+        b = cast(base, dt.datetime()) if base.dtype.kind == K.DATE else base
+        n = arith("mul", amount, lit(mult)) if mult != 1 else amount
+        return Func(dt.datetime(nullable), "dateadd_micros", (b, n))
+    raise ValueError(f"unsupported INTERVAL unit {unit}")
+
+
+def last_day(a: Expr) -> Func:
+    return Func(dt.date(a.dtype.nullable), "last_day", (a,))
+
+
 def dict_map(col: Expr, mapping: np.ndarray) -> Func:
     """Integer code-translation gather: remaps one dictionary's codes into a
     shared (merged) code space so string columns with different dictionaries
@@ -224,9 +337,18 @@ def dict_lut(col: Expr, lut: np.ndarray, nullable: bool | None = None) -> Func:
                 (col, Const(dt.bigint(False), lut.astype(np.bool_))))
 
 
+def dict_ilut(col: Expr, lut: np.ndarray, out: dt.DataType) -> Func:
+    """Integer lookup-table gather over dictionary codes — how LENGTH /
+    ASCII / LOCATE on dict-encoded strings execute on device."""
+    return Func(out, "dict_lut", (col, Const(dt.bigint(False),
+                                             lut.astype(np.int64))))
+
+
 __all__ = [
     "COMPARE_OPS", "LOGIC_OPS", "ARITH_OPS",
     "lit", "decimal_lit", "arith", "neg", "compare", "logic", "is_null",
     "if_", "case_when", "coalesce", "ifnull", "cast", "in_list", "between",
-    "temporal_part", "dict_lut", "dict_map",
+    "temporal_part", "dict_lut", "dict_map", "dict_ilut", "str_func",
+    "math_func", "round_func", "greatest_least", "datediff", "date_add",
+    "last_day", "STRING_VALUED_FUNCS", "STRING_INT_FUNCS",
 ]

@@ -385,6 +385,17 @@ class Evaluator:
 
     # -- strings (post-lowering) ----------------------------------------- #
 
+    def _op_string_unlowered(self, e, cols, memo):
+        raise NotImplementedError(
+            f"string function {e.op.upper()} could not be lowered onto "
+            "dictionary codes (non-dictionary input, non-constant "
+            "arguments, or dictionary product too large)")
+
+    op_upper = op_lower = op_trim = op_ltrim = op_rtrim = \
+        op_reverse = op_substring = op_replace = op_concat = op_left = \
+        op_right = op_lpad = op_rpad = op_length = op_char_length = \
+        op_ascii = op_locate = op_instr = _op_string_unlowered
+
     def op_dict_lut(self, e, cols, memo):
         xp = self.xp
         cv, cm = self.eval(e.args[0], cols, memo)
@@ -422,6 +433,307 @@ class Evaluator:
         _, _, d, m = self._ymd(e.args[0], cols, memo)
         return d, m
 
+    # -- math builtins ---------------------------------------------------- #
+
+    def op_ceil(self, e, cols, memo):
+        return self._ceil_floor(e, cols, memo, self.xp.ceil)
+
+    def op_floor(self, e, cols, memo):
+        return self._ceil_floor(e, cols, memo, self.xp.floor)
+
+    def _ceil_floor(self, e, cols, memo, fn):
+        xp = self.xp
+        a = e.args[0]
+        v, m = self._num(a, cols, memo)
+        if a.dtype.is_float:
+            return fn(self._as_double(v, a.dtype)), m
+        if a.dtype.kind == K.DECIMAL:
+            p = dec.pow10(a.dtype.scale)
+            q = xp.floor_divide(v, p)
+            if fn is xp.ceil:
+                q = q + ((v - q * p) != 0)
+            return _as_i64(xp, q), m
+        return _as_i64(xp, v), m
+
+    def op_round(self, e, cols, memo):
+        return self._round_trunc(e, cols, memo, False)
+
+    def op_truncate(self, e, cols, memo):
+        return self._round_trunc(e, cols, memo, True)
+
+    def _round_trunc(self, e, cols, memo, trunc: bool):
+        xp = self.xp
+        a, d = e.args
+        nd = int(d.value)
+        v, m = self._num(a, cols, memo)
+        if a.dtype.is_float:
+            f = self._as_double(v, a.dtype)
+            p = 10.0 ** nd
+            scaled = f * p
+            if trunc:
+                out = xp.trunc(scaled) / p
+            else:
+                out = xp.where(scaled >= 0, xp.floor(scaled + 0.5),
+                               xp.ceil(scaled - 0.5)) / p
+            return out, m
+        if a.dtype.kind == K.DECIMAL:
+            drop = a.dtype.scale - e.dtype.scale
+            if drop > 0:
+                p = dec.pow10(drop)
+                v = _trunc_div(xp, v, xp.int64(p)) if trunc \
+                    else _round_div(xp, v, xp.int64(p))
+            if nd < 0:   # ROUND(dec, -k): also round off integer digits
+                p2 = dec.pow10(-nd)
+                v = (_trunc_div(xp, v, xp.int64(p2)) if trunc
+                     else _round_div(xp, v, xp.int64(p2))) * p2
+            return v, m
+        if nd < 0:       # integer rounding to powers of ten
+            p = dec.pow10(-nd)
+            out = _trunc_div(xp, v, xp.int64(p)) if trunc \
+                else _round_div(xp, v, xp.int64(p))
+            return out * p, m
+        return v, m
+
+    def op_sign(self, e, cols, memo):
+        v, m = self._num(e.args[0], cols, memo)
+        return _as_i64(self.xp, self.xp.sign(v)), m
+
+    def _double1(self, e, cols, memo):
+        a = e.args[0]
+        v, m = self._num(a, cols, memo)
+        return self._as_double(v, a.dtype), m
+
+    def op_sqrt(self, e, cols, memo):
+        xp = self.xp
+        v, m = self._double1(e, cols, memo)
+        return xp.sqrt(xp.where(v < 0, 0.0, v)), vand(m, v >= 0)
+
+    def op_exp(self, e, cols, memo):
+        v, m = self._double1(e, cols, memo)
+        return self.xp.exp(v), m
+
+    def op_ln(self, e, cols, memo):
+        xp = self.xp
+        v, m = self._double1(e, cols, memo)
+        return xp.log(xp.where(v <= 0, 1.0, v)), vand(m, v > 0)
+
+    def op_log(self, e, cols, memo):
+        xp = self.xp
+        if len(e.args) == 1:
+            return self.op_ln(e, cols, memo)
+        # LOG(base, x)
+        bv, bm = self._num(e.args[0], cols, memo)
+        b = self._as_double(bv, e.args[0].dtype)
+        xv, xm = self._num(e.args[1], cols, memo)
+        x = self._as_double(xv, e.args[1].dtype)
+        ok = (x > 0) & (b > 0) & (b != 1.0)
+        num = xp.log(xp.where(x <= 0, 1.0, x))
+        den = xp.log(xp.where((b <= 0) | (b == 1.0), 2.0, b))
+        return num / den, vand(vand(bm, xm), ok)
+
+    def op_log2(self, e, cols, memo):
+        xp = self.xp
+        v, m = self._double1(e, cols, memo)
+        return xp.log2(xp.where(v <= 0, 1.0, v)), vand(m, v > 0)
+
+    def op_log10(self, e, cols, memo):
+        xp = self.xp
+        v, m = self._double1(e, cols, memo)
+        return xp.log10(xp.where(v <= 0, 1.0, v)), vand(m, v > 0)
+
+    def op_pow(self, e, cols, memo):
+        xp = self.xp
+        bv, bm = self._num(e.args[0], cols, memo)
+        ev_, em = self._num(e.args[1], cols, memo)
+        b = self._as_double(bv, e.args[0].dtype)
+        x = self._as_double(ev_, e.args[1].dtype)
+        # negative base with fractional exponent -> NULL (MySQL: error/NaN)
+        ok = (b >= 0) | (x == xp.floor(x))
+        out = xp.power(xp.where(ok, b, 1.0), x)
+        return out, vand(vand(bm, em), ok)
+
+    def op_sin(self, e, cols, memo):
+        v, m = self._double1(e, cols, memo)
+        return self.xp.sin(v), m
+
+    def op_cos(self, e, cols, memo):
+        v, m = self._double1(e, cols, memo)
+        return self.xp.cos(v), m
+
+    def op_tan(self, e, cols, memo):
+        v, m = self._double1(e, cols, memo)
+        return self.xp.tan(v), m
+
+    def op_cot(self, e, cols, memo):
+        xp = self.xp
+        v, m = self._double1(e, cols, memo)
+        t = xp.tan(v)
+        return 1.0 / xp.where(t == 0, 1.0, t), vand(m, t != 0)
+
+    def op_asin(self, e, cols, memo):
+        xp = self.xp
+        v, m = self._double1(e, cols, memo)
+        ok = (v >= -1) & (v <= 1)
+        return xp.arcsin(xp.clip(v, -1, 1)), vand(m, ok)
+
+    def op_acos(self, e, cols, memo):
+        xp = self.xp
+        v, m = self._double1(e, cols, memo)
+        ok = (v >= -1) & (v <= 1)
+        return xp.arccos(xp.clip(v, -1, 1)), vand(m, ok)
+
+    def op_atan(self, e, cols, memo):
+        v, m = self._double1(e, cols, memo)
+        return self.xp.arctan(v), m
+
+    def op_atan2(self, e, cols, memo):
+        xp = self.xp
+        av, am = self._num(e.args[0], cols, memo)
+        bv, bm = self._num(e.args[1], cols, memo)
+        return xp.arctan2(self._as_double(av, e.args[0].dtype),
+                          self._as_double(bv, e.args[1].dtype)), vand(am, bm)
+
+    def op_radians(self, e, cols, memo):
+        v, m = self._double1(e, cols, memo)
+        return v * (np.pi / 180.0), m
+
+    def op_degrees(self, e, cols, memo):
+        v, m = self._double1(e, cols, memo)
+        return v * (180.0 / np.pi), m
+
+    def _minmax_chain(self, e, cols, memo, fn):
+        xp = self.xp
+        if e.dtype.is_string and getattr(e, "_derived_dict", None) is None:
+            raise NotImplementedError(
+                f"{e.op.upper()} over strings requires dictionary-encoded "
+                "columns (merged-code lowering did not apply)")
+        val = valid = None
+        for a in e.args:
+            v, m = self._branch_val(e, a, cols, memo)
+            if val is None:
+                val, valid = v, m
+            else:
+                val = fn(val, v)
+                valid = vand(valid, m)   # MySQL: NULL if any arg NULL
+        return val, valid
+
+    def op_greatest(self, e, cols, memo):
+        return self._minmax_chain(e, cols, memo, self.xp.maximum)
+
+    def op_least(self, e, cols, memo):
+        return self._minmax_chain(e, cols, memo, self.xp.minimum)
+
+    # -- temporal builtins ------------------------------------------------- #
+
+    def op_dayofweek(self, e, cols, memo):
+        # 1 = Sunday (ODBC); epoch day 0 = Thursday
+        days, m = self._days_of(e.args[0], cols, memo)
+        return _pymod(self.xp, days + 4, 7) + 1, m
+
+    def op_weekday(self, e, cols, memo):
+        # 0 = Monday
+        days, m = self._days_of(e.args[0], cols, memo)
+        return _pymod(self.xp, days + 3, 7), m
+
+    def op_dayofyear(self, e, cols, memo):
+        from ..types.temporal import civil_from_days, days_from_civil
+        xp = self.xp
+        days, m = self._days_of(e.args[0], cols, memo)
+        days = _as_i64(xp, days)
+        y, _, _ = civil_from_days(xp, days)
+        jan1 = days_from_civil(xp, y, xp.ones_like(y), xp.ones_like(y))
+        return days - jan1 + 1, m
+
+    def op_quarter(self, e, cols, memo):
+        _, mo, _, m = self._ymd(e.args[0], cols, memo)
+        return (mo + 2) // 3, m
+
+    def _time_part(self, e, cols, memo, div, mod):
+        from ..types.temporal import MICROS_PER_DAY
+        xp = self.xp
+        a = e.args[0]
+        v, m = self.eval(a, cols, memo)
+        if a.dtype.kind == K.DATE:
+            return xp.zeros_like(_as_i64(xp, v)), m
+        tod = _pymod(xp, _as_i64(xp, v), MICROS_PER_DAY)
+        return _pymod(xp, tod // div, mod), m
+
+    def op_hour(self, e, cols, memo):
+        return self._time_part(e, cols, memo, 3_600_000_000, 24)
+
+    def op_minute(self, e, cols, memo):
+        return self._time_part(e, cols, memo, 60_000_000, 60)
+
+    def op_second(self, e, cols, memo):
+        return self._time_part(e, cols, memo, 1_000_000, 60)
+
+    def op_microsecond(self, e, cols, memo):
+        return self._time_part(e, cols, memo, 1, 1_000_000)
+
+    def op_datediff(self, e, cols, memo):
+        da, ma = self._days_of(e.args[0], cols, memo)
+        db, mb = self._days_of(e.args[1], cols, memo)
+        return _as_i64(self.xp, da) - _as_i64(self.xp, db), vand(ma, mb)
+
+    def op_dateadd_days(self, e, cols, memo):
+        from ..types.temporal import MICROS_PER_DAY
+        a, n = e.args
+        v, m = self.eval(a, cols, memo)
+        nv, nm = self._num(n, cols, memo)
+        step = MICROS_PER_DAY if a.dtype.kind == K.DATETIME else 1
+        return _as_i64(self.xp, v) + _as_i64(self.xp, nv) * step, vand(m, nm)
+
+    def op_dateadd_months(self, e, cols, memo):
+        from ..types.temporal import (MICROS_PER_DAY, civil_from_days,
+                                      days_from_civil, days_in_month)
+        xp = self.xp
+        a, n = e.args
+        v, m = self.eval(a, cols, memo)
+        nv, nm = self._num(n, cols, memo)
+        v = _as_i64(xp, v)
+        is_dt = a.dtype.kind == K.DATETIME
+        days = xp.floor_divide(v, MICROS_PER_DAY) if is_dt else v
+        tod = v - days * MICROS_PER_DAY if is_dt else 0
+        y, mo, d = civil_from_days(xp, days)
+        mi = y * 12 + (mo - 1) + _as_i64(xp, nv)
+        y2 = xp.floor_divide(mi, 12)
+        mo2 = mi - y2 * 12 + 1
+        d2 = xp.minimum(d, days_in_month(xp, y2, mo2))
+        out_days = days_from_civil(xp, y2, mo2, d2)
+        out = out_days * MICROS_PER_DAY + tod if is_dt else out_days
+        return out, vand(m, nm)
+
+    def op_dateadd_micros(self, e, cols, memo):
+        a, n = e.args
+        v, m = self.eval(a, cols, memo)
+        nv, nm = self._num(n, cols, memo)
+        return _as_i64(self.xp, v) + _as_i64(self.xp, nv), vand(m, nm)
+
+    def op_last_day(self, e, cols, memo):
+        from ..types.temporal import days_from_civil, days_in_month
+        xp = self.xp
+        y, mo, _d, m = self._ymd(e.args[0], cols, memo)
+        return days_from_civil(xp, y, mo, days_in_month(xp, y, mo)), m
+
+    def op_to_days(self, e, cols, memo):
+        # MySQL TO_DAYS: days since year 0 (epoch 1970-01-01 = 719528)
+        days, m = self._days_of(e.args[0], cols, memo)
+        return _as_i64(self.xp, days) + 719528, m
+
+    def op_from_days(self, e, cols, memo):
+        v, m = self._num(e.args[0], cols, memo)
+        return _as_i64(self.xp, v) - 719528, m
+
+    def op_unix_timestamp(self, e, cols, memo):
+        from ..types.temporal import MICROS_PER_DAY, MICROS_PER_SEC
+        xp = self.xp
+        a = e.args[0]
+        v, m = self.eval(a, cols, memo)
+        v = _as_i64(xp, v)
+        if a.dtype.kind == K.DATE:
+            v = v * MICROS_PER_DAY
+        return xp.floor_divide(v, MICROS_PER_SEC), m
+
     # -- casts ------------------------------------------------------------ #
 
     def op_cast(self, e, cols, memo):
@@ -455,6 +767,12 @@ class Evaluator:
                 out = xp.where(v >= 0, xp.floor(v + 0.5), xp.ceil(v - 0.5))
                 return out.astype(ity), m
             return (v.astype(ity) if hasattr(v, "astype") else int(v)), m
+        if dst.kind == K.DATETIME and src.kind == K.DATE:
+            from ..types.temporal import MICROS_PER_DAY
+            return _as_i64(xp, v) * MICROS_PER_DAY, m
+        if dst.kind == K.DATE and src.kind == K.DATETIME:
+            from ..types.temporal import MICROS_PER_DAY
+            return xp.floor_divide(_as_i64(xp, v), MICROS_PER_DAY), m
         raise NotImplementedError(f"cast {src} -> {dst}")
 
 
@@ -482,6 +800,12 @@ def _mask_arr(xp, m, like):
 
 def _as_i64(xp, v):
     return v.astype(xp.int64) if hasattr(v, "astype") else xp.int64(v)
+
+
+def _pymod(xp, a, b):
+    """Floor (python-style, non-negative for positive divisor) modulo —
+    keeps calendar arithmetic correct for pre-epoch dates."""
+    return xp.mod(a, b)
 
 
 def _as_u64(xp, v):

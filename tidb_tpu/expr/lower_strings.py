@@ -52,7 +52,19 @@ def like_to_regex(pattern: str, escape: str = "\\") -> re.Pattern:
 def _dict_for(e: Expr, dicts: dict[int, StringDict]) -> Optional[StringDict]:
     if isinstance(e, ColumnRef) and e.dtype.is_string:
         return dicts.get(e.index)
+    # dict_map nodes produced by string-function lowering carry the derived
+    # output dictionary, so e.g. WHERE UPPER(c) = 'X' lowers end-to-end
+    d = getattr(e, "_derived_dict", None)
+    if d is not None:
+        return d
     return None
+
+
+def expr_out_dict(e: Expr, dicts: dict[int, StringDict]) -> Optional[StringDict]:
+    """Output dictionary of a lowered string-valued expression (column
+    passthrough or a derived dictionary from string-function lowering) —
+    how planners propagate dictionaries through Projections."""
+    return _dict_for(e, dicts)
 
 
 def _const_str(e: Expr) -> Optional[str]:
@@ -65,11 +77,30 @@ _CMP_SWAP = {"eq": "eq", "ne": "ne", "lt": "gt", "le": "ge", "gt": "lt", "ge": "
 
 
 def lower_strings(e: Expr, dicts: dict[int, StringDict]) -> Expr:
-    """Rewrite string predicates to code-space ops. Non-string nodes recurse."""
+    """Rewrite string predicates AND string functions to code-space ops.
+
+    String-valued functions (UPPER, SUBSTRING, CONCAT, ...) over
+    dict-encoded columns compute per-DISTINCT-value host-side over the
+    (small) dictionary, producing a derived output dictionary + a code
+    translation that runs as one gather on device — the TPU redesign of
+    pkg/expression/builtin_string_vec.go's per-row loops.  Non-string
+    nodes recurse."""
     if not isinstance(e, Func):
         return e
     args = tuple(lower_strings(a, dicts) for a in e.args)
     e = Func(e.dtype, e.op, args)
+
+    from .builders import STRING_INT_FUNCS, STRING_VALUED_FUNCS
+    if e.op in STRING_VALUED_FUNCS:
+        lowered = _lower_str_valued(e, args, dicts)
+        if lowered is not None:
+            return lowered
+        return e
+    if e.op in STRING_INT_FUNCS:
+        lowered = _lower_str_int(e, args, dicts)
+        if lowered is not None:
+            return lowered
+        return e
 
     if e.op in B.COMPARE_OPS and len(args) == 2:
         # column-vs-column string compare: if the two sides use different
@@ -106,6 +137,12 @@ def lower_strings(e: Expr, dicts: dict[int, StringDict]) -> Expr:
                               dtype=bool, count=len(d))
             return B.dict_lut(args[0], _pad_lut(lut))
 
+    if e.op in ("greatest", "least") and e.dtype.is_string:
+        lowered = _lower_gl_strings(e, args, dicts)
+        if lowered is not None:
+            return lowered
+        return e
+
     if e.op == "in" and _dict_for(args[0], dicts) is not None:
         d = _dict_for(args[0], dicts)
         has_null = any(isinstance(a, Const) and a.value is None for a in args[1:])
@@ -130,6 +167,290 @@ def _pad_lut(lut: np.ndarray) -> np.ndarray:
     return lut if len(lut) else np.zeros(1, dtype=bool)
 
 
+# ------------------------------------------------------------------ #
+# string functions over dictionary codes
+# ------------------------------------------------------------------ #
+
+def _const_scalar(a: Expr):
+    """Python value of a non-NULL scalar Const (str or int), else None."""
+    if isinstance(a, Const) and isinstance(a.value, (str, int)) \
+            and not isinstance(a.value, bool):
+        return a.value
+    return None
+
+
+def _mysql_substring(s: str, pos: int, length: Optional[int]) -> str:
+    if pos == 0:
+        return ""
+    start = pos - 1 if pos > 0 else len(s) + pos
+    if start < 0:
+        return ""
+    end = len(s) if length is None else start + max(length, 0)
+    return s[start:end]
+
+
+def _str_valued_impl(op: str, consts: list):
+    """Per-dictionary-value python implementation of a string-valued
+    function with constant non-column arguments."""
+    if op == "upper":
+        return lambda v: v.upper()
+    if op == "lower":
+        return lambda v: v.lower()
+    if op in ("trim", "ltrim", "rtrim"):
+        r = str(consts[0]) if consts else None
+
+        def _trim(v, op=op, r=r):
+            if not r:
+                return {"trim": v.strip(" "), "ltrim": v.lstrip(" "),
+                        "rtrim": v.rstrip(" ")}[op]
+            # MySQL TRIM(remstr ...): removes whole-string occurrences
+            if op in ("trim", "ltrim"):
+                while v.startswith(r):
+                    v = v[len(r):]
+            if op in ("trim", "rtrim"):
+                while v.endswith(r):
+                    v = v[:-len(r)]
+            return v
+        return _trim
+    if op == "reverse":
+        return lambda v: v[::-1]
+    if op == "substring":
+        pos = consts[0]
+        length = consts[1] if len(consts) > 1 else None
+        return lambda v: _mysql_substring(v, pos, length)
+    if op == "replace":
+        frm, to = str(consts[0]), str(consts[1])
+        return (lambda v: v.replace(frm, to)) if frm else (lambda v: v)
+    if op == "left":
+        n = max(int(consts[0]), 0)
+        return lambda v: v[:n]
+    if op == "right":
+        n = int(consts[0])
+        return (lambda v: v[-n:]) if n > 0 else (lambda v: "")
+    if op == "lpad":
+        n, pad = int(consts[0]), str(consts[1])
+        return lambda v: (v[:n] if len(v) >= n or not pad
+                          else (pad * n)[:n - len(v)] + v)
+    if op == "rpad":
+        n, pad = int(consts[0]), str(consts[1])
+        return lambda v: (v[:n] if len(v) >= n or not pad
+                          else v + (pad * n)[:n - len(v)])
+    return None
+
+
+def _derived_map(out_dtype: dt.DataType, col: Expr, values: list[str]) -> Func:
+    """dict_map node carrying a derived output dictionary: `values[code]`
+    is the function result for source code `code`."""
+    new = StringDict(sorted(set(values)))
+    mapping = np.fromiter((new.code_of(v) for v in values), np.int32,
+                          count=len(values)) if values \
+        else np.zeros(1, np.int32)
+    node = Func(out_dtype, "dict_map",
+                (col, Const(dt.bigint(False), mapping)))
+    object.__setattr__(node, "_derived_dict", new)
+    return node
+
+
+def fold_string_func(e: Expr) -> Optional[Const]:
+    """Constant-fold a string-function tree whose leaves are all scalar
+    Consts (post-order), e.g. UPPER('abc') or CONCAT('a', 'b', col-less).
+    Returns None when any argument is non-constant."""
+    if not isinstance(e, Func):
+        return None
+    from .builders import STRING_INT_FUNCS, STRING_VALUED_FUNCS
+    if e.op not in STRING_VALUED_FUNCS and e.op not in STRING_INT_FUNCS:
+        return None
+    vals = []
+    for a in e.args:
+        if isinstance(a, Func):
+            a = fold_string_func(a)
+            if a is None:
+                return None
+        if not isinstance(a, Const):
+            return None
+        if a.value is None:
+            return Const(e.dtype.with_nullable(True), None)
+        vals.append(a.value)
+    if e.op == "concat":
+        return Const(e.dtype, "".join(str(v) for v in vals))
+    if e.op in STRING_INT_FUNCS:
+        if e.op == "length":
+            r = len(str(vals[0]).encode("utf-8"))
+        elif e.op == "char_length":
+            r = len(str(vals[0]))
+        elif e.op == "ascii":
+            s = str(vals[0])
+            r = ord(s[0]) if s else 0
+        elif e.op == "locate":
+            start = max(int(vals[2]) - 1, 0) if len(vals) > 2 else 0
+            r = str(vals[1]).find(str(vals[0]), start) + 1
+        else:  # instr
+            r = str(vals[0]).find(str(vals[1])) + 1
+        return Const(e.dtype, int(r))
+    fn = _str_valued_impl(e.op, vals[1:])
+    if fn is None:
+        return None
+    return Const(e.dtype, fn(str(vals[0])))
+
+
+def string_func_arg_error(e: Func) -> Optional[str]:
+    """Structural check at plan time: non-column arguments of string
+    functions must be constants (the dictionary-lowering contract);
+    returns an error message or None."""
+    from .builders import STRING_INT_FUNCS, STRING_VALUED_FUNCS
+    if e.op not in STRING_VALUED_FUNCS and e.op not in STRING_INT_FUNCS:
+        return None
+    if e.op == "concat":
+        return None
+    col_pos = 1 if e.op == "locate" else 0
+    for i, a in enumerate(e.args):
+        if i == col_pos:
+            continue
+        if not isinstance(a, Const):
+            return (f"{e.op.upper()}: argument {i + 1} must be a constant "
+                    "(only the string column may vary per row)")
+    return None
+
+
+def _lower_str_valued(e: Func, args, dicts) -> Optional[Expr]:
+    if e.op == "concat":
+        return _lower_concat(e, args, dicts)
+    col = args[0]
+    d = _dict_for(col, dicts)
+    if d is None:
+        return None
+    consts = []
+    for a in args[1:]:
+        c = _const_scalar(a)
+        if c is None:
+            if isinstance(a, Const) and a.value is None:
+                return Const(e.dtype.with_nullable(True), None)
+            return None
+        consts.append(c)
+    fn = _str_valued_impl(e.op, consts)
+    if fn is None:
+        return None
+    return _derived_map(e.dtype, col, [fn(v) for v in d.values])
+
+
+_CONCAT_MAX_PRODUCT = 1 << 16
+
+
+def _lower_concat(e: Func, args, dicts) -> Optional[Expr]:
+    """CONCAT over one or two dict columns + scalar constants.  Two
+    columns use a product code space (capped) — codeA*|B|+codeB."""
+    parts = []          # ("col", expr, dict) | ("const", str)
+    cols = []
+    for a in args:
+        d = _dict_for(a, dicts)
+        if d is not None:
+            parts.append(("col", a, d))
+            cols.append((a, d))
+            continue
+        c = _const_scalar(a)
+        if c is None:
+            if isinstance(a, Const) and a.value is None:
+                return Const(e.dtype.with_nullable(True), None)
+            return None
+        parts.append(("const", str(c), None))
+    if len(cols) == 1:
+        _ca, da = cols[0]
+        vals = []
+        for v in da.values:
+            vals.append("".join(v if p[0] == "col" else p[1] for p in parts))
+        return _derived_map(e.dtype, cols[0][0], vals)
+    if len(cols) == 2:
+        (ca, da), (cb, db) = cols
+        if len(da) * len(db) > _CONCAT_MAX_PRODUCT or not len(da) or not len(db):
+            return None
+        code = Func(dt.bigint(e.dtype.nullable), "add",
+                    (Func(dt.bigint(e.dtype.nullable), "mul",
+                          (ca, Const(dt.bigint(False), len(db)))), cb))
+        vals = []
+        for va in da.values:
+            for vb in db.values:
+                out = []
+                seen_a = False
+                for p in parts:
+                    if p[0] == "const":
+                        out.append(p[1])
+                    elif not seen_a:
+                        out.append(va)
+                        seen_a = True
+                    else:
+                        out.append(vb)
+                vals.append("".join(out))
+        return _derived_map(e.dtype, code, vals)
+    return None
+
+
+def _lower_gl_strings(e: Func, args, dicts) -> Optional[Expr]:
+    """GREATEST/LEAST over strings: remap every argument into one merged
+    sorted code space (codes then order lexicographically, so integer
+    max/min is string max/min); result carries the merged dictionary."""
+    values = set()
+    metas = []           # (kind, dict|str)
+    for a in args:
+        d = _dict_for(a, dicts)
+        if d is not None:
+            values.update(d.values)
+            metas.append(("col", a, d))
+            continue
+        s = _const_str(a)
+        if s is None:
+            return None
+        values.add(s)
+        metas.append(("const", s, None))
+    merged = StringDict(sorted(values))
+    new_args = []
+    for kind, a, d in metas:
+        if kind == "const":
+            new_args.append(Const(dt.bigint(False), merged.code_of(a)))
+            continue
+        mapping = np.fromiter((merged.code_of(v) for v in d.values),
+                              np.int32, count=len(d)) \
+            if len(d) else np.zeros(1, np.int32)
+        new_args.append(Func(a.dtype, "dict_map",
+                             (a, Const(dt.bigint(False), mapping))))
+    node = Func(e.dtype, e.op, tuple(new_args))
+    object.__setattr__(node, "_derived_dict", merged)
+    return node
+
+
+def _lower_str_int(e: Func, args, dicts) -> Optional[Expr]:
+    """LENGTH/CHAR_LENGTH/ASCII/LOCATE/INSTR over a dict column -> int LUT
+    gather."""
+    if e.op in ("length", "char_length", "ascii"):
+        col = args[0]
+        d = _dict_for(col, dicts)
+        if d is None:
+            return None
+        if e.op == "length":
+            lut = [len(v.encode("utf-8")) for v in d.values]
+        elif e.op == "char_length":
+            lut = [len(v) for v in d.values]
+        else:
+            lut = [ord(v[0]) if v else 0 for v in d.values]
+        return B.dict_ilut(col, np.asarray(lut if lut else [0], np.int64),
+                           e.dtype)
+    if e.op in ("locate", "instr"):
+        if e.op == "locate":
+            sub, col = args[0], args[1]
+            pos = _const_scalar(args[2]) if len(args) > 2 else 1
+        else:
+            col, sub = args[0], args[1]
+            pos = 1
+        d = _dict_for(col, dicts)
+        needle = _const_scalar(sub)
+        if d is None or needle is None or not isinstance(pos, int):
+            return None
+        start = max(int(pos) - 1, 0)
+        lut = [v.find(str(needle), start) + 1 for v in d.values]
+        return B.dict_ilut(col, np.asarray(lut if lut else [0], np.int64),
+                           e.dtype)
+    return None
+
+
 def _lower_cmp(dtype: dt.DataType, op: str, col: Expr, s: str, d: StringDict) -> Expr:
     ic = lambda code: Const(dt.bigint(False), int(code))
     if op == "eq":
@@ -147,4 +468,4 @@ def _lower_cmp(dtype: dt.DataType, op: str, col: Expr, s: str, d: StringDict) ->
     raise AssertionError(op)
 
 
-__all__ = ["lower_strings", "like_to_regex"]
+__all__ = ["lower_strings", "like_to_regex", "expr_out_dict"]

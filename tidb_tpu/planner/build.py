@@ -130,11 +130,10 @@ class ExprBuilder:
         unit = iv.unit
         if base.dtype.kind not in (K.DATE, K.DATETIME):
             raise PlanError("INTERVAL arithmetic needs a date operand")
-        if isinstance(base, Const):
+        if isinstance(base, Const) and base.dtype.kind == K.DATE \
+                and unit in ("DAY", "MONTH", "YEAR"):
             return _fold_interval_const(base, amount, unit)
-        if unit == "DAY" and base.dtype.kind == K.DATE:
-            return Func(base.dtype, "add", (base, Const(dt.bigint(False), amount)))
-        raise PlanError(f"non-constant INTERVAL {unit} not supported yet")
+        return B.date_add(base, B.lit(amount), unit)
 
     def _b_unary(self, n: A.Unary) -> Expr:
         if n.op == "NOT":
@@ -213,14 +212,89 @@ class ExprBuilder:
             if self.agg_resolver is None:
                 raise PlanError(f"aggregate {name} not allowed here")
             return self.agg_resolver(n)
+        if name in ("DATE_ADD", "ADDDATE", "DATE_SUB", "SUBDATE"):
+            # the INTERVAL argument is not an expression — don't build it
+            base = _coerce_to(dt.date(), self.build(n.args[0]))
+            return self._date_addsub(name, n, [base])
         args = [self.build(a) for a in n.args
                 if not isinstance(a, A.Star)]
-        if name in ("YEAR", "MONTH"):
+        if name in ("YEAR", "MONTH", "QUARTER", "DAYOFWEEK", "WEEKDAY",
+                    "DAYOFYEAR", "HOUR", "MINUTE", "SECOND", "MICROSECOND",
+                    "TO_DAYS", "UNIX_TIMESTAMP"):
             return B.temporal_part(name.lower(), args[0])
+        if name == "FROM_DAYS":
+            return Func(dt.date(args[0].dtype.nullable), "from_days",
+                        (args[0],))
         if name in ("DAY", "DAYOFMONTH"):
             return B.temporal_part("dayofmonth", args[0])
+        if name == "LAST_DAY":
+            return B.last_day(args[0])
+        if name == "DATEDIFF":
+            return B.datediff(_coerce_to(dt.date(), args[0]),
+                              _coerce_to(dt.date(), args[1]))
+        if name == "EXTRACT":
+            # parser encodes EXTRACT(unit FROM x) as FuncCall with the unit
+            # name stashed first as a string literal
+            unit = n.args[0].value if isinstance(n.args[0], A.Lit) else None
+            part = {"YEAR": "year", "MONTH": "month", "DAY": "dayofmonth",
+                    "QUARTER": "quarter", "HOUR": "hour", "MINUTE": "minute",
+                    "SECOND": "second",
+                    "MICROSECOND": "microsecond"}.get(str(unit).upper())
+            if part is None:
+                raise PlanError(f"unsupported EXTRACT unit {unit}")
+            return B.temporal_part(part, args[1])
         if name == "ABS":
             return Func(args[0].dtype, "abs", tuple(args))
+        if name in ("CEIL", "CEILING"):
+            return B.math_func("ceil", args[0])
+        if name == "FLOOR":
+            return B.math_func("floor", args[0])
+        if name in ("ROUND", "TRUNCATE"):
+            d = 0
+            if len(args) > 1:
+                if not isinstance(args[1], Const):
+                    raise PlanError(f"{name} digits must be constant")
+                d = int(args[1].value)
+            return B.round_func(args[0], d, truncate=(name == "TRUNCATE"))
+        if name in ("POW", "POWER"):
+            return B.math_func("pow", args[0], args[1])
+        if name == "LOG" and len(args) == 2:
+            return B.math_func("log", args[0], args[1])
+        if name in ("SQRT", "EXP", "LOG", "LOG2", "LOG10", "SIN", "COS",
+                    "TAN", "COT", "ASIN", "ACOS", "ATAN", "RADIANS",
+                    "DEGREES"):
+            op = "ln" if name == "LOG" else name.lower()
+            return B.math_func(op, args[0])
+        if name == "LN":
+            return B.math_func("ln", args[0])
+        if name == "ATAN2":
+            return B.math_func("atan2", args[0], args[1])
+        if name == "SIGN":
+            return B.math_func("sign", args[0])
+        if name == "PI":
+            return B.lit(float(np.pi))
+        if name == "MOD":
+            return B.arith("mod", args[0], args[1])
+        if name in ("GREATEST", "LEAST"):
+            return B.greatest_least(name.lower(), args)
+        if name in ("UPPER", "UCASE"):
+            return self._str_func("upper", args[0])
+        if name in ("LOWER", "LCASE"):
+            return self._str_func("lower", args[0])
+        if name in ("LENGTH", "OCTET_LENGTH"):
+            return self._str_func("length", args[0])
+        if name in ("CHAR_LENGTH", "CHARACTER_LENGTH"):
+            return self._str_func("char_length", args[0])
+        if name in ("SUBSTRING", "SUBSTR", "MID"):
+            return self._str_func("substring", *args)
+        if name == "CONCAT":
+            return self._str_func("concat", *args)
+        if name in ("TRIM", "LTRIM", "RTRIM", "REVERSE", "REPLACE",
+                    "LEFT", "RIGHT", "LPAD", "RPAD", "ASCII", "LOCATE",
+                    "INSTR"):
+            return self._str_func(name.lower(), *args)
+        if name == "POSITION":
+            return self._str_func("locate", args[0], args[1])
         if name == "IF":
             return B.if_(args[0], args[1], args[2])
         if name == "IFNULL":
@@ -231,7 +305,52 @@ class ExprBuilder:
             return B.if_(B.compare("eq", args[0], args[1]), B.lit(None), args[0])
         if name == "DATE":
             return B.cast(args[0], dt.date())
+        if name in ("NOW", "CURRENT_TIMESTAMP", "SYSDATE", "CURDATE",
+                    "CURRENT_DATE"):
+            # statement-start clock (MySQL: constant within a statement)
+            import time as _time
+            now = _time.time()
+            micros = int(now * 1_000_000)
+            if name in ("CURDATE", "CURRENT_DATE"):
+                return Const(dt.date(False), micros // tmp.MICROS_PER_DAY)
+            return Const(dt.datetime(False), micros)
         raise PlanError(f"unsupported function {name}")
+
+    def _str_func(self, op: str, *args: Expr) -> Expr:
+        """String function with plan-time constant folding and a
+        structural check that non-column arguments are constants (the
+        dictionary-lowering contract — see expr/lower_strings.py)."""
+        from ..expr.lower_strings import (fold_string_func,
+                                          string_func_arg_error)
+        e = B.str_func(op, *args)
+        folded = fold_string_func(e)
+        if folded is not None:
+            return folded
+        if isinstance(e, Func):
+            err = string_func_arg_error(e)
+            if err is not None:
+                raise PlanError(err)
+        return e
+
+    def _date_addsub(self, name: str, n: A.FuncCall, args) -> Expr:
+        """DATE_ADD/DATE_SUB(base, INTERVAL expr unit) — constant bases
+        fold at plan time, runtime bases lower to device date arithmetic."""
+        iv = n.args[1]
+        if not (isinstance(iv, A.Lit) and iv.kind == "interval"):
+            raise PlanError(f"{name} needs an INTERVAL argument")
+        amt_e = self.build(iv.value) if isinstance(iv.value, A.Node) \
+            else B.lit(int(iv.value))
+        base = args[0]
+        neg = name in ("DATE_SUB", "SUBDATE")
+        if base.dtype.kind not in (K.DATE, K.DATETIME):
+            raise PlanError(f"{name} needs a date operand")
+        if isinstance(base, Const) and isinstance(amt_e, Const) \
+                and base.dtype.kind == K.DATE \
+                and iv.unit in ("DAY", "MONTH", "YEAR"):
+            amount = int(amt_e.value) * (-1 if neg else 1)
+            return _fold_interval_const(base, amount, iv.unit)
+        amt = Func(amt_e.dtype, "neg", (amt_e,)) if neg else amt_e
+        return B.date_add(base, amt, iv.unit)
 
     def _b_star(self, n: A.Star) -> Expr:
         raise PlanError("* only valid as a top-level select item")

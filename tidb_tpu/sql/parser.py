@@ -1123,7 +1123,59 @@ class Parser:
 
     def func_call(self, name: str) -> A.Node:
         self.expect_op("(")
-        fc = A.FuncCall(name.upper())
+        nm = name.upper()
+        # SQL-standard special argument forms
+        if nm in ("SUBSTRING", "SUBSTR", "MID"):
+            first = self.expr()
+            if self.accept_kw("FROM"):
+                fc = A.FuncCall("SUBSTRING")
+                fc.args = [first, self.expr()]
+                if self.accept_kw("FOR"):
+                    fc.args.append(self.expr())
+                self.expect_op(")")
+                return fc
+            fc = A.FuncCall("SUBSTRING")
+            fc.args = [first]
+            while self.accept_op(","):
+                fc.args.append(self.expr())
+            self.expect_op(")")
+            return fc
+        if nm == "TRIM":
+            mode = "BOTH"
+            if self.cur.kind == "ident" and self.cur.text.upper() in (
+                    "BOTH", "LEADING", "TRAILING"):
+                mode = self.advance().text.upper()
+                remstr = None
+                if not self.at_kw("FROM"):
+                    remstr = self.expr()
+                self.expect_kw("FROM")
+                target = self.expr()
+            else:
+                first = self.expr()
+                if self.accept_kw("FROM"):
+                    remstr, target = first, self.expr()
+                else:
+                    remstr, target = None, first
+            self.expect_op(")")
+            fc = A.FuncCall({"BOTH": "TRIM", "LEADING": "LTRIM",
+                             "TRAILING": "RTRIM"}[mode])
+            fc.args = [target] + ([remstr] if remstr is not None else [])
+            return fc
+        if nm == "EXTRACT":
+            unit = self.advance().text.upper()
+            self.expect_kw("FROM")
+            fc = A.FuncCall("EXTRACT")
+            fc.args = [A.Lit(unit, "str"), self.expr()]
+            self.expect_op(")")
+            return fc
+        if nm == "POSITION":
+            a = self.bit_or()   # stop below IN so `x IN y` doesn't swallow it
+            self.expect_kw("IN")
+            fc = A.FuncCall("POSITION")
+            fc.args = [a, self.expr()]
+            self.expect_op(")")
+            return fc
+        fc = A.FuncCall(nm)
         if self.at_op("*"):
             self.advance()
             self.expect_op(")")
@@ -1186,7 +1238,8 @@ class Parser:
 
 # keywords that can also start function calls (YEAR(x), DATE(x), IF(...))
 _FUNC_KEYWORDS = {"YEAR", "MONTH", "DAY", "HOUR", "MINUTE", "SECOND", "IF",
-                  "DATE", "TIME", "SUBSTRING", "TRUNCATE"}
+                  "DATE", "TIME", "SUBSTRING", "TRUNCATE", "LEFT", "RIGHT",
+                  "MOD", "CHARACTER"}
 
 # keywords allowed as plain identifiers (column/table names)
 _NONRESERVED = {"YEAR", "MONTH", "DAY", "HOUR", "MINUTE", "SECOND", "DATE",

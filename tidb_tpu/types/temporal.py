@@ -86,6 +86,32 @@ def civil_from_days(xp, days):
     return y, m, d
 
 
+def days_from_civil(xp, y, m, d):
+    """Vectorized days-from-civil (inverse of civil_from_days), same
+    algorithm family — used by device DATE_ADD month arithmetic."""
+    y = y - (m <= 2)
+    era = xp.floor_divide(y, 400)
+    yoe = y - era * 400
+    mp = xp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+def is_leap(xp, y):
+    return ((y % 4 == 0) & (y % 100 != 0)) | (y % 400 == 0)
+
+
+_MONTH_DAYS = np.array([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31],
+                       dtype=np.int64)
+
+
+def days_in_month(xp, y, m):
+    """Vectorized month length (for DATE_ADD day clamping / LAST_DAY)."""
+    base = xp.asarray(_MONTH_DAYS)[xp.clip(m - 1, 0, 11)]
+    return base + (is_leap(xp, y) & (m == 2))
+
+
 def year_month_day_np(days: np.ndarray) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     y, m, d = civil_from_days(np, days)
     return y.astype(np.int64), m.astype(np.int64), d.astype(np.int64)
