@@ -169,12 +169,6 @@ def test_device_host_parity(sess, expr):
     """The same function evaluated on the device path (fused projection)
     and the host path (projection over host-materialized rows) must agree
     — the per-function capability/residue-split test."""
-    from tidb_tpu.executor.physical import ExecContext
-    from tidb_tpu.executor.plan import to_physical
-    from tidb_tpu.planner.build import build_select
-    from tidb_tpu.planner.optimize import optimize_plan
-    from tidb_tpu.sql.parser import parse_one
-
     q = f"select {expr} from ft order by id"
     device_rows = sess.must_query(q)
 

@@ -1,0 +1,129 @@
+"""Subqueries + semi/anti joins, differentially tested against sqlite.
+
+Covers the TPC-H Q4/Q16/Q21/Q22 shapes VERDICT round 1 called for:
+IN / NOT IN (null-aware anti), correlated and uncorrelated [NOT] EXISTS,
+and scalar subqueries in comparisons.
+"""
+
+import sqlite3
+
+import numpy as np
+import pytest
+
+from tidb_tpu.session import Domain, Session
+
+
+@pytest.fixture(scope="module")
+def engines():
+    rng = np.random.default_rng(77)
+    n_o, n_l = 400, 1200
+    orders = [(i, int(rng.integers(0, 50)), str(rng.choice(["A", "B", "F"])))
+              for i in range(n_o)]
+    line = [(int(rng.integers(0, n_o + 40)), int(rng.integers(0, 30)),
+             int(rng.integers(1, 100)),
+             None if rng.random() < 0.05 else int(rng.integers(0, 30)))
+            for _ in range(n_l)]
+
+    ours = Session(Domain())
+    ours.execute("create table orders (o_id bigint, o_cust bigint, "
+                 "o_status varchar(4))")
+    ours.execute("create table lineitem (l_oid bigint, l_supp bigint, "
+                 "l_qty bigint, l_supp2 bigint)")
+    lite = sqlite3.connect(":memory:")
+    lite.execute("create table orders (o_id bigint, o_cust bigint, "
+                 "o_status varchar(4))")
+    lite.execute("create table lineitem (l_oid bigint, l_supp bigint, "
+                 "l_qty bigint, l_supp2 bigint)")
+    for o in orders:
+        ours.execute(f"insert into orders values ({o[0]}, {o[1]}, '{o[2]}')")
+    lite.executemany("insert into orders values (?,?,?)", orders)
+    for r in line:
+        v = ", ".join("NULL" if x is None else str(x) for x in r)
+        ours.execute(f"insert into lineitem values ({v})")
+    lite.executemany("insert into lineitem values (?,?,?,?)", line)
+    lite.commit()
+    return ours, lite
+
+
+CORPUS = [
+    # IN subquery -> semi join (Q16/Q18 shape)
+    "select count(*) from orders where o_id in (select l_oid from lineitem)",
+    "select o_status, count(*) from orders where o_id in "
+    "  (select l_oid from lineitem where l_qty > 50) "
+    "  group by o_status order by o_status",
+    # NOT IN -> null-aware anti join (no NULLs in l_oid here)
+    "select count(*) from orders where o_id not in "
+    "  (select l_oid from lineitem)",
+    # NOT IN over a NULLABLE column -> empty (null-aware semantics)
+    "select count(*) from orders where o_cust not in "
+    "  (select l_supp2 from lineitem)",
+    "select count(*) from orders where o_cust in "
+    "  (select l_supp2 from lineitem)",
+    # uncorrelated EXISTS / NOT EXISTS
+    "select count(*) from orders where exists "
+    "  (select 1 from lineitem where l_qty > 95)",
+    "select count(*) from orders where not exists "
+    "  (select 1 from lineitem where l_qty > 99)",
+    # correlated EXISTS -> decorrelated semi join (Q4 shape)
+    "select o_status, count(*) from orders where exists "
+    "  (select 1 from lineitem where l_oid = o_id and l_qty < 5) "
+    "  group by o_status order by o_status",
+    # correlated NOT EXISTS -> anti join (Q21/Q22 shape)
+    "select count(*) from orders where not exists "
+    "  (select 1 from lineitem where l_oid = o_id)",
+    # correlated EXISTS with an extra non-equi correlated condition
+    # (Q21's l3.l_suppkey <> l1.l_suppkey shape)
+    "select count(*) from orders where exists "
+    "  (select 1 from lineitem where l_oid = o_id and l_supp <> o_cust)",
+    # scalar subquery in a comparison (Q22 shape)
+    "select count(*) from lineitem where l_qty > "
+    "  (select avg(l_qty) from lineitem)",
+    "select o_id from orders where o_cust = "
+    "  (select max(o_cust) from orders) order by o_id limit 5",
+    # semi join + plain predicates mixed
+    "select count(*) from orders where o_status = 'A' and o_id in "
+    "  (select l_oid from lineitem where l_qty between 10 and 60)",
+    # IN with computed target expression
+    "select count(*) from orders where o_id + 1 in "
+    "  (select l_oid from lineitem)",
+]
+
+
+@pytest.mark.parametrize("sql", CORPUS)
+def test_subquery_differential(engines, sql):
+    ours, lite = engines
+    got = ours.must_query(sql)
+    exp = lite.execute(sql).fetchall()
+    norm = lambda rows: sorted(tuple(float(x) if isinstance(x, float) else x
+                                     for x in r) for r in rows)
+    assert norm(got) == norm(exp), (
+        f"\nquery: {sql}\nours: {got[:10]}\nsqlite: {exp[:10]}")
+
+
+def test_semi_join_device_path(engines):
+    """The semi join pushes to the device when sides are scan chains."""
+    ours, _ = engines
+    plan = "\n".join(r[0] for r in ours.must_query(
+        "explain select count(*) from orders where o_id in "
+        "(select l_oid from lineitem)"))
+    assert "CopJoinTask[agg,semi]" in plan, plan
+
+
+def test_anti_join_device_path(engines):
+    ours, _ = engines
+    plan = "\n".join(r[0] for r in ours.must_query(
+        "explain select count(*) from orders where o_id not in "
+        "(select l_oid from lineitem)"))
+    assert "CopJoinTask[agg,anti]" in plan, plan
+
+
+def test_shuffle_semi_join(engines, monkeypatch):
+    """Semi join via the repartition path at 8 devices."""
+    from tidb_tpu.executor import plan as planmod
+    monkeypatch.setattr(planmod, "BROADCAST_BUILD_MAX_ROWS", 0)
+    ours, lite = engines
+    q = ("select count(*) from orders where o_id in "
+         "(select l_oid from lineitem)")
+    plan = "\n".join(r[0] for r in ours.must_query("explain " + q))
+    assert "CopShuffleJoin[agg,semi]" in plan, plan
+    assert ours.must_query(q) == lite.execute(q).fetchall()

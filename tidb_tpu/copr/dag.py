@@ -164,6 +164,7 @@ class LookupJoin(CopNode):
     build_dtypes: Tuple[dt.DataType, ...] = ()
     unique: bool = True
     out_capacity: int = 0          # unique=False only
+    null_aware: bool = False       # anti only: NOT IN semantics
 
     def children(self):
         return (self.child,)
@@ -244,19 +245,49 @@ def to_multimatch(node: CopNode, out_capacity: int) -> CopNode:
     return node
 
 
-def rewrite_expand_capacity(node: CopNode, new_cap: int) -> CopNode:
-    """Rebuild the DAG with the non-unique LookupJoin's out_capacity
-    replaced (the dispatcher's regrow-and-retry step)."""
+def rewrite_lookup(node: CopNode, pred=None, **changes) -> CopNode:
+    """Rebuild the DAG with the (pred-matching) LookupJoin's fields
+    replaced (runtime strategy switches: multi-match regrow etc.)."""
     import dataclasses
-    if isinstance(node, LookupJoin) and not node.unique:
-        return dataclasses.replace(node, out_capacity=new_cap)
+    if isinstance(node, LookupJoin) and (pred is None or pred(node)):
+        return dataclasses.replace(node, **changes)
     if not node.children():
         return node
-    kids = tuple(rewrite_expand_capacity(c, new_cap) for c in node.children())
+    kids = tuple(rewrite_lookup(c, pred, **changes)
+                 for c in node.children())
     if isinstance(node, (Selection, Projection, Limit, TopN, Aggregation,
                          LookupJoin)):
         return dataclasses.replace(node, child=kids[0])
     return node
+
+
+def drop_lookup(node: CopNode, keep: bool) -> CopNode:
+    """Replace the semi/anti LookupJoin with its probe chain outright:
+    `keep=True` passes every probe row (anti vs an empty build),
+    `keep=False` passes none (NOT IN with a NULL build key) via a
+    constant-false Selection.  Exact — no sentinel keys that could
+    collide with real data."""
+    import dataclasses
+
+    from ..expr.ir import Const
+    if isinstance(node, LookupJoin):
+        if keep:
+            return node.child
+        return Selection(node.child, (Const(dt.bigint(False), 0),))
+    if not node.children():
+        return node
+    kids = tuple(drop_lookup(c, keep) for c in node.children())
+    if isinstance(node, (Selection, Projection, Limit, TopN, Aggregation,
+                         LookupJoin)):
+        return dataclasses.replace(node, child=kids[0])
+    return node
+
+
+def rewrite_expand_capacity(node: CopNode, new_cap: int) -> CopNode:
+    """Rebuild the DAG with the non-unique LookupJoin's out_capacity
+    replaced (the dispatcher's regrow-and-retry step)."""
+    return rewrite_lookup(node, pred=lambda j: not j.unique,
+                          out_capacity=new_cap)
 
 
 def dag_digest(node: CopNode) -> int:
@@ -269,5 +300,6 @@ __all__ = [
     "AggFunc", "AggDesc", "CopNode", "TableScan", "Selection", "Projection",
     "GroupStrategy", "Aggregation", "TopN", "Limit", "LookupJoin",
     "ShuffleJoinSpec", "output_dtypes", "dag_digest", "find_expand_join",
+    "rewrite_lookup", "drop_lookup",
     "rewrite_expand_capacity",
 ]

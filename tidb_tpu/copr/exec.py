@@ -389,6 +389,8 @@ def _exec_lookup_join(node: D.LookupJoin, batch: DeviceBatch, ev: Evaluator,
 
     if node.kind in ("semi", "anti"):
         keep = (cnt > 0) if node.kind == "semi" else (cnt == 0)
+        if node.kind == "anti" and node.null_aware and km is not True:
+            keep = keep & km       # NOT IN: NULL probe key -> filtered
         return DeviceBatch(batch.cols, sel & keep, batch.extras)
 
     oc = node.out_capacity

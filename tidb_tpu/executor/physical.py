@@ -197,6 +197,7 @@ class CopJoinTaskExec(PhysOp):
     build_key_dict: Any = None     # probe-side StringDict for string keys
     probe_key_dtype: Any = None    # for decimal scale alignment
     join_kind: str = "inner"
+    null_aware: bool = False
     n_probe: int = 0
     out_names: list = field(default_factory=list)
     out_dtypes: list = field(default_factory=list)
@@ -220,11 +221,23 @@ class CopJoinTaskExec(PhysOp):
         keys, ok = self._build_keys(kcol)
         rows_idx = np.nonzero(ok)[0]           # NULL keys never join
         keys = keys[rows_idx]
-        if len(keys) == 0:
-            return self._empty_build_result(ctx, bchunk)
         dag = self.dag
+        semi = self.join_kind in ("semi", "anti")
+        if self.null_aware and not kcol.validity.all():
+            # NOT IN with a NULL build key: NO probe row qualifies.  Keep
+            # the fused program shape (incl. any aggregation over zero
+            # joined rows): the join node becomes a constant-false filter.
+            return self._run(ctx, D.drop_lookup(dag, keep=False), ())
+        if len(keys) == 0:
+            if not semi:
+                return self._empty_build_result(ctx, bchunk)
+            # empty build side: semi matches nothing; anti keeps every
+            # probe row (NOT IN of an empty set is TRUE even for NULL
+            # probe keys, so no null-aware filtering either)
+            return self._run(ctx, D.drop_lookup(
+                dag, keep=(self.join_kind == "anti")), ())
         n_uniq = len(np.unique(keys))
-        if n_uniq != len(keys):
+        if not semi and n_uniq != len(keys):
             # duplicate build keys: switch to the expanding multi-match
             # strategy on device (reference: NDV-driven join shape choice).
             # Initial capacity: per-device probe rows x average duplication,
@@ -241,31 +254,36 @@ class CopJoinTaskExec(PhysOp):
         perm = np.arange(len(keys), dtype=np.int64)[order]
         aux = [(jnp.asarray(sorted_keys), None),
                (jnp.asarray(perm), None)]
-        for c in bchunk.columns:
-            data = c.data[rows_idx]
-            valid = c.validity[rows_idx]
-            aux.append((jnp.asarray(data),
-                        None if valid.all() else jnp.asarray(valid)))
-        snap = self.table.snapshot()
-        if isinstance(dag, D.Aggregation):
-            res = ctx.client.execute_agg(dag, snap, self.key_meta,
-                                         aux_cols=tuple(aux))
-            cols = res.key_columns + res.columns
-        else:
-            cols = ctx.client.execute_rows(dag, snap,
-                                           tuple(self.out_dtypes),
-                                           self.out_dicts,
-                                           aux_cols=tuple(aux))
-        for j, d in self.out_dicts.items():
-            if j < len(cols) and cols[j].dictionary is None:
-                cols[j].dictionary = d
+        if not semi:   # semi/anti never read build columns on device
+            for c in bchunk.columns:
+                data = c.data[rows_idx]
+                valid = c.validity[rows_idx]
+                aux.append((jnp.asarray(data),
+                            None if valid.all() else jnp.asarray(valid)))
+        chunk = self._run(ctx, dag, tuple(aux))
         # build-side output columns keep their own dictionaries
         if not isinstance(self.dag, D.Aggregation):
-            for j, c in enumerate(cols):
+            for j, c in enumerate(chunk.columns):
                 if c.dtype.is_string and c.dictionary is None:
                     bj = j - self.n_probe
                     if 0 <= bj < len(bchunk.columns):
                         c.dictionary = bchunk.columns[bj].dictionary
+        return chunk
+
+    def _run(self, ctx, dag, aux) -> ResultChunk:
+        """Dispatch the fused program and decode with output dicts."""
+        snap = self.table.snapshot()
+        if isinstance(dag, D.Aggregation):
+            res = ctx.client.execute_agg(dag, snap, self.key_meta,
+                                         aux_cols=aux)
+            cols = res.key_columns + res.columns
+        else:
+            cols = ctx.client.execute_rows(dag, snap,
+                                           tuple(self.out_dtypes),
+                                           self.out_dicts, aux_cols=aux)
+        for j, d in self.out_dicts.items():
+            if j < len(cols) and cols[j].dictionary is None:
+                cols[j].dictionary = d
         return ResultChunk(list(self.out_names), cols)
 
     def _build_keys(self, kcol: Column) -> tuple[np.ndarray, np.ndarray]:
@@ -525,7 +543,10 @@ class HostTopN(PhysOp):
 
 @dataclass
 class HostHashJoin(PhysOp):
-    """Host hash join (join/hash_join_v2.go analog, numpy build+probe)."""
+    """Host hash join (join/hash_join_v2.go analog, numpy build+probe).
+    kinds: inner | left | right | cross | semi | anti (anti optionally
+    null-aware for NOT IN semantics, the reference's null-aware anti
+    join in executor/join/)."""
     kind: str
     left: PhysOp = None
     right: PhysOp = None
@@ -533,16 +554,24 @@ class HostHashJoin(PhysOp):
     other_conds: list = field(default_factory=list)
     out_names: list = field(default_factory=list)
     out_dtypes: list = field(default_factory=list)
+    null_aware: bool = False
 
     def __post_init__(self):
         self.children = [self.left, self.right]
 
     def describe(self):
-        return f"HostHashJoin[{self.kind}] keys={len(self.eq_keys)}"
+        na = ",null-aware" if self.null_aware else ""
+        return f"HostHashJoin[{self.kind}{na}] keys={len(self.eq_keys)}"
 
     def execute(self, ctx):
         lc = self.left.execute(ctx)
         rc = self.right.execute(ctx)
+        if self.null_aware and self.eq_keys:
+            # NOT IN: one NULL in the build keys empties the whole result
+            for _, rk in self.eq_keys:
+                if not rc.columns[rk].validity.all():
+                    return ResultChunk(lc.names,
+                                       [c.slice(0, 0) for c in lc.columns])
         if self.eq_keys and min(lc.num_rows, rc.num_rows) > 1:
             remaining = ctx.remaining_quota()
             from ..utils.memory import nbytes_of
@@ -584,7 +613,9 @@ class HostHashJoin(PhysOp):
                     continue
                 if lps[p] is None and self.kind != "right":
                     continue
-                if rps[p] is None and self.kind != "left":
+                # empty right partition: left/anti joins must still emit
+                # the (unmatched) left rows
+                if rps[p] is None and self.kind not in ("left", "anti"):
                     continue
                 lcols = lps[p].read() if lps[p] is not None else \
                     [c.slice(0, 0) for c in lc.columns]
@@ -613,6 +644,17 @@ class HostHashJoin(PhysOp):
                                + [c.take(ri) for c in rc.columns])
             keep = _conds_mask(cand, self.other_conds)
             li, ri = li[keep], ri[keep]
+        if self.kind in ("semi", "anti"):
+            matched = np.zeros(nl, bool)
+            matched[li] = True
+            keep = matched if self.kind == "semi" else ~matched
+            if self.null_aware:
+                # NOT IN: NULL probe keys yield NULL (filtered), and the
+                # build-NULL case was handled up in execute()
+                for lk, _ in self.eq_keys:
+                    keep &= lc.columns[lk].validity
+            idx = np.nonzero(keep)[0]
+            return ResultChunk(lc.names, [c.take(idx) for c in lc.columns])
         # outer null-extension for probe rows with no surviving pair
         if self.kind == "left":
             matched = np.zeros(nl, bool)

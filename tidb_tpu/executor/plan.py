@@ -71,7 +71,8 @@ def to_physical(p: LogicalPlan, no_device_join: bool = False) -> PhysOp:
                             to_physical(p.right, ndj),
                             list(p.eq_keys), list(p.other_conds),
                             out_names=p.schema.names(),
-                            out_dtypes=[c.dtype for c in p.schema.cols])
+                            out_dtypes=[c.dtype for c in p.schema.cols],
+                            null_aware=p.null_aware)
     if isinstance(p, LogicalSort):
         return HostSort(to_physical(p.child, ndj), list(p.keys))
     if isinstance(p, LogicalTopN):
@@ -212,7 +213,8 @@ def _try_cop_join(p: LogicalPlan, top, mids, join: LogicalJoin) -> Optional[Phys
     build keys turn out non-unique."""
     from .physical import CopJoinTaskExec
 
-    if join.kind not in ("inner", "left") or len(join.eq_keys) != 1:
+    if join.kind not in ("inner", "left", "semi", "anti") \
+            or len(join.eq_keys) != 1:
         return None
     li, ri = join.eq_keys[0]
 
@@ -240,15 +242,19 @@ def _try_cop_join(p: LogicalPlan, top, mids, join: LogicalJoin) -> Optional[Phys
 
     probe_key = lower_strings(join.left.schema.ref(li), cur_dicts)
     key_dict = cur_dicts.get(li) if probe_key.dtype.is_string else None
+    semi = join.kind in ("semi", "anti")
     jnode = D.LookupJoin(node, probe_key=probe_key, kind=join.kind,
-                         build_dtypes=tuple(
+                         build_dtypes=() if semi else tuple(
                              c.dtype.with_nullable(True) if join.kind == "left"
-                             else c.dtype for c in bsch.cols))
+                             else c.dtype for c in bsch.cols),
+                         null_aware=join.null_aware)
 
-    # post-join conds/projections + optional top over the concat schema
+    # post-join conds/projections + optional top over the output schema
+    # (probe ++ build; probe only for semi/anti)
     all_dicts = dict(cur_dicts)
-    for j, d in (build_out_dicts or {}).items():
-        all_dicts[n_probe + j] = d
+    if not semi:
+        for j, d in (build_out_dicts or {}).items():
+            all_dicts[n_probe + j] = d
     bound = _bind_post_join(top, mids, join, jnode, all_dicts)
     if bound is None:
         return None  # generic path handles host agg over host join
@@ -258,7 +264,7 @@ def _try_cop_join(p: LogicalPlan, top, mids, join: LogicalJoin) -> Optional[Phys
     exec_ = CopJoinTaskExec(
         nodew, ds.table, build_exec=build_exec, build_key_index=ri,
         build_key_dict=key_dict, probe_key_dtype=probe_key.dtype,
-        join_kind=join.kind, n_probe=n_probe,
+        join_kind=join.kind, null_aware=join.null_aware, n_probe=n_probe,
         out_names=out_names, out_dtypes=out_dtypes, key_meta=key_meta,
         out_dicts=out_dicts, fallback=fallback)
     if host_top is not None and host_top[0] == "topn":
@@ -282,11 +288,11 @@ def _bind_post_join(top, mids, join: LogicalJoin, start: D.CopNode,
     out_dicts = dict(all_dicts)
     nodew: D.CopNode = start
     if join.other_conds:
-        if join.kind == "left":
-            # residual ON conditions on an outer join are match conditions,
-            # not filters: a failed condition must null-extend, not drop the
-            # probe row.  The host join implements this; a fused device
-            # Selection would wrongly filter (review finding).
+        if join.kind != "inner":
+            # residual conditions on outer/semi/anti joins are per-pair
+            # MATCH conditions, not filters: the host join evaluates them
+            # per candidate pair; a fused device Selection would wrongly
+            # drop (left) or mis-classify (semi/anti) probe rows.
             return None
         conds = tuple(lower_strings(c, all_dicts) for c in join.other_conds)
         if not all(_device_supported(c) for c in conds):
@@ -392,8 +398,10 @@ def _try_shuffle_join(p: LogicalPlan, top, mids,
     from ..expr import builders as B
     from .physical import CopShuffleJoinExec
 
-    if join.kind not in ("inner", "left"):
+    if join.kind not in ("inner", "left", "semi", "anti"):
         return None
+    if join.null_aware:
+        return None   # NOT IN needs the host-side build-NULL check
     li, ri = join.eq_keys[0]
     lchain = _bind_scan_chain(join.left)
     rchain = _bind_scan_chain(join.right)
@@ -429,8 +437,9 @@ def _try_shuffle_join(p: LogicalPlan, top, mids,
     n_l = len(join.left.schema)
     joined_dtypes = tuple(c.dtype for c in join.schema.cols)
     all_dicts = dict(ldicts)
-    for j, d in rdicts.items():
-        all_dicts[n_l + j] = d
+    if join.kind not in ("semi", "anti"):
+        for j, d in rdicts.items():
+            all_dicts[n_l + j] = d
 
     leaf: D.CopNode = D.TableScan(tuple(range(len(joined_dtypes))),
                                   joined_dtypes)

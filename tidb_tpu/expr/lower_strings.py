@@ -282,8 +282,11 @@ def fold_string_func(e: Expr) -> Optional[Const]:
             s = str(vals[0])
             r = ord(s[0]) if s else 0
         elif e.op == "locate":
-            start = max(int(vals[2]) - 1, 0) if len(vals) > 2 else 0
-            r = str(vals[1]).find(str(vals[0]), start) + 1
+            pos = int(vals[2]) if len(vals) > 2 else 1
+            if pos < 1:             # MySQL: LOCATE(.., pos < 1) is 0
+                r = 0
+            else:
+                r = str(vals[1]).find(str(vals[0]), pos - 1) + 1
         else:  # instr
             r = str(vals[0]).find(str(vals[1])) + 1
         return Const(e.dtype, int(r))
@@ -444,7 +447,9 @@ def _lower_str_int(e: Func, args, dicts) -> Optional[Expr]:
         needle = _const_scalar(sub)
         if d is None or needle is None or not isinstance(pos, int):
             return None
-        start = max(int(pos) - 1, 0)
+        if pos < 1:                 # MySQL: LOCATE(.., pos < 1) is 0
+            return Const(e.dtype, 0)
+        start = int(pos) - 1
         lut = [v.find(str(needle), start) + 1 for v in d.values]
         return B.dict_ilut(col, np.asarray(lut if lut else [0], np.int64),
                            e.dtype)

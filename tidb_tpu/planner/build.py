@@ -41,6 +41,28 @@ class PlanError(ValueError):
     pass
 
 
+# Session-installed hook that plans+executes an uncorrelated subquery AST
+# and returns its scalar result as a Const (None when absent — e.g. pure
+# parser/planner tests).  ContextVar so concurrent server sessions don't
+# stomp each other.
+import contextvars
+
+SUBQUERY_EXECUTOR: contextvars.ContextVar = contextvars.ContextVar(
+    "subquery_executor", default=None)
+
+# list the session installs per statement; builders append a reason when
+# the plan embeds statement-time state (NOW(), scalar subquery results)
+# so the plan cache skips it
+PLAN_TAINTS: contextvars.ContextVar = contextvars.ContextVar(
+    "plan_taints", default=None)
+
+
+def _taint_plan(reason: str) -> None:
+    t = PLAN_TAINTS.get()
+    if t is not None:
+        t.append(reason)
+
+
 # --------------------------------------------------------------------- #
 # expression building over a schema
 # --------------------------------------------------------------------- #
@@ -307,7 +329,9 @@ class ExprBuilder:
             return B.cast(args[0], dt.date())
         if name in ("NOW", "CURRENT_TIMESTAMP", "SYSDATE", "CURDATE",
                     "CURRENT_DATE"):
-            # statement-start clock (MySQL: constant within a statement)
+            # statement-start clock (MySQL: constant within a statement);
+            # taints the plan so the cache never replays a stale clock
+            _taint_plan("now")
             import time as _time
             now = _time.time()
             micros = int(now * 1_000_000)
@@ -356,10 +380,17 @@ class ExprBuilder:
         raise PlanError("* only valid as a top-level select item")
 
     def _b_subqueryexpr(self, n: A.SubqueryExpr) -> Expr:
-        raise PlanError("scalar subquery not supported yet")
+        """Uncorrelated scalar subquery: evaluated once at plan time via
+        the session-installed executor (the reference evaluates these
+        during optimization: EvalSubqueryFirstRow, expression_rewriter.go)."""
+        fn = SUBQUERY_EXECUTOR.get()
+        if fn is None:
+            raise PlanError("scalar subquery not supported in this context")
+        return fn(n.select)
 
     def _b_existsexpr(self, n: A.ExistsExpr) -> Expr:
-        raise PlanError("EXISTS not supported yet")
+        raise PlanError("EXISTS is only supported as a WHERE-clause "
+                        "predicate")
 
 
 def _fold_interval_const(base: Const, amount: int, unit: str) -> Const:
@@ -454,8 +485,19 @@ def build_select(sel: A.SelectStmt, catalog, default_db: str,
     child = _build_from(sel.from_, catalog, default_db, ctes)
 
     if sel.where is not None:
-        cond = ExprBuilder(child.schema).build(sel.where)
-        child = LogicalSelection(child, _split_cnf(cond))
+        # WHERE-clause subquery predicates (IN/EXISTS) become semi/anti
+        # joins (rule_decorrelate.go analog); the rest build normally
+        plain: list[A.Node] = []
+        for cj in _split_where_ast(sel.where):
+            joined = _try_subquery_conjunct(cj, child, catalog, default_db,
+                                            ctes)
+            if joined is not None:
+                child = joined
+            else:
+                plain.append(cj)
+        if plain:
+            cond = ExprBuilder(child.schema).build(_and_ast(plain))
+            child = LogicalSelection(child, _split_cnf(cond))
 
     # expand stars
     items: list[A.SelectItem] = []
@@ -535,6 +577,129 @@ def _split_cnf(e: Expr) -> list[Expr]:
     if isinstance(e, Func) and e.op == "and":
         return _split_cnf(e.args[0]) + _split_cnf(e.args[1])
     return [e]
+
+
+# --------------------------------------------------------------------- #
+# WHERE-clause subqueries -> semi/anti joins (decorrelation)
+# --------------------------------------------------------------------- #
+
+def _split_where_ast(n: A.Node) -> list[A.Node]:
+    if isinstance(n, A.Binary) and n.op == "AND":
+        return _split_where_ast(n.left) + _split_where_ast(n.right)
+    return [n]
+
+
+def _and_ast(conjs: list[A.Node]) -> A.Node:
+    out = conjs[0]
+    for c in conjs[1:]:
+        out = A.Binary("AND", out, c)
+    return out
+
+
+def _try_subquery_conjunct(c: A.Node, child: LogicalPlan, catalog,
+                           default_db: str, ctes) -> Optional[LogicalPlan]:
+    """If conjunct `c` is an IN-subquery / [NOT] EXISTS predicate, return
+    `child` wrapped in the corresponding semi/anti join; else None."""
+    if isinstance(c, A.InExpr) and len(c.items) == 1 \
+            and isinstance(c.items[0], A.SubqueryExpr):
+        return _build_in_subquery(c, child, catalog, default_db, ctes)
+    if isinstance(c, A.ExistsExpr):
+        return _build_exists(c.select, child, catalog, default_db, ctes,
+                             negated=False)
+    if isinstance(c, A.Unary) and c.op == "NOT" \
+            and isinstance(c.arg, A.ExistsExpr):
+        return _build_exists(c.arg.select, child, catalog, default_db, ctes,
+                             negated=True)
+    return None
+
+
+def _build_in_subquery(c: A.InExpr, child: LogicalPlan, catalog,
+                       default_db: str, ctes) -> LogicalPlan:
+    """x [NOT] IN (SELECT y ...) -> semi / null-aware anti join
+    (the reference's null-aware anti join, executor/join/)."""
+    sub = build_query(c.items[0].select, catalog, default_db, ctes)
+    if len(sub.plan.schema) != 1:
+        raise PlanError("IN subquery must return exactly one column")
+    target = ExprBuilder(child.schema).build(c.target)
+    left = child
+    li = None
+    post_restore = False
+    if isinstance(target, ColumnRef):
+        li = target.index
+    else:
+        # computed target: append it as a hidden join-key column
+        refs = [child.schema.ref(i) for i in range(len(child.schema))]
+        cols = list(child.schema.cols) + [SchemaCol("__in_key__", target.dtype)]
+        left = LogicalProjection(child, refs + [target], Schema(cols))
+        li = len(child.schema)
+        post_restore = True
+    join = LogicalJoin("anti" if c.negated else "semi", left, sub.plan,
+                       eq_keys=[(li, 0)], other_conds=[],
+                       schema=Schema(list(left.schema.cols)),
+                       null_aware=c.negated)
+    if post_restore:
+        refs = [join.schema.ref(i) for i in range(len(child.schema))]
+        return LogicalProjection(join, refs, Schema(list(child.schema.cols)))
+    return join
+
+
+def _build_exists(sub: A.SelectStmt, outer: LogicalPlan, catalog,
+                  default_db: str, ctes, negated: bool) -> LogicalPlan:
+    """[NOT] EXISTS (SELECT ...) -> semi/anti join, decorrelating
+    outer-column references in the subquery WHERE into join keys /
+    residual conditions (rule_decorrelate.go analog)."""
+    kind = "anti" if negated else "semi"
+    out_schema = Schema(list(outer.schema.cols))
+    # uncorrelated fast path: the whole subquery builds standalone
+    try:
+        bs = build_query(sub, catalog, default_db, ctes)
+        return LogicalJoin(kind, outer, bs.plan, eq_keys=[], other_conds=[],
+                           schema=out_schema)
+    except PlanError:
+        pass
+    if getattr(sub, "from_", None) is None:
+        raise PlanError("EXISTS subquery needs a FROM clause")
+    if sub.group_by or sub.having is not None or sel_has_limit(sub):
+        raise PlanError("correlated EXISTS with GROUP BY/HAVING/LIMIT "
+                        "not supported")
+    inner = _build_from(sub.from_, catalog, default_db, dict(ctes or {}))
+    n_outer = len(outer.schema)
+    combined = Schema(list(outer.schema.cols) + list(inner.schema.cols))
+    eq_keys: list[tuple[int, int]] = []
+    others: list[Expr] = []
+    inner_conds: list[Expr] = []
+    for cj in (_split_where_ast(sub.where) if sub.where is not None else []):
+        try:
+            inner_conds += _split_cnf(ExprBuilder(inner.schema).build(cj))
+            continue
+        except PlanError:
+            pass
+        e = ExprBuilder(combined).build(cj)   # correlated: may still raise
+        k = _eq_key_of(e, n_outer)
+        if k is not None:
+            eq_keys.append(k)
+        else:
+            others.append(e)
+    if inner_conds:
+        inner = LogicalSelection(inner, inner_conds)
+    return LogicalJoin(kind, outer, inner, eq_keys=eq_keys,
+                       other_conds=others, schema=out_schema)
+
+
+def sel_has_limit(sub) -> bool:
+    return getattr(sub, "limit", None) is not None
+
+
+def _eq_key_of(e: Expr, n_left: int):
+    if (isinstance(e, Func) and e.op == "eq"
+            and isinstance(e.args[0], ColumnRef)
+            and isinstance(e.args[1], ColumnRef)):
+        a, b = e.args[0].index, e.args[1].index
+        if a < n_left <= b:
+            return (a, b - n_left)
+        if b < n_left <= a:
+            return (b, a - n_left)
+    return None
 
 
 def _walk_ast(n: A.Node, prune=None):

@@ -108,7 +108,7 @@ class LogicalAggregate(LogicalPlan):
 
 @dataclass
 class LogicalJoin(LogicalPlan):
-    kind: str                      # 'inner' | 'left' | 'right' | 'cross'
+    kind: str          # 'inner' | 'left' | 'right' | 'cross' | 'semi' | 'anti'
     left: LogicalPlan = None
     right: LogicalPlan = None
     # equi-join keys as (left_index, right_index) into child schemas
@@ -116,6 +116,9 @@ class LogicalJoin(LogicalPlan):
     # residual conditions over the concatenated schema
     other_conds: list[Expr] = field(default_factory=list)
     schema: Schema = None
+    # NOT IN semantics (null-aware anti join, rule_decorrelate.go analog):
+    # any NULL build key empties the result; NULL probe keys never pass
+    null_aware: bool = False
 
     def __post_init__(self):
         self.children = [self.left, self.right]

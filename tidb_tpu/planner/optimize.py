@@ -160,6 +160,29 @@ def push_predicates(p: LogicalPlan, pending: list[Expr] | None = None) -> Logica
 
     if isinstance(p, LogicalJoin):
         n_left = len(p.left.schema)
+        if p.kind in ("semi", "anti"):
+            # join schema == left schema: pending conds push into the left
+            # child.  Right-only residuals sink into the right child (they
+            # only restrict the match set — safe for both semi and anti);
+            # left-referencing residuals must stay as match conditions
+            # (pushing them would wrongly drop/keep anti rows).
+            own_keys, own_res, right_conds = [], [], []
+            for c in p.other_conds:
+                k = _as_eq_key(c, n_left)
+                if k is not None:
+                    own_keys.append(k)
+                    continue
+                refs = referenced_columns(c)
+                if refs and min(refs) >= n_left:
+                    right_conds.append(_remap(c, -n_left))
+                else:
+                    own_res.append(c)
+            p.eq_keys = p.eq_keys + own_keys
+            p.other_conds = own_res
+            p.left = push_predicates(p.left, pending)
+            p.right = push_predicates(p.right, right_conds)
+            p.children = [p.left, p.right]
+            return p
         if p.kind in ("inner", "cross"):
             left_conds, right_conds, eq_keys, residue = [], [], [], []
             for c in pending + p.other_conds:
@@ -260,7 +283,9 @@ def prune_columns(p: LogicalPlan, needed: set[int] | None = None) -> LogicalPlan
         return p, mapping
 
     if isinstance(p, LogicalProjection):
-        keep = sorted(needed)
+        # keep at least one expr: a zero-column chunk loses its row count
+        # (EXISTS subqueries project constants nobody references)
+        keep = sorted(needed) or [0]
         p.exprs = [p.exprs[i] for i in keep]
         p.schema = Schema([p.schema.cols[i] for i in keep])
         child_needed = set()
@@ -316,7 +341,11 @@ def prune_columns(p: LogicalPlan, needed: set[int] | None = None) -> LogicalPlan
                 full[old] = rmap[old - n_left] + new_n_left
         p.eq_keys = [(lmap[l], rmap[r]) for l, r in p.eq_keys]
         p.other_conds = [map_refs(c, full) for c in p.other_conds]
-        p.schema = Schema(list(p.left.schema.cols) + list(p.right.schema.cols))
+        if p.kind in ("semi", "anti"):
+            p.schema = Schema(list(p.left.schema.cols))
+        else:
+            p.schema = Schema(list(p.left.schema.cols)
+                              + list(p.right.schema.cols))
         return p, {old: full[old] for old in needed}
 
     if isinstance(p, (LogicalSort, LogicalTopN)):

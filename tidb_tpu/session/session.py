@@ -457,11 +457,24 @@ class Session:
             e = cache.get(cache_sql, self.db, merged, self.domain.catalog)
             if e is not None:
                 return e.built, e.phys
-        built = build_query(stmt, self.domain.catalog, self.db)
+        # uncorrelated scalar subqueries evaluate eagerly at plan time
+        # (EvalSubqueryFirstRow analog); plans that did so are not cached
+        # since the folded constant goes stale with the data
+        from ..planner import build as _build_mod
+        ran_subquery: list = []
+        token = _build_mod.SUBQUERY_EXECUTOR.set(
+            lambda ast: self._eval_scalar_subquery(ast, ran_subquery))
+        token2 = _build_mod.PLAN_TAINTS.set(ran_subquery)
+        try:
+            built = build_query(stmt, self.domain.catalog, self.db)
+        finally:
+            _build_mod.SUBQUERY_EXECUTOR.reset(token)
+            _build_mod.PLAN_TAINTS.reset(token2)
         self._maybe_auto_analyze(built.plan)
         plan = optimize_plan(built.plan)
         plan = apply_index_paths(plan, self.domain.stats)
         phys = to_physical(plan)
+        use_cache = use_cache and not ran_subquery
         if use_cache and _plan_cacheable(phys):
             keys = {}
             for db, name in self._referenced_tables(stmt):
@@ -474,6 +487,35 @@ class Session:
             cache.put(cache_sql, self.db, merged,
                       PlanCacheEntry(built, phys, keys))
         return built, phys
+
+    def _eval_scalar_subquery(self, sub_ast, ran: list):
+        """Plan + execute an uncorrelated scalar subquery and fold its
+        result to a Const (reference: EvalSubqueryFirstRow,
+        planner/core/expression_rewriter.go)."""
+        from ..expr import builders as B
+        from ..expr.ir import Const
+        from ..planner.ranger import apply_index_paths
+        ran.append(True)
+        built = build_query(sub_ast, self.domain.catalog, self.db)
+        if len(built.plan.schema) != 1:
+            raise PlanError("scalar subquery must return one column")
+        plan = optimize_plan(built.plan)
+        plan = apply_index_paths(plan, self.domain.stats)
+        chunk = to_physical(plan).execute(self._exec_ctx())
+        if chunk.num_rows > 1:
+            raise PlanError("scalar subquery returned more than one row")
+        if chunk.num_rows == 0:
+            return B.lit(None)
+        col = chunk.columns[0]
+        if not col.validity[0]:
+            return B.lit(None)
+        if col.dtype.is_string:
+            # decode to a plain string literal so downstream lowering maps
+            # it into the OUTER table's dictionary space
+            return Const(col.dtype.with_nullable(False), col.to_python()[0])
+        v = col.data[0]
+        v = v.item() if hasattr(v, "item") else v
+        return Const(col.dtype.with_nullable(False), v)
 
     def _maybe_auto_analyze(self, plan):
         """Refresh stale stats before planning (handle/autoanalyze.go
