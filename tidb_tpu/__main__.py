@@ -21,15 +21,19 @@ import argparse
 import sys
 
 
-def _domain():
+def _domain(args=None):
     from .session.session import Domain
+    data_dir = getattr(args, "data_dir", None)
+    if data_dir:
+        return Domain(data_dir=data_dir,
+                      sync=bool(getattr(args, "sync_wal", False)))
     return Domain()
 
 
 def cmd_serve(args) -> int:
     import time
     from .server import MySQLServer, StatusServer
-    dom = _domain()
+    dom = _domain(args)
     dom.start_background()
     srv = MySQLServer(dom, host=args.host, port=args.port)
     port = srv.start()
@@ -120,6 +124,11 @@ def main(argv=None) -> int:
     s.add_argument("--host", default="127.0.0.1")
     s.add_argument("--port", type=int, default=4000)
     s.add_argument("--status-port", type=int, default=10080)
+    s.add_argument("--data-dir", default=None,
+                   help="durable storage dir (WAL + catalog-on-KV); "
+                        "omit for in-memory")
+    s.add_argument("--sync-wal", action="store_true",
+                   help="fdatasync every commit record")
     s.set_defaults(fn=cmd_serve)
 
     d = sub.add_parser("dump", help="logical export from a running "

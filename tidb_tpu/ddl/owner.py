@@ -125,6 +125,11 @@ class DDLExecutor:
         job.schema_state = state
         self.domain.schema_version += 1
         self.storage.save(job)
+        try:
+            tbl = self.domain.catalog.get_table(job.db, job.table)
+            tbl._persist_meta()   # catalog-on-KV: index states survive
+        except Exception:
+            pass                  # table dropped mid-job
 
     def _run_one(self, job: DDLJob):
         tbl = self.domain.catalog.get_table(job.db, job.table)

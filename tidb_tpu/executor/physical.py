@@ -566,12 +566,20 @@ class HostHashJoin(PhysOp):
     def execute(self, ctx):
         lc = self.left.execute(ctx)
         rc = self.right.execute(ctx)
-        if self.null_aware and self.eq_keys:
-            # NOT IN: one NULL in the build keys empties the whole result
+        if self.null_aware and self.eq_keys and rc.num_rows:
+            # NOT IN (non-empty set): one NULL build key empties the whole
+            # result; NULL probe keys never pass.  (An EMPTY build set is
+            # TRUE for every probe row, NULLs included — skip both.)
             for _, rk in self.eq_keys:
                 if not rc.columns[rk].validity.all():
                     return ResultChunk(lc.names,
                                        [c.slice(0, 0) for c in lc.columns])
+            keep = np.ones(lc.num_rows, bool)
+            for lk, _ in self.eq_keys:
+                keep &= lc.columns[lk].validity
+            if not keep.all():
+                idx = np.nonzero(keep)[0]
+                lc = ResultChunk(lc.names, [c.take(idx) for c in lc.columns])
         if self.eq_keys and min(lc.num_rows, rc.num_rows) > 1:
             remaining = ctx.remaining_quota()
             from ..utils.memory import nbytes_of
@@ -648,11 +656,7 @@ class HostHashJoin(PhysOp):
             matched = np.zeros(nl, bool)
             matched[li] = True
             keep = matched if self.kind == "semi" else ~matched
-            if self.null_aware:
-                # NOT IN: NULL probe keys yield NULL (filtered), and the
-                # build-NULL case was handled up in execute()
-                for lk, _ in self.eq_keys:
-                    keep &= lc.columns[lk].validity
+            # (null-aware probe/build filtering happened in execute())
             idx = np.nonzero(keep)[0]
             return ResultChunk(lc.names, [c.take(idx) for c in lc.columns])
         # outer null-extension for probe rows with no surviving pair

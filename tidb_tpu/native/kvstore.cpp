@@ -19,8 +19,21 @@
 // (tidb_tpu/store/kv.py).  Scan results are returned through a per-call
 // arena so no allocation contracts cross the FFI.
 
+// Durability (reference: unistore's badger-backed MVCC persists all CFs,
+// mvcc.go:50): committed writes stream to a write-ahead log; kv_checkpoint
+// compacts the whole committed state into a snapshot file and truncates
+// the WAL.  In-flight (locked, uncommitted) state is intentionally NOT
+// logged — the client lives in the same process, so a crash aborts its
+// open transactions exactly like percolator lock cleanup would.
+//
+// File layout at <path>: "<path>.snap" (replayable compacted stream) +
+// "<path>.wal" (appended commit records).  Record:
+//   [u8 op][u64 start_ts][u64 commit_ts][u32 klen][u32 vlen][key][value]
+// A torn tail record (crash mid-append) is detected and ignored.
+
 #include <algorithm>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <map>
 #include <memory>
@@ -28,6 +41,11 @@
 #include <shared_mutex>
 #include <string>
 #include <vector>
+
+#ifdef _WIN32
+#else
+#include <unistd.h>
+#endif
 
 namespace {
 
@@ -68,7 +86,68 @@ struct Store {
   std::map<std::string, VersionChain> keys;
   mutable std::shared_mutex mu;
   uint64_t ts_counter = 1;  // simple TSO for embedded use (PD analog)
+  // durability (empty path = in-memory only)
+  std::string path;
+  FILE* wal = nullptr;
+  bool sync = false;
 };
+
+void apply_committed(Store* s, const std::string& key, uint64_t start_ts,
+                     uint64_t commit_ts, Op op, const std::string& value) {
+  auto& vc = s->keys[key];
+  // replay must be idempotent and order-independent: a crash between the
+  // checkpoint rename and the WAL truncation leaves records present in
+  // BOTH files, so dedupe by (commit_ts, start_ts) and insert at the
+  // sorted (newest-first) position rather than blindly at the front
+  auto pos = vc.writes.begin();
+  for (; pos != vc.writes.end(); ++pos) {
+    if (pos->commit_ts == commit_ts && pos->start_ts == start_ts) return;
+    if (pos->commit_ts < commit_ts) break;
+  }
+  if (op == OP_PUT) vc.data[start_ts] = value;
+  vc.writes.insert(pos, WriteRec{commit_ts, start_ts, op});
+  if (commit_ts > s->ts_counter) s->ts_counter = commit_ts;
+  if (start_ts > s->ts_counter) s->ts_counter = start_ts;
+}
+
+void log_commit(Store* s, const std::string& key, uint64_t start_ts,
+                uint64_t commit_ts, Op op, const std::string& value) {
+  if (s->wal == nullptr) return;
+  uint8_t o = static_cast<uint8_t>(op);
+  uint32_t kl = key.size(), vl = (op == OP_PUT) ? value.size() : 0;
+  std::fwrite(&o, 1, 1, s->wal);
+  std::fwrite(&start_ts, 8, 1, s->wal);
+  std::fwrite(&commit_ts, 8, 1, s->wal);
+  std::fwrite(&kl, 4, 1, s->wal);
+  std::fwrite(&vl, 4, 1, s->wal);
+  std::fwrite(key.data(), 1, kl, s->wal);
+  if (vl) std::fwrite(value.data(), 1, vl, s->wal);
+  std::fflush(s->wal);
+#ifndef _WIN32
+  if (s->sync) fdatasync(fileno(s->wal));
+#endif
+}
+
+// Replay one record stream; stops cleanly at a torn tail.
+void replay_file(Store* s, const std::string& fname) {
+  FILE* f = std::fopen(fname.c_str(), "rb");
+  if (f == nullptr) return;
+  for (;;) {
+    uint8_t o;
+    uint64_t sts, cts;
+    uint32_t kl, vl;
+    if (std::fread(&o, 1, 1, f) != 1) break;
+    if (std::fread(&sts, 8, 1, f) != 1) break;
+    if (std::fread(&cts, 8, 1, f) != 1) break;
+    if (std::fread(&kl, 4, 1, f) != 1) break;
+    if (std::fread(&vl, 4, 1, f) != 1) break;
+    std::string key(kl, '\0'), val(vl, '\0');
+    if (kl && std::fread(key.data(), 1, kl, f) != kl) break;
+    if (vl && std::fread(val.data(), 1, vl, f) != vl) break;
+    apply_committed(s, key, sts, cts, static_cast<Op>(o), val);
+  }
+  std::fclose(f);
+}
 
 struct Arena {
   std::vector<std::string> bufs;
@@ -104,7 +183,74 @@ extern "C" {
 
 void* kv_open() { return new Store(); }
 
-void kv_close(void* h) { delete static_cast<Store*>(h); }
+// Durable open: replay <path>.snap + <path>.wal, then append to the WAL.
+// sync != 0 fdatasyncs every commit record (fflush-only otherwise).
+void* kv_open_at(const char* path, int32_t plen, uint8_t sync) {
+  auto* s = new Store();
+  s->path.assign(path, plen);
+  s->sync = sync != 0;
+  replay_file(s, s->path + ".snap");
+  replay_file(s, s->path + ".wal");
+  s->ts_counter += 1;  // strictly above anything persisted
+  s->wal = std::fopen((s->path + ".wal").c_str(), "ab");
+  if (s->wal == nullptr) {  // unwritable dir/disk: fail loudly, never
+    delete s;               // silently degrade to non-durable
+    return nullptr;
+  }
+  return s;
+}
+
+// Compact the committed state into <path>.snap and truncate the WAL.
+// Returns number of records written, or -1 when the store is in-memory.
+int64_t kv_checkpoint(void* h) {
+  auto* s = static_cast<Store*>(h);
+  std::unique_lock lk(s->mu);
+  if (s->path.empty()) return -1;
+  std::string tmp = s->path + ".snap.tmp";
+  FILE* f = std::fopen(tmp.c_str(), "wb");
+  if (f == nullptr) return -1;
+  int64_t n = 0;
+  for (const auto& [key, vc] : s->keys) {
+    // oldest-first so replay's front-insert rebuilds newest-first
+    for (auto it = vc.writes.rbegin(); it != vc.writes.rend(); ++it) {
+      if (it->op == OP_ROLLBACK) continue;
+      uint8_t o = static_cast<uint8_t>(it->op);
+      std::string val;
+      if (it->op == OP_PUT) {
+        auto dit = vc.data.find(it->start_ts);
+        if (dit == vc.data.end()) continue;
+        val = dit->second;
+      }
+      uint32_t kl = key.size(), vl = val.size();
+      std::fwrite(&o, 1, 1, f);
+      std::fwrite(&it->start_ts, 8, 1, f);
+      std::fwrite(&it->commit_ts, 8, 1, f);
+      std::fwrite(&kl, 4, 1, f);
+      std::fwrite(&vl, 4, 1, f);
+      std::fwrite(key.data(), 1, kl, f);
+      if (vl) std::fwrite(val.data(), 1, vl, f);
+      ++n;
+    }
+  }
+  std::fflush(f);
+#ifndef _WIN32
+  fdatasync(fileno(f));
+#endif
+  std::fclose(f);
+  std::rename(tmp.c_str(), (s->path + ".snap").c_str());
+  if (s->wal != nullptr) {
+    std::fclose(s->wal);
+    s->wal = std::fopen((s->path + ".wal").c_str(), "wb");  // truncate
+    if (s->wal == nullptr) return -2;  // caller must treat as fatal
+  }
+  return n;
+}
+
+void kv_close(void* h) {
+  auto* s = static_cast<Store*>(h);
+  if (s->wal != nullptr) std::fclose(s->wal);
+  delete s;
+}
 
 uint64_t kv_alloc_ts(void* h) {
   auto* s = static_cast<Store*>(h);
@@ -165,7 +311,14 @@ int32_t kv_commit(void* h, const char* key, int32_t klen, uint64_t start_ts,
   }
   vc.writes.insert(vc.writes.begin(),
                    WriteRec{commit_ts, start_ts, vc.lock.op});
+  Op op = vc.lock.op;
   vc.lock = Lock{};
+  if (s->wal != nullptr) {
+    static const std::string kEmpty;
+    const auto dit = vc.data.find(start_ts);
+    log_commit(s, it->first, start_ts, commit_ts, op,
+               op == OP_PUT && dit != vc.data.end() ? dit->second : kEmpty);
+  }
   return OK;
 }
 

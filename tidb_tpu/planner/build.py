@@ -650,10 +650,13 @@ def _build_exists(sub: A.SelectStmt, outer: LogicalPlan, catalog,
     residual conditions (rule_decorrelate.go analog)."""
     kind = "anti" if negated else "semi"
     out_schema = Schema(list(outer.schema.cols))
-    # uncorrelated fast path: the whole subquery builds standalone
+    # uncorrelated fast path: the whole subquery builds standalone; only
+    # its non-emptiness matters, so LIMIT 1 bounds the cross semi/anti
+    # join to a single build row
     try:
         bs = build_query(sub, catalog, default_db, ctes)
-        return LogicalJoin(kind, outer, bs.plan, eq_keys=[], other_conds=[],
+        limited = LogicalLimit(bs.plan, 1)
+        return LogicalJoin(kind, outer, limited, eq_keys=[], other_conds=[],
                            schema=out_schema)
     except PlanError:
         pass

@@ -111,6 +111,12 @@ class TableInfo:
     schema_gate: Any = None
 
     _alloc_mu: Any = None
+    # catalog-on-KV write-through (session/meta.py): called after every
+    # schema mutation so the persisted TableInfo stays current
+    _meta_hook: Any = None
+    # set when loaded from persisted metadata: handle/auto-inc counters
+    # recover from the data on first write (MySQL max+1 restart semantics)
+    _needs_counter_recovery: bool = False
 
     def __post_init__(self):
         import threading
@@ -197,7 +203,12 @@ class TableInfo:
             txn.rollback()
             raise
         self.indexes.append(ix)
+        self._persist_meta()
         return ix
+
+    def _persist_meta(self):
+        if self._meta_hook is not None:
+            self._meta_hook()
 
     def drop_index(self, name: str, if_exists: bool = False):
         ix = self.index_by_name(name)
@@ -213,6 +224,7 @@ class TableInfo:
             txn.delete(k)
         txn.commit()
         self.indexes.remove(ix)
+        self._persist_meta()
 
     # ---------------- write path ---------------- #
 
@@ -225,6 +237,7 @@ class TableInfo:
         fixed = []
         ai_idx = (self.col_names.index(self.auto_inc_col)
                   if self.auto_inc_col else -1)
+        self._recover_counters()
         with self._alloc_mu:
             # handle/auto-inc allocation is a critical section: concurrent
             # inserters hold the schema gate's READ side together, so the
@@ -353,6 +366,28 @@ class TableInfo:
         self._pending = []
         self._invalidate()
         return n
+
+    def _recover_counters(self):
+        """After a restart, resume handle/auto-inc allocation above the
+        persisted data (AUTO_INCREMENT = max+1, autoid allocator analog)."""
+        if not self._needs_counter_recovery:
+            return
+        with self._alloc_mu:
+            if not self._needs_counter_recovery:
+                return
+            self._needs_counter_recovery = False
+            if self.kv is None:
+                return
+            snap = self.snapshot()
+            handles = self._snapshot_handles
+            if handles is not None and len(handles):
+                self._next_handle = max(self._next_handle,
+                                        int(np.max(handles)))
+            if self.auto_inc_col is not None and snap.num_rows:
+                c = snap.columns[self.col_names.index(self.auto_inc_col)]
+                live = c.data[c.validity]
+                if len(live):
+                    self._auto_inc = max(self._auto_inc, int(np.max(live)))
 
     def register_columns(self, cols: list[Column]):
         """Bulk load pre-built columns (benchmarks; TiFlash bulk ingest

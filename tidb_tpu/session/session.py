@@ -46,13 +46,33 @@ class Domain:
     """Per-process singleton state (pkg/domain analog): catalog + mesh +
     cop client + sysvars."""
 
-    def __init__(self, mesh=None):
+    def __init__(self, mesh=None, data_dir: Optional[str] = None,
+                 sync: bool = False):
         from ..stats.handle import StatsHandle
         from ..store.kv import KVStore
         self.catalog = Catalog()
         self.mesh = mesh if mesh is not None else get_mesh()
         self.client = CopClient(self.mesh)
-        self.kv = KVStore()          # native C++ MVCC row store
+        if data_dir is not None:
+            # durable mode: WAL-backed native engine + catalog-on-KV, so
+            # data, schema, and DDL-job state all survive restart
+            import os as _os
+            _os.makedirs(data_dir, exist_ok=True)
+            self.kv = KVStore(path=_os.path.join(data_dir, "kv"), sync=sync)
+            from .meta import attach
+            self.meta = attach(self.catalog, self.kv)
+            self.meta.load_catalog(self.catalog)
+            # resume table-id allocation above every persisted table so a
+            # new table never reuses a live (or dropped) key range
+            max_id = 100
+            for tables in self.catalog.databases.values():
+                for t in tables.values():
+                    max_id = max(max_id, t.table_id)
+            max_id = max(max_id, self.meta.load_max_dropped_id())
+            self._next_table_id = max_id
+        else:
+            self.kv = KVStore()      # native C++ MVCC row store (in-memory)
+            self.meta = None
         self.stats = StatsHandle()   # pkg/statistics/handle analog
         from ..privilege import PrivilegeManager
         self.privileges = PrivilegeManager()   # pkg/privilege Handle analog
@@ -64,7 +84,8 @@ class Domain:
         self._ddl_mu = threading.Lock()
         from ..utils.stmtsummary import StmtSummary
         self.stmt_summary = StmtSummary()   # util/stmtsummary analog
-        self._next_table_id = 100
+        if not hasattr(self, "_next_table_id"):   # durable mode recovered it
+            self._next_table_id = 100
         self.sysvars: dict[str, Any] = {
             "tidb_distsql_scan_concurrency": 15,
             "tidb_max_chunk_size": 1024,
@@ -696,6 +717,7 @@ class Session:
                 self._alter_drop_column(tbl, act[1])
             else:
                 raise PlanError(f"unsupported ALTER action {act[0]}")
+        tbl._persist_meta()   # catalog-on-KV: column changes survive
         return ResultSet()
 
     def _alter_add_column(self, tbl, cd) -> None:

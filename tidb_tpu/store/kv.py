@@ -81,16 +81,44 @@ def _load_lib():
         lib.kv_gc.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib.kv_num_keys.restype = ctypes.c_int64
         lib.kv_num_keys.argtypes = [ctypes.c_void_p]
+        lib.kv_open_at.restype = ctypes.c_void_p
+        lib.kv_open_at.argtypes = [ctypes.c_char_p, ctypes.c_int32,
+                                   ctypes.c_uint8]
+        lib.kv_checkpoint.restype = ctypes.c_int64
+        lib.kv_checkpoint.argtypes = [ctypes.c_void_p]
         _lib = lib
     return _lib
 
 
 class KVStore:
-    """kv.Storage analog over the native engine (embedded TSO)."""
+    """kv.Storage analog over the native engine (embedded TSO).
 
-    def __init__(self):
+    `path` (a file prefix, e.g. "<dir>/kv") makes the store durable:
+    committed writes append to <path>.wal; checkpoint() compacts the state
+    into <path>.snap and truncates the log; reopening the same path
+    replays both.  `sync` fdatasyncs every commit record."""
+
+    def __init__(self, path: Optional[str] = None, sync: bool = False):
         self._lib = _load_lib()
-        self._h = ctypes.c_void_p(self._lib.kv_open())
+        self.path = path
+        if path is None:
+            self._h = ctypes.c_void_p(self._lib.kv_open())
+        else:
+            p = os.fsencode(path)
+            self._h = ctypes.c_void_p(
+                self._lib.kv_open_at(p, len(p), 1 if sync else 0))
+            if not self._h:
+                raise KVError(0, f"cannot open WAL at {path!r} "
+                                 "(unwritable directory?)")
+
+    def checkpoint(self) -> int:
+        """Compact to <path>.snap + truncate the WAL (BR snapshot-backup
+        seam; -1 when in-memory)."""
+        n = int(self._lib.kv_checkpoint(self._h))
+        if n == -2:
+            raise KVError(0, "checkpoint could not reopen the WAL; "
+                             "store is no longer durable")
+        return n
 
     def close(self):
         if self._h:
