@@ -193,3 +193,29 @@ def test_drop_table_purges_data_and_ids_never_reused(tmp_path):
     assert tid_b > tid_a                # dropped id never reused
     assert s2.must_query("select count(*) from b") == [(0,)]
     dom2.kv.close()
+
+
+def test_torn_tail_then_more_commits(tmp_path):
+    """A torn WAL tail is truncated at reopen so records appended AFTER a
+    crash are not stranded behind garbage (review finding)."""
+    from tidb_tpu.store.kv import KVStore
+    p = str(tmp_path / "kv")
+    s1 = KVStore(path=p)
+    for i in range(5):
+        t = s1.begin()
+        t.put(b"k%d" % i, b"v%d" % i)
+        t.commit()
+    s1.close()
+    # simulate a crash mid-append: write half a record at the tail
+    with open(p + ".wal", "ab") as f:
+        f.write(b"\x00\x01\x02\x03garbage")
+    s2 = KVStore(path=p)     # replays 5 records, truncates the tear
+    t = s2.begin()
+    t.put(b"post", b"tear")
+    t.commit()
+    s2.close()
+    s3 = KVStore(path=p)
+    ts = s3.alloc_ts()
+    assert s3.get(b"k3", ts) == b"v3"
+    assert s3.get(b"post", ts) == b"tear"   # NOT stranded behind the tear
+    s3.close()

@@ -1,0 +1,195 @@
+"""Pessimistic transactions + deadlock detection.
+
+Reference analog: KvPessimisticLock (unistore/tikv/server.go:237) and the
+waits-for deadlock detector (unistore/tikv/detector.go).  VERDICT round-1
+item #10: concurrent conflicting UPDATEs block-then-succeed; an induced
+waits-for cycle aborts exactly one transaction.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tidb_tpu.store.kv import (DeadlockError, KVError, KVStore,
+                               LockWaitTimeout)
+
+
+def test_conflicting_writers_block_then_succeed():
+    """The lost-update test: two pessimistic increments serialize."""
+    s = KVStore()
+    t0 = s.begin()
+    t0.put(b"cnt", b"0")
+    t0.commit()
+
+    order = []
+
+    def bump(tag):
+        t = s.begin(pessimistic=True)
+        t.lock_keys([b"cnt"], wait_ms=5000)   # blocks while other holds it
+        cur = int(t.get(b"cnt"))
+        time.sleep(0.05)                      # widen the race window
+        t.put(b"cnt", b"%d" % (cur + 1))
+        t.commit()
+        order.append(tag)
+
+    th1 = threading.Thread(target=bump, args=("a",))
+    th2 = threading.Thread(target=bump, args=("b",))
+    th1.start()
+    th2.start()
+    th1.join()
+    th2.join()
+    assert len(order) == 2
+    assert s.get(b"cnt", s.alloc_ts()) == b"2"   # no lost update
+    s.close()
+
+
+def test_optimistic_same_race_conflicts():
+    """Contrast: the same interleaving under optimistic 2PC fails one txn
+    with a write conflict instead of blocking."""
+    s = KVStore()
+    t0 = s.begin()
+    t0.put(b"cnt", b"0")
+    t0.commit()
+
+    t1 = s.begin()
+    t2 = s.begin()
+    v1 = int(t1.get(b"cnt"))
+    v2 = int(t2.get(b"cnt"))
+    t1.put(b"cnt", b"%d" % (v1 + 1))
+    t2.put(b"cnt", b"%d" % (v2 + 1))
+    t1.commit()
+    with pytest.raises(KVError):
+        t2.commit()
+    s.close()
+
+
+def test_deadlock_detected_and_victim_aborts():
+    s = KVStore()
+    t0 = s.begin()
+    t0.put(b"a", b"1")
+    t0.put(b"b", b"2")
+    t0.commit()
+
+    t1 = s.begin(pessimistic=True)
+    t2 = s.begin(pessimistic=True)
+    t1.lock_keys([b"a"])
+    t2.lock_keys([b"b"])
+
+    results = {}
+
+    def t1_wants_b():
+        try:
+            t1.lock_keys([b"b"], wait_ms=8000)
+            results["t1"] = "ok"
+        except DeadlockError:
+            results["t1"] = "deadlock"
+
+    th = threading.Thread(target=t1_wants_b)
+    th.start()
+    time.sleep(0.15)          # let t1 enter the wait queue
+    # t2 -> a while t1 (holder of a) waits on b held by t2: cycle
+    try:
+        t2.lock_keys([b"a"], wait_ms=8000)
+        results["t2"] = "ok"
+    except DeadlockError:
+        results["t2"] = "deadlock"
+    th.join(timeout=10)
+    assert not th.is_alive()
+    assert sorted(results.values()) == ["deadlock", "ok"], results
+    # the survivor can commit; the victim's rollback released its locks
+    survivor = t1 if results["t1"] == "ok" else t2
+    survivor.put(b"a", b"x")
+    survivor.put(b"b", b"y")
+    survivor.commit()
+    ts = s.alloc_ts()
+    assert s.get(b"a", ts) == b"x" and s.get(b"b", ts) == b"y"
+    s.close()
+
+
+def test_lock_wait_timeout():
+    s = KVStore()
+    t0 = s.begin()
+    t0.put(b"k", b"v")
+    t0.commit()
+    t1 = s.begin(pessimistic=True)
+    t1.lock_keys([b"k"])
+    t2 = s.begin(pessimistic=True)
+    start = time.monotonic()
+    with pytest.raises(LockWaitTimeout):
+        t2.lock_keys([b"k"], wait_ms=200)
+    assert 0.15 < time.monotonic() - start < 3.0
+    t1.rollback()
+    # lock released: now it succeeds
+    t2.lock_keys([b"k"], wait_ms=200)
+    t2.rollback()
+    s.close()
+
+
+def test_select_for_update_locks_release_on_commit():
+    """Keys locked but never written release at commit (FOR UPDATE rows
+    left unchanged must not stay locked)."""
+    s = KVStore()
+    t0 = s.begin()
+    t0.put(b"k", b"v")
+    t0.commit()
+    t1 = s.begin(pessimistic=True)
+    t1.lock_keys([b"k"])
+    t1.commit()               # nothing written; lock must be released
+    t2 = s.begin(pessimistic=True)
+    t2.lock_keys([b"k"], wait_ms=100)   # would time out if lock leaked
+    t2.rollback()
+    s.close()
+
+
+def test_sql_level_pessimistic_txn():
+    """BEGIN PESSIMISTIC through the session: conflicting UPDATE blocks
+    until the first txn commits, then applies on top of it."""
+    from tidb_tpu.session import Domain, Session
+    dom = Domain()
+    s1 = Session(dom)
+    s2 = Session(dom)
+    s1.execute("create table acct (id bigint primary key, bal bigint)")
+    s1.execute("insert into acct values (1, 100)")
+
+    s1.execute("begin pessimistic")
+    s1.execute("update acct set bal = bal - 10 where id = 1")
+
+    done = []
+
+    def other():
+        s2.execute("begin pessimistic")
+        s2.execute("update acct set bal = bal - 30 where id = 1")
+        s2.execute("commit")
+        done.append(time.monotonic())
+
+    th = threading.Thread(target=other)
+    th.start()
+    time.sleep(0.2)
+    assert not done               # s2 is blocked on s1's row lock
+    t_commit = time.monotonic()
+    s1.execute("commit")
+    th.join(timeout=10)
+    assert done and done[0] >= t_commit
+    assert s1.must_query("select bal from acct") == [(60,)]   # both applied
+
+
+def test_update_sees_own_buffered_writes():
+    """Two UPDATEs of the same row inside one txn compose (union scan:
+    the statement view includes the txn's earlier buffered mutations)."""
+    from tidb_tpu.session import Domain, Session
+    s = Session(Domain())
+    s.execute("create table t (id bigint primary key, x bigint)")
+    s.execute("insert into t values (1, 0)")
+    s.execute("begin pessimistic")
+    s.execute("update t set x = x + 1 where id = 1")
+    s.execute("update t set x = x + 1 where id = 1")
+    s.execute("commit")
+    assert s.must_query("select x from t") == [(2,)]
+
+    # same through an optimistic explicit txn
+    s.execute("begin")
+    s.execute("update t set x = x + 10 where id = 1")
+    s.execute("update t set x = x * 2 where id = 1")
+    s.execute("commit")
+    assert s.must_query("select x from t") == [(24,)]

@@ -127,9 +127,12 @@ class DDLExecutor:
         self.storage.save(job)
         try:
             tbl = self.domain.catalog.get_table(job.db, job.table)
-            tbl._persist_meta()   # catalog-on-KV: index states survive
         except Exception:
-            pass                  # table dropped mid-job
+            tbl = None            # table dropped mid-job
+        if tbl is not None:
+            tbl._persist_meta()   # catalog-on-KV: index states survive
+            # (persistence failures propagate — silently losing an index
+            # state transition would corrupt the restart view)
 
     def _run_one(self, job: DDLJob):
         tbl = self.domain.catalog.get_table(job.db, job.table)

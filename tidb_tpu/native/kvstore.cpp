@@ -35,6 +35,8 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <condition_variable>
+#include <chrono>
 #include <map>
 #include <memory>
 #include <mutex>
@@ -56,6 +58,9 @@ enum ErrCode : int32_t {
   ERR_NOT_FOUND = 3,
   ERR_TXN_MISMATCH = 4,    // commit/rollback without matching lock
   ERR_ALREADY_ROLLED_BACK = 5,
+  ERR_DEADLOCK = 6,        // waits-for cycle: requester is the victim
+  ERR_LOCK_WAIT_TIMEOUT = 7,
+  ERR_WAL = 8,             // WAL write failed: durability lost, commit refused
 };
 
 enum Op : uint8_t { OP_PUT = 0, OP_DELETE = 1, OP_ROLLBACK = 2 };
@@ -66,6 +71,9 @@ struct Lock {
   Op op = OP_PUT;
   std::string value;  // staged data
   bool present = false;
+  // pessimistic locks (tikv KvPessimisticLock analog): taken at DML time,
+  // upgraded to a prewrite lock at commit, never staged to the WAL
+  bool pessimistic = false;
 };
 
 struct WriteRec {
@@ -90,7 +98,25 @@ struct Store {
   std::string path;
   FILE* wal = nullptr;
   bool sync = false;
+  bool wal_failed = false;  // a WAL write failed: refuse further commits
+  // pessimistic lock waiting + deadlock detection (detector.go analog):
+  // waits_for[waiter_start_ts] = holder_start_ts (a txn waits on at most
+  // one key at a time, so single edges suffice)
+  std::condition_variable_any lock_cv;
+  std::map<uint64_t, uint64_t> waits_for;
 };
+
+// true if following waits_for edges from `from` reaches `target`
+bool wf_reaches(const Store* s, uint64_t from, uint64_t target) {
+  uint64_t cur = from;
+  for (size_t hops = 0; hops < s->waits_for.size() + 1; ++hops) {
+    auto it = s->waits_for.find(cur);
+    if (it == s->waits_for.end()) return false;
+    cur = it->second;
+    if (cur == target) return true;
+  }
+  return false;
+}
 
 void apply_committed(Store* s, const std::string& key, uint64_t start_ts,
                      uint64_t commit_ts, Op op, const std::string& value) {
@@ -110,28 +136,45 @@ void apply_committed(Store* s, const std::string& key, uint64_t start_ts,
   if (start_ts > s->ts_counter) s->ts_counter = start_ts;
 }
 
-void log_commit(Store* s, const std::string& key, uint64_t start_ts,
-                uint64_t commit_ts, Op op, const std::string& value) {
-  if (s->wal == nullptr) return;
+// Serialize ONE record; returns false on any short write.  The single
+// writer shared by the WAL appender and the checkpointer (the reader is
+// replay_file) so the on-disk format lives in one place per direction.
+bool write_record(FILE* f, const std::string& key, uint64_t start_ts,
+                  uint64_t commit_ts, Op op, const std::string& value) {
   uint8_t o = static_cast<uint8_t>(op);
   uint32_t kl = key.size(), vl = (op == OP_PUT) ? value.size() : 0;
-  std::fwrite(&o, 1, 1, s->wal);
-  std::fwrite(&start_ts, 8, 1, s->wal);
-  std::fwrite(&commit_ts, 8, 1, s->wal);
-  std::fwrite(&kl, 4, 1, s->wal);
-  std::fwrite(&vl, 4, 1, s->wal);
-  std::fwrite(key.data(), 1, kl, s->wal);
-  if (vl) std::fwrite(value.data(), 1, vl, s->wal);
-  std::fflush(s->wal);
-#ifndef _WIN32
-  if (s->sync) fdatasync(fileno(s->wal));
-#endif
+  if (std::fwrite(&o, 1, 1, f) != 1) return false;
+  if (std::fwrite(&start_ts, 8, 1, f) != 1) return false;
+  if (std::fwrite(&commit_ts, 8, 1, f) != 1) return false;
+  if (std::fwrite(&kl, 4, 1, f) != 1) return false;
+  if (std::fwrite(&vl, 4, 1, f) != 1) return false;
+  if (kl && std::fwrite(key.data(), 1, kl, f) != kl) return false;
+  if (vl && std::fwrite(value.data(), 1, vl, f) != vl) return false;
+  return true;
 }
 
-// Replay one record stream; stops cleanly at a torn tail.
-void replay_file(Store* s, const std::string& fname) {
+// Append + flush one commit record.  Any failure poisons the WAL
+// (wal_failed): the caller fails the commit and all later ones — never
+// silently degrade to acking non-durable writes.
+bool log_commit(Store* s, const std::string& key, uint64_t start_ts,
+                uint64_t commit_ts, Op op, const std::string& value) {
+  if (s->wal == nullptr) return true;
+  bool ok = write_record(s->wal, key, start_ts, commit_ts, op, value);
+  ok = ok && std::fflush(s->wal) == 0;
+#ifndef _WIN32
+  if (ok && s->sync) ok = fdatasync(fileno(s->wal)) == 0;
+#endif
+  return ok;
+}
+
+// Replay one record stream; stops cleanly at a torn tail.  Returns the
+// byte offset of the last complete record so the caller can truncate the
+// tear before appending (appending after garbage would strand every
+// later record behind an unparseable header).
+long replay_file(Store* s, const std::string& fname) {
   FILE* f = std::fopen(fname.c_str(), "rb");
-  if (f == nullptr) return;
+  if (f == nullptr) return 0;
+  long good = 0;
   for (;;) {
     uint8_t o;
     uint64_t sts, cts;
@@ -145,8 +188,10 @@ void replay_file(Store* s, const std::string& fname) {
     if (kl && std::fread(key.data(), 1, kl, f) != kl) break;
     if (vl && std::fread(val.data(), 1, vl, f) != vl) break;
     apply_committed(s, key, sts, cts, static_cast<Op>(o), val);
+    good = std::ftell(f);
   }
   std::fclose(f);
+  return good;
 }
 
 struct Arena {
@@ -165,6 +210,7 @@ thread_local std::string g_err;
 int32_t check_lock_conflict(const VersionChain& vc, uint64_t read_ts,
                             uint64_t caller_start_ts) {
   if (!vc.lock.present) return OK;
+  if (vc.lock.pessimistic) return OK;  // no staged write: reads pass
   if (vc.lock.start_ts == caller_start_ts) return OK;  // own lock
   if (vc.lock.start_ts <= read_ts) return ERR_LOCKED;
   return OK;  // lock from a future txn doesn't block this snapshot
@@ -190,8 +236,13 @@ void* kv_open_at(const char* path, int32_t plen, uint8_t sync) {
   s->path.assign(path, plen);
   s->sync = sync != 0;
   replay_file(s, s->path + ".snap");
-  replay_file(s, s->path + ".wal");
+  long wal_good = replay_file(s, s->path + ".wal");
   s->ts_counter += 1;  // strictly above anything persisted
+#ifndef _WIN32
+  truncate((s->path + ".wal").c_str(), wal_good);  // drop any torn tail
+#else
+  (void)wal_good;
+#endif
   s->wal = std::fopen((s->path + ".wal").c_str(), "ab");
   if (s->wal == nullptr) {  // unwritable dir/disk: fail loudly, never
     delete s;               // silently degrade to non-durable
@@ -210,38 +261,42 @@ int64_t kv_checkpoint(void* h) {
   FILE* f = std::fopen(tmp.c_str(), "wb");
   if (f == nullptr) return -1;
   int64_t n = 0;
+  bool ok = true;
   for (const auto& [key, vc] : s->keys) {
-    // oldest-first so replay's front-insert rebuilds newest-first
-    for (auto it = vc.writes.rbegin(); it != vc.writes.rend(); ++it) {
+    if (!ok) break;
+    // oldest-first so replay's insertion rebuilds newest-first
+    for (auto it = vc.writes.rbegin(); ok && it != vc.writes.rend(); ++it) {
       if (it->op == OP_ROLLBACK) continue;
-      uint8_t o = static_cast<uint8_t>(it->op);
       std::string val;
       if (it->op == OP_PUT) {
         auto dit = vc.data.find(it->start_ts);
         if (dit == vc.data.end()) continue;
         val = dit->second;
       }
-      uint32_t kl = key.size(), vl = val.size();
-      std::fwrite(&o, 1, 1, f);
-      std::fwrite(&it->start_ts, 8, 1, f);
-      std::fwrite(&it->commit_ts, 8, 1, f);
-      std::fwrite(&kl, 4, 1, f);
-      std::fwrite(&vl, 4, 1, f);
-      std::fwrite(key.data(), 1, kl, f);
-      if (vl) std::fwrite(val.data(), 1, vl, f);
+      ok = write_record(f, key, it->start_ts, it->commit_ts, it->op, val);
       ++n;
     }
   }
-  std::fflush(f);
+  ok = ok && std::fflush(f) == 0;
 #ifndef _WIN32
-  fdatasync(fileno(f));
+  ok = ok && fdatasync(fileno(f)) == 0;
 #endif
   std::fclose(f);
-  std::rename(tmp.c_str(), (s->path + ".snap").c_str());
+  if (!ok) {                       // partial snapshot: keep .snap + WAL
+    std::remove(tmp.c_str());
+    return -2;
+  }
+  if (std::rename(tmp.c_str(), (s->path + ".snap").c_str()) != 0) {
+    std::remove(tmp.c_str());
+    return -2;
+  }
   if (s->wal != nullptr) {
     std::fclose(s->wal);
     s->wal = std::fopen((s->path + ".wal").c_str(), "wb");  // truncate
-    if (s->wal == nullptr) return -2;  // caller must treat as fatal
+    if (s->wal == nullptr) {
+      s->wal_failed = true;
+      return -2;  // caller must treat as fatal
+    }
   }
   return n;
 }
@@ -269,14 +324,21 @@ int32_t kv_prewrite(void* h, const char* key, int32_t klen, const char* val,
   if (vc.lock.present && vc.lock.start_ts != start_ts) {
     return ERR_LOCKED;
   }
-  // write conflict: any commit (or rollback of us) after start_ts
-  for (const auto& w : vc.writes) {
-    if (w.commit_ts > start_ts) {
-      if (w.op == OP_ROLLBACK && w.start_ts != start_ts) continue;
-      return w.op == OP_ROLLBACK ? ERR_ALREADY_ROLLED_BACK
-                                 : ERR_WRITE_CONFLICT;
+  // prewriting over our own pessimistic lock skips the conflict check:
+  // kv_pessimistic_lock already validated against for_update_ts, and
+  // commits in (start_ts, for_update_ts] are permitted in this mode
+  bool own_pess = vc.lock.present && vc.lock.pessimistic
+                  && vc.lock.start_ts == start_ts;
+  if (!own_pess) {
+    // write conflict: any commit (or rollback of us) after start_ts
+    for (const auto& w : vc.writes) {
+      if (w.commit_ts > start_ts) {
+        if (w.op == OP_ROLLBACK && w.start_ts != start_ts) continue;
+        return w.op == OP_ROLLBACK ? ERR_ALREADY_ROLLED_BACK
+                                   : ERR_WRITE_CONFLICT;
+      }
+      break;  // writes are newest-first; older ones can't conflict
     }
-    break;  // writes are newest-first; older ones can't conflict
   }
   // rollback record for this exact start_ts => txn was aborted
   for (const auto& w : vc.writes) {
@@ -285,6 +347,7 @@ int32_t kv_prewrite(void* h, const char* key, int32_t klen, const char* val,
     }
   }
   vc.lock.present = true;
+  vc.lock.pessimistic = false;   // upgrade: pessimistic -> prewrite lock
   vc.lock.start_ts = start_ts;
   vc.lock.primary.assign(primary, plen);
   vc.lock.op = static_cast<Op>(op);
@@ -306,19 +369,24 @@ int32_t kv_commit(void* h, const char* key, int32_t klen, uint64_t start_ts,
     }
     return ERR_TXN_MISMATCH;
   }
+  if (vc.lock.pessimistic) return ERR_TXN_MISMATCH;  // prewrite first
+  if (s->wal_failed) return ERR_WAL;
+  // log BEFORE applying: a failed WAL write must fail the commit, not
+  // silently ack a non-durable one
+  if (s->wal != nullptr) {
+    if (!log_commit(s, it->first, start_ts, commit_ts, vc.lock.op,
+                    vc.lock.value)) {
+      s->wal_failed = true;
+      return ERR_WAL;
+    }
+  }
   if (vc.lock.op == OP_PUT) {
     vc.data[start_ts] = std::move(vc.lock.value);
   }
   vc.writes.insert(vc.writes.begin(),
                    WriteRec{commit_ts, start_ts, vc.lock.op});
-  Op op = vc.lock.op;
   vc.lock = Lock{};
-  if (s->wal != nullptr) {
-    static const std::string kEmpty;
-    const auto dit = vc.data.find(start_ts);
-    log_commit(s, it->first, start_ts, commit_ts, op,
-               op == OP_PUT && dit != vc.data.end() ? dit->second : kEmpty);
-  }
+  s->lock_cv.notify_all();
   return OK;
 }
 
@@ -329,6 +397,7 @@ int32_t kv_rollback(void* h, const char* key, int32_t klen,
   auto& vc = s->keys[std::string(key, klen)];
   if (vc.lock.present && vc.lock.start_ts == start_ts) {
     vc.lock = Lock{};
+    s->lock_cv.notify_all();
   }
   // tombstone so a late prewrite of the same txn fails
   vc.writes.insert(vc.writes.begin(),
@@ -426,6 +495,72 @@ int64_t kv_gc(void* h, uint64_t safepoint) {
     }
   }
   return dropped;
+}
+
+// Acquire a pessimistic lock (KvPessimisticLock, unistore/tikv/server.go
+// :237).  Blocks up to wait_ms while another txn holds the key, with
+// waits-for-cycle detection (detector.go): the REQUESTER is the deadlock
+// victim.  for_update_ts guards against commits later than what the
+// statement read (write-conflict -> caller refreshes and retries).
+int32_t kv_pessimistic_lock(void* h, const char* key, int32_t klen,
+                            const char* primary, int32_t plen,
+                            uint64_t start_ts, uint64_t for_update_ts,
+                            int32_t wait_ms) {
+  auto* s = static_cast<Store*>(h);
+  std::unique_lock lk(s->mu);
+  std::string k(key, klen);
+  auto deadline = std::chrono::steady_clock::now()
+                  + std::chrono::milliseconds(wait_ms);
+  for (;;) {
+    auto& vc = s->keys[k];
+    for (const auto& w : vc.writes) {
+      if (w.op == OP_ROLLBACK) {
+        if (w.start_ts == start_ts) return ERR_ALREADY_ROLLED_BACK;
+        continue;
+      }
+      if (w.commit_ts > for_update_ts) return ERR_WRITE_CONFLICT;
+      break;
+    }
+    if (!vc.lock.present) {
+      vc.lock.present = true;
+      vc.lock.pessimistic = true;
+      vc.lock.start_ts = start_ts;
+      vc.lock.primary.assign(primary, plen);
+      vc.lock.op = OP_PUT;
+      vc.lock.value.clear();
+      return OK;
+    }
+    if (vc.lock.start_ts == start_ts) return OK;  // re-entrant
+    uint64_t holder = vc.lock.start_ts;
+    // adding edge start_ts -> holder: cycle iff holder (transitively)
+    // already waits on us
+    if (wf_reaches(s, holder, start_ts)) return ERR_DEADLOCK;
+    s->waits_for[start_ts] = holder;
+    bool timed_out = !s->lock_cv.wait_until(lk, deadline, [&] {
+      auto it2 = s->keys.find(k);
+      return it2 == s->keys.end() || !it2->second.lock.present
+             || it2->second.lock.start_ts == start_ts;
+    });
+    s->waits_for.erase(start_ts);
+    if (timed_out) return ERR_LOCK_WAIT_TIMEOUT;
+  }
+}
+
+// Release a pessimistic lock without aborting the txn (statement rollback
+// / unlock of keys that were locked but not written).
+int32_t kv_pessimistic_rollback(void* h, const char* key, int32_t klen,
+                                uint64_t start_ts) {
+  auto* s = static_cast<Store*>(h);
+  std::unique_lock lk(s->mu);
+  auto it = s->keys.find(std::string(key, klen));
+  if (it == s->keys.end()) return OK;
+  auto& vc = it->second;
+  if (vc.lock.present && vc.lock.pessimistic
+      && vc.lock.start_ts == start_ts) {
+    vc.lock = Lock{};
+    s->lock_cv.notify_all();
+  }
+  return OK;
 }
 
 int64_t kv_num_keys(void* h) {

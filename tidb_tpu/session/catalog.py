@@ -278,6 +278,31 @@ class TableInfo:
         self._invalidate()
         return len(fixed)
 
+    def update_rows(self, handles, old_rows, new_rows, txn=None) -> int:
+        """Rewrite specific rows IN PLACE (stable handles) through the row
+        store — the UpdateExec analog.  Inside an explicit transaction the
+        caller's txn buffers the writes (and, in pessimistic mode, locks
+        each record key at DML time via Txn.put)."""
+        from .codec_io import encode_table_row
+        own = txn is None
+        with self.schema_gate.read():
+            t = txn or self.kv.begin()
+            try:
+                for h, old, new in zip(handles, old_rows, new_rows):
+                    self._delete_index_entries(t, old, int(h))
+                    key, val = encode_table_row(self.table_id, int(h), new,
+                                                self.col_types)
+                    t.put(key, val)
+                    self._write_index_entries(t, new, int(h))
+                if own:
+                    t.commit()
+            except Exception:
+                if own:
+                    t.rollback()
+                raise
+        self._invalidate()
+        return len(handles)
+
     def delete_where(self, keep_mask: np.ndarray) -> int:
         """Delete rows where ~keep_mask (aligned with snapshot row order)."""
         snap = self.snapshot()

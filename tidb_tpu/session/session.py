@@ -22,6 +22,7 @@ from ..planner.optimize import optimize_plan
 from ..sql import ast as A
 from ..sql.parser import parse_sql
 from ..store.client import CopClient
+from ..store.kv import KVError
 from ..types import dtypes as dt
 from .catalog import (Catalog, CatalogError, TableInfo, plainify,
                       type_from_sql)
@@ -622,7 +623,13 @@ class Session:
         if stmt.kind == "begin":
             if self.txn is not None:
                 self._finish_txn(commit=True)
-            self.txn = self.domain.kv.begin()
+            merged = {**self.domain.sysvars, **self.vars}
+            mode = stmt.mode or str(merged.get("tidb_txn_mode", "optimistic"))
+            self.txn = self.domain.kv.begin(
+                pessimistic=(mode == "pessimistic"))
+            if self.txn.pessimistic:
+                self.txn.lock_wait_ms = int(
+                    merged.get("innodb_lock_wait_timeout", 3)) * 1000
             self._txn_tables = set()
             self._txn_schema_ver = self.domain.schema_version
         elif stmt.kind == "commit":
@@ -798,7 +805,14 @@ class Session:
     def _where_mask(self, tbl: TableInfo, where: Optional[A.Node]) -> np.ndarray:
         """Evaluate WHERE over the table snapshot -> bool mask (NULL=false)."""
         snap = tbl.snapshot()
-        n = snap.num_rows
+        return self._where_mask_cols(tbl, snap.columns, snap.dictionaries,
+                                     where)
+
+    def _where_mask_cols(self, tbl: TableInfo, columns, dicts,
+                         where: Optional[A.Node]) -> np.ndarray:
+        """WHERE mask over explicit columns (txn union-scan views pass
+        their own overlaid columns here, not the shared snapshot)."""
+        n = len(columns[0]) if columns else 0
         if where is None:
             return np.ones(n, bool)
         from ..expr.compile import eval_expr
@@ -806,11 +820,11 @@ class Session:
         from ..planner.build import ExprBuilder
         from ..planner.logical import Schema, SchemaCol
         sch = Schema([SchemaCol(nm, c.dtype)
-                      for nm, c in zip(tbl.col_names, snap.columns)])
+                      for nm, c in zip(tbl.col_names, columns)])
         ir = ExprBuilder(sch).build(where)
-        ir = lower_strings(ir, snap.dictionaries)
+        ir = lower_strings(ir, dicts)
         pairs = [(c.data, (True if c.validity.all() else c.validity))
-                 for c in snap.columns]
+                 for c in columns]
         v, m = eval_expr(np, ir, pairs)
         v = np.broadcast_to(np.asarray(v), (n,))
         if v.dtype != bool:
@@ -836,10 +850,81 @@ class Session:
     def _exec_update(self, stmt: A.Update) -> ResultSet:
         return self._retry_write_conflict(lambda: self._do_update(stmt))
 
+    def _txn_row_overlay(self, tbl: TableInfo) -> dict:
+        """handle -> decoded row (None = buffered delete) from the active
+        txn's membuffer for this table — the UnionScanExec ingredient."""
+        from ..store.codec import decode_record_key, decode_row, record_prefix
+        out: dict = {}
+        if self.txn is None or tbl.kv is None:
+            return out
+        pre = record_prefix(tbl.table_id)
+        for k, v in self.txn.mutations.items():
+            if k.startswith(pre):
+                h = decode_record_key(k)[1]
+                out[h] = None if v is None else tuple(
+                    decode_row(v, tbl.col_types))
+        return out
+
+    def _update_view(self, tbl: TableInfo):
+        """(rows, handles, columns, dicts) the UPDATE statement sees:
+        committed snapshot merged with the txn's own buffered mutations
+        (union scan), never mutating the shared snapshot cache."""
+        snap = tbl.snapshot()
+        rows = [list(r) for r in zip(*[c.to_python() for c in snap.columns])] \
+            if snap.num_rows else []
+        handles = [int(h) for h in (tbl._snapshot_handles
+                                    if tbl._snapshot_handles is not None
+                                    else range(len(rows)))]
+        overlay = self._txn_row_overlay(tbl)
+        if not overlay:
+            return rows, handles, snap.columns, snap.dictionaries
+        merged, mh, seen = [], [], set()
+        for h, r in zip(handles, rows):
+            seen.add(h)
+            if h in overlay:
+                if overlay[h] is None:
+                    continue              # buffered delete
+                merged.append(list(overlay[h]))
+            else:
+                merged.append(r)
+            mh.append(h)
+        for h in sorted(set(overlay) - seen):
+            if overlay[h] is not None:    # buffered insert
+                merged.append(list(overlay[h]))
+                mh.append(h)
+        cols = _rows_to_columns(tbl, [tuple(plainify(x) for x in r)
+                                      for r in merged])
+        dicts = {i: c.dictionary for i, c in enumerate(cols)
+                 if c.dictionary is not None}
+        return merged, mh, cols, dicts
+
     def _do_update(self, stmt: A.Update) -> ResultSet:
         tbl = self.domain.catalog.get_table(self.db, stmt.table)
-        snap = tbl.snapshot()
-        mask = self._where_mask(tbl, stmt.where)
+        if self.txn is not None and getattr(self.txn, "pessimistic", False) \
+                and tbl.kv is not None:
+            # pessimistic statement protocol: lock the affected record
+            # keys FIRST (blocking conflicting writers), then recompute
+            # from a post-lock view so the update applies on top of
+            # whatever committed while we waited (no lost updates)
+            from ..store.codec import record_key
+            locked: set = set()
+            for attempt in range(8):
+                tbl._invalidate()
+                rows0, handles0, cols0, dicts0 = self._update_view(tbl)
+                m = self._where_mask_cols(tbl, cols0, dicts0, stmt.where)
+                matched = {handles0[i] for i in np.nonzero(m)[0]}
+                fresh = matched - locked
+                if not fresh:
+                    break
+                self.txn.lock_keys([record_key(tbl.table_id, h)
+                                    for h in sorted(fresh)])
+                locked |= fresh
+            else:
+                raise KVError(0, "pessimistic lock retry limit exceeded "
+                                 "(contended WHERE set keeps growing)")
+        rows, handles, cols, dicts = self._update_view(tbl)
+        mask = self._where_mask_cols(tbl, cols, dicts, stmt.where)
+        n_rows = len(rows)
         n_aff = int(mask.sum())
         if n_aff == 0:
             return ResultSet(affected=0)
@@ -848,34 +933,44 @@ class Session:
         from ..planner.build import ExprBuilder
         from ..planner.logical import Schema, SchemaCol
         sch = Schema([SchemaCol(nm, c.dtype)
-                      for nm, c in zip(tbl.col_names, snap.columns)])
+                      for nm, c in zip(tbl.col_names, cols)])
         pairs = [(c.data, (True if c.validity.all() else c.validity))
-                 for c in snap.columns]
+                 for c in cols]
         ci = {n: i for i, n in enumerate(tbl.col_names)}
-        rows = [list(r) for r in zip(*[c.to_python() for c in snap.columns])] \
-            if snap.num_rows else []
         midx = np.nonzero(mask)[0]
+        old_rows = [tuple(rows[i]) for i in midx]
         for col, expr_ast in stmt.assignments:
             if col not in ci:
                 raise PlanError(f"unknown column {col!r}")
-            t = tbl.col_types[ci[col]]
             if isinstance(expr_ast, A.Lit):
                 val = self._literal_value(expr_ast)
                 for i in midx:
                     rows[i][ci[col]] = val
                 continue
-            ir = lower_strings(ExprBuilder(sch).build(expr_ast),
-                               snap.dictionaries)
+            ir = lower_strings(ExprBuilder(sch).build(expr_ast), dicts)
             if ir.dtype.is_string:
                 raise PlanError("computed string UPDATE not supported yet")
             v, m = eval_expr(np, ir, pairs)
-            v = np.broadcast_to(np.asarray(v), (snap.num_rows,))
+            v = np.broadcast_to(np.asarray(v), (n_rows,))
             for i in midx:
                 ok = True if m is True else bool(np.broadcast_to(
-                    np.asarray(m), (snap.num_rows,))[i])
+                    np.asarray(m), (n_rows,))[i])
                 rows[i][ci[col]] = _decode_val(v[i], ir.dtype) if ok else None
-        new_rows = [tuple(plainify(x) for x in r) for r in rows]
-        tbl.replace_columns(_rows_to_columns(tbl, new_rows))
+        if tbl.kv is not None:
+            # targeted in-place rewrite through the row store: handles stay
+            # stable, and inside a pessimistic txn each record key is
+            # locked at DML time (blocking conflicting writers)
+            upd_handles = [handles[i] for i in midx]
+            updated = [tuple(plainify(x) for x in rows[i]) for i in midx]
+            if self.txn is not None:
+                tbl.update_rows(upd_handles, old_rows, updated,
+                                txn=self.txn)
+                self._txn_tables.add(tbl)
+            else:
+                tbl.update_rows(upd_handles, old_rows, updated)
+        else:
+            new_rows = [tuple(plainify(x) for x in r) for r in rows]
+            tbl.replace_columns(_rows_to_columns(tbl, new_rows))
         self.domain.stats.note_modify(tbl, n_aff, delta=0)
         return ResultSet(affected=n_aff)
 

@@ -339,6 +339,7 @@ class DeallocateStmt(Node):
 @dataclass
 class TxnStmt(Node):
     kind: str = ""                  # 'begin' | 'commit' | 'rollback'
+    mode: str = ""                  # begin only: '' | 'pessimistic' | 'optimistic'
 
 
 @dataclass

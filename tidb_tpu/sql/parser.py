@@ -127,7 +127,11 @@ class Parser:
             return self.set_stmt()
         if self.at_kw("BEGIN"):
             self.advance()
-            return A.TxnStmt("begin")
+            mode = ""
+            if self.cur.kind == "ident" and self.cur.text.upper() in (
+                    "PESSIMISTIC", "OPTIMISTIC"):
+                mode = self.advance().text.lower()
+            return A.TxnStmt("begin", mode)
         if self.at_kw("START"):
             self.advance()
             self.expect_kw("TRANSACTION")

@@ -33,8 +33,19 @@ class KVError(RuntimeError):
 
 
 ERR_NAMES = {1: "locked", 2: "write conflict", 3: "not found",
-             4: "txn mismatch", 5: "already rolled back"}
+             4: "txn mismatch", 5: "already rolled back",
+             6: "deadlock", 7: "lock wait timeout", 8: "wal write failed"}
 ERR_LOCKED, ERR_WRITE_CONFLICT, ERR_NOT_FOUND = 1, 2, 3
+ERR_DEADLOCK, ERR_LOCK_WAIT_TIMEOUT = 6, 7
+
+
+class DeadlockError(KVError):
+    """Waits-for cycle: this transaction was chosen as the victim
+    (unistore/tikv/detector.go analog)."""
+
+
+class LockWaitTimeout(KVError):
+    """innodb_lock_wait_timeout analog."""
 
 
 def _load_lib():
@@ -86,6 +97,15 @@ def _load_lib():
                                    ctypes.c_uint8]
         lib.kv_checkpoint.restype = ctypes.c_int64
         lib.kv_checkpoint.argtypes = [ctypes.c_void_p]
+        lib.kv_pessimistic_lock.restype = ctypes.c_int32
+        lib.kv_pessimistic_lock.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32,
+            ctypes.c_char_p, ctypes.c_int32, ctypes.c_uint64,
+            ctypes.c_uint64, ctypes.c_int32]
+        lib.kv_pessimistic_rollback.restype = ctypes.c_int32
+        lib.kv_pessimistic_rollback.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32,
+            ctypes.c_uint64]
         _lib = lib
     return _lib
 
@@ -129,8 +149,8 @@ class KVStore:
         """TSO allocation (PD analog)."""
         return int(self._lib.kv_alloc_ts(self._h))
 
-    def begin(self) -> "Txn":
-        return Txn(self, self.alloc_ts())
+    def begin(self, pessimistic: bool = False) -> "Txn":
+        return Txn(self, self.alloc_ts(), pessimistic=pessimistic)
 
     # -- snapshot reads ------------------------------------------------ #
 
@@ -189,27 +209,75 @@ class KVStore:
 
 @dataclass
 class Txn:
-    """Optimistic transaction: membuffer + percolator 2PC on commit
-    (client-go twoPhaseCommitter analog)."""
+    """Transaction: membuffer + percolator 2PC on commit (client-go
+    twoPhaseCommitter analog).  Pessimistic mode locks every written key
+    at DML time (KvPessimisticLock) so conflicting writers BLOCK instead
+    of failing at commit; a waits-for cycle aborts the requester
+    (DeadlockError)."""
     store: KVStore
     start_ts: int
     mutations: dict = field(default_factory=dict)  # key -> value|None(delete)
     committed: bool = False
+    pessimistic: bool = False
+    locked: set = field(default_factory=set)
+    lock_wait_ms: int = 3000
+    for_update_ts: int = 0       # latest lock acquisition ts
 
     def put(self, key: bytes, value: bytes):
+        if self.pessimistic:
+            self.lock_keys([key])
         self.mutations[key] = value
 
     def delete(self, key: bytes):
+        if self.pessimistic:
+            self.lock_keys([key])
         self.mutations[key] = None
+
+    def lock_keys(self, keys, wait_ms: Optional[int] = None):
+        """Acquire pessimistic locks (SELECT FOR UPDATE / DML locking).
+        for_update_ts is allocated fresh so commits between start_ts and
+        now are tolerated — the pessimistic-mode contract."""
+        lib = self.store._lib
+        h = self.store._h
+        wait = self.lock_wait_ms if wait_ms is None else wait_ms
+        primary = next(iter(sorted(self.locked | set(keys))))
+        for k in keys:
+            if k in self.locked:
+                continue
+            # a commit can land between our for_update_ts and the wait's
+            # end; the pessimistic protocol refreshes for_update_ts and
+            # retries (client-go's WriteConflict handling)
+            for _ in range(64):
+                for_update_ts = self.store.alloc_ts()
+                self.for_update_ts = max(self.for_update_ts, for_update_ts)
+                rc = lib.kv_pessimistic_lock(h, k, len(k), primary,
+                                             len(primary), self.start_ts,
+                                             for_update_ts, wait)
+                if rc != ERR_WRITE_CONFLICT:
+                    break
+            if rc == ERR_DEADLOCK:
+                self.rollback()
+                raise DeadlockError(rc, f"lock {k!r}")
+            if rc == ERR_LOCK_WAIT_TIMEOUT:
+                raise LockWaitTimeout(rc, f"lock {k!r}")
+            if rc != 0:
+                raise KVError(rc, f"pessimistic lock {k!r}")
+            self.locked.add(k)
+
+    @property
+    def read_ts(self) -> int:
+        """Pessimistic reads see everything up to the lock acquisition
+        (for_update_ts); optimistic reads stay at the start snapshot."""
+        return max(self.start_ts, self.for_update_ts)
 
     def get(self, key: bytes) -> Optional[bytes]:
         if key in self.mutations:
             return self.mutations[key]
-        return self.store.get(key, self.start_ts)
+        return self.store.get(key, self.read_ts)
 
     def scan(self, start: bytes, end: bytes, **kw):
         """Union-scan analog: merge membuffer over the snapshot."""
-        snap = dict(self.store.scan(start, end, self.start_ts, **kw))
+        snap = dict(self.store.scan(start, end, self.read_ts, **kw))
         for k, v in self.mutations.items():
             if start <= k < (end or k + b"\x00"):
                 if v is None:
@@ -221,6 +289,7 @@ class Txn:
 
     def commit(self) -> int:
         if not self.mutations:
+            self._release_unwritten_locks()
             self.committed = True
             return self.start_ts
         lib = self.store._lib
@@ -244,15 +313,30 @@ class Txn:
             rc = lib.kv_commit(h, k, len(k), self.start_ts, commit_ts)
             if rc != 0:
                 raise KVError(rc, f"commit {k!r}")
+        self._release_unwritten_locks()
         self.committed = True
         return commit_ts
+
+    def _release_unwritten_locks(self):
+        """Pessimistic locks on keys that were locked but never written
+        (e.g. SELECT FOR UPDATE rows left unchanged) release at commit."""
+        lib = self.store._lib
+        h = self.store._h
+        for k in self.locked - set(self.mutations):
+            lib.kv_pessimistic_rollback(h, k, len(k), self.start_ts)
+        self.locked.clear()
 
     def rollback(self):
         lib = self.store._lib
         h = self.store._h
         for k in self.mutations:
             lib.kv_rollback(h, k, len(k), self.start_ts)
+        for k in self.locked - set(self.mutations):
+            lib.kv_pessimistic_rollback(h, k, len(k), self.start_ts)
+        self.locked.clear()
         self.mutations.clear()
 
 
-__all__ = ["KVStore", "Txn", "KVError", "ERR_LOCKED", "ERR_WRITE_CONFLICT"]
+__all__ = ["KVStore", "Txn", "KVError", "DeadlockError", "LockWaitTimeout",
+           "ERR_LOCKED", "ERR_WRITE_CONFLICT", "ERR_DEADLOCK",
+           "ERR_LOCK_WAIT_TIMEOUT"]
