@@ -494,6 +494,8 @@ class Session:
             _build_mod.PLAN_TAINTS.reset(token2)
         self._maybe_auto_analyze(built.plan)
         plan = optimize_plan(built.plan)
+        from ..planner.join_reorder import reorder_joins
+        plan = reorder_joins(plan, self.domain.stats)
         plan = apply_index_paths(plan, self.domain.stats)
         phys = to_physical(plan)
         use_cache = use_cache and not ran_subquery
@@ -521,7 +523,9 @@ class Session:
         built = build_query(sub_ast, self.domain.catalog, self.db)
         if len(built.plan.schema) != 1:
             raise PlanError("scalar subquery must return one column")
+        from ..planner.join_reorder import reorder_joins
         plan = optimize_plan(built.plan)
+        plan = reorder_joins(plan, self.domain.stats)
         plan = apply_index_paths(plan, self.domain.stats)
         chunk = to_physical(plan).execute(self._exec_ctx())
         if chunk.num_rows > 1:

@@ -68,9 +68,16 @@ def _stats_kernel(x, valid, n_buckets, n_top):
     run_counts = jax.ops.segment_sum(in_valid.astype(jnp.int64), run_id, n)
     run_vals = jax.ops.segment_max(jnp.where(in_valid, xs, jnp.int64(-2**62)),
                                    run_id, n)
-    # TopN
-    top_counts, top_idx = jax.lax.top_k(run_counts, n_top)
+    # TopN (tiny tables: fewer rows than n_top slots — clamp, then pad
+    # with zero-count entries so the output shape stays static)
+    k = min(n_top, n)
+    top_counts, top_idx = jax.lax.top_k(run_counts, k)
     top_vals = run_vals[top_idx]
+    if k < n_top:
+        top_counts = jnp.concatenate(
+            [top_counts, jnp.zeros(n_top - k, top_counts.dtype)])
+        top_vals = jnp.concatenate(
+            [top_vals, jnp.zeros(n_top - k, top_vals.dtype)])
     # equal-depth histogram: bound j at sorted position min((j+1)*size, nv)-1
     size = jnp.maximum((nv + n_buckets - 1) // n_buckets, 1)
     ub_pos = jnp.minimum((jnp.arange(n_buckets) + 1) * size, nv) - 1
