@@ -115,13 +115,16 @@ class Aggregation(CopNode):
 @dataclass(frozen=True)
 class TopN(CopNode):
     """Per-shard TopN (root merges shard tops, reference cophandler/topn.go).
-    `sort_key` is a single int-comparable expression (the planner packs
-    multi-column keys or falls back to root sort); `desc` flips order."""
+    `sort_key`/`desc` is the single-key form; `sort_keys` (a tuple of
+    (expr, desc) pairs, priority order) carries multi-column ORDER BY —
+    the device sorts all keys in one lax.sort (cophandler/topn.go
+    multi-ByItem analog)."""
     child: CopNode = None  # type: ignore[assignment]
     sort_key: Expr = None  # type: ignore[assignment]
     desc: bool = False
     limit: int = 0
     nulls_last: bool = False  # MySQL: NULLs first ASC, last DESC
+    sort_keys: Tuple = ()     # ((Expr, desc), ...): overrides sort_key/desc
 
     def children(self):
         return (self.child,)

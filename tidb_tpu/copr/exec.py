@@ -415,20 +415,24 @@ def _exec_topn(node: D.TopN, batch: DeviceBatch, ev: Evaluator) -> DeviceBatch:
     memo: dict = {}
     n = len(batch.cols[0][0])
     sel = _sel_array(batch.sel, n)
-    v, m = ev.eval(node.sort_key, batch.cols, memo)
-    v = _ensure_array(v, n)
-    kd = node.sort_key.dtype
-    key = sortable_int64(jnp, v, kd.is_float, kd.kind == K.UINT64)
-    if node.desc:
-        key = ~key               # exact descending order, no overflow
     dead = (~sel).astype(jnp.int32)
-    if m is True:
-        nullflag = jnp.zeros(n, jnp.int32)
-    else:
-        # NULL sorts first in ASC, last in DESC
-        nullflag = jnp.where(m, 1, 0).astype(jnp.int32) if not node.desc \
-            else jnp.where(m, 0, 1).astype(jnp.int32)
-    *_, idx = lax.sort((dead, nullflag, key, jnp.arange(n)), num_keys=3)
+    operands = [dead]
+    for e, desc in (node.sort_keys or ((node.sort_key, node.desc),)):
+        v, m = ev.eval(e, batch.cols, memo)
+        v = _ensure_array(v, n)
+        key = sortable_int64(jnp, v, e.dtype.is_float,
+                             e.dtype.kind == K.UINT64)
+        if desc:
+            key = ~key           # exact descending order, no overflow
+        if m is True:
+            nullflag = jnp.zeros(n, jnp.int32)
+        else:
+            # NULL sorts first in ASC, last in DESC
+            nullflag = jnp.where(m, 1, 0).astype(jnp.int32) if not desc \
+                else jnp.where(m, 0, 1).astype(jnp.int32)
+        operands += [nullflag, key]
+    nk = len(operands)
+    *_, idx = lax.sort(tuple(operands) + (jnp.arange(n),), num_keys=nk)
     k = min(node.limit, n)
     idx = idx[:k]
     live = jnp.sum(sel)

@@ -119,13 +119,96 @@ class ResultChunk:
         return [(c.data, (True if c.validity.all() else c.validity))
                 for c in self.columns]
 
+    def nbytes(self):
+        return sum(c.data.nbytes + c.validity.nbytes for c in self.columns)
+
+
+# Host streaming block: the Next()/required-rows protocol's chunk unit.
+# The reference streams 1024-row Go chunks (exec/executor.go MaxChunkSize);
+# numpy wants bigger vector blocks, so the host protocol streams 64K-row
+# slices — same bounded-memory contract, amortized interpreter overhead.
+STREAM_ROWS = 64 * 1024
+
+
+def _empty_column(t: dt.DataType) -> Column:
+    npdt = t.np_dtype()
+    return Column(t, np.empty(0, npdt), np.empty(0, bool))
+
+
+def _unify_string_columns(cols: list[Column]) -> list[Column]:
+    """Remap string columns with differing dictionaries into one merged
+    code space (per-chunk dictionaries arise from string-producing
+    projections; scan chunks share the table dictionary)."""
+    dicts = [c.dictionary for c in cols]
+    first = dicts[0]
+    if all(d is first for d in dicts):
+        return cols
+    merged = StringDict(
+        [v for d in dicts if d is not None for v in d.values])
+    out = []
+    for c in cols:
+        if c.dictionary is None or not len(c.dictionary):
+            out.append(Column(c.dtype, np.zeros(len(c), c.data.dtype),
+                              np.zeros(len(c), bool)
+                              if c.dictionary is None else c.validity,
+                              merged))
+            continue
+        m = np.fromiter((merged.code_of(v) for v in c.dictionary.values),
+                        np.int64, count=len(c.dictionary))
+        codes = m[np.clip(c.data, 0, len(m) - 1)].astype(c.data.dtype)
+        out.append(Column(c.dtype, codes, c.validity, merged))
+    return out
+
+
+def concat_result_chunks(chunks: Sequence[ResultChunk], names,
+                         dtypes=None) -> ResultChunk:
+    """Concatenate streamed chunks, unifying per-chunk string dictionaries."""
+    chunks = [c for c in chunks if c is not None]
+    if not chunks:
+        return ResultChunk(list(names),
+                           [_empty_column(t) for t in (dtypes or [])])
+    if len(chunks) == 1:
+        return chunks[0]
+    out = []
+    for i in range(len(chunks[0].columns)):
+        cols = [ch.columns[i] for ch in chunks]
+        if cols[0].dtype.is_string:
+            cols = _unify_string_columns(cols)
+        out.append(Column.concat(cols))
+    return ResultChunk(chunks[0].names, out)
+
+
+def _slice_stream(chunk: ResultChunk):
+    n = chunk.num_rows
+    if n <= STREAM_ROWS:
+        yield chunk
+        return
+    for lo in range(0, n, STREAM_ROWS):
+        hi = min(lo + STREAM_ROWS, n)
+        yield ResultChunk(chunk.names,
+                          [c.slice(lo, hi) for c in chunk.columns])
+
 
 class PhysOp:
+    """Host operator. Implement EITHER `execute` (materializing) OR
+    `chunks` (streaming); the base class derives the other.  `chunks` is
+    the Volcano Next()-with-required-rows analog
+    (pkg/executor/internal/exec/executor.go:51): a generator of bounded
+    ResultChunks; `required_rows` hints that the consumer needs at most
+    that many total rows (Limit/TopN early stop)."""
     out_names: list[str]
     out_dtypes: list[dt.DataType]
 
     def execute(self, ctx: ExecContext) -> ResultChunk:
-        raise NotImplementedError
+        if type(self).chunks is PhysOp.chunks:
+            raise NotImplementedError(type(self).__name__)
+        return concat_result_chunks(list(self.chunks(ctx)),
+                                    self.out_names, self.out_dtypes)
+
+    def chunks(self, ctx: ExecContext, required_rows: Optional[int] = None):
+        if type(self).execute is PhysOp.execute:
+            raise NotImplementedError(type(self).__name__)
+        yield from _slice_stream(self.execute(ctx))
 
     def explain(self, indent=0):
         pad = "  " * indent
@@ -417,10 +500,12 @@ class HostSelection(PhysOp):
         self.out_names = self.child.out_names
         self.out_dtypes = self.child.out_dtypes
 
-    def execute(self, ctx):
-        chunk = self.child.execute(ctx)
-        idx = np.nonzero(_conds_mask(chunk, self.conditions))[0]
-        return ResultChunk(chunk.names, [c.take(idx) for c in chunk.columns])
+    def chunks(self, ctx, required_rows=None):
+        for chunk in self.child.chunks(ctx):
+            idx = np.nonzero(_conds_mask(chunk, self.conditions))[0]
+            if len(idx) or chunk.num_rows == 0:
+                yield ResultChunk(chunk.names,
+                                  [c.take(idx) for c in chunk.columns])
 
 
 @dataclass
@@ -433,10 +518,10 @@ class HostProjection(PhysOp):
         self.children = [self.child]
         self.out_dtypes = [e.dtype for e in self.exprs]
 
-    def execute(self, ctx):
-        chunk = self.child.execute(ctx)
-        cols = [_eval_to_column(e, chunk) for e in self.exprs]
-        return ResultChunk(list(self.out_names), cols)
+    def chunks(self, ctx, required_rows=None):
+        for chunk in self.child.chunks(ctx, required_rows):
+            cols = [_eval_to_column(e, chunk) for e in self.exprs]
+            yield ResultChunk(list(self.out_names), cols)
 
 
 @dataclass
@@ -450,11 +535,20 @@ class HostLimit(PhysOp):
         self.out_names = self.child.out_names
         self.out_dtypes = self.child.out_dtypes
 
-    def execute(self, ctx):
-        chunk = self.child.execute(ctx)
-        lo, hi = self.offset, self.offset + self.limit
-        return ResultChunk(chunk.names, [c.slice(lo, min(hi, len(c)))
-                                         for c in chunk.columns])
+    def chunks(self, ctx, required_rows=None):
+        """Early-stop pull: stops drawing child chunks once offset+limit
+        rows passed through (the required-rows protocol's payoff)."""
+        need = self.offset + self.limit
+        seen = 0
+        for chunk in self.child.chunks(ctx, required_rows=need):
+            lo = min(max(self.offset - seen, 0), chunk.num_rows)
+            hi = min(max(need - seen, 0), chunk.num_rows)
+            seen += chunk.num_rows
+            if hi > lo:
+                yield ResultChunk(chunk.names,
+                                  [c.slice(lo, hi) for c in chunk.columns])
+            if seen >= need:
+                return
 
 
 def _sort_keys_matrix(chunk: ResultChunk, keys) -> list[np.ndarray]:
@@ -492,6 +586,10 @@ def _sort_keys_matrix(chunk: ResultChunk, keys) -> list[np.ndarray]:
 
 @dataclass
 class HostSort(PhysOp):
+    """Streaming external sort: buffers child chunks up to a quota-derived
+    block size, spills each block as a SORTED RUN (rows + rank matrix),
+    then streams the k-way merge (sortexec external sort analog).  When
+    the whole input fits, it sorts in memory and streams slices."""
     child: PhysOp
     keys: list  # [(Expr, desc)]
 
@@ -500,8 +598,94 @@ class HostSort(PhysOp):
         self.out_names = self.child.out_names
         self.out_dtypes = self.child.out_dtypes
 
-    def execute(self, ctx):
-        chunk = self.child.execute(ctx)
+    def _can_spill_streaming(self, first: ResultChunk) -> bool:
+        # cross-run rank comparability: wide-decimal keys use per-block
+        # dense ranks (object dtype) and cannot spill as streaming runs
+        for e, _ in self.keys:
+            if e.dtype.kind == K.DECIMAL and e.dtype.np_dtype() == object:
+                return False
+        # object-backed PAYLOAD columns (wide-decimal SUM outputs) cannot
+        # be memory-mapped back by merge_sorted_runs either
+        for c in first.columns:
+            if c.data.dtype == object:
+                return False
+        return True
+
+    def _dict_compatible(self, first: ResultChunk, ch: ResultChunk) -> bool:
+        return all(a.dictionary is b.dictionary
+                   for a, b in zip(first.columns, ch.columns)
+                   if a.dtype.is_string)
+
+    def chunks(self, ctx, required_rows=None):
+        if not self.keys:
+            yield from self.child.chunks(ctx, required_rows)
+            return
+        remaining = ctx.remaining_quota()
+        # spill threshold: half the remaining statement quota (the other
+        # half covers rank matrices + merge buffers), floor 1 MiB
+        block_bytes = None
+        if remaining is not None and ctx.spill_enabled:
+            block_bytes = max(remaining // 2, 1 << 20)
+        buf: list[ResultChunk] = []
+        buf_bytes = 0
+        runs = []
+        d = None
+        first = None
+        try:
+            it = self.child.chunks(ctx)
+            for ch in it:
+                if ch.num_rows == 0:
+                    continue
+                if first is None:
+                    first = ch
+                elif not self._dict_compatible(first, ch):
+                    # per-chunk dictionaries: runs would not share a code
+                    # space; fall back to materialize + unify
+                    buf.append(ch)
+                    buf.extend(c for c in it)
+                    merged = concat_result_chunks(
+                        ([self._runs_to_chunk(runs)] + buf)
+                        if runs else buf, self.out_names, self.out_dtypes)
+                    runs = []
+                    yield from _slice_stream(self._sorted_full(ctx, merged))
+                    return
+                buf.append(ch)
+                buf_bytes += ch.nbytes()
+                if block_bytes is not None and buf_bytes >= block_bytes \
+                        and self._can_spill_streaming(first):
+                    if d is None:
+                        from ..utils.rowcontainer import spill_dir
+                        d = spill_dir()
+                        ctx.spills += 1
+                    runs.append(self._flush_run(d.name, len(runs), buf))
+                    buf, buf_bytes = [], 0
+            if not runs:
+                chunk = concat_result_chunks(buf, self.out_names,
+                                             self.out_dtypes)
+                yield from _slice_stream(self._sorted_full(ctx, chunk))
+                return
+            if buf:
+                runs.append(self._flush_run(d.name, len(runs), buf))
+            from ..utils.rowcontainer import merge_sorted_runs
+            for cols in merge_sorted_runs(runs, STREAM_ROWS):
+                yield ResultChunk(list(self.out_names), cols)
+        finally:
+            if d is not None:
+                d.cleanup()
+
+    def _flush_run(self, tmpdir, tag, buf):
+        from ..utils.rowcontainer import SortedRun
+        chunk = concat_result_chunks(buf, self.out_names, self.out_dtypes)
+        ranks = _sort_keys_matrix(chunk, self.keys)
+        return SortedRun.write(tmpdir, f"run-{tag}", chunk.columns, ranks)
+
+    def _runs_to_chunk(self, runs):
+        from ..utils.rowcontainer import merge_sorted_runs
+        pieces = [ResultChunk(list(self.out_names), cols)
+                  for cols in merge_sorted_runs(runs, STREAM_ROWS)]
+        return concat_result_chunks(pieces, self.out_names, self.out_dtypes)
+
+    def _sorted_full(self, ctx, chunk: ResultChunk) -> ResultChunk:
         ranks = _sort_keys_matrix(chunk, self.keys)
         if not ranks:
             return chunk
@@ -510,11 +694,12 @@ class HostSort(PhysOp):
         remaining = ctx.remaining_quota()
         if (remaining is not None and extra > remaining
                 and ctx.spill_enabled and n > 1):
-            # external sort: bounded blocks, disk runs, k-way merge
+            # external index sort over materialized input (wide-decimal /
+            # per-chunk-dict inputs that could not spill streaming runs)
             from ..utils.rowcontainer import external_sort_index, spill_dir
             ctx.spills += 1
-            with spill_dir() as d:
-                idx = external_sort_index(ranks, d, max(n // 8, 1024))
+            with spill_dir() as sd:
+                idx = external_sort_index(ranks, sd, max(n // 8, 1024))
         else:
             ctx.track(extra)
             idx = np.lexsort(tuple(reversed(ranks)))
@@ -524,6 +709,10 @@ class HostSort(PhysOp):
 
 @dataclass
 class HostTopN(PhysOp):
+    """Streaming TopN: consumes child chunks keeping a bounded candidate
+    buffer of at most max(4*(offset+limit), STREAM_ROWS) rows, pruned by
+    a full lexsort of the buffer (executor TopNExec heap analog — the
+    buffer IS the heap, vectorized)."""
     child: PhysOp
     keys: list
     limit: int
@@ -534,11 +723,32 @@ class HostTopN(PhysOp):
         self.out_names = self.child.out_names
         self.out_dtypes = self.child.out_dtypes
 
-    def execute(self, ctx):
-        chunk = HostSort(self.child, self.keys).execute(ctx)
-        lo, hi = self.offset, self.offset + self.limit
-        return ResultChunk(chunk.names, [c.slice(lo, min(hi, len(c)))
-                                         for c in chunk.columns])
+    def chunks(self, ctx, required_rows=None):
+        k = self.offset + self.limit
+        if k == 0:
+            return
+        cap = max(4 * k, STREAM_ROWS)
+        buf = None
+        for ch in self.child.chunks(ctx):
+            if ch.num_rows == 0:
+                continue
+            buf = ch if buf is None else concat_result_chunks(
+                [buf, ch], self.out_names, self.out_dtypes)
+            if buf.num_rows > cap:
+                buf = self._top(buf, k)
+        if buf is None:
+            return
+        buf = self._top(buf, k)       # final exact sort of survivors
+        lo = min(self.offset, buf.num_rows)
+        hi = min(k, buf.num_rows)
+        if hi > lo:
+            yield ResultChunk(buf.names,
+                              [c.slice(lo, hi) for c in buf.columns])
+
+    def _top(self, chunk: ResultChunk, k: int) -> ResultChunk:
+        ranks = _sort_keys_matrix(chunk, self.keys)
+        idx = np.lexsort(tuple(reversed(ranks)))[:k]
+        return ResultChunk(chunk.names, [c.take(idx) for c in chunk.columns])
 
 
 @dataclass
@@ -563,36 +773,110 @@ class HostHashJoin(PhysOp):
         na = ",null-aware" if self.null_aware else ""
         return f"HostHashJoin[{self.kind}{na}] keys={len(self.eq_keys)}"
 
-    def execute(self, ctx):
-        lc = self.left.execute(ctx)
+    def _na_filter(self, lc: ResultChunk) -> ResultChunk:
+        """NOT IN probe-side: NULL probe keys never pass (non-empty set)."""
+        keep = np.ones(lc.num_rows, bool)
+        for lk, _ in self.eq_keys:
+            keep &= lc.columns[lk].validity
+        if keep.all():
+            return lc
+        idx = np.nonzero(keep)[0]
+        return ResultChunk(lc.names, [c.take(idx) for c in lc.columns])
+
+    def chunks(self, ctx, required_rows=None):
+        """Build side materialized; probe side STREAMED chunk-at-a-time
+        (the bounded-memory probe of hash_join_v2.go).  The partition-
+        spill path engages only when the build side alone strains the
+        quota (it must materialize the probe to co-partition it)."""
         rc = self.right.execute(ctx)
-        if self.null_aware and self.eq_keys and rc.num_rows:
+        na = self.null_aware and self.eq_keys and rc.num_rows
+        if na:
             # NOT IN (non-empty set): one NULL build key empties the whole
-            # result; NULL probe keys never pass.  (An EMPTY build set is
-            # TRUE for every probe row, NULLs included — skip both.)
+            # result.  (An EMPTY build set is TRUE for every probe row,
+            # NULLs included — skip both checks.)
             for _, rk in self.eq_keys:
                 if not rc.columns[rk].validity.all():
-                    return ResultChunk(lc.names,
-                                       [c.slice(0, 0) for c in lc.columns])
-            keep = np.ones(lc.num_rows, bool)
-            for lk, _ in self.eq_keys:
-                keep &= lc.columns[lk].validity
-            if not keep.all():
-                idx = np.nonzero(keep)[0]
-                lc = ResultChunk(lc.names, [c.take(idx) for c in lc.columns])
-        if self.eq_keys and min(lc.num_rows, rc.num_rows) > 1:
-            remaining = ctx.remaining_quota()
-            from ..utils.memory import nbytes_of
-            extra = nbytes_of(lc.columns) + nbytes_of(rc.columns)
-            if (remaining is not None and extra > remaining
-                    and ctx.spill_enabled):
-                return self._execute_spilled(ctx, lc, rc)
+                    return
+        from ..utils.memory import nbytes_of
+        rbytes = nbytes_of(rc.columns)
+        remaining = ctx.remaining_quota()
+        left_materializes = type(self.left).chunks is PhysOp.chunks
+        if (self.eq_keys and rc.num_rows > 1 and remaining is not None
+                and ctx.spill_enabled
+                and (2 * rbytes > remaining or left_materializes)):
+            # build side alone strains the quota, OR the probe child is a
+            # materializing op (its full output exists regardless, so the
+            # old combined lc+rc quota/spill discipline still applies)
+            lc = concat_result_chunks(
+                list(self.left.chunks(ctx)), self.left.out_names,
+                self.left.out_dtypes)
+            if na:
+                lc = self._na_filter(lc)
+            extra = nbytes_of(lc.columns) + rbytes
+            if extra > remaining:
+                yield self._execute_spilled(ctx, lc, rc)
+                return
             ctx.track(extra)
             try:
-                return self._join(lc, rc)
+                yield self._join(lc, rc)
+                return
             finally:
                 ctx.release(extra)
-        return self._join(lc, rc)
+        ctx.track(rbytes)
+        try:
+            if self.kind == "right":
+                yield from self._stream_right(ctx, rc, na)
+                return
+            for lch in self.left.chunks(ctx):
+                if na:
+                    lch = self._na_filter(lch)
+                cb = lch.nbytes()
+                ctx.track(cb)     # probe chunks charge transiently
+                try:
+                    out = self._join(lch, rc)
+                finally:
+                    ctx.release(cb)
+                if out.num_rows or lch.num_rows == 0:
+                    yield out
+        finally:
+            ctx.release(rbytes)
+
+    def _stream_right(self, ctx, rc: ResultChunk, na: bool):
+        """Right join with a streamed left side: emit matched pairs per
+        probe chunk while tracking build-row match bits; null-extend the
+        unmatched build rows at end-of-stream."""
+        matched = np.zeros(rc.num_rows, bool)
+        last_lc = None
+        for lch in self.left.chunks(ctx):
+            if na:
+                lch = self._na_filter(lch)
+            last_lc = lch
+            li, ri = self._match_pairs(lch, rc)
+            if self.other_conds:
+                cand = ResultChunk(lch.names + rc.names,
+                                   [c.take(li) for c in lch.columns]
+                                   + [c.take(ri) for c in rc.columns])
+                keep = _conds_mask(cand, self.other_conds)
+                li, ri = li[keep], ri[keep]
+            matched[ri] = True
+            if len(li):
+                yield ResultChunk(lch.names + rc.names,
+                                  [c.take(li) for c in lch.columns]
+                                  + [c.take(ri) for c in rc.columns])
+        miss = np.nonzero(~matched)[0]
+        if len(miss):
+            neg = np.full(len(miss), -1, np.int64)
+            if last_lc is not None:
+                lcols = [_take_nullable(c, neg) for c in last_lc.columns]
+                lnames = last_lc.names
+            else:
+                lnames = list(self.left.out_names)
+                lcols = [Column(t.with_nullable(True),
+                                np.zeros(len(miss), t.np_dtype()),
+                                np.zeros(len(miss), bool))
+                         for t in self.left.out_dtypes]
+            yield ResultChunk(lnames + rc.names,
+                              lcols + [c.take(miss) for c in rc.columns])
 
     def _execute_spilled(self, ctx, lc, rc):
         """hash_join_spill.go analog: partition both sides by join-key
@@ -714,10 +998,12 @@ def _join_key_arrays(a: Column, b: Column):
     matches (inner-join semantics for NULL = NULL)."""
     av, bv = a.data.astype(np.int64, copy=True), b.data.astype(np.int64, copy=True)
     if a.dtype.is_string and b.dtype.is_string and a.dictionary is not b.dictionary:
-        merged = {v: i for i, v in enumerate(
-            sorted(set(a.dictionary.values) | set(b.dictionary.values)))}
-        am = np.array([merged[v] for v in a.dictionary.values] or [0])
-        bm = np.array([merged[v] for v in b.dictionary.values] or [0])
+        # None dictionaries arise from empty streamed results — no values
+        avals = a.dictionary.values if a.dictionary is not None else []
+        bvals = b.dictionary.values if b.dictionary is not None else []
+        merged = {v: i for i, v in enumerate(sorted(set(avals) | set(bvals)))}
+        am = np.array([merged[v] for v in avals] or [0])
+        bm = np.array([merged[v] for v in bvals] or [0])
         av = am[np.clip(a.data, 0, len(am) - 1)]
         bv = bm[np.clip(b.data, 0, len(bm) - 1)]
     if a.dtype.kind == K.DECIMAL or b.dtype.kind == K.DECIMAL:
@@ -754,6 +1040,10 @@ def _ragged_ranges(starts: np.ndarray, counts: np.ndarray) -> np.ndarray:
 
 def _take_nullable(c: Column, idx: np.ndarray) -> Column:
     """take() that maps index -1 to NULL (outer-join padding)."""
+    if len(c) == 0:
+        return Column(c.dtype.with_nullable(True),
+                      np.zeros(len(idx), c.data.dtype),
+                      np.zeros(len(idx), bool), c.dictionary)
     safe = np.where(idx >= 0, idx, 0)
     out = c.take(safe)
     out.validity = np.where(idx >= 0, out.validity, False)
@@ -794,7 +1084,195 @@ class HostAgg(PhysOp):
     def __post_init__(self):
         self.children = [self.child]
 
-    def execute(self, ctx):
+    # -- streaming partial/final split (agg_hash_executor.go partial and
+    # -- final worker roles, collapsed into one chunk loop) ------------- #
+
+    def chunks(self, ctx, required_rows=None):
+        if any(a.distinct for a in self.aggs):
+            # DISTINCT partial states are value SETS, not fixed-width rows:
+            # materialize (the hash-partition spill path bounds memory)
+            yield from _slice_stream(self._execute_full(ctx))
+            return
+        acc = None
+        pending: list[ResultChunk] = []
+        pending_rows = 0
+        pnames = self._partial_names()
+        for ch in self.child.chunks(ctx):
+            if ch.num_rows == 0 and self.group_exprs:
+                continue
+            part = self._partial_chunk(ch)
+            pending.append(part)
+            pending_rows += part.num_rows
+            if pending_rows >= STREAM_ROWS:
+                acc = self._reduce_partials(concat_result_chunks(
+                    ([acc] if acc is not None else []) + pending,
+                    pnames))
+                pending, pending_rows = [], 0
+        if pending or acc is None:
+            if not pending and acc is None:
+                # zero input chunks: scalar agg still emits its one row
+                empty = ResultChunk(
+                    list(self.child.out_names),
+                    [_empty_column(t) for t in self.child.out_dtypes])
+                pending = [self._partial_chunk(empty)]
+            acc = self._reduce_partials(concat_result_chunks(
+                ([acc] if acc is not None else []) + pending, pnames))
+        yield from _slice_stream(self._finalize_partials(acc))
+
+    def _partial_names(self):
+        names = [f"g{i}" for i in range(len(self.group_exprs))]
+        for i, a in enumerate(self.aggs):
+            for tag in self._pspec(a):
+                names.append(f"a{i}_{tag}")
+        return names
+
+    def _pspec(self, a) -> tuple:
+        """Partial-state slots per agg (SURVEY §A.4 partial-state layout):
+        merge kind per slot drives _reduce_partials."""
+        if a.func == D.AggFunc.COUNT:
+            return ("cnt",)
+        if a.func == D.AggFunc.SUM:
+            isf = a.arg.dtype.kind in (K.FLOAT64, K.FLOAT32)
+            return ("sumf" if isf else "sumo", "cnt")
+        if a.func == D.AggFunc.MIN:
+            return ("min", "cnt")
+        if a.func == D.AggFunc.MAX:
+            return ("max", "cnt")
+        raise NotImplementedError(a.func)
+
+    def _partial_chunk(self, ch: ResultChunk) -> ResultChunk:
+        """Group-reduce one input chunk to partial-state rows."""
+        n = ch.num_rows
+        gcols = [_eval_to_column(g, ch) for g in self.group_exprs]
+        if gcols:
+            uniq_g, inverse, first = _group_ids(gcols, n)
+            g = uniq_g
+            key_cols = [c.take(first) for c in gcols]
+        else:
+            g = 1
+            inverse = np.zeros(n, np.int64)
+            key_cols = []
+        pcols: list[Column] = []
+        for a in self.aggs:
+            if a.arg is None:
+                cnt = np.bincount(inverse, minlength=g).astype(np.int64)
+                pcols.append(Column(dt.bigint(False), cnt, np.ones(g, bool)))
+                continue
+            c = _eval_to_column(a.arg, ch)
+            valid = c.validity
+            cnt = np.bincount(inverse[valid], minlength=g).astype(np.int64)
+            cnt_col = Column(dt.bigint(False), cnt, np.ones(g, bool))
+            if a.func == D.AggFunc.COUNT:
+                pcols.append(cnt_col)
+            elif a.func == D.AggFunc.SUM:
+                if a.arg.dtype.kind in (K.FLOAT64, K.FLOAT32):
+                    out = np.zeros(g, np.float64)
+                    np.add.at(out, inverse[valid],
+                              c.data[valid].astype(np.float64))
+                    pcols.append(Column(a.out_dtype, out, cnt > 0))
+                else:
+                    out = np.zeros(g, object)
+                    np.add.at(out, inverse[valid],
+                              c.data[valid].astype(object))
+                    pcols.append(Column(a.out_dtype, out, cnt > 0))
+                pcols.append(cnt_col)
+            elif a.func in (D.AggFunc.MIN, D.AggFunc.MAX):
+                isf = a.arg.dtype.is_float
+                init = self._mm_init(a, isf)
+                # partials accumulate in WIDE (int64/float64) space: the
+                # ±extreme init values do not fit narrow code dtypes
+                # (int32 string/date codes would wrap to -1)
+                out = np.full(g, init, np.float64 if isf else np.int64)
+                op = np.minimum if a.func == D.AggFunc.MIN else np.maximum
+                op.at(out, inverse[valid], c.data[valid].astype(out.dtype))
+                # invalid rows keep the ±inf init so merges stay neutral
+                pcols.append(Column(c.dtype, out, cnt > 0, c.dictionary))
+                pcols.append(cnt_col)
+            else:
+                raise NotImplementedError(a.func)
+        return ResultChunk(self._partial_names(), key_cols + pcols)
+
+    @staticmethod
+    def _mm_init(a, isf):
+        lo = -np.inf if isf else np.iinfo(np.int64).min
+        hi = np.inf if isf else np.iinfo(np.int64).max
+        return hi if a.func == D.AggFunc.MIN else lo
+
+    def _reduce_partials(self, chunk: ResultChunk) -> ResultChunk:
+        """Merge partial-state rows that share a group key."""
+        nk = len(self.group_exprs)
+        key_cols = chunk.columns[:nk]
+        pcols = chunk.columns[nk:]
+        n = chunk.num_rows
+        if nk:
+            g, inverse, first = _group_ids(key_cols, n)
+            out_keys = [c.take(first) for c in key_cols]
+        else:
+            g, inverse, out_keys = 1, np.zeros(n, np.int64), []
+        out_p: list[Column] = []
+        i = 0
+        for a in self.aggs:
+            for tag in self._pspec(a):
+                c = pcols[i]
+                i += 1
+                if tag == "cnt":
+                    out = np.zeros(g, np.int64)
+                    np.add.at(out, inverse, c.data.astype(np.int64))
+                    out_p.append(Column(c.dtype, out, np.ones(g, bool)))
+                elif tag == "sumf":
+                    out = np.zeros(g, np.float64)
+                    np.add.at(out, inverse, np.asarray(c.data, np.float64))
+                    out_p.append(Column(c.dtype, out, np.ones(g, bool)))
+                elif tag == "sumo":
+                    out = np.zeros(g, object)
+                    np.add.at(out, inverse, c.data.astype(object))
+                    out_p.append(Column(c.dtype, out, np.ones(g, bool)))
+                else:   # min / max — neutral-init data merges directly
+                    isf = c.data.dtype.kind == "f"
+                    a_ = a
+                    init = self._mm_init(a_, isf)
+                    out = np.full(g, init, c.data.dtype)
+                    op = (np.minimum if a.func == D.AggFunc.MIN
+                          else np.maximum)
+                    op.at(out, inverse, c.data)
+                    out_p.append(Column(c.dtype, out, np.ones(g, bool),
+                                        c.dictionary))
+        return ResultChunk(chunk.names, out_keys + out_p)
+
+    def _finalize_partials(self, acc: ResultChunk) -> ResultChunk:
+        nk = len(self.group_exprs)
+        key_cols = acc.columns[:nk]
+        pcols = acc.columns[nk:]
+        g = acc.num_rows
+        out_cols: list[Column] = []
+        i = 0
+        for a in self.aggs:
+            spec = self._pspec(a)
+            if a.func == D.AggFunc.COUNT:
+                cnt = pcols[i].data.astype(np.int64)
+                out_cols.append(Column(a.out_dtype, cnt, np.ones(g, bool)))
+            elif a.func == D.AggFunc.SUM:
+                s, cnt = pcols[i], pcols[i + 1].data
+                if spec[0] == "sumf":
+                    out_cols.append(Column(
+                        a.out_dtype,
+                        np.where(cnt > 0, np.asarray(s.data, np.float64),
+                                 0.0),
+                        cnt > 0))
+                else:
+                    out_cols.append(_sum_col(a, s.data, cnt))
+            else:   # MIN / MAX
+                v, cnt = pcols[i], pcols[i + 1].data
+                data = np.where(cnt > 0, v.data, 0)
+                out_cols.append(Column(
+                    a.out_dtype, data.astype(a.out_dtype.np_dtype()),
+                    cnt > 0, v.dictionary))
+            i += len(spec)
+        return ResultChunk(list(self.out_names), key_cols + out_cols)
+
+    # -- materializing path (DISTINCT aggs) ---------------------------- #
+
+    def _execute_full(self, ctx):
         chunk = self.child.execute(ctx)
         n = chunk.num_rows
         if self.group_exprs and n > 1:
@@ -844,16 +1322,7 @@ class HostAgg(PhysOp):
         n = chunk.num_rows
         gcols = [_eval_to_column(g, chunk) for g in self.group_exprs]
         if gcols:
-            mats = []
-            for c in gcols:
-                mats.append(np.where(c.validity, c.data.astype(np.int64),
-                                     np.iinfo(np.int64).min))
-                mats.append((~c.validity).astype(np.int64))
-            packed = np.stack(mats, axis=1)
-            uniq, inverse = np.unique(packed, axis=0, return_inverse=True)
-            g = len(uniq)
-            first = np.full(g, max(n - 1, 0), np.int64)
-            np.minimum.at(first, inverse, np.arange(n))
+            g, inverse, first = _group_ids(gcols, n)
             key_cols = [c.take(first) for c in gcols]
         else:
             g = 1
@@ -912,11 +1381,56 @@ class HostAgg(PhysOp):
         raise NotImplementedError(a.func)
 
 
+def _group_ids(gcols: list[Column], n: int):
+    """(num_groups, inverse, first-row-index) for a set of key columns:
+    NULL-distinct packed int64 grouping (HashAgg's group-key encoding)."""
+    mats = []
+    for c in gcols:
+        if c.data.dtype.kind == "f":
+            # exact float grouping: bit pattern, with -0.0 folded into 0.0
+            d = np.asarray(c.data, np.float64)
+            key = np.where(d == 0.0, 0.0, d).view(np.int64)
+        else:
+            key = c.data.astype(np.int64)
+        mats.append(np.where(c.validity, key, np.iinfo(np.int64).min))
+        mats.append((~c.validity).astype(np.int64))
+    packed = np.stack(mats, axis=1)
+    uniq, inverse = np.unique(packed, axis=0, return_inverse=True)
+    g = len(uniq)
+    first = np.full(g, max(n - 1, 0), np.int64)
+    np.minimum.at(first, inverse, np.arange(n))
+    return g, inverse, first
+
+
 def _sum_col(a: AggItem, out_obj: np.ndarray, cnt: np.ndarray) -> Column:
     wide = a.out_dtype.np_dtype() == object
     vals = np.array([int(x) for x in out_obj],
                     dtype=object if wide else np.int64)
     return Column(a.out_dtype, vals, cnt > 0)
+
+
+@dataclass
+class MemTableExec(PhysOp):
+    """information_schema / performance_schema memtable reader
+    (pkg/executor/infoschema_reader.go retriever analog): materializes the
+    virtual table's rows from live Domain state at execute time."""
+    table: Any                    # infoschema.MemTableInfo
+    col_offsets: list
+    out_names: list = field(default_factory=list)
+    out_dtypes: list = field(default_factory=list)
+    children: list = field(default_factory=list)
+
+    def describe(self):
+        return f"MemTableScan {self.table.name}"
+
+    def execute(self, ctx: ExecContext) -> ResultChunk:
+        rows = self.table.producer(self.table.domain)
+        cols = []
+        for out_i, off in enumerate(self.col_offsets):
+            t = self.out_dtypes[out_i]
+            vals = [r[off] for r in rows]
+            cols.append(Column.from_values(t.with_nullable(True), vals))
+        return ResultChunk(list(self.out_names), cols)
 
 
 @dataclass

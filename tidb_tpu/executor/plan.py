@@ -97,6 +97,11 @@ def to_physical(p: LogicalPlan, no_device_join: bool = False) -> PhysOp:
                            out_names=p.schema.names(),
                            out_dtypes=[c.dtype for c in p.schema.cols])
     if isinstance(p, DataSource):
+        if getattr(p.table, "is_memtable", False):
+            from .physical import MemTableExec
+            return MemTableExec(p.table, list(p.col_offsets),
+                                out_names=p.schema.names(),
+                                out_dtypes=[c.dtype for c in p.schema.cols])
         raise AssertionError("DataSource should fuse into a CopTask")
     raise NotImplementedError(type(p).__name__)
 
@@ -119,6 +124,8 @@ def _try_cop(p: LogicalPlan, no_device_join: bool = False) -> Optional[PhysOp]:
     if not isinstance(cur, DataSource):
         return None
     ds = cur
+    if getattr(ds.table, "is_memtable", False):
+        return None     # infoschema memtables read host state, never device
 
     snap = ds.table.snapshot()
     dicts = {}
@@ -178,14 +185,17 @@ def _try_cop(p: LogicalPlan, no_device_join: bool = False) -> Optional[PhysOp]:
         for i, d in agg_dicts.items():   # MIN/MAX over dict-encoded strings
             out_dicts[len(key_meta) + i] = d
     elif isinstance(top, LogicalTopN):
-        if len(top.keys) != 1:
-            return None  # multi-key TopN: host sort over the fused scan
-        key, desc = top.keys[0]
-        key = lower_strings(key, cur_dicts)
-        if not _device_supported(key):
+        keys = []
+        for key, desc in top.keys:
+            key = lower_strings(key, cur_dicts)
+            if not _device_supported(key):
+                return None
+            keys.append((key, desc))
+        if not keys:
             return None
-        node = D.TopN(node, sort_key=key, desc=desc,
-                      limit=top.limit + top.offset)
+        node = D.TopN(node, sort_key=keys[0][0], desc=keys[0][1],
+                      limit=top.limit + top.offset,
+                      sort_keys=tuple(keys) if len(keys) > 1 else ())
         exec_ = CopTaskExec(node, ds.table, out_names=out_names,
                             out_dtypes=out_dtypes, out_dicts=out_dicts)
         # root merge of per-device tops
@@ -357,6 +367,8 @@ def _bind_scan_chain(plan: LogicalPlan):
     if not isinstance(cur, DataSource):
         return None
     ds = cur
+    if getattr(ds.table, "is_memtable", False):
+        return None     # infoschema memtables never bind a device scan
     snap = ds.table.snapshot()
     cur_dicts = {}
     for i, off in enumerate(ds.col_offsets):

@@ -64,11 +64,18 @@ class ShardedShuffleJoinProgram:
         self.n_dev = len(mesh.devices.reshape(-1))
         self.agg = spec.top if isinstance(spec.top, D.Aggregation) else None
         self.kind = "agg" if self.agg is not None else "rows"
-        # same host-merge policy as ShardedCopProgram (see spmd.py): SORT
-        # group tables and MIN/MAX partials merge on host
-        self.host_merge = self.agg is not None and (
-            self.agg.strategy == D.GroupStrategy.SORT or any(
-                a.func in (D.AggFunc.MIN, D.AggFunc.MAX)
+        # same host-merge policy as ShardedCopProgram (see spmd.py): only
+        # SORT group tables merge on host; MIN/MAX merge in-program via
+        # the psum-gather trick
+        self.host_merge = (self.agg is not None
+                           and self.agg.strategy == D.GroupStrategy.SORT)
+        # same limb-exactness fence as spmd.py: int/decimal SUM (hi, lo)
+        # limb psum stays int64-exact only below 2^31 contributing rows
+        from ..types.dtypes import TypeKind as _K
+        self._psum_limb_fence = (
+            self.agg is not None and not self.host_merge and any(
+                a.func == D.AggFunc.SUM and a.arg is not None
+                and a.arg.dtype.kind not in (_K.FLOAT64, _K.FLOAT32)
                 for a in self.agg.aggs))
 
         in_specs = (P(SHARD_AXIS), P(SHARD_AXIS),
@@ -152,13 +159,21 @@ class ShardedShuffleJoinProgram:
             if self.host_merge:
                 out = jax.tree_util.tree_map(lambda a: a[None], states)
             else:
-                out = _collective_merge(states, SHARD_AXIS)
+                out = _collective_merge(states, SHARD_AXIS,
+                                        len(self.mesh.devices.reshape(-1)))
             return out, extras
         batch = _exec_node(spec.top, joined.cols, sel_mask, ev, aux)
         out_cols, n = compact(batch, caps.rows)
         return ([(v[None], m[None]) for v, m in out_cols], n[None]), extras
 
     def __call__(self, lcols, lcounts, rcols, rcounts, aux_cols=()):
+        if self._psum_limb_fence:
+            # global joined-row bound: every device may emit caps.out rows
+            if self.n_dev * self.caps.out >= 2 ** 31:
+                raise OverflowError(
+                    f"global join capacity {self.n_dev}x{self.caps.out} "
+                    "exceeds the 2^31 limb-exact SUM bound for in-program "
+                    "psum merge")
         return self._fn(tuple(lcols), lcounts, tuple(rcols), rcounts,
                         tuple(aux_cols))
 

@@ -32,16 +32,27 @@ from ..expr.compile import Evaluator
 from .mesh import SHARD_AXIS
 
 
-def _collective_merge(states: dict, axis: str) -> dict:
+def _psum_gather(arr, axis: str, n_dev: int):
+    """all_gather built from psum alone: each device deposits its partial
+    into its own slot of a zeros (D, ...) array, psum fills every slot
+    exactly once.  Lets MIN/MAX merge in-program on runtimes that lower
+    only Sum all-reduce (the axon AOT case VERDICT flagged) — cost is a
+    Dx state blow-up, negligible for agg partials."""
+    idx = lax.axis_index(axis)
+    slot = jnp.zeros((n_dev,) + arr.shape, arr.dtype).at[idx].set(arr)
+    return lax.psum(slot, axis)
+
+
+def _collective_merge(states: dict, axis: str, n_dev: int) -> dict:
     """Merge partial-state pytrees across the mesh axis.  This is the exact
-    seam BASELINE.json names: `psum` replaces the final-agg merge workers."""
+    seam BASELINE.json names: `psum` replaces the final-agg merge workers.
+    MIN/MAX ride the same psum via _psum_gather + in-program reduce."""
     def go(name, arr):
         how = _MERGE[name]
         if how == "sum":
             return lax.psum(arr, axis)
-        if how == "min":
-            return lax.pmin(arr, axis)
-        return lax.pmax(arr, axis)
+        g = _psum_gather(arr, axis, n_dev)
+        return jnp.min(g, axis=0) if how == "min" else jnp.max(g, axis=0)
 
     out: dict = {}
     for k, v in states.items():
@@ -75,16 +86,14 @@ class ShardedCopProgram:
         self.row_capacity = row_capacity
         self.agg = dag_root if isinstance(dag_root, D.Aggregation) else None
         self.kind = "agg" if self.agg is not None else "rows"
-        # MIN/MAX partials merge host-side: some TPU runtimes (axon AOT)
-        # lower only Sum all-reduce, so pmin/pmax can't go in-program.
-        # Sums/counts still psum over ICI — the seam BASELINE.json names.
-        # SORT-strategy group tables also merge host-side: per-device group
-        # sets aren't aligned, so there is no elementwise collective merge
-        # (the repartition-exchange path is the in-program alternative).
-        self.host_merge = self.agg is not None and (
-            self.agg.strategy == D.GroupStrategy.SORT or any(
-                a.func in (D.AggFunc.MIN, D.AggFunc.MAX)
-                for a in self.agg.aggs))
+        # MIN/MAX merge IN-PROGRAM via _psum_gather (psum-only all_gather +
+        # reduce), so runtimes that lower only Sum all-reduce still keep
+        # the whole merge on device.  Only SORT-strategy group tables merge
+        # host-side: per-device group sets aren't aligned, so there is no
+        # elementwise collective merge (the repartition-exchange path is
+        # the in-program alternative).
+        self.host_merge = (self.agg is not None
+                           and self.agg.strategy == D.GroupStrategy.SORT)
         # int/decimal SUMs produce (hi, lo) limb states whose in-program
         # psum is int64-exact only below 2^31 global rows; float sums,
         # counts, and host-merged (object-int) programs are exempt
@@ -126,7 +135,8 @@ class ShardedCopProgram:
                 # add a leading per-device axis; host reduces across it
                 out = jax.tree_util.tree_map(lambda a: a[None], states)
             else:
-                out = _collective_merge(states, SHARD_AXIS)
+                out = _collective_merge(states, SHARD_AXIS,
+                                        len(self.mesh.devices.reshape(-1)))
         else:
             batch = _exec_node(self.root, flat, base_sel, ev, aux)
             out_cols, n = compact(batch, self.row_capacity)

@@ -46,6 +46,16 @@ class CopResult:
 class CopClient:
     def __init__(self, mesh):
         self.mesh = mesh
+        # paging feedback: dag digest -> EWMA of observed per-shard live
+        # fraction; replaces the constant first guess with the reference's
+        # adaptive min->max paging discipline (pkg/util/paging) fed by
+        # actual run results instead of a fixed growth schedule.  LRU-capped:
+        # digests embed predicate constants, so point-query workloads would
+        # otherwise grow it without bound.
+        from collections import OrderedDict
+        self._page_feedback: OrderedDict[int, float] = OrderedDict()
+        self._page_feedback_cap = 512
+        self.last_page_iters = 0       # observability: regrow passes
 
     # ------------------------------------------------------------- #
 
@@ -252,14 +262,25 @@ class CopClient:
         n_dev = len(self.mesh.devices.reshape(-1))
         is_topn = isinstance(root, D.TopN)
         is_limit = isinstance(root, D.Limit)
+        fb_key = D.dag_digest(root)
+        per_shard = -(-snap.num_rows // max(snap.n_shards, 1)) \
+            if snap.num_rows else 1
         if is_topn or is_limit:
             cap = max(root.limit, 16)
         else:
-            per_shard = -(-snap.num_rows // max(snap.n_shards, 1)) if snap.num_rows else 1
-            cap = max(_pow2_at_least(max(per_shard // INITIAL_SELECTIVITY, 1)), 1024)
+            fb = self._page_feedback.get(fb_key)
+            if fb is not None:
+                # prior observation + 50% headroom, clamped to the shard
+                cap = _pow2_at_least(
+                    max(int(per_shard * min(fb * 1.5, 1.0)) + 1, 256))
+            else:
+                cap = max(_pow2_at_least(
+                    max(per_shard // INITIAL_SELECTIVITY, 1)), 1024)
 
         cols, counts = snap.device_cols(self.mesh)
+        self.last_page_iters = 0
         for _ in range(10):  # paging: grow until fits
+            self.last_page_iters += 1
             prog = get_sharded_program(root, self.mesh, row_capacity=cap)
             out = prog(cols, counts, aux_cols)
             if prog.has_extras:
@@ -276,6 +297,13 @@ class CopClient:
         else:
             raise RuntimeError("paging loop did not converge")
 
+        if not (is_topn or is_limit) and per_shard > 0:
+            frac = float(out_counts.max()) / per_shard
+            old = self._page_feedback.get(fb_key, frac)
+            self._page_feedback[fb_key] = 0.5 * old + 0.5 * frac
+            self._page_feedback.move_to_end(fb_key)
+            while len(self._page_feedback) > self._page_feedback_cap:
+                self._page_feedback.popitem(last=False)
         return self._assemble_rows(out_cols, out_counts, cap, out_dtypes,
                                    dictionaries)
 

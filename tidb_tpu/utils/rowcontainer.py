@@ -112,5 +112,94 @@ def external_sort_index(ranks, tmpdir: str, block_rows: int) -> np.ndarray:
     return out
 
 
+class SortedRun:
+    """One sorted run on disk: row columns + the sort-rank matrix, both in
+    sorted order, stored as raw .npy files so the merge can memory-map
+    them (sortexec/parallel_sort_spill_helper.go run analog).  Unlike
+    external_sort_index, the run carries the ROWS — the producer may drop
+    its input chunks after spilling (streaming sort)."""
+
+    def __init__(self, path: str, n: int, nk: int, dtypes, dicts):
+        self.path = path
+        self.n = n
+        self.nk = nk
+        self.dtypes = dtypes
+        self.dicts = dicts
+
+    @classmethod
+    def write(cls, tmpdir: str, tag: str, columns, ranks) -> "SortedRun":
+        order = np.lexsort(tuple(reversed(ranks)))
+        rd = os.path.join(tmpdir, tag)
+        os.makedirs(rd)
+        for i, c in enumerate(columns):
+            np.save(os.path.join(rd, f"d{i}.npy"), c.data[order])
+            np.save(os.path.join(rd, f"v{i}.npy"), c.validity[order])
+        for j, k in enumerate(ranks):
+            np.save(os.path.join(rd, f"k{j}.npy"), k[order])
+        return cls(rd, len(order), len(ranks),
+                   [c.dtype for c in columns],
+                   [c.dictionary for c in columns])
+
+    def open(self):
+        """(rank memmaps, [(data, validity) memmaps])."""
+        ks = [np.load(os.path.join(self.path, f"k{j}.npy"), mmap_mode="r")
+              for j in range(self.nk)]
+        cs = [(np.load(os.path.join(self.path, f"d{i}.npy"), mmap_mode="r"),
+               np.load(os.path.join(self.path, f"v{i}.npy"), mmap_mode="r"))
+              for i in range(len(self.dtypes))]
+        return ks, cs
+
+
+def merge_sorted_runs(runs, out_rows: int):
+    """Streaming k-way merge of SortedRuns: yields lists of Columns of up
+    to out_rows rows in globally sorted order.  Peak RAM is O(out_rows)
+    plus the OS page cache over the memmapped runs — the keep-order
+    streaming-merge seam (sortexec/multi_way_merge.go,
+    distsql SelectResult keep-order merge analog)."""
+    import heapq
+
+    from ..chunk.column import Column
+
+    if not runs:
+        return
+    opened = [r.open() for r in runs]
+    dtypes, dicts = runs[0].dtypes, runs[0].dicts
+    heap = [(tuple(k[0].item() for k in opened[r][0]), r)
+            for r in range(len(runs)) if runs[r].n]
+    heapq.heapify(heap)
+    pos = [0] * len(runs)
+    rid_buf: list[int] = []
+    pos_buf: list[int] = []
+
+    def gather():
+        rid = np.asarray(rid_buf, np.int64)
+        p = np.asarray(pos_buf, np.int64)
+        cols = []
+        for i, t in enumerate(dtypes):
+            out = np.empty(len(rid), opened[0][1][i][0].dtype)
+            val = np.empty(len(rid), bool)
+            for r in set(rid_buf):
+                m = rid == r
+                out[m] = opened[r][1][i][0][p[m]]
+                val[m] = opened[r][1][i][1][p[m]]
+            cols.append(Column(t, out, val, dicts[i]))
+        rid_buf.clear()
+        pos_buf.clear()
+        return cols
+
+    while heap:
+        _, r = heapq.heappop(heap)
+        rid_buf.append(r)
+        pos_buf.append(pos[r])
+        pos[r] += 1
+        if pos[r] < runs[r].n:
+            heapq.heappush(
+                heap, (tuple(k[pos[r]].item() for k in opened[r][0]), r))
+        if len(rid_buf) >= out_rows:
+            yield gather()
+    if rid_buf:
+        yield gather()
+
+
 def spill_dir() -> tempfile.TemporaryDirectory:
     return tempfile.TemporaryDirectory(prefix="tidb-tpu-spill-")
