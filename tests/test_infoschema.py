@@ -1,0 +1,99 @@
+"""information_schema / performance_schema memtable readers
+(pkg/infoschema/tables.go, pkg/executor/infoschema_reader.go analogs)."""
+
+from tidb_tpu.session import Domain, Session
+
+
+def make_session():
+    s = Session(Domain())
+    s.execute("create table t (a bigint not null, b varchar(10), "
+              "d decimal(10,2))")
+    s.execute("insert into t values (1,'x',1.50),(2,'y',2.25)")
+    s.execute("create index ib on t (b)")
+    return s
+
+
+def test_tables_and_schemata():
+    s = make_session()
+    rows = s.must_query(
+        "select table_schema, table_name, table_rows, engine "
+        "from information_schema.tables where table_schema = 'test'")
+    assert rows == [("test", "t", 2, "tpu-columnar")]
+    dbs = {r[1] for r in s.must_query(
+        "select catalog_name, schema_name from information_schema.schemata")}
+    assert {"test", "mysql"} <= dbs
+
+
+def test_columns_reader():
+    s = make_session()
+    rows = s.must_query(
+        "select column_name, data_type, is_nullable, numeric_scale "
+        "from information_schema.columns where table_name = 't' "
+        "order by ordinal_position")
+    assert rows == [("a", "bigint", "NO", None),
+                    ("b", "varchar", "YES", None),
+                    ("d", "decimal(10,2)", "YES", 2)]
+
+
+def test_statistics_and_tidb_indexes():
+    s = make_session()
+    rows = s.must_query(
+        "select index_name, column_name, non_unique from "
+        "information_schema.statistics where table_name = 't'")
+    assert ("ib", "b", 1) in rows
+    rows = s.must_query(
+        "select key_name, state from information_schema.tidb_indexes "
+        "where table_name = 't'")
+    assert ("ib", "public") in rows
+
+
+def test_processlist_and_variables():
+    s = make_session()
+    rows = s.must_query(
+        "select user, db from information_schema.processlist")
+    assert ("root", "test") in rows
+    rows = s.must_query(
+        "select variable_value from performance_schema.session_variables "
+        "where variable_name = 'tidb_distsql_scan_concurrency'")
+    assert rows == [("15",)]
+
+
+def test_statements_summary_queryable():
+    s = make_session()
+    s.must_query("select a from t")
+    rows = s.must_query(
+        "select exec_count from information_schema.statements_summary "
+        "where digest_text like '%select a from t%'")
+    assert rows and rows[0][0] >= 1
+    # performance_schema alias of the same memtable
+    rows2 = s.must_query(
+        "select count(*) from "
+        "performance_schema.events_statements_summary_by_digest")
+    assert rows2[0][0] >= 1
+
+
+def test_ddl_jobs_reader():
+    s = make_session()
+    rows = s.must_query(
+        "select table_name, job_type, state from "
+        "information_schema.ddl_jobs")
+    assert ("t", "add index", "done") in rows
+
+
+def test_joins_and_aggregates_over_memtables():
+    s = make_session()
+    # memtables compose with the full host operator tree
+    rows = s.must_query(
+        "select c.table_name, count(*) from information_schema.columns c "
+        "join information_schema.tables t on c.table_name = t.table_name "
+        "where t.table_schema = 'test' group by c.table_name")
+    assert rows == [("t", 3)]
+
+
+def test_show_tables_in_system_db():
+    s = make_session()
+    s.execute("use information_schema")
+    names = {r[0] for r in s.must_query("show tables")}
+    assert {"TABLES", "COLUMNS", "PROCESSLIST", "SLOW_QUERY"} <= names
+    dbs = {r[0] for r in s.must_query("show databases")}
+    assert {"information_schema", "performance_schema"} <= dbs

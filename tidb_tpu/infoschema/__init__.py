@@ -1,0 +1,242 @@
+"""information_schema / performance_schema memtable readers.
+
+Reference analog: pkg/infoschema/tables.go (virtual memtable definitions)
+and pkg/executor/infoschema_reader.go (the retrievers).  Tables here are
+SQL-queryable views over live engine state — catalog, sessions, statement
+summary, slow log, DDL jobs, stats, sysvars — produced on demand as host
+rows (they never touch the device path; selections/projections/joins over
+them run in the host root executors).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+from ..types import dtypes as dt
+
+S = dt.varchar()
+I = dt.bigint(True)
+F = dt.double(True)
+
+
+@dataclass(eq=False)
+class MemTableInfo:
+    """A virtual table: schema + row producer over the Domain.
+
+    Quacks like catalog.TableInfo for the planner (col_names/col_types/
+    indexes); executor/plan.py routes it to MemTableExec instead of a
+    CopTask (infoschema_reader.go retriever role)."""
+    name: str
+    col_names: list[str]
+    col_types: list
+    producer: Callable          # (domain) -> list[tuple]
+    indexes: list = field(default_factory=list)
+    is_memtable: bool = True
+    table_id: int = -1
+    domain: object = None        # bound by Catalog.get_table
+    _epoch: int = 0              # plan-cache fingerprint: rows are read at
+                                 # execute time, so plans never go stale
+
+    def snapshot(self):          # pragma: no cover - guarded by planner
+        raise TypeError(f"memtable {self.name} has no columnar snapshot")
+
+    @property
+    def num_rows(self) -> int:
+        return 0                 # planner cardinality: unknown/small
+
+
+def _schemata(dom):
+    return [("def", db, "utf8mb4", "utf8mb4_bin")
+            for db in sorted(dom.catalog.databases)]
+
+
+def _tables(dom):
+    rows = []
+    for db in sorted(dom.catalog.databases):
+        for t in sorted(dom.catalog.databases[db].values(),
+                        key=lambda x: x.name):
+            rows.append(("def", db, t.name, "BASE TABLE", "tpu-columnar",
+                         t.num_rows, t.table_id))
+    return rows
+
+
+def _type_name(t) -> str:
+    if t.kind == dt.TypeKind.DECIMAL:
+        return f"decimal({t.prec},{t.scale})"
+    return t.kind.value
+
+
+def _columns(dom):
+    rows = []
+    K = dt.TypeKind
+    for db in sorted(dom.catalog.databases):
+        for t in sorted(dom.catalog.databases[db].values(),
+                        key=lambda x: x.name):
+            for i, (cn, ct) in enumerate(zip(t.col_names, t.col_types)):
+                prec = ct.prec if ct.kind == K.DECIMAL else None
+                scale = ct.scale if ct.kind == K.DECIMAL else None
+                rows.append(("def", db, t.name, cn, i + 1,
+                             "YES" if ct.nullable else "NO",
+                             _type_name(ct), prec, scale))
+    return rows
+
+
+def _statistics(dom):
+    rows = []
+    for db in sorted(dom.catalog.databases):
+        for t in sorted(dom.catalog.databases[db].values(),
+                        key=lambda x: x.name):
+            for ix in getattr(t, "indexes", []):
+                for seq, col in enumerate(ix.columns):
+                    rows.append(("def", db, t.name,
+                                 0 if ix.unique else 1, ix.name,
+                                 seq + 1, col))
+    return rows
+
+
+def _tidb_indexes(dom):
+    rows = []
+    for db in sorted(dom.catalog.databases):
+        for t in sorted(dom.catalog.databases[db].values(),
+                        key=lambda x: x.name):
+            for ix in getattr(t, "indexes", []):
+                for seq, col in enumerate(ix.columns):
+                    rows.append((db, t.name, ix.name, col, seq + 1,
+                                 0 if ix.unique else 1, ix.index_id,
+                                 ix.state))
+    return rows
+
+
+def _processlist(dom):
+    return [(sid, sess.user, "127.0.0.1", sess.db,
+             "Query", 0,
+             "autocommit" if sess.txn is None else "in transaction", "")
+            for sid, sess in dom.sessions()]
+
+
+def _slow_query(dom):
+    return [(sql, ms / 1000.0, rows)
+            for sql, ms, rows in dom.stmt_summary.slow_rows()]
+
+
+def _stmt_summary(dom):
+    return dom.stmt_summary.summary_rows()
+
+
+def _ddl_jobs(dom):
+    if dom._ddl is None:
+        return []
+    return [(j.job_id, j.db, j.table, j.job_type, j.schema_state, j.state,
+             j.rows_backfilled, j.error)
+            for j in dom.ddl.storage.all_jobs()]
+
+
+def _session_variables(dom):
+    return sorted((k, str(v)) for k, v in dom.sysvars.items())
+
+
+def _stats_meta(dom):
+    rows = []
+    for db in sorted(dom.catalog.databases):
+        for t in sorted(dom.catalog.databases[db].values(),
+                        key=lambda x: x.name):
+            st = dom.stats.get(t)
+            if st is None:
+                continue
+            rows.append((db, t.name, st.version, st.count, st.modify_count))
+    return rows
+
+
+def _cluster_info(dom):
+    import jax
+    try:
+        devs = jax.devices()
+        plat = devs[0].platform
+        n = len(devs)
+    except Exception:        # backend not initialized: report unknown
+        plat, n = "unknown", 0
+    return [("tidb-tpu", "127.0.0.1:4000", "0.2.0", plat, n)]
+
+
+_INFORMATION_SCHEMA = {
+    "SCHEMATA": ([("CATALOG_NAME", S), ("SCHEMA_NAME", S),
+                  ("DEFAULT_CHARACTER_SET_NAME", S),
+                  ("DEFAULT_COLLATION_NAME", S)], _schemata),
+    "TABLES": ([("TABLE_CATALOG", S), ("TABLE_SCHEMA", S),
+                ("TABLE_NAME", S), ("TABLE_TYPE", S), ("ENGINE", S),
+                ("TABLE_ROWS", I), ("TIDB_TABLE_ID", I)], _tables),
+    "COLUMNS": ([("TABLE_CATALOG", S), ("TABLE_SCHEMA", S),
+                 ("TABLE_NAME", S), ("COLUMN_NAME", S),
+                 ("ORDINAL_POSITION", I), ("IS_NULLABLE", S),
+                 ("DATA_TYPE", S), ("NUMERIC_PRECISION", I),
+                 ("NUMERIC_SCALE", I)], _columns),
+    "STATISTICS": ([("TABLE_CATALOG", S), ("TABLE_SCHEMA", S),
+                    ("TABLE_NAME", S), ("NON_UNIQUE", I),
+                    ("INDEX_NAME", S), ("SEQ_IN_INDEX", I),
+                    ("COLUMN_NAME", S)], _statistics),
+    "TIDB_INDEXES": ([("TABLE_SCHEMA", S), ("TABLE_NAME", S),
+                      ("KEY_NAME", S), ("COLUMN_NAME", S),
+                      ("SEQ_IN_INDEX", I), ("NON_UNIQUE", I),
+                      ("INDEX_ID", I), ("STATE", S)], _tidb_indexes),
+    "PROCESSLIST": ([("ID", I), ("USER", S), ("HOST", S), ("DB", S),
+                     ("COMMAND", S), ("TIME", I), ("STATE", S),
+                     ("INFO", S)], _processlist),
+    "SLOW_QUERY": ([("QUERY", S), ("QUERY_TIME", F),
+                    ("ROWS_SENT", I)], _slow_query),
+    "STATEMENTS_SUMMARY": ([("DIGEST_TEXT", S), ("EXEC_COUNT", I),
+                            ("AVG_LATENCY_MS", F), ("MAX_LATENCY_MS", F),
+                            ("SUM_ROWS", I), ("QUERY_SAMPLE_TEXT", S)],
+                           _stmt_summary),
+    "DDL_JOBS": ([("JOB_ID", I), ("DB_NAME", S), ("TABLE_NAME", S),
+                  ("JOB_TYPE", S), ("SCHEMA_STATE", S), ("STATE", S),
+                  ("ROW_COUNT", I), ("ERROR", S)], _ddl_jobs),
+    "SESSION_VARIABLES": ([("VARIABLE_NAME", S), ("VARIABLE_VALUE", S)],
+                          _session_variables),
+    "TIDB_STATS_META": ([("DB_NAME", S), ("TABLE_NAME", S),
+                         ("VERSION", I), ("ROW_COUNT", I),
+                         ("MODIFY_COUNT", I)], _stats_meta),
+    "CLUSTER_INFO": ([("TYPE", S), ("INSTANCE", S), ("VERSION", S),
+                      ("DEVICE_PLATFORM", S), ("DEVICE_COUNT", I)],
+                     _cluster_info),
+}
+
+_PERFORMANCE_SCHEMA = {
+    "EVENTS_STATEMENTS_SUMMARY_BY_DIGEST":
+        _INFORMATION_SCHEMA["STATEMENTS_SUMMARY"],
+    "SESSION_VARIABLES": _INFORMATION_SCHEMA["SESSION_VARIABLES"],
+    "PROCESSLIST": _INFORMATION_SCHEMA["PROCESSLIST"],
+}
+
+_REGISTRY = {"information_schema": _INFORMATION_SCHEMA,
+             "performance_schema": _PERFORMANCE_SCHEMA}
+
+
+def is_system_db(db: str) -> bool:
+    return db.lower() in _REGISTRY
+
+
+def system_databases() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+def system_tables(db: str) -> list[str]:
+    return sorted(_REGISTRY.get(db.lower(), {}))
+
+
+def get_memtable(db: str, name: str) -> MemTableInfo:
+    tables = _REGISTRY.get(db.lower())
+    if tables is None:
+        raise KeyError(db)
+    spec = tables.get(name.upper())
+    if spec is None:
+        from ..session.catalog import CatalogError
+        raise CatalogError(f"table {db}.{name} doesn't exist")
+    cols, producer = spec
+    return MemTableInfo(name.upper(), [c for c, _ in cols],
+                        [t for _, t in cols], producer)
+
+
+__all__ = ["MemTableInfo", "is_system_db", "system_databases",
+           "system_tables", "get_memtable"]

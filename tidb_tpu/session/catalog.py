@@ -493,13 +493,20 @@ def plainify(v):
 
 
 class Catalog:
-    """In-memory catalog of databases/tables (infoschema analog)."""
+    """In-memory catalog of databases/tables (infoschema analog).
+
+    information_schema / performance_schema resolve to virtual memtables
+    (infoschema/__init__.py) bound to the owning Domain."""
 
     def __init__(self):
         self.databases: dict[str, dict[str, TableInfo]] = {"test": {},
                                                            "mysql": {}}
+        self.domain = None       # set by Domain.__init__ (memtable binding)
 
     def create_database(self, name: str, if_not_exists=False):
+        from ..infoschema import is_system_db
+        if is_system_db(name):
+            raise CatalogError(f"database {name!r} is a system database")
         if name in self.databases:
             if if_not_exists:
                 return
@@ -530,12 +537,20 @@ class Catalog:
         del d[name]
 
     def get_table(self, db: str, name: str) -> TableInfo:
+        from ..infoschema import get_memtable, is_system_db
+        if is_system_db(db):
+            mt = get_memtable(db, name)
+            mt.domain = self.domain
+            return mt
         d = self._db(db)
         if name not in d:
             raise CatalogError(f"table {db}.{name} doesn't exist")
         return d[name]
 
     def _db(self, db: str) -> dict:
+        from ..infoschema import is_system_db
+        if is_system_db(db):
+            raise CatalogError(f"database {db!r} is a system database")
         if db not in self.databases:
             raise CatalogError(f"unknown database {db!r}")
         return self.databases[db]

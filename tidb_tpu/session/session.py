@@ -52,6 +52,7 @@ class Domain:
         from ..stats.handle import StatsHandle
         from ..store.kv import KVStore
         self.catalog = Catalog()
+        self.catalog.domain = self          # memtable binding (infoschema)
         self.mesh = mesh if mesh is not None else get_mesh()
         self.client = CopClient(self.mesh)
         if data_dir is not None:
@@ -249,7 +250,9 @@ class Session:
             self.domain.catalog.drop_database(stmt.name, stmt.if_exists)
             return ResultSet()
         if isinstance(stmt, A.UseDatabase):
-            if stmt.name not in self.domain.catalog.databases:
+            from ..infoschema import is_system_db
+            if stmt.name not in self.domain.catalog.databases \
+                    and not is_system_db(stmt.name):
                 raise CatalogError(f"unknown database {stmt.name!r}")
             self.db = stmt.name
             return ResultSet()
@@ -279,7 +282,10 @@ class Session:
             return self._exec_show(stmt)
         if isinstance(stmt, A.SetStmt):
             for name, val in stmt.assignments:
-                v = val.value if isinstance(val, A.Lit) else None
+                # full expression eval: SET x = -1 / DEFAULT / 2*1024 all
+                # work (reference: variable assignment evals an expression)
+                v = (val.value if isinstance(val, A.Lit)
+                     else self._eval_scalar(val))
                 (self.domain.sysvars if stmt.scope == "global"
                  else self.vars)[name.lower()] = v
             for name, val in stmt.user_vars:
@@ -995,11 +1001,18 @@ class Session:
     def _exec_show(self, stmt: A.ShowStmt) -> ResultSet:
         cat = self.domain.catalog
         if stmt.kind == "tables":
+            from ..infoschema import is_system_db, system_tables
+            if is_system_db(self.db):
+                names = system_tables(self.db)
+            else:
+                names = sorted(cat.databases[self.db])
             return ResultSet([f"Tables_in_{self.db}"],
-                             [(n,) for n in sorted(cat.databases[self.db])])
+                             [(n,) for n in names])
         if stmt.kind == "databases":
+            from ..infoschema import system_databases
             return ResultSet(["Database"],
-                             [(n,) for n in sorted(cat.databases)])
+                             [(n,) for n in sorted(list(cat.databases)
+                                                   + system_databases())])
         if stmt.kind == "columns":
             t = cat.get_table(self.db, stmt.target)
             return ResultSet(["Field", "Type", "Null"],
