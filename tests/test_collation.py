@@ -1,0 +1,134 @@
+"""Collation-aware compare (pkg/util/collate analog): ci collations become
+ONE host pass over the dictionary producing rank LUTs; device/host compares
+stay integer compares."""
+
+import pytest
+
+from tidb_tpu.session import Domain, Session
+from tidb_tpu.utils.collate import RankTable, sortkey
+from tidb_tpu.chunk.column import StringDict
+
+
+@pytest.fixture()
+def sess():
+    s = Session(Domain())
+    s.execute("create table t (name varchar(20) collate utf8mb4_general_ci, "
+              "v bigint)")
+    s.execute("insert into t values ('Apple',1),('apple',2),('BANANA',3),"
+              "('banana ',4),('Cherry',5),(NULL,6)")
+    return s
+
+
+def test_sortkey_semantics():
+    assert sortkey("Apple", "utf8mb4_general_ci") == "apple"
+    assert sortkey("banana ", "utf8mb4_general_ci") == "banana"  # PAD SPACE
+    assert sortkey("Apple", "utf8mb4_bin") == "Apple"
+    assert sortkey("Ápple", "utf8mb4_unicode_ci") == "apple"  # accents
+
+
+def test_rank_table_equal_keys_share_rank():
+    d = StringDict(["Apple", "apple", "Banana"])
+    rt = RankTable(d, "utf8mb4_general_ci")
+    codes = {v: rt.ranks[d.code_of(v)] for v in d.values}
+    assert codes["Apple"] == codes["apple"] != codes["Banana"]
+    assert rt.rank_of("APPLE") == codes["Apple"]
+    assert rt.rank_of("zzz") == -1
+
+
+def test_ci_equality_and_range(sess):
+    assert sess.must_query(
+        "select v from t where name = 'APPLE' order by v") == [(1,), (2,)]
+    assert sess.must_query(
+        "select v from t where name <> 'apple' order by v") == \
+        [(3,), (4,), (5,)]
+    assert sess.must_query(
+        "select v from t where name < 'b' order by v") == [(1,), (2,)]
+    assert sess.must_query(
+        "select v from t where name >= 'BANANA' order by v") == \
+        [(3,), (4,), (5,)]
+
+
+def test_ci_like_and_in(sess):
+    assert sess.must_query(
+        "select v from t where name like 'ban%' order by v") == [(3,), (4,)]
+    assert sess.must_query(
+        "select v from t where name in ('APPLE', 'CHERRY') order by v") == \
+        [(1,), (2,), (5,)]
+
+
+def test_ci_order_by(sess):
+    got = [r[0] for r in sess.must_query(
+        "select name from t where name is not null order by name, v")]
+    assert got == ["Apple", "apple", "BANANA", "banana ", "Cherry"]
+
+
+def test_ci_group_by_and_minmax(sess):
+    counts = sorted(r[0] for r in sess.must_query(
+        "select count(*) from t where name is not null group by name"))
+    assert counts == [1, 2, 2]
+    assert sess.must_query("select min(name), max(name) from t") == \
+        [("Apple", "Cherry")]
+
+
+def test_ci_join(sess):
+    sess.execute("create table u (name varchar(20), w bigint)")
+    sess.execute("insert into u values ('APPLE', 10), ('CHERRY', 30)")
+    got = sess.must_query(
+        "select t.v, u.w from t join u on t.name = u.name order by t.v")
+    assert got == [(1, 10), (2, 10), (5, 30)]
+
+
+def test_ci_join_exact_and_case_variant(sess):
+    """Build value matches one probe value exactly and another by case:
+    both must join (the device broadcast path is gated off for ci keys)."""
+    sess.execute("create table u2 (name varchar(20), w bigint)")
+    sess.execute("insert into u2 values ('Apple', 10)")
+    got = sess.must_query(
+        "select t.v, u2.w from t join u2 on t.name = u2.name order by t.v")
+    assert got == [(1, 10), (2, 10)]
+
+
+def test_ci_minmax_empty_input(sess):
+    assert sess.must_query(
+        "select min(name), max(name) from t where v > 100") == \
+        [(None, None)]
+
+
+def test_ci_count_distinct(sess):
+    assert sess.must_query(
+        "select count(distinct name) from t") == [(3,)]
+    got = sess.must_query(
+        "select group_concat(distinct name) from t where name like 'a%'")
+    assert got == [("Apple",)]
+
+
+def test_ci_like_no_pad_space(sess):
+    # LIKE never pads: 'BANANA' matches case-insensitively but 'banana '
+    # (trailing space) must NOT match the exact pattern
+    assert sess.must_query(
+        "select v from t where name like 'banana'") == [(3,)]
+    assert sess.must_query(
+        "select v from t where name like 'banana_'") == [(4,)]
+
+
+def test_stddev_distinct_rejected(sess):
+    import pytest as _pytest
+
+    from tidb_tpu.planner.build import PlanError
+    with _pytest.raises(PlanError):
+        sess.must_query("select stddev(distinct v) from t")
+
+
+def test_bin_collation_unchanged(sess):
+    sess.execute("create table b (name varchar(20), v bigint)")
+    sess.execute("insert into b values ('Apple',1),('apple',2)")
+    assert sess.must_query("select v from b where name = 'apple'") == [(2,)]
+    got = [r[0] for r in sess.must_query(
+        "select name from b order by name")]
+    assert got == ["Apple", "apple"]     # bin: 'A' < 'a'
+
+
+def test_ci_pushes_to_device(sess):
+    plan = "\n".join(r[0] for r in sess.must_query(
+        "explain select count(*) from t where name = 'apple'"))
+    assert "CopTask[agg]" in plan, plan

@@ -29,11 +29,12 @@ class StringDict:
     sortkeys are materialized once at encode time, device compares ints.
     """
 
-    __slots__ = ("values", "_index")
+    __slots__ = ("values", "_index", "_rank_cache")
 
     def __init__(self, values: Sequence[str] = ()):
         self.values: list[str] = sorted(set(values))
         self._index = {v: i for i, v in enumerate(self.values)}
+        self._rank_cache: dict = {}   # collation -> collate.RankTable
 
     def __len__(self) -> int:
         return len(self.values)

@@ -30,7 +30,15 @@ class AggFunc(enum.Enum):
     MAX = "max"
     FIRST = "first"          # group key passthrough
     # AVG never reaches the coprocessor: the planner splits it into
-    # SUM + COUNT exactly like the reference (SURVEY.md §A.4).
+    # SUM + COUNT exactly like the reference (SURVEY.md §A.4).  The
+    # variance/stddev family is likewise rewritten to SUM/SUM(x^2)/COUNT.
+    # Host-side aggregates (aggfuncs breadth; _bind_agg keeps them off the
+    # device program):
+    BIT_AND = "bit_and"
+    BIT_OR = "bit_or"
+    BIT_XOR = "bit_xor"
+    GROUP_CONCAT = "group_concat"
+    ANY_VALUE = "any_value"
 
 
 @dataclass(frozen=True)

@@ -551,11 +551,32 @@ class HostLimit(PhysOp):
                 return
 
 
+def _ci_ranks(c: Column) -> Optional[np.ndarray]:
+    """Collation rank array for a ci string column, else None."""
+    from ..utils.collate import is_binary, rank_table
+    if (c.dtype.is_string and c.dictionary is not None
+            and not is_binary(c.dtype.collation)):
+        lut = rank_table(c.dictionary, c.dtype.collation).ranks
+        return lut[np.clip(c.data, 0, len(lut) - 1)].astype(np.int64)
+    return None
+
+
 def _sort_keys_matrix(chunk: ResultChunk, keys) -> list[np.ndarray]:
     """Per key: (null_rank, value_rank) arrays for lexsort; MySQL NULLs
-    sort first ASC / last DESC."""
+    sort first ASC / last DESC.  Ci-collated string columns sort by
+    collation rank, not raw code."""
     out = []
     for e, desc in keys:
+        if isinstance(e, ColumnRef) and e.index < len(chunk.columns):
+            ci = _ci_ranks(chunk.columns[e.index])
+            if ci is not None:
+                rank = np.where(chunk.columns[e.index].validity, ci,
+                                np.iinfo(np.int64).min)
+                if desc:
+                    rank = np.where(chunk.columns[e.index].validity, -ci,
+                                    np.iinfo(np.int64).max)
+                out.append(rank)
+                continue
         v, m = eval_expr(np, e, chunk.col_pairs())
         v = np.broadcast_to(np.asarray(v), (chunk.num_rows,))
         if v.dtype == bool:
@@ -997,15 +1018,18 @@ def _join_key_arrays(a: Column, b: Column):
     remapped into a merged code space; NULL keys get a sentinel that never
     matches (inner-join semantics for NULL = NULL)."""
     av, bv = a.data.astype(np.int64, copy=True), b.data.astype(np.int64, copy=True)
-    if a.dtype.is_string and b.dtype.is_string and a.dictionary is not b.dictionary:
-        # None dictionaries arise from empty streamed results — no values
-        avals = a.dictionary.values if a.dictionary is not None else []
-        bvals = b.dictionary.values if b.dictionary is not None else []
-        merged = {v: i for i, v in enumerate(sorted(set(avals) | set(bvals)))}
-        am = np.array([merged[v] for v in avals] or [0])
-        bm = np.array([merged[v] for v in bvals] or [0])
-        av = am[np.clip(a.data, 0, len(am) - 1)]
-        bv = bm[np.clip(b.data, 0, len(bm) - 1)]
+    if a.dtype.is_string and b.dtype.is_string:
+        from ..utils.collate import is_binary, merged_rank_maps
+        coll = next((t.collation for t in (a.dtype, b.dtype)
+                     if not is_binary(t.collation)), "binary")
+        if a.dictionary is not b.dictionary or coll != "binary":
+            # None dictionaries arise from empty streamed results
+            from ..chunk.column import StringDict
+            da = a.dictionary if a.dictionary is not None else StringDict()
+            db = b.dictionary if b.dictionary is not None else StringDict()
+            am, bm = merged_rank_maps(da, db, coll)
+            av = am[np.clip(a.data, 0, max(len(am) - 1, 0))].astype(np.int64)
+            bv = bm[np.clip(b.data, 0, max(len(bm) - 1, 0))].astype(np.int64)
     if a.dtype.kind == K.DECIMAL or b.dtype.kind == K.DECIMAL:
         sa = a.dtype.scale if a.dtype.kind == K.DECIMAL else 0
         sb = b.dtype.scale if b.dtype.kind == K.DECIMAL else 0
@@ -1087,9 +1111,24 @@ class HostAgg(PhysOp):
     # -- streaming partial/final split (agg_hash_executor.go partial and
     # -- final worker roles, collapsed into one chunk loop) ------------- #
 
+    _STREAMABLE = (D.AggFunc.COUNT, D.AggFunc.SUM, D.AggFunc.MIN,
+                   D.AggFunc.MAX, D.AggFunc.BIT_AND, D.AggFunc.BIT_OR,
+                   D.AggFunc.BIT_XOR)
+
+    def _must_materialize(self, a) -> bool:
+        if a.distinct or a.func not in self._STREAMABLE:
+            return True
+        if a.func in (D.AggFunc.MIN, D.AggFunc.MAX) \
+                and a.arg is not None and a.arg.dtype.is_string:
+            from ..utils.collate import is_binary
+            return not is_binary(a.arg.dtype.collation)  # rank != code order
+        return False
+
     def chunks(self, ctx, required_rows=None):
-        if any(a.distinct for a in self.aggs):
-            # DISTINCT partial states are value SETS, not fixed-width rows:
+        if any(self._must_materialize(a)
+               for a in self.aggs):
+            # DISTINCT partial states are value SETS (and GROUP_CONCAT /
+            # ANY_VALUE carry row order), not fixed-width mergeable rows:
             # materialize (the hash-partition spill path bounds memory)
             yield from _slice_stream(self._execute_full(ctx))
             return
@@ -1138,6 +1177,12 @@ class HostAgg(PhysOp):
             return ("min", "cnt")
         if a.func == D.AggFunc.MAX:
             return ("max", "cnt")
+        if a.func == D.AggFunc.BIT_AND:
+            return ("band",)
+        if a.func == D.AggFunc.BIT_OR:
+            return ("bor",)
+        if a.func == D.AggFunc.BIT_XOR:
+            return ("bxor",)
         raise NotImplementedError(a.func)
 
     def _partial_chunk(self, ch: ResultChunk) -> ResultChunk:
@@ -1188,6 +1233,10 @@ class HostAgg(PhysOp):
                 # invalid rows keep the ±inf init so merges stay neutral
                 pcols.append(Column(c.dtype, out, cnt > 0, c.dictionary))
                 pcols.append(cnt_col)
+            elif a.func in (D.AggFunc.BIT_AND, D.AggFunc.BIT_OR,
+                            D.AggFunc.BIT_XOR):
+                pcols.append(_bit_agg(a.func, a.out_dtype, g,
+                                      inverse[valid], c.data[valid]))
             else:
                 raise NotImplementedError(a.func)
         return ResultChunk(self._partial_names(), key_cols + pcols)
@@ -1227,10 +1276,12 @@ class HostAgg(PhysOp):
                     out = np.zeros(g, object)
                     np.add.at(out, inverse, c.data.astype(object))
                     out_p.append(Column(c.dtype, out, np.ones(g, bool)))
+                elif tag in ("band", "bor", "bxor"):
+                    out_p.append(_bit_agg(a.func, c.dtype, g, inverse,
+                                          c.data))
                 else:   # min / max — neutral-init data merges directly
                     isf = c.data.dtype.kind == "f"
-                    a_ = a
-                    init = self._mm_init(a_, isf)
+                    init = self._mm_init(a, isf)
                     out = np.full(g, init, c.data.dtype)
                     op = (np.minimum if a.func == D.AggFunc.MIN
                           else np.maximum)
@@ -1261,6 +1312,11 @@ class HostAgg(PhysOp):
                         cnt > 0))
                 else:
                     out_cols.append(_sum_col(a, s.data, cnt))
+            elif a.func in (D.AggFunc.BIT_AND, D.AggFunc.BIT_OR,
+                            D.AggFunc.BIT_XOR):
+                out_cols.append(Column(a.out_dtype,
+                                       pcols[i].data.astype(np.uint64),
+                                       np.ones(g, bool)))
             else:   # MIN / MAX
                 v, cnt = pcols[i], pcols[i + 1].data
                 data = np.where(cnt > 0, v.data, 0)
@@ -1340,8 +1396,12 @@ class HostAgg(PhysOp):
             return Column(a.out_dtype, cnt, np.ones(g, bool))
         c = _eval_to_column(a.arg, chunk)
         valid = c.validity
-        if a.distinct:
-            pack = np.stack([inverse[valid], c.data[valid].astype(np.int64)],
+        if a.distinct and a.func != D.AggFunc.GROUP_CONCAT:
+            vals64 = c.data[valid].astype(np.int64)
+            ci = _ci_ranks(c) if n else None
+            if ci is not None:
+                vals64 = ci[valid]      # ci: case variants are one value
+            pack = np.stack([inverse[valid], vals64],
                             axis=1)
             uniq = np.unique(pack, axis=0)
             if a.func == D.AggFunc.COUNT:
@@ -1367,6 +1427,20 @@ class HostAgg(PhysOp):
             np.add.at(out, inverse[valid], c.data[valid].astype(object))
             return _sum_col(a, out, cnt)
         if a.func in (D.AggFunc.MIN, D.AggFunc.MAX):
+            ci = _ci_ranks(c) if n else None
+            if ci is not None:
+                # ci collation: extremum by RANK, output the original value
+                # (argmin via rank*n+row packing)
+                m = max(n, 1)
+                r = ci if a.func == D.AggFunc.MIN else (ci.max() - ci)
+                key = r * m + np.arange(n, dtype=np.int64)
+                best = np.full(g, np.iinfo(np.int64).max, np.int64)
+                np.minimum.at(best, inverse[valid], key[valid])
+                rows = np.where(cnt > 0, best % m, 0)
+                col = c.take(rows)
+                col.validity = cnt > 0
+                col.dtype = a.out_dtype
+                return col
             isf = a.arg.dtype.is_float
             ninf = -np.inf if isf else np.iinfo(np.int64).min
             pinf = np.inf if isf else np.iinfo(np.int64).max
@@ -1378,7 +1452,65 @@ class HostAgg(PhysOp):
                          np.where(cnt > 0, out, 0).astype(a.out_dtype.np_dtype()),
                          cnt > 0, c.dictionary)
             return col
+        if a.func in (D.AggFunc.BIT_AND, D.AggFunc.BIT_OR,
+                      D.AggFunc.BIT_XOR):
+            return _bit_agg(a.func, a.out_dtype, g, inverse[valid],
+                            c.data[valid])
+        if a.func == D.AggFunc.ANY_VALUE:
+            # first non-NULL value per group; NULL when the group has none
+            if n == 0:
+                return Column(a.out_dtype, np.zeros(g, c.data.dtype),
+                              np.zeros(g, bool), c.dictionary)
+            has = np.zeros(g, bool)
+            has[inverse[valid]] = True
+            first_valid = np.full(g, n, np.int64)
+            np.minimum.at(first_valid, inverse[valid],
+                          np.arange(n)[valid])
+            out = c.take(np.where(has, first_valid, 0))
+            out.validity = has
+            out.dtype = a.out_dtype
+            return out
+        if a.func == D.AggFunc.GROUP_CONCAT:
+            # MySQL semantics: comma separator, NULLs skipped, NULL result
+            # for all-NULL groups; DISTINCT dedupes keeping first occurrence
+            vals = c.to_python()
+            from ..utils.collate import is_binary, sortkey
+            coll = c.dtype.collation if c.dtype.is_string else "binary"
+            parts: list[list[str]] = [[] for _ in range(g)]
+            seen: list[set] = [set() for _ in range(g)] if a.distinct else []
+            for row in range(n):
+                if not valid[row]:
+                    continue
+                sv = _gc_str(vals[row])
+                gi = int(inverse[row])
+                if a.distinct:
+                    key = sv if is_binary(coll) else sortkey(sv, coll)
+                    if key in seen[gi]:
+                        continue
+                    seen[gi].add(key)
+                parts[gi].append(sv)
+            strs = [",".join(p) if p else None for p in parts]
+            return Column.from_values(a.out_dtype, strs)
         raise NotImplementedError(a.func)
+
+
+def _gc_str(v) -> str:
+    """GROUP_CONCAT value rendering (ints/decimals/strings/dates)."""
+    return str(v)
+
+
+def _bit_agg(func, out_dtype, g: int, inverse: np.ndarray,
+             data: np.ndarray) -> Column:
+    """BIT_AND/OR/XOR partial over uint64 bit patterns (aggfuncs
+    bit_and.go family); neutral inits make partials directly mergeable."""
+    op, neutral = {
+        D.AggFunc.BIT_AND: (np.bitwise_and, np.uint64(0xFFFFFFFFFFFFFFFF)),
+        D.AggFunc.BIT_OR: (np.bitwise_or, np.uint64(0)),
+        D.AggFunc.BIT_XOR: (np.bitwise_xor, np.uint64(0)),
+    }[func]
+    out = np.full(g, neutral, np.uint64)
+    op.at(out, inverse, data.astype(np.int64).astype(np.uint64))
+    return Column(out_dtype, out, np.ones(g, bool))
 
 
 def _group_ids(gcols: list[Column], n: int):
@@ -1386,7 +1518,10 @@ def _group_ids(gcols: list[Column], n: int):
     NULL-distinct packed int64 grouping (HashAgg's group-key encoding)."""
     mats = []
     for c in gcols:
-        if c.data.dtype.kind == "f":
+        ci = _ci_ranks(c)
+        if ci is not None:
+            key = ci                 # ci collation: group by rank
+        elif c.data.dtype.kind == "f":
             # exact float grouping: bit pattern, with -0.0 folded into 0.0
             d = np.asarray(c.data, np.float64)
             key = np.where(d == 0.0, 0.0, d).view(np.int64)

@@ -185,11 +185,21 @@ def _try_cop(p: LogicalPlan, no_device_join: bool = False) -> Optional[PhysOp]:
         for i, d in agg_dicts.items():   # MIN/MAX over dict-encoded strings
             out_dicts[len(key_meta) + i] = d
     elif isinstance(top, LogicalTopN):
+        from ..utils.collate import RankTable, is_binary
         keys = []
         for key, desc in top.keys:
             key = lower_strings(key, cur_dicts)
             if not _device_supported(key):
                 return None
+            if key.dtype.is_string and not is_binary(key.dtype.collation):
+                # ci collation: sort by rank LUT, not raw code
+                d = (cur_dicts.get(key.index)
+                     if isinstance(key, ColumnRef) else None)
+                if d is None:
+                    return None
+                from ..expr import builders as B
+                key = B.dict_map(
+                    key, RankTable(d, key.dtype.collation).ranks)
             keys.append((key, desc))
         if not keys:
             return None
@@ -227,6 +237,13 @@ def _try_cop_join(p: LogicalPlan, top, mids, join: LogicalJoin) -> Optional[Phys
             or len(join.eq_keys) != 1:
         return None
     li, ri = join.eq_keys[0]
+    from ..utils.collate import is_binary
+    for side, k in ((join.left, li), (join.right, ri)):
+        kt = side.schema.cols[k].dtype
+        if kt.is_string and not is_binary(kt.collation):
+            # ci join keys: code/rank remap differs per side; the host hash
+            # join compares through merged collation ranks
+            return None
 
     # build side must be a chain over a DataSource; small enough to
     # broadcast, else the cross-device repartition join takes it
@@ -507,8 +524,16 @@ def _bind_agg(agg: LogicalAggregate, child: D.CopNode, dicts,
     None if it must stay on host (generic keys / distinct)."""
     if any(a.distinct for a in agg.aggs):
         return None
+    from ..utils.collate import is_binary
+    if any(g.dtype.is_string and not is_binary(g.dtype.collation)
+           for g in agg.group_exprs):
+        return None      # ci group keys: host groups by collation rank
     descs = []
     for i, a in enumerate(agg.aggs):
+        if (a.arg is not None and a.arg.dtype.is_string
+                and not is_binary(a.arg.dtype.collation)
+                and a.func in (D.AggFunc.MIN, D.AggFunc.MAX)):
+            return None  # ci MIN/MAX: rank order != code order
         arg = lower_strings(a.arg, dicts) if a.arg is not None else None
         if arg is not None and not _device_supported(arg):
             return None

@@ -76,6 +76,41 @@ def _const_str(e: Expr) -> Optional[str]:
 _CMP_SWAP = {"eq": "eq", "ne": "ne", "lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}
 
 
+def _eff_collation(*exprs: Optional[Expr]) -> str:
+    """Effective collation of a comparison (simplified coercibility: any
+    non-binary column collation wins; literals are coercible)."""
+    from ..utils.collate import is_binary
+    for x in exprs:
+        if x is not None and x.dtype.is_string \
+                and not is_binary(x.dtype.collation):
+            return x.dtype.collation
+    return "binary"
+
+
+def _lower_cmp_ci(dtype: dt.DataType, op: str, col: Expr, s: str,
+                  d: StringDict, collation: str) -> Expr:
+    """Collation-aware column-vs-literal compare: codes remap through the
+    collation rank LUT (util/collate Compare/Key collapsed into one
+    dictionary pass)."""
+    from ..utils.collate import RankTable
+    rt = RankTable(d, collation)
+    ic = lambda v: Const(dt.bigint(False), int(v))
+    if op in ("eq", "ne"):
+        r = rt.rank_of(s)
+        lut = rt.ranks == r          # r == -1 matches nothing
+        if op == "ne":
+            lut = ~lut
+        return B.dict_lut(col, _pad_lut(lut), nullable=dtype.nullable)
+    ranks = B.dict_map(col, rt.ranks)
+    if op == "lt":
+        return Func(dtype, "lt", (ranks, ic(rt.lower_bound(s))))
+    if op == "le":
+        return Func(dtype, "lt", (ranks, ic(rt.upper_bound(s))))
+    if op == "gt":
+        return Func(dtype, "ge", (ranks, ic(rt.upper_bound(s))))
+    return Func(dtype, "ge", (ranks, ic(rt.lower_bound(s))))
+
+
 def lower_strings(e: Expr, dicts: dict[int, StringDict]) -> Expr:
     """Rewrite string predicates AND string functions to code-space ops.
 
@@ -103,17 +138,16 @@ def lower_strings(e: Expr, dicts: dict[int, StringDict]) -> Expr:
         return e
 
     if e.op in B.COMPARE_OPS and len(args) == 2:
+        coll = _eff_collation(args[0], args[1])
         # column-vs-column string compare: if the two sides use different
-        # dictionaries, remap both into a merged sorted code space first
-        # (codes are only comparable within one dictionary).
+        # dictionaries (or a non-binary collation), remap both into a
+        # merged code/rank space first (codes are only comparable within
+        # one dictionary and one collation).
         da, db = _dict_for(args[0], dicts), _dict_for(args[1], dicts)
-        if da is not None and db is not None and da is not db:
-            merged = sorted(set(da.values) | set(db.values))
-            idx = {v: i for i, v in enumerate(merged)}
-            map_a = np.fromiter((idx[v] for v in da.values), dtype=np.int32,
-                                count=len(da)) if len(da) else np.zeros(1, np.int32)
-            map_b = np.fromiter((idx[v] for v in db.values), dtype=np.int32,
-                                count=len(db)) if len(db) else np.zeros(1, np.int32)
+        if da is not None and db is not None \
+                and (da is not db or coll != "binary"):
+            from ..utils.collate import merged_rank_maps
+            map_a, map_b = merged_rank_maps(da, db, coll)
             return Func(e.dtype, e.op,
                         (B.dict_map(args[0], map_a), B.dict_map(args[1], map_b)))
 
@@ -126,15 +160,28 @@ def lower_strings(e: Expr, dicts: dict[int, StringDict]) -> Expr:
             if d is not None and _const_str(args[0]) is not None:
                 col, s, op = args[1], _const_str(args[0]), _CMP_SWAP[e.op]
         if col is not None:
+            if coll != "binary":
+                return _lower_cmp_ci(e.dtype, op, col, s, d, coll)
             return _lower_cmp(e.dtype, op, col, s, d)
 
     if e.op == "like":
         d = _dict_for(args[0], dicts)
         p = _const_str(args[1])
         if d is not None and p is not None:
-            rx = like_to_regex(p)
-            lut = np.fromiter((rx.match(v) is not None for v in d.values),
-                              dtype=bool, count=len(d))
+            coll = _eff_collation(args[0])
+            if coll != "binary":
+                # ci LIKE: casefold both sides — MySQL LIKE is character-
+                # wise with NO pad-space and no accent folding
+                from ..utils.collate import like_key
+                rx = like_to_regex(like_key(p, coll))
+                lut = np.fromiter(
+                    (rx.match(like_key(v, coll)) is not None
+                     for v in d.values), dtype=bool, count=len(d))
+            else:
+                rx = like_to_regex(p)
+                lut = np.fromiter((rx.match(v) is not None
+                                   for v in d.values),
+                                  dtype=bool, count=len(d))
             return B.dict_lut(args[0], _pad_lut(lut))
 
     if e.op in ("greatest", "least") and e.dtype.is_string:
@@ -149,11 +196,20 @@ def lower_strings(e: Expr, dicts: dict[int, StringDict]) -> Expr:
         items = [_const_str(a) for a in args[1:]
                  if not (isinstance(a, Const) and a.value is None)]
         if all(s is not None for s in items):
-            lut = np.zeros(max(len(d), 1), dtype=bool)
-            for s in items:
-                c = d.code_of(s)
-                if c >= 0:
-                    lut[c] = True
+            coll = _eff_collation(args[0])
+            if coll != "binary":
+                from ..utils.collate import sortkey
+                keys = {sortkey(s, coll) for s in items}
+                lut = np.fromiter((sortkey(v, coll) in keys
+                                   for v in d.values), dtype=bool,
+                                  count=len(d)) if len(d) \
+                    else np.zeros(1, bool)
+            else:
+                lut = np.zeros(max(len(d), 1), dtype=bool)
+                for s in items:
+                    c = d.code_of(s)
+                    if c >= 0:
+                        lut[c] = True
             match = B.dict_lut(args[0], _pad_lut(lut))
             if has_null:
                 # x IN (..., NULL): TRUE on match, else NULL

@@ -30,7 +30,11 @@ from .logical import (AggItem, CTEStorage, DataSource, LogicalAggregate,
 
 K = dt.TypeKind
 
-AGG_FUNCS = {"SUM", "COUNT", "AVG", "MIN", "MAX"}
+AGG_FUNCS = {"SUM", "COUNT", "AVG", "MIN", "MAX",
+             "STDDEV", "STD", "STDDEV_POP", "STDDEV_SAMP",
+             "VARIANCE", "VAR_POP", "VAR_SAMP",
+             "BIT_AND", "BIT_OR", "BIT_XOR",
+             "GROUP_CONCAT", "ANY_VALUE", "APPROX_COUNT_DISTINCT"}
 
 _CMP = {"=": "eq", "<>": "ne", "<": "lt", "<=": "le", ">": "gt", ">=": "ge"}
 _ARITH = {"+": "add", "-": "sub", "*": "mul", "/": "div", "DIV": "intdiv",
@@ -780,8 +784,53 @@ def _build_agg_select(sel: A.SelectStmt, items, child) -> tuple[LogicalPlan, lis
         elif name == "COUNT":
             i = _add_agg(agg_items, AggFunc.COUNT, arg, fc.distinct)
             out = _AggRef(i, agg_items[i].out_dtype)
+        elif name == "APPROX_COUNT_DISTINCT":
+            # exact host implementation of the approximate contract
+            i = _add_agg(agg_items, AggFunc.COUNT, arg, True)
+            out = _AggRef(i, agg_items[i].out_dtype)
+        elif name in ("STDDEV", "STD", "STDDEV_POP", "STDDEV_SAMP",
+                      "VARIANCE", "VAR_POP", "VAR_SAMP"):
+            # moment rewrite (reference: aggfuncs var_pop/stddev classes):
+            # SUM(x), SUM(x*x), COUNT(x) — all three push to the device psum
+            # path; the final expression runs in the post-agg projection.
+            # var_pop = E[x^2] - E[x]^2; _samp scales by n/(n-1) (NULL at
+            # n<=1 via the div-by-zero->NULL rule).
+            if arg is None or not arg.dtype.is_numeric:
+                raise PlanError(f"{name} needs a numeric argument")
+            if fc.distinct:
+                # MySQL rejects DISTINCT here; the moment rewrite would
+                # dedupe x*x instead of x and compute a wrong variance
+                raise PlanError(f"DISTINCT not supported for {name}")
+            xf = B.cast(arg, dt.double(True))
+            s1 = _add_agg(agg_items, AggFunc.SUM, xf, fc.distinct)
+            s2 = _add_agg(agg_items, AggFunc.SUM,
+                          B.arith("mul", xf, xf), fc.distinct)
+            c = _add_agg(agg_items, AggFunc.COUNT, xf, fc.distinct)
+            s1r = _AggRef(s1, agg_items[s1].out_dtype)
+            s2r = _AggRef(s2, agg_items[s2].out_dtype)
+            nr = B.cast(_AggRef(c, agg_items[c].out_dtype), dt.double(True))
+            mean = B.arith("div", s1r, nr)
+            var_pop = B.arith("sub", B.arith("div", s2r, nr),
+                              B.arith("mul", mean, mean))
+            if name in ("STDDEV_SAMP", "VAR_SAMP"):
+                scale = B.arith("div", nr,
+                                B.arith("sub", nr, B.lit(1.0)))
+                var = B.arith("mul", var_pop, scale)
+            else:
+                var = var_pop
+            if name in ("STDDEV", "STD", "STDDEV_POP", "STDDEV_SAMP"):
+                # clamp tiny negative fp residue before sqrt
+                out = B.math_func(
+                    "sqrt", B.greatest_least("greatest",
+                                             [var, B.lit(0.0)]))
+            else:
+                out = var
         else:
-            f = {"SUM": AggFunc.SUM, "MIN": AggFunc.MIN, "MAX": AggFunc.MAX}[name]
+            f = {"SUM": AggFunc.SUM, "MIN": AggFunc.MIN, "MAX": AggFunc.MAX,
+                 "BIT_AND": AggFunc.BIT_AND, "BIT_OR": AggFunc.BIT_OR,
+                 "BIT_XOR": AggFunc.BIT_XOR,
+                 "GROUP_CONCAT": AggFunc.GROUP_CONCAT,
+                 "ANY_VALUE": AggFunc.ANY_VALUE}[name]
             if arg is None:
                 raise PlanError(f"{name} needs an argument")
             i = _add_agg(agg_items, f, arg, fc.distinct)
@@ -864,6 +913,10 @@ def _add_agg(agg_items: list[AggItem], func: AggFunc, arg, distinct: bool) -> in
         out_t = dt.bigint(False)
     elif func == AggFunc.SUM:
         out_t = sum_out_dtype(arg.dtype)
+    elif func in (AggFunc.BIT_AND, AggFunc.BIT_OR, AggFunc.BIT_XOR):
+        out_t = dt.ubigint(False)      # MySQL: unsigned 64-bit, never NULL
+    elif func == AggFunc.GROUP_CONCAT:
+        out_t = dt.varchar(True)
     else:
         out_t = arg.dtype
     agg_items.append(AggItem(func, arg, distinct, out_t))

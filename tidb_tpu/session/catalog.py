@@ -55,7 +55,8 @@ TYPE_MAP = {
 }
 
 
-def type_from_sql(name: str, prec: int, scale: int, not_null: bool) -> dt.DataType:
+def type_from_sql(name: str, prec: int, scale: int, not_null: bool,
+                  collation: str = "") -> dt.DataType:
     base = name.split(" ")[0]
     unsigned = "UNSIGNED" in name
     if base in ("DECIMAL", "NUMERIC"):
@@ -68,6 +69,9 @@ def type_from_sql(name: str, prec: int, scale: int, not_null: bool) -> dt.DataTy
     t = fn(nullable=not not_null)
     if unsigned and t.kind == K.INT64:
         t = dt.ubigint(nullable=not not_null)
+    if collation and t.kind == K.STRING:
+        from dataclasses import replace
+        t = replace(t, collation=collation)
     return t
 
 

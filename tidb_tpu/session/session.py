@@ -689,7 +689,8 @@ class Session:
         for c in stmt.columns:
             names.append(c.name)
             not_null = c.not_null or c.name in stmt.primary_key
-            types.append(type_from_sql(c.type_name, c.prec, c.scale, not_null))
+            types.append(type_from_sql(c.type_name, c.prec, c.scale, not_null,
+                                       c.collation))
             if c.auto_increment:
                 auto_inc = c.name
         tbl = TableInfo(stmt.name, names, types, stmt.primary_key, auto_inc,
@@ -740,7 +741,8 @@ class Session:
     def _alter_add_column(self, tbl, cd) -> None:
         if cd.name in tbl.col_names:
             raise CatalogError(f"column {cd.name!r} already exists")
-        t = type_from_sql(cd.type_name, cd.prec, cd.scale, cd.not_null)
+        t = type_from_sql(cd.type_name, cd.prec, cd.scale, cd.not_null,
+                          cd.collation)
         default = None
         if cd.default is not None:
             default = self._literal_value(cd.default)

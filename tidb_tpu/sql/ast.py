@@ -202,6 +202,7 @@ class ColumnDef(Node):
     primary_key: bool = False
     default: Optional[Node] = None
     auto_increment: bool = False
+    collation: str = ""             # COLLATE clause ('' = table/charset default)
 
 
 @dataclass

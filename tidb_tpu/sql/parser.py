@@ -723,7 +723,7 @@ class Parser:
                 self.expect_kw("SET")
                 self.ident()
             elif self.accept_kw("COLLATE"):
-                self.ident()
+                cd.collation = self.ident().lower()
             else:
                 break
         return cd

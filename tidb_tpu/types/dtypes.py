@@ -75,6 +75,10 @@ class DataType:
     # DECIMAL precision/scale (flen/decimal in the reference's FieldType).
     prec: int = -1
     scale: int = -1
+    # string collation (util/collate analog); "binary" == utf8mb4_bin ==
+    # raw dictionary-code order.  Case/accent-insensitive collations
+    # compare through sortkey rank LUTs (utils/collate.py).
+    collation: str = "binary"
 
     # ------------------------------------------------------------------ #
 
@@ -163,8 +167,8 @@ def decimal_wide(prec: int, scale: int, nullable: bool = True) -> DataType:
     return DataType(TypeKind.DECIMAL, nullable, prec=prec, scale=scale)
 
 
-def varchar(nullable: bool = True) -> DataType:
-    return DataType(TypeKind.STRING, nullable)
+def varchar(nullable: bool = True, collation: str = "binary") -> DataType:
+    return DataType(TypeKind.STRING, nullable, collation=collation)
 
 
 def date(nullable: bool = True) -> DataType:

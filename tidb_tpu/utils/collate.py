@@ -1,0 +1,109 @@
+"""Collation sortkeys + rank LUTs over string dictionaries.
+
+Reference analog: pkg/util/collate (20.5k LoC of per-collation Compare/Key
+implementations).  The TPU redesign needs none of the per-row compare code:
+strings are dictionary codes, so a collation becomes ONE host-side pass
+over the (small) dictionary producing an int rank LUT — device compares
+stay integer compares, exactly like the binary path (SURVEY.md §7).
+
+Supported: binary / utf8mb4_bin (raw code order, no LUT needed),
+utf8mb4_general_ci (case-insensitive), utf8mb4_unicode_ci and
+utf8mb4_0900_ai_ci (case- and accent-insensitive, NFKD approximation).
+Non-binary collations use MySQL PAD SPACE semantics (trailing spaces
+ignored); 0900 collations are NO PAD in MySQL, approximated the same way.
+"""
+
+from __future__ import annotations
+
+import unicodedata
+from bisect import bisect_left, bisect_right
+
+import numpy as np
+
+from ..chunk.column import StringDict
+
+BINARY = ("binary", "utf8mb4_bin", "utf8_bin", "latin1_bin", "ascii_bin")
+
+
+def is_binary(name: str) -> bool:
+    return name in BINARY or not name.endswith("_ci")
+
+
+def _strip_accents(s: str) -> str:
+    return "".join(c for c in unicodedata.normalize("NFKD", s)
+                   if not unicodedata.combining(c))
+
+
+def sortkey(s: str, collation: str) -> str:
+    """Collation sort key: equal keys collate equal; key order == collation
+    order (codec.Key analog, computed per dictionary value not per row)."""
+    if is_binary(collation):
+        return s
+    s = s.rstrip(" ")                      # PAD SPACE
+    if "unicode" in collation or "_ai_" in collation or "0900" in collation:
+        s = _strip_accents(s)
+    return s.casefold()
+
+
+class RankTable:
+    """Dense ranks of a dictionary's values under a collation: codes with
+    equal sortkeys share a rank, rank order == collation order."""
+
+    def __init__(self, d: StringDict, collation: str):
+        self.collation = collation
+        keys = [sortkey(v, collation) for v in d.values]
+        self.sorted_keys = sorted(set(keys))
+        idx = {k: i for i, k in enumerate(self.sorted_keys)}
+        self.ranks = (np.fromiter((idx[k] for k in keys), np.int32,
+                                  count=len(keys))
+                      if keys else np.zeros(1, np.int32))
+
+    def rank_of(self, s: str) -> int:
+        """Exact rank of a literal's sortkey, or -1 if absent."""
+        k = sortkey(s, self.collation)
+        i = bisect_left(self.sorted_keys, k)
+        if i < len(self.sorted_keys) and self.sorted_keys[i] == k:
+            return i
+        return -1
+
+    def lower_bound(self, s: str) -> int:
+        return bisect_left(self.sorted_keys, sortkey(s, self.collation))
+
+    def upper_bound(self, s: str) -> int:
+        return bisect_right(self.sorted_keys, sortkey(s, self.collation))
+
+
+def rank_table(d: StringDict, collation: str) -> "RankTable":
+    """Per-dictionary cached RankTable (dictionaries are immutable and
+    shared across chunks; streaming paths ask per chunk per key)."""
+    rt = d._rank_cache.get(collation)
+    if rt is None:
+        rt = d._rank_cache[collation] = RankTable(d, collation)
+    return rt
+
+
+def like_key(s: str, collation: str) -> str:
+    """LIKE-compare normalization: MySQL LIKE is character-wise with NO
+    pad-space (unlike ordinary ci compares), so only casefold — never
+    rstrip, and no NFKD expansion (it would change `_` wildcard widths)."""
+    if is_binary(collation):
+        return s
+    return s.casefold()
+
+
+def merged_rank_maps(da: StringDict, db: StringDict, collation: str):
+    """Rank maps for two dictionaries into one shared collation-rank
+    space (cross-dictionary ci compares/joins)."""
+    ka = [sortkey(v, collation) for v in da.values]
+    kb = [sortkey(v, collation) for v in db.values]
+    merged = sorted(set(ka) | set(kb))
+    idx = {k: i for i, k in enumerate(merged)}
+    ma = (np.fromiter((idx[k] for k in ka), np.int32, count=len(ka))
+          if ka else np.zeros(1, np.int32))
+    mb = (np.fromiter((idx[k] for k in kb), np.int32, count=len(kb))
+          if kb else np.zeros(1, np.int32))
+    return ma, mb
+
+
+__all__ = ["sortkey", "is_binary", "RankTable", "rank_table", "like_key",
+           "merged_rank_maps"]
