@@ -108,6 +108,16 @@ class Column:
                 out[i] = v if isinstance(v, (int, np.integer)) else tmp.parse_date(str(v))
             elif kind == dt.TypeKind.DATETIME:
                 out[i] = v if isinstance(v, (int, np.integer)) else tmp.parse_datetime(str(v))
+            elif kind == dt.TypeKind.ENUM and not isinstance(v, (int, np.integer)):
+                ix = dt.enum_index(dtype, str(v))
+                if ix < 0:
+                    raise ValueError(f"invalid ENUM value {v!r}")
+                out[i] = ix
+            elif kind == dt.TypeKind.SET and not isinstance(v, (int, np.integer)):
+                m = dt.set_mask(dtype, str(v))
+                if m < 0:
+                    raise ValueError(f"invalid SET value {v!r}")
+                out[i] = m
             else:
                 out[i] = v
         return cls(dtype, out, valid)
@@ -139,6 +149,15 @@ class Column:
                 out.append(tmp.datetime_to_string(int(self.data[i])))
             elif kind in (dt.TypeKind.FLOAT64, dt.TypeKind.FLOAT32):
                 out.append(float(self.data[i]))
+            elif kind == dt.TypeKind.ENUM:
+                ix = int(self.data[i])
+                out.append(self.dtype.members[ix - 1]
+                           if 1 <= ix <= len(self.dtype.members) else "")
+            elif kind == dt.TypeKind.SET:
+                m = int(self.data[i])
+                out.append(",".join(
+                    v for j, v in enumerate(self.dtype.members)
+                    if m >> j & 1))
             else:
                 out.append(int(self.data[i]))
         return out

@@ -185,7 +185,7 @@ def _try_cop(p: LogicalPlan, no_device_join: bool = False) -> Optional[PhysOp]:
         for i, d in agg_dicts.items():   # MIN/MAX over dict-encoded strings
             out_dicts[len(key_meta) + i] = d
     elif isinstance(top, LogicalTopN):
-        from ..utils.collate import RankTable, is_binary
+        from ..utils.collate import is_binary, rank_table
         keys = []
         for key, desc in top.keys:
             key = lower_strings(key, cur_dicts)
@@ -199,7 +199,7 @@ def _try_cop(p: LogicalPlan, no_device_join: bool = False) -> Optional[PhysOp]:
                     return None
                 from ..expr import builders as B
                 key = B.dict_map(
-                    key, RankTable(d, key.dtype.collation).ranks)
+                    key, rank_table(d, key.dtype.collation).ranks)
             keys.append((key, desc))
         if not keys:
             return None

@@ -216,8 +216,10 @@ def temporal_part(part: str, a: Expr) -> Func:
 
 STRING_VALUED_FUNCS = {"upper", "lower", "trim", "ltrim", "rtrim", "reverse",
                        "substring", "replace", "concat", "left", "right",
-                       "lpad", "rpad"}
-STRING_INT_FUNCS = {"length", "char_length", "ascii", "locate", "instr"}
+                       "lpad", "rpad",
+                       "json_extract", "json_unquote", "json_type"}
+STRING_INT_FUNCS = {"length", "char_length", "ascii", "locate", "instr",
+                    "json_valid", "json_length", "json_contains"}
 
 
 def str_func(name: str, *args: Expr) -> Func:

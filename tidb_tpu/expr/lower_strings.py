@@ -92,8 +92,8 @@ def _lower_cmp_ci(dtype: dt.DataType, op: str, col: Expr, s: str,
     """Collation-aware column-vs-literal compare: codes remap through the
     collation rank LUT (util/collate Compare/Key collapsed into one
     dictionary pass)."""
-    from ..utils.collate import RankTable
-    rt = RankTable(d, collation)
+    from ..utils.collate import rank_table
+    rt = rank_table(d, collation)
     ic = lambda v: Const(dt.bigint(False), int(v))
     if op in ("eq", "ne"):
         r = rt.rank_of(s)
@@ -270,6 +270,16 @@ def _str_valued_impl(op: str, consts: list):
         return _trim
     if op == "reverse":
         return lambda v: v[::-1]
+    if op == "json_extract":
+        from ..utils.jsonfns import extract
+        path = str(consts[0])
+        return lambda v: extract(v, path)
+    if op == "json_unquote":
+        from ..utils.jsonfns import unquote
+        return unquote
+    if op == "json_type":
+        from ..utils.jsonfns import jtype
+        return jtype
     if op == "substring":
         pos = consts[0]
         length = consts[1] if len(consts) > 1 else None
@@ -307,6 +317,37 @@ def _derived_map(out_dtype: dt.DataType, col: Expr, values: list[str]) -> Func:
     return node
 
 
+def _derived_map_nullable(out_dtype: dt.DataType, col: Expr,
+                          values: list[Optional[str]]) -> Expr:
+    """_derived_map where some per-value results are SQL NULL (JSON path
+    misses): codes whose result is None gate to NULL via a miss LUT."""
+    if not any(v is None for v in values):
+        return _derived_map(out_dtype, col, values)  # type: ignore[arg-type]
+    filled = [v if v is not None else "" for v in values]
+    base = _derived_map(out_dtype.with_nullable(True), col, filled)
+    miss = np.fromiter((v is None for v in values), bool,
+                       count=len(values)) if values else np.zeros(1, bool)
+    node = Func(out_dtype.with_nullable(True), "if",
+                (B.dict_lut(col, miss), Const(dt.null_type(), None), base))
+    object.__setattr__(node, "_derived_dict",
+                       getattr(base, "_derived_dict", None))
+    return node
+
+
+def _derived_ilut_nullable(out_dtype: dt.DataType, col: Expr,
+                           values: list[Optional[int]]) -> Expr:
+    """Int LUT gather where some per-value results are SQL NULL."""
+    filled = np.asarray([v if v is not None else 0 for v in values] or [0],
+                        np.int64)
+    base = B.dict_ilut(col, filled, out_dtype.with_nullable(True))
+    if not any(v is None for v in values):
+        return base
+    miss = np.fromiter((v is None for v in values), bool,
+                       count=len(values)) if values else np.zeros(1, bool)
+    return Func(out_dtype.with_nullable(True), "if",
+                (B.dict_lut(col, miss), Const(dt.null_type(), None), base))
+
+
 def fold_string_func(e: Expr) -> Optional[Const]:
     """Constant-fold a string-function tree whose leaves are all scalar
     Consts (post-order), e.g. UPPER('abc') or CONCAT('a', 'b', col-less).
@@ -330,6 +371,20 @@ def fold_string_func(e: Expr) -> Optional[Const]:
     if e.op == "concat":
         return Const(e.dtype, "".join(str(v) for v in vals))
     if e.op in STRING_INT_FUNCS:
+        if e.op in ("json_valid", "json_length", "json_contains"):
+            from ..utils import jsonfns
+            if e.op == "json_valid":
+                r = jsonfns.valid(str(vals[0]))
+            elif e.op == "json_length":
+                r = jsonfns.jlength(str(vals[0]),
+                                    str(vals[1]) if len(vals) > 1 else "$")
+            else:
+                r = jsonfns.contains(
+                    str(vals[0]), str(vals[1]),
+                    str(vals[2]) if len(vals) > 2 else "$")
+            if r is None:
+                return Const(e.dtype.with_nullable(True), None)
+            return Const(e.dtype, int(r))
         if e.op == "length":
             r = len(str(vals[0]).encode("utf-8"))
         elif e.op == "char_length":
@@ -349,7 +404,10 @@ def fold_string_func(e: Expr) -> Optional[Const]:
     fn = _str_valued_impl(e.op, vals[1:])
     if fn is None:
         return None
-    return Const(e.dtype, fn(str(vals[0])))
+    r = fn(str(vals[0]))
+    if r is None:                  # e.g. JSON_EXTRACT path miss
+        return Const(e.dtype.with_nullable(True), None)
+    return Const(e.dtype, r)
 
 
 def string_func_arg_error(e: Func) -> Optional[str]:
@@ -389,7 +447,10 @@ def _lower_str_valued(e: Func, args, dicts) -> Optional[Expr]:
     fn = _str_valued_impl(e.op, consts)
     if fn is None:
         return None
-    return _derived_map(e.dtype, col, [fn(v) for v in d.values])
+    vals = [fn(v) for v in d.values]
+    if any(v is None for v in vals):
+        return _derived_map_nullable(e.dtype, col, vals)
+    return _derived_map(e.dtype, col, vals)
 
 
 _CONCAT_MAX_PRODUCT = 1 << 16
@@ -509,6 +570,25 @@ def _lower_str_int(e: Func, args, dicts) -> Optional[Expr]:
         lut = [v.find(str(needle), start) + 1 for v in d.values]
         return B.dict_ilut(col, np.asarray(lut if lut else [0], np.int64),
                            e.dtype)
+    if e.op in ("json_valid", "json_length", "json_contains"):
+        from ..utils import jsonfns
+        col = args[0]
+        d = _dict_for(col, dicts)
+        if d is None:
+            return None
+        consts = [_const_scalar(a) for a in args[1:]]
+        if any(c is None for c in consts):
+            return None
+        if e.op == "json_valid":
+            vals = [jsonfns.valid(v) for v in d.values]
+        elif e.op == "json_length":
+            path = str(consts[0]) if consts else "$"
+            vals = [jsonfns.jlength(v, path) for v in d.values]
+        else:
+            cand = str(consts[0])
+            path = str(consts[1]) if len(consts) > 1 else "$"
+            vals = [jsonfns.contains(v, cand, path) for v in d.values]
+        return _derived_ilut_nullable(e.dtype, col, vals)
     return None
 
 

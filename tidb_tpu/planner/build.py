@@ -321,6 +321,16 @@ class ExprBuilder:
             return self._str_func(name.lower(), *args)
         if name == "POSITION":
             return self._str_func("locate", args[0], args[1])
+        if name in ("JSON_EXTRACT", "JSON_UNQUOTE", "JSON_TYPE",
+                    "JSON_VALID", "JSON_LENGTH", "JSON_CONTAINS"):
+            need = {"JSON_EXTRACT": (2, 2), "JSON_UNQUOTE": (1, 1),
+                    "JSON_TYPE": (1, 1), "JSON_VALID": (1, 1),
+                    "JSON_LENGTH": (1, 2), "JSON_CONTAINS": (2, 3)}[name]
+            if not need[0] <= len(args) <= need[1]:
+                raise PlanError(f"{name} takes {need[0]}"
+                                + (f"..{need[1]}" if need[1] != need[0]
+                                   else "") + " arguments")
+            return self._str_func(name.lower(), *args)
         if name == "IF":
             return B.if_(args[0], args[1], args[2])
         if name == "IFNULL":
@@ -428,6 +438,17 @@ def _coerce_compare(a: Expr, b: Expr) -> tuple[Expr, Expr]:
             return B.decimal_lit(str(v))
         if target.kind in (K.INT64, K.UINT64, K.FLOAT64, K.FLOAT32):
             return B.lit(float(v))
+        if target.kind == K.ENUM:
+            # compare by 1-based member ordinal; absent literal never
+            # matches (index -1)
+            return Const(dt.bigint(False), dt.enum_index(target, str(v)))
+        if target.kind == K.SET:
+            return Const(dt.bigint(False), dt.set_mask(target, str(v)))
+        if target.kind == K.BIT:
+            try:
+                return Const(dt.bigint(False), int(v))
+            except (TypeError, ValueError):
+                return s
         return s
 
     if isinstance(a, Const) and a.dtype.is_string and not b.dtype.is_string:
@@ -833,6 +854,9 @@ def _build_agg_select(sel: A.SelectStmt, items, child) -> tuple[LogicalPlan, lis
                  "ANY_VALUE": AggFunc.ANY_VALUE}[name]
             if arg is None:
                 raise PlanError(f"{name} needs an argument")
+            if fc.distinct and f in (AggFunc.BIT_AND, AggFunc.BIT_OR,
+                                     AggFunc.BIT_XOR, AggFunc.ANY_VALUE):
+                raise PlanError(f"DISTINCT not supported for {name}")
             i = _add_agg(agg_items, f, arg, fc.distinct)
             out = _AggRef(i, agg_items[i].out_dtype)
         agg_cache[key] = out

@@ -50,6 +50,13 @@ def _const_for(col_type: dt.DataType, c: Const):
     consts carry SCALED ints at the const's own scale, so every cross-type
     pairing rescales explicitly."""
     from ..types import decimal as dec
+    if col_type.is_string:
+        from ..utils.collate import is_binary
+        if not is_binary(col_type.collation):
+            # ci collation: index keys are binary-exact bytes, a binary
+            # point/range scan would miss case variants — keep the
+            # predicate as a residual filter instead
+            return None
     v = c.value
     if v is None:
         return None

@@ -52,17 +52,29 @@ TYPE_MAP = {
     "TIME": dt.time,
     "VARCHAR": dt.varchar, "CHAR": dt.varchar, "TEXT": dt.varchar,
     "STRING": dt.varchar,
+    # JSON columns store normalized text (dict-encoded like VARCHAR); the
+    # JSON_* builtins evaluate per-distinct-value over the dictionary
+    "JSON": dt.varchar,
 }
 
 
 def type_from_sql(name: str, prec: int, scale: int, not_null: bool,
-                  collation: str = "") -> dt.DataType:
+                  collation: str = "", members: tuple = ()) -> dt.DataType:
     base = name.split(" ")[0]
     unsigned = "UNSIGNED" in name
     if base in ("DECIMAL", "NUMERIC"):
         p = prec if prec > 0 else 10
         s = scale if scale >= 0 else 0
         return dt.decimal(p, s, nullable=not not_null)
+    if base == "ENUM":
+        return dt.enum_type(members, nullable=not not_null)
+    if base == "SET":
+        try:
+            return dt.set_type(members, nullable=not not_null)
+        except ValueError as e:
+            raise CatalogError(str(e))
+    if base == "BIT":
+        return dt.bit(prec if prec > 0 else 1, nullable=not not_null)
     fn = TYPE_MAP.get(base)
     if fn is None:
         raise CatalogError(f"unsupported column type {name}")
@@ -257,6 +269,7 @@ class TableInfo:
                     if r[i] is None and not t.nullable:
                         raise CatalogError(
                             f"column {self.col_names[i]!r} cannot be null")
+                    r[i] = canon_write_value(t, r[i], self.col_names[i])
                 fixed.append(tuple(r))
             first_handle = self._next_handle + 1
             self._next_handle += len(fixed)
@@ -288,6 +301,10 @@ class TableInfo:
         caller's txn buffers the writes (and, in pessimistic mode, locks
         each record key at DML time via Txn.put)."""
         from .codec_io import encode_table_row
+        new_rows = [tuple(canon_write_value(t_, v, n)
+                          for t_, v, n in zip(self.col_types, r,
+                                              self.col_names))
+                    for r in new_rows]
         own = txn is None
         with self.schema_gate.read():
             t = txn or self.kv.begin()
@@ -482,6 +499,25 @@ class TableInfo:
                 out.append(Column.concat([base[i], newc]) if len(base[i])
                            else newc)
         return out
+
+
+def canon_write_value(t: dt.DataType, v, col_name: str = ""):
+    """Canonicalize one value at the WRITE boundary (insert/update/import):
+    ENUM/SET string literals become ordinal/bitmask ints (pkg/types
+    ParseEnum/ParseSet analog)."""
+    if v is None or not isinstance(v, str):
+        return v
+    if t.kind == K.ENUM:
+        ix = dt.enum_index(t, v)
+        if ix < 0:
+            raise CatalogError(f"invalid ENUM value {v!r} for {col_name!r}")
+        return ix
+    if t.kind == K.SET:
+        m = dt.set_mask(t, v)
+        if m < 0:
+            raise CatalogError(f"invalid SET value {v!r} for {col_name!r}")
+        return m
+    return v
 
 
 def plainify(v):

@@ -33,11 +33,18 @@ def table_key(db: str, name: str) -> bytes:
 
 
 def _enc_type(t: dt.DataType) -> dict:
-    return {"k": t.kind.name, "n": t.nullable, "p": t.prec, "s": t.scale}
+    out = {"k": t.kind.name, "n": t.nullable, "p": t.prec, "s": t.scale}
+    if t.collation != "binary":
+        out["c"] = t.collation
+    if t.members:
+        out["m"] = list(t.members)
+    return out
 
 
 def _dec_type(d: dict) -> dt.DataType:
-    return dt.DataType(dt.TypeKind[d["k"]], d["n"], d["p"], d["s"])
+    return dt.DataType(dt.TypeKind[d["k"]], d["n"], d["p"], d["s"],
+                       collation=d.get("c", "binary"),
+                       members=tuple(d.get("m", ())))
 
 
 def encode_table(tbl: TableInfo) -> bytes:

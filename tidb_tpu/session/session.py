@@ -690,7 +690,7 @@ class Session:
             names.append(c.name)
             not_null = c.not_null or c.name in stmt.primary_key
             types.append(type_from_sql(c.type_name, c.prec, c.scale, not_null,
-                                       c.collation))
+                                       c.collation, c.members))
             if c.auto_increment:
                 auto_inc = c.name
         tbl = TableInfo(stmt.name, names, types, stmt.primary_key, auto_inc,
@@ -742,7 +742,7 @@ class Session:
         if cd.name in tbl.col_names:
             raise CatalogError(f"column {cd.name!r} already exists")
         t = type_from_sql(cd.type_name, cd.prec, cd.scale, cd.not_null,
-                          cd.collation)
+                          cd.collation, cd.members)
         default = None
         if cd.default is not None:
             default = self._literal_value(cd.default)

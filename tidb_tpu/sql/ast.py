@@ -203,6 +203,7 @@ class ColumnDef(Node):
     default: Optional[Node] = None
     auto_increment: bool = False
     collation: str = ""             # COLLATE clause ('' = table/charset default)
+    members: tuple = ()             # ENUM('a','b') / SET(...) member list
 
 
 @dataclass

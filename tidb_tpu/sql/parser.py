@@ -451,6 +451,13 @@ class Parser:
         self.advance()
         return int(t.text)
 
+    def _str_lit(self) -> str:
+        t = self.cur
+        if t.kind != "str":
+            raise ParseError("expected string literal", t)
+        self.advance()
+        return t.text
+
     def select_item(self) -> A.SelectItem:
         if self.at_op("*"):
             self.advance()
@@ -698,7 +705,8 @@ class Parser:
     def column_def(self) -> A.ColumnDef:
         name = self.ident()
         tname, prec, scale = self.type_name()
-        cd = A.ColumnDef(name, tname, prec, scale)
+        cd = A.ColumnDef(name, tname, prec, scale,
+                         members=self._type_members)
         while True:
             if self.accept_kw("NOT"):
                 self.expect_kw("NULL")
@@ -735,7 +743,15 @@ class Parser:
         self.advance()
         name = t.text.upper()
         prec = scale = -1
-        if self.accept_op("("):
+        self._type_members = ()
+        if name in ("ENUM", "SET"):
+            self.expect_op("(")
+            vals = [self._str_lit()]
+            while self.accept_op(","):
+                vals.append(self._str_lit())
+            self.expect_op(")")
+            self._type_members = tuple(vals)
+        elif self.accept_op("("):
             prec = self._int_lit()
             if self.accept_op(","):
                 scale = self._int_lit()

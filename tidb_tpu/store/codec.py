@@ -124,8 +124,11 @@ def encode_index_value(v: Any, t: dt.DataType) -> bytes:
         return b"\x01" + encode_int_key(d)
     if k == K.STRING:
         return b"\x01" + encode_bytes_key(str(v).encode())
-    if k == K.TIME:
+    if k in (K.TIME, K.ENUM, K.SET):
         return b"\x01" + encode_int_key(int(v))
+    if k == K.BIT:
+        # uint64 memcomparable via sign-flip (BIT(64) values >= 2^63)
+        return b"\x01" + encode_int_key(int(v) - (1 << 63))
     raise ValueError(f"cannot index {t}")
 
 
@@ -189,9 +192,12 @@ def encode_row(values: Sequence[Any], types: Sequence[dt.DataType]) -> bytes:
             out.append(5)
             out += struct.pack("<q", v if isinstance(v, int)
                                else tmp.parse_datetime(str(v)))
-        elif k == K.TIME:
+        elif k in (K.TIME, K.ENUM, K.SET):
             out.append(6)
             out += struct.pack("<q", int(v))
+        elif k == K.BIT:
+            out.append(7)
+            out += struct.pack("<Q", int(v))
         else:
             raise ValueError(f"cannot encode {t}")
     return bytes(out)
@@ -238,6 +244,10 @@ def decode_row(data: bytes, types: Sequence[dt.DataType]) -> list[Any]:
             out.append(tmp.datetime_to_string(v))
         elif tag == 6:
             (v,) = struct.unpack_from("<q", data, off)
+            off += 8
+            out.append(int(v))
+        elif tag == 7:
+            (v,) = struct.unpack_from("<Q", data, off)
             off += 8
             out.append(int(v))
         else:

@@ -46,6 +46,9 @@ class TypeKind(enum.Enum):
     DATE = "date"
     DATETIME = "datetime"
     TIME = "time"
+    ENUM = "enum"      # 1-based member index (pkg/types/enum.go)
+    SET = "set"        # member bitmask (pkg/types/set.go)
+    BIT = "bit"        # BIT(n): uint64 bit value (pkg/types/binary_literal.go)
     NULL = "null"  # type of the NULL literal before inference
 
 
@@ -79,6 +82,8 @@ class DataType:
     # raw dictionary-code order.  Case/accent-insensitive collations
     # compare through sortkey rank LUTs (utils/collate.py).
     collation: str = "binary"
+    # ENUM/SET member list in DEFINITION order (ordinal semantics)
+    members: tuple = ()
 
     # ------------------------------------------------------------------ #
 
@@ -136,6 +141,9 @@ _NP_DTYPES = {
     TypeKind.DATE: np.int32,
     TypeKind.DATETIME: np.int64,
     TypeKind.TIME: np.int64,
+    TypeKind.ENUM: np.int32,
+    TypeKind.SET: np.int64,
+    TypeKind.BIT: np.uint64,
     TypeKind.NULL: np.int64,
 }
 
@@ -169,6 +177,45 @@ def decimal_wide(prec: int, scale: int, nullable: bool = True) -> DataType:
 
 def varchar(nullable: bool = True, collation: str = "binary") -> DataType:
     return DataType(TypeKind.STRING, nullable, collation=collation)
+
+
+def enum_type(members, nullable: bool = True) -> DataType:
+    return DataType(TypeKind.ENUM, nullable, members=tuple(members))
+
+
+def set_type(members, nullable: bool = True) -> DataType:
+    # 63, not 64: masks ride the signed-int64 row/key codecs
+    if len(members) > 63:
+        raise ValueError("SET supports at most 63 members")
+    return DataType(TypeKind.SET, nullable, members=tuple(members))
+
+
+def bit(width: int = 1, nullable: bool = True) -> DataType:
+    return DataType(TypeKind.BIT, nullable, prec=max(width, 1))
+
+
+def enum_index(t: DataType, s: str) -> int:
+    """1-based member index of a string under MySQL's case-insensitive
+    member match, or -1 when absent."""
+    low = s.lower()
+    for i, m in enumerate(t.members):
+        if m.lower() == low:
+            return i + 1
+    return -1
+
+
+def set_mask(t: DataType, s: str) -> int:
+    """Bitmask of a comma-separated SET literal, or -1 when any element
+    is not a member."""
+    if s == "":
+        return 0
+    mask = 0
+    for part in s.split(","):
+        i = enum_index(t, part)
+        if i < 0:
+            return -1
+        mask |= 1 << (i - 1)
+    return mask
 
 
 def date(nullable: bool = True) -> DataType:

@@ -1,0 +1,165 @@
+"""JSON builtin implementations over JSON text values.
+
+Reference analog: pkg/types/json_binary*.go + pkg/expression/builtin_json*.
+JSON columns store normalized text dict-encoded like VARCHAR, so every
+JSON_* builtin evaluates ONCE per distinct value over the dictionary
+(expr/lower_strings.py) and runs as a gather on device — the same
+per-distinct-value trick as the string builtins.
+
+Path grammar (subset of MySQL JSON path): `$`, `.member`, `."quoted"`,
+`[N]`.  Multiple-path and wildcard forms are not supported.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, Optional
+
+_STEP = re.compile(
+    r"""\.(?:([A-Za-z_][A-Za-z0-9_]*)|"((?:[^"\\]|\\.)*)")|\[(\d+)\]""")
+
+
+class JSONPathError(ValueError):
+    pass
+
+
+def parse_path(path: str):
+    if not path.startswith("$"):
+        raise JSONPathError(f"bad JSON path {path!r}")
+    steps = []
+    i = 1
+    while i < len(path):
+        m = _STEP.match(path, i)
+        if m is None:
+            raise JSONPathError(f"bad JSON path {path!r} at {i}")
+        if m.group(3) is not None:
+            steps.append(int(m.group(3)))
+        else:
+            steps.append(m.group(1) if m.group(1) is not None
+                         else m.group(2).encode().decode("unicode_escape"))
+        i = m.end()
+    return steps
+
+
+def _loads(text: str):
+    return json.loads(text)
+
+
+def _walk(doc: Any, steps) -> tuple[bool, Any]:
+    for s in steps:
+        if isinstance(s, int):
+            if isinstance(doc, list) and 0 <= s < len(doc):
+                doc = doc[s]
+            elif s == 0 and not isinstance(doc, list):
+                continue         # MySQL: $[0] of a scalar is the scalar
+            else:
+                return False, None
+        else:
+            if isinstance(doc, dict) and s in doc:
+                doc = doc[s]
+            else:
+                return False, None
+    return True, doc
+
+
+def _dump(v: Any) -> str:
+    return json.dumps(v, separators=(", ", ": "), ensure_ascii=False)
+
+
+def extract(text: str, path: str) -> Optional[str]:
+    """JSON text of the value at `path`, or None (SQL NULL) on a miss or
+    invalid input document."""
+    try:
+        doc = _loads(text)
+    except ValueError:
+        return None
+    ok, v = _walk(doc, parse_path(path))
+    return _dump(v) if ok else None
+
+
+def unquote(text: str) -> str:
+    """JSON_UNQUOTE: strip quotes of a JSON string literal; other values
+    pass through unchanged."""
+    t = text.strip()
+    if len(t) >= 2 and t[0] == '"' and t[-1] == '"':
+        try:
+            v = _loads(t)
+            if isinstance(v, str):
+                return v
+        except ValueError:
+            pass
+    return text
+
+
+def jtype(text: str) -> Optional[str]:
+    try:
+        v = _loads(text)
+    except ValueError:
+        return None
+    if v is None:
+        return "NULL"
+    if isinstance(v, bool):
+        return "BOOLEAN"
+    if isinstance(v, int):
+        return "INTEGER"
+    if isinstance(v, float):
+        return "DOUBLE"
+    if isinstance(v, str):
+        return "STRING"
+    if isinstance(v, list):
+        return "ARRAY"
+    return "OBJECT"
+
+
+def valid(text: str) -> int:
+    try:
+        _loads(text)
+        return 1
+    except ValueError:
+        return 0
+
+
+def jlength(text: str, path: str = "$") -> Optional[int]:
+    try:
+        doc = _loads(text)
+    except ValueError:
+        return None
+    ok, v = _walk(doc, parse_path(path))
+    if not ok:
+        return None
+    if isinstance(v, dict) or isinstance(v, list):
+        return len(v)
+    return 1
+
+
+def _contained(target: Any, cand: Any) -> bool:
+    """MySQL JSON_CONTAINS semantics."""
+    if isinstance(target, list):
+        if isinstance(cand, list):
+            return all(any(_contained(t, c) for t in target) for c in cand)
+        return any(_contained(t, cand) for t in target)
+    if isinstance(target, dict) and isinstance(cand, dict):
+        return all(k in target and _contained(target[k], v)
+                   for k, v in cand.items())
+    return type(target) is type(cand) and target == cand or \
+        (isinstance(target, (int, float))
+         and isinstance(cand, (int, float))
+         and not isinstance(target, bool) and not isinstance(cand, bool)
+         and target == cand)
+
+
+def contains(text: str, candidate: str, path: str = "$") -> Optional[int]:
+    try:
+        doc = _loads(text)
+        cand = _loads(candidate)
+    except ValueError:
+        return None
+    ok, v = _walk(doc, parse_path(path))
+    if not ok:
+        return None
+    return int(_contained(v, cand))
+
+
+__all__ = ["extract", "unquote", "jtype", "valid", "jlength", "contains",
+           "parse_path", "JSONPathError"]
