@@ -1,0 +1,185 @@
+"""REPLACE / INSERT IGNORE / LOAD DATA (executor/replace.go,
+load_data.go analogs) + optimizer hints with merge and index-lookup joins
+(planner/core/hints, join/merge_join.go, join/index_lookup_join.go)."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.session import Domain, Session
+
+
+@pytest.fixture()
+def sess():
+    s = Session(Domain())
+    s.execute("create table t (id bigint, name varchar(20), v bigint)")
+    s.execute("create unique index uid on t (id)")
+    s.execute("insert into t values (1,'a',10), (2,'b',20)")
+    return s
+
+
+def test_replace_into(sess):
+    r = sess.execute("replace into t values (1,'a2',11), (3,'c',30)")
+    assert r.affected == 3      # 1 delete + 2 inserts (MySQL counting)
+    assert sess.must_query("select id, name, v from t order by id") == \
+        [(1, "a2", 11), (2, "b", 20), (3, "c", 30)]
+
+
+def test_replace_within_batch_later_wins(sess):
+    sess.execute("replace into t values (5,'x',1), (5,'y',2)")
+    assert sess.must_query("select name from t where id = 5") == [("y",)]
+
+
+def test_insert_ignore(sess):
+    r = sess.execute("insert ignore into t values (2,'dup',99), (4,'d',40)")
+    assert r.affected == 1
+    assert sess.must_query("select name from t where id = 2") == [("b",)]
+    assert sess.must_query("select name from t where id = 4") == [("d",)]
+
+
+def test_replace_function_still_parses(sess):
+    assert sess.must_query(
+        "select replace(name, 'a', 'X') from t where id = 1") == [("X",)]
+
+
+def test_load_data(tmp_path, sess):
+    p = tmp_path / "rows.csv"
+    p.write_text("10,ten,100\n11,eleven,\\N\n12,twelve,120\n")
+    r = sess.execute(f"load data infile '{p}' into table t "
+                     "fields terminated by ','")
+    assert r.affected == 3
+    assert sess.must_query(
+        "select id, name, v from t where id >= 10 order by id") == \
+        [(10, "ten", 100), (11, "eleven", None), (12, "twelve", 120)]
+
+
+def test_load_data_ignore_lines_and_columns(tmp_path, sess):
+    p = tmp_path / "rows2.csv"
+    p.write_text("header,skip\n20,u\n21,v\n")
+    r = sess.execute(f"load data infile '{p}' into table t "
+                     "fields terminated by ',' ignore 1 lines (id, name)")
+    assert r.affected == 2
+    assert sess.must_query(
+        "select id, name, v from t where id >= 20 order by id") == \
+        [(20, "u", None), (21, "v", None)]
+
+
+@pytest.fixture()
+def jsess():
+    s = Session(Domain())
+    s.execute("create table big (k bigint, v bigint)")
+    s.execute("create table small (k bigint, w bigint)")
+    s.execute("insert into big values " +
+              ",".join(f"({i % 50},{i})" for i in range(2000)))
+    s.execute("insert into small values (3,30),(7,70),(3,31)")
+    s.execute("create index ik on big (k)")
+    return s
+
+
+def _base(s):
+    return sorted(s.must_query(
+        "select b.v, sm.w from big b join small sm on b.k = sm.k"))
+
+
+def test_hash_join_hint_forces_host(jsess):
+    q = ("select /*+ HASH_JOIN(sm) */ b.v, sm.w from big b "
+         "join small sm on b.k = sm.k")
+    plan = "\n".join(r[0] for r in jsess.must_query("explain " + q))
+    assert "HostHashJoin" in plan, plan
+    assert sorted(jsess.must_query(q)) == _base(jsess)
+
+
+def test_merge_join_hint(jsess):
+    q = ("select /*+ MERGE_JOIN(sm) */ b.v, sm.w from big b "
+         "join small sm on b.k = sm.k")
+    plan = "\n".join(r[0] for r in jsess.must_query("explain " + q))
+    assert "HostMergeJoin" in plan, plan
+    assert sorted(jsess.must_query(q)) == _base(jsess)
+
+
+def test_inl_join_hint_with_reorder_swap(jsess):
+    q = ("select /*+ INL_JOIN(b) */ sm.w, b.v from small sm "
+         "join big b on sm.k = b.k")
+    plan = "\n".join(r[0] for r in jsess.must_query("explain " + q))
+    assert "HostIndexLookupJoin" in plan and "index=ik" in plan, plan
+    got = sorted(jsess.must_query(q))
+    exp = sorted(jsess.must_query(
+        "select sm.w, b.v from small sm join big b on sm.k = b.k"))
+    assert got == exp and len(got) == 120
+
+
+def test_inl_left_join_and_residual(jsess):
+    q = ("select /*+ INL_JOIN(b) */ sm.w, b.v from small sm "
+         "left join big b on sm.k = b.k where sm.k = 7")
+    got = sorted(jsess.must_query(q))
+    exp = sorted(jsess.must_query(
+        "select sm.w, b.v from small sm left join big b on sm.k = b.k "
+        "where sm.k = 7"))
+    assert got == exp
+
+
+def test_use_and_ignore_index_hints(jsess):
+    p1 = "\n".join(r[0] for r in jsess.must_query(
+        "explain select /*+ USE_INDEX(big, ik) */ v from big where k = 3"))
+    p2 = "\n".join(r[0] for r in jsess.must_query(
+        "explain select /*+ IGNORE_INDEX(big, ik) */ v from big "
+        "where k = 3"))
+    assert "IndexLookUp" in p1, p1
+    assert "IndexLookUp" not in p2, p2
+    a = sorted(jsess.must_query(
+        "select /*+ USE_INDEX(big, ik) */ v from big where k = 3"))
+    b = sorted(jsess.must_query(
+        "select /*+ IGNORE_INDEX(big, ik) */ v from big where k = 3"))
+    assert a == b
+
+
+def test_leading_hint_runs(jsess):
+    assert jsess.must_query(
+        "select /*+ LEADING(b) */ count(*) from big b, small sm "
+        "where b.k = sm.k") == [(120,)]
+
+
+def test_insert_ignore_in_txn_keeps_index_consistent(sess):
+    sess.execute("begin")
+    sess.execute("insert ignore into t values (1,'dup',0), (9,'ok',90)")
+    sess.execute("commit")
+    assert sess.must_query("select count(*) from t where id = 1") == [(1,)]
+    assert sess.must_query("select name from t where id = 9") == [("ok",)]
+    # admin check raises / reports rows on row-index inconsistency
+    assert sess.must_query("admin check table t") == []
+
+
+def test_hint_comment_outside_select_parses(sess):
+    sess.execute("update /*+ NO_INDEX_MERGE() */ t set v = 99 where id = 1")
+    assert sess.must_query("select v from t where id = 1") == [(99,)]
+
+
+def test_inl_null_aware_anti_falls_back(jsess):
+    jsess.execute("create table nn (k bigint)")
+    jsess.execute("insert into nn values (3), (NULL)")
+    jsess.execute("create index ink on nn (k)")
+    # NOT IN over a set containing NULL: empty result, even under INL hint
+    got = jsess.must_query(
+        "select /*+ INL_JOIN(nn) */ w from small "
+        "where k not in (select k from nn)")
+    assert got == []
+
+
+def test_bad_json_path_is_plan_error(sess):
+    from tidb_tpu.planner.build import PlanError
+    with pytest.raises(PlanError):
+        sess.must_query("select json_extract(name, 'a') from t")
+
+
+def test_load_data_multichar_separator(tmp_path, sess):
+    p = tmp_path / "m.txt"
+    p.write_text("30||thirty||300\n")
+    sess.execute(f"load data infile '{p}' into table t "
+                 "fields terminated by '||'")
+    assert sess.must_query(
+        "select id, name, v from t where id = 30") == [(30, "thirty", 300)]
+
+
+def test_unknown_hint_ignored(jsess):
+    assert jsess.must_query(
+        "select /*+ MAX_EXECUTION_TIME(1000) */ count(*) from small") == \
+        [(3,)]

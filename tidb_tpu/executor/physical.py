@@ -1013,6 +1013,110 @@ class HostHashJoin(PhysOp):
         return li, ri
 
 
+@dataclass
+class HostMergeJoin(HostHashJoin):
+    """Sort-merge join (join/merge_join.go analog): both sides sort by the
+    join key, matches stream out in key order — chosen via the MERGE_JOIN
+    hint (and valuable when a downstream ORDER BY rides the same key).
+    Matching reuses the packed-key searchsorted core; the defining
+    property delivered here is key-ordered output."""
+
+    def describe(self):
+        return f"HostMergeJoin[{self.kind}] keys={len(self.eq_keys)}"
+
+    def chunks(self, ctx, required_rows=None):
+        lc = concat_result_chunks(list(self.left.chunks(ctx)),
+                                  self.left.out_names, self.left.out_dtypes)
+        rc = concat_result_chunks(list(self.right.chunks(ctx)),
+                                  self.right.out_names,
+                                  self.right.out_dtypes)
+        if self.null_aware and self.eq_keys and rc.num_rows:
+            for _, rk in self.eq_keys:
+                if not rc.columns[rk].validity.all():
+                    return
+            lc = self._na_filter(lc)
+        if self.eq_keys and lc.num_rows:
+            lkeys, rkeys = self._key_arrays(lc, rc)
+            lorder = np.argsort(_pack_rows(lkeys), kind="stable")
+            lc = ResultChunk(lc.names, [c.take(lorder) for c in lc.columns])
+            if rc.num_rows:
+                rorder = np.argsort(_pack_rows(rkeys), kind="stable")
+                rc = ResultChunk(rc.names,
+                                 [c.take(rorder) for c in rc.columns])
+        yield from _slice_stream(self._join(lc, rc))
+
+
+@dataclass
+class HostIndexLookupJoin(HostHashJoin):
+    """Index nested-loop join (join/index_lookup_join.go analog): streams
+    the outer side and, per chunk, fetches ONLY the matching inner rows
+    through the inner table's index — no inner-side scan.  Chosen via the
+    INL_JOIN hint when the inner side is an indexed bare table."""
+    inner_table: Any = None        # catalog.TableInfo
+    inner_index: Any = None        # IndexInfo whose first column is the key
+    inner_offsets: list = field(default_factory=list)
+    inner_conds: list = field(default_factory=list)   # residual filters
+    inner_names: list = field(default_factory=list)
+    inner_dtypes: list = field(default_factory=list)
+    out_perm: list = None          # column permutation (swapped sides)
+
+    def describe(self):
+        return (f"HostIndexLookupJoin[{self.kind}] inner="
+                f"{self.inner_table.name} index={self.inner_index.name}")
+
+    def chunks(self, ctx, required_rows=None):
+        for och in self.left.chunks(ctx):
+            if self.null_aware:
+                och = self._na_filter(och)
+            rc = self._fetch_inner(och)
+            out = self._join(och, rc)
+            if self.out_perm is not None:
+                out = ResultChunk(list(self.out_names),
+                                  [out.columns[j] for j in self.out_perm])
+            if out.num_rows or och.num_rows == 0:
+                yield out
+
+    def _fetch_inner(self, och: ResultChunk) -> ResultChunk:
+        """Distinct outer keys -> index range reads -> inner ResultChunk."""
+        from ..store.codec import (decode_index_handle, decode_row,
+                                   encode_index_value, index_key,
+                                   record_key)
+        lk = self.eq_keys[0][0]
+        kcol = och.columns[lk]
+        keys = set()
+        vals = kcol.to_python()
+        for v, ok in zip(vals, kcol.validity):
+            if ok:
+                keys.add(v)
+        tbl = self.inner_table
+        kt = tbl.col_types[tbl.col_names.index(self.inner_index.columns[0])]
+        ts = tbl.kv.alloc_ts()
+        rows = []
+        for v in sorted(keys, key=lambda x: (str(type(x)), str(x))):
+            try:
+                part = encode_index_value(v, kt)
+            except (ValueError, TypeError):
+                continue
+            prefix = index_key(tbl.table_id, self.inner_index.index_id,
+                               part)
+            end = prefix + b"\xff"
+            for k, val in tbl.kv.scan(prefix, end, ts):
+                h = decode_index_handle(k, val)
+                data = tbl.kv.get(record_key(tbl.table_id, h), ts)
+                if data is not None:
+                    rows.append(decode_row(data, tbl.col_types))
+        cols = []
+        for out_i, off in enumerate(self.inner_offsets):
+            t = self.inner_dtypes[out_i]
+            cols.append(Column.from_values(
+                t.with_nullable(True), [r[off] for r in rows]))
+        rc = ResultChunk(list(self.inner_names), cols)
+        if self.inner_conds:
+            keep = np.nonzero(_conds_mask(rc, self.inner_conds))[0]
+            rc = ResultChunk(rc.names, [c.take(keep) for c in rc.columns])
+        return rc
+
+
 def _join_key_arrays(a: Column, b: Column):
     """Key columns as comparable int64 arrays; cross-dictionary strings are
     remapped into a merged code space; NULL keys get a sentinel that never

@@ -67,6 +67,19 @@ def to_physical(p: LogicalPlan, no_device_join: bool = False) -> PhysOp:
                        list(p.aggs), out_names=p.schema.names(),
                        out_dtypes=[c.dtype for c in p.schema.cols])
     if isinstance(p, LogicalJoin):
+        method = _join_method_hint(p)
+        if method == "merge":
+            from .physical import HostMergeJoin
+            return HostMergeJoin(p.kind, to_physical(p.left, ndj),
+                                 to_physical(p.right, ndj),
+                                 list(p.eq_keys), list(p.other_conds),
+                                 out_names=p.schema.names(),
+                                 out_dtypes=[c.dtype for c in p.schema.cols],
+                                 null_aware=p.null_aware)
+        if method == "inl":
+            inl = _try_inl_join(p, ndj)
+            if inl is not None:
+                return inl
         return HostHashJoin(p.kind, to_physical(p.left, ndj),
                             to_physical(p.right, ndj),
                             list(p.eq_keys), list(p.other_conds),
@@ -120,6 +133,8 @@ def _try_cop(p: LogicalPlan, no_device_join: bool = False) -> Optional[PhysOp]:
         mids.append(cur)
         cur = cur.child
     if isinstance(cur, LogicalJoin) and not no_device_join:
+        if _join_method_hint(cur):
+            return None      # join-method hint overrides device fusion
         return _try_cop_join(p, top, mids, cur)
     if not isinstance(cur, DataSource):
         return None
@@ -219,6 +234,100 @@ def _try_cop(p: LogicalPlan, no_device_join: bool = False) -> Optional[PhysOp]:
     return CopTaskExec(node, ds.table, out_names=out_names,
                        out_dtypes=out_dtypes, key_meta=key_meta,
                        out_dicts=out_dicts)
+
+
+def _join_method_hint(p: LogicalJoin) -> str:
+    """Effective join-method hint: the node's own annotation, or a leaf
+    marker on a table attached DIRECTLY to this join (not through a
+    nested join) — leaf markers survive join-reorder rebuilds."""
+    if p.hint_method:
+        return p.hint_method
+
+    def direct(n):
+        if n is None or isinstance(n, LogicalJoin):
+            return ""
+        if isinstance(n, DataSource):
+            return getattr(n, "hint_join", "")
+        for c in getattr(n, "children", []):
+            m = direct(c)
+            if m:
+                return m
+        return ""
+    return direct(p.left) or direct(p.right)
+
+
+def _inl_inner_ds(side):
+    """Unwrap a Selection chain to a bare stored-table DataSource."""
+    conds: list = []
+    cur = side
+    while isinstance(cur, LogicalSelection):
+        conds.extend(cur.conditions)
+        cur = cur.child
+    if not isinstance(cur, DataSource) or getattr(cur.table, "kv", None) \
+            is None or getattr(cur.table, "is_memtable", False):
+        return None, None
+    return cur, conds
+
+
+def _try_inl_join(p: LogicalJoin, ndj: bool) -> Optional[PhysOp]:
+    """INL_JOIN hint: the hinted side must reduce to a (possibly filtered)
+    bare DataSource with a public index led by the join key column and a
+    type-compatible outer key.  If join-reorder left the hinted table on
+    the LEFT of an inner join, the sides swap (with an output
+    permutation); otherwise fall back to hash join."""
+    from ..utils.collate import is_binary
+    from .physical import HostIndexLookupJoin
+    if p.kind not in ("inner", "left", "semi", "anti") \
+            or len(p.eq_keys) != 1:
+        return None
+    if p.kind == "anti" and p.null_aware:
+        # NOT IN: a NULL inner key empties the whole result, but index
+        # lookups never observe NULL inner rows — hash join handles it
+        return None
+    li, ri = p.eq_keys[0]
+
+    def build(outer, inner, ok, ik, swapped):
+        ds, conds = _inl_inner_ds(inner)
+        if ds is None:
+            return None
+        key_name = ds.schema.cols[ik].name.lower()
+        ot = outer.schema.cols[ok].dtype
+        it = ds.schema.cols[ik].dtype
+        if ot.kind != it.kind or ot.scale != it.scale:
+            return None
+        if it.is_string and not is_binary(it.collation):
+            return None      # ci keys: index bytes are binary-exact
+        ix = next((x for x in getattr(ds.table, "indexes", [])
+                   if x.state == "public"
+                   and x.columns[0].lower() == key_name), None)
+        if ix is None:
+            return None
+        n_out = len(outer.schema)
+        if swapped:
+            # physical output is outer++inner = right++left; permute back
+            n_in = len(ds.schema)
+            perm = list(range(n_out, n_out + n_in)) + list(range(n_out))
+        else:
+            perm = None
+        return HostIndexLookupJoin(
+            p.kind, to_physical(outer, ndj), to_physical(inner, ndj),
+            [(ok, ik)], list(p.other_conds),
+            out_names=p.schema.names(),
+            out_dtypes=[c.dtype for c in p.schema.cols],
+            null_aware=p.null_aware,
+            inner_table=ds.table, inner_index=ix,
+            inner_offsets=list(ds.col_offsets), inner_conds=conds,
+            inner_names=ds.schema.names(),
+            inner_dtypes=[c.dtype for c in ds.schema.cols],
+            out_perm=perm)
+
+    built = build(p.left, p.right, li, ri, swapped=False)
+    if built is not None:
+        return built
+    if p.kind == "inner" and not p.other_conds:
+        # inner joins commute: lookup through the LEFT side's index
+        return build(p.right, p.left, ri, li, swapped=True)
+    return None
 
 
 BROADCAST_BUILD_MAX_ROWS = 1 << 22     # broadcast-join build-side cap

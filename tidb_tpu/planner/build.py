@@ -330,6 +330,16 @@ class ExprBuilder:
                 raise PlanError(f"{name} takes {need[0]}"
                                 + (f"..{need[1]}" if need[1] != need[0]
                                    else "") + " arguments")
+            path_pos = {"JSON_EXTRACT": 1, "JSON_LENGTH": 1,
+                        "JSON_CONTAINS": 2}.get(name)
+            if path_pos is not None and path_pos < len(args) \
+                    and isinstance(args[path_pos], Const) \
+                    and isinstance(args[path_pos].value, str):
+                from ..utils.jsonfns import JSONPathError, parse_path
+                try:
+                    parse_path(args[path_pos].value)
+                except JSONPathError as e:
+                    raise PlanError(str(e))
             return self._str_func(name.lower(), *args)
         if name == "IF":
             return B.if_(args[0], args[1], args[2])
@@ -566,7 +576,51 @@ def build_select(sel: A.SelectStmt, catalog, default_db: str,
         plan = LogicalAggregate(plan, [plan.schema.ref(i)
                                        for i in range(len(plan.schema))], [],
                                 Schema(list(plan.schema.cols)))
+    _apply_hints(plan, sel.hints)
     return BuiltSelect(plan, names)
+
+
+from .logical import find_datasource as _find_ds
+from .logical import walk_plan as _walk_plan
+
+_JOIN_METHOD_HINTS = {
+    "HASH_JOIN": "hash", "TIDB_HJ": "hash",
+    "MERGE_JOIN": "merge", "SM_JOIN": "merge", "TIDB_SMJ": "merge",
+    "INL_JOIN": "inl", "INL_HASH_JOIN": "inl", "TIDB_INLJ": "inl",
+}
+
+
+def _apply_hints(plan: LogicalPlan, hints: list) -> None:
+    """Annotate the logical plan with optimizer hints (the hintProcessor
+    role of planner/core/hints): join method, index choice, join order."""
+    if not hints:
+        return
+    joins = [n for n in _walk_plan(plan) if isinstance(n, LogicalJoin)]
+    # innermost joins first: the SMALLEST join containing the hinted table
+    # is the one the hint names (preorder would always hit the root)
+    joins.sort(key=lambda j: sum(1 for _ in _walk_plan(j)))
+    for name, args in hints:
+        if name in _JOIN_METHOD_HINTS:
+            method = _JOIN_METHOD_HINTS[name]
+            for t in args:
+                ds = _find_ds(plan, t)
+                if ds is not None and not ds.hint_join:
+                    ds.hint_join = method   # leaf marker survives reorder
+                for j in joins:
+                    if _find_ds(j, t) is not None and not j.hint_method:
+                        j.hint_method = method
+                        break
+        elif name == "USE_INDEX" and args:
+            ds = _find_ds(plan, args[0])
+            if ds is not None:
+                ds.hint_use = [a.lower() for a in args[1:]] or None
+        elif name == "IGNORE_INDEX" and args:
+            ds = _find_ds(plan, args[0])
+            if ds is not None:
+                ds.hint_ignore = [a.lower() for a in args[1:]]
+        elif name == "LEADING" and args and joins:
+            joins[0].hint_leading = list(args)
+        # unknown hints are accepted and ignored (MySQL warning semantics)
 
 
 def _build_no_table(sel: A.SelectStmt) -> BuiltSelect:

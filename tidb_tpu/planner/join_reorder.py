@@ -170,8 +170,20 @@ def _reorder_group(root: LogicalJoin, stats_handle) -> LogicalPlan:
                     best = ndv if best is None else max(best, ndv)
         return best
 
-    # greedy: smallest leaf first, then minimize the running estimate
-    order = [min(range(len(leaves)), key=lambda i: rows[i])]
+    # greedy: smallest leaf first, then minimize the running estimate.
+    # LEADING(t, ...) pins the hinted table as the greedy start.
+    order = None
+    lead = getattr(root, "hint_leading", None)
+    if lead:
+        from .logical import find_datasource
+        for t in lead:
+            hit = next((i for i, l in enumerate(leaves)
+                        if find_datasource(l, t) is not None), None)
+            if hit is not None:
+                order = [hit]
+                break
+    if order is None:
+        order = [min(range(len(leaves)), key=lambda i: rows[i])]
     cur_rows = rows[order[0]]
     remaining = set(range(len(leaves))) - set(order)
     while remaining:

@@ -62,6 +62,11 @@ class DataSource(LogicalPlan):
     alias: str
     schema: Schema = None
     col_offsets: list[int] = None  # into the table's stored columns
+    hint_use: list = None          # USE_INDEX(t, ix...) index names
+    hint_ignore: list = None       # IGNORE_INDEX(t, ix...)
+    # join-method hint naming this table ('' | 'hash' | 'merge' | 'inl');
+    # carried on the LEAF so join-reorder rebuilds don't lose it
+    hint_join: str = ""
 
     def __post_init__(self):
         self.children = []
@@ -119,6 +124,9 @@ class LogicalJoin(LogicalPlan):
     # NOT IN semantics (null-aware anti join, rule_decorrelate.go analog):
     # any NULL build key empties the result; NULL probe keys never pass
     null_aware: bool = False
+    # optimizer-hint join method: '' | 'hash' | 'merge' | 'inl'
+    hint_method: str = ""
+    hint_leading: list = None      # LEADING(t1, t2, ...) table order
 
     def __post_init__(self):
         self.children = [self.left, self.right]
@@ -228,6 +236,24 @@ class LogicalCTEScan(LogicalPlan):
 
     def __post_init__(self):
         self.children = []
+
+
+def walk_plan(p: LogicalPlan):
+    """Preorder walk over a logical plan tree."""
+    yield p
+    for c in getattr(p, "children", []):
+        if c is not None:
+            yield from walk_plan(c)
+
+
+def find_datasource(p: LogicalPlan, name: str):
+    """DataSource with the given alias (case-insensitive), or None — the
+    one shared alias-resolution walk (hints, LEADING, join-method)."""
+    low = name.lower()
+    for n in walk_plan(p):
+        if isinstance(n, DataSource) and n.alias.lower() == low:
+            return n
+    return None
 
 
 def explain_logical(p: LogicalPlan, indent: int = 0) -> str:

@@ -181,9 +181,15 @@ def choose_index(conditions: list[Expr], ds: DataSource,
     tbl = ds.table
     if getattr(tbl, "kv", None) is None:
         return None
+    use = getattr(ds, "hint_use", None)
+    ignore = getattr(ds, "hint_ignore", None) or []
     best: Optional[IndexAccess] = None
     for ix in getattr(tbl, "indexes", []):
         if ix.state != "public":
+            continue
+        if ix.name.lower() in ignore:
+            continue
+        if use is not None and ix.name.lower() not in use:
             continue
         acc = match_index(conditions, ds, ix)
         if acc is None:
@@ -192,6 +198,8 @@ def choose_index(conditions: list[Expr], ds: DataSource,
             best = acc
     if best is None or best.is_point or stats is None:
         return best
+    if use is not None:
+        return best       # USE_INDEX forces the path past the cost model
     cost_idx = _index_cost(best, ds, stats)
     cost_scan = tbl.num_rows * SCAN_ROW_COST
     return best if cost_idx < cost_scan else None

@@ -244,8 +244,8 @@ class TableInfo:
 
     # ---------------- write path ---------------- #
 
-    def insert_rows(self, rows: list[tuple], txn=None) -> int:
-        from .codec_io import encode_table_row  # local import, avoids cycle
+    def _prepare_insert(self, rows: list[tuple]) -> tuple[list[tuple], int]:
+        """Validate + canonicalize rows and allocate handles/auto-inc."""
         for r in rows:
             if len(r) != len(self.col_names):
                 raise CatalogError(
@@ -273,17 +273,35 @@ class TableInfo:
                 fixed.append(tuple(r))
             first_handle = self._next_handle + 1
             self._next_handle += len(fixed)
+        return fixed, first_handle
+
+    def _insert_fixed(self, t, fixed: list[tuple], first_handle: int):
+        """Write prepared rows into an open txn. Caller holds the schema
+        gate's read side.  Uniqueness is PRE-checked before any buffered
+        write so a DuplicateKeyError leaves the txn clean — INSERT IGNORE
+        inside an explicit transaction must not leave a half-written row."""
+        from .codec_io import encode_table_row
+        for j, r in enumerate(fixed):
+            h = first_handle + j
+            for ix in self.writable_indexes():
+                if not ix.unique:
+                    continue
+                key, val = self._index_entry(ix, r, h)
+                if val and t.get(key) is not None:
+                    raise DuplicateKeyError(
+                        f"Duplicate entry for key '{self.name}.{ix.name}'")
+            key, val = encode_table_row(self.table_id, h, r, self.col_types)
+            t.put(key, val)
+            self._write_index_entries(t, r, h)
+
+    def insert_rows(self, rows: list[tuple], txn=None) -> int:
+        fixed, first_handle = self._prepare_insert(rows)
         if self.kv is not None:
             own = txn is None
             with self.schema_gate.read():
                 t = txn or self.kv.begin()
                 try:
-                    for j, r in enumerate(fixed):
-                        h = first_handle + j
-                        key, val = encode_table_row(self.table_id, h,
-                                                    r, self.col_types)
-                        t.put(key, val)
-                        self._write_index_entries(t, r, h)
+                    self._insert_fixed(t, fixed, first_handle)
                     if own:
                         t.commit()
                 except Exception:
@@ -294,6 +312,53 @@ class TableInfo:
             self._pending.extend(fixed)
         self._invalidate()
         return len(fixed)
+
+    def replace_rows(self, rows: list[tuple], txn=None) -> int:
+        """REPLACE INTO semantics (executor/replace.go analog): per row,
+        delete every existing row that conflicts on a public unique index,
+        then insert.  Returns deleted + inserted (MySQL affected-rows
+        counting).  Rows process in order, so later rows replace earlier
+        ones within one batch."""
+        from ..store.codec import decode_index_handle, decode_row, record_key
+        uix = [ix for ix in self.indexes
+               if ix.unique and ix.state == "public"]
+        if self.kv is None:
+            raise CatalogError("REPLACE requires the KV row store")
+        affected = 0
+        own = txn is None
+        with self.schema_gate.read():
+            t = txn or self.kv.begin()
+            try:
+                for r in rows:
+                    fixed, fh = self._prepare_insert([r])
+                    canon = fixed[0]
+                    for ix in uix:
+                        offs = self._index_cols(ix)
+                        if any(canon[i] is None for i in offs):
+                            continue     # NULL unique keys never conflict
+                        key, _ = self._index_entry(ix, canon, 0)
+                        got = t.get(key)
+                        if got is None:
+                            continue
+                        h = decode_index_handle(key, got)
+                        rk = record_key(self.table_id, h)
+                        data = t.get(rk)
+                        if data is None:
+                            continue
+                        old = tuple(decode_row(data, self.col_types))
+                        self._delete_index_entries(t, old, h)
+                        t.delete(rk)
+                        affected += 1
+                    self._insert_fixed(t, fixed, fh)
+                    affected += 1
+                if own:
+                    t.commit()
+            except Exception:
+                if own:
+                    t.rollback()
+                raise
+        self._invalidate()
+        return affected
 
     def update_rows(self, handles, old_rows, new_rows, txn=None) -> int:
         """Rewrite specific rows IN PLACE (stable handles) through the row

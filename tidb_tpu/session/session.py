@@ -271,6 +271,8 @@ class Session:
             return self._exec_alter(stmt)
         if isinstance(stmt, A.Insert):
             return self._exec_insert(stmt)
+        if isinstance(stmt, A.LoadData):
+            return self._exec_load_data(stmt)
         if isinstance(stmt, A.Update):
             return self._exec_update(stmt)
         if isinstance(stmt, A.Delete):
@@ -804,15 +806,91 @@ class Session:
                 full.append(tuple(
                     r[idx[n]] if n in idx else None for n in tbl.col_names))
             rows = full
-        if self.txn is None:
-            n = self._retry_write_conflict(
-                lambda: tbl.insert_rows(rows, txn=None))
+        if stmt.replace:
+            write = lambda txn: tbl.replace_rows(rows, txn=txn)
+        elif stmt.ignore:
+            write = lambda txn: self._insert_ignore(tbl, rows, txn)
         else:
-            n = tbl.insert_rows(rows, txn=self.txn)
+            write = lambda txn: tbl.insert_rows(rows, txn=txn)
+        if self.txn is None:
+            n = self._retry_write_conflict(lambda: write(None))
+        else:
+            n = write(self.txn)
         if self.txn is not None:
             self._txn_tables.add(tbl)
         self.domain.stats.note_modify(tbl, n)
         return ResultSet(affected=n)
+
+    @staticmethod
+    def _insert_ignore(tbl, rows, txn) -> int:
+        """INSERT IGNORE: duplicate-key rows are skipped, not errors."""
+        from .catalog import DuplicateKeyError
+        n = 0
+        for r in rows:
+            try:
+                n += tbl.insert_rows([r], txn=txn)
+            except DuplicateKeyError:
+                pass
+        return n
+
+    def _exec_load_data(self, stmt: A.LoadData) -> ResultSet:
+        """LOAD DATA INFILE (executor/load_data.go analog): parse the file
+        with the FIELDS/LINES options and batch-insert."""
+        import csv as _csv
+        import io
+        tbl = self.domain.catalog.get_table(self.db, stmt.table)
+        try:
+            with open(stmt.path, "r", newline="") as f:
+                text = f.read()
+        except OSError as e:
+            raise CatalogError(f"cannot read {stmt.path!r}: {e}")
+        if stmt.line_sep not in ("\n", "\r\n"):
+            text = text.replace(stmt.line_sep, "\n")
+        sep = stmt.field_sep or "\t"
+        if len(sep) > 1:
+            # csv only takes 1-char delimiters: normalize multi-char
+            # separators to an unlikely control char first
+            text = text.replace(sep, "\x01")
+            sep = "\x01"
+        reader = _csv.reader(
+            io.StringIO(text), delimiter=sep,
+            quotechar=(stmt.enclosed or '"')[0])
+        names = stmt.columns or tbl.col_names
+        idx = {n: i for i, n in enumerate(names)}
+        total = 0
+        batch: list[tuple] = []
+
+        def flush():
+            nonlocal total
+            if not batch:
+                return
+            if stmt.replace:
+                total += tbl.replace_rows(batch, txn=self.txn)
+            else:
+                total += self._insert_ignore(tbl, batch, self.txn)
+            batch.clear()
+
+        for ln, rec in enumerate(reader):
+            if ln < stmt.ignore_lines or not rec:
+                continue
+            vals = []
+            for cn, ct in zip(tbl.col_names, tbl.col_types):
+                if cn not in idx or idx[cn] >= len(rec):
+                    vals.append(None)
+                    continue
+                raw = rec[idx[cn]]
+                if raw == "\\N" or (raw == "" and not ct.is_string):
+                    vals.append(None)
+                else:
+                    vals.append(raw)
+            batch.append(tuple(vals))
+            if len(batch) >= 4096:
+                flush()
+        flush()
+        if self.txn is not None:
+            self._txn_tables.add(tbl)
+        self.domain.stats.note_modify(tbl, total)
+        return ResultSet(affected=total)
 
     def _where_mask(self, tbl: TableInfo, where: Optional[A.Node]) -> np.ndarray:
         """Evaluate WHERE over the table snapshot -> bool mask (NULL=false)."""

@@ -167,6 +167,7 @@ class SelectStmt(Node):
     distinct: bool = False
     ctes: list["CTE"] = field(default_factory=list)
     recursive: bool = False         # WITH RECURSIVE
+    hints: list[tuple] = field(default_factory=list)  # [(NAME, [args])]
 
 
 @dataclass
@@ -279,6 +280,21 @@ class Insert(Node):
     columns: list[str] = field(default_factory=list)
     rows: list[list[Node]] = field(default_factory=list)
     select: Optional[SelectStmt] = None
+    replace: bool = False           # REPLACE INTO: delete conflicts first
+    ignore: bool = False            # INSERT IGNORE: skip dup-key rows
+
+
+@dataclass
+class LoadData(Node):
+    """LOAD DATA INFILE (executor/load_data.go analog)."""
+    path: str = ""
+    table: str = ""
+    columns: list[str] = field(default_factory=list)
+    field_sep: str = "\t"
+    enclosed: str = ""
+    line_sep: str = "\n"
+    ignore_lines: int = 0
+    replace: bool = False
 
 
 @dataclass

@@ -32,6 +32,8 @@ KEYWORDS = {
     "PRECEDING", "FOLLOWING", "CURRENT", "ROW", "WINDOW",
     "USER", "GRANT", "REVOKE", "GRANTS", "IDENTIFIED", "PRIVILEGES", "TO",
     "FLUSH", "PASSWORD", "FOR",
+    "REPLACE", "IGNORE", "LOAD", "DATA", "INFILE", "LOCAL", "FIELDS",
+    "TERMINATED", "ENCLOSED", "OPTIONALLY", "LINES",
 }
 
 # multi-char operators first (maximal munch)
@@ -67,6 +69,10 @@ def tokenize(sql: str) -> list[Token]:
             j = sql.find("*/", i + 2)
             if j < 0:
                 raise LexError(f"unterminated comment at {i}")
+            if sql.startswith("/*+", i):
+                # optimizer hint comment (parser_driver hint analog):
+                # surface the body as one token for the hint parser
+                toks.append(Token("hint", sql[i + 3:j].strip(), i))
             i = j + 2
             continue
         if c == "`":

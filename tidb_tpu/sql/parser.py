@@ -26,7 +26,14 @@ class ParseError(ValueError):
 
 class Parser:
     def __init__(self, sql: str):
-        self.toks = tokenize(sql)
+        toks = tokenize(sql)
+        # hint comments are only meaningful right after SELECT; anywhere
+        # else they behave like ordinary comments (dropped), so SQL such
+        # as `UPDATE /*+ x */ t SET ...` still parses
+        self.toks = [t for j, t in enumerate(toks)
+                     if t.kind != "hint"
+                     or (j > 0 and toks[j - 1].kind == "kw"
+                         and toks[j - 1].text == "SELECT")]
         self.i = 0
 
     # ---------------- token helpers ---------------- #
@@ -114,6 +121,10 @@ class Parser:
             return self.drop_stmt()
         if self.at_kw("INSERT"):
             return self.insert_stmt()
+        if self.at_kw("REPLACE"):
+            return self.insert_stmt(replace=True)
+        if self.at_kw("LOAD"):
+            return self.load_data_stmt()
         if self.at_kw("UPDATE"):
             return self.update_stmt()
         if self.at_kw("DELETE"):
@@ -415,6 +426,8 @@ class Parser:
     def select_stmt(self) -> A.SelectStmt:
         self.expect_kw("SELECT")
         s = A.SelectStmt()
+        if self.cur.kind == "hint":
+            s.hints = _parse_hints(self.advance().text)
         if self.accept_kw("DISTINCT"):
             s.distinct = True
         else:
@@ -799,13 +812,18 @@ class Parser:
             names.append(self.ident())
         return A.DropTable(names, ie)
 
-    def insert_stmt(self) -> A.Insert:
-        self.expect_kw("INSERT")
+    def insert_stmt(self, replace: bool = False) -> A.Insert:
+        ignore = False
+        if replace:
+            self.expect_kw("REPLACE")
+        else:
+            self.expect_kw("INSERT")
+            ignore = self.accept_kw("IGNORE")
         self.expect_kw("INTO")
         name = self.ident()
         if self.accept_op("."):
             name = self.ident()
-        ins = A.Insert(name)
+        ins = A.Insert(name, replace=replace, ignore=ignore)
         if self.accept_op("("):
             ins.columns = [self.ident()]
             while self.accept_op(","):
@@ -825,6 +843,47 @@ class Parser:
             if not self.accept_op(","):
                 break
         return ins
+
+    def load_data_stmt(self) -> A.LoadData:
+        self.expect_kw("LOAD")
+        self.expect_kw("DATA")
+        self.accept_kw("LOCAL")
+        self.expect_kw("INFILE")
+        ld = A.LoadData(path=self._str_lit())
+        if self.accept_kw("REPLACE"):
+            ld.replace = True
+        else:
+            self.accept_kw("IGNORE")      # dup-key policy; default skip
+        self.expect_kw("INTO")
+        self.expect_kw("TABLE")
+        ld.table = self.ident()
+        if self.accept_kw("FIELDS") or self.accept_kw("COLUMNS"):
+            while True:
+                if self.accept_kw("TERMINATED"):
+                    self.expect_kw("BY")
+                    ld.field_sep = self._str_lit()
+                elif self.accept_kw("ENCLOSED"):
+                    self.expect_kw("BY")
+                    ld.enclosed = self._str_lit()
+                elif self.accept_kw("OPTIONALLY"):
+                    self.expect_kw("ENCLOSED")
+                    self.expect_kw("BY")
+                    ld.enclosed = self._str_lit()
+                else:
+                    break
+        if self.accept_kw("LINES"):
+            self.expect_kw("TERMINATED")
+            self.expect_kw("BY")
+            ld.line_sep = self._str_lit()
+        if self.accept_kw("IGNORE"):
+            ld.ignore_lines = self._int_lit()
+            self.expect_kw("LINES")
+        if self.accept_op("("):
+            ld.columns = [self.ident()]
+            while self.accept_op(","):
+                ld.columns.append(self.ident())
+            self.expect_op(")")
+        return ld
 
     def update_stmt(self) -> A.Update:
         self.expect_kw("UPDATE")
@@ -1259,7 +1318,7 @@ class Parser:
 # keywords that can also start function calls (YEAR(x), DATE(x), IF(...))
 _FUNC_KEYWORDS = {"YEAR", "MONTH", "DAY", "HOUR", "MINUTE", "SECOND", "IF",
                   "DATE", "TIME", "SUBSTRING", "TRUNCATE", "LEFT", "RIGHT",
-                  "MOD", "CHARACTER"}
+                  "MOD", "CHARACTER", "REPLACE"}
 
 # keywords allowed as plain identifiers (column/table names)
 _NONRESERVED = {"YEAR", "MONTH", "DAY", "HOUR", "MINUTE", "SECOND", "DATE",
@@ -1269,7 +1328,28 @@ _NONRESERVED = {"YEAR", "MONTH", "DAY", "HOUR", "MINUTE", "SECOND", "DATE",
                 "TRANSACTION", "TRUNCATE", "ROW", "ROWS", "RANGE", "OVER",
                 "PARTITION", "CURRENT", "WINDOW", "RECURSIVE", "PRECEDING",
                 "FOLLOWING", "UNBOUNDED", "USER", "GRANTS", "PRIVILEGES",
-                "PASSWORD", "FLUSH", "IDENTIFIED"}
+                "PASSWORD", "FLUSH", "IDENTIFIED",
+                "DATA", "LOCAL", "FIELDS", "LINES", "TERMINATED",
+                "ENCLOSED", "OPTIONALLY", "INFILE"}
+
+
+_HINT_RE = None
+
+
+def _parse_hints(body: str) -> list[tuple]:
+    """`NAME(arg, ...) NAME2(...) ...` -> [(NAME, [args])] (the
+    parser_driver optimizer-hint grammar, simplified)."""
+    import re
+    global _HINT_RE
+    if _HINT_RE is None:
+        _HINT_RE = re.compile(
+            r"([A-Za-z_][A-Za-z0-9_]*)\s*(?:\(([^)]*)\))?")
+    out = []
+    for m in _HINT_RE.finditer(body):
+        args = [a.strip().strip("`") for a in (m.group(2) or "").split(",")
+                if a.strip()]
+        out.append((m.group(1).upper(), args))
+    return out
 
 
 def parse_sql(sql: str) -> list[A.Node]:
