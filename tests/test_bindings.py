@@ -1,0 +1,95 @@
+"""SQL plan management: plan bindings (pkg/bindinfo analog) and the
+index advisor (ADMIN RECOMMEND INDEX)."""
+
+import pytest
+
+from tidb_tpu.planner.build import PlanError
+from tidb_tpu.session import Domain, Session
+
+Q = "select b.v, sm.w from big b join small sm on b.k = sm.k"
+HINTED = ("select /*+ MERGE_JOIN(sm) */ b.v, sm.w from big b "
+          "join small sm on b.k = sm.k")
+
+
+@pytest.fixture()
+def sess():
+    s = Session(Domain())
+    s.execute("create table big (k bigint, v bigint)")
+    s.execute("create table small (k bigint, w bigint)")
+    s.execute("insert into big values " +
+              ",".join(f"({i % 50},{i})" for i in range(500)))
+    s.execute("insert into small values (3,30),(7,70)")
+    return s
+
+
+def _join_line(s, q):
+    plan = "\n".join(r[0] for r in s.must_query("explain " + q))
+    return next(l.strip() for l in plan.splitlines() if "Join" in l)
+
+
+def test_binding_applies_and_drops(sess):
+    base = sorted(sess.must_query(Q))
+    sess.execute(f"create global binding for {Q} using {HINTED}")
+    assert "HostMergeJoin" in _join_line(sess, Q)
+    assert sorted(sess.must_query(Q)) == base
+    sess.execute(f"drop global binding for {Q}")
+    assert "HostMergeJoin" not in _join_line(sess, Q)
+
+
+def test_binding_matches_across_literals(sess):
+    sess.execute("create index ik on big (k)")
+    plan0 = "\n".join(r[0] for r in sess.must_query(
+        "explain select v from big where k = 42"))
+    assert "IndexLookUp" in plan0, plan0
+    sess.execute(
+        "create global binding for select v from big where k = 1 "
+        "using select /*+ IGNORE_INDEX(big, ik) */ v from big where k = 1")
+    # different literal, same digest: the binding's hint must apply
+    plan1 = "\n".join(r[0] for r in sess.must_query(
+        "explain select v from big where k = 42"))
+    assert "IndexLookUp" not in plan1, plan1
+
+
+def test_show_bindings_scope_filter(sess):
+    sess.execute(f"create global binding for {Q} using {HINTED}")
+    assert sess.must_query("show session bindings") == []
+    assert len(sess.must_query("show global bindings")) == 1
+    # default scope is SESSION (TiDB semantics)
+    sess.execute(f"create binding for {Q} using {HINTED}")
+    assert len(sess.must_query("show session bindings")) == 1
+
+
+def test_session_binding_shadows_global(sess):
+    sess.execute(f"create global binding for {Q} using {HINTED}")
+    hashed = ("select /*+ HASH_JOIN(sm) */ b.v, sm.w from big b "
+              "join small sm on b.k = sm.k")
+    sess.execute(f"create session binding for {Q} using {hashed}")
+    assert "HostHashJoin" in _join_line(sess, Q)
+    rows = sess.must_query("show bindings")
+    assert {r[3] for r in rows} == {"session", "global"}
+
+
+def test_binding_requires_hints_and_same_digest(sess):
+    with pytest.raises(PlanError):
+        sess.execute(f"create global binding for {Q} using {Q}")
+    with pytest.raises(PlanError):
+        sess.execute(
+            f"create global binding for {Q} using "
+            "select /*+ HASH_JOIN(sm) */ w from small sm")
+
+
+def test_plan_cache_does_not_shadow_binding(sess):
+    sess.must_query(Q)                       # warm the plan cache unhinted
+    sess.execute(f"create global binding for {Q} using {HINTED}")
+    assert "HostMergeJoin" in _join_line(sess, Q)
+
+
+def test_index_advisor(sess):
+    for _ in range(4):
+        sess.must_query("select v from big where k = 9")
+    recs = sess.must_query("admin recommend index")
+    assert any(r[0] == "big" and r[1] == "k" for r in recs), recs
+    # once indexed, the recommendation disappears
+    sess.execute("create index ik on big (k)")
+    recs2 = sess.must_query("admin recommend index")
+    assert not any(r[0] == "big" and "k" in r[1] for r in recs2), recs2

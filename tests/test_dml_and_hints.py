@@ -170,6 +170,47 @@ def test_bad_json_path_is_plan_error(sess):
         sess.must_query("select json_extract(name, 'a') from t")
 
 
+def test_load_data_duplicate_errors_without_ignore(tmp_path, sess):
+    from tidb_tpu.session.catalog import DuplicateKeyError
+    p = tmp_path / "dup.csv"
+    p.write_text("1,dup,0\n")
+    with pytest.raises(DuplicateKeyError):
+        sess.execute(f"load data infile '{p}' into table t "
+                     "fields terminated by ','")
+    p2 = tmp_path / "dup2.csv"
+    p2.write_text("1,dup,0\n50,fifty,500\n")
+    r = sess.execute(f"load data infile '{p2}' ignore into table t "
+                     "fields terminated by ','")
+    assert r.affected == 1
+
+
+def test_multi_row_insert_dup_keeps_txn_clean(sess):
+    from tidb_tpu.session.catalog import DuplicateKeyError
+    sess.execute("begin")
+    with pytest.raises(DuplicateKeyError):
+        sess.execute("insert into t values (9,'x',0), (1,'dup',0)")
+    sess.execute("commit")
+    # statement atomicity: the pre-dup row must not have been committed
+    assert sess.must_query("select count(*) from t where id = 9") == [(0,)]
+
+
+def test_leading_hint_three_tables(jsess):
+    jsess.execute("create table third (k bigint, z bigint)")
+    jsess.execute("insert into third values (3,1),(7,2)")
+    q = ("select /*+ LEADING(b) */ count(*) from big b, small sm, third th "
+         "where b.k = sm.k and sm.k = th.k")
+    plan = "\n".join(r[0] for r in jsess.must_query("explain " + q))
+    # LEADING(b) pins big as the greedy start leaf: without the hint the
+    # smallest table (small/third) would lead
+    exp = jsess.must_query(
+        "select count(*) from big b, small sm, third th "
+        "where b.k = sm.k and sm.k = th.k")
+    assert jsess.must_query(q) == exp
+    # big leads: it is the probe/outer of the innermost (first) join
+    assert any("probe=big" in l for l in plan.splitlines()
+               if "probe=" in l), plan
+
+
 def test_load_data_multichar_separator(tmp_path, sess):
     p = tmp_path / "m.txt"
     p.write_text("30||thirty||300\n")

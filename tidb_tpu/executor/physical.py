@@ -1035,15 +1035,30 @@ class HostMergeJoin(HostHashJoin):
                 if not rc.columns[rk].validity.all():
                     return
             lc = self._na_filter(lc)
-        if self.eq_keys and lc.num_rows:
-            lkeys, rkeys = self._key_arrays(lc, rc)
-            lorder = np.argsort(_pack_rows(lkeys), kind="stable")
-            lc = ResultChunk(lc.names, [c.take(lorder) for c in lc.columns])
-            if rc.num_rows:
-                rorder = np.argsort(_pack_rows(rkeys), kind="stable")
-                rc = ResultChunk(rc.names,
-                                 [c.take(rorder) for c in rc.columns])
-        yield from _slice_stream(self._join(lc, rc))
+        from ..utils.memory import nbytes_of
+        extra = nbytes_of(lc.columns) + nbytes_of(rc.columns)
+        remaining = ctx.remaining_quota()
+        if (remaining is not None and extra > remaining
+                and ctx.spill_enabled and self.eq_keys
+                and min(lc.num_rows, rc.num_rows) > 1):
+            # over quota: fall back to the partition-spill hash join
+            # (bounded memory beats preserving merge order)
+            yield self._execute_spilled(ctx, lc, rc)
+            return
+        ctx.track(extra)
+        try:
+            if self.eq_keys and lc.num_rows:
+                lkeys, rkeys = self._key_arrays(lc, rc)
+                lorder = np.argsort(_pack_rows(lkeys), kind="stable")
+                lc = ResultChunk(lc.names,
+                                 [c.take(lorder) for c in lc.columns])
+                if rc.num_rows:
+                    rorder = np.argsort(_pack_rows(rkeys), kind="stable")
+                    rc = ResultChunk(rc.names,
+                                     [c.take(rorder) for c in rc.columns])
+            yield from _slice_stream(self._join(lc, rc))
+        finally:
+            ctx.release(extra)
 
 
 @dataclass

@@ -321,12 +321,24 @@ def _try_inl_join(p: LogicalJoin, ndj: bool) -> Optional[PhysOp]:
             inner_dtypes=[c.dtype for c in ds.schema.cols],
             out_perm=perm)
 
-    built = build(p.left, p.right, li, ri, swapped=False)
-    if built is not None:
-        return built
-    if p.kind == "inner" and not p.other_conds:
-        # inner joins commute: lookup through the LEFT side's index
-        return build(p.right, p.left, ri, li, swapped=True)
+    # honor WHICH table the hint named as the lookup inner: prefer the
+    # side carrying the 'inl' leaf marker
+    lds, _ = _inl_inner_ds(p.left)
+    rds, _ = _inl_inner_ds(p.right)
+    left_hinted = (lds is not None
+                   and getattr(lds, "hint_join", "") == "inl"
+                   and not (rds is not None
+                            and getattr(rds, "hint_join", "") == "inl"))
+    tries = [(p.left, p.right, li, ri, False),
+             (p.right, p.left, ri, li, True)]
+    if left_hinted:
+        tries.reverse()
+    for outer, inner, ok, ik, swapped in tries:
+        if swapped and (p.kind != "inner" or p.other_conds):
+            continue     # only inner joins without residuals commute
+        built = build(outer, inner, ok, ik, swapped)
+        if built is not None:
+            return built
     return None
 
 

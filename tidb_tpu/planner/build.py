@@ -619,7 +619,9 @@ def _apply_hints(plan: LogicalPlan, hints: list) -> None:
             if ds is not None:
                 ds.hint_ignore = [a.lower() for a in args[1:]]
         elif name == "LEADING" and args and joins:
-            joins[0].hint_leading = list(args)
+            # join-reorder reads the hint from the GROUP ROOT (the
+            # outermost join of the flattened inner-join group)
+            joins[-1].hint_leading = list(args)
         # unknown hints are accepted and ignored (MySQL warning semantics)
 
 

@@ -277,19 +277,24 @@ class TableInfo:
 
     def _insert_fixed(self, t, fixed: list[tuple], first_handle: int):
         """Write prepared rows into an open txn. Caller holds the schema
-        gate's read side.  Uniqueness is PRE-checked before any buffered
-        write so a DuplicateKeyError leaves the txn clean — INSERT IGNORE
-        inside an explicit transaction must not leave a half-written row."""
+        gate's read side.  Uniqueness is PRE-checked for the WHOLE batch
+        (including intra-batch duplicates) before any buffered write, so a
+        DuplicateKeyError leaves the txn clean — statement atomicity
+        inside an explicit transaction."""
         from .codec_io import encode_table_row
+        uix = [ix for ix in self.writable_indexes() if ix.unique]
+        seen: set = set()
         for j, r in enumerate(fixed):
-            h = first_handle + j
-            for ix in self.writable_indexes():
-                if not ix.unique:
-                    continue
-                key, val = self._index_entry(ix, r, h)
-                if val and t.get(key) is not None:
+            for ix in uix:
+                key, val = self._index_entry(ix, r, first_handle + j)
+                if not val:
+                    continue        # NULL-containing keys never conflict
+                if key in seen or t.get(key) is not None:
                     raise DuplicateKeyError(
                         f"Duplicate entry for key '{self.name}.{ix.name}'")
+                seen.add(key)
+        for j, r in enumerate(fixed):
+            h = first_handle + j
             key, val = encode_table_row(self.table_id, h, r, self.col_types)
             t.put(key, val)
             self._write_index_entries(t, r, h)

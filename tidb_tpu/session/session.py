@@ -86,6 +86,8 @@ class Domain:
         self._ddl_mu = threading.Lock()
         from ..utils.stmtsummary import StmtSummary
         self.stmt_summary = StmtSummary()   # util/stmtsummary analog
+        from ..planner.bindinfo import BindManager
+        self.bindings = BindManager()       # GLOBAL plan bindings
         if not hasattr(self, "_next_table_id"):   # durable mode recovered it
             self._next_table_id = 100
         self.sysvars: dict[str, Any] = {
@@ -182,6 +184,8 @@ class Session:
         self.user = user
         self.vars: dict[str, Any] = {}
         self.user_vars: dict[str, Any] = {}      # SET @x = ...
+        from ..planner.bindinfo import BindManager
+        self.bindings = BindManager()            # SESSION plan bindings
         self.prepared: dict[str, tuple[str, int]] = {}  # name -> (sql, n_params)
         self.txn = None              # active explicit transaction
         self._txn_tables: set = set()
@@ -197,6 +201,24 @@ class Session:
             span = getattr(stmt, "text_span", None)
             text = sql[span[0]:span[1]].strip() if span else sql
             self._cur_sql = text
+            # plan bindings: a matching digest donates its hints
+            # (bindinfo BindHandle match; session shadows global).
+            # EXPLAIN shows the bound plan too.
+            target, btext = stmt, text
+            if isinstance(stmt, (A.Explain, A.TraceStmt)):
+                target = stmt.stmt
+                import re as _re
+                btext = _re.sub(r"(?is)^\s*(explain(\s+analyze)?|trace)\s+",
+                                "", text)
+            if isinstance(target, A.SelectStmt) and not target.hints:
+                b = (self.bindings.match(btext)
+                     or self.domain.bindings.match(btext))
+                if b is not None:
+                    target.hints = list(b.hints)
+                    # bound statements bypass the plan cache: a cached
+                    # unhinted plan must not shadow the binding (and
+                    # vice versa after DROP BINDING)
+                    self._cur_sql = None
             try:
                 out = self._exec_stmt(stmt)
             except Exception:
@@ -233,6 +255,12 @@ class Session:
             return self._exec_user_admin(stmt)
         if isinstance(stmt, (A.SelectStmt, A.SetOpStmt)):
             return self._exec_select(stmt)
+        if isinstance(stmt, A.CreateBinding):
+            return self._exec_create_binding(stmt)
+        if isinstance(stmt, A.DropBinding):
+            mgr = (self.domain.bindings if stmt.scope == "global"
+                   else self.bindings)
+            return ResultSet(affected=int(mgr.drop(stmt.original_sql)))
         if isinstance(stmt, A.Explain):
             return self._exec_explain(stmt)
         if isinstance(stmt, A.TraceStmt):
@@ -821,6 +849,25 @@ class Session:
         self.domain.stats.note_modify(tbl, n)
         return ResultSet(affected=n)
 
+    def _exec_create_binding(self, stmt: A.CreateBinding) -> ResultSet:
+        """CREATE [GLOBAL|SESSION] BINDING: both statements must parse,
+        normalize to the same digest, and the bind side must carry hints."""
+        from ..utils.stmtsummary import normalize_sql
+        orig = parse_sql(stmt.original_sql)
+        bind = parse_sql(stmt.bind_sql)
+        if len(orig) != 1 or len(bind) != 1 \
+                or not isinstance(bind[0], A.SelectStmt):
+            raise PlanError("BINDING takes single SELECT statements")
+        if normalize_sql(stmt.original_sql) != normalize_sql(stmt.bind_sql):
+            raise PlanError(
+                "binding statement digest differs from the original")
+        if not bind[0].hints:
+            raise PlanError("binding statement carries no optimizer hints")
+        mgr = (self.domain.bindings if stmt.scope == "global"
+               else self.bindings)
+        mgr.create(stmt.original_sql, stmt.bind_sql, bind[0].hints)
+        return ResultSet()
+
     @staticmethod
     def _insert_ignore(tbl, rows, txn) -> int:
         """INSERT IGNORE: duplicate-key rows are skipped, not errors."""
@@ -866,8 +913,11 @@ class Session:
                 return
             if stmt.replace:
                 total += tbl.replace_rows(batch, txn=self.txn)
-            else:
+            elif stmt.ignore:
                 total += self._insert_ignore(tbl, batch, self.txn)
+            else:
+                # MySQL: without IGNORE/REPLACE a duplicate key ERRORS
+                total += tbl.insert_rows(batch, txn=self.txn)
             batch.clear()
 
         for ln, rec in enumerate(reader):
@@ -1080,6 +1130,15 @@ class Session:
 
     def _exec_show(self, stmt: A.ShowStmt) -> ResultSet:
         cat = self.domain.catalog
+        if stmt.kind == "bindings":
+            rows = []
+            if stmt.target in (None, "session"):
+                rows += [r + ("session",) for r in self.bindings.rows()]
+            if stmt.target in (None, "global"):
+                rows += [r + ("global",)
+                         for r in self.domain.bindings.rows()]
+            return ResultSet(
+                ["Original_sql", "Bind_sql", "Status", "Scope"], rows)
         if stmt.kind == "tables":
             from ..infoschema import is_system_db, system_tables
             if is_system_db(self.db):
@@ -1184,6 +1243,11 @@ class Session:
                  "Row_count", "Error"], rows)
         if stmt.kind == "check table":
             return self._admin_check_table(stmt.target)
+        if stmt.kind == "recommend index":
+            from ..planner.advisor import recommend_indexes
+            return ResultSet(
+                ["Table", "Columns", "Est_benefit_execs", "Sample_sql"],
+                recommend_indexes(self.domain, self.db))
         raise PlanError(f"unsupported ADMIN {stmt.kind}")
 
     def _admin_check_table(self, name: str) -> ResultSet:

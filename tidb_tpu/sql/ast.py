@@ -295,6 +295,7 @@ class LoadData(Node):
     line_sep: str = "\n"
     ignore_lines: int = 0
     replace: bool = False
+    ignore: bool = False            # IGNORE keyword: skip dup-key rows
 
 
 @dataclass
@@ -419,10 +420,24 @@ class FlushStmt(Node):
 
 @dataclass
 class AdminStmt(Node):
-    """ADMIN SHOW DDL JOBS | ADMIN CHECK TABLE t (reference:
-    ast.AdminStmt)."""
-    kind: str = ""                  # 'show ddl jobs' | 'check table'
+    """ADMIN SHOW DDL JOBS | ADMIN CHECK TABLE t | ADMIN RECOMMEND INDEX
+    (reference: ast.AdminStmt)."""
+    kind: str = ""      # 'show ddl jobs' | 'check table' | 'recommend index'
     target: Optional[str] = None
+
+
+@dataclass
+class CreateBinding(Node):
+    """CREATE [GLOBAL|SESSION] BINDING FOR <stmt> USING <hinted stmt>."""
+    scope: str = "global"
+    original_sql: str = ""
+    bind_sql: str = ""
+
+
+@dataclass
+class DropBinding(Node):
+    scope: str = "global"
+    original_sql: str = ""
 
 
 __all__ = [n for n in dir() if n[0].isupper()]

@@ -34,6 +34,7 @@ KEYWORDS = {
     "FLUSH", "PASSWORD", "FOR",
     "REPLACE", "IGNORE", "LOAD", "DATA", "INFILE", "LOCAL", "FIELDS",
     "TERMINATED", "ENCLOSED", "OPTIONALLY", "LINES",
+    "BINDING", "BINDINGS",
 }
 
 # multi-char operators first (maximal munch)

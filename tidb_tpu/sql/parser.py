@@ -35,6 +35,28 @@ class Parser:
                      or (j > 0 and toks[j - 1].kind == "kw"
                          and toks[j - 1].text == "SELECT")]
         self.i = 0
+        self.sql = sql           # raw text (binding statement capture)
+
+    def _stmt_text_until(self, stop_kw) -> str:
+        """Raw SQL text of an embedded statement, from the current token
+        up to `stop_kw` (a top-level keyword followed by SELECT/WITH —
+        distinguishes binding USING from join USING) or end-of-statement.
+        Advances past the captured tokens."""
+        start = self.cur.pos
+        j = self.i
+        while j < len(self.toks):
+            t = self.toks[j]
+            if t.kind == "eof" or (t.kind == "op" and t.text == ";"):
+                break
+            if (stop_kw and t.kind == "kw" and t.text == stop_kw
+                    and j + 1 < len(self.toks)
+                    and self.toks[j + 1].kind == "kw"
+                    and self.toks[j + 1].text in ("SELECT", "WITH")):
+                break
+            j += 1
+        end = self.toks[j].pos if j < len(self.toks) else len(self.sql)
+        self.i = j
+        return self.sql[start:end].strip()
 
     # ---------------- token helpers ---------------- #
 
@@ -192,6 +214,10 @@ class Parser:
         if self.accept_kw("CHECK"):
             self.expect_kw("TABLE")
             return A.AdminStmt("check table", self.ident())
+        if self.cur.kind == "ident" and self.cur.text.upper() == "RECOMMEND":
+            self.advance()
+            self.expect_kw("INDEX")
+            return A.AdminStmt("recommend index")
         raise ParseError("unsupported ADMIN", self.cur)
 
     def _prepare_family(self) -> A.Node:
@@ -563,6 +589,16 @@ class Parser:
 
     def create_stmt(self) -> A.Node:
         self.expect_kw("CREATE")
+        if self.at_kw("GLOBAL", "SESSION", "BINDING"):
+            scope = "session"       # TiDB default scope is SESSION
+            if self.at_kw("GLOBAL", "SESSION"):
+                scope = self.advance().text.lower()
+            self.expect_kw("BINDING")
+            self.expect_kw("FOR")
+            orig = self._stmt_text_until("USING")
+            self.expect_kw("USING")
+            bind = self._stmt_text_until(None)
+            return A.CreateBinding(scope, orig, bind)
         if self.accept_kw("DATABASE"):
             ine = self._if_not_exists()
             return A.CreateDatabase(self.ident(), ine)
@@ -779,6 +815,13 @@ class Parser:
 
     def drop_stmt(self) -> A.Node:
         self.expect_kw("DROP")
+        if self.at_kw("GLOBAL", "SESSION", "BINDING"):
+            scope = "session"       # TiDB default scope is SESSION
+            if self.at_kw("GLOBAL", "SESSION"):
+                scope = self.advance().text.lower()
+            self.expect_kw("BINDING")
+            self.expect_kw("FOR")
+            return A.DropBinding(scope, self._stmt_text_until(None))
         if self.accept_kw("USER"):
             ie = False
             if self.accept_kw("IF"):
@@ -852,8 +895,8 @@ class Parser:
         ld = A.LoadData(path=self._str_lit())
         if self.accept_kw("REPLACE"):
             ld.replace = True
-        else:
-            self.accept_kw("IGNORE")      # dup-key policy; default skip
+        elif self.accept_kw("IGNORE"):
+            ld.ignore = True              # without it, dup keys ERROR
         self.expect_kw("INTO")
         self.expect_kw("TABLE")
         ld.table = self.ident()
@@ -910,6 +953,14 @@ class Parser:
 
     def show_stmt(self) -> A.ShowStmt:
         self.expect_kw("SHOW")
+        if self.accept_kw("BINDINGS"):
+            return A.ShowStmt("bindings")       # target None = both scopes
+        if self.at_kw("GLOBAL", "SESSION") \
+                and self.toks[self.i + 1].kind == "kw" \
+                and self.toks[self.i + 1].text == "BINDINGS":
+            scope = self.advance().text.lower()
+            self.advance()
+            return A.ShowStmt("bindings", scope)
         if self.accept_kw("TABLES"):
             return A.ShowStmt("tables")
         if self.accept_kw("DATABASES"):

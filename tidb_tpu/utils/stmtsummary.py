@@ -19,9 +19,15 @@ _WS = re.compile(r"\s+")
 _IN_LIST = re.compile(r"\(\s*\?(?:\s*,\s*\?)+\s*\)")
 
 
+_COMMENT = re.compile(r"/\*.*?\*/", re.S)
+
+
 def normalize_sql(sql: str) -> str:
-    """Literal-free normalized form (digester.go analog)."""
-    s = _STR.sub("?", sql)
+    """Literal-free normalized form (digester.go analog).  Comments —
+    including /*+ hint */ blocks — do not participate in the digest, so a
+    hinted statement matches its unhinted original (bindinfo contract)."""
+    s = _COMMENT.sub(" ", sql)
+    s = _STR.sub("?", s)
     s = _NUM.sub("?", s)
     s = _WS.sub(" ", s).strip().lower()
     s = _IN_LIST.sub("(...)", s)   # collapse IN/VALUES lists
