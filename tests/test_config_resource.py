@@ -1,0 +1,175 @@
+"""Sysvar registry (pkg/sessionctx/variable analog), TOML config
+(pkg/config), resource control (pkg/resourcegroup + runaway)."""
+
+import time
+
+import pytest
+
+from tidb_tpu.planner.build import PlanError
+from tidb_tpu.session import Domain, Session
+from tidb_tpu.utils.resourcegroup import RunawayError
+
+
+@pytest.fixture()
+def sess():
+    s = Session(Domain())
+    s.execute("create table t (a bigint)")
+    s.execute("insert into t values " +
+              ",".join(f"({i})" for i in range(300)))
+    return s
+
+
+def test_sysvar_validation(sess):
+    with pytest.raises(PlanError):
+        sess.execute("set tidb_no_such_variable = 1")
+    with pytest.raises(PlanError):
+        sess.execute("set tidb_distsql_scan_concurrency = 'abc'")
+    with pytest.raises(PlanError):
+        sess.execute("set tidb_txn_mode = 'bogus'")
+    sess.execute("set tidb_txn_mode = 'pessimistic'")
+
+
+def test_sysvar_clamping_and_bool(sess):
+    sess.execute("set tidb_distsql_scan_concurrency = 100000")
+    v = dict(sess.must_query("show variables"))
+    assert v["tidb_distsql_scan_concurrency"] == "256"   # clamped to max
+    sess.execute("set tidb_enable_plan_cache = OFF")
+    v = dict(sess.must_query("show variables"))
+    assert v["tidb_enable_plan_cache"] == "0"
+
+
+def test_sysvar_registry_breadth(sess):
+    v = dict(sess.must_query("show variables"))
+    # compat surface present with defaults
+    assert v["sql_mode"].startswith("ONLY_FULL_GROUP_BY")
+    assert v["autocommit"] == "1"
+    assert v["transaction_isolation"] == "REPEATABLE-READ"
+    assert len(v) >= 70
+
+
+def test_config_file(tmp_path, sess):
+    from tidb_tpu.config import ConfigError, apply_to_domain, load_config
+    p = tmp_path / "cfg.toml"
+    p.write_text('port = 4444\nhost = "0.0.0.0"\n'
+                 '[variables]\ntidb_mem_quota_query = 12345\n'
+                 '[log]\nslow-threshold-ms = 42\n')
+    cfg = load_config(str(p))
+    assert (cfg.port, cfg.host) == (4444, "0.0.0.0")
+    apply_to_domain(cfg, sess.domain)
+    assert sess.domain.sysvars["tidb_mem_quota_query"] == 12345
+    assert sess.domain.stmt_summary.slow_threshold_ms == 42
+    bad = tmp_path / "bad.toml"
+    bad.write_text("prot = 123\n")
+    with pytest.raises(ConfigError):
+        load_config(str(bad))
+    bad2 = tmp_path / "bad2.toml"
+    bad2.write_text("[variables]\ntidb_nope = 1\n")
+    with pytest.raises(ConfigError):
+        apply_to_domain(load_config(str(bad2)), sess.domain)
+
+
+def test_resource_group_lifecycle(sess):
+    sess.execute("create resource group rg RU_PER_SEC = 1000 BURSTABLE")
+    rows = sess.must_query(
+        "select name, ru_per_sec, burstable from "
+        "information_schema.resource_groups order by name")
+    assert ("rg", 1000, "YES") in rows
+    with pytest.raises(PlanError):
+        sess.execute("create resource group rg RU_PER_SEC = 1")
+    # IF NOT EXISTS is a no-op on an existing group, never a replace
+    sess.execute("create resource group if not exists rg RU_PER_SEC = 5")
+    rows = sess.must_query(
+        "select ru_per_sec, burstable from "
+        "information_schema.resource_groups where name = 'rg'")
+    assert rows == [(1000, "YES")]
+    # ALTER merges named options; unnamed ones keep their values
+    sess.execute("alter resource group rg RU_PER_SEC = 2000")
+    rows = sess.must_query(
+        "select ru_per_sec, burstable from "
+        "information_schema.resource_groups where name = 'rg'")
+    assert rows == [(2000, "YES")]
+    with pytest.raises(PlanError):
+        sess.execute("alter resource group missing RU_PER_SEC = 1")
+    sess.execute("drop resource group rg")
+    with pytest.raises(PlanError):
+        sess.execute("drop resource group rg")
+    with pytest.raises(PlanError):
+        sess.execute("drop resource group default")
+
+
+def test_resource_group_throttles(sess):
+    sess.execute("create resource group slow RU_PER_SEC = 4")
+    sess.must_query("select count(*) from t")     # warm the jit cache
+    sess.execute("set resource group slow")
+    t0 = time.monotonic()
+    for _ in range(10):
+        sess.must_query("select count(*) from t")   # ~1 RU each
+    elapsed = time.monotonic() - t0
+    # 10 RU at 4 RU/s minus at most 1s of burst: must block >= ~1s
+    assert elapsed > 0.8, elapsed
+    sess.execute("set resource group default")
+    t0 = time.monotonic()
+    for _ in range(10):
+        sess.must_query("select count(*) from t")
+    assert time.monotonic() - t0 < 0.8
+
+
+def test_runaway_kill(sess):
+    sess.execute("create resource group tight RU_PER_SEC = 0 "
+                 "QUERY_LIMIT = (EXEC_ELAPSED = '1ms' ACTION = KILL)")
+    sess.execute("set resource group tight")
+    with pytest.raises(RunawayError):
+        sess.must_query("select count(*) from t where a > 1")
+    rows_ = sess.must_query  # session still usable after the kill
+    sess.execute("set resource group default")
+    assert rows_("select 1") == [(1,)]
+    got = sess.must_query("select runaway_count from "
+                          "information_schema.resource_groups "
+                          "where name = 'tight'")
+    assert got[0][0] >= 1
+
+
+def test_connector_alias_vars_accepted(sess):
+    # pre-8.0 connectors SET these during handshake
+    sess.execute("set tx_isolation = 'READ-COMMITTED'")
+    sess.execute("set sql_auto_is_null = 0")
+    sess.execute("set @@session.sql_safe_updates = 1")
+
+
+def test_load_data_atomic_across_batches(tmp_path, sess):
+    from tidb_tpu.session.catalog import DuplicateKeyError
+    sess.execute("create table ld (id bigint, v bigint)")
+    sess.execute("create unique index lu on ld (id)")
+    n = 5000
+    lines = [f"{i},{i}" for i in range(n)]
+    lines.append("4999,0")        # dup beyond the first 4096-row batch
+    p = tmp_path / "big.csv"
+    p.write_text("\n".join(lines) + "\n")
+    with pytest.raises(DuplicateKeyError):
+        sess.execute(f"load data infile '{p}' into table ld "
+                     "fields terminated by ','")
+    # earlier batches must have rolled back too
+    assert sess.must_query("select count(*) from ld") == [(0,)]
+
+
+def test_runaway_kill_spares_committed_dml(sess):
+    sess.execute("create resource group w RU_PER_SEC = 0 "
+                 "QUERY_LIMIT = (EXEC_ELAPSED = '1ms' ACTION = KILL)")
+    sess.execute("set resource group w")
+    # a slow write is NOT failed post-commit; it counts as runaway only
+    sess.execute("insert into t select a + 9999 from t")
+    sess.execute("set resource group default")
+    assert sess.must_query("select count(*) from t where a >= 9999") == \
+        [(300,)]
+    got = sess.must_query("select runaway_count from "
+                          "information_schema.resource_groups "
+                          "where name = 'w'")
+    assert got[0][0] >= 1
+
+
+def test_runaway_cooldown_does_not_kill(sess):
+    sess.execute("create resource group cd RU_PER_SEC = 0 "
+                 "QUERY_LIMIT = (EXEC_ELAPSED = '1ms' ACTION = COOLDOWN)")
+    sess.execute("set resource group cd")
+    assert sess.must_query("select count(*) from t") == [(300,)]
+    sess.execute("set resource group default")

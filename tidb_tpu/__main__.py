@@ -32,8 +32,24 @@ def _domain(args=None):
 
 def cmd_serve(args) -> int:
     import time
+    from .config import apply_to_domain, load_config
     from .server import MySQLServer, StatusServer
+    cfg = load_config(getattr(args, "config", None))
+    # precedence: explicit CLI flag > config file > built-in default
+    # (argparse defaults are None sentinels so an explicit flag at its
+    # default value still wins)
+    if args.host is None:
+        args.host = cfg.host
+    if args.port is None:
+        args.port = cfg.port
+    if args.status_port is None:
+        args.status_port = cfg.status_port
+    if getattr(args, "data_dir", None) is None:
+        args.data_dir = cfg.data_dir
+    if not getattr(args, "sync_wal", False):
+        args.sync_wal = cfg.sync_wal
     dom = _domain(args)
+    apply_to_domain(cfg, dom)
     dom.start_background()
     srv = MySQLServer(dom, host=args.host, port=args.port)
     port = srv.start()
@@ -121,9 +137,11 @@ def main(argv=None) -> int:
     sub = ap.add_subparsers(dest="cmd", required=True)
 
     s = sub.add_parser("serve", help="run the MySQL wire server")
-    s.add_argument("--host", default="127.0.0.1")
-    s.add_argument("--port", type=int, default=4000)
-    s.add_argument("--status-port", type=int, default=10080)
+    s.add_argument("--host", default=None)
+    s.add_argument("--port", type=int, default=None)
+    s.add_argument("--status-port", type=int, default=None)
+    s.add_argument("--config", default=None,
+                   help="TOML config file (pkg/config analog)")
     s.add_argument("--data-dir", default=None,
                    help="durable storage dir (WAL + catalog-on-KV); "
                         "omit for in-memory")

@@ -149,6 +149,10 @@ def _stats_meta(dom):
     return rows
 
 
+def _resource_groups(dom):
+    return dom.resource_groups.rows()
+
+
 def _cluster_info(dom):
     import jax
     try:
@@ -200,6 +204,9 @@ _INFORMATION_SCHEMA = {
     "CLUSTER_INFO": ([("TYPE", S), ("INSTANCE", S), ("VERSION", S),
                       ("DEVICE_PLATFORM", S), ("DEVICE_COUNT", I)],
                      _cluster_info),
+    "RESOURCE_GROUPS": ([("NAME", S), ("RU_PER_SEC", I), ("BURSTABLE", S),
+                         ("EXEC_ELAPSED_SEC", F), ("RUNAWAY_ACTION", S),
+                         ("RUNAWAY_COUNT", I)], _resource_groups),
 }
 
 _PERFORMANCE_SCHEMA = {

@@ -90,12 +90,10 @@ class Domain:
         self.bindings = BindManager()       # GLOBAL plan bindings
         if not hasattr(self, "_next_table_id"):   # durable mode recovered it
             self._next_table_id = 100
-        self.sysvars: dict[str, Any] = {
-            "tidb_distsql_scan_concurrency": 15,
-            "tidb_max_chunk_size": 1024,
-            "tidb_enable_vectorized_expression": 1,
-            "tidb_ddl_reorg_worker_cnt": 4,
-        }
+        from .sysvars import defaults as _sysvar_defaults
+        self.sysvars: dict[str, Any] = _sysvar_defaults()
+        from ..utils.resourcegroup import ResourceGroupManager
+        self.resource_groups = ResourceGroupManager()
 
     @property
     def ddl(self):
@@ -230,7 +228,30 @@ class Session:
             qcnt.inc(type=type(stmt).__name__)
             qdur.observe(dt_ns / 1e9)
             self.domain.stmt_summary.record(text, dt_ns, len(out.rows))
+            self._charge_resource_group(stmt, out, dt_ns / 1e9)
         return out
+
+    def _charge_resource_group(self, stmt, out: ResultSet,
+                               elapsed_sec: float) -> None:
+        """Post-paid RU accounting + runaway watch (resource control).
+        ACTION=KILL only raises for statements that did not mutate data:
+        the watch runs post-execution, and killing an already-committed
+        DML would report failure for persisted writes (the reference
+        aborts mid-execution; read-only raise is the safe analog)."""
+        gname = self.vars.get("tidb_resource_group") or \
+            self.domain.sysvars.get("tidb_resource_group", "default")
+        group = self.domain.resource_groups.get(gname)
+        if group is None or (group.ru_per_sec <= 0
+                             and not group.exec_elapsed_sec):
+            return
+        from ..utils.resourcegroup import RunawayError, charge_statement
+        try:
+            charge_statement(group, len(out.rows) + out.affected,
+                             elapsed_sec)
+        except RunawayError:
+            if out.affected:
+                return           # counted as runaway, writes stand
+            raise
 
     def must_query(self, sql: str) -> list[tuple]:
         """testkit MustQuery analog."""
@@ -257,6 +278,31 @@ class Session:
             return self._exec_select(stmt)
         if isinstance(stmt, A.CreateBinding):
             return self._exec_create_binding(stmt)
+        if isinstance(stmt, A.CreateResourceGroup):
+            try:
+                if stmt.replace:      # ALTER: merge named options only
+                    self.domain.resource_groups.alter(
+                        stmt.name, stmt.ru_per_sec, stmt.burstable,
+                        stmt.exec_elapsed_sec, stmt.action)
+                else:
+                    self.domain.resource_groups.create(
+                        stmt.name, stmt.ru_per_sec, stmt.burstable,
+                        stmt.exec_elapsed_sec, stmt.action,
+                        if_not_exists=stmt.if_not_exists)
+            except ValueError as e:
+                raise PlanError(str(e))
+            return ResultSet()
+        if isinstance(stmt, A.DropResourceGroup):
+            try:
+                self.domain.resource_groups.drop(stmt.name, stmt.if_exists)
+            except ValueError as e:
+                raise PlanError(str(e))
+            return ResultSet()
+        if isinstance(stmt, A.SetResourceGroup):
+            if self.domain.resource_groups.get(stmt.name) is None:
+                raise PlanError(f"unknown resource group {stmt.name!r}")
+            self.vars["tidb_resource_group"] = stmt.name
+            return ResultSet()
         if isinstance(stmt, A.DropBinding):
             mgr = (self.domain.bindings if stmt.scope == "global"
                    else self.bindings)
@@ -311,11 +357,16 @@ class Session:
         if isinstance(stmt, A.ShowStmt):
             return self._exec_show(stmt)
         if isinstance(stmt, A.SetStmt):
+            from .sysvars import SysVarError, validate_set
             for name, val in stmt.assignments:
                 # full expression eval: SET x = -1 / DEFAULT / 2*1024 all
                 # work (reference: variable assignment evals an expression)
                 v = (val.value if isinstance(val, A.Lit)
                      else self._eval_scalar(val))
+                try:
+                    v = validate_set(name.lower(), v)
+                except SysVarError as e:
+                    raise PlanError(str(e))
                 (self.domain.sysvars if stmt.scope == "global"
                  else self.vars)[name.lower()] = v
             for name, val in stmt.user_vars:
@@ -906,37 +957,50 @@ class Session:
         idx = {n: i for i, n in enumerate(names)}
         total = 0
         batch: list[tuple] = []
+        # one transaction for the WHOLE load: a failure in a late batch
+        # must not leave earlier batches committed (statement atomicity)
+        own = self.txn is None
+        txn = self.txn or tbl.kv.begin()
 
         def flush():
             nonlocal total
             if not batch:
                 return
             if stmt.replace:
-                total += tbl.replace_rows(batch, txn=self.txn)
+                total += tbl.replace_rows(batch, txn=txn)
             elif stmt.ignore:
-                total += self._insert_ignore(tbl, batch, self.txn)
+                total += self._insert_ignore(tbl, batch, txn)
             else:
                 # MySQL: without IGNORE/REPLACE a duplicate key ERRORS
-                total += tbl.insert_rows(batch, txn=self.txn)
+                total += tbl.insert_rows(batch, txn=txn)
             batch.clear()
 
-        for ln, rec in enumerate(reader):
-            if ln < stmt.ignore_lines or not rec:
-                continue
-            vals = []
-            for cn, ct in zip(tbl.col_names, tbl.col_types):
-                if cn not in idx or idx[cn] >= len(rec):
-                    vals.append(None)
+        try:
+            for ln, rec in enumerate(reader):
+                if ln < stmt.ignore_lines or not rec:
                     continue
-                raw = rec[idx[cn]]
-                if raw == "\\N" or (raw == "" and not ct.is_string):
-                    vals.append(None)
-                else:
-                    vals.append(raw)
-            batch.append(tuple(vals))
-            if len(batch) >= 4096:
-                flush()
-        flush()
+                vals = []
+                for cn, ct in zip(tbl.col_names, tbl.col_types):
+                    if cn not in idx or idx[cn] >= len(rec):
+                        vals.append(None)
+                        continue
+                    raw = rec[idx[cn]]
+                    if raw == "\\N" or (raw == "" and not ct.is_string):
+                        vals.append(None)
+                    else:
+                        vals.append(raw)
+                batch.append(tuple(vals))
+                if len(batch) >= 4096:
+                    flush()
+            flush()
+            if own:
+                txn.commit()
+        except Exception:
+            if own:
+                txn.rollback()
+            raise
+        finally:
+            tbl._invalidate()
         if self.txn is not None:
             self._txn_tables.add(tbl)
         self.domain.stats.note_modify(tbl, total)

@@ -1,0 +1,206 @@
+"""System-variable registry: scopes, types, defaults, validation.
+
+Reference analog: pkg/sessionctx/variable (sysvar.go + vardef/tidb_vars.go,
+~700 vars).  This registry carries the variables this engine actually
+honors plus the widely-set compatibility surface; SET validates and
+coerces through it, unknown variables are rejected like MySQL's ERROR
+1193 (unless prefixed `@@local.`-style passthrough is added later).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+SCOPE_GLOBAL = "global"
+SCOPE_SESSION = "session"
+SCOPE_BOTH = "both"
+SCOPE_NONE = "noop"       # accepted for compatibility, no effect
+
+
+@dataclass(frozen=True)
+class SysVar:
+    name: str
+    default: Any
+    scope: str = SCOPE_BOTH
+    kind: str = "int"         # int | bool | float | str | enum
+    min: Optional[int] = None
+    max: Optional[int] = None
+    options: tuple = ()       # enum values
+    validator: Optional[Callable] = None
+
+
+def _v(*args, **kw) -> SysVar:
+    return SysVar(*args, **kw)
+
+
+_VARS = [
+    # engine-honored knobs
+    _v("tidb_distsql_scan_concurrency", 15, kind="int", min=1, max=256),
+    _v("tidb_max_chunk_size", 1024, kind="int", min=32, max=65536),
+    _v("tidb_enable_vectorized_expression", 1, kind="bool"),
+    _v("tidb_ddl_reorg_worker_cnt", 4, kind="int", min=1, max=128),
+    _v("tidb_mem_quota_query", -1, kind="int"),
+    _v("tidb_enable_tmp_storage_on_oom", 1, kind="bool"),
+    _v("tidb_enable_plan_cache", 1, kind="bool"),
+    _v("tidb_gc_life_time_sec", 600, kind="int", min=1),
+    _v("tidb_gc_run_interval_sec", 60, kind="int", min=1),
+    _v("tidb_ttl_job_interval_sec", 60, kind="int", min=1),
+    _v("tidb_auto_analyze_ratio", 0.5, kind="float"),
+    _v("tidb_enable_auto_analyze", 1, kind="bool"),
+    _v("tidb_txn_mode", "optimistic", kind="enum",
+       options=("optimistic", "pessimistic")),
+    _v("tidb_slow_log_threshold", 300, kind="int", min=0),
+    _v("tidb_resource_group", "default", kind="str"),
+    # MySQL compatibility surface (honored where the engine has the
+    # concept; stored + reflected otherwise)
+    _v("autocommit", 1, kind="bool"),
+    _v("sql_mode", "ONLY_FULL_GROUP_BY,STRICT_TRANS_TABLES", kind="str"),
+    _v("time_zone", "SYSTEM", kind="str"),
+    _v("max_execution_time", 0, kind="int", min=0),
+    _v("max_allowed_packet", 67108864, kind="int", min=1024),
+    _v("character_set_client", "utf8mb4", kind="str"),
+    _v("character_set_connection", "utf8mb4", kind="str"),
+    _v("character_set_results", "utf8mb4", kind="str"),
+    _v("collation_connection", "utf8mb4_bin", kind="str"),
+    _v("default_collation_for_utf8mb4", "utf8mb4_bin", kind="str"),
+    _v("transaction_isolation", "REPEATABLE-READ", kind="enum",
+       options=("REPEATABLE-READ", "READ-COMMITTED")),
+    # pre-8.0 connector/ORM aliases and connect-time compat vars —
+    # clients SET these during handshake; they must not error
+    _v("tx_isolation", "REPEATABLE-READ", kind="enum",
+       options=("REPEATABLE-READ", "READ-COMMITTED")),
+    _v("tx_read_only", 0, kind="bool", scope=SCOPE_NONE),
+    _v("transaction_read_only", 0, kind="bool", scope=SCOPE_NONE),
+    _v("sql_auto_is_null", 0, kind="bool", scope=SCOPE_NONE),
+    _v("sql_safe_updates", 0, kind="bool", scope=SCOPE_NONE),
+    _v("sql_notes", 1, kind="bool", scope=SCOPE_NONE),
+    _v("sql_warnings", 0, kind="bool", scope=SCOPE_NONE),
+    _v("sql_log_bin", 1, kind="bool", scope=SCOPE_NONE),
+    _v("sql_quote_show_create", 1, kind="bool", scope=SCOPE_NONE),
+    _v("character_set_server", "utf8mb4", kind="str"),
+    _v("collation_server", "utf8mb4_bin", kind="str"),
+    _v("character_set_database", "utf8mb4", kind="str"),
+    _v("collation_database", "utf8mb4_bin", kind="str"),
+    _v("default_storage_engine", "tpu-columnar", kind="str",
+       scope=SCOPE_NONE),
+    _v("net_buffer_length", 16384, kind="int", scope=SCOPE_NONE),
+    _v("query_cache_size", 0, kind="int", scope=SCOPE_NONE),
+    _v("query_cache_type", 0, kind="int", scope=SCOPE_NONE),
+    _v("system_time_zone", "UTC", kind="str", scope=SCOPE_GLOBAL),
+    _v("sql_require_primary_key", 0, kind="bool", scope=SCOPE_NONE),
+    _v("init_connect", "", kind="str", scope=SCOPE_GLOBAL),
+    _v("wait_timeout", 28800, kind="int", min=1),
+    _v("interactive_timeout", 28800, kind="int", min=1),
+    _v("net_write_timeout", 60, kind="int", min=1),
+    _v("net_read_timeout", 30, kind="int", min=1),
+    _v("lower_case_table_names", 2, kind="int", scope=SCOPE_GLOBAL),
+    _v("version_comment", "tidb-tpu", kind="str", scope=SCOPE_GLOBAL),
+    _v("port", 4000, kind="int", scope=SCOPE_GLOBAL),
+    _v("socket", "", kind="str", scope=SCOPE_GLOBAL),
+    _v("datadir", "", kind="str", scope=SCOPE_GLOBAL),
+    _v("last_insert_id", 0, kind="int", scope=SCOPE_SESSION),
+    _v("auto_increment_increment", 1, kind="int", min=1, max=65535),
+    _v("auto_increment_offset", 1, kind="int", min=1, max=65535),
+    _v("group_concat_max_len", 1024, kind="int", min=4),
+    _v("sql_select_limit", 2 ** 64 - 1, kind="int", min=0),
+    _v("foreign_key_checks", 0, kind="bool"),
+    _v("unique_checks", 1, kind="bool"),
+    _v("innodb_lock_wait_timeout", 50, kind="int", min=1),
+    # TiDB-compat knobs accepted as no-ops (reference defines ~700; the
+    # ones users commonly SET must not error)
+    _v("tidb_enable_async_commit", 1, kind="bool", scope=SCOPE_NONE),
+    _v("tidb_enable_1pc", 1, kind="bool", scope=SCOPE_NONE),
+    _v("tidb_enable_clustered_index", "ON", kind="str", scope=SCOPE_NONE),
+    _v("tidb_analyze_version", 2, kind="int", scope=SCOPE_NONE),
+    _v("tidb_cost_model_version", 2, kind="int", scope=SCOPE_NONE),
+    _v("tidb_partition_prune_mode", "dynamic", kind="str",
+       scope=SCOPE_NONE),
+    _v("tidb_enable_paging", 1, kind="bool", scope=SCOPE_NONE),
+    _v("tidb_executor_concurrency", 5, kind="int", scope=SCOPE_NONE),
+    _v("tidb_hash_join_concurrency", 5, kind="int", scope=SCOPE_NONE),
+    _v("tidb_index_lookup_concurrency", 4, kind="int", scope=SCOPE_NONE),
+    _v("tidb_build_stats_concurrency", 4, kind="int", scope=SCOPE_NONE),
+    _v("tidb_enable_rate_limit_action", 0, kind="bool", scope=SCOPE_NONE),
+    _v("tidb_replica_read", "leader", kind="str", scope=SCOPE_NONE),
+    _v("tidb_isolation_read_engines", "tpu", kind="str",
+       scope=SCOPE_NONE),
+    _v("tidb_enable_stmt_summary", 1, kind="bool", scope=SCOPE_NONE),
+    _v("tidb_stmt_summary_max_stmt_count", 3000, kind="int",
+       scope=SCOPE_NONE),
+    _v("tidb_enable_collect_execution_info", 1, kind="bool",
+       scope=SCOPE_NONE),
+    _v("tidb_opt_agg_push_down", 1, kind="bool", scope=SCOPE_NONE),
+    _v("tidb_opt_join_reorder_threshold", 12, kind="int",
+       scope=SCOPE_NONE),
+    _v("tidb_index_join_batch_size", 25000, kind="int", scope=SCOPE_NONE),
+    _v("tidb_init_chunk_size", 32, kind="int", scope=SCOPE_NONE),
+    _v("tidb_retry_limit", 10, kind="int", scope=SCOPE_NONE),
+    _v("tidb_disable_txn_auto_retry", 1, kind="bool", scope=SCOPE_NONE),
+    _v("tidb_constraint_check_in_place", 0, kind="bool",
+       scope=SCOPE_NONE),
+    _v("tidb_skip_utf8_check", 0, kind="bool", scope=SCOPE_NONE),
+    _v("tidb_enable_window_function", 1, kind="bool", scope=SCOPE_NONE),
+    _v("tidb_enable_table_partition", "ON", kind="str", scope=SCOPE_NONE),
+    _v("tidb_scatter_region", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_wait_split_region_finish", 1, kind="bool", scope=SCOPE_NONE),
+    _v("tidb_store_batch_size", 4, kind="int", scope=SCOPE_NONE),
+    _v("tidb_enable_index_merge", 1, kind="bool", scope=SCOPE_NONE),
+    _v("tidb_enable_noop_functions", 0, kind="bool", scope=SCOPE_NONE),
+    _v("tidb_row_format_version", 2, kind="int", scope=SCOPE_NONE),
+]
+
+REGISTRY: dict[str, SysVar] = {v.name: v for v in _VARS}
+
+
+class SysVarError(ValueError):
+    pass
+
+
+def validate_set(name: str, value: Any) -> Any:
+    """Coerce + validate a SET value; raises SysVarError on unknown
+    variable or out-of-range value.  Returns the canonical value."""
+    sv = REGISTRY.get(name)
+    if sv is None:
+        raise SysVarError(f"Unknown system variable {name!r}")
+    if value is None:
+        return sv.default          # SET x = DEFAULT
+    if sv.kind == "bool":
+        if isinstance(value, str):
+            up = value.upper()
+            if up in ("ON", "TRUE", "1"):
+                return 1
+            if up in ("OFF", "FALSE", "0"):
+                return 0
+            raise SysVarError(f"{name}: bad boolean {value!r}")
+        return 1 if value else 0
+    if sv.kind == "int":
+        try:
+            iv = int(value)
+        except (TypeError, ValueError):
+            raise SysVarError(f"{name}: expected integer, got {value!r}")
+        if sv.min is not None and iv < sv.min:
+            iv = sv.min           # MySQL clamps with a warning
+        if sv.max is not None and iv > sv.max:
+            iv = sv.max
+        return iv
+    if sv.kind == "float":
+        try:
+            return float(value)
+        except (TypeError, ValueError):
+            raise SysVarError(f"{name}: expected float, got {value!r}")
+    if sv.kind == "enum":
+        s = str(value).upper().replace("_", "-")
+        for opt in sv.options:
+            if s == opt.upper() or str(value).lower() == opt.lower():
+                return opt
+        raise SysVarError(
+            f"{name}: must be one of {', '.join(sv.options)}")
+    return str(value)
+
+
+def defaults() -> dict[str, Any]:
+    return {v.name: v.default for v in _VARS}
+
+
+__all__ = ["SysVar", "REGISTRY", "SysVarError", "validate_set", "defaults"]

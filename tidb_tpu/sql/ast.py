@@ -440,4 +440,28 @@ class DropBinding(Node):
     original_sql: str = ""
 
 
+@dataclass
+class CreateResourceGroup(Node):
+    """CREATE/ALTER RESOURCE GROUP (pkg/resourcegroup meta).  None =
+    option not named in the statement (ALTER merges, CREATE defaults)."""
+    name: str = ""
+    ru_per_sec: Optional[int] = None
+    burstable: Optional[bool] = None
+    exec_elapsed_sec: Optional[float] = None
+    action: Optional[str] = None
+    if_not_exists: bool = False
+    replace: bool = False          # ALTER form
+
+
+@dataclass
+class DropResourceGroup(Node):
+    name: str = ""
+    if_exists: bool = False
+
+
+@dataclass
+class SetResourceGroup(Node):
+    name: str = ""
+
+
 __all__ = [n for n in dir() if n[0].isupper()]

@@ -599,6 +599,12 @@ class Parser:
             self.expect_kw("USING")
             bind = self._stmt_text_until(None)
             return A.CreateBinding(scope, orig, bind)
+        if self.cur.kind == "ident" and self.cur.text.upper() == "RESOURCE":
+            self.advance()
+            self.expect_kw("GROUP")
+            ine = self._if_not_exists()
+            return self._resource_group_body(self.ident().lower(), ine,
+                                             False)
         if self.accept_kw("DATABASE"):
             ine = self._if_not_exists()
             return A.CreateDatabase(self.ident(), ine)
@@ -717,6 +723,11 @@ class Parser:
         self.expect_kw("ALTER")
         if self.accept_kw("USER"):
             return A.AlterUser(self._user_password_list())
+        if self.cur.kind == "ident" and self.cur.text.upper() == "RESOURCE":
+            self.advance()
+            self.expect_kw("GROUP")
+            return self._resource_group_body(self.ident().lower(), False,
+                                             True)
         self.expect_kw("TABLE")
         table = self.ident()
         if self.accept_op("."):
@@ -822,6 +833,14 @@ class Parser:
             self.expect_kw("BINDING")
             self.expect_kw("FOR")
             return A.DropBinding(scope, self._stmt_text_until(None))
+        if self.cur.kind == "ident" and self.cur.text.upper() == "RESOURCE":
+            self.advance()
+            self.expect_kw("GROUP")
+            ie = False
+            if self.accept_kw("IF"):
+                self.expect_kw("EXISTS")
+                ie = True
+            return A.DropResourceGroup(self.ident().lower(), ie)
         if self.accept_kw("USER"):
             ie = False
             if self.accept_kw("IF"):
@@ -886,6 +905,51 @@ class Parser:
             if not self.accept_op(","):
                 break
         return ins
+
+    def _resource_group_body(self, name: str, ine: bool,
+                             replace: bool) -> A.CreateResourceGroup:
+        """RU_PER_SEC = N [BURSTABLE] [QUERY_LIMIT = (EXEC_ELAPSED = '1s'
+        [,] ACTION = KILL|COOLDOWN)] (resource-group option grammar)."""
+        rg = A.CreateResourceGroup(name, if_not_exists=ine, replace=replace)
+        while True:
+            if self.cur.kind != "ident":
+                break
+            opt = self.cur.text.upper()
+            if opt == "RU_PER_SEC":
+                self.advance()
+                self.expect_op("=")
+                rg.ru_per_sec = self._int_lit()
+            elif opt == "BURSTABLE":
+                self.advance()
+                rg.burstable = True
+            elif opt == "QUERY_LIMIT":
+                self.advance()
+                self.expect_op("=")
+                self.expect_op("(")
+                while not self.at_op(")"):
+                    sub = self.cur.text.upper()
+                    self.advance()
+                    self.expect_op("=")
+                    if sub == "EXEC_ELAPSED":
+                        txt = self._str_lit().strip().lower()
+                        mult = 1.0
+                        for suf, m in (("ms", 1e-3), ("s", 1.0),
+                                       ("m", 60.0), ("h", 3600.0)):
+                            if txt.endswith(suf):
+                                txt = txt[:-len(suf)]
+                                mult = m
+                                break
+                        rg.exec_elapsed_sec = float(txt) * mult
+                    elif sub == "ACTION":
+                        rg.action = self.advance().text.lower()
+                    else:
+                        raise ParseError(f"unknown QUERY_LIMIT option "
+                                         f"{sub}", self.cur)
+                    self.accept_op(",")
+                self.expect_op(")")
+            else:
+                break
+        return rg
 
     def load_data_stmt(self) -> A.LoadData:
         self.expect_kw("LOAD")
@@ -989,8 +1053,12 @@ class Parser:
             return A.ShowStmt(kind)
         raise ParseError("unsupported SHOW", self.cur)
 
-    def set_stmt(self) -> A.SetStmt:
+    def set_stmt(self) -> A.Node:
         self.expect_kw("SET")
+        if self.cur.kind == "ident" and self.cur.text.upper() == "RESOURCE":
+            self.advance()
+            self.expect_kw("GROUP")
+            return A.SetResourceGroup(self.ident().lower())
         scope = "session"
         if self.accept_kw("GLOBAL"):
             scope = "global"

@@ -26,8 +26,8 @@ def normalize_sql(sql: str) -> str:
     """Literal-free normalized form (digester.go analog).  Comments —
     including /*+ hint */ blocks — do not participate in the digest, so a
     hinted statement matches its unhinted original (bindinfo contract)."""
-    s = _COMMENT.sub(" ", sql)
-    s = _STR.sub("?", s)
+    s = _STR.sub("?", sql)       # strings first: comment markers inside
+    s = _COMMENT.sub(" ", s)     # string literals must not swallow SQL
     s = _NUM.sub("?", s)
     s = _WS.sub(" ", s).strip().lower()
     s = _IN_LIST.sub("(...)", s)   # collapse IN/VALUES lists
