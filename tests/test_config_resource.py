@@ -129,6 +129,41 @@ def test_runaway_kill(sess):
     assert got[0][0] >= 1
 
 
+def test_sysvar_scope_enforced(sess):
+    with pytest.raises(PlanError):
+        sess.execute("set lower_case_table_names = 0")    # GLOBAL-only
+    sess.execute("set global lower_case_table_names = 0")
+    with pytest.raises(PlanError):
+        sess.execute("set global last_insert_id = 5")     # SESSION-only
+
+
+def test_query_limit_parse_errors(sess):
+    from tidb_tpu.sql.parser import ParseError
+    with pytest.raises(ParseError):
+        sess.execute("create resource group b1 QUERY_LIMIT = "
+                     "(EXEC_ELAPSED = 'abc' ACTION = KILL)")
+    with pytest.raises(ParseError):
+        sess.execute("create resource group b2 QUERY_LIMIT = "
+                     "(EXEC_ELAPSED = '1s' ACTION = KILLL)")
+
+
+def test_digest_comment_with_apostrophe():
+    from tidb_tpu.utils.stmtsummary import normalize_sql
+    a = normalize_sql("select /* don't */ 'x', a from t")
+    b = normalize_sql("select 'x', a from t")
+    assert a == b == "select ?, a from t"
+    assert normalize_sql("select '/*', a, '*/' from t") == \
+        "select ?, a, ? from t"
+
+
+def test_config_bad_value_type(tmp_path):
+    from tidb_tpu.config import ConfigError, load_config
+    p = tmp_path / "c.toml"
+    p.write_text('port = "abc"\n')
+    with pytest.raises(ConfigError):
+        load_config(str(p))
+
+
 def test_connector_alias_vars_accepted(sess):
     # pre-8.0 connectors SET these during handshake
     sess.execute("set tx_isolation = 'READ-COMMITTED'")

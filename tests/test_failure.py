@@ -1,0 +1,83 @@
+"""Failure detection & recovery: typed backoff budgets, failpoint-injected
+dispatch errors, region split (pkg/store/copr backoff loop, client-go
+retry.Backoffer, failpoint analogs)."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.session import Domain, Session
+from tidb_tpu.store.backoff import (DEVICE_BUSY, STALE_EPOCH,
+                                    STORE_UNAVAILABLE, Backoffer,
+                                    RegionError, RetryBudgetExceeded)
+
+
+def test_backoff_curve_and_budget():
+    sleeps = []
+    bo = Backoffer(max_sleep_ms=100_000,
+                   sleep_fn=lambda s: sleeps.append(s))
+    err = RegionError(STALE_EPOCH)
+    for _ in range(6):
+        bo.backoff(STALE_EPOCH, err)
+    # exponential growth: later sleeps dominate earlier ones
+    assert sleeps[-1] > sleeps[0]
+    tight = Backoffer(max_sleep_ms=50, sleep_fn=lambda s: None)
+    with pytest.raises(RetryBudgetExceeded) as ei:
+        for _ in range(64):
+            tight.backoff(STALE_EPOCH, err)
+    assert 1 < len(ei.value.history) < 64
+
+
+def test_backoff_per_kind_counters():
+    bo = Backoffer(max_sleep_ms=10_000, sleep_fn=lambda s: None)
+    bo.backoff(STALE_EPOCH, RegionError(STALE_EPOCH))
+    bo.backoff(DEVICE_BUSY, RegionError(DEVICE_BUSY))
+    bo.backoff(STALE_EPOCH, RegionError(STALE_EPOCH))
+    assert bo.attempts == {"staleEpoch": 2, "deviceBusy": 1}
+
+
+@pytest.fixture()
+def sess():
+    s = Session(Domain())
+    s.execute("create table t (a bigint, b bigint)")
+    s.execute("insert into t values " +
+              ",".join(f"({i}, {i % 7})" for i in range(2000)))
+    return s
+
+
+def test_injected_failures_recover(sess):
+    client = sess.domain.client
+    client.retry_budget_ms = 10_000
+    exp = sess.must_query("select b, count(*) from t group by b")
+    client.inject_failures(STORE_UNAVAILABLE, 2)
+    got = sess.must_query("select b, count(*) from t group by b")
+    assert sorted(got) == sorted(exp)
+    assert client.last_retries == 2
+
+
+def test_retry_budget_exhaustion_surfaces(sess):
+    client = sess.domain.client
+    client.retry_budget_ms = 1.0          # no room to retry
+    client.inject_failures(STORE_UNAVAILABLE, 50)
+    with pytest.raises(RetryBudgetExceeded):
+        sess.must_query("select count(*) from t")
+    client._failpoints.clear()
+    client.retry_budget_ms = 5000.0
+    assert sess.must_query("select count(*) from t") == [(2000,)]
+
+
+def test_split_table_regions(sess):
+    tbl = sess.domain.catalog.get_table("test", "t")
+    exp = sorted(sess.must_query("select b, sum(a) from t group by b"))
+    assert tbl.snapshot().n_shards == 8
+    sess.execute("split table t regions 16")
+    snap = tbl.snapshot()
+    assert snap.n_shards == 16
+    # re-fan-out still produces identical results
+    assert sorted(sess.must_query(
+        "select b, sum(a) from t group by b")) == exp
+    sess.execute("split table t regions 4")
+    assert tbl.snapshot().n_shards == 4
+    assert sorted(sess.must_query(
+        "select b, sum(a) from t group by b")) == exp
+    with pytest.raises(Exception):
+        sess.execute("split table t regions 0")

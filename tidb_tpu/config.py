@@ -61,15 +61,25 @@ def load_config(path: Optional[str] = None) -> Config:
     if unknown:
         raise ConfigError(
             f"unknown config keys: {', '.join(sorted(unknown))}")
-    cfg.host = raw.get("host", cfg.host)
-    cfg.port = int(raw.get("port", cfg.port))
-    cfg.status_port = int(raw.get("status-port", cfg.status_port))
-    cfg.data_dir = raw.get("data-dir", cfg.data_dir) or None
-    cfg.sync_wal = bool(raw.get("sync-wal", cfg.sync_wal))
-    log = raw.get("log", {})
-    cfg.slow_threshold_ms = float(
-        log.get("slow-threshold-ms", cfg.slow_threshold_ms))
-    cfg.variables = dict(raw.get("variables", {}))
+    try:
+        cfg.host = str(raw.get("host", cfg.host))
+        cfg.port = int(raw.get("port", cfg.port))
+        cfg.status_port = int(raw.get("status-port", cfg.status_port))
+        cfg.data_dir = raw.get("data-dir", cfg.data_dir) or None
+        cfg.sync_wal = bool(raw.get("sync-wal", cfg.sync_wal))
+        log = raw.get("log", {})
+        if not isinstance(log, dict):
+            raise ConfigError("[log] must be a table")
+        cfg.slow_threshold_ms = float(
+            log.get("slow-threshold-ms", cfg.slow_threshold_ms))
+        variables = raw.get("variables", {})
+        if not isinstance(variables, dict):
+            raise ConfigError("[variables] must be a table")
+        cfg.variables = dict(variables)
+    except ConfigError:
+        raise
+    except (TypeError, ValueError) as e:
+        raise ConfigError(f"bad config value in {path!r}: {e}")
     return cfg
 
 

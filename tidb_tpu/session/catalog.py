@@ -517,6 +517,17 @@ class TableInfo:
         self._snapshot = None
         self._epoch += 1
 
+    def split_regions(self, n_shards: int) -> None:
+        """Re-shard the table's scan fan-out (SPLIT TABLE ... REGIONS n,
+        the region-split analog): the next snapshot carries the new shard
+        count and a bumped epoch, so device programs re-fan-out — the
+        same invalidation path a real region split takes through the
+        region cache."""
+        if not 1 <= n_shards <= 4096:
+            raise CatalogError("REGIONS must be between 1 and 4096")
+        self.n_shards = int(n_shards)
+        self._invalidate()
+
     # ---------------- read path (columnarize) ---------------- #
 
     @property

@@ -298,6 +298,10 @@ class Session:
             except ValueError as e:
                 raise PlanError(str(e))
             return ResultSet()
+        if isinstance(stmt, A.SplitTable):
+            tbl = self.domain.catalog.get_table(self.db, stmt.table)
+            tbl.split_regions(stmt.regions)
+            return ResultSet(affected=stmt.regions)
         if isinstance(stmt, A.SetResourceGroup):
             if self.domain.resource_groups.get(stmt.name) is None:
                 raise PlanError(f"unknown resource group {stmt.name!r}")
@@ -364,7 +368,7 @@ class Session:
                 v = (val.value if isinstance(val, A.Lit)
                      else self._eval_scalar(val))
                 try:
-                    v = validate_set(name.lower(), v)
+                    v = validate_set(name.lower(), v, scope=stmt.scope)
                 except SysVarError as e:
                     raise PlanError(str(e))
                 (self.domain.sysvars if stmt.scope == "global"

@@ -157,12 +157,19 @@ class SysVarError(ValueError):
     pass
 
 
-def validate_set(name: str, value: Any) -> Any:
+def validate_set(name: str, value: Any,
+                 scope: Optional[str] = None) -> Any:
     """Coerce + validate a SET value; raises SysVarError on unknown
-    variable or out-of-range value.  Returns the canonical value."""
+    variable, wrong scope, or out-of-range value.  Returns the canonical
+    value.  `scope` is the statement's scope ('global'/'session')."""
     sv = REGISTRY.get(name)
     if sv is None:
         raise SysVarError(f"Unknown system variable {name!r}")
+    if scope == "global" and sv.scope == SCOPE_SESSION:
+        raise SysVarError(f"{name} is a SESSION variable")
+    if scope == "session" and sv.scope == SCOPE_GLOBAL:
+        raise SysVarError(
+            f"{name} is a GLOBAL variable; use SET GLOBAL")
     if value is None:
         return sv.default          # SET x = DEFAULT
     if sv.kind == "bool":

@@ -464,4 +464,12 @@ class SetResourceGroup(Node):
     name: str = ""
 
 
+@dataclass
+class SplitTable(Node):
+    """SPLIT TABLE t REGIONS n (region-split analog: re-shard the scan
+    fan-out)."""
+    table: str = ""
+    regions: int = 0
+
+
 __all__ = [n for n in dir() if n[0].isupper()]

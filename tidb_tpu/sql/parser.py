@@ -186,6 +186,15 @@ class Parser:
         if self.cur.kind == "ident" and self.cur.text.upper() in (
                 "PREPARE", "EXECUTE", "DEALLOCATE"):
             return self._prepare_family()
+        if self.cur.kind == "ident" and self.cur.text.upper() == "SPLIT":
+            self.advance()
+            self.expect_kw("TABLE")
+            name = self.ident()
+            t = self.cur
+            if not (t.kind == "ident" and t.text.upper() == "REGIONS"):
+                raise ParseError("expected REGIONS", t)
+            self.advance()
+            return A.SplitTable(name, self._int_lit())
         if self.at_kw("ADMIN"):
             return self.admin_stmt()
         if self.at_kw("GRANT"):
@@ -927,10 +936,14 @@ class Parser:
                 self.expect_op("=")
                 self.expect_op("(")
                 while not self.at_op(")"):
+                    if self.cur.kind == "eof":
+                        raise ParseError("unterminated QUERY_LIMIT",
+                                         self.cur)
                     sub = self.cur.text.upper()
                     self.advance()
                     self.expect_op("=")
                     if sub == "EXEC_ELAPSED":
+                        tok = self.cur
                         txt = self._str_lit().strip().lower()
                         mult = 1.0
                         for suf, m in (("ms", 1e-3), ("s", 1.0),
@@ -939,9 +952,18 @@ class Parser:
                                 txt = txt[:-len(suf)]
                                 mult = m
                                 break
-                        rg.exec_elapsed_sec = float(txt) * mult
+                        try:
+                            rg.exec_elapsed_sec = float(txt) * mult
+                        except ValueError:
+                            raise ParseError(
+                                "bad EXEC_ELAPSED duration", tok)
                     elif sub == "ACTION":
-                        rg.action = self.advance().text.lower()
+                        tok = self.cur
+                        act = self.advance().text.lower()
+                        if act not in ("kill", "cooldown"):
+                            raise ParseError(
+                                "ACTION must be KILL or COOLDOWN", tok)
+                        rg.action = act
                     else:
                         raise ParseError(f"unknown QUERY_LIMIT option "
                                          f"{sub}", self.cur)

@@ -56,11 +56,56 @@ class CopClient:
         self._page_feedback: OrderedDict[int, float] = OrderedDict()
         self._page_feedback_cap = 512
         self.last_page_iters = 0       # observability: regrow passes
+        # failure detection/recovery (copIterator backoff-and-retry):
+        # transient dispatch errors retry under a typed backoff budget
+        self.retry_budget_ms = 5000.0
+        # last_retries is best-effort observability (per-dispatch); the
+        # failpoint queue is lock-guarded since the client is shared by
+        # every connection thread
+        self.last_retries = 0
+        import threading
+        self._fp_mu = threading.Lock()
+        self._failpoints: list = []    # injected RegionErrors (tests/chaos)
+
+    # -- dispatch retry seam (pkg/store/copr backoff loop analog) ------ #
+
+    def inject_failures(self, kind, n: int = 1) -> None:
+        """Failpoint: the next n dispatches raise a RegionError of `kind`
+        before touching the device (chaos/testing seam, the reference's
+        failpoint.Inject on rpc errors)."""
+        from .backoff import RegionError
+        with self._fp_mu:
+            self._failpoints.extend(RegionError(kind) for _ in range(n))
+
+    def _next_failpoint(self):
+        with self._fp_mu:
+            return self._failpoints.pop(0) if self._failpoints else None
+
+    def _retry(self, fn):
+        from .backoff import Backoffer, RegionError
+        bo = Backoffer(max_sleep_ms=self.retry_budget_ms)
+        retries = 0
+        while True:
+            try:
+                fp = self._next_failpoint()
+                if fp is not None:
+                    raise fp
+                self.last_retries = retries
+                return fn()
+            except RegionError as e:
+                bo.backoff(e.kind, e)
+                retries += 1
 
     # ------------------------------------------------------------- #
 
     def execute_agg(self, agg: D.Aggregation, snap: ColumnarSnapshot,
                     key_meta: list[GroupKeyMeta], aux_cols=()) -> CopResult:
+        return self._retry(lambda: self._execute_agg_once(
+            agg, snap, key_meta, aux_cols))
+
+    def _execute_agg_once(self, agg: D.Aggregation, snap: ColumnarSnapshot,
+                          key_meta: list[GroupKeyMeta],
+                          aux_cols=()) -> CopResult:
         cols, counts = snap.device_cols(self.mesh)
         if agg.strategy == D.GroupStrategy.SORT:
             return self._execute_sort_agg(agg, cols, counts, key_meta,
@@ -208,6 +253,11 @@ class CopClient:
     def execute_shuffle_agg(self, spec: D.ShuffleJoinSpec, lsnap, rsnap,
                             key_meta: list[GroupKeyMeta],
                             aux_cols=()) -> CopResult:
+        return self._retry(lambda: self._execute_shuffle_agg_once(
+            spec, lsnap, rsnap, key_meta, aux_cols))
+
+    def _execute_shuffle_agg_once(self, spec, lsnap, rsnap, key_meta,
+                                  aux_cols=()) -> CopResult:
         prog, out = self._run_shuffle(spec, lsnap, rsnap, aux_cols)
         agg = prog.spec.top
         states = jax.device_get(out)
@@ -226,6 +276,12 @@ class CopClient:
     def execute_shuffle_rows(self, spec: D.ShuffleJoinSpec, lsnap, rsnap,
                              out_dtypes, dictionaries=None,
                              aux_cols=()) -> list[Column]:
+        return self._retry(lambda: self._execute_shuffle_rows_once(
+            spec, lsnap, rsnap, out_dtypes, dictionaries, aux_cols))
+
+    def _execute_shuffle_rows_once(self, spec, lsnap, rsnap, out_dtypes,
+                                   dictionaries=None,
+                                   aux_cols=()) -> list[Column]:
         n_dev = len(self.mesh.devices.reshape(-1))
         if isinstance(spec.top, (D.TopN, D.Limit)):
             row_cap = max(spec.top.limit, 16)
@@ -258,6 +314,12 @@ class CopClient:
 
     def execute_rows(self, root: D.CopNode, snap: ColumnarSnapshot,
                      out_dtypes, dictionaries=None, aux_cols=()) -> list[Column]:
+        return self._retry(lambda: self._execute_rows_once(
+            root, snap, out_dtypes, dictionaries, aux_cols))
+
+    def _execute_rows_once(self, root: D.CopNode, snap: ColumnarSnapshot,
+                           out_dtypes, dictionaries=None,
+                           aux_cols=()) -> list[Column]:
         """Row-returning plan with the paging loop."""
         n_dev = len(self.mesh.devices.reshape(-1))
         is_topn = isinstance(root, D.TopN)

@@ -31,10 +31,12 @@ class ResourceGroup:
     burstable: bool = False
     exec_elapsed_sec: float = 0.0  # 0 = no runaway watch
     runaway_action: str = "kill"   # kill | cooldown
-    # token bucket state
+    # token bucket state (guarded by _mu: the server is thread-per-
+    # connection and every session in the group shares this bucket)
     tokens: float = 0.0
     last_refill: float = field(default_factory=time.monotonic)
     runaway_count: int = 0
+    _mu: threading.Lock = field(default_factory=threading.Lock)
 
     def _refill(self, now: float) -> None:
         if self.ru_per_sec <= 0:
@@ -46,24 +48,29 @@ class ResourceGroup:
         self.tokens = min(self.tokens + dt * self.ru_per_sec, cap)
         self.last_refill = now
 
+    def note_runaway(self) -> None:
+        with self._mu:
+            self.runaway_count += 1
+
     def consume(self, rus: float, max_wait_sec: float = 5.0) -> float:
         """Charge `rus`; blocks (bounded) while the bucket is in debt.
         Returns seconds slept — the throttle the reference applies via
-        its token client."""
+        its token client.  Sleeps happen OUTSIDE the lock."""
         if self.ru_per_sec <= 0:
             return 0.0
         slept = 0.0
         while True:
-            now = time.monotonic()
-            self._refill(now)
-            if self.tokens > 0:
-                self.tokens -= rus     # post-paid: may go negative (debt)
-                return slept
-            need = min((-self.tokens + rus) / self.ru_per_sec,
-                       max_wait_sec - slept)
-            if need <= 0:
-                self.tokens -= rus     # waited long enough; take the debt
-                return slept
+            with self._mu:
+                now = time.monotonic()
+                self._refill(now)
+                if self.tokens > 0:
+                    self.tokens -= rus  # post-paid: may go negative (debt)
+                    return slept
+                need = min((-self.tokens + rus) / self.ru_per_sec,
+                           max_wait_sec - slept)
+                if need <= 0:
+                    self.tokens -= rus  # waited long enough; take the debt
+                    return slept
             time.sleep(min(need, 0.05))
             slept += min(need, 0.05)
 
@@ -139,7 +146,7 @@ def charge_statement(group: ResourceGroup, rows_touched: int,
     """Post-execution accounting: RU charge + runaway watch."""
     rus = rows_touched / 100.0 + 1.0
     if group.exec_elapsed_sec and elapsed_sec > group.exec_elapsed_sec:
-        group.runaway_count += 1
+        group.note_runaway()
         if group.runaway_action == "kill":
             raise RunawayError(
                 f"query exceeded EXEC_ELAPSED "

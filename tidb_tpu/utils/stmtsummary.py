@@ -19,15 +19,51 @@ _WS = re.compile(r"\s+")
 _IN_LIST = re.compile(r"\(\s*\?(?:\s*,\s*\?)+\s*\)")
 
 
-_COMMENT = re.compile(r"/\*.*?\*/", re.S)
+def _strip_strings_and_comments(sql: str) -> str:
+    """One left-to-right pass replacing string literals with ? and
+    removing comments — regex passes cannot order these correctly (a
+    quote inside a comment, or comment markers inside a string, corrupt
+    each other's extents)."""
+    out = []
+    i, n = 0, len(sql)
+    while i < n:
+        c = sql[i]
+        if c in "'\"":
+            q = c
+            i += 1
+            while i < n:
+                if sql[i] == "\\":
+                    i += 2
+                    continue
+                if sql[i] == q:
+                    if i + 1 < n and sql[i + 1] == q:   # '' escape
+                        i += 2
+                        continue
+                    i += 1
+                    break
+                i += 1
+            out.append("?")
+            continue
+        if sql.startswith("--", i) or c == "#":
+            j = sql.find("\n", i)
+            i = n if j < 0 else j + 1
+            out.append(" ")
+            continue
+        if sql.startswith("/*", i):
+            j = sql.find("*/", i + 2)
+            i = n if j < 0 else j + 2
+            out.append(" ")
+            continue
+        out.append(c)
+        i += 1
+    return "".join(out)
 
 
 def normalize_sql(sql: str) -> str:
     """Literal-free normalized form (digester.go analog).  Comments —
     including /*+ hint */ blocks — do not participate in the digest, so a
     hinted statement matches its unhinted original (bindinfo contract)."""
-    s = _STR.sub("?", sql)       # strings first: comment markers inside
-    s = _COMMENT.sub(" ", s)     # string literals must not swallow SQL
+    s = _strip_strings_and_comments(sql)
     s = _NUM.sub("?", s)
     s = _WS.sub(" ", s).strip().lower()
     s = _IN_LIST.sub("(...)", s)   # collapse IN/VALUES lists
