@@ -1,0 +1,220 @@
+"""Control-plane breadth: DXF-lite (pkg/disttask analog), owner election
+(pkg/owner), telemetry (pkg/telemetry, local-only), plugin framework
+(pkg/plugin audit hooks)."""
+
+import time
+
+import pytest
+
+from tidb_tpu.session import Domain, Session
+
+
+# ---------------- DXF ---------------- #
+
+def test_dxf_plan_run_succeed(tmp_path):
+    s = Session(Domain())
+    s.execute("create table a (x bigint)")
+    s.execute("create table b (x bigint)")
+    s.execute("insert into a values (1),(2)")
+    s.execute("insert into b values (3)")
+    m = s.domain.dxf
+    tid = m.submit("analyze", {"db": "test"})
+    t = m.run(tid)
+    assert t.state == "succeed"
+    assert sorted(sub.result for sub in t.subtasks) == [1, 2]
+    rows = s.must_query("select task_id, type, state, subtasks_done, "
+                        "subtasks_total from information_schema.dist_tasks")
+    assert rows == [(tid, "analyze", "succeed", 2, 2)]
+
+
+def test_dxf_import_csv(tmp_path):
+    s = Session(Domain())
+    s.execute("create table t (a bigint, b bigint)")
+    p = tmp_path / "rows.csv"
+    p.write_text("\n".join(f"{i},{i * 2}" for i in range(10_000)) + "\n")
+    m = s.domain.dxf
+    tid = m.submit("import-csv", {"table": "t", "path": str(p),
+                                  "chunk_rows": 2048})
+    t = m.run(tid)
+    assert t.state == "succeed"
+    assert len(t.subtasks) == 5
+    assert s.must_query("select count(*), sum(b) from t") == \
+        [(10_000, sum(i * 2 for i in range(10_000)))]
+
+
+def test_dxf_failure_and_cancel():
+    from tidb_tpu.dxf import TaskManager, TaskTypeRegistry
+    reg = TaskTypeRegistry()
+    reg.register("boom", lambda meta: [{"i": i} for i in range(4)],
+                 lambda meta: (_ for _ in ()).throw(
+                     RuntimeError(f"sub{meta['i']}")))
+    m = TaskManager(workers=2, registry=reg)
+    tid = m.submit("boom", {})
+    t = m.run(tid)
+    assert t.state == "failed" and "sub" in t.error
+    reg.register("slow", lambda meta: [{} for _ in range(4)],
+                 lambda meta: time.sleep(0.01))
+    tid2 = m.submit("slow", {})
+    m.cancel(tid2)
+    assert m.run(tid2).state == "cancelled"
+
+
+def test_dxf_resume_after_restart(tmp_path):
+    """Subtask state persists to KV: a restarted manager resumes
+    unfinished subtasks, skipping succeeded ones."""
+    from tidb_tpu.dxf import TaskManager, TaskTypeRegistry
+    from tidb_tpu.store.kv import KVStore
+    kv = KVStore(path=str(tmp_path / "kv"))
+    runs = []
+    reg = TaskTypeRegistry()
+    reg.register("work", lambda meta: [{"i": i} for i in range(4)],
+                 lambda meta: runs.append(meta["i"]) or meta["i"])
+    m1 = TaskManager(kv=kv, registry=reg)
+    tid = m1.submit("work", {})
+    t = m1.get(tid)
+    t.subtasks[0].state = "succeed"      # simulate partial completion
+    t.state = "running"
+    m1._persist(t)
+    m2 = TaskManager(kv=kv, registry=reg)   # "restarted owner"
+    t2 = m2.get(tid)
+    assert t2 is not None and t2.subtasks[0].state == "succeed"
+    out = m2.run(tid)
+    assert out.state == "succeed"
+    assert sorted(runs) == [1, 2, 3]     # subtask 0 was NOT re-run
+
+
+def test_dxf_planner_failure_no_ghost_task():
+    s = Session(Domain())
+    m = s.domain.dxf
+    with pytest.raises(FileNotFoundError):
+        m.submit("import-csv", {"table": "t", "path": "/no/such/file"})
+    assert m.tasks() == []
+
+
+def test_dxf_rerun_clears_error():
+    from tidb_tpu.dxf import TaskManager, TaskTypeRegistry
+    reg = TaskTypeRegistry()
+    state = {"fail": True}
+
+    def run(meta):
+        if state["fail"]:
+            raise RuntimeError("flaky")
+        return 1
+
+    reg.register("flaky", lambda meta: [{}], run)
+    m = TaskManager(workers=1, registry=reg)
+    tid = m.submit("flaky", {})
+    assert m.run(tid).state == "failed"
+    state["fail"] = False
+    for s_ in m.get(tid).subtasks:
+        if s_.state == "failed":
+            s_.state = "pending"
+    t = m.run(tid)
+    assert t.state == "succeed" and t.error == ""
+
+
+def test_digest_subtraction_not_comment():
+    from tidb_tpu.utils.stmtsummary import normalize_sql
+    # 'a--1' is subtraction (no whitespace after --): nothing truncated
+    assert normalize_sql("select a--1 from t") == "select a--? from t"
+    assert normalize_sql("select a -- trailing comment\nfrom t") == \
+        "select a from t"
+
+
+# ---------------- owner election ---------------- #
+
+def test_owner_campaign_race_single_winner(tmp_path):
+    """Concurrent campaigns on an expired lease: exactly one wins (the
+    read+write share one KV txn, so W-W conflict aborts the loser)."""
+    import threading
+
+    from tidb_tpu.ddl.election import OwnerManager
+    from tidb_tpu.store.kv import KVStore
+    kv = KVStore(path=str(tmp_path / "kv"))
+    mgrs = [OwnerManager(kv, "ddl", lease_sec=5.0, owner_id=f"m{i}")
+            for i in range(4)]
+    results = {}
+    barrier = threading.Barrier(4)
+
+    def go(m):
+        barrier.wait()
+        results[m.owner_id] = m.campaign()
+
+    ts = [threading.Thread(target=go, args=(m,)) for m in mgrs]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert sum(results.values()) == 1, results
+
+def test_owner_election_single_winner(tmp_path):
+    from tidb_tpu.ddl.election import OwnerManager
+    from tidb_tpu.store.kv import KVStore
+    kv = KVStore(path=str(tmp_path / "kv"))
+    a = OwnerManager(kv, "ddl", lease_sec=0.5, owner_id="a")
+    b = OwnerManager(kv, "ddl", lease_sec=0.5, owner_id="b")
+    assert a.campaign()
+    assert a.is_owner()
+    assert not b.campaign()        # lease held
+    assert not b.is_owner()
+    a.resign()
+    assert b.campaign() and b.is_owner()
+    b.close()
+
+
+def test_owner_lease_expiry(tmp_path):
+    from tidb_tpu.ddl.election import OwnerManager
+    from tidb_tpu.store.kv import KVStore
+    kv = KVStore(path=str(tmp_path / "kv"))
+    a = OwnerManager(kv, "ddl", lease_sec=0.2, owner_id="a")
+    b = OwnerManager(kv, "ddl", lease_sec=0.2, owner_id="b")
+    assert a.campaign()
+    time.sleep(0.3)                # a dies silently; lease expires
+    assert b.campaign() and b.is_owner()
+    assert not a.is_owner()
+
+
+# ---------------- telemetry ---------------- #
+
+def test_telemetry_opt_in(tmp_path):
+    from tidb_tpu.utils.telemetry import collect, report
+    s = Session(Domain())
+    s.execute("create table t (a bigint)")
+    s.must_query("select 1")
+    out = tmp_path / "tele.json"
+    assert report(s.domain, str(out)) is None       # OFF by default
+    s.execute("set global tidb_enable_telemetry = 1")
+    assert report(s.domain, str(out)) == str(out)
+    import json
+    d = json.loads(out.read_text())
+    assert d["schema"]["tables"] >= 1
+    assert d["workload"]["total_execs"] >= 1
+    assert "features" in d and not d["features"]["bindings"]
+
+
+# ---------------- plugins ---------------- #
+
+def test_audit_plugin_and_isolation():
+    from tidb_tpu.plugin import AuditLogPlugin, registry
+    audit = AuditLogPlugin()
+
+    class Broken:
+        name = "broken"
+
+        def on_stmt_end(self, *a, **kw):
+            raise RuntimeError("boom")
+
+    registry.register(audit)
+    registry.register(Broken())
+    try:
+        s = Session(Domain())
+        s.execute("create table t (a bigint)")
+        s.execute("insert into t values (1)")
+        s.must_query("select a from t")
+        assert any("select a from t" in l for l in audit.lines)
+        assert any("rows=1" in l for l in audit.lines)
+        # the broken plugin was isolated, errors recorded, statements ran
+        assert any(p == "broken" for p, _ in registry.errors)
+    finally:
+        registry.unregister("audit-log")
+        registry.unregister("broken")

@@ -153,6 +153,16 @@ def _resource_groups(dom):
     return dom.resource_groups.rows()
 
 
+def _dist_tasks(dom):
+    m = getattr(dom, "_dxf", None)
+    if m is None:
+        return []
+    return [(t.task_id, t.task_type, t.state,
+             sum(1 for s in t.subtasks if s.state == "succeed"),
+             len(t.subtasks), t.error)
+            for t in m.tasks()]
+
+
 def _cluster_info(dom):
     import jax
     try:
@@ -207,6 +217,9 @@ _INFORMATION_SCHEMA = {
     "RESOURCE_GROUPS": ([("NAME", S), ("RU_PER_SEC", I), ("BURSTABLE", S),
                          ("EXEC_ELAPSED_SEC", F), ("RUNAWAY_ACTION", S),
                          ("RUNAWAY_COUNT", I)], _resource_groups),
+    "DIST_TASKS": ([("TASK_ID", I), ("TYPE", S), ("STATE", S),
+                    ("SUBTASKS_DONE", I), ("SUBTASKS_TOTAL", I),
+                    ("ERROR", S)], _dist_tasks),
 }
 
 _PERFORMANCE_SCHEMA = {

@@ -1,0 +1,86 @@
+"""Plugin framework: audit/extension hook points.
+
+Reference analog: pkg/plugin (audit plugins with OnGeneralEvent /
+OnConnectionEvent) and pkg/extension (the function/event extension
+points).  A plugin is any object exposing a subset of the hook methods;
+hooks fire synchronously on the statement path, and a misbehaving plugin
+is isolated (its exceptions are recorded, not propagated) — the
+reference's plugin sandboxing contract.
+
+    class MyAudit:
+        name = "my-audit"
+        def on_connection(self, event, conn_id, user): ...
+        def on_stmt_begin(self, sess, sql): ...
+        def on_stmt_end(self, sess, sql, error, elapsed_sec, rows): ...
+
+    from tidb_tpu.plugin import registry
+    registry.register(MyAudit())
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+
+class PluginRegistry:
+    def __init__(self):
+        self._plugins: list[Any] = []
+        self._mu = threading.Lock()
+        self.errors: list[tuple[str, str]] = []    # (plugin, error)
+
+    def register(self, plugin: Any) -> None:
+        if not getattr(plugin, "name", ""):
+            raise ValueError("plugin needs a .name")
+        with self._mu:
+            self._plugins.append(plugin)
+
+    def unregister(self, name: str) -> bool:
+        with self._mu:
+            before = len(self._plugins)
+            self._plugins = [p for p in self._plugins if p.name != name]
+            return len(self._plugins) != before
+
+    def plugins(self) -> list:
+        with self._mu:
+            return list(self._plugins)
+
+    def fire(self, hook: str, *args, **kw) -> None:
+        """Invoke `hook` on every plugin that implements it; plugin
+        failures are isolated and recorded."""
+        for p in self.plugins():
+            fn = getattr(p, hook, None)
+            if fn is None:
+                continue
+            try:
+                fn(*args, **kw)
+            except Exception as e:       # noqa: BLE001 - isolation
+                with self._mu:
+                    self.errors.append((p.name, f"{hook}: {e}"))
+
+
+registry = PluginRegistry()
+
+
+class AuditLogPlugin:
+    """Sample audit plugin (the reference ships audit as its flagship
+    plugin): appends one line per statement to a log list or file."""
+
+    name = "audit-log"
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self.lines: list[str] = []
+
+    def on_stmt_end(self, sess, sql: str, error: Optional[str],
+                    elapsed_sec: float, rows: int) -> None:
+        line = (f"user={sess.user} db={sess.db} rows={rows} "
+                f"ms={elapsed_sec * 1e3:.1f} "
+                f"err={error or '-'} sql={sql[:200]}")
+        self.lines.append(line)
+        if self.path:
+            with open(self.path, "a") as f:
+                f.write(line + "\n")
+
+
+__all__ = ["PluginRegistry", "registry", "AuditLogPlugin"]

@@ -96,6 +96,16 @@ class Domain:
         self.resource_groups = ResourceGroupManager()
 
     @property
+    def dxf(self):
+        """Lazily-created distributed task framework manager
+        (pkg/disttask analog)."""
+        m = getattr(self, "_dxf", None)
+        if m is None:
+            from ..dxf.tasks import manager_for
+            m = self._dxf = manager_for(self)
+        return m
+
+    @property
     def ddl(self):
         """Lazily-started online-DDL owner (pkg/ddl analog)."""
         if self._ddl is None:
@@ -217,10 +227,14 @@ class Session:
                     # unhinted plan must not shadow the binding (and
                     # vice versa after DROP BINDING)
                     self._cur_sql = None
+            from ..plugin import registry as _plugins
+            _plugins.fire("on_stmt_begin", self, text)
             try:
                 out = self._exec_stmt(stmt)
-            except Exception:
+            except Exception as e:
                 qcnt.inc(type="error")
+                _plugins.fire("on_stmt_end", self, text, str(e),
+                              (time.perf_counter_ns() - t0) / 1e9, 0)
                 raise
             finally:
                 self._cur_sql = None
@@ -228,7 +242,16 @@ class Session:
             qcnt.inc(type=type(stmt).__name__)
             qdur.observe(dt_ns / 1e9)
             self.domain.stmt_summary.record(text, dt_ns, len(out.rows))
-            self._charge_resource_group(stmt, out, dt_ns / 1e9)
+            try:
+                # runaway KILL must fire before the success audit hook:
+                # a killed statement is an error to the client
+                self._charge_resource_group(stmt, out, dt_ns / 1e9)
+            except Exception as e:
+                _plugins.fire("on_stmt_end", self, text, str(e),
+                              dt_ns / 1e9, 0)
+                raise
+            _plugins.fire("on_stmt_end", self, text, None, dt_ns / 1e9,
+                          len(out.rows) + out.affected)
         return out
 
     def _charge_resource_group(self, stmt, out: ResultSet,

@@ -52,6 +52,7 @@ _VARS = [
        options=("optimistic", "pessimistic")),
     _v("tidb_slow_log_threshold", 300, kind="int", min=0),
     _v("tidb_resource_group", "default", kind="str"),
+    _v("tidb_enable_telemetry", 0, kind="bool", scope=SCOPE_GLOBAL),
     # MySQL compatibility surface (honored where the engine has the
     # concept; stored + reflected otherwise)
     _v("autocommit", 1, kind="bool"),

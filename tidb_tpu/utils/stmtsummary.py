@@ -14,7 +14,6 @@ import time
 from dataclasses import dataclass, field
 
 _NUM = re.compile(r"\b\d+(?:\.\d+)?\b")
-_STR = re.compile(r"'(?:[^'\\]|\\.)*'")
 _WS = re.compile(r"\s+")
 _IN_LIST = re.compile(r"\(\s*\?(?:\s*,\s*\?)+\s*\)")
 
@@ -44,7 +43,10 @@ def _strip_strings_and_comments(sql: str) -> str:
                 i += 1
             out.append("?")
             continue
-        if sql.startswith("--", i) or c == "#":
+        if (sql.startswith("--", i)
+                and (i + 2 >= n or sql[i + 2] in " \t\n")) or c == "#":
+            # MySQL: '--' starts a comment only when followed by
+            # whitespace — 'a--1' is subtraction, not a comment
             j = sql.find("\n", i)
             i = n if j < 0 else j + 1
             out.append(" ")

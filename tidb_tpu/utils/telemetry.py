@@ -1,0 +1,61 @@
+"""Telemetry: opt-in local usage reporting.
+
+Reference analog: pkg/telemetry (feature-usage collection reported on an
+interval; excised of any network egress here — reports are written as
+local JSON only, and collection is OFF unless tidb_enable_telemetry is
+set).  The collected shape mirrors the reference's report: instance
+info, uptime, feature-usage flags, statement counts.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Optional
+
+
+def collect(domain) -> dict:
+    """Assemble one telemetry report from live Domain state."""
+    import jax
+    try:
+        devs = jax.devices()
+        hw = {"platform": devs[0].platform, "device_count": len(devs)}
+    except Exception:
+        hw = {"platform": "unknown", "device_count": 0}
+    tables = sum(len(t) for t in domain.catalog.databases.values())
+    indexes = sum(len(getattr(t, "indexes", []))
+                  for ts in domain.catalog.databases.values()
+                  for t in ts.values())
+    stmt_rows = domain.stmt_summary.summary_rows()
+    features = {
+        "bindings": bool(domain.bindings.rows()),
+        "resource_groups": len(domain.resource_groups.rows()) > 1,
+        "ddl_jobs": domain._ddl is not None,
+        "durable_store": domain.meta is not None,
+    }
+    return {
+        "report_time": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "version": "0.2.0",
+        "hardware": hw,
+        "schema": {"tables": tables, "indexes": indexes},
+        "workload": {
+            "distinct_digests": len(stmt_rows),
+            "total_execs": sum(r[1] for r in stmt_rows),
+        },
+        "features": features,
+    }
+
+
+def report(domain, path: Optional[str] = None) -> Optional[str]:
+    """Write one report to `path` (JSON) if telemetry is enabled.
+    Returns the path written, or None when disabled."""
+    from .memory import sysvar_bool
+    if not sysvar_bool(domain.sysvars.get("tidb_enable_telemetry"), False):
+        return None
+    out = path or "telemetry-report.json"
+    with open(out, "w") as f:
+        json.dump(collect(domain), f, indent=2)
+    return out
+
+
+__all__ = ["collect", "report"]
