@@ -60,27 +60,39 @@ def test_dxf_failure_and_cancel():
 
 
 def test_dxf_resume_after_restart(tmp_path):
-    """Subtask state persists to KV: a restarted manager resumes
-    unfinished subtasks, skipping succeeded ones."""
+    """Subtask completions persist AS THEY HAPPEN: a restarted manager
+    resumes only unfinished subtasks — already-committed side effects
+    (e.g. import chunks) are never re-executed."""
     from tidb_tpu.dxf import TaskManager, TaskTypeRegistry
     from tidb_tpu.store.kv import KVStore
     kv = KVStore(path=str(tmp_path / "kv"))
     runs = []
+    crash = {"on": True}
     reg = TaskTypeRegistry()
-    reg.register("work", lambda meta: [{"i": i} for i in range(4)],
-                 lambda meta: runs.append(meta["i"]) or meta["i"])
-    m1 = TaskManager(kv=kv, registry=reg)
+
+    def work(meta):
+        if crash["on"] and meta["i"] >= 2:
+            raise RuntimeError("owner crash")   # first run dies partway
+        runs.append(meta["i"])
+        return meta["i"]
+
+    reg.register("work", lambda meta: [{"i": i} for i in range(4)], work)
+    m1 = TaskManager(kv=kv, workers=1, registry=reg)
     tid = m1.submit("work", {})
-    t = m1.get(tid)
-    t.subtasks[0].state = "succeed"      # simulate partial completion
-    t.state = "running"
-    m1._persist(t)
+    assert m1.run(tid).state == "failed"
+    assert sorted(runs) == [0, 1]
+    crash["on"] = False
     m2 = TaskManager(kv=kv, registry=reg)   # "restarted owner"
     t2 = m2.get(tid)
-    assert t2 is not None and t2.subtasks[0].state == "succeed"
+    assert t2 is not None
+    # subtask completions were auto-persisted mid-run
+    assert [s.state for s in t2.subtasks[:2]] == ["succeed", "succeed"]
+    for s_ in t2.subtasks:
+        if s_.state == "failed":
+            s_.state = "pending"
     out = m2.run(tid)
-    assert out.state == "succeed"
-    assert sorted(runs) == [1, 2, 3]     # subtask 0 was NOT re-run
+    assert out.state == "succeed" and out.error == ""
+    assert sorted(runs) == [0, 1, 2, 3]  # subtasks 0/1 were NOT re-run
 
 
 def test_dxf_planner_failure_no_ghost_task():

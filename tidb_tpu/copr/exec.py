@@ -73,14 +73,19 @@ def _reduce(vals, mask, gids, num_groups, how: str):
     """Masked (optionally grouped) reduction.
 
     how: 'sum' | 'min' | 'max'.  gids None => scalar reduction.
-    Grouped: dense (G,) output; broadcast-compare for small G (fuses into
-    the scan pass), scatter otherwise.
-    """
+    Grouped: dense (G,) output.  Strategy is PER-PLATFORM: on TPU a
+    broadcast one-hot compare for small G fuses into the streaming scan
+    pass (scatter lowering on TPU can serialize); on CPU the (G, N)
+    broadcast costs G x the scan traffic per aggregate and XLA's
+    scatter-add is cheap — measured 14x on TPC-H Q1 — so CPU always
+    scatters."""
     neutral = {"sum": 0, "min": _max_of(vals.dtype), "max": _min_of(vals.dtype)}[how]
     v = jnp.where(mask, vals, jnp.asarray(neutral, vals.dtype))
     if gids is None:
         return getattr(jnp, how)(v)
-    if num_groups <= DENSE_BROADCAST_MAX_GROUPS:
+    broadcast_max = (0 if jax.default_backend() == "cpu"
+                     else DENSE_BROADCAST_MAX_GROUPS)
+    if num_groups <= broadcast_max:
         onehot = gids[None, :] == jnp.arange(num_groups, dtype=gids.dtype)[:, None]
         vv = jnp.where(onehot, v[None, :], jnp.asarray(neutral, vals.dtype))
         return getattr(jnp, how)(vv, axis=1)

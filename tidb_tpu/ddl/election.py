@@ -82,13 +82,19 @@ class OwnerManager:
         return self._claim(require_held=True)
 
     def resign(self) -> None:
-        if self.is_owner():
-            txn = self.kv.begin()
-            try:
-                txn.delete(self.key)
-                txn.commit()
-            except Exception:
+        """Atomic compare-and-delete: the ownership check and the delete
+        share one txn, so resign can never remove a lease another node
+        claimed after our check (same serialization as campaign)."""
+        txn = self.kv.begin()
+        try:
+            raw = txn.get(self.key)
+            if raw is None or json.loads(raw.decode())["id"] != self.owner_id:
                 txn.rollback()
+                return
+            txn.delete(self.key)
+            txn.commit()
+        except Exception:
+            txn.rollback()
 
     # -- background renewal (the etcd keepalive analog) ---------------- #
 

@@ -155,6 +155,11 @@ class TaskManager:
             except Exception as e:       # noqa: BLE001 - task isolation
                 s.state = "failed"
                 s.error = str(e)
+            # persist EVERY subtask completion: crash-resume must skip
+            # finished subtasks (their side effects committed), not
+            # re-execute them (_mu serializes concurrent pool persists)
+            with self._mu:
+                self._persist(t)
 
         pending = [s for s in t.subtasks if s.state != "succeed"]
         with ThreadPoolExecutor(max_workers=self.workers) as pool:

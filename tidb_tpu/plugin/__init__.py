@@ -25,9 +25,11 @@ from typing import Any, Optional
 
 class PluginRegistry:
     def __init__(self):
+        from collections import deque
         self._plugins: list[Any] = []
         self._mu = threading.Lock()
-        self.errors: list[tuple[str, str]] = []    # (plugin, error)
+        # bounded: a misfiring plugin on a busy server must not leak
+        self.errors: Any = deque(maxlen=256)       # (plugin, error)
 
     def register(self, plugin: Any) -> None:
         if not getattr(plugin, "name", ""):
@@ -68,9 +70,10 @@ class AuditLogPlugin:
 
     name = "audit-log"
 
-    def __init__(self, path: Optional[str] = None):
+    def __init__(self, path: Optional[str] = None, max_lines: int = 10_000):
+        from collections import deque
         self.path = path
-        self.lines: list[str] = []
+        self.lines: Any = deque(maxlen=max_lines)  # in-memory ring
 
     def on_stmt_end(self, sess, sql: str, error: Optional[str],
                     elapsed_sec: float, rows: int) -> None:

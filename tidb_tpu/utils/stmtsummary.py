@@ -44,7 +44,7 @@ def _strip_strings_and_comments(sql: str) -> str:
             out.append("?")
             continue
         if (sql.startswith("--", i)
-                and (i + 2 >= n or sql[i + 2] in " \t\n")) or c == "#":
+                and (i + 2 >= n or sql[i + 2].isspace())) or c == "#":
             # MySQL: '--' starts a comment only when followed by
             # whitespace — 'a--1' is subtraction, not a comment
             j = sql.find("\n", i)
