@@ -69,6 +69,21 @@ def _sel_array(sel, n):
 # Aggregation partial states (the psum seam, SURVEY.md §A.4)
 # --------------------------------------------------------------------- #
 
+# platform the program being TRACED will run on — set by the program
+# builders from their actual device placement (a CPU mesh on a TPU host,
+# e.g. dryrun_multichip, must still take the CPU strategy); falls back
+# to the process default backend
+_TRACE_PLATFORM: list = [None]
+
+
+def set_trace_platform(platform):
+    _TRACE_PLATFORM[0] = platform
+
+
+def trace_platform() -> str:
+    return _TRACE_PLATFORM[0] or jax.default_backend()
+
+
 def _reduce(vals, mask, gids, num_groups, how: str):
     """Masked (optionally grouped) reduction.
 
@@ -83,7 +98,7 @@ def _reduce(vals, mask, gids, num_groups, how: str):
     v = jnp.where(mask, vals, jnp.asarray(neutral, vals.dtype))
     if gids is None:
         return getattr(jnp, how)(v)
-    broadcast_max = (0 if jax.default_backend() == "cpu"
+    broadcast_max = (0 if trace_platform() == "cpu"
                      else DENSE_BROADCAST_MAX_GROUPS)
     if num_groups <= broadcast_max:
         onehot = gids[None, :] == jnp.arange(num_groups, dtype=gids.dtype)[:, None]

@@ -50,9 +50,16 @@ class DistTask:
     finish_time: float = 0.0
 
     def to_json(self) -> bytes:
-        d = asdict(self)
-        for s in d["subtasks"]:
-            s["result"] = None          # results are not persisted
+        # built by hand, NOT asdict(): results are never persisted and
+        # must not be deep-copied either (they may be large or hold
+        # non-copyable objects)
+        d = {"task_id": self.task_id, "task_type": self.task_type,
+             "meta": self.meta, "state": self.state, "error": self.error,
+             "start_time": self.start_time,
+             "finish_time": self.finish_time,
+             "subtasks": [{"idx": s.idx, "meta": s.meta,
+                           "state": s.state, "result": None,
+                           "error": s.error} for s in self.subtasks]}
         return json.dumps(d).encode()
 
     @classmethod
@@ -157,9 +164,16 @@ class TaskManager:
                 s.error = str(e)
             # persist EVERY subtask completion: crash-resume must skip
             # finished subtasks (their side effects committed), not
-            # re-execute them (_mu serializes concurrent pool persists)
-            with self._mu:
-                self._persist(t)
+            # re-execute them (_mu serializes concurrent pool persists).
+            # O(K) state rows per persist — results are excluded, so each
+            # write is small.  A persist failure must not escape subtask
+            # isolation (the task would be stuck 'running' forever).
+            try:
+                with self._mu:
+                    self._persist(t)
+            except Exception as e:       # noqa: BLE001
+                s.error = (s.error + "; " if s.error else "") + \
+                    f"persist: {e}"
 
         pending = [s for s in t.subtasks if s.state != "succeed"]
         with ThreadPoolExecutor(max_workers=self.workers) as pool:

@@ -112,6 +112,8 @@ class ShardedShuffleJoinProgram:
         return out_cols[:-1], recv_valid, rkeys, rkey_ok, max_count
 
     def _device_fn(self, lcols, lcounts, rcols, rcounts, aux):
+        from ..copr.exec import set_trace_platform
+        set_trace_platform(self.mesh.devices.reshape(-1)[0].platform)
         ev = Evaluator(jnp)
         aux = tuple((v, True if m is None else m) for v, m in aux)
         spec, caps = self.spec, self.caps

@@ -123,6 +123,8 @@ class ShardedCopProgram:
             out_specs=out_specs, check_vma=False))
 
     def _device_fn(self, cols, counts, aux):
+        from ..copr.exec import set_trace_platform
+        set_trace_platform(self.mesh.devices.reshape(-1)[0].platform)
         cols = [(v, m) for v, m in cols]
         flat, base_sel = _flatten_block(cols, counts)
         flat = [(v, True if m is None else m) for v, m in flat]
