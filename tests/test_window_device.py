@@ -1,0 +1,148 @@
+"""Device window functions (TiFlash MPP window analog): hash-repartition
+by PARTITION BY + per-device sort + segment ops (parallel/window.py)."""
+
+import collections
+
+import numpy as np
+import pytest
+
+from tidb_tpu.session import Domain, Session
+
+
+def _plan(s, q):
+    return "\n".join(r[0] for r in s.must_query("explain " + q))
+
+
+@pytest.fixture()
+def sess():
+    s = Session(Domain())
+    s.execute("create table t (g bigint, o bigint, v bigint)")
+    rng = np.random.default_rng(7)
+    rows = [(int(rng.integers(0, 25)), int(rng.integers(0, 60)),
+             int(rng.integers(-50, 50))) for _ in range(4000)]
+    s.execute("insert into t values " +
+              ",".join(f"({a},{b},{c})" for a, b, c in rows))
+    s.rows = rows
+    return s
+
+
+def test_row_number_device_matches_oracle(sess):
+    q = ("select g, o, v, row_number() over "
+         "(partition by g order by o, v) from t")
+    assert "CopWindow" in _plan(sess, q)
+    got = sess.must_query(q)
+    by_g = collections.defaultdict(list)
+    for a, b, c in sess.rows:
+        by_g[a].append((b, c))
+    exp = collections.Counter()
+    for g, lst in by_g.items():
+        for rn, (b, c) in enumerate(sorted(lst), 1):
+            exp[(g, b, c, rn)] += 1
+    assert collections.Counter(map(tuple, got)) == exp
+
+
+def test_rank_dense_rank_desc_device(sess):
+    q = ("select g, v, rank() over (partition by g order by v desc), "
+         "dense_rank() over (partition by g order by v desc) from t")
+    assert "CopWindow" in _plan(sess, q)
+    vals = collections.defaultdict(list)
+    for a, _b, c in sess.rows:
+        vals[a].append(c)
+    for g, v, rk, dr in sess.must_query(q):
+        vs = sorted(vals[g], reverse=True)
+        assert rk == vs.index(v) + 1
+        assert dr == len({x for x in vals[g] if x > v}) + 1
+
+
+def test_whole_partition_aggs_device(sess):
+    q = ("select g, sum(v) over (partition by g), "
+         "count(*) over (partition by g), "
+         "min(v) over (partition by g), max(v) over (partition by g), "
+         "avg(v) over (partition by g) from t")
+    assert "CopWindow" in _plan(sess, q)
+    vals = collections.defaultdict(list)
+    for a, _b, c in sess.rows:
+        vals[a].append(c)
+    for g, sm, cnt, mn, mx, av in sess.must_query(q):
+        assert (sm, cnt, mn, mx) == (sum(vals[g]), len(vals[g]),
+                                     min(vals[g]), max(vals[g]))
+        assert abs(av - sum(vals[g]) / len(vals[g])) < 1e-9
+
+
+def test_window_null_keys_device():
+    s = Session(Domain())
+    s.execute("create table n (g bigint, v bigint)")
+    s.execute("insert into n values (1, 10), (1, NULL), (NULL, 5), "
+              "(NULL, 7), (2, 3)")
+    q = ("select g, v, row_number() over (partition by g order by v) "
+         "from n")
+    assert "CopWindow" in _plan(s, q)
+    got = sorted(s.must_query(q), key=lambda r: (r[0] is None, r[0] or 0,
+                                                 r[1] is None, r[1] or 0))
+    # NULL partition key forms its own partition; NULL orders first ASC
+    # (sort key above places the NULL-v row after the 10-v row)
+    assert got == [(1, 10, 2), (1, None, 1),
+                   (2, 3, 1),
+                   (None, 5, 1), (None, 7, 2)]
+
+
+def test_window_skew_regrows_buckets():
+    """Every row in ONE partition: a single device receives everything,
+    forcing the bucket-capacity regrow (paging discipline)."""
+    s = Session(Domain())
+    s.execute("create table sk (g bigint, v bigint)")
+    s.execute("insert into sk values " +
+              ",".join(f"(7, {i})" for i in range(5000)))
+    q = "select v, row_number() over (partition by g order by v) from sk"
+    assert "CopWindow" in _plan(s, q)
+    got = sorted(s.must_query(q))
+    assert got == [(i, i + 1) for i in range(5000)]
+
+
+def test_window_over_filter_fuses_scan(sess):
+    q = ("select g, v, rank() over (partition by g order by v) from t "
+         "where v >= 0")
+    assert "CopWindow" in _plan(sess, q)
+    vals = collections.defaultdict(list)
+    for a, _b, c in sess.rows:
+        if c >= 0:
+            vals[a].append(c)
+    for g, v, rk in sess.must_query(q):
+        assert v >= 0 and rk == sorted(vals[g]).index(v) + 1
+
+
+def test_window_string_minmax_and_fallbacks(sess):
+    s = Session(Domain())
+    s.execute("create table w (g bigint, name varchar(10), v bigint)")
+    s.execute("insert into w values (1,'pear',1),(1,'apple',2),"
+              "(2,'fig',3),(2,'kiwi',4)")
+    q = ("select g, min(name) over (partition by g), "
+         "max(name) over (partition by g) from w")
+    assert "CopWindow" in _plan(s, q)
+    assert sorted(set(s.must_query(q))) == \
+        [(1, "apple", "pear"), (2, "fig", "kiwi")]
+    # derived string expr keeps its output dictionary on device
+    q2 = "select g, min(upper(name)) over (partition by g) from w"
+    assert "CopWindow" in _plan(s, q2)
+    assert sorted(set(s.must_query(q2))) == [(1, "APPLE"), (2, "FIG")]
+    # ordered string min/max: host path must decode codes via the dict
+    q3 = ("select g, min(name) over (partition by g order by v) from w "
+          "where v <= 2")
+    assert "HostWindow" in _plan(s, q3)
+    assert sorted(s.must_query(q3)) == [(1, "apple"), (1, "pear")]
+    # decimal AVG unscales on device
+    s.execute("create table dv (g bigint, d decimal(10,2))")
+    s.execute("insert into dv values (1, 1.50), (1, 2.50), (2, 4.00)")
+    q4 = "select g, avg(d) over (partition by g) from dv"
+    assert "CopWindow" in _plan(s, q4)
+    assert sorted(set(s.must_query(q4))) == [(1, 2.0), (2, 4.0)]
+    # mixed ORDER BY specs and explicit frames stay on host
+    mixed = ("select rank() over (partition by g order by v), "
+             "sum(v) over (partition by g) from w")
+    assert "HostWindow" in _plan(s, mixed)
+    framed = ("select sum(v) over (partition by g order by v "
+              "rows between 1 preceding and current row) from w")
+    assert "HostWindow" in _plan(s, framed)
+    # no PARTITION BY: global window needs a total order -> host
+    noglobal = "select row_number() over (order by v) from w"
+    assert "HostWindow" in _plan(s, noglobal)

@@ -182,6 +182,29 @@ class LookupJoin(CopNode):
 
 
 @dataclass(frozen=True)
+class WindowShuffleSpec:
+    """Device window-function program spec.
+
+    Reference analog: TiFlash's MPP window execution — an exchange hash-
+    partitioned on PARTITION BY feeds per-node sort + window operators
+    (cophandler/mpp_exec.go window path, executor/window.go semantics).
+    TPU redesign: the scan chain runs per device, rows hash-partition
+    over the mesh by PARTITION BY keys via lax.all_to_all, each device
+    multi-key-sorts its partitions once and computes every window item
+    with segment ops — ONE shard_map program, exchange bytes on ICI.
+
+    `items` is a tuple of (func, arg_expr_or_None, out_dtype);
+    supported funcs: row_number | rank | dense_rank (need ORDER BY) and
+    count | sum | min | max | avg over the WHOLE partition (no ORDER BY,
+    default unbounded frame).  Output schema: child columns ++ one
+    column per item (row order unspecified, like any unordered SELECT)."""
+    child: CopNode
+    partition_keys: Tuple = ()      # (Expr, ...) over child output
+    order_keys: Tuple = ()          # ((Expr, desc), ...)
+    items: Tuple = ()               # ((func, arg, out_dtype), ...)
+
+
+@dataclass(frozen=True)
 class ShuffleJoinSpec:
     """Cross-device repartition (shuffle) hash join program spec.
 

@@ -487,6 +487,9 @@ class CopProgram:
         self._fn = jax.jit(self._trace)
 
     def _trace(self, scan_cols, row_count, aux_cols=()):
+        # single-device programs run on the process default backend:
+        # reset any platform a prior CPU-mesh trace left sticky
+        set_trace_platform(None)
         # At the jit boundary "all valid" is encoded as None (a pytree node,
         # hence static structure); inside the trace it becomes the literal
         # True the Evaluator's fast paths key on.

@@ -1664,6 +1664,31 @@ def _sum_col(a: AggItem, out_obj: np.ndarray, cnt: np.ndarray) -> Column:
 
 
 @dataclass
+class CopWindowExec(PhysOp):
+    """Device window functions (TiFlash MPP window analog): rows
+    hash-repartition by PARTITION BY over the mesh, each device sorts its
+    partitions once and computes every window item with segment ops —
+    one fused shard_map program (parallel/window.py)."""
+    spec: Any                      # D.WindowShuffleSpec
+    table: Any
+    out_names: list = field(default_factory=list)
+    out_dtypes: list = field(default_factory=list)
+    out_dicts: dict = field(default_factory=dict)
+    children: list = field(default_factory=list)
+
+    def describe(self):
+        funcs = ",".join(f for f, _a, _t in self.spec.items)
+        return f"CopWindow[{funcs}] table={self.table.name} -> TPU"
+
+    def execute(self, ctx: ExecContext) -> ResultChunk:
+        # dictionaries attach inside the client's _assemble_rows
+        cols = ctx.client.execute_window(
+            self.spec, self.table.snapshot(), tuple(self.out_dtypes),
+            self.out_dicts)
+        return ResultChunk(list(self.out_names), cols)
+
+
+@dataclass
 class MemTableExec(PhysOp):
     """information_schema / performance_schema memtable reader
     (pkg/executor/infoschema_reader.go retriever analog): materializes the
@@ -2099,7 +2124,8 @@ def _window_column(item, chunk: ResultChunk) -> Column:
     out[sidx] = vals
     ov = np.empty(n, bool)
     ov[sidx] = valid
-    return Column(t, out.astype(t.np_dtype()), ov)
+    # min/max over a dict-encoded string returns a CODE: keep its dict
+    return Column(t, out.astype(t.np_dtype()), ov, dictionary)
 
 
 def _frame_bounds(item, idx, ps, pe, pstart, peer_end, has_order):

@@ -102,6 +102,9 @@ def to_physical(p: LogicalPlan, no_device_join: bool = False) -> PhysOp:
                          out_names=p.schema.names(),
                          out_dtypes=[c.dtype for c in p.schema.cols])
     if isinstance(p, LogicalWindow):
+        dev = _try_cop_window(p)
+        if dev is not None:
+            return dev
         return HostWindow(to_physical(p.children[0], ndj), list(p.items),
                           out_names=p.schema.names(),
                           out_dtypes=[c.dtype for c in p.schema.cols])
@@ -234,6 +237,88 @@ def _try_cop(p: LogicalPlan, no_device_join: bool = False) -> Optional[PhysOp]:
     return CopTaskExec(node, ds.table, out_names=out_names,
                        out_dtypes=out_dtypes, key_meta=key_meta,
                        out_dicts=out_dicts)
+
+
+_WIN_RANK_FUNCS = ("row_number", "rank", "dense_rank")
+_WIN_AGG_FUNCS = ("count", "sum", "min", "max", "avg")
+
+
+def _try_cop_window(p) -> Optional[PhysOp]:
+    """Push window functions to device (TiFlash MPP window analog): a
+    hash-repartition by PARTITION BY co-locates each partition, then one
+    per-device sort + segment ops compute every item.  Requirements:
+    every item shares one PARTITION BY (non-empty) and ORDER BY, no
+    explicit frames, rank-family or whole-partition aggregates only, and
+    every key/arg lowers to a device expression."""
+    from ..utils.collate import is_binary
+    from .physical import CopWindowExec
+    items = p.items
+    if not items:
+        return None
+    part, order = items[0].partition, items[0].order
+    if not part:
+        return None      # global windows need a total order: host
+    for it in items:
+        if it.partition != part or it.order != order \
+                or it.frame is not None:
+            return None
+        if it.func in _WIN_RANK_FUNCS:
+            if not order and it.func != "row_number":
+                return None
+        elif it.func in _WIN_AGG_FUNCS:
+            if order:
+                return None      # ordered agg = moving frame: host
+            if it.func != "count" and not it.args:
+                return None
+        else:
+            return None
+    bound = _bind_scan_chain(p.child)
+    if bound is None:
+        return None
+    node, cur_dicts, ds = bound
+
+    def low(e):
+        e2 = lower_strings(e, cur_dicts)
+        if not _device_supported(e2):
+            return None
+        if e2.dtype.np_dtype() == object:
+            return None
+        if e2.dtype.is_string and not is_binary(e2.dtype.collation):
+            return None              # ci keys: code order != collation
+        return e2
+
+    pkeys = tuple(low(e) for e in part)
+    if any(k is None for k in pkeys):
+        return None
+    okeys = []
+    for e, desc in order:
+        k = low(e)
+        if k is None:
+            return None
+        okeys.append((k, desc))
+    spec_items = []
+    arg_dicts = {}
+    for i, it in enumerate(items):
+        arg = None
+        if it.func in _WIN_AGG_FUNCS and it.args:
+            arg = low(it.args[0])
+            if arg is None:
+                return None
+            if it.func in ("min", "max"):
+                d = expr_out_dict(arg, cur_dicts)
+                if d is not None:
+                    arg_dicts[i] = d
+        spec_items.append((it.func, arg, it.out_dtype))
+    spec = D.WindowShuffleSpec(node, pkeys, tuple(okeys),
+                               tuple(spec_items))
+    n_child = len(p.schema) - len(items)
+    out_dicts = {i: d for i, d in cur_dicts.items() if i < n_child}
+    for i, d in arg_dicts.items():
+        out_dicts[n_child + i] = d
+    return CopWindowExec(spec, ds.table,
+                         out_names=p.schema.names(),
+                         out_dtypes=[c.dtype for c in p.schema.cols],
+                         out_dicts=out_dicts)
 
 
 def _join_method_hint(p: LogicalJoin) -> str:

@@ -1155,8 +1155,6 @@ def _build_window_item(fc: A.FuncCall, schema: Schema) -> WindowItem:
     elif fl in ("min", "max"):
         if not args:
             raise PlanError(f"{name} needs an argument")
-        if args[0].dtype.is_string:
-            raise PlanError(f"{name} over strings not supported in windows")
         out = args[0].dtype.with_nullable(True)
     else:  # lag/lead/first_value/last_value
         if not args:

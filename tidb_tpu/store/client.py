@@ -250,6 +250,35 @@ class CopClient:
             return prog, out
         raise RuntimeError("shuffle capacity regrow did not converge")
 
+    def execute_window(self, spec: D.WindowShuffleSpec,
+                       snap: ColumnarSnapshot, out_dtypes,
+                       dictionaries=None) -> list[Column]:
+        return self._retry(lambda: self._execute_window_once(
+            spec, snap, out_dtypes, dictionaries))
+
+    def _execute_window_once(self, spec, snap, out_dtypes,
+                             dictionaries=None) -> list[Column]:
+        """Hash-repartitioned window program (TiFlash MPP window analog):
+        bucket capacity regrows from the reported true maximum, the
+        paging discipline."""
+        from ..parallel.window import get_window_program
+        cols, counts = snap.device_cols(self.mesh)
+        n_dev = len(self.mesh.devices.reshape(-1))
+        # expected bucket rows under uniform hashing, 2x headroom
+        cap = _pow2_at_least(
+            max(2 * snap.num_rows // max(n_dev * n_dev, 1) + 1, 1024))
+        for _ in range(10):
+            prog = get_window_program(spec, self.mesh, cap)
+            (out_cols, out_counts), extras = prog(cols, counts)
+            need = int(np.max(np.asarray(jax.device_get(extras["wmax"]))))
+            if need <= cap:
+                break
+            cap = _pow2_at_least(need)
+        else:
+            raise RuntimeError("window bucket regrow did not converge")
+        return self._assemble_rows(out_cols, out_counts,
+                                   n_dev * cap, out_dtypes, dictionaries)
+
     def execute_shuffle_agg(self, spec: D.ShuffleJoinSpec, lsnap, rsnap,
                             key_meta: list[GroupKeyMeta],
                             aux_cols=()) -> CopResult:
