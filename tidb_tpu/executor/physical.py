@@ -1664,6 +1664,142 @@ def _sum_col(a: AggItem, out_obj: np.ndarray, cnt: np.ndarray) -> Column:
 
 
 @dataclass
+class HostApplyExec(PhysOp):
+    """Correlated scalar subqueries (LogicalApply executor; the P8
+    parallel-apply seam).  For each DISTINCT combination of the outer
+    values a subquery references, the subquery is planned with those
+    values bound as constants and executed once — the apply cache
+    (join/apply_cache.go analog) collapses duplicate outer rows."""
+    child: PhysOp
+    subqueries: list        # [(sub_ast, out_dtype, name)]
+    catalog: Any = None
+    default_db: str = ""
+    outer_quals: list = field(default_factory=list)  # [(name, qualifier)]
+    out_names: list = field(default_factory=list)
+    out_dtypes: list = field(default_factory=list)
+
+    def __post_init__(self):
+        self.children = [self.child]
+
+    def describe(self):
+        return f"HostApply[{len(self.subqueries)} subqueries] (cached)"
+
+    def chunks(self, ctx, required_rows=None):
+        for chunk in self.child.chunks(ctx):
+            cols = list(chunk.columns)
+            for sub_ast, out_t, _name in self.subqueries:
+                cols.append(self._apply_one(ctx, chunk, sub_ast, out_t))
+            yield ResultChunk(list(self.out_names), cols)
+
+    def _apply_one(self, ctx, chunk: ResultChunk, sub_ast,
+                   out_t) -> Column:
+        from ..planner.build import (OUTER_RESOLVER, PlanError,
+                                     build_query)
+        from ..planner.optimize import optimize_plan
+        from ..sql import ast as A
+        n = chunk.num_rows
+        # decoded outer values per row, resolved lazily by name
+        decoded: dict[int, list] = {}
+
+        def col_values(i):
+            if i not in decoded:
+                decoded[i] = chunk.columns[i].to_python()
+            return decoded[i]
+
+        quals = self.outer_quals or [(nm.lower(), "")
+                                     for nm in chunk.names]
+
+        def find_outer(ident) -> Optional[int]:
+            """Qualifier-aware outer resolution (no silent misbinding):
+            a qualified miss returns None (-> unknown column error from
+            the subquery build); bare ambiguity raises."""
+            from ..planner.build import PlanError
+            if len(ident.parts) >= 2:
+                q, name = ident.parts[-2].lower(), ident.parts[-1].lower()
+                hits = [i for i, (nm, qu) in enumerate(quals)
+                        if nm == name and qu == q]
+            else:
+                name = ident.parts[0].lower()
+                hits = [i for i, (nm, _qu) in enumerate(quals)
+                        if nm == name]
+            if len(hits) > 1:
+                raise PlanError(f"ambiguous outer column {name!r} in "
+                                "correlated subquery")
+            return hits[0] if hits else None
+
+        from .plan import to_physical
+        cache: dict = {}
+        out_vals: list = []
+        used_cols: list = []      # discovered on the first row
+
+        def run_row(row: int):
+            def resolver(ident: A.Ident):
+                i = find_outer(ident)
+                if i is None:
+                    return None
+                if i not in used_cols:
+                    used_cols.append(i)
+                v = col_values(i)[row]
+                from ..session.catalog import plainify
+                from ..expr import builders as B
+                return B.lit(plainify(v))
+
+            import copy as _copy
+
+            from ..planner.build import SUBQUERY_EXECUTOR
+
+            def nested_eval(ast2):
+                """Eager executor for subqueries NESTED inside the apply
+                (the session's hook is out of scope at executor time)."""
+                from ..expr import builders as B
+                from ..session.catalog import plainify
+                b2 = build_query(ast2, self.catalog, self.default_db, {})
+                if len(b2.plan.schema) != 1:
+                    raise PlanError(
+                        "scalar subquery must return one column")
+                c2 = to_physical(optimize_plan(b2.plan)).execute(ctx)
+                if c2.num_rows > 1:
+                    raise PlanError(
+                        "scalar subquery returned more than one row")
+                if c2.num_rows == 0 or not c2.columns[0].validity[0]:
+                    return B.lit(None)
+                return B.lit(plainify(c2.columns[0].to_python()[0]))
+
+            tok = OUTER_RESOLVER.set(resolver)
+            tok2 = SUBQUERY_EXECUTOR.set(nested_eval)
+            try:
+                built = build_query(_copy.deepcopy(sub_ast), self.catalog,
+                                    self.default_db, {})
+                plan = optimize_plan(built.plan)
+                sub = to_physical(plan).execute(ctx)
+            finally:
+                SUBQUERY_EXECUTOR.reset(tok2)
+                OUTER_RESOLVER.reset(tok)
+            if sub.num_rows > 1:
+                raise PlanError(
+                    "scalar subquery returned more than one row")
+            if sub.num_rows == 0 or not sub.columns[0].validity[0]:
+                return None
+            return sub.columns[0].to_python()[0]
+
+        for row in range(n):
+            if used_cols:
+                key = tuple(col_values(i)[row] for i in used_cols)
+                if key in cache:
+                    out_vals.append(cache[key])
+                    continue
+                val = run_row(row)
+                cache[key] = val
+            else:
+                val = run_row(row)
+                if used_cols:     # first row discovered the refs
+                    cache[tuple(col_values(i)[row]
+                                for i in used_cols)] = val
+            out_vals.append(val)
+        return Column.from_values(out_t, out_vals)
+
+
+@dataclass
 class CopWindowExec(PhysOp):
     """Device window functions (TiFlash MPP window analog): rows
     hash-repartition by PARTITION BY over the mesh, each device sorts its

@@ -108,6 +108,17 @@ def to_physical(p: LogicalPlan, no_device_join: bool = False) -> PhysOp:
         return HostWindow(to_physical(p.children[0], ndj), list(p.items),
                           out_names=p.schema.names(),
                           out_dtypes=[c.dtype for c in p.schema.cols])
+    from ..planner.logical import LogicalApply
+    if isinstance(p, LogicalApply):
+        from .physical import HostApplyExec
+        inner = p.children[0]
+        return HostApplyExec(to_physical(inner, ndj),
+                             list(p.subqueries), p.catalog, p.default_db,
+                             outer_quals=[(c.name.lower(),
+                                           (c.qualifier or "").lower())
+                                          for c in inner.schema.cols],
+                             out_names=p.schema.names(),
+                             out_dtypes=[c.dtype for c in p.schema.cols])
     if isinstance(p, LogicalCTEScan):
         return CTEScanExec(p.storage, p.role,
                            out_names=p.schema.names(),

@@ -54,6 +54,13 @@ import contextvars
 SUBQUERY_EXECUTOR: contextvars.ContextVar = contextvars.ContextVar(
     "subquery_executor", default=None)
 
+# Correlated-subquery ident hook: consulted by _b_ident when the local
+# schema misses; returns an Expr or None.  Installed (a) during the
+# trial build that discovers a subquery's outer references, and (b)
+# during per-row apply execution with actual outer values bound.
+OUTER_RESOLVER: contextvars.ContextVar = contextvars.ContextVar(
+    "outer_resolver", default=None)
+
 # list the session installs per statement; builders append a reason when
 # the plan embeds statement-time state (NOW(), scalar subquery results)
 # so the plan cache skips it
@@ -77,10 +84,13 @@ class ExprBuilder:
     window calls likewise require a window_resolver."""
 
     def __init__(self, schema: Schema, agg_resolver=None,
-                 window_resolver=None):
+                 window_resolver=None, outer_resolver=None):
         self.schema = schema
         self.agg_resolver = agg_resolver
         self.window_resolver = window_resolver
+        # correlated-subquery hook: called with an Ident the local schema
+        # can't resolve; returns an Expr bound to the OUTER query or None
+        self.outer_resolver = outer_resolver
 
     def build(self, n: A.Node) -> Expr:
         m = getattr(self, f"_b_{type(n).__name__.lower()}", None)
@@ -96,9 +106,15 @@ class ExprBuilder:
         else:
             q, name = n.parts[-2], n.parts[-1]
         hits = self.schema.find(name, q)
+        # NO unqualified fallback on a qualifier miss: that silently
+        # bound t.k inside a subquery over u to u.k — wrong results.
+        # An outer_resolver (correlated subquery build) may claim it.
         if not hits:
-            hits = self.schema.find(name, None)
-        if not hits:
+            res = self.outer_resolver or OUTER_RESOLVER.get()
+            if res is not None:
+                out = res(n)
+                if out is not None:
+                    return out
             raise PlanError(f"unknown column {'.'.join(n.parts)!r}")
         if len(hits) > 1:
             raise PlanError(f"ambiguous column {name!r}")
@@ -512,12 +528,147 @@ def build_query(stmt: A.Node, catalog, default_db: str,
     return build_select(stmt, catalog, default_db, ctes)
 
 
+def _rewrite_scalar_subqueries(node, child, catalog, default_db, ctes,
+                               applies: list):
+    """Replace CORRELATED bare scalar subqueries with placeholder idents
+    served by a LogicalApply column (rule_decorrelate's apply fallback).
+    Uncorrelated subqueries are left for the eager-eval path; IN/EXISTS
+    forms are left for the semi/anti-join path."""
+    import dataclasses as _dc
+
+    def try_correlated(sub_sel):
+        import copy as _copy
+
+        # probe builds run on COPIES: build_select rewrites nested
+        # subqueries in place, and a discarded trial must not leave
+        # placeholders in the AST the real build (or per-row apply
+        # execution) will use
+        try:
+            build_query(_copy.deepcopy(sub_sel), catalog, default_db,
+                        dict(ctes))
+            return None          # uncorrelated
+        except PlanError as e:
+            if "unknown column" not in str(e):
+                raise
+
+        def dummy_resolver(ident: A.Ident):
+            if len(ident.parts) == 1:
+                q, name = None, ident.parts[0]
+            else:
+                q, name = ident.parts[-2], ident.parts[-1]
+            hits = child.schema.find(name, q)
+            if not hits:
+                return None
+            t = child.schema.cols[hits[0]].dtype
+            return Const(t.with_nullable(True),
+                         "" if t.is_string else 0)
+
+        tok = OUTER_RESOLVER.set(dummy_resolver)
+        try:
+            built = build_query(_copy.deepcopy(sub_sel), catalog,
+                                default_db, dict(ctes))
+        finally:
+            OUTER_RESOLVER.reset(tok)
+        if len(built.plan.schema) != 1:
+            raise PlanError("scalar subquery must return one column")
+        out_t = built.plan.schema.cols[0].dtype.with_nullable(True)
+        name = f"__apply_{len(applies)}"
+        applies.append((sub_sel, out_t, name))
+        _taint_plan("correlated subquery")
+        return A.Ident((name,))
+
+    def maybe_correlated(sub_sel) -> bool:
+        """Cheap pre-filter: a subquery whose idents all resolve against
+        its own FROM tables cannot be correlated — skip the (expensive)
+        probe build.  Bails to True (full probe) on derived tables."""
+        tables = []
+        stack = [sub_sel.from_]
+        while stack:
+            f = stack.pop()
+            if isinstance(f, A.Join):
+                stack += [f.left, f.right]
+            elif isinstance(f, A.TableName):
+                try:
+                    t = catalog.get_table(f.db or default_db, f.name)
+                except Exception:
+                    return True
+                tables.append(((f.alias or f.name).lower(),
+                               {c.lower() for c in t.col_names}))
+            else:
+                return True        # derived table / CTE: full probe
+        aliases = {a for a, _c in tables}
+        for x in _walk_ast(sub_sel):
+            if not isinstance(x, A.Ident):
+                continue
+            if len(x.parts) >= 2:
+                if x.parts[-2].lower() not in aliases:
+                    return True
+            elif not any(x.parts[-1].lower() in cols
+                         for _a, cols in tables):
+                return True
+        return False
+
+    def walk(n):
+        if isinstance(n, A.SubqueryExpr):
+            if not maybe_correlated(n.select):
+                return n           # provably local: eager path handles it
+            repl = try_correlated(n.select)
+            return repl if repl is not None else n
+        if isinstance(n, A.ExistsExpr):
+            return n             # semi/anti-join path
+        if isinstance(n, A.InExpr) and any(
+                isinstance(i, A.SubqueryExpr) for i in n.items):
+            return n             # semi/anti-join path
+        if not isinstance(n, A.Node):
+            return n
+        for f in _dc.fields(n):
+            v = getattr(n, f.name)
+            if isinstance(v, A.Node):
+                setattr(n, f.name, walk(v))
+            elif isinstance(v, list):
+                setattr(n, f.name, [
+                    walk(x) if isinstance(x, A.Node)
+                    else tuple(walk(y) if isinstance(y, A.Node) else y
+                               for y in x) if isinstance(x, tuple)
+                    else x
+                    for x in v])
+        return n
+
+    return walk(node)
+
+
 def build_select(sel: A.SelectStmt, catalog, default_db: str,
                  ctes: Optional[dict] = None) -> BuiltSelect:
     ctes = ctes or {}
     if sel.from_ is None:
         return _build_no_table(sel)
     child = _build_from(sel.from_, catalog, default_db, ctes)
+
+    # correlated scalar subqueries -> LogicalApply columns (must wrap the
+    # child BEFORE items/where build so placeholders resolve)
+    applies: list = []
+    if SUBQUERY_EXECUTOR.get() is not None:
+        def rw(node):
+            return _rewrite_scalar_subqueries(
+                node, child, catalog, default_db, ctes, applies)
+        if sel.where is not None:
+            sel.where = rw(sel.where)
+        for it in sel.items:
+            if not isinstance(it.expr, A.Star):
+                it.expr = rw(it.expr)
+        # ORDER BY apply columns only make sense pre-aggregation; a
+        # correlated subquery in HAVING would need apply-above-aggregate
+        # (per-group evaluation) — unsupported, surfaces unknown-column
+        if sel.order_by and not (
+                sel.group_by
+                or _contains_agg(sel.items, sel.having, sel.order_by)):
+            sel.order_by = [(rw(e), desc) for e, desc in sel.order_by]
+    if applies:
+        from .logical import LogicalApply
+        cols = list(child.schema.cols) + [
+            SchemaCol(nm, t, "__apply__") for _ast, t, nm in applies]
+        child = LogicalApply(child, applies, catalog, default_db,
+                             Schema(cols))
 
     if sel.where is not None:
         # WHERE-clause subquery predicates (IN/EXISTS) become semi/anti
@@ -540,6 +691,8 @@ def build_select(sel: A.SelectStmt, catalog, default_db: str,
         if isinstance(it.expr, A.Star):
             q = it.expr.table
             for i, c in enumerate(child.schema.cols):
+                if (c.qualifier or "") == "__apply__":
+                    continue     # apply columns never appear in SELECT *
                 if q is None or (c.qualifier or "").lower() == q.lower():
                     items.append(A.SelectItem(A.Ident((c.qualifier, c.name)
                                                       if c.qualifier else (c.name,)),
@@ -812,6 +965,13 @@ def _is_window_call(x) -> bool:
     return isinstance(x, A.FuncCall) and x.over is not None
 
 
+def _agg_scan_prune(x) -> bool:
+    """Stop descent below window calls (SUM(x) OVER ... is no aggregate)
+    and below subqueries (their aggregates belong to the INNER query)."""
+    return _is_window_call(x) or isinstance(x, A.SubqueryExpr) \
+        or isinstance(x, A.ExistsExpr)
+
+
 def _contains_agg(items, having, order_by) -> bool:
     roots = [it.expr for it in items]
     if having is not None:
@@ -820,8 +980,7 @@ def _contains_agg(items, having, order_by) -> bool:
     return any(
         isinstance(x, A.FuncCall) and x.over is None and x.name in AGG_FUNCS
         for r in roots
-        # a window call is not an aggregate (SUM(x) OVER ...): skip subtree
-        for x in _walk_ast(r, prune=_is_window_call))
+        for x in _walk_ast(r, prune=_agg_scan_prune))
 
 
 def _build_agg_select(sel: A.SelectStmt, items, child) -> tuple[LogicalPlan, list[str]]:
@@ -1155,6 +1314,14 @@ def _build_window_item(fc: A.FuncCall, schema: Schema) -> WindowItem:
     elif fl in ("min", "max"):
         if not args:
             raise PlanError(f"{name} needs an argument")
+        if args[0].dtype.is_string:
+            from ..utils.collate import is_binary
+            if not is_binary(args[0].dtype.collation):
+                # the host window path compares raw codes (binary order);
+                # wrong under ci — reject rather than return wrong values
+                raise PlanError(
+                    f"{name} over a non-binary collation is not "
+                    "supported in window functions")
         out = args[0].dtype.with_nullable(True)
     else:  # lag/lead/first_value/last_value
         if not args:

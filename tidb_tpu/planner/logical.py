@@ -133,6 +133,24 @@ class LogicalJoin(LogicalPlan):
 
 
 @dataclass
+class LogicalApply(LogicalPlan):
+    """Correlated scalar subqueries (reference: LogicalApply +
+    rule_decorrelate fallback; P8 parallel apply).  Appends one column
+    per subquery to the child's schema; each subquery re-evaluates per
+    DISTINCT combination of the outer values it references (the apply
+    cache, executor/join/apply_cache.go analog)."""
+    child: LogicalPlan = None
+    # [(sub_ast, out_dtype, name)] — outer refs bind by name at exec time
+    subqueries: list = field(default_factory=list)
+    catalog: object = None
+    default_db: str = ""
+    schema: Schema = None
+
+    def __post_init__(self):
+        self.children = [self.child]
+
+
+@dataclass
 class LogicalSort(LogicalPlan):
     child: LogicalPlan
     keys: list[tuple[Expr, bool]]  # (expr over child schema, desc)
