@@ -106,3 +106,25 @@ def test_star_excludes_apply_columns(sess):
         "select * from t where v < (select max(w) from u where u.k = t.k) "
         "order by k")
     assert got == [(1, 10), (2, 20)]    # no __apply_0 column leaks
+
+
+def test_find_in_set_empty_needle_consistency(sess):
+    # literal and column paths must agree (MySQL: '' matches an empty
+    # element; empty LIST never matches)
+    sess.execute("create table fe (b varchar(10))")
+    sess.execute("insert into fe values ('a,,b'), ('')")
+    assert sess.must_query("select find_in_set('', 'a,,b')") == [(2,)]
+    assert sorted(sess.must_query(
+        "select find_in_set('', b) from fe")) == [(0,), (2,)]
+
+
+def test_apply_cache_spans_chunks(sess):
+    """Cache lives across streamed chunks: distinct-value evaluations,
+    not per-chunk re-evaluations (class docstring contract)."""
+    sess.execute("create table wide (k bigint)")
+    sess.execute("insert into wide values " +
+                 ",".join(f"({i % 2})" for i in range(200_000)))
+    got = sess.must_query(
+        "select k, (select count(*) from u where u.k = wide.k + 1) "
+        "from wide limit 4")
+    assert len(got) == 4

@@ -289,3 +289,50 @@ def test_cte_in_set_op(s):
         with a as (select n from nums)
         select n from a intersect select n from other order by n""")
     assert [r[0] for r in rows] == [2, 3]
+
+
+def test_show_create_table():
+    from tidb_tpu.session import Domain, Session
+    s = Session(Domain())
+    s.execute("create table sc (a bigint not null, "
+              "b varchar(10) collate utf8mb4_general_ci, "
+              "c decimal(10,2), sz enum('s','m'), primary key (a))")
+    s.execute("create index ib on sc (b)")
+    ddl = s.must_query("show create table sc")[0][1]
+    assert "CREATE TABLE `sc`" in ddl
+    assert "`a` bigint NOT NULL" in ddl
+    assert "COLLATE utf8mb4_general_ci" in ddl
+    assert "decimal(10,2)" in ddl
+    assert "enum('s','m')" in ddl
+    assert "PRIMARY KEY (`a`)" in ddl
+    assert "KEY `ib` (`b`)" in ddl
+    assert ddl.count("PRIMARY") == 1      # PK index not double-rendered
+
+
+def test_admin_checksum_table():
+    from tidb_tpu.session import Domain, Session
+    s = Session(Domain())
+    s.execute("create table ck (a bigint, b varchar(5))")
+    s.execute("insert into ck values (1,'x'),(2,'y')")
+    (db, name, c1, kvs, nb), = s.must_query("admin checksum table ck")
+    assert (db, name, kvs) == ("test", "ck", 2) and nb > 0
+    # checksum changes with data, and is stable across identical state
+    (_, _, c1b, _, _), = s.must_query("admin checksum table ck")
+    assert c1b == c1
+    s.execute("insert into ck values (3,'z')")
+    (_, _, c2, kvs2, _), = s.must_query("admin checksum table ck")
+    assert c2 != c1 and kvs2 == 3
+
+
+def test_find_in_set():
+    from tidb_tpu.session import Domain, Session
+    s = Session(Domain())
+    s.execute("create table f (a bigint, b varchar(10))")
+    s.execute("insert into f values (1,'x,y'),(2,'y'),(3,'')")
+    assert s.must_query(
+        "select a, find_in_set('y', b) from f order by a") == \
+        [(1, 2), (2, 1), (3, 0)]
+    assert s.must_query(
+        "select a from f where find_in_set(b, 'y,z') > 0") == [(2,)]
+    assert s.must_query("select find_in_set('b', 'a,b,c')") == [(2,)]
+    assert s.must_query("select find_in_set('q', 'a,b,c')") == [(0,)]

@@ -1685,14 +1685,20 @@ class HostApplyExec(PhysOp):
         return f"HostApply[{len(self.subqueries)} subqueries] (cached)"
 
     def chunks(self, ctx, required_rows=None):
-        for chunk in self.child.chunks(ctx):
+        # cache/used-cols live for the WHOLE scan (per subquery), so
+        # duplicate outer values across chunks evaluate once; this
+        # operator is row-preserving, so required_rows forwards
+        states = [{"cache": {}, "used": []} for _ in self.subqueries]
+        for chunk in self.child.chunks(ctx, required_rows):
             cols = list(chunk.columns)
-            for sub_ast, out_t, _name in self.subqueries:
-                cols.append(self._apply_one(ctx, chunk, sub_ast, out_t))
+            for (sub_ast, out_t, _name), st in zip(self.subqueries,
+                                                   states):
+                cols.append(self._apply_one(ctx, chunk, sub_ast, out_t,
+                                            st))
             yield ResultChunk(list(self.out_names), cols)
 
     def _apply_one(self, ctx, chunk: ResultChunk, sub_ast,
-                   out_t) -> Column:
+                   out_t, state: dict) -> Column:
         from ..planner.build import (OUTER_RESOLVER, PlanError,
                                      build_query)
         from ..planner.optimize import optimize_plan
@@ -1728,9 +1734,9 @@ class HostApplyExec(PhysOp):
             return hits[0] if hits else None
 
         from .plan import to_physical
-        cache: dict = {}
+        cache: dict = state["cache"]
         out_vals: list = []
-        used_cols: list = []      # discovered on the first row
+        used_cols: list = state["used"]   # discovered on the first row
 
         def run_row(row: int):
             def resolver(ident: A.Ident):

@@ -219,6 +219,7 @@ STRING_VALUED_FUNCS = {"upper", "lower", "trim", "ltrim", "rtrim", "reverse",
                        "lpad", "rpad",
                        "json_extract", "json_unquote", "json_type"}
 STRING_INT_FUNCS = {"length", "char_length", "ascii", "locate", "instr",
+                    "find_in_set",
                     "json_valid", "json_length", "json_contains"}
 
 

@@ -394,7 +394,10 @@ class Evaluator:
     op_upper = op_lower = op_trim = op_ltrim = op_rtrim = \
         op_reverse = op_substring = op_replace = op_concat = op_left = \
         op_right = op_lpad = op_rpad = op_length = op_char_length = \
-        op_ascii = op_locate = op_instr = _op_string_unlowered
+        op_ascii = op_locate = op_instr = op_find_in_set = \
+        op_json_extract = op_json_unquote = op_json_type = \
+        op_json_valid = op_json_length = op_json_contains = \
+        _op_string_unlowered
 
     def op_dict_lut(self, e, cols, memo):
         xp = self.xp

@@ -385,6 +385,11 @@ def fold_string_func(e: Expr) -> Optional[Const]:
             if r is None:
                 return Const(e.dtype.with_nullable(True), None)
             return Const(e.dtype, int(r))
+        if e.op == "find_in_set":
+            parts = str(vals[1]).split(",") if vals[1] != "" else []
+            needle = str(vals[0])
+            r = parts.index(needle) + 1 if needle in parts else 0
+            return Const(e.dtype, int(r))
         if e.op == "length":
             r = len(str(vals[0]).encode("utf-8"))
         elif e.op == "char_length":
@@ -418,6 +423,13 @@ def string_func_arg_error(e: Func) -> Optional[str]:
     if e.op not in STRING_VALUED_FUNCS and e.op not in STRING_INT_FUNCS:
         return None
     if e.op == "concat":
+        return None
+    if e.op == "find_in_set":
+        # either argument may be the per-row column (not both)
+        n_const = sum(isinstance(a, Const) for a in e.args)
+        if n_const == 0:
+            return ("FIND_IN_SET: one of the two arguments must be a "
+                    "constant")
         return None
     col_pos = 1 if e.op == "locate" else 0
     for i, a in enumerate(e.args):
@@ -570,6 +582,27 @@ def _lower_str_int(e: Func, args, dicts) -> Optional[Expr]:
         lut = [v.find(str(needle), start) + 1 for v in d.values]
         return B.dict_ilut(col, np.asarray(lut if lut else [0], np.int64),
                            e.dtype)
+    if e.op == "find_in_set":
+        def fis(needle: str, lst: str) -> int:
+            # MySQL: empty LIST never matches, but an empty NEEDLE does
+            # match an empty element ('a,,b' position 2)
+            if lst == "":
+                return 0
+            parts = lst.split(",")
+            return parts.index(needle) + 1 if needle in parts else 0
+
+        needle_c, lst_c = _const_scalar(args[0]), _const_scalar(args[1])
+        d0 = _dict_for(args[0], dicts)
+        d1 = _dict_for(args[1], dicts)
+        if d0 is not None and lst_c is not None:
+            lut = [fis(v, str(lst_c)) for v in d0.values]
+            return B.dict_ilut(args[0],
+                               np.asarray(lut or [0], np.int64), e.dtype)
+        if d1 is not None and needle_c is not None:
+            lut = [fis(str(needle_c), v) for v in d1.values]
+            return B.dict_ilut(args[1],
+                               np.asarray(lut or [0], np.int64), e.dtype)
+        return None
     if e.op in ("json_valid", "json_length", "json_contains"):
         from ..utils import jsonfns
         col = args[0]

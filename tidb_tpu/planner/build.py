@@ -337,6 +337,8 @@ class ExprBuilder:
             return self._str_func(name.lower(), *args)
         if name == "POSITION":
             return self._str_func("locate", args[0], args[1])
+        if name == "FIND_IN_SET":
+            return self._str_func("find_in_set", args[0], args[1])
         if name in ("JSON_EXTRACT", "JSON_UNQUOTE", "JSON_TYPE",
                     "JSON_VALID", "JSON_LENGTH", "JSON_CONTAINS"):
             need = {"JSON_EXTRACT": (2, 2), "JSON_UNQUOTE": (1, 1),
@@ -542,14 +544,21 @@ def _rewrite_scalar_subqueries(node, child, catalog, default_db, ctes,
         # probe builds run on COPIES: build_select rewrites nested
         # subqueries in place, and a discarded trial must not leave
         # placeholders in the AST the real build (or per-row apply
-        # execution) will use
+        # execution) will use.  Nested subqueries are NOT executed during
+        # the probe (its only purpose is correlation detection): the
+        # eager executor is stubbed to a typed-unknown NULL literal.
+        probe_tok = SUBQUERY_EXECUTOR.set(lambda _ast: B.lit(None))
         try:
             build_query(_copy.deepcopy(sub_sel), catalog, default_db,
                         dict(ctes))
             return None          # uncorrelated
-        except PlanError as e:
-            if "unknown column" not in str(e):
-                raise
+        except PlanError:
+            # unknown column => correlated; any other error may be an
+            # artifact of the stubbed nested executor — the dtype trial
+            # below (real executor + dummy outer binding) is authoritative
+            pass
+        finally:
+            SUBQUERY_EXECUTOR.reset(probe_tok)
 
         def dummy_resolver(ident: A.Ident):
             if len(ident.parts) == 1:

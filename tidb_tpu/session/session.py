@@ -1221,6 +1221,10 @@ class Session:
 
     def _exec_show(self, stmt: A.ShowStmt) -> ResultSet:
         cat = self.domain.catalog
+        if stmt.kind == "create table":
+            tbl = cat.get_table(self.db, stmt.target)
+            return ResultSet(["Table", "Create Table"],
+                             [(tbl.name, _render_create_table(tbl))])
         if stmt.kind == "bindings":
             rows = []
             if stmt.target in (None, "session"):
@@ -1339,6 +1343,28 @@ class Session:
             return ResultSet(
                 ["Table", "Columns", "Est_benefit_execs", "Sample_sql"],
                 recommend_indexes(self.domain, self.db))
+        if stmt.kind == "checksum table":
+            # br/pkg/checksum analog: order-independent XOR of per-pair
+            # CRCs over the table's record+index ranges at one ts
+            import zlib
+
+            from ..store.codec import (index_prefix, index_prefix_end,
+                                       record_prefix, record_prefix_end)
+            tbl = self.domain.catalog.get_table(self.db, stmt.target)
+            ts = self.domain.kv.alloc_ts()
+            cksum = kvs = nbytes = 0
+            for lo, hi in ((record_prefix(tbl.table_id),
+                            record_prefix_end(tbl.table_id)),
+                           (index_prefix(tbl.table_id),
+                            index_prefix_end(tbl.table_id))):
+                for k, v in self.domain.kv.scan(lo, hi, ts):
+                    cksum ^= zlib.crc32(v, zlib.crc32(k))
+                    kvs += 1
+                    nbytes += len(k) + len(v)
+            return ResultSet(
+                ["Db_name", "Table_name", "Checksum_crc32_xor",
+                 "Total_kvs", "Total_bytes"],
+                [(self.db, tbl.name, cksum, kvs, nbytes)])
         raise PlanError(f"unsupported ADMIN {stmt.kind}")
 
     def _admin_check_table(self, name: str) -> ResultSet:
@@ -1437,3 +1463,42 @@ def _decode_val(v, t: dt.DataType):
 
 
 __all__ = ["Session", "Domain", "ResultSet"]
+
+
+def _render_create_table(tbl) -> str:
+    """SHOW CREATE TABLE rendering (executor/show.go ConstructResultOfShow
+    CreateTable analog)."""
+    from ..types import dtypes as dt
+    from ..utils.collate import is_binary
+    K = dt.TypeKind
+    lines = []
+    for name, t in zip(tbl.col_names, tbl.col_types):
+        if t.kind == K.DECIMAL:
+            ty = f"decimal({t.prec},{t.scale})"
+        elif t.kind == K.ENUM:
+            ty = "enum(" + ",".join(f"'{m}'" for m in t.members) + ")"
+        elif t.kind == K.SET:
+            ty = "set(" + ",".join(f"'{m}'" for m in t.members) + ")"
+        elif t.kind == K.BIT:
+            ty = f"bit({t.prec})"
+        else:
+            ty = t.kind.value
+        line = f"  `{name}` {ty}"
+        if t.kind == K.STRING and not is_binary(t.collation):
+            line += f" COLLATE {t.collation}"
+        if not t.nullable:
+            line += " NOT NULL"
+        if tbl.auto_inc_col == name:
+            line += " AUTO_INCREMENT"
+        lines.append(line)
+    if tbl.primary_key:
+        lines.append("  PRIMARY KEY (" +
+                     ",".join(f"`{c}`" for c in tbl.primary_key) + ")")
+    for ix in getattr(tbl, "indexes", []):
+        if ix.state != "public" or ix.name.upper() == "PRIMARY":
+            continue      # the PK's backing index renders as PRIMARY KEY
+        kind = "UNIQUE KEY" if ix.unique else "KEY"
+        lines.append(f"  {kind} `{ix.name}` (" +
+                     ",".join(f"`{c}`" for c in ix.columns) + ")")
+    return (f"CREATE TABLE `{tbl.name}` (\n" + ",\n".join(lines) +
+            "\n) ENGINE=tpu-columnar DEFAULT CHARSET=utf8mb4")

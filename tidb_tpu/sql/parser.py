@@ -227,6 +227,10 @@ class Parser:
             self.advance()
             self.expect_kw("INDEX")
             return A.AdminStmt("recommend index")
+        if self.cur.kind == "ident" and self.cur.text.upper() == "CHECKSUM":
+            self.advance()
+            self.expect_kw("TABLE")
+            return A.AdminStmt("checksum table", self.ident())
         raise ParseError("unsupported ADMIN", self.cur)
 
     def _prepare_family(self) -> A.Node:
@@ -1039,6 +1043,9 @@ class Parser:
 
     def show_stmt(self) -> A.ShowStmt:
         self.expect_kw("SHOW")
+        if self.accept_kw("CREATE"):
+            self.expect_kw("TABLE")
+            return A.ShowStmt("create table", self.ident())
         if self.accept_kw("BINDINGS"):
             return A.ShowStmt("bindings")       # target None = both scopes
         if self.at_kw("GLOBAL", "SESSION") \
