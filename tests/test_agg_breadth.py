@@ -139,3 +139,86 @@ def test_streaming_bit_aggs():
             bo |= v
             bx ^= v
         assert got[g] == (ba, bo, bx)
+
+
+def test_string_minmax_union_all_distinct_dicts():
+    """Streaming string MIN/MAX across partial chunks with DIFFERENT
+    dictionaries: dict unification must not clip the cnt==0 sentinel of a
+    group absent (or all-NULL) in one chunk into a real code (ADVICE r2,
+    medium).  Before the fix, max(s) for group 2 returned 'zz'."""
+    s = Session(Domain())
+    s.execute("create table u1 (g bigint, s varchar(10))")
+    s.execute("create table u2 (g bigint, s varchar(10))")
+    s.execute("insert into u1 values (1,'zz'), (2, null)")
+    s.execute("insert into u2 values (2,'aa')")
+    got = s.must_query(
+        "select g, min(s), max(s) from (select g, s from u1 "
+        "union all select g, s from u2) t group by g order by g")
+    assert got == [(1, "zz", "zz"), (2, "aa", "aa")]
+
+
+def test_string_minmax_union_all_group_missing_everywhere():
+    """A group whose s is NULL in EVERY chunk stays NULL after merges."""
+    s = Session(Domain())
+    s.execute("create table v1 (g bigint, s varchar(10))")
+    s.execute("create table v2 (g bigint, s varchar(10))")
+    s.execute("insert into v1 values (1,'mm'), (9, null)")
+    s.execute("insert into v2 values (9, null), (2,'bb')")
+    got = s.must_query(
+        "select g, min(s) from (select g, s from v1 "
+        "union all select g, s from v2) t group by g order by g")
+    assert got == [(1, "mm"), (2, "bb"), (9, None)]
+
+
+def test_reduce_partials_cross_dict_sentinel():
+    """White-box ADVICE-r2 regression: merging partial string MIN/MAX
+    chunks whose dictionaries differ must not let _unify_string_columns
+    clip a cnt==0 group's ±extreme sentinel into a real code.  Pre-fix,
+    group 2's MIN came back 'mm' (the clipped sentinel) instead of 'zz'."""
+    import numpy as np
+    from tidb_tpu.chunk.column import Column, StringDict
+    from tidb_tpu.executor.physical import (HostAgg, ResultChunk,
+                                            concat_result_chunks)
+    from tidb_tpu.planner.logical import AggItem
+    from tidb_tpu.copr import dag as D
+    from tidb_tpu.expr import ColumnRef
+    from tidb_tpu.types import dtypes as dt
+
+    st = dt.varchar()
+    big = dt.bigint(False)
+    agg = HostAgg(child=None, group_exprs=[ColumnRef(big, 0, "g")],
+                  aggs=[AggItem(D.AggFunc.MIN, ColumnRef(st, 1, "s"),
+                                False, st),
+                        AggItem(D.AggFunc.MAX, ColumnRef(st, 1, "s"),
+                                False, st)],
+                  out_names=["g", "mn", "mx"], out_dtypes=[big, st, st])
+    names = agg._partial_names()
+    hi, lo = np.iinfo(np.int64).max, np.iinfo(np.int64).min
+    d1, d2 = StringDict(["mm"]), StringDict(["zz"])
+    # chunk 1 (dict {'mm'}): g1 -> 'mm'; g2 all-NULL -> sentinels, cnt 0
+    p1 = ResultChunk(names, [
+        Column(big, np.array([1, 2]), np.ones(2, bool)),
+        Column(st, np.array([0, hi], np.int64),
+               np.array([True, False]), d1),                  # min
+        Column(big, np.array([1, 0]), np.ones(2, bool)),
+        Column(st, np.array([0, lo], np.int64),
+               np.array([True, False]), d1),                  # max
+        Column(big, np.array([1, 0]), np.ones(2, bool)),
+    ])
+    # chunk 2 (dict {'zz'}): g2 -> 'zz'
+    p2 = ResultChunk(names, [
+        Column(big, np.array([2]), np.ones(1, bool)),
+        Column(st, np.array([0], np.int64), np.array([True]), d2),
+        Column(big, np.array([1]), np.ones(1, bool)),
+        Column(st, np.array([0], np.int64), np.array([True]), d2),
+        Column(big, np.array([1]), np.ones(1, bool)),
+    ])
+    acc = agg._reduce_partials(concat_result_chunks([p1, p2], names))
+    out = agg._finalize_partials(acc)
+    got = {}
+    for i in range(out.num_rows):
+        g = int(out.columns[0].data[i])
+        dec = lambda c: (c.dictionary.decode(int(c.data[i]))
+                         if c.validity[i] else None)
+        got[g] = (dec(out.columns[1]), dec(out.columns[2]))
+    assert got == {1: ("mm", "mm"), 2: ("zz", "zz")}

@@ -224,3 +224,38 @@ def test_unknown_hint_ignored(jsess):
     assert jsess.must_query(
         "select /*+ MAX_EXECUTION_TIME(1000) */ count(*) from small") == \
         [(3,)]
+
+
+def test_load_data_atomic_inside_explicit_txn(tmp_path, sess):
+    """LOAD DATA inside an explicit txn is statement-atomic: a duplicate
+    key in a LATE batch (after 4096-row flushes) must unwind the earlier
+    batches from the caller's membuffer, not persist them on COMMIT
+    (ADVICE r2)."""
+    p = tmp_path / "dup_late.csv"
+    lines = [f"{1000 + i},r{i},1" for i in range(4100)]
+    lines.append("2,dup,99")          # id 2 already exists (unique uid)
+    p.write_text("\n".join(lines) + "\n")
+    from tidb_tpu.session.catalog import DuplicateKeyError
+    sess.execute("begin")
+    with pytest.raises(DuplicateKeyError):
+        sess.execute(f"load data infile '{p}' into table t "
+                     "fields terminated by ','")
+    sess.execute("commit")
+    assert sess.must_query(
+        "select count(*) from t where id >= 1000") == [(0,)]
+    # the txn itself stays usable and earlier state is intact
+    assert sess.must_query("select count(*) from t") == [(2,)]
+
+
+def test_replace_atomic_inside_explicit_txn(sess):
+    """Multi-row DML statements under an explicit txn are statement-atomic
+    via the generic _dml_atomic savepoint: a failing later row unwinds the
+    earlier rows' staged writes (code-review r3 finding)."""
+    sess.execute("begin")
+    with pytest.raises(Exception):
+        # later row fails type coercion after row 50 is staged
+        sess.execute("replace into t values (50,'ok',1), (51,'bad',"
+                     "'notanint')")
+    sess.execute("commit")
+    assert sess.must_query(
+        "select count(*) from t where id in (50, 51)") == [(0,)]

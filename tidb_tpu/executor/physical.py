@@ -79,6 +79,19 @@ class ExecContext:
     sysvars: Any = None
     mem_tracker: Any = None    # utils.memory.Tracker (statement root)
     spills: int = 0            # spill events this statement
+    _kv_ts: dict = None        # engine id -> statement KV read snapshot
+
+    def kv_read_ts(self, kv) -> int:
+        """ONE KV read snapshot per statement and engine: every index/row
+        lookup an executor tree performs reads the same commit state, the
+        statement-snapshot discipline of the reference's snapshot ts
+        (sessiontxn).  Allocated lazily on first KV access."""
+        if self._kv_ts is None:
+            self._kv_ts = {}
+        ts = self._kv_ts.get(id(kv))
+        if ts is None:
+            ts = self._kv_ts[id(kv)] = kv.alloc_ts()
+        return ts
 
     def track(self, nbytes: int):
         """Charge bytes to the statement quota (may raise
@@ -1080,10 +1093,15 @@ class HostIndexLookupJoin(HostHashJoin):
                 f"{self.inner_table.name} index={self.inner_index.name}")
 
     def chunks(self, ctx, required_rows=None):
+        # one read ts for the WHOLE statement (shared with every other KV
+        # reader in the tree): per-chunk ts would let a concurrent commit
+        # land between outer chunks and make the inner lookups
+        # non-repeatable within one statement (ADVICE r2)
+        ts = ctx.kv_read_ts(self.inner_table.kv)
         for och in self.left.chunks(ctx):
             if self.null_aware:
                 och = self._na_filter(och)
-            rc = self._fetch_inner(och)
+            rc = self._fetch_inner(och, ts)
             out = self._join(och, rc)
             if self.out_perm is not None:
                 out = ResultChunk(list(self.out_names),
@@ -1091,7 +1109,7 @@ class HostIndexLookupJoin(HostHashJoin):
             if out.num_rows or och.num_rows == 0:
                 yield out
 
-    def _fetch_inner(self, och: ResultChunk) -> ResultChunk:
+    def _fetch_inner(self, och: ResultChunk, ts: int) -> ResultChunk:
         """Distinct outer keys -> index range reads -> inner ResultChunk."""
         from ..store.codec import (decode_index_handle, decode_row,
                                    encode_index_value, index_key,
@@ -1105,7 +1123,6 @@ class HostIndexLookupJoin(HostHashJoin):
                 keys.add(v)
         tbl = self.inner_table
         kt = tbl.col_types[tbl.col_names.index(self.inner_index.columns[0])]
-        ts = tbl.kv.alloc_ts()
         rows = []
         for v in sorted(keys, key=lambda x: (str(type(x)), str(x))):
             try:
@@ -1398,15 +1415,23 @@ class HostAgg(PhysOp):
                 elif tag in ("band", "bor", "bxor"):
                     out_p.append(_bit_agg(a.func, c.dtype, g, inverse,
                                           c.data))
-                else:   # min / max — neutral-init data merges directly
+                else:   # min / max
                     isf = c.data.dtype.kind == "f"
                     init = self._mm_init(a, isf)
                     out = np.full(g, init, c.data.dtype)
                     op = (np.minimum if a.func == D.AggFunc.MIN
                           else np.maximum)
-                    op.at(out, inverse, c.data)
-                    out_p.append(Column(c.dtype, out, np.ones(g, bool),
-                                        c.dictionary))
+                    # cnt==0 rows carry the ±extreme sentinel, but dict
+                    # unification (_unify_string_columns) clips codes into
+                    # the merged dictionary's range — restore the neutral
+                    # from validity before merging (ADVICE r2, medium)
+                    data = np.where(c.validity, c.data, init)
+                    op.at(out, inverse, data)
+                    # acc is itself re-concatenated with later partials, so
+                    # its validity must mark sentinel rows too
+                    vout = np.zeros(g, bool)
+                    np.logical_or.at(vout, inverse, c.validity)
+                    out_p.append(Column(c.dtype, out, vout, c.dictionary))
         return ResultChunk(chunk.names, out_keys + out_p)
 
     def _finalize_partials(self, acc: ResultChunk) -> ResultChunk:
@@ -1925,7 +1950,7 @@ class IndexLookUpExec(PhysOp):
         tbl, acc = self.table, self.access
         ix = acc.index
         kv = tbl.kv
-        ts = kv.alloc_ts()
+        ts = ctx.kv_read_ts(kv)
         offs = [tbl.col_names.index(c) for c in ix.columns]
         types = [tbl.col_types[i] for i in offs]
         parts = [C.encode_index_value(v, t)

@@ -371,13 +371,13 @@ class Session:
         if isinstance(stmt, A.AlterTable):
             return self._exec_alter(stmt)
         if isinstance(stmt, A.Insert):
-            return self._exec_insert(stmt)
+            return self._dml_atomic(self._exec_insert, stmt)
         if isinstance(stmt, A.LoadData):
-            return self._exec_load_data(stmt)
+            return self._dml_atomic(self._exec_load_data, stmt)
         if isinstance(stmt, A.Update):
-            return self._exec_update(stmt)
+            return self._dml_atomic(self._exec_update, stmt)
         if isinstance(stmt, A.Delete):
-            return self._exec_delete(stmt)
+            return self._dml_atomic(self._exec_delete, stmt)
         if isinstance(stmt, A.TruncateTable):
             n = self.domain.catalog.get_table(self.db, stmt.name).truncate()
             return ResultSet(affected=n)
@@ -946,6 +946,21 @@ class Session:
         mgr.create(stmt.original_sql, stmt.bind_sql, bind[0].hints)
         return ResultSet()
 
+    def _dml_atomic(self, handler, stmt) -> ResultSet:
+        """MySQL statement atomicity inside an explicit transaction: stage
+        the DML against a membuffer savepoint so a mid-statement failure
+        (late duplicate key, type error on a later row) unwinds THIS
+        statement's writes only, leaving the txn usable (the reference's
+        StmtCommit/StmtRollback membuffer staging)."""
+        if self.txn is None:
+            return handler(stmt)
+        sp = self.txn.savepoint()
+        try:
+            return handler(stmt)
+        except Exception:
+            self.txn.rollback_to(sp)
+            raise
+
     @staticmethod
     def _insert_ignore(tbl, rows, txn) -> int:
         """INSERT IGNORE: duplicate-key rows are skipped, not errors."""
@@ -985,7 +1000,8 @@ class Session:
         total = 0
         batch: list[tuple] = []
         # one transaction for the WHOLE load: a failure in a late batch
-        # must not leave earlier batches committed (statement atomicity)
+        # must not leave earlier batches committed (statement atomicity;
+        # the explicit-txn case is staged by _dml_atomic's savepoint)
         own = self.txn is None
         txn = self.txn or tbl.kv.begin()
 

@@ -66,6 +66,10 @@ class CopClient:
         import threading
         self._fp_mu = threading.Lock()
         self._failpoints: list = []    # injected RegionErrors (tests/chaos)
+        # _page_feedback is shared across connection threads: guard its
+        # get/assign/move_to_end/popitem sequence (ADVICE r2: a concurrent
+        # eviction between get and move_to_end raised KeyError)
+        self._pf_mu = threading.Lock()
 
     # -- dispatch retry seam (pkg/store/copr backoff loop analog) ------ #
 
@@ -359,7 +363,8 @@ class CopClient:
         if is_topn or is_limit:
             cap = max(root.limit, 16)
         else:
-            fb = self._page_feedback.get(fb_key)
+            with self._pf_mu:
+                fb = self._page_feedback.get(fb_key)
             if fb is not None:
                 # prior observation + 50% headroom, clamped to the shard
                 cap = _pow2_at_least(
@@ -390,11 +395,12 @@ class CopClient:
 
         if not (is_topn or is_limit) and per_shard > 0:
             frac = float(out_counts.max()) / per_shard
-            old = self._page_feedback.get(fb_key, frac)
-            self._page_feedback[fb_key] = 0.5 * old + 0.5 * frac
-            self._page_feedback.move_to_end(fb_key)
-            while len(self._page_feedback) > self._page_feedback_cap:
-                self._page_feedback.popitem(last=False)
+            with self._pf_mu:
+                old = self._page_feedback.get(fb_key, frac)
+                self._page_feedback[fb_key] = 0.5 * old + 0.5 * frac
+                self._page_feedback.move_to_end(fb_key)
+                while len(self._page_feedback) > self._page_feedback_cap:
+                    self._page_feedback.popitem(last=False)
         return self._assemble_rows(out_cols, out_counts, cap, out_dtypes,
                                    dictionaries)
 

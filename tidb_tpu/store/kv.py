@@ -317,6 +317,16 @@ class Txn:
         self.committed = True
         return commit_ts
 
+    def savepoint(self) -> dict:
+        """Statement-level savepoint: snapshot of the membuffer.  Restoring
+        with rollback_to() undoes every put/delete since — the statement-
+        atomicity staging the reference gets from its membuffer checkpoints
+        (client-go memdb stages)."""
+        return dict(self.mutations)
+
+    def rollback_to(self, sp: dict):
+        self.mutations = dict(sp)
+
     def _release_unwritten_locks(self):
         """Pessimistic locks on keys that were locked but never written
         (e.g. SELECT FOR UPDATE rows left unchanged) release at commit."""
