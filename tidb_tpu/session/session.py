@@ -956,10 +956,12 @@ class Session:
             return handler(stmt)
         sp = self.txn.savepoint()
         try:
-            return handler(stmt)
+            res = handler(stmt)
         except Exception:
             self.txn.rollback_to(sp)
             raise
+        self.txn.release_savepoint()
+        return res
 
     @staticmethod
     def _insert_ignore(tbl, rows, txn) -> int:

@@ -110,10 +110,15 @@ class CopClient:
     def _execute_agg_once(self, agg: D.Aggregation, snap: ColumnarSnapshot,
                           key_meta: list[GroupKeyMeta],
                           aux_cols=()) -> CopResult:
-        cols, counts = snap.device_cols(self.mesh)
         if agg.strategy == D.GroupStrategy.SORT:
+            if not aux_cols and self._platform() == "cpu":
+                res = self._host_sort_agg(agg, snap, key_meta)
+                if res is not None:
+                    return res
+            cols, counts = snap.device_cols(self.mesh)
             return self._execute_sort_agg(agg, cols, counts, key_meta,
                                           aux_cols)
+        cols, counts = snap.device_cols(self.mesh)
         for _ in range(8):
             prog = get_sharded_program(agg, self.mesh)
             out = prog(cols, counts, aux_cols)
@@ -135,6 +140,33 @@ class CopClient:
         else:
             merged = merge_states([states])
         key_cols, agg_cols = finalize(agg, merged, key_meta)
+        return CopResult(agg_cols, key_cols)
+
+    def _platform(self) -> str:
+        return self.mesh.devices.reshape(-1)[0].platform
+
+    def _host_sort_agg(self, agg: D.Aggregation, snap: ColumnarSnapshot,
+                       key_meta) -> Optional[CopResult]:
+        """CPU engine choice for high-NDV group-by.
+
+        The reference's CPU answer is a hash table (parallel HashAgg,
+        pkg/executor/aggregate/agg_hash_executor.go:94); XLA's TPU-shaped
+        sort+scatter SORT program measured 56x SLOWER than a host
+        np.unique on CPU (VERDICT r2 #2).  On a CPU mesh the coprocessor
+        therefore runs unbounded-NDV group-by as a host unique + segment
+        reduction over the snapshot columns — the per-platform strategy
+        split precedented by the dense-reduce path (copr/exec._reduce).
+        Returns None when the DAG shape isn't the scan/filter/project
+        chain this path handles (falls back to the device program).
+        """
+        from ..copr.hostagg import host_sort_agg
+        states = host_sort_agg(agg, snap)
+        if states is None:
+            return None
+        # single host table: groups are already unique — the cross-device
+        # re-group of merge_sorted_states would be a no-op
+        merged = {k: v for k, v in states.items() if k != "__ngroups__"}
+        key_cols, agg_cols = finalize_sorted(agg, merged, key_meta)
         return CopResult(agg_cols, key_cols)
 
     def _grown_join_dag(self, dag, extras) -> Optional[D.CopNode]:

@@ -207,6 +207,9 @@ class KVStore:
         return int(self._lib.kv_num_keys(self._h))
 
 
+_UNSET = object()   # savepoint sentinel: key absent from the membuffer
+
+
 @dataclass
 class Txn:
     """Transaction: membuffer + percolator 2PC on commit (client-go
@@ -222,15 +225,18 @@ class Txn:
     locked: set = field(default_factory=set)
     lock_wait_ms: int = 3000
     for_update_ts: int = 0       # latest lock acquisition ts
+    _undo: Optional[dict] = None  # active statement savepoint (undo delta)
 
     def put(self, key: bytes, value: bytes):
         if self.pessimistic:
             self.lock_keys([key])
+        self._record_undo(key)
         self.mutations[key] = value
 
     def delete(self, key: bytes):
         if self.pessimistic:
             self.lock_keys([key])
+        self._record_undo(key)
         self.mutations[key] = None
 
     def lock_keys(self, keys, wait_ms: Optional[int] = None):
@@ -318,14 +324,29 @@ class Txn:
         return commit_ts
 
     def savepoint(self) -> dict:
-        """Statement-level savepoint: snapshot of the membuffer.  Restoring
-        with rollback_to() undoes every put/delete since — the statement-
-        atomicity staging the reference gets from its membuffer checkpoints
-        (client-go memdb stages)."""
-        return dict(self.mutations)
+        """Statement-level savepoint as an UNDO DELTA: put/delete record a
+        key's prior membuffer state on first touch, so staging costs
+        O(statement writes), not O(transaction writes) — the client-go
+        memdb staging-checkpoint discipline.  Restoring with rollback_to()
+        undoes every write since; release_savepoint() on statement success
+        stops the recording."""
+        self._undo = {}
+        return self._undo
 
     def rollback_to(self, sp: dict):
-        self.mutations = dict(sp)
+        for k, prior in sp.items():
+            if prior is _UNSET:
+                self.mutations.pop(k, None)
+            else:
+                self.mutations[k] = prior
+        self._undo = None
+
+    def release_savepoint(self):
+        self._undo = None
+
+    def _record_undo(self, key: bytes):
+        if self._undo is not None and key not in self._undo:
+            self._undo[key] = self.mutations.get(key, _UNSET)
 
     def _release_unwritten_locks(self):
         """Pessimistic locks on keys that were locked but never written
