@@ -1,51 +1,72 @@
-"""Benchmark: TPC-H Q1 + Q6 through the fused TPU coprocessor path.
+"""Benchmark: TPC-H Q1 + Q6 + high-NDV group-by through the coprocessor.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
-- value: TPC-H Q1 rows/sec/chip (SF via BENCH_SF env, default 10 on TPU,
-  0.1 on CPU) through the full CopClient -> shard_map -> fused-kernel ->
-  psum path, warm, median of BENCH_ITERS runs.
+- value: TPC-H Q1 rows/sec/chip at the LARGEST scale factor that completed
+  on the best available platform (TPU preferred), through the full
+  CopClient -> shard_map -> fused-kernel -> psum path, warm, median of
+  BENCH_ITERS runs.
 - vs_baseline: speedup over a single-core vectorized numpy implementation
   of the same query on the same host — a *stronger* stand-in for the
-  reference's CPU unistore closure executor (closure_exec.go is a
-  row-group-at-a-time interpreted Go loop; vectorized numpy is what an
-  optimized CPU columnar engine would do), measured live.
+  reference's CPU unistore closure executor (closure_exec.go:468 is a
+  row-group-at-a-time interpreted Go loop), measured live.
 
-Extra sub-metrics (Q6, and per-query baselines) go to stderr so the stdout
-contract stays one line.
+Orchestration (VERDICT r2 #1 — the TPU number must land):
+  1. data pre-generation in a CPU child (no TPU backend touched), cached
+     to /tmp, so the TPU budget is spent only on device work;
+  2. a tiny INIT-PROBE child that only calls jax.devices() with its own
+     long timeout — observed axon behavior: a missing TPU grant surfaces
+     as UNAVAILABLE only after ~25-40 min, so the r2 900s timeout killed
+     the child before the verdict; timestamps localize every stage;
+  3. persistent jax compilation cache so a slow first compile is paid once;
+  4. an SF ladder (0.1 -> 1 -> 10): each completed rung rewrites the
+     best-so-far result file, so a timeout mid-ladder still reports the
+     largest completed TPU datapoint;
+  5. every stage logs elapsed-time-stamped lines to stderr.
 """
 
 import json
 import os
+import pickle
 import subprocess
 import sys
 import time
 
-
 import numpy as np
+
+T0 = time.time()
+DATA_DIR = os.environ.get("BENCH_DATA_DIR", "/tmp/tidb_tpu_bench")
+RESULTS_PATH = os.path.join(DATA_DIR, "results.jsonl")
+CACHE_DIR = os.path.join(DATA_DIR, "jax_cache")
+COLS_NEEDED = ["l_quantity", "l_extendedprice", "l_discount", "l_tax",
+               "l_returnflag", "l_linestatus", "l_shipdate", "l_partkey"]
 
 
 def log(*a):
-    print(*a, file=sys.stderr, flush=True)
+    print(f"[bench {time.time()-T0:7.1f}s]", *a, file=sys.stderr, flush=True)
 
 
-def _run_child(env_extra, timeout_s):
-    """Run the inner bench as a child process, hang- and crash-proof.
+def _data_path(sf):
+    return os.path.join(DATA_DIR, f"lineitem_sf{sf:g}.pkl")
 
-    TPU plugin init can hang in uninterruptible I/O (round 1: rc=124), in
-    which case even SIGKILL doesn't reap the child — so on timeout we kill
-    the whole process group, wait briefly, and abandon the corpse rather
-    than block.  Returns (rc_or_None_if_timeout, stdout_bytes).
-    """
-    env = dict(os.environ, BENCH_INNER="1", **env_extra)
+
+# --------------------------------------------------------------------- #
+# child process management (hang- and crash-proof; round-1 learning:
+# a hung TPU plugin can leave an unkillable D-state corpse)
+# --------------------------------------------------------------------- #
+
+def _run_child(env_extra, timeout_s, tag):
+    env = dict(os.environ, **env_extra)
+    log(f"starting child {tag} (timeout {timeout_s:.0f}s)")
     proc = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__)], env=env,
         stdout=subprocess.PIPE, start_new_session=True)
     try:
         out, _ = proc.communicate(timeout=timeout_s)
+        log(f"child {tag} exited rc={proc.returncode}")
         return proc.returncode, out
     except subprocess.TimeoutExpired:
-        log(f"bench child timed out after {timeout_s}s; killing process group")
+        log(f"child {tag} timed out after {timeout_s:.0f}s; killing group")
         try:
             os.killpg(proc.pid, 9)
         except Exception:
@@ -58,28 +79,280 @@ def _run_child(env_extra, timeout_s):
 
 
 def orchestrate():
-    """Parent never touches a jax backend: try the default platform in a
-    timed child (retry once on fast failure), then fall back to CPU."""
-    t_tpu = int(os.environ.get("BENCH_TPU_TIMEOUT", "900"))
-    t_cpu = int(os.environ.get("BENCH_CPU_TIMEOUT", "1800"))
-    attempts = ([] if os.environ.get("JAX_PLATFORMS") == "cpu"
-                else [({}, t_tpu)])
-    if attempts:
-        rc, out = _run_child(*attempts[0])
-        if rc == 0 and out.strip():
-            sys.stdout.buffer.write(out)
-            return 0
-        if rc is not None:  # fast failure, not a hang: one retry
-            log(f"bench child failed rc={rc}; retrying once in 15s")
-            time.sleep(15)
-            rc, out = _run_child({}, t_tpu)
-            if rc == 0 and out.strip():
-                sys.stdout.buffer.write(out)
-                return 0
-        log("default-platform bench unusable; falling back to CPU")
-    rc, out = _run_child({"JAX_PLATFORMS": "cpu"}, t_cpu)
+    deadline = T0 + float(os.environ.get("BENCH_DEADLINE", "3300"))
+    os.makedirs(DATA_DIR, exist_ok=True)
+    try:
+        os.remove(RESULTS_PATH)
+    except OSError:
+        pass
+
+    ladder = [float(x) for x in
+              os.environ.get("BENCH_SF_LADDER", "0.1,1,10").split(",")]
+    cpu_only = os.environ.get("JAX_PLATFORMS") == "cpu"
+
+    # 1. pre-generate data (CPU child, no TPU backend) — only the rungs
+    #    we might reach; SF=10 is ~60M rows (~4 GB), generate lazily later
+    pregen = [sf for sf in ladder if sf <= 1]
+    rc, _ = _run_child({"BENCH_MODE": "gen", "JAX_PLATFORMS": "cpu",
+                        "BENCH_SF_LIST": ",".join(str(s) for s in pregen)},
+                       900, "datagen")
+    if rc != 0:
+        log("datagen child failed; children will generate inline")
+
+    best_tpu = None
+    if not cpu_only:
+        # 2. init probe with a timeout long enough for axon's UNAVAILABLE
+        #    to surface (~25-40 min observed)
+        probe_t = min(float(os.environ.get("BENCH_PROBE_TIMEOUT", "2400")),
+                      max(deadline - time.time() - 300, 60))
+        rc, out = _run_child({"BENCH_MODE": "probe"}, probe_t, "tpu-probe")
+        if rc == 0:
+            log("TPU probe OK:", out.decode().strip())
+            # 3. TPU bench child: SF ladder until deadline
+            bench_t = max(deadline - time.time() - 120, 120)
+            rc, out = _run_child(
+                {"BENCH_MODE": "bench",
+                 "BENCH_SF_LADDER": ",".join(str(s) for s in ladder)},
+                bench_t, "tpu-bench")
+            best_tpu = _best_result(platform_not="cpu")
+            if best_tpu is None:
+                log("TPU bench produced no result rung; falling back")
+        else:
+            log(f"TPU probe failed/timed out (rc={rc}); CPU fallback")
+
+    if best_tpu is not None:
+        print(json.dumps(best_tpu))
+        return 0
+
+    # 4. CPU fallback
+    cpu_t = max(deadline - time.time() - 30, 300)
+    rc, out = _run_child({"BENCH_MODE": "bench", "JAX_PLATFORMS": "cpu",
+                          "BENCH_SF_LADDER": "0.1"}, cpu_t, "cpu-bench")
+    best = _best_result()
+    if best is not None:
+        print(json.dumps(best))
+        return 0
     sys.stdout.buffer.write(out)
     return rc if rc is not None else 1
+
+
+def _best_result(platform_not=None):
+    """Largest-SF result line recorded by a bench child."""
+    try:
+        lines = [json.loads(ln) for ln in open(RESULTS_PATH)
+                 if ln.strip()]
+    except OSError:
+        return None
+    if platform_not is not None:
+        lines = [r for r in lines if r.get("platform") != platform_not]
+    if not lines:
+        return None
+    r = max(lines, key=lambda r: r.get("sf", 0))
+    r.pop("platform", None)
+    r.pop("sf", None)
+    return r
+
+
+# --------------------------------------------------------------------- #
+# modes that run inside children
+# --------------------------------------------------------------------- #
+
+def _force_platform():
+    import jax
+    if os.environ.get("JAX_PLATFORMS"):
+        # a sitecustomize may have imported jax at boot; env alone is too
+        # late then — config.update still wins pre-backend-init
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+
+def mode_gen():
+    """Generate + cache bench data without touching any TPU backend."""
+    _force_platform()
+    from tidb_tpu.testing.tpch import gen_lineitem
+    for sf in [float(x) for x in os.environ["BENCH_SF_LIST"].split(",")]:
+        path = _data_path(sf)
+        if os.path.exists(path):
+            log(f"sf={sf:g} cache hit")
+            continue
+        t = time.time()
+        names, cols = gen_lineitem(sf=sf, columns=COLS_NEEDED)
+        with open(path + ".tmp", "wb") as f:
+            pickle.dump((names, cols), f, protocol=4)
+        os.replace(path + ".tmp", path)
+        log(f"generated sf={sf:g}: {len(cols[0])} rows in {time.time()-t:.1f}s")
+
+
+def mode_probe():
+    """jax.devices() and one tiny computation — nothing else."""
+    if (os.environ.get("BENCH_TEST_HANG")
+            and os.environ.get("JAX_PLATFORMS") != "cpu"):
+        time.sleep(3600)  # test hook: simulate a hung TPU backend init
+    log("probe: importing jax")
+    import jax
+    log("probe: jax.devices()")
+    d = jax.devices()
+    log(f"probe: devices={d}")
+    import jax.numpy as jnp
+    x = jnp.ones((128, 128))
+    y = (x @ x).block_until_ready()
+    log(f"probe: matmul ok ({float(y[0, 0])})")
+    print(f"platform={d[0].platform} n={len(d)}")
+
+
+def _load_data(sf):
+    path = _data_path(sf)
+    if os.path.exists(path):
+        with open(path, "rb") as f:
+            return pickle.load(f)
+    from tidb_tpu.testing.tpch import gen_lineitem
+    t = time.time()
+    names, cols = gen_lineitem(sf=sf, columns=COLS_NEEDED)
+    log(f"generated sf={sf:g} inline: {len(cols[0])} rows "
+        f"in {time.time()-t:.1f}s")
+    return names, cols
+
+
+def _record(res):
+    with open(RESULTS_PATH, "a") as f:
+        f.write(json.dumps(res) + "\n")
+
+
+def mode_bench():
+    _force_platform()
+    import jax
+    try:
+        os.makedirs(CACHE_DIR, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        log("compile cache at", CACHE_DIR)
+    except Exception as e:  # cache is an optimization, never a blocker
+        log("compile cache unavailable:", e)
+    platform = jax.devices()[0].platform
+    n_chips = len(jax.devices())
+    log(f"platform={platform} devices={n_chips}")
+    iters = int(os.environ.get("BENCH_ITERS", "5"))
+    ladder = [float(x) for x in os.environ["BENCH_SF_LADDER"].split(",")]
+    for sf in ladder:
+        log(f"=== SF {sf:g} ===")
+        _bench_one_sf(sf, platform, n_chips, iters)
+
+
+def _bench_one_sf(sf, platform, n_chips, iters):
+    import jax
+
+    from __graft_entry__ import _q1_dag
+    from tidb_tpu import copr
+    from tidb_tpu.copr import dag as D
+    from tidb_tpu.copr.aggregate import GroupKeyMeta
+    from tidb_tpu.expr import ColumnRef
+    from tidb_tpu.expr import builders as B
+    from tidb_tpu.parallel.mesh import get_mesh
+    from tidb_tpu.store import CopClient, snapshot_from_columns
+    from tidb_tpu.types import dtypes as dt
+
+    names, cols = _load_data(sf)
+    ix = {n: i for i, n in enumerate(names)}
+    n_rows = len(cols[0])
+    n_shards = int(os.environ.get("BENCH_SHARDS",
+                                  str(max(8, len(jax.devices())))))
+    log(f"rows={n_rows} shards={n_shards}")
+
+    mesh = get_mesh()
+    q1_cols = [c for i, c in enumerate(cols) if names[i] != "l_partkey"]
+    q1_names = [n for n in names if n != "l_partkey"]
+    snap = snapshot_from_columns(q1_names, q1_cols, n_shards=n_shards)
+    client = CopClient(mesh)
+    agg, meta = _q1_dag(q1_cols, q1_names)
+
+    t = time.time()
+    res = client.execute_agg(agg, snap, meta)   # warmup: compile + H2D
+    log(f"Q1 warmup (compile+transfer) {time.time()-t:.1f}s")
+    times = []
+    for _ in range(iters):
+        t = time.time()
+        res = client.execute_agg(agg, snap, meta)
+        times.append(time.time() - t)
+    q1_t = float(np.median(times))
+    q1_rps = n_rows / q1_t / n_chips
+    log(f"Q1: {q1_t*1e3:.1f} ms  {q1_rps/1e6:.1f} M rows/s/chip "
+        f"({n_chips} chips)")
+
+    # correctness spot-check vs numpy
+    ix1 = {n: i for i, n in enumerate(q1_names)}
+    exp = np_q1(q1_cols, ix1)
+    got_counts = sorted(int(c) for c in res.columns[-1].data)
+    assert got_counts == sorted(v[4] for v in exp.values()), "Q1 mismatch"
+
+    # Q6
+    r = lambda n: ColumnRef(q1_cols[ix1[n]].dtype, ix1[n], n)
+    scan = D.TableScan(tuple(range(len(q1_names))),
+                       tuple(c.dtype for c in q1_cols))
+    sel = D.Selection(scan, (
+        B.compare("ge", r("l_shipdate"), B.lit("1994-01-01", dt.date())),
+        B.compare("lt", r("l_shipdate"), B.lit("1995-01-01", dt.date())),
+        B.between(r("l_discount"), B.decimal_lit("0.05"),
+                  B.decimal_lit("0.07")),
+        B.compare("lt", r("l_quantity"), B.decimal_lit("24"))))
+    rev = B.arith("mul", r("l_extendedprice"), r("l_discount"))
+    q6 = D.Aggregation(sel, (),
+                       (copr.AggDesc(copr.AggFunc.SUM, rev,
+                                     copr.sum_out_dtype(rev.dtype)),
+                        copr.AggDesc(copr.AggFunc.COUNT, None,
+                                     dt.bigint(False))),
+                       D.GroupStrategy.SCALAR)
+    res6 = client.execute_agg(q6, snap, [])
+    times = []
+    for _ in range(iters):
+        t = time.time()
+        res6 = client.execute_agg(q6, snap, [])
+        times.append(time.time() - t)
+    q6_t = float(np.median(times))
+    log(f"Q6: {q6_t*1e3:.1f} ms  {n_rows/q6_t/1e6:.1f} M rows/s")
+    exp_rev, exp_cnt = np_q6(cols, ix)
+    assert int(res6.columns[1].data[0]) == exp_cnt, "Q6 count mismatch"
+
+    # high-NDV group-by (SORT strategy / host unique path per platform)
+    pk = cols[ix["l_partkey"]]
+    hsnap = snapshot_from_columns(["l_partkey"], [pk], n_shards=n_shards)
+    pk_ref = ColumnRef(pk.dtype, 0, "l_partkey")
+    ndv_est = int(min(sf * 200_000, n_rows)) or 1
+    hagg = D.Aggregation(
+        D.TableScan((0,), (pk.dtype,)), (pk_ref,),
+        (copr.AggDesc(copr.AggFunc.COUNT, None, dt.bigint(False)),),
+        D.GroupStrategy.SORT,
+        group_capacity=max(1024, 1 << (ndv_est - 1).bit_length()))
+    resh = client.execute_agg(hagg, hsnap, [GroupKeyMeta(pk.dtype, 0)])
+    times = []
+    for _ in range(max(iters // 2, 1)):
+        t = time.time()
+        resh = client.execute_agg(hagg, hsnap, [GroupKeyMeta(pk.dtype, 0)])
+        times.append(time.time() - t)
+    hndv_t = float(np.median(times))
+    t = time.time()
+    uk, ucnt = np.unique(pk.data, return_counts=True)
+    np_ndv_t = time.time() - t
+    assert len(resh.key_columns[0]) == len(uk), "high-NDV group mismatch"
+    assert int(np.asarray(
+        [int(c) for c in resh.columns[0].data]).sum()) == int(ucnt.sum())
+    log(f"high-NDV group-by ({len(uk)} groups): {hndv_t*1e3:.1f} ms "
+        f"({n_rows/hndv_t/1e6:.1f} M rows/s)  numpy oracle: "
+        f"{np_ndv_t*1e3:.1f} ms  speedup {np_ndv_t/hndv_t:.2f}x")
+
+    # CPU baseline: single-core vectorized numpy, same queries
+    t = time.time(); np_q1(q1_cols, ix1); b1 = time.time() - t
+    t = time.time(); np_q6(cols, ix); b6 = time.time() - t
+    log(f"numpy 1-core Q1: {b1*1e3:.1f} ms ({n_rows/b1/1e6:.1f} M rows/s)  "
+        f"Q6: {b6*1e3:.1f} ms")
+
+    _record({
+        "metric": f"tpch_q1_sf{sf:g}_rows_per_sec_per_chip",
+        "value": round(q1_rps, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(b1 / q1_t, 2),
+        "platform": platform,
+        "sf": sf,
+    })
+    log(f"SF {sf:g} result recorded")
 
 
 def np_q1(cols, ix):
@@ -113,133 +386,15 @@ def np_q6(cols, ix):
     return int((price[m] * disc[m]).sum()), int(m.sum())
 
 
-def main():
-    import jax
-
-    if (os.environ.get("BENCH_TEST_HANG")
-            and os.environ.get("JAX_PLATFORMS") != "cpu"):
-        time.sleep(3600)  # test hook: simulate a hung TPU backend init
-    # honor JAX_PLATFORMS even when a sitecustomize imported jax at boot
-    # (env alone is too late then; config.update still wins pre-compute)
-    if os.environ.get("JAX_PLATFORMS"):
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-    platform = jax.devices()[0].platform
-    sf = float(os.environ.get("BENCH_SF", "10" if platform != "cpu" else "0.1"))
-    iters = int(os.environ.get("BENCH_ITERS", "5"))
-    n_shards = int(os.environ.get("BENCH_SHARDS", str(max(8, len(jax.devices())))))
-    log(f"platform={platform} devices={len(jax.devices())} SF={sf}")
-
-    from tidb_tpu.parallel.mesh import get_mesh
-    from tidb_tpu.store import CopClient, snapshot_from_columns
-    from tidb_tpu.testing.tpch import gen_lineitem
-    from __graft_entry__ import _q1_dag
-
-    cols_needed = ["l_quantity", "l_extendedprice", "l_discount", "l_tax",
-                   "l_returnflag", "l_linestatus", "l_shipdate"]
-    t0 = time.time()
-    names, cols = gen_lineitem(sf=sf, columns=cols_needed)
-    ix = {n: i for i, n in enumerate(names)}
-    n_rows = len(cols[0])
-    log(f"generated {n_rows} lineitem rows in {time.time()-t0:.1f}s")
-
-    mesh = get_mesh()
-    snap = snapshot_from_columns(names, cols, n_shards=n_shards)
-    client = CopClient(mesh)
-    agg, meta = _q1_dag(cols, names)
-
-    # warmup (compile + device transfer)
-    res = client.execute_agg(agg, snap, meta)
-    times = []
-    for _ in range(iters):
-        t = time.time()
-        res = client.execute_agg(agg, snap, meta)
-        times.append(time.time() - t)
-    q1_t = float(np.median(times))
-    n_chips = len(jax.devices())
-    q1_rps = n_rows / q1_t / n_chips
-    log(f"TPU Q1: {q1_t*1e3:.1f} ms  {q1_rps/1e6:.1f} M rows/s/chip ({n_chips} chips)")
-
-    # correctness spot-check vs numpy
-    exp = np_q1(cols, ix)
-    got_counts = sorted(int(c) for c in res.columns[-1].data)
-    assert got_counts == sorted(v[4] for v in exp.values()), "Q1 mismatch"
-
-    # Q6 via the same path
-    from tidb_tpu import copr
-    from tidb_tpu.copr import dag as D
-    from tidb_tpu.expr import ColumnRef, builders as B
-    from tidb_tpu.types import dtypes as dt
-    r = lambda n: ColumnRef(cols[ix[n]].dtype, ix[n], n)
-    scan = D.TableScan(tuple(range(len(names))), tuple(c.dtype for c in cols))
-    sel = D.Selection(scan, (
-        B.compare("ge", r("l_shipdate"), B.lit("1994-01-01", dt.date())),
-        B.compare("lt", r("l_shipdate"), B.lit("1995-01-01", dt.date())),
-        B.between(r("l_discount"), B.decimal_lit("0.05"), B.decimal_lit("0.07")),
-        B.compare("lt", r("l_quantity"), B.decimal_lit("24"))))
-    rev = B.arith("mul", r("l_extendedprice"), r("l_discount"))
-    q6 = D.Aggregation(sel, (),
-                       (copr.AggDesc(copr.AggFunc.SUM, rev,
-                                     copr.sum_out_dtype(rev.dtype)),
-                        copr.AggDesc(copr.AggFunc.COUNT, None, dt.bigint(False))),
-                       D.GroupStrategy.SCALAR)
-    res6 = client.execute_agg(q6, snap, [])
-    times = []
-    for _ in range(iters):
-        t = time.time()
-        res6 = client.execute_agg(q6, snap, [])
-        times.append(time.time() - t)
-    q6_t = float(np.median(times))
-    log(f"TPU Q6: {q6_t*1e3:.1f} ms  {n_rows/q6_t/1e6:.1f} M rows/s")
-    exp_rev, exp_cnt = np_q6(cols, ix)
-    assert int(res6.columns[1].data[0]) == exp_cnt, "Q6 count mismatch"
-
-    # high-NDV group-by sub-metric (SORT strategy, VERDICT r1 item 2):
-    # GROUP BY l_partkey (~SF*200k distinct) via device sort+segment-reduce
-    from tidb_tpu.copr.aggregate import GroupKeyMeta
-    pk_names, pk_cols = gen_lineitem(sf=sf, columns=["l_partkey"])
-    pk = pk_cols[0]
-    hsnap = snapshot_from_columns(pk_names, pk_cols, n_shards=n_shards)
-    pk_ref = ColumnRef(pk.dtype, 0, "l_partkey")
-    hscan = D.TableScan((0,), (pk.dtype,))
-    ndv_est = int(min(sf * 200_000, n_rows)) or 1
-    hagg = D.Aggregation(
-        hscan, (pk_ref,),
-        (copr.AggDesc(copr.AggFunc.COUNT, None, dt.bigint(False)),),
-        D.GroupStrategy.SORT,
-        group_capacity=max(1024, 1 << (ndv_est - 1).bit_length()))
-    resh = client.execute_agg(hagg, hsnap, [GroupKeyMeta(pk.dtype, 0)])
-    times = []
-    for _ in range(max(iters // 2, 1)):
-        t = time.time()
-        resh = client.execute_agg(hagg, hsnap, [GroupKeyMeta(pk.dtype, 0)])
-        times.append(time.time() - t)
-    hndv_t = float(np.median(times))
-    t = time.time()
-    uk, ucnt = np.unique(pk.data, return_counts=True)
-    np_ndv_t = time.time() - t
-    assert len(resh.key_columns[0]) == len(uk), "high-NDV group count mismatch"
-    assert int(np.asarray(
-        [int(c) for c in resh.columns[0].data]).sum()) == int(ucnt.sum())
-    log(f"TPU high-NDV group-by ({len(uk)} groups): {hndv_t*1e3:.1f} ms  "
-        f"({n_rows/hndv_t/1e6:.1f} M rows/s)  numpy oracle: "
-        f"{np_ndv_t*1e3:.1f} ms  speedup {np_ndv_t/hndv_t:.2f}x")
-
-    # CPU baseline: single-core vectorized numpy, same queries
-    t = time.time(); np_q1(cols, ix); b1 = time.time() - t
-    t = time.time(); np_q6(cols, ix); b6 = time.time() - t
-    log(f"numpy 1-core Q1: {b1*1e3:.1f} ms ({n_rows/b1/1e6:.1f} M rows/s)  "
-        f"Q6: {b6*1e3:.1f} ms")
-
-    print(json.dumps({
-        "metric": f"tpch_q1_sf{sf:g}_rows_per_sec_per_chip",
-        "value": round(q1_rps, 1),
-        "unit": "rows/s",
-        "vs_baseline": round(b1 / q1_t, 2),
-    }))
-
-
 if __name__ == "__main__":
-    if os.environ.get("BENCH_INNER"):
-        main()
+    mode = os.environ.get("BENCH_MODE")
+    if mode == "gen":
+        mode_gen()
+    elif mode == "probe":
+        mode_probe()
+    elif mode == "bench":
+        mode_bench()
+    elif os.environ.get("BENCH_INNER"):  # legacy entry
+        mode_bench()
     else:
         sys.exit(orchestrate())

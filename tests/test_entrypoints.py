@@ -50,8 +50,10 @@ def test_bench_emits_json_even_when_default_backend_hangs():
     # BENCH_TEST_HANG forces the non-cpu child to hang, deterministically
     # exercising the timeout -> killpg -> CPU-fallback path on any host.
     env = _clean_env()
-    env.update(BENCH_SF="0.01", BENCH_ITERS="1", BENCH_TPU_TIMEOUT="15",
-               BENCH_CPU_TIMEOUT="200", BENCH_TEST_HANG="1")
+    env.update(BENCH_ITERS="1", BENCH_PROBE_TIMEOUT="15",
+               BENCH_DEADLINE="240", BENCH_SF_LADDER="0.1",
+               BENCH_TEST_HANG="1",
+               BENCH_DATA_DIR="/tmp/tidb_tpu_bench_test")
     out = subprocess.run(
         [sys.executable, "bench.py"], cwd=REPO, env=env,
         capture_output=True, text=True, timeout=280)
