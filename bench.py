@@ -262,6 +262,11 @@ def _bench_one_sf(sf, platform, n_chips, iters):
     q1_names = [n for n in names if n != "l_partkey"]
     snap = snapshot_from_columns(q1_names, q1_cols, n_shards=n_shards)
     client = CopClient(mesh)
+    # tables beyond the HBM budget stream in double-buffered batches
+    cap = int(os.environ.get("BENCH_DEVICE_MEM_CAP", "0") or 0)
+    client.device_mem_cap = cap or (12 << 30 if platform != "cpu" else 0)
+    if snap.row_batches(client.device_mem_cap):
+        log(f"table {snap.device_bytes()/2**30:.1f} GiB > cap: streaming")
     agg, meta = _q1_dag(q1_cols, q1_names)
 
     t = time.time()

@@ -1,0 +1,116 @@
+"""Device streaming for tables bigger than device memory (VERDICT r2 #3).
+
+CopClient splits snapshots whose stacked device footprint exceeds
+device_mem_cap into row-range batch views, double-buffers H2D against
+compute, and merges per-batch partial states — results must be IDENTICAL
+to the resident path (reference analog: kv.Request.Paging, SURVEY §5.7)."""
+
+import numpy as np
+
+from tidb_tpu import copr
+from tidb_tpu.copr import dag as D
+from tidb_tpu.copr.aggregate import GroupKeyMeta
+from tidb_tpu.expr import ColumnRef
+from tidb_tpu.expr import builders as B
+from tidb_tpu.parallel.mesh import get_mesh
+from tidb_tpu.store import CopClient, snapshot_from_columns
+from tidb_tpu.testing.tpch import gen_lineitem
+from tidb_tpu.types import dtypes as dt
+
+from __graft_entry__ import _q1_dag
+
+
+def _snap(sf=0.002, cols=None):
+    names, cs = gen_lineitem(sf=sf, columns=cols)
+    return names, cs, snapshot_from_columns(names, cs, n_shards=4,
+                                            min_capacity=32)
+
+
+def _clients():
+    mesh = get_mesh()
+    resident = CopClient(mesh)
+    resident.device_mem_cap = 0
+    streaming = CopClient(mesh)
+    return resident, streaming
+
+
+def _res_rows(res):
+    keys = [tuple(c.data[i] for c in res.key_columns)
+            for i in range(len(res.key_columns[0]))] \
+        if res.key_columns else [()] * len(res.columns[0])
+    vals = [tuple(int(c.data[i]) if c.validity[i] else None
+                  for c in res.columns)
+            for i in range(len(res.columns[0]))]
+    return sorted(zip(keys, vals))
+
+
+def test_stream_q1_dense_agg_matches_resident():
+    names, cols, snap = _snap()
+    agg, meta = _q1_dag(cols, names)
+    resident, streaming = _clients()
+    base = resident.execute_agg(agg, snap, meta)
+    # cap so the table needs several batches
+    streaming.device_mem_cap = max(snap.device_bytes() // 5, 4096)
+    assert snap.row_batches(streaming.device_mem_cap) is not None
+    got = streaming.execute_agg(agg, snap, meta)
+    assert _res_rows(got) == _res_rows(base)
+
+
+def test_stream_sort_agg_matches_resident():
+    names, cols, snap = _snap(cols=["l_partkey"])
+    pk = cols[0]
+    ref = ColumnRef(pk.dtype, 0, "l_partkey")
+    agg = D.Aggregation(
+        D.TableScan((0,), (pk.dtype,)), (ref,),
+        (copr.AggDesc(copr.AggFunc.COUNT, None, dt.bigint(False)),
+         copr.AggDesc(copr.AggFunc.MIN, ref, pk.dtype)),
+        D.GroupStrategy.SORT, group_capacity=4096)
+    resident, streaming = _clients()
+    meta = [GroupKeyMeta(pk.dtype, 0)]
+    dcols, counts = snap.device_cols(resident.mesh)
+    base = resident._execute_sort_agg(agg, dcols, counts, meta, ())
+    streaming.device_mem_cap = max(snap.device_bytes() // 4, 2048)
+    batches = snap.row_batches(streaming.device_mem_cap)
+    assert batches is not None and len(batches) > 1
+    got = streaming._stream_sort_agg(agg, batches, meta)
+    assert _res_rows(got) == _res_rows(base)
+
+
+def test_stream_rows_and_topn_match_resident():
+    names, cols, snap = _snap()
+    ix = {n: i for i, n in enumerate(names)}
+    price_t = cols[ix["l_extendedprice"]].dtype
+    scan = D.TableScan((ix["l_extendedprice"],), (price_t,))
+    sel = D.Selection(scan, (B.compare(
+        "gt", ColumnRef(price_t, 0), B.decimal_lit("30000")),))
+    resident, streaming = _clients()
+    base = resident.execute_rows(sel, snap, (price_t,))
+    streaming.device_mem_cap = max(snap.device_bytes() // 5, 4096)
+    got = streaming.execute_rows(sel, snap, (price_t,))
+    assert sorted(base[0].data.tolist()) == sorted(got[0].data.tolist())
+
+    topn = D.TopN(scan, sort_key=ColumnRef(price_t, 0), desc=True, limit=7)
+    base_t = resident.execute_rows(topn, snap, (price_t,))
+    got_t = streaming.execute_rows(topn, snap, (price_t,))
+    exp = np.sort(cols[ix["l_extendedprice"]].data)[::-1][:7]
+    # both return candidate unions; the caller trims — verify the true
+    # top-7 is contained in each union
+    for out in (base_t, got_t):
+        top = np.sort(np.asarray(out[0].data))[::-1][:7]
+        np.testing.assert_array_equal(top, exp)
+
+
+def test_row_batches_shapes_share_one_program():
+    names, cols, snap = _snap()
+    cap = max(snap.device_bytes() // 6, 4096)
+    batches = snap.row_batches(cap)
+    assert batches is not None and len(batches) >= 2
+    layouts = {b.shard_layout()[:2] for b in batches}
+    assert len(layouts) == 1, layouts      # one (S, capacity) -> one jit
+    assert sum(b.num_rows for b in batches) == snap.num_rows
+
+
+def test_small_snapshot_never_streams():
+    names, cols, snap = _snap()
+    assert snap.row_batches(snap.device_bytes()) is None
+    assert snap.row_batches(0) is None

@@ -59,6 +59,13 @@ class CopClient:
         # failure detection/recovery (copIterator backoff-and-retry):
         # transient dispatch errors retry under a typed backoff budget
         self.retry_budget_ms = 5000.0
+        # streaming threshold: tables whose stacked device footprint
+        # exceeds this stream through HBM in double-buffered batches
+        # (SURVEY.md §5.7; 0 = never stream).  Overridable per-client and
+        # via TIDB_TPU_DEVICE_MEM_CAP.
+        import os
+        self.device_mem_cap = int(
+            os.environ.get("TIDB_TPU_DEVICE_MEM_CAP", "0") or 0)
         # last_retries is best-effort observability (per-dispatch); the
         # failpoint queue is lock-guarded since the client is shared by
         # every connection thread
@@ -115,9 +122,15 @@ class CopClient:
                 res = self._host_sort_agg(agg, snap, key_meta)
                 if res is not None:
                     return res
+            batches = self._stream_batches(agg, snap)
+            if batches is not None:
+                return self._stream_sort_agg(agg, batches, key_meta)
             cols, counts = snap.device_cols(self.mesh)
             return self._execute_sort_agg(agg, cols, counts, key_meta,
                                           aux_cols)
+        batches = self._stream_batches(agg, snap)
+        if batches is not None:
+            return self._stream_dense_agg(agg, batches, key_meta)
         cols, counts = snap.device_cols(self.mesh)
         for _ in range(8):
             prog = get_sharded_program(agg, self.mesh)
@@ -144,6 +157,64 @@ class CopClient:
 
     def _platform(self) -> str:
         return self.mesh.devices.reshape(-1)[0].platform
+
+    # ------------------------------------------------------------- #
+    # streaming: tables bigger than device memory (SURVEY.md §5.7)
+    # ------------------------------------------------------------- #
+
+    def _stream_batches(self, dag, snap: ColumnarSnapshot, aux_cols=()):
+        """Row-range batch views when the snapshot exceeds the device
+        memory cap; None = run resident.  Plans with expanding joins keep
+        the resident path (their capacity-regrow loop re-runs programs)."""
+        if not self.device_mem_cap or aux_cols \
+                or D.find_expand_join(dag) is not None:
+            return None
+        return snap.row_batches(self.device_mem_cap)
+
+    def _stream_states(self, agg, batches):
+        """Double-buffered dispatch: batch k+1's H2D transfer overlaps
+        batch k's compute (jax dispatch is async; nothing blocks until the
+        final device_get).  The paging/double-buffer analog of
+        kv.Request.Paging (SURVEY.md §5.7)."""
+        outs = []
+        nxt = batches[0].device_put_uncached(self.mesh)
+        prog = get_sharded_program(agg, self.mesh)
+        for i in range(len(batches)):
+            cols, counts = nxt
+            outs.append(prog(cols, counts, ()))
+            if i + 1 < len(batches):
+                nxt = batches[i + 1].device_put_uncached(self.mesh)
+            del cols, counts     # free the batch once its program consumed it
+        return [jax.device_get(o) for o in outs]
+
+    def _stream_dense_agg(self, agg, batches, key_meta) -> CopResult:
+        states_list = self._stream_states(agg, batches)
+        merged = merge_states(states_list)
+        key_cols, agg_cols = finalize(agg, merged, key_meta)
+        return CopResult(agg_cols, key_cols)
+
+    def _stream_sort_agg(self, agg, batches, key_meta) -> CopResult:
+        import dataclasses
+        cap = agg.group_capacity or DEFAULT_GROUP_CAPACITY
+        per_dev_all = []
+        for b in batches:
+            cols, counts = b.device_put_uncached(self.mesh)
+            for _ in range(10):
+                sized = dataclasses.replace(agg, group_capacity=cap)
+                prog = get_sharded_program(sized, self.mesh)
+                states = jax.device_get(prog(cols, counts, ()))
+                true_ng = int(np.max(np.asarray(states["__ngroups__"])))
+                if true_ng <= cap:
+                    break
+                cap = _pow2_at_least(true_ng)
+            else:
+                raise RuntimeError("group-capacity regrow did not converge")
+            per_dev_all.extend(self._split_devices(states))
+            del cols, counts
+        sized = dataclasses.replace(agg, group_capacity=cap)
+        merged = merge_sorted_states(sized, per_dev_all)
+        key_cols, agg_cols = finalize_sorted(sized, merged, key_meta)
+        return CopResult(agg_cols, key_cols)
 
     def _host_sort_agg(self, agg: D.Aggregation, snap: ColumnarSnapshot,
                        key_meta) -> Optional[CopResult]:
@@ -386,6 +457,16 @@ class CopClient:
                            out_dtypes, dictionaries=None,
                            aux_cols=()) -> list[Column]:
         """Row-returning plan with the paging loop."""
+        batches = self._stream_batches(root, snap, aux_cols)
+        if batches is not None:
+            # per-batch results concatenate; TopN/Limit callers already
+            # re-trim the multi-device candidate union, batches just widen
+            # that union
+            parts = [self._execute_rows_once(root, b, out_dtypes,
+                                             dictionaries, aux_cols)
+                     for b in batches]
+            return [Column.concat([p[j] for p in parts])
+                    for j in range(len(out_dtypes))]
         n_dev = len(self.mesh.devices.reshape(-1))
         is_topn = isinstance(root, D.TopN)
         is_limit = isinstance(root, D.Limit)

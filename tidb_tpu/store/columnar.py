@@ -89,10 +89,7 @@ class ColumnarSnapshot:
 
     # ---------------- device cache (region cache analog) ------------- #
 
-    def device_cols(self, mesh) -> tuple[list, Any]:
-        key = (id(mesh), self.epoch)
-        if key in self._device_cache:
-            return self._device_cache[key]
+    def _put(self, mesh) -> tuple[list, Any]:
         host_cols, counts = self.stacked_host()
         # the shard axis must divide the mesh: pad with empty shards
         # (count 0) so any shard plan runs on any mesh size
@@ -113,9 +110,53 @@ class ColumnarSnapshot:
             v = None if valid is None else jax.device_put(valid, sh)
             dev.append((d, v))
         dev_counts = jax.device_put(counts, sh)
+        return dev, dev_counts
+
+    def device_cols(self, mesh) -> tuple[list, Any]:
+        key = (id(mesh), self.epoch)
+        if key in self._device_cache:
+            return self._device_cache[key]
+        put = self._put(mesh)
         self._device_cache.clear()     # one epoch resident at a time
-        self._device_cache[key] = (dev, dev_counts)
+        self._device_cache[key] = put
         return self._device_cache[key]
+
+    def device_put_uncached(self, mesh) -> tuple[list, Any]:
+        """Device placement WITHOUT the resident cache — the streaming
+        (rows >> HBM) path places one batch at a time and lets it free as
+        soon as its program consumed it (SURVEY.md §5.7 paging analog)."""
+        return self._put(mesh)
+
+    # ---------------- streaming batches (rows >> device memory) ------ #
+
+    def device_bytes(self) -> int:
+        """Stacked device footprint: S x capacity x (itemsize + validity)."""
+        s, cap, _ = self.shard_layout()
+        return s * cap * sum(c.data.dtype.itemsize + 1 for c in self.columns)
+
+    def view(self, lo: int, hi: int, min_capacity: int = 0) -> "ColumnarSnapshot":
+        """Zero-copy row-range view (same shard count; forced capacity so
+        every batch of a stream compiles to ONE program shape)."""
+        return ColumnarSnapshot(
+            self.names, self.dtypes,
+            [c.slice(lo, hi) for c in self.columns], epoch=self.epoch,
+            n_shards=self.n_shards,
+            min_capacity=max(min_capacity, self.min_capacity))
+
+    def row_batches(self, max_bytes: int) -> Optional[list]:
+        """Split into row-range views whose device footprint fits
+        max_bytes, or None when the whole snapshot already fits."""
+        if max_bytes <= 0 or self.device_bytes() <= max_bytes or \
+                not self.num_rows:
+            return None
+        per_row = sum(c.data.dtype.itemsize + 1 for c in self.columns)
+        # pow2 capacity rounding can inflate a batch up to 2x: size for it
+        rows = max(int(max_bytes // (2 * per_row)), self.n_shards)
+        per_shard_cap = max(_pow2_at_least(-(-rows // self.n_shards)),
+                            self.min_capacity)
+        rows = per_shard_cap * self.n_shards
+        return [self.view(lo, min(lo + rows, self.num_rows), per_shard_cap)
+                for lo in range(0, self.num_rows, rows)]
 
 
 def snapshot_from_columns(names: Sequence[str], cols: Sequence[Column],
