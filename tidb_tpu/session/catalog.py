@@ -539,13 +539,28 @@ class TableInfo:
             return base + len(self._pending)
         return self.snapshot().num_rows
 
+    _placement_excluded: Any = None    # store exclusions survive epochs
+
     def snapshot(self) -> ColumnarSnapshot:
         if self._snapshot is not None:
             return self._snapshot
         cols = self._columnarize()
+        from ..store.placement import Placement
+        n = len(cols[0]) if cols else 0
+        placement = Placement.even(n, self.n_shards)
+        if self._placement_excluded:
+            # re-place shards away from stores excluded in prior epochs
+            # (the region cache remembers dead stores across refreshes)
+            for st in sorted(self._placement_excluded):
+                placement.exclude_store(st)
+        placement.on_change = self._note_placement
         self._snapshot = snapshot_from_columns(
-            self.col_names, cols, n_shards=self.n_shards, epoch=self._epoch)
+            self.col_names, cols, n_shards=self.n_shards, epoch=self._epoch,
+            placement=placement)
         return self._snapshot
+
+    def _note_placement(self, placement) -> None:
+        self._placement_excluded = set(placement.excluded)
 
     _snapshot_handles: Any = None
 

@@ -70,6 +70,7 @@ class CopClient:
         # failpoint queue is lock-guarded since the client is shared by
         # every connection thread
         self.last_retries = 0
+        self.last_heals = 0    # topology mutations by the retry loop
         import threading
         self._fp_mu = threading.Lock()
         self._failpoints: list = []    # injected RegionErrors (tests/chaos)
@@ -80,19 +81,31 @@ class CopClient:
 
     # -- dispatch retry seam (pkg/store/copr backoff loop analog) ------ #
 
-    def inject_failures(self, kind, n: int = 1) -> None:
+    def inject_failures(self, kind, n: int = 1, shard=None,
+                        store=None) -> None:
         """Failpoint: the next n dispatches raise a RegionError of `kind`
         before touching the device (chaos/testing seam, the reference's
-        failpoint.Inject on rpc errors)."""
+        failpoint.Inject on rpc errors).  `shard`/`store` name the failing
+        topology element so the retry can heal it (re-split / exclude)."""
         from .backoff import RegionError
         with self._fp_mu:
-            self._failpoints.extend(RegionError(kind) for _ in range(n))
+            for _ in range(n):
+                e = RegionError(kind)
+                e.shard = shard
+                e.store = store
+                self._failpoints.append(e)
 
     def _next_failpoint(self):
         with self._fp_mu:
             return self._failpoints.pop(0) if self._failpoints else None
 
-    def _retry(self, fn):
+    def _retry(self, fn, snap: "ColumnarSnapshot" = None):
+        """Backoff loop that HEALS the topology before retrying: a
+        RegionError naming a shard/store mutates the snapshot's placement
+        (split the shard / exclude the store, placement.heal), bumping its
+        epoch so the retry dispatches a DIFFERENT fan-out — the
+        copr handleTask re-split discipline (coprocessor.go:337,:1308),
+        not an identical re-run."""
         from .backoff import Backoffer, RegionError
         bo = Backoffer(max_sleep_ms=self.retry_budget_ms)
         retries = 0
@@ -105,6 +118,10 @@ class CopClient:
                 return fn()
             except RegionError as e:
                 bo.backoff(e.kind, e)
+                if snap is not None and snap.placement is not None:
+                    healed = snap.placement.heal(e)
+                    if healed:
+                        self.last_heals += 1
                 retries += 1
 
     # ------------------------------------------------------------- #
@@ -112,7 +129,7 @@ class CopClient:
     def execute_agg(self, agg: D.Aggregation, snap: ColumnarSnapshot,
                     key_meta: list[GroupKeyMeta], aux_cols=()) -> CopResult:
         return self._retry(lambda: self._execute_agg_once(
-            agg, snap, key_meta, aux_cols))
+            agg, snap, key_meta, aux_cols), snap=snap)
 
     def _execute_agg_once(self, agg: D.Aggregation, snap: ColumnarSnapshot,
                           key_meta: list[GroupKeyMeta],
@@ -451,7 +468,7 @@ class CopClient:
     def execute_rows(self, root: D.CopNode, snap: ColumnarSnapshot,
                      out_dtypes, dictionaries=None, aux_cols=()) -> list[Column]:
         return self._retry(lambda: self._execute_rows_once(
-            root, snap, out_dtypes, dictionaries, aux_cols))
+            root, snap, out_dtypes, dictionaries, aux_cols), snap=snap)
 
     def _execute_rows_once(self, root: D.CopNode, snap: ColumnarSnapshot,
                            out_dtypes, dictionaries=None,

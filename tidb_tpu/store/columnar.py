@@ -43,6 +43,11 @@ class ColumnarSnapshot:
     epoch: int = 0
     n_shards: int = 8
     min_capacity: int = 1024
+    # shard->store topology (store/placement.py).  None = plain even
+    # split.  Mutating the placement (split/exclude) bumps its epoch and
+    # invalidates the device cache, so the next dispatch re-fans-out
+    # under the new topology (region-cache invalidation analog).
+    placement: Any = None
 
     _device_cache: dict = field(default_factory=dict, repr=False)
 
@@ -68,17 +73,32 @@ class ColumnarSnapshot:
         counts = np.minimum(np.maximum(n - np.arange(s) * per, 0), per)
         return s, cap, counts.astype(np.int64)
 
-    def stacked_host(self) -> tuple[list, np.ndarray]:
-        """Stacked (S, C) host arrays [(data, validity|None), ...] + counts."""
-        s, cap, counts = self.shard_layout()
-        per = -(-self.num_rows // s) if self.num_rows else 0
+    def _even_ranges(self) -> list:
+        s = self.n_shards
+        n = self.num_rows
+        per = -(-n // s) if n else 0
+        return [(min(i * per, n), min(i * per + per, n)) for i in range(s)]
+
+    def _placement_ranges(self, n_dev: int) -> list:
+        """Slot row-ranges in device order (D*K grid, K = max shards on
+        any device; short devices pad with empty slots)."""
+        per_dev = self.placement.device_slots(n_dev)
+        k = max((len(lst) for lst in per_dev), default=1) or 1
+        ranges = []
+        for lst in per_dev:
+            ranges += [(s.lo, s.hi) for s in lst]
+            ranges += [(0, 0)] * (k - len(lst))
+        return ranges
+
+    def _stacked_ranges(self, ranges) -> tuple[list, np.ndarray]:
+        cap = max(_pow2_at_least(max((hi - lo for lo, hi in ranges),
+                                     default=0)), self.min_capacity)
+        counts = np.array([hi - lo for lo, hi in ranges], np.int64)
         cols = []
         for c in self.columns:
-            data = np.zeros((s, cap), dtype=c.data.dtype)
-            valid = np.zeros((s, cap), dtype=bool)
-            for i in range(s):
-                lo = min(i * per, self.num_rows)
-                hi = min(lo + per, self.num_rows)
+            data = np.zeros((len(ranges), cap), dtype=c.data.dtype)
+            valid = np.zeros((len(ranges), cap), dtype=bool)
+            for i, (lo, hi) in enumerate(ranges):
                 if hi > lo:
                     data[i, : hi - lo] = c.data[lo:hi]
                     valid[i, : hi - lo] = c.validity[lo:hi]
@@ -87,13 +107,22 @@ class ColumnarSnapshot:
             cols.append((data, None if all_valid else valid))
         return cols, counts
 
+    def stacked_host(self) -> tuple[list, np.ndarray]:
+        """Stacked (S, C) host arrays [(data, validity|None), ...] + counts
+        (even layout; placement-aware stacking happens in _put)."""
+        return self._stacked_ranges(self._even_ranges())
+
     # ---------------- device cache (region cache analog) ------------- #
 
     def _put(self, mesh) -> tuple[list, Any]:
-        host_cols, counts = self.stacked_host()
+        n_dev = mesh.devices.size
+        if self.placement is not None:
+            host_cols, counts = self._stacked_ranges(
+                self._placement_ranges(n_dev))
+        else:
+            host_cols, counts = self.stacked_host()
         # the shard axis must divide the mesh: pad with empty shards
         # (count 0) so any shard plan runs on any mesh size
-        n_dev = mesh.devices.size
         s = len(counts)
         s_pad = -(-s // n_dev) * n_dev
         if s_pad != s:
@@ -113,7 +142,8 @@ class ColumnarSnapshot:
         return dev, dev_counts
 
     def device_cols(self, mesh) -> tuple[list, Any]:
-        key = (id(mesh), self.epoch)
+        p_epoch = self.placement.epoch if self.placement is not None else -1
+        key = (id(mesh), self.epoch, p_epoch)
         if key in self._device_cache:
             return self._device_cache[key]
         put = self._put(mesh)
@@ -161,10 +191,11 @@ class ColumnarSnapshot:
 
 def snapshot_from_columns(names: Sequence[str], cols: Sequence[Column],
                           n_shards: int = 8, epoch: int = 0,
-                          min_capacity: int = 1024) -> ColumnarSnapshot:
+                          min_capacity: int = 1024,
+                          placement=None) -> ColumnarSnapshot:
     return ColumnarSnapshot(list(names), [c.dtype for c in cols], list(cols),
                             epoch=epoch, n_shards=n_shards,
-                            min_capacity=min_capacity)
+                            min_capacity=min_capacity, placement=placement)
 
 
 __all__ = ["ColumnarSnapshot", "snapshot_from_columns"]
