@@ -249,13 +249,25 @@ class CopTaskExec(PhysOp):
     key_meta: list = field(default_factory=list)
     out_dicts: dict = field(default_factory=dict)
     children: list = field(default_factory=list)
+    # pruned partition ids (None = all / table not partitioned) —
+    # rule_partition_processor.go output carried on the reader
+    partitions: Any = None
 
     def describe(self):
         kind = "agg" if isinstance(self.dag, D.Aggregation) else "rows"
-        return f"CopTask[{kind}] table={self.table.name} -> TPU"
+        part = ""
+        if getattr(self.table, "partition", None) is not None:
+            names = self.table.partition_names()
+            shown = (names if self.partitions is None
+                     else [names[i] for i in self.partitions])
+            part = f" partitions={','.join(shown)}/{len(names)}"
+        return f"CopTask[{kind}] table={self.table.name}{part} -> TPU"
 
     def execute(self, ctx: ExecContext) -> ResultChunk:
-        snap = self.table.snapshot()
+        if getattr(self.table, "partition", None) is not None:
+            snap = self.table.partition_snapshot(self.partitions)
+        else:
+            snap = self.table.snapshot()
         if isinstance(self.dag, D.Aggregation):
             res = ctx.client.execute_agg(self.dag, snap, self.key_meta)
             cols = res.key_columns + res.columns

@@ -156,6 +156,25 @@ def _try_cop(p: LogicalPlan, no_device_join: bool = False) -> Optional[PhysOp]:
     if getattr(ds.table, "is_memtable", False):
         return None     # infoschema memtables read host state, never device
 
+    # partition pruning (rule_partition_processor.go analog): predicates
+    # directly on the scan narrow the partition id list BEFORE fusing
+    pruned = None
+    if getattr(ds.table, "partition", None) is not None:
+        spec = ds.table.partition
+        try:
+            scan_ix = list(ds.col_offsets).index(
+                ds.table.col_names.index(spec.column))
+        except ValueError:
+            scan_ix = None
+        if scan_ix is not None:
+            conds = []
+            for m in reversed(mids):
+                if not isinstance(m, LogicalSelection):
+                    break
+                conds.extend(m.conditions)
+            from ..planner.partition_prune import prune_partitions
+            pruned = prune_partitions(spec, scan_ix, conds)
+
     snap = ds.table.snapshot()
     dicts = {}
     for i, off in enumerate(ds.col_offsets):
@@ -202,7 +221,8 @@ def _try_cop(p: LogicalPlan, no_device_join: bool = False) -> Optional[PhysOp]:
             # aggregate on host
             child_exec = CopTaskExec(node, ds.table, out_names=out_names,
                                      out_dtypes=out_dtypes,
-                                     out_dicts=out_dicts)
+                                     out_dicts=out_dicts,
+                                     partitions=pruned)
             return HostAgg(child_exec, list(top.group_exprs), list(top.aggs),
                            out_names=top.schema.names(),
                            out_dtypes=[c.dtype for c in top.schema.cols])
@@ -236,16 +256,19 @@ def _try_cop(p: LogicalPlan, no_device_join: bool = False) -> Optional[PhysOp]:
                       limit=top.limit + top.offset,
                       sort_keys=tuple(keys) if len(keys) > 1 else ())
         exec_ = CopTaskExec(node, ds.table, out_names=out_names,
-                            out_dtypes=out_dtypes, out_dicts=out_dicts)
+                            out_dtypes=out_dtypes, out_dicts=out_dicts,
+                            partitions=pruned)
         # root merge of per-device tops
         return HostTopN(exec_, list(top.keys), top.limit, top.offset)
     elif isinstance(top, LogicalLimit):
         node = D.Limit(node, limit=top.limit + top.offset)
         exec_ = CopTaskExec(node, ds.table, out_names=out_names,
-                            out_dtypes=out_dtypes, out_dicts=out_dicts)
+                            out_dtypes=out_dtypes, out_dicts=out_dicts,
+                            partitions=pruned)
         return HostLimit(exec_, top.limit, top.offset)
 
-    return CopTaskExec(node, ds.table, out_names=out_names,
+    return CopTaskExec(node, ds.table, partitions=pruned,
+                       out_names=out_names,
                        out_dtypes=out_dtypes, key_meta=key_meta,
                        out_dicts=out_dicts)
 

@@ -1523,6 +1523,42 @@ def _build_cte_ref(entry: CTEEntry, alias: str, catalog,
 # FROM clause
 # --------------------------------------------------------------------- #
 
+import threading as _threading
+
+_view_expansion = _threading.local()
+
+
+def _expand_view(view, alias: str, catalog, db: str,
+                 ctes: Optional[dict]) -> LogicalPlan:
+    """Inline a view reference as a named subquery (reference:
+    core/logical_plan_builder.go BuildDataSourceFromView).  The stored
+    SELECT text re-parses and re-plans on every reference; a per-thread
+    expansion stack rejects recursive view chains."""
+    from ..sql.parser import parse_sql
+    stack = getattr(_view_expansion, "stack", frozenset())
+    key = (db, view.name.lower())
+    if key in stack:
+        raise PlanError(f"view {view.name!r} references itself "
+                        "(recursive views are invalid)")
+    _view_expansion.stack = stack | {key}
+    try:
+        stmt = parse_sql(view.select_sql)[0]
+        built = build_query(stmt, catalog, db, ctes or {})
+    finally:
+        _view_expansion.stack = stack
+    sub = built.plan
+    out_names = list(view.columns) or list(built.output_names)
+    if len(out_names) != len(built.output_names):
+        raise PlanError(
+            f"view {view.name!r} column list has {len(out_names)} names "
+            f"for {len(built.output_names)} select columns")
+    sch = Schema([SchemaCol(n, c.dtype, alias)
+                  for n, c in zip(out_names,
+                                  sub.schema.cols[:len(out_names)])])
+    sub.schema = sch
+    return sub
+
+
 def _build_from(node: A.Node, catalog, default_db: str,
                 ctes: Optional[dict] = None) -> LogicalPlan:
     ctes = ctes or {}
@@ -1531,7 +1567,11 @@ def _build_from(node: A.Node, catalog, default_db: str,
         if node.db is None and node.name.lower() in ctes:
             return _build_cte_ref(ctes[node.name.lower()], alias, catalog,
                                   default_db)
-        tbl = catalog.get_table(node.db or default_db, node.name)
+        db = node.db or default_db
+        view = getattr(catalog, "get_view", lambda *_: None)(db, node.name)
+        if view is not None:
+            return _expand_view(view, alias, catalog, db, ctes)
+        tbl = catalog.get_table(db, node.name)
         sch = Schema([SchemaCol(n, t, alias)
                       for n, t in zip(tbl.col_names, tbl.col_types)])
         return DataSource(tbl, alias, sch, list(range(len(tbl.col_names))))

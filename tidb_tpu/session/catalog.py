@@ -121,6 +121,11 @@ class TableInfo:
     ttl_col: Optional[str] = None
     ttl_interval_sec: int = 0
     ttl_enable: bool = True
+    # table partitioning (sql/ast.PartitionSpec | None); partitions are
+    # logical row sets over one store — pruning skips whole partitions at
+    # scan time (rule_partition_processor.go analog)
+    partition: Any = None
+    _part_snap_cache: Any = None   # (epoch, ids) -> sub-snapshot
     # schema gate: writers hold read side per statement; online-DDL state
     # transitions take the write side to drain in-flight writers (the F1
     # schema-lease wait analog, utils/rwlock.py)
@@ -301,6 +306,14 @@ class TableInfo:
 
     def insert_rows(self, rows: list[tuple], txn=None) -> int:
         fixed, first_handle = self._prepare_insert(rows)
+        if self.partition is not None and self.partition.kind == "range" \
+                and self.partition.parts[-1][1] is not None and fixed:
+            ci = self.col_names.index(self.partition.column)
+            hi = self.partition.parts[-1][1]
+            for r in fixed:
+                if r[ci] is not None and int(r[ci]) >= hi:
+                    raise CatalogError(
+                        f"Table has no partition for value {int(r[ci])}")
         if self.kv is not None:
             own = txn is None
             with self.schema_gate.read():
@@ -562,6 +575,61 @@ class TableInfo:
     def _note_placement(self, placement) -> None:
         self._placement_excluded = set(placement.excluded)
 
+    # ---------------- partitioning (logical row sets) ---------------- #
+
+    def partition_names(self) -> list[str]:
+        return [p[0] for p in self.partition.parts] if self.partition else []
+
+    def _partition_index(self, col: Column) -> "np.ndarray":
+        """Per-row partition id for the partition column (model:
+        rule_partition_processor.go partition locating).  NULL routes to
+        partition 0 (MySQL: lowest RANGE partition / hash bucket 0)."""
+        v = col.data.astype(np.int64)
+        spec = self.partition
+        if spec.kind == "hash":
+            pid = np.abs(v) % np.int64(spec.num)
+        else:
+            bounds = np.array([b for _, b in spec.parts if b is not None],
+                              np.int64)
+            pid = np.searchsorted(bounds, v, side="right")
+            # beyond the last finite bound: MAXVALUE partition if present,
+            # else clamp (insert-time validation rejects such rows)
+            pid = np.minimum(pid, len(spec.parts) - 1)
+        return np.where(col.validity, pid, 0)
+
+    def check_partition_rows(self, col: Column) -> None:
+        """RANGE without MAXVALUE rejects out-of-range rows
+        (ER_NO_PARTITION_FOR_GIVEN_VALUE)."""
+        spec = self.partition
+        if spec is None or spec.kind != "range" or \
+                spec.parts[-1][1] is None:
+            return
+        hi = spec.parts[-1][1]
+        bad = col.data[col.validity & (col.data >= hi)]
+        if len(bad):
+            raise CatalogError(
+                f"Table has no partition for value {int(bad[0])}")
+
+    def partition_snapshot(self, ids) -> ColumnarSnapshot:
+        """Snapshot restricted to the given partition ids (pruned scan)."""
+        snap = self.snapshot()
+        if self.partition is None or ids is None:
+            return snap
+        ids = tuple(sorted(set(ids)))
+        if ids == tuple(range(len(self.partition.parts))):
+            return snap
+        if self._part_snap_cache and \
+                self._part_snap_cache[0] == (snap.epoch, ids):
+            return self._part_snap_cache[1]
+        col = snap.columns[self.col_names.index(self.partition.column)]
+        pid = self._partition_index(col)
+        idx = np.nonzero(np.isin(pid, np.array(ids, np.int64)))[0]
+        sub = snapshot_from_columns(
+            self.col_names, [c.take(idx) for c in snap.columns],
+            n_shards=self.n_shards, epoch=snap.epoch)
+        self._part_snap_cache = ((snap.epoch, ids), sub)
+        return sub
+
     _snapshot_handles: Any = None
 
     def _columnarize(self) -> list[Column]:
@@ -628,6 +696,16 @@ def plainify(v):
     return v
 
 
+@dataclass
+class ViewInfo:
+    """A stored view: column names + the defining SELECT kept as SQL text,
+    re-planned at every expansion so base-table schema changes flow
+    through (meta/model ViewInfo analog; parser.y CreateViewStmt)."""
+    name: str
+    columns: list            # [] = inherit the select's output names
+    select_sql: str
+
+
 class Catalog:
     """In-memory catalog of databases/tables (infoschema analog).
 
@@ -637,6 +715,9 @@ class Catalog:
     def __init__(self):
         self.databases: dict[str, dict[str, TableInfo]] = {"test": {},
                                                            "mysql": {}}
+        # views per db: name -> ViewInfo (planner expands at reference
+        # time, logical_plan_builder BuildDataSourceFromView analog)
+        self.views: dict[str, dict[str, "ViewInfo"]] = {}
         self.domain = None       # set by Domain.__init__ (memtable binding)
 
     def create_database(self, name: str, if_not_exists=False):
@@ -690,6 +771,29 @@ class Catalog:
         if db not in self.databases:
             raise CatalogError(f"unknown database {db!r}")
         return self.databases[db]
+
+    # ---------------- views ---------------- #
+
+    def create_view(self, db: str, view: "ViewInfo",
+                    or_replace: bool = False):
+        d = self._db(db)            # existence/system-db validation
+        if view.name in d:
+            raise CatalogError(f"table {view.name!r} exists")
+        vs = self.views.setdefault(db, {})
+        if view.name in vs and not or_replace:
+            raise CatalogError(f"view {view.name!r} exists")
+        vs[view.name] = view
+
+    def drop_view(self, db: str, name: str, if_exists=False):
+        vs = self.views.get(db, {})
+        if name not in vs:
+            if if_exists:
+                return
+            raise CatalogError(f"unknown view {db}.{name}")
+        del vs[name]
+
+    def get_view(self, db: str, name: str) -> Optional["ViewInfo"]:
+        return self.views.get(db, {}).get(name)
 
 
 __all__ = ["Catalog", "TableInfo", "IndexInfo", "CatalogError",

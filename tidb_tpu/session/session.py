@@ -344,6 +344,16 @@ class Session:
             for n in stmt.names:
                 self.domain.catalog.drop_table(self.db, n, stmt.if_exists)
             return ResultSet()
+        if isinstance(stmt, A.CreateView):
+            from .catalog import ViewInfo
+            self.domain.catalog.create_view(
+                self.db, ViewInfo(stmt.name, list(stmt.columns),
+                                  stmt.select_sql), stmt.or_replace)
+            return ResultSet()
+        if isinstance(stmt, A.DropView):
+            for n in stmt.names:
+                self.domain.catalog.drop_view(self.db, n, stmt.if_exists)
+            return ResultSet()
         if isinstance(stmt, A.CreateDatabase):
             self.domain.catalog.create_database(stmt.name, stmt.if_not_exists)
             return ResultSet()
@@ -814,6 +824,16 @@ class Session:
             tbl.ttl_col = stmt.ttl.column
             tbl.ttl_interval_sec = stmt.ttl.interval_sec
             tbl.ttl_enable = stmt.ttl.enable
+        if stmt.partition is not None:
+            pc = stmt.partition.column
+            if pc not in names:
+                raise CatalogError(f"unknown partition column {pc!r}")
+            t = types[names.index(pc)]
+            if t.kind not in (dt.TypeKind.INT64, dt.TypeKind.UINT64,
+                              dt.TypeKind.DATE, dt.TypeKind.DATETIME):
+                raise CatalogError(
+                    "partition column must be integer or date typed")
+            tbl.partition = stmt.partition
         self.domain.catalog.create_table(self.db, tbl, stmt.if_not_exists)
         created = self.domain.catalog.get_table(self.db, stmt.name)
         if created is tbl:
@@ -1257,7 +1277,8 @@ class Session:
             if is_system_db(self.db):
                 names = system_tables(self.db)
             else:
-                names = sorted(cat.databases[self.db])
+                names = sorted(set(cat.databases[self.db])
+                               | set(cat.views.get(self.db, {})))
             return ResultSet([f"Tables_in_{self.db}"],
                              [(n,) for n in names])
         if stmt.kind == "databases":

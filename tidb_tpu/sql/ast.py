@@ -224,6 +224,7 @@ class CreateTable(Node):
     # inline index defs: (name_or_None, [cols], unique)
     indexes: list[tuple] = field(default_factory=list)
     ttl: Optional[TTLOption] = None
+    partition: Optional[PartitionSpec] = None
 
 
 @dataclass
@@ -249,6 +250,35 @@ class AlterTable(Node):
     ('drop_column', name)."""
     table: str
     actions: list[tuple] = field(default_factory=list)
+
+
+@dataclass
+class PartitionSpec:
+    """PARTITION BY clause (reference: parser.y PartitionOpt; model
+    meta/model PartitionInfo).  kind 'range': parts = [(name, upper-bound
+    int | None for MAXVALUE)], ordered ascending.  kind 'hash': num
+    partitions named p0..p{n-1}."""
+    kind: str                      # 'range' | 'hash'
+    column: str
+    parts: list = field(default_factory=list)
+    num: int = 0
+
+
+@dataclass
+class CreateView(Node):
+    """CREATE [OR REPLACE] VIEW name [(cols)] AS select (parser.y
+    CreateViewStmt analog); the select is kept as SQL text and re-planned
+    at every expansion, so schema changes flow through."""
+    name: str
+    columns: list = field(default_factory=list)
+    select_sql: str = ""
+    or_replace: bool = False
+
+
+@dataclass
+class DropView(Node):
+    names: list = field(default_factory=list)
+    if_exists: bool = False
 
 
 @dataclass

@@ -624,6 +624,28 @@ class Parser:
         if self.accept_kw("USER"):
             ine = self._if_not_exists()
             return A.CreateUser(self._user_password_list(), ine)
+        or_replace = False
+        if self.accept_kw("OR"):
+            if not (self.at_kw("REPLACE")
+                    or (self.cur.kind == "ident"
+                        and self.cur.text.upper() == "REPLACE")):
+                raise ParseError("expected REPLACE after CREATE OR",
+                                 self.cur)
+            self.advance()
+            or_replace = True
+        if self.cur.kind == "ident" and self.cur.text.upper() == "VIEW":
+            self.advance()
+            name = self.ident()
+            cols: list = []
+            if self.at_op("("):
+                cols = self._paren_name_list()
+            self.expect_kw("AS")
+            sql = self._stmt_text_until(None)
+            parse_sql(sql)                 # validate the view body NOW
+            return A.CreateView(name, cols, sql, or_replace)
+        if or_replace:
+            raise ParseError("expected VIEW after CREATE OR REPLACE",
+                             self.cur)
         unique = self.accept_kw("UNIQUE")
         if self.accept_kw("INDEX") or (unique and self.accept_kw("KEY")):
             ine = self._if_not_exists()
@@ -686,6 +708,11 @@ class Parser:
                     ct.ttl = A.TTLOption(col, n * secs)
                 else:
                     ct.ttl.column, ct.ttl.interval_sec = col, n * secs
+            elif (self.cur.kind in ("kw", "ident")
+                  and self.cur.text.upper() == "PARTITION"):
+                self.advance()
+                self.expect_kw("BY")
+                ct.partition = self._partition_spec()
             elif (self.cur.kind == "ident"
                   and self.cur.text.upper() == "TTL_ENABLE"):
                 self.advance()
@@ -700,6 +727,67 @@ class Parser:
             if c.primary_key and c.name not in ct.primary_key:
                 ct.primary_key.append(c.name)
         return ct
+
+    def _accept_word(self, w: str) -> bool:
+        """Accept a keyword OR identifier spelled `w` (non-reserved words
+        like HASH/MAXVALUE lex as idents)."""
+        if self.cur.kind in ("kw", "ident") and self.cur.text.upper() == w:
+            self.advance()
+            return True
+        return False
+
+    def _partition_spec(self) -> A.PartitionSpec:
+        """RANGE (col) (PARTITION p VALUES LESS THAN (n|MAXVALUE), ...)
+        | HASH (col) PARTITIONS n   (parser.y PartitionOpt subset;
+        bounds are integer literals — the meta-model keeps them as ints)."""
+        if self._accept_word("RANGE"):
+            self.expect_op("(")
+            col = self.ident()
+            self.expect_op(")")
+            self.expect_op("(")
+            parts = []
+            while True:
+                if not self._accept_word("PARTITION"):
+                    raise ParseError("expected PARTITION", self.cur)
+                pname = self.ident()
+                self.expect_kw("VALUES")
+                if not (self._accept_word("LESS")
+                        and self._accept_word("THAN")):
+                    raise ParseError("expected LESS THAN", self.cur)
+                if self._accept_word("MAXVALUE"):
+                    bound = None
+                else:
+                    self.expect_op("(")
+                    if self._accept_word("MAXVALUE"):
+                        bound = None
+                    else:
+                        neg = self.accept_op("-")
+                        bound = self._int_lit()
+                        if neg:
+                            bound = -bound
+                    self.expect_op(")")
+                parts.append((pname, bound))
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+            bounds = [b for _, b in parts if b is not None]
+            if bounds != sorted(bounds) or (
+                    None in [b for _, b in parts[:-1]]):
+                raise ParseError("RANGE partition bounds must ascend "
+                                 "(MAXVALUE last)", self.cur)
+            return A.PartitionSpec("range", col, parts)
+        if self._accept_word("HASH"):
+            self.expect_op("(")
+            col = self.ident()
+            self.expect_op(")")
+            if not self._accept_word("PARTITIONS"):
+                raise ParseError("expected PARTITIONS", self.cur)
+            n = self._int_lit()
+            if not 1 <= n <= 1024:
+                raise ParseError("PARTITIONS must be 1..1024", self.cur)
+            return A.PartitionSpec(
+                "hash", col, [(f"p{i}", None) for i in range(n)], n)
+        raise ParseError("expected RANGE or HASH partitioning", self.cur)
 
     def _paren_name_list(self) -> list[str]:
         """Index column list; prefix lengths col(10) and ASC/DESC are
@@ -877,6 +965,16 @@ class Parser:
             if self.accept_op("."):
                 table = self.ident()
             return A.DropIndex(name, table, ie)
+        if self.cur.kind == "ident" and self.cur.text.upper() == "VIEW":
+            self.advance()
+            ie = False
+            if self.accept_kw("IF"):
+                self.expect_kw("EXISTS")
+                ie = True
+            names = [self.ident()]
+            while self.accept_op(","):
+                names.append(self.ident())
+            return A.DropView(names, ie)
         self.expect_kw("TABLE")
         ie = False
         if self.accept_kw("IF"):
