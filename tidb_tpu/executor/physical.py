@@ -261,7 +261,9 @@ class CopTaskExec(PhysOp):
             shown = (names if self.partitions is None
                      else [names[i] for i in self.partitions])
             part = f" partitions={','.join(shown)}/{len(names)}"
-        return f"CopTask[{kind}] table={self.table.name}{part} -> TPU"
+        cached = " [cop-cache hit]" if getattr(self, "_cache_hit", False) \
+            else ""
+        return f"CopTask[{kind}] table={self.table.name}{part} -> TPU{cached}"
 
     def execute(self, ctx: ExecContext) -> ResultChunk:
         if getattr(self.table, "partition", None) is not None:
@@ -269,7 +271,11 @@ class CopTaskExec(PhysOp):
         else:
             snap = self.table.snapshot()
         if isinstance(self.dag, D.Aggregation):
+            h0 = getattr(ctx.client, "result_cache_hits", 0)
             res = ctx.client.execute_agg(self.dag, snap, self.key_meta)
+            # EXPLAIN ANALYZE surfacing (coprocessor_cache.go hit counter)
+            self._cache_hit = \
+                getattr(ctx.client, "result_cache_hits", 0) > h0
             cols = res.key_columns + res.columns
             for j, d in self.out_dicts.items():
                 if cols[j].dictionary is None:

@@ -78,6 +78,18 @@ class CopClient:
         # get/assign/move_to_end/popitem sequence (ADVICE r2: a concurrent
         # eviction between get and move_to_end raised KeyError)
         self._pf_mu = threading.Lock()
+        # coprocessor RESULT cache (copr/coprocessor_cache.go analog):
+        # key = (dag digest, snapshot epoch, placement epoch, shard
+        # layout); a table write creates a new snapshot + epoch, so stale
+        # entries never hit and the LRU ages them out.  Entries hold a
+        # weakref to their snapshot: a hit must come from the SAME
+        # snapshot object (guards id()/epoch reuse).
+        self._result_cache: OrderedDict = OrderedDict()
+        self._result_cache_cap = 64
+        self._rc_max_bytes = 4 << 20   # only small responses, like the ref
+        self._rc_mu = threading.Lock()
+        self.result_cache_hits = 0
+        self.result_cache_misses = 0
 
     # -- dispatch retry seam (pkg/store/copr backoff loop analog) ------ #
 
@@ -128,8 +140,44 @@ class CopClient:
 
     def execute_agg(self, agg: D.Aggregation, snap: ColumnarSnapshot,
                     key_meta: list[GroupKeyMeta], aux_cols=()) -> CopResult:
-        return self._retry(lambda: self._execute_agg_once(
+        key = None
+        if not aux_cols:      # aux (join builds) = host inputs, not cacheable
+            key = self._rc_key(agg, snap)
+            hit = self._rc_get(key, snap)
+            if hit is not None:
+                return hit
+        res = self._retry(lambda: self._execute_agg_once(
             agg, snap, key_meta, aux_cols), snap=snap)
+        if key is not None:
+            self._rc_put(key, snap, res)
+        return res
+
+    def _rc_key(self, dag, snap: ColumnarSnapshot):
+        p_epoch = snap.placement.epoch if snap.placement is not None else -1
+        return (D.dag_digest(dag), snap.epoch, p_epoch, snap.num_rows,
+                snap.n_shards)
+
+    def _rc_get(self, key, snap) -> Optional[CopResult]:
+        with self._rc_mu:
+            ent = self._result_cache.get(key)
+            if ent is not None and ent[0]() is snap:
+                self._result_cache.move_to_end(key)
+                self.result_cache_hits += 1
+                return ent[1]
+        self.result_cache_misses += 1
+        return None
+
+    def _rc_put(self, key, snap, res: CopResult) -> None:
+        import weakref
+        nbytes = sum(c.data.nbytes for c in res.columns + res.key_columns
+                     if hasattr(c.data, "nbytes"))
+        if nbytes > self._rc_max_bytes:
+            return
+        with self._rc_mu:
+            self._result_cache[key] = (weakref.ref(snap), res)
+            self._result_cache.move_to_end(key)
+            while len(self._result_cache) > self._result_cache_cap:
+                self._result_cache.popitem(last=False)
 
     def _execute_agg_once(self, agg: D.Aggregation, snap: ColumnarSnapshot,
                           key_meta: list[GroupKeyMeta],

@@ -73,7 +73,9 @@ def explain_analyze_text(root, coll: RuntimeStatsColl) -> list[tuple]:
         if st is None:
             out.append((pad + op.describe(), None, None, None))
         else:
-            out.append((pad + st.label, st.rows,
+            # re-describe at RENDER time: execution may have annotated the
+            # operator (cop-cache hit, runtime join strategy, ...)
+            out.append((pad + op.describe(), st.rows,
                         f"{st.time_ms:.3f}ms", st.loops))
         for c in getattr(op, "children", []):
             visit(c, depth + 1)
