@@ -47,6 +47,9 @@ def sess():
 def test_injected_failures_recover(sess):
     client = sess.domain.client
     client.retry_budget_ms = 10_000
+    # a repeat query would legitimately hit the cop RESULT cache and never
+    # reach the dispatch (where the failpoints fire) — disable it here
+    client._result_cache_cap = 0
     exp = sess.must_query("select b, count(*) from t group by b")
     client.inject_failures(STORE_UNAVAILABLE, 2)
     got = sess.must_query("select b, count(*) from t group by b")
