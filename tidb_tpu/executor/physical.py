@@ -292,6 +292,29 @@ class CopTaskExec(PhysOp):
 
 
 @dataclass
+class HostTableScanExec(PhysOp):
+    """Plain host scan of the columnar snapshot — used where device
+    dispatch would be a pessimization: inner plans under a correlated
+    Apply re-plan per distinct outer key, and baking the key into a
+    device DAG would compile a fresh XLA program every time (the r2 Q2
+    pathology: 100 keys x ~7s compile).  The reference's inner side of
+    parallel_apply likewise runs plain executors."""
+    table: Any
+    col_offsets: list = field(default_factory=list)
+    out_names: list = field(default_factory=list)
+    out_dtypes: list = field(default_factory=list)
+    children: list = field(default_factory=list)
+
+    def describe(self):
+        return f"HostTableScan table={self.table.name}"
+
+    def chunks(self, ctx, required_rows=None):
+        snap = self.table.snapshot()
+        cols = [snap.columns[o] for o in self.col_offsets]
+        yield from _slice_stream(ResultChunk(list(self.out_names), cols))
+
+
+@dataclass
 class CopJoinTaskExec(PhysOp):
     """Broadcast lookup join fused into the device program.
 
@@ -1814,14 +1837,21 @@ class HostApplyExec(PhysOp):
                     return B.lit(None)
                 return B.lit(plainify(c2.columns[0].to_python()[0]))
 
+            from .plan import HOST_ONLY
             tok = OUTER_RESOLVER.set(resolver)
             tok2 = SUBQUERY_EXECUTOR.set(nested_eval)
+            # per-key plans bake the outer value in as a constant: device
+            # fusion would compile one XLA program per distinct key, so
+            # the inner plan stays on host executors (parallel_apply.go
+            # runs plain executors the same way)
+            tok3 = HOST_ONLY.set(True)
             try:
                 built = build_query(_copy.deepcopy(sub_ast), self.catalog,
                                     self.default_db, {})
                 plan = optimize_plan(built.plan)
                 sub = to_physical(plan).execute(ctx)
             finally:
+                HOST_ONLY.reset(tok3)
                 SUBQUERY_EXECUTOR.reset(tok2)
                 OUTER_RESOLVER.reset(tok)
             if sub.num_rows > 1:

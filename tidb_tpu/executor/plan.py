@@ -34,6 +34,23 @@ K = dt.TypeKind
 
 MAX_DENSE_GROUPS = 1_000_000
 
+# stats handle for the CURRENT planning pass (set by the session around
+# to_physical — the SUBQUERY_EXECUTOR contextvar precedent); consumers:
+# SORT-agg group-table capacity from column NDV, so fresh auto-analyze
+# stats skip the grow-from-default regrow round-trips
+import contextvars
+
+STATS_HANDLE: contextvars.ContextVar = contextvars.ContextVar(
+    "stats_handle", default=None)
+
+# host-only planning mode (set by HostApplyExec around inner-plan builds):
+# correlated subqueries re-plan per distinct outer key with the key baked
+# in as a constant — device fusion would compile a fresh XLA program per
+# key, so the inner plan runs entirely on host executors instead
+# (pkg/executor/parallel_apply.go runs plain executors the same way)
+HOST_ONLY: contextvars.ContextVar = contextvars.ContextVar(
+    "host_only", default=False)
+
 
 def to_physical(p: LogicalPlan, no_device_join: bool = False) -> PhysOp:
     if isinstance(p, LogicalProjection) and isinstance(p.child, DualSource):
@@ -129,6 +146,12 @@ def to_physical(p: LogicalPlan, no_device_join: bool = False) -> PhysOp:
             return MemTableExec(p.table, list(p.col_offsets),
                                 out_names=p.schema.names(),
                                 out_dtypes=[c.dtype for c in p.schema.cols])
+        if HOST_ONLY.get():
+            from .physical import HostTableScanExec
+            return HostTableScanExec(p.table, list(p.col_offsets),
+                                     out_names=p.schema.names(),
+                                     out_dtypes=[c.dtype
+                                                 for c in p.schema.cols])
         raise AssertionError("DataSource should fuse into a CopTask")
     raise NotImplementedError(type(p).__name__)
 
@@ -137,6 +160,8 @@ def to_physical(p: LogicalPlan, no_device_join: bool = False) -> PhysOp:
 
 def _try_cop(p: LogicalPlan, no_device_join: bool = False) -> Optional[PhysOp]:
     """Fuse the subtree rooted at p into one CopTask if possible."""
+    if HOST_ONLY.get():
+        return None
     top = None          # Aggregation | TopN | Limit at the root
     mids: list = []     # Selection / Projection chain
     cur = p
@@ -215,7 +240,12 @@ def _try_cop(p: LogicalPlan, no_device_join: bool = False) -> Optional[PhysOp]:
         pass
     elif isinstance(top, LogicalAggregate):
         agg_dicts: dict[int, object] = {}
-        agg_node = _bind_agg(top, node, cur_dicts, key_meta, agg_dicts)
+        # NDV capacity seeding only resolves group keys against the SCAN
+        # schema; a Projection in the chain remaps indices (review r3) —
+        # drop the seed there and let the client regrow from observed
+        has_proj = any(isinstance(m, LogicalProjection) for m in mids)
+        agg_node = _bind_agg(top, node, cur_dicts, key_meta, agg_dicts,
+                              ds=None if has_proj else ds)
         if agg_node is None:
             # aggregation itself not pushable: fuse the scan part only and
             # aggregate on host
@@ -284,6 +314,8 @@ def _try_cop_window(p) -> Optional[PhysOp]:
     every item shares one PARTITION BY (non-empty) and ORDER BY, no
     explicit frames, rank-family or whole-partition aggregates only, and
     every key/arg lowers to a device expression."""
+    if HOST_ONLY.get():
+        return None
     from ..utils.collate import is_binary
     from .physical import CopWindowExec
     items = p.items
@@ -587,7 +619,8 @@ def _bind_post_join(top, mids, join: LogicalJoin, start: D.CopNode,
     if top is not None:
         if isinstance(top, LogicalAggregate):
             agg_dicts: dict[int, object] = {}
-            agg_node = _bind_agg(top, nodew, all_dicts, key_meta, agg_dicts)
+            agg_node = _bind_agg(top, nodew, all_dicts, key_meta,
+                                  agg_dicts)
             if agg_node is None:
                 return None
             nodew = agg_node
@@ -759,7 +792,8 @@ def _chain_output_dicts(plan: LogicalPlan) -> dict:
 
 
 def _bind_agg(agg: LogicalAggregate, child: D.CopNode, dicts,
-              key_meta_out: list, agg_dicts_out: dict) -> Optional[D.Aggregation]:
+              key_meta_out: list, agg_dicts_out: dict,
+              ds=None) -> Optional[D.Aggregation]:
     """Bind a LogicalAggregate to a device Aggregation (DENSE/SCALAR), or
     None if it must stay on host (generic keys / distinct)."""
     if any(a.distinct for a in agg.aggs):
@@ -828,7 +862,37 @@ def _bind_agg(agg: LogicalAggregate, child: D.CopNode, dicts,
         lowered.append(lg)
     key_meta_out.extend(metas)
     return D.Aggregation(child, tuple(lowered), tuple(descs),
-                         D.GroupStrategy.SORT)
+                         D.GroupStrategy.SORT,
+                         group_capacity=_ndv_capacity(agg, ds))
+
+
+def _ndv_capacity(agg, ds) -> int:
+    """Initial SORT group-table capacity from stats NDV (the consumer half
+    of auto-analyze, VERDICT r2 #8): product of per-key NDVs with 25%
+    headroom, pow2-rounded, bounded — 0 when stats are absent (the client
+    then starts at its default and regrows from observed __ngroups__)."""
+    handle = STATS_HANDLE.get()
+    if handle is None or ds is None:
+        return 0
+    st = handle.get(ds.table)
+    if st is None:
+        return 0
+    total = 1
+    for g in agg.group_exprs:
+        if not isinstance(g, ColumnRef):
+            return 0
+        try:
+            name = ds.schema.cols[g.index].name.lower()
+        except Exception:
+            return 0
+        cs = st.col(name)
+        if cs is None or not getattr(cs, "ndv", 0):
+            return 0
+        total *= max(int(cs.ndv), 1)
+        if total > MAX_DENSE_GROUPS:
+            break
+    cap = 1 << (int(total * 1.25) - 1).bit_length()
+    return max(1024, min(cap, 1 << 22))
 
 
 __all__ = ["to_physical"]

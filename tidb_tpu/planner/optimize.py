@@ -220,6 +220,24 @@ def push_predicates(p: LogicalPlan, pending: list[Expr] | None = None) -> Logica
         p.children = [p.left, p.right]
         return _wrap(p, pending)
 
+    from .logical import LogicalApply
+    if isinstance(p, LogicalApply):
+        # Apply appends subquery columns AFTER the child's schema:
+        # conditions that only touch child columns sink below (they don't
+        # observe apply outputs), the rest stay above.  Without this, a
+        # WHERE mixing one correlated predicate with ordinary join
+        # predicates left the Apply sitting on the raw cross join
+        # (rule_decorrelate + PPD ordering in the reference).
+        n_child = len(p.child.schema)
+        sink, stay = [], []
+        for c in pending:
+            refs = referenced_columns(c)
+            (sink.append(c) if not refs or max(refs) < n_child
+             else stay.append(c))
+        p.child = push_predicates(p.child, sink)
+        p.children = [p.child]
+        return _wrap(p, stay)
+
     if isinstance(p, (LogicalSort, LogicalLimit, LogicalTopN, LogicalAggregate)):
         if isinstance(p, LogicalAggregate):
             # conditions over group cols could sink; keep above for now

@@ -621,7 +621,12 @@ class Session:
         from ..planner.join_reorder import reorder_joins
         plan = reorder_joins(plan, self.domain.stats)
         plan = apply_index_paths(plan, self.domain.stats)
-        phys = to_physical(plan)
+        from ..executor.plan import STATS_HANDLE
+        tok = STATS_HANDLE.set(self.domain.stats)
+        try:
+            phys = to_physical(plan)
+        finally:
+            STATS_HANDLE.reset(tok)
         use_cache = use_cache and not ran_subquery
         if use_cache and _plan_cacheable(phys):
             keys = {}
@@ -647,11 +652,17 @@ class Session:
         built = build_query(sub_ast, self.domain.catalog, self.db)
         if len(built.plan.schema) != 1:
             raise PlanError("scalar subquery must return one column")
+        from ..executor.plan import STATS_HANDLE
         from ..planner.join_reorder import reorder_joins
         plan = optimize_plan(built.plan)
         plan = reorder_joins(plan, self.domain.stats)
         plan = apply_index_paths(plan, self.domain.stats)
-        chunk = to_physical(plan).execute(self._exec_ctx())
+        tok = STATS_HANDLE.set(self.domain.stats)
+        try:
+            phys = to_physical(plan)
+        finally:
+            STATS_HANDLE.reset(tok)
+        chunk = phys.execute(self._exec_ctx())
         if chunk.num_rows > 1:
             raise PlanError("scalar subquery returned more than one row")
         if chunk.num_rows == 0:
