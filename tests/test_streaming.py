@@ -264,3 +264,42 @@ def test_sql_order_by_under_quota_streams():
     s.execute("set tidb_mem_quota_query = 300000")
     got = s.must_query("select a from big order by b, a limit 50")
     assert got == expected
+
+
+def test_parallel_map_chunks_preserves_order_and_drops_none():
+    """P10 worker-pool seam: with concurrency forced >1 the pooled path
+    must preserve chunk order and drop None results, exactly like the
+    serial path (1-core containers normally clamp to serial)."""
+    import os
+    from unittest import mock
+
+    from tidb_tpu.executor.physical import _parallel_map_chunks
+
+    chunks = list(range(20))
+
+    def fn(i):
+        import time as _t
+        _t.sleep(0.001 * (20 - i) / 20)   # later chunks finish FIRST
+        return None if i % 5 == 4 else i * 10
+
+    ctx = ExecContext(None, {"tidb_executor_concurrency": 4})
+    with mock.patch.object(os, "cpu_count", return_value=8):
+        got = list(_parallel_map_chunks(ctx, iter(chunks), fn))
+    exp = [i * 10 for i in chunks if i % 5 != 4]
+    assert got == exp
+
+
+def test_sql_result_stable_under_concurrency_sysvar():
+    s = Session(Domain())
+    s.execute("create table pc (a bigint, b bigint)")
+    s.execute("insert into pc values " +
+              ",".join(f"({i}, {i % 11})" for i in range(5000)))
+    q = ("select /*+ HASH_JOIN(r) */ l.a, r.b from pc l join pc r "
+         "on l.b = r.b where l.a < 50 and r.a < 50 order by l.a, r.b, r.a")
+    base = s.must_query(q)
+    s.execute("set tidb_executor_concurrency = 8")
+    import os
+    from unittest import mock
+    with mock.patch.object(os, "cpu_count", return_value=8):
+        got = s.must_query(q)
+    assert got == base

@@ -202,6 +202,43 @@ def _slice_stream(chunk: ResultChunk):
                           [c.slice(lo, hi) for c in chunk.columns])
 
 
+def _parallel_map_chunks(ctx, source, fn):
+    """Ordered parallel map over streamed chunks — the worker-pool seam of
+    the reference's ProjectionExec (projection.go:205 parallelExecute) and
+    hash-join probe workers (P10).  numpy kernels release the GIL, so the
+    vectorized per-chunk work scales across threads; output order is
+    preserved and at most 2x concurrency chunks are in flight (bounded
+    memory).  fn returning None drops the chunk."""
+    import concurrent.futures as cf
+    import os
+    from collections import deque
+    try:
+        n = int((ctx.sysvars or {}).get("tidb_executor_concurrency", 5))
+    except (TypeError, ValueError):
+        n = 5
+    # threads beyond physical cores only add pool overhead (the GIL-free
+    # portion is the numpy kernels); a 1-core host runs the direct path
+    n = min(n, os.cpu_count() or 1)
+    if n <= 1:
+        for ch in source:
+            out = fn(ch)
+            if out is not None:
+                yield out
+        return
+    with cf.ThreadPoolExecutor(max_workers=n) as ex:
+        pending: deque = deque()
+        for ch in source:
+            pending.append(ex.submit(fn, ch))
+            if len(pending) >= 2 * n:
+                out = pending.popleft().result()
+                if out is not None:
+                    yield out
+        while pending:
+            out = pending.popleft().result()
+            if out is not None:
+                yield out
+
+
 class PhysOp:
     """Host operator. Implement EITHER `execute` (materializing) OR
     `chunks` (streaming); the base class derives the other.  `chunks` is
@@ -555,11 +592,13 @@ class HostSelection(PhysOp):
         self.out_dtypes = self.child.out_dtypes
 
     def chunks(self, ctx, required_rows=None):
-        for chunk in self.child.chunks(ctx):
+        def filt(chunk):
             idx = np.nonzero(_conds_mask(chunk, self.conditions))[0]
             if len(idx) or chunk.num_rows == 0:
-                yield ResultChunk(chunk.names,
-                                  [c.take(idx) for c in chunk.columns])
+                return ResultChunk(chunk.names,
+                                   [c.take(idx) for c in chunk.columns])
+            return None
+        yield from _parallel_map_chunks(ctx, self.child.chunks(ctx), filt)
 
 
 @dataclass
@@ -573,9 +612,11 @@ class HostProjection(PhysOp):
         self.out_dtypes = [e.dtype for e in self.exprs]
 
     def chunks(self, ctx, required_rows=None):
-        for chunk in self.child.chunks(ctx, required_rows):
+        def project(chunk):
             cols = [_eval_to_column(e, chunk) for e in self.exprs]
-            yield ResultChunk(list(self.out_names), cols)
+            return ResultChunk(list(self.out_names), cols)
+        yield from _parallel_map_chunks(
+            ctx, self.child.chunks(ctx, required_rows), project)
 
 
 @dataclass
@@ -902,7 +943,7 @@ class HostHashJoin(PhysOp):
             if self.kind == "right":
                 yield from self._stream_right(ctx, rc, na)
                 return
-            for lch in self.left.chunks(ctx):
+            def probe(lch):
                 if na:
                     lch = self._na_filter(lch)
                 cb = lch.nbytes()
@@ -911,8 +952,9 @@ class HostHashJoin(PhysOp):
                     out = self._join(lch, rc)
                 finally:
                     ctx.release(cb)
-                if out.num_rows or lch.num_rows == 0:
-                    yield out
+                return out if (out.num_rows or lch.num_rows == 0) else None
+            yield from _parallel_map_chunks(ctx, self.left.chunks(ctx),
+                                            probe)
         finally:
             ctx.release(rbytes)
 

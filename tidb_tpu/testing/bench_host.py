@@ -1,0 +1,73 @@
+"""Micro-bench: parallel host operators vs serial (P10 worker-pool seam,
+projection.go:205 / hash-join probe workers analog).  Run on a multi-core
+host: `python -m tidb_tpu.testing.bench_host`.  On a 1-core container the
+pool clamps to the direct path and this prints ~1.0x parity."""
+import time
+
+import numpy as np
+
+from tidb_tpu.chunk.column import Column
+from tidb_tpu.executor.physical import (ExecContext, HostProjection,
+                                        HostHashJoin, PhysOp, ResultChunk)
+from tidb_tpu.expr import ColumnRef, builders as B
+from tidb_tpu.types import dtypes as dt
+
+BI = dt.bigint(False)
+N, CH = 6_000_000, 64 * 1024
+rng = np.random.default_rng(0)
+data = rng.integers(0, 1 << 40, N)
+d2 = rng.integers(1, 1 << 20, N)
+
+class Src(PhysOp):
+    out_names = ["a", "b"]
+    out_dtypes = [BI, BI]
+    def chunks(self, ctx, required_rows=None):
+        for lo in range(0, N, CH):
+            yield ResultChunk(["a", "b"], [
+                Column(BI, data[lo:lo+CH], np.ones(min(CH, N-lo), bool)),
+                Column(BI, d2[lo:lo+CH], np.ones(min(CH, N-lo), bool))])
+
+a, b = ColumnRef(BI, 0, "a"), ColumnRef(BI, 1, "b")
+# expensive-ish projection: mixed arithmetic chains
+exprs = [B.arith("mul", B.arith("add", a, b), B.arith("mod", a, b)),
+         B.arith("mod", B.arith("mul", a, a), B.arith("add", b, B.lit(7))),
+         B.arith("add", B.arith("intdiv", a, b), B.arith("mul", b, b))]
+proj = HostProjection(Src(), exprs, out_names=["x", "y", "z"])
+
+def run(conc):
+    ctx = ExecContext(None, {"tidb_executor_concurrency": conc})
+    t = time.time()
+    rows = sum(ch.num_rows for ch in proj.chunks(ctx))
+    return time.time() - t, rows
+
+run(1)
+t1, r1 = run(1)
+t8, r8 = run(8)
+print(f"projection: serial {t1*1e3:.0f}ms  8-way {t8*1e3:.0f}ms  "
+      f"speedup {t1/t8:.2f}x  rows={r1}")
+assert r1 == r8 == N
+
+# hash join probe: 6M probe rows vs 100k build
+build_n = 100_000
+bk = rng.integers(0, 1 << 20, build_n)
+class BuildSrc(PhysOp):
+    out_names = ["k", "w"]
+    out_dtypes = [BI, BI]
+    def execute(self, ctx):
+        return ResultChunk(["k", "w"], [
+            Column(BI, bk, np.ones(build_n, bool)),
+            Column(BI, bk * 2, np.ones(build_n, bool))])
+join = HostHashJoin("inner", Src(), BuildSrc(), [(1, 0)], [],
+                    out_names=["a", "b", "k", "w"],
+                    out_dtypes=[BI, BI, BI, BI])
+def runj(conc):
+    ctx = ExecContext(None, {"tidb_executor_concurrency": conc})
+    t = time.time()
+    rows = sum(ch.num_rows for ch in join.chunks(ctx))
+    return time.time() - t, rows
+runj(1)
+tj1, rj1 = runj(1)
+tj8, rj8 = runj(8)
+print(f"hash join:  serial {tj1*1e3:.0f}ms  8-way {tj8*1e3:.0f}ms  "
+      f"speedup {tj1/tj8:.2f}x  rows={rj1}")
+assert rj1 == rj8

@@ -9,8 +9,11 @@ cancel (the "Out Of Memory Quota!" error).
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import Optional
+
+_TRACK_MU = threading.RLock()
 
 
 class MemoryExceededError(RuntimeError):
@@ -35,13 +38,16 @@ class Tracker:
         return Tracker(label, parent=self)
 
     def consume(self, n: int):
-        t = self
-        while t is not None:
-            t.consumed += n
-            t.max_consumed = max(t.max_consumed, t.consumed)
-            if 0 <= t.limit < t.consumed and n > 0:
-                t._on_exceed()
-            t = t.parent
+        # parallel host operators charge from worker threads (P10): the
+        # shared counter update takes the module lock
+        with _TRACK_MU:
+            t = self
+            while t is not None:
+                t.consumed += n
+                t.max_consumed = max(t.max_consumed, t.consumed)
+                if 0 <= t.limit < t.consumed and n > 0:
+                    t._on_exceed()
+                t = t.parent
 
     def release(self, n: int):
         self.consume(-n)
