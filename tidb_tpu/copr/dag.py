@@ -176,6 +176,11 @@ class LookupJoin(CopNode):
     unique: bool = True
     out_capacity: int = 0          # unique=False only
     null_aware: bool = False       # anti only: NOT IN semantics
+    # which aux GROUP carries this join's build side: a fused program may
+    # chain several broadcast joins (the fragment tree cut at broadcast
+    # exchanges, physicalop/fragment.go analog) — each join level reads
+    # its own (sorted keys, perm, build columns) group
+    aux_slot: int = 0
 
     def children(self):
         return (self.child,)

@@ -376,12 +376,14 @@ def _exec_node(node: D.CopNode, scan_cols: Sequence, row_count, ev: Evaluator,
 
 def _exec_lookup_join(node: D.LookupJoin, batch: DeviceBatch, ev: Evaluator,
                       aux) -> DeviceBatch:
-    """Sorted-lookup join (see dag.LookupJoin).  aux layout:
-    aux[0]=(sorted build keys,), aux[1]=(perm,), aux[2:]=build columns."""
+    """Sorted-lookup join (see dag.LookupJoin).  aux is a tuple of GROUPS,
+    one per chained join level; group layout: [0]=(sorted build keys,),
+    [1]=(perm,), [2:]=build columns."""
     n = len(batch.cols[0][0])
-    sorted_keys = aux[0][0]
-    perm = aux[1][0]
-    build_cols = aux[2:]
+    grp = aux[node.aux_slot]
+    sorted_keys = grp[0][0]
+    perm = grp[1][0]
+    build_cols = grp[2:]
     kv, km = ev.eval(node.probe_key, batch.cols, {})
     kv = _ensure_array(kv, n).astype(jnp.int64)
 
@@ -494,7 +496,9 @@ class CopProgram:
         # hence static structure); inside the trace it becomes the literal
         # True the Evaluator's fast paths key on.
         scan_cols = [(v, True if m is None else m) for v, m in scan_cols]
-        aux_cols = tuple((v, True if m is None else m) for v, m in aux_cols)
+        aux_cols = tuple(
+            tuple((v, True if m is None else m) for v, m in grp)
+            for grp in aux_cols)
         ev = Evaluator(jnp)
         if self.agg is not None:
             batch = _exec_node(self.agg.child, scan_cols, row_count, ev,

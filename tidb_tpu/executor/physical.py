@@ -379,16 +379,55 @@ class CopJoinTaskExec(PhysOp):
     out_dicts: dict = field(default_factory=dict)
     fallback: PhysOp = None
     children: list = field(default_factory=list)
+    # fragment-tree mode (physicalop/fragment.go analog): a CHAIN of
+    # broadcast joins fused into one program.  Each entry is a dict
+    # {exec, key_index, key_dict, probe_key_dtype}; entry i feeds aux
+    # group i (LookupJoin.aux_slot).  None = legacy single-join fields.
+    builds: list = None
 
     def __post_init__(self):
-        self.children = [self.build_exec]
+        self.children = ([b["exec"] for b in self.builds] if self.builds
+                         else [self.build_exec])
 
     def describe(self):
         kind = "agg" if isinstance(self.dag, D.Aggregation) else "rows"
+        lvl = f" x{len(self.builds)} levels" if self.builds else ""
         return (f"CopJoinTask[{kind},{self.join_kind}] probe={self.table.name}"
-                f" broadcast-build -> TPU")
+                f" broadcast-build{lvl} -> TPU")
 
     def execute(self, ctx: ExecContext) -> ResultChunk:
+        if self.builds:
+            return self._execute_tree(ctx)
+        return self._execute_single(ctx)
+
+    def _execute_tree(self, ctx: ExecContext) -> ResultChunk:
+        """Chained broadcast joins: every level's build must be non-empty
+        with unique keys (the planner only emits inner/left levels); any
+        runtime anomaly falls back to the host plan whole."""
+        import jax.numpy as jnp
+        groups = []
+        for b in self.builds:
+            bchunk = b["exec"].execute(ctx)
+            kcol = bchunk.columns[b["key_index"]]
+            keys, ok = self._keys_for(kcol, b["key_dict"],
+                                      b["probe_key_dtype"])
+            rows_idx = np.nonzero(ok)[0]
+            keys = keys[rows_idx]
+            if len(keys) == 0 or len(np.unique(keys)) != len(keys):
+                return self.fallback.execute(ctx)
+            order = np.argsort(keys, kind="stable")
+            grp = [(jnp.asarray(keys[order]), None),
+                   (jnp.asarray(np.arange(len(keys),
+                                          dtype=np.int64)[order]), None)]
+            for c in bchunk.columns:
+                data = c.data[rows_idx]
+                valid = c.validity[rows_idx]
+                grp.append((jnp.asarray(data),
+                            None if valid.all() else jnp.asarray(valid)))
+            groups.append(tuple(grp))
+        return self._run(ctx, self.dag, tuple(groups))
+
+    def _execute_single(self, ctx: ExecContext) -> ResultChunk:
         import jax.numpy as jnp
         bchunk = self.build_exec.execute(ctx)
         kcol = bchunk.columns[self.build_key_index]
@@ -434,7 +473,7 @@ class CopJoinTaskExec(PhysOp):
                 valid = c.validity[rows_idx]
                 aux.append((jnp.asarray(data),
                             None if valid.all() else jnp.asarray(valid)))
-        chunk = self._run(ctx, dag, tuple(aux))
+        chunk = self._run(ctx, dag, (tuple(aux),))   # one aux group
         # build-side output columns keep their own dictionaries
         if not isinstance(self.dag, D.Aggregation):
             for j, c in enumerate(chunk.columns):
@@ -461,22 +500,27 @@ class CopJoinTaskExec(PhysOp):
         return ResultChunk(list(self.out_names), cols)
 
     def _build_keys(self, kcol: Column) -> tuple[np.ndarray, np.ndarray]:
+        return self._keys_for(kcol, self.build_key_dict,
+                              self.probe_key_dtype)
+
+    def _keys_for(self, kcol: Column, key_dict,
+                  probe_key_dtype) -> tuple[np.ndarray, np.ndarray]:
         """Build-side key column -> (int64 keys comparable with the probe
         key expr, validity)."""
         ok = kcol.validity.copy()
         if kcol.dtype.is_string:
             # remap build codes into the probe dictionary's code space
-            if self.build_key_dict is None or kcol.dictionary is None:
+            if key_dict is None or kcol.dictionary is None:
                 return kcol.data.astype(np.int64), ok
             mapping = np.fromiter(
-                (self.build_key_dict.code_of(v) for v in kcol.dictionary.values),
+                (key_dict.code_of(v) for v in kcol.dictionary.values),
                 np.int64, count=len(kcol.dictionary)) \
                 if len(kcol.dictionary) else np.zeros(1, np.int64)
             keys = mapping[np.clip(kcol.data, 0, len(mapping) - 1)]
             ok = ok & (keys >= 0)          # absent from probe dict: no match
             return keys, ok
         keys = kcol.data.astype(np.int64)
-        pt = self.probe_key_dtype
+        pt = probe_key_dtype
         if pt is not None and (kcol.dtype.kind == K.DECIMAL
                                or pt.kind == K.DECIMAL):
             sb = kcol.dtype.scale if kcol.dtype.kind == K.DECIMAL else 0

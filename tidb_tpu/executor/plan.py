@@ -517,18 +517,25 @@ def _try_cop_join(p: LogicalPlan, top, mids, join: LogicalJoin) -> Optional[Phys
             # join compares through merged collation ranks
             return None
 
-    # build side must be a chain over a DataSource; small enough to
-    # broadcast, else the cross-device repartition join takes it
-    bcur = join.right
-    while isinstance(bcur, (LogicalSelection, LogicalProjection)):
-        bcur = bcur.child
-    if not isinstance(bcur, DataSource):
+    # build side: any Selection/Projection/Join subtree over DataSources
+    # whose base rows fit the broadcast budget — a join-shaped build is a
+    # host-materialized FRAGMENT (fragment.go cut: the build subtree's
+    # root is a Broadcast exchange).  Oversized single-table builds take
+    # the cross-device repartition join instead.
+    if not _broadcastable(join.right):
+        bcur = join.right
+        while isinstance(bcur, (LogicalSelection, LogicalProjection)):
+            bcur = bcur.child
+        if isinstance(bcur, DataSource):
+            return _try_shuffle_join(p, top, mids, join)
         return None
-    if bcur.table.num_rows > BROADCAST_BUILD_MAX_ROWS:
-        return _try_shuffle_join(p, top, mids, join)
 
-    # probe = left subtree: Selection/Projection chain over a DataSource
-    lchain = _bind_scan_chain(join.left)
+    # probe = left subtree: Selection/Projection chain over a DataSource,
+    # OR a nested broadcast-joinable join tree (the fragment chain —
+    # physicalop/fragment.go cut at broadcast exchanges; each nested
+    # level's build lands in its own aux group)
+    builds: list = []
+    lchain = _bind_probe_side(join.left, builds)
     if lchain is None:
         return None
     node, cur_dicts, ds = lchain
@@ -537,16 +544,23 @@ def _try_cop_join(p: LogicalPlan, top, mids, join: LogicalJoin) -> Optional[Phys
     # build side: its own (recursive) physical plan, host-materialized
     build_exec = to_physical(join.right)
     bsch = join.right.schema
-    build_out_dicts = _chain_output_dicts(join.right)
+    build_out_dicts = _subtree_output_dicts(join.right)
 
     probe_key = lower_strings(join.left.schema.ref(li), cur_dicts)
+    if not _device_supported(probe_key):
+        return None
     key_dict = cur_dicts.get(li) if probe_key.dtype.is_string else None
     semi = join.kind in ("semi", "anti")
+    if builds and semi:
+        # nested chains skip the runtime null-aware/empty-build special
+        # cases semi/anti depend on — keep those single-level
+        return None
+    top_slot = len(builds)
     jnode = D.LookupJoin(node, probe_key=probe_key, kind=join.kind,
                          build_dtypes=() if semi else tuple(
                              c.dtype.with_nullable(True) if join.kind == "left"
                              else c.dtype for c in bsch.cols),
-                         null_aware=join.null_aware)
+                         null_aware=join.null_aware, aux_slot=top_slot)
 
     # post-join conds/projections + optional top over the output schema
     # (probe ++ build; probe only for semi/anti)
@@ -560,18 +574,117 @@ def _try_cop_join(p: LogicalPlan, top, mids, join: LogicalJoin) -> Optional[Phys
     nodew, out_names, out_dtypes, out_dicts, key_meta, host_top = bound
 
     fallback = to_physical(p, no_device_join=True)
-    exec_ = CopJoinTaskExec(
-        nodew, ds.table, build_exec=build_exec, build_key_index=ri,
-        build_key_dict=key_dict, probe_key_dtype=probe_key.dtype,
-        join_kind=join.kind, null_aware=join.null_aware, n_probe=n_probe,
-        out_names=out_names, out_dtypes=out_dtypes, key_meta=key_meta,
-        out_dicts=out_dicts, fallback=fallback)
+    if builds:
+        # fragment chain: nested builds + this join's own build, in aux
+        # slot order; runtime anomalies fall back to the host plan whole
+        builds.append({"exec": build_exec, "key_index": ri,
+                       "key_dict": key_dict,
+                       "probe_key_dtype": probe_key.dtype})
+        exec_ = CopJoinTaskExec(
+            nodew, ds.table, join_kind=join.kind, n_probe=n_probe,
+            out_names=out_names, out_dtypes=out_dtypes, key_meta=key_meta,
+            out_dicts=out_dicts, fallback=fallback, builds=builds)
+    else:
+        exec_ = CopJoinTaskExec(
+            nodew, ds.table, build_exec=build_exec, build_key_index=ri,
+            build_key_dict=key_dict, probe_key_dtype=probe_key.dtype,
+            join_kind=join.kind, null_aware=join.null_aware, n_probe=n_probe,
+            out_names=out_names, out_dtypes=out_dtypes, key_meta=key_meta,
+            out_dicts=out_dicts, fallback=fallback)
     if host_top is not None and host_top[0] == "topn":
         return HostTopN(exec_, list(host_top[1].keys), host_top[1].limit,
                         host_top[1].offset)
     if host_top is not None:
         return HostLimit(exec_, host_top[1].limit, host_top[1].offset)
     return exec_
+
+
+def _bind_probe_side(plan: LogicalPlan, builds: list):
+    """Bind a probe subtree: Selection/Projection chain over a DataSource
+    OR over a nested broadcast-joinable join (fragment chain).  Nested
+    builds append to `builds` in aux-slot order.  Returns
+    (node, output_dicts, base_datasource) or None."""
+    mids: list = []
+    cur = plan
+    while isinstance(cur, (LogicalSelection, LogicalProjection)):
+        mids.append(cur)
+        cur = cur.child
+    if isinstance(cur, LogicalJoin):
+        if _join_method_hint(cur):
+            return None
+        sub = _bind_join_tree(cur, builds)
+        if sub is None:
+            return None
+        node, cur_dicts, ds = sub
+    else:
+        sc = _bind_scan_chain(cur)
+        if sc is None:
+            return None
+        node, cur_dicts, ds = sc
+    for m in reversed(mids):
+        if isinstance(m, LogicalSelection):
+            conds = tuple(lower_strings(c, cur_dicts) for c in m.conditions)
+            if not all(_device_supported(c) for c in conds):
+                return None
+            node = D.Selection(node, conds)
+        else:
+            exprs = tuple(lower_strings(e, cur_dicts) for e in m.exprs)
+            if not all(_device_supported(e) for e in exprs):
+                return None
+            node = D.Projection(node, exprs)
+            cur_dicts = {j: d for j, e in enumerate(exprs)
+                         if (d := expr_out_dict(e, cur_dicts)) is not None}
+    return node, cur_dicts, ds
+
+
+def _bind_join_tree(join: LogicalJoin, builds: list):
+    """Bind one NESTED join level of a broadcast fragment chain
+    (inner/left, single equality key, unique-keyed small build — runtime
+    anomalies make the whole chain fall back to host).  Appends this
+    level's build spec and returns (node, joined_dicts, ds) or None."""
+    from ..utils.collate import is_binary
+    if join.kind not in ("inner", "left") or len(join.eq_keys) != 1:
+        return None
+    li, ri = join.eq_keys[0]
+    for side, k in ((join.left, li), (join.right, ri)):
+        kt = side.schema.cols[k].dtype
+        if kt.is_string and not is_binary(kt.collation):
+            return None
+    if not _broadcastable(join.right):
+        return None
+    probe = _bind_probe_side(join.left, builds)
+    if probe is None:
+        return None
+    node, cur_dicts, ds = probe
+    n_probe = len(join.left.schema)
+    probe_key = lower_strings(join.left.schema.ref(li), cur_dicts)
+    if not _device_supported(probe_key):
+        return None
+    key_dict = cur_dicts.get(li) if probe_key.dtype.is_string else None
+    bsch = join.right.schema
+    slot = len(builds)
+    jnode = D.LookupJoin(node, probe_key=probe_key, kind=join.kind,
+                         build_dtypes=tuple(
+                             c.dtype.with_nullable(True)
+                             if join.kind == "left" else c.dtype
+                             for c in bsch.cols),
+                         aux_slot=slot)
+    builds.append({"exec": to_physical(join.right), "key_index": ri,
+                   "key_dict": key_dict,
+                   "probe_key_dtype": probe_key.dtype})
+    all_dicts = dict(cur_dicts)
+    for j, d in (_subtree_output_dicts(join.right) or {}).items():
+        all_dicts[n_probe + j] = d
+    out_node: D.CopNode = jnode
+    if join.other_conds:
+        if join.kind != "inner":
+            return None
+        conds = tuple(lower_strings(c, all_dicts)
+                      for c in join.other_conds)
+        if not all(_device_supported(c) for c in conds):
+            return None
+        out_node = D.Selection(out_node, conds)
+    return out_node, all_dicts, ds
 
 
 def _bind_post_join(top, mids, join: LogicalJoin, start: D.CopNode,
@@ -765,6 +878,64 @@ def _try_shuffle_join(p: LogicalPlan, top, mids,
     if host_top is not None:
         return HostLimit(exec_, host_top[1].limit, host_top[1].offset)
     return exec_
+
+
+def _broadcastable(plan: LogicalPlan) -> bool:
+    """True when the subtree is Selection/Projection/Join over DataSources
+    whose TOTAL base rows fit the broadcast budget (upper bound on a
+    unique-key join chain's output; m:n blowups are caught at runtime by
+    the non-unique build-key check)."""
+    total = 0
+    stack = [plan]
+    while stack:
+        cur = stack.pop()
+        if isinstance(cur, DataSource):
+            if getattr(cur.table, "is_memtable", False):
+                return False
+            total += cur.table.num_rows
+            if total > BROADCAST_BUILD_MAX_ROWS:
+                return False
+        elif isinstance(cur, (LogicalSelection, LogicalProjection,
+                              LogicalJoin)):
+            stack.extend(cur.children)
+        else:
+            return False
+    return True
+
+
+def _subtree_output_dicts(plan: LogicalPlan) -> dict:
+    """Output-position -> StringDict through Selection/Projection/Join
+    subtrees (generalizes _chain_output_dicts: a join concatenates left
+    dicts with right dicts shifted by the left width).  Only ColumnRef
+    projections pass a dictionary through — computed strings get fresh
+    runtime dicts the device constants were not lowered against."""
+    if isinstance(plan, DataSource):
+        if getattr(plan.table, "is_memtable", False):
+            return {}
+        snap = plan.table.snapshot()
+        return {i: c.dictionary
+                for i, c in ((i, snap.columns[off])
+                             for i, off in enumerate(plan.col_offsets))
+                if c.dictionary is not None}
+    if isinstance(plan, LogicalSelection):
+        return _subtree_output_dicts(plan.child)
+    if isinstance(plan, LogicalProjection):
+        child = _subtree_output_dicts(plan.child)
+        out = {}
+        for j, e in enumerate(plan.exprs):
+            if isinstance(e, ColumnRef) and e.index in child:
+                out[j] = child[e.index]
+        return out
+    if isinstance(plan, LogicalJoin):
+        if plan.kind in ("semi", "anti"):
+            return _subtree_output_dicts(plan.children[0])
+        left = _subtree_output_dicts(plan.children[0])
+        right = _subtree_output_dicts(plan.children[1])
+        n_left = len(plan.children[0].schema)
+        out = dict(left)
+        out.update({n_left + j: d for j, d in right.items()})
+        return out
+    return {}
 
 
 def _chain_output_dicts(plan: LogicalPlan) -> dict:

@@ -115,7 +115,8 @@ class ShardedShuffleJoinProgram:
         from ..copr.exec import set_trace_platform
         set_trace_platform(self.mesh.devices.reshape(-1)[0].platform)
         ev = Evaluator(jnp)
-        aux = tuple((v, True if m is None else m) for v, m in aux)
+        aux = tuple(tuple((v, True if m is None else m) for v, m in grp)
+                    for grp in aux)
         spec, caps = self.spec, self.caps
         semi = spec.kind in ("semi", "anti")
 

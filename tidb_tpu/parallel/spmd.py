@@ -128,7 +128,8 @@ class ShardedCopProgram:
         cols = [(v, m) for v, m in cols]
         flat, base_sel = _flatten_block(cols, counts)
         flat = [(v, True if m is None else m) for v, m in flat]
-        aux = tuple((v, True if m is None else m) for v, m in aux)
+        aux = tuple(tuple((v, True if m is None else m) for v, m in grp)
+                    for grp in aux)
         ev = Evaluator(jnp)
         if self.agg is not None:
             batch = _exec_node(self.agg.child, flat, base_sel, ev, aux)

@@ -187,15 +187,24 @@ def _reorder_group(root: LogicalJoin, stats_handle) -> LogicalPlan:
     cur_rows = rows[order[0]]
     remaining = set(range(len(leaves))) - set(order)
     while remaining:
+        # connected candidates (an eq edge to the placed set) strictly
+        # before cross products — a cheap cross of two filtered tiny
+        # tables must not beat joining along the graph (the reference's
+        # greedy walks join edges; cartesian only when disconnected)
         best_i, best_est = None, None
+        best_cross_i, best_cross_est = None, None
         for i in sorted(remaining):
             ndv = eq_edge(set(order), i)
             if ndv is not None:
                 est = cur_rows * rows[i] / max(ndv, 1.0)
+                if best_est is None or est < best_est:
+                    best_i, best_est = i, est
             else:
-                est = cur_rows * rows[i]          # cross join: last resort
-            if best_est is None or est < best_est:
-                best_i, best_est = i, est
+                est = cur_rows * rows[i]
+                if best_cross_est is None or est < best_cross_est:
+                    best_cross_i, best_cross_est = i, est
+        if best_i is None:            # disconnected: cross join
+            best_i, best_est = best_cross_i, best_cross_est
         order.append(best_i)
         remaining.discard(best_i)
         cur_rows = max(best_est, 1.0)

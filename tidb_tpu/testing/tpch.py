@@ -124,4 +124,21 @@ def gen_part(sf: float = 1.0, seed: int = 1) -> tuple[list[str], list[Column]]:
     return PART_NAMES, cols
 
 
-__all__ = ["gen_lineitem", "gen_part", "LINEITEM_NAMES", "PART_NAMES", "DEC2"]
+ORDERS_MINI_NAMES = ["o_orderkey", "o_custkey", "o_totalprice"]
+
+
+def gen_orders_mini(n: int = 1024, seed: int = 7) -> tuple[list[str], list[Column]]:
+    """Small orders table keyed to lineitem's l_orderkey domain — enough
+    for multi-join fragment validation (dryrun/Q3 shape)."""
+    rng = np.random.default_rng(seed)
+    okey = np.arange(1, n + 1)
+    cols = [
+        Column.from_numpy(dt.bigint(False), okey),
+        Column.from_numpy(dt.bigint(False), rng.integers(1, n // 4 + 2, n)),
+        Column.from_numpy(DEC2, rng.integers(1000, 500000, n)),
+    ]
+    return ORDERS_MINI_NAMES, cols
+
+
+__all__ = ["gen_lineitem", "gen_part", "gen_orders_mini", "LINEITEM_NAMES",
+           "PART_NAMES", "DEC2"]
