@@ -1,0 +1,72 @@
+"""Stale read: SELECT ... FROM t AS OF TIMESTAMP ... (VERDICT r2 missing
+#11; reference: sessiontxn/staleread/processor.go — historical MVCC
+snapshot reads).  Int literals are raw logical ts; datetime strings map
+through the store's wallclock->ts samples."""
+
+import datetime
+import time
+
+import pytest
+
+from tidb_tpu.session import Domain, Session
+from tidb_tpu.planner.build import PlanError
+
+
+@pytest.fixture()
+def s():
+    s = Session(Domain())
+    s.execute("create table t (id bigint, v bigint)")
+    s.execute("insert into t values (1, 10), (2, 20)")
+    return s
+
+
+def test_as_of_logical_ts(s):
+    tbl = s.domain.catalog.get_table("test", "t")
+    ts0 = tbl.kv.alloc_ts()
+    s.execute("insert into t values (3, 30)")
+    s.execute("update t set v = 99 where id = 1")
+    assert sorted(s.must_query("select id, v from t")) == \
+        [(1, 99), (2, 20), (3, 30)]
+    assert sorted(s.must_query(
+        f"select id, v from t as of timestamp {ts0}")) == \
+        [(1, 10), (2, 20)]
+    # aggregates + filters ride the same historical snapshot
+    assert s.must_query(
+        f"select count(*), sum(v) from t as of timestamp {ts0}") == \
+        [(2, 30)]
+    assert s.must_query(
+        f"select v from t as of timestamp {ts0} where id = 1") == [(10,)]
+
+
+def test_as_of_wallclock(s):
+    tbl = s.domain.catalog.get_table("test", "t")
+    tbl.kv.alloc_ts()                     # ensure a sample at 'now'
+    time.sleep(0.12)
+    stamp = datetime.datetime.now().isoformat()
+    time.sleep(0.12)
+    s.execute("delete from t where id = 2")
+    assert s.must_query("select count(*) from t") == [(1,)]
+    got = s.must_query(
+        f"select count(*) from t as of timestamp '{stamp}'")
+    assert got == [(2,)]
+
+
+def test_as_of_with_alias_and_strings(s):
+    s.execute("create table st (id bigint, name varchar(10))")
+    s.execute("insert into st values (1, 'old')")
+    ts0 = s.domain.catalog.get_table("test", "st").kv.alloc_ts()
+    s.execute("update st set id = 2 where id = 1")
+    s.execute("insert into st values (3, 'new')")
+    assert s.must_query(
+        f"select x.id, x.name from st as of timestamp {ts0} x "
+        "where x.name = 'old'") == [(1, "old")]
+    # historical dictionary: 'new' does not exist at ts0
+    assert s.must_query(
+        f"select count(*) from st as of timestamp {ts0} "
+        "where name = 'new'") == [(0,)]
+
+
+def test_as_of_before_store_rejected(s):
+    with pytest.raises(PlanError):
+        s.must_query(
+            "select * from t as of timestamp '1999-01-01 00:00:00'")

@@ -289,6 +289,11 @@ class CopTaskExec(PhysOp):
     # pruned partition ids (None = all / table not partitioned) —
     # rule_partition_processor.go output carried on the reader
     partitions: Any = None
+    # stale read: historical MVCC ts (sessiontxn/staleread); the planner
+    # pins the snapshot it bound dictionaries against so execute doesn't
+    # pay a second full historical scan
+    as_of_ts: Any = None
+    as_of_snap: Any = None
 
     def describe(self):
         kind = "agg" if isinstance(self.dag, D.Aggregation) else "rows"
@@ -303,7 +308,12 @@ class CopTaskExec(PhysOp):
         return f"CopTask[{kind}] table={self.table.name}{part} -> TPU{cached}"
 
     def execute(self, ctx: ExecContext) -> ResultChunk:
-        if getattr(self.table, "partition", None) is not None:
+        if self.as_of_ts is not None:
+            snap = self.as_of_snap
+            if snap is None:
+                snap = self.as_of_snap = \
+                    self.table.snapshot_at(self.as_of_ts)
+        elif getattr(self.table, "partition", None) is not None:
             snap = self.table.partition_snapshot(self.partitions)
         else:
             snap = self.table.snapshot()

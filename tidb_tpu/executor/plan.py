@@ -147,6 +147,10 @@ def to_physical(p: LogicalPlan, no_device_join: bool = False) -> PhysOp:
                                 out_names=p.schema.names(),
                                 out_dtypes=[c.dtype for c in p.schema.cols])
         if HOST_ONLY.get():
+            if getattr(p, "as_of_ts", None) is not None:
+                from ..planner.build import PlanError
+                raise PlanError("AS OF TIMESTAMP is not supported inside "
+                                "correlated subqueries")
             from .physical import HostTableScanExec
             return HostTableScanExec(p.table, list(p.col_offsets),
                                      out_names=p.schema.names(),
@@ -200,7 +204,11 @@ def _try_cop(p: LogicalPlan, no_device_join: bool = False) -> Optional[PhysOp]:
             from ..planner.partition_prune import prune_partitions
             pruned = prune_partitions(spec, scan_ix, conds)
 
-    snap = ds.table.snapshot()
+    # stale reads bind against the HISTORICAL snapshot: its string
+    # dictionaries (and data) differ from the current epoch's
+    as_of = getattr(ds, "as_of_ts", None)
+    snap = (ds.table.snapshot_at(as_of) if as_of is not None
+            else ds.table.snapshot())
     dicts = {}
     for i, off in enumerate(ds.col_offsets):
         c = snap.columns[off]
@@ -252,7 +260,9 @@ def _try_cop(p: LogicalPlan, no_device_join: bool = False) -> Optional[PhysOp]:
             child_exec = CopTaskExec(node, ds.table, out_names=out_names,
                                      out_dtypes=out_dtypes,
                                      out_dicts=out_dicts,
-                                     partitions=pruned)
+                                     partitions=pruned, as_of_ts=as_of,
+                                     as_of_snap=snap if as_of is not None
+                                     else None)
             return HostAgg(child_exec, list(top.group_exprs), list(top.aggs),
                            out_names=top.schema.names(),
                            out_dtypes=[c.dtype for c in top.schema.cols])
@@ -287,17 +297,20 @@ def _try_cop(p: LogicalPlan, no_device_join: bool = False) -> Optional[PhysOp]:
                       sort_keys=tuple(keys) if len(keys) > 1 else ())
         exec_ = CopTaskExec(node, ds.table, out_names=out_names,
                             out_dtypes=out_dtypes, out_dicts=out_dicts,
-                            partitions=pruned)
+                            partitions=pruned, as_of_ts=as_of,
+                            as_of_snap=snap if as_of is not None else None)
         # root merge of per-device tops
         return HostTopN(exec_, list(top.keys), top.limit, top.offset)
     elif isinstance(top, LogicalLimit):
         node = D.Limit(node, limit=top.limit + top.offset)
         exec_ = CopTaskExec(node, ds.table, out_names=out_names,
                             out_dtypes=out_dtypes, out_dicts=out_dicts,
-                            partitions=pruned)
+                            partitions=pruned, as_of_ts=as_of,
+                            as_of_snap=snap if as_of is not None else None)
         return HostLimit(exec_, top.limit, top.offset)
 
-    return CopTaskExec(node, ds.table, partitions=pruned,
+    return CopTaskExec(node, ds.table, partitions=pruned, as_of_ts=as_of,
+                       as_of_snap=snap if as_of is not None else None,
                        out_names=out_names,
                        out_dtypes=out_dtypes, key_meta=key_meta,
                        out_dicts=out_dicts)
@@ -573,6 +586,12 @@ def _try_cop_join(p: LogicalPlan, top, mids, join: LogicalJoin) -> Optional[Phys
         return None  # generic path handles host agg over host join
     nodew, out_names, out_dtypes, out_dicts, key_meta, host_top = bound
 
+    if builds and not semi:
+        # chain mode has no runtime dictionary reattachment: every string
+        # build column must carry a plan-time dictionary (review r3)
+        for j, c in enumerate(bsch.cols):
+            if c.dtype.is_string and j not in (build_out_dicts or {}):
+                return None
     fallback = to_physical(p, no_device_join=True)
     if builds:
         # fragment chain: nested builds + this join's own build, in aux
@@ -662,6 +681,13 @@ def _bind_join_tree(join: LogicalJoin, builds: list):
         return None
     key_dict = cur_dicts.get(li) if probe_key.dtype.is_string else None
     bsch = join.right.schema
+    bdicts = _subtree_output_dicts(join.right) or {}
+    for j, c in enumerate(bsch.cols):
+        if c.dtype.is_string and j not in bdicts:
+            # chained joins skip the runtime dictionary reattachment a
+            # single-level join performs: computed-string build columns
+            # (fresh runtime dicts) must take the host path (review r3)
+            return None
     slot = len(builds)
     jnode = D.LookupJoin(node, probe_key=probe_key, kind=join.kind,
                          build_dtypes=tuple(
@@ -772,6 +798,8 @@ def _bind_scan_chain(plan: LogicalPlan):
     ds = cur
     if getattr(ds.table, "is_memtable", False):
         return None     # infoschema memtables never bind a device scan
+    if getattr(ds, "as_of_ts", None) is not None:
+        return None     # stale reads bind only through the plain CopTask
     snap = ds.table.snapshot()
     cur_dicts = {}
     for i, off in enumerate(ds.col_offsets):

@@ -1523,6 +1523,25 @@ def _build_cte_ref(entry: CTEEntry, alias: str, catalog,
 # FROM clause
 # --------------------------------------------------------------------- #
 
+def _resolve_as_of(tbl, as_of) -> int:
+    """AS OF TIMESTAMP literal -> MVCC read ts (staleread processor.go
+    analog): ints are raw logical ts; datetime strings map through the
+    store's wallclock->ts samples."""
+    if getattr(tbl, "kv", None) is None:
+        raise PlanError("AS OF TIMESTAMP needs the KV row store")
+    if isinstance(as_of, int):
+        return as_of
+    import datetime as _dt
+    try:
+        when = _dt.datetime.fromisoformat(str(as_of))
+    except ValueError as e:
+        raise PlanError(f"bad AS OF TIMESTAMP literal {as_of!r}: {e}")
+    try:
+        return tbl.kv.ts_at_time(when.timestamp())
+    except Exception as e:
+        raise PlanError(str(e))
+
+
 import threading as _threading
 
 _view_expansion = _threading.local()
@@ -1574,7 +1593,10 @@ def _build_from(node: A.Node, catalog, default_db: str,
         tbl = catalog.get_table(db, node.name)
         sch = Schema([SchemaCol(n, t, alias)
                       for n, t in zip(tbl.col_names, tbl.col_types)])
-        return DataSource(tbl, alias, sch, list(range(len(tbl.col_names))))
+        ds = DataSource(tbl, alias, sch, list(range(len(tbl.col_names))))
+        if node.as_of is not None:
+            ds.as_of_ts = _resolve_as_of(tbl, node.as_of)
+        return ds
     if isinstance(node, A.SubqueryRef):
         built = build_query(node.select, catalog, default_db, ctes)
         sub = built.plan

@@ -64,6 +64,7 @@ class DataSource(LogicalPlan):
     col_offsets: list[int] = None  # into the table's stored columns
     hint_use: list = None          # USE_INDEX(t, ix...) index names
     hint_ignore: list = None       # IGNORE_INDEX(t, ix...)
+    as_of_ts: object = None        # stale read: resolved MVCC read ts
     # join-method hint naming this table ('' | 'hash' | 'merge' | 'inl');
     # carried on the LEAF so join-reorder rebuilds don't lose it
     hint_join: str = ""

@@ -280,6 +280,8 @@ def apply_index_paths(p: LogicalPlan, stats_handle=None) -> LogicalPlan:
         if getattr(p, "right", None) is c:
             p.right = nc
     if isinstance(p, LogicalSelection) and isinstance(p.child, DataSource):
+        if getattr(p.child, "as_of_ts", None) is not None:
+            return p     # stale reads go through the historical snapshot
         stats = (stats_handle.get(p.child.table)
                  if stats_handle is not None else None)
         acc = choose_index(p.conditions, p.child, stats)

@@ -126,6 +126,11 @@ class TableInfo:
     # scan time (rule_partition_processor.go analog)
     partition: Any = None
     _part_snap_cache: Any = None   # (epoch, ids) -> sub-snapshot
+    # foreign keys THIS table declares (child side): list of
+    # ast.ForeignKeyDef; parent resolution through _fk_resolver
+    # (set by the session at CREATE TABLE — planner/core/foreign_key.go)
+    foreign_keys: list = field(default_factory=list)
+    _fk_resolver: Any = None       # (table_name) -> TableInfo
     # schema gate: writers hold read side per statement; online-DDL state
     # transitions take the write side to drain in-flight writers (the F1
     # schema-lease wait analog, utils/rwlock.py)
@@ -304,8 +309,44 @@ class TableInfo:
             t.put(key, val)
             self._write_index_entries(t, r, h)
 
+    def _fk_check_rows(self, fixed: list) -> None:
+        """Child-side FK validation: every non-NULL FK value must exist in
+        the parent's referenced column (reads the parent's committed
+        snapshot — executor/fktest parent-exists check).  NULL FK values
+        always pass (MySQL semantics)."""
+        if not self.foreign_keys or self._fk_resolver is None or not fixed:
+            return
+        for fk in self.foreign_keys:
+            ci = self.col_names.index(fk.column)
+            vals = [r[ci] for r in fixed if r[ci] is not None]
+            if not vals:
+                continue
+            parent = self._fk_resolver(fk.ref_table)
+            snap = parent.snapshot()
+            pci = parent.col_names.index(fk.ref_column)
+            pcol = snap.columns[pci]
+            have = pcol.data[pcol.validity]
+            if parent is self:
+                # self-referential: rows earlier in this batch also count
+                kci = self.col_names.index(fk.ref_column)
+                batch_keys = np.array(
+                    [r[kci] for r in fixed if r[kci] is not None],
+                    dtype=np.int64) if any(
+                        r[kci] is not None for r in fixed) else \
+                    np.empty(0, np.int64)
+                have = np.concatenate([have.astype(np.int64), batch_keys])
+            missing = ~np.isin(np.array(vals, dtype=np.int64),
+                               have.astype(np.int64))
+            if missing.any():
+                bad = np.array(vals)[missing][0]
+                raise CatalogError(
+                    "Cannot add or update a child row: a foreign key "
+                    f"constraint fails (`{self.name}`.`{fk.column}` -> "
+                    f"`{fk.ref_table}`.`{fk.ref_column}`, value {bad})")
+
     def insert_rows(self, rows: list[tuple], txn=None) -> int:
         fixed, first_handle = self._prepare_insert(rows)
+        self._fk_check_rows(fixed)
         if self.partition is not None and self.partition.kind == "range" \
                 and self.partition.parts[-1][1] is not None and fixed:
             ci = self.col_names.index(self.partition.column)
@@ -384,6 +425,7 @@ class TableInfo:
         caller's txn buffers the writes (and, in pessimistic mode, locks
         each record key at DML time via Txn.put)."""
         from .codec_io import encode_table_row
+        self._fk_check_rows(new_rows)
         new_rows = [tuple(canon_write_value(t_, v, n)
                           for t_, v, n in zip(self.col_types, r,
                                               self.col_names))
@@ -406,6 +448,18 @@ class TableInfo:
                 raise
         self._invalidate()
         return len(handles)
+
+    def delete_handles(self, drop_handles) -> int:
+        """Delete rows by STABLE row-store handle — immune to snapshot
+        re-ordering between mask computation and the delete (the FK
+        cascade path interleaves deletes across tables)."""
+        if self.kv is None:
+            raise CatalogError("handle deletes need the KV row store")
+        self.snapshot()                      # (re)bind _snapshot_handles
+        drop = np.asarray(sorted(drop_handles), dtype=np.int64)
+        keep = ~np.isin(np.asarray(self._snapshot_handles, dtype=np.int64),
+                        drop)
+        return self.delete_where(keep)
 
     def delete_where(self, keep_mask: np.ndarray) -> int:
         """Delete rows where ~keep_mask (aligned with snapshot row order)."""
@@ -574,6 +628,21 @@ class TableInfo:
 
     def _note_placement(self, placement) -> None:
         self._placement_excluded = set(placement.excluded)
+
+    def snapshot_at(self, ts: int) -> ColumnarSnapshot:
+        """Historical snapshot at an MVCC read ts (stale read,
+        sessiontxn/staleread): columnarizes the row store as of `ts`,
+        uncached (one-shot reads; GC may reclaim very old versions)."""
+        if self.kv is None:
+            raise CatalogError("snapshot_at needs the KV row store")
+        from .codec_io import scan_table_rows
+        _handles, rows = scan_table_rows(self.kv, self.table_id, int(ts),
+                                         self.col_types)
+        cols = [Column.from_values(t, [r[i] for r in rows])
+                for i, t in enumerate(self.col_types)]
+        return snapshot_from_columns(self.col_names, cols,
+                                     n_shards=self.n_shards,
+                                     epoch=-int(ts))
 
     # ---------------- partitioning (logical row sets) ---------------- #
 

@@ -342,6 +342,17 @@ class Session:
             return self._exec_create_table(stmt)
         if isinstance(stmt, A.DropTable):
             for n in stmt.names:
+                refs = [
+                    (t.name, fk.column)
+                    for t in self.domain.catalog.databases
+                    .get(self.db, {}).values()
+                    for fk in getattr(t, "foreign_keys", [])
+                    if fk.ref_table == n and t.name not in stmt.names]
+                if refs:
+                    raise CatalogError(
+                        f"Cannot drop table {n!r}: referenced by a "
+                        f"foreign key constraint ({refs[0][0]}."
+                        f"{refs[0][1]})")
                 self.domain.catalog.drop_table(self.db, n, stmt.if_exists)
             return ResultSet()
         if isinstance(stmt, A.CreateView):
@@ -845,6 +856,34 @@ class Session:
                 raise CatalogError(
                     "partition column must be integer or date typed")
             tbl.partition = stmt.partition
+        if stmt.foreign_keys:
+            # integer keys only: FK comparison runs over raw int64 column
+            # data; date/string values are not canonical at check time
+            ok_kinds = (dt.TypeKind.INT64, dt.TypeKind.UINT64)
+            for fk in stmt.foreign_keys:
+                if fk.column not in names:
+                    raise CatalogError(f"unknown FK column {fk.column!r}")
+                if types[names.index(fk.column)].kind not in ok_kinds:
+                    raise CatalogError(
+                        "FOREIGN KEY columns must be integer typed")
+                parent = tbl if fk.ref_table == stmt.name else \
+                    self.domain.catalog.get_table(self.db, fk.ref_table)
+                if fk.ref_column not in parent.col_names:
+                    raise CatalogError(
+                        f"unknown referenced column "
+                        f"{fk.ref_table}.{fk.ref_column}")
+                pk = parent.col_types[
+                    parent.col_names.index(fk.ref_column)].kind
+                if pk not in ok_kinds:
+                    raise CatalogError(
+                        "FOREIGN KEY must reference an integer column "
+                        f"({fk.ref_table}.{fk.ref_column} is {pk.value})")
+            tbl.foreign_keys = list(stmt.foreign_keys)
+            db = self.db
+            cat = self.domain.catalog
+            tbl._fk_resolver = (
+                lambda nm, _t=tbl, _db=db, _cat=cat:
+                _t if nm == _t.name else _cat.get_table(_db, nm))
         self.domain.catalog.create_table(self.db, tbl, stmt.if_not_exists)
         created = self.domain.catalog.get_table(self.db, stmt.name)
         if created is tbl:
@@ -1236,6 +1275,7 @@ class Session:
                 ok = True if m is True else bool(np.broadcast_to(
                     np.asarray(m), (n_rows,))[i])
                 rows[i][ci[col]] = _decode_val(v[i], ir.dtype) if ok else None
+        self._fk_parent_update_check(tbl, cols, midx, old_rows, rows)
         if tbl.kv is not None:
             # targeted in-place rewrite through the row store: handles stay
             # stable, and inside a pessimistic txn each record key is
@@ -1250,6 +1290,7 @@ class Session:
                 tbl.update_rows(upd_handles, old_rows, updated)
         else:
             new_rows = [tuple(plainify(x) for x in r) for r in rows]
+            tbl._fk_check_rows([new_rows[i] for i in midx])
             tbl.replace_columns(_rows_to_columns(tbl, new_rows))
         self.domain.stats.note_modify(tbl, n_aff, delta=0)
         return ResultSet(affected=n_aff)
@@ -1260,13 +1301,145 @@ class Session:
     def _do_delete(self, stmt: A.Delete) -> ResultSet:
         tbl = self.domain.catalog.get_table(self.db, stmt.table)
         if stmt.where is None:
+            self._fk_on_delete(tbl, np.ones(tbl.num_rows, bool))
             n = tbl.truncate()
             self.domain.stats.note_modify(tbl, n, delta=-n)
             return ResultSet(affected=n)
         mask = self._where_mask(tbl, stmt.where)
-        n = tbl.delete_where(~mask)
+        if tbl.kv is not None and self._fk_children(tbl):
+            # cascades may reshuffle this table's own snapshot (self-
+            # referential FKs): pin the doomed rows by stable handle
+            tbl.snapshot()
+            del_handles = np.asarray(tbl._snapshot_handles)[mask].tolist()
+            self._fk_on_delete(tbl, mask)
+            n = tbl.delete_handles(del_handles)
+        else:
+            self._fk_on_delete(tbl, mask)
+            n = tbl.delete_where(~mask)
         self.domain.stats.note_modify(tbl, n, delta=-n)
         return ResultSet(affected=n)
+
+    # -- foreign keys: parent-side enforcement (executor side of
+    # -- planner/core/foreign_key.go: FKCheck/FKCascade plans) ---------- #
+
+    def _fk_children(self, tbl):
+        return [(t, fk)
+                for t in self.domain.catalog.databases
+                .get(self.db, {}).values()
+                for fk in getattr(t, "foreign_keys", [])
+                if fk.ref_table == tbl.name]
+
+    def _fk_on_delete(self, tbl, del_mask, depth: int = 0):
+        """RESTRICT rejects the delete while referencing child rows exist;
+        CASCADE deletes them first (recursively — FKCascade exec).
+        Cascade deletes go by STABLE handles: a sibling/deeper cascade may
+        reshuffle a table's snapshot between mask computation and the
+        delete, so positional masks cannot be trusted across levels.
+        `del_mask` must align with tbl.snapshot() at call time."""
+        if depth > 32:
+            raise CatalogError("foreign key cascade depth exceeded")
+        children = self._fk_children(tbl)
+        if not children or not del_mask.any():
+            return
+        if depth == 0:
+            # PRE-CHECK the whole cascade closure read-only first: a
+            # RESTRICT violation behind a sibling CASCADE must reject the
+            # statement BEFORE any child rows are deleted (MySQL rolls
+            # the whole statement back)
+            self._fk_check_delete(tbl, del_mask)
+        snap = tbl.snapshot()
+        excl = set()
+        if tbl.kv is not None and tbl._snapshot_handles is not None:
+            excl = set(np.asarray(tbl._snapshot_handles)[del_mask]
+                       .tolist())
+        for child, fk in children:
+            pcol = snap.columns[tbl.col_names.index(fk.ref_column)]
+            pvals = pcol.data[del_mask & pcol.validity]
+            if not len(pvals):
+                continue
+            csnap = child.snapshot()
+            ccol = csnap.columns[child.col_names.index(fk.column)]
+            hit = ccol.validity & np.isin(ccol.data, pvals)
+            if child is tbl and excl:
+                hit = hit & ~np.isin(
+                    np.asarray(child._snapshot_handles, dtype=np.int64),
+                    np.asarray(sorted(excl), dtype=np.int64))
+            if not hit.any():
+                continue
+            if fk.on_delete == "restrict":
+                raise CatalogError(
+                    "Cannot delete or update a parent row: a foreign "
+                    f"key constraint fails (`{child.name}`.`{fk.column}` "
+                    f"REFERENCES `{tbl.name}`.`{fk.ref_column}`)")
+            n = int(hit.sum())
+            if child.kv is not None:
+                child_handles = np.asarray(child._snapshot_handles)[hit]
+                self._fk_on_delete(child, hit, depth + 1)
+                child.delete_handles(child_handles.tolist())
+            else:
+                self._fk_on_delete(child, hit, depth + 1)
+                child.delete_where(~hit)
+            self.domain.stats.note_modify(child, n, delta=-n)
+
+    def _fk_check_delete(self, tbl, del_mask, depth: int = 0):
+        """Read-only pass over the cascade closure: raises on the first
+        RESTRICT violation without mutating anything."""
+        if depth > 32:
+            raise CatalogError("foreign key cascade depth exceeded")
+        children = self._fk_children(tbl)
+        if not children or not del_mask.any():
+            return
+        snap = tbl.snapshot()
+        excl = set()
+        if tbl.kv is not None and tbl._snapshot_handles is not None:
+            excl = set(np.asarray(tbl._snapshot_handles)[del_mask]
+                       .tolist())
+        for child, fk in children:
+            pcol = snap.columns[tbl.col_names.index(fk.ref_column)]
+            pvals = pcol.data[del_mask & pcol.validity]
+            if not len(pvals):
+                continue
+            ccol = child.snapshot().columns[
+                child.col_names.index(fk.column)]
+            hit = ccol.validity & np.isin(ccol.data, pvals)
+            if child is tbl and excl:
+                hit = hit & ~np.isin(
+                    np.asarray(child._snapshot_handles, dtype=np.int64),
+                    np.asarray(sorted(excl), dtype=np.int64))
+            if not hit.any():
+                continue
+            if fk.on_delete == "restrict":
+                raise CatalogError(
+                    "Cannot delete or update a parent row: a foreign "
+                    f"key constraint fails (`{child.name}`.`{fk.column}` "
+                    f"REFERENCES `{tbl.name}`.`{fk.ref_column}`)")
+            self._fk_check_delete(child, hit, depth + 1)
+
+    def _fk_parent_update_check(self, tbl, cols, midx, old_rows, rows):
+        """Changing a referenced key value while child rows point at it is
+        rejected (ON UPDATE RESTRICT — the only supported update action)."""
+        children = self._fk_children(tbl)
+        if not children:
+            return
+        ci = {n: i for i, n in enumerate(tbl.col_names)}
+        for child, fk in children:
+            pci = ci[fk.ref_column]
+            changed = [int(i) for k, i in enumerate(midx)
+                       if old_rows[k][pci] != rows[i][pci]]
+            if not changed:
+                continue
+            pcol = cols[pci]
+            sel = np.array(changed, dtype=np.int64)
+            pvals = pcol.data[sel][pcol.validity[sel]]
+            if not len(pvals):
+                continue
+            ccol = child.snapshot().columns[
+                child.col_names.index(fk.column)]
+            if (ccol.validity & np.isin(ccol.data, pvals)).any():
+                raise CatalogError(
+                    "Cannot delete or update a parent row: a foreign "
+                    f"key constraint fails (`{child.name}`.`{fk.column}` "
+                    f"REFERENCES `{tbl.name}`.`{fk.ref_column}`)")
 
     def _exec_show(self, stmt: A.ShowStmt) -> ResultSet:
         cat = self.domain.catalog

@@ -129,6 +129,9 @@ class TableName(Node):
     name: str
     db: Optional[str] = None
     alias: Optional[str] = None
+    # stale read: AS OF TIMESTAMP <literal> (sessiontxn/staleread) —
+    # an int literal is a raw logical ts, a string parses as a datetime
+    as_of: Optional[object] = None
 
 
 @dataclass
@@ -205,6 +208,7 @@ class ColumnDef(Node):
     auto_increment: bool = False
     collation: str = ""             # COLLATE clause ('' = table/charset default)
     members: tuple = ()             # ENUM('a','b') / SET(...) member list
+    references: Optional[tuple] = None  # (ref_table, ref_col, on_delete)
 
 
 @dataclass
@@ -225,6 +229,7 @@ class CreateTable(Node):
     indexes: list[tuple] = field(default_factory=list)
     ttl: Optional[TTLOption] = None
     partition: Optional[PartitionSpec] = None
+    foreign_keys: list = field(default_factory=list)  # [ForeignKeyDef]
 
 
 @dataclass
@@ -262,6 +267,17 @@ class PartitionSpec:
     column: str
     parts: list = field(default_factory=list)
     num: int = 0
+
+
+@dataclass
+class ForeignKeyDef:
+    """FOREIGN KEY (col) REFERENCES parent(col) [ON DELETE action]
+    (parser.y ReferenceDef analog; model meta/model FKInfo)."""
+    name: str
+    column: str
+    ref_table: str
+    ref_column: str
+    on_delete: str = "restrict"    # restrict | cascade
 
 
 @dataclass

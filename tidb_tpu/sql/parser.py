@@ -591,12 +591,30 @@ class Parser:
         db = None
         if self.accept_op("."):
             db, name = name, self.ident()
+        as_of = None
+        if (self.at_kw("AS") and self.i + 1 < len(self.toks)
+                and self.toks[self.i + 1].kind in ("kw", "ident")
+                and self.toks[self.i + 1].text.upper() == "OF"):
+            # stale read: t AS OF TIMESTAMP <literal>
+            self.advance()   # AS
+            self.advance()   # OF
+            if not (self.cur.kind in ("kw", "ident")
+                    and self.cur.text.upper() == "TIMESTAMP"):
+                raise ParseError("expected TIMESTAMP after AS OF", self.cur)
+            self.advance()
+            t = self.advance()
+            if t.kind in ("int", "decimal", "float"):
+                as_of = int(float(t.text))
+            elif t.kind == "str":
+                as_of = t.text
+            else:
+                raise ParseError("AS OF TIMESTAMP needs a literal", t)
         alias = None
         if self.accept_kw("AS"):
             alias = self.ident()
         elif self.cur.kind == "ident":
             alias = self.ident()
-        return A.TableName(name, db, alias)
+        return A.TableName(name, db, alias, as_of)
 
     # ---------------- DDL ---------------- #
 
@@ -683,8 +701,32 @@ class Parser:
                     iname = self.ident()
                 cols = self._paren_name_list()
                 ct.indexes.append((iname, cols, uniq))
+            elif (self.cur.kind in ("kw", "ident")
+                  and self.cur.text.upper() in ("CONSTRAINT", "FOREIGN")):
+                fname = None
+                if self._accept_word("CONSTRAINT"):
+                    if self.cur.kind == "ident" \
+                            and self.cur.text.upper() != "FOREIGN":
+                        fname = self.ident()
+                if not self._accept_word("FOREIGN"):
+                    raise ParseError("expected FOREIGN KEY", self.cur)
+                self.expect_kw("KEY")
+                if self.cur.kind == "ident":   # optional index name
+                    self.ident()
+                cols = self._paren_name_list()
+                if len(cols) != 1:
+                    raise ParseError(
+                        "only single-column FOREIGN KEY supported",
+                        self.cur)
+                ct.foreign_keys.append(self._references_clause(
+                    fname, cols[0]))
             else:
-                ct.columns.append(self.column_def())
+                cd = self.column_def()
+                ct.columns.append(cd)
+                if cd.references is not None:
+                    rt, rc, od = cd.references
+                    ct.foreign_keys.append(A.ForeignKeyDef(
+                        None, cd.name, rt, rc, od))
             if not self.accept_op(","):
                 break
         self.expect_op(")")
@@ -893,9 +935,46 @@ class Parser:
                 self.ident()
             elif self.accept_kw("COLLATE"):
                 cd.collation = self.ident().lower()
+            elif (self.cur.kind in ("kw", "ident")
+                  and self.cur.text.upper() == "REFERENCES"):
+                self.advance()
+                fk = self._references_clause(None, cd.name, inline=True)
+                cd.references = (fk.ref_table, fk.ref_column, fk.on_delete)
             else:
                 break
         return cd
+
+    def _references_clause(self, fname, column,
+                           inline: bool = False) -> "A.ForeignKeyDef":
+        """[REFERENCES already consumed when inline] parent (col)
+        [ON DELETE RESTRICT|CASCADE|NO ACTION] [ON UPDATE RESTRICT|...]"""
+        if not inline and not self._accept_word("REFERENCES"):
+            raise ParseError("expected REFERENCES", self.cur)
+        parent = self.ident()
+        cols = self._paren_name_list()
+        if len(cols) != 1:
+            raise ParseError("only single-column REFERENCES supported",
+                             self.cur)
+        on_delete = "restrict"
+        while self.at_kw("ON"):
+            self.advance()
+            if self.accept_kw("DELETE"):
+                act = self.advance().text.upper()
+                if act == "NO":
+                    self._accept_word("ACTION")
+                    act = "RESTRICT"
+                if act not in ("RESTRICT", "CASCADE"):
+                    raise ParseError(
+                        f"unsupported ON DELETE {act}", self.cur)
+                on_delete = act.lower()
+            elif self.accept_kw("UPDATE"):
+                act = self.advance().text.upper()  # restrict enforced
+                if act == "NO":
+                    self._accept_word("ACTION")
+            else:
+                raise ParseError("expected DELETE or UPDATE after ON",
+                                 self.cur)
+        return A.ForeignKeyDef(fname, column, parent, cols[0], on_delete)
 
     def type_name(self) -> tuple[str, int, int]:
         t = self.cur

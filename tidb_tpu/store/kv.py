@@ -121,6 +121,7 @@ class KVStore:
     def __init__(self, path: Optional[str] = None, sync: bool = False):
         self._lib = _load_lib()
         self.path = path
+        self._ts_samples: list = []    # (wallclock, ts) for stale reads
         if path is None:
             self._h = ctypes.c_void_p(self._lib.kv_open())
         else:
@@ -146,8 +147,32 @@ class KVStore:
             self._h = None
 
     def alloc_ts(self) -> int:
-        """TSO allocation (PD analog)."""
-        return int(self._lib.kv_alloc_ts(self._h))
+        """TSO allocation (PD analog).  Samples a coarse wallclock->ts
+        index so stale reads (AS OF TIMESTAMP, sessiontxn/staleread) can
+        map a datetime back to a logical snapshot ts."""
+        import time as _time
+        ts = int(self._lib.kv_alloc_ts(self._h))
+        self._ts_samples.append((_time.time(), ts))
+        if len(self._ts_samples) > 200_000:
+            # keep recency exact, thin the old half (staleness windows
+            # that far back only need coarse resolution)
+            old = self._ts_samples[:100_000:2]
+            self._ts_samples = old + self._ts_samples[100_000:]
+        return ts
+
+    def ts_at_time(self, epoch_seconds: float) -> int:
+        """Largest sampled ts allocated at or before the wallclock time
+        (the TSO physical-time mapping of the reference, staleread
+        processor.go).  Raises if the time predates the store.  The
+        sample index is in-memory only: after reopening a persistent
+        store, datetime staleness spans only the current process's
+        lifetime (raw integer ts literals always work)."""
+        import bisect
+        i = bisect.bisect_right(self._ts_samples,
+                                (epoch_seconds, float("inf")))
+        if i == 0:
+            raise KVError(0, "requested staleness predates the store")
+        return self._ts_samples[i - 1][1]
 
     def begin(self, pessimistic: bool = False) -> "Txn":
         return Txn(self, self.alloc_ts(), pessimistic=pessimistic)
