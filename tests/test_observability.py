@@ -72,3 +72,49 @@ def test_normalize_sql():
         normalize_sql("select  *  from t where a = 123")
     assert normalize_sql("select 'x' from t") == \
         normalize_sql("select 'yy' from t")
+
+
+def test_top_sql_memtable():
+    """util/topsql analog: hottest (sql, plan) pairs by CPU time are
+    queryable from information_schema.tidb_top_sql."""
+    from tidb_tpu.session import Domain, Session
+    s = Session(Domain())
+    s.execute("create table tq (a bigint)")
+    s.execute("insert into tq values (1),(2),(3)")
+    for _ in range(3):
+        s.must_query("select sum(a) from tq")
+    rows = s.must_query(
+        "select sql_digest, plan_digest, cpu_time_ms, exec_count "
+        "from information_schema.tidb_top_sql")
+    target = [r for r in rows if "sum" in r[0]]
+    assert target, rows
+    digest, plan_digest, cpu_ms, cnt = target[0]
+    assert cnt == 3
+    assert plan_digest            # plan attributed
+    assert cpu_ms >= 0
+
+
+def test_plan_replayer_dump():
+    """executor/plan_replayer.go analog: the zip bundle carries sql,
+    plan, schema, stats and variables."""
+    import os
+    import zipfile
+
+    from tidb_tpu.session import Domain, Session
+    s = Session(Domain())
+    s.execute("create table pr (a bigint, b bigint)")
+    s.execute("insert into pr values " +
+              ",".join(f"({i},{i % 5})" for i in range(1200)))
+    s.execute("analyze table pr")
+    out = s.execute("plan replayer dump explain "
+                    "select b, count(*) from pr where a > 10 group by b")
+    token = out.rows[0][0]
+    path = os.path.join("/tmp", "tidb_tpu_replayer", token)
+    with zipfile.ZipFile(path) as z:
+        names = set(z.namelist())
+        assert {"sql/sql.sql", "plan.txt", "schema/schema.sql",
+                "stats.json", "variables.json"} <= names
+        assert b"create table" in z.read("schema/schema.sql").lower()
+        assert b"ndv" in z.read("stats.json")
+        assert b"CopTask" in z.read("plan.txt") or \
+            b"Host" in z.read("plan.txt")

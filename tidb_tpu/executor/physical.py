@@ -2090,53 +2090,100 @@ class IndexLookUpExec(PhysOp):
                 f"eq={self.access.eq_values}{rng}")
 
     def execute(self, ctx):
-        from ..store import codec as C
-        tbl, acc = self.table, self.access
-        ix = acc.index
+        tbl = self.table
         kv = tbl.kv
         ts = ctx.kv_read_ts(kv)
-        offs = [tbl.col_names.index(c) for c in ix.columns]
-        types = [tbl.col_types[i] for i in offs]
-        parts = [C.encode_index_value(v, t)
-                 for v, t in zip(acc.eq_values, types)]
-        handles: list[int] = []
-        if acc.is_point:
-            key = C.index_key(tbl.table_id, ix.index_id, *parts)
-            val = kv.get(key, ts)
-            if val is not None:
-                handles = [C.decode_index_handle(key, val)]
+        handles = _index_handles(tbl, self.access, kv, ts)
+        return _fetch_filter_rows(tbl, kv, ts, handles, self.col_offsets,
+                                  self.out_names, self.conditions)
+
+
+def _index_handles(tbl, acc, kv, ts: int) -> list:
+    """Row handles matched by one IndexAccess (index-side half of the
+    IndexLookUp pipeline; shared with IndexMergeExec)."""
+    from ..store import codec as C
+    ix = acc.index
+    offs = [tbl.col_names.index(c) for c in ix.columns]
+    types = [tbl.col_types[i] for i in offs]
+    parts = [C.encode_index_value(v, t)
+             for v, t in zip(acc.eq_values, types)]
+    handles: list[int] = []
+    if acc.is_point:
+        key = C.index_key(tbl.table_id, ix.index_id, *parts)
+        val = kv.get(key, ts)
+        if val is not None:
+            handles = [C.decode_index_handle(key, val)]
+        return handles
+    base = C.index_key(tbl.table_id, ix.index_id, *parts)
+    start, end = base, _prefix_succ(base)
+    if acc.range_col is not None:
+        rt = types[len(acc.eq_values)]
+        if acc.low is not None:
+            lo = base + C.encode_index_value(acc.low, rt)
+            start = lo if acc.low_incl else _prefix_succ(lo)
         else:
-            base = C.index_key(tbl.table_id, ix.index_id, *parts)
-            start, end = base, _prefix_succ(base)
-            if acc.range_col is not None:
-                rt = types[len(acc.eq_values)]
-                if acc.low is not None:
-                    lo = base + C.encode_index_value(acc.low, rt)
-                    start = lo if acc.low_incl else _prefix_succ(lo)
-                else:
-                    # bounded above only: skip NULL entries (flag 0x00) —
-                    # col < x is never true for NULL
-                    start = base + b"\x01"
-                if acc.high is not None:
-                    hi = base + C.encode_index_value(acc.high, rt)
-                    end = _prefix_succ(hi) if acc.high_incl else hi
-            for k, v in kv.scan(start, end, ts):
-                handles.append(C.decode_index_handle(k, v))
-        rows = []
-        for h in handles:
-            rv = kv.get(C.record_key(tbl.table_id, h), ts)
-            if rv is not None:
-                rows.append(C.decode_row(rv, tbl.col_types))
-        cols = [Column.from_values(tbl.col_types[off],
-                                   [r[off] for r in rows])
-                for off in self.col_offsets]
-        chunk = ResultChunk(list(self.out_names), cols)
-        if not self.conditions or chunk.num_rows == 0:
-            return chunk
-        dicts = {i: c.dictionary for i, c in enumerate(cols)
-                 if c.dictionary is not None}
-        idx = np.nonzero(_conds_mask(chunk, self.conditions, dicts))[0]
-        return ResultChunk(chunk.names, [c.take(idx) for c in chunk.columns])
+            # bounded above only: skip NULL entries (flag 0x00) —
+            # col < x is never true for NULL
+            start = base + b"\x01"
+        if acc.high is not None:
+            hi = base + C.encode_index_value(acc.high, rt)
+            end = _prefix_succ(hi) if acc.high_incl else hi
+    for k, v in kv.scan(start, end, ts):
+        handles.append(C.decode_index_handle(k, v))
+    return handles
+
+
+def _fetch_filter_rows(tbl, kv, ts, handles, col_offsets, out_names,
+                      conditions) -> ResultChunk:
+    """Table-side half of the IndexLookUp pipeline: fetch + decode rows
+    by handle, project, apply residual filters."""
+    from ..store import codec as C
+    rows = []
+    for h in handles:
+        rv = kv.get(C.record_key(tbl.table_id, h), ts)
+        if rv is not None:
+            rows.append(C.decode_row(rv, tbl.col_types))
+    cols = [Column.from_values(tbl.col_types[off], [r[off] for r in rows])
+            for off in col_offsets]
+    chunk = ResultChunk(list(out_names), cols)
+    if not conditions or chunk.num_rows == 0:
+        return chunk
+    dicts = {i: c.dictionary for i, c in enumerate(cols)
+             if c.dictionary is not None}
+    idx = np.nonzero(_conds_mask(chunk, conditions, dicts))[0]
+    return ResultChunk(chunk.names, [c.take(idx) for c in chunk.columns])
+
+
+@dataclass
+class IndexMergeExec(PhysOp):
+    """Union-type IndexMerge (executor/index_merge_reader.go analog): one
+    handle set per index access — one access per OR disjunct — unioned,
+    rows fetched once per distinct handle, then filtered by the FULL
+    disjunction (each access may over-approximate its disjunct)."""
+    table: Any
+    accesses: list = field(default_factory=list)
+    col_offsets: list = field(default_factory=list)
+    conditions: list = field(default_factory=list)   # the whole OR
+    out_names: list = field(default_factory=list)
+    out_dtypes: list = field(default_factory=list)
+    children: list = field(default_factory=list)
+
+    def describe(self):
+        parts = ", ".join(
+            f"{a.index.name} eq={a.eq_values}" for a in self.accesses)
+        return f"IndexMerge[{self.table.name}: {parts}]"
+
+    def execute(self, ctx):
+        tbl = self.table
+        kv = tbl.kv
+        ts = ctx.kv_read_ts(kv)
+        handles: dict = {}            # ordered de-dup
+        for acc in self.accesses:
+            for h in _index_handles(tbl, acc, kv, ts):
+                handles[h] = None
+        return _fetch_filter_rows(tbl, kv, ts, list(handles),
+                                  self.col_offsets, self.out_names,
+                                  self.conditions)
 
 
 # --------------------------------------------------------------------- #

@@ -61,6 +61,14 @@ def to_physical(p: LogicalPlan, no_device_join: bool = False) -> PhysOp:
         return cop
 
     ndj = no_device_join
+    from ..planner.ranger import LogicalIndexMerge
+    if isinstance(p, LogicalIndexMerge):
+        from .physical import IndexMergeExec
+        return IndexMergeExec(p.ds.table, list(p.accesses),
+                              list(p.ds.col_offsets),
+                              conditions=list(p.conditions),
+                              out_names=p.schema.names(),
+                              out_dtypes=[c.dtype for c in p.schema.cols])
     if isinstance(p, LogicalIndexScan):
         return IndexLookUpExec(p.ds.table, p.access, list(p.ds.col_offsets),
                                out_names=p.schema.names(),

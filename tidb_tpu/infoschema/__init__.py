@@ -125,6 +125,10 @@ def _stmt_summary(dom):
     return dom.stmt_summary.summary_rows()
 
 
+def _top_sql(dom):
+    return dom.stmt_summary.top_sql_rows()
+
+
 def _ddl_jobs(dom):
     if dom._ddl is None:
         return []
@@ -203,6 +207,10 @@ _INFORMATION_SCHEMA = {
                             ("AVG_LATENCY_MS", F), ("MAX_LATENCY_MS", F),
                             ("SUM_ROWS", I), ("QUERY_SAMPLE_TEXT", S)],
                            _stmt_summary),
+    "TIDB_TOP_SQL": ([("SQL_DIGEST", S), ("PLAN_DIGEST", S),
+                      ("CPU_TIME_MS", F), ("EXEC_COUNT", I),
+                      ("AVG_LATENCY_MS", F), ("QUERY_SAMPLE_TEXT", S),
+                      ("PLAN", S)], _top_sql),
     "DDL_JOBS": ([("JOB_ID", I), ("DB_NAME", S), ("TABLE_NAME", S),
                   ("JOB_TYPE", S), ("SCHEMA_STATE", S), ("STATE", S),
                   ("ROW_COUNT", I), ("ERROR", S)], _ddl_jobs),

@@ -253,6 +253,21 @@ def _score(acc: IndexAccess) -> tuple:
 # ------------------------------------------------------------------ #
 
 @dataclass
+class LogicalIndexMerge(LogicalPlan):
+    """Union of several index accesses serving one OR predicate
+    (index_merge_reader.go)."""
+    ds: DataSource = None
+    accesses: list = None
+    conditions: list = None          # the whole disjunction (re-filter)
+    schema: Schema = None
+
+    def __post_init__(self):
+        self.children = []
+        if self.schema is None:
+            self.schema = self.ds.schema
+
+
+@dataclass
 class LogicalIndexScan(LogicalPlan):
     """Index-served scan of a KV table (IndexLookUp / PointGet analog)."""
     ds: DataSource
@@ -290,8 +305,49 @@ def apply_index_paths(p: LogicalPlan, stats_handle=None) -> LogicalPlan:
             if acc.residual:
                 return LogicalSelection(scan, acc.residual)
             return scan
+        im = _try_index_merge(p, stats)
+        if im is not None:
+            return im
     return p
 
 
+def _flatten_or(e: Expr) -> list:
+    if isinstance(e, Func) and e.op == "or":
+        out = []
+        for a in e.args:
+            out.extend(_flatten_or(a))
+        return out
+    return [e]
+
+
+def _split_and(e: Expr) -> list:
+    if isinstance(e, Func) and e.op == "and":
+        out = []
+        for a in e.args:
+            out.extend(_split_and(a))
+        return out
+    return [e]
+
+
+def _try_index_merge(p: LogicalSelection, stats):
+    """UNION-type IndexMerge (executor/index_merge_reader.go): a single
+    top-level OR whose every disjunct pins SOME index becomes a union of
+    index accesses; rows fetched by the handle union are re-filtered by
+    the whole disjunction, so over-approximating accesses stay sound."""
+    if len(p.conditions) != 1:
+        return None
+    disjuncts = _flatten_or(p.conditions[0])
+    if len(disjuncts) < 2:
+        return None
+    accesses = []
+    for d in disjuncts:
+        acc = choose_index(_split_and(d), p.child, stats)
+        if acc is None:
+            return None          # one unindexed disjunct = full scan wins
+        accesses.append(acc)
+    return LogicalIndexMerge(p.child, accesses, list(p.conditions))
+
+
 __all__ = ["IndexAccess", "match_index", "choose_index", "LogicalIndexScan",
+           "LogicalIndexMerge",
            "apply_index_paths"]

@@ -229,6 +229,8 @@ class Session:
                     self._cur_sql = None
             from ..plugin import registry as _plugins
             _plugins.fire("on_stmt_begin", self, text)
+            cpu0 = time.thread_time_ns()    # Top-SQL CPU attribution
+            self._last_plan_text = ""
             try:
                 out = self._exec_stmt(stmt)
             except Exception as e:
@@ -241,7 +243,10 @@ class Session:
             dt_ns = time.perf_counter_ns() - t0
             qcnt.inc(type=type(stmt).__name__)
             qdur.observe(dt_ns / 1e9)
-            self.domain.stmt_summary.record(text, dt_ns, len(out.rows))
+            self.domain.stmt_summary.record(
+                text, dt_ns, len(out.rows),
+                cpu_ns=time.thread_time_ns() - cpu0,
+                plan_text=self._last_plan_text)
             try:
                 # runaway KILL must fire before the success audit hook:
                 # a killed statement is an error to the client
@@ -420,6 +425,8 @@ class Session:
             for name, val in stmt.user_vars:
                 self.user_vars[name.lower()] = self._eval_scalar(val)
             return ResultSet()
+        if isinstance(stmt, A.PlanReplayerDump):
+            return self._exec_plan_replayer(stmt)
         if isinstance(stmt, A.TxnStmt):
             return self._exec_txn(stmt)
         if isinstance(stmt, A.PrepareStmt):
@@ -638,6 +645,10 @@ class Session:
             phys = to_physical(plan)
         finally:
             STATS_HANDLE.reset(tok)
+        try:       # Top-SQL plan digest attribution (util/topsql)
+            self._last_plan_text = phys.explain()
+        except Exception:
+            pass
         use_cache = use_cache and not ran_subquery
         if use_cache and _plan_cacheable(phys):
             keys = {}
@@ -744,6 +755,57 @@ class Session:
                              explain_analyze_text(phys, coll))
         text = phys.explain()
         return ResultSet(["plan"], [(line,) for line in text.split("\n")])
+
+    def _exec_plan_replayer(self, stmt: A.PlanReplayerDump) -> ResultSet:
+        """PLAN REPLAYER DUMP EXPLAIN <sql> (executor/plan_replayer.go):
+        writes a zip bundle — sql, plan text, CREATE TABLE statements for
+        every referenced table, stats JSON, session/global sysvars,
+        engine version — and returns its token filename."""
+        import json as _json
+        import os
+        import tempfile
+        import time as _time
+        import zipfile
+
+        parsed = parse_sql(stmt.sql)[0]
+        if not isinstance(parsed, (A.SelectStmt, A.SetOpStmt)):
+            raise PlanError("PLAN REPLAYER DUMP supports SELECT only")
+        built, phys = self._plan_select(parsed)
+        plan_text = phys.explain()
+        tables = []
+        for db, name in self._referenced_tables(parsed):
+            try:
+                tables.append(self.domain.catalog.get_table(
+                    db or self.db, name))
+            except Exception:
+                continue
+        stats_blob = {}
+        for t in tables:
+            st = self.domain.stats.get(t)
+            if st is None:
+                continue
+            stats_blob[t.name] = {
+                "count": st.count,
+                "modify_count": st.modify_count,
+                "columns": {cn: {"ndv": cs.ndv,
+                                 "null_count": cs.null_count}
+                            for cn, cs in st.cols.items()},
+            }
+        out_dir = os.path.join(tempfile.gettempdir(), "tidb_tpu_replayer")
+        os.makedirs(out_dir, exist_ok=True)
+        token = f"replayer_{int(_time.time() * 1000):x}.zip"
+        path = os.path.join(out_dir, token)
+        with zipfile.ZipFile(path, "w") as z:
+            z.writestr("sql/sql.sql", stmt.sql)
+            z.writestr("plan.txt", plan_text)
+            z.writestr("schema/schema.sql", "\n\n".join(
+                _render_create_table(t) for t in tables))
+            z.writestr("stats.json", _json.dumps(stats_blob, indent=1))
+            z.writestr("variables.json", _json.dumps(
+                {**self.domain.sysvars, **self.vars}, default=str,
+                indent=1))
+            z.writestr("meta.txt", "tidb-tpu 0.2.0")
+        return ResultSet(["File_token"], [(token,)])
 
     def _exec_trace(self, stmt: A.TraceStmt) -> ResultSet:
         """TRACE <stmt>: span tree of the statement's phases

@@ -292,6 +292,14 @@ class CreateView(Node):
 
 
 @dataclass
+class PlanReplayerDump(Node):
+    """PLAN REPLAYER DUMP EXPLAIN <sql> (executor/plan_replayer.go):
+    bundle plan + schema + stats + sysvars into a zip for offline
+    reproduction."""
+    sql: str = ""
+
+
+@dataclass
 class DropView(Node):
     names: list = field(default_factory=list)
     if_exists: bool = False

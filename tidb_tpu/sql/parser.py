@@ -186,6 +186,14 @@ class Parser:
         if self.cur.kind == "ident" and self.cur.text.upper() in (
                 "PREPARE", "EXECUTE", "DEALLOCATE"):
             return self._prepare_family()
+        if self.cur.kind == "ident" and self.cur.text.upper() == "PLAN":
+            self.advance()
+            if not self._accept_word("REPLAYER"):
+                raise ParseError("expected REPLAYER after PLAN", self.cur)
+            if not self._accept_word("DUMP"):
+                raise ParseError("expected DUMP", self.cur)
+            self.expect_kw("EXPLAIN")
+            return A.PlanReplayerDump(self._stmt_text_until(None))
         if self.cur.kind == "ident" and self.cur.text.upper() == "SPLIT":
             self.advance()
             self.expect_kw("TABLE")

@@ -82,6 +82,11 @@ class StmtStats:
     sum_rows: int = 0
     first_seen: float = 0.0
     last_seen: float = 0.0
+    # Top-SQL attribution (pkg/util/topsql): CPU time + plan digest so
+    # the hottest (sql, plan) pairs rank by actual processor cost
+    sum_cpu_ns: int = 0
+    plan_digest: str = ""
+    sample_plan: str = ""
 
     @property
     def avg_latency_ms(self) -> float:
@@ -106,7 +111,8 @@ class StmtSummary:
         self.slow_threshold_ms = slow_threshold_ms
         self.max_slow = max_slow
 
-    def record(self, sql: str, latency_ns: int, rows: int):
+    def record(self, sql: str, latency_ns: int, rows: int,
+               cpu_ns: int = 0, plan_text: str = ""):
         digest = normalize_sql(sql)
         now = time.time()
         with self._lock:
@@ -119,6 +125,12 @@ class StmtSummary:
             st.max_latency_ns = max(st.max_latency_ns, latency_ns)
             st.sum_rows += rows
             st.last_seen = now
+            st.sum_cpu_ns += int(cpu_ns)
+            if plan_text:
+                import hashlib
+                st.plan_digest = hashlib.sha256(
+                    plan_text.encode()).hexdigest()[:16]
+                st.sample_plan = plan_text
             if latency_ns / 1e6 >= self.slow_threshold_ms:
                 self._slow.append(SlowQuery(sql, latency_ns / 1e6, now, rows))
                 if len(self._slow) > self.max_slow:
@@ -131,6 +143,20 @@ class StmtSummary:
                      s.sample_sql)
                     for s in sorted(self._stats.values(),
                                     key=lambda x: -x.sum_latency_ns)]
+
+    def top_sql_rows(self, n: int = 30) -> list[tuple]:
+        """Top statements by CPU time (util/topsql reporter analog):
+        (sql_digest, plan_digest, cpu_ms, exec_count, avg_latency_ms,
+        sample_sql, sample_plan)."""
+        with self._lock:
+            ranked = sorted(self._stats.values(),
+                            key=lambda x: -(x.sum_cpu_ns
+                                            or x.sum_latency_ns))[:n]
+            return [(s.digest, s.plan_digest,
+                     round((s.sum_cpu_ns or s.sum_latency_ns) / 1e6, 3),
+                     s.exec_count, round(s.avg_latency_ms, 3),
+                     s.sample_sql, s.sample_plan)
+                    for s in ranked]
 
     def slow_rows(self) -> list[tuple]:
         with self._lock:
