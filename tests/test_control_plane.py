@@ -230,3 +230,74 @@ def test_audit_plugin_and_isolation():
     finally:
         registry.unregister("audit-log")
         registry.unregister("broken")
+
+
+def test_extension_points():
+    """pkg/extension analog: bootstrap + sysvars + custom scalar SQL
+    function registered before the Domain boots."""
+    from tidb_tpu import extension
+    from tidb_tpu.session import Domain, Session
+
+    seen = []
+    try:
+        extension.register(
+            "test-ext",
+            bootstrap=lambda dom: seen.append(dom),
+            functions={"triple_plus": (lambda x, y: 3 * x + y, 2)},
+            sysvars=[("test_ext_mode", "fast")],
+        )
+        s = Session(Domain())
+        assert seen and seen[0] is s.domain
+        assert s.domain.sysvars.get("test_ext_mode") == "fast"
+        s.execute("create table ext_t (a bigint, b bigint)")
+        s.execute("insert into ext_t values (1, 2), (10, 5), (null, 1)")
+        got = s.must_query(
+            "select triple_plus(a, b) from ext_t order by b")
+        assert [g[0] for g in got] == [None, 5.0, 35.0]
+    finally:
+        extension.registry.unregister("test-ext")
+
+
+def test_workload_repository_snapshots():
+    from tidb_tpu.session import Domain, Session
+    s = Session(Domain())
+    s.execute("create table wr (a bigint)")
+    s.execute("insert into wr values (1),(2)")
+    s.must_query("select count(*) from wr")
+    s.domain.snapshot_workload_repo()
+    s.must_query("select sum(a) from wr")
+    s.domain.snapshot_workload_repo()
+    rows = s.must_query(
+        "select snapshot_ts, sql_digest, exec_count from "
+        "information_schema.workload_repo_statements")
+    assert len(rows) >= 3
+    assert any("count" in r[1] for r in rows)
+
+
+def test_autoid_service_ranges_and_durability(tmp_path):
+    """pkg/autoid_service analog: batched ranges from a persisted
+    counter; a reopened durable domain resumes PAST the last persisted
+    range end (id jump, never reuse)."""
+    from tidb_tpu.session import Domain, Session
+
+    d = str(tmp_path / "dd")
+    dom = Domain(data_dir=d)
+    s = Session(dom)
+    s.execute("create table au (id bigint auto_increment, v bigint, "
+              "primary key (id))")
+    s.execute("insert into au (v) values (10), (11)")
+    s.execute("insert into au values (500, 12)")     # explicit jump
+    s.execute("insert into au (v) values (13)")
+    got = s.must_query("select id, v from au order by v")
+    ids = [r[0] for r in got]
+    assert ids[:3] == [1, 2, 500]
+    assert ids[3] > 500                              # past the bump
+    assert dom.autoid.current(
+        s.domain.catalog.get_table("test", "au").table_id) >= ids[3]
+
+    # restart: allocation resumes past the persisted range end
+    dom2 = Domain(data_dir=d)
+    s2 = Session(dom2)
+    s2.execute("insert into au (v) values (14)")
+    new_id = s2.must_query("select id from au where v = 14")[0][0]
+    assert new_id > ids[3]

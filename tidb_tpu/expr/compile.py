@@ -37,6 +37,11 @@ K = dt.TypeKind
 Pair = tuple[Any, Any]  # (value, valid)
 
 
+# extension scalar functions (tidb_tpu/extension): name -> (callable,
+# arity); evaluated host-side row-at-a-time via Evaluator._ext_func
+EXTENSION_FUNCS: dict = {}
+
+
 def vand(a, b):
     if a is True:
         return b
@@ -75,10 +80,51 @@ class Evaluator:
                 return self.xp.asarray(e.value), True
             return e.value, True
         assert isinstance(e, Func)
+        if e.op.startswith("ext:"):
+            return self._ext_func(e, cols, memo)
         fn = getattr(self, f"op_{e.op}", None)
         if fn is None:
             raise NotImplementedError(f"op {e.op}")
         return fn(e, cols, memo)
+
+    def _ext_func(self, e: Func, cols, memo) -> Pair:
+        """Extension scalar function (pkg/extension function point): a
+        registered host python callable applied row-at-a-time — HOST
+        evaluation only (never device-fused; _device_supported excludes
+        ext: ops)."""
+        ext = EXTENSION_FUNCS.get(e.op[4:])
+        if ext is None:
+            raise NotImplementedError(f"extension function {e.op[4:]}")
+        fn, _arity = ext
+        vals = [self.eval(a, cols, memo) for a in e.args]
+        n = 1
+        for v, _m in vals:
+            if getattr(v, "ndim", 0):
+                n = max(n, len(v))
+        out = np.empty(n, np.float64)
+        ok = np.ones(n, bool)
+        for i in range(n):
+            row = []
+            null = False
+            for v, m in vals:
+                mv = m if m is True else (bool(m[i]) if getattr(
+                    m, "ndim", 0) else bool(m))
+                if not mv:
+                    null = True
+                    break
+                row.append(v[i].item() if getattr(v, "ndim", 0) else v)
+            if null:
+                ok[i] = False
+                out[i] = 0.0
+                continue
+            r = fn(*row)
+            if r is None:
+                ok[i] = False
+                out[i] = 0.0
+            else:
+                out[i] = float(r)
+        return self.xp.asarray(out), (True if ok.all()
+                                      else self.xp.asarray(ok))
 
     # -- helpers --------------------------------------------------------- #
 

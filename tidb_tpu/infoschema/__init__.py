@@ -129,6 +129,13 @@ def _top_sql(dom):
     return dom.stmt_summary.top_sql_rows()
 
 
+def _workload_repo(dom):
+    return [(time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(ts)),
+             dig, cnt, avg, mx, rows)
+            for ts, dig, cnt, avg, mx, rows
+            in getattr(dom, "workload_repo", [])]
+
+
 def _ddl_jobs(dom):
     if dom._ddl is None:
         return []
@@ -207,6 +214,10 @@ _INFORMATION_SCHEMA = {
                             ("AVG_LATENCY_MS", F), ("MAX_LATENCY_MS", F),
                             ("SUM_ROWS", I), ("QUERY_SAMPLE_TEXT", S)],
                            _stmt_summary),
+    "WORKLOAD_REPO_STATEMENTS": ([("SNAPSHOT_TS", S), ("SQL_DIGEST", S),
+                                  ("EXEC_COUNT", I), ("AVG_LATENCY_MS", F),
+                                  ("MAX_LATENCY_MS", F), ("SUM_ROWS", I)],
+                                 _workload_repo),
     "TIDB_TOP_SQL": ([("SQL_DIGEST", S), ("PLAN_DIGEST", S),
                       ("CPU_TIME_MS", F), ("EXEC_COUNT", I),
                       ("AVG_LATENCY_MS", F), ("QUERY_SAMPLE_TEXT", S),

@@ -380,6 +380,16 @@ class ExprBuilder:
             if name in ("CURDATE", "CURRENT_DATE"):
                 return Const(dt.date(False), micros // tmp.MICROS_PER_DAY)
             return Const(dt.datetime(False), micros)
+        from ..expr.compile import EXTENSION_FUNCS
+        ext = EXTENSION_FUNCS.get(name.lower())
+        if ext is not None:
+            fn, arity = ext
+            if arity >= 0 and len(args) != arity:
+                raise PlanError(
+                    f"function {name} expects {arity} arguments")
+            _taint_plan("extension")   # host fn: never cache its plan
+            return Func(dt.double(True), f"ext:{name.lower()}",
+                        tuple(args))
         raise PlanError(f"unsupported function {name}")
 
     def _str_func(self, op: str, *args: Expr) -> Expr:

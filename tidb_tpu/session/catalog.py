@@ -131,6 +131,11 @@ class TableInfo:
     # (set by the session at CREATE TABLE — planner/core/foreign_key.go)
     foreign_keys: list = field(default_factory=list)
     _fk_resolver: Any = None       # (table_name) -> TableInfo
+    # centralized autoid service (session/autoid.py): when bound, auto-inc
+    # values come from batched RANGES the service persists; None keeps the
+    # local counter (pre-service tables, tests)
+    _autoid: Any = None
+    _ai_cache_end: int = 0         # exclusive end of the fetched range
     # schema gate: writers hold read side per statement; online-DDL state
     # transitions take the write side to drain in-flight writers (the F1
     # schema-lease wait analog, utils/rwlock.py)
@@ -271,10 +276,23 @@ class TableInfo:
             for r in rows:
                 r = list(r)
                 if ai_idx >= 0 and r[ai_idx] is None:
+                    if self._autoid is not None \
+                            and self._auto_inc >= self._ai_cache_end:
+                        # range exhausted: fetch the next batch from the
+                        # centralized service (autoid_service analog)
+                        start, end = self._autoid.alloc_range(
+                            self.table_id, at_least=self._auto_inc)
+                        self._auto_inc, self._ai_cache_end = start, end
                     self._auto_inc += 1
                     r[ai_idx] = self._auto_inc
                 elif ai_idx >= 0 and isinstance(r[ai_idx], int):
-                    self._auto_inc = max(self._auto_inc, r[ai_idx])
+                    if r[ai_idx] > self._auto_inc:
+                        self._auto_inc = r[ai_idx]
+                        if self._autoid is not None \
+                                and r[ai_idx] >= self._ai_cache_end:
+                            self._autoid.bump(self.table_id, r[ai_idx])
+                            self._ai_cache_end = max(self._ai_cache_end,
+                                                     r[ai_idx])
                 for i, t in enumerate(self.col_types):
                     if r[i] is None and not t.nullable:
                         raise CatalogError(

@@ -94,6 +94,17 @@ class Domain:
         self.sysvars: dict[str, Any] = _sysvar_defaults()
         from ..utils.resourcegroup import ResourceGroupManager
         self.resource_groups = ResourceGroupManager()
+        from .autoid import AutoIDService
+        self.autoid = AutoIDService(self.kv)  # pkg/autoid_service analog
+        for _tables in self.catalog.databases.values():
+            for _t in _tables.values():       # durable-load rebind
+                _t._autoid = self.autoid
+        from ..extension import registry as _ext_registry
+        _ext_registry.setup_domain(self)   # pkg/extension bootstrap point
+        # workload repository (util/workloadrepo): periodic snapshots of
+        # the statement summary, queryable via
+        # information_schema.workload_repo_statements
+        self.workload_repo: list = []
 
     @property
     def dxf(self):
@@ -133,8 +144,21 @@ class Domain:
             "ttl", float(self.sysvars.get("tidb_ttl_job_interval_sec", 60)),
             lambda: run_ttl_sweep(self))
         self.timers.register("auto-analyze", 30.0, self._auto_analyze_sweep)
+        self.timers.register("workload-repo", 60.0,
+                             self.snapshot_workload_repo)
         self.timers.start()
         return self.timers
+
+    def snapshot_workload_repo(self):
+        """Workload repository sweep (pkg/util/workloadrepo): persist a
+        timestamped snapshot of the statement summary so workload history
+        survives summary eviction; bounded ring."""
+        import time as _time
+        now = _time.time()
+        for row in self.stmt_summary.summary_rows():
+            self.workload_repo.append((now,) + tuple(row[:5]))
+        if len(self.workload_repo) > 50_000:
+            del self.workload_repo[:25_000]
 
     def _auto_analyze_sweep(self):
         """Background auto-analyze (handle/autoanalyze.go worker)."""
@@ -615,6 +639,13 @@ class Session:
         from ..planner.ranger import apply_index_paths
         cache = self.domain.plan_cache
         merged = {**self.domain.sysvars, **self.vars}
+        # knob application precedes the plan-cache lookup: a cached plan
+        # must reflect the current planner knobs
+        bm0 = int(merged.get("tidb_tpu_broadcast_build_max_rows", -1)
+                  or -1)
+        if bm0 >= 0:
+            from ..executor import plan as _planmod0
+            _planmod0.BROADCAST_BUILD_MAX_ROWS = bm0
         use_cache = (cache_sql is not None
                      and _flag_on(merged, "tidb_enable_plan_cache"))
         if use_cache:
@@ -724,7 +755,16 @@ class Session:
         quota = int(merged.get("tidb_mem_quota_query", 1 << 30))
         if quota <= 0:
             quota = -1       # TiDB semantics: 0/negative = unlimited
-        return ExecContext(self.domain.client, merged,
+        client = self.domain.client
+        # engine knobs ride sysvars (the reference's every-perf-knob-is-a-
+        # sysvar discipline, vardef/tidb_vars.go)
+        cap = int(merged.get("tidb_tpu_device_mem_cap", -1) or -1)
+        if cap >= 0:
+            client.device_mem_cap = cap
+        rc = int(merged.get("tidb_tpu_result_cache_entries", -1) or -1)
+        if rc >= 0:
+            client._result_cache_cap = rc
+        return ExecContext(client, merged,
                            mem_tracker=Tracker("query", quota))
 
     def _exec_select(self, stmt) -> ResultSet:
@@ -897,7 +937,10 @@ class Session:
                 auto_inc = c.name
         tbl = TableInfo(stmt.name, names, types, stmt.primary_key, auto_inc,
                         table_id=self.domain.alloc_table_id(),
-                        kv=self.domain.kv)
+                        kv=self.domain.kv,
+                        n_shards=int({**self.domain.sysvars, **self.vars}
+                                     .get("tidb_tpu_shard_count", 8) or 8))
+        tbl._autoid = self.domain.autoid
         if stmt.ttl is not None:
             if stmt.ttl.column not in names:
                 raise CatalogError(

@@ -36,6 +36,17 @@ def _v(*args, **kw) -> SysVar:
 
 _VARS = [
     # engine-honored knobs
+    # TPU-engine knobs (this framework's own surface — the reference
+    # exposes every perf knob as a sysvar, vardef/tidb_vars.go)
+    # -1 = unset: the engine default (module constant / ctor value)
+    # stays authoritative until a user explicitly SETs the variable
+    _v("tidb_tpu_device_mem_cap", -1, kind="int", min=-1,
+       scope=SCOPE_GLOBAL),            # bytes; 0 = resident (no streaming)
+    _v("tidb_tpu_broadcast_build_max_rows", -1, kind="int", min=-1,
+       scope=SCOPE_GLOBAL),            # broadcast- vs shuffle-join cut
+    _v("tidb_tpu_shard_count", 8, kind="int", min=1, max=4096),
+    _v("tidb_tpu_result_cache_entries", -1, kind="int", min=-1,
+       max=4096, scope=SCOPE_GLOBAL),
     _v("tidb_distsql_scan_concurrency", 15, kind="int", min=1, max=256),
     _v("tidb_max_chunk_size", 1024, kind="int", min=32, max=65536),
     _v("tidb_enable_vectorized_expression", 1, kind="bool"),
@@ -118,7 +129,7 @@ _VARS = [
     _v("tidb_partition_prune_mode", "dynamic", kind="str",
        scope=SCOPE_NONE),
     _v("tidb_enable_paging", 1, kind="bool", scope=SCOPE_NONE),
-    _v("tidb_executor_concurrency", 5, kind="int", scope=SCOPE_NONE),
+    _v("tidb_executor_concurrency", 5, kind="int", min=1, max=256),
     _v("tidb_hash_join_concurrency", 5, kind="int", scope=SCOPE_NONE),
     _v("tidb_index_lookup_concurrency", 4, kind="int", scope=SCOPE_NONE),
     _v("tidb_build_stats_concurrency", 4, kind="int", scope=SCOPE_NONE),
@@ -149,6 +160,168 @@ _VARS = [
     _v("tidb_enable_index_merge", 1, kind="bool", scope=SCOPE_NONE),
     _v("tidb_enable_noop_functions", 0, kind="bool", scope=SCOPE_NONE),
     _v("tidb_row_format_version", 2, kind="int", scope=SCOPE_NONE),
+    # widely-set TiDB compatibility surface (noop scope): ORMs and
+    # operator tooling SET these freely; they must not error
+    _v("tidb_allow_batch_cop", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_allow_fallback_to_tikv", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_allow_mpp", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_auto_analyze_end_time", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_auto_analyze_start_time", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_backoff_lock_fast", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_backoff_weight", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_batch_commit", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_batch_delete", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_batch_insert", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_broadcast_join_threshold_count", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_broadcast_join_threshold_size", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_capture_plan_baselines", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_check_mb4_value_in_utf8", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_checksum_table_concurrency", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_committer_concurrency", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_current_ts", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_ddl_error_count_limit", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_ddl_flashback_concurrency", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_ddl_reorg_batch_size", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_ddl_reorg_priority", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_dml_batch_size", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_enable_amend_pessimistic_txn", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_enable_auto_increment_in_generated", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_enable_cascades_planner", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_enable_chunk_rpc", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_enable_column_tracking", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_enable_ddl", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_enable_enhanced_security", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_enable_exchange_partition", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_enable_extended_stats", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_enable_fast_analyze", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_enable_foreign_key", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_enable_gc_aware_memory_track", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_enable_global_index", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_enable_index_merge_join", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_enable_list_partition", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_enable_local_txn", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_enable_metadata_lock", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_enable_mutation_checker", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_enable_new_cost_interface", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_enable_new_only_full_group_by_check", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_enable_noop_variables", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_enable_null_aware_anti_join", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_enable_ordered_result_mode", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_enable_outer_join_reorder", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_enable_parallel_apply", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_enable_pipelined_window_function", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_enable_prepared_plan_cache", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_enable_pseudo_for_outdated_stats", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_enable_resource_control", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_enable_reuse_chunk", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_enable_slow_log", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_enable_strict_double_type_check", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_enable_tiflash_read_for_write_stmt", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_enable_top_sql", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_enable_tso_follower_proxy", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_evolve_plan_baselines", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_expensive_query_time_threshold", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_force_priority", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_gc_concurrency", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_gc_enable", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_gc_max_wait_time", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_gc_scan_lock_mode", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_general_log", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_generate_binary_plan", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_guarantee_linearizability", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_hash_exchange_with_new_collation", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_hashagg_final_concurrency", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_hashagg_partial_concurrency", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_ignore_prepared_cache_close_stmt", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_index_lookup_join_concurrency", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_index_lookup_size", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_index_merge_intersection_concurrency", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_index_serial_scan_concurrency", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_last_ddl_info", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_last_query_info", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_last_txn_info", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_log_file_max_days", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_low_resolution_tso", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_max_auto_analyze_time", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_max_delta_schema_count", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_max_paging_size", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_max_tiflash_threads", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_mem_oom_action", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_mem_quota_analyze", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_mem_quota_apply_cache", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_mem_quota_binding_cache", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_memory_usage_alarm_ratio", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_merge_join_concurrency", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_metric_query_range_duration", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_metric_query_step", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_min_paging_size", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_multi_statement_mode", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_nontransactional_ignore_error", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_opt_broadcast_cartesian_join", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_opt_concurrency_factor", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_opt_copcpu_factor", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_opt_correlation_exp_factor", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_opt_correlation_threshold", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_opt_cpu_factor", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_opt_desc_factor", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_opt_disk_factor", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_opt_distinct_agg_push_down", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_opt_enable_correlation_adjustment", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_opt_force_inline_cte", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_opt_insubq_to_join_and_agg", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_opt_limit_push_down_threshold", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_opt_memory_factor", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_opt_mpp_outer_join_fixed_build_side", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_opt_network_factor", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_opt_prefer_range_scan", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_opt_projection_push_down", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_opt_range_max_size", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_opt_scan_factor", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_opt_seek_factor", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_opt_skew_distinct_agg", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_opt_write_row_id", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_placement_mode", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_pprof_sql_cpu", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_prepared_plan_cache_memory_guard_ratio", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_prepared_plan_cache_size", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_projection_concurrency", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_query_log_max_len", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_rc_read_check_ts", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_read_consistency", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_read_staleness", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_record_plan_in_slow_log", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_redact_log", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_regard_null_as_point", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_remove_orderby_in_subquery", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_restricted_read_only", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_server_memory_limit", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_shard_allocate_step", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_simplified_metrics", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_skip_ascii_check", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_skip_isolation_level_check", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_slow_query_file", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_snapshot", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_source_id", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_stats_cache_mem_quota", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_stats_load_sync_wait", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_stmt_summary_history_size", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_stmt_summary_internal_query", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_stmt_summary_max_sql_length", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_stmt_summary_refresh_interval", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_store_limit", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_streamagg_concurrency", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_super_read_only", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_sysdate_is_now", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_table_cache_lease", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_tmp_table_max_size", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_top_sql_max_meta_count", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_top_sql_max_time_series_count", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_track_aggregate_memory_usage", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_tso_client_batch_max_wait_time", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_txn_assertion_level", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_txn_commit_batch_size", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_wait_split_region_timeout", "", kind="str", scope=SCOPE_NONE),
+    _v("tidb_window_concurrency", "", kind="str", scope=SCOPE_NONE),
 ]
 
 REGISTRY: dict[str, SysVar] = {v.name: v for v in _VARS}
