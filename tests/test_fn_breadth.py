@@ -1,0 +1,98 @@
+"""Builtin breadth round 3: string hashes, repeat/substring_index,
+soundex, strcmp/crc32, dayname/monthname via derived dictionaries,
+week/weekofyear, from_unixtime, makedate (builtin_string_vec.go /
+builtin_time_vec.go analogs), python-oracle verified."""
+
+import datetime
+import hashlib
+import zlib
+
+import pytest
+
+from tidb_tpu.session import Domain, Session
+
+
+@pytest.fixture(scope="module")
+def s():
+    s = Session(Domain())
+    s.execute("create table f (s varchar(20), d date, n bigint)")
+    s.execute(
+        "insert into f values ('hello world', '2024-01-01', 5), "
+        "('Smith', '2023-01-01', 17), ('abc,def,ghi', '2024-02-29', 0), "
+        "(null, null, null)")
+    return s
+
+
+def q(s, sql):
+    return s.must_query(sql)
+
+
+def test_string_valued_breadth(s):
+    assert q(s, "select repeat(s, 2) from f where n = 5") == \
+        [("hello worldhello world",)]
+    assert q(s, "select substring_index(s, ',', 2) from f where n = 0") \
+        == [("abc,def",)]
+    assert q(s, "select substring_index(s, ',', -1) from f where n = 0") \
+        == [("ghi",)]
+    assert q(s, "select hex(s) from f where n = 17") == \
+        [("Smith".encode().hex().upper(),)]
+    assert q(s, "select soundex(s) from f where n = 17") == [("S530",)]
+    assert q(s, "select repeat(s, 2) from f where s is null") == [(None,)]
+
+
+def test_hash_functions(s):
+    assert q(s, "select md5(s) from f where n = 5") == \
+        [(hashlib.md5(b"hello world").hexdigest(),)]
+    assert q(s, "select sha1(s) from f where n = 17") == \
+        [(hashlib.sha1(b"Smith").hexdigest(),)]
+    assert q(s, "select sha2(s, 256) from f where n = 5") == \
+        [(hashlib.sha256(b"hello world").hexdigest(),)]
+    assert q(s, "select sha2(s, 512) from f where n = 5") == \
+        [(hashlib.sha512(b"hello world").hexdigest(),)]
+    assert q(s, "select crc32(s) from f where n = 5") == \
+        [(zlib.crc32(b"hello world"),)]
+
+
+def test_strcmp(s):
+    assert q(s, "select strcmp(s, 'Smith') from f where n = 17") == [(0,)]
+    assert q(s, "select strcmp(s, 'Z') from f where n = 17") == [(-1,)]
+    assert q(s, "select strcmp('A', s) from f where n = 17") == [(-1,)]
+    assert q(s, "select strcmp('x', 'a') from f where n = 17") == [(1,)]
+
+
+def test_day_month_names(s):
+    assert q(s, "select dayname(d), monthname(d) from f where n = 5") == \
+        [("Monday", "January")]
+    assert q(s, "select dayname(d) from f where n = 0") == [("Thursday",)]
+    assert q(s, "select monthname(d) from f where n = 0") == \
+        [("February",)]
+    assert q(s, "select dayname(d) from f where d is null") == [(None,)]
+    # names group/filter like any dict-encoded string
+    assert q(s, "select count(*) from f where dayname(d) = 'Monday'") == \
+        [(1,)]
+
+
+def test_week_modes_match_python(s):
+    s.execute("create table dr (d date not null)")
+    base = datetime.date(2019, 12, 20)
+    vals = ",".join(
+        f"('{(base + datetime.timedelta(days=i)).isoformat()}')"
+        for i in range(800))
+    s.execute(f"insert into dr values {vals}")
+    for d, w in q(s, "select d, week(d, 3) from dr order by d"):
+        assert w == d.isocalendar()[1], (d, w)
+    # mode 0 spot checks (MySQL semantics)
+    assert q(s, "select week(d) from f where n = 5") == [(0,)]      # 2024-01-01
+    assert q(s, "select week(d, 0) from f where n = 17") == [(1,)]  # 2023-01-01 Sunday
+
+
+def test_from_unixtime_and_makedate(s):
+    assert str(q(s, "select from_unixtime(86400) from f where n = 5")
+               [0][0]).startswith("1970-01-02 00:00")
+    assert q(s, "select makedate(2024, 60) from f where n = 5") == \
+        [(datetime.date(2024, 2, 29),)]
+    assert q(s, "select makedate(2023, 0) from f where n = 5") == \
+        [(None,)]
+    # runtime (non-const) args ride the device scan path
+    assert str(q(s, "select from_unixtime(n * 86400) from f "
+                 "where n = 5")[0][0]).startswith("1970-01-06")

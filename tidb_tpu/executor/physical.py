@@ -54,7 +54,8 @@ DEVICE_OPS = {
     "year", "month", "dayofmonth", "dayofweek", "weekday", "dayofyear",
     "quarter", "hour", "minute", "second", "microsecond", "datediff",
     "dateadd_days", "dateadd_months", "dateadd_micros", "last_day",
-    "to_days", "from_days", "unix_timestamp",
+    "to_days", "from_days", "unix_timestamp", "week", "from_unixtime",
+    "makedate",
 }
 
 

@@ -216,10 +216,11 @@ def temporal_part(part: str, a: Expr) -> Func:
 
 STRING_VALUED_FUNCS = {"upper", "lower", "trim", "ltrim", "rtrim", "reverse",
                        "substring", "replace", "concat", "left", "right",
-                       "lpad", "rpad",
+                       "lpad", "rpad", "repeat", "substring_index",
+                       "md5", "sha1", "sha2", "hex", "soundex",
                        "json_extract", "json_unquote", "json_type"}
 STRING_INT_FUNCS = {"length", "char_length", "ascii", "locate", "instr",
-                    "find_in_set",
+                    "find_in_set", "crc32", "strcmp",
                     "json_valid", "json_length", "json_contains"}
 
 

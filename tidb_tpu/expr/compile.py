@@ -773,6 +773,52 @@ class Evaluator:
         v, m = self._num(e.args[0], cols, memo)
         return _as_i64(self.xp, v) - 719528, m
 
+    def op_week(self, e, cols, memo):
+        """WEEK(d[, mode]): mode 0 (MySQL default, Sunday-start, week 1 =
+        first week containing a Sunday) and mode 3 (ISO 8601, Monday-
+        start) — builtin_time.go weekMode subset, vectorized over the
+        civil-date math."""
+        from ..types.temporal import civil_from_days, days_from_civil
+        xp = self.xp
+        days, m = self._days_of(e.args[0], cols, memo)
+        days = _as_i64(xp, days)
+        mode = int(e.args[1].value) if len(e.args) > 1 else 0
+        if mode == 3:
+            # the ISO week of d is the week of d's Thursday
+            thursday = days - (days + 3) % 7 + 3
+            y, _, _ = civil_from_days(xp, thursday)
+            j = days_from_civil(xp, y, 1, 1)
+            return (thursday - j) // 7 + 1, m
+        y, _, _ = civil_from_days(xp, days)
+        j = days_from_civil(xp, y, 1, 1)
+        fs = j + (7 - (j + 4) % 7) % 7       # first Sunday of the year
+        return xp.maximum(xp.floor_divide(days - fs, 7) + 1, 0), m
+
+    def op_from_unixtime(self, e, cols, memo):
+        from ..types.temporal import MICROS_PER_SEC
+        v, m = self._num(e.args[0], cols, memo)
+        a = e.args[0]
+        if a.dtype.kind == K.DECIMAL:
+            from ..types import decimal as dec
+            micros = _as_i64(self.xp, v) * (
+                MICROS_PER_SEC // dec.pow10(min(a.dtype.scale, 6)))
+        else:
+            micros = _as_i64(self.xp, v) * MICROS_PER_SEC
+        return micros, m
+
+    def op_makedate(self, e, cols, memo):
+        """MAKEDATE(year, dayofyear) -> DATE; NULL when dayofyear < 1."""
+        from ..types.temporal import days_from_civil
+        xp = self.xp
+        y, my = self._num(e.args[0], cols, memo)
+        doy, md = self._num(e.args[1], cols, memo)
+        y = _as_i64(xp, y)
+        doy = _as_i64(xp, doy)
+        j = days_from_civil(xp, y, 1, 1)
+        out = j + doy - 1
+        ok = doy >= 1
+        return out, vand(vand(my, md), ok)
+
     def op_unix_timestamp(self, e, cols, memo):
         from ..types.temporal import MICROS_PER_DAY, MICROS_PER_SEC
         xp = self.xp

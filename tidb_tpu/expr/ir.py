@@ -91,14 +91,26 @@ def referenced_columns(e: Expr) -> set[int]:
     return {n.index for n in walk(e) if isinstance(n, ColumnRef)}
 
 
+def clone_func(e: "Func", args) -> "Func":
+    """Rebuild a Func with new args, preserving side-channel annotations
+    (a dict_map's derived output dictionary) — EVERY plan rewrite that
+    reconstructs Func nodes must go through this."""
+    out = Func(e.dtype, e.op, tuple(args))
+    d = getattr(e, "_derived_dict", None)
+    if d is not None:
+        object.__setattr__(out, "_derived_dict", d)
+    return out
+
+
 def map_column_indices(e: Expr, mapping: dict[int, int]) -> Expr:
     """Rewrite ColumnRef indices (used when pruning/reordering schemas)."""
     if isinstance(e, ColumnRef):
         return ColumnRef(e.dtype, mapping[e.index], e.name)
     if isinstance(e, Func):
-        return Func(e.dtype, e.op, tuple(map_column_indices(a, mapping) for a in e.args))
+        return clone_func(e, (map_column_indices(a, mapping)
+                              for a in e.args))
     return e
 
 
-__all__ = ["Expr", "ColumnRef", "Const", "Func", "walk",
+__all__ = ["Expr", "ColumnRef", "Const", "Func", "walk", "clone_func",
            "referenced_columns", "map_column_indices"]

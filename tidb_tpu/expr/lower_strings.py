@@ -122,8 +122,9 @@ def lower_strings(e: Expr, dicts: dict[int, StringDict]) -> Expr:
     nodes recurse."""
     if not isinstance(e, Func):
         return e
+    from .ir import clone_func
     args = tuple(lower_strings(a, dicts) for a in e.args)
-    e = Func(e.dtype, e.op, args)
+    e = clone_func(e, args)
 
     from .builders import STRING_INT_FUNCS, STRING_VALUED_FUNCS
     if e.op in STRING_VALUED_FUNCS:
@@ -301,6 +302,54 @@ def _str_valued_impl(op: str, consts: list):
         n, pad = int(consts[0]), str(consts[1])
         return lambda v: (v[:n] if len(v) >= n or not pad
                           else v + (pad * n)[:n - len(v)])
+    if op == "repeat":
+        n = int(consts[0])
+        return lambda v: v * n if n > 0 else ""
+    if op == "substring_index":
+        delim, count = str(consts[0]), int(consts[1])
+
+        def _si(v, delim=delim, count=count):
+            if not delim or count == 0:
+                return ""
+            parts = v.split(delim)
+            if count > 0:
+                return delim.join(parts[:count])
+            return delim.join(parts[count:])
+        return _si
+    if op == "md5":
+        import hashlib
+        return lambda v: hashlib.md5(v.encode()).hexdigest()
+    if op == "sha1":
+        import hashlib
+        return lambda v: hashlib.sha1(v.encode()).hexdigest()
+    if op == "sha2":
+        import hashlib
+        bits = int(consts[0]) if consts else 256
+        algo = {0: "sha256", 224: "sha224", 256: "sha256",
+                384: "sha384", 512: "sha512"}.get(bits)
+        if algo is None:
+            return lambda v: None          # MySQL: invalid bits -> NULL
+        return lambda v, a=algo: hashlib.new(a, v.encode()).hexdigest()
+    if op == "hex":
+        return lambda v: v.encode("utf-8").hex().upper()
+    if op == "soundex":
+        def _soundex(v):
+            codes = {**dict.fromkeys("BFPV", "1"),
+                     **dict.fromkeys("CGJKQSXZ", "2"),
+                     **dict.fromkeys("DT", "3"), "L": "4",
+                     **dict.fromkeys("MN", "5"), "R": "6"}
+            s = [c for c in v.upper() if c.isalpha()]
+            if not s:
+                return ""
+            out = [s[0]]
+            prev = codes.get(s[0], "")
+            for c in s[1:]:
+                code = codes.get(c, "")
+                if code and code != prev:
+                    out.append(code)
+                prev = code if c not in "HW" else prev
+            return ("".join(out) + "000")[:4]
+        return _soundex
     return None
 
 
@@ -390,6 +439,12 @@ def fold_string_func(e: Expr) -> Optional[Const]:
             needle = str(vals[0])
             r = parts.index(needle) + 1 if needle in parts else 0
             return Const(e.dtype, int(r))
+        if e.op == "crc32":
+            import zlib
+            return Const(e.dtype, zlib.crc32(str(vals[0]).encode()))
+        if e.op == "strcmp":
+            a_, b_ = str(vals[0]), str(vals[1])
+            return Const(e.dtype, (a_ > b_) - (a_ < b_))
         if e.op == "length":
             r = len(str(vals[0]).encode("utf-8"))
         elif e.op == "char_length":
@@ -424,11 +479,11 @@ def string_func_arg_error(e: Func) -> Optional[str]:
         return None
     if e.op == "concat":
         return None
-    if e.op == "find_in_set":
+    if e.op in ("find_in_set", "strcmp"):
         # either argument may be the per-row column (not both)
         n_const = sum(isinstance(a, Const) for a in e.args)
         if n_const == 0:
-            return ("FIND_IN_SET: one of the two arguments must be a "
+            return (f"{e.op.upper()}: one of the two arguments must be a "
                     "constant")
         return None
     col_pos = 1 if e.op == "locate" else 0
@@ -544,7 +599,8 @@ def _lower_gl_strings(e: Func, args, dicts) -> Optional[Expr]:
             if len(d) else np.zeros(1, np.int32)
         new_args.append(Func(a.dtype, "dict_map",
                              (a, Const(dt.bigint(False), mapping))))
-    node = Func(e.dtype, e.op, tuple(new_args))
+    from .ir import clone_func
+    node = clone_func(e, new_args)
     object.__setattr__(node, "_derived_dict", merged)
     return node
 
@@ -582,6 +638,27 @@ def _lower_str_int(e: Func, args, dicts) -> Optional[Expr]:
         lut = [v.find(str(needle), start) + 1 for v in d.values]
         return B.dict_ilut(col, np.asarray(lut if lut else [0], np.int64),
                            e.dtype)
+    if e.op == "crc32":
+        import zlib
+        col = args[0]
+        d = _dict_for(col, dicts)
+        if d is None:
+            return None
+        lut = [zlib.crc32(v.encode()) for v in d.values]
+        return B.dict_ilut(col, np.asarray(lut if lut else [0], np.int64),
+                           e.dtype)
+    if e.op == "strcmp":
+        # one side a dict column, the other a string constant (binary
+        # byte order, like the reference's strcmp over binary collation)
+        for ci, flip in ((0, 1), (1, -1)):
+            d = _dict_for(args[ci], dicts)
+            s = _const_str(args[1 - ci])
+            if d is not None and s is not None:
+                lut = [flip * ((v > s) - (v < s)) for v in d.values]
+                return B.dict_ilut(
+                    args[ci], np.asarray(lut if lut else [0], np.int64),
+                    e.dtype)
+        return None
     if e.op == "find_in_set":
         def fis(needle: str, lst: str) -> int:
             # MySQL: empty LIST never matches, but an empty NEEDLE does

@@ -333,8 +333,46 @@ class ExprBuilder:
             return self._str_func("concat", *args)
         if name in ("TRIM", "LTRIM", "RTRIM", "REVERSE", "REPLACE",
                     "LEFT", "RIGHT", "LPAD", "RPAD", "ASCII", "LOCATE",
-                    "INSTR"):
+                    "INSTR", "REPEAT", "SUBSTRING_INDEX", "MD5", "SHA1",
+                    "SHA2", "HEX", "SOUNDEX", "CRC32", "STRCMP"):
             return self._str_func(name.lower(), *args)
+        if name == "SHA":
+            return self._str_func("sha1", *args)
+        if name in ("WEEK", "WEEKOFYEAR"):
+            base = args[0]
+            if base.dtype.kind not in (K.DATE, K.DATETIME):
+                raise PlanError(f"{name} needs a date operand")
+            mode = 3 if name == "WEEKOFYEAR" else 0
+            if name == "WEEK" and len(args) > 1:
+                if not (isinstance(args[1], Const)
+                        and args[1].value in (0, 3)):
+                    raise PlanError("WEEK supports modes 0 and 3")
+                mode = int(args[1].value)
+            return Func(dt.bigint(base.dtype.nullable), "week",
+                        (base, B.lit(mode)))
+        if name == "FROM_UNIXTIME" and len(args) == 1:
+            return Func(dt.datetime(args[0].dtype.nullable),
+                        "from_unixtime", (args[0],))
+        if name == "MAKEDATE":
+            return Func(dt.date(True), "makedate", (args[0], args[1]))
+        if name in ("DAYNAME", "MONTHNAME"):
+            base = args[0]
+            if base.dtype.kind not in (K.DATE, K.DATETIME):
+                raise PlanError(f"{name} needs a date operand")
+            from ..expr.lower_strings import _derived_map
+            if name == "DAYNAME":
+                names_ = ["Monday", "Tuesday", "Wednesday", "Thursday",
+                          "Friday", "Saturday", "Sunday"]
+                key = Func(dt.bigint(base.dtype.nullable), "weekday",
+                           (base,))
+            else:
+                names_ = ["", "January", "February", "March", "April",
+                          "May", "June", "July", "August", "September",
+                          "October", "November", "December"]
+                key = Func(dt.bigint(base.dtype.nullable), "month",
+                           (base,))
+            return _derived_map(
+                dt.varchar(base.dtype.nullable), key, names_)
         if name == "POSITION":
             return self._str_func("locate", args[0], args[1])
         if name == "FIND_IN_SET":
@@ -1121,7 +1159,8 @@ def _build_agg_select(sel: A.SelectStmt, items, child) -> tuple[LogicalPlan, lis
             raise PlanError(
                 f"column {e.name!r} must appear in GROUP BY or an aggregate")
         if isinstance(e, Func):
-            return Func(e.dtype, e.op, tuple(remap(a) for a in e.args))
+            from ..expr.ir import clone_func
+            return clone_func(e, (remap(a) for a in e.args))
         return e
 
     final_exprs = [remap(e) for e in raw_items]
@@ -1286,7 +1325,8 @@ def _build_window_select(sel: A.SelectStmt, items, child):
         if isinstance(e, _WinRef):
             return ColumnRef(e.dtype, n_child + e.win_index, e.name)
         if isinstance(e, Func):
-            return Func(e.dtype, e.op, tuple(remap(a) for a in e.args))
+            from ..expr.ir import clone_func
+            return clone_func(e, (remap(a) for a in e.args))
         return e
 
     exprs = [remap(e) for e in raw]

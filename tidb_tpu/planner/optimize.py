@@ -16,7 +16,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..expr.compile import eval_expr
-from ..expr.ir import ColumnRef, Const, Expr, Func, referenced_columns
+from ..expr.ir import clone_func, ColumnRef, Const, Expr, Func, referenced_columns
 from ..types import dtypes as dt
 from .build import _split_cnf
 from .logical import (DataSource, LogicalAggregate, LogicalJoin, LogicalLimit,
@@ -31,14 +31,14 @@ from .logical import (DataSource, LogicalAggregate, LogicalJoin, LogicalLimit,
 def _fold_expr(e: Expr) -> Expr:
     if isinstance(e, Func):
         args = tuple(_fold_expr(a) for a in e.args)
-        e = Func(e.dtype, e.op, args)
+        e = clone_func(e, args)
         if args and all(isinstance(a, Const) and not isinstance(a.value, np.ndarray)
                         for a in args) and e.op not in ("dict_lut", "dict_map"):
             try:
                 v, m = eval_expr(np, e, [])
             except Exception:
                 return e
-            if m is False:
+            if m is not True and not bool(np.all(m)):
                 return Const(dt.null_type(), None)
             val = v.item() if hasattr(v, "item") else v
             if isinstance(val, bool):
@@ -83,7 +83,7 @@ def _extract_or_common(e: Expr) -> Expr:
     predicate pushdown."""
     if not (isinstance(e, Func) and e.op == "or"):
         if isinstance(e, Func):
-            return Func(e.dtype, e.op,
+            return clone_func(e,
                         tuple(_extract_or_common(a) for a in e.args))
         return e
     branches = _split_dnf(e)
@@ -127,7 +127,7 @@ def _subst(e: Expr, exprs: list[Expr]) -> Expr:
     if isinstance(e, ColumnRef):
         return exprs[e.index]
     if isinstance(e, Func):
-        return Func(e.dtype, e.op, tuple(_subst(a, exprs) for a in e.args))
+        return clone_func(e, (_subst(a, exprs) for a in e.args))
     return e
 
 
@@ -135,7 +135,7 @@ def _remap(e: Expr, offset: int) -> Expr:
     if isinstance(e, ColumnRef):
         return ColumnRef(e.dtype, e.index + offset, e.name)
     if isinstance(e, Func):
-        return Func(e.dtype, e.op, tuple(_remap(a, offset) for a in e.args))
+        return clone_func(e, (_remap(a, offset) for a in e.args))
     return e
 
 
@@ -396,7 +396,7 @@ def map_refs(e: Expr, mapping: dict[int, int]) -> Expr:
     if isinstance(e, ColumnRef):
         return ColumnRef(e.dtype, mapping[e.index], e.name)
     if isinstance(e, Func):
-        return Func(e.dtype, e.op, tuple(map_refs(a, mapping) for a in e.args))
+        return clone_func(e, (map_refs(a, mapping) for a in e.args))
     return e
 
 
