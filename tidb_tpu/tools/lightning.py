@@ -140,3 +140,90 @@ def _duplicate_check(tbl):
 
 
 __all__ = ["import_csv"]
+
+
+def global_sort_import(domain, db: str, table: str, path: str,
+                       run_dir: str, mem_budget_bytes: int = 64 << 20,
+                       has_header: bool = True,
+                       ingest_batch: int = 8192) -> int:
+    """Bulk import through GLOBAL SORT on external storage (the
+    lightning external backend, pkg/lightning/backend/external): stream
+    the source, encode record + index KV pairs, spill sorted runs to
+    `run_dir` under a memory budget, then k-way-merge the runs and
+    ingest one fully KEY-ORDERED stream — the path that scales past RAM
+    where import_csv materializes the file.
+
+    `run_dir` is the external-storage seam: re-running with the same
+    directory resumes from completed runs (only the unfinished tail of
+    the source re-encodes)."""
+    import csv as _csv
+
+    from .external_sort import ExternalSorter
+
+    tbl = domain.catalog.get_table(db, table)
+    if tbl.kv is None:
+        raise ValueError("bulk import needs a KV-backed table")
+
+    def to_value(raw: str, t):
+        if raw == "\\N" or raw == "":
+            return None
+        if t.is_integer:
+            return int(raw)
+        if t.is_float:
+            return float(raw)
+        return raw
+
+    sorter = ExternalSorter(run_dir, mem_budget_bytes)
+    n_rows = 0
+    with tbl.schema_gate.read():
+        if not sorter.runs:          # fresh import: encode + spill runs
+            with open(path, newline="") as f:
+                reader = _csv.reader(f)
+                first = True
+                with tbl._alloc_mu:
+                    handle = tbl._next_handle
+                for raw in reader:
+                    if first:
+                        first = False
+                        if has_header:
+                            continue
+                    if not raw:
+                        continue
+                    vals = tuple(to_value(c, t)
+                                 for c, t in zip(raw, tbl.col_types))
+                    for i, t in enumerate(tbl.col_types):
+                        if vals[i] is None and not t.nullable:
+                            raise ValueError(
+                                "NULL in NOT NULL column "
+                                f"{tbl.col_names[i]!r}")
+                    handle += 1
+                    n_rows += 1
+                    k, v = encode_table_row(tbl.table_id, handle, vals,
+                                            tbl.col_types)
+                    sorter.add(k, v)
+                    for ix in tbl.writable_indexes():
+                        ik, iv = tbl._index_entry(ix, vals, handle)
+                        sorter.add(ik, iv)
+                with tbl._alloc_mu:
+                    tbl._next_handle = max(tbl._next_handle, handle)
+            sorter.flush()
+        # merge-read every run in key order, ingest in batches
+        txn = tbl.kv.begin()
+        in_batch = 0
+        from ..store.codec import record_prefix
+        rec_prefix = record_prefix(tbl.table_id)
+        merged_rows = 0
+        for k, v in sorter.merged():
+            txn.put(k, v)
+            if k.startswith(rec_prefix):
+                merged_rows += 1
+            in_batch += 1
+            if in_batch >= ingest_batch:
+                txn.commit()
+                txn = tbl.kv.begin()
+                in_batch = 0
+        txn.commit()
+    sorter.cleanup()
+    tbl._invalidate()
+    _duplicate_check(tbl)
+    return n_rows or merged_rows
