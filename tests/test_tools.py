@@ -263,3 +263,65 @@ def test_global_sort_import(tmp_path):
     got_ids = sorted(r[0] for r in s.must_query(
         "select id from gs where v = 13"))
     assert got_ids == [i for i in range(n) if i % 97 == 13]
+
+
+def test_global_sort_import_rejects_stale_run_dir(tmp_path):
+    """A partial earlier attempt's runs must not be mistaken for the
+    whole source (review r3): stale run dirs are rejected."""
+    from tidb_tpu.session import Domain, Session
+    from tidb_tpu.tools.external_sort import ExternalSorter
+    from tidb_tpu.tools.lightning import global_sort_import
+
+    dom = Domain()
+    s = Session(dom)
+    s.execute("create table gsr (id bigint)")
+    p = tmp_path / "one.csv"
+    p.write_text("id\n1\n2\n")
+    d = str(tmp_path / "runs")
+    stale = ExternalSorter(d, mem_budget_bytes=1 << 16)
+    stale.add(b"k", b"v")
+    stale.flush()
+    with pytest.raises(ValueError, match="earlier attempt"):
+        global_sort_import(dom, "test", "gsr", str(p), d)
+
+
+def test_global_sort_import_safe_under_concurrent_inserts(tmp_path):
+    """Handle blocks reserve under the allocation lock, so imported rows
+    and concurrent INSERTs can never collide (review r3)."""
+    import threading
+
+    from tidb_tpu.session import Domain, Session
+    from tidb_tpu.tools.lightning import global_sort_import
+
+    dom = Domain()
+    s = Session(dom)
+    s.execute("create table gci (id bigint, v bigint)")
+    n = 2500
+    p = tmp_path / "c.csv"
+    with open(p, "w") as f:
+        f.write("id,v\n")
+        for i in range(n):
+            f.write(f"{i},{i}\n")
+    stop = threading.Event()
+    inserted = [0]
+
+    def writer():
+        s2 = Session(dom)
+        while not stop.is_set():
+            s2.execute(f"insert into gci values (-1, {inserted[0]})")
+            inserted[0] += 1
+
+    t = threading.Thread(target=writer)
+    t.start()
+    try:
+        got = global_sort_import(dom, "test", "gci", str(p),
+                                 str(tmp_path / "runs"),
+                                 mem_budget_bytes=1 << 15)
+    finally:
+        stop.set()
+        t.join()
+    assert got == n
+    total = s.must_query("select count(*) from gci")[0][0]
+    assert total == n + inserted[0]          # nothing overwritten
+    assert s.must_query(
+        "select count(*) from gci where id >= 0") == [(n,)]

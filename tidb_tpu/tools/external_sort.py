@@ -25,9 +25,14 @@ KV = Tuple[bytes, bytes]
 _MAGIC = b"XSRT1\n"
 
 
+_TAIL = b"XSRTEND1"
+
+
 class RunWriter:
     """One sorted run file: length-prefixed (key, value) records in key
-    order + a stats footer (external/onefile writer analog)."""
+    order, closed by a STATS FOOTER (count, min_key, max_key) readable in
+    O(1) from the file tail — the external/onefile writer's statistics
+    that a merge planner splits key ranges from."""
 
     def __init__(self, path: str):
         self.path = path
@@ -52,20 +57,51 @@ class RunWriter:
             self.count += 1
 
     def close(self) -> None:
+        mn = self.min_key or b""
+        mx = self.max_key or b""
+        footer = struct.pack("<QII", self.count, len(mn), len(mx)) + mn + mx
+        self._f.write(footer)
+        self._f.write(struct.pack("<I", len(footer)))
+        self._f.write(_TAIL)
         self._f.close()
+
+
+def run_stats(path: str) -> tuple:
+    """(count, min_key, max_key) from the footer — O(1), no data scan."""
+    with open(path, "rb") as f:
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        f.seek(size - len(_TAIL) - 4)
+        flen_raw = f.read(4)
+        if f.read(len(_TAIL)) != _TAIL:
+            raise ValueError(f"{path}: missing sorted-run footer")
+        flen = struct.unpack("<I", flen_raw)[0]
+        f.seek(size - len(_TAIL) - 4 - flen)
+        footer = f.read(flen)
+    count, lmn, lmx = struct.unpack("<QII", footer[:16])
+    mn = footer[16:16 + lmn]
+    mx = footer[16 + lmn:16 + lmn + lmx]
+    return count, (mn if lmn else None), (mx if lmx else None)
+
+
+def _payload_end(path: str) -> int:
+    with open(path, "rb") as f:
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        f.seek(size - len(_TAIL) - 4)
+        flen = struct.unpack("<I", f.read(4))[0]
+    return size - len(_TAIL) - 4 - flen
 
 
 def read_run(path: str, start: Optional[bytes] = None,
              end: Optional[bytes] = None) -> Iterator[KV]:
     """Stream one run in key order, optionally clipped to [start, end)."""
+    stop = _payload_end(path)
     with open(path, "rb") as f:
         if f.read(len(_MAGIC)) != _MAGIC:
             raise ValueError(f"{path}: not a sorted-run file")
-        while True:
-            hdr = f.read(8)
-            if len(hdr) < 8:
-                return
-            lk, lv = struct.unpack("<II", hdr)
+        while f.tell() < stop:
+            lk, lv = struct.unpack("<II", f.read(8))
             k = f.read(lk)
             v = f.read(lv)
             if end is not None and k >= end:
@@ -121,18 +157,10 @@ class ExternalSorter:
         yield from heapq.merge(*streams, key=lambda kv: kv[0])
 
     def stats(self) -> list[tuple]:
-        """(path, count, min_key, max_key) per run — the footer stats a
-        merge planner splits ranges from."""
-        out = []
-        for p in self.runs:
-            cnt, mn, mx = 0, None, None
-            for k, _v in read_run(p):
-                if mn is None:
-                    mn = k
-                mx = k
-                cnt += 1
-            out.append((p, cnt, mn, mx))
-        return out
+        """(path, count, min_key, max_key) per run, read from each run's
+        footer in O(1) — the statistics a merge planner splits key ranges
+        from."""
+        return [(p,) + run_stats(p) for p in self.runs]
 
     def cleanup(self) -> None:
         for p in self.runs:
@@ -143,4 +171,4 @@ class ExternalSorter:
         self.runs = []
 
 
-__all__ = ["ExternalSorter", "RunWriter", "read_run"]
+__all__ = ["ExternalSorter", "RunWriter", "read_run", "run_stats"]

@@ -25,6 +25,18 @@ from ..session.codec_io import encode_table_row
 CHUNK_ROWS = 4096        # one checkpointed ingest unit (region/SST analog)
 
 
+def _to_value(raw: str, t):
+    """CSV field -> python value in the column's type (shared by both
+    import paths so NULL/number coercion can never diverge)."""
+    if raw == "\\N" or raw == "":
+        return None
+    if t.is_integer:
+        return int(raw)
+    if t.is_float:
+        return float(raw)
+    return raw
+
+
 def import_csv(domain, db: str, table: str, path: str,
                threads: int = 4, has_header: bool = True,
                checkpoint_path: Optional[str] = None) -> int:
@@ -59,14 +71,7 @@ def import_csv(domain, db: str, table: str, path: str,
             h += len(chunk)
         tbl._next_handle = h
 
-    def to_value(raw: str, t):
-        if raw == "\\N" or raw == "":
-            return None
-        if t.is_integer:
-            return int(raw)
-        if t.is_float:
-            return float(raw)
-        return raw
+    to_value = _to_value
 
     def ingest_chunk(arg) -> int:
         ci, chunk = arg
@@ -139,9 +144,6 @@ def _duplicate_check(tbl):
                 f"unique index {ix.name!r} of {tbl.name!r}")
 
 
-__all__ = ["import_csv"]
-
-
 def global_sort_import(domain, db: str, table: str, path: str,
                        run_dir: str, mem_budget_bytes: int = 64 << 20,
                        has_header: bool = True,
@@ -153,9 +155,11 @@ def global_sort_import(domain, db: str, table: str, path: str,
     ingest one fully KEY-ORDERED stream — the path that scales past RAM
     where import_csv materializes the file.
 
-    `run_dir` is the external-storage seam: re-running with the same
-    directory resumes from completed runs (only the unfinished tail of
-    the source re-encodes)."""
+    `run_dir` must be empty/fresh: a partial previous attempt's runs are
+    an incomplete encode, so resuming from them would silently drop data
+    (re-run imports re-encode from the source instead).  Handle ranges
+    reserve in blocks under the table's allocation lock, so concurrent
+    INSERTs can never collide with imported rows."""
     import csv as _csv
 
     from .external_sort import ExternalSorter
@@ -163,60 +167,64 @@ def global_sort_import(domain, db: str, table: str, path: str,
     tbl = domain.catalog.get_table(db, table)
     if tbl.kv is None:
         raise ValueError("bulk import needs a KV-backed table")
-
-    def to_value(raw: str, t):
-        if raw == "\\N" or raw == "":
-            return None
-        if t.is_integer:
-            return int(raw)
-        if t.is_float:
-            return float(raw)
-        return raw
-
     sorter = ExternalSorter(run_dir, mem_budget_bytes)
+    if sorter.runs:
+        raise ValueError(
+            f"run_dir {run_dir!r} already holds sorted runs from an "
+            "earlier attempt; use a fresh directory (a partial encode "
+            "must not be mistaken for the whole source)")
+
+    HBLOCK = 65536
+    block_next, block_end = 0, 0
+
+    def next_handle() -> int:
+        nonlocal block_next, block_end
+        if block_next >= block_end:
+            with tbl._alloc_mu:
+                block_next = tbl._next_handle + 1
+                tbl._next_handle += HBLOCK
+            block_end = block_next + HBLOCK
+        h = block_next
+        block_next += 1
+        return h
+
     n_rows = 0
     with tbl.schema_gate.read():
-        if not sorter.runs:          # fresh import: encode + spill runs
-            with open(path, newline="") as f:
-                reader = _csv.reader(f)
-                first = True
-                with tbl._alloc_mu:
-                    handle = tbl._next_handle
-                for raw in reader:
-                    if first:
-                        first = False
-                        if has_header:
-                            continue
-                    if not raw:
+        with open(path, newline="") as f:
+            reader = _csv.reader(f)
+            first = True
+            for raw in reader:
+                if first:
+                    first = False
+                    if has_header:
                         continue
-                    vals = tuple(to_value(c, t)
-                                 for c, t in zip(raw, tbl.col_types))
-                    for i, t in enumerate(tbl.col_types):
-                        if vals[i] is None and not t.nullable:
-                            raise ValueError(
-                                "NULL in NOT NULL column "
-                                f"{tbl.col_names[i]!r}")
-                    handle += 1
-                    n_rows += 1
-                    k, v = encode_table_row(tbl.table_id, handle, vals,
-                                            tbl.col_types)
-                    sorter.add(k, v)
-                    for ix in tbl.writable_indexes():
-                        ik, iv = tbl._index_entry(ix, vals, handle)
-                        sorter.add(ik, iv)
-                with tbl._alloc_mu:
-                    tbl._next_handle = max(tbl._next_handle, handle)
-            sorter.flush()
+                if not raw:
+                    continue
+                if len(raw) != len(tbl.col_names):
+                    raise ValueError(
+                        f"row width {len(raw)} != {len(tbl.col_names)} "
+                        f"columns: {raw!r}")
+                vals = tuple(_to_value(c, t)
+                             for c, t in zip(raw, tbl.col_types))
+                for i, t in enumerate(tbl.col_types):
+                    if vals[i] is None and not t.nullable:
+                        raise ValueError(
+                            "NULL in NOT NULL column "
+                            f"{tbl.col_names[i]!r}")
+                h = next_handle()
+                n_rows += 1
+                k, v = encode_table_row(tbl.table_id, h, vals,
+                                        tbl.col_types)
+                sorter.add(k, v)
+                for ix in tbl.writable_indexes():
+                    ik, iv = tbl._index_entry(ix, vals, h)
+                    sorter.add(ik, iv)
+        sorter.flush()
         # merge-read every run in key order, ingest in batches
         txn = tbl.kv.begin()
         in_batch = 0
-        from ..store.codec import record_prefix
-        rec_prefix = record_prefix(tbl.table_id)
-        merged_rows = 0
         for k, v in sorter.merged():
             txn.put(k, v)
-            if k.startswith(rec_prefix):
-                merged_rows += 1
             in_batch += 1
             if in_batch >= ingest_batch:
                 txn.commit()
@@ -226,4 +234,7 @@ def global_sort_import(domain, db: str, table: str, path: str,
     sorter.cleanup()
     tbl._invalidate()
     _duplicate_check(tbl)
-    return n_rows or merged_rows
+    return n_rows
+
+
+__all__ = ["import_csv", "global_sort_import"]
