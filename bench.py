@@ -262,6 +262,9 @@ def _bench_one_sf(sf, platform, n_chips, iters):
     q1_names = [n for n in names if n != "l_partkey"]
     snap = snapshot_from_columns(q1_names, q1_cols, n_shards=n_shards)
     client = CopClient(mesh)
+    # the bench measures ENGINE throughput: identical repeated dispatches
+    # must not short-circuit through the coprocessor result cache
+    client._result_cache_cap = 0
     # tables beyond the HBM budget stream in double-buffered batches
     cap = int(os.environ.get("BENCH_DEVICE_MEM_CAP", "0") or 0)
     client.device_mem_cap = cap or (12 << 30 if platform != "cpu" else 0)
