@@ -96,3 +96,32 @@ def test_from_unixtime_and_makedate(s):
     # runtime (non-const) args ride the device scan path
     assert str(q(s, "select from_unixtime(n * 86400) from f "
                  "where n = 5")[0][0]).startswith("1970-01-06")
+
+
+def test_host_string_producers(s):
+    """date_format / concat_ws / bin-oct-hex(int) / format evaluate in
+    host root executors and dictionary-encode their produced strings."""
+    assert q(s, "select date_format(d, '%Y-%m-%d') from f where n = 0") \
+        == [("2024-02-29",)]
+    assert q(s, "select date_format(d, '%W %M %e, %Y') from f "
+             "where n = 5") == [("Monday January 1, 2024",)]
+    assert q(s, "select date_format(d, '%y/%c/%d %H:%i') from f "
+             "where n = 17") == [("23/1/01 00:00",)]
+    assert q(s, "select date_format(d, '%j') from f where n = 0") == \
+        [("060",)]
+    assert q(s, "select date_format(d, '%Y') from f where d is null") == \
+        [(None,)]
+    # grouping/filtering on the produced strings works (dict-encoded)
+    assert q(s, "select count(*) from f where "
+             "date_format(d, '%Y') = '2024'") == [(2,)]
+
+    s.execute("create table cw (a varchar(8) not null, "
+              "b varchar(8) not null)")
+    s.execute("insert into cw values ('x', 'y'), ('p', 'q')")
+    assert sorted(q(s, "select concat_ws('-', a, b) from cw")) == \
+        [("p-q",), ("x-y",)]
+
+    assert q(s, "select bin(n), oct(n), hex(n) from f where n = 17") == \
+        [("10001", "21", "11")]
+    assert q(s, "select format(n * 1234567, 2) from f where n = 5") == \
+        [("6,172,835.00",)]

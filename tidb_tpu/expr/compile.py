@@ -42,6 +42,12 @@ Pair = tuple[Any, Any]  # (value, valid)
 EXTENSION_FUNCS: dict = {}
 
 
+def _jan1(xp, y):
+    """Days-since-epoch of January 1st of year(s) y."""
+    from ..types.temporal import days_from_civil
+    return days_from_civil(xp, y, 1, 1)
+
+
 def vand(a, b):
     if a is True:
         return b
@@ -818,6 +824,96 @@ class Evaluator:
         out = j + doy - 1
         ok = doy >= 1
         return out, vand(vand(my, md), ok)
+
+    # -- host string-producing builtins ------------------------------- #
+    # These yield python-str object arrays; they are NOT in DEVICE_OPS,
+    # so plans keep them in host root executors where _eval_to_column
+    # dictionary-encodes the produced values (the residual-evaluation
+    # half of the pushdown contract, SURVEY.md §A.1).
+
+    def op_date_format(self, e, cols, memo):
+        """DATE_FORMAT(d, fmt) — the common MySQL specifiers
+        (builtin_time.go dateFormat subset)."""
+        from ..types.temporal import MICROS_PER_DAY, civil_from_days
+        xp = self.xp
+        v, m = self.eval(e.args[0], cols, memo)
+        fmt = str(e.args[1].value)
+        v = np.asarray(v)
+        if e.args[0].dtype.kind == K.DATETIME:
+            days = v // MICROS_PER_DAY
+            micros = v - days * MICROS_PER_DAY
+        else:
+            days = v
+            micros = np.zeros_like(np.asarray(days))
+        days = np.atleast_1d(np.asarray(days)).astype(np.int64)
+        micros = np.atleast_1d(np.asarray(micros)).astype(np.int64)
+        y, mo, d = civil_from_days(np, days)
+        wd = (days + 3) % 7                      # 0 = Monday
+        doy = days - _jan1(np, y) + 1
+        hh = micros // 3_600_000_000
+        mi = micros // 60_000_000 % 60
+        ss = micros // 1_000_000 % 60
+        day_names = ["Monday", "Tuesday", "Wednesday", "Thursday",
+                     "Friday", "Saturday", "Sunday"]
+        mon_names = ["January", "February", "March", "April", "May",
+                     "June", "July", "August", "September", "October",
+                     "November", "December"]
+        out = np.empty(len(days), object)
+        for i in range(len(days)):
+            parts = []
+            j = 0
+            while j < len(fmt):
+                c = fmt[j]
+                if c != "%" or j + 1 >= len(fmt):
+                    parts.append(c)
+                    j += 1
+                    continue
+                sp = fmt[j + 1]
+                j += 2
+                yy, mm, dd = int(y[i]), int(mo[i]), int(d[i])
+                rep = {
+                    "Y": f"{yy:04d}", "y": f"{yy % 100:02d}",
+                    "m": f"{mm:02d}", "c": str(mm),
+                    "d": f"{dd:02d}", "e": str(dd),
+                    "M": mon_names[mm - 1], "b": mon_names[mm - 1][:3],
+                    "W": day_names[int(wd[i])],
+                    "a": day_names[int(wd[i])][:3],
+                    "j": f"{int(doy[i]):03d}",
+                    "H": f"{int(hh[i]):02d}", "k": str(int(hh[i])),
+                    "h": f"{(int(hh[i]) % 12) or 12:02d}",
+                    "i": f"{int(mi[i]):02d}", "s": f"{int(ss[i]):02d}",
+                    "S": f"{int(ss[i]):02d}",
+                    "p": "AM" if int(hh[i]) < 12 else "PM",
+                    "T": f"{int(hh[i]):02d}:{int(mi[i]):02d}"
+                         f":{int(ss[i]):02d}",
+                    "%": "%",
+                }.get(sp)
+                parts.append(rep if rep is not None else sp)
+            out[i] = "".join(parts)
+        return out, m
+
+    def op_int_to_base(self, e, cols, memo):
+        """BIN/OCT/HEX over integers: args = (value, base-const)."""
+        v, m = self._num(e.args[0], cols, memo)
+        base = int(e.args[1].value)
+        arr = np.atleast_1d(_as_i64(self.xp, v))
+        fmt = {2: "b", 8: "o", 16: "X"}[base]
+        out = np.array([format(int(x) & 0xFFFFFFFFFFFFFFFF, fmt)
+                        for x in arr], object)
+        return out, m
+
+    def op_format_num(self, e, cols, memo):
+        """FORMAT(n, d): thousands separators + d decimals."""
+        v, m = self._num(e.args[0], cols, memo)
+        d = max(int(e.args[1].value), 0)
+        a0 = e.args[0]
+        if a0.dtype.kind == K.DECIMAL:
+            vals = [int(x) / dec.pow10(a0.dtype.scale)
+                    for x in np.atleast_1d(np.asarray(v))]
+        else:
+            vals = [float(x) for x in np.atleast_1d(np.asarray(v))]
+        out = np.array([f"{x:,.{d}f}" for x in vals], object)
+        return out, m
 
     def op_unix_timestamp(self, e, cols, memo):
         from ..types.temporal import MICROS_PER_DAY, MICROS_PER_SEC

@@ -334,8 +334,10 @@ class ExprBuilder:
         if name in ("TRIM", "LTRIM", "RTRIM", "REVERSE", "REPLACE",
                     "LEFT", "RIGHT", "LPAD", "RPAD", "ASCII", "LOCATE",
                     "INSTR", "REPEAT", "SUBSTRING_INDEX", "MD5", "SHA1",
-                    "SHA2", "HEX", "SOUNDEX", "CRC32", "STRCMP"):
+                    "SHA2", "SOUNDEX", "CRC32", "STRCMP"):
             return self._str_func(name.lower(), *args)
+        if name == "HEX" and args[0].dtype.kind == K.STRING:
+            return self._str_func("hex", args[0])
         if name == "SHA":
             return self._str_func("sha1", *args)
         if name in ("WEEK", "WEEKOFYEAR"):
@@ -355,6 +357,43 @@ class ExprBuilder:
                         "from_unixtime", (args[0],))
         if name == "MAKEDATE":
             return Func(dt.date(True), "makedate", (args[0], args[1]))
+        if name == "DATE_FORMAT":
+            if not (len(args) == 2 and isinstance(args[1], Const)
+                    and isinstance(args[1].value, str)):
+                raise PlanError("DATE_FORMAT needs a constant format")
+            if args[0].dtype.kind not in (K.DATE, K.DATETIME):
+                raise PlanError("DATE_FORMAT needs a date operand")
+            return Func(dt.varchar(args[0].dtype.nullable), "date_format",
+                        (args[0], args[1]))
+        if name == "CONCAT_WS":
+            if len(args) < 2:
+                raise PlanError("CONCAT_WS needs a separator + arguments")
+            sep = args[0]
+            if not (isinstance(sep, Const) and isinstance(sep.value, str)):
+                raise PlanError("CONCAT_WS needs a constant separator")
+            if any(a.dtype.nullable for a in args[1:]):
+                # NULL args are SKIPPED (not propagated) — the concat
+                # rewrite can't express per-row skips over dict codes
+                raise PlanError("CONCAT_WS over nullable arguments is "
+                                "not supported yet")
+            woven: list = []
+            for a in args[1:]:
+                if woven:
+                    woven.append(sep)
+                woven.append(a)
+            return self._str_func("concat", *woven)
+        if name in ("BIN", "OCT") or (name == "HEX"
+                                      and args[0].dtype.kind != K.STRING):
+            if not args[0].dtype.is_integer:
+                raise PlanError(f"{name} needs an integer operand")
+            base = {"BIN": 2, "OCT": 8, "HEX": 16}[name]
+            return Func(dt.varchar(args[0].dtype.nullable), "int_to_base",
+                        (args[0], B.lit(base)))
+        if name == "FORMAT":
+            if not (len(args) == 2 and isinstance(args[1], Const)):
+                raise PlanError("FORMAT needs a constant decimal count")
+            return Func(dt.varchar(args[0].dtype.nullable), "format_num",
+                        (args[0], args[1]))
         if name in ("DAYNAME", "MONTHNAME"):
             base = args[0]
             if base.dtype.kind not in (K.DATE, K.DATETIME):
