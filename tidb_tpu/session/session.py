@@ -48,9 +48,10 @@ class Domain:
     cop client + sysvars."""
 
     def __init__(self, mesh=None, data_dir: Optional[str] = None,
-                 sync: bool = False):
+                 sync: bool = False, keyspace: str = ""):
         from ..stats.handle import StatsHandle
         from ..store.kv import KVStore
+        self.keyspace = keyspace     # tenant prefix (pkg/keyspace analog)
         self.catalog = Catalog()
         self.catalog.domain = self          # memtable binding (infoschema)
         self.mesh = mesh if mesh is not None else get_mesh()
@@ -60,7 +61,8 @@ class Domain:
             # data, schema, and DDL-job state all survive restart
             import os as _os
             _os.makedirs(data_dir, exist_ok=True)
-            self.kv = KVStore(path=_os.path.join(data_dir, "kv"), sync=sync)
+            self.kv = KVStore(path=_os.path.join(data_dir, "kv"),
+                              sync=sync, keyspace=keyspace)
             from .meta import attach
             self.meta = attach(self.catalog, self.kv)
             self.meta.load_catalog(self.catalog)
@@ -73,7 +75,7 @@ class Domain:
             max_id = max(max_id, self.meta.load_max_dropped_id())
             self._next_table_id = max_id
         else:
-            self.kv = KVStore()      # native C++ MVCC row store (in-memory)
+            self.kv = KVStore(keyspace=keyspace)  # native C++ MVCC store
             self.meta = None
         self.stats = StatsHandle()   # pkg/statistics/handle analog
         from ..privilege import PrivilegeManager

@@ -118,10 +118,15 @@ class KVStore:
     into <path>.snap and truncates the log; reopening the same path
     replays both.  `sync` fdatasyncs every commit record."""
 
-    def __init__(self, path: Optional[str] = None, sync: bool = False):
+    def __init__(self, path: Optional[str] = None, sync: bool = False,
+                 keyspace: str = ""):
         self._lib = _load_lib()
         self.path = path
         self._ts_samples: list = []    # (wallclock, ts) for stale reads
+        # keyspace (pkg/keyspace analog): a tenant prefix transparently
+        # applied to every key, so tenants sharing one physical store
+        # cannot observe each other's keys.  "" = the null keyspace.
+        self._ks = (keyspace.encode() + b"\x00") if keyspace else b""
         if path is None:
             self._h = ctypes.c_void_p(self._lib.kv_open())
         else:
@@ -177,9 +182,34 @@ class KVStore:
     def begin(self, pessimistic: bool = False) -> "Txn":
         return Txn(self, self.alloc_ts(), pessimistic=pessimistic)
 
+    # -- keyspace (tenant prefix) -------------------------------------- #
+
+    def with_keyspace(self, keyspace: str) -> "KVStore":
+        """A VIEW of this store under a tenant keyspace: shares the
+        engine handle and TSO, prefixes every key (pkg/keyspace)."""
+        import copy as _copy
+        view = _copy.copy(self)
+        view._ks = (keyspace.encode() + b"\x00") if keyspace else b""
+        return view
+
+    def _pk(self, key: bytes) -> bytes:
+        return self._ks + key if self._ks else key
+
+    def _strip(self, key: bytes) -> bytes:
+        return key[len(self._ks):] if self._ks else key
+
+    def _ks_end(self) -> bytes:
+        ba = bytearray(self._ks)
+        for i in reversed(range(len(ba))):
+            if ba[i] != 0xFF:
+                ba[i] += 1
+                return bytes(ba[: i + 1])
+        return b""
+
     # -- snapshot reads ------------------------------------------------ #
 
     def get(self, key: bytes, ts: int) -> Optional[bytes]:
+        key = self._pk(key)
         out = ctypes.c_char_p()
         out_len = ctypes.c_int32()
         rc = self._lib.kv_get(self._h, key, len(key), ts,
@@ -195,7 +225,8 @@ class KVStore:
              ) -> Iterator[tuple[bytes, bytes]]:
         """Paged snapshot scan (the kv paging analog, SURVEY.md §5.7)."""
         buf = ctypes.create_string_buffer(page_bytes)
-        cur = start
+        cur = self._pk(start)
+        end = self._pk(end) if end else (self._ks_end() if self._ks else end)
         remaining = limit
         while remaining > 0:
             used = ctypes.c_int64()
@@ -219,7 +250,7 @@ class KVStore:
                 vlen = int.from_bytes(data[off:off + 4], "little"); off += 4
                 v = data[off:off + vlen]; off += vlen
                 last_key = k
-                yield k, v
+                yield self._strip(k), v
                 remaining -= 1
             if not trunc.value or last_key is None:
                 return
@@ -253,21 +284,26 @@ class Txn:
     _undo: Optional[dict] = None  # active statement savepoint (undo delta)
 
     def put(self, key: bytes, value: bytes):
+        key = self.store._pk(key)
         if self.pessimistic:
-            self.lock_keys([key])
+            self._lock_raw([key])
         self._record_undo(key)
         self.mutations[key] = value
 
     def delete(self, key: bytes):
+        key = self.store._pk(key)
         if self.pessimistic:
-            self.lock_keys([key])
+            self._lock_raw([key])
         self._record_undo(key)
         self.mutations[key] = None
 
     def lock_keys(self, keys, wait_ms: Optional[int] = None):
-        """Acquire pessimistic locks (SELECT FOR UPDATE / DML locking).
-        for_update_ts is allocated fresh so commits between start_ts and
-        now are tolerated — the pessimistic-mode contract."""
+        self._lock_raw([self.store._pk(k) for k in keys], wait_ms)
+
+    def _lock_raw(self, keys, wait_ms: Optional[int] = None):
+        """Acquire pessimistic locks on PREFIXED keys (SELECT FOR UPDATE /
+        DML locking).  for_update_ts is allocated fresh so commits between
+        start_ts and now are tolerated — the pessimistic-mode contract."""
         lib = self.store._lib
         h = self.store._h
         wait = self.lock_wait_ms if wait_ms is None else wait_ms
@@ -302,14 +338,17 @@ class Txn:
         return max(self.start_ts, self.for_update_ts)
 
     def get(self, key: bytes) -> Optional[bytes]:
-        if key in self.mutations:
-            return self.mutations[key]
+        pk = self.store._pk(key)
+        if pk in self.mutations:
+            return self.mutations[pk]
         return self.store.get(key, self.read_ts)
 
     def scan(self, start: bytes, end: bytes, **kw):
-        """Union-scan analog: merge membuffer over the snapshot."""
+        """Union-scan analog: merge membuffer over the snapshot.  Yields
+        UNPREFIXED keys; the membuffer holds prefixed ones."""
         snap = dict(self.store.scan(start, end, self.read_ts, **kw))
-        for k, v in self.mutations.items():
+        for pk, v in self.mutations.items():
+            k = self.store._strip(pk)
             if start <= k < (end or k + b"\x00"):
                 if v is None:
                     snap.pop(k, None)
