@@ -30,10 +30,14 @@ from .aggregate import _np_key_code
 K = dt.TypeKind
 
 
-def _host_scan_chain(node: D.CopNode, snap) -> Optional[list]:
+def _host_scan_chain(node: D.CopNode, snap,
+                     allow_mask: bool = False) -> Optional[tuple]:
     """Evaluate a TableScan[->Selection][->Projection] chain over the host
-    snapshot columns; returns compacted [(data, valid), ...] live rows or
-    None when the DAG contains anything else (LookupJoin, TopN, ...)."""
+    snapshot columns.  Returns (cols, live_mask) where live_mask is None
+    when rows were compacted; with allow_mask, HIGH-selectivity filters
+    (>90% kept) skip the per-column compaction copies and return the
+    boolean mask instead — the dense-agg consumer routes dead rows to a
+    trim group, one pass instead of seven takes.  None = out of scope."""
     chain = []
     cur = node
     while True:
@@ -49,6 +53,7 @@ def _host_scan_chain(node: D.CopNode, snap) -> Optional[list]:
     ev = Evaluator(np)
     cols = None
     n = snap.num_rows
+    live = None
     for op in chain:
         if isinstance(op, D.TableScan):
             cols = []
@@ -58,7 +63,7 @@ def _host_scan_chain(node: D.CopNode, snap) -> Optional[list]:
                              True if c.validity.all() else c.validity))
         elif isinstance(op, D.Selection):
             memo: dict = {}
-            keep = np.ones(n, bool)
+            keep = np.ones(n, bool) if live is None else live.copy()
             for cond in op.conditions:
                 v, m = ev.eval(cond, cols, memo)
                 v = np.broadcast_to(np.asarray(v), (n,))
@@ -68,11 +73,16 @@ def _host_scan_chain(node: D.CopNode, snap) -> Optional[list]:
                     keep = keep & v & np.broadcast_to(np.asarray(m), (n,))
                 else:
                     keep = keep & v
-            if not keep.all():
-                idx = np.nonzero(keep)[0]
-                cols = [(np.asarray(v)[idx] if np.ndim(v) else v,
-                         m if m is True else m[idx]) for v, m in cols]
-                n = len(idx)
+            if keep.all():
+                continue
+            if allow_mask and keep.mean() > 0.9:
+                live = keep
+                continue
+            idx = np.nonzero(keep)[0]
+            cols = [(np.asarray(v)[idx] if np.ndim(v) else v,
+                     m if m is True else m[idx]) for v, m in cols]
+            n = len(idx)
+            live = None
         else:  # Projection
             memo = {}
             out = []
@@ -80,7 +90,7 @@ def _host_scan_chain(node: D.CopNode, snap) -> Optional[list]:
                 v, m = ev.eval(e, cols, memo)
                 out.append((np.broadcast_to(np.asarray(v), (n,)), m))
             cols = out
-    return cols
+    return cols, live
 
 
 def _group_codes(combined: np.ndarray, need_inv: bool):
@@ -129,9 +139,10 @@ def host_sort_agg(agg: D.Aggregation, snap) -> Optional[dict]:
         # beyond the single-table limb-exact SUM bound: let the device
         # program split rows across shards instead of aborting
         return None
-    cols = _host_scan_chain(agg.child, snap)
-    if cols is None:
+    chain = _host_scan_chain(agg.child, snap)
+    if chain is None:
         return None
+    cols, _live = chain
     n = len(cols[0][0]) if cols else 0
 
     ev = Evaluator(np)
@@ -263,9 +274,10 @@ def host_dense_agg(agg: D.Aggregation, snap) -> Optional[dict]:
         if a.func not in (D.AggFunc.COUNT, D.AggFunc.SUM, D.AggFunc.MIN,
                           D.AggFunc.MAX):
             return None
-    cols = _host_scan_chain(agg.child, snap)
-    if cols is None:
+    chain = _host_scan_chain(agg.child, snap, allow_mask=True)
+    if chain is None:
         return None
+    cols, live = chain
     n = len(cols[0][0]) if cols else 0
     ev = Evaluator(np)
     memo: dict = {}
@@ -286,7 +298,13 @@ def host_dense_agg(agg: D.Aggregation, snap) -> Optional[dict]:
         G = 1
         gid = np.zeros(n, np.int64)
 
-    rows = np.bincount(gid, minlength=G).astype(np.int64)
+    if live is not None:
+        # uncompacted high-selectivity filter: dead rows route to a trim
+        # group past G (single pass instead of per-column takes)
+        gid = np.where(live, gid, np.int64(G))
+        rows = np.bincount(gid, minlength=G + 1)[:G].astype(np.int64)
+    else:
+        rows = np.bincount(gid, minlength=G).astype(np.int64)
     states: dict = {"__rows__": rows}
     for i, a in enumerate(agg.aggs):
         if a.func == D.AggFunc.COUNT and a.arg is None:
@@ -294,31 +312,45 @@ def host_dense_agg(agg: D.Aggregation, snap) -> Optional[dict]:
             continue
         av, am = ev.eval(a.arg, cols, memo)
         av = np.broadcast_to(np.asarray(av), (n,))
+        # dead (filtered) rows already route to the trim slot past G, so
+        # only the aggregate's OWN null mask needs applying to values
         all_valid = am is True
-        mask = None if all_valid else np.broadcast_to(np.asarray(am), (n,))
-        cnt = (rows if all_valid
-               else np.bincount(gid[mask], minlength=G).astype(np.int64))
+        if all_valid:
+            cnt = rows
+            mask = None
+        else:
+            mask = np.broadcast_to(np.asarray(am), (n,))
+            cnt_arr = np.zeros(G + 1, np.int64)
+            np.add.at(cnt_arr, gid, mask.astype(np.int64))
+            cnt = cnt_arr[:G]
         if a.func == D.AggFunc.COUNT:
             states[f"a{i}"] = {"count": cnt}
         elif a.func == D.AggFunc.SUM:
             if a.arg.dtype.kind in (K.FLOAT64, K.FLOAT32):
                 v = av.astype(np.float64)
-                if not all_valid:
+                if mask is not None:
                     v = np.where(mask, v, 0.0)
-                out = np.zeros(G, np.float64)
+                out = np.zeros(G + 1, np.float64)
                 np.add.at(out, gid, v)
-                states[f"a{i}"] = {"sum": out, "cnt": cnt}
+                states[f"a{i}"] = {"sum": out[:G], "cnt": cnt}
             else:
                 if n >= 2 ** 31:
                     return None        # past the limb-exact bound
                 v = av if av.dtype == np.int64 else av.astype(np.int64)
-                if not all_valid:
+                if mask is not None:
                     v = np.where(mask, v, np.int64(0))
-                hi = np.zeros(G, np.int64)
-                lo = np.zeros(G, np.int64)
-                np.add.at(hi, gid, v >> 32)
-                np.add.at(lo, gid, v & 0xFFFFFFFF)
-                states[f"a{i}"] = {"hi": hi, "lo": lo, "cnt": cnt}
+                hi = np.zeros(G + 1, np.int64)
+                lo = np.zeros(G + 1, np.int64)
+                vmax = int(v.max()) if len(v) else 0
+                vmin = int(v.min()) if len(v) else 0
+                if 0 <= vmin and vmax < 2 ** 32:
+                    # values fit one limb: skip the hi shift + scatter
+                    np.add.at(lo, gid, v)
+                else:
+                    np.add.at(hi, gid, v >> 32)
+                    np.add.at(lo, gid, v & 0xFFFFFFFF)
+                states[f"a{i}"] = {"hi": hi[:G], "lo": lo[:G],
+                                   "cnt": cnt}
         else:
             v = np.asarray(av)
             if v.dtype.kind == "f":
@@ -330,13 +362,13 @@ def host_dense_agg(agg: D.Aggregation, snap) -> Optional[dict]:
                 info = np.iinfo(v.dtype)
                 neutral = (info.max if a.func == D.AggFunc.MIN
                            else info.min)
-            if not all_valid:
+            if mask is not None:
                 v = np.where(mask, v, v.dtype.type(neutral))
-            out = np.full(G, neutral, v.dtype)
+            out = np.full(G + 1, neutral, v.dtype)
             (np.minimum if a.func == D.AggFunc.MIN
              else np.maximum).at(out, gid, v)
             states[f"a{i}"] = {("min" if a.func == D.AggFunc.MIN
-                                else "max"): out, "cnt": cnt}
+                                else "max"): out[:G], "cnt": cnt}
     return states
 
 
