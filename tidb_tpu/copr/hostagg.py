@@ -63,7 +63,7 @@ def _host_scan_chain(node: D.CopNode, snap,
                              True if c.validity.all() else c.validity))
         elif isinstance(op, D.Selection):
             memo: dict = {}
-            keep = np.ones(n, bool) if live is None else live.copy()
+            keep = np.ones(n, bool) if live is None else live
             for cond in op.conditions:
                 v, m = ev.eval(cond, cols, memo)
                 v = np.broadcast_to(np.asarray(v), (n,))
@@ -320,9 +320,8 @@ def host_dense_agg(agg: D.Aggregation, snap) -> Optional[dict]:
             mask = None
         else:
             mask = np.broadcast_to(np.asarray(am), (n,))
-            cnt_arr = np.zeros(G + 1, np.int64)
-            np.add.at(cnt_arr, gid, mask.astype(np.int64))
-            cnt = cnt_arr[:G]
+            cnt = np.bincount(gid[mask],
+                              minlength=G + 1)[:G].astype(np.int64)
         if a.func == D.AggFunc.COUNT:
             states[f"a{i}"] = {"count": cnt}
         elif a.func == D.AggFunc.SUM:
