@@ -1,0 +1,102 @@
+"""SQL surface round 3: INSERT ... ON DUPLICATE KEY UPDATE, INSERT SET,
+UPDATE/DELETE ORDER BY + LIMIT, SELECT ... FOR UPDATE (executor/insert.go
+upsert, UpdateExec/DeleteExec ordering, adapter.go ForUpdate)."""
+
+import threading
+
+import pytest
+
+from tidb_tpu.session import Domain, Session
+
+
+@pytest.fixture()
+def s():
+    s = Session(Domain())
+    s.execute("create table t (id bigint not null, v bigint, "
+              "name varchar(10), primary key (id))")
+    s.execute("insert into t values (1, 10, 'a'), (2, 20, 'b')")
+    return s
+
+
+def test_upsert_insert_and_update(s):
+    r = s.execute("insert into t values (3, 30, 'c') "
+                  "on duplicate key update v = 99")
+    assert r.affected == 1                      # fresh insert
+    r = s.execute("insert into t values (1, 111, 'x') "
+                  "on duplicate key update v = values(v), name = 'dup'")
+    assert r.affected == 2                      # update counting
+    assert s.must_query("select v, name from t where id = 1") == \
+        [(111, "dup")]
+    # arithmetic over existing + proposed
+    r = s.execute("insert into t values (2, 5, 'y') "
+                  "on duplicate key update v = v + values(v)")
+    assert r.affected == 2
+    assert s.must_query("select v from t where id = 2") == [(25,)]
+    # identical update counts 0
+    r = s.execute("insert into t values (3, 999, 'z') "
+                  "on duplicate key update v = 30, name = 'c'")
+    assert r.affected == 0
+
+
+def test_upsert_multi_row_and_txn(s):
+    s.execute("begin")
+    r = s.execute("insert into t values (1, 1, 'q'), (9, 90, 'n') "
+                  "on duplicate key update v = 77")
+    assert r.affected == 3                      # 2 (update) + 1 (insert)
+    s.execute("commit")
+    assert s.must_query("select v from t where id = 1") == [(77,)]
+    assert s.must_query("select v from t where id = 9") == [(90,)]
+
+
+def test_insert_set_sugar(s):
+    s.execute("insert into t set id = 5, v = 50, name = 'e'")
+    assert s.must_query("select v, name from t where id = 5") == \
+        [(50, "e")]
+
+
+def test_update_order_by_limit(s):
+    s.execute("insert into t values (3, 30, 'c'), (4, 40, 'd')")
+    s.execute("update t set v = 0 order by id desc limit 2")
+    assert s.must_query("select id from t where v = 0 order by id") == \
+        [(3,), (4,)]
+    s.execute("update t set v = -1 where id < 3 order by v limit 1")
+    assert s.must_query("select id from t where v = -1") == [(1,)]
+
+
+def test_delete_order_by_limit(s):
+    s.execute("insert into t values (3, 30, 'c'), (4, 40, 'd')")
+    s.execute("delete from t order by id desc limit 2")
+    assert s.must_query("select id from t order by id") == [(1,), (2,)]
+    s.execute("delete from t limit 1")
+    assert s.must_query("select count(*) from t") == [(1,)]
+
+
+def test_select_for_update_blocks_writer(s):
+    s.execute("begin pessimistic")
+    assert s.must_query("select v from t where id = 1 for update") == \
+        [(10,)]
+    errs = []
+    done = threading.Event()
+
+    def writer():
+        s2 = Session(s.domain)
+        try:
+            s2.execute("begin pessimistic")
+            s2.vars["innodb_lock_wait_timeout"] = 1
+            if s2.txn is not None:
+                s2.txn.lock_wait_ms = 300
+            s2.execute("update t set v = 5 where id = 1")
+            s2.execute("rollback")
+        except Exception as e:
+            errs.append(type(e).__name__)
+        done.set()
+
+    t = threading.Thread(target=writer)
+    t.start()
+    assert done.wait(10)
+    t.join()
+    assert errs and "LockWaitTimeout" in errs[0]
+    s.execute("commit")
+    # share-lock / LOCK IN SHARE MODE syntax parses
+    s.must_query("select v from t where id = 1 for share")
+    s.must_query("select v from t where id = 1 lock in share mode")

@@ -772,6 +772,8 @@ class Session:
     def _exec_select(self, stmt) -> ResultSet:
         cache_sql = self._cur_sql
         self._cur_sql = None  # inner selects (INSERT..SELECT) don't cache
+        if getattr(stmt, "for_update", False):
+            self._lock_for_update(stmt)
         built, phys = self._plan_select(stmt, cache_sql)
         ctx = self._exec_ctx()
         chunk = phys.execute(ctx)
@@ -1089,7 +1091,10 @@ class Session:
                 full.append(tuple(
                     r[idx[n]] if n in idx else None for n in tbl.col_names))
             rows = full
-        if stmt.replace:
+        if stmt.on_dup:
+            write = lambda txn: self._insert_on_dup(tbl, rows,
+                                                    stmt.on_dup, txn)
+        elif stmt.replace:
             write = lambda txn: tbl.replace_rows(rows, txn=txn)
         elif stmt.ignore:
             write = lambda txn: self._insert_ignore(tbl, rows, txn)
@@ -1139,6 +1144,94 @@ class Session:
             raise
         self.txn.release_savepoint()
         return res
+
+    def _insert_on_dup(self, tbl, rows, on_dup, txn) -> int:
+        """INSERT ... ON DUPLICATE KEY UPDATE (executor/insert.go upsert):
+        per row, a conflict on any public unique index turns the insert
+        into an update of the EXISTING row; assignment expressions may
+        reference existing columns by name and the proposed row via
+        VALUES(col).  Affected-rows: 1 per insert, 2 per changing update,
+        0 when the update leaves the row identical (MySQL counting)."""
+        from .catalog import DuplicateKeyError, canon_write_value
+        affected = 0
+        ci = {n: i for i, n in enumerate(tbl.col_names)}
+        for col, _e in on_dup:
+            if col not in ci:
+                raise PlanError(f"unknown column {col!r} in ON DUPLICATE "
+                                "KEY UPDATE")
+        for r in rows:
+            proposed = tuple(
+                canon_write_value(t, v, n)
+                for t, v, n in zip(tbl.col_types, r, tbl.col_names))
+            hit = self._find_unique_conflict(tbl, proposed, txn)
+            if hit is None:
+                affected += tbl.insert_rows([r], txn=txn)
+                continue
+            handle, existing = hit
+            new_row = list(existing)
+            for col, expr_ast in on_dup:
+                new_row[ci[col]] = self._eval_upsert_expr(
+                    expr_ast, tbl, existing, proposed)
+            new_row = tuple(plainify(v) for v in new_row)
+            if tuple(existing) == new_row:
+                continue               # identical: 0 affected
+            tbl.update_rows([handle], [tuple(existing)], [new_row],
+                            txn=txn)
+            affected += 2
+        return affected
+
+    def _find_unique_conflict(self, tbl, row, txn):
+        """(handle, existing_row) of the first public unique-index
+        conflict for a proposed row, or None."""
+        from ..store.codec import decode_index_handle, decode_row, record_key
+        if tbl.kv is None:
+            return None
+        reader = txn if txn is not None else tbl.kv
+        ts = None if txn is not None else tbl.kv.alloc_ts()
+        for ix in tbl.indexes:
+            if not ix.unique or ix.state != "public":
+                continue
+            key, val = tbl._index_entry(ix, row, 0)
+            if not val:
+                continue               # NULL key parts never conflict
+            got = (reader.get(key) if txn is not None
+                   else reader.get(key, ts))
+            if got is None:
+                continue
+            h = decode_index_handle(key, got)
+            rk = record_key(tbl.table_id, h)
+            rv = (reader.get(rk) if txn is not None
+                  else reader.get(rk, ts))
+            if rv is not None:
+                return h, decode_row(rv, tbl.col_types)
+        return None
+
+    def _eval_upsert_expr(self, node, tbl, existing, proposed):
+        """Evaluate an ON DUPLICATE KEY UPDATE assignment over the
+        existing row (idents) and the proposed row (VALUES(col))."""
+        ci = {n: i for i, n in enumerate(tbl.col_names)}
+        if isinstance(node, A.Lit):
+            return self._literal_value(node)
+        if isinstance(node, A.Ident):
+            name = node.parts[-1].lower()
+            if name not in ci:
+                raise PlanError(f"unknown column {name!r}")
+            return existing[ci[name]]
+        if isinstance(node, A.FuncCall) and node.name == "VALUES" \
+                and len(node.args) == 1 \
+                and isinstance(node.args[0], A.Ident):
+            name = node.args[0].parts[-1].lower()
+            if name not in ci:
+                raise PlanError(f"unknown column {name!r}")
+            return proposed[ci[name]]
+        if isinstance(node, A.Binary) and node.op in "+-*":
+            a = self._eval_upsert_expr(node.left, tbl, existing, proposed)
+            b = self._eval_upsert_expr(node.right, tbl, existing, proposed)
+            if a is None or b is None:
+                return None
+            return {"+": a + b, "-": a - b, "*": a * b}[node.op]
+        raise PlanError("unsupported ON DUPLICATE KEY UPDATE expression "
+                        "(literals, columns, VALUES(col), + - * only)")
 
     @staticmethod
     def _insert_ignore(tbl, rows, txn) -> int:
@@ -1350,6 +1443,8 @@ class Session:
                                  "(contended WHERE set keeps growing)")
         rows, handles, cols, dicts = self._update_view(tbl)
         mask = self._where_mask_cols(tbl, cols, dicts, stmt.where)
+        mask = self._dml_restrict_mask(tbl, mask, stmt.order_by,
+                                       stmt.limit, cols=cols, dicts=dicts)
         n_rows = len(rows)
         n_aff = int(mask.sum())
         if n_aff == 0:
@@ -1407,12 +1502,22 @@ class Session:
 
     def _do_delete(self, stmt: A.Delete) -> ResultSet:
         tbl = self.domain.catalog.get_table(self.db, stmt.table)
-        if stmt.where is None:
+        if stmt.where is None and stmt.limit is None:
             self._fk_on_delete(tbl, np.ones(tbl.num_rows, bool))
             n = tbl.truncate()
             self.domain.stats.note_modify(tbl, n, delta=-n)
             return ResultSet(affected=n)
+        if stmt.where is None:
+            mask = np.ones(tbl.num_rows, bool)
+            mask = self._dml_restrict_mask(tbl, mask, stmt.order_by,
+                                           stmt.limit)
+            self._fk_on_delete(tbl, mask)
+            n = tbl.delete_where(~mask)
+            self.domain.stats.note_modify(tbl, n, delta=-n)
+            return ResultSet(affected=n)
         mask = self._where_mask(tbl, stmt.where)
+        mask = self._dml_restrict_mask(tbl, mask, stmt.order_by,
+                                       stmt.limit)
         if tbl.kv is not None and self._fk_children(tbl):
             # cascades may reshuffle this table's own snapshot (self-
             # referential FKs): pin the doomed rows by stable handle
@@ -1428,6 +1533,79 @@ class Session:
 
     # -- foreign keys: parent-side enforcement (executor side of
     # -- planner/core/foreign_key.go: FKCheck/FKCascade plans) ---------- #
+
+    def _lock_for_update(self, stmt) -> None:
+        """SELECT ... FOR UPDATE: inside an explicit transaction, lock
+        the matched rows of a single-table read so conflicting writers
+        block until COMMIT (the pessimistic locking-read contract;
+        adapter.go handles it via the ForUpdate flag).  Outside a
+        transaction the read is a plain snapshot (locks would release
+        immediately); multi-table locking reads are not supported."""
+        if self.txn is None:
+            return
+        if not isinstance(stmt.from_, A.TableName):
+            return
+        try:
+            tbl = self.domain.catalog.get_table(
+                stmt.from_.db or self.db, stmt.from_.name)
+        except Exception:
+            return
+        if getattr(tbl, "kv", None) is None \
+                or getattr(tbl, "is_memtable", False):
+            return
+        from ..store.codec import record_key
+        try:
+            mask = self._where_mask(tbl, stmt.where)
+        except Exception:
+            # predicate not evaluable standalone (subqueries): lock the
+            # whole scanned table — conservative, never under-locks
+            mask = np.ones(tbl.num_rows, bool)
+        tbl.snapshot()
+        handles = (np.asarray(tbl._snapshot_handles)[mask]
+                   if tbl._snapshot_handles is not None else [])
+        if len(handles):
+            self.txn.lock_keys(
+                [record_key(tbl.table_id, int(h)) for h in handles])
+
+    def _dml_restrict_mask(self, tbl, mask, order_by, limit,
+                           cols=None, dicts=None):
+        """Apply DML ORDER BY ... LIMIT n: keep only the first n matched
+        rows in key order (UpdateExec/DeleteExec with ORDER BY+LIMIT).
+        `cols`/`dicts` must be the SAME view the mask was computed over
+        (txn membuffer views differ from the snapshot)."""
+        if limit is None and not order_by:
+            return mask
+        idx = np.nonzero(mask)[0]
+        if order_by:
+            from ..expr.compile import eval_expr
+            from ..expr.lower_strings import lower_strings
+            from ..planner.build import ExprBuilder
+            from ..planner.logical import Schema, SchemaCol
+            if cols is None:
+                snap = tbl.snapshot()
+                cols = snap.columns
+                dicts = snap.dictionaries
+            sch = Schema([SchemaCol(nm, c.dtype)
+                          for nm, c in zip(tbl.col_names, cols)])
+            pairs = [(c.data, (True if c.validity.all() else c.validity))
+                     for c in cols]
+            n_all = len(cols[0]) if cols else 0
+            keys = []
+            for e_ast, desc in reversed(list(order_by)):
+                ir = lower_strings(ExprBuilder(sch).build(e_ast),
+                                   dicts or {})
+                v, _m = eval_expr(np, ir, pairs)
+                v = np.broadcast_to(np.asarray(v), (n_all,))[idx]
+                keys.append(np.asarray(-v if desc and v.dtype.kind in "iu"
+                                       else (-v if desc
+                                             and v.dtype.kind == "f"
+                                             else v)))
+            idx = idx[np.lexsort(tuple(keys))]
+        if limit is not None:
+            idx = idx[:limit]
+        out = np.zeros(len(mask), bool)
+        out[idx] = True
+        return out
 
     def _fk_children(self, tbl):
         return [(t, fk)

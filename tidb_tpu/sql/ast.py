@@ -171,6 +171,7 @@ class SelectStmt(Node):
     ctes: list["CTE"] = field(default_factory=list)
     recursive: bool = False         # WITH RECURSIVE
     hints: list[tuple] = field(default_factory=list)  # [(NAME, [args])]
+    for_update: bool = False        # SELECT ... FOR UPDATE locking read
 
 
 @dataclass
@@ -336,6 +337,9 @@ class Insert(Node):
     select: Optional[SelectStmt] = None
     replace: bool = False           # REPLACE INTO: delete conflicts first
     ignore: bool = False            # INSERT IGNORE: skip dup-key rows
+    # ON DUPLICATE KEY UPDATE assignments [(col, expr)] — expr may use
+    # VALUES(col) to reference the proposed row (executor/insert.go upsert)
+    on_dup: list = field(default_factory=list)
 
 
 @dataclass
@@ -357,12 +361,16 @@ class Update(Node):
     table: str = ""
     assignments: list[tuple[str, Node]] = field(default_factory=list)
     where: Optional[Node] = None
+    order_by: list = field(default_factory=list)   # [(expr, desc)]
+    limit: Optional[int] = None
 
 
 @dataclass
 class Delete(Node):
     table: str = ""
     where: Optional[Node] = None
+    order_by: list = field(default_factory=list)   # [(expr, desc)]
+    limit: Optional[int] = None
 
 
 @dataclass

@@ -502,6 +502,22 @@ class Parser:
             s.order_by = self._order_by_list()
         if self.accept_kw("LIMIT"):
             s.limit, s.offset = self._limit_clause()
+        if self.at_kw("FOR"):
+            # FOR UPDATE | FOR SHARE [NOWAIT]: locking read clause
+            self.advance()
+            if self.accept_kw("UPDATE"):
+                s.for_update = True
+            elif self._accept_word("SHARE"):
+                s.for_update = False   # share locks are a no-op here
+            else:
+                raise ParseError("expected UPDATE or SHARE after FOR",
+                                 self.cur)
+            self._accept_word("NOWAIT")
+        elif self._accept_word("LOCK"):
+            self.expect_kw("IN")
+            self._accept_word("SHARE")
+            if not self.accept_kw("MODE"):
+                self._accept_word("MODE")
         return s
 
     def _int_lit(self) -> int:
@@ -1091,6 +1107,19 @@ class Parser:
             self.expect_op(")")
         if self.at_kw("SELECT", "WITH"):
             ins.select = self.select_query()
+            self._maybe_on_dup(ins)
+            return ins
+        if self.at_kw("SET"):
+            # INSERT ... SET col = expr, ... (single-row sugar)
+            self.advance()
+            while True:
+                ins.columns.append(self.ident())
+                self.expect_op("=")
+                (ins.rows or ins.rows.append([]) or ins.rows)  # ensure row
+                ins.rows[0].append(self.expr())
+                if not self.accept_op(","):
+                    break
+            self._maybe_on_dup(ins)
             return ins
         self.expect_kw("VALUES")
         while True:
@@ -1102,7 +1131,24 @@ class Parser:
             ins.rows.append(row)
             if not self.accept_op(","):
                 break
+        self._maybe_on_dup(ins)
         return ins
+
+    def _maybe_on_dup(self, ins: "A.Insert") -> None:
+        """ON DUPLICATE KEY UPDATE col = expr, ... (upsert clause)."""
+        if not self.at_kw("ON"):
+            return
+        self.advance()
+        if not self._accept_word("DUPLICATE"):
+            raise ParseError("expected DUPLICATE after ON", self.cur)
+        self.expect_kw("KEY")
+        self.expect_kw("UPDATE")
+        while True:
+            col = self.ident()
+            self.expect_op("=")
+            ins.on_dup.append((col, self.expr()))
+            if not self.accept_op(","):
+                break
 
     def _resource_group_body(self, name: str, ine: bool,
                              replace: bool) -> A.CreateResourceGroup:
@@ -1216,6 +1262,7 @@ class Parser:
                 break
         if self.accept_kw("WHERE"):
             u.where = self.expr()
+        u.order_by, u.limit = self._dml_order_limit()
         return u
 
     def delete_stmt(self) -> A.Delete:
@@ -1224,7 +1271,25 @@ class Parser:
         d = A.Delete(self.ident())
         if self.accept_kw("WHERE"):
             d.where = self.expr()
+        d.order_by, d.limit = self._dml_order_limit()
         return d
+
+    def _dml_order_limit(self):
+        """[ORDER BY ...] [LIMIT n] tail of single-table UPDATE/DELETE."""
+        order = []
+        if self.accept_kw("ORDER"):
+            self.expect_kw("BY")
+            while True:
+                e = self.expr()
+                desc = bool(self.accept_kw("DESC")) \
+                    or (self.accept_kw("ASC") and False)
+                order.append((e, desc))
+                if not self.accept_op(","):
+                    break
+        limit = None
+        if self.accept_kw("LIMIT"):
+            limit = self._int_lit()
+        return order, limit
 
     def show_stmt(self) -> A.ShowStmt:
         self.expect_kw("SHOW")
@@ -1449,6 +1514,15 @@ class Parser:
 
     def primary(self) -> A.Node:
         t = self.cur
+        if (t.kind == "kw" and t.text == "VALUES"
+                and self.toks[self.i + 1].kind == "op"
+                and self.toks[self.i + 1].text == "("):
+            # VALUES(col) inside ON DUPLICATE KEY UPDATE assignments
+            self.advance()
+            self.expect_op("(")
+            inner = self.expr()
+            self.expect_op(")")
+            return A.FuncCall("VALUES", [inner])
         if t.kind == "int":
             self.advance()
             return A.Lit(int(t.text), "int")
