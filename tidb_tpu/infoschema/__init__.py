@@ -129,6 +129,74 @@ def _top_sql(dom):
     return dom.stmt_summary.top_sql_rows()
 
 
+def _views(dom):
+    rows = []
+    for db in sorted(dom.catalog.views):
+        for v in sorted(dom.catalog.views[db].values(),
+                        key=lambda x: x.name):
+            rows.append(("def", db, v.name, v.select_sql, "NONE",
+                         "YES" if not v.columns else "NO"))
+    return rows
+
+
+def _partitions(dom):
+    rows = []
+    for db in sorted(dom.catalog.databases):
+        for t in sorted(dom.catalog.databases[db].values(),
+                        key=lambda x: x.name):
+            spec = getattr(t, "partition", None)
+            if spec is None:
+                rows.append(("def", db, t.name, None, None, None, None,
+                             t.num_rows))
+                continue
+            try:
+                snap = t.snapshot()
+                pid = t._partition_index(
+                    snap.columns[t.col_names.index(spec.column)])
+            except Exception:
+                pid = None
+            for i, (pname, bound) in enumerate(spec.parts):
+                n = int((pid == i).sum()) if pid is not None else None
+                rows.append(("def", db, t.name, pname, i + 1,
+                             spec.kind.upper(),
+                             "MAXVALUE" if spec.kind == "range"
+                             and bound is None else
+                             (str(bound) if bound is not None else None),
+                             n))
+    return rows
+
+
+def _key_column_usage(dom):
+    rows = []
+    for db in sorted(dom.catalog.databases):
+        for t in sorted(dom.catalog.databases[db].values(),
+                        key=lambda x: x.name):
+            for ix in getattr(t, "indexes", []):
+                if not ix.unique:
+                    continue
+                for seq, col in enumerate(ix.columns):
+                    rows.append(("def", db, ix.name, db, t.name, col,
+                                 seq + 1, None, None))
+            for k, fk in enumerate(getattr(t, "foreign_keys", [])):
+                rows.append(("def", db, fk.name or f"fk_{t.name}_{k + 1}",
+                             db, t.name, fk.column, 1,
+                             fk.ref_table, fk.ref_column))
+    return rows
+
+
+def _referential_constraints(dom):
+    rows = []
+    for db in sorted(dom.catalog.databases):
+        for t in sorted(dom.catalog.databases[db].values(),
+                        key=lambda x: x.name):
+            for k, fk in enumerate(getattr(t, "foreign_keys", [])):
+                rows.append(("def", db,
+                             fk.name or f"fk_{t.name}_{k + 1}",
+                             t.name, fk.ref_table,
+                             fk.on_delete.upper()))
+    return rows
+
+
 def _workload_repo(dom):
     return [(time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(ts)),
              dig, cnt, avg, mx, rows)
@@ -214,6 +282,30 @@ _INFORMATION_SCHEMA = {
                             ("AVG_LATENCY_MS", F), ("MAX_LATENCY_MS", F),
                             ("SUM_ROWS", I), ("QUERY_SAMPLE_TEXT", S)],
                            _stmt_summary),
+    "VIEWS": ([("TABLE_CATALOG", S), ("TABLE_SCHEMA", S),
+               ("TABLE_NAME", S), ("VIEW_DEFINITION", S),
+               ("CHECK_OPTION", S), ("IS_UPDATABLE", S)], _views),
+    "PARTITIONS": ([("TABLE_CATALOG", S), ("TABLE_SCHEMA", S),
+                    ("TABLE_NAME", S), ("PARTITION_NAME", S),
+                    ("PARTITION_ORDINAL_POSITION", I),
+                    ("PARTITION_METHOD", S),
+                    ("PARTITION_DESCRIPTION", S),
+                    ("TABLE_ROWS", I)], _partitions),
+    "KEY_COLUMN_USAGE": ([("CONSTRAINT_CATALOG", S),
+                          ("CONSTRAINT_SCHEMA", S),
+                          ("CONSTRAINT_NAME", S), ("TABLE_SCHEMA", S),
+                          ("TABLE_NAME", S), ("COLUMN_NAME", S),
+                          ("ORDINAL_POSITION", I),
+                          ("REFERENCED_TABLE_NAME", S),
+                          ("REFERENCED_COLUMN_NAME", S)],
+                         _key_column_usage),
+    "REFERENTIAL_CONSTRAINTS": ([("CONSTRAINT_CATALOG", S),
+                                 ("CONSTRAINT_SCHEMA", S),
+                                 ("CONSTRAINT_NAME", S),
+                                 ("TABLE_NAME", S),
+                                 ("REFERENCED_TABLE_NAME", S),
+                                 ("DELETE_RULE", S)],
+                                _referential_constraints),
     "WORKLOAD_REPO_STATEMENTS": ([("SNAPSHOT_TS", S), ("SQL_DIGEST", S),
                                   ("EXEC_COUNT", I), ("AVG_LATENCY_MS", F),
                                   ("MAX_LATENCY_MS", F), ("SUM_ROWS", I)],
