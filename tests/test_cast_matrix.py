@@ -1,0 +1,125 @@
+"""Implicit/explicit cast matrix (VERDICT r3 #4): string<->date/number
+coercions with MySQL semantics sqlite cannot oracle (rounding, uint
+wrap, date parsing, CHAR(n) truncation, string-operand temporal fns).
+Reference: pkg/expression/builtin_cast.go + pkg/types conversion rules.
+"""
+
+import datetime
+from decimal import Decimal
+
+import pytest
+
+from tidb_tpu.session import Session
+
+
+@pytest.fixture(scope="module")
+def s():
+    s = Session()
+    s.execute("create table t (a varchar(20), n bigint, d date, f double)")
+    s.execute("insert into t values "
+              "('2024-01-31', 5, '2024-03-01', 1.5), "
+              "(null, null, null, null), "
+              "('12.7', 7, '2023-12-25', 2.0), "
+              "('garbage', 0, '2000-01-01', -3.25)")
+    return s
+
+
+def test_cast_string_column_to_date(s):
+    assert s.must_query("select cast(a as date) from t") == [
+        (datetime.date(2024, 1, 31),), (None,), (None,), (None,)]
+
+
+def test_cast_string_column_to_datetime(s):
+    got = s.must_query("select cast('2024-01-31 10:30:05' as datetime)")
+    assert got == [("2024-01-31 10:30:05",)]
+    assert s.must_query("select cast('2024/01/31' as date)") == [
+        (datetime.date(2024, 1, 31),)]
+    assert s.must_query("select cast('20240131' as date)") == [
+        (datetime.date(2024, 1, 31),)]
+    assert s.must_query("select cast('2024-13-01' as date)") == [(None,)]
+
+
+def test_cast_string_to_numbers_mysql_prefix(s):
+    # MySQL parses the leading numeric prefix; decimal strings ROUND
+    assert s.must_query("select cast(a as signed) from t") == [
+        (2024,), (None,), (13,), (0,)]
+    assert s.must_query("select cast(a as double) from t") == [
+        (2024.0,), (None,), (12.7,), (0.0,)]
+    assert s.must_query("select cast('3.7' as signed)") == [(4,)]
+    assert s.must_query("select cast('-3.7' as signed)") == [(-4,)]
+    # negatives wrap mod 2^64 for UNSIGNED
+    assert s.must_query("select cast('-2' as unsigned)") == [
+        (18446744073709551614,)]
+
+
+def test_cast_string_to_decimal(s):
+    assert s.must_query("select cast(a as decimal(10,2)) from t") == [
+        (Decimal("2024.00"),), (None,), (Decimal("12.70"),),
+        (Decimal("0.00"),)]
+
+
+def test_cast_to_char_and_truncation(s):
+    assert s.must_query("select cast(n as char) from t") == [
+        ("5",), (None,), ("7",), ("0",)]
+    assert s.must_query("select cast(d as char) from t") == [
+        ("2024-03-01",), (None,), ("2023-12-25",), ("2000-01-01",)]
+    assert s.must_query("select cast(f as char) from t") == [
+        ("1.5",), (None,), ("2",), ("-3.25",)]
+    assert s.must_query("select cast(a as char(4)) from t") == [
+        ("2024",), (None,), ("12.7",), ("garb",)]
+    assert s.must_query("select cast(12345 as char(3))") == [("123",)]
+
+
+def test_string_operand_arithmetic(s):
+    assert s.must_query("select a + 1 from t") == [
+        (2025.0,), (None,), (13.7,), (1.0,)]
+
+
+def test_string_operand_temporal_fns(s):
+    assert s.must_query("select date_format(a, '%Y/%m') from t") == [
+        ("2024/01",), (None,), (None,), (None,)]
+    assert s.must_query("select datediff(d, a) from t") == [
+        (30,), (None,), (None,), (None,)]
+    got = s.must_query("select a + interval 1 day from t")
+    assert got[0] == ("2024-02-01 00:00:00",)
+    assert got[1] == (None,)
+    assert s.must_query(
+        "select dayname('2024-01-31'), monthname('2024-01-31')") == [
+        ("Wednesday", "January")]
+
+
+def test_concat_ws_null_skip(s):
+    # NULL arguments are SKIPPED, not propagated (builtin_string.go
+    # concatWS); all-NULL yields '' not NULL
+    assert s.must_query(
+        "select concat_ws('-', a, cast(n as char)) from t") == [
+        ("2024-01-31-5",), ("",), ("12.7-7",), ("garbage-0",)]
+    assert s.must_query("select concat_ws(',', 'x', null, 'y')") == [
+        ("x,y",)]
+
+
+def test_rowwise_host_string_composition(s):
+    # host string producers (cast_char) compose with dict string fns
+    # through the row-wise fallback
+    assert s.must_query("select upper(cast(d as char)) from t")[0] == (
+        "2024-03-01",)
+    assert s.must_query(
+        "select concat(a, '#', cast(n as char)) from t") == [
+        ("2024-01-31#5",), (None,), ("12.7#7",), ("garbage#0",)]
+
+
+def test_coalesce_dict_strings_regression():
+    # the exact round-3 verdict repro: COALESCE/IFNULL over dictionary-
+    # encoded string columns returned codes-as-strings or crashed
+    s2 = Session()
+    s2.execute("create table r (a varchar(10), b varchar(10))")
+    s2.execute("insert into r values ('x', null), ('y', 'w'), (null, 'q')")
+    assert s2.must_query("select coalesce(b, 'z') from r") == [
+        ("z",), ("w",), ("q",)]
+    assert s2.must_query("select coalesce(b, a) from r") == [
+        ("x",), ("w",), ("q",)]
+    assert s2.must_query("select ifnull(b, 'z') from r") == [
+        ("z",), ("w",), ("q",)]
+    assert s2.must_query(
+        "select case when b is null then 'N' else b end from r") == [
+        ("N",), ("w",), ("q",)]

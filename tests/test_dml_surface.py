@@ -100,3 +100,28 @@ def test_select_for_update_blocks_writer(s):
     # share-lock / LOCK IN SHARE MODE syntax parses
     s.must_query("select v from t where id = 1 for share")
     s.must_query("select v from t where id = 1 lock in share mode")
+
+
+def test_update_order_by_desc_uint64_zero(s):
+    # DESC over bigint unsigned: negating raw uint64 keys wraps (0 stays
+    # 0 and sorts first); ranks must put the LARGEST value first
+    s.execute("create table u (id bigint not null, k bigint unsigned, "
+              "primary key (id))")
+    s.execute("insert into u values (1, 0), (2, 10), (3, 5)")
+    s.execute("update u set k = 999 order by k desc limit 1")
+    assert s.must_query("select id from u where k = 999") == [(2,)]
+    s.execute("delete from u order by k desc limit 1")  # deletes k=999
+    assert sorted(s.must_query("select id from u")) == [(1,), (3,)]
+
+
+def test_update_order_by_desc_null_keys(s):
+    # MySQL: NULLs sort FIRST in ASC, LAST in DESC — a NULL key row must
+    # not be picked by ORDER BY col DESC LIMIT 1
+    s.execute("create table nt (id bigint not null, k bigint, "
+              "primary key (id))")
+    s.execute("insert into nt values (1, null), (2, 7), (3, 3)")
+    s.execute("update nt set k = 100 order by k desc limit 1")
+    assert s.must_query("select id from nt where k = 100") == [(2,)]
+    # ASC picks the NULL row first
+    s.execute("update nt set k = -1 order by k limit 1")
+    assert s.must_query("select id from nt where k = -1") == [(1,)]

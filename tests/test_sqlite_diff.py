@@ -240,3 +240,102 @@ def test_range_partition_rejects_out_of_range():
         s.execute("insert into rp values (10)")
     s.execute("insert into rp values (9)")
     assert s.must_query("select count(*) from rp") == [(1,)]
+
+
+# ------------------------------------------------------------------ #
+# dict-string conditionals, NULL-mixing, and casts (VERDICT r3 #2):
+# the round-3 corpus under-covered expressions that MERGE string
+# columns with different dictionaries (COALESCE/IFNULL/CASE returned
+# wrong values or crashed); this corpus systematically exercises
+# string-fn x nullable x dict-mix x cast.  CONCAT/CONCAT_WS are
+# registered on sqlite as python UDFs with MySQL semantics (sqlite
+# 3.40 lacks them natively).
+# ------------------------------------------------------------------ #
+
+@pytest.fixture(scope="module")
+def str_engines():
+    rng = np.random.default_rng(7)
+    n = 400
+    colors = ["red", "green", "blue", None]
+    fruits = ["apple", "fig", "plum", "kiwi", None]
+    nums = ["12", "2024", "7", "0", "x9", None]      # ints only: sqlite
+    s1 = rng.choice(colors, n, p=[0.3, 0.3, 0.2, 0.2])
+    s2 = rng.choice(fruits, n, p=[0.25, 0.25, 0.2, 0.15, 0.15])
+    nm = rng.choice(nums, n)
+
+    ours = Session()
+    ours.execute("create table ds (a bigint, s1 varchar(10), "
+                 "s2 varchar(10), num varchar(10))")
+    lite = sqlite3.connect(":memory:")
+    lite.execute("create table ds (a bigint, s1 varchar(10), "
+                 "s2 varchar(10), num varchar(10))")
+
+    def _concat(*args):
+        if any(a is None for a in args):
+            return None
+        return "".join(str(a) for a in args)
+
+    def _concat_ws(sep, *args):
+        if sep is None:
+            return None
+        return str(sep).join(str(a) for a in args if a is not None)
+
+    lite.create_function("concat", -1, _concat)
+    lite.create_function("concat_ws", -1, _concat_ws)
+    vals = [(i, None if s1[i] is None else str(s1[i]),
+             None if s2[i] is None else str(s2[i]),
+             None if nm[i] is None else str(nm[i])) for i in range(n)]
+    for row in vals:
+        ph = ",".join("null" if v is None else
+                      (f"'{v}'" if isinstance(v, str) else str(v))
+                      for v in row)
+        ours.execute(f"insert into ds values ({ph})")
+    lite.executemany("insert into ds values (?,?,?,?)", vals)
+    lite.commit()
+    return ours, lite
+
+
+STR_CORPUS = [
+    # the exact shapes the round-3 verdict found broken
+    "select coalesce(s1, 'z') from ds order by a",
+    "select ifnull(s1, 'z') from ds order by a",
+    "select coalesce(s1, s2) from ds order by a",
+    "select coalesce(s2, s1, '?') from ds order by a",
+    "select case when s1 is null then s2 else s1 end from ds order by a",
+    "select nullif(s1, 'red') from ds order by a",
+    # conditionals feeding predicates / grouping / ordering
+    "select count(*) from ds where coalesce(s1, 'z') = 'z'",
+    "select a from ds where coalesce(s1, s2) = 'red' order by a",
+    "select coalesce(s1, '?') as k, count(*) from ds group by k order by k",
+    "select a, coalesce(s1, s2) as k from ds order by k, a limit 25",
+    "select upper(coalesce(s1, s2)) from ds order by a limit 50",
+    "select length(coalesce(s1, '')) from ds order by a limit 50",
+    # dict-mix comparisons
+    "select count(*) from ds where s1 = s2",
+    "select count(*) from ds where coalesce(s1, s2) = coalesce(s2, s1)",
+    # concat family incl NULL-skip (python UDF oracle on sqlite)
+    "select concat(s1, '-', s2) from ds order by a limit 50",
+    "select concat_ws('-', s1, s2) from ds order by a limit 50",
+    "select concat_ws('/', s1, s2, num) from ds order by a limit 50",
+    "select count(*) from ds where concat_ws('-', s1, s2) = ''",
+    # string->number casts (integer strings: both engines prefix-parse)
+    "select cast(num as signed) from ds order by a limit 50",
+    "select count(*) from ds where cast(num as signed) > 100",
+    "select cast(num as signed) + a from ds order by a limit 50",
+    "select cast(a as char) from ds order by a limit 20",
+    "select concat(cast(a as char), ':', coalesce(s1, '?')) "
+    "  from ds order by a limit 30",
+    # CASE over mixed sources incl literals
+    "select case when a % 3 = 0 then s1 when a % 3 = 1 then s2 "
+    "  else 'mix' end from ds order by a limit 60",
+]
+
+
+@pytest.mark.parametrize("sql", STR_CORPUS)
+def test_dict_string_differential(str_engines, sql):
+    ours, lite = str_engines
+    got = ours.must_query(sql)
+    exp = lite.execute(sql).fetchall()
+    assert rows_equal(got, exp), (
+        f"\nquery: {sql}\nours ({len(got)}): {got[:10]}\n"
+        f"sqlite ({len(exp)}): {exp[:10]}")

@@ -63,6 +63,11 @@ def _device_supported(e: Expr) -> bool:
     if isinstance(e, Func):
         if e.op not in DEVICE_OPS:
             return False
+        if e.op == "cast" and (e.dtype.is_string
+                               or e.args[0].dtype.is_string):
+            # a surviving string cast means dictionary lowering did not
+            # apply (non-dict source); it must stay on host
+            return False
         return all(_device_supported(a) for a in e.args)
     if isinstance(e, Const):
         # raw string consts must have been lowered to codes/LUTs
@@ -226,10 +231,14 @@ def _parallel_map_chunks(ctx, source, fn):
             if out is not None:
                 yield out
         return
+    import contextvars
     with cf.ThreadPoolExecutor(max_workers=n) as ex:
         pending: deque = deque()
         for ch in source:
-            pending.append(ex.submit(fn, ch))
+            # workers must see the submitter's contextvars (HOST_ONLY,
+            # SUBQUERY_EXECUTOR, OUTER_RESOLVER set by Apply/plan seams)
+            ctx_copy = contextvars.copy_context()
+            pending.append(ex.submit(ctx_copy.run, fn, ch))
             if len(pending) >= 2 * n:
                 out = pending.popleft().result()
                 if out is not None:
@@ -605,7 +614,7 @@ def _eval_to_column(e: Expr, chunk: ResultChunk) -> Column:
     # host residue evaluates the same code-space ops as the device
     dicts = _chunk_dicts(chunk)
     e = lower_strings(e, dicts)
-    v, m = eval_expr(np, e, chunk.col_pairs())
+    v, m = eval_expr(np, e, chunk.col_pairs(), dicts)
     v = np.broadcast_to(np.asarray(v), (n,)).copy() if np.ndim(v) == 0 \
         else np.asarray(v)
     if v.dtype == bool:
@@ -1359,7 +1368,7 @@ def _conds_mask(chunk: ResultChunk, conds, dicts=None) -> np.ndarray:
         dicts = _chunk_dicts(chunk)
     for c in conds:
         c = lower_strings(c, dicts)
-        v, m = eval_expr(np, c, pairs)
+        v, m = eval_expr(np, c, pairs, dicts)
         v = np.broadcast_to(np.asarray(v), (chunk.num_rows,))
         if v.dtype != bool:
             v = v != 0

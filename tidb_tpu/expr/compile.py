@@ -24,7 +24,7 @@ all live here, golden-tested against python Decimal in tests/test_expr.py.
 
 from __future__ import annotations
 
-from typing import Any, Sequence
+from typing import Any, Optional, Sequence
 
 import numpy as np
 
@@ -57,10 +57,16 @@ def vand(a, b):
 
 
 class Evaluator:
-    """Evaluate IR over columns. `xp` = numpy or jax.numpy."""
+    """Evaluate IR over columns. `xp` = numpy or jax.numpy.
 
-    def __init__(self, xp):
+    `dicts` (host evaluation only) maps column index -> StringDict; with
+    it, string functions that dictionary lowering could not rewrite fall
+    back to per-row python evaluation — the residual row-wise builtin
+    path of the reference (builtin_string.go evalString loops)."""
+
+    def __init__(self, xp, dicts=None):
         self.xp = xp
+        self.dicts = dicts
 
     # -- public entry ---------------------------------------------------- #
 
@@ -438,10 +444,134 @@ class Evaluator:
     # -- strings (post-lowering) ----------------------------------------- #
 
     def _op_string_unlowered(self, e, cols, memo):
+        out = self._rowwise_string(e, cols, memo)
+        if out is not None:
+            return out
         raise NotImplementedError(
             f"string function {e.op.upper()} could not be lowered onto "
             "dictionary codes (non-dictionary input, non-constant "
             "arguments, or dictionary product too large)")
+
+    def _str_rows(self, a, cols, memo) -> Optional[tuple]:
+        """(list[str], validity) of a string-producing argument for the
+        row-wise fallback: dict columns decode through their dictionary,
+        host string producers (cast_char/date_format) pass object arrays
+        through, constants broadcast.  None when the values can't be
+        recovered (no dictionary available)."""
+        if isinstance(a, Const):
+            if a.value is None:
+                return ["", False]
+            if isinstance(a.value, str):
+                return [a.value, True]
+            return [str(a.value), True]
+        d = None
+        if isinstance(a, ColumnRef) and a.dtype.is_string:
+            d = (self.dicts or {}).get(a.index)
+            if d is None:
+                return None
+        else:
+            d = getattr(a, "_derived_dict", None)
+        v, m = self.eval(a, cols, memo)
+        v = np.atleast_1d(np.asarray(v))
+        if v.dtype == object:
+            return [list(v), m]
+        if d is not None:
+            return [[d.decode(int(c)) for c in v], m]
+        if not a.dtype.is_string:
+            # numeric operand in a string context (CONCAT(n, 'x'))
+            k = a.dtype.kind
+            if k in (K.FLOAT64, K.FLOAT32):
+                vals = []
+                for x in v:
+                    s = repr(float(x))
+                    vals.append(s[:-2] if s.endswith(".0") else s)
+            else:
+                vals = [str(int(x)) for x in v]
+            return [vals, m]
+        return None
+
+    def _rowwise_string(self, e, cols, memo):
+        """Per-row host evaluation of a string function over recoverable
+        string inputs (numpy only) — composes dict columns with host
+        string producers where no single dictionary space exists."""
+        if self.xp is not np:
+            return None
+        from .lower_strings import _str_valued_impl
+        from .builders import STRING_INT_FUNCS, STRING_VALUED_FUNCS
+        arows = [self._str_rows(a, cols, memo) for a in e.args]
+        n = 1
+        for r in arows:
+            if r is not None and isinstance(r[0], list):
+                n = max(n, len(r[0]))
+
+        def row(r, i):
+            if r is None:
+                return None, False
+            vals, m = r
+            v = vals if isinstance(vals, str) else vals[i]
+            if m is True:
+                ok = True
+            elif m is False:
+                ok = False
+            else:
+                mm = np.atleast_1d(np.asarray(m))
+                ok = bool(mm[i]) if len(mm) > 1 else bool(mm[0])
+            return v, ok
+
+        if e.op == "concat":
+            if any(r is None for r in arows):
+                return None
+            out = np.empty(n, object)
+            valid = np.ones(n, bool)
+            for i in range(n):
+                parts = []
+                for r in arows:
+                    v, ok = row(r, i)
+                    if not ok:
+                        valid[i] = False
+                        break
+                    parts.append(v)
+                out[i] = "".join(parts) if valid[i] else ""
+            return out, valid
+        if e.op in STRING_VALUED_FUNCS or e.op in (
+                "length", "char_length", "ascii"):
+            col_rows = arows[0]
+            if col_rows is None or not isinstance(col_rows[0], list):
+                return None
+            consts = []
+            for a in e.args[1:]:
+                if not isinstance(a, Const) or a.value is None:
+                    return None
+                consts.append(a.value)
+            if e.op == "length":
+                fn = lambda v: len(v.encode("utf-8"))
+            elif e.op == "char_length":
+                fn = lambda v: len(v)
+            elif e.op == "ascii":
+                fn = lambda v: ord(v[0]) if v else 0
+            else:
+                fn = _str_valued_impl(e.op, consts)
+            if fn is None:
+                return None
+            int_out = e.op in STRING_INT_FUNCS
+            out = np.zeros(n, np.int64) if int_out else np.empty(n, object)
+            valid = np.ones(n, bool)
+            for i in range(n):
+                v, ok = row(col_rows, i)
+                if not ok:
+                    valid[i] = False
+                    if not int_out:
+                        out[i] = ""
+                    continue
+                r = fn(v)
+                if r is None:
+                    valid[i] = False
+                    if not int_out:
+                        out[i] = ""
+                else:
+                    out[i] = r
+            return out, valid
+        return None
 
     op_upper = op_lower = op_trim = op_ltrim = op_rtrim = \
         op_reverse = op_substring = op_replace = op_concat = op_left = \
@@ -831,6 +961,44 @@ class Evaluator:
     # dictionary-encodes the produced values (the residual-evaluation
     # half of the pushdown contract, SURVEY.md §A.1).
 
+    def op_cast_char(self, e, cols, memo):
+        """CAST(x AS CHAR[(n)]) for non-string x — per-row host string
+        production, dictionary-encoded by the host projection
+        (builtin_cast.go castAsStringSig).  String sources lower in
+        lower_strings and never reach this op."""
+        from ..types import temporal as tmp
+        a = e.args[0]
+        v, m = self.eval(a, cols, memo)
+        v = np.atleast_1d(np.asarray(v))
+        kind = a.dtype.kind
+        out = np.empty(len(v), object)
+        for i in range(len(v)):
+            x = v[i]
+            if kind == K.DECIMAL:
+                s = dec.to_string(int(x), a.dtype.scale)
+            elif kind == K.DATE:
+                s = tmp.date_to_string(int(x))
+            elif kind == K.DATETIME:
+                s = tmp.datetime_to_string(int(x))
+            elif kind in (K.FLOAT64, K.FLOAT32):
+                s = repr(float(x))
+                if s.endswith(".0"):
+                    s = s[:-2]
+                s = s.replace("e+", "e")
+            elif kind == K.ENUM:
+                ix = int(x)
+                s = (a.dtype.members[ix - 1]
+                     if 1 <= ix <= len(a.dtype.members) else "")
+            elif kind == K.UINT64:
+                s = str(int(np.uint64(np.int64(x))))
+            else:
+                s = str(int(x))
+            out[i] = s
+        n = getattr(e, "_char_len", None)
+        if n is not None:
+            out = np.array([s[:n] for s in out], object)
+        return out, m
+
     def op_date_format(self, e, cols, memo):
         """DATE_FORMAT(d, fmt) — the common MySQL specifiers
         (builtin_time.go dateFormat subset)."""
@@ -930,8 +1098,13 @@ class Evaluator:
     def op_cast(self, e, cols, memo):
         xp = self.xp
         a = e.args[0]
-        v, m = self._num(a, cols, memo)
         src, dst = a.dtype, e.dtype
+        if src.is_string or dst.is_string:
+            # string casts must have been lowered onto dictionary codes
+            # (lower_strings._lower_cast_strings) or routed to cast_char;
+            # evaluating here would cast raw dict CODES
+            raise NotImplementedError(f"unlowered string cast {src} -> {dst}")
+        v, m = self._num(a, cols, memo)
         if dst.kind in (K.FLOAT64, K.FLOAT32):
             out = self._as_double(v, src)
             if dst.kind == K.FLOAT32 and hasattr(out, "astype"):
@@ -1037,8 +1210,8 @@ def _div_valid(xp, ma, mb, vb):
     return vand(vand(ma, mb), nz)
 
 
-def eval_expr(xp, e: Expr, cols: Sequence[Pair]) -> Pair:
-    return Evaluator(xp).eval(e, cols, {})
+def eval_expr(xp, e: Expr, cols: Sequence[Pair], dicts=None) -> Pair:
+    return Evaluator(xp, dicts).eval(e, cols, {})
 
 
 __all__ = ["Evaluator", "eval_expr", "vand"]

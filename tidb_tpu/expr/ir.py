@@ -96,9 +96,10 @@ def clone_func(e: "Func", args) -> "Func":
     (a dict_map's derived output dictionary) — EVERY plan rewrite that
     reconstructs Func nodes must go through this."""
     out = Func(e.dtype, e.op, tuple(args))
-    d = getattr(e, "_derived_dict", None)
-    if d is not None:
-        object.__setattr__(out, "_derived_dict", d)
+    for attr in ("_derived_dict", "_char_len"):
+        d = getattr(e, attr, None)
+        if d is not None:
+            object.__setattr__(out, attr, d)
     return out
 
 

@@ -191,6 +191,18 @@ def lower_strings(e: Expr, dicts: dict[int, StringDict]) -> Expr:
             return lowered
         return e
 
+    if e.op in ("coalesce", "if", "case") and e.dtype.is_string:
+        lowered = _lower_cond_strings(e, args, dicts)
+        if lowered is not None:
+            return lowered
+        return e
+
+    if e.op in ("cast", "cast_char"):
+        lowered = _lower_cast_strings(e, args, dicts)
+        if lowered is not None:
+            return lowered
+        return e
+
     if e.op == "in" and _dict_for(args[0], dicts) is not None:
         d = _dict_for(args[0], dicts)
         has_null = any(isinstance(a, Const) and a.value is None for a in args[1:])
@@ -601,6 +613,209 @@ def _lower_gl_strings(e: Func, args, dicts) -> Optional[Expr]:
                              (a, Const(dt.bigint(False), mapping))))
     from .ir import clone_func
     node = clone_func(e, new_args)
+    object.__setattr__(node, "_derived_dict", merged)
+    return node
+
+
+# ------------------------------------------------------------------ #
+# implicit/explicit casts over dictionary codes (builtin_cast.go +
+# pkg/types conversion rules, re-designed as per-distinct-value host
+# parses feeding one device gather)
+# ------------------------------------------------------------------ #
+
+_NUM_PREFIX = re.compile(r"\s*[-+]?(\d+(\.\d*)?|\.\d+)([eE][-+]?\d+)?")
+_DATE_RX = re.compile(r"(\d{4})[-/.](\d{1,2})[-/.](\d{1,2})")
+_DATE_COMPACT_RX = re.compile(r"(\d{4})(\d{2})(\d{2})")
+
+
+def _str_num_prefix(s: str) -> float:
+    """MySQL string->number coercion: value of the leading numeric
+    prefix, 0 when there is none ('2024-01-31' -> 2024.0, 'abc' -> 0)."""
+    m = _NUM_PREFIX.match(s)
+    if m is None or not m.group(0).strip():
+        return 0.0
+    try:
+        return float(m.group(0))
+    except ValueError:
+        return 0.0
+
+
+def _str_to_days(s: str) -> Optional[int]:
+    """Parse a date (or the date part of a datetime) string to
+    days-since-epoch; None when unparseable (MySQL: NULL + warning)."""
+    from ..types.temporal import date_to_days
+    s = s.strip()
+    for sep in (" ", "T"):
+        if sep in s:
+            s = s.split(sep, 1)[0]
+            break
+    m = _DATE_RX.fullmatch(s) or _DATE_COMPACT_RX.fullmatch(s)
+    if m is None:
+        return None
+    try:
+        return date_to_days(int(m.group(1)), int(m.group(2)),
+                            int(m.group(3)))
+    except ValueError:
+        return None
+
+
+def _str_to_micros(s: str) -> Optional[int]:
+    """Parse a datetime string to micros-since-epoch; a bare date means
+    midnight; None when unparseable."""
+    from ..types.temporal import MICROS_PER_DAY, MICROS_PER_SEC
+    s = s.strip()
+    dpart, tpart = s, ""
+    for sep in (" ", "T"):
+        if sep in s:
+            dpart, tpart = s.split(sep, 1)
+            break
+    days = _str_to_days(dpart)
+    if days is None:
+        return None
+    micros = days * MICROS_PER_DAY
+    if tpart:
+        parts = tpart.split(":")
+        try:
+            h = int(parts[0])
+            mi = int(parts[1]) if len(parts) > 1 else 0
+            sec = parts[2] if len(parts) > 2 else "0"
+            if "." in sec:
+                sp, fp = sec.split(".", 1)
+                frac = int((fp + "000000")[:6])
+                si = int(sp) if sp else 0
+            else:
+                frac, si = 0, int(sec)
+            if not (0 <= h < 24 and 0 <= mi < 60 and 0 <= si < 62):
+                return None
+            micros += ((h * 60 + mi) * 60 + si) * MICROS_PER_SEC + frac
+        except ValueError:
+            return None
+    return micros
+
+
+def _round_half_away(x: float) -> int:
+    import math
+    return int(math.floor(x + 0.5)) if x >= 0 else int(math.ceil(x - 0.5))
+
+
+def _lower_cast_strings(e: Func, args, dicts) -> Optional[Expr]:
+    """CAST with a string on either side.
+
+    - dict string -> number/temporal: per-distinct-value host parse
+      feeding an int/float LUT gather (invalid dates are NULL; numbers
+      take the numeric prefix, MySQL's relaxed coercion).
+    - dict string -> CHAR(n): truncation through a derived dictionary.
+    Non-dict string sources and non-string casts return None (op_cast /
+    op_cast_char handle them)."""
+    src = args[0]
+    dst = e.dtype
+    d = _dict_for(src, dicts)
+    if d is None:
+        return None
+    if not src.dtype.is_string:
+        return None
+    if dst.kind == K.DATE:
+        vals = [_str_to_days(v) for v in d.values]
+        return _derived_ilut_nullable(dst, src, vals)
+    if dst.kind == K.DATETIME:
+        vals = [_str_to_micros(v) for v in d.values]
+        return _derived_ilut_nullable(dst, src, vals)
+    if dst.kind in (K.INT64, K.UINT64):
+        lut = []
+        for v in d.values:
+            x = _round_half_away(_str_num_prefix(v))
+            if dst.kind == K.UINT64:
+                # MySQL wraps negatives mod 2^64; keep the bit pattern
+                x = int(np.uint64(x % (1 << 64)).astype(np.int64))
+            else:
+                x = max(min(x, (1 << 63) - 1), -(1 << 63))
+            lut.append(x)
+        return B.dict_ilut(src, np.asarray(lut or [0], np.int64), dst)
+    if dst.kind in (K.FLOAT64, K.FLOAT32):
+        lut = np.asarray([_str_num_prefix(v) for v in d.values] or [0.0],
+                         np.float64)
+        if dst.kind == K.FLOAT32:
+            lut = lut.astype(np.float32)
+        return Func(dst, "dict_lut", (src, Const(dt.double(False), lut)))
+    if dst.kind == K.DECIMAL:
+        from decimal import Decimal, InvalidOperation
+        scale = dst.scale
+        lut = []
+        for v in d.values:
+            m = _NUM_PREFIX.match(v)
+            txt = m.group(0).strip() if m else ""
+            try:
+                q = Decimal(txt) if txt else Decimal(0)
+            except InvalidOperation:
+                q = Decimal(0)
+            scaled = q.scaleb(scale).to_integral_value(rounding="ROUND_HALF_UP")
+            lut.append(int(scaled))
+        return B.dict_ilut(src, np.asarray(lut or [0], np.int64), dst)
+    if dst.is_string:
+        # CAST(str AS CHAR[(n)]): passthrough, truncating when a length
+        # was given (dt carries it in prec)
+        n = getattr(e, "_char_len", None)
+        if n is None:
+            return src
+        vals = [v[:n] for v in d.values]
+        return _derived_map(dst, src, vals)
+    return None
+
+
+def _cond_value_slots(op: str, n: int) -> list[int]:
+    """Indices of VALUE-producing args of a conditional (the rest are
+    boolean conditions): coalesce -> all; if(c,t,e) -> 1,2; case with
+    (c1,v1,...,else?) -> odd indices plus trailing else."""
+    if op == "coalesce":
+        return list(range(n))
+    if op == "if":
+        return [1, 2]
+    has_else = n % 2 == 1
+    slots = list(range(1, n - (1 if has_else else 0), 2))
+    if has_else:
+        slots.append(n - 1)
+    return slots
+
+
+def _lower_cond_strings(e: Func, args, dicts) -> Optional[Expr]:
+    """COALESCE/IF/CASE over strings: codes are only comparable within one
+    dictionary, so value branches drawing from different dict columns (or
+    string literals) must remap into ONE merged sorted code space before
+    the integer select runs; the node then carries the merged dictionary
+    (reference: builtin_control.go caseWhen/if/ifnull over strings —
+    re-designed as a host-side dictionary merge + device gathers)."""
+    slots = _cond_value_slots(e.op, len(args))
+    values: set[str] = set()
+    metas = []                      # (slot, kind, expr, dict|str|None)
+    for i in slots:
+        a = args[i]
+        d = _dict_for(a, dicts)
+        if d is not None:
+            values.update(d.values)
+            metas.append((i, "col", a, d))
+            continue
+        s = _const_str(a)
+        if s is not None:
+            values.add(s)
+            metas.append((i, "const", a, s))
+            continue
+        if isinstance(a, Const) and a.value is None:
+            metas.append((i, "null", a, None))
+            continue
+        return None                 # non-dict string source: host fallback
+    merged = StringDict(sorted(values))
+    new_args = list(args)
+    for i, kind, a, d in metas:
+        if kind == "const":
+            new_args[i] = Const(dt.bigint(False), merged.code_of(d))
+        elif kind == "col":
+            mapping = np.fromiter((merged.code_of(v) for v in d.values),
+                                  np.int32, count=len(d)) \
+                if len(d) else np.zeros(1, np.int32)
+            new_args[i] = Func(a.dtype, "dict_map",
+                               (a, Const(dt.bigint(False), mapping)))
+    from .ir import clone_func
+    node = clone_func(e, tuple(new_args))
     object.__setattr__(node, "_derived_dict", merged)
     return node
 

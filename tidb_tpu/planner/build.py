@@ -155,7 +155,13 @@ class ExprBuilder:
             # temporal interval arithmetic
             if isinstance(n.right, A.Lit) and n.right.kind == "interval":
                 return self._interval_arith(n)
-            return B.arith(_ARITH[op], self.build(n.left), self.build(n.right))
+            lhs, rhs = self.build(n.left), self.build(n.right)
+            # MySQL numeric context: strings coerce to double ('12.7'+1)
+            if lhs.dtype.is_string:
+                lhs = _coerce_to(dt.double(), lhs)
+            if rhs.dtype.is_string:
+                rhs = _coerce_to(dt.double(), rhs)
+            return B.arith(_ARITH[op], lhs, rhs)
         raise PlanError(f"unsupported operator {op}")
 
     def _interval_arith(self, n: A.Binary) -> Expr:
@@ -170,6 +176,8 @@ class ExprBuilder:
         if n.op == "-":
             amount = -amount
         unit = iv.unit
+        if base.dtype.is_string:
+            base = _coerce_to(dt.datetime(), base)
         if base.dtype.kind not in (K.DATE, K.DATETIME):
             raise PlanError("INTERVAL arithmetic needs a date operand")
         if isinstance(base, Const) and base.dtype.kind == K.DATE \
@@ -227,6 +235,10 @@ class ExprBuilder:
     def _b_castexpr(self, n: A.CastExpr) -> Expr:
         a = self.build(n.arg)
         tn = n.type_name.upper()
+        if isinstance(a, Const) and isinstance(a.value, str):
+            folded = _fold_const_str_cast(a.value, tn, n)
+            if folded is not None:
+                return folded
         if tn in ("SIGNED", "SIGNED INTEGER", "INT", "BIGINT"):
             to = dt.bigint()
         elif tn in ("UNSIGNED", "UNSIGNED INTEGER"):
@@ -240,8 +252,27 @@ class ExprBuilder:
             to = dt.date()
         elif tn in ("DATETIME", "TIMESTAMP"):
             to = dt.datetime()
+        elif tn in ("CHAR", "VARCHAR", "NCHAR", "BINARY"):
+            # CAST(x AS CHAR[(n)]): string targets route non-string
+            # sources to the host cast_char producer; string sources
+            # stay and lower as dictionary truncation/passthrough
+            ln = n.prec if n.prec > 0 else None
+            if a.dtype.is_string:
+                if ln is None:
+                    return a
+                node = Func(dt.varchar(a.dtype.nullable), "cast", (a,))
+            else:
+                node = Func(dt.varchar(a.dtype.nullable), "cast_char",
+                            (a,))
+            if ln is not None:
+                object.__setattr__(node, "_char_len", int(ln))
+            return node
         else:
             raise PlanError(f"unsupported CAST target {tn}")
+        if a.dtype.is_string and to.kind in (dt.TypeKind.DATE,
+                                             dt.TypeKind.DATETIME):
+            # unparseable strings cast to NULL (relaxed MySQL coercion)
+            return Func(to.with_nullable(True), "cast", (a,))
         return B.cast(a, to)
 
     def _b_funccall(self, n: A.FuncCall) -> Expr:
@@ -342,6 +373,8 @@ class ExprBuilder:
             return self._str_func("sha1", *args)
         if name in ("WEEK", "WEEKOFYEAR"):
             base = args[0]
+            if base.dtype.is_string:
+                base = _coerce_to(dt.date(), base)
             if base.dtype.kind not in (K.DATE, K.DATETIME):
                 raise PlanError(f"{name} needs a date operand")
             mode = 3 if name == "WEEKOFYEAR" else 0
@@ -361,27 +394,63 @@ class ExprBuilder:
             if not (len(args) == 2 and isinstance(args[1], Const)
                     and isinstance(args[1].value, str)):
                 raise PlanError("DATE_FORMAT needs a constant format")
-            if args[0].dtype.kind not in (K.DATE, K.DATETIME):
+            base = args[0]
+            if base.dtype.is_string:
+                base = _coerce_to(dt.datetime(), base)
+            if base.dtype.kind not in (K.DATE, K.DATETIME):
                 raise PlanError("DATE_FORMAT needs a date operand")
-            return Func(dt.varchar(args[0].dtype.nullable), "date_format",
-                        (args[0], args[1]))
+            return Func(dt.varchar(base.dtype.nullable), "date_format",
+                        (base, args[1]))
         if name == "CONCAT_WS":
             if len(args) < 2:
                 raise PlanError("CONCAT_WS needs a separator + arguments")
             sep = args[0]
             if not (isinstance(sep, Const) and isinstance(sep.value, str)):
                 raise PlanError("CONCAT_WS needs a constant separator")
-            if any(a.dtype.nullable for a in args[1:]):
-                # NULL args are SKIPPED (not propagated) — the concat
-                # rewrite can't express per-row skips over dict codes
-                raise PlanError("CONCAT_WS over nullable arguments is "
-                                "not supported yet")
-            woven: list = []
-            for a in args[1:]:
+            items = list(args[1:])
+            null_ix = [i for i, a in enumerate(items) if a.dtype.nullable]
+            if not null_ix:
+                woven: list = []
+                for a in items:
+                    if woven:
+                        woven.append(sep)
+                    woven.append(a)
+                return self._str_func("concat", *woven)
+            # NULL args are SKIPPED (builtin_string.go concatWS): expand
+            # the 2^k null patterns of the k nullable args into a CASE —
+            # each branch is a plain concat, so the whole expression
+            # lowers to merged-dictionary gathers on device
+            if len(null_ix) > 4:
+                raise PlanError("CONCAT_WS supports at most 4 nullable "
+                                "arguments")
+            pairs = []
+            for pat in range(1, 1 << len(null_ix)):   # >=1 arg NULL
+                conds = []
+                skip = set()
+                for b, i in enumerate(null_ix):
+                    if pat >> b & 1:
+                        conds.append(B.is_null(items[i]))
+                        skip.add(i)
+                    else:
+                        conds.append(B.logic("not", B.is_null(items[i])))
+                cond = conds[0]
+                for c in conds[1:]:
+                    cond = B.logic("and", cond, c)
+                kept = [a for i, a in enumerate(items) if i not in skip]
+                woven = []
+                for a in kept:
+                    if woven:
+                        woven.append(sep)
+                    woven.append(a)
+                val = (self._str_func("concat", *woven) if woven
+                       else B.lit(""))
+                pairs.append((cond, val))
+            woven = []
+            for a in items:
                 if woven:
                     woven.append(sep)
                 woven.append(a)
-            return self._str_func("concat", *woven)
+            return B.case_when(pairs, self._str_func("concat", *woven))
         if name in ("BIN", "OCT") or (name == "HEX"
                                       and args[0].dtype.kind != K.STRING):
             if not args[0].dtype.is_integer:
@@ -396,6 +465,8 @@ class ExprBuilder:
                         (args[0], args[1]))
         if name in ("DAYNAME", "MONTHNAME"):
             base = args[0]
+            if base.dtype.is_string:
+                base = _coerce_to(dt.date(), base)
             if base.dtype.kind not in (K.DATE, K.DATETIME):
                 raise PlanError(f"{name} needs a date operand")
             from ..expr.lower_strings import _derived_map
@@ -495,6 +566,8 @@ class ExprBuilder:
             else B.lit(int(iv.value))
         base = args[0]
         neg = name in ("DATE_SUB", "SUBDATE")
+        if base.dtype.is_string:
+            base = _coerce_to(dt.datetime(), base)
         if base.dtype.kind not in (K.DATE, K.DATETIME):
             raise PlanError(f"{name} needs a date operand")
         if isinstance(base, Const) and isinstance(amt_e, Const) \
@@ -573,9 +646,57 @@ def _coerce_compare(a: Expr, b: Expr) -> tuple[Expr, Expr]:
     return a, b
 
 
+def _fold_const_str_cast(s: str, tn: str, n: "A.CastExpr") -> Optional[Expr]:
+    """Constant-fold CAST('literal' AS T) with the same relaxed MySQL
+    coercion the dictionary lowering applies per distinct value."""
+    from ..expr.lower_strings import (_round_half_away, _str_num_prefix,
+                                      _str_to_days, _str_to_micros)
+    if tn in ("SIGNED", "SIGNED INTEGER", "INT", "BIGINT"):
+        return Const(dt.bigint(False), _round_half_away(_str_num_prefix(s)))
+    if tn in ("UNSIGNED", "UNSIGNED INTEGER"):
+        x = _round_half_away(_str_num_prefix(s)) % (1 << 64)
+        return Const(dt.ubigint(False), int(np.uint64(x).astype(np.int64)))
+    if tn in ("DOUBLE", "REAL", "FLOAT"):
+        return Const(dt.double(False), _str_num_prefix(s))
+    if tn == "DATE":
+        days = _str_to_days(s)
+        return Const(dt.date(True), days) if days is not None \
+            else Const(dt.null_type(), None)
+    if tn in ("DATETIME", "TIMESTAMP"):
+        us = _str_to_micros(s)
+        return Const(dt.datetime(True), us) if us is not None \
+            else Const(dt.null_type(), None)
+    if tn == "DECIMAL":
+        from decimal import Decimal, InvalidOperation
+        scale = n.scale if n.scale >= 0 else 0
+        prec = n.prec if n.prec > 0 else 10
+        from ..expr.lower_strings import _NUM_PREFIX
+        m = _NUM_PREFIX.match(s)
+        txt = m.group(0).strip() if m else ""
+        try:
+            q = Decimal(txt) if txt else Decimal(0)
+        except InvalidOperation:
+            q = Decimal(0)
+        scaled = int(q.scaleb(scale).to_integral_value(
+            rounding="ROUND_HALF_UP"))
+        return Const(dt.decimal(prec, scale), scaled)
+    if tn in ("CHAR", "VARCHAR", "NCHAR", "BINARY"):
+        ln = n.prec if n.prec > 0 else None
+        return Const(dt.varchar(False), s if ln is None else s[:ln])
+    return None
+
+
 def _coerce_to(target: dt.DataType, e: Expr) -> Expr:
     if isinstance(e, Const) and e.dtype.is_string and not target.is_string:
         return _coerce_compare(e, ColumnRef(target, 0))[0]
+    if e.dtype.is_string and not isinstance(e, Const) \
+            and not target.is_string:
+        # implicit string->T cast over a column/expression: lowers to a
+        # per-dictionary-value parse + gather (builtin_cast.go coercion)
+        to = target
+        if to.kind in (dt.TypeKind.DATE, dt.TypeKind.DATETIME):
+            to = to.with_nullable(True)
+        return Func(to, "cast", (e,))
     return e
 
 
@@ -1651,7 +1772,9 @@ def _expand_view(view, alias: str, catalog, db: str,
     _view_expansion.stack = stack | {key}
     try:
         stmt = parse_sql(view.select_sql)[0]
-        built = build_query(stmt, catalog, db, ctes or {})
+        # view bodies resolve in their own namespace: a CTE in the
+        # referencing query must not shadow a base table named inside
+        built = build_query(stmt, catalog, db, {})
     finally:
         _view_expansion.stack = stack
     sub = built.plan

@@ -1153,6 +1153,12 @@ class Session:
         VALUES(col).  Affected-rows: 1 per insert, 2 per changing update,
         0 when the update leaves the row identical (MySQL counting)."""
         from .catalog import DuplicateKeyError, canon_write_value
+        if tbl.kv is None:
+            # conflict probing walks unique-index KV entries; without a
+            # KV backing the upsert would silently degrade to a plain
+            # insert and surface as a confusing DuplicateKeyError
+            raise PlanError("INSERT ... ON DUPLICATE KEY UPDATE requires "
+                            "a KV-backed table")
         affected = 0
         ci = {n: i for i, n in enumerate(tbl.col_names)}
         for col, _e in on_dup:
@@ -1594,12 +1600,23 @@ class Session:
             for e_ast, desc in reversed(list(order_by)):
                 ir = lower_strings(ExprBuilder(sch).build(e_ast),
                                    dicts or {})
-                v, _m = eval_expr(np, ir, pairs)
+                v, valid = eval_expr(np, ir, pairs)
                 v = np.broadcast_to(np.asarray(v), (n_all,))[idx]
-                keys.append(np.asarray(-v if desc and v.dtype.kind in "iu"
-                                       else (-v if desc
-                                             and v.dtype.kind == "f"
-                                             else v)))
+                if isinstance(valid, np.ndarray):
+                    valid = np.broadcast_to(valid, (n_all,))[idx]
+                else:
+                    valid = np.broadcast_to(np.asarray(bool(valid)),
+                                            (len(idx),))
+                # Sort on dense ranks, not raw values: negating raw keys
+                # wraps uint64 (0 stays 0 → sorts FIRST in DESC) and maps
+                # INT64_MIN to itself. Ranks start at 1 so the NULL rank 0
+                # sorts first ASC and (after negation) last DESC — MySQL's
+                # NULL ordering.
+                _, ranks = np.unique(v, return_inverse=True)
+                ranks = ranks.astype(np.int64) + 1
+                if desc:
+                    ranks = -ranks
+                keys.append(np.where(valid, ranks, 0))
             idx = idx[np.lexsort(tuple(keys))]
         if limit is not None:
             idx = idx[:limit]

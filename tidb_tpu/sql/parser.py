@@ -992,9 +992,15 @@ class Parser:
                         f"unsupported ON DELETE {act}", self.cur)
                 on_delete = act.lower()
             elif self.accept_kw("UPDATE"):
-                act = self.advance().text.upper()  # restrict enforced
+                act = self.advance().text.upper()
                 if act == "NO":
                     self._accept_word("ACTION")
+                    act = "RESTRICT"
+                # only RESTRICT is enforced at update time; reject anything
+                # else instead of silently downgrading CASCADE/SET NULL
+                if act != "RESTRICT":
+                    raise ParseError(
+                        f"unsupported ON UPDATE {act}", self.cur)
             else:
                 raise ParseError("expected DELETE or UPDATE after ON",
                                  self.cur)
