@@ -469,6 +469,17 @@ class ExprBuilder:
                 base = _coerce_to(dt.date(), base)
             if base.dtype.kind not in (K.DATE, K.DATETIME):
                 raise PlanError(f"{name} needs a date operand")
+            if isinstance(base, Const):
+                if base.value is None:
+                    return Const(dt.null_type(), None)
+                from ..types.temporal import days_to_date
+                days = int(base.value)
+                if base.dtype.kind == K.DATETIME:
+                    from ..types.temporal import MICROS_PER_DAY
+                    days //= MICROS_PER_DAY
+                d0 = days_to_date(days)
+                return B.lit(d0.strftime("%A") if name == "DAYNAME"
+                             else d0.strftime("%B"))
             from ..expr.lower_strings import _derived_map
             if name == "DAYNAME":
                 names_ = ["Monday", "Tuesday", "Wednesday", "Thursday",
