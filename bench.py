@@ -92,7 +92,7 @@ def orchestrate():
 
     # 1. pre-generate data (CPU child, no TPU backend) — only the rungs
     #    we might reach; SF=10 is ~60M rows (~4 GB), generate lazily later
-    pregen = [sf for sf in ladder if sf <= 1]
+    pregen = [sf for sf in ladder if sf <= (10 if cpu_only else 1)]
     rc, _ = _run_child({"BENCH_MODE": "gen", "JAX_PLATFORMS": "cpu",
                         "BENCH_SF_LIST": ",".join(str(s) for s in pregen)},
                        900, "datagen")
@@ -124,10 +124,13 @@ def orchestrate():
         print(json.dumps(best_tpu))
         return 0
 
-    # 4. CPU fallback
+    # 4. CPU fallback — the FULL ladder (r3 pinned this to 0.1 and left
+    #    1746s of budget unused; SF=1/10 engage streaming + shard sizing)
     cpu_t = max(deadline - time.time() - 30, 300)
     rc, out = _run_child({"BENCH_MODE": "bench", "JAX_PLATFORMS": "cpu",
-                          "BENCH_SF_LADDER": "0.1"}, cpu_t, "cpu-bench")
+                          "BENCH_SF_LADDER":
+                          ",".join(str(s) for s in ladder)},
+                         cpu_t, "cpu-bench")
     best = _best_result()
     if best is not None:
         print(json.dumps(best))
@@ -217,6 +220,28 @@ def _record(res):
         f.write(json.dumps(res) + "\n")
 
 
+RATIOS_PATH = os.path.join(DATA_DIR, "ratios.json")
+
+
+def _load_ratio(platform, sf):
+    try:
+        with open(RATIOS_PATH) as f:
+            return json.load(f).get(f"{platform}_sf{sf:g}")
+    except (OSError, ValueError):
+        return None
+
+
+def _store_ratio(platform, sf, ratio):
+    try:
+        with open(RATIOS_PATH) as f:
+            d = json.load(f)
+    except (OSError, ValueError):
+        d = {}
+    d[f"{platform}_sf{sf:g}"] = round(float(ratio), 3)
+    with open(RATIOS_PATH, "w") as f:
+        json.dump(d, f)
+
+
 def mode_bench():
     _force_platform()
     import jax
@@ -267,7 +292,11 @@ def _bench_one_sf(sf, platform, n_chips, iters):
     client._result_cache_cap = 0
     # tables beyond the HBM budget stream in double-buffered batches
     cap = int(os.environ.get("BENCH_DEVICE_MEM_CAP", "0") or 0)
-    client.device_mem_cap = cap or (12 << 30 if platform != "cpu" else 0)
+    # CPU fallback caps at 2 GiB so the SF=10 rung exercises the HBM
+    # streaming path (double-buffered row batches) instead of one resident
+    # table — the memory behavior the TPU path depends on
+    client.device_mem_cap = cap or (12 << 30 if platform != "cpu"
+                                    else 2 << 30)
     if snap.row_batches(client.device_mem_cap):
         log(f"table {snap.device_bytes()/2**30:.1f} GiB > cap: streaming")
     agg, meta = _q1_dag(q1_cols, q1_names)
@@ -275,19 +304,47 @@ def _bench_one_sf(sf, platform, n_chips, iters):
     t = time.time()
     res = client.execute_agg(agg, snap, meta)   # warmup: compile + H2D
     log(f"Q1 warmup (compile+transfer) {time.time()-t:.1f}s")
-    times = []
-    for _ in range(iters):
-        t = time.time()
-        res = client.execute_agg(agg, snap, meta)
-        times.append(time.time() - t)
-    q1_t = float(np.median(times))
+    ix1 = {n: i for i, n in enumerate(q1_names)}
+
+    def _measure_q1():
+        """Interleave engine and numpy-baseline runs so transient host
+        contention (the r3 artifact recorded 157ms/0.45x while a dying
+        probe child thrashed the 1-core container) hits both equally;
+        the ratio of medians is contention-fair."""
+        et, bt = [], []
+        for _ in range(iters):
+            t = time.time()
+            client.execute_agg(agg, snap, meta)
+            et.append(time.time() - t)
+            t = time.time()
+            np_q1(q1_cols, ix1)
+            bt.append(time.time() - t)
+        return et, bt
+
+    et, bt = _measure_q1()
+    # variance gate 1: noisy engine timings -> one re-measure
+    if len(et) >= 3 and float(np.std(et)) > 0.5 * float(np.median(et)):
+        log(f"Q1 timing CV high ({np.std(et)/np.median(et):.2f}); re-measuring")
+        et, bt = _measure_q1()
+    q1_t = float(np.median(et))
+    b1 = float(np.median(bt))
+    # variance gate 2: implausible shift vs the last recorded ratio for
+    # this (platform, sf) -> re-measure once and trust the fresh run
+    prior = _load_ratio(platform, sf)
+    if prior is not None and not (0.5 <= (b1 / q1_t) / prior <= 2.0):
+        log(f"Q1 ratio {b1/q1_t:.2f}x shifted >2x from prior {prior:.2f}x; "
+            "re-measuring")
+        et, bt = _measure_q1()
+        q1_t = float(np.median(et))
+        b1 = float(np.median(bt))
+    _store_ratio(platform, sf, b1 / q1_t)
     q1_rps = n_rows / q1_t / n_chips
     log(f"Q1: {q1_t*1e3:.1f} ms  {q1_rps/1e6:.1f} M rows/s/chip "
-        f"({n_chips} chips)")
+        f"({n_chips} chips)  numpy {b1*1e3:.1f} ms  ratio {b1/q1_t:.2f}x")
 
     # correctness spot-check vs numpy
-    ix1 = {n: i for i, n in enumerate(q1_names)}
     exp = np_q1(q1_cols, ix1)
+    res = client.execute_agg(agg, snap, meta)
     got_counts = sorted(int(c) for c in res.columns[-1].data)
     assert got_counts == sorted(v[4] for v in exp.values()), "Q1 mismatch"
 
@@ -346,8 +403,7 @@ def _bench_one_sf(sf, platform, n_chips, iters):
         f"({n_rows/hndv_t/1e6:.1f} M rows/s)  numpy oracle: "
         f"{np_ndv_t*1e3:.1f} ms  speedup {np_ndv_t/hndv_t:.2f}x")
 
-    # CPU baseline: single-core vectorized numpy, same queries
-    t = time.time(); np_q1(q1_cols, ix1); b1 = time.time() - t
+    # CPU baseline Q6 (Q1 baseline measured interleaved above)
     t = time.time(); np_q6(cols, ix); b6 = time.time() - t
     log(f"numpy 1-core Q1: {b1*1e3:.1f} ms ({n_rows/b1/1e6:.1f} M rows/s)  "
         f"Q6: {b6*1e3:.1f} ms")

@@ -1,0 +1,99 @@
+"""TPU grant retry daemon (VERDICT r3 #1b).
+
+Observed axon behavior: `jax.devices()` fails with UNAVAILABLE only after a
+~25-40 min backend init when the pool has no grant, and grants appear in
+windows.  This daemon converts any grant window that opens during a round
+into a recorded TPU datapoint:
+
+    python bench_retry.py &        # run in background for the whole round
+
+Loop: spawn a probe child (bench.py BENCH_MODE=probe, its own process
+group, hang-proof); on a grant, immediately run the TPU bench ladder and
+write the best rung to BENCH_TPU.json at the repo root (plus the full
+per-rung history in $BENCH_DATA_DIR/results.jsonl); otherwise sleep and
+retry.  Stops after the first successful TPU bench or at
+BENCH_RETRY_DEADLINE seconds (default: run forever — the driver's round
+end kills it).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+T0 = time.time()
+HERE = os.path.dirname(os.path.abspath(__file__))
+BENCH = os.path.join(HERE, "bench.py")
+DATA_DIR = os.environ.get("BENCH_DATA_DIR", "/tmp/tidb_tpu_bench")
+OUT = os.path.join(HERE, "BENCH_TPU.json")
+
+
+def log(*a):
+    print(f"[retry {time.time()-T0:8.0f}s]", *a, file=sys.stderr, flush=True)
+
+
+def _child(env_extra, timeout_s, tag):
+    env = dict(os.environ, **env_extra)
+    proc = subprocess.Popen([sys.executable, BENCH], env=env,
+                            stdout=subprocess.PIPE, start_new_session=True)
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+        return proc.returncode, out
+    except subprocess.TimeoutExpired:
+        log(f"{tag} timed out at {timeout_s:.0f}s; killing group")
+        try:
+            os.killpg(proc.pid, 9)
+        except Exception:
+            pass
+        try:
+            out, _ = proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            out = b""
+        return None, out or b""
+
+
+def main():
+    deadline = None
+    if os.environ.get("BENCH_RETRY_DEADLINE"):
+        deadline = T0 + float(os.environ["BENCH_RETRY_DEADLINE"])
+    probe_t = float(os.environ.get("BENCH_PROBE_TIMEOUT", "2700"))
+    sleep_s = float(os.environ.get("BENCH_RETRY_SLEEP", "300"))
+    ladder = os.environ.get("BENCH_SF_LADDER", "0.1,1,10")
+    attempt = 0
+    while deadline is None or time.time() < deadline:
+        attempt += 1
+        log(f"attempt {attempt}: probing for a TPU grant "
+            f"(timeout {probe_t:.0f}s)")
+        rc, out = _child({"BENCH_MODE": "probe"}, probe_t, "probe")
+        if rc != 0:
+            log(f"no grant (rc={rc}); sleeping {sleep_s:.0f}s")
+            time.sleep(sleep_s)
+            continue
+        log("TPU GRANTED:", out.decode().strip(), "— running bench ladder")
+        bench_t = float(os.environ.get("BENCH_TPU_BUDGET", "3000"))
+        rc, out = _child({"BENCH_MODE": "bench", "BENCH_SF_LADDER": ladder},
+                         bench_t, "tpu-bench")
+        results = []
+        try:
+            with open(os.path.join(DATA_DIR, "results.jsonl")) as f:
+                results = [json.loads(ln) for ln in f if ln.strip()]
+        except OSError:
+            pass
+        tpu = [r for r in results if r.get("platform") not in (None, "cpu")]
+        if tpu:
+            best = max(tpu, key=lambda r: r.get("sf", 0))
+            with open(OUT, "w") as f:
+                json.dump({"attempt": attempt,
+                           "granted_after_s": round(time.time() - T0),
+                           "result": best, "all_rungs": tpu}, f, indent=1)
+            log(f"TPU result recorded to {OUT}: {best}")
+            return 0
+        log(f"bench child rc={rc} but no TPU rung recorded; retrying")
+        time.sleep(sleep_s)
+    log("deadline reached without a TPU grant")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

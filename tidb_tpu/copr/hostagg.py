@@ -216,9 +216,10 @@ def host_sort_agg(agg: D.Aggregation, snap) -> Optional[dict]:
             states[f"k{j}"] = {"val": vz[rep], "valid": valid[rep]}
 
     def seg_sum(vals):
-        out = np.zeros(ng, vals.dtype)
-        np.add.at(out, inv, vals)
-        return out
+        # bincount beats np.add.at ~10x; float64 weights are the natural
+        # accumulator for float sums
+        return np.bincount(inv, weights=vals,
+                           minlength=ng)[:ng].astype(vals.dtype)
 
     for i, a in enumerate(agg.aggs):
         if a.func == D.AggFunc.COUNT and a.arg is None:
@@ -241,8 +242,12 @@ def host_sort_agg(agg: D.Aggregation, snap) -> Optional[dict]:
                 raise OverflowError(
                     f"{n} rows exceed the 2^31 limb-exact SUM bound")
             v = np.where(mask, av.astype(np.int64), np.int64(0))
-            states[f"a{i}"] = {"hi": seg_sum(v >> 32),
-                               "lo": seg_sum(v & 0xFFFFFFFF), "cnt": cnt}
+            vmax = int(v.max()) if len(v) else 0
+            vmin = int(v.min()) if len(v) else 0
+            hi, lo = _seg_sum_int(inv, v, ng,
+                                  one_limb=(0 <= vmin and vmax < 2 ** 32),
+                                  cnt=rows)
+            states[f"a{i}"] = {"hi": hi, "lo": lo, "cnt": cnt}
             continue
         # MIN / MAX: neutral-fill invalid rows, segment-reduce in the
         # value's own dtype (uint64 must not be squeezed through int64)
@@ -262,6 +267,44 @@ def host_sort_agg(agg: D.Aggregation, snap) -> Optional[dict]:
         states[f"a{i}"] = {("min" if a.func == D.AggFunc.MIN else "max"):
                            out, "cnt": cnt}
     return states
+
+
+_SEG_CHUNK = 1 << 20
+
+
+def _seg_sum_int(gid: np.ndarray, v: np.ndarray, size: int,
+                 one_limb: bool,
+                 cnt: Optional[np.ndarray] = None
+                 ) -> tuple[np.ndarray, np.ndarray]:
+    """Exact per-group (hi, lo) 32-bit-limb sums of int64 values via
+    chunked np.bincount: each <=2^20-row chunk's float64 weight
+    accumulation stays below 2^52 (exact), and chunk results accumulate
+    in int64 — ~3x faster than np.add.at's scatter loop on this host.
+    `cnt` (per-group row count, len size) avoids re-counting for the
+    signed hi-limb bias when the caller already has it."""
+    lo = np.zeros(size, np.int64)
+    hi = np.zeros(size, np.int64)
+    if not one_limb and cnt is None:
+        cnt = np.zeros(size, np.int64)
+        count_inline = True
+    else:
+        count_inline = False
+    for s in range(0, len(v), _SEG_CHUNK):
+        g = gid[s:s + _SEG_CHUNK]
+        vv = v[s:s + _SEG_CHUNK]
+        lo += np.bincount(g, weights=vv & 0xFFFFFFFF,
+                          minlength=size)[:size].astype(np.int64)
+        if not one_limb:
+            # hi limb is signed: bias into [0, 2^32) for the float
+            # accumulation, subtract the per-group bias at the end
+            biased = (vv >> 32) + (np.int64(1) << 31)
+            hi += np.bincount(g, weights=biased,
+                              minlength=size)[:size].astype(np.int64)
+            if count_inline:
+                cnt += np.bincount(g, minlength=size)[:size]
+    if not one_limb:
+        hi -= np.asarray(cnt, np.int64) << 31
+    return hi, lo
 
 
 def host_dense_agg(agg: D.Aggregation, snap) -> Optional[dict]:
@@ -302,9 +345,8 @@ def host_dense_agg(agg: D.Aggregation, snap) -> Optional[dict]:
         # uncompacted high-selectivity filter: dead rows route to a trim
         # group past G (single pass instead of per-column takes)
         gid = np.where(live, gid, np.int64(G))
-        rows = np.bincount(gid, minlength=G + 1)[:G].astype(np.int64)
-    else:
-        rows = np.bincount(gid, minlength=G).astype(np.int64)
+    full_cnt = np.bincount(gid, minlength=G + 1).astype(np.int64)
+    rows = full_cnt[:G]
     states: dict = {"__rows__": rows}
     for i, a in enumerate(agg.aggs):
         if a.func == D.AggFunc.COUNT and a.arg is None:
@@ -329,8 +371,7 @@ def host_dense_agg(agg: D.Aggregation, snap) -> Optional[dict]:
                 v = av.astype(np.float64)
                 if mask is not None:
                     v = np.where(mask, v, 0.0)
-                out = np.zeros(G + 1, np.float64)
-                np.add.at(out, gid, v)
+                out = np.bincount(gid, weights=v, minlength=G + 1)
                 states[f"a{i}"] = {"sum": out[:G], "cnt": cnt}
             else:
                 if n >= 2 ** 31:
@@ -338,16 +379,12 @@ def host_dense_agg(agg: D.Aggregation, snap) -> Optional[dict]:
                 v = av if av.dtype == np.int64 else av.astype(np.int64)
                 if mask is not None:
                     v = np.where(mask, v, np.int64(0))
-                hi = np.zeros(G + 1, np.int64)
-                lo = np.zeros(G + 1, np.int64)
                 vmax = int(v.max()) if len(v) else 0
                 vmin = int(v.min()) if len(v) else 0
-                if 0 <= vmin and vmax < 2 ** 32:
-                    # values fit one limb: skip the hi shift + scatter
-                    np.add.at(lo, gid, v)
-                else:
-                    np.add.at(hi, gid, v >> 32)
-                    np.add.at(lo, gid, v & 0xFFFFFFFF)
+                hi, lo = _seg_sum_int(gid, v, G + 1,
+                                      one_limb=(0 <= vmin
+                                                and vmax < 2 ** 32),
+                                      cnt=full_cnt)
                 states[f"a{i}"] = {"hi": hi[:G], "lo": lo[:G],
                                    "cnt": cnt}
         else:
