@@ -113,6 +113,9 @@ class TableInfo:
     _pending: list = field(default_factory=list)  # bulk-mode write buffer
     _snapshot: Optional[ColumnarSnapshot] = None
     _epoch: int = 0
+    # per-table schema version for MDL + commit-time validation
+    # (infoschema version as seen by this table's DDL transitions)
+    schema_ver: int = 0
     _auto_inc: int = 0
     _next_handle: int = 0
     _next_index_id: int = 0

@@ -83,6 +83,8 @@ class Domain:
         from ..planner.plan_cache import PlanCache
         self.plan_cache = PlanCache()          # instance plan cache
         self.schema_version = 1                # bumped per DDL transition
+        from ..ddl.mdl import MDLRegistry
+        self.mdl = MDLRegistry()               # pkg/ddl/mdl analog
         self._ddl = None
         import threading
         self._ddl_mu = threading.Lock()
@@ -887,6 +889,7 @@ class Session:
                 self.txn.lock_wait_ms = int(
                     merged.get("innodb_lock_wait_timeout", 3)) * 1000
             self._txn_tables = set()
+            self._txn_table_vers = {}
             self._txn_schema_ver = self.domain.schema_version
         elif stmt.kind == "commit":
             self._finish_txn(commit=True)
@@ -900,34 +903,54 @@ class Session:
         txn, self.txn = self.txn, None
         if txn is None:
             return
-        if not commit:
-            txn.rollback()
-            self._txn_tables = set()
-            return
-        if getattr(self, "_txn_schema_ver", None) not in (
-                None, self.domain.schema_version):
-            # a DDL state transition happened mid-transaction: committing
-            # could miss index entries written under the old schema state
-            # (reference: ErrInfoSchemaChanged at commit, domain
-            # SchemaValidator)
-            txn.rollback()
-            self._txn_tables = set()
-            raise CatalogError(
-                "Information schema is changed during the execution of "
-                "the statement (DDL ran concurrently); transaction rolled "
-                "back, please retry")
         try:
-            txn.commit()
-            self._invalidate_txn_tables()
-        except Exception:
-            txn.rollback()
-            self._txn_tables = set()
-            raise
+            if not commit:
+                txn.rollback()
+                self._txn_tables = set()
+                return
+            # commit-time schema validation, PER WRITTEN TABLE (kv.go:533
+            # SchemaVar / domain SchemaValidator): only a DDL transition
+            # on a table THIS txn wrote aborts it — with MDL draining,
+            # this fires only on the wait-timeout path
+            stale = [t.name for t, ver in
+                     getattr(self, "_txn_table_vers", {}).items()
+                     if t.schema_ver != ver]
+            if stale:
+                txn.rollback()
+                self._txn_tables = set()
+                raise CatalogError(
+                    "Information schema is changed during the execution "
+                    f"of the statement (DDL on {', '.join(stale)} ran "
+                    "concurrently); transaction rolled back, please retry")
+            try:
+                txn.commit()
+                self._invalidate_txn_tables()
+            except Exception:
+                txn.rollback()
+                self._txn_tables = set()
+                raise
+        finally:
+            self.domain.mdl.release_all(txn)
+            self._txn_table_vers = {}
 
     def _invalidate_txn_tables(self):
         for t in self._txn_tables:
             t._invalidate()
         self._txn_tables = set()
+
+    def _txn_note_table(self, tbl) -> None:
+        """Record a table the open txn writes: registers the metadata
+        lock (pkg/ddl/mdl) at the schema version this txn first saw, so a
+        concurrent DDL transition drains this txn before advancing, and
+        pins the version for the per-table commit check."""
+        self._txn_tables.add(tbl)
+        if not hasattr(self, "_txn_table_vers") \
+                or self._txn_table_vers is None:
+            self._txn_table_vers = {}
+        if tbl not in self._txn_table_vers:
+            self._txn_table_vers[tbl] = tbl.schema_ver
+            self.domain.mdl.acquire(tbl.table_id, self.txn,
+                                    tbl.schema_ver)
 
     def _exec_create_table(self, stmt: A.CreateTable) -> ResultSet:
         names, types = [], []
@@ -1105,7 +1128,7 @@ class Session:
         else:
             n = write(self.txn)
         if self.txn is not None:
-            self._txn_tables.add(tbl)
+            self._txn_note_table(tbl)
         self.domain.stats.note_modify(tbl, n)
         return ResultSet(affected=n)
 
@@ -1493,7 +1516,7 @@ class Session:
             if self.txn is not None:
                 tbl.update_rows(upd_handles, old_rows, updated,
                                 txn=self.txn)
-                self._txn_tables.add(tbl)
+                self._txn_note_table(tbl)
             else:
                 tbl.update_rows(upd_handles, old_rows, updated)
         else:
