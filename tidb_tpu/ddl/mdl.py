@@ -41,6 +41,19 @@ class MDLRegistry:
             if changed:
                 self._cv.notify_all()
 
+    def evict_below(self, table_id: int, ver: int) -> int:
+        """Drop holders stuck below `ver` after a drain timeout: they are
+        doomed to abort at commit (>=2-version gap), so later transitions
+        must not re-wait on them.  Returns how many were evicted."""
+        with self._cv:
+            h = self._holders.get(table_id, {})
+            stale = [t for t, v in h.items() if v < ver]
+            for t in stale:
+                del h[t]
+            if stale:
+                self._cv.notify_all()
+            return len(stale)
+
     def holders_below(self, table_id: int, ver: int) -> int:
         with self._cv:
             h = self._holders.get(table_id, {})

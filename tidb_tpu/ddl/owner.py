@@ -130,6 +130,21 @@ class DDLExecutor:
         except Exception:
             tbl = None            # table dropped mid-job
         if tbl is not None:
+            tbl.schema_ver += 1
+            # MDL (pkg/ddl/mdl, F1 wait-for-version-ack): before running
+            # under the NEW version, drain every open txn still using an
+            # older version of THIS table.  On timeout the transition
+            # proceeds and the straggler txn aborts at commit instead
+            # (session._finish_txn per-table schema validation).
+            timeout = float(self.domain.sysvars.get(
+                "tidb_mdl_wait_timeout", 10.0) or 10.0)
+            drained = self.domain.mdl.wait_drain(
+                tbl.table_id, tbl.schema_ver, timeout_s=timeout)
+            if not drained:
+                # straggler txns are now >=2 versions behind: doomed to
+                # abort at commit, so stop re-waiting on them
+                self.domain.mdl.evict_below(tbl.table_id, tbl.schema_ver)
+                job.mdl_timeouts = getattr(job, "mdl_timeouts", 0) + 1
             tbl._persist_meta()   # catalog-on-KV: index states survive
             # (persistence failures propagate — silently losing an index
             # state transition would corrupt the restart view)

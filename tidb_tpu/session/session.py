@@ -909,12 +909,14 @@ class Session:
                 self._txn_tables = set()
                 return
             # commit-time schema validation, PER WRITTEN TABLE (kv.go:533
-            # SchemaVar / domain SchemaValidator): only a DDL transition
-            # on a table THIS txn wrote aborts it — with MDL draining,
-            # this fires only on the wait-timeout path
+            # SchemaVar / domain SchemaValidator): F1 adjacent states are
+            # mutually compatible, so ONE version step on a written table
+            # is fine (MDL drains before the second step); a >=2 gap
+            # means this txn straddled two transitions (MDL timeout path)
+            # and could miss index entries -> abort with retry semantics
             stale = [t.name for t, ver in
                      getattr(self, "_txn_table_vers", {}).items()
-                     if t.schema_ver != ver]
+                     if t.schema_ver > ver + 1]
             if stale:
                 txn.rollback()
                 self._txn_tables = set()
@@ -930,7 +932,7 @@ class Session:
                 self._txn_tables = set()
                 raise
         finally:
-            self.domain.mdl.release_all(txn)
+            self.domain.mdl.release_all(id(txn))
             self._txn_table_vers = {}
 
     def _invalidate_txn_tables(self):
@@ -949,8 +951,8 @@ class Session:
             self._txn_table_vers = {}
         if tbl not in self._txn_table_vers:
             self._txn_table_vers[tbl] = tbl.schema_ver
-            self.domain.mdl.acquire(tbl.table_id, self.txn,
-                                    tbl.schema_ver)
+            self.domain.mdl.acquire(tbl.table_id, id(self.txn),
+                                     tbl.schema_ver)
 
     def _exec_create_table(self, stmt: A.CreateTable) -> ResultSet:
         names, types = [], []

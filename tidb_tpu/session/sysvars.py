@@ -51,6 +51,7 @@ _VARS = [
     _v("tidb_max_chunk_size", 1024, kind="int", min=32, max=65536),
     _v("tidb_enable_vectorized_expression", 1, kind="bool"),
     _v("tidb_ddl_reorg_worker_cnt", 4, kind="int", min=1, max=128),
+    _v("tidb_mdl_wait_timeout", 10.0, kind="float", min=0.0, max=3600.0),
     _v("tidb_mem_quota_query", -1, kind="int"),
     _v("tidb_enable_tmp_storage_on_oom", 1, kind="bool"),
     _v("tidb_enable_plan_cache", 1, kind="bool"),
