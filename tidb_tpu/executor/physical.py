@@ -2091,21 +2091,59 @@ class IndexLookUpExec(PhysOp):
     out_names: list = field(default_factory=list)
     out_dtypes: list = field(default_factory=list)
     children: list = field(default_factory=list)
+    # order property (find_best_task keep-order analog): the index scan's
+    # native key order SATISFIES a required ORDER BY, so the plan carries
+    # no sort; `reverse` walks the index backward (DESC), `limit`/`offset`
+    # stop the handle walk early (ORDER BY ... LIMIT through the index)
+    keep_order: bool = False
+    reverse: bool = False
+    limit: Any = None
+    offset: int = 0
 
     def describe(self):
         ix = self.access.index
         kind = "PointGet" if self.access.is_point else "IndexLookUp"
         rng = f" range[{self.access.range_col}]" if self.access.range_col else ""
+        ko = ""
+        if self.keep_order:
+            ko = ", keep-order" + (" desc" if self.reverse else "")
+            if self.limit is not None:
+                ko += f", limit={self.limit}"
         return (f"{kind}[{self.table.name}.{ix.name}] "
-                f"eq={self.access.eq_values}{rng}")
+                f"eq={self.access.eq_values}{rng}{ko}")
 
     def execute(self, ctx):
         tbl = self.table
         kv = tbl.kv
         ts = ctx.kv_read_ts(kv)
         handles = _index_handles(tbl, self.access, kv, ts)
-        return _fetch_filter_rows(tbl, kv, ts, handles, self.col_offsets,
-                                  self.out_names, self.conditions)
+        if self.reverse:
+            handles = list(reversed(handles))
+        if self.limit is None:
+            return _fetch_filter_rows(tbl, kv, ts, handles,
+                                      self.col_offsets, self.out_names,
+                                      self.conditions)
+        # early-stop walk: fetch/filter in handle batches until
+        # offset+limit surviving rows are found, preserving index order
+        need = self.limit + self.offset
+        out = None
+        for lo in range(0, len(handles), 256):
+            chunk = _fetch_filter_rows(tbl, kv, ts,
+                                       handles[lo:lo + 256],
+                                       self.col_offsets, self.out_names,
+                                       self.conditions)
+            out = chunk if out is None else ResultChunk(
+                out.names, [Column.concat([a, b]) for a, b in
+                            zip(out.columns, chunk.columns)])
+            if out.num_rows >= need:
+                break
+        if out is None:
+            return _fetch_filter_rows(tbl, kv, ts, [], self.col_offsets,
+                                      self.out_names, self.conditions)
+        lo, hi = self.offset, need
+        return ResultChunk(out.names,
+                           [c.slice(lo, min(hi, out.num_rows))
+                            for c in out.columns])
 
 
 def _index_handles(tbl, acc, kv, ts: int) -> list:

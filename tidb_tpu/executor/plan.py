@@ -56,6 +56,14 @@ def to_physical(p: LogicalPlan, no_device_join: bool = False) -> PhysOp:
     if isinstance(p, LogicalProjection) and isinstance(p.child, DualSource):
         return DualExec(list(p.exprs), out_names=p.schema.names())
 
+    if isinstance(p, LogicalTopN) and p.limit + p.offset <= 4096:
+        # order property first (find_best_task): a small ORDER BY+LIMIT
+        # through an index walk reads ~limit rows; the device TopN scan
+        # reads the whole table
+        ordered = _try_index_ordered_topn(p)
+        if ordered is not None:
+            return ordered
+
     cop = _try_cop(p, no_device_join)
     if cop is not None:
         return cop
@@ -169,6 +177,76 @@ def to_physical(p: LogicalPlan, no_device_join: bool = False) -> PhysOp:
 
 
 # --------------------------------------------------------------------- #
+
+def _try_index_ordered_topn(p) -> Optional[PhysOp]:
+    """Order-property physical choice (find_best_task keep-order analog,
+    core/optimizer.go:1080): ORDER BY <index prefix> LIMIT n over a
+    KV-backed table is served by walking the index in key order (or
+    backward for DESC) with an early-stop handle fetch — no sort operator
+    in the plan.  Requires: plain ColumnRef keys forming a prefix of one
+    index, uniform direction, child = DataSource or Selection(DataSource)
+    with row-evaluable residuals."""
+    from ..expr.ir import ColumnRef
+    from ..planner.ranger import IndexAccess
+    child = p.child
+    conds: list = []
+    proj = None
+    keys = list(p.keys)
+    if isinstance(child, LogicalProjection) \
+            and all(isinstance(e, ColumnRef) for e in child.exprs):
+        # see through a pure column projection: remap keys into the
+        # source schema; the projection re-applies above the ordered scan
+        proj = child
+        remapped = []
+        for e, d in keys:
+            if not isinstance(e, ColumnRef) \
+                    or e.index >= len(proj.exprs):
+                return None
+            remapped.append((proj.exprs[e.index], d))
+        keys = remapped
+        child = child.children[0]
+    if isinstance(child, LogicalSelection):
+        conds = list(child.conditions)
+        child = child.children[0]
+    if not isinstance(child, DataSource) or child.table.kv is None \
+            or getattr(child.table, "partition", None) is not None \
+            or getattr(child, "as_of_ts", None) is not None \
+            or getattr(child.table, "is_memtable", False):
+        return None
+    if not keys:
+        return None
+    descs = {d for _, d in keys}
+    if len(descs) != 1:
+        return None                     # mixed ASC/DESC: order not native
+    desc = descs.pop()
+    key_cols = []
+    for e, _d in keys:
+        if not isinstance(e, ColumnRef):
+            return None
+        key_cols.append(child.table.col_names[
+            child.col_offsets[e.index]].lower()
+            if e.index < len(child.col_offsets) else None)
+    if None in key_cols:
+        return None
+    ignore = {n.lower() for n in (child.hint_ignore or [])}
+    for ix in child.table.indexes:
+        if ix.state != "public" or ix.name.lower() in ignore:
+            continue
+        if [c.lower() for c in ix.columns[:len(key_cols)]] == key_cols:
+            acc = IndexAccess(ix)       # full-range ordered walk
+            scan = IndexLookUpExec(
+                child.table, acc, list(child.col_offsets),
+                conditions=conds,
+                out_names=child.schema.names(),
+                out_dtypes=[c.dtype for c in child.schema.cols],
+                keep_order=True, reverse=desc,
+                limit=p.limit, offset=p.offset)
+            if proj is None:
+                return scan
+            return HostProjection(scan, list(proj.exprs),
+                                  out_names=proj.schema.names())
+    return None
+
 
 def _try_cop(p: LogicalPlan, no_device_join: bool = False) -> Optional[PhysOp]:
     """Fuse the subtree rooted at p into one CopTask if possible."""
