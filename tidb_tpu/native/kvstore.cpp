@@ -90,8 +90,68 @@ struct VersionChain {
   std::map<uint64_t, std::string> data;
 };
 
+// ------------------------------------------------------------------ //
+// LSM layer: immutable sorted runs (badger-LSM analog, mvcc.go:50).
+//
+// The mutable std::map is the MEMTABLE: all locks and fresh writes live
+// there.  kv_flush freezes every unlocked key's committed chain into an
+// immutable Run — flat sorted key array + columnar version arrays + a
+// bloom filter — and erases it from the memtable.  Reads merge memtable
+// (newest) over runs (newest-last); compaction merges runs and applies
+// the GC safepoint as a filter (gc-by-compaction, not whole-store scan).
+// Runs are an in-memory layout: durability stays WAL + checkpoint.
+// ------------------------------------------------------------------ //
+
+struct Run {
+  std::vector<std::string> keys;      // sorted ascending
+  std::vector<uint32_t> woff;         // keys.size()+1 prefix offsets
+  std::vector<WriteRec> writes;       // per key newest-first
+  std::vector<std::string> vals;      // parallel to writes (PUT payload)
+  std::vector<uint64_t> bloom;        // bit array, power-of-two words
+  uint64_t bloom_mask = 0;
+
+  static uint64_t h1(const std::string& k) {
+    uint64_t h = 1469598103934665603ull;
+    for (char c : k) { h ^= static_cast<uint8_t>(c); h *= 1099511628211ull; }
+    return h;
+  }
+  void bloom_build() {
+    size_t bits = 64;
+    while (bits < keys.size() * 10) bits <<= 1;
+    bloom.assign(bits / 64, 0);
+    bloom_mask = bits - 1;
+    for (const auto& k : keys) {
+      uint64_t a = h1(k), b = a * 0x9e3779b97f4a7c15ull + 1;
+      bloom[(a & bloom_mask) >> 6] |= 1ull << (a & 63);
+      bloom[(b & bloom_mask) >> 6] |= 1ull << (b & 63);
+    }
+  }
+  bool maybe(const std::string& k) const {
+    if (bloom.empty()) return false;
+    uint64_t a = h1(k), b = a * 0x9e3779b97f4a7c15ull + 1;
+    return (bloom[(a & bloom_mask) >> 6] >> (a & 63) & 1)
+        && (bloom[(b & bloom_mask) >> 6] >> (b & 63) & 1);
+  }
+  // index of k, or -1 (binary search over the flat sorted array)
+  int64_t find(const std::string& k) const {
+    auto it = std::lower_bound(keys.begin(), keys.end(), k);
+    if (it == keys.end() || *it != k) return -1;
+    return it - keys.begin();
+  }
+  int64_t lower(const std::string& k) const {
+    return std::lower_bound(keys.begin(), keys.end(), k) - keys.begin();
+  }
+};
+
 struct Store {
   std::map<std::string, VersionChain> keys;
+  // immutable sorted runs, NEWEST LAST; shared_ptr so readers finishing
+  // under the shared lock never race a compaction swap
+  std::vector<std::shared_ptr<Run>> runs;
+  uint64_t gc_safepoint = 0;
+  size_t flush_threshold = 1 << 16;   // memtable keys before auto-flush
+  size_t max_runs = 8;                // compaction trigger
+  uint64_t commits_since_check = 0;
   mutable std::shared_mutex mu;
   uint64_t ts_counter = 1;  // simple TSO for embedded use (PD analog)
   // durability (empty path = in-memory only)
@@ -223,6 +283,165 @@ const WriteRec* latest_write_le(const VersionChain& vc, uint64_t ts) {
   return nullptr;
 }
 
+// newest write <= ts for key across the runs (newest run first); sets
+// *val to the PUT payload.  Returns false when no run holds one.
+bool runs_latest_le(const Store* s, const std::string& k, uint64_t ts,
+                    const WriteRec** w_out, const std::string** val_out) {
+  for (auto rit = s->runs.rbegin(); rit != s->runs.rend(); ++rit) {
+    const Run& r = **rit;
+    if (!r.maybe(k)) continue;
+    int64_t i = r.find(k);
+    if (i < 0) continue;
+    for (uint32_t j = r.woff[i]; j < r.woff[i + 1]; ++j) {
+      const WriteRec& w = r.writes[j];
+      if (w.commit_ts <= ts && w.op != OP_ROLLBACK) {
+        *w_out = &w;
+        *val_out = &r.vals[j];
+        return true;
+      }
+    }
+  }
+  return false;
+}
+
+// conflict view for prewrite/pessimistic-lock: newest non-rollback commit
+// across memtable+runs, plus whether a rollback record exists for
+// start_ts.  (memtable is always newer than any run for a key.)
+void conflict_view(const Store* s, const VersionChain* vc,
+                   const std::string& k, uint64_t start_ts,
+                   uint64_t* newest_commit, bool* rolled_back) {
+  *newest_commit = 0;
+  *rolled_back = false;
+  auto scan_list = [&](const WriteRec* ws, size_t n) {
+    for (size_t i = 0; i < n; ++i) {
+      const WriteRec& w = ws[i];
+      if (w.op == OP_ROLLBACK) {
+        if (w.start_ts == start_ts) *rolled_back = true;
+        continue;
+      }
+      if (*newest_commit == 0) *newest_commit = w.commit_ts;
+      // keep scanning only for rollback-by-start_ts
+    }
+  };
+  if (vc != nullptr && !vc->writes.empty())
+    scan_list(vc->writes.data(), vc->writes.size());
+  for (auto rit = s->runs.rbegin(); rit != s->runs.rend(); ++rit) {
+    const Run& r = **rit;
+    if (!r.maybe(k)) continue;
+    int64_t i = r.find(k);
+    if (i < 0) continue;
+    scan_list(&r.writes[r.woff[i]], r.woff[i + 1] - r.woff[i]);
+  }
+}
+
+// GC filter shared by kv_gc (memtable) and compaction (runs): which
+// writes of a newest-first chain survive `safepoint`.
+std::vector<char> gc_live_mask(const std::vector<WriteRec>& ws,
+                               uint64_t safepoint) {
+  const WriteRec* keep = nullptr;
+  for (const auto& w : ws)
+    if (w.commit_ts <= safepoint && w.op != OP_ROLLBACK) { keep = &w; break; }
+  std::vector<char> live(ws.size(), 0);
+  for (size_t i = 0; i < ws.size(); ++i) {
+    const WriteRec& w = ws[i];
+    live[i] = w.commit_ts > safepoint
+              || (keep && w.op != OP_ROLLBACK
+                  && w.commit_ts == keep->commit_ts);
+  }
+  return live;
+}
+
+// merge every run (newest-last) into one, applying the GC safepoint as a
+// compaction filter.  Caller holds the unique lock.
+int64_t compact_runs(Store* s) {
+  if (s->runs.size() <= 1 && s->gc_safepoint == 0) return 0;
+  auto merged = std::make_shared<Run>();
+  int64_t dropped = 0;
+  // per-run cursors over sorted keys
+  std::vector<size_t> cur(s->runs.size(), 0);
+  for (;;) {
+    const std::string* next = nullptr;
+    for (size_t r = 0; r < s->runs.size(); ++r) {
+      if (cur[r] >= s->runs[r]->keys.size()) continue;
+      const std::string& k = s->runs[r]->keys[cur[r]];
+      if (next == nullptr || k < *next) next = &k;
+    }
+    if (next == nullptr) break;
+    std::string key = *next;
+    // newest-first chain: newest run's records first
+    std::vector<WriteRec> ws;
+    std::vector<std::string> vs;
+    for (size_t r = s->runs.size(); r-- > 0;) {
+      Run& src = *s->runs[r];
+      if (cur[r] >= src.keys.size() || src.keys[cur[r]] != key) continue;
+      size_t i = cur[r]++;
+      for (uint32_t j = src.woff[i]; j < src.woff[i + 1]; ++j) {
+        ws.push_back(src.writes[j]);
+        vs.push_back(std::move(src.vals[j]));
+      }
+    }
+    auto live = gc_live_mask(ws, s->gc_safepoint);
+    std::vector<WriteRec> kept_w;
+    std::vector<std::string> kept_v;
+    for (size_t i = 0; i < ws.size(); ++i) {
+      if (live[i]) {
+        kept_w.push_back(ws[i]);
+        kept_v.push_back(std::move(vs[i]));
+      } else {
+        ++dropped;
+      }
+    }
+    // fully dead key (tombstoned before the safepoint): drop entirely
+    bool all_dead = true;
+    for (const auto& w : kept_w)
+      if (w.op != OP_ROLLBACK) { all_dead = false; break; }
+    if (kept_w.empty() || all_dead) continue;
+    merged->keys.push_back(std::move(key));
+    for (size_t i = 0; i < kept_w.size(); ++i) {
+      merged->writes.push_back(kept_w[i]);
+      merged->vals.push_back(std::move(kept_v[i]));
+    }
+    merged->woff.push_back(
+        static_cast<uint32_t>(merged->writes.size()));
+  }
+  // woff holds per-key END offsets so far; convert to prefix offsets
+  std::vector<uint32_t> off(merged->keys.size() + 1, 0);
+  for (size_t i = 0; i < merged->keys.size(); ++i)
+    off[i + 1] = merged->woff[i];
+  merged->woff = std::move(off);
+  merged->bloom_build();
+  s->runs.clear();
+  if (!merged->keys.empty()) s->runs.push_back(std::move(merged));
+  return dropped;
+}
+
+// freeze every unlocked memtable key's committed chain into a new run.
+// Caller holds the unique lock.  Returns keys moved.
+int64_t flush_memtable(Store* s) {
+  auto run = std::make_shared<Run>();
+  run->woff.push_back(0);
+  for (auto it = s->keys.begin(); it != s->keys.end();) {
+    VersionChain& vc = it->second;
+    if (vc.lock.present || vc.writes.empty()) { ++it; continue; }
+    run->keys.push_back(it->first);
+    for (const auto& w : vc.writes) {
+      run->writes.push_back(w);
+      auto dit = vc.data.find(w.start_ts);
+      run->vals.push_back(
+          (w.op == OP_PUT && dit != vc.data.end()) ? dit->second
+                                                   : std::string());
+    }
+    run->woff.push_back(static_cast<uint32_t>(run->writes.size()));
+    it = s->keys.erase(it);
+  }
+  if (run->keys.empty()) return 0;
+  int64_t moved = static_cast<int64_t>(run->keys.size());
+  run->bloom_build();
+  s->runs.push_back(std::move(run));
+  if (s->runs.size() > s->max_runs) compact_runs(s);
+  return moved;
+}
+
 }  // namespace
 
 extern "C" {
@@ -262,6 +481,21 @@ int64_t kv_checkpoint(void* h) {
   if (f == nullptr) return -1;
   int64_t n = 0;
   bool ok = true;
+  // runs first (older data), then memtable; replay dedupes by
+  // (commit_ts, start_ts) and orders by insertion, so either works
+  for (const auto& run : s->runs) {
+    if (!ok) break;
+    for (size_t i = 0; ok && i < run->keys.size(); ++i) {
+      for (uint32_t j = run->woff[i + 1]; j-- > run->woff[i];) {
+        const WriteRec& w = run->writes[j];   // oldest-first
+        if (w.op == OP_ROLLBACK) continue;
+        ok = write_record(f, run->keys[i], w.start_ts, w.commit_ts,
+                          w.op, run->vals[j]);
+        ++n;
+        if (!ok) break;
+      }
+    }
+  }
   for (const auto& [key, vc] : s->keys) {
     if (!ok) break;
     // oldest-first so replay's insertion rebuilds newest-first
@@ -329,23 +563,22 @@ int32_t kv_prewrite(void* h, const char* key, int32_t klen, const char* val,
   // commits in (start_ts, for_update_ts] are permitted in this mode
   bool own_pess = vc.lock.present && vc.lock.pessimistic
                   && vc.lock.start_ts == start_ts;
+  uint64_t newest = 0;
+  bool rolled_back = false;
+  conflict_view(s, &vc, k, start_ts, &newest, &rolled_back);
   if (!own_pess) {
-    // write conflict: any commit (or rollback of us) after start_ts
+    // write conflict: any commit after start_ts (memtable or runs)
     for (const auto& w : vc.writes) {
-      if (w.commit_ts > start_ts) {
-        if (w.op == OP_ROLLBACK && w.start_ts != start_ts) continue;
-        return w.op == OP_ROLLBACK ? ERR_ALREADY_ROLLED_BACK
-                                   : ERR_WRITE_CONFLICT;
+      if (w.commit_ts > start_ts && w.op == OP_ROLLBACK
+          && w.start_ts == start_ts) {
+        return ERR_ALREADY_ROLLED_BACK;
       }
-      break;  // writes are newest-first; older ones can't conflict
+      break;
     }
+    if (newest > start_ts) return ERR_WRITE_CONFLICT;
   }
   // rollback record for this exact start_ts => txn was aborted
-  for (const auto& w : vc.writes) {
-    if (w.op == OP_ROLLBACK && w.start_ts == start_ts) {
-      return ERR_ALREADY_ROLLED_BACK;
-    }
-  }
+  if (rolled_back) return ERR_ALREADY_ROLLED_BACK;
   vc.lock.present = true;
   vc.lock.pessimistic = false;   // upgrade: pessimistic -> prewrite lock
   vc.lock.start_ts = start_ts;
@@ -387,6 +620,12 @@ int32_t kv_commit(void* h, const char* key, int32_t klen, uint64_t start_ts,
                    WriteRec{commit_ts, start_ts, vc.lock.op});
   vc.lock = Lock{};
   s->lock_cv.notify_all();
+  // amortized auto-flush: freeze the memtable once it outgrows the
+  // threshold (checked every 1024 commits to keep the hot path flat)
+  if (++s->commits_since_check >= 1024) {
+    s->commits_since_check = 0;
+    if (s->keys.size() >= s->flush_threshold) flush_memtable(s);
+  }
   return OK;
 }
 
@@ -412,16 +651,28 @@ int32_t kv_get(void* h, const char* key, int32_t klen, uint64_t ts,
                const char** out, int32_t* out_len) {
   auto* s = static_cast<Store*>(h);
   std::shared_lock lk(s->mu);
-  auto it = s->keys.find(std::string(key, klen));
-  if (it == s->keys.end()) return ERR_NOT_FOUND;
-  const auto& vc = it->second;
-  int32_t lc = check_lock_conflict(vc, ts, 0);
-  if (lc != OK) return lc;
-  const WriteRec* w = latest_write_le(vc, ts);
-  if (w == nullptr || w->op == OP_DELETE) return ERR_NOT_FOUND;
-  auto dit = vc.data.find(w->start_ts);
-  if (dit == vc.data.end()) return ERR_NOT_FOUND;
-  g_err = dit->second;
+  std::string k(key, klen);
+  auto it = s->keys.find(k);
+  if (it != s->keys.end()) {
+    const auto& vc = it->second;
+    int32_t lc = check_lock_conflict(vc, ts, 0);
+    if (lc != OK) return lc;
+    const WriteRec* w = latest_write_le(vc, ts);
+    if (w != nullptr) {   // memtable writes are newer than any run's
+      if (w->op == OP_DELETE) return ERR_NOT_FOUND;
+      auto dit = vc.data.find(w->start_ts);
+      if (dit == vc.data.end()) return ERR_NOT_FOUND;
+      g_err = dit->second;
+      *out = g_err.data();
+      *out_len = static_cast<int32_t>(g_err.size());
+      return OK;
+    }
+  }
+  const WriteRec* w = nullptr;
+  const std::string* val = nullptr;
+  if (!runs_latest_le(s, k, ts, &w, &val)) return ERR_NOT_FOUND;
+  if (w->op == OP_DELETE) return ERR_NOT_FOUND;
+  g_err = *val;
   *out = g_err.data();
   *out_len = static_cast<int32_t>(g_err.size());
   return OK;
@@ -440,28 +691,88 @@ int32_t kv_scan(void* h, const char* start, int32_t slen, const char* end,
   std::shared_lock lk(s->mu);
   std::string sk(start, slen), ek(end, elen);
   auto it = s->keys.lower_bound(sk);
+  // k-way merge: memtable iterator + one cursor per run (runs sorted)
+  std::vector<size_t> rcur(s->runs.size());
+  for (size_t r = 0; r < s->runs.size(); ++r)
+    rcur[r] = static_cast<size_t>(s->runs[r]->lower(sk));
   int32_t n = 0;
   int64_t off = 0;
   *truncated = 0;
-  for (; it != s->keys.end() && n < limit; ++it) {
-    if (!ek.empty() && it->first >= ek) break;
-    const auto& vc = it->second;
-    if (check_lock_conflict(vc, ts, 0) != OK) return -ERR_LOCKED;
-    const WriteRec* w = latest_write_le(vc, ts);
-    if (w == nullptr || w->op == OP_DELETE) continue;
-    auto dit = vc.data.find(w->start_ts);
-    if (dit == vc.data.end()) continue;
-    int64_t need = 8 + static_cast<int64_t>(it->first.size())
-                   + static_cast<int64_t>(dit->second.size());
+  while (n < limit) {
+    // smallest key across sources
+    const std::string* next = nullptr;
+    bool from_mem = false;
+    if (it != s->keys.end() && (ek.empty() || it->first < ek)) {
+      next = &it->first;
+      from_mem = true;
+    }
+    for (size_t r = 0; r < s->runs.size(); ++r) {
+      const Run& run = *s->runs[r];
+      if (rcur[r] >= run.keys.size()) continue;
+      const std::string& k = run.keys[rcur[r]];
+      if (!ek.empty() && k >= ek) continue;
+      if (next == nullptr || k < *next) {
+        next = &k;
+        from_mem = false;
+      }
+    }
+    if (next == nullptr) break;
+    std::string key = *next;
+    // resolve version: memtable first (newer), then runs
+    const std::string* val = nullptr;
+    bool deleted = false;
+    if (from_mem || (it != s->keys.end() && it->first == key)) {
+      const auto& vc = it->second;
+      if (check_lock_conflict(vc, ts, 0) != OK) return -ERR_LOCKED;
+      const WriteRec* w = latest_write_le(vc, ts);
+      if (w != nullptr) {
+        if (w->op == OP_DELETE) {
+          deleted = true;
+        } else {
+          auto dit = vc.data.find(w->start_ts);
+          if (dit != vc.data.end()) val = &dit->second;
+          else deleted = true;
+        }
+      }
+      ++it;
+    }
+    if (val == nullptr && !deleted) {
+      // run cursors already sit on this key: resolve newest-run-first
+      // without re-searching (the per-key binary search would dominate)
+      for (size_t r = s->runs.size(); r-- > 0 && val == nullptr
+                                      && !deleted;) {
+        const Run& run = *s->runs[r];
+        if (rcur[r] >= run.keys.size() || run.keys[rcur[r]] != key)
+          continue;
+        for (uint32_t j = run.woff[rcur[r]];
+             j < run.woff[rcur[r] + 1]; ++j) {
+          const WriteRec& w = run.writes[j];
+          if (w.commit_ts <= ts && w.op != OP_ROLLBACK) {
+            if (w.op == OP_PUT) val = &run.vals[j];
+            else deleted = true;
+            break;
+          }
+        }
+      }
+    }
+    // advance every run cursor sitting on this key
+    for (size_t r = 0; r < s->runs.size(); ++r) {
+      const Run& run = *s->runs[r];
+      if (rcur[r] < run.keys.size() && run.keys[rcur[r]] == key)
+        ++rcur[r];
+    }
+    if (val == nullptr) continue;
+    int64_t need = 8 + static_cast<int64_t>(key.size())
+                   + static_cast<int64_t>(val->size());
     if (off + need > buf_cap) {
       *truncated = 1;
       break;
     }
-    uint32_t kl = it->first.size(), vl = dit->second.size();
+    uint32_t kl = key.size(), vl = val->size();
     std::memcpy(buf + off, &kl, 4); off += 4;
-    std::memcpy(buf + off, it->first.data(), kl); off += kl;
+    std::memcpy(buf + off, key.data(), kl); off += kl;
     std::memcpy(buf + off, &vl, 4); off += 4;
-    std::memcpy(buf + off, dit->second.data(), vl); off += vl;
+    std::memcpy(buf + off, val->data(), vl); off += vl;
     ++n;
   }
   *used = off;
@@ -494,7 +805,69 @@ int64_t kv_gc(void* h, uint64_t safepoint) {
       ++it;
     }
   }
+  // runs GC by COMPACTION FILTER: record the safepoint and merge, so
+  // dead versions drop during the rewrite instead of a dedicated scan
+  s->gc_safepoint = safepoint;
+  dropped += compact_runs(s);
   return dropped;
+}
+
+// Freeze unlocked memtable keys into an immutable sorted run.
+int64_t kv_flush(void* h) {
+  auto* s = static_cast<Store*>(h);
+  std::unique_lock lk(s->mu);
+  return flush_memtable(s);
+}
+
+int64_t kv_run_count(void* h) {
+  auto* s = static_cast<Store*>(h);
+  std::shared_lock lk(s->mu);
+  return static_cast<int64_t>(s->runs.size());
+}
+
+// In-process point-get micro-bench (bench harness only): n random gets
+// over the CURRENT committed state, returns total nanoseconds.  Lives in
+// C++ so the measurement excludes ctypes call overhead.
+int64_t kv_bench_gets(void* h, int64_t n, uint64_t seed, uint64_t ts) {
+  auto* s = static_cast<Store*>(h);
+  // collect a key sample under the lock (memtable + runs)
+  std::vector<std::string> sample;
+  {
+    std::shared_lock lk(s->mu);
+    size_t total = s->keys.size();
+    for (const auto& run : s->runs) total += run->keys.size();
+    size_t stride = total / 65536 + 1;   // uniform over the key space
+    size_t i = 0;
+    for (const auto& [k, vc] : s->keys) {
+      (void)vc;
+      if (i++ % stride == 0) sample.push_back(k);
+    }
+    for (const auto& run : s->runs) {
+      for (size_t j = 0; j < run->keys.size(); ++j) {
+        if (i++ % stride == 0) sample.push_back(run->keys[j]);
+      }
+    }
+  }
+  if (sample.empty()) return 0;
+  uint64_t x = seed | 1;
+  const char* out;
+  int32_t out_len;
+  auto t0 = std::chrono::steady_clock::now();
+  for (int64_t i = 0; i < n; ++i) {
+    x ^= x << 13; x ^= x >> 7; x ^= x << 17;   // xorshift
+    const std::string& k = sample[x % sample.size()];
+    kv_get(h, k.data(), static_cast<int32_t>(k.size()), ts, &out,
+           &out_len);
+  }
+  auto t1 = std::chrono::steady_clock::now();
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(t1 - t0)
+      .count();
+}
+
+void kv_set_flush_threshold(void* h, int64_t n) {
+  auto* s = static_cast<Store*>(h);
+  std::unique_lock lk(s->mu);
+  s->flush_threshold = n > 0 ? static_cast<size_t>(n) : (1ull << 62);
 }
 
 // Acquire a pessimistic lock (KvPessimisticLock, unistore/tikv/server.go
@@ -513,14 +886,11 @@ int32_t kv_pessimistic_lock(void* h, const char* key, int32_t klen,
                   + std::chrono::milliseconds(wait_ms);
   for (;;) {
     auto& vc = s->keys[k];
-    for (const auto& w : vc.writes) {
-      if (w.op == OP_ROLLBACK) {
-        if (w.start_ts == start_ts) return ERR_ALREADY_ROLLED_BACK;
-        continue;
-      }
-      if (w.commit_ts > for_update_ts) return ERR_WRITE_CONFLICT;
-      break;
-    }
+    uint64_t newest = 0;
+    bool rolled_back = false;
+    conflict_view(s, &vc, k, start_ts, &newest, &rolled_back);
+    if (rolled_back) return ERR_ALREADY_ROLLED_BACK;
+    if (newest > for_update_ts) return ERR_WRITE_CONFLICT;
     if (!vc.lock.present) {
       vc.lock.present = true;
       vc.lock.pessimistic = true;
@@ -566,7 +936,29 @@ int32_t kv_pessimistic_rollback(void* h, const char* key, int32_t klen,
 int64_t kv_num_keys(void* h) {
   auto* s = static_cast<Store*>(h);
   std::shared_lock lk(s->mu);
-  return static_cast<int64_t>(s->keys.size());
+  // distinct keys across memtable + runs (a flushed key may have been
+  // re-written into the memtable; count it once)
+  if (s->runs.empty()) return static_cast<int64_t>(s->keys.size());
+  int64_t n = static_cast<int64_t>(s->keys.size());
+  for (const auto& run : s->runs) {
+    for (const auto& k : run->keys) {
+      if (s->keys.find(k) == s->keys.end()) ++n;
+    }
+  }
+  if (s->runs.size() > 1) {
+    // subtract keys double-counted across runs
+    for (size_t a = 1; a < s->runs.size(); ++a) {
+      for (const auto& k : s->runs[a]->keys) {
+        for (size_t b = 0; b < a; ++b) {
+          if (s->runs[b]->find(k) >= 0) {
+            if (s->keys.find(k) == s->keys.end()) --n;
+            break;
+          }
+        }
+      }
+    }
+  }
+  return n;
 }
 
 }  // extern "C"

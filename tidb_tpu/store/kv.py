@@ -92,6 +92,13 @@ def _load_lib():
         lib.kv_gc.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib.kv_num_keys.restype = ctypes.c_int64
         lib.kv_num_keys.argtypes = [ctypes.c_void_p]
+        lib.kv_flush.restype = ctypes.c_int64
+        lib.kv_flush.argtypes = [ctypes.c_void_p]
+        lib.kv_run_count.restype = ctypes.c_int64
+        lib.kv_run_count.argtypes = [ctypes.c_void_p]
+        lib.kv_set_flush_threshold.restype = None
+        lib.kv_set_flush_threshold.argtypes = [ctypes.c_void_p,
+                                               ctypes.c_int64]
         lib.kv_open_at.restype = ctypes.c_void_p
         lib.kv_open_at.argtypes = [ctypes.c_char_p, ctypes.c_int32,
                                    ctypes.c_uint8]
@@ -261,6 +268,21 @@ class KVStore:
 
     def num_keys(self) -> int:
         return int(self._lib.kv_num_keys(self._h))
+
+    # ---------------- LSM controls (immutable sorted runs) ------------ #
+
+    def flush(self) -> int:
+        """Freeze unlocked memtable keys into an immutable sorted run
+        (bloom-filtered, binary-searched); returns keys moved."""
+        return int(self._lib.kv_flush(self._h))
+
+    def run_count(self) -> int:
+        return int(self._lib.kv_run_count(self._h))
+
+    def set_flush_threshold(self, n: int) -> None:
+        """Memtable key count that triggers an automatic flush at
+        commit time (amortized check); n <= 0 disables auto-flush."""
+        self._lib.kv_set_flush_threshold(self._h, int(n))
 
 
 _UNSET = object()   # savepoint sentinel: key absent from the membuffer
