@@ -1,0 +1,136 @@
+"""Worker-pool parallelism proofs (VERDICT r3 #7): this 1-core container
+clamps pools to one worker in production, so these tests patch
+os.cpu_count and prove with BLOCKING fakes that >1 chunk/subplan is
+genuinely in flight when cores exist.
+
+Reference analogs: projection.go:205 parallelExecute,
+pkg/executor/parallel_apply.go.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from tidb_tpu.session import Session
+
+
+def test_parallel_map_chunks_runs_concurrently(monkeypatch):
+    """Two workers must be INSIDE fn at the same time: each call blocks
+    on a barrier that only releases when the other arrives — a serial
+    executor would deadlock (and trip the barrier timeout)."""
+    monkeypatch.setattr("os.cpu_count", lambda: 4)
+    from tidb_tpu.executor.physical import ExecContext, _parallel_map_chunks
+    barrier = threading.Barrier(2, timeout=10)
+    seen = []
+
+    def fn(x):
+        barrier.wait()        # requires a concurrent partner
+        seen.append(x)
+        return x * 10
+
+    ctx = ExecContext(client=None, sysvars={"tidb_executor_concurrency": 4})
+    out = list(_parallel_map_chunks(ctx, iter([1, 2, 3, 4]), fn))
+    assert out == [10, 20, 30, 40]      # order preserved
+    assert len(seen) == 4
+
+
+def test_parallel_map_chunks_propagates_contextvars(monkeypatch):
+    monkeypatch.setattr("os.cpu_count", lambda: 2)
+    import contextvars
+
+    from tidb_tpu.executor.physical import ExecContext, _parallel_map_chunks
+    cv = contextvars.ContextVar("probe", default="unset")
+    cv.set("from-submitter")
+    ctx = ExecContext(client=None, sysvars={"tidb_executor_concurrency": 2})
+    out = list(_parallel_map_chunks(ctx, iter([0, 1]), lambda _x: cv.get()))
+    assert out == ["from-submitter", "from-submitter"]
+
+
+@pytest.fixture()
+def apply_sess():
+    s = Session()
+    s.execute("create table o (id bigint not null, grp bigint, "
+              "primary key (id))")
+    s.execute("create table i (grp bigint, v bigint)")
+    s.execute("insert into o values " + ",".join(
+        f"({k}, {k % 5})" for k in range(50)))
+    s.execute("insert into i values " + ",".join(
+        f"({g}, {g * 100 + j})" for g in range(5) for j in range(3)))
+    return s
+
+
+def test_apply_batches_distinct_keys(apply_sess):
+    """50 outer rows over 5 distinct correlation keys -> the inner plan
+    runs 5 times (+1 discovery probe at most), not 50."""
+    from tidb_tpu.executor import physical as P
+    runs_holder = []
+    orig = P.HostApplyExec._apply_one
+
+    def spy(self, *a, **kw):
+        out = orig(self, *a, **kw)
+        runs_holder.append(self.last_inner_runs)
+        return out
+
+    P.HostApplyExec._apply_one = spy
+    try:
+        got = apply_sess.must_query(
+            "select id, (select max(v) from i where i.grp = o.grp) "
+            "from o order by id limit 6")
+    finally:
+        P.HostApplyExec._apply_one = orig
+    assert got == [(k, (k % 5) * 100 + 2) for k in range(6)]
+    assert runs_holder and runs_holder[-1] <= 6   # 5 keys + <=1 probe
+
+
+def test_apply_parallel_keys_concurrent(apply_sess, monkeypatch):
+    """With cores available, distinct-key subplans run on the pool:
+    block inside the inner build until 2 threads arrive."""
+    monkeypatch.setattr("os.cpu_count", lambda: 4)
+    from tidb_tpu.planner import build as B
+    barrier = threading.Barrier(2, timeout=15)
+    hits = []
+    orig = B.build_query
+
+    def blocking(*a, **kw):
+        # only POOL-side inner builds block (the serial discovery probe
+        # runs on the main thread and must not consume the barrier)
+        pool_thread = threading.current_thread().name.startswith(
+            "ThreadPoolExecutor")
+        if pool_thread and B.OUTER_RESOLVER.get(None) is not None \
+                and len(hits) < 2:
+            hits.append(1)
+            barrier.wait()
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(B, "build_query", blocking)
+    monkeypatch.setattr("tidb_tpu.executor.physical.build_query",
+                        blocking, raising=False)
+    # row 0's key is probed serially for discovery; the remaining TWO
+    # distinct keys go to the pool together
+    got = apply_sess.must_query(
+        "select id, (select sum(v) from i where i.grp = o.grp) "
+        "from o where id in (1, 2, 3) order by id")
+    assert len(got) == 3
+
+
+def test_apply_uncorrelated_runs_once(apply_sess):
+    from tidb_tpu.executor import physical as P
+    runs_holder = []
+    orig = P.HostApplyExec._apply_one
+
+    def spy(self, *a, **kw):
+        out = orig(self, *a, **kw)
+        runs_holder.append(self.last_inner_runs)
+        return out
+
+    P.HostApplyExec._apply_one = spy
+    try:
+        got = apply_sess.must_query(
+            "select id, (select count(*) from i) from o "
+            "order by id limit 3")
+    finally:
+        P.HostApplyExec._apply_one = orig
+    assert got == [(0, 15), (1, 15), (2, 15)]
+    if runs_holder:                       # apply plan shape reached
+        assert runs_holder[-1] == 1       # one execution for all rows

@@ -1967,20 +1967,45 @@ class HostApplyExec(PhysOp):
                 return None
             return sub.columns[0].to_python()[0]
 
-        for row in range(n):
-            if used_cols:
-                key = tuple(col_values(i)[row] for i in used_cols)
-                if key in cache:
-                    out_vals.append(cache[key])
-                    continue
-                val = run_row(row)
-                cache[key] = val
+        # Batched apply (parallel_apply.go): probe row 0 serially to
+        # DISCOVER the referenced outer columns, then collect the
+        # chunk's distinct missing keys and execute their subplans on a
+        # worker pool (contextvars-copied so OUTER_RESOLVER/HOST_ONLY
+        # travel); rows then map through the cache.
+        self.last_inner_runs = getattr(self, "last_inner_runs", 0)
+        if n == 0:
+            return Column.from_values(out_t, [])
+        if not used_cols:
+            v0 = run_row(0)
+            self.last_inner_runs += 1
+            if not used_cols:
+                # uncorrelated: one execution serves every row
+                return Column.from_values(out_t, [v0] * n)
+            cache[tuple(col_values(i)[0] for i in used_cols)] = v0
+        keys = [tuple(col_values(i)[row] for i in used_cols)
+                for row in range(n)]
+        missing: dict = {}
+        for row, key in enumerate(keys):
+            if key not in cache and key not in missing:
+                missing[key] = row
+        if missing:
+            import os as _os
+            items = list(missing.items())
+            self.last_inner_runs += len(items)
+            workers = min(len(items), _os.cpu_count() or 1, 8)
+            if workers > 1:
+                import concurrent.futures as cf
+                import contextvars as _cv
+                with cf.ThreadPoolExecutor(max_workers=workers) as ex:
+                    futs = [(key, ex.submit(_cv.copy_context().run,
+                                            run_row, row))
+                            for key, row in items]
+                    for key, f in futs:
+                        cache[key] = f.result()
             else:
-                val = run_row(row)
-                if used_cols:     # first row discovered the refs
-                    cache[tuple(col_values(i)[row]
-                                for i in used_cols)] = val
-            out_vals.append(val)
+                for key, row in items:
+                    cache[key] = run_row(row)
+        out_vals = [cache[key] for key in keys]
         return Column.from_values(out_t, out_vals)
 
 
