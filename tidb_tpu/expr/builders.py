@@ -218,10 +218,14 @@ STRING_VALUED_FUNCS = {"upper", "lower", "trim", "ltrim", "rtrim", "reverse",
                        "substring", "replace", "concat", "left", "right",
                        "lpad", "rpad", "repeat", "substring_index",
                        "md5", "sha1", "sha2", "hex", "soundex",
-                       "json_extract", "json_unquote", "json_type"}
+                       "json_extract", "json_unquote", "json_type",
+                       "insert_str", "quote", "to_base64", "from_base64",
+                       "unhex", "regexp_substr", "regexp_replace", "conv"}
 STRING_INT_FUNCS = {"length", "char_length", "ascii", "locate", "instr",
                     "find_in_set", "crc32", "strcmp",
-                    "json_valid", "json_length", "json_contains"}
+                    "json_valid", "json_length", "json_contains",
+                    "bit_length", "inet_aton", "regexp_like",
+                    "regexp_instr"}
 
 
 def str_func(name: str, *args: Expr) -> Func:

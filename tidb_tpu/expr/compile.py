@@ -534,10 +534,13 @@ class Evaluator:
                 out[i] = "".join(parts) if valid[i] else ""
             return out, valid
         if e.op in STRING_VALUED_FUNCS or e.op in (
-                "length", "char_length", "ascii"):
+                "length", "char_length", "ascii", "bit_length",
+                "inet_aton", "regexp_like", "regexp_instr"):
             col_rows = arows[0]
-            if col_rows is None or not isinstance(col_rows[0], list):
+            if col_rows is None:
                 return None
+            if isinstance(col_rows[0], str):    # folded constant operand
+                col_rows = [[col_rows[0]] * n, col_rows[1]]
             consts = []
             for a in e.args[1:]:
                 if not isinstance(a, Const) or a.value is None:
@@ -549,6 +552,10 @@ class Evaluator:
                 fn = lambda v: len(v)
             elif e.op == "ascii":
                 fn = lambda v: ord(v[0]) if v else 0
+            elif e.op in ("bit_length", "inet_aton", "regexp_like",
+                          "regexp_instr"):
+                from .lower_strings import _str_int_impl
+                fn = _str_int_impl(e.op, consts)
             else:
                 fn = _str_valued_impl(e.op, consts)
             if fn is None:
@@ -579,6 +586,10 @@ class Evaluator:
         op_ascii = op_locate = op_instr = op_find_in_set = \
         op_json_extract = op_json_unquote = op_json_type = \
         op_json_valid = op_json_length = op_json_contains = \
+        op_insert_str = op_quote = op_to_base64 = op_from_base64 = \
+        op_unhex = op_regexp_substr = op_regexp_replace = op_conv = \
+        op_bit_length = op_inet_aton = op_regexp_like = \
+        op_regexp_instr = \
         _op_string_unlowered
 
     def op_dict_lut(self, e, cols, memo):
@@ -1069,6 +1080,23 @@ class Evaluator:
         out = np.array([format(int(x) & 0xFFFFFFFFFFFFFFFF, fmt)
                         for x in arr], object)
         return out, m
+
+    def op_inet_ntoa(self, e, cols, memo):
+        """INET_NTOA(n) -> dotted-quad string (host string producer;
+        builtin_miscellaneous.go inetNtoa)."""
+        v, m = self._num(e.args[0], cols, memo)
+        arr = np.atleast_1d(_as_i64(self.xp, v))
+        out = np.empty(len(arr), object)
+        ok = np.ones(len(arr), bool)
+        for i, x in enumerate(arr):
+            x = int(x)
+            if 0 <= x <= 0xFFFFFFFF:
+                out[i] = ".".join(str(x >> s & 255)
+                                  for s in (24, 16, 8, 0))
+            else:
+                out[i] = ""
+                ok[i] = False
+        return out, vand(m, True if ok.all() else ok)
 
     def op_format_num(self, e, cols, memo):
         """FORMAT(n, d): thousands separators + d decimals."""

@@ -344,6 +344,104 @@ def _str_valued_impl(op: str, consts: list):
         return lambda v, a=algo: hashlib.new(a, v.encode()).hexdigest()
     if op == "hex":
         return lambda v: v.encode("utf-8").hex().upper()
+    if op == "insert_str":
+        pos, ln, new = int(consts[0]), int(consts[1]), str(consts[2])
+
+        def _ins(v, pos=pos, ln=ln, new=new):
+            # MySQL INSERT: out-of-range pos returns the original string
+            if pos < 1 or pos > len(v):
+                return v
+            end = len(v) if ln < 0 else min(pos - 1 + ln, len(v))
+            return v[:pos - 1] + new + v[end:]
+        return _ins
+    if op == "quote":
+        def _quote(v):
+            out = ["'"]
+            for ch in v:
+                if ch in ("'", "\\"):
+                    out.append("\\" + ch)
+                elif ch == "\0":
+                    out.append("\\0")
+                elif ch == "\x1a":
+                    out.append("\\Z")
+                else:
+                    out.append(ch)
+            out.append("'")
+            return "".join(out)
+        return _quote
+    if op == "to_base64":
+        import base64
+        return lambda v: base64.b64encode(v.encode()).decode()
+    if op == "from_base64":
+        import base64
+
+        def _fb64(v):
+            try:
+                return base64.b64decode(v, validate=True).decode(
+                    "utf-8", errors="replace")
+            except Exception:
+                return None          # MySQL: invalid input -> NULL
+        return _fb64
+    if op == "unhex":
+        def _unhex(v):
+            try:
+                return bytes.fromhex(v).decode("utf-8", errors="replace")
+            except ValueError:
+                return None
+        return _unhex
+    if op == "regexp_substr":
+        pat = str(consts[0])
+        try:
+            rx = re.compile(pat, re.IGNORECASE)   # ci default collation
+        except re.error:
+            return lambda v: None
+
+        def _rsub(v, rx=rx):
+            m = rx.search(v)
+            return m.group(0) if m else None
+        return _rsub
+    if op == "regexp_replace":
+        pat, repl = str(consts[0]), str(consts[1])
+        try:
+            rx = re.compile(pat, re.IGNORECASE)
+        except re.error:
+            return lambda v: None
+        return lambda v, rx=rx, repl=repl: rx.sub(repl, v)
+    if op == "conv":
+        fb, tb = int(consts[0]), int(consts[1])
+        if not (2 <= abs(fb) <= 36 and 2 <= abs(tb) <= 36):
+            return lambda v: None
+        digits = "0123456789abcdefghijklmnopqrstuvwxyz"[:abs(fb)]
+
+        def _conv(v, fb=fb, tb=tb, digits=digits):
+            # parse the longest valid prefix in base |fb| (MySQL relaxed)
+            t = v.strip().lower()
+            neg = t.startswith("-")
+            if neg or t.startswith("+"):
+                t = t[1:]
+            acc = 0
+            seen = False
+            for ch in t:
+                dv = digits.find(ch)
+                if dv < 0:
+                    break
+                acc = acc * abs(fb) + dv
+                seen = True
+            if not seen:
+                return "0"
+            if neg:
+                acc = -acc
+            u = acc % (1 << 64)        # MySQL: unsigned 64-bit wrap
+            out_digits = "0123456789ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+            if u == 0:
+                return "0"
+            out = []
+            base = abs(tb)
+            while u:
+                out.append(out_digits[u % base])
+                u //= base
+            return "".join(reversed(out))
+        return _conv
     if op == "soundex":
         def _soundex(v):
             codes = {**dict.fromkeys("BFPV", "1"),
@@ -443,6 +541,13 @@ def fold_string_func(e: Expr) -> Optional[Const]:
                 r = jsonfns.contains(
                     str(vals[0]), str(vals[1]),
                     str(vals[2]) if len(vals) > 2 else "$")
+            if r is None:
+                return Const(e.dtype.with_nullable(True), None)
+            return Const(e.dtype, int(r))
+        if e.op in ("bit_length", "inet_aton", "regexp_like",
+                    "regexp_instr"):
+            fn = _str_int_impl(e.op, vals[1:])
+            r = fn(str(vals[0])) if fn else None
             if r is None:
                 return Const(e.dtype.with_nullable(True), None)
             return Const(e.dtype, int(r))
@@ -820,6 +925,40 @@ def _lower_cond_strings(e: Func, args, dicts) -> Optional[Expr]:
     return node
 
 
+def _str_int_impl(op: str, consts: list):
+    """Per-value python impl of the NEW int-valued string functions
+    (bit_length/inet_aton/regexp_like/regexp_instr); the long-standing
+    ones keep their dedicated branches below."""
+    if op == "bit_length":
+        return lambda v: 8 * len(v.encode("utf-8"))
+    if op == "inet_aton":
+        def _aton(v):
+            parts = v.split(".")
+            if not 1 <= len(parts) <= 4 or any(not p.isdigit()
+                                               for p in parts):
+                return None
+            vals = [int(p) for p in parts]
+            if any(x > 255 for x in vals[:-1]) \
+                    or vals[-1] >= 1 << (8 * (5 - len(parts))):
+                return None
+            acc = 0
+            for x in vals[:-1]:
+                acc = (acc << 8) | x
+            return (acc << (8 * (5 - len(parts)))) | vals[-1]
+        return _aton
+    if op in ("regexp_like", "regexp_instr"):
+        pat = str(consts[0])
+        try:
+            rx = re.compile(pat, re.IGNORECASE)
+        except re.error:
+            return lambda v: None
+        if op == "regexp_like":
+            return lambda v, rx=rx: 1 if rx.search(v) else 0
+        return lambda v, rx=rx: (
+            (m.start() + 1) if (m := rx.search(v)) else 0)
+    return None
+
+
 def _lower_str_int(e: Func, args, dicts) -> Optional[Expr]:
     """LENGTH/CHAR_LENGTH/ASCII/LOCATE/INSTR over a dict column -> int LUT
     gather."""
@@ -895,6 +1034,20 @@ def _lower_str_int(e: Func, args, dicts) -> Optional[Expr]:
             return B.dict_ilut(args[1],
                                np.asarray(lut or [0], np.int64), e.dtype)
         return None
+    if e.op in ("bit_length", "inet_aton", "regexp_like",
+                "regexp_instr"):
+        col = args[0]
+        d = _dict_for(col, dicts)
+        if d is None:
+            return None
+        consts = [_const_scalar(a) for a in args[1:]]
+        if any(c is None for c in consts):
+            return None
+        fn = _str_int_impl(e.op, consts)
+        if fn is None:
+            return None
+        vals = [fn(v) for v in d.values]
+        return _derived_ilut_nullable(e.dtype, col, vals)
     if e.op in ("json_valid", "json_length", "json_contains"):
         from ..utils import jsonfns
         col = args[0]

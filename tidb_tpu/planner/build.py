@@ -289,6 +289,21 @@ class ExprBuilder:
             # the INTERVAL argument is not an expression — don't build it
             base = _coerce_to(dt.date(), self.build(n.args[0]))
             return self._date_addsub(name, n, [base])
+        if name in ("TIMESTAMPDIFF", "TIMESTAMPADD"):
+            # first argument is a bare unit keyword, not an expression
+            if not n.args or not isinstance(n.args[0], A.Ident):
+                raise PlanError(f"{name} needs a unit keyword")
+            unit = n.args[0].parts[-1].upper()
+            rest = [self.build(a) for a in n.args[1:]]
+            if name == "TIMESTAMPADD":
+                base = rest[1]
+                if base.dtype.is_string:
+                    base = _coerce_to(dt.datetime(), base)
+                if not (isinstance(rest[0], Const)
+                        and rest[0].value is not None):
+                    raise PlanError("TIMESTAMPADD amount must be constant")
+                return B.date_add(base, B.lit(int(rest[0].value)), unit)
+            return self._timestampdiff(unit, rest[0], rest[1])
         args = [self.build(a) for a in n.args
                 if not isinstance(a, A.Star)]
         if name in ("YEAR", "MONTH", "QUARTER", "DAYOFWEEK", "WEEKDAY",
@@ -496,6 +511,58 @@ class ExprBuilder:
                 dt.varchar(base.dtype.nullable), key, names_)
         if name == "POSITION":
             return self._str_func("locate", args[0], args[1])
+        if name == "ISNULL":
+            return B.is_null(args[0])
+        if name in ("QUOTE", "TO_BASE64", "FROM_BASE64", "UNHEX",
+                    "BIT_LENGTH", "INET_ATON", "REGEXP_SUBSTR",
+                    "REGEXP_REPLACE", "REGEXP_INSTR", "REGEXP_LIKE"):
+            return self._str_func(name.lower(), *args)
+        if name == "INSERT":
+            if len(args) != 4:
+                raise PlanError("INSERT needs (str, pos, len, newstr)")
+            return self._str_func("insert_str", *args)
+        if name == "ELT":
+            # ELT(n, s1..sk) -> CASE n WHEN i THEN s_i (control-flow
+            # rewrite lowers to merged-dictionary gathers on device)
+            if len(args) < 2:
+                raise PlanError("ELT needs an index + strings")
+            pairs = [(B.compare("eq", args[0], B.lit(i)), a)
+                     for i, a in enumerate(args[1:], 1)]
+            return B.case_when(pairs, None)
+        if name == "FIELD":
+            if len(args) < 2:
+                raise PlanError("FIELD needs a needle + candidates")
+            pairs = [(B.compare("eq", args[0], a), B.lit(i))
+                     for i, a in enumerate(args[1:], 1)]
+            return B.case_when(pairs, B.lit(0))
+        if name == "CONV":
+            if len(args) != 3 \
+                    or not all(isinstance(a, Const) for a in args[1:]):
+                raise PlanError("CONV needs (x, const from_base, "
+                                "const to_base)")
+            x = args[0]
+            if not x.dtype.is_string:
+                xs = Func(dt.varchar(x.dtype.nullable), "cast_char", (x,))
+            else:
+                xs = x
+            return self._str_func("conv", xs, args[1], args[2])
+        if name == "INET_NTOA":
+            return Func(dt.varchar(args[0].dtype.nullable), "inet_ntoa",
+                        (args[0],))
+        if name == "SPACE":
+            if not (isinstance(args[0], Const)
+                    and args[0].value is not None):
+                raise PlanError("SPACE needs a constant count")
+            k = max(int(args[0].value), 0)
+            return B.lit(" " * min(k, 1 << 20))
+        if name == "CHARSET":
+            return B.lit("utf8mb4" if args[0].dtype.is_string else
+                         "binary")
+        if name == "COLLATION":
+            return B.lit(args[0].dtype.collation
+                         if args[0].dtype.is_string else "binary")
+        if name in ("EXPORT_SET", "MAKE_SET"):
+            return self._bit_weave(name, args)
         if name == "FIND_IN_SET":
             return self._str_func("find_in_set", args[0], args[1])
         if name in ("JSON_EXTRACT", "JSON_UNQUOTE", "JSON_TYPE",
@@ -550,6 +617,163 @@ class ExprBuilder:
             return Func(dt.double(True), f"ext:{name.lower()}",
                         tuple(args))
         raise PlanError(f"unsupported function {name}")
+
+    def _concat_ws_items(self, sep: Expr, items: list) -> Expr:
+        """CONCAT_WS semantics over built items: NULL args are SKIPPED
+        (builtin_string.go concatWS).  Nullable args expand into the 2^k
+        null-pattern CASE so the whole expression lowers to dictionary
+        gathers on device (shared by CONCAT_WS and MAKE_SET)."""
+        null_ix = [i for i, a in enumerate(items) if a.dtype.nullable]
+        if not null_ix:
+            woven: list = []
+            for a in items:
+                if woven:
+                    woven.append(sep)
+                woven.append(a)
+            return self._str_func("concat", *woven)
+        if len(null_ix) > 4:
+            raise PlanError("CONCAT_WS supports at most 4 nullable "
+                            "arguments")
+        pairs = []
+        for pat in range(1, 1 << len(null_ix)):   # >=1 arg NULL
+            conds = []
+            skip = set()
+            for b, i in enumerate(null_ix):
+                if pat >> b & 1:
+                    conds.append(B.is_null(items[i]))
+                    skip.add(i)
+                else:
+                    conds.append(B.logic("not", B.is_null(items[i])))
+            cond = conds[0]
+            for c in conds[1:]:
+                cond = B.logic("and", cond, c)
+            kept = [a for i, a in enumerate(items) if i not in skip]
+            woven = []
+            for a in kept:
+                if woven:
+                    woven.append(sep)
+                woven.append(a)
+            val = (self._str_func("concat", *woven) if woven
+                   else B.lit(""))
+            pairs.append((cond, val))
+        woven = []
+        for a in items:
+            if woven:
+                woven.append(sep)
+            woven.append(a)
+        return B.case_when(pairs, self._str_func("concat", *woven))
+
+    def _bit_weave(self, name: str, args) -> Expr:
+        """EXPORT_SET(bits,on,off[,sep[,k]]) / MAKE_SET(bits,s1..sk):
+        per-bit IF selections woven with the separator — the control-flow
+        rewrite keeps device lowering possible for small k and falls to
+        the row-wise host path beyond the dictionary-product cap."""
+        bits = args[0]
+        bt = dt.bigint(bits.dtype.nullable)
+
+        def bit(i: int) -> Expr:
+            return B.compare("eq", Func(bt, "mod", (
+                Func(bt, "intdiv", (bits, B.lit(1 << i))), B.lit(2))),
+                B.lit(1))
+        if name == "EXPORT_SET":
+            if len(args) < 3:
+                raise PlanError("EXPORT_SET needs (bits, on, off, ...)")
+            on, off = args[1], args[2]
+            sep = args[3] if len(args) > 3 else B.lit(",")
+            k = int(args[4].value) if len(args) > 4 \
+                and isinstance(args[4], Const) else 64
+            k = max(1, min(k, 64))
+            woven = []
+            for i in range(k):
+                if woven:
+                    woven.append(sep)
+                woven.append(B.if_(bit(i), on, off))
+            out = self._str_func("concat", *woven)
+            if bits.dtype.nullable:   # EXPORT_SET(NULL, ...) is NULL
+                out = B.if_(B.is_null(bits), B.lit(None), out)
+            return out
+        # MAKE_SET: only strings whose bit is set, comma-joined — the
+        # CONCAT_WS NULL-skip shape, capped like it
+        items = [B.if_(bit(i), a, B.lit(None)) for i, a in
+                 enumerate(args[1:])]
+        if len(items) > 4:
+            raise PlanError("MAKE_SET supports at most 4 members")
+        out = self._concat_ws_items(B.lit(","), items)
+        if bits.dtype.nullable:       # MAKE_SET(NULL, ...) is NULL
+            out = B.if_(B.is_null(bits), B.lit(None), out)
+        return out
+
+    def _timestampdiff(self, unit: str, a: Expr, b: Expr) -> Expr:
+        """TIMESTAMPDIFF(unit, a, b) = integer units from a to b,
+        truncated toward zero (builtin_time.go timestampDiff) — built
+        from existing device temporal ops so it fuses on device."""
+        if a.dtype.is_string:
+            a = _coerce_to(dt.datetime(), a)
+        if b.dtype.is_string:
+            b = _coerce_to(dt.datetime(), b)
+        if a.dtype.kind not in (K.DATE, K.DATETIME) \
+                or b.dtype.kind not in (K.DATE, K.DATETIME):
+            raise PlanError("TIMESTAMPDIFF needs date operands")
+        nullable = a.dtype.nullable or b.dtype.nullable
+        bt = dt.bigint(nullable)
+
+        def us(x: Expr) -> Expr:
+            from ..types.temporal import MICROS_PER_DAY
+            if x.dtype.kind == K.DATE:
+                return Func(bt, "mul", (x, Const(dt.bigint(False),
+                                                 MICROS_PER_DAY)))
+            return x
+        if unit in ("SECOND", "MINUTE", "HOUR", "DAY", "WEEK"):
+            per = {"SECOND": 1_000_000, "MINUTE": 60_000_000,
+                   "HOUR": 3_600_000_000, "DAY": 86_400_000_000,
+                   "WEEK": 7 * 86_400_000_000}[unit]
+            diff = Func(bt, "sub", (us(b), us(a)))
+            return Func(bt, "intdiv", (diff, Const(dt.bigint(False), per)))
+        if unit not in ("MONTH", "QUARTER", "YEAR"):
+            raise PlanError(f"unsupported TIMESTAMPDIFF unit {unit}")
+
+        def ym(x: Expr) -> Expr:
+            y = Func(bt, "year", (x,))
+            m = Func(bt, "month", (x,))
+            return Func(bt, "add", (Func(bt, "mul",
+                                         (y, Const(dt.bigint(False), 12))),
+                                    m))
+
+        def intra(x: Expr) -> Expr:
+            # progress within the month: day-of-month * 1 day + time
+            from ..types.temporal import MICROS_PER_DAY
+            d = Func(bt, "mul", (Func(bt, "dayofmonth", (x,)),
+                                 Const(dt.bigint(False), MICROS_PER_DAY)))
+            if x.dtype.kind == K.DATE:
+                return d
+            tod = Func(bt, "add", (Func(bt, "mul", (
+                Func(bt, "add", (Func(bt, "mul", (
+                    Func(bt, "add", (Func(bt, "mul", (
+                        Func(bt, "hour", (x,)),
+                        Const(dt.bigint(False), 60))),
+                        Func(bt, "minute", (x,)))),
+                    Const(dt.bigint(False), 60))),
+                    Func(bt, "second", (x,)))),
+                Const(dt.bigint(False), 1_000_000))),
+                Func(bt, "microsecond", (x,))))
+            return Func(bt, "add", (d, tod))
+        months = Func(bt, "sub", (ym(b), ym(a)))
+        gtz = Func(bt, "gt", (months, Const(dt.bigint(False), 0)))
+        ltz = Func(bt, "lt", (months, Const(dt.bigint(False), 0)))
+        short = Func(bt, "lt", (intra(b), intra(a)))   # partial month fwd
+        over = Func(bt, "gt", (intra(b), intra(a)))    # partial month bwd
+        adj = Func(bt, "sub",
+                   (B.if_(Func(bt, "and", (gtz, short)),
+                          Const(dt.bigint(False), 1),
+                          Const(dt.bigint(False), 0)),
+                    B.if_(Func(bt, "and", (ltz, over)),
+                          Const(dt.bigint(False), 1),
+                          Const(dt.bigint(False), 0))))
+        months = Func(bt, "sub", (months, adj))
+        if unit == "MONTH":
+            return months
+        per = 3 if unit == "QUARTER" else 12
+        return Func(bt, "intdiv", (months, Const(dt.bigint(False), per)))
 
     def _str_func(self, op: str, *args: Expr) -> Expr:
         """String function with plan-time constant folding and a

@@ -1443,6 +1443,10 @@ class Parser:
             if self.accept_kw("LIKE"):
                 left = A.LikeExpr(left, self.bit_or(), negated)
                 continue
+            if self._accept_word("REGEXP") or self._accept_word("RLIKE"):
+                node = A.FuncCall("REGEXP_LIKE", [left, self.bit_or()])
+                left = A.Unary("NOT", node) if negated else node
+                continue
             if negated:
                 self.i = save
                 break
@@ -1520,6 +1524,17 @@ class Parser:
 
     def primary(self) -> A.Node:
         t = self.cur
+        if (t.kind == "kw" and t.text == "INSERT"
+                and self.toks[self.i + 1].kind == "op"
+                and self.toks[self.i + 1].text == "("):
+            # INSERT(str, pos, len, newstr) — the string function
+            self.advance()
+            self.expect_op("(")
+            args = [self.expr()]
+            while self.accept_op(","):
+                args.append(self.expr())
+            self.expect_op(")")
+            return A.FuncCall("INSERT", args)
         if (t.kind == "kw" and t.text == "VALUES"
                 and self.toks[self.i + 1].kind == "op"
                 and self.toks[self.i + 1].text == "("):
