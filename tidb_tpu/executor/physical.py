@@ -226,15 +226,20 @@ def _parallel_map_chunks(ctx, source, fn):
     # portion is the numpy kernels); a 1-core host runs the direct path
     n = min(n, os.cpu_count() or 1)
     if n <= 1:
+        from ..copr.coordinator import check_killed
         for ch in source:
+            check_killed()
             out = fn(ch)
             if out is not None:
                 yield out
         return
     import contextvars
+
+    from ..copr.coordinator import check_killed
     with cf.ThreadPoolExecutor(max_workers=n) as ex:
         pending: deque = deque()
         for ch in source:
+            check_killed()
             # workers must see the submitter's contextvars (HOST_ONLY,
             # SUBQUERY_EXECUTOR, OUTER_RESOLVER set by Apply/plan seams)
             ctx_copy = contextvars.copy_context()
@@ -318,6 +323,11 @@ class CopTaskExec(PhysOp):
         return f"CopTask[{kind}] table={self.table.name}{part} -> TPU{cached}"
 
     def execute(self, ctx: ExecContext) -> ResultChunk:
+        from ..copr.coordinator import QUERY_HANDLE, check_killed
+        check_killed()
+        handle = QUERY_HANDLE.get()
+        if handle is not None:
+            handle.note_fragment(self.describe())
         if self.as_of_ts is not None:
             snap = self.as_of_snap
             if snap is None:

@@ -85,6 +85,8 @@ class Domain:
         self.schema_version = 1                # bumped per DDL transition
         from ..ddl.mdl import MDLRegistry
         self.mdl = MDLRegistry()               # pkg/ddl/mdl analog
+        from ..copr.coordinator import Coordinator
+        self.coordinator = Coordinator()       # mppcoordmanager analog
         self._ddl = None
         import threading
         self._ddl_mu = threading.Lock()
@@ -226,6 +228,8 @@ class Session:
         self.txn = None              # active explicit transaction
         self._txn_tables: set = set()
         self._cur_sql: Optional[str] = None      # text of the running stmt
+        import threading as _th
+        self._kill_event = _th.Event()   # KILL QUERY sets; stmt start clears
 
     # ------------------------------------------------------------- #
 
@@ -259,6 +263,14 @@ class Session:
             _plugins.fire("on_stmt_begin", self, text)
             cpu0 = time.thread_time_ns()    # Top-SQL CPU attribution
             self._last_plan_text = ""
+            # coordinator registration + cancellation scope
+            # (mppcoordmanager + KILL): the kill event travels to every
+            # dispatch/chunk checkpoint via contextvar
+            from ..copr.coordinator import KILL_EVENT, QUERY_HANDLE
+            self._kill_event.clear()
+            handle = self.domain.coordinator.begin(self.conn_id, text)
+            ktok = KILL_EVENT.set(self._kill_event)
+            htok = QUERY_HANDLE.set(handle)
             try:
                 out = self._exec_stmt(stmt)
             except Exception as e:
@@ -267,6 +279,9 @@ class Session:
                               (time.perf_counter_ns() - t0) / 1e9, 0)
                 raise
             finally:
+                QUERY_HANDLE.reset(htok)
+                KILL_EVENT.reset(ktok)
+                self.domain.coordinator.end(self.conn_id)
                 self._cur_sql = None
             dt_ns = time.perf_counter_ns() - t0
             qcnt.inc(type=type(stmt).__name__)
@@ -286,6 +301,25 @@ class Session:
             _plugins.fire("on_stmt_end", self, text, None, dt_ns / 1e9,
                           len(out.rows) + out.affected)
         return out
+
+    def _exec_kill(self, stmt) -> ResultSet:
+        """KILL [QUERY|CONNECTION] <id>: set the victim's kill event;
+        its next cancellation checkpoint (dispatch loop, retry/backoff
+        iteration, streamed batch, host chunk boundary) raises
+        QueryInterrupted — conn.go killConn + mppcoordmanager cancel."""
+        sessions = dict(self.domain.sessions())
+        target = sessions.get(stmt.conn_id)
+        if target is None:
+            raise PlanError(f"Unknown thread id: {stmt.conn_id}")
+        from ..privilege import PrivilegeError
+        priv = getattr(self.domain, "privileges", None)
+        is_super = priv is None or priv.check(self.user, "SUPER")
+        if target.user != self.user and not is_super:
+            raise PrivilegeError(
+                "You are not owner of thread "
+                f"{stmt.conn_id} (SUPER required)")
+        target._kill_event.set()
+        return ResultSet()
 
     def _charge_resource_group(self, stmt, out: ResultSet,
                                elapsed_sec: float) -> None:
@@ -455,6 +489,8 @@ class Session:
             return ResultSet()
         if isinstance(stmt, A.PlanReplayerDump):
             return self._exec_plan_replayer(stmt)
+        if isinstance(stmt, A.KillStmt):
+            return self._exec_kill(stmt)
         if isinstance(stmt, A.TxnStmt):
             return self._exec_txn(stmt)
         if isinstance(stmt, A.PrepareStmt):

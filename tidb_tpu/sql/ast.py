@@ -476,6 +476,12 @@ class RevokeStmt(Node):
 
 
 @dataclass
+class KillStmt(Node):
+    conn_id: int
+    query_only: bool = True      # KILL QUERY vs KILL CONNECTION
+
+
+@dataclass
 class FlushStmt(Node):
     what: str = "privileges"
 

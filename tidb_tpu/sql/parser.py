@@ -151,6 +151,18 @@ class Parser:
             return self.update_stmt()
         if self.at_kw("DELETE"):
             return self.delete_stmt()
+        if (self.cur.kind in ("kw", "ident")
+                and self.cur.text.upper() == "KILL"):
+            self.advance()
+            query_only = True
+            if self._accept_word("QUERY"):
+                query_only = True
+            elif self._accept_word("CONNECTION"):
+                query_only = False
+            elif self._accept_word("TIDB"):
+                self._accept_word("QUERY") or self._accept_word(
+                    "CONNECTION")
+            return A.KillStmt(self._int_lit(), query_only)
         if self.at_kw("USE"):
             self.advance()
             return A.UseDatabase(self.ident())

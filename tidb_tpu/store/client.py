@@ -118,10 +118,12 @@ class CopClient:
         epoch so the retry dispatches a DIFFERENT fan-out — the
         copr handleTask re-split discipline (coprocessor.go:337,:1308),
         not an identical re-run."""
+        from ..copr.coordinator import check_killed
         from .backoff import Backoffer, RegionError
         bo = Backoffer(max_sleep_ms=self.retry_budget_ms)
         retries = 0
         while True:
+            check_killed()    # KILL QUERY cancels in-flight dispatch loops
             try:
                 fp = self._next_failpoint()
                 if fp is not None:
@@ -250,10 +252,12 @@ class CopClient:
         batch k's compute (jax dispatch is async; nothing blocks until the
         final device_get).  The paging/double-buffer analog of
         kv.Request.Paging (SURVEY.md §5.7)."""
+        from ..copr.coordinator import check_killed
         outs = []
         nxt = batches[0].device_put_uncached(self.mesh)
         prog = get_sharded_program(agg, self.mesh)
         for i in range(len(batches)):
+            check_killed()   # cancellation between streamed HBM batches
             cols, counts = nxt
             outs.append(prog(cols, counts, ()))
             if i + 1 < len(batches):

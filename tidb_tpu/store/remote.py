@@ -213,15 +213,32 @@ class RemoteCopClient:
                                            dictionaries, aux_cols)
 
     def _dispatch(self, snap, fn):
+        from ..copr.coordinator import check_killed
         bo = Backoffer(max_sleep_ms=5000.0)
         while True:
+            check_killed()
             ent = self._snap_meta(snap)
+            self._preflight_liveness(ent)
             try:
                 return fn(ent)
             except RegionError as e:
                 bo.backoff(e.kind, e)
                 ent["placement"].heal(e)
                 ent["shipped"].discard(getattr(e, "store", None))
+
+    def _preflight_liveness(self, ent) -> None:
+        """Store liveness probe BEFORE dispatch (copr/mpp_probe.go
+        analog): a store whose process died is excluded from the routing
+        placement up front, so the fan-out never pays a failed round
+        against it."""
+        live = set(self.cluster.live_ids())
+        dead = {sh.store for sh in ent["placement"].shards
+                if sh.num_rows and sh.store < len(self.cluster.stores)
+                and sh.store not in live}
+        for sid in dead:
+            ent["placement"].exclude_store(sid)
+            self.preflight_exclusions = getattr(
+                self, "preflight_exclusions", 0) + 1
 
     def _per_store(self, ent, snap, build_msg):
         """Fan a request out to every store owning live shards; a store
