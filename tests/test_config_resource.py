@@ -241,3 +241,19 @@ def test_compat_sysvars_accept_set():
     rows = s.must_query(
         "select count(*) from information_schema.session_variables")
     assert rows[0][0] > 200      # the registry surface is broad
+
+
+def test_dense_broadcast_max_groups_sysvar():
+    """Engine knobs ride sysvars (SURVEY A.3): the DENSE-agg broadcast
+    group cap is set via SET and consumed at plan/dispatch time."""
+    from tidb_tpu.copr import exec as execmod
+    s = Session(Domain())
+    s.execute("create table dk (a bigint not null, primary key (a))")
+    s.execute("insert into dk values (1), (2)")
+    saved = execmod.DENSE_BROADCAST_MAX_GROUPS
+    try:
+        s.execute("set global tidb_tpu_dense_broadcast_max_groups = 7")
+        s.must_query("select count(*) from dk")
+        assert execmod.DENSE_BROADCAST_MAX_GROUPS == 7
+    finally:
+        execmod.DENSE_BROADCAST_MAX_GROUPS = saved

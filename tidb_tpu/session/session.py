@@ -681,11 +681,17 @@ class Session:
         merged = {**self.domain.sysvars, **self.vars}
         # knob application precedes the plan-cache lookup: a cached plan
         # must reflect the current planner knobs
-        bm0 = int(merged.get("tidb_tpu_broadcast_build_max_rows", -1)
-                  or -1)
+        def _knob(name):
+            v = merged.get(name)
+            return -1 if v is None or v == "" else int(v)
+        bm0 = _knob("tidb_tpu_broadcast_build_max_rows")
         if bm0 >= 0:
             from ..executor import plan as _planmod0
             _planmod0.BROADCAST_BUILD_MAX_ROWS = bm0
+        dg0 = _knob("tidb_tpu_dense_broadcast_max_groups")
+        if dg0 >= 0:
+            from ..copr import exec as _execmod0
+            _execmod0.DENSE_BROADCAST_MAX_GROUPS = dg0
         use_cache = (cache_sql is not None
                      and _flag_on(merged, "tidb_enable_plan_cache"))
         if use_cache:
@@ -798,10 +804,12 @@ class Session:
         client = self.domain.client
         # engine knobs ride sysvars (the reference's every-perf-knob-is-a-
         # sysvar discipline, vardef/tidb_vars.go)
-        cap = int(merged.get("tidb_tpu_device_mem_cap", -1) or -1)
+        v0 = merged.get("tidb_tpu_device_mem_cap")
+        cap = -1 if v0 is None or v0 == "" else int(v0)
         if cap >= 0:
             client.device_mem_cap = cap
-        rc = int(merged.get("tidb_tpu_result_cache_entries", -1) or -1)
+        v1 = merged.get("tidb_tpu_result_cache_entries")
+        rc = -1 if v1 is None or v1 == "" else int(v1)
         if rc >= 0:
             client._result_cache_cap = rc
         return ExecContext(client, merged,

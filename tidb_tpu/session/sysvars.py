@@ -45,6 +45,8 @@ _VARS = [
     _v("tidb_tpu_broadcast_build_max_rows", -1, kind="int", min=-1,
        scope=SCOPE_GLOBAL),            # broadcast- vs shuffle-join cut
     _v("tidb_tpu_shard_count", 8, kind="int", min=1, max=4096),
+    _v("tidb_tpu_dense_broadcast_max_groups", -1, kind="int", min=-1,
+       max=1 << 20),
     _v("tidb_tpu_result_cache_entries", -1, kind="int", min=-1,
        max=4096, scope=SCOPE_GLOBAL),
     _v("tidb_distsql_scan_concurrency", 15, kind="int", min=1, max=256),
