@@ -434,28 +434,10 @@ class CopJoinTaskExec(PhysOp):
         """Chained broadcast joins: every level's build must be non-empty
         with unique keys (the planner only emits inner/left levels); any
         runtime anomaly falls back to the host plan whole."""
-        import jax.numpy as jnp
-        groups = []
-        for b in self.builds:
-            bchunk = b["exec"].execute(ctx)
-            kcol = bchunk.columns[b["key_index"]]
-            keys, ok = self._keys_for(kcol, b["key_dict"],
-                                      b["probe_key_dtype"])
-            rows_idx = np.nonzero(ok)[0]
-            keys = keys[rows_idx]
-            if len(keys) == 0 or len(np.unique(keys)) != len(keys):
-                return self.fallback.execute(ctx)
-            order = np.argsort(keys, kind="stable")
-            grp = [(jnp.asarray(keys[order]), None),
-                   (jnp.asarray(np.arange(len(keys),
-                                          dtype=np.int64)[order]), None)]
-            for c in bchunk.columns:
-                data = c.data[rows_idx]
-                valid = c.validity[rows_idx]
-                grp.append((jnp.asarray(data),
-                            None if valid.all() else jnp.asarray(valid)))
-            groups.append(tuple(grp))
-        return self._run(ctx, self.dag, tuple(groups))
+        groups = _prep_build_groups(ctx, self.builds, self._keys_for)
+        if groups is None:
+            return self.fallback.execute(ctx)
+        return self._run(ctx, self.dag, groups)
 
     def _execute_single(self, ctx: ExecContext) -> ResultChunk:
         import jax.numpy as jnp
@@ -2019,6 +2001,34 @@ class HostApplyExec(PhysOp):
         return Column.from_values(out_t, out_vals)
 
 
+def _prep_build_groups(ctx, builds, keys_for):
+    """Materialize broadcast-join build sides into device aux groups
+    (sorted keys + permutation + columns).  None = runtime anomaly
+    (empty build / duplicate keys): the caller's host fallback runs —
+    shared by CopJoinTaskExec chains and window-over-join fragments."""
+    import jax.numpy as jnp
+    groups = []
+    for b in builds:
+        bchunk = b["exec"].execute(ctx)
+        kcol = bchunk.columns[b["key_index"]]
+        keys, ok = keys_for(kcol, b["key_dict"], b["probe_key_dtype"])
+        rows_idx = np.nonzero(ok)[0]
+        keys = keys[rows_idx]
+        if len(keys) == 0 or len(np.unique(keys)) != len(keys):
+            return None
+        order = np.argsort(keys, kind="stable")
+        grp = [(jnp.asarray(keys[order]), None),
+               (jnp.asarray(np.arange(len(keys),
+                                      dtype=np.int64)[order]), None)]
+        for c in bchunk.columns:
+            data = c.data[rows_idx]
+            valid = c.validity[rows_idx]
+            grp.append((jnp.asarray(data),
+                        None if valid.all() else jnp.asarray(valid)))
+        groups.append(tuple(grp))
+    return tuple(groups)
+
+
 @dataclass
 class CopWindowExec(PhysOp):
     """Device window functions (TiFlash MPP window analog): rows
@@ -2031,16 +2041,34 @@ class CopWindowExec(PhysOp):
     out_dtypes: list = field(default_factory=list)
     out_dicts: dict = field(default_factory=dict)
     children: list = field(default_factory=list)
+    # window-over-join: broadcast build specs feeding the LookupJoin
+    # levels inside spec.child, with a host fallback for runtime
+    # anomalies (fragment.go: windows consume exchange output)
+    builds: list = None
+    fallback: PhysOp = None
+
+    def __post_init__(self):
+        if self.builds:
+            self.children = [b["exec"] for b in self.builds]
 
     def describe(self):
         funcs = ",".join(f for f, _a, _t in self.spec.items)
-        return f"CopWindow[{funcs}] table={self.table.name} -> TPU"
+        over = f" over-join x{len(self.builds)}" if self.builds else ""
+        return f"CopWindow[{funcs}] table={self.table.name}{over} -> TPU"
 
     def execute(self, ctx: ExecContext) -> ResultChunk:
+        aux = ()
+        if self.builds:
+            aux = _prep_build_groups(
+                ctx, self.builds,
+                lambda kcol, kd, pt: CopJoinTaskExec._keys_for(
+                    None, kcol, kd, pt))
+            if aux is None:
+                return self.fallback.execute(ctx)
         # dictionaries attach inside the client's _assemble_rows
         cols = ctx.client.execute_window(
             self.spec, self.table.snapshot(), tuple(self.out_dtypes),
-            self.out_dicts)
+            self.out_dicts, aux_cols=aux)
         return ResultChunk(list(self.out_names), cols)
 
 

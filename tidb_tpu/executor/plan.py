@@ -437,10 +437,19 @@ def _try_cop_window(p) -> Optional[PhysOp]:
                 return None
         else:
             return None
+    builds: list = []
     bound = _bind_scan_chain(p.child)
-    if bound is None:
-        return None
-    node, cur_dicts, ds = bound
+    if bound is not None:
+        node, cur_dicts, ds = bound
+    else:
+        # window-over-join (fragment.go: windows consume exchange
+        # output): bind the join subtree as a broadcast fragment chain
+        # feeding the repartition, with a host fallback for runtime
+        # anomalies (empty/duplicate-keyed builds)
+        jb = _bind_probe_side(p.child, builds)
+        if jb is None or not builds:
+            return None
+        node, cur_dicts, ds = jb
 
     def low(e):
         e2 = lower_strings(e, cur_dicts)
@@ -480,10 +489,18 @@ def _try_cop_window(p) -> Optional[PhysOp]:
     out_dicts = {i: d for i, d in cur_dicts.items() if i < n_child}
     for i, d in arg_dicts.items():
         out_dicts[n_child + i] = d
+    fallback = None
+    if builds:
+        fallback = HostWindow(to_physical(p.children[0], True),
+                              list(p.items),
+                              out_names=p.schema.names(),
+                              out_dtypes=[c.dtype
+                                          for c in p.schema.cols])
     return CopWindowExec(spec, ds.table,
                          out_names=p.schema.names(),
                          out_dtypes=[c.dtype for c in p.schema.cols],
-                         out_dicts=out_dicts)
+                         out_dicts=out_dicts,
+                         builds=builds or None, fallback=fallback)
 
 
 def _join_method_hint(p: LogicalJoin) -> str:

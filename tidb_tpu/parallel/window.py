@@ -70,7 +70,7 @@ class ShardedWindowProgram:
         self.n_dev = len(mesh.devices.reshape(-1))
         self.out_dtypes = (D.output_dtypes(spec.child)
                            + tuple(it[2] for it in spec.items))
-        in_specs = (P(SHARD_AXIS), P(SHARD_AXIS))
+        in_specs = (P(SHARD_AXIS), P(SHARD_AXIS), P())  # aux replicated
         out_specs = ((P(SHARD_AXIS), P(SHARD_AXIS)), P(SHARD_AXIS))
         self._fn = jax.jit(shard_map(
             self._device_fn, mesh=mesh, in_specs=in_specs,
@@ -78,13 +78,15 @@ class ShardedWindowProgram:
 
     # -- device program ------------------------------------------------ #
 
-    def _device_fn(self, cols, counts):
+    def _device_fn(self, cols, counts, aux):
         set_trace_platform(self.mesh.devices.reshape(-1)[0].platform)
         spec = self.spec
         ev = Evaluator(jnp)
         flat, base_sel = _flatten_block([(v, m) for v, m in cols], counts)
         flat = [(v, True if m is None else m) for v, m in flat]
-        batch = _exec_node(spec.child, flat, base_sel, ev, ())
+        aux = tuple(tuple((v, True if m is None else m) for v, m in grp)
+                    for grp in aux)
+        batch = _exec_node(spec.child, flat, base_sel, ev, aux)
         n = len(batch.cols[0][0])
         live = _sel_array(batch.sel, n)
         memo: dict = {}
@@ -239,8 +241,8 @@ class ShardedWindowProgram:
             extras
 
 
-    def __call__(self, cols, counts):
-        return self._fn(tuple(cols), counts)
+    def __call__(self, cols, counts, aux_cols=()):
+        return self._fn(tuple(cols), counts, tuple(aux_cols))
 
 
 @functools.lru_cache(maxsize=64)

@@ -437,12 +437,12 @@ class CopClient:
 
     def execute_window(self, spec: D.WindowShuffleSpec,
                        snap: ColumnarSnapshot, out_dtypes,
-                       dictionaries=None) -> list[Column]:
+                       dictionaries=None, aux_cols=()) -> list[Column]:
         return self._retry(lambda: self._execute_window_once(
-            spec, snap, out_dtypes, dictionaries))
+            spec, snap, out_dtypes, dictionaries, aux_cols))
 
     def _execute_window_once(self, spec, snap, out_dtypes,
-                             dictionaries=None) -> list[Column]:
+                             dictionaries=None, aux_cols=()) -> list[Column]:
         """Hash-repartitioned window program (TiFlash MPP window analog):
         bucket capacity regrows from the reported true maximum, the
         paging discipline."""
@@ -454,7 +454,7 @@ class CopClient:
             max(2 * snap.num_rows // max(n_dev * n_dev, 1) + 1, 1024))
         for _ in range(10):
             prog = get_window_program(spec, self.mesh, cap)
-            (out_cols, out_counts), extras = prog(cols, counts)
+            (out_cols, out_counts), extras = prog(cols, counts, aux_cols)
             need = int(np.max(np.asarray(jax.device_get(extras["wmax"]))))
             if need <= cap:
                 break
