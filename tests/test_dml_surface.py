@@ -125,3 +125,47 @@ def test_update_order_by_desc_null_keys(s):
     # ASC picks the NULL row first
     s.execute("update nt set k = -1 order by k limit 1")
     assert s.must_query("select id from nt where k = -1") == [(1,)]
+
+
+def test_delete_is_transactional():
+    """DELETE inside an explicit transaction buffers in the membuffer:
+    ROLLBACK restores the rows, COMMIT persists the delete (DeleteExec
+    membuffer staging; TRUNCATE stays implicit-commit)."""
+    from tidb_tpu.session import Domain, Session
+    s = Session(Domain())
+    s.execute("create table td (a bigint not null, primary key (a))")
+    s.execute("insert into td values (1), (2), (3)")
+    s.execute("begin")
+    s.execute("delete from td where a = 2")
+    s.execute("rollback")
+    assert s.must_query("select a from td order by a") == \
+        [(1,), (2,), (3,)]
+    s.execute("begin")
+    s.execute("delete from td where a = 2")
+    s.execute("commit")
+    assert s.must_query("select a from td order by a") == [(1,), (3,)]
+    # DELETE without WHERE is transactional too
+    s.execute("begin")
+    s.execute("delete from td")
+    s.execute("rollback")
+    assert s.must_query("select count(*) from td") == [(2,)]
+
+
+def test_cascade_delete_rolls_back_whole_closure():
+    from tidb_tpu.session import Domain, Session
+    s = Session(Domain())
+    s.execute("create table p (id bigint not null, primary key (id))")
+    s.execute("create table ch (id bigint not null, pid bigint, "
+              "primary key (id), "
+              "foreign key (pid) references p (id) on delete cascade)")
+    s.execute("insert into p values (1), (2)")
+    s.execute("insert into ch values (10, 1), (11, 1), (12, 2)")
+    s.execute("begin")
+    s.execute("delete from p where id = 1")
+    s.execute("rollback")
+    assert s.must_query("select count(*) from p") == [(2,)]
+    assert s.must_query("select count(*) from ch") == [(3,)]
+    s.execute("begin")
+    s.execute("delete from p where id = 1")
+    s.execute("commit")
+    assert s.must_query("select id from ch order by id") == [(12,)]

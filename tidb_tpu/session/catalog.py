@@ -470,19 +470,22 @@ class TableInfo:
         self._invalidate()
         return len(handles)
 
-    def delete_handles(self, drop_handles) -> int:
+    def delete_handles(self, drop_handles, txn=None) -> int:
         """Delete rows by STABLE row-store handle — immune to snapshot
         re-ordering between mask computation and the delete (the FK
-        cascade path interleaves deletes across tables)."""
+        cascade path interleaves deletes across tables).  Inside an
+        explicit transaction the caller\'s txn buffers the deletes
+        (DeleteExec: statement writes ride the membuffer and roll back
+        with the transaction)."""
         if self.kv is None:
             raise CatalogError("handle deletes need the KV row store")
         self.snapshot()                      # (re)bind _snapshot_handles
         drop = np.asarray(sorted(drop_handles), dtype=np.int64)
         keep = ~np.isin(np.asarray(self._snapshot_handles, dtype=np.int64),
                         drop)
-        return self.delete_where(keep)
+        return self.delete_where(keep, txn=txn)
 
-    def delete_where(self, keep_mask: np.ndarray) -> int:
+    def delete_where(self, keep_mask: np.ndarray, txn=None) -> int:
         """Delete rows where ~keep_mask (aligned with snapshot row order)."""
         snap = self.snapshot()
         idx = np.nonzero(keep_mask)[0]
@@ -491,14 +494,16 @@ class TableInfo:
             handles = self._snapshot_handles
             with self.schema_gate.read():
                 return self._delete_rows_locked(snap, keep_mask, handles,
-                                                deleted)
+                                                deleted, txn=txn)
         else:
             self._base_cols = [c.take(idx) for c in snap.columns]
         self._invalidate()
         return deleted
 
-    def _delete_rows_locked(self, snap, keep_mask, handles, deleted) -> int:
-        t = self.kv.begin()
+    def _delete_rows_locked(self, snap, keep_mask, handles, deleted,
+                            txn=None) -> int:
+        own = txn is None
+        t = txn or self.kv.begin()
         from ..store.codec import record_key
         drop = np.nonzero(~np.asarray(keep_mask))[0]
         # materialize ONLY the dropped rows for index-entry removal
@@ -506,13 +511,19 @@ class TableInfo:
         if self.indexes and len(drop):
             dropped = [c.take(drop) for c in snap.columns]
             drop_rows = list(zip(*[c.to_python() for c in dropped]))
-        for j, i in enumerate(drop):
-            h = int(handles[i])
-            t.delete(record_key(self.table_id, h))
-            if drop_rows is not None:
-                self._delete_index_entries(
-                    t, tuple(plainify(v) for v in drop_rows[j]), h)
-        t.commit()
+        try:
+            for j, i in enumerate(drop):
+                h = int(handles[i])
+                t.delete(record_key(self.table_id, h))
+                if drop_rows is not None:
+                    self._delete_index_entries(
+                        t, tuple(plainify(v) for v in drop_rows[j]), h)
+            if own:
+                t.commit()
+        except Exception:
+            if own:
+                t.rollback()
+            raise
         self._invalidate()
         return deleted
 

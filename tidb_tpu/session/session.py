@@ -1577,9 +1577,17 @@ class Session:
 
     def _do_delete(self, stmt: A.Delete) -> ResultSet:
         tbl = self.domain.catalog.get_table(self.db, stmt.table)
+        if self.txn is not None and tbl.kv is not None:
+            self._txn_note_table(tbl)
         if stmt.where is None and stmt.limit is None:
             self._fk_on_delete(tbl, np.ones(tbl.num_rows, bool))
-            n = tbl.truncate()
+            if self.txn is not None and tbl.kv is not None:
+                # DELETE without WHERE is still transactional (TRUNCATE
+                # is the implicit-commit one): buffer row deletes
+                n = tbl.delete_where(np.zeros(tbl.num_rows, bool),
+                                     txn=self.txn)
+            else:
+                n = tbl.truncate()
             self.domain.stats.note_modify(tbl, n, delta=-n)
             return ResultSet(affected=n)
         if stmt.where is None:
@@ -1587,7 +1595,7 @@ class Session:
             mask = self._dml_restrict_mask(tbl, mask, stmt.order_by,
                                            stmt.limit)
             self._fk_on_delete(tbl, mask)
-            n = tbl.delete_where(~mask)
+            n = tbl.delete_where(~mask, txn=self.txn)
             self.domain.stats.note_modify(tbl, n, delta=-n)
             return ResultSet(affected=n)
         mask = self._where_mask(tbl, stmt.where)
@@ -1599,10 +1607,10 @@ class Session:
             tbl.snapshot()
             del_handles = np.asarray(tbl._snapshot_handles)[mask].tolist()
             self._fk_on_delete(tbl, mask)
-            n = tbl.delete_handles(del_handles)
+            n = tbl.delete_handles(del_handles, txn=self.txn)
         else:
             self._fk_on_delete(tbl, mask)
-            n = tbl.delete_where(~mask)
+            n = tbl.delete_where(~mask, txn=self.txn)
         self.domain.stats.note_modify(tbl, n, delta=-n)
         return ResultSet(affected=n)
 
@@ -1746,7 +1754,12 @@ class Session:
             if child.kv is not None:
                 child_handles = np.asarray(child._snapshot_handles)[hit]
                 self._fk_on_delete(child, hit, depth + 1)
-                child.delete_handles(child_handles.tolist())
+                # cascades ride the SAME txn as the parent delete: a
+                # rollback must restore the whole closure together
+                child.delete_handles(child_handles.tolist(),
+                                     txn=self.txn)
+                if self.txn is not None:
+                    self._txn_note_table(child)
             else:
                 self._fk_on_delete(child, hit, depth + 1)
                 child.delete_where(~hit)
