@@ -143,3 +143,60 @@ def test_misc(s):
     assert q(s, "select space(3)") == [("   ",)]
     assert q(s, "select charset('x'), collation('x')") == \
         [("utf8mb4", "binary")]
+
+
+def test_session_functions():
+    """DATABASE/USER/VERSION/CONNECTION_ID/LAST_INSERT_ID + UUID/RAND
+    (server/conn.go session identity; builtin_info.go)."""
+    s2 = Session()
+    assert s2.must_query("select database(), schema()") == \
+        [("test", "test")]
+    assert s2.must_query("select user()") == [("root@%",)]
+    assert s2.must_query("select version()")[0][0].endswith("tidb-tpu")
+    cid = s2.must_query("select connection_id()")[0][0]
+    assert isinstance(cid, int) and cid >= 1
+    s2.execute("create table ai (id bigint not null auto_increment, "
+               "v bigint, primary key (id))")
+    s2.execute("insert into ai (v) values (7), (8)")
+    assert s2.must_query("select last_insert_id()") == [(1,)]
+    s2.execute("insert into ai (v) values (9)")
+    assert s2.must_query("select last_insert_id()") == [(3,)]
+    # UUID/RAND are fresh per row; seeded RAND is deterministic
+    s2.execute("create table u3 (a bigint)")
+    s2.execute("insert into u3 values (1), (2), (3)")
+    uu = [r[0] for r in s2.must_query("select uuid() from u3")]
+    assert len(set(uu)) == 3 and all(len(x) == 36 for x in uu)
+    assert s2.must_query("select rand(5)") == \
+        s2.must_query("select rand(5)")
+    rr = [r[0] for r in s2.must_query("select rand() from u3")]
+    assert len(set(rr)) == 3 and all(0 <= x < 1 for x in rr)
+
+
+def test_str_to_date():
+    import datetime
+    s2 = Session()
+    assert s2.must_query(
+        "select str_to_date('31/01/2024', '%d/%m/%Y')") == \
+        [(datetime.date(2024, 1, 31),)]
+    assert s2.must_query(
+        "select str_to_date('2024-01-31 10:30:05', "
+        "'%Y-%m-%d %H:%i:%s')") == [("2024-01-31 10:30:05",)]
+    assert s2.must_query(
+        "select str_to_date('garbage', '%d/%m/%Y')") == [(None,)]
+    s2.execute("create table sd (a varchar(20))")
+    s2.execute("insert into sd values ('05 Jan 2024'), (null), ('x')")
+    assert s2.must_query(
+        "select str_to_date(a, '%d %b %Y') from sd") == [
+        (datetime.date(2024, 1, 5),), (None,), (None,)]
+    assert s2.must_query(
+        "select count(*) from sd where str_to_date(a, '%d %b %Y') "
+        "is not null") == [(1,)]
+
+
+def test_utc_and_misc():
+    s2 = Session()
+    d = s2.must_query("select utc_date()")[0][0]
+    import datetime
+    assert isinstance(d, datetime.date)
+    assert s2.must_query("select coercibility('x')") == [(4,)]
+    assert s2.must_query("select benchmark(10, 1+1)") == [(0,)]

@@ -589,7 +589,7 @@ class Evaluator:
         op_insert_str = op_quote = op_to_base64 = op_from_base64 = \
         op_unhex = op_regexp_substr = op_regexp_replace = op_conv = \
         op_bit_length = op_inet_aton = op_regexp_like = \
-        op_regexp_instr = \
+        op_regexp_instr = op_str_to_date = \
         _op_string_unlowered
 
     def op_dict_lut(self, e, cols, memo):
@@ -1080,6 +1080,24 @@ class Evaluator:
         out = np.array([format(int(x) & 0xFFFFFFFFFFFFFFFF, fmt)
                         for x in arr], object)
         return out, m
+
+    def op_uuid(self, e, cols, memo):
+        """UUID(): fresh value PER ROW (host string producer; plans
+        carrying it are tainted out of the plan cache)."""
+        import uuid as _uuid
+        n = len(cols[0][0]) if cols else 1
+        out = np.array([str(_uuid.uuid4()) for _ in range(n)], object)
+        return out, True
+
+    def op_rand(self, e, cols, memo):
+        """RAND([seed]): per-row uniform [0,1); seeded form is a
+        deterministic sequence (builtin_math.go randSig)."""
+        n = len(cols[0][0]) if cols else 1
+        if e.args:
+            rng = np.random.default_rng(int(e.args[0].value))
+        else:
+            rng = np.random.default_rng()
+        return self.xp.asarray(rng.random(n)), True
 
     def op_inet_ntoa(self, e, cols, memo):
         """INET_NTOA(n) -> dotted-quad string (host string producer;

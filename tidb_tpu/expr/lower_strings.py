@@ -203,6 +203,12 @@ def lower_strings(e: Expr, dicts: dict[int, StringDict]) -> Expr:
             return lowered
         return e
 
+    if e.op == "str_to_date":
+        lowered = _lower_str_to_date(e, args, dicts)
+        if lowered is not None:
+            return lowered
+        return e
+
     if e.op == "in" and _dict_for(args[0], dicts) is not None:
         d = _dict_for(args[0], dicts)
         has_null = any(isinstance(a, Const) and a.value is None for a in args[1:])
@@ -865,6 +871,70 @@ def _lower_cast_strings(e: Func, args, dicts) -> Optional[Expr]:
         vals = [v[:n] for v in d.values]
         return _derived_map(dst, src, vals)
     return None
+
+
+_MYSQL_STRPTIME = {
+    "%Y": "%Y", "%y": "%y", "%m": "%m", "%c": "%m", "%d": "%d",
+    "%e": "%d", "%H": "%H", "%k": "%H", "%h": "%I", "%I": "%I",
+    "%l": "%I", "%i": "%M", "%s": "%S", "%S": "%S", "%f": "%f",
+    "%p": "%p", "%b": "%b", "%M": "%B", "%a": "%a", "%W": "%A",
+    "%j": "%j", "%T": "%H:%M:%S", "%r": "%I:%M:%S %p", "%%": "%%",
+}
+
+
+def _str_to_date_value(s: str, fmt: str):
+    """STR_TO_DATE per-value parse -> (days|micros, is_datetime) or None
+    (MySQL: unparseable -> NULL).  MySQL specifiers map onto strptime."""
+    import datetime as _dt
+
+    from ..types.temporal import MICROS_PER_DAY, MICROS_PER_SEC
+    out = []
+    i = 0
+    while i < len(fmt):
+        if fmt[i] == "%" and i + 1 < len(fmt):
+            tok = fmt[i:i + 2]
+            py = _MYSQL_STRPTIME.get(tok)
+            if py is None:
+                return None
+            out.append(py)
+            i += 2
+        else:
+            out.append(fmt[i])
+            i += 1
+    try:
+        d = _dt.datetime.strptime(s.strip(), "".join(out))
+    except ValueError:
+        return None
+    days = (_dt.date(d.year, d.month, d.day)
+            - _dt.date(1970, 1, 1)).days
+    micros = (days * MICROS_PER_DAY
+              + ((d.hour * 60 + d.minute) * 60 + d.second)
+              * MICROS_PER_SEC + d.microsecond)
+    return days, micros
+
+
+def _lower_str_to_date(e: Func, args, dicts) -> Optional[Expr]:
+    """STR_TO_DATE(col, 'fmt') over a dict column or constant: per-value
+    strptime feeding an int LUT gather (builtin_time.go strToDate)."""
+    fmt = _const_str(args[1])
+    if fmt is None:
+        return None
+    want_dt = e.dtype.kind == K.DATETIME
+
+    def conv(v: str):
+        r = _str_to_date_value(v, fmt)
+        if r is None:
+            return None
+        return r[1] if want_dt else r[0]
+    s0 = _const_str(args[0])
+    if s0 is not None:
+        r = conv(s0)
+        return Const(e.dtype if r is not None else dt.null_type(), r)
+    d = _dict_for(args[0], dicts)
+    if d is None:
+        return None
+    vals = [conv(v) for v in d.values]
+    return _derived_ilut_nullable(e.dtype, args[0], vals)
 
 
 def _cond_value_slots(op: str, n: int) -> list[int]:

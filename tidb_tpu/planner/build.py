@@ -64,6 +64,11 @@ OUTER_RESOLVER: contextvars.ContextVar = contextvars.ContextVar(
 # list the session installs per statement; builders append a reason when
 # the plan embeds statement-time state (NOW(), scalar subquery results)
 # so the plan cache skips it
+# session identity visible to scalar functions (DATABASE()/USER()/
+# CONNECTION_ID()/LAST_INSERT_ID()): set by the session around planning
+SESSION_INFO: contextvars.ContextVar = contextvars.ContextVar(
+    "session_info", default=None)
+
 PLAN_TAINTS: contextvars.ContextVar = contextvars.ContextVar(
     "plan_taints", default=None)
 
@@ -595,6 +600,66 @@ class ExprBuilder:
             return B.if_(B.compare("eq", args[0], args[1]), B.lit(None), args[0])
         if name == "DATE":
             return B.cast(args[0], dt.date())
+        if name in ("VERSION",):
+            return Const(dt.varchar(False), "8.0.11-tidb-tpu")
+        if name in ("USER", "CURRENT_USER", "SESSION_USER", "SYSTEM_USER",
+                    "DATABASE", "SCHEMA", "CONNECTION_ID",
+                    "LAST_INSERT_ID"):
+            info = SESSION_INFO.get() or {}
+            _taint_plan("session")       # identity varies per connection
+            if name in ("DATABASE", "SCHEMA"):
+                db = info.get("db")
+                return Const(dt.varchar(True), db) if db \
+                    else Const(dt.null_type(), None)
+            if name == "CONNECTION_ID":
+                return Const(dt.bigint(False), int(info.get("conn_id", 0)))
+            if name == "LAST_INSERT_ID":
+                return Const(dt.bigint(False),
+                             int(info.get("last_insert_id", 0)))
+            return Const(dt.varchar(False),
+                         f"{info.get('user', 'root')}@%")
+        if name == "UUID":
+            _taint_plan("uuid")          # fresh per execution, never cache
+            return Func(dt.varchar(False), "uuid", ())
+        if name == "RAND":
+            _taint_plan("rand")
+            seed = None
+            if args and isinstance(args[0], Const) \
+                    and args[0].value is not None:
+                seed = int(args[0].value)
+            return Func(dt.double(False), "rand",
+                        (Const(dt.bigint(False), seed),)
+                        if seed is not None else ())
+        if name == "BENCHMARK":
+            return B.lit(0)              # MySQL: returns 0 (timing tool)
+        if name == "COERCIBILITY":
+            # literals are coercible (4), column values implicit (2)
+            return B.lit(4 if isinstance(args[0], Const) else 2)
+        if name == "STR_TO_DATE":
+            if not (len(args) == 2 and isinstance(args[1], Const)
+                    and isinstance(args[1].value, str)):
+                raise PlanError("STR_TO_DATE needs a constant format")
+            fmt = str(args[1].value)
+            has_time = any(t in fmt for t in
+                           ("%H", "%i", "%s", "%T", "%k", "%l", "%p",
+                            "%r", "%f"))
+            out = (dt.datetime(True) if has_time else dt.date(True))
+            if isinstance(args[0], Const):
+                if not isinstance(args[0].value, str):
+                    return Const(dt.null_type(), None)
+                from ..expr.lower_strings import _str_to_date_value
+                r = _str_to_date_value(args[0].value, fmt)
+                if r is None:
+                    return Const(dt.null_type(), None)
+                return Const(out, r[1] if has_time else r[0])
+            return Func(out, "str_to_date", (args[0], args[1]))
+        if name in ("UTC_DATE", "UTC_TIMESTAMP"):
+            _taint_plan("now")
+            import time as _time
+            micros = int(_time.time() * 1_000_000)
+            if name == "UTC_DATE":
+                return Const(dt.date(False), micros // tmp.MICROS_PER_DAY)
+            return Const(dt.datetime(False), micros)
         if name in ("NOW", "CURRENT_TIMESTAMP", "SYSDATE", "CURDATE",
                     "CURRENT_DATE"):
             # statement-start clock (MySQL: constant within a statement);

@@ -267,10 +267,15 @@ class Session:
             # (mppcoordmanager + KILL): the kill event travels to every
             # dispatch/chunk checkpoint via contextvar
             from ..copr.coordinator import KILL_EVENT, QUERY_HANDLE
+            from ..planner.build import SESSION_INFO
             self._kill_event.clear()
             handle = self.domain.coordinator.begin(self.conn_id, text)
             ktok = KILL_EVENT.set(self._kill_event)
             htok = QUERY_HANDLE.set(handle)
+            stok = SESSION_INFO.set({
+                "db": self.db, "user": self.user,
+                "conn_id": self.conn_id,
+                "last_insert_id": getattr(self, "last_insert_id", 0)})
             try:
                 out = self._exec_stmt(stmt)
             except Exception as e:
@@ -279,6 +284,7 @@ class Session:
                               (time.perf_counter_ns() - t0) / 1e9, 0)
                 raise
             finally:
+                SESSION_INFO.reset(stok)
                 QUERY_HANDLE.reset(htok)
                 KILL_EVENT.reset(ktok)
                 self.domain.coordinator.end(self.conn_id)
@@ -1175,6 +1181,10 @@ class Session:
             n = write(self.txn)
         if self.txn is not None:
             self._txn_note_table(tbl)
+        if tbl.auto_inc_col is not None and n:
+            # MySQL LAST_INSERT_ID(): first auto-generated id of the last
+            # batch; the table counter sits past the batch after insert
+            self.last_insert_id = max(int(tbl._auto_inc) - n + 1, 1)
         self.domain.stats.note_modify(tbl, n)
         return ResultSet(affected=n)
 

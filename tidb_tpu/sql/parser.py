@@ -1536,6 +1536,14 @@ class Parser:
 
     def primary(self) -> A.Node:
         t = self.cur
+        if (t.kind == "kw" and t.text in ("DATABASE", "SCHEMA")
+                and self.toks[self.i + 1].kind == "op"
+                and self.toks[self.i + 1].text == "("):
+            name = t.text
+            self.advance()
+            self.expect_op("(")
+            self.expect_op(")")
+            return A.FuncCall(name, [])
         if (t.kind == "kw" and t.text == "INSERT"
                 and self.toks[self.i + 1].kind == "op"
                 and self.toks[self.i + 1].text == "("):
