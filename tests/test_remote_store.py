@@ -155,3 +155,53 @@ def test_sql_suite_over_remote_topology(remote_session, sql):
     finally:
         s.domain.client = real
     assert got == exp, sql
+
+
+def test_tidb_as_coprocessor():
+    """TiDB-as-coprocessor (executor/coprocessor.go:57): the SQL process
+    serves DAGs over its own catalog tables to a remote peer."""
+    import numpy as np
+
+    from tidb_tpu import copr
+    from tidb_tpu.copr import dag as D
+    from tidb_tpu.copr.aggregate import finalize, merge_states
+    from tidb_tpu.expr import ColumnRef
+    from tidb_tpu.store.remote import RemoteStore
+    from tidb_tpu.store.server import serve_coprocessor
+    from tidb_tpu.types import dtypes as dt
+
+    s = Session(Domain())
+    s.execute("create table cop (a bigint not null, b bigint, "
+              "primary key (a))")
+    s.execute("insert into cop values " + ",".join(
+        f"({i}, {i % 7})" for i in range(200)))
+    port = serve_coprocessor(s.domain)
+    peer = RemoteStore(0, port)
+    assert peer.request(("ping",))[0] == "pong"
+
+    tbl = s.domain.catalog.get_table("test", "cop")
+    snap = tbl.snapshot()
+    b_ref = ColumnRef(dt.bigint(True), 1, "b")
+    agg = D.Aggregation(
+        D.TableScan((0, 1), tuple(snap.dtypes)), (),
+        (copr.AggDesc(copr.AggFunc.COUNT, None, dt.bigint(False)),
+         copr.AggDesc(copr.AggFunc.SUM, b_ref,
+                      copr.sum_out_dtype(b_ref.dtype))),
+        D.GroupStrategy.SCALAR)
+    # two half-table range requests merge like any store's partials
+    st1 = peer.request(("exec_agg", "test.cop", -1, agg, [(0, 100)]))
+    st2 = peer.request(("exec_agg", "test.cop", -1, agg, [(100, 200)]))
+    assert st1[0] == "states" and st2[0] == "states"
+    merged = merge_states([st1[1], st2[1]])
+    _k, cols = finalize(agg, merged, [])
+    assert int(cols[0].data[0]) == 200
+    assert int(cols[1].data[0]) == sum(i % 7 for i in range(200))
+    # row-returning plan with a selection
+    from tidb_tpu.expr import builders as B
+    sel = D.Selection(D.TableScan((0, 1), tuple(snap.dtypes)),
+                      (B.compare("lt", ColumnRef(dt.bigint(False), 0, "a"),
+                                 B.lit(5)),))
+    rows = peer.request(("exec_rows", "test.cop", -1, sel, None,
+                         tuple(snap.dtypes)))
+    assert rows[0] == "rows" and len(rows[1][0]) == 5
+    peer.close()

@@ -158,6 +158,91 @@ def _topn_indices(topn, cols, n: int) -> np.ndarray:
     return order[:topn.limit]
 
 
+class CatalogStoreEngine(StoreEngine):
+    """TiDB-as-coprocessor (executor/coprocessor.go:57): the SQL process
+    itself serves coprocessor requests over its OWN catalog tables — a
+    peer ships a DAG naming "db.table" and gets partial states / rows
+    back, exactly as from a store process.  Snapshots resolve live from
+    the catalog; epoch -1 means "latest" (the response carries the
+    snapshot epoch the execution bound)."""
+
+    def __init__(self, domain):
+        super().__init__()
+        self.domain = domain
+
+    def _snap_for(self, table: str, epoch: int, ranges):
+        from ..chunk.column import Column
+        from .columnar import ColumnarSnapshot
+        db, _, name = table.partition(".")
+        if not name:
+            db, name = "test", db
+        try:
+            tbl = self.domain.catalog.get_table(db, name)
+        except Exception:
+            return super()._snap_for(table, epoch, ranges)
+        snap = tbl.snapshot()
+        if epoch not in (-1, snap.epoch):
+            return None, ("err", "stale_epoch", snap.epoch)
+        if ranges is None:
+            return snap, None
+        cols = []
+        for c in snap.columns:
+            parts = [c.slice(lo, hi) for lo, hi in ranges]
+            cols.append(parts[0] if len(parts) == 1
+                        else Column.concat(parts))
+        return ColumnarSnapshot(snap.names, snap.dtypes, cols,
+                                epoch=snap.epoch, n_shards=1), None
+
+
+def serve_coprocessor(domain, port: int = 0) -> int:
+    """Expose this SQL process as a coprocessor endpoint on 127.0.0.1;
+    returns the bound port.  Runs the accept loop on a daemon thread."""
+    eng = CatalogStoreEngine(domain)
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", port))
+    srv.listen(16)
+    bound = srv.getsockname()[1]
+
+    def accept_loop():
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=_handle_conn, args=(eng, conn),
+                             daemon=True).start()
+
+    threading.Thread(target=accept_loop, daemon=True,
+                     name="coprocessor-endpoint").start()
+    domain._copr_endpoint = (srv, bound)
+    return bound
+
+
+def _handle_conn(eng: StoreEngine, conn) -> None:
+    try:
+        while True:
+            msg = recv_msg(conn)
+            op = msg[0]
+            if op == "ping":
+                resp = ("pong", eng.requests_served)
+            elif op == "load":
+                eng.load(*msg[1:])
+                resp = ("ok",)
+            elif op == "exec_agg":
+                resp = eng.exec_agg(*msg[1:])
+            elif op == "exec_rows":
+                resp = eng.exec_rows(*msg[1:])
+            else:
+                resp = ("err", "bad_op", op)
+            eng.requests_served += 1
+            send_msg(conn, resp)
+    except (ConnectionError, OSError):
+        pass
+    finally:
+        conn.close()
+
+
 def serve(port: int = 0):
     eng = StoreEngine()
     fail_after = [None]    # failpoint: exit before the k-th next response
