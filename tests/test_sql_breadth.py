@@ -336,3 +336,32 @@ def test_find_in_set():
         "select a from f where find_in_set(b, 'y,z') > 0") == [(2,)]
     assert s.must_query("select find_in_set('b', 'a,b,c')") == [(2,)]
     assert s.must_query("select find_in_set('q', 'a,b,c')") == [(0,)]
+
+
+def test_client_handshake_compat():
+    """MySQL client/ORM connect-time statements: SET NAMES, SET
+    TRANSACTION ISOLATION LEVEL, @@sysvar/@uservar expressions
+    (server/conn.go handshake; variable/sysvar.go)."""
+    from tidb_tpu.session import Domain, Session
+    s = Session(Domain())
+    s.execute("set names utf8mb4 collate utf8mb4_general_ci")
+    assert s.must_query("select @@character_set_client") == [("utf8mb4",)]
+    assert s.must_query("select @@collation_connection") == \
+        [("utf8mb4_general_ci",)]
+    s.execute("set session transaction isolation level read committed")
+    assert s.must_query("select @@transaction_isolation") == \
+        [("READ-COMMITTED",)]
+    s.execute("set transaction isolation level repeatable read, "
+              "read write")
+    assert s.must_query("select @@transaction_read_only") == [(0,)]
+    assert s.must_query("select @@global.tidb_mdl_wait_timeout") == \
+        [(10.0,)]
+    # user variables in expressions
+    s.execute("set @x = 42")
+    assert s.must_query("select @x, @x * 2 + 1") == [(42, 85)]
+    assert s.must_query("select @undefined") == [(None,)]
+    # accepted compat sysvars
+    for stmt in ("set profiling = 0", "set big_tables = 0",
+                 "set optimizer_switch = 'index_merge=on'",
+                 "set div_precision_increment = 6"):
+        s.execute(stmt)

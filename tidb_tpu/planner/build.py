@@ -105,6 +105,23 @@ class ExprBuilder:
 
     # ---- leaves ---- #
 
+    def _b_sysvar(self, n: "A.SysVar") -> Expr:
+        """@@sysvar / @uservar -> session-resolved constant (plans
+        tainted: the value varies per connection/SET)."""
+        info = SESSION_INFO.get() or {}
+        _taint_plan("sysvar")
+        getter = info.get("getuservar" if n.user else "getvar")
+        v = getter(n.name, n.scope) if getter is not None else None
+        if v is None:
+            return Const(dt.null_type(), None)
+        if isinstance(v, bool):
+            return Const(dt.bigint(False), int(v))
+        if isinstance(v, int):
+            return Const(dt.bigint(False), v)
+        if isinstance(v, float):
+            return Const(dt.double(False), v)
+        return Const(dt.varchar(False), str(v))
+
     def _b_ident(self, n: A.Ident) -> Expr:
         if len(n.parts) == 1:
             q, name = None, n.parts[0]

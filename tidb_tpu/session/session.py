@@ -272,10 +272,23 @@ class Session:
             handle = self.domain.coordinator.begin(self.conn_id, text)
             ktok = KILL_EVENT.set(self._kill_event)
             htok = QUERY_HANDLE.set(handle)
+            def _getvar(name, scope=""):
+                if scope == "global":
+                    return self.domain.sysvars.get(name)
+                merged = {**self.domain.sysvars, **self.vars}
+                from .sysvars import REGISTRY
+                if name in merged:
+                    return merged[name]
+                ent = REGISTRY.get(name)
+                return ent.default if ent is not None else None
+
             stok = SESSION_INFO.set({
                 "db": self.db, "user": self.user,
                 "conn_id": self.conn_id,
-                "last_insert_id": getattr(self, "last_insert_id", 0)})
+                "last_insert_id": getattr(self, "last_insert_id", 0),
+                "getvar": _getvar,
+                "getuservar":
+                    lambda name, _s="": self.user_vars.get(name)})
             try:
                 out = self._exec_stmt(stmt)
             except Exception as e:

@@ -54,6 +54,15 @@ _VARS = [
     _v("tidb_enable_vectorized_expression", 1, kind="bool"),
     _v("tidb_ddl_reorg_worker_cnt", 4, kind="int", min=1, max=128),
     _v("tidb_mdl_wait_timeout", 10.0, kind="float", min=0.0, max=3600.0),
+    # MySQL client/ORM handshake compat (accepted, enforced where the
+    # engine has the corresponding behavior)
+    _v("profiling", 0, kind="bool"),
+    _v("innodb_strict_mode", 1, kind="bool"),
+    _v("optimizer_switch", "", kind="str"),
+    _v("big_tables", 0, kind="bool"),
+    _v("sql_buffer_result", 0, kind="bool"),
+    _v("lc_time_names", "en_US", kind="str"),
+    _v("div_precision_increment", 4, kind="int", min=0, max=30),
     _v("tidb_mem_quota_query", -1, kind="int"),
     _v("tidb_enable_tmp_storage_on_oom", 1, kind="bool"),
     _v("tidb_enable_plan_cache", 1, kind="bool"),

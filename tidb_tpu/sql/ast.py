@@ -24,6 +24,14 @@ class Ident(Node):
 
 
 @dataclass
+class SysVar(Node):
+    """@@[scope.]sysvar or @uservar in expression position."""
+    name: str
+    scope: str = ""                 # "" | "session" | "global"
+    user: bool = False
+
+
+@dataclass
 class Star(Node):
     table: Optional[str] = None     # t.* support
 

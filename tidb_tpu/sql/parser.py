@@ -1356,11 +1356,51 @@ class Parser:
             self.advance()
             self.expect_kw("GROUP")
             return A.SetResourceGroup(self.ident().lower())
+        if self._accept_word("NAMES"):
+            # SET NAMES <charset> [COLLATE <collation>] -> the three
+            # connection charset vars (MySQL handshake compat)
+            cs = (self._str_lit() if self.cur.kind == "str"
+                  else self.ident())
+            coll = None
+            if self.accept_kw("COLLATE") or self._accept_word("COLLATE"):
+                coll = (self._str_lit() if self.cur.kind == "str"
+                        else self.ident())
+            st = A.SetStmt("session")
+            for v in ("character_set_client", "character_set_results",
+                      "character_set_connection"):
+                st.assignments.append((v, A.Lit(cs, "str")))
+            if coll:
+                st.assignments.append(
+                    ("collation_connection", A.Lit(coll, "str")))
+            return st
         scope = "session"
         if self.accept_kw("GLOBAL"):
             scope = "global"
         elif self.accept_kw("SESSION"):
             scope = "session"
+        if self._accept_word("TRANSACTION"):
+            # SET [SESSION|GLOBAL] TRANSACTION ISOLATION LEVEL ... |
+            # READ ONLY|WRITE -> transaction_* sysvars
+            st = A.SetStmt(scope)
+            while True:
+                if self._accept_word("ISOLATION"):
+                    self._accept_word("LEVEL")
+                    parts = [self.ident().upper()]
+                    if parts[0] in ("READ", "REPEATABLE"):
+                        parts.append(self.ident().upper())
+                    level = "-".join(parts)
+                    st.assignments.append(
+                        ("transaction_isolation", A.Lit(level, "str")))
+                elif self._accept_word("READ"):
+                    ro = 1 if self._accept_word("ONLY") else (
+                        self._accept_word("WRITE") and 0)
+                    st.assignments.append(
+                        ("transaction_read_only", A.Lit(int(ro), "int")))
+                else:
+                    raise ParseError(
+                        "expected ISOLATION LEVEL or READ", self.cur)
+                if not self.accept_op(","):
+                    return st
         st = A.SetStmt(scope)
         while True:
             user_var = False
@@ -1536,6 +1576,16 @@ class Parser:
 
     def primary(self) -> A.Node:
         t = self.cur
+        if t.kind == "op" and t.text == "@":
+            self.advance()
+            if self.accept_op("@"):
+                scope = ""
+                if self.cur.kind in ("kw", "ident") and \
+                        self.cur.text.upper() in ("GLOBAL", "SESSION"):
+                    scope = self.advance().text.lower()
+                    self.expect_op(".")
+                return A.SysVar(self.ident().lower(), scope)
+            return A.SysVar(self.ident().lower(), user=True)
         if (t.kind == "kw" and t.text in ("DATABASE", "SCHEMA")
                 and self.toks[self.i + 1].kind == "op"
                 and self.toks[self.i + 1].text == "("):
