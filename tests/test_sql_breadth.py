@@ -365,3 +365,23 @@ def test_client_handshake_compat():
                  "set optimizer_switch = 'index_merge=on'",
                  "set div_precision_increment = 6"):
         s.execute(stmt)
+
+
+def test_show_family_compat():
+    """DESCRIBE <table>, SHOW VARIABLES/STATUS LIKE, EXPLAIN FORMAT
+    (executor/show.go surface)."""
+    from tidb_tpu.session import Domain, Session
+    s = Session(Domain())
+    s.execute("create table sh (a bigint not null, b varchar(5), "
+              "primary key (a))")
+    assert s.execute("describe sh").rows[0][0] == "a"
+    got = s.execute("show variables like 'tidb_mdl%'").rows
+    assert got and got[0][0] == "tidb_mdl_wait_timeout"
+    # registry defaults appear even when never SET
+    got = s.execute("show variables like 'profiling'").rows
+    assert got == [("profiling", "0")]
+    st = dict(s.execute("show status").rows)
+    assert "Uptime" in st and "Threads_connected" in st
+    assert s.execute("show status like 'Up%'").rows[0][0] == "Uptime"
+    plan = s.execute("explain format='brief' select * from sh").rows
+    assert plan and "CopTask" in plan[0][0]

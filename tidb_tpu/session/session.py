@@ -1917,9 +1917,33 @@ class Session:
                              [(g,) for g in
                               self.domain.privileges.show_grants(user, host)])
         if stmt.kind == "variables":
-            vs = {**self.domain.sysvars, **self.vars}
-            return ResultSet(["Variable_name", "Value"],
-                             sorted((k, str(v)) for k, v in vs.items()))
+            from .sysvars import REGISTRY
+            vs = {name: ent.default for name, ent in REGISTRY.items()}
+            vs.update(self.domain.sysvars)
+            vs.update(self.vars)
+            rows = sorted((k, "" if v is None else str(v))
+                          for k, v in vs.items())
+            if stmt.like:
+                from ..expr.lower_strings import like_to_regex
+                rx = like_to_regex(stmt.like.lower())
+                rows = [r for r in rows if rx.match(r[0].lower())]
+            return ResultSet(["Variable_name", "Value"], rows)
+        if stmt.kind == "status":
+            import time as _t
+            qs = sum(1 for _ in self.domain.sessions())
+            rows = [("Threads_connected", str(qs)),
+                    ("Uptime", str(int(_t.time()
+                                       - getattr(self.domain, "_t0",
+                                                 _t.time())))),
+                    ("Ssl_cipher", ""),
+                    ("Queries", str(len(self.domain.stmt_summary.rows())
+                                    if hasattr(self.domain.stmt_summary,
+                                               "rows") else 0))]
+            if stmt.like:
+                from ..expr.lower_strings import like_to_regex
+                rx = like_to_regex(stmt.like.lower())
+                rows = [r for r in rows if rx.match(r[0].lower())]
+            return ResultSet(["Variable_name", "Value"], rows)
         raise PlanError(f"unsupported SHOW {stmt.kind}")
 
     def _exec_show_stats(self, kind: str) -> ResultSet:

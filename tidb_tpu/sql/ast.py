@@ -396,6 +396,7 @@ class TraceStmt(Node):
 class ShowStmt(Node):
     kind: str = ""                  # 'tables' | 'databases' | 'variables' | 'columns'
     target: Optional[str] = None
+    like: Optional[str] = None      # SHOW ... LIKE 'pattern' filter
 
 
 @dataclass

@@ -133,6 +133,17 @@ class Parser:
             return A.TraceStmt(self.statement())
         if self.at_kw("EXPLAIN", "DESCRIBE"):
             self.advance()
+            if (self.cur.kind == "ident"
+                    and self.cur.text.upper() == "FORMAT"
+                    and self.toks[self.i + 1].text == "="):
+                self.advance()      # EXPLAIN FORMAT = 'brief'|'row'|...
+                self.expect_op("=")
+                (self._str_lit() if self.cur.kind == "str"
+                 else self.ident())
+            # DESCRIBE <table> = SHOW COLUMNS FROM <table>
+            elif self.cur.kind == "ident" \
+                    and self.toks[self.i + 1].text != "(":
+                return A.ShowStmt("columns", self.ident())
             analyze = self.accept_kw("ANALYZE")
             return A.Explain(self.statement(), analyze)
         if self.at_kw("CREATE"):
@@ -1309,6 +1320,11 @@ class Parser:
             limit = self._int_lit()
         return order, limit
 
+    def _show_like(self, st: "A.ShowStmt") -> "A.ShowStmt":
+        if self.accept_kw("LIKE"):
+            st.like = self._str_lit()
+        return st
+
     def show_stmt(self) -> A.ShowStmt:
         self.expect_kw("SHOW")
         if self.accept_kw("CREATE"):
@@ -1330,10 +1346,14 @@ class Parser:
             self.expect_kw("FROM")
             return A.ShowStmt("columns", self.ident())
         if self.accept_kw("VARIABLES"):
-            return A.ShowStmt("variables")
+            return self._show_like(A.ShowStmt("variables"))
+        if self._accept_word("STATUS"):
+            return self._show_like(A.ShowStmt("status"))
         if self.accept_kw("GLOBAL", "SESSION"):
+            if self._accept_word("STATUS"):
+                return self._show_like(A.ShowStmt("status"))
             self.expect_kw("VARIABLES")
-            return A.ShowStmt("variables")
+            return self._show_like(A.ShowStmt("variables"))
         if self.accept_kw("INDEX", "KEYS"):
             self.expect_kw("FROM")
             return A.ShowStmt("index", self.ident())
