@@ -26,11 +26,25 @@ This is an idiomatic JAX/XLA design, not a port of the Go reference
 - :mod:`tidb_tpu.utils`     — tracing, metrics, config/sysvars (pkg/util)
 """
 
+import os
+
 import jax
 
 # SQL semantics need 64-bit ints (BIGINT) and doubles end-to-end.  TPU
 # emulates i64/f64 with 32-bit pairs; hot kernels downcast internally where
 # provably safe (see copr/kernels.py).
 jax.config.update("jax_enable_x64", True)
+
+# Explicit platform override for embedders.  The JAX_PLATFORMS env var is
+# not enough in environments whose interpreter boot registers a PJRT
+# plugin and forces its platform in-process (observed with the axon TPU
+# plugin's sitecustomize); jax.config.update after import is the only
+# binding override.  Device acquisition itself is lazy (parallel/mesh is
+# only resolved at first device dispatch — see session.Domain), so merely
+# importing this package or running host-only statements never blocks on
+# TPU backend initialization.
+_platform = os.environ.get("TIDB_TPU_PLATFORM")
+if _platform:
+    jax.config.update("jax_platforms", _platform)
 
 __version__ = "0.1.0"

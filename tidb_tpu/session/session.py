@@ -54,8 +54,15 @@ class Domain:
         self.keyspace = keyspace     # tenant prefix (pkg/keyspace analog)
         self.catalog = Catalog()
         self.catalog.domain = self          # memtable binding (infoschema)
-        self.mesh = mesh if mesh is not None else get_mesh()
-        self.client = CopClient(self.mesh)
+        # device mesh acquisition is LAZY: resolving jax.devices() under a
+        # pending TPU grant blocks for the whole backend-init timeout, so
+        # an embedder constructing a Session (or running host-only
+        # statements like SELECT 1) must not pay it.  The CopClient
+        # resolves the mesh on first device dispatch; Domain.mesh
+        # delegates there.  Explicit platform override: set
+        # TIDB_TPU_PLATFORM (e.g. "cpu") before importing tidb_tpu, or
+        # pass a concrete mesh here.
+        self.client = CopClient(mesh if mesh is not None else get_mesh)
         if data_dir is not None:
             # durable mode: WAL-backed native engine + catalog-on-KV, so
             # data, schema, and DDL-job state all survive restart
@@ -111,6 +118,16 @@ class Domain:
         # the statement summary, queryable via
         # information_schema.workload_repo_statements
         self.workload_repo: list = []
+
+    @property
+    def mesh(self):
+        """Device mesh, resolved on first access (see __init__: lazy so
+        Session construction never blocks on TPU backend init)."""
+        return self.client.mesh
+
+    @mesh.setter
+    def mesh(self, value):
+        self.client.mesh = value
 
     @property
     def dxf(self):

@@ -45,7 +45,18 @@ class CopResult:
 
 class CopClient:
     def __init__(self, mesh):
-        self.mesh = mesh
+        # ``mesh`` may be a jax.sharding.Mesh or a zero-arg callable
+        # returning one.  The callable form defers jax backend
+        # initialization until a query actually needs device execution:
+        # under a pending TPU grant (axon UNAVAILABLE-until-timeout),
+        # constructing a Session and running host-only statements must
+        # not block on device acquisition (library-safe init).
+        from jax.sharding import Mesh as _Mesh
+        import threading as _threading
+        is_factory = callable(mesh) and not isinstance(mesh, _Mesh)
+        self._mesh = None if is_factory else mesh
+        self._mesh_fn = mesh if is_factory else None
+        self._mesh_mu = _threading.Lock()
         # paging feedback: dag digest -> EWMA of observed per-shard live
         # fraction; replaces the constant first guess with the reference's
         # adaptive min->max paging discipline (pkg/util/paging) fed by
@@ -90,6 +101,18 @@ class CopClient:
         self._rc_mu = threading.Lock()
         self.result_cache_hits = 0
         self.result_cache_misses = 0
+
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            with self._mesh_mu:     # concurrent first dispatches resolve once
+                if self._mesh is None:
+                    self._mesh = self._mesh_fn()
+        return self._mesh
+
+    @mesh.setter
+    def mesh(self, value):
+        self._mesh = value
 
     # -- dispatch retry seam (pkg/store/copr backoff loop analog) ------ #
 

@@ -128,11 +128,12 @@ class RemoteCopClient:
 
     def __init__(self, cluster: RemoteCluster, mesh=None):
         self.cluster = cluster
-        self.inner = CopClient(mesh) if mesh is not None else \
-            CopClient(__import__(
-                "tidb_tpu.parallel.mesh",
-                fromlist=["get_mesh"]).get_mesh())
-        self.mesh = self.inner.mesh
+        if mesh is None:
+            # factory form: defer device acquisition until first dispatch
+            # (library-safe init — same contract as CopClient)
+            mesh = __import__("tidb_tpu.parallel.mesh",
+                              fromlist=["get_mesh"]).get_mesh
+        self.inner = CopClient(mesh)
         self._meta: dict = {}       # id(snap) -> _SnapMeta
         self._mu = threading.Lock()
         self.remote_dispatches = 0
