@@ -85,6 +85,26 @@ class Projection(CopNode):
         return (self.child,)
 
 
+@dataclass(frozen=True)
+class Expand(CopNode):
+    """Grouping-sets row replication (GROUP BY ... WITH ROLLUP).
+
+    Reference analog: tipb ExecType_TypeExpand executed at
+    unistore/cophandler/mpp.go:638, planned by logical_expand.go:32.
+    Output schema: child columns ++ one nullable column per rollup key ++
+    gid (int64).  Level l of `levels` replicates every live row keeping
+    the first len(keys)-l keys (rolled keys masked NULL); gid = l, so
+    GROUPING() lowers to bit tests over gid and rolled NULLs stay
+    distinguishable from natural NULLs.
+    """
+    child: CopNode = None  # type: ignore[assignment]
+    keys: Tuple[Expr, ...] = ()
+    levels: int = 0
+
+    def children(self):
+        return (self.child,)
+
+
 class GroupStrategy(enum.Enum):
     SCALAR = "scalar"    # no GROUP BY: one output row
     DENSE = "dense"      # small known key domain -> dense group ids
@@ -243,6 +263,10 @@ def output_dtypes(node: CopNode) -> Tuple[dt.DataType, ...]:
         return output_dtypes(node.child)
     if isinstance(node, TopN):
         return output_dtypes(node.child)
+    if isinstance(node, Expand):
+        return (output_dtypes(node.child)
+                + tuple(k.dtype.with_nullable(True) for k in node.keys)
+                + (dt.bigint(False),))
     if isinstance(node, Projection):
         return tuple(e.dtype for e in node.exprs)
     if isinstance(node, Aggregation):
@@ -279,7 +303,8 @@ def to_multimatch(node: CopNode, out_capacity: int) -> CopNode:
     if not node.children():
         return node
     kids = tuple(to_multimatch(c, out_capacity) for c in node.children())
-    if isinstance(node, (Selection, Projection, Limit, TopN, Aggregation)):
+    if isinstance(node, (Selection, Projection, Expand, Limit, TopN,
+                         Aggregation)):
         return dataclasses.replace(node, child=kids[0])
     return node
 
@@ -294,8 +319,8 @@ def rewrite_lookup(node: CopNode, pred=None, **changes) -> CopNode:
         return node
     kids = tuple(rewrite_lookup(c, pred, **changes)
                  for c in node.children())
-    if isinstance(node, (Selection, Projection, Limit, TopN, Aggregation,
-                         LookupJoin)):
+    if isinstance(node, (Selection, Projection, Expand, Limit, TopN,
+                         Aggregation, LookupJoin)):
         return dataclasses.replace(node, child=kids[0])
     return node
 
@@ -316,8 +341,8 @@ def drop_lookup(node: CopNode, keep: bool) -> CopNode:
     if not node.children():
         return node
     kids = tuple(drop_lookup(c, keep) for c in node.children())
-    if isinstance(node, (Selection, Projection, Limit, TopN, Aggregation,
-                         LookupJoin)):
+    if isinstance(node, (Selection, Projection, Expand, Limit, TopN,
+                         Aggregation, LookupJoin)):
         return dataclasses.replace(node, child=kids[0])
     return node
 
@@ -329,6 +354,21 @@ def rewrite_expand_capacity(node: CopNode, new_cap: int) -> CopNode:
                           out_capacity=new_cap)
 
 
+def chain_str(node: CopNode) -> str:
+    """Compact fragment chain for EXPLAIN, leaf first:
+    'TableScan>Selection>Expand>Aggregation[sort]'."""
+    parts = []
+    cur = node
+    while cur is not None:
+        name = type(cur).__name__
+        if isinstance(cur, Aggregation):
+            name += f"[{cur.strategy.value}]"
+        parts.append(name)
+        kids = cur.children()
+        cur = kids[0] if kids else None
+    return ">".join(reversed(parts))
+
+
 def dag_digest(node: CopNode) -> int:
     """Stable-ish digest used as the jit-compile cache key together with the
     shard capacity bucket (SURVEY.md §A.6)."""
@@ -337,8 +377,8 @@ def dag_digest(node: CopNode) -> int:
 
 __all__ = [
     "AggFunc", "AggDesc", "CopNode", "TableScan", "Selection", "Projection",
-    "GroupStrategy", "Aggregation", "TopN", "Limit", "LookupJoin",
+    "Expand", "GroupStrategy", "Aggregation", "TopN", "Limit", "LookupJoin",
     "ShuffleJoinSpec", "output_dtypes", "dag_digest", "find_expand_join",
-    "rewrite_lookup", "drop_lookup",
+    "rewrite_lookup", "drop_lookup", "chain_str",
     "rewrite_expand_capacity",
 ]

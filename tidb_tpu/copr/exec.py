@@ -356,6 +356,28 @@ def _exec_node(node: D.CopNode, scan_cols: Sequence, row_count, ev: Evaluator,
             cols.append((_ensure_array(v, n), m))
         return DeviceBatch(cols, batch.sel, batch.extras)
 
+    if isinstance(node, D.Expand):
+        batch = _exec_node(node.child, scan_cols, row_count, ev, aux)
+        n = len(batch.cols[0][0]) if batch.cols else 0
+        L = len(node.keys)
+        LV = node.levels
+        memo = {}
+        sel = _sel_array(batch.sel, n)
+        out_cols = []
+        for v, m in batch.cols:
+            v = _ensure_array(v, n)
+            out_cols.append((jnp.tile(v, LV),
+                             True if m is True else jnp.tile(m, LV)))
+        lvl = jnp.repeat(jnp.arange(LV, dtype=jnp.int64), n)
+        for j, k in enumerate(node.keys):
+            v, m = ev.eval(k, batch.cols, memo)
+            v = jnp.tile(_ensure_array(v, n), LV)
+            keep = (lvl + j) < L       # key j live on levels l < L - j
+            mj = keep if m is True else (jnp.tile(m, LV) & keep)
+            out_cols.append((v, mj))
+        out_cols.append((lvl, True))
+        return DeviceBatch(out_cols, jnp.tile(sel, LV), batch.extras)
+
     if isinstance(node, D.Limit):
         batch = _exec_node(node.child, scan_cols, row_count, ev, aux)
         n = len(batch.cols[0][0])

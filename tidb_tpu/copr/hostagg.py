@@ -45,7 +45,7 @@ def _host_scan_chain(node: D.CopNode, snap,
         chain.append(cur)
         if isinstance(cur, D.TableScan):
             break
-        if isinstance(cur, (D.Selection, D.Projection)):
+        if isinstance(cur, (D.Selection, D.Projection, D.Expand)):
             cur = cur.child
             continue
         return None
@@ -90,6 +90,35 @@ def _host_scan_chain(node: D.CopNode, snap,
                      m if m is True else m[idx]) for v, m in cols]
             n = len(idx)
             live = None
+        elif isinstance(op, D.Expand):
+            # rollup grouping sets: compact any pending mask first so the
+            # replication multiplies only live rows, then np.tile
+            if live is not None:
+                idx = np.nonzero(live)[0]
+                cols = [(np.asarray(v)[idx] if np.ndim(v) else v,
+                         m if m is True else m[idx]) for v, m in cols]
+                n = len(idx)
+                live = None
+            memo = {}
+            L = len(op.keys)
+            LV = op.levels
+            keyvals = [ev.eval(k, cols, memo) for k in op.keys]
+            out = []
+            for v, m in cols:
+                v = np.broadcast_to(np.asarray(v), (n,))
+                out.append((np.tile(v, LV), True if m is True
+                            else np.tile(np.broadcast_to(
+                                np.asarray(m), (n,)), LV)))
+            lvl = np.repeat(np.arange(LV, dtype=np.int64), n)
+            for j, (v, m) in enumerate(keyvals):
+                v = np.tile(np.broadcast_to(np.asarray(v), (n,)), LV)
+                keep = (lvl + j) < L
+                mv = keep if m is True else (
+                    np.tile(np.broadcast_to(np.asarray(m), (n,)), LV) & keep)
+                out.append((v, mv))
+            out.append((lvl, True))
+            cols = out
+            n = n * LV
         else:  # Projection
             memo = {}
             out = []

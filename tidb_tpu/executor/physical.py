@@ -320,7 +320,8 @@ class CopTaskExec(PhysOp):
             part = f" partitions={','.join(shown)}/{len(names)}"
         cached = " [cop-cache hit]" if getattr(self, "_cache_hit", False) \
             else ""
-        return f"CopTask[{kind}] table={self.table.name}{part} -> TPU{cached}"
+        return (f"CopTask[{kind}] table={self.table.name}{part} "
+                f"dag={D.chain_str(self.dag)} -> TPU{cached}")
 
     def execute(self, ctx: ExecContext) -> ResultChunk:
         from ..copr.coordinator import QUERY_HANDLE, check_killed
@@ -673,6 +674,48 @@ class HostProjection(PhysOp):
             return ResultChunk(list(self.out_names), cols)
         yield from _parallel_map_chunks(
             ctx, self.child.chunks(ctx, required_rows), project)
+
+
+@dataclass
+class HostExpandExec(PhysOp):
+    """Grouping-sets row replication (WITH ROLLUP) on the host path.
+
+    Reference analog: the Expand executor at unistore/cophandler/mpp.go:638.
+    Output: child columns ++ nullable rollup key columns ++ gid; level l
+    keeps the first len(keys)-l keys."""
+    child: PhysOp
+    keys: list
+    levels: int
+    out_names: list = field(default_factory=list)
+    out_dtypes: list = field(default_factory=list)
+
+    def __post_init__(self):
+        self.children = [self.child]
+
+    def describe(self):
+        return f"HostExpand levels={self.levels}"
+
+    def chunks(self, ctx, required_rows=None):
+        L = len(self.keys)
+        LV = self.levels
+
+        def expand(chunk):
+            n = chunk.num_rows
+            kcols = [_eval_to_column(k, chunk) for k in self.keys]
+            lvl = np.repeat(np.arange(LV, dtype=np.int64), n)
+            cols = [Column(c.dtype, np.tile(c.data, LV),
+                           np.tile(c.validity, LV), c.dictionary)
+                    for c in chunk.columns]
+            for j, c in enumerate(kcols):
+                keep = (lvl + j) < L
+                cols.append(Column(c.dtype.with_nullable(True),
+                                   np.tile(c.data, LV),
+                                   np.tile(c.validity, LV) & keep,
+                                   c.dictionary))
+            cols.append(Column(dt.bigint(False), lvl,
+                               np.ones(n * LV, bool), None))
+            return ResultChunk(list(self.out_names), cols)
+        yield from _parallel_map_chunks(ctx, self.child.chunks(ctx), expand)
 
 
 @dataclass

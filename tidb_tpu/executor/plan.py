@@ -99,6 +99,12 @@ def to_physical(p: LogicalPlan, no_device_join: bool = False) -> PhysOp:
         return HostAgg(to_physical(p.child, ndj), list(p.group_exprs),
                        list(p.aggs), out_names=p.schema.names(),
                        out_dtypes=[c.dtype for c in p.schema.cols])
+    from ..planner.logical import LogicalExpand
+    if isinstance(p, LogicalExpand):
+        from .physical import HostExpandExec
+        return HostExpandExec(to_physical(p.child, ndj), list(p.keys),
+                              p.levels, out_names=p.schema.names(),
+                              out_dtypes=[c.dtype for c in p.schema.cols])
     if isinstance(p, LogicalJoin):
         method = _join_method_hint(p)
         if method == "merge":
@@ -258,10 +264,17 @@ def _try_cop(p: LogicalPlan, no_device_join: bool = False) -> Optional[PhysOp]:
     if isinstance(cur, (LogicalAggregate, LogicalTopN, LogicalLimit)):
         top = cur
         cur = cur.child
+    from ..planner.logical import LogicalExpand
+    expand_l = None     # rollup Expand between the agg and its scan chain
+    if isinstance(top, LogicalAggregate) and isinstance(cur, LogicalExpand):
+        expand_l = cur
+        cur = cur.child
     while isinstance(cur, (LogicalSelection, LogicalProjection)):
         mids.append(cur)
         cur = cur.child
     if isinstance(cur, LogicalJoin) and not no_device_join:
+        if expand_l is not None:
+            return None      # rollup-over-join: host Expand above the join
         if _join_method_hint(cur):
             return None      # join-method hint overrides device fusion
         return _try_cop_join(p, top, mids, cur)
@@ -328,6 +341,26 @@ def _try_cop(p: LogicalPlan, no_device_join: bool = False) -> Optional[PhysOp]:
             out_dicts = dict(new_dicts)
             out_dtypes = [e.dtype for e in exprs]
             out_names = m.schema.names()
+
+    if expand_l is not None:
+        # fuse the rollup Expand into the device program: appended key
+        # columns join the scan schema (dicts follow), gid is plain int64
+        ex_keys = tuple(lower_strings(k, cur_dicts) for k in expand_l.keys)
+        if not all(_device_supported(k) for k in ex_keys):
+            return None
+        base = len(out_dtypes)
+        node = D.Expand(node, ex_keys, expand_l.levels)
+        new_dicts = dict(cur_dicts)
+        for j, k in enumerate(ex_keys):
+            dct = expr_out_dict(k, cur_dicts)
+            if dct is not None:
+                new_dicts[base + j] = dct
+        cur_dicts = new_dicts
+        out_dtypes = (list(out_dtypes)
+                      + [c.dtype for c in expand_l.schema.cols[base:]])
+        out_names = (list(out_names)
+                     + [c.name for c in expand_l.schema.cols[base:]])
+        out_dicts = dict(cur_dicts)
 
     key_meta: list[GroupKeyMeta] = []
     if top is None:

@@ -23,10 +23,11 @@ from ..types import dtypes as dt
 from ..types import temporal as tmp
 from ..copr.aggregate import sum_out_dtype
 from .logical import (AggItem, CTEStorage, DataSource, LogicalAggregate,
-                      LogicalCTEScan, LogicalJoin, LogicalLimit, LogicalPlan,
-                      LogicalProjection, LogicalSelection, LogicalSetOp,
-                      LogicalSort, LogicalTopN, LogicalWindow, Schema,
-                      SchemaCol, WindowItem)
+                      LogicalCTEScan, LogicalExpand, LogicalJoin,
+                      LogicalLimit, LogicalPlan, LogicalProjection,
+                      LogicalSelection, LogicalSetOp, LogicalSort,
+                      LogicalTopN, LogicalWindow, Schema, SchemaCol,
+                      WindowItem)
 
 K = dt.TypeKind
 
@@ -34,7 +35,8 @@ AGG_FUNCS = {"SUM", "COUNT", "AVG", "MIN", "MAX",
              "STDDEV", "STD", "STDDEV_POP", "STDDEV_SAMP",
              "VARIANCE", "VAR_POP", "VAR_SAMP",
              "BIT_AND", "BIT_OR", "BIT_XOR",
-             "GROUP_CONCAT", "ANY_VALUE", "APPROX_COUNT_DISTINCT"}
+             "GROUP_CONCAT", "ANY_VALUE", "APPROX_COUNT_DISTINCT",
+             "GROUPING"}
 
 _CMP = {"=": "eq", "<>": "ne", "<": "lt", "<=": "le", ">": "gt", ">=": "ge"}
 _ARITH = {"+": "add", "-": "sub", "*": "mul", "/": "div", "DIV": "intdiv",
@@ -1543,6 +1545,26 @@ def _build_agg_select(sel: A.SelectStmt, items, child) -> tuple[LogicalPlan, lis
         if key in agg_cache:
             return agg_cache[key]
         name = fc.name
+        if name == "GROUPING":
+            # GROUPING(k...) is resolved against the rollup keys; it
+            # lowers post-agg to bit tests over the Expand gid column
+            if not sel.rollup:
+                raise PlanError("GROUPING only valid with WITH ROLLUP")
+            if not fc.args:
+                raise PlanError("GROUPING needs at least one argument")
+            pos = []
+            for a in fc.args:
+                ka = ceb.build(a)
+                for gi, g in enumerate(group_irs):
+                    if ka == g:
+                        pos.append(gi)
+                        break
+                else:
+                    raise PlanError(
+                        "GROUPING argument must be a GROUP BY expression")
+            out = _GroupingRef(tuple(pos))
+            agg_cache[key] = out
+            return out
         star = len(fc.args) == 1 and isinstance(fc.args[0], A.Star)
         arg = None if star else ceb.build(fc.args[0])
         if name == "AVG":
@@ -1618,20 +1640,57 @@ def _build_agg_select(sel: A.SelectStmt, items, child) -> tuple[LogicalPlan, lis
         else None
     raw_having = eb.build(having_ast) if having_ast is not None else None
 
-    # aggregate node schema: group cols then agg cols
-    gcols = [SchemaCol(_expr_name(g, child.schema), g.dtype) for g in group_irs]
+    # aggregate node schema: group cols then agg cols.  WITH ROLLUP routes
+    # the child through LogicalExpand (grouping-sets replication): the agg
+    # then groups on the Expand's nullable key columns plus its gid column
+    # (reference: logical_expand.go:32 builds the same shape).
+    L = len(group_irs)
+    if sel.rollup and L:
+        n_child = len(child.schema)
+        key_names = [_expr_name(g, child.schema) for g in group_irs]
+        key_dts = [g.dtype.with_nullable(True) for g in group_irs]
+        ex_schema = Schema(
+            list(child.schema.cols)
+            + [SchemaCol(n, t) for n, t in zip(key_names, key_dts)]
+            + [SchemaCol("gid", dt.bigint(False))])
+        child = LogicalExpand(child, list(group_irs), L + 1, ex_schema)
+        gid_ref = ColumnRef(dt.bigint(False), n_child + L, "gid")
+        agg_groups = [ColumnRef(t, n_child + j, n)
+                      for j, (n, t) in enumerate(zip(key_names, key_dts))]
+        agg_groups.append(gid_ref)
+        gcols = ([SchemaCol(n, t) for n, t in zip(key_names, key_dts)]
+                 + [SchemaCol("gid", dt.bigint(False))])
+    else:
+        agg_groups = list(group_irs)
+        gcols = [SchemaCol(_expr_name(g, child.schema), g.dtype)
+                 for g in group_irs]
     acols = [SchemaCol(f"agg#{i}", a.out_dtype) for i, a in enumerate(agg_items)]
     agg_schema = Schema(gcols + acols)
-    agg_plan = LogicalAggregate(child, group_irs, agg_items, agg_schema)
+    agg_plan = LogicalAggregate(child, agg_groups, agg_items, agg_schema)
 
-    n_group = len(group_irs)
+    n_group = len(agg_groups)
 
     def remap(e: Expr) -> Expr:
+        if isinstance(e, _GroupingRef):
+            # GROUPING(k_j...): key j is rolled in level gid iff gid+j >= L;
+            # multi-arg packs bits MSB-first (MySQL 8 semantics)
+            gout = ColumnRef(dt.bigint(False), n_group - 1, "gid")
+            out = None
+            k = len(e.positions)
+            for i, j in enumerate(e.positions):
+                bit = B.cast(
+                    B.compare("ge", B.arith("add", gout, B.lit(j)),
+                              B.lit(L)), dt.bigint(False))
+                if k > 1:
+                    bit = B.arith("mul", bit, B.lit(1 << (k - 1 - i)))
+                out = bit if out is None else B.arith("add", out, bit)
+            return out
         if isinstance(e, _AggRef):
             return ColumnRef(e.dtype, n_group + e.agg_index, f"agg#{e.agg_index}")
         for gi, g in enumerate(group_irs):
             if e == g:
-                return ColumnRef(e.dtype, gi, agg_schema.cols[gi].name)
+                return ColumnRef(agg_schema.cols[gi].dtype, gi,
+                                 agg_schema.cols[gi].name)
         if isinstance(e, ColumnRef):
             raise PlanError(
                 f"column {e.name!r} must appear in GROUP BY or an aggregate")
@@ -1680,6 +1739,17 @@ class _AggRef(ColumnRef):
     def __init__(self, agg_index: int, dtype: dt.DataType):
         super().__init__(dtype, 100000 + agg_index, f"agg#{agg_index}")
         object.__setattr__(self, "agg_index", agg_index)
+
+
+class _GroupingRef(ColumnRef):
+    """Placeholder for GROUPING(keys...) during select-list building;
+    remapped post-agg to bit tests over the Expand gid column."""
+
+    def __init__(self, positions: tuple):
+        super().__init__(dt.bigint(False), 200000 + (positions[0] if
+                                                     positions else 0),
+                         "grouping")
+        object.__setattr__(self, "positions", positions)
 
 
 def _add_agg(agg_items: list[AggItem], func: AggFunc, arg, distinct: bool) -> int:

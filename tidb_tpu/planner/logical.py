@@ -113,6 +113,27 @@ class LogicalAggregate(LogicalPlan):
 
 
 @dataclass
+class LogicalExpand(LogicalPlan):
+    """Grouping-sets row replication for GROUP BY ... WITH ROLLUP.
+
+    Reference analog: the logical Expand operator
+    (pkg/planner/core/operator/logicalop/logical_expand.go:32) executed by
+    the engine at unistore/cophandler/mpp.go:638.  Level l of `levels`
+    replicates every input row keeping the first len(keys)-l rollup keys
+    (the rolled ones become NULL).  Output schema: child columns ++ one
+    nullable column per rollup key ++ gid (bigint, = the row's level l),
+    so GROUPING() can distinguish rolled NULLs from natural NULLs.
+    """
+    child: LogicalPlan
+    keys: list = None          # rollup key exprs over child schema
+    levels: int = 0            # len(keys) + 1 for ROLLUP
+    schema: Schema = None
+
+    def __post_init__(self):
+        self.children = [self.child]
+
+
+@dataclass
 class LogicalJoin(LogicalPlan):
     kind: str          # 'inner' | 'left' | 'right' | 'cross' | 'semi' | 'anti'
     left: LogicalPlan = None

@@ -254,9 +254,13 @@ def push_predicates(p: LogicalPlan, pending: list[Expr] | None = None) -> Logica
         p.children = [p.child]
         return p
 
-    # leaves (DataSource, DualSource, subquery roots)
+    # leaves (DataSource, DualSource, subquery roots) and barrier nodes
+    # (LogicalExpand): keep .child in sync with children[] so later passes
+    # reading either see the same tree
     for i, c in enumerate(p.children):
         p.children[i] = push_predicates(c)
+        if getattr(p, "child", None) is c:
+            p.child = p.children[i]
     return _wrap(p, pending)
 
 
@@ -379,6 +383,25 @@ def prune_columns(p: LogicalPlan, needed: set[int] | None = None) -> LogicalPlan
         _, cmap = _prune_child(p, 0, set(needed))
         p.schema = p.child.schema
         return p, {old: cmap[old] for old in needed}
+
+    from .logical import LogicalExpand
+    if isinstance(p, LogicalExpand):
+        # appended key/gid columns stay; prune only the passthrough child
+        # columns (plus whatever the rollup keys reference)
+        n_child = len(p.child.schema)
+        child_needed = {i for i in needed if i < n_child}
+        for k in p.keys:
+            child_needed |= referenced_columns(k)
+        _, cmap = _prune_child(p, 0, child_needed)
+        p.keys = [map_refs(k, cmap) for k in p.keys]
+        new_n_child = len(p.child.schema)
+        tail = p.schema.cols[n_child:]       # key cols + gid
+        p.schema = Schema(list(p.child.schema.cols) + list(tail))
+        full = {}
+        for old in needed:
+            full[old] = cmap[old] if old < n_child \
+                else new_n_child + (old - n_child)
+        return p, full
 
     # DualSource etc.
     return p, {i: i for i in needed}

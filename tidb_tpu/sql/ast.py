@@ -171,6 +171,7 @@ class SelectStmt(Node):
     from_: Optional[Node] = None
     where: Optional[Node] = None
     group_by: list[Node] = field(default_factory=list)
+    rollup: bool = False            # GROUP BY ... WITH ROLLUP
     having: Optional[Node] = None
     order_by: list[tuple[Node, bool]] = field(default_factory=list)  # (expr, desc)
     limit: Optional[int] = None

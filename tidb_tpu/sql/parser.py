@@ -517,6 +517,11 @@ class Parser:
                 s.group_by.append(self.expr())
                 if not self.accept_op(","):
                     break
+            if self.accept_kw("WITH"):
+                # only WITH ROLLUP may follow a GROUP BY list
+                if not self._accept_word("ROLLUP"):
+                    raise ParseError("expected ROLLUP after WITH", self.cur)
+                s.rollup = True
         if self.accept_kw("HAVING"):
             s.having = self.expr()
         if self.at_kw("ORDER"):
