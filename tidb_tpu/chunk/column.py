@@ -162,12 +162,59 @@ class Column:
                 out.append(int(self.data[i]))
         return out
 
+    def all_valid(self) -> bool:
+        """Cached validity.all() — hot scan chains ask per chunk, and the
+        reduce over millions of bools per column per chunk adds up."""
+        av = getattr(self, "_all_valid", None)
+        if av is None:
+            av = self._all_valid = bool(self.validity.all())
+        return av
+
+    def narrowed(self) -> np.ndarray:
+        """Smallest-width int array holding exactly `data`'s values —
+        the physical scan representation (frame-of-reference encoding,
+        the TiFlash compressed-column-store analog, SURVEY.md §2.8).
+        Filters and H2D transfers then move 1-4 bytes/row instead of 8;
+        the expression compiler re-widens where the logical (int64/
+        decimal/temporal) width matters (expr/compile.py _iwiden).
+        Cached: snapshots are immutable, so one min/max pass amortizes
+        over every query against the epoch."""
+        ph = getattr(self, "_phys", None)
+        if ph is not None:
+            return ph
+        d = self.data
+        # only signed ints narrow: narrowing unsigned to signed would
+        # break the evaluator's uint64 compare/arith semantics
+        if d.dtype.kind != "i" or d.dtype.itemsize == 1 or not len(d):
+            self._phys = d
+            return d
+        lo, hi = int(d.min()), int(d.max())
+        for t in (np.int8, np.int16, np.int32):
+            if np.dtype(t).itemsize >= d.dtype.itemsize:
+                break
+            ii = np.iinfo(t)
+            if ii.min <= lo and hi <= ii.max:
+                self._phys = d.astype(t)
+                return self._phys
+        self._phys = d
+        return d
+
     def take(self, idx: np.ndarray) -> "Column":
         return Column(self.dtype, self.data[idx], self.validity[idx], self.dictionary)
 
     def slice(self, start: int, stop: int) -> "Column":
-        return Column(self.dtype, self.data[start:stop], self.validity[start:stop],
-                      self.dictionary)
+        col = Column(self.dtype, self.data[start:stop],
+                     self.validity[start:stop], self.dictionary)
+        # inherit the parent's narrow decision (and validity flag) so every
+        # row-range view of one snapshot shares one physical width — stream
+        # batches must all compile to the SAME program shape
+        ph = getattr(self, "_phys", None)
+        if ph is not None:
+            col._phys = ph[start:stop]
+        av = getattr(self, "_all_valid", None)
+        if av:
+            col._all_valid = True
+        return col
 
     def pad_to(self, capacity: int) -> "Column":
         """Pad with NULL rows to a fixed capacity (static-shape batching —

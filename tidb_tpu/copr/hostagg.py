@@ -61,9 +61,15 @@ def _host_scan_chain(node: D.CopNode, snap,
             cols = []
             for off in op.col_offsets:
                 c = snap.columns[off]
-                data = c.data if rng is None else c.data[lo:hi]
-                if rng is None:
-                    valid = True if c.validity.all() else c.validity
+                # narrow physical representation: the hardened evaluator
+                # (expr/compile.py _iwiden/_cmp_fit) computes at logical
+                # width where it matters; scans read 1-4 B/row
+                phys = c.narrowed()
+                data = phys if rng is None else phys[lo:hi]
+                if c.all_valid():       # cached full-column reduce
+                    valid = True
+                elif rng is None:
+                    valid = c.validity
                 else:
                     v = c.validity[lo:hi]
                     valid = True if v.all() else v
@@ -80,9 +86,10 @@ def _host_scan_chain(node: D.CopNode, snap,
                     keep = keep & v & np.broadcast_to(np.asarray(m), (n,))
                 else:
                     keep = keep & v
-            if keep.all():
+            nk = np.count_nonzero(keep)    # one reduce serves both checks
+            if nk == n:
                 continue
-            if allow_mask and keep.mean() > 0.9:
+            if allow_mask and nk > 0.9 * n:
                 live = keep
                 continue
             idx = np.nonzero(keep)[0]
@@ -130,33 +137,46 @@ def _host_scan_chain(node: D.CopNode, snap,
 
 
 def _group_codes(combined: np.ndarray, need_inv: bool):
-    """(unique codes, per-group row counts, inverse|None).
+    """(unique codes, per-group row counts int64, inverse|None).
 
     NDV-adaptive strategy (the reference picks hash vs stream agg from
     NDV; numpy's levers are different): when the observed code range is
-    narrow relative to n, an O(n) bincount histogram beats the O(n log n)
-    sorting unique by 2-4x; otherwise fall back to np.unique."""
+    narrow relative to n, an O(n) histogram beats the O(n log n) sorting
+    unique by 2-4x; otherwise fall back to np.unique.  The histogram runs
+    in the native counting loop (native/hostops.cpp) when built — it
+    reads the narrow physical key array directly, where np.bincount's
+    mandatory bin/weight conversions cost 3-4x the compulsory traffic."""
+    from . import nativeops
     n = len(combined)
     if n:
-        vmin = combined.min()
-        vmax = combined.max()
-        rng = int(vmax) - int(vmin) + 1
-        if rng <= max(2 * n, 1 << 22):
-            cnts = np.bincount(combined - vmin, minlength=rng)
+        if combined.dtype.itemsize < 4:
+            # int8/int16 subtraction below could wrap (range may exceed
+            # the narrow width); int32 always holds the shifted codes
+            combined = combined.astype(np.int32)
+        vmin = int(combined.min())
+        vmax = int(combined.max())
+        rng = vmax - vmin + 1
+        if rng < (1 << 31) and rng <= max(2 * n, 1 << 22):
+            cnts = nativeops.count_keys(combined, vmin, rng)
+            if cnts is None:
+                cnts = np.bincount(combined - vmin, minlength=rng)
             nz = np.flatnonzero(cnts)
             uniq = nz + vmin
-            rows = cnts[nz]
+            rows = cnts[nz].astype(np.int64)
             if not need_inv:
                 return uniq, rows, None
-            lookup = np.empty(rng, np.int64)
-            lookup[nz] = np.arange(len(nz))
-            return uniq, rows, lookup[combined - vmin]
+            lookup = np.zeros(rng, np.int32)
+            lookup[nz] = np.arange(len(nz), dtype=np.int32)
+            inv = nativeops.gather_lookup(combined, vmin, lookup)
+            if inv is None:
+                inv = lookup[combined - vmin].astype(np.int64)
+            return uniq, rows, inv
     if need_inv:
         uniq, inv, rows = np.unique(combined, return_inverse=True,
                                     return_counts=True)
-        return uniq, rows, inv
+        return uniq, rows.astype(np.int64), inv
     uniq, rows = np.unique(combined, return_counts=True)
-    return uniq, rows, None
+    return uniq, rows.astype(np.int64), None
 
 
 def host_sort_agg(agg: D.Aggregation, snap) -> Optional[dict]:
@@ -198,8 +218,10 @@ def host_sort_agg(agg: D.Aggregation, snap) -> Optional[dict]:
         key_vals.append(vz)
         key_valids.append(valid)
         if all_valid and not e.dtype.is_float:
-            # already canonical: ints/codes compare bit-stably
-            code = vz if vz.dtype == np.int64 else vz.astype(np.int64)
+            # already canonical: ints/codes compare bit-stably.  Signed
+            # narrow physical arrays pass through unwidened — the native
+            # counting loop reads them at physical width
+            code = vz if vz.dtype.kind == "i" else vz.astype(np.int64)
         else:
             code = _np_key_code(vz, valid, e.dtype)
         key_codes.append(code)
@@ -277,12 +299,14 @@ def host_sort_agg(agg: D.Aggregation, snap) -> Optional[dict]:
             if n >= 2 ** 31:
                 raise OverflowError(
                     f"{n} rows exceed the 2^31 limb-exact SUM bound")
-            v = np.where(mask, av.astype(np.int64), np.int64(0))
+            v = np.where(mask, av, av.dtype.type(0) if hasattr(av, "dtype")
+                         else 0)
             vmax = int(v.max()) if len(v) else 0
             vmin = int(v.min()) if len(v) else 0
-            hi, lo = _seg_sum_int(inv, v, ng,
-                                  one_limb=(0 <= vmin and vmax < 2 ** 32),
-                                  cnt=rows)
+            one_limb = 0 <= vmin and vmax < 2 ** 32
+            if not one_limb and v.dtype != np.int64:
+                v = v.astype(np.int64)
+            hi, lo = _seg_sum_int(inv, v, ng, one_limb)
             states[f"a{i}"] = {"hi": hi, "lo": lo, "cnt": cnt}
             continue
         # MIN / MAX: neutral-fill invalid rows, segment-reduce in the
@@ -309,37 +333,32 @@ _SEG_CHUNK = 1 << 20
 
 
 def _seg_sum_int(gid: np.ndarray, v: np.ndarray, size: int,
-                 one_limb: bool,
-                 cnt: Optional[np.ndarray] = None
-                 ) -> tuple[np.ndarray, np.ndarray]:
-    """Exact per-group (hi, lo) 32-bit-limb sums of int64 values via
-    chunked np.bincount: each <=2^20-row chunk's float64 weight
-    accumulation stays below 2^52 (exact), and chunk results accumulate
-    in int64 — ~3x faster than np.add.at's scatter loop on this host.
-    `cnt` (per-group row count, len size) avoids re-counting for the
-    signed hi-limb bias when the caller already has it."""
+                 one_limb: bool) -> tuple[np.ndarray, np.ndarray]:
+    """Exact per-group (hi, lo) 32-bit-limb sums of int values via chunked
+    np.bincount: each <=2^20-row chunk's float64 weight accumulation stays
+    below 2^52 in magnitude (exact — float64 is exact for negative weights
+    under the same bound, so the signed hi limb needs no bias), and chunk
+    results accumulate in int64 — ~3x faster than np.add.at's scatter
+    loop on this host.
+
+    one_limb (all values in [0, 2^32)): `v` may be ANY int width — narrow
+    physical columns feed bincount directly, skipping the astype and mask
+    passes.  Two-limb: `v` must be int64."""
     lo = np.zeros(size, np.int64)
     hi = np.zeros(size, np.int64)
-    if not one_limb and cnt is None:
-        cnt = np.zeros(size, np.int64)
-        count_inline = True
-    else:
-        count_inline = False
     for s in range(0, len(v), _SEG_CHUNK):
         g = gid[s:s + _SEG_CHUNK]
         vv = v[s:s + _SEG_CHUNK]
+        if one_limb:
+            lo += np.bincount(g, weights=vv,
+                              minlength=size)[:size].astype(np.int64)
+            continue
         lo += np.bincount(g, weights=vv & 0xFFFFFFFF,
                           minlength=size)[:size].astype(np.int64)
-        if not one_limb:
-            # hi limb is signed: bias into [0, 2^32) for the float
-            # accumulation, subtract the per-group bias at the end
-            biased = (vv >> 32) + (np.int64(1) << 31)
-            hi += np.bincount(g, weights=biased,
-                              minlength=size)[:size].astype(np.int64)
-            if count_inline:
-                cnt += np.bincount(g, minlength=size)[:size]
-    if not one_limb:
-        hi -= np.asarray(cnt, np.int64) << 31
+        # arithmetic shift: (v>>32)*2^32 + (v&0xFFFFFFFF) == v exactly,
+        # including negatives; |hi| <= 2^31 so the chunk sum stays exact
+        hi += np.bincount(g, weights=vv >> 32,
+                          minlength=size)[:size].astype(np.int64)
     return hi, lo
 
 
@@ -432,15 +451,15 @@ def _dense_chunk_states(agg: D.Aggregation, snap, rng) -> Optional[dict]:
             else:
                 if n >= 2 ** 31:
                     return None        # past the limb-exact bound
-                v = av if av.dtype == np.int64 else av.astype(np.int64)
+                v = av
                 if mask is not None:
-                    v = np.where(mask, v, np.int64(0))
+                    v = np.where(mask, v, v.dtype.type(0))
                 vmax = int(v.max()) if len(v) else 0
                 vmin = int(v.min()) if len(v) else 0
-                hi, lo = _seg_sum_int(gid, v, G + 1,
-                                      one_limb=(0 <= vmin
-                                                and vmax < 2 ** 32),
-                                      cnt=full_cnt)
+                one_limb = 0 <= vmin and vmax < 2 ** 32
+                if not one_limb and v.dtype != np.int64:
+                    v = v.astype(np.int64)
+                hi, lo = _seg_sum_int(gid, v, G + 1, one_limb)
                 states[f"a{i}"] = {"hi": hi[:G], "lo": lo[:G],
                                    "cnt": cnt}
         else:

@@ -149,6 +149,62 @@ class Evaluator:
             v = int(v)
         return v, m
 
+    # -- narrow physical columns (chunk.Column.narrowed) ----------------- #
+    #
+    # Scans may hand the evaluator int8/int16/int32 arrays holding int64/
+    # decimal/date logical values (the frame-of-reference column encoding:
+    # 1-4 bytes/row of memory traffic instead of 8).  Integer arithmetic
+    # must then compute at full width — numpy/jnp promotion would keep the
+    # narrow width and overflow.  np: ufunc dtype= computes widened without
+    # materializing upcast temporaries; jnp: astype converts fuse into the
+    # surrounding XLA kernel.
+
+    def _iwiden(self, op: str, va, vb, unsigned: bool):
+        xp = self.xp
+        tgt = xp.uint64 if unsigned else xp.int64
+        if xp is np:
+            # object arrays (exact python-int wide decimals) and pure
+            # python scalars keep python arithmetic — exact at any
+            # magnitude; the ufunc dtype= kwarg cannot cast the former
+            # and would wrap/raise on >64-bit literals for the latter
+            da = getattr(va, "dtype", None)
+            db = getattr(vb, "dtype", None)
+            if (da is not None and da.kind == "O") \
+                    or (db is not None and db.kind == "O") \
+                    or (da is None and db is None):
+                return {"add": lambda: va + vb,
+                        "subtract": lambda: va - vb,
+                        "multiply": lambda: va * vb}[op]()
+            return getattr(np, op)(va, vb, dtype=tgt)
+        if getattr(va, "dtype", None) is not None and va.dtype != tgt:
+            va = va.astype(tgt)
+        if getattr(vb, "dtype", None) is not None and vb.dtype != tgt:
+            vb = vb.astype(tgt)
+        return {"add": xp.add, "subtract": xp.subtract,
+                "multiply": xp.multiply}[op](va, vb)
+
+    @staticmethod
+    def _is_narrow(v) -> bool:
+        d = getattr(v, "dtype", None)
+        return d is not None and d.kind in "iu" and d.itemsize < 8
+
+    def _cmp_fit(self, va, vb):
+        """Make a (narrow array, int scalar) comparison width-safe AND
+        narrow-fast: a literal that fits the array's physical dtype is cast
+        down (the compare then runs at physical width); one that does not
+        fit widens the array side (numpy NEP50 would raise OverflowError,
+        jnp would silently wrap)."""
+        for x, y, flip in ((va, vb, False), (vb, va, True)):
+            if self._is_narrow(x) and isinstance(y, (int, np.integer)) \
+                    and getattr(y, "ndim", 0) == 0:
+                info = np.iinfo(x.dtype)
+                if info.min <= int(y) <= info.max:
+                    y = x.dtype.type(y)
+                else:
+                    x = x.astype(self.xp.int64)
+                return (y, x) if flip else (x, y)
+        return va, vb
+
     def _to_common(self, e: Func, cols, memo):
         """Evaluate both operands and unify numeric representation."""
         xp = self.xp
@@ -165,9 +221,9 @@ class Evaluator:
             sb = b.dtype.scale if kb == K.DECIMAL else 0
             s = max(sa, sb)
             if sa < s:
-                va = va * dec.pow10(s - sa)
+                va = self._iwiden("multiply", va, dec.pow10(s - sa), False)
             if sb < s:
-                vb = vb * dec.pow10(s - sb)
+                vb = self._iwiden("multiply", vb, dec.pow10(s - sb), False)
             return va, ma, vb, mb, dt.decimal(18, s)
         # DATE (days) vs DATETIME (micros): coerce DATE up, MySQL-style
         if {ka, kb} == {K.DATE, K.DATETIME}:
@@ -208,13 +264,25 @@ class Evaluator:
 
     # -- arithmetic ------------------------------------------------------ #
 
+    _INT_FAMILY = (K.INT64, K.UINT64, K.DECIMAL, K.DATE, K.DATETIME,
+                   K.TIME)
+
+    def _arith(self, op: str, va, vb, t):
+        """Add/sub/mul honoring the logical (int64/uint64) width when a
+        physical operand is narrow."""
+        if t.kind in self._INT_FAMILY and (self._is_narrow(va)
+                                           or self._is_narrow(vb)):
+            return self._iwiden(op, va, vb, t.kind == K.UINT64)
+        return {"add": lambda: va + vb, "subtract": lambda: va - vb,
+                "multiply": lambda: va * vb}[op]()
+
     def op_add(self, e, cols, memo):
         va, ma, vb, mb, t = self._to_common(e, cols, memo)
-        return va + vb, vand(ma, mb)
+        return self._arith("add", va, vb, t), vand(ma, mb)
 
     def op_sub(self, e, cols, memo):
         va, ma, vb, mb, t = self._to_common(e, cols, memo)
-        return va - vb, vand(ma, mb)
+        return self._arith("subtract", va, vb, t), vand(ma, mb)
 
     def op_mul(self, e, cols, memo):
         a, b = e.args
@@ -222,9 +290,9 @@ class Evaluator:
             # scales add: no rescale needed before the integer multiply
             va, ma = self._num(a, cols, memo)
             vb, mb = self._num(b, cols, memo)
-            return va * vb, vand(ma, mb)
-        va, ma, vb, mb, _ = self._to_common(e, cols, memo)
-        return va * vb, vand(ma, mb)
+            return self._arith("multiply", va, vb, e.dtype), vand(ma, mb)
+        va, ma, vb, mb, t = self._to_common(e, cols, memo)
+        return self._arith("multiply", va, vb, t), vand(ma, mb)
 
     def op_div(self, e, cols, memo):
         xp = self.xp
@@ -238,9 +306,11 @@ class Evaluator:
             # k < 0 (result scale capped below dividend scale): scale the
             # divisor instead — pow10 must stay integral to keep exactness.
             if k >= 0:
-                num, den = va * dec.pow10(k), vb
+                num = self._iwiden("multiply", va, dec.pow10(k), False)
+                den = _as_i64(xp, vb) if self._is_narrow(vb) else vb
             else:
-                num, den = va, vb * dec.pow10(-k)
+                num = _as_i64(xp, va) if self._is_narrow(va) else va
+                den = self._iwiden("multiply", vb, dec.pow10(-k), False)
             return (_round_div(xp, num, den), _div_valid(xp, ma, mb, vb))
         va, ma = self._num(a, cols, memo)
         vb, mb = self._num(b, cols, memo)
@@ -252,6 +322,10 @@ class Evaluator:
     def op_intdiv(self, e, cols, memo):
         xp = self.xp
         va, ma, vb, mb, t = self._to_common(e, cols, memo)
+        if self._is_narrow(va):
+            va = _as_i64(xp, va)
+        if self._is_narrow(vb):
+            vb = _as_i64(xp, vb)
         if t.kind == K.FLOAT64:
             safe = xp.where(vb == 0, 1.0, vb)
             q = xp.trunc(va / safe).astype(xp.int64)
@@ -262,6 +336,10 @@ class Evaluator:
     def op_mod(self, e, cols, memo):
         xp = self.xp
         va, ma, vb, mb, t = self._to_common(e, cols, memo)
+        if self._is_narrow(va):
+            va = _as_i64(xp, va)
+        if self._is_narrow(vb):
+            vb = _as_i64(xp, vb)
         if t.kind == K.FLOAT64:
             safe = xp.where(vb == 0, 1.0, vb)
             r = va - xp.trunc(va / safe) * vb
@@ -271,10 +349,14 @@ class Evaluator:
 
     def op_neg(self, e, cols, memo):
         v, m = self._num(e.args[0], cols, memo)
+        if self._is_narrow(v):
+            v = _as_i64(self.xp, v)    # -(INT_MIN of the narrow width)
         return -v, m
 
     def op_abs(self, e, cols, memo):
         v, m = self._num(e.args[0], cols, memo)
+        if self._is_narrow(v):
+            v = _as_i64(self.xp, v)
         return self.xp.abs(v), m
 
     # -- comparisons ----------------------------------------------------- #
@@ -301,6 +383,7 @@ class Evaluator:
                 res = xp.where(vb < 0, fn(xp.int64(0), xp.int64(-1)), res)
             return res, vand(ma, mb)
         va, ma, vb, mb, _ = self._to_common(e, cols, memo)
+        va, vb = self._cmp_fit(va, vb)
         return fn(va, vb), vand(ma, mb)
 
     def op_eq(self, e, cols, memo):
@@ -327,6 +410,8 @@ class Evaluator:
         va, ma = self._truthy(e.args[0], cols, memo)
         vb, mb = self._truthy(e.args[1], cols, memo)
         val = va & vb
+        if ma is True and mb is True:   # all-valid fast path (hot scans)
+            return val, True
         # NULL AND FALSE = FALSE:  valid if both valid, or either side is a valid FALSE
         valid = _or3(vand(ma, mb), vand(ma, ~va), vand(mb, ~vb))
         return val, valid
@@ -335,6 +420,8 @@ class Evaluator:
         va, ma = self._truthy(e.args[0], cols, memo)
         vb, mb = self._truthy(e.args[1], cols, memo)
         val = va | vb
+        if ma is True and mb is True:
+            return val, True
         valid = _or3(vand(ma, mb), vand(ma, va), vand(mb, vb))
         return val, valid
 
@@ -408,7 +495,12 @@ class Evaluator:
         elif pk == K.DECIMAL:
             sa = a.dtype.scale if a.dtype.kind == K.DECIMAL else 0
             if sa < parent.dtype.scale:
-                v = v * dec.pow10(parent.dtype.scale - sa)
+                v = self._iwiden("multiply", v,
+                                 dec.pow10(parent.dtype.scale - sa), False)
+        if pk in self._INT_FAMILY and self._is_narrow(v):
+            # branches of one CASE/IF must share a width: a narrow branch
+            # next to a wide/const branch would overflow xp.where promotion
+            v = _as_i64(self.xp, v)
         return v, m
 
     # -- IN -------------------------------------------------------------- #
@@ -428,11 +520,15 @@ class Evaluator:
                 st = target.dtype.scale if target.dtype.kind == K.DECIMAL else 0
                 si = it.dtype.scale if it.dtype.kind == K.DECIMAL else 0
                 s = max(st, si)
-                a = tv * dec.pow10(s - st) if st < s else tv
-                b = iv * dec.pow10(s - si) if si < s else iv
+                a = self._iwiden("multiply", tv, dec.pow10(s - st), False) \
+                    if st < s else tv
+                b = self._iwiden("multiply", iv, dec.pow10(s - si), False) \
+                    if si < s else iv
+                a, b = self._cmp_fit(a, b)
                 match = a == b
             else:
-                match = tv == iv
+                a, b = self._cmp_fit(tv, iv)
+                match = a == b
             if im is not True:  # NULL/invalid item can never be a match
                 match = match & im
             any_match = match if any_match is None else (any_match | match)

@@ -96,11 +96,16 @@ class ColumnarSnapshot:
         counts = np.array([hi - lo for lo, hi in ranges], np.int64)
         cols = []
         for c in self.columns:
-            data = np.zeros((len(ranges), cap), dtype=c.data.dtype)
+            # narrow physical width on device too: H2D bytes and HBM
+            # footprint drop 2-8x; the expression compiler re-widens
+            # inside the fused program where the logical width matters
+            # (expr/compile.py _iwiden — XLA fuses the converts)
+            phys = c.narrowed()
+            data = np.zeros((len(ranges), cap), dtype=phys.dtype)
             valid = np.zeros((len(ranges), cap), dtype=bool)
             for i, (lo, hi) in enumerate(ranges):
                 if hi > lo:
-                    data[i, : hi - lo] = c.data[lo:hi]
+                    data[i, : hi - lo] = phys[lo:hi]
                     valid[i, : hi - lo] = c.validity[lo:hi]
             live = np.arange(cap)[None, :] < counts[:, None]
             all_valid = bool(valid[live].all())
@@ -160,9 +165,11 @@ class ColumnarSnapshot:
     # ---------------- streaming batches (rows >> device memory) ------ #
 
     def device_bytes(self) -> int:
-        """Stacked device footprint: S x capacity x (itemsize + validity)."""
+        """Stacked device footprint: S x capacity x (itemsize + validity),
+        at the narrow physical width actually placed on device."""
         s, cap, _ = self.shard_layout()
-        return s * cap * sum(c.data.dtype.itemsize + 1 for c in self.columns)
+        return s * cap * sum(c.narrowed().dtype.itemsize + 1
+                             for c in self.columns)
 
     def view(self, lo: int, hi: int, min_capacity: int = 0) -> "ColumnarSnapshot":
         """Zero-copy row-range view (same shard count; forced capacity so
@@ -179,7 +186,9 @@ class ColumnarSnapshot:
         if max_bytes <= 0 or self.device_bytes() <= max_bytes or \
                 not self.num_rows:
             return None
-        per_row = sum(c.data.dtype.itemsize + 1 for c in self.columns)
+        # device_bytes() above already narrowed every column, so views
+        # sliced off here inherit one shared physical width per column
+        per_row = sum(c.narrowed().dtype.itemsize + 1 for c in self.columns)
         # pow2 capacity rounding can inflate a batch up to 2x: size for it
         rows = max(int(max_bytes // (2 * per_row)), self.n_shards)
         per_shard_cap = max(_pow2_at_least(-(-rows // self.n_shards)),

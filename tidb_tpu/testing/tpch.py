@@ -38,7 +38,6 @@ def gen_lineitem(sf: float = 1.0, seed: int = 0,
                  columns: list[str] | None = None) -> tuple[list[str], list[Column]]:
     """Generate lineitem columns; `columns` restricts output (saves RAM)."""
     n = int(6_000_000 * sf)
-    rng = np.random.default_rng(seed)
     want = set(columns or LINEITEM_NAMES)
     out_names, out_cols = [], []
 
@@ -47,40 +46,57 @@ def gen_lineitem(sf: float = 1.0, seed: int = 0,
             out_names.append(name)
             out_cols.append(col)
 
-    orderkey = np.sort(rng.integers(1, max(int(1_500_000 * sf), 1) * 4 + 1, n))
-    emit("l_orderkey", Column.from_numpy(dt.bigint(False), orderkey))
-    partkey = rng.integers(1, max(int(200_000 * sf), 1) + 1, n)
-    emit("l_partkey", Column.from_numpy(dt.bigint(False), partkey))
-    emit("l_suppkey", Column.from_numpy(dt.bigint(False),
-                                        rng.integers(1, max(int(10_000 * sf), 1) + 1, n)))
-    emit("l_linenumber", Column.from_numpy(dt.bigint(False),
-                                           rng.integers(1, 8, n)))
+    # each block draws from its own seeded child stream, so restricting
+    # `columns` skips unwanted work (the SF=100 bench wants 4 of 15
+    # columns — no 600M-row orderkey sort) without changing the values
+    # of the columns that ARE produced
+    def crng(tag: int):
+        return np.random.default_rng([seed, tag])
 
-    qty = rng.integers(1, 51, n)
-    emit("l_quantity", Column.from_numpy(DEC2, qty * 100))
+    if "l_orderkey" in want:
+        orderkey = np.sort(
+            crng(1).integers(1, max(int(1_500_000 * sf), 1) * 4 + 1, n))
+        emit("l_orderkey", Column.from_numpy(dt.bigint(False), orderkey))
+    if {"l_partkey", "l_extendedprice"} & want:
+        partkey = crng(2).integers(1, max(int(200_000 * sf), 1) + 1, n)
+        emit("l_partkey", Column.from_numpy(dt.bigint(False), partkey))
+    if "l_suppkey" in want:
+        emit("l_suppkey", Column.from_numpy(
+            dt.bigint(False),
+            crng(3).integers(1, max(int(10_000 * sf), 1) + 1, n)))
+    if "l_linenumber" in want:
+        emit("l_linenumber", Column.from_numpy(
+            dt.bigint(False), crng(4).integers(1, 8, n)))
 
-    # extendedprice = qty * p_retailprice(partkey); retail ~ 90000+partkey%...
-    retail = (90000 + (partkey % 20001) + 100 * (partkey % 1000)) // 1  # cents
-    emit("l_extendedprice", Column.from_numpy(DEC2, qty * retail))
+    if {"l_quantity", "l_extendedprice"} & want:
+        qty = crng(5).integers(1, 51, n)
+        emit("l_quantity", Column.from_numpy(DEC2, qty * 100))
+        if "l_extendedprice" in want:
+            # extendedprice = qty * p_retailprice(partkey), in cents
+            retail = 90000 + (partkey % 20001) + 100 * (partkey % 1000)
+            emit("l_extendedprice", Column.from_numpy(DEC2, qty * retail))
 
-    emit("l_discount", Column.from_numpy(DEC2, rng.integers(0, 11, n)))
-    emit("l_tax", Column.from_numpy(DEC2, rng.integers(0, 9, n)))
+    if "l_discount" in want:
+        emit("l_discount", Column.from_numpy(DEC2, crng(6).integers(0, 11, n)))
+    if "l_tax" in want:
+        emit("l_tax", Column.from_numpy(DEC2, crng(7).integers(0, 9, n)))
 
-    ship = _STARTDATE + rng.integers(1, 122 + 2406, n)  # orderdate+1..121 span
     if {"l_returnflag", "l_linestatus", "l_shipdate", "l_commitdate",
             "l_receiptdate"} & want:
+        rng = crng(8)
+        ship = _STARTDATE + rng.integers(1, 122 + 2406, n)  # orderdate+1..121
         receipt = ship + rng.integers(1, 31, n)
-        # returnflag: R or A (50/50) if receipt <= currentdate else N
+        # returnflag: R or A (50/50) if receipt <= currentdate else N.
+        # Codes computed numerically (dict order A=0, N=1, R=2): the
+        # per-row python encode loop took minutes at SF>=10.
         returned = receipt <= _CURRENTDATE
         ra = rng.random(n) < 0.5
-        flag = np.where(returned, np.where(ra, "R", "A"), "N")
         fdict = StringDict(["A", "N", "R"])
-        codes, _ = fdict.encode_array(list(flag))
+        codes = np.where(returned, np.where(ra, 2, 0), 1).astype(np.int32)
         emit("l_returnflag", Column(dt.varchar(False), codes,
                                     np.ones(n, bool), fdict))
-        status = np.where(ship > _CURRENTDATE, "O", "F")
-        sdict = StringDict(["F", "O"])
-        scodes, _ = sdict.encode_array(list(status))
+        sdict = StringDict(["F", "O"])   # F=0, O=1
+        scodes = (ship > _CURRENTDATE).astype(np.int32)
         emit("l_linestatus", Column(dt.varchar(False), scodes,
                                     np.ones(n, bool), sdict))
         emit("l_shipdate", Column.from_numpy(dt.date(False), ship))
@@ -91,12 +107,14 @@ def gen_lineitem(sf: float = 1.0, seed: int = 0,
     if "l_shipinstruct" in want:
         d = StringDict(SHIPINSTRUCT)
         emit("l_shipinstruct",
-             Column(dt.varchar(False), rng.integers(0, len(d), n).astype(np.int32),
+             Column(dt.varchar(False),
+                    crng(9).integers(0, len(d), n).astype(np.int32),
                     np.ones(n, bool), d))
     if "l_shipmode" in want:
         d = StringDict(SHIPMODES)
         emit("l_shipmode",
-             Column(dt.varchar(False), rng.integers(0, len(d), n).astype(np.int32),
+             Column(dt.varchar(False),
+                    crng(10).integers(0, len(d), n).astype(np.int32),
                     np.ones(n, bool), d))
     return out_names, out_cols
 
