@@ -1,27 +1,37 @@
-"""Benchmark: TPC-H Q1 + Q6 + high-NDV group-by through the coprocessor.
+"""Benchmark: TPC-H Q1 + Q6 + Q19 + ROLLUP + high-NDV group-by through
+the coprocessor, with roofline accounting.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
 - value: TPC-H Q1 rows/sec/chip at the LARGEST scale factor that completed
   on the best available platform (TPU preferred), through the full
   CopClient -> shard_map -> fused-kernel -> psum path, warm, median of
   BENCH_ITERS runs.
 - vs_baseline: speedup over a single-core vectorized numpy implementation
-  of the same query on the same host — a *stronger* stand-in for the
-  reference's CPU unistore closure executor (closure_exec.go:468 is a
-  row-group-at-a-time interpreted Go loop), measured live.
+  of the same query on the same host (see BASELINE.md "reference CPU
+  baseline" note: the Go reference is not runnable in this image, and the
+  numpy oracle is a STRONGER stand-in than the reference's interpreted
+  row-group closure executor, closure_exec.go:468).
+- per-rung fields: q6/q19/rollup/high-NDV times + speedups, achieved
+  physical GB/s for Q1+Q6 against a measured host copy-bandwidth roofline
+  (VERDICT r4 #1), and an SF=100 Q6 rung (VERDICT r4 #4).
+- tpu_attempts: summary of TPU_ATTEMPTS.jsonl — the round-long trail of
+  TPU grant probes left by bench_retry.py (VERDICT r4 #9).
 
-Orchestration (VERDICT r2 #1 — the TPU number must land):
+Orchestration:
   1. data pre-generation in a CPU child (no TPU backend touched), cached
      to /tmp, so the TPU budget is spent only on device work;
-  2. a tiny INIT-PROBE child that only calls jax.devices() with its own
-     long timeout — observed axon behavior: a missing TPU grant surfaces
-     as UNAVAILABLE only after ~25-40 min, so the r2 900s timeout killed
-     the child before the verdict; timestamps localize every stage;
+  2. a short INIT-PROBE child: an open axon grant window answers
+     jax.devices() in seconds; a closed one hangs (observed) — waiting
+     ~40 min just to learn "closed" wasted rounds 1-4, so the probe
+     times out at BENCH_PROBE_TIMEOUT (default 300s) and the CPU ladder
+     starts; the round-long retry daemon owns the long game and its
+     BENCH_TPU.json (if it caught a window) is merged into the result;
   3. persistent jax compilation cache so a slow first compile is paid once;
   4. an SF ladder (0.1 -> 1 -> 10): each completed rung rewrites the
      best-so-far result file, so a timeout mid-ladder still reports the
-     largest completed TPU datapoint;
+     largest completed datapoint; the CPU child then adds the SF=100
+     Q6-only rung (generated inline, never pickled);
   5. every stage logs elapsed-time-stamped lines to stderr.
 """
 
@@ -35,11 +45,16 @@ import time
 import numpy as np
 
 T0 = time.time()
+HERE = os.path.dirname(os.path.abspath(__file__))
 DATA_DIR = os.environ.get("BENCH_DATA_DIR", "/tmp/tidb_tpu_bench")
 RESULTS_PATH = os.path.join(DATA_DIR, "results.jsonl")
 CACHE_DIR = os.path.join(DATA_DIR, "jax_cache")
+ATTEMPTS_PATH = os.path.join(HERE, "TPU_ATTEMPTS.jsonl")
+DAEMON_TPU_PATH = os.path.join(HERE, "BENCH_TPU.json")
 COLS_NEEDED = ["l_quantity", "l_extendedprice", "l_discount", "l_tax",
-               "l_returnflag", "l_linestatus", "l_shipdate", "l_partkey"]
+               "l_returnflag", "l_linestatus", "l_shipdate", "l_partkey",
+               "l_shipmode", "l_shipinstruct"]
+SF100_COLS = ["l_quantity", "l_extendedprice", "l_discount", "l_shipdate"]
 
 
 def log(*a):
@@ -78,6 +93,20 @@ def _run_child(env_extra, timeout_s, tag):
         return None, out or b""
 
 
+def _attempts_summary():
+    """Round-long TPU probe trail left by bench_retry.py."""
+    try:
+        lines = [json.loads(ln) for ln in open(ATTEMPTS_PATH) if ln.strip()]
+    except OSError:
+        return {"attempts": 0}
+    grants = [a for a in lines if a.get("outcome") == "granted"]
+    return {"attempts": len([a for a in lines
+                             if a.get("outcome") in ("no-grant", "granted")]),
+            "grants": len(grants),
+            "first_ts": lines[0].get("ts") if lines else None,
+            "last_ts": lines[-1].get("ts") if lines else None}
+
+
 def orchestrate():
     deadline = T0 + float(os.environ.get("BENCH_DEADLINE", "3300"))
     os.makedirs(DATA_DIR, exist_ok=True)
@@ -90,26 +119,21 @@ def orchestrate():
               os.environ.get("BENCH_SF_LADDER", "0.1,1,10").split(",")]
     cpu_only = os.environ.get("JAX_PLATFORMS") == "cpu"
 
-    # 1. pre-generate data (CPU child, no TPU backend) — only the rungs
-    #    we might reach; SF=10 is ~60M rows (~4 GB), generate lazily later
-    pregen = [sf for sf in ladder if sf <= (10 if cpu_only else 1)]
+    # 1. pre-generate data (CPU child, no TPU backend)
     rc, _ = _run_child({"BENCH_MODE": "gen", "JAX_PLATFORMS": "cpu",
-                        "BENCH_SF_LIST": ",".join(str(s) for s in pregen)},
+                        "BENCH_SF_LIST": ",".join(str(s) for s in ladder)},
                        900, "datagen")
     if rc != 0:
         log("datagen child failed; children will generate inline")
 
     best_tpu = None
     if not cpu_only:
-        # 2. init probe with a timeout long enough for axon's UNAVAILABLE
-        #    to surface (~25-40 min observed)
-        probe_t = min(float(os.environ.get("BENCH_PROBE_TIMEOUT", "2400")),
-                      max(deadline - time.time() - 300, 60))
+        probe_t = min(float(os.environ.get("BENCH_PROBE_TIMEOUT", "300")),
+                      max(deadline - time.time() - 600, 60))
         rc, out = _run_child({"BENCH_MODE": "probe"}, probe_t, "tpu-probe")
         if rc == 0:
             log("TPU probe OK:", out.decode().strip())
-            # 3. TPU bench child: SF ladder until deadline
-            bench_t = max(deadline - time.time() - 120, 120)
+            bench_t = max(deadline - time.time() - 420, 120)
             rc, out = _run_child(
                 {"BENCH_MODE": "bench",
                  "BENCH_SF_LADDER": ",".join(str(s) for s in ladder)},
@@ -120,40 +144,72 @@ def orchestrate():
         else:
             log(f"TPU probe failed/timed out (rc={rc}); CPU fallback")
 
-    if best_tpu is not None:
-        print(json.dumps(best_tpu))
-        return 0
+    if best_tpu is None:
+        # daemon-caught TPU window earlier in the round?
+        try:
+            with open(DAEMON_TPU_PATH) as f:
+                daemon = json.load(f)
+            best_tpu = dict(daemon["result"])
+            best_tpu["tpu_from_retry_daemon"] = True
+            log("using TPU rung recorded by bench_retry.py:", best_tpu)
+        except (OSError, KeyError, ValueError):
+            pass
 
-    # 4. CPU fallback — the FULL ladder (r3 pinned this to 0.1 and left
-    #    1746s of budget unused; SF=1/10 engage streaming + shard sizing)
+    # CPU ladder runs regardless when there is remaining budget: the
+    # fallback result, plus the SF=100 rung (cheap on the host path)
     cpu_t = max(deadline - time.time() - 30, 300)
+    child_deadline = time.time() + cpu_t - 30
     rc, out = _run_child({"BENCH_MODE": "bench", "JAX_PLATFORMS": "cpu",
                           "BENCH_SF_LADDER":
-                          ",".join(str(s) for s in ladder)},
+                          ",".join(str(s) for s in ladder),
+                          "BENCH_CHILD_DEADLINE": str(child_deadline)},
                          cpu_t, "cpu-bench")
-    best = _best_result()
-    if best is not None:
-        print(json.dumps(best))
-        return 0
-    sys.stdout.buffer.write(out)
-    return rc if rc is not None else 1
+    best = best_tpu if best_tpu is not None else _best_result()
+    if best is None:
+        sys.stdout.buffer.write(out)
+        return rc if rc is not None else 1
+    cpu_best = _best_result(platform_only="cpu")
+    if best_tpu is not None and cpu_best is not None:
+        best["cpu_fallback"] = {k: v for k, v in cpu_best.items()
+                                if k not in ("metric", "unit")}
+    sf100 = _sf100_result()
+    if sf100 is not None:
+        best["sf100_q6"] = sf100
+    best["tpu_attempts"] = _attempts_summary()
+    best.pop("platform_kept", None)
+    print(json.dumps(best))
+    return 0
 
 
-def _best_result(platform_not=None):
+def _best_result(platform_not=None, platform_only=None):
     """Largest-SF result line recorded by a bench child."""
     try:
         lines = [json.loads(ln) for ln in open(RESULTS_PATH)
                  if ln.strip()]
     except OSError:
         return None
+    lines = [r for r in lines if not r.get("sf100_only")]
     if platform_not is not None:
         lines = [r for r in lines if r.get("platform") != platform_not]
+    if platform_only is not None:
+        lines = [r for r in lines if r.get("platform") == platform_only]
     if not lines:
         return None
-    r = max(lines, key=lambda r: r.get("sf", 0))
-    r.pop("platform", None)
-    r.pop("sf", None)
+    r = dict(max(lines, key=lambda r: r.get("sf", 0)))
     return r
+
+
+def _sf100_result():
+    try:
+        lines = [json.loads(ln) for ln in open(RESULTS_PATH)
+                 if ln.strip()]
+    except OSError:
+        return None
+    for r in reversed(lines):
+        if r.get("sf100_only"):
+            r.pop("sf100_only", None)
+            return r
+    return None
 
 
 # --------------------------------------------------------------------- #
@@ -168,13 +224,24 @@ def _force_platform():
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 
+def _cache_ok(path) -> bool:
+    """A cached pickle from an older bench revision may miss columns the
+    current rungs need — validate before trusting it."""
+    try:
+        with open(path, "rb") as f:
+            names, _cols = pickle.load(f)
+        return set(COLS_NEEDED) <= set(names)
+    except Exception:
+        return False
+
+
 def mode_gen():
     """Generate + cache bench data without touching any TPU backend."""
     _force_platform()
     from tidb_tpu.testing.tpch import gen_lineitem
     for sf in [float(x) for x in os.environ["BENCH_SF_LIST"].split(",")]:
         path = _data_path(sf)
-        if os.path.exists(path):
+        if os.path.exists(path) and _cache_ok(path):
             log(f"sf={sf:g} cache hit")
             continue
         t = time.time()
@@ -204,7 +271,7 @@ def mode_probe():
 
 def _load_data(sf):
     path = _data_path(sf)
-    if os.path.exists(path):
+    if os.path.exists(path) and _cache_ok(path):
         with open(path, "rb") as f:
             return pickle.load(f)
     from tidb_tpu.testing.tpch import gen_lineitem
@@ -242,6 +309,19 @@ def _store_ratio(platform, sf, ratio):
         json.dump(d, f)
 
 
+def _host_copy_bw_gbps():
+    """Measured host memcpy bandwidth — the roofline denominator for the
+    CPU path (a copy touches 2 bytes of traffic per byte of payload)."""
+    buf = np.empty(1 << 28, np.uint8)   # 256 MB
+    buf[:] = 1
+    t = time.time()
+    for _ in range(3):
+        out = buf.copy()
+    dt_ = (time.time() - t) / 3
+    del out
+    return 2 * buf.nbytes / dt_ / 1e9
+
+
 def mode_bench():
     _force_platform()
     import jax
@@ -257,12 +337,166 @@ def mode_bench():
     log(f"platform={platform} devices={n_chips}")
     iters = int(os.environ.get("BENCH_ITERS", "5"))
     ladder = [float(x) for x in os.environ["BENCH_SF_LADDER"].split(",")]
+    mem_bw = _host_copy_bw_gbps() if platform == "cpu" else None
+    if mem_bw:
+        log(f"host copy bandwidth: {mem_bw:.1f} GB/s")
     for sf in ladder:
         log(f"=== SF {sf:g} ===")
-        _bench_one_sf(sf, platform, n_chips, iters)
+        _bench_one_sf(sf, platform, n_chips, iters, mem_bw)
+    deadline = float(os.environ.get("BENCH_CHILD_DEADLINE", "0") or 0)
+    if platform == "cpu" and os.environ.get("BENCH_SF100", "1") != "0":
+        budget = (deadline - time.time()) if deadline else 1e9
+        # inline 600M-row generation alone measured ~900s on the 1-core
+        # host; only start the rung when it can actually finish
+        if budget > 1300:
+            _bench_sf100(platform, mem_bw)
+        else:
+            log(f"skipping SF=100 rung ({budget:.0f}s left < 1300s)")
 
 
-def _bench_one_sf(sf, platform, n_chips, iters):
+def _median_times(fn, iters):
+    ts = []
+    for _ in range(iters):
+        t = time.time()
+        fn()
+        ts.append(time.time() - t)
+    return float(np.median(ts))
+
+
+def _q6_dag(q1_cols, ix1):
+    from tidb_tpu import copr
+    from tidb_tpu.copr import dag as D
+    from tidb_tpu.expr import ColumnRef
+    from tidb_tpu.expr import builders as B
+    from tidb_tpu.types import dtypes as dt
+    r = lambda n: ColumnRef(q1_cols[ix1[n]].dtype, ix1[n], n)
+    scan = D.TableScan(tuple(range(len(q1_cols))),
+                       tuple(c.dtype for c in q1_cols))
+    sel = D.Selection(scan, (
+        B.compare("ge", r("l_shipdate"), B.lit("1994-01-01", dt.date())),
+        B.compare("lt", r("l_shipdate"), B.lit("1995-01-01", dt.date())),
+        B.between(r("l_discount"), B.decimal_lit("0.05"),
+                  B.decimal_lit("0.07")),
+        B.compare("lt", r("l_quantity"), B.decimal_lit("24"))))
+    rev = B.arith("mul", r("l_extendedprice"), r("l_discount"))
+    return D.Aggregation(sel, (),
+                         (copr.AggDesc(copr.AggFunc.SUM, rev,
+                                       copr.sum_out_dtype(rev.dtype)),
+                          copr.AggDesc(copr.AggFunc.COUNT, None,
+                                       dt.bigint(False))),
+                         D.GroupStrategy.SCALAR)
+
+
+# Q19-like predicate-heavy rung (BASELINE config 3): three OR'd
+# conjunctive clauses over quantity ranges x shipmode sets x shipinstruct
+def _q19_clauses(cols, ix):
+    md = cols[ix["l_shipmode"]].dictionary
+    sd = cols[ix["l_shipinstruct"]].dictionary
+    air, regair = md.code_of("AIR"), md.code_of("REG AIR")
+    fob, mail = md.code_of("FOB"), md.code_of("MAIL")
+    ship_, truck = md.code_of("SHIP"), md.code_of("TRUCK")
+    dip = sd.code_of("DELIVER IN PERSON")
+    return (air, regair, fob, mail, ship_, truck, dip)
+
+
+def _q19_dag(cols, ix):
+    from tidb_tpu import copr
+    from tidb_tpu.copr import dag as D
+    from tidb_tpu.expr import ColumnRef, Const
+    from tidb_tpu.expr import builders as B
+    from tidb_tpu.types import dtypes as dt
+    air, regair, fob, mail, ship_, truck, dip = _q19_clauses(cols, ix)
+    r = lambda n: ColumnRef(cols[ix[n]].dtype, ix[n], n)
+    sc = lambda c: Const(cols[ix["l_shipmode"]].dtype, c)
+    qty = r("l_quantity")
+    clause = lambda qlo, qhi, modes: B.logic(
+        "and", B.logic("and",
+                       B.between(qty, B.decimal_lit(str(qlo)),
+                                 B.decimal_lit(str(qhi))),
+                       B.in_list(r("l_shipmode"), [sc(m) for m in modes])),
+        B.compare("eq", r("l_shipinstruct"),
+                  Const(cols[ix["l_shipinstruct"]].dtype, dip)))
+    pred = B.logic("or", B.logic("or",
+                                 clause(1, 11, (air, regair)),
+                                 clause(10, 20, (fob, mail))),
+                   clause(20, 30, (ship_, truck)))
+    scan = D.TableScan(tuple(range(len(cols))),
+                       tuple(c.dtype for c in cols))
+    sel = D.Selection(scan, (pred,))
+    rev = B.arith("mul", r("l_extendedprice"),
+                  B.arith("sub", B.decimal_lit("1"), r("l_discount")))
+    return D.Aggregation(sel, (),
+                         (copr.AggDesc(copr.AggFunc.SUM, rev,
+                                       copr.sum_out_dtype(rev.dtype)),
+                          copr.AggDesc(copr.AggFunc.COUNT, None,
+                                       dt.bigint(False))),
+                         D.GroupStrategy.SCALAR)
+
+
+def np_q19(cols, ix):
+    air, regair, fob, mail, ship_, truck, dip = _q19_clauses(cols, ix)
+    qty = cols[ix["l_quantity"]].data
+    mode = cols[ix["l_shipmode"]].data
+    inst = cols[ix["l_shipinstruct"]].data
+    price = cols[ix["l_extendedprice"]].data
+    disc = cols[ix["l_discount"]].data
+    c1 = (qty >= 100) & (qty <= 1100) & ((mode == air) | (mode == regair))
+    c2 = (qty >= 1000) & (qty <= 2000) & ((mode == fob) | (mode == mail))
+    c3 = (qty >= 2000) & (qty <= 3000) & ((mode == ship_) | (mode == truck))
+    m = (c1 | c2 | c3) & (inst == dip)
+    return int((price[m].astype(np.int64) * (100 - disc[m])).sum()), int(m.sum())
+
+
+def _rollup_dag(cols, ix):
+    from tidb_tpu import copr
+    from tidb_tpu.copr import dag as D
+    from tidb_tpu.expr import ColumnRef
+    from tidb_tpu.types import dtypes as dt
+    rf = ColumnRef(cols[ix["l_returnflag"]].dtype, ix["l_returnflag"], "rf")
+    ls = ColumnRef(cols[ix["l_linestatus"]].dtype, ix["l_linestatus"], "ls")
+    qty = ColumnRef(cols[ix["l_quantity"]].dtype, ix["l_quantity"], "qty")
+    scan = D.TableScan(tuple(range(len(cols))),
+                       tuple(c.dtype for c in cols))
+    n_base = len(cols)
+    ex = D.Expand(scan, (rf, ls), 3)
+    krf = ColumnRef(rf.dtype.with_nullable(True), n_base, "rf")
+    kls = ColumnRef(ls.dtype.with_nullable(True), n_base + 1, "ls")
+    gid = ColumnRef(dt.bigint(False), n_base + 2, "gid")
+    agg = D.Aggregation(ex, (krf, kls, gid),
+                        (copr.AggDesc(copr.AggFunc.SUM, qty,
+                                      copr.sum_out_dtype(qty.dtype)),
+                         copr.AggDesc(copr.AggFunc.COUNT, None,
+                                      dt.bigint(False))),
+                        D.GroupStrategy.SORT, group_capacity=64)
+    from tidb_tpu.copr.aggregate import GroupKeyMeta
+    meta = [GroupKeyMeta(krf.dtype, 0, cols[ix["l_returnflag"]].dictionary),
+            GroupKeyMeta(kls.dtype, 0, cols[ix["l_linestatus"]].dictionary),
+            GroupKeyMeta(gid.dtype, 0)]
+    return agg, meta
+
+
+def np_rollup(cols, ix):
+    """Oracle: grouping-sets counts/sums over (returnflag, linestatus)."""
+    rf = cols[ix["l_returnflag"]].data.astype(np.int64)
+    ls = cols[ix["l_linestatus"]].data.astype(np.int64)
+    qty = cols[ix["l_quantity"]].data
+    gid2 = rf * 2 + ls
+    out = {}
+    c2 = np.bincount(gid2, minlength=6)
+    s2 = np.bincount(gid2, weights=qty.astype(np.float64), minlength=6)
+    for g in range(6):
+        if c2[g]:
+            out[(g // 2, g % 2, 0)] = (int(s2[g]), int(c2[g]))
+    c1 = np.bincount(rf, minlength=3)
+    s1 = np.bincount(rf, weights=qty.astype(np.float64), minlength=3)
+    for g in range(3):
+        if c1[g]:
+            out[(g, None, 1)] = (int(s1[g]), int(c1[g]))
+    out[(None, None, 2)] = (int(qty.sum()), len(qty))
+    return out
+
+
+def _bench_one_sf(sf, platform, n_chips, iters, mem_bw):
     import jax
 
     from __graft_entry__ import _q1_dag
@@ -270,7 +504,6 @@ def _bench_one_sf(sf, platform, n_chips, iters):
     from tidb_tpu.copr import dag as D
     from tidb_tpu.copr.aggregate import GroupKeyMeta
     from tidb_tpu.expr import ColumnRef
-    from tidb_tpu.expr import builders as B
     from tidb_tpu.parallel.mesh import get_mesh
     from tidb_tpu.store import CopClient, snapshot_from_columns
     from tidb_tpu.types import dtypes as dt
@@ -283,18 +516,18 @@ def _bench_one_sf(sf, platform, n_chips, iters):
     log(f"rows={n_rows} shards={n_shards}")
 
     mesh = get_mesh()
-    q1_cols = [c for i, c in enumerate(cols) if names[i] != "l_partkey"]
-    q1_names = [n for n in names if n != "l_partkey"]
+    q1_names = [n for n in names if n not in
+                ("l_partkey", "l_shipmode", "l_shipinstruct")]
+    q1_cols = [cols[ix[n]] for n in q1_names]
+    ix1 = {n: i for i, n in enumerate(q1_names)}
     snap = snapshot_from_columns(q1_names, q1_cols, n_shards=n_shards)
     client = CopClient(mesh)
     # the bench measures ENGINE throughput: identical repeated dispatches
     # must not short-circuit through the coprocessor result cache
     client._result_cache_cap = 0
-    # tables beyond the HBM budget stream in double-buffered batches
     cap = int(os.environ.get("BENCH_DEVICE_MEM_CAP", "0") or 0)
     # CPU fallback caps at 2 GiB so the SF=10 rung exercises the HBM
-    # streaming path (double-buffered row batches) instead of one resident
-    # table — the memory behavior the TPU path depends on
+    # streaming path when the host engine choice does not intercept
     client.device_mem_cap = cap or (12 << 30 if platform != "cpu"
                                     else 2 << 30)
     if snap.row_batches(client.device_mem_cap):
@@ -304,13 +537,11 @@ def _bench_one_sf(sf, platform, n_chips, iters):
     t = time.time()
     res = client.execute_agg(agg, snap, meta)   # warmup: compile + H2D
     log(f"Q1 warmup (compile+transfer) {time.time()-t:.1f}s")
-    ix1 = {n: i for i, n in enumerate(q1_names)}
 
     def _measure_q1():
         """Interleave engine and numpy-baseline runs so transient host
-        contention (the r3 artifact recorded 157ms/0.45x while a dying
-        probe child thrashed the 1-core container) hits both equally;
-        the ratio of medians is contention-fair."""
+        contention hits both equally; the ratio of medians is
+        contention-fair."""
         et, bt = [], []
         for _ in range(iters):
             t = time.time()
@@ -322,14 +553,11 @@ def _bench_one_sf(sf, platform, n_chips, iters):
         return et, bt
 
     et, bt = _measure_q1()
-    # variance gate 1: noisy engine timings -> one re-measure
     if len(et) >= 3 and float(np.std(et)) > 0.5 * float(np.median(et)):
         log(f"Q1 timing CV high ({np.std(et)/np.median(et):.2f}); re-measuring")
         et, bt = _measure_q1()
     q1_t = float(np.median(et))
     b1 = float(np.median(bt))
-    # variance gate 2: implausible shift vs the last recorded ratio for
-    # this (platform, sf) -> re-measure once and trust the fresh run
     prior = _load_ratio(platform, sf)
     if prior is not None and not (0.5 <= (b1 / q1_t) / prior <= 2.0):
         log(f"Q1 ratio {b1/q1_t:.2f}x shifted >2x from prior {prior:.2f}x; "
@@ -339,8 +567,11 @@ def _bench_one_sf(sf, platform, n_chips, iters):
         b1 = float(np.median(bt))
     _store_ratio(platform, sf, b1 / q1_t)
     q1_rps = n_rows / q1_t / n_chips
+    # physical bytes: Q1 touches every q1 column at narrow width
+    q1_bytes = sum(c.narrowed().dtype.itemsize for c in q1_cols) * n_rows
     log(f"Q1: {q1_t*1e3:.1f} ms  {q1_rps/1e6:.1f} M rows/s/chip "
-        f"({n_chips} chips)  numpy {b1*1e3:.1f} ms  ratio {b1/q1_t:.2f}x")
+        f"({n_chips} chips)  numpy {b1*1e3:.1f} ms  ratio {b1/q1_t:.2f}x  "
+        f"{q1_bytes/q1_t/1e9:.1f} GB/s")
 
     # correctness spot-check vs numpy
     exp = np_q1(q1_cols, ix1)
@@ -348,35 +579,61 @@ def _bench_one_sf(sf, platform, n_chips, iters):
     got_counts = sorted(int(c) for c in res.columns[-1].data)
     assert got_counts == sorted(v[4] for v in exp.values()), "Q1 mismatch"
 
-    # Q6
-    r = lambda n: ColumnRef(q1_cols[ix1[n]].dtype, ix1[n], n)
-    scan = D.TableScan(tuple(range(len(q1_names))),
-                       tuple(c.dtype for c in q1_cols))
-    sel = D.Selection(scan, (
-        B.compare("ge", r("l_shipdate"), B.lit("1994-01-01", dt.date())),
-        B.compare("lt", r("l_shipdate"), B.lit("1995-01-01", dt.date())),
-        B.between(r("l_discount"), B.decimal_lit("0.05"),
-                  B.decimal_lit("0.07")),
-        B.compare("lt", r("l_quantity"), B.decimal_lit("24"))))
-    rev = B.arith("mul", r("l_extendedprice"), r("l_discount"))
-    q6 = D.Aggregation(sel, (),
-                       (copr.AggDesc(copr.AggFunc.SUM, rev,
-                                     copr.sum_out_dtype(rev.dtype)),
-                        copr.AggDesc(copr.AggFunc.COUNT, None,
-                                     dt.bigint(False))),
-                       D.GroupStrategy.SCALAR)
+    # ---- Q6 ---- #
+    q6 = _q6_dag(q1_cols, ix1)
     res6 = client.execute_agg(q6, snap, [])
-    times = []
-    for _ in range(iters):
-        t = time.time()
-        res6 = client.execute_agg(q6, snap, [])
-        times.append(time.time() - t)
-    q6_t = float(np.median(times))
-    log(f"Q6: {q6_t*1e3:.1f} ms  {n_rows/q6_t/1e6:.1f} M rows/s")
     exp_rev, exp_cnt = np_q6(cols, ix)
+    assert int(res6.columns[0].data[0]) == exp_rev, "Q6 sum mismatch"
     assert int(res6.columns[1].data[0]) == exp_cnt, "Q6 count mismatch"
+    q6_t = _median_times(lambda: client.execute_agg(q6, snap, []), iters)
+    b6 = _median_times(lambda: np_q6(cols, ix), max(iters // 2, 2))
+    q6_cols = ("l_shipdate", "l_discount", "l_quantity", "l_extendedprice")
+    q6_bytes = sum(cols[ix[n]].narrowed().dtype.itemsize
+                   for n in q6_cols) * n_rows
+    log(f"Q6: {q6_t*1e3:.1f} ms ({n_rows/q6_t/1e6:.0f} M rows/s)  numpy "
+        f"{b6*1e3:.1f} ms  ratio {b6/q6_t:.2f}x  {q6_bytes/q6_t/1e9:.1f} GB/s")
 
-    # high-NDV group-by (SORT strategy / host unique path per platform)
+    # ---- Q19 predicate rung ---- #
+    q19_names = ["l_quantity", "l_extendedprice", "l_discount",
+                 "l_shipmode", "l_shipinstruct"]
+    q19_cols = [cols[ix[n]] for n in q19_names]
+    ix19 = {n: i for i, n in enumerate(q19_names)}
+    snap19 = snapshot_from_columns(q19_names, q19_cols, n_shards=n_shards)
+    q19 = _q19_dag(q19_cols, ix19)
+    res19 = client.execute_agg(q19, snap19, [])
+    e_rev, e_cnt = np_q19(q19_cols, ix19)
+    assert int(res19.columns[0].data[0]) == e_rev, "Q19 sum mismatch"
+    assert int(res19.columns[1].data[0]) == e_cnt, "Q19 count mismatch"
+    q19_t = _median_times(lambda: client.execute_agg(q19, snap19, []), iters)
+    b19 = _median_times(lambda: np_q19(q19_cols, ix19), max(iters // 2, 2))
+    log(f"Q19: {q19_t*1e3:.1f} ms  numpy {b19*1e3:.1f} ms  "
+        f"ratio {b19/q19_t:.2f}x")
+
+    # ---- ROLLUP (grouping sets / Expand) rung ---- #
+    ru_names = ["l_returnflag", "l_linestatus", "l_quantity"]
+    ru_cols = [cols[ix[n]] for n in ru_names]
+    ixr = {n: i for i, n in enumerate(ru_names)}
+    snapr = snapshot_from_columns(ru_names, ru_cols, n_shards=n_shards)
+    ragg, rmeta = _rollup_dag(ru_cols, ixr)
+    resr = client.execute_agg(ragg, snapr, rmeta)
+    expr_ = np_rollup(ru_cols, ixr)
+    got = {}
+    kc = resr.key_columns
+    for i in range(len(kc[0])):
+        key = (int(kc[0].data[i]) if kc[0].validity[i] else None,
+               int(kc[1].data[i]) if kc[1].validity[i] else None,
+               int(kc[2].data[i]))
+        got[key] = (int(resr.columns[0].data[i]),
+                    int(resr.columns[1].data[i]))
+    assert got == expr_, "ROLLUP mismatch"
+    ru_t = _median_times(lambda: client.execute_agg(ragg, snapr, rmeta),
+                         max(iters // 2, 2))
+    bru = _median_times(lambda: np_rollup(ru_cols, ixr),
+                        max(iters // 2, 2))
+    log(f"ROLLUP: {ru_t*1e3:.1f} ms  numpy {bru*1e3:.1f} ms  "
+        f"ratio {bru/ru_t:.2f}x")
+
+    # ---- high-NDV group-by ---- #
     pk = cols[ix["l_partkey"]]
     hsnap = snapshot_from_columns(["l_partkey"], [pk], n_shards=n_shards)
     pk_ref = ColumnRef(pk.dtype, 0, "l_partkey")
@@ -387,12 +644,9 @@ def _bench_one_sf(sf, platform, n_chips, iters):
         D.GroupStrategy.SORT,
         group_capacity=max(1024, 1 << (ndv_est - 1).bit_length()))
     resh = client.execute_agg(hagg, hsnap, [GroupKeyMeta(pk.dtype, 0)])
-    times = []
-    for _ in range(max(iters // 2, 1)):
-        t = time.time()
-        resh = client.execute_agg(hagg, hsnap, [GroupKeyMeta(pk.dtype, 0)])
-        times.append(time.time() - t)
-    hndv_t = float(np.median(times))
+    hndv_t = _median_times(
+        lambda: client.execute_agg(hagg, hsnap, [GroupKeyMeta(pk.dtype, 0)]),
+        max(iters // 2, 1))
     t = time.time()
     uk, ucnt = np.unique(pk.data, return_counts=True)
     np_ndv_t = time.time() - t
@@ -403,20 +657,73 @@ def _bench_one_sf(sf, platform, n_chips, iters):
         f"({n_rows/hndv_t/1e6:.1f} M rows/s)  numpy oracle: "
         f"{np_ndv_t*1e3:.1f} ms  speedup {np_ndv_t/hndv_t:.2f}x")
 
-    # CPU baseline Q6 (Q1 baseline measured interleaved above)
-    t = time.time(); np_q6(cols, ix); b6 = time.time() - t
-    log(f"numpy 1-core Q1: {b1*1e3:.1f} ms ({n_rows/b1/1e6:.1f} M rows/s)  "
-        f"Q6: {b6*1e3:.1f} ms")
-
-    _record({
+    rec = {
         "metric": f"tpch_q1_sf{sf:g}_rows_per_sec_per_chip",
         "value": round(q1_rps, 1),
         "unit": "rows/s",
         "vs_baseline": round(b1 / q1_t, 2),
         "platform": platform,
         "sf": sf,
-    })
+        "q1_ms": round(q1_t * 1e3, 1),
+        "q1_gbps_phys": round(q1_bytes / q1_t / 1e9, 2),
+        "q6_ms": round(q6_t * 1e3, 1),
+        "q6_vs_numpy": round(b6 / q6_t, 2),
+        "q6_gbps_phys": round(q6_bytes / q6_t / 1e9, 2),
+        "q19_ms": round(q19_t * 1e3, 1),
+        "q19_vs_numpy": round(b19 / q19_t, 2),
+        "rollup_ms": round(ru_t * 1e3, 1),
+        "rollup_vs_numpy": round(bru / ru_t, 2),
+        "hndv_ms": round(hndv_t * 1e3, 1),
+        "hndv_vs_numpy": round(np_ndv_t / hndv_t, 2),
+        "hndv_groups": int(len(uk)),
+    }
+    if mem_bw:
+        rec["mem_bw_gbps"] = round(mem_bw, 1)
+        rec["q1_roofline_frac"] = round(q1_bytes / q1_t / 1e9 / mem_bw, 3)
+        rec["q6_roofline_frac"] = round(q6_bytes / q6_t / 1e9 / mem_bw, 3)
+    _record(rec)
     log(f"SF {sf:g} result recorded")
+
+
+def _bench_sf100(platform, mem_bw):
+    """SF=100 Q6-only rung (BASELINE config 4 scale): 600M rows generated
+    inline (4 columns, never pickled), aggregated through the engine."""
+    from tidb_tpu.parallel.mesh import get_mesh
+    from tidb_tpu.store import CopClient, snapshot_from_columns
+    from tidb_tpu.testing.tpch import gen_lineitem
+    log("=== SF 100 (Q6 only) ===")
+    t = time.time()
+    names, cols = gen_lineitem(sf=100, columns=SF100_COLS)
+    n_rows = len(cols[0])
+    log(f"generated inline: {n_rows} rows in {time.time()-t:.1f}s")
+    ix = {n: i for i, n in enumerate(names)}
+    snap = snapshot_from_columns(names, cols, n_shards=64)
+    client = CopClient(get_mesh())
+    client._result_cache_cap = 0
+    q6 = _q6_dag(cols, ix)
+    t = time.time()
+    res = client.execute_agg(q6, snap, [])
+    log(f"Q6 warmup {time.time()-t:.1f}s")
+    exp_rev, exp_cnt = np_q6(cols, ix)
+    assert int(res.columns[0].data[0]) == exp_rev, "SF100 Q6 sum mismatch"
+    assert int(res.columns[1].data[0]) == exp_cnt, "SF100 Q6 count mismatch"
+    q6_t = _median_times(lambda: client.execute_agg(q6, snap, []), 3)
+    b6 = _median_times(lambda: np_q6(cols, ix), 2)
+    rec = {
+        "sf100_only": True,
+        "platform": platform,
+        "rows": n_rows,
+        "q6_ms": round(q6_t * 1e3, 1),
+        "q6_rows_per_sec": round(n_rows / q6_t, 1),
+        "q6_vs_numpy": round(b6 / q6_t, 2),
+    }
+    q6_bytes = sum(c.narrowed().dtype.itemsize for c in cols) * n_rows
+    rec["q6_gbps_phys"] = round(q6_bytes / q6_t / 1e9, 2)
+    if mem_bw:
+        rec["q6_roofline_frac"] = round(q6_bytes / q6_t / 1e9 / mem_bw, 3)
+    log(f"SF100 Q6: {q6_t*1e3:.0f} ms  numpy {b6*1e3:.0f} ms  "
+        f"ratio {b6/q6_t:.2f}x")
+    _record(rec)
 
 
 def np_q1(cols, ix):
@@ -447,7 +754,7 @@ def np_q6(cols, ix):
     price = cols[ix["l_extendedprice"]].data
     m = ((ship >= 8766) & (ship < 9131) & (disc >= 5) & (disc <= 7)
          & (qty < 2400))
-    return int((price[m] * disc[m]).sum()), int(m.sum())
+    return int((price[m].astype(np.int64) * disc[m]).sum()), int(m.sum())
 
 
 if __name__ == "__main__":

@@ -259,8 +259,8 @@ def test_topn_extreme_key_values():
 
 
 def test_decimal_sum_widens_past_18_digits():
-    # SUM(DECIMAL(18,0)) widens to DECIMAL(38,0) (MySQL p+22 rule): a total
-    # past 18 digits is exact, not an overflow
+    # SUM(DECIMAL(18,0)) widens to DECIMAL(40,0) (MySQL min(p+22,65)
+    # rule): a total past 18 digits is exact, not an overflow
     big = 10**17
     c = Column.from_numpy(dt.decimal(18, 0), np.full(20, big))
     scan = D.TableScan((0,), (dt.decimal(18, 0),))
@@ -273,19 +273,20 @@ def test_decimal_sum_widens_past_18_digits():
     merged = copr.merge_states([states])
     _, agg_cols = copr.finalize(agg, merged, [])
     assert agg_cols[0].to_python()[0] == 20 * big
-    assert agg_cols[0].dtype.prec == dt.DECIMAL_MAX_PRECISION
+    assert agg_cols[0].dtype.prec == 18 + 22
 
 
-def test_decimal_sum_overflow_past_38_digits_raises():
+def test_decimal_sum_overflow_past_result_precision_raises():
     import pytest
     scan = D.TableScan((0,), (dt.decimal(18, 0),))
     agg = D.Aggregation(scan, (), (D.AggDesc(
         D.AggFunc.SUM, ColumnRef(dt.decimal(18, 0), 0),
         copr.sum_out_dtype(dt.decimal(18, 0))),), D.GroupStrategy.SCALAR)
-    # fabricate merged limb states whose recombined total exceeds 38 digits
+    # fabricate merged limb states whose recombined total exceeds the
+    # declared DECIMAL(40,0) result precision
     merged = {"__rows__": np.array([1], object),
-              "a0": {"hi": np.array([(10**38) >> 32], object),
-                     "lo": np.array([(10**38) & 0xFFFFFFFF], object),
+              "a0": {"hi": np.array([(10**41) >> 32], object),
+                     "lo": np.array([(10**41) & 0xFFFFFFFF], object),
                      "cnt": np.array([1], object)}}
     with pytest.raises(OverflowError):
         copr.finalize(agg, merged, [])

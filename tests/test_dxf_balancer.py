@@ -1,0 +1,88 @@
+"""DXF balancer: multi-node ADD INDEX backfill that survives node loss.
+
+VERDICT r4 #8 / reference pkg/disttask/framework/doc.go:15-80: spread a
+reorg's subtasks across >=2 store processes, kill one mid-reorg, and
+prove the subtasks rebalance onto survivors and the index is complete.
+"""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.dxf.balancer import DXFNodeError, DXFNodePool
+from tidb_tpu.session import Domain, Session
+from tidb_tpu.store.remote import RemoteCluster
+
+N_ROWS = 3000
+
+
+@pytest.fixture()
+def cluster():
+    c = RemoteCluster(n_stores=3)
+    yield c
+    c.close()
+
+
+def _mk_session(pool):
+    s = Session(Domain())
+    s.domain.dxf_pool = pool
+    s.execute("create table b (k bigint primary key, v bigint, "
+              "w varchar(8))")
+    rng = np.random.default_rng(11)
+    rows = ",".join(
+        f"({i}, {int(rng.integers(0, 500))}, "
+        f"'{['aa', 'bb', 'cc'][int(rng.integers(0, 3))]}')"
+        for i in range(N_ROWS))
+    s.execute("insert into b values " + rows)
+    return s
+
+
+def _check_index_complete(s, name="iv"):
+    """Every row must have exactly one index entry (ADMIN CHECK TABLE
+    re-derives entries from rows; the raw count catches duplicates)."""
+    from tidb_tpu.store.codec import index_prefix, index_prefix_end
+    tbl = s.domain.catalog.get_table("test", "b")
+    ix = tbl.index_by_name(name)
+    assert ix is not None and ix.state == "public"
+    ts = tbl.kv.alloc_ts()
+    n = sum(1 for _ in tbl.kv.scan(
+        index_prefix(tbl.table_id, ix.index_id),
+        index_prefix_end(tbl.table_id, ix.index_id), ts))
+    assert n == N_ROWS, n
+    s.execute("admin check table b")
+
+
+def test_distributed_backfill_across_nodes(cluster):
+    pool = DXFNodePool(cluster.stores)
+    s = _mk_session(pool)
+    s.execute("alter table b add index iv (v)")
+    _check_index_complete(s)
+    # every node actually took subtasks (balanced spread)
+    counts = [pool.per_node[st.store_id] for st in cluster.stores]
+    assert all(c > 0 for c in counts), counts
+    assert sum(counts) >= N_ROWS // 512
+
+
+def test_backfill_survives_node_loss(cluster):
+    pool = DXFNodePool(cluster.stores)
+    s = _mk_session(pool)
+    # store 0 dies after serving 2 more requests — mid-reorg
+    cluster.stores[0].request(("fail_after", 2))
+    s.execute("alter table b add index iv (v)")
+    _check_index_complete(s)
+    assert cluster.stores[0].store_id in pool.dead
+    assert pool.rebalanced >= 1
+    # the dead node's share was picked up by survivors
+    survivors = [pool.per_node[st.store_id] for st in cluster.stores[1:]]
+    assert sum(survivors) > 0
+
+
+def test_all_nodes_dead_fails_cleanly(cluster):
+    pool = DXFNodePool(cluster.stores)
+    s = _mk_session(pool)
+    for st in cluster.stores:
+        st.request(("fail_after", 1))
+    with pytest.raises(Exception):
+        s.execute("alter table b add index iv (v)")
+    # failed reorg must roll the index back out of the schema
+    tbl = s.domain.catalog.get_table("test", "b")
+    assert tbl.index_by_name("iv") is None

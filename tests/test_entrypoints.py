@@ -60,5 +60,5 @@ def test_bench_emits_json_even_when_default_backend_hangs():
     assert out.returncode == 0, (out.stdout, out.stderr[-2000:])
     line = [l for l in out.stdout.splitlines() if l.strip().startswith("{")][-1]
     rec = json.loads(line)
-    assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
+    assert {"metric", "value", "unit", "vs_baseline"} <= set(rec)
     assert rec["value"] > 0

@@ -1,0 +1,117 @@
+"""Wide DECIMAL (19-65 digits): python-int object columns on the host.
+
+Reference analog: pkg/types/mydecimal.go:47 (9-digit-word representation,
+65-digit max).  The TPU engine keeps <=18-digit decimals in the scaled-
+int64 device representation; 19-65 digits become host-only object arrays
+— exact at any magnitude, never device-fused (VERDICT r4 #6: silent
+truncation at 18 digits was the trap this closes).
+"""
+
+import decimal as pydec
+from decimal import Decimal
+
+import pytest
+
+from tidb_tpu.session import Session
+
+# the TESTS themselves need wide arithmetic: python's default Decimal
+# context rounds to 28 significant digits
+pydec.getcontext().prec = 96
+
+BIG = "12345678901234567890.1234567891"          # 30 digits
+NEG = "-99999999999999999999.9999999999"
+
+
+@pytest.fixture
+def sess():
+    s = Session()
+    s.execute("CREATE TABLE w (id INT, v DECIMAL(30,10), n DECIMAL(8,2))")
+    s.execute(f"INSERT INTO w VALUES (1, {BIG}, 10.25), (2, {NEG}, 3.50), "
+              "(3, NULL, 1.00)")
+    return s
+
+
+def test_round_trip_exact(sess):
+    got = sess.execute("SELECT v FROM w ORDER BY id").rows
+    assert got[0][0] == Decimal(BIG)
+    assert got[1][0] == Decimal(NEG)
+    assert got[2][0] is None
+
+
+def test_aggregates_exact(sess):
+    row = sess.execute(
+        "SELECT SUM(v), MIN(v), MAX(v), COUNT(v), AVG(v) FROM w").rows[0]
+    assert row[0] == Decimal(BIG) + Decimal(NEG)
+    assert row[1] == Decimal(NEG)
+    assert row[2] == Decimal(BIG)
+    assert row[3] == 2
+    # AVG = SUM/COUNT at scale+4
+    assert abs(row[4] - (Decimal(BIG) + Decimal(NEG)) / 2) < Decimal("1e-9")
+
+
+def test_arithmetic_exact(sess):
+    row = sess.execute("SELECT v + n, v - n, v * 2 FROM w WHERE id=1").rows[0]
+    assert row[0] == Decimal(BIG) + Decimal("10.25")
+    assert row[1] == Decimal(BIG) - Decimal("10.25")
+    assert row[2] == Decimal(BIG) * 2
+
+
+def test_comparisons_and_where(sess):
+    assert sess.execute("SELECT id FROM w WHERE v > 0").rows == [(1,)]
+    assert sess.execute("SELECT id FROM w WHERE v < 0").rows == [(2,)]
+    assert sess.execute(
+        f"SELECT id FROM w WHERE v = {BIG}").rows == [(1,)]
+
+
+def test_cast_matrix(sess):
+    # wide -> wide (narrower scale): rounds
+    r = sess.execute("SELECT CAST(v AS DECIMAL(35,2)) FROM w WHERE id=1")
+    assert r.rows[0][0] == Decimal("12345678901234567890.12")
+    # narrow -> wide: widens exactly
+    r = sess.execute("SELECT CAST(n AS DECIMAL(30,10)) FROM w WHERE id=1")
+    assert r.rows[0][0] == Decimal("10.2500000000")
+    # literal -> wide
+    r = sess.execute("SELECT CAST(1.5 AS DECIMAL(30,10))")
+    assert r.rows[0][0] == Decimal("1.5000000000")
+    # wide value into a too-small target: ER_DATA_OUT_OF_RANGE analog
+    with pytest.raises(Exception):
+        sess.execute("SELECT CAST(v AS DECIMAL(10,2)) FROM w WHERE id=1")
+
+
+def test_precision_limits():
+    s = Session()
+    with pytest.raises(Exception):
+        s.execute("CREATE TABLE bad (x DECIMAL(70,2))")
+    with pytest.raises(Exception):
+        s.execute("CREATE TABLE bad2 (x DECIMAL(40,35))")   # scale > 30
+    # 65 digits is accepted (MySQL max)
+    s.execute("CREATE TABLE ok (x DECIMAL(65,0))")
+    v = 10 ** 64 - 1
+    s.execute(f"INSERT INTO ok VALUES ({v})")
+    assert s.execute("SELECT x FROM ok").rows[0][0] == Decimal(v)
+
+
+def test_group_by_narrow_key_wide_value():
+    s = Session()
+    s.execute("CREATE TABLE g (k INT, v DECIMAL(25,5))")
+    s.execute("INSERT INTO g VALUES (1, 11111111111111111111.5), "
+              "(1, 0.5), (2, 22222222222222222222.25)")
+    rows = sorted(s.execute(
+        "SELECT k, SUM(v), MAX(v) FROM g GROUP BY k").rows)
+    assert rows[0][0] == 1
+    assert rows[0][1] == Decimal("11111111111111111112.00000")
+    assert rows[1][2] == Decimal("22222222222222222222.25000")
+
+
+def test_order_by_wide(sess):
+    got = [r[0] for r in sess.execute(
+        "SELECT id FROM w WHERE v IS NOT NULL ORDER BY v DESC").rows]
+    assert got == [1, 2]
+
+
+def test_update_and_delete_wide(sess):
+    sess.execute(f"UPDATE w SET v = v + 1 WHERE id = 1")
+    r = sess.execute("SELECT v FROM w WHERE id=1").rows[0][0]
+    assert r == Decimal(BIG) + 1
+    sess.execute("DELETE FROM w WHERE v < 0")
+    assert sess.execute("SELECT COUNT(*) FROM w").rows[0][0] == 2

@@ -60,6 +60,8 @@ DEVICE_OPS = {
 
 
 def _device_supported(e: Expr) -> bool:
+    if e.dtype is not None and getattr(e.dtype, "is_wide_decimal", False):
+        return False     # 19-65 digit decimals are host object arrays
     if isinstance(e, Func):
         if e.op not in DEVICE_OPS:
             return False
@@ -1541,13 +1543,19 @@ class HostAgg(PhysOp):
                 pcols.append(cnt_col)
             elif a.func in (D.AggFunc.MIN, D.AggFunc.MAX):
                 isf = a.arg.dtype.is_float
-                init = self._mm_init(a, isf)
-                # partials accumulate in WIDE (int64/float64) space: the
-                # ±extreme init values do not fit narrow code dtypes
-                # (int32 string/date codes would wrap to -1)
-                out = np.full(g, init, np.float64 if isf else np.int64)
+                iso = c.data.dtype == np.dtype(object)
+                init = self._mm_init(a, isf or iso)
+                # partials accumulate in WIDE (int64/float64/object) space:
+                # the ±extreme init values do not fit narrow code dtypes
+                # (int32 string/date codes would wrap to -1); wide decimals
+                # keep python ints with ±inf float sentinels
+                out = np.full(g, init,
+                              object if iso else
+                              (np.float64 if isf else np.int64))
                 op = np.minimum if a.func == D.AggFunc.MIN else np.maximum
-                op.at(out, inverse[valid], c.data[valid].astype(out.dtype))
+                vals = c.data[valid] if iso \
+                    else c.data[valid].astype(out.dtype)
+                op.at(out, inverse[valid], vals)
                 # invalid rows keep the ±inf init so merges stay neutral
                 pcols.append(Column(c.dtype, out, cnt > 0, c.dictionary))
                 pcols.append(cnt_col)
@@ -1599,7 +1607,8 @@ class HostAgg(PhysOp):
                                           c.data))
                 else:   # min / max
                     isf = c.data.dtype.kind == "f"
-                    init = self._mm_init(a, isf)
+                    init = self._mm_init(a, isf
+                                         or c.data.dtype.kind == "O")
                     out = np.full(g, init, c.data.dtype)
                     op = (np.minimum if a.func == D.AggFunc.MIN
                           else np.maximum)

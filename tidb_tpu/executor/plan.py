@@ -168,11 +168,14 @@ def to_physical(p: LogicalPlan, no_device_join: bool = False) -> PhysOp:
             return MemTableExec(p.table, list(p.col_offsets),
                                 out_names=p.schema.names(),
                                 out_dtypes=[c.dtype for c in p.schema.cols])
-        if HOST_ONLY.get():
+        if HOST_ONLY.get() or not _scan_device_ok(p):
             if getattr(p, "as_of_ts", None) is not None:
                 from ..planner.build import PlanError
-                raise PlanError("AS OF TIMESTAMP is not supported inside "
-                                "correlated subqueries")
+                if HOST_ONLY.get():
+                    raise PlanError("AS OF TIMESTAMP is not supported "
+                                    "inside correlated subqueries")
+                raise PlanError("AS OF TIMESTAMP is not supported on "
+                                "tables with wide DECIMAL columns")
             from .physical import HostTableScanExec
             return HostTableScanExec(p.table, list(p.col_offsets),
                                      out_names=p.schema.names(),
@@ -254,6 +257,13 @@ def _try_index_ordered_topn(p) -> Optional[PhysOp]:
     return None
 
 
+
+def _scan_device_ok(ds) -> bool:
+    """Wide (19-65 digit) decimal columns are host object arrays and can
+    never be stacked into device shards."""
+    return not any(getattr(c.dtype, "is_wide_decimal", False)
+                   for c in ds.schema.cols)
+
 def _try_cop(p: LogicalPlan, no_device_join: bool = False) -> Optional[PhysOp]:
     """Fuse the subtree rooted at p into one CopTask if possible."""
     if HOST_ONLY.get():
@@ -315,6 +325,8 @@ def _try_cop(p: LogicalPlan, no_device_join: bool = False) -> Optional[PhysOp]:
             dicts[i] = c.dictionary
 
     # bind + lower the chain bottom-up
+    if not _scan_device_ok(ds):
+        return None
     node: D.CopNode = D.TableScan(tuple(ds.col_offsets),
                                   tuple(c.dtype for c in ds.schema.cols))
     cur_dicts = dict(dicts)
@@ -942,6 +954,8 @@ def _bind_scan_chain(plan: LogicalPlan):
         c = snap.columns[off]
         if c.dictionary is not None:
             cur_dicts[i] = c.dictionary
+    if not _scan_device_ok(ds):
+        return None
     node: D.CopNode = D.TableScan(tuple(ds.col_offsets),
                                   tuple(c.dtype for c in ds.schema.cols))
     for m in reversed(mids):

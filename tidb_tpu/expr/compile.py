@@ -203,6 +203,13 @@ class Evaluator:
                 else:
                     x = x.astype(self.xp.int64)
                 return (y, x) if flip else (x, y)
+            # int64 array vs a beyond-64-bit python literal (wide decimal
+            # rescales): numpy would raise; compare in exact object ints
+            d = getattr(x, "dtype", None)
+            if d is not None and d.kind in "iu" and isinstance(y, int) \
+                    and not (-2 ** 63 <= y < 2 ** 64):
+                x = x.astype(object)
+                return (y, x) if flip else (x, y)
         return va, vb
 
     def _to_common(self, e: Func, cols, memo):
@@ -1253,20 +1260,42 @@ class Evaluator:
                 out = out.astype(xp.float32)
             return out, m
         if dst.kind == K.DECIMAL:
+            wide = dst.is_wide_decimal or src.is_wide_decimal
             if src.kind == K.DECIMAL:
                 ds = dst.scale - src.scale
+                if wide:
+                    vo = _to_object(v)
+                    out = (vo * dec.pow10(ds) if ds >= 0
+                           else _round_div(np, vo, dec.pow10(-ds)))
+                    return _dec_fit(out, m, dst), m
                 if ds >= 0:
-                    return v * dec.pow10(ds), m
+                    return self._iwiden("multiply", v,
+                                        dec.pow10(ds), False), m
                 return _round_div(xp, v, dec.pow10(-ds)), m
             if src.is_float:
                 scaled = v * float(dec.pow10(dst.scale))
                 out = xp.where(scaled >= 0, xp.floor(scaled + 0.5),
-                               xp.ceil(scaled - 0.5)).astype(xp.int64)
-                return out, m
-            return v * dec.pow10(dst.scale), m  # int -> decimal
+                               xp.ceil(scaled - 0.5))
+                if dst.is_wide_decimal:
+                    # python-int object lanes, exact for the float's value
+                    vals = np.asarray(out, np.float64).reshape(-1)
+                    obj = np.array([int(x) for x in vals], dtype=object)
+                    return _dec_fit(obj, m, dst), m
+                return out.astype(xp.int64), m
+            if dst.is_wide_decimal:
+                return _dec_fit(_to_object(v) * dec.pow10(dst.scale),
+                                m, dst), m
+            return self._iwiden("multiply", v,
+                                dec.pow10(dst.scale), False), m
         if dst.kind in (K.INT64, K.UINT64):
             ity = xp.int64 if dst.kind == K.INT64 else xp.uint64
             if src.kind == K.DECIMAL:
+                if src.is_wide_decimal:
+                    out = _round_div(np, _to_object(v),
+                                     dec.pow10(src.scale))
+                    _int_fit(out, m, dst.kind == K.UINT64)
+                    return out.astype(np.int64 if dst.kind == K.INT64
+                                      else np.uint64), m
                 out = _round_div(xp, v, dec.pow10(src.scale))
                 return (out.astype(ity) if hasattr(out, "astype") else out), m
             if src.is_float:
@@ -1284,7 +1313,39 @@ class Evaluator:
 
 # ---------------------------------------------------------------------- #
 
+def _to_object(v):
+    """Numeric value(s) as python-int object array/scalar (exact wide-
+    decimal representation; host only)."""
+    if hasattr(v, "astype"):
+        return v.astype(object)
+    return int(v)
+
+
+def _dec_fit(data, m, dst):
+    """ER_DATA_OUT_OF_RANGE when a decimal result exceeds its declared
+    precision (mydecimal.go overflow; strict-mode semantics)."""
+    bound = dec.pow10(dst.prec if dst.prec > 0 else 65)
+    vals = data if m is True else (data[np.asarray(m)]
+                                   if hasattr(data, "__getitem__") else data)
+    arr = np.asarray(vals, dtype=object).reshape(-1)
+    if len(arr) and (max(arr.max(), -arr.min())) >= bound:
+        raise ValueError(
+            f"Out of range value for DECIMAL({dst.prec},{dst.scale})")
+    return data
+
+
+def _int_fit(data, m, unsigned: bool):
+    lo, hi = (0, 2 ** 64 - 1) if unsigned else (-2 ** 63, 2 ** 63 - 1)
+    vals = data if m is True else data[np.asarray(m)]
+    arr = np.asarray(vals, dtype=object).reshape(-1)
+    if len(arr) and (int(arr.min()) < lo or int(arr.max()) > hi):
+        raise ValueError("Out of range value for BIGINT"
+                         + (" UNSIGNED" if unsigned else ""))
+
+
 def _or3(a, b, c):
+    if a is True:
+        return True
     out = a
     for x in (b, c):
         if x is True:

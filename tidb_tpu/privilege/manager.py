@@ -197,5 +197,16 @@ class PrivilegeManager:
                           f"{'YES' if auth else 'NO'})"
         return True, None
 
+    def authenticate_cleartext(self, user: str, password: str):
+        """caching_sha2_password FULL-auth verify (TLS-protected
+        cleartext checks against the stored SHA1(SHA1(pw)))."""
+        from ..utils.auth import native_password_hash
+        rec = self._match(user)
+        if rec is None:
+            return False, f"Access denied for user '{user}'"
+        if native_password_hash(password) != rec.auth_hash:
+            return False, f"Access denied for user '{user}'"
+        return True, None
+
 
 __all__ = ["PrivilegeManager", "PrivilegeError", "UserRecord", "KNOWN_PRIVS"]

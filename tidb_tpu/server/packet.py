@@ -16,8 +16,9 @@ import struct
 from typing import Any, Optional, Sequence
 
 from ..types import dtypes as dt
-from ..utils.auth import (check_scramble, native_password_hash,
-                          scramble_password)
+from ..utils.auth import (check_scramble, check_sha2_scramble,
+                          native_password_hash, scramble_password,
+                          sha2_cache_digest, sha2_scramble)
 
 K = dt.TypeKind
 
@@ -26,6 +27,7 @@ CLIENT_LONG_PASSWORD = 1 << 0
 CLIENT_FOUND_ROWS = 1 << 1
 CLIENT_LONG_FLAG = 1 << 2
 CLIENT_CONNECT_WITH_DB = 1 << 3
+CLIENT_SSL = 1 << 11
 CLIENT_PROTOCOL_41 = 1 << 9
 CLIENT_TRANSACTIONS = 1 << 13
 CLIENT_SECURE_CONNECTION = 1 << 15
@@ -45,6 +47,8 @@ SERVER_CAPABILITIES = (
 # server status bits
 SERVER_STATUS_AUTOCOMMIT = 0x0002
 SERVER_STATUS_IN_TRANS = 0x0001
+SERVER_STATUS_CURSOR_EXISTS = 0x0040
+SERVER_STATUS_LAST_ROW_SENT = 0x0080
 
 # commands
 COM_QUIT = 0x01
@@ -56,6 +60,10 @@ COM_STMT_PREPARE = 0x16
 COM_STMT_EXECUTE = 0x17
 COM_STMT_CLOSE = 0x19
 COM_STMT_RESET = 0x1A
+COM_STMT_FETCH = 0x1C
+
+# COM_STMT_EXECUTE cursor flags (conn_stmt.go / cursor protocol)
+CURSOR_TYPE_READ_ONLY = 0x01
 
 # column types (include/field_types.h)
 MYSQL_TYPE_DOUBLE = 0x05
@@ -113,21 +121,39 @@ def get_lenenc_str(buf: bytes, pos: int) -> tuple[bytes, int]:
 # server packets
 # ------------------------------------------------------------------ #
 
-def handshake_v10(conn_id: int, salt: bytes, server_version: str) -> bytes:
+def handshake_v10(conn_id: int, salt: bytes, server_version: str,
+                  capabilities: int = SERVER_CAPABILITIES,
+                  plugin: str = "mysql_native_password") -> bytes:
     assert len(salt) == 20
     p = bytearray()
     p += b"\x0a" + server_version.encode() + b"\x00"
     p += struct.pack("<I", conn_id)
     p += salt[:8] + b"\x00"
-    p += struct.pack("<H", SERVER_CAPABILITIES & 0xFFFF)
+    p += struct.pack("<H", capabilities & 0xFFFF)
     p += bytes([33])  # utf8_general_ci
     p += struct.pack("<H", SERVER_STATUS_AUTOCOMMIT)
-    p += struct.pack("<H", SERVER_CAPABILITIES >> 16)
+    p += struct.pack("<H", capabilities >> 16)
     p += bytes([21])  # auth data length (20 + NUL)
     p += b"\x00" * 10
     p += salt[8:20] + b"\x00"
-    p += b"mysql_native_password\x00"
+    p += plugin.encode() + b"\x00"
     return bytes(p)
+
+
+def auth_switch_request(plugin: str, salt: bytes) -> bytes:
+    """AuthSwitchRequest (conn.go writeAuthSwitchRequest analog)."""
+    return b"\xfe" + plugin.encode() + b"\x00" + salt + b"\x00"
+
+
+def auth_more_data(payload: bytes) -> bytes:
+    """AuthMoreData frame (0x01-prefixed; caching_sha2 fast/full
+    markers ride here: 0x03 = fast-auth success, 0x04 = perform full
+    authentication)."""
+    return b"\x01" + payload
+
+
+SHA2_FAST_AUTH_OK = b"\x03"
+SHA2_FULL_AUTH = b"\x04"
 
 
 def parse_handshake_response(payload: bytes) -> dict:

@@ -178,8 +178,17 @@ def encode_row(values: Sequence[Any], types: Sequence[dt.DataType]) -> bytes:
             out.append(1)
             out += struct.pack("<d", float(v))
         elif k == K.DECIMAL:
-            out.append(2)
-            out += struct.pack("<q", dec.encode(v, t.scale))
+            scaled = dec.encode(v, t.scale)
+            if t.is_wide_decimal:
+                # 19-65 digit decimals: length-prefixed little-endian
+                # signed magnitude (mydecimal.go's var-width analog)
+                nb = (scaled.bit_length() + 8) // 8 or 1
+                out.append(8)
+                out += struct.pack("<B", nb)
+                out += scaled.to_bytes(nb, "little", signed=True)
+            else:
+                out.append(2)
+                out += struct.pack("<q", scaled)
         elif k == K.STRING:
             b = str(v).encode()
             out.append(3)
@@ -228,6 +237,12 @@ def decode_row(data: bytes, types: Sequence[dt.DataType]) -> list[Any]:
         elif tag == 2:
             (v,) = struct.unpack_from("<q", data, off)
             off += 8
+            out.append(dec.to_string(v, t.scale))
+        elif tag == 8:
+            nb = data[off]
+            off += 1
+            v = int.from_bytes(data[off:off + nb], "little", signed=True)
+            off += nb
             out.append(dec.to_string(v, t.scale))
         elif tag == 3:
             (ln,) = struct.unpack_from("<I", data, off)

@@ -96,6 +96,13 @@ class ColumnarSnapshot:
         counts = np.array([hi - lo for lo, hi in ranges], np.int64)
         cols = []
         for c in self.columns:
+            if c.data.dtype == object:
+                # wide (19-65 digit) decimal: host-only object ints.  The
+                # planner refuses to fuse any expression touching it
+                # (_device_supported), so its slot only keeps TableScan
+                # offsets stable — upload a 1-byte placeholder.
+                cols.append((np.zeros((len(ranges), cap), np.int8), None))
+                continue
             # narrow physical width on device too: H2D bytes and HBM
             # footprint drop 2-8x; the expression compiler re-widens
             # inside the fused program where the logical width matters

@@ -243,6 +243,24 @@ def _handle_conn(eng: StoreEngine, conn) -> None:
         conn.close()
 
 
+def _dxf_backfill(table_id, index_id, unique, offs, col_types, rows):
+    """DXF taskexecutor role (disttask framework worker): compute the
+    index KV entries for one backfill subtask.  The owner ships
+    (handle, encoded row) pairs and commits the returned entries — the
+    reference's ingest-mode split (workers encode, the owner ingests,
+    backfilling_dist_scheduler.go)."""
+    from .codec import decode_row, encode_index_entry
+    entries = []
+    for h, rv in rows:
+        row = decode_row(rv, col_types)
+        vals = [row[i] for i in offs]
+        types = [col_types[i] for i in offs]
+        k, v = encode_index_entry(table_id, index_id, vals, types,
+                                  int(h), unique)
+        entries.append((int(h), k, v))
+    return ("entries", entries)
+
+
 def serve(port: int = 0):
     eng = StoreEngine()
     fail_after = [None]    # failpoint: exit before the k-th next response
@@ -270,6 +288,8 @@ def serve(port: int = 0):
                     resp = eng.exec_agg(*msg[1:])
                 elif op == "exec_rows":
                     resp = eng.exec_rows(*msg[1:])
+                elif op == "dxf_backfill":
+                    resp = _dxf_backfill(*msg[1:])
                 elif op == "fail_after":
                     fail_after[0] = int(msg[1])
                     resp = ("ok",)

@@ -31,14 +31,21 @@ def pow10(n: int) -> int:
 
 
 def encode(value: Union[str, int, float, pydec.Decimal], scale: int) -> int:
-    """Encode a python value into a scaled int with MySQL half-up rounding."""
+    """Encode a python value into a scaled int with MySQL half-up rounding.
+    A widened context covers 65-digit (wide) decimals — the default
+    28-digit context raises InvalidOperation past ~28 digits."""
     d = pydec.Decimal(str(value)) if not isinstance(value, pydec.Decimal) else value
-    q = d.scaleb(scale).quantize(pydec.Decimal(1), rounding=pydec.ROUND_HALF_UP)
+    with pydec.localcontext() as ctx:
+        ctx.prec = 96
+        q = d.scaleb(scale).quantize(pydec.Decimal(1),
+                                     rounding=pydec.ROUND_HALF_UP)
     return int(q)
 
 
 def decode(scaled: int, scale: int) -> pydec.Decimal:
-    return pydec.Decimal(scaled).scaleb(-scale)
+    with pydec.localcontext() as ctx:
+        ctx.prec = 96        # wide decimals exceed the default 28 digits
+        return pydec.Decimal(scaled).scaleb(-scale)
 
 
 def to_string(scaled: int, scale: int) -> str:

@@ -59,16 +59,21 @@ DIV_FRAC_INCR = 4
 # Max decimal digits representable in the scaled-int64 encoding.
 DECIMAL64_MAX_PRECISION = 18
 
-# Max digits of a "wide" decimal (host-side aggregation results).  Mirrors
-# the reference's SUM result widening (expression/aggregation: SUM over
-# DECIMAL(p,s) -> DECIMAL(min(p+22,65),s), mydecimal.go), bounded to 38.
-# Exactness: per-row |value| < 10^19 (decimal64/int64), so limb splits have
-# |hi|,lo < 2^32; batches are fenced to < 2^31 rows (copr/exec.py), keeping
-# int64 limb sums wrap-free, and cross-shard merges are exact (object ints
-# host-side; the psum path is fenced to < 2^31 global rows in
-# parallel/spmd.py).  Attainable sums are therefore always exact; 38 is the
-# declared-type ceiling, not an exactness claim beyond those fences.
-DECIMAL_MAX_PRECISION = 38
+# Max digits of a "wide" decimal: declared columns/casts beyond 18 digits
+# and aggregation results.  Matches MyDecimal's 65-digit ceiling
+# (reference: pkg/types/mydecimal.go:47); wide values are python-int
+# object arrays on the host (exact at any magnitude) and never ship to
+# device.  The SUM widening rule mirrors the reference
+# (DECIMAL(p,s) -> DECIMAL(min(p+22,65),s), expression/aggregation).
+# Exactness of the device limb path: per-row |value| < 10^19 (decimal64/
+# int64), so limb splits have |hi|,lo < 2^32; batches are fenced to
+# < 2^31 rows (copr/exec.py), keeping int64 limb sums wrap-free, and
+# cross-shard merges are exact (object ints host-side; the psum path is
+# fenced to < 2^31 global rows in parallel/spmd.py).
+DECIMAL_MAX_PRECISION = 65
+
+# MySQL's maximum DECIMAL scale.
+DECIMAL_MAX_SCALE = 30
 
 
 @dataclass(frozen=True)
@@ -112,6 +117,13 @@ class DataType:
     @property
     def is_temporal(self) -> bool:
         return self.kind in (TypeKind.DATE, TypeKind.DATETIME, TypeKind.TIME)
+
+    @property
+    def is_wide_decimal(self) -> bool:
+        """19-65 digit DECIMAL: python-int object representation,
+        host-only (never device-fused)."""
+        return (self.kind == TypeKind.DECIMAL
+                and self.prec > DECIMAL64_MAX_PRECISION)
 
     def np_dtype(self) -> np.dtype:
         """numpy dtype of the dense host/device representation."""
@@ -163,16 +175,27 @@ def double(nullable: bool = True) -> DataType:
 
 
 def decimal(prec: int, scale: int, nullable: bool = True) -> DataType:
-    if prec > DECIMAL64_MAX_PRECISION:
-        prec = DECIMAL64_MAX_PRECISION
+    """DECIMAL(p,s).  p <= 18 is the scaled-int64 fast representation;
+    19..65 is the wide (python-int object array, host-only) one — no
+    silent clamping: a declared DECIMAL(30,10) really holds 30 digits
+    (reference: mydecimal.go:47).  p > 65 / s > 30 are MySQL errors."""
+    if prec > DECIMAL_MAX_PRECISION:
+        raise ValueError(
+            f"DECIMAL precision {prec} exceeds the maximum "
+            f"{DECIMAL_MAX_PRECISION} (ER_TOO_BIG_PRECISION)")
+    if scale > DECIMAL_MAX_SCALE:
+        raise ValueError(
+            f"DECIMAL scale {scale} exceeds the maximum "
+            f"{DECIMAL_MAX_SCALE} (ER_TOO_BIG_SCALE)")
     return DataType(TypeKind.DECIMAL, nullable, prec=prec, scale=scale)
 
 
 def decimal_wide(prec: int, scale: int, nullable: bool = True) -> DataType:
-    """Aggregation-result decimal, up to 38 digits (object-backed on host)."""
-    if prec > DECIMAL_MAX_PRECISION:
-        prec = DECIMAL_MAX_PRECISION
-    return DataType(TypeKind.DECIMAL, nullable, prec=prec, scale=scale)
+    """Aggregation-result decimal: clamps to the 65-digit ceiling instead
+    of raising (SUM widening may push past it)."""
+    return DataType(TypeKind.DECIMAL, nullable,
+                    prec=min(prec, DECIMAL_MAX_PRECISION),
+                    scale=min(scale, DECIMAL_MAX_SCALE))
 
 
 def varchar(nullable: bool = True, collation: str = "binary") -> DataType:

@@ -30,4 +30,35 @@ def check_scramble(scrambled: bytes, salt: bytes, stored_hash: bytes) -> bool:
     return hashlib.sha1(h1).digest() == stored_hash
 
 
-__all__ = ["native_password_hash", "scramble_password", "check_scramble"]
+def sha2_cache_digest(password: str) -> bytes:
+    """SHA256(SHA256(password)) — the fast-auth cache entry the server
+    keeps after one full authentication (reference: privilege/privileges
+    globalPrivCache sha2 cache; MySQL's caching_sha2_password design)."""
+    return hashlib.sha256(hashlib.sha256(password.encode()).digest()).digest()
+
+
+def sha2_scramble(password: str, nonce: bytes) -> bytes:
+    """Client-side caching_sha2_password fast-auth token:
+    SHA256(pwd) XOR SHA256(SHA256(SHA256(pwd)) || nonce)."""
+    if not password:
+        return b""
+    h1 = hashlib.sha256(password.encode()).digest()
+    h2 = hashlib.sha256(h1).digest()
+    mix = hashlib.sha256(h2 + nonce).digest()
+    return bytes(a ^ b for a, b in zip(h1, mix))
+
+
+def check_sha2_scramble(token: bytes, nonce: bytes,
+                        cache_digest: bytes) -> bool:
+    """Server-side fast-auth verify against the cached
+    SHA256(SHA256(password)): recover SHA256(pwd) from the token and
+    re-hash."""
+    if not token:
+        return cache_digest == sha2_cache_digest("")
+    mix = hashlib.sha256(cache_digest + nonce).digest()
+    h1 = bytes(a ^ b for a, b in zip(token, mix))
+    return hashlib.sha256(h1).digest() == cache_digest
+
+
+__all__ = ["native_password_hash", "scramble_password", "check_scramble",
+           "sha2_cache_digest", "sha2_scramble", "check_sha2_scramble"]
