@@ -338,14 +338,15 @@ def host_sort_agg(agg: D.Aggregation, snap) -> Optional[dict]:
     ev = Evaluator(np)
     memo: dict = {}
     # canonical per-key (code, nullflag) in the device program's zeroing
-    # semantics: NULLs zeroed + flagged, -0.0 groups with +0.0
+    # semantics: NULLs zeroed + flagged, -0.0 groups with +0.0.
+    # `valid is True` stays a sentinel — materializing np.ones(n) per
+    # all-valid key cost two full passes on the rollup rung.
     key_vals, key_valids, key_codes = [], [], []
     for e in agg.group_by:
         v, m = ev.eval(e, cols, memo)
         v = np.broadcast_to(np.asarray(v), (n,))
         all_valid = m is True
-        valid = (np.ones(n, bool) if all_valid
-                 else np.broadcast_to(np.asarray(m), (n,)))
+        valid = True if all_valid else np.broadcast_to(np.asarray(m), (n,))
         vz = v if all_valid else np.where(valid, v, np.zeros((), v.dtype))
         if e.dtype.is_float:
             vz = np.where(vz == 0, np.zeros((), vz.dtype), vz)
@@ -357,13 +358,15 @@ def host_sort_agg(agg: D.Aggregation, snap) -> Optional[dict]:
             # counting loop reads them at physical width
             code = vz if vz.dtype.kind == "i" else vz.astype(np.int64)
         else:
-            code = _np_key_code(vz, valid, e.dtype)
+            code = _np_key_code(vz, np.asarray(valid), e.dtype)
         key_codes.append(code)
 
-    # combine keys into one int64 id.  Fast path: direct mixed-radix
-    # packing over per-key OBSERVED ranges — one linear pass per key.
-    # The np.unique factorization fallback costs a sort per key and
-    # dominated the rollup rung ~40:1 before this path existed.
+    # combine keys into one int id.  Fast path: direct mixed-radix
+    # packing over per-key OBSERVED ranges — one linear pass per key, at
+    # the narrowest width that holds the radix product (a 6-slot rollup
+    # key domain packs in int16, not 8-byte temporaries).  The np.unique
+    # factorization fallback costs a sort per key and dominated the
+    # rollup rung ~40:1 before this path existed.
     combined = None
     if n and len(key_codes) >= 2:   # single-key ids pass through unshifted
         spans = []
@@ -371,43 +374,55 @@ def host_sort_agg(agg: D.Aggregation, snap) -> Optional[dict]:
         for code, valid in zip(key_codes, key_valids):
             vmin = int(code.min())
             vmax = int(code.max())
-            allv = bool(valid.all())
+            allv = valid is True
             w = (vmax - vmin + 1) * (1 if allv else 2)
             spans.append((vmin, w, allv))
             total *= w
             if total >= 2 ** 62:
                 break
         if total < 2 ** 62:
-            combined = np.zeros(n, np.int64)
+            # strict bounds: every per-key radix w divides total, so
+            # total < 2**15 guarantees tgt(w) is representable too
+            tgt = (np.int16 if total < 2 ** 15 else
+                   np.int32 if total < 2 ** 31 else np.int64)
+            combined = np.zeros(n, tgt)
             for (vmin, w, allv), code, valid in zip(spans, key_codes,
                                                     key_valids):
-                combined *= w
-                f = code.astype(np.int64)
-                if vmin:
-                    f -= vmin
+                np.multiply(combined, tgt(w), out=combined)
+                if allv and vmin == 0:
+                    np.add(combined, code, out=combined,
+                           casting="unsafe")
+                    continue
+                # field = (code - vmin)[*2 + nullflag], computed one
+                # width up from the code so the shift cannot wrap
+                up = {1: np.int16, 2: np.int32}.get(
+                    code.dtype.itemsize, np.int64)
+                f = np.subtract(code, vmin, dtype=up)
                 if not allv:
-                    f += f                              # field *= 2
-                    f += (~valid).astype(np.int64)      # null flag bit
-                combined += f
+                    np.add(f, f, out=f)
+                    np.add(f, ~valid, out=f, casting="unsafe")
+                np.add(combined, f, out=combined, casting="unsafe")
     if combined is None:
         # pairwise factorized radices: a sort per key, but works for any
         # key domain (values stay < n^2 < 2^63)
+        def _nf(j):
+            kv = key_valids[j]
+            return 0 if kv is True else (~kv).astype(np.int64)
+
         combined = key_codes[0]
-        if not key_valids[0].all():
+        if key_valids[0] is not True:
             if combined.size and -2 ** 62 < int(combined.min()) \
                     and int(combined.max()) < 2 ** 62:
-                combined = combined * np.int64(2) \
-                    + (~key_valids[0]).astype(np.int64)
+                combined = combined * np.int64(2) + _nf(0)
             else:
                 u = np.unique(combined, return_inverse=True)[1]
-                combined = u * np.int64(2) \
-                    + (~key_valids[0]).astype(np.int64)
+                combined = u * np.int64(2) + _nf(0)
         for j in range(1, len(key_codes)):
             ua, inv_a = np.unique(combined, return_inverse=True)
             ub, inv_b = np.unique(key_codes[j], return_inverse=True)
             combined = inv_a.astype(np.int64) * np.int64(2 * len(ub)) \
                 + inv_b.astype(np.int64) * 2 \
-                + (~key_valids[j]).astype(np.int64)
+                + _nf(j)
 
     # per-row group ids are only needed beyond COUNT(*), and a group
     # representative row only when the key can't be decoded from its own
@@ -415,7 +430,7 @@ def host_sort_agg(agg: D.Aggregation, snap) -> Optional[dict]:
     # np.unique, so avoid it entirely: representatives come from a
     # scatter of row ids through inv instead)
     k0 = agg.group_by[0]
-    decodable_key = (len(agg.group_by) == 1 and key_valids[0].all()
+    decodable_key = (len(agg.group_by) == 1 and key_valids[0] is True
                      and not k0.dtype.is_float)
     need_inv = (not decodable_key
                 or any(not (a.func == D.AggFunc.COUNT and a.arg is None)
@@ -434,7 +449,9 @@ def host_sort_agg(agg: D.Aggregation, snap) -> Optional[dict]:
         rep = np.empty(ng, np.int64)
         rep[inv] = np.arange(n)
         for j, (vz, valid) in enumerate(zip(key_vals, key_valids)):
-            states[f"k{j}"] = {"val": vz[rep], "valid": valid[rep]}
+            states[f"k{j}"] = {"val": vz[rep],
+                               "valid": (np.ones(ng, bool) if valid is True
+                                         else valid[rep])}
 
     def seg_sum(vals):
         # bincount beats np.add.at ~10x; float64 weights are the natural
