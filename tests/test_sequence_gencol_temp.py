@@ -1,0 +1,162 @@
+"""Sequences, generated columns, temporary tables (VERDICT r4 missing #9).
+
+Reference analogs: pkg/ddl/sequence.go (+ expression nextval/lastval/
+setval), table/column.go generated-column evaluation, and the temptable
+session-scoped infoschema overlay.
+"""
+
+import pytest
+
+from tidb_tpu.session import Domain, Session
+from tidb_tpu.session.catalog import CatalogError
+from tidb_tpu.planner.build import PlanError
+
+
+@pytest.fixture
+def sess():
+    return Session()
+
+
+# ---------------- sequences ---------------- #
+
+def test_sequence_basic(sess):
+    sess.execute("CREATE SEQUENCE s START WITH 5 INCREMENT BY 3 CACHE 4")
+    assert sess.execute("SELECT NEXTVAL(s)").rows == [(5,)]
+    assert sess.execute("SELECT NEXTVAL(s)").rows == [(8,)]
+    assert sess.execute("SELECT LASTVAL(s)").rows == [(8,)]
+    assert sess.execute("SELECT SETVAL(s, 100)").rows == [(100,)]
+    assert sess.execute("SELECT NEXTVAL(s)").rows == [(103,)]
+
+
+def test_sequence_lastval_before_use_is_null(sess):
+    sess.execute("CREATE SEQUENCE s2")
+    assert sess.execute("SELECT LASTVAL(s2)").rows == [(None,)]
+
+
+def test_sequence_per_row_advance(sess):
+    sess.execute("CREATE SEQUENCE s3")
+    sess.execute("CREATE TABLE t3 (k INT)")
+    sess.execute("INSERT INTO t3 VALUES (1),(2),(3)")
+    rows = sess.execute("SELECT NEXTVAL(s3) FROM t3").rows
+    assert sorted(r[0] for r in rows) == [1, 2, 3]
+
+
+def test_sequence_in_insert_values(sess):
+    sess.execute("CREATE SEQUENCE s4 START WITH 7")
+    sess.execute("CREATE TABLE t4 (id BIGINT, v INT)")
+    sess.execute("INSERT INTO t4 VALUES (NEXTVAL(s4), 1), (NEXTVAL(s4), 2)")
+    assert [r[0] for r in sess.execute(
+        "SELECT id FROM t4 ORDER BY id").rows] == [7, 8]
+
+
+def test_sequence_max_value_and_cycle(sess):
+    sess.execute("CREATE SEQUENCE sm MAXVALUE 2 CACHE 1")
+    assert sess.execute("SELECT NEXTVAL(sm)").rows == [(1,)]
+    assert sess.execute("SELECT NEXTVAL(sm)").rows == [(2,)]
+    with pytest.raises(Exception):
+        sess.execute("SELECT NEXTVAL(sm)")
+    sess.execute("CREATE SEQUENCE sc MAXVALUE 2 CACHE 1 CYCLE")
+    vals = [sess.execute("SELECT NEXTVAL(sc)").rows[0][0] for _ in range(4)]
+    assert vals == [1, 2, 1, 2]
+
+
+def test_sequence_restart_skips_batch(sess):
+    """A restarted owner must never repeat values: the KV high-water mark
+    advances per cache batch (the autoid discipline)."""
+    from tidb_tpu.session.catalog import SequenceInfo
+    sess.execute("CREATE SEQUENCE sr CACHE 10")
+    first = sess.execute("SELECT NEXTVAL(sr)").rows[0][0]
+    # simulate restart: rebuild from the same KV
+    seq2 = SequenceInfo("sr", "test", cache=10, kv=sess.domain.kv)
+    v = seq2.next_value()
+    assert v > first            # skipped to the next batch, no repeats
+
+
+def test_drop_sequence(sess):
+    sess.execute("CREATE SEQUENCE sd")
+    sess.execute("DROP SEQUENCE sd")
+    with pytest.raises(CatalogError):
+        sess.execute("SELECT NEXTVAL(sd)")
+    sess.execute("DROP SEQUENCE IF EXISTS sd")
+
+
+# ---------------- generated columns ---------------- #
+
+def test_generated_stored_and_virtual(sess):
+    sess.execute("CREATE TABLE g (a INT, b INT, c INT AS (a + b) STORED, "
+                 "d INT GENERATED ALWAYS AS (c * 2) VIRTUAL)")
+    sess.execute("INSERT INTO g (a, b) VALUES (1, 2), (10, 20)")
+    assert sess.execute("SELECT c, d FROM g ORDER BY a").rows == \
+        [(3, 6), (30, 60)]
+
+
+def test_generated_recomputes_on_update(sess):
+    sess.execute("CREATE TABLE gu (a INT, c INT AS (a * 10))")
+    sess.execute("INSERT INTO gu (a) VALUES (1)")
+    sess.execute("UPDATE gu SET a = 7")
+    assert sess.execute("SELECT c FROM gu").rows == [(70,)]
+
+
+def test_generated_insert_rejected(sess):
+    sess.execute("CREATE TABLE gr (a INT, c INT AS (a + 1))")
+    with pytest.raises(PlanError):
+        sess.execute("INSERT INTO gr (a, c) VALUES (1, 5)")
+    with pytest.raises(PlanError):
+        sess.execute("INSERT INTO gr VALUES (1, 5)")
+    sess.execute("INSERT INTO gr VALUES (1, NULL)")   # NULL slot ok
+    assert sess.execute("SELECT c FROM gr").rows == [(2,)]
+
+
+def test_generated_string_expr(sess):
+    sess.execute("CREATE TABLE gs (a VARCHAR(10), b VARCHAR(10), "
+                 "ab VARCHAR(20) AS (CONCAT(a, '-', b)) STORED)")
+    sess.execute("INSERT INTO gs (a, b) VALUES ('x', 'y')")
+    assert sess.execute("SELECT ab FROM gs").rows == [("x-y",)]
+
+
+def test_generated_forward_reference_rejected(sess):
+    with pytest.raises(CatalogError):
+        sess.execute("CREATE TABLE gf (a INT, c INT AS (d + 1), "
+                     "d INT AS (a + 1))")
+
+
+def test_index_on_generated_column(sess):
+    sess.execute("CREATE TABLE gi (a INT, c INT AS (a * 2), INDEX ic (c))")
+    sess.execute("INSERT INTO gi (a) VALUES (1),(2),(3)")
+    assert sess.execute(
+        "SELECT a FROM gi WHERE c = 4").rows == [(2,)]
+    sess.execute("admin check table gi")
+
+
+# ---------------- temporary tables ---------------- #
+
+def test_temp_table_session_scoped():
+    dom = Domain()
+    s1, s2 = Session(dom), Session(dom)
+    s1.execute("CREATE TEMPORARY TABLE tt (a INT)")
+    s1.execute("INSERT INTO tt VALUES (1)")
+    assert s1.execute("SELECT COUNT(*) FROM tt").rows == [(1,)]
+    with pytest.raises(CatalogError):
+        s2.execute("SELECT * FROM tt")
+
+
+def test_temp_table_shadows_permanent():
+    dom = Domain()
+    s1, s2 = Session(dom), Session(dom)
+    s1.execute("CREATE TABLE sh (a INT)")
+    s1.execute("INSERT INTO sh VALUES (100)")
+    s1.execute("CREATE TEMPORARY TABLE sh (a INT)")
+    s1.execute("INSERT INTO sh VALUES (1)")      # goes to the temp table
+    assert s1.execute("SELECT a FROM sh").rows == [(1,)]
+    assert s2.execute("SELECT a FROM sh").rows == [(100,)]
+    s1.execute("DROP TEMPORARY TABLE sh")
+    assert s1.execute("SELECT a FROM sh").rows == [(100,)]
+
+
+def test_temp_table_dropped_on_close():
+    dom = Domain()
+    s1 = Session(dom)
+    s1.execute("CREATE TEMPORARY TABLE tc (a INT)")
+    s1.execute("INSERT INTO tc VALUES (1)")
+    s1.close()
+    assert s1.temp_tables == {}

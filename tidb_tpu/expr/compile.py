@@ -411,6 +411,48 @@ class Evaluator:
     def op_ge(self, e, cols, memo):
         return self._cmp(e, cols, memo, lambda a, b: a >= b)
 
+    # -- sequences (host-only, side-effecting; never folded/cached) ------ #
+
+    def _seq_conn(self):
+        from ..planner.build import SESSION_INFO
+        info = SESSION_INFO.get()
+        return int(info.get("conn_id", 0)) if info else 0
+
+    def _rows_n(self, cols) -> int:
+        for v, _m in cols:
+            if getattr(v, "ndim", 0):
+                return len(v)
+        return 1
+
+    def op_seq_next(self, e, cols, memo):
+        """NEXTVAL(seq): advances once per evaluated row (MySQL/TiDB
+        row-at-a-time semantics)."""
+        seq = e.args[0].value
+        conn = self._seq_conn()
+        n = self._rows_n(cols)
+        vals = np.fromiter((seq.next_value(conn) for _ in range(n)),
+                           np.int64, count=n)
+        return (self.xp.asarray(vals) if n > 1 or cols else
+                int(vals[0])), True
+
+    def op_seq_last(self, e, cols, memo):
+        seq = e.args[0].value
+        v = seq.last_value(self._seq_conn())
+        if v is None:
+            return self.xp.int64(0), False
+        return int(v), True
+
+    def op_seq_set(self, e, cols, memo):
+        seq = e.args[0].value
+        v, m = self._num(e.args[1], cols, memo)
+        if m is not True:
+            return self.xp.int64(0), False
+        val = int(v if not getattr(v, "ndim", 0) else np.asarray(v).item())
+        out = seq.set_value(val, self._seq_conn())
+        if out is None:          # ignored backwards move -> NULL
+            return self.xp.int64(0), False
+        return int(out), True
+
     # -- three-valued logic ---------------------------------------------- #
 
     def op_and(self, e, cols, memo):

@@ -74,6 +74,10 @@ SESSION_INFO: contextvars.ContextVar = contextvars.ContextVar(
 PLAN_TAINTS: contextvars.ContextVar = contextvars.ContextVar(
     "plan_taints", default=None)
 
+# sequence-name resolver installed by the session: name -> SequenceInfo
+SEQUENCE_RESOLVER: contextvars.ContextVar = contextvars.ContextVar(
+    "sequence_resolver", default=None)
+
 
 def _taint_plan(reason: str) -> None:
     t = PLAN_TAINTS.get()
@@ -309,6 +313,25 @@ class ExprBuilder:
             if self.agg_resolver is None:
                 raise PlanError(f"aggregate {name} not allowed here")
             return self.agg_resolver(n)
+        if name in ("NEXTVAL", "LASTVAL", "SETVAL"):
+            # sequence functions (reference: ddl/sequence.go,
+            # expression/builtin_func: nextval/lastval/setval)
+            resolver = SEQUENCE_RESOLVER.get()
+            if resolver is None:
+                raise PlanError(f"{name} requires a session context")
+            if not n.args or not isinstance(n.args[0], A.Ident):
+                raise PlanError(f"{name} needs a sequence name")
+            seq = resolver(n.args[0].parts[-1])
+            _taint_plan("sequence")      # side-effecting, never plan-cache
+            ref = Const(dt.bigint(False), seq)
+            if name == "NEXTVAL":
+                return Func(dt.bigint(False), "seq_next", (ref,))
+            if name == "LASTVAL":
+                return Func(dt.bigint(True), "seq_last", (ref,))
+            if len(n.args) != 2:
+                raise PlanError("SETVAL needs (sequence, value)")
+            return Func(dt.bigint(False), "seq_set",
+                        (ref, self.build(n.args[1])))
         if name in ("DATE_ADD", "ADDDATE", "DATE_SUB", "SUBDATE"):
             # the INTERVAL argument is not an expression — don't build it
             base = _coerce_to(dt.date(), self.build(n.args[0]))

@@ -33,7 +33,12 @@ def _fold_expr(e: Expr) -> Expr:
         args = tuple(_fold_expr(a) for a in e.args)
         e = clone_func(e, args)
         if args and all(isinstance(a, Const) and not isinstance(a.value, np.ndarray)
-                        for a in args) and e.op not in ("dict_lut", "dict_map"):
+                        for a in args) and e.op not in (
+                            "dict_lut", "dict_map",
+                            # side-effecting/per-row: folding would advance
+                            # a sequence (or freeze a per-row value) at
+                            # plan time
+                            "seq_next", "seq_last", "seq_set"):
             try:
                 v, m = eval_expr(np, e, [])
             except Exception:

@@ -137,6 +137,10 @@ class ClientConn:
                     self.io.write(P.err_packet(_errno_for(e), str(e)))
         finally:
             try:
+                self.session.close()   # drop temp tables' KV rows
+            except Exception:
+                pass
+            try:
                 self.sock.close()
             except OSError:
                 pass

@@ -11,6 +11,8 @@ carry read timestamps.
 
 from __future__ import annotations
 
+import contextvars
+import threading
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -19,6 +21,11 @@ import numpy as np
 from ..chunk.column import Column, StringDict
 from ..store.columnar import ColumnarSnapshot, snapshot_from_columns
 from ..types import dtypes as dt
+
+# per-session temporary-table overlay: {(db, name): TableInfo}, installed
+# by Session.execute for the duration of each statement
+TEMP_TABLES: contextvars.ContextVar = contextvars.ContextVar(
+    "temp_tables", default=None)
 
 K = dt.TypeKind
 
@@ -145,6 +152,9 @@ class TableInfo:
     schema_gate: Any = None
 
     _alloc_mu: Any = None
+    # generated columns: [(col_index, compiled IR over the table schema)],
+    # computed on every write path (table/column.go generated-column eval)
+    generated_cols: list = field(default_factory=list)
     # catalog-on-KV write-through (session/meta.py): called after every
     # schema mutation so the persisted TableInfo stays current
     _meta_hook: Any = None
@@ -365,7 +375,29 @@ class TableInfo:
                     f"constraint fails (`{self.name}`.`{fk.column}` -> "
                     f"`{fk.ref_table}`.`{fk.ref_column}`, value {bad})")
 
+    def _apply_generated(self, rows: list) -> list:
+        """Compute generated-column values for a write batch, vectorized
+        through the expression engine (columns built from the python-level
+        row values, results decoded back)."""
+        if not self.generated_cols or not rows:
+            return rows
+        from ..executor.physical import ResultChunk, _eval_to_column
+        rows = [list(r) for r in rows]
+        cols = [Column.from_values(t, [r[i] for r in rows])
+                for i, t in enumerate(self.col_types)]
+        chunk = ResultChunk(list(self.col_names), cols)
+        for idx, ir in self.generated_cols:
+            out = _eval_to_column(ir, chunk)
+            vals = out.to_python()
+            for j, r in enumerate(rows):
+                r[idx] = vals[j]
+            # later generated columns may reference this one
+            chunk.columns[idx] = Column.from_values(self.col_types[idx],
+                                                    vals)
+        return [tuple(r) for r in rows]
+
     def insert_rows(self, rows: list[tuple], txn=None) -> int:
+        rows = self._apply_generated(rows)
         fixed, first_handle = self._prepare_insert(rows)
         self._fk_check_rows(fixed)
         if self.partition is not None and self.partition.kind == "range" \
@@ -446,6 +478,7 @@ class TableInfo:
         caller's txn buffers the writes (and, in pessimistic mode, locks
         each record key at DML time via Txn.put)."""
         from .codec_io import encode_table_row
+        new_rows = self._apply_generated(new_rows)
         self._fk_check_rows(new_rows)
         new_rows = [tuple(canon_write_value(t_, v, n)
                           for t_, v, n in zip(self.col_types, r,
@@ -807,6 +840,97 @@ class ViewInfo:
     select_sql: str
 
 
+class SequenceInfo:
+    """A sequence object: batched, KV-persisted value allocation.
+
+    Reference analog: pkg/ddl/sequence.go + the meta sequence value key —
+    NEXTVAL allocates from an in-memory cache of `cache` values and
+    persists only the batch high-water mark, so a restart skips to the
+    next batch boundary instead of repeating values (the autoid
+    discipline).  LASTVAL is per-session (keyed by connection id)."""
+
+    META_PREFIX = b"m_seq_"
+
+    def __init__(self, name: str, db: str, start: int = 1,
+                 increment: int = 1, min_value: Optional[int] = None,
+                 max_value: Optional[int] = None, cache: int = 1000,
+                 cycle: bool = False, kv=None):
+        if increment == 0:
+            raise CatalogError("sequence INCREMENT must be nonzero")
+        self.name = name
+        self.db = db
+        self.increment = increment
+        self.min_value = min_value if min_value is not None else \
+            (1 if increment > 0 else -(2 ** 63) + 1)
+        self.max_value = max_value if max_value is not None else \
+            (2 ** 63 - 1 if increment > 0 else -1)
+        self.start = start
+        self.cache = max(cache, 1)
+        self.cycle = cycle
+        self.kv = kv
+        self._mu = threading.Lock()
+        self._next = start            # next value to hand out
+        self._cache_end = start       # first value NOT covered by the batch
+        self._lastval: dict[int, int] = {}    # conn_id -> last value
+        self._restore()
+
+    def _meta_key(self) -> bytes:
+        return self.META_PREFIX + f"{self.db}.{self.name}".encode()
+
+    def _restore(self):
+        if self.kv is None:
+            return
+        ts = self.kv.alloc_ts()
+        end = self._meta_key() + b"\x00"
+        for k, v in self.kv.scan(self._meta_key(), end, ts):
+            self._next = self._cache_end = int(v.decode())
+
+    def _persist(self, value: int):
+        if self.kv is None:
+            return
+        txn = self.kv.begin()
+        txn.put(self._meta_key(), str(value).encode())
+        txn.commit()
+
+    def next_value(self, conn_id: int = 0) -> int:
+        with self._mu:
+            if self.increment > 0 and self._next > self.max_value or \
+                    self.increment < 0 and self._next < self.min_value:
+                if not self.cycle:
+                    raise CatalogError(
+                        f"sequence {self.name!r} has run out")
+                self._next = (self.min_value if self.increment > 0
+                              else self.max_value)
+                self._cache_end = self._next
+            if (self._next - self._cache_end) * (1 if self.increment > 0
+                                                 else -1) >= 0:
+                # batch exhausted (or first use): reserve the next batch
+                new_end = self._next + self.increment * self.cache
+                self._persist(new_end)
+                self._cache_end = new_end
+            v = self._next
+            self._next += self.increment
+            self._lastval[conn_id] = v
+            return v
+
+    def last_value(self, conn_id: int = 0) -> Optional[int]:
+        with self._mu:
+            return self._lastval.get(conn_id)
+
+    def set_value(self, value: int, conn_id: int = 0) -> Optional[int]:
+        """SETVAL: only moves the sequence FORWARD; a value at or below
+        the current position is ignored and returns None/NULL (TiDB/
+        MariaDB semantics — issued values must stay unique)."""
+        with self._mu:
+            if (value - self._next) * (1 if self.increment > 0
+                                       else -1) < 0:
+                return None
+            self._next = value + self.increment
+            self._persist(self._next + self.increment * self.cache)
+            self._cache_end = self._next + self.increment * self.cache
+            return value
+
+
 class Catalog:
     """In-memory catalog of databases/tables (infoschema analog).
 
@@ -819,6 +943,8 @@ class Catalog:
         # views per db: name -> ViewInfo (planner expands at reference
         # time, logical_plan_builder BuildDataSourceFromView analog)
         self.views: dict[str, dict[str, "ViewInfo"]] = {}
+        # sequences: (db, name) -> SequenceInfo (ddl/sequence.go analog)
+        self.sequences: dict[tuple, "SequenceInfo"] = {}
         self.domain = None       # set by Domain.__init__ (memtable binding)
 
     def create_database(self, name: str, if_not_exists=False):
@@ -860,6 +986,13 @@ class Catalog:
             mt = get_memtable(db, name)
             mt.domain = self.domain
             return mt
+        # session temporary tables shadow permanent ones (reference:
+        # infoschema local temporary table overlay, temptable pkg)
+        tmp = TEMP_TABLES.get()
+        if tmp is not None:
+            t = tmp.get((db, name))
+            if t is not None:
+                return t
         d = self._db(db)
         if name not in d:
             raise CatalogError(f"table {db}.{name} doesn't exist")
@@ -872,6 +1005,31 @@ class Catalog:
         if db not in self.databases:
             raise CatalogError(f"unknown database {db!r}")
         return self.databases[db]
+
+    # ---------------- sequences ---------------- #
+
+    def create_sequence(self, db: str, seq: "SequenceInfo",
+                        if_not_exists=False):
+        self._db(db)      # existence check
+        key = (db, seq.name)
+        if key in self.sequences:
+            if if_not_exists:
+                return
+            raise CatalogError(f"sequence {seq.name!r} exists")
+        self.sequences[key] = seq
+
+    def drop_sequence(self, db: str, name: str, if_exists=False):
+        if (db, name) not in self.sequences:
+            if if_exists:
+                return
+            raise CatalogError(f"unknown sequence {name!r}")
+        del self.sequences[(db, name)]
+
+    def get_sequence(self, db: str, name: str) -> "SequenceInfo":
+        seq = self.sequences.get((db, name))
+        if seq is None:
+            raise CatalogError(f"table {db}.{name} doesn't exist")
+        return seq
 
     # ---------------- views ---------------- #
 

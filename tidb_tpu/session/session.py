@@ -245,8 +245,21 @@ class Session:
         self.txn = None              # active explicit transaction
         self._txn_tables: set = set()
         self._cur_sql: Optional[str] = None      # text of the running stmt
+        # session-scoped temporary tables: (db, name) -> TableInfo;
+        # installed as a catalog overlay per statement (catalog.TEMP_TABLES)
+        self.temp_tables: dict = {}
         import threading as _th
         self._kill_event = _th.Event()   # KILL QUERY sets; stmt start clears
+
+    def close(self) -> None:
+        """Drop session state that outlives no session: temporary tables
+        (their KV rows truncate so the shared store does not leak)."""
+        for t in list(self.temp_tables.values()):
+            try:
+                t.truncate()
+            except Exception:
+                pass
+        self.temp_tables.clear()
 
     # ------------------------------------------------------------- #
 
@@ -306,6 +319,11 @@ class Session:
                 "getvar": _getvar,
                 "getuservar":
                     lambda name, _s="": self.user_vars.get(name)})
+            from ..planner.build import SEQUENCE_RESOLVER
+            from .catalog import TEMP_TABLES
+            qtok = SEQUENCE_RESOLVER.set(
+                lambda nm: self.domain.catalog.get_sequence(self.db, nm))
+            ttok = TEMP_TABLES.set(self.temp_tables)
             try:
                 out = self._exec_stmt(stmt)
             except Exception as e:
@@ -314,6 +332,8 @@ class Session:
                               (time.perf_counter_ns() - t0) / 1e9, 0)
                 raise
             finally:
+                TEMP_TABLES.reset(ttok)
+                SEQUENCE_RESOLVER.reset(qtok)
                 SESSION_INFO.reset(stok)
                 QUERY_HANDLE.reset(htok)
                 KILL_EVENT.reset(ktok)
@@ -443,7 +463,44 @@ class Session:
             return self._exec_trace(stmt)
         if isinstance(stmt, A.CreateTable):
             return self._exec_create_table(stmt)
+        if isinstance(stmt, A.CreateSequence):
+            from .catalog import SequenceInfo
+            seq = SequenceInfo(stmt.name, self.db, start=stmt.start,
+                               increment=stmt.increment,
+                               min_value=stmt.min_value,
+                               max_value=stmt.max_value, cache=stmt.cache,
+                               cycle=stmt.cycle, kv=self.domain.kv)
+            self.domain.catalog.create_sequence(self.db, seq,
+                                                stmt.if_not_exists)
+            return ResultSet()
+        if isinstance(stmt, A.DropSequence):
+            self.domain.catalog.drop_sequence(self.db, stmt.name,
+                                              stmt.if_exists)
+            return ResultSet()
         if isinstance(stmt, A.DropTable):
+            # session temporary tables shadow permanent ones and drop
+            # without touching the shared catalog
+            remaining = []
+            for n in stmt.names:
+                t = self.temp_tables.pop((self.db, n), None)
+                if t is not None:
+                    try:
+                        t.truncate()
+                    except Exception:
+                        pass
+                else:
+                    remaining.append(n)
+            if stmt.temporary:
+                # DROP TEMPORARY TABLE must NEVER touch a permanent table
+                # (MySQL semantics: unknown temp names are errors unless
+                # IF EXISTS)
+                if remaining and not stmt.if_exists:
+                    raise CatalogError(
+                        f"unknown temporary table {remaining[0]!r}")
+                return ResultSet()
+            if not remaining:
+                return ResultSet()
+            stmt = A.DropTable(remaining, stmt.if_exists)
             for n in stmt.names:
                 refs = [
                     (t.name, fk.column)
@@ -544,7 +601,10 @@ class Session:
             return ResultSet()
         if isinstance(stmt, A.AnalyzeTable):
             tbl = self.domain.catalog.get_table(self.db, stmt.name)
-            self.domain.stats.analyze_table(tbl)
+            self.domain.stats.analyze_table(
+                tbl, columns=stmt.columns or None,
+                sample_rate=stmt.sample_rate,
+                predicate_only=stmt.predicate_columns)
             return ResultSet()
         if isinstance(stmt, A.AdminStmt):
             return self._exec_admin(stmt)
@@ -682,6 +742,35 @@ class Session:
         # FLUSH PRIVILEGES: no-op — the manager is authoritative
         return ResultSet()
 
+    def _note_predicate_columns(self, plan) -> None:
+        """Track filtered columns for ANALYZE ... PREDICATE COLUMNS
+        (column_stats_usage.go analog) and schedule an async stats load
+        for planned-against tables with no stats yet (handle/syncload)."""
+        from ..planner.logical import DataSource, LogicalSelection
+        from ..planner.optimize import referenced_columns
+        stats = self.domain.stats
+
+        def walk(p):
+            if isinstance(p, LogicalSelection) \
+                    and isinstance(p.children[0], DataSource):
+                ds = p.children[0]
+                refs = set()
+                for c in p.conditions:
+                    refs |= referenced_columns(c)
+                names = [ds.schema.cols[i].name for i in refs
+                         if i < len(ds.schema.cols)]
+                stats.note_predicate_columns(ds.table, names)
+            if isinstance(p, DataSource) \
+                    and not getattr(p.table, "is_memtable", False):
+                stats.request_load(p.table)
+            for c in getattr(p, "children", []):
+                walk(c)
+
+        try:
+            walk(plan)
+        except Exception:
+            pass     # tracking is advisory, never a planning failure
+
     def _eval_scalar(self, expr: A.Node):
         """Evaluate a scalar expression (SET @x = ...); subqueries inside
         the expression still pass privilege checks."""
@@ -749,6 +838,7 @@ class Session:
             _build_mod.PLAN_TAINTS.reset(token2)
         self._maybe_auto_analyze(built.plan)
         plan = optimize_plan(built.plan)
+        self._note_predicate_columns(plan)
         from ..planner.join_reorder import reorder_joins
         plan = reorder_joins(plan, self.domain.stats)
         plan = apply_index_paths(plan, self.domain.stats)
@@ -1098,8 +1188,25 @@ class Session:
             tbl._fk_resolver = (
                 lambda nm, _t=tbl, _db=db, _cat=cat:
                 _t if nm == _t.name else _cat.get_table(_db, nm))
-        self.domain.catalog.create_table(self.db, tbl, stmt.if_not_exists)
-        created = self.domain.catalog.get_table(self.db, stmt.name)
+        gen = [(c.name, c.generated, c.generated_stored)
+               for c in stmt.columns if c.generated is not None]
+        if gen:
+            self._bind_generated_columns(tbl, stmt, gen)
+        if stmt.temporary:
+            # session-scoped: registered in the session overlay, never in
+            # the shared catalog (reference: temptable / local temporary
+            # table infoschema overlay)
+            key = (self.db, stmt.name)
+            if key in self.temp_tables:
+                if stmt.if_not_exists:
+                    return ResultSet()
+                raise CatalogError(f"table {stmt.name!r} exists")
+            self.temp_tables[key] = tbl
+            created = tbl
+        else:
+            self.domain.catalog.create_table(self.db, tbl,
+                                             stmt.if_not_exists)
+            created = self.domain.catalog.get_table(self.db, stmt.name)
         if created is tbl:
             # implicit PRIMARY index gives PK uniqueness + the point-get
             # path (the reference's clustered-handle role, tablecodec)
@@ -1109,6 +1216,46 @@ class Session:
                 tbl.create_index(iname or f"idx_{i+1}_" + "_".join(cols),
                                  cols, uniq)
         return ResultSet()
+
+    def _bind_generated_columns(self, tbl, stmt: A.CreateTable, gen) -> None:
+        """Compile generated-column expressions over the table schema and
+        attach them for the write paths (reference: table/column.go
+        generated column eval; computed at write for STORED and — as a
+        simplification — VIRTUAL alike, which is observationally
+        equivalent for the deterministic expressions MySQL requires)."""
+        from ..planner.build import ExprBuilder
+        from ..planner.logical import Schema, SchemaCol
+        schema = Schema([SchemaCol(n, t)
+                         for n, t in zip(tbl.col_names, tbl.col_types)])
+        eb = ExprBuilder(schema)
+
+        def refs(e):
+            from ..expr.ir import ColumnRef, Func
+            if isinstance(e, ColumnRef):
+                yield e
+            elif isinstance(e, Func):
+                for a in e.args:
+                    yield from refs(a)
+
+        compiled = []
+        gen_names = {name for name, _a, _s in gen}
+        for name, ast_expr, _stored in gen:
+            ir = eb.build(ast_expr)
+            for r in refs(ir):
+                if tbl.col_names[r.index] in gen_names \
+                        and tbl.col_names.index(name) <= r.index:
+                    raise CatalogError(
+                        "generated column may only reference earlier "
+                        "generated columns")
+                if tbl.auto_inc_col is not None \
+                        and tbl.col_names[r.index] == tbl.auto_inc_col:
+                    # MySQL ER_GENERATED_COLUMN_REF_AUTO_INC: the value is
+                    # allocated after generation would run
+                    raise CatalogError(
+                        "generated column cannot refer to an "
+                        "auto-increment column")
+            compiled.append((tbl.col_names.index(name), ir))
+        tbl.generated_cols = compiled
 
     def _exec_alter(self, stmt: A.AlterTable) -> ResultSet:
         tbl = self.domain.catalog.get_table(self.db, stmt.table)
@@ -1187,7 +1334,14 @@ class Session:
         else:
             rows = [tuple(self._literal_value(v) for v in r)
                     for r in stmt.rows]
+        gen_names = {tbl.col_names[i]
+                     for i, _ in getattr(tbl, "generated_cols", [])}
         if stmt.columns:
+            for n in stmt.columns:
+                if n in gen_names:
+                    raise PlanError(
+                        f"The value specified for generated column {n!r} "
+                        "in table is not allowed")
             idx = {n: i for i, n in enumerate(stmt.columns)}
             full = []
             for r in rows:
@@ -1196,6 +1350,15 @@ class Session:
                 full.append(tuple(
                     r[idx[n]] if n in idx else None for n in tbl.col_names))
             rows = full
+        elif gen_names:
+            # positional inserts must leave generated slots NULL/DEFAULT
+            gidx = [i for i, _ in tbl.generated_cols]
+            for r in rows:
+                for i in gidx:
+                    if i < len(r) and r[i] is not None:
+                        raise PlanError(
+                            "The value specified for generated column "
+                            f"{tbl.col_names[i]!r} in table is not allowed")
         if stmt.on_dup:
             write = lambda txn: self._insert_on_dup(tbl, rows,
                                                     stmt.on_dup, txn)
@@ -2079,7 +2242,15 @@ class Session:
         if isinstance(node, A.Unary) and node.op == "-":
             v = self._literal_value(node.arg)
             return -v if not isinstance(v, str) else "-" + v
-        raise PlanError("INSERT values must be literals")
+        # general scalar expressions in VALUES: NOW(), NEXTVAL(seq),
+        # arithmetic... evaluated through the expression engine
+        # (the reference's insert value expression eval)
+        try:
+            return plainify(self._eval_scalar(node))
+        except PlanError:
+            raise
+        except Exception as e:
+            raise PlanError(f"unsupported INSERT value expression: {e}")
 
 
 

@@ -219,6 +219,8 @@ class ColumnDef(Node):
     collation: str = ""             # COLLATE clause ('' = table/charset default)
     members: tuple = ()             # ENUM('a','b') / SET(...) member list
     references: Optional[tuple] = None  # (ref_table, ref_col, on_delete)
+    generated: Optional[Node] = None    # [GENERATED ALWAYS] AS (expr)
+    generated_stored: bool = False      # STORED vs VIRTUAL
 
 
 @dataclass
@@ -240,6 +242,26 @@ class CreateTable(Node):
     ttl: Optional[TTLOption] = None
     partition: Optional[PartitionSpec] = None
     foreign_keys: list = field(default_factory=list)  # [ForeignKeyDef]
+    temporary: bool = False          # CREATE TEMPORARY TABLE (session-scoped)
+
+
+@dataclass
+class CreateSequence(Node):
+    """Reference analog: pkg/ddl/sequence.go + parser sequence options."""
+    name: str
+    start: int = 1
+    increment: int = 1
+    min_value: Optional[int] = None
+    max_value: Optional[int] = None
+    cache: int = 1000
+    cycle: bool = False
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropSequence(Node):
+    name: str
+    if_exists: bool = False
 
 
 @dataclass
@@ -319,6 +341,7 @@ class DropView(Node):
 class DropTable(Node):
     names: list[str] = field(default_factory=list)
     if_exists: bool = False
+    temporary: bool = False      # DROP TEMPORARY TABLE: temp scope ONLY
 
 
 @dataclass
@@ -436,6 +459,9 @@ class TxnStmt(Node):
 @dataclass
 class AnalyzeTable(Node):
     name: str = ""
+    columns: list = field(default_factory=list)   # ANALYZE ... COLUMNS c,...
+    predicate_columns: bool = False               # ... PREDICATE COLUMNS
+    sample_rate: Optional[float] = None           # WITH r SAMPLERATE
 
 
 @dataclass

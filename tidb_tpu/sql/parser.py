@@ -205,7 +205,24 @@ class Parser:
         if self.at_kw("ANALYZE"):
             self.advance()
             self.expect_kw("TABLE")
-            return A.AnalyzeTable(self.ident())
+            an = A.AnalyzeTable(self.ident())
+            if self._accept_word("PREDICATE"):
+                if not self._accept_word("COLUMNS"):
+                    raise ParseError("expected COLUMNS after PREDICATE",
+                                     self.cur)
+                an.predicate_columns = True
+            elif self._accept_word("COLUMNS"):
+                an.columns = [self.ident()]
+                while self.accept_op(","):
+                    an.columns.append(self.ident())
+            if self.accept_kw("WITH"):
+                t = self.advance()
+                if t.kind not in ("int", "float", "decimal"):
+                    raise ParseError("expected a sample rate", t)
+                if not self._accept_word("SAMPLERATE"):
+                    raise ParseError("expected SAMPLERATE", self.cur)
+                an.sample_rate = float(t.text)
+            return an
         if self.cur.kind == "ident" and self.cur.text.upper() in (
                 "PREPARE", "EXECUTE", "DEALLOCATE"):
             return self._prepare_family()
@@ -728,13 +745,16 @@ class Parser:
             return A.CreateIndex(name, table, cols, unique, ine)
         if unique:
             raise ParseError("expected INDEX after CREATE UNIQUE", self.cur)
+        if self._accept_word("SEQUENCE"):
+            return self._create_sequence()
+        temporary = self._accept_word("TEMPORARY")
         self.expect_kw("TABLE")
         ine = self._if_not_exists()
         name = self.ident()
         if self.accept_op("."):
             name = self.ident()  # db-qualified; db ignored round 1
         self.expect_op("(")
-        ct = A.CreateTable(name, if_not_exists=ine)
+        ct = A.CreateTable(name, if_not_exists=ine, temporary=temporary)
         while True:
             if self.at_kw("PRIMARY"):
                 self.advance()
@@ -957,6 +977,49 @@ class Parser:
             return True
         return False
 
+    def _create_sequence(self) -> "A.CreateSequence":
+        """CREATE SEQUENCE name [START [WITH] n] [INCREMENT [BY] n]
+        [MINVALUE n | NOMINVALUE] [MAXVALUE n | NOMAXVALUE]
+        [CACHE n | NOCACHE] [CYCLE | NOCYCLE]
+        (reference: parser sequence options, ddl/sequence.go)."""
+        ine = self._if_not_exists()
+        cs = A.CreateSequence(self.ident(), if_not_exists=ine)
+
+        def int_val() -> int:
+            neg = self.accept_op("-")
+            t = self.advance()
+            if t.kind != "int":
+                raise ParseError("expected integer sequence option", t)
+            return -int(t.text) if neg else int(t.text)
+
+        while self.cur.kind in ("kw", "ident"):
+            w = self.cur.text.upper()
+            if w == "START":
+                self.advance()
+                self._accept_word("WITH")
+                cs.start = int_val()
+            elif w == "INCREMENT":
+                self.advance()
+                self._accept_word("BY")
+                cs.increment = int_val()
+            elif w == "MINVALUE":
+                self.advance()
+                cs.min_value = int_val()
+            elif w == "MAXVALUE":
+                self.advance()
+                cs.max_value = int_val()
+            elif w in ("NOMINVALUE", "NOMAXVALUE", "NOCACHE", "NOCYCLE"):
+                self.advance()
+            elif w == "CACHE":
+                self.advance()
+                cs.cache = max(int_val(), 1)
+            elif w == "CYCLE":
+                self.advance()
+                cs.cycle = True
+            else:
+                break
+        return cs
+
     def column_def(self) -> A.ColumnDef:
         name = self.ident()
         tname, prec, scale = self.type_name()
@@ -979,6 +1042,21 @@ class Parser:
                 cd.default = self.expr()
             elif self.accept_kw("AUTO_INCREMENT"):
                 cd.auto_increment = True
+            elif (self.at_kw("AS")
+                  or (self.cur.kind in ("kw", "ident")
+                      and self.cur.text.upper() == "GENERATED")):
+                # [GENERATED ALWAYS] AS (expr) [VIRTUAL|STORED]
+                if not self.at_kw("AS"):
+                    self.advance()           # GENERATED
+                    self._accept_word("ALWAYS")
+                self.expect_kw("AS")
+                self.expect_op("(")
+                cd.generated = self.expr()
+                self.expect_op(")")
+                if self._accept_word("STORED"):
+                    cd.generated_stored = True
+                else:
+                    self._accept_word("VIRTUAL")
             elif self.accept_kw("COMMENT"):
                 self.advance()  # string
             elif self.at_kw("CHARACTER"):
@@ -1091,6 +1169,12 @@ class Parser:
         if self.accept_kw("DATABASE"):
             ie = self.accept_kw("IF") and self.expect_kw("EXISTS") is not None
             return A.DropDatabase(self.ident(), ie)
+        if self._accept_word("SEQUENCE"):
+            ie = False
+            if self.accept_kw("IF"):
+                self.expect_kw("EXISTS")
+                ie = True
+            return A.DropSequence(self.ident(), ie)
         if self.accept_kw("INDEX"):
             ie = False
             if self.accept_kw("IF"):
@@ -1112,6 +1196,7 @@ class Parser:
             while self.accept_op(","):
                 names.append(self.ident())
             return A.DropView(names, ie)
+        temporary = self._accept_word("TEMPORARY")
         self.expect_kw("TABLE")
         ie = False
         if self.accept_kw("IF"):
@@ -1120,7 +1205,7 @@ class Parser:
         names = [self.ident()]
         while self.accept_op(","):
             names.append(self.ident())
-        return A.DropTable(names, ie)
+        return A.DropTable(names, ie, temporary)
 
     def insert_stmt(self, replace: bool = False) -> A.Insert:
         ignore = False

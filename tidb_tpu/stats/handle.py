@@ -87,11 +87,21 @@ class StatsHandle:
 
     AUTO_ANALYZE_RATIO = 0.5       # tidb_auto_analyze_ratio default
     AUTO_ANALYZE_MIN_COUNT = 1000  # reference: autoAnalyzeMinCnt
+    # above this row count ANALYZE samples instead of full-scanning
+    # (reference: row_sampler.go ReservoirRowSampleCollector)
+    SAMPLE_THRESHOLD = 2_000_000
+    SAMPLE_TARGET = 200_000
 
     def __init__(self):
         self._cache: dict[int, TableStats] = {}
         self._lock = threading.Lock()
         self.auto_analyze_enabled = True
+        # predicate-column tracking (tidb_enable_column_tracking /
+        # column_stats_usage): which columns queries actually filter on
+        self._pred_cols: dict[int, set] = {}
+        # async stats load (handle/syncload analog): tables whose first
+        # plan found no stats get analyzed in the background
+        self._loading: set = set()
 
     # ------------------------------------------------------------ #
 
@@ -126,16 +136,98 @@ class StatsHandle:
 
     # ------------------------------------------------------------ #
 
+    # -- predicate-column tracking + async load --------------------- #
+
+    def note_predicate_columns(self, table, names) -> None:
+        """Record columns that appeared in query predicates; ANALYZE
+        TABLE ... PREDICATE COLUMNS restricts collection to this set
+        (reference: column_stats_usage.go)."""
+        if not names:
+            return
+        with self._lock:
+            self._pred_cols.setdefault(self._key(table), set()).update(
+                n.lower() for n in names)
+
+    def predicate_columns(self, table) -> set:
+        return set(self._pred_cols.get(self._key(table), ()))
+
+    def request_load(self, table) -> bool:
+        """Async stats load (handle/syncload analog): schedule a
+        background ANALYZE for a planned-against table with no stats;
+        the current plan proceeds on defaults.  Returns True if
+        scheduled."""
+        key = self._key(table)
+        with self._lock:
+            if key in self._cache or key in self._loading:
+                return False
+            if getattr(table, "num_rows", 0) < self.AUTO_ANALYZE_MIN_COUNT:
+                return False
+            self._loading.add(key)
+
+        def run():
+            try:
+                self.analyze_table(table)
+            except Exception:
+                pass
+            finally:
+                with self._lock:
+                    self._loading.discard(key)
+
+        threading.Thread(target=run, name="stats-async-load",
+                         daemon=True).start()
+        return True
+
+    # ------------------------------------------------------------ #
+
     def analyze_table(self, table, n_buckets: int = 64,
-                      n_top: int = 16) -> TableStats:
-        """ANALYZE TABLE: device-build stats for every analyzable column."""
+                      n_top: int = 16, columns=None,
+                      sample_rate: Optional[float] = None,
+                      predicate_only: bool = False) -> TableStats:
+        """ANALYZE TABLE: device-build stats for every analyzable column.
+
+        Large tables sample (systematic row sample, scaled estimates with
+        the Duj1 NDV estimator — row_sampler.go's role); `columns`
+        restricts collection; `predicate_only` restricts to the tracked
+        predicate columns (ANALYZE ... PREDICATE COLUMNS)."""
         snap = table.snapshot()
         cols = snap.columns
         n = len(cols[0]) if cols else 0
+        want = None
+        if predicate_only:
+            want = self.predicate_columns(table)
+            if not want and not columns:
+                # nothing tracked yet: keep whatever stats exist (TiDB
+                # analyzes nothing rather than erasing)
+                return self.get(table) or TableStats(
+                    table_id=self._key(table), version=time.time_ns(),
+                    count=n)
+        if columns:
+            want = {c.lower() for c in columns} | (want or set())
+        if sample_rate is None and n > self.SAMPLE_THRESHOLD:
+            sample_rate = self.SAMPLE_TARGET / n
+        idx = None
+        scale = 1.0
+        if n and sample_rate is not None and 0 < sample_rate < 1.0:
+            m = max(int(n * sample_rate), 1)
+            step = max(n // m, 1)
+            rng = np.random.default_rng(n)
+            idx = (np.arange(m) * step
+                   + rng.integers(0, step, m)).clip(0, n - 1)
+            scale = n / m
         ts = TableStats(table_id=self._key(table),
                         version=time.time_ns(), count=n)
+        if want is not None:
+            # column-restricted analyze MERGES into existing stats
+            # (TiDB keeps unlisted columns' histograms)
+            prev = self.get(table)
+            if prev is not None:
+                ts.cols.update(prev.cols)
         for name, col in zip(table.col_names, cols):
-            cs = self._analyze_column(name, col, n_buckets, n_top)
+            if want is not None and name.lower() not in want:
+                continue
+            c = col.take(idx) if idx is not None else col
+            cs = self._analyze_column(name, c, n_buckets, n_top,
+                                      scale=scale)
             if cs is not None:
                 ts.cols[name.lower()] = cs
         with self._lock:
@@ -143,7 +235,8 @@ class StatsHandle:
         return ts
 
     def _analyze_column(self, name: str, col: Column, n_buckets: int,
-                        n_top: int) -> Optional[ColumnStats]:
+                        n_top: int,
+                        scale: float = 1.0) -> Optional[ColumnStats]:
         if len(col) == 0:
             empty = Histogram(np.array([], np.int64), np.array([], np.int64),
                               np.array([], np.int64))
@@ -153,6 +246,25 @@ class StatsHandle:
                                0, 0, 0)
         raw = build_column_stats(col.data, col.validity, n_buckets, n_top)
         ndv = int(raw["ndv"])
+        if scale > 1.0:
+            # sampled build: scale counts, estimate full-table NDV with
+            # the Duj1 estimator d / (1 - (1-q) f1/n) from the singleton
+            # count (statistics/row_sampler.go calculateEstimateNDV)
+            vals = col.data[col.validity]
+            n_s = len(vals)
+            if n_s:
+                _u, cnts = np.unique(vals, return_counts=True)
+                f1 = int((cnts == 1).sum())
+                denom = 1.0 - (1.0 - 1.0 / scale) * f1 / n_s
+                est = ndv / max(denom, 1e-3)
+                ndv = int(round(min(max(est, ndv),
+                                    int(raw["count"]) * scale)))
+            raw = dict(raw)
+            for k in ("cum_counts", "repeats", "top_counts", "cm"):
+                raw[k] = np.round(raw[k] * scale).astype(np.int64)
+            raw["count"] = np.int64(round(int(raw["count"]) * scale))
+            raw["null_count"] = np.int64(
+                round(int(raw["null_count"]) * scale))
         hist = Histogram(raw["bounds"], raw["cum_counts"], raw["repeats"],
                          ndv=ndv, null_count=int(raw["null_count"]),
                          min_val=(int(raw["min_val"])
