@@ -153,6 +153,46 @@ def test_temp_table_shadows_permanent():
     assert s1.execute("SELECT a FROM sh").rows == [(100,)]
 
 
+def test_sequence_and_gencol_survive_restart(tmp_path):
+    """Catalog-on-KV: sequence definitions and generated-column
+    expressions reload at domain init (meta.go analog)."""
+    d = str(tmp_path / "data")
+    dom = Domain(data_dir=d)
+    s = Session(dom)
+    s.execute("CREATE SEQUENCE sq START WITH 100")
+    s.execute("CREATE TABLE g (a INT, c INT AS (a * 10) STORED)")
+    s.execute("INSERT INTO g (a) VALUES (1)")
+    v1 = s.execute("SELECT NEXTVAL(sq)").rows[0][0]
+    dom2 = Domain(data_dir=d)
+    s2 = Session(dom2)
+    assert s2.execute("SELECT NEXTVAL(sq)").rows[0][0] > v1
+    s2.execute("INSERT INTO g (a) VALUES (7)")
+    assert s2.execute("SELECT a, c FROM g ORDER BY a").rows == \
+        [(1, 10), (7, 70)]
+    with pytest.raises(Exception):
+        s2.execute("INSERT INTO g (a, c) VALUES (9, 1)")
+
+
+def test_temp_table_index_ddl_stays_in_session():
+    """CREATE INDEX on a temp table must index the TEMP table (never the
+    shadowed permanent one) and never reach the DDL owner thread."""
+    dom = Domain()
+    s = Session(dom)
+    s.execute("CREATE TABLE ix (a INT)")          # permanent
+    s.execute("CREATE TEMPORARY TABLE ix (a INT)")
+    s.execute("INSERT INTO ix VALUES (1),(2)")
+    s.execute("CREATE INDEX ia ON ix (a)")
+    tmp = s.temp_tables[("test", "ix")]
+    perm = dom.catalog.databases["test"]["ix"]
+    assert tmp.index_by_name("ia") is not None
+    assert perm.index_by_name("ia") is None
+    s.execute("ALTER TABLE ix ADD INDEX ib (a)")
+    assert tmp.index_by_name("ib") is not None
+    assert perm.index_by_name("ib") is None
+    s.execute("ALTER TABLE ix DROP INDEX ib")
+    assert tmp.index_by_name("ib") is None
+
+
 def test_temp_table_dropped_on_close():
     dom = Domain()
     s1 = Session(dom)

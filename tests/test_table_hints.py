@@ -1,0 +1,63 @@
+"""Table-factor index hints (USE/IGNORE/FORCE INDEX) + db-qualified DDL/DML.
+
+Reference analogs: parser table hints -> planner/util AccessPath pruning
+(planner/core/logical_plan_builder.go getPossibleAccessPaths), and
+qualified table names on every statement kind.
+"""
+
+import pytest
+
+from tidb_tpu.session import Session
+
+
+@pytest.fixture
+def sess():
+    s = Session()
+    s.execute("CREATE TABLE h (k BIGINT PRIMARY KEY, v BIGINT, "
+              "INDEX iv (v))")
+    s.execute("INSERT INTO h VALUES " + ",".join(
+        f"({i},{i % 50})" for i in range(2000)))
+    return s
+
+
+def _plan(sess, sql):
+    return "\n".join(r[0] for r in sess.execute("EXPLAIN " + sql).rows)
+
+
+def test_use_index_forces_path(sess):
+    assert "IndexLookUp" in _plan(
+        sess, "SELECT * FROM h USE INDEX (iv) WHERE v = 3")
+
+
+def test_ignore_index_forbids_path(sess):
+    assert "IndexLookUp" not in _plan(
+        sess, "SELECT * FROM h IGNORE INDEX (iv) WHERE v = 3")
+
+
+def test_force_index(sess):
+    assert "IndexLookUp" in _plan(
+        sess, "SELECT * FROM h FORCE INDEX (iv) WHERE v = 3")
+
+
+def test_hints_do_not_change_results(sess):
+    a = sess.execute("SELECT COUNT(*) FROM h USE INDEX (iv) "
+                     "WHERE v = 3").rows
+    b = sess.execute("SELECT COUNT(*) FROM h IGNORE INDEX (iv) "
+                     "WHERE v = 3").rows
+    assert a == b == [(40,)]
+
+
+def test_use_index_key_spelling(sess):
+    assert "IndexLookUp" in _plan(
+        sess, "SELECT * FROM h USE KEY (iv) WHERE v = 3")
+
+
+def test_qualified_ddl_dml():
+    s = Session()
+    s.execute("CREATE DATABASE qd")
+    s.execute("CREATE TABLE qd.x (a INT)")
+    s.execute("INSERT INTO qd.x VALUES (5),(6)")
+    s.execute("UPDATE qd.x SET a = 7 WHERE a = 5")
+    s.execute("DELETE FROM qd.x WHERE a = 6")
+    assert s.execute("SELECT * FROM qd.x").rows == [(7,)]
+    assert s.db == "test"           # current db untouched

@@ -445,7 +445,11 @@ class Evaluator:
     def op_seq_set(self, e, cols, memo):
         seq = e.args[0].value
         v, m = self._num(e.args[1], cols, memo)
-        if m is not True:
+        if getattr(v, "ndim", 0) and np.asarray(v).size != 1:
+            raise ValueError("SETVAL takes a constant value, "
+                             "not a per-row expression")
+        if m is not True and not (np.asarray(m).reshape(-1)[:1].all()
+                                  if getattr(m, "ndim", 0) else bool(m)):
             return self.xp.int64(0), False
         val = int(v if not getattr(v, "ndim", 0) else np.asarray(v).item())
         out = seq.set_value(val, self._seq_conn())

@@ -2218,6 +2218,16 @@ def _build_from(node: A.Node, catalog, default_db: str,
         ds = DataSource(tbl, alias, sch, list(range(len(tbl.col_names))))
         if node.as_of is not None:
             ds.as_of_ts = _resolve_as_of(tbl, node.as_of)
+        for kind, names in getattr(node, "index_hints", []):
+            # table-factor hints (FROM t USE INDEX (ix)): same plumbing
+            # as the /*+ USE_INDEX */ optimizer hints; FORCE == USE here
+            low = [x.lower() for x in names]
+            if kind in ("use", "force"):
+                ds.hint_use = (ds.hint_use or []) + low if low else []
+                if not low:
+                    ds.hint_use = []    # USE INDEX (): forbid all indexes
+            else:
+                ds.hint_ignore = (ds.hint_ignore or []) + low
         return ds
     if isinstance(node, A.SubqueryRef):
         built = build_query(node.select, catalog, default_db, ctes)

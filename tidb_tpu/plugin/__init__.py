@@ -1,20 +1,26 @@
-"""Plugin framework: audit/extension hook points.
+"""Plugin framework: audit / authentication / schema / daemon kinds.
 
-Reference analog: pkg/plugin (audit plugins with OnGeneralEvent /
-OnConnectionEvent) and pkg/extension (the function/event extension
-points).  A plugin is any object exposing a subset of the hook methods;
-hooks fire synchronously on the statement path, and a misbehaving plugin
-is isolated (its exceptions are recorded, not propagated) — the
-reference's plugin sandboxing contract.
+Reference analog: pkg/plugin — the four plugin kinds (Audit, Authentication,
+Schema, Daemon; plugin/spi.go AuditManifest/AuthenticationManifest/
+SchemaManifest/DaemonManifest) and pkg/extension.  A plugin is any object
+exposing a subset of the hook methods; hooks fire synchronously, and a
+misbehaving plugin is isolated (its exceptions are recorded, not
+propagated) — the reference's plugin sandboxing contract.
 
-    class MyAudit:
-        name = "my-audit"
-        def on_connection(self, event, conn_id, user): ...
-        def on_stmt_begin(self, sess, sql): ...
-        def on_stmt_end(self, sess, sql, error, elapsed_sec, rows): ...
+Hooks by kind:
+
+    Audit           on_connection(event, conn_id, user)
+                    on_stmt_begin(sess, sql)
+                    on_stmt_end(sess, sql, error, elapsed_sec, rows)
+    Authentication  authenticate(user, host) -> True | False | None
+                    (None = no opinion; False vetoes a login the builtin
+                    check accepted — plugin/spi.go OnUserAuthenticated)
+    Schema          on_ddl(event, db, sql)    (OnSchemaChange analog)
+    Daemon          start(domain) / stop()    (background service
+                    lifecycle owned by the server, DaemonManifest)
 
     from tidb_tpu.plugin import registry
-    registry.register(MyAudit())
+    registry.register(MyPlugin())
 """
 
 from __future__ import annotations
@@ -59,6 +65,47 @@ class PluginRegistry:
             except Exception as e:       # noqa: BLE001 - isolation
                 with self._mu:
                     self.errors.append((p.name, f"{hook}: {e}"))
+
+    # -- authentication kind (veto semantics) ----------------------- #
+
+    def check_auth(self, user: str, host: str = "%"):
+        """Consult authentication plugins; the first non-None answer
+        wins.  False vetoes the login even when the builtin credential
+        check passed; a plugin failure abstains (fail-open like the
+        builtin-path isolation, recorded in .errors)."""
+        for p in self.plugins():
+            fn = getattr(p, "authenticate", None)
+            if fn is None:
+                continue
+            try:
+                out = fn(user, host)
+            except Exception as e:       # noqa: BLE001 - isolation
+                with self._mu:
+                    self.errors.append((p.name, f"authenticate: {e}"))
+                continue
+            if out is not None:
+                return bool(out)
+        return None
+
+    # -- daemon kind (lifecycle owned by the server) ---------------- #
+
+    def start_daemons(self, domain) -> None:
+        for p in self.plugins():
+            if hasattr(p, "start"):
+                try:
+                    p.start(domain)
+                except Exception as e:   # noqa: BLE001
+                    with self._mu:
+                        self.errors.append((p.name, f"start: {e}"))
+
+    def stop_daemons(self) -> None:
+        for p in self.plugins():
+            if hasattr(p, "stop"):
+                try:
+                    p.stop()
+                except Exception as e:   # noqa: BLE001
+                    with self._mu:
+                        self.errors.append((p.name, f"stop: {e}"))
 
 
 registry = PluginRegistry()

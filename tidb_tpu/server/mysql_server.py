@@ -465,6 +465,10 @@ class MySQLServer:
     # -------------------------------------------------------------- #
 
     def authenticate(self, user: str, auth: bytes, salt: bytes):
+        from ..plugin import registry as _plugins
+        veto = _plugins.check_auth(user)
+        if veto is False:        # authentication plugin kind: hard veto
+            return False, f"Access denied for user '{user}' (plugin)"
         priv = getattr(self.domain, "privileges", None)
         if priv is not None:
             return priv.authenticate(user, auth, salt)
@@ -490,6 +494,8 @@ class MySQLServer:
 
     def start(self) -> int:
         """Bind + start the accept thread; returns the bound port."""
+        from ..plugin import registry as _plugins
+        _plugins.start_daemons(self.domain)    # daemon plugin kind
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((self.host, self.port))
@@ -521,6 +527,8 @@ class MySQLServer:
     def close(self, timeout: float = 5.0):
         """Graceful shutdown: stop accepting, wait for live conns
         (server.go graceful shutdown analog)."""
+        from ..plugin import registry as _plugins
+        _plugins.stop_daemons()
         self._closing = True
         if self._listener is not None:
             # shutdown() interrupts a thread blocked in accept() — close()

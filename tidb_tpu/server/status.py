@@ -110,7 +110,95 @@ class StatusServer:
         if path == "/metrics":
             from ..utils.metrics import global_registry
             return global_registry().prometheus_text(), "text/plain"
+        if path == "/settings":
+            # handler/settings analog: live global sysvars
+            return json.dumps(dict(sorted(
+                self.domain.sysvars.items()))), "application/json"
+        if path == "/regions/meta":
+            # region/shard topology introspection
+            # (handler/tikv_handler.go RegionsMeta analog)
+            out = []
+            for db, tables in self.domain.catalog.databases.items():
+                for name, tbl in tables.items():
+                    snap = tbl.snapshot()
+                    s, cap, counts = snap.shard_layout()
+                    ent = {"db": db, "table": name,
+                           "table_id": tbl.table_id,
+                           "rows": snap.num_rows, "shards": s,
+                           "shard_capacity": cap}
+                    if snap.placement is not None:
+                        ent["placement"] = [
+                            {"shard": i, "store": sh.store,
+                             "range": [sh.lo, sh.hi]}
+                            for i, sh in enumerate(snap.placement.shards)]
+                    out.append(ent)
+            return json.dumps(out), "application/json"
+        if path.startswith("/mvcc/key/"):
+            # MVCC version history of one row key
+            # (handler/tikv_handler.go MvccTxnHandler analog)
+            parts = path.split("/")[3:]
+            if len(parts) != 3:
+                raise KeyError(path)
+            db, table, handle = parts[0], parts[1], int(parts[2])
+            tbl = self.domain.catalog.get_table(db, table)
+            return json.dumps(self._mvcc_versions(tbl, handle)), \
+                "application/json"
+        if path == "/ddl/history":
+            # handler/ddl history analog: persisted job records
+            jobs = []
+            try:
+                for j in self.domain.ddl.storage.history():
+                    jobs.append({"job_id": j.job_id, "type": j.job_type,
+                                 "state": j.state, "table": j.table,
+                                 "error": j.error})
+            except Exception:
+                pass
+            return json.dumps(jobs), "application/json"
+        if path == "/schema_version":
+            ver = getattr(self.domain, "schema_version", None)
+            if callable(ver):
+                ver = ver()
+            return json.dumps({"schema_version": ver}), "application/json"
         raise KeyError(path)
+
+    def _mvcc_versions(self, tbl, handle: int, max_versions: int = 8):
+        """Version history of a record key, recovered by bisecting the
+        timestamp axis over MVCC reads (each version's commit ts is the
+        smallest ts at which its value becomes visible)."""
+        from ..store.codec import decode_row, record_key
+        kv = tbl.kv
+        if kv is None:
+            return {"error": "table has no KV store (bulk mode)"}
+        key = record_key(tbl.table_id, handle)
+        hi = kv.alloc_ts()
+        out = []
+        cur = kv.get(key, hi)
+        while len(out) < max_versions:
+            # smallest ts with this value = its commit ts
+            lo, h = 1, hi
+            while lo < h:
+                mid = (lo + h) // 2
+                if kv.get(key, mid) == cur:
+                    h = mid
+                else:
+                    lo = mid + 1
+            if cur is None and lo <= 1:
+                break           # before the row's creation, not a delete
+            ent = {"commit_ts": lo}
+            if cur is None:
+                ent["deleted"] = True
+            else:
+                try:
+                    ent["row"] = [str(v) for v in
+                                  decode_row(cur, tbl.col_types)]
+                except Exception:
+                    ent["value_len"] = len(cur)
+            out.append(ent)
+            if lo <= 1:
+                break
+            hi = lo - 1
+            cur = kv.get(key, hi)
+        return {"key": key.hex(), "versions": out}
 
 
 __all__ = ["StatusServer"]

@@ -21,6 +21,7 @@ from .catalog import Catalog, IndexInfo, TableInfo
 
 M_DB = b"m\x00db\x00"
 M_TBL = b"m\x00tbl\x00"
+M_SEQ = b"m\x00seq\x00"     # sequence definitions (values live at m_seq_)
 M_MAXID = b"m\x00maxid"     # high-water table id incl. dropped tables
 
 
@@ -61,6 +62,11 @@ def encode_table(tbl: TableInfo) -> bytes:
         "next_index_id": tbl._next_index_id,
         "n_shards": tbl.n_shards,
         "ttl": [tbl.ttl_col, tbl.ttl_interval_sec, tbl.ttl_enable],
+        # generated columns: compiled IR pickled (internal format; the
+        # IR is a frozen dataclass tree over stable dtypes)
+        "gen": [[i, __import__("base64").b64encode(
+                     __import__("pickle").dumps(ir)).decode()]
+                for i, ir in getattr(tbl, "generated_cols", [])],
     }).encode()
 
 
@@ -77,6 +83,11 @@ def decode_table(data: bytes, kv) -> TableInfo:
     tbl._next_index_id = d["next_index_id"]
     tbl.n_shards = d["n_shards"]
     tbl.ttl_col, tbl.ttl_interval_sec, tbl.ttl_enable = d["ttl"]
+    if d.get("gen"):
+        import base64
+        import pickle
+        tbl.generated_cols = [(i, pickle.loads(base64.b64decode(b)))
+                              for i, b in d["gen"]]
     # handle/auto-inc counters recover lazily from the data on first
     # write (MySQL restart semantics: AUTO_INCREMENT resumes at max+1)
     tbl._needs_counter_recovery = True
@@ -144,6 +155,18 @@ class MetaStore:
         v = self.kv.get(M_MAXID, self.kv.alloc_ts())
         return int(v) if v else 0
 
+    def save_sequence(self, db: str, seq) -> None:
+        self._put(M_SEQ + db.encode() + b"\x00" + seq.name.encode(),
+                  json.dumps({
+                      "name": seq.name, "start": seq.start,
+                      "increment": seq.increment,
+                      "min_value": seq.min_value,
+                      "max_value": seq.max_value,
+                      "cache": seq.cache, "cycle": seq.cycle}).encode())
+
+    def drop_sequence(self, db: str, name: str) -> None:
+        self._put(M_SEQ + db.encode() + b"\x00" + name.encode(), None)
+
     def load_catalog(self, catalog: Catalog) -> int:
         """Rebuild the in-memory catalog from KV at startup (infoschema
         load at domain init, domain.go:146 analog).  Returns #tables."""
@@ -159,6 +182,16 @@ class MetaStore:
             catalog.databases.setdefault(db, {})[tbl.name] = tbl
             tbl._meta_hook = (lambda t=tbl, d=db: self.save_table(d, t))
             n += 1
+        from .catalog import SequenceInfo
+        for k, v in self.kv.scan(M_SEQ, M_SEQ + b"\xff", ts):
+            db, _name = k[len(M_SEQ):].decode().split("\x00", 1)
+            d = json.loads(v)
+            seq = SequenceInfo(d["name"], db, start=d["start"],
+                               increment=d["increment"],
+                               min_value=d["min_value"],
+                               max_value=d["max_value"], cache=d["cache"],
+                               cycle=d["cycle"], kv=self.kv)
+            catalog.sequences[(db, seq.name)] = seq
         return n
 
 
@@ -193,10 +226,26 @@ def attach(catalog: Catalog, kv) -> MetaStore:
         if tbl is not None:
             meta.drop_table(db, name, tbl)
 
+    orig_create_seq = catalog.create_sequence
+    orig_drop_seq = catalog.drop_sequence
+
+    def create_sequence(db, seq, if_not_exists=False):
+        orig_create_seq(db, seq, if_not_exists)
+        if catalog.sequences.get((db, seq.name)) is seq:
+            meta.save_sequence(db, seq)
+
+    def drop_sequence(db, name, if_exists=False):
+        existed = (db, name) in catalog.sequences
+        orig_drop_seq(db, name, if_exists)
+        if existed:
+            meta.drop_sequence(db, name)
+
     catalog.create_database = create_database
     catalog.drop_database = drop_database
     catalog.create_table = create_table
     catalog.drop_table = drop_table
+    catalog.create_sequence = create_sequence
+    catalog.drop_sequence = drop_sequence
     return meta
 
 

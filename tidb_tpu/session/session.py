@@ -411,12 +411,23 @@ class Session:
                         "CreateDatabase", "DropDatabase", "CreateUser",
                         "AlterUser", "DropUser", "GrantStmt", "RevokeStmt")
 
+    _DDL_STMTS = ("CreateTable", "DropTable", "CreateIndex", "DropIndex",
+                  "AlterTable", "TruncateTable", "CreateDatabase",
+                  "DropDatabase", "CreateSequence", "DropSequence",
+                  "CreateView", "DropView")
+
     def _exec_stmt(self, stmt: A.Node) -> ResultSet:
         self._check_privileges(stmt)
         if (self.txn is not None
                 and type(stmt).__name__ in self._IMPLICIT_COMMIT):
             # MySQL semantics: DDL implicitly commits the open transaction
             self._finish_txn(commit=True)
+        if type(stmt).__name__ in self._DDL_STMTS:
+            # schema plugin kind (plugin/spi.go SchemaManifest
+            # OnSchemaChange): observe every DDL on its way in
+            from ..plugin import registry as _plugins
+            _plugins.fire("on_ddl", type(stmt).__name__, self.db,
+                          self._cur_sql or "")
         if isinstance(stmt, (A.CreateUser, A.AlterUser, A.DropUser,
                              A.GrantStmt, A.RevokeStmt, A.FlushStmt)):
             return self._exec_user_admin(stmt)
@@ -445,7 +456,7 @@ class Session:
                 raise PlanError(str(e))
             return ResultSet()
         if isinstance(stmt, A.SplitTable):
-            tbl = self.domain.catalog.get_table(self.db, stmt.table)
+            tbl = self.domain.catalog.get_table(getattr(stmt, 'db', None) or self.db, stmt.table)
             tbl.split_regions(stmt.regions)
             return ResultSet(affected=stmt.regions)
         if isinstance(stmt, A.SetResourceGroup):
@@ -539,13 +550,28 @@ class Session:
             self.db = stmt.name
             return ResultSet()
         if isinstance(stmt, A.CreateIndex):
-            self.domain.catalog.get_table(self.db, stmt.table)  # exist check
+            self.domain.catalog.get_table(getattr(stmt, 'db', None) or self.db, stmt.table)  # exist check
+            tmp = self.temp_tables.get((self.db, stmt.table))
+            if tmp is not None:
+                # session temp tables never reach the (session-agnostic)
+                # DDL owner thread: index synchronously, no online ladder
+                tmp.create_index(stmt.name, list(stmt.columns),
+                                 stmt.unique, stmt.if_not_exists)
+                return ResultSet()
             self.domain.ddl.run_job("add index", self.db, stmt.table, {
                 "name": stmt.name, "columns": list(stmt.columns),
                 "unique": stmt.unique, "if_not_exists": stmt.if_not_exists})
             return ResultSet()
         if isinstance(stmt, A.DropIndex):
-            self.domain.catalog.get_table(self.db, stmt.table)
+            self.domain.catalog.get_table(getattr(stmt, 'db', None) or self.db, stmt.table)
+            tmp = self.temp_tables.get((self.db, stmt.table))
+            if tmp is not None:
+                ix = tmp.index_by_name(stmt.name)
+                if ix is not None:
+                    tmp.indexes.remove(ix)
+                elif not stmt.if_exists:
+                    raise CatalogError(f"unknown index {stmt.name!r}")
+                return ResultSet()
             self.domain.ddl.run_job("drop index", self.db, stmt.table, {
                 "name": stmt.name, "if_exists": stmt.if_exists})
             return ResultSet()
@@ -1125,6 +1151,7 @@ class Session:
                                      tbl.schema_ver)
 
     def _exec_create_table(self, stmt: A.CreateTable) -> ResultSet:
+        db = stmt.db or self.db
         names, types = [], []
         auto_inc = None
         for c in stmt.columns:
@@ -1171,7 +1198,7 @@ class Session:
                     raise CatalogError(
                         "FOREIGN KEY columns must be integer typed")
                 parent = tbl if fk.ref_table == stmt.name else \
-                    self.domain.catalog.get_table(self.db, fk.ref_table)
+                    self.domain.catalog.get_table(db, fk.ref_table)
                 if fk.ref_column not in parent.col_names:
                     raise CatalogError(
                         f"unknown referenced column "
@@ -1183,7 +1210,6 @@ class Session:
                         "FOREIGN KEY must reference an integer column "
                         f"({fk.ref_table}.{fk.ref_column} is {pk.value})")
             tbl.foreign_keys = list(stmt.foreign_keys)
-            db = self.db
             cat = self.domain.catalog
             tbl._fk_resolver = (
                 lambda nm, _t=tbl, _db=db, _cat=cat:
@@ -1196,7 +1222,7 @@ class Session:
             # session-scoped: registered in the session overlay, never in
             # the shared catalog (reference: temptable / local temporary
             # table infoschema overlay)
-            key = (self.db, stmt.name)
+            key = (db, stmt.name)
             if key in self.temp_tables:
                 if stmt.if_not_exists:
                     return ResultSet()
@@ -1204,9 +1230,9 @@ class Session:
             self.temp_tables[key] = tbl
             created = tbl
         else:
-            self.domain.catalog.create_table(self.db, tbl,
+            self.domain.catalog.create_table(db, tbl,
                                              stmt.if_not_exists)
-            created = self.domain.catalog.get_table(self.db, stmt.name)
+            created = self.domain.catalog.get_table(db, stmt.name)
         if created is tbl:
             # implicit PRIMARY index gives PK uniqueness + the point-get
             # path (the reference's clustered-handle role, tablecodec)
@@ -1258,14 +1284,27 @@ class Session:
         tbl.generated_cols = compiled
 
     def _exec_alter(self, stmt: A.AlterTable) -> ResultSet:
-        tbl = self.domain.catalog.get_table(self.db, stmt.table)
+        tbl = self.domain.catalog.get_table(getattr(stmt, 'db', None) or self.db, stmt.table)
+        # session temp tables never reach the DDL owner thread (its
+        # catalog lookups cannot see the session overlay)
+        is_temp = self.temp_tables.get((self.db, stmt.table)) is tbl
         for act in stmt.actions:
             if act[0] == "add_index":
                 _, iname, cols, uniq = act
+                if is_temp:
+                    tbl.create_index(iname or "idx_" + "_".join(cols),
+                                     list(cols), uniq)
+                    continue
                 self.domain.ddl.run_job("add index", self.db, tbl.name, {
                     "name": iname or "idx_" + "_".join(cols),
                     "columns": list(cols), "unique": uniq})
             elif act[0] == "drop_index":
+                if is_temp:
+                    ix = tbl.index_by_name(act[1])
+                    if ix is None:
+                        raise CatalogError(f"unknown index {act[1]!r}")
+                    tbl.indexes.remove(ix)
+                    continue
                 self.domain.ddl.run_job("drop index", self.db, tbl.name,
                                         {"name": act[1]})
             elif act[0] == "add_column":
@@ -1327,7 +1366,7 @@ class Session:
             raise
 
     def _exec_insert(self, stmt: A.Insert) -> ResultSet:
-        tbl = self.domain.catalog.get_table(self.db, stmt.table)
+        tbl = self.domain.catalog.get_table(getattr(stmt, 'db', None) or self.db, stmt.table)
         if stmt.select is not None:
             res = self._exec_select(stmt.select)
             rows = [tuple(plainify(v) for v in r) for r in res.rows]
@@ -1528,7 +1567,7 @@ class Session:
         with the FIELDS/LINES options and batch-insert."""
         import csv as _csv
         import io
-        tbl = self.domain.catalog.get_table(self.db, stmt.table)
+        tbl = self.domain.catalog.get_table(getattr(stmt, 'db', None) or self.db, stmt.table)
         try:
             with open(stmt.path, "r", newline="") as f:
                 text = f.read()
@@ -1696,7 +1735,7 @@ class Session:
         return merged, mh, cols, dicts
 
     def _do_update(self, stmt: A.Update) -> ResultSet:
-        tbl = self.domain.catalog.get_table(self.db, stmt.table)
+        tbl = self.domain.catalog.get_table(getattr(stmt, 'db', None) or self.db, stmt.table)
         if self.txn is not None and getattr(self.txn, "pessimistic", False) \
                 and tbl.kv is not None:
             # pessimistic statement protocol: lock the affected record
@@ -1779,7 +1818,7 @@ class Session:
         return self._retry_write_conflict(lambda: self._do_delete(stmt))
 
     def _do_delete(self, stmt: A.Delete) -> ResultSet:
-        tbl = self.domain.catalog.get_table(self.db, stmt.table)
+        tbl = self.domain.catalog.get_table(getattr(stmt, 'db', None) or self.db, stmt.table)
         if self.txn is not None and tbl.kv is not None:
             self._txn_note_table(tbl)
         if stmt.where is None and stmt.limit is None:

@@ -140,6 +140,8 @@ class TableName(Node):
     # stale read: AS OF TIMESTAMP <literal> (sessiontxn/staleread) —
     # an int literal is a raw logical ts, a string parses as a datetime
     as_of: Optional[object] = None
+    # table-factor index hints: [('use'|'ignore'|'force', [names])]
+    index_hints: list = field(default_factory=list)
 
 
 @dataclass
@@ -234,6 +236,7 @@ class TTLOption(Node):
 @dataclass
 class CreateTable(Node):
     name: str
+    db: Optional[str] = None         # CREATE TABLE db.name
     columns: list[ColumnDef] = field(default_factory=list)
     primary_key: list[str] = field(default_factory=list)
     if_not_exists: bool = False
@@ -364,6 +367,7 @@ class UseDatabase(Node):
 @dataclass
 class Insert(Node):
     table: str = ""
+    db: Optional[str] = None
     columns: list[str] = field(default_factory=list)
     rows: list[list[Node]] = field(default_factory=list)
     select: Optional[SelectStmt] = None
@@ -391,6 +395,7 @@ class LoadData(Node):
 @dataclass
 class Update(Node):
     table: str = ""
+    db: Optional[str] = None
     assignments: list[tuple[str, Node]] = field(default_factory=list)
     where: Optional[Node] = None
     order_by: list = field(default_factory=list)   # [(expr, desc)]
@@ -400,6 +405,7 @@ class Update(Node):
 @dataclass
 class Delete(Node):
     table: str = ""
+    db: Optional[str] = None
     where: Optional[Node] = None
     order_by: list = field(default_factory=list)   # [(expr, desc)]
     limit: Optional[int] = None

@@ -681,9 +681,22 @@ class Parser:
         alias = None
         if self.accept_kw("AS"):
             alias = self.ident()
-        elif self.cur.kind == "ident":
+        elif self.cur.kind == "ident" and self.cur.text.upper() not in (
+                "USE", "IGNORE", "FORCE"):
             alias = self.ident()
-        return A.TableName(name, db, alias, as_of)
+        tn = A.TableName(name, db, alias, as_of)
+        # index hints: t USE|IGNORE|FORCE INDEX|KEY (ix, ...)
+        while (self.cur.kind in ("kw", "ident")
+               and self.cur.text.upper() in ("USE", "IGNORE", "FORCE")):
+            kind = self.advance().text.lower()
+            if not (self.accept_kw("INDEX") or self.accept_kw("KEY")
+                    or self._accept_word("INDEX")
+                    or self._accept_word("KEY")):
+                raise ParseError(f"expected INDEX after {kind.upper()}",
+                                 self.cur)
+            names = self._paren_name_list()
+            tn.index_hints.append((kind, names))
+        return tn
 
     # ---------------- DDL ---------------- #
 
@@ -751,10 +764,12 @@ class Parser:
         self.expect_kw("TABLE")
         ine = self._if_not_exists()
         name = self.ident()
+        db = None
         if self.accept_op("."):
-            name = self.ident()  # db-qualified; db ignored round 1
+            db, name = name, self.ident()
         self.expect_op("(")
-        ct = A.CreateTable(name, if_not_exists=ine, temporary=temporary)
+        ct = A.CreateTable(name, db=db, if_not_exists=ine,
+                           temporary=temporary)
         while True:
             if self.at_kw("PRIMARY"):
                 self.advance()
@@ -1216,9 +1231,10 @@ class Parser:
             ignore = self.accept_kw("IGNORE")
         self.expect_kw("INTO")
         name = self.ident()
+        dbq = None
         if self.accept_op("."):
-            name = self.ident()
-        ins = A.Insert(name, replace=replace, ignore=ignore)
+            dbq, name = name, self.ident()
+        ins = A.Insert(name, db=dbq, replace=replace, ignore=ignore)
         if self.accept_op("("):
             ins.columns = [self.ident()]
             while self.accept_op(","):
@@ -1371,8 +1387,11 @@ class Parser:
     def update_stmt(self) -> A.Update:
         self.expect_kw("UPDATE")
         name = self.ident()
+        dbq = None
+        if self.accept_op("."):
+            dbq, name = name, self.ident()
         self.expect_kw("SET")
-        u = A.Update(name)
+        u = A.Update(name, db=dbq)
         while True:
             col = self.ident()
             self.expect_op("=")
@@ -1387,7 +1406,11 @@ class Parser:
     def delete_stmt(self) -> A.Delete:
         self.expect_kw("DELETE")
         self.expect_kw("FROM")
-        d = A.Delete(self.ident())
+        name = self.ident()
+        dbq = None
+        if self.accept_op("."):
+            dbq, name = name, self.ident()
+        d = A.Delete(name, db=dbq)
         if self.accept_kw("WHERE"):
             d.where = self.expr()
         d.order_by, d.limit = self._dml_order_limit()

@@ -156,6 +156,8 @@ class StatsHandle:
         background ANALYZE for a planned-against table with no stats;
         the current plan proceeds on defaults.  Returns True if
         scheduled."""
+        if not self.auto_analyze_enabled:
+            return False
         key = self._key(table)
         with self._lock:
             if key in self._cache or key in self._loading:
