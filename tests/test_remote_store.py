@@ -205,3 +205,50 @@ def test_tidb_as_coprocessor():
                          tuple(snap.dtypes)))
     assert rows[0] == "rows" and len(rows[1][0]) == 5
     peer.close()
+
+
+def test_batch_round_cache_skips_successful_stores():
+    """Batch-cop partial retry (copr/batch_coprocessor.go): within one
+    dispatch round, a (store, ranges) task set that already succeeded is
+    served from the round cache on retry — the store is not re-executed
+    unless its range set changed (healing moved shards onto it)."""
+    c3 = RemoteCluster(n_stores=2)
+    try:
+        s = Session(Domain())
+        s.domain.client = RemoteCopClient(c3, mesh=s.domain.mesh)
+        s.execute("create table t3 (a bigint not null, b bigint)")
+        s.execute("insert into t3 values " + ",".join(
+            f"({i}, {i % 5})" for i in range(600)))
+        client = s.domain.client
+        assert s.must_query("select sum(b) from t3") == \
+            [(sum(i % 5 for i in range(600)),)]
+        # rebuild the last dispatch's inputs and re-run _per_store with
+        # one shared round cache: the second run must be RPC-free
+        snap = s.domain.catalog.get_table("test", "t3").snapshot()
+        ent = client._snap_meta(snap)
+
+        def served():
+            return {sid: c3.stores[sid].request(("ping",))[1]
+                    for sid in c3.live_ids()}
+
+        from tidb_tpu.copr import dag as D
+        from tidb_tpu import copr
+        from tidb_tpu.expr import ColumnRef
+        from tidb_tpu.types import dtypes as dt
+        agg = D.Aggregation(
+            D.TableScan((0, 1), tuple(c.dtype for c in snap.columns)), (),
+            (copr.AggDesc(copr.AggFunc.COUNT, None, dt.bigint(False)),),
+            D.GroupStrategy.SCALAR)
+        msg = lambda table, ranges: ("exec_agg", table, snap.epoch, agg,
+                                     ranges)
+        rc: dict = {}
+        client._per_store(ent, snap, msg, rc)
+        base = served()
+        out2 = client._per_store(ent, snap, msg, rc)   # same round cache
+        after = served()
+        # only the ping itself may have bumped the counters
+        assert all(after[sid] - base[sid] == 1 for sid in after), \
+            (base, after)
+        assert len(out2) >= 1
+    finally:
+        c3.close()

@@ -385,3 +385,23 @@ def test_show_family_compat():
     assert s.execute("show status like 'Up%'").rows[0][0] == "Uptime"
     plan = s.execute("explain format='brief' select * from sh").rows
     assert plan and "CopTask" in plan[0][0]
+
+
+def test_percent_rank_cume_dist_vs_sqlite():
+    """PERCENT_RANK / CUME_DIST (executor/window.go analogs)."""
+    import sqlite3
+    from tidb_tpu.session import Session
+    s = Session()
+    s.execute("CREATE TABLE wpr (g INT, v INT)")
+    rows = [(1, 10), (1, 20), (1, 20), (1, 40), (2, 5), (2, 5)]
+    s.execute("INSERT INTO wpr VALUES " + ",".join(
+        f"({a},{b})" for a, b in rows))
+    q = ("SELECT g, v, PERCENT_RANK() OVER (PARTITION BY g ORDER BY v), "
+         "CUME_DIST() OVER (PARTITION BY g ORDER BY v) FROM wpr")
+    got = sorted(s.execute(q).rows)
+    con = sqlite3.connect(":memory:")
+    con.execute("CREATE TABLE wpr (g INT, v INT)")
+    con.executemany("INSERT INTO wpr VALUES (?,?)", rows)
+    exp = sorted(con.execute(q).fetchall())
+    for a, b in zip(got, exp):
+        assert abs(a[2] - b[2]) < 1e-9 and abs(a[3] - b[3]) < 1e-9, (a, b)

@@ -2535,6 +2535,18 @@ def _window_column(item, chunk: ResultChunk) -> Column:
         out[sidx] = vals
         return Column(t, out.astype(t.np_dtype()), np.ones(n, bool))
 
+    if f in ("percent_rank", "cume_dist"):
+        # percent_rank = (rank-1)/(rows-1); cume_dist = peer_end+1 relative
+        # to the partition (executor/window.go percentRank/cumeDist)
+        rank = (pstart - ps + 1).astype(np.float64)
+        if f == "percent_rank":
+            vals = np.where(sz > 1, (rank - 1) / np.maximum(sz - 1, 1), 0.0)
+        else:
+            vals = (peer_end - ps + 1).astype(np.float64) / sz
+        out = np.empty(n, np.float64)
+        out[sidx] = vals
+        return Column(t, out, np.ones(n, bool))
+
     # value-bearing functions
     src = _eval_to_column(item.args[0], chunk) if item.args else None
     v = src.data[sidx] if src is not None else np.zeros(n, np.int64)

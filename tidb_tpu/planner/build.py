@@ -1850,7 +1850,7 @@ def _attach_order_limit(sel: A.SelectStmt, plan: LogicalPlan,
 
 WINDOW_FUNCS = {"ROW_NUMBER", "RANK", "DENSE_RANK", "NTILE", "LAG", "LEAD",
                 "FIRST_VALUE", "LAST_VALUE", "SUM", "COUNT", "AVG", "MIN",
-                "MAX"}
+                "MAX", "PERCENT_RANK", "CUME_DIST"}
 
 
 def _contains_window(items) -> bool:
@@ -1924,6 +1924,10 @@ def _build_window_item(fc: A.FuncCall, schema: Schema) -> WindowItem:
     fl = name.lower()
     if fl in ("row_number", "rank", "dense_rank"):
         out = dt.bigint(False)
+    elif fl in ("percent_rank", "cume_dist"):
+        if not order:
+            raise PlanError(f"{name} requires ORDER BY in its window")
+        out = dt.double(False)
     elif fl == "ntile":
         if not (args and isinstance(args[0], Const)):
             raise PlanError("NTILE needs a constant argument")
@@ -2223,8 +2227,9 @@ def _build_from(node: A.Node, catalog, default_db: str,
             # as the /*+ USE_INDEX */ optimizer hints; FORCE == USE here
             low = [x.lower() for x in names]
             if kind in ("use", "force"):
-                ds.hint_use = (ds.hint_use or []) + low if low else []
-                if not low:
+                if low:
+                    ds.hint_use = (ds.hint_use or []) + low
+                else:
                     ds.hint_use = []    # USE INDEX (): forbid all indexes
             else:
                 ds.hint_ignore = (ds.hint_ignore or []) + low

@@ -88,8 +88,16 @@ class PluginRegistry:
         return None
 
     # -- daemon kind (lifecycle owned by the server) ---------------- #
+    #
+    # The registry is process-global, so daemon start/stop is
+    # REFCOUNTED: the first server start()s them, the last close()
+    # stop()s them — two servers in one process share one daemon set.
 
     def start_daemons(self, domain) -> None:
+        with self._mu:
+            self._daemon_refs = getattr(self, "_daemon_refs", 0) + 1
+            if self._daemon_refs > 1:
+                return
         for p in self.plugins():
             if hasattr(p, "start"):
                 try:
@@ -99,6 +107,13 @@ class PluginRegistry:
                         self.errors.append((p.name, f"start: {e}"))
 
     def stop_daemons(self) -> None:
+        with self._mu:
+            refs = getattr(self, "_daemon_refs", 0)
+            if refs == 0:
+                return
+            self._daemon_refs = refs - 1
+            if self._daemon_refs > 0:
+                return
         for p in self.plugins():
             if hasattr(p, "stop"):
                 try:

@@ -494,13 +494,16 @@ class MySQLServer:
 
     def start(self) -> int:
         """Bind + start the accept thread; returns the bound port."""
-        from ..plugin import registry as _plugins
-        _plugins.start_daemons(self.domain)    # daemon plugin kind
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((self.host, self.port))
         self._listener.listen(64)
         self.port = self._listener.getsockname()[1]
+        # daemon plugin kind starts only after the bind succeeded (a
+        # failed start() must not leak running daemons)
+        from ..plugin import registry as _plugins
+        _plugins.start_daemons(self.domain)
+        self._daemons_started = True
         self._thread = threading.Thread(target=self._accept_loop,
                                         name="mysql-accept", daemon=True)
         self._thread.start()
@@ -527,8 +530,10 @@ class MySQLServer:
     def close(self, timeout: float = 5.0):
         """Graceful shutdown: stop accepting, wait for live conns
         (server.go graceful shutdown analog)."""
-        from ..plugin import registry as _plugins
-        _plugins.stop_daemons()
+        if getattr(self, "_daemons_started", False):
+            from ..plugin import registry as _plugins
+            _plugins.stop_daemons()
+            self._daemons_started = False
         self._closing = True
         if self._listener is not None:
             # shutdown() interrupts a thread blocked in accept() — close()

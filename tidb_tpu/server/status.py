@@ -161,44 +161,52 @@ class StatusServer:
             return json.dumps({"schema_version": ver}), "application/json"
         raise KeyError(path)
 
-    def _mvcc_versions(self, tbl, handle: int, max_versions: int = 8):
-        """Version history of a record key, recovered by bisecting the
-        timestamp axis over MVCC reads (each version's commit ts is the
-        smallest ts at which its value becomes visible)."""
+    def _mvcc_versions(self, tbl, handle: int, max_versions: int = 8,
+                       max_scan: int = 8192):
+        """Version history of a record key, recovered by walking the
+        (small, sequential) logical-ts axis downward and emitting value
+        changes.  Exact even when a value recurs (a bisect on value
+        equality would conflate recurrences); `max_scan` bounds the walk
+        and sets `truncated` when older history is out of range."""
         from ..store.codec import decode_row, record_key
         kv = tbl.kv
         if kv is None:
             return {"error": "table has no KV store (bulk mode)"}
         key = record_key(tbl.table_id, handle)
         hi = kv.alloc_ts()
+        lo_bound = max(1, hi - max_scan)
         out = []
-        cur = kv.get(key, hi)
-        while len(out) < max_versions:
-            # smallest ts with this value = its commit ts
-            lo, h = 1, hi
-            while lo < h:
-                mid = (lo + h) // 2
-                if kv.get(key, mid) == cur:
-                    h = mid
-                else:
-                    lo = mid + 1
-            if cur is None and lo <= 1:
-                break           # before the row's creation, not a delete
-            ent = {"commit_ts": lo}
-            if cur is None:
+
+        def emit(ts, val):
+            ent = {"commit_ts": ts}
+            if val is None:
                 ent["deleted"] = True
             else:
                 try:
                     ent["row"] = [str(v) for v in
-                                  decode_row(cur, tbl.col_types)]
+                                  decode_row(val, tbl.col_types)]
                 except Exception:
-                    ent["value_len"] = len(cur)
+                    ent["value_len"] = len(val)
             out.append(ent)
-            if lo <= 1:
+
+        cur = kv.get(key, hi)
+        t = hi
+        reached_origin = False
+        while t >= lo_bound and len(out) < max_versions:
+            prev = kv.get(key, t - 1) if t > 1 else None
+            if t == 1:
+                if cur is not None:
+                    emit(1, cur)
+                reached_origin = True
                 break
-            hi = lo - 1
-            cur = kv.get(key, hi)
-        return {"key": key.hex(), "versions": out}
+            if prev != cur:
+                emit(t, cur)       # this value was committed at ts t
+                cur = prev
+            t -= 1
+        res = {"key": key.hex(), "versions": out}
+        if not reached_origin and lo_bound > 1:
+            res["truncated"] = True
+        return res
 
 
 __all__ = ["StatusServer"]

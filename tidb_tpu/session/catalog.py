@@ -963,6 +963,8 @@ class Catalog:
                 return
             raise CatalogError(f"unknown database {name!r}")
         del self.databases[name]
+        for key in [k for k in self.sequences if k[0] == name]:
+            del self.sequences[key]
 
     def create_table(self, db: str, tbl: TableInfo, if_not_exists=False):
         d = self._db(db)

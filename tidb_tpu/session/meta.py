@@ -117,6 +117,10 @@ class MetaStore:
         for t in tables:
             txn.delete(table_key(db, t.name if isinstance(t, TableInfo)
                                  else t))
+        # drop the database's sequence definitions + value keys too
+        pre = M_SEQ + db.encode() + b"\x00"
+        for k, _ in self.kv.scan(pre, pre + b"\xff", txn.start_ts):
+            txn.delete(k)
         txn.commit()
         for t in tables:
             if isinstance(t, TableInfo):

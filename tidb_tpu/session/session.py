@@ -424,10 +424,17 @@ class Session:
             self._finish_txn(commit=True)
         if type(stmt).__name__ in self._DDL_STMTS:
             # schema plugin kind (plugin/spi.go SchemaManifest
-            # OnSchemaChange): observe every DDL on its way in
+            # OnSchemaChange): fire only AFTER the DDL succeeded, with
+            # the statement's resolved database
             from ..plugin import registry as _plugins
-            _plugins.fire("on_ddl", type(stmt).__name__, self.db,
+            out = self._dispatch_stmt(stmt)
+            _plugins.fire("on_ddl", type(stmt).__name__,
+                          getattr(stmt, "db", None) or self.db,
                           self._cur_sql or "")
+            return out
+        return self._dispatch_stmt(stmt)
+
+    def _dispatch_stmt(self, stmt: A.Node) -> ResultSet:
         if isinstance(stmt, (A.CreateUser, A.AlterUser, A.DropUser,
                              A.GrantStmt, A.RevokeStmt, A.FlushStmt)):
             return self._exec_user_admin(stmt)
@@ -489,11 +496,17 @@ class Session:
                                               stmt.if_exists)
             return ResultSet()
         if isinstance(stmt, A.DropTable):
-            # session temporary tables shadow permanent ones and drop
-            # without touching the shared catalog
+            # names may be db-qualified ("db.name"); session temporary
+            # tables shadow permanent ones and drop without touching the
+            # shared catalog
+            def split(n):
+                db, _, nm = n.rpartition(".")
+                return (db or self.db, nm)
+
             remaining = []
             for n in stmt.names:
-                t = self.temp_tables.pop((self.db, n), None)
+                db, nm = split(n)
+                t = self.temp_tables.pop((db, nm), None)
                 if t is not None:
                     try:
                         t.truncate()
@@ -509,22 +522,21 @@ class Session:
                     raise CatalogError(
                         f"unknown temporary table {remaining[0]!r}")
                 return ResultSet()
-            if not remaining:
-                return ResultSet()
-            stmt = A.DropTable(remaining, stmt.if_exists)
-            for n in stmt.names:
+            bare = {split(n)[1] for n in remaining}
+            for n in remaining:
+                db, nm = split(n)
                 refs = [
                     (t.name, fk.column)
                     for t in self.domain.catalog.databases
-                    .get(self.db, {}).values()
+                    .get(db, {}).values()
                     for fk in getattr(t, "foreign_keys", [])
-                    if fk.ref_table == n and t.name not in stmt.names]
+                    if fk.ref_table == nm and t.name not in bare]
                 if refs:
                     raise CatalogError(
-                        f"Cannot drop table {n!r}: referenced by a "
+                        f"Cannot drop table {nm!r}: referenced by a "
                         f"foreign key constraint ({refs[0][0]}."
                         f"{refs[0][1]})")
-                self.domain.catalog.drop_table(self.db, n, stmt.if_exists)
+                self.domain.catalog.drop_table(db, nm, stmt.if_exists)
             return ResultSet()
         if isinstance(stmt, A.CreateView):
             from .catalog import ViewInfo
@@ -551,20 +563,22 @@ class Session:
             return ResultSet()
         if isinstance(stmt, A.CreateIndex):
             self.domain.catalog.get_table(getattr(stmt, 'db', None) or self.db, stmt.table)  # exist check
-            tmp = self.temp_tables.get((self.db, stmt.table))
+            ddl_db = getattr(stmt, 'db', None) or self.db
+            tmp = self.temp_tables.get((ddl_db, stmt.table))
             if tmp is not None:
                 # session temp tables never reach the (session-agnostic)
                 # DDL owner thread: index synchronously, no online ladder
                 tmp.create_index(stmt.name, list(stmt.columns),
                                  stmt.unique, stmt.if_not_exists)
                 return ResultSet()
-            self.domain.ddl.run_job("add index", self.db, stmt.table, {
+            self.domain.ddl.run_job("add index", ddl_db, stmt.table, {
                 "name": stmt.name, "columns": list(stmt.columns),
                 "unique": stmt.unique, "if_not_exists": stmt.if_not_exists})
             return ResultSet()
         if isinstance(stmt, A.DropIndex):
             self.domain.catalog.get_table(getattr(stmt, 'db', None) or self.db, stmt.table)
-            tmp = self.temp_tables.get((self.db, stmt.table))
+            ddl_db = getattr(stmt, 'db', None) or self.db
+            tmp = self.temp_tables.get((ddl_db, stmt.table))
             if tmp is not None:
                 ix = tmp.index_by_name(stmt.name)
                 if ix is not None:
@@ -572,7 +586,7 @@ class Session:
                 elif not stmt.if_exists:
                     raise CatalogError(f"unknown index {stmt.name!r}")
                 return ResultSet()
-            self.domain.ddl.run_job("drop index", self.db, stmt.table, {
+            self.domain.ddl.run_job("drop index", ddl_db, stmt.table, {
                 "name": stmt.name, "if_exists": stmt.if_exists})
             return ResultSet()
         if isinstance(stmt, A.AlterTable):
@@ -703,7 +717,8 @@ class Session:
         target = getattr(stmt, "table", None) or getattr(stmt, "name", "")
         if isinstance(stmt, A.DropTable):
             for n in stmt.names:
-                priv.require(self.user, need, self.db, n)
+                db, _, nm = n.rpartition(".")
+                priv.require(self.user, need, db or self.db, nm)
             return
         if isinstance(stmt, (A.CreateDatabase, A.DropDatabase)):
             return priv.require(self.user, need, stmt.name)
@@ -1287,7 +1302,8 @@ class Session:
         tbl = self.domain.catalog.get_table(getattr(stmt, 'db', None) or self.db, stmt.table)
         # session temp tables never reach the DDL owner thread (its
         # catalog lookups cannot see the session overlay)
-        is_temp = self.temp_tables.get((self.db, stmt.table)) is tbl
+        ddl_db = getattr(stmt, 'db', None) or self.db
+        is_temp = self.temp_tables.get((ddl_db, stmt.table)) is tbl
         for act in stmt.actions:
             if act[0] == "add_index":
                 _, iname, cols, uniq = act
@@ -1295,7 +1311,7 @@ class Session:
                     tbl.create_index(iname or "idx_" + "_".join(cols),
                                      list(cols), uniq)
                     continue
-                self.domain.ddl.run_job("add index", self.db, tbl.name, {
+                self.domain.ddl.run_job("add index", ddl_db, tbl.name, {
                     "name": iname or "idx_" + "_".join(cols),
                     "columns": list(cols), "unique": uniq})
             elif act[0] == "drop_index":
@@ -1305,7 +1321,7 @@ class Session:
                         raise CatalogError(f"unknown index {act[1]!r}")
                     tbl.indexes.remove(ix)
                     continue
-                self.domain.ddl.run_job("drop index", self.db, tbl.name,
+                self.domain.ddl.run_job("drop index", ddl_db, tbl.name,
                                         {"name": act[1]})
             elif act[0] == "add_column":
                 self._alter_add_column(tbl, act[1])

@@ -271,6 +271,7 @@ class DropSequence(Node):
 class CreateIndex(Node):
     name: str
     table: str
+    db: Optional[str] = None
     columns: list[str] = field(default_factory=list)
     unique: bool = False
     if_not_exists: bool = False
@@ -280,6 +281,7 @@ class CreateIndex(Node):
 class DropIndex(Node):
     name: str
     table: str
+    db: Optional[str] = None
     if_exists: bool = False
 
 
@@ -289,6 +291,7 @@ class AlterTable(Node):
     ('drop_index', name) | ('add_column', ColumnDef) |
     ('drop_column', name)."""
     table: str
+    db: Optional[str] = None
     actions: list[tuple] = field(default_factory=list)
 
 

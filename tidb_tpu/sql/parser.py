@@ -752,10 +752,11 @@ class Parser:
             name = self.ident()
             self.expect_kw("ON")
             table = self.ident()
+            dbq = None
             if self.accept_op("."):
-                table = self.ident()
+                dbq, table = table, self.ident()
             cols = self._paren_name_list()
-            return A.CreateIndex(name, table, cols, unique, ine)
+            return A.CreateIndex(name, table, dbq, cols, unique, ine)
         if unique:
             raise ParseError("expected INDEX after CREATE UNIQUE", self.cur)
         if self._accept_word("SEQUENCE"):
@@ -960,9 +961,10 @@ class Parser:
                                              True)
         self.expect_kw("TABLE")
         table = self.ident()
+        dbq = None
         if self.accept_op("."):
-            table = self.ident()
-        at = A.AlterTable(table)
+            dbq, table = table, self.ident()
+        at = A.AlterTable(table, db=dbq)
         while True:
             if self.accept_kw("ADD"):
                 uniq = self.accept_kw("UNIQUE")
@@ -1198,9 +1200,10 @@ class Parser:
             name = self.ident()
             self.expect_kw("ON")
             table = self.ident()
+            dbq = None
             if self.accept_op("."):
-                table = self.ident()
-            return A.DropIndex(name, table, ie)
+                dbq, table = table, self.ident()
+            return A.DropIndex(name, table, dbq, ie)
         if self.cur.kind == "ident" and self.cur.text.upper() == "VIEW":
             self.advance()
             ie = False
@@ -1217,9 +1220,16 @@ class Parser:
         if self.accept_kw("IF"):
             self.expect_kw("EXISTS")
             ie = True
-        names = [self.ident()]
+
+        def qname():
+            n = self.ident()
+            if self.accept_op("."):
+                return f"{n}.{self.ident()}"
+            return n
+
+        names = [qname()]
         while self.accept_op(","):
-            names.append(self.ident())
+            names.append(qname())
         return A.DropTable(names, ie, temporary)
 
     def insert_stmt(self, replace: bool = False) -> A.Insert:

@@ -192,8 +192,8 @@ class RemoteCopClient:
             return self.inner.execute_agg(agg, snap, key_meta, aux_cols)
         try:
             return self._dispatch(
-                snap, lambda ent: self._agg_remote(agg, snap, ent,
-                                                   key_meta))
+                snap, lambda ent, rc: self._agg_remote(agg, snap, ent,
+                                                       key_meta, rc))
         except _Unsupported:
             self.local_fallbacks += 1
             return self.inner.execute_agg(agg, snap, key_meta, aux_cols)
@@ -205,9 +205,9 @@ class RemoteCopClient:
                                            dictionaries, aux_cols)
         try:
             return self._dispatch(
-                snap, lambda ent: self._rows_remote(root, snap, ent,
-                                                    out_dtypes,
-                                                    dictionaries))
+                snap, lambda ent, rc: self._rows_remote(root, snap, ent,
+                                                        out_dtypes,
+                                                        dictionaries, rc))
         except _Unsupported:
             self.local_fallbacks += 1
             return self.inner.execute_rows(root, snap, out_dtypes,
@@ -216,12 +216,17 @@ class RemoteCopClient:
     def _dispatch(self, snap, fn):
         from ..copr.coordinator import check_killed
         bo = Backoffer(max_sleep_ms=5000.0)
+        # batch-cop partial retry (copr/batch_coprocessor.go): stores
+        # whose batched task set already succeeded this round are not
+        # re-executed after another store's failure heals the placement —
+        # only moved/failed range sets re-dispatch
+        round_cache: dict = {}
         while True:
             check_killed()
             ent = self._snap_meta(snap)
             self._preflight_liveness(ent)
             try:
-                return fn(ent)
+                return fn(ent, round_cache)
             except RegionError as e:
                 bo.backoff(e.kind, e)
                 ent["placement"].heal(e)
@@ -241,16 +246,23 @@ class RemoteCopClient:
             self.preflight_exclusions = getattr(
                 self, "preflight_exclusions", 0) + 1
 
-    def _per_store(self, ent, snap, build_msg):
-        """Fan a request out to every store owning live shards; a store
-        failure mid-fan-out aborts this round with its RegionError (the
-        retry loop heals and re-fans-out)."""
+    def _per_store(self, ent, snap, build_msg, round_cache=None):
+        """Fan a request out to every store owning live shards, ONE
+        batched request per store covering all its ranges (the
+        batch-coprocessor discipline, copr/batch_coprocessor.go).  A
+        store failure mid-fan-out aborts this round with its RegionError
+        (the retry loop heals and re-fans-out); `round_cache` carries the
+        successful (store, ranges) results across those retries so only
+        moved/failed task sets re-execute."""
         import concurrent.futures as cf
         by_store = self._store_ranges(ent["placement"])
         if not by_store:
             raise _Unsupported()
 
         def one(sid, ranges):
+            key = (sid, tuple(map(tuple, ranges)))
+            if round_cache is not None and key in round_cache:
+                return round_cache[key]
             if sid >= len(self.cluster.stores):
                 raise _Unsupported()   # every real store excluded
             store = self.cluster.stores[sid]
@@ -263,6 +275,8 @@ class RemoteCopClient:
                     err.store = sid
                     raise err
                 raise _Unsupported()
+            if round_cache is not None:
+                round_cache[key] = resp[1]
             return resp[1]
         self.remote_dispatches += 1
         items = sorted(by_store.items())
@@ -272,11 +286,12 @@ class RemoteCopClient:
             futs = [ex.submit(one, sid, rngs) for sid, rngs in items]
             return [f.result() for f in futs]
 
-    def _agg_remote(self, agg, snap, ent, key_meta) -> CopResult:
+    def _agg_remote(self, agg, snap, ent, key_meta,
+                    round_cache=None) -> CopResult:
         per_store = self._per_store(
             ent, snap,
             lambda table, ranges: ("exec_agg", table, snap.epoch, agg,
-                                   ranges))
+                                   ranges), round_cache)
         if agg.strategy == D.GroupStrategy.SORT:
             merged = merge_sorted_states(agg, per_store)
             key_cols, agg_cols = finalize_sorted(agg, merged, key_meta)
@@ -285,12 +300,13 @@ class RemoteCopClient:
             key_cols, agg_cols = finalize(agg, merged, key_meta)
         return CopResult(agg_cols, key_cols)
 
-    def _rows_remote(self, root, snap, ent, out_dtypes, dictionaries):
+    def _rows_remote(self, root, snap, ent, out_dtypes, dictionaries,
+                     round_cache=None):
         from ..chunk.column import Column
         per_store = self._per_store(
             ent, snap,
             lambda table, ranges: ("exec_rows", table, snap.epoch, root,
-                                   ranges, tuple(out_dtypes)))
+                                   ranges, tuple(out_dtypes)), round_cache)
         cols = [Column.concat([st[j] for st in per_store])
                 for j in range(len(out_dtypes))]
         if dictionaries:
