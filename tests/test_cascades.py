@@ -1,0 +1,212 @@
+"""Cascades/memo optimizer (pkg/planner/cascades + memo analogs).
+
+Covers: memo dedup, DP join-order search, cost-based merge-join choice
+with order-property sort elimination, INL join selection, TopN pushdown
+through outer joins, and result equivalence against the heuristic path.
+"""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.chunk.column import Column
+from tidb_tpu.planner.build import build_query
+from tidb_tpu.planner.cascades.memo import Memo
+from tidb_tpu.planner.cascades.search import search
+from tidb_tpu.planner.logical import explain_logical
+from tidb_tpu.planner.optimize import optimize_plan
+from tidb_tpu.session import Domain, Session
+from tidb_tpu.session.catalog import TableInfo
+from tidb_tpu.sql.parser import parse_one
+from tidb_tpu.types import dtypes as dt
+
+
+def _mk(dom, name, cols):
+    names = [n for n, _ in cols]
+    arrays = [a for _, a in cols]
+    t = TableInfo(name, names, [dt.bigint() for _ in cols])
+    t.register_columns([Column(dt.bigint(), a.astype(np.int64),
+                               np.ones(len(a), bool)) for a in arrays])
+    dom.catalog.create_table("test", t)
+    return t
+
+
+@pytest.fixture()
+def world(rng):
+    dom = Domain()
+    s = Session(dom)
+    big = _mk(dom, "big", [("a", rng.integers(0, 5000, 50_000)),
+                           ("v", rng.integers(0, 100, 50_000))])
+    mid = _mk(dom, "mid", [("a", np.arange(5000)),
+                           ("b", rng.integers(0, 8, 5000))])
+    tiny = _mk(dom, "tiny", [("b", np.arange(8)),
+                             ("w", np.arange(8) * 10)])
+    for t in (big, mid, tiny):
+        dom.stats.analyze_table(t)
+    return dom, s
+
+
+def _searched(dom, sql):
+    built = build_query(parse_one(sql), dom.catalog, "test")
+    return search(optimize_plan(built.plan), dom.stats)
+
+
+# ------------------------------------------------------------------ #
+
+def test_memo_dedup_shares_groups(world):
+    dom, _ = world
+    built = build_query(parse_one(
+        "select count(*) from big where a < 10"), dom.catalog, "test")
+    plan = optimize_plan(built.plan)
+    memo = Memo()
+    g1 = memo.insert_tree(plan, dom.stats)
+    n = len(memo.groups)
+    g2 = memo.insert_tree(plan, dom.stats)
+    assert g1 == g2 and len(memo.groups) == n
+
+
+def test_dp_join_order_starts_from_filtered_tiny(world):
+    dom, _ = world
+    out = _searched(dom, "select count(*) from big, mid, tiny "
+                         "where big.a = mid.a and mid.b = tiny.b "
+                         "and tiny.w < 30")
+    txt = explain_logical(out)
+    # DP must build (mid ⋈ σ(tiny)) first and probe with big on top —
+    # tiny is strictly deeper than big in the join tree
+    depth = {}
+    for line in txt.splitlines():
+        ind = len(line) - len(line.lstrip())
+        for t in ("big", "tiny"):
+            if t in line and t not in depth:
+                depth[t] = ind
+    assert depth["tiny"] > depth["big"], txt
+
+
+def test_cascades_results_match_heuristic(world):
+    dom, s = world
+    queries = [
+        "select count(*) from big, mid, tiny "
+        "where big.a = mid.a and mid.b = tiny.b and tiny.w < 30",
+        "select tiny.w, count(*) c from big join mid on big.a = mid.a "
+        "join tiny on mid.b = tiny.b group by tiny.w order by tiny.w",
+        "select big.v from big left join mid on big.a = mid.a "
+        "order by big.v limit 7",
+        "select mid.b, sum(big.v) from big, mid where big.a = mid.a "
+        "and big.v < 50 group by mid.b order by mid.b",
+    ]
+    ref = Session(dom)
+    s.execute("set tidb_enable_cascades_planner=1")
+    for q in queries:
+        assert s.must_query(q) == ref.must_query(q), q
+
+
+def test_merge_join_wins_on_fanout_with_order(rng):
+    # fan-out join (output ≫ both inputs) under ORDER BY join key: the
+    # sort-merge implementation provides the order, so hash+big-sort
+    # loses and the extracted plan carries no Sort at all
+    dom = Domain()
+    s = Session(dom)
+    _mk(dom, "probe", [("k", rng.integers(0, 1000, 100_000)),
+                       ("v", rng.integers(0, 50, 100_000))])
+    _mk(dom, "dim", [("k", np.repeat(np.arange(1000), 5)),
+                     ("w", rng.integers(0, 9, 5000))])
+    for t in ("probe", "dim"):
+        dom.stats.analyze_table(dom.catalog.get_table("test", t))
+    sql = ("select probe.k, dim.w from probe join dim on probe.k = dim.k "
+           "order by probe.k")
+    out = _searched(dom, sql)
+    txt = explain_logical(out)
+    assert "LogicalSort" not in txt, txt
+    assert any(isinstance(n, type(out)) or True for n in [out])
+    # the chosen join rides the merge hint
+    from tidb_tpu.planner.logical import LogicalJoin, walk_plan
+    joins = [n for n in walk_plan(out) if isinstance(n, LogicalJoin)]
+    assert joins and joins[0].hint_method == "merge", txt
+    # end-to-end correctness incl. the dropped sort
+    ref = Session(dom)
+    s.execute("set tidb_enable_cascades_planner=1")
+    q2 = sql + " , dim.w limit 50"
+    assert s.must_query(q2) == ref.must_query(q2)
+
+
+def test_inl_join_chosen_for_small_outer_indexed_inner():
+    dom = Domain()
+    s = Session(dom)
+    s.execute("create table fact (k bigint, v bigint, key ix_k (k))")
+    s.execute("create table probe (k bigint)")
+    rows = ",".join(f"({i % 500}, {i})" for i in range(5000))
+    s.execute(f"insert into fact values {rows}")
+    s.execute("insert into probe values " +
+              ",".join(f"({i})" for i in range(20)))
+    for t in ("fact", "probe"):
+        dom.stats.analyze_table(dom.catalog.get_table("test", t))
+    sql = ("select probe.k, fact.v from probe join fact "
+           "on probe.k = fact.k")
+    out = _searched(dom, sql)
+    from tidb_tpu.planner.logical import LogicalJoin, walk_plan
+    joins = [n for n in walk_plan(out) if isinstance(n, LogicalJoin)]
+    assert joins and joins[0].hint_method == "inl", explain_logical(out)
+    ref = Session(dom)
+    s.execute("set tidb_enable_cascades_planner=1")
+    assert sorted(s.must_query(sql)) == sorted(ref.must_query(sql))
+
+
+def test_topn_pushes_through_left_join(world):
+    dom, s = world
+    # select only the ordered column: v ties make extra columns
+    # nondeterministic under LIMIT
+    sql = ("select big.v from big left join mid on big.a = mid.a "
+           "order by big.v limit 5")
+    out = _searched(dom, sql)
+    txt = explain_logical(out)
+    from tidb_tpu.planner.logical import (LogicalJoin, LogicalTopN,
+                                          walk_plan)
+    # a TopN (or its Limit degeneration) must sit BELOW the join now
+    join = next(n for n in walk_plan(out) if isinstance(n, LogicalJoin))
+    inner = [n for n in walk_plan(join)
+             if isinstance(n, LogicalTopN)]
+    assert inner, txt
+    ref = Session(dom)
+    s.execute("set tidb_enable_cascades_planner=1")
+    assert s.must_query(sql) == ref.must_query(sql)
+
+
+def test_leaf_hash_hint_not_overridden_by_merge_winner(rng):
+    # HASH_JOIN(dim) rides a leaf marker; the cost model would pick merge
+    # on this fan-out shape, but the user hint must win (review finding)
+    dom = Domain()
+    s = Session(dom)
+    _mk(dom, "probe", [("k", rng.integers(0, 1000, 100_000)),
+                       ("v", rng.integers(0, 50, 100_000))])
+    _mk(dom, "dim", [("k", np.repeat(np.arange(1000), 5)),
+                     ("w", rng.integers(0, 9, 5000))])
+    for t in ("probe", "dim"):
+        dom.stats.analyze_table(dom.catalog.get_table("test", t))
+    s.execute("set tidb_enable_cascades_planner=1")
+    q = ("select /*+ HASH_JOIN(dim) */ probe.k, dim.w from probe "
+         "join dim on probe.k = dim.k order by probe.k limit 10")
+    plan = "\n".join(r[0] for r in s.must_query("explain " + q))
+    assert "MergeJoin" not in plan, plan
+    ref = Session(dom)
+    assert s.must_query(q) == ref.must_query(q)
+
+
+def test_plan_cache_keys_on_cascades_flag(world):
+    dom, s = world
+    q = "select count(*) from big, mid where big.a = mid.a"
+    first = s.must_query(q)
+    s.execute("set tidb_enable_cascades_planner=1")
+    # flipping the planner flag must not reuse the heuristic-path plan
+    from tidb_tpu.planner.plan_cache import _PLAN_SYSVARS
+    assert "tidb_enable_cascades_planner" in _PLAN_SYSVARS
+    assert s.must_query(q) == first
+
+
+def test_hints_survive_cascades(world):
+    dom, s = world
+    s.execute("set tidb_enable_cascades_planner=1")
+    q = ("select /*+ MERGE_JOIN(mid) */ count(*) from big, mid "
+         "where big.a = mid.a")
+    ref = Session(dom)
+    assert s.must_query(q) == ref.must_query(q)
+    plan = "\n".join(r[0] for r in s.must_query("explain " + q))
+    assert "MergeJoin" in plan, plan

@@ -1,0 +1,131 @@
+"""Cross-database DDL/DML correctness (round-5 review findings):
+qualified privilege checks, drop-database sequence cleanup, FK guard
+qualification, plugin DDL event database resolution."""
+
+import pytest
+
+from tidb_tpu.privilege import PrivilegeError
+from tidb_tpu.session import Domain, Session
+from tidb_tpu.session.catalog import CatalogError
+
+
+@pytest.fixture()
+def dom():
+    return Domain()
+
+
+def _root(dom):
+    s = Session(dom)
+    s.user = "root"
+    return s
+
+
+def test_qualified_index_ddl_checks_target_db(dom):
+    root = _root(dom)
+    root.execute("create database dba")
+    root.execute("create database dbb")
+    root.execute("create table dbb.t (a bigint)")
+    root.execute("create user 'ix'@'%'")
+    root.execute("grant all on dba.* to 'ix'@'%'")
+    s = Session(dom)
+    s.user = "ix"
+    s.execute("use dba")
+    # qualified DDL against dbb must check dbb, not the session db
+    with pytest.raises(PrivilegeError):
+        s.execute("create index i1 on dbb.t (a)")
+    with pytest.raises(PrivilegeError):
+        s.execute("alter table dbb.t add column b bigint")
+    root.execute("grant all on dbb.* to 'ix'@'%'")
+    s.execute("create index i1 on dbb.t (a)")
+
+
+def test_drop_database_resets_sequence_values(dom):
+    s = _root(dom)
+    s.execute("create database sq")
+    s.execute("use sq")
+    s.execute("create sequence seq1 start 1")
+    first = s.must_query("select nextval(seq1)")[0][0]
+    s.must_query("select nextval(seq1)")
+    s.execute("drop database sq")
+    s.execute("create database sq")
+    s.execute("use sq")
+    s.execute("create sequence seq1 start 1")
+    # a recreated sequence must restart, not resume the old high-water
+    assert s.must_query("select nextval(seq1)")[0][0] == first
+
+
+def test_drop_table_fk_guard_is_db_qualified(dom):
+    s = _root(dom)
+    s.execute("create database d1")
+    s.execute("create database d2")
+    s.execute("create table d2.p (id bigint primary key)")
+    s.execute("create table d2.c (id bigint primary key, pid bigint, "
+              "foreign key (pid) references p (id))")
+    s.execute("create table d1.c (x bigint)")
+    # a same-named table in ANOTHER db must not suppress the FK guard
+    with pytest.raises(CatalogError):
+        s.execute("drop table d2.p, d1.c")
+    # dropping child and parent together is fine
+    s.execute("drop table d2.c, d2.p")
+
+
+def test_backtick_name_containing_dot_drops(dom):
+    s = _root(dom)
+    s.execute("create table `a.b` (x bigint)")
+    s.execute("insert into `a.b` values (1)")
+    assert s.must_query("select x from `a.b`") == [(1,)]
+    s.execute("drop table `a.b`")           # must NOT split on the dot
+
+
+def test_multi_db_drop_fires_event_per_db(dom):
+    from tidb_tpu.plugin import registry
+
+    events = []
+
+    class P:
+        name = "evt2"
+
+        @staticmethod
+        def on_ddl(kind, db, sql):
+            events.append((kind, db))
+
+    s = _root(dom)
+    s.execute("create database e1")
+    s.execute("create database e2")
+    s.execute("create table e1.t (a bigint)")
+    s.execute("create table e2.t (a bigint)")
+    registry.register(P())
+    try:
+        s.execute("drop table e1.t, e2.t")
+        assert ("DropTable", "e1") in events
+        assert ("DropTable", "e2") in events
+    finally:
+        registry.unregister("evt2")
+
+
+def test_plugin_ddl_event_reports_target_db(dom):
+    from tidb_tpu.plugin import registry
+
+    events = []
+
+    class P:
+        name = "audit_db"
+
+        @staticmethod
+        def on_ddl(kind, db, sql):
+            events.append((kind, db))
+
+    registry.register(P())
+    try:
+        s = _root(dom)
+        s.execute("create database evt")
+        s.execute("create table evt.t (a bigint)")
+        s.execute("use test")
+        s.execute("drop table evt.t")
+        s.execute("drop database evt")
+        assert ("CreateDatabase", "evt") in events
+        assert ("CreateTable", "evt") in events
+        assert ("DropTable", "evt") in events
+        assert ("DropDatabase", "evt") in events
+    finally:
+        registry.unregister("audit_db")

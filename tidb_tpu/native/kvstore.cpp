@@ -779,6 +779,60 @@ int32_t kv_scan(void* h, const char* start, int32_t slen, const char* end,
   return n;
 }
 
+// Full version history of one key, newest-first (status-API /mvcc
+// introspection; reference pkg/server/handler mvcc handlers).  Walks the
+// memtable chain then runs newest-first, skipping rollbacks.  Per
+// version: [commit_ts u64][op u8][vlen i32][payload].  Returns the
+// emitted count; *truncated set when max_n or the buffer cut it short.
+int32_t kv_versions(void* h, const char* key, int32_t klen, int32_t max_n,
+                    char* buf, int64_t buf_cap, int64_t* used,
+                    uint8_t* truncated) {
+  auto* s = static_cast<Store*>(h);
+  std::shared_lock lk(s->mu);
+  std::string k(key, klen);
+  int32_t n = 0;
+  int64_t off = 0;
+  *truncated = 0;
+  bool full = false;
+  auto emit = [&](const WriteRec& w, const std::string* val) {
+    if (n >= max_n) { *truncated = 1; full = true; return; }
+    int32_t vlen = (w.op == OP_PUT && val != nullptr)
+        ? static_cast<int32_t>(val->size()) : 0;
+    if (off + 13 + vlen > buf_cap) { *truncated = 1; full = true; return; }
+    std::memcpy(buf + off, &w.commit_ts, 8); off += 8;
+    buf[off++] = static_cast<char>(w.op);
+    std::memcpy(buf + off, &vlen, 4); off += 4;
+    if (vlen > 0) { std::memcpy(buf + off, val->data(), vlen); off += vlen; }
+    ++n;
+  };
+  auto it = s->keys.find(k);
+  if (it != s->keys.end()) {
+    for (const auto& w : it->second.writes) {
+      if (full) break;
+      if (w.op == OP_ROLLBACK) continue;
+      const std::string* val = nullptr;
+      if (w.op == OP_PUT) {
+        auto dit = it->second.data.find(w.start_ts);
+        if (dit != it->second.data.end()) val = &dit->second;
+      }
+      emit(w, val);
+    }
+  }
+  for (auto rit = s->runs.rbegin(); !full && rit != s->runs.rend(); ++rit) {
+    const Run& r = **rit;
+    if (!r.maybe(k)) continue;
+    int64_t i = r.find(k);
+    if (i < 0) continue;
+    for (uint32_t j = r.woff[i]; !full && j < r.woff[i + 1]; ++j) {
+      const WriteRec& w = r.writes[j];
+      if (w.op == OP_ROLLBACK) continue;
+      emit(w, w.op == OP_PUT ? &r.vals[j] : nullptr);
+    }
+  }
+  *used = off;
+  return n;
+}
+
 // MVCC garbage collection: drop versions not visible at safepoint
 // (gcworker analog, pkg/store/gcworker/gc_worker.go).
 int64_t kv_gc(void* h, uint64_t safepoint) {

@@ -432,6 +432,9 @@ def optimize_plan(plan: LogicalPlan) -> LogicalPlan:
     plan = fold_constants(plan)
     plan = push_predicates(plan)
     plan, _ = prune_columns(plan)
+    from .rules import eliminate_aggregation, eliminate_max_min
+    plan = eliminate_aggregation(plan)
+    plan = eliminate_max_min(plan)
     return plan
 
 

@@ -20,7 +20,9 @@ from typing import Any, Optional
 
 # sysvars that change planning decisions -> part of the key
 _PLAN_SYSVARS = ("tidb_enable_vectorized_expression",
-                 "tidb_opt_agg_push_down", "tidb_isolation_read_engines")
+                 "tidb_opt_agg_push_down", "tidb_isolation_read_engines",
+                 "tidb_enable_cascades_planner",
+                 "tidb_opt_skew_distinct_agg")
 
 
 class PlanCacheEntry:

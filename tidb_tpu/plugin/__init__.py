@@ -34,6 +34,11 @@ class PluginRegistry:
         from collections import deque
         self._plugins: list[Any] = []
         self._mu = threading.Lock()
+        # serializes the whole daemon start/stop transition: refcount
+        # check AND the start()/stop() loop, so a concurrent last-close
+        # can never stop daemons a first-open just started
+        self._daemon_mu = threading.Lock()
+        self._daemon_refs = 0
         # bounded: a misfiring plugin on a busy server must not leak
         self.errors: Any = deque(maxlen=256)       # (plugin, error)
 
@@ -94,33 +99,32 @@ class PluginRegistry:
     # stop()s them — two servers in one process share one daemon set.
 
     def start_daemons(self, domain) -> None:
-        with self._mu:
-            self._daemon_refs = getattr(self, "_daemon_refs", 0) + 1
+        with self._daemon_mu:
+            self._daemon_refs += 1
             if self._daemon_refs > 1:
                 return
-        for p in self.plugins():
-            if hasattr(p, "start"):
-                try:
-                    p.start(domain)
-                except Exception as e:   # noqa: BLE001
-                    with self._mu:
-                        self.errors.append((p.name, f"start: {e}"))
+            for p in self.plugins():
+                if hasattr(p, "start"):
+                    try:
+                        p.start(domain)
+                    except Exception as e:   # noqa: BLE001
+                        with self._mu:
+                            self.errors.append((p.name, f"start: {e}"))
 
     def stop_daemons(self) -> None:
-        with self._mu:
-            refs = getattr(self, "_daemon_refs", 0)
-            if refs == 0:
+        with self._daemon_mu:
+            if self._daemon_refs == 0:
                 return
-            self._daemon_refs = refs - 1
+            self._daemon_refs -= 1
             if self._daemon_refs > 0:
                 return
-        for p in self.plugins():
-            if hasattr(p, "stop"):
-                try:
-                    p.stop()
-                except Exception as e:   # noqa: BLE001
-                    with self._mu:
-                        self.errors.append((p.name, f"stop: {e}"))
+            for p in self.plugins():
+                if hasattr(p, "stop"):
+                    try:
+                        p.stop()
+                    except Exception as e:   # noqa: BLE001
+                        with self._mu:
+                            self.errors.append((p.name, f"stop: {e}"))
 
 
 registry = PluginRegistry()

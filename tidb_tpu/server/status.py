@@ -161,23 +161,22 @@ class StatusServer:
             return json.dumps({"schema_version": ver}), "application/json"
         raise KeyError(path)
 
-    def _mvcc_versions(self, tbl, handle: int, max_versions: int = 8,
-                       max_scan: int = 8192):
-        """Version history of a record key, recovered by walking the
-        (small, sequential) logical-ts axis downward and emitting value
-        changes.  Exact even when a value recurs (a bisect on value
-        equality would conflate recurrences); `max_scan` bounds the walk
-        and sets `truncated` when older history is out of range."""
+    def _mvcc_versions(self, tbl, handle: int, max_versions: int = 8):
+        """Version history of a record key, read straight off the native
+        store's MVCC chains (kv_versions; reference pkg/server/handler
+        mvcc handlers) — exact, newest-first, O(versions) instead of a
+        per-ts probe walk."""
         from ..store.codec import decode_row, record_key
         kv = tbl.kv
         if kv is None:
             return {"error": "table has no KV store (bulk mode)"}
         key = record_key(tbl.table_id, handle)
-        hi = kv.alloc_ts()
-        lo_bound = max(1, hi - max_scan)
+        try:
+            history, truncated = kv.versions(key, max_versions)
+        except AttributeError:
+            return {"error": "store does not expose version history"}
         out = []
-
-        def emit(ts, val):
+        for ts, val in history:
             ent = {"commit_ts": ts}
             if val is None:
                 ent["deleted"] = True
@@ -188,23 +187,8 @@ class StatusServer:
                 except Exception:
                     ent["value_len"] = len(val)
             out.append(ent)
-
-        cur = kv.get(key, hi)
-        t = hi
-        reached_origin = False
-        while t >= lo_bound and len(out) < max_versions:
-            prev = kv.get(key, t - 1) if t > 1 else None
-            if t == 1:
-                if cur is not None:
-                    emit(1, cur)
-                reached_origin = True
-                break
-            if prev != cur:
-                emit(t, cur)       # this value was committed at ts t
-                cur = prev
-            t -= 1
         res = {"key": key.hex(), "versions": out}
-        if not reached_origin and lo_bound > 1:
+        if truncated:
             res["truncated"] = True
         return res
 

@@ -877,6 +877,18 @@ class SequenceInfo:
     def _meta_key(self) -> bytes:
         return self.META_PREFIX + f"{self.db}.{self.name}".encode()
 
+    def _purge_value_key(self):
+        """Delete the persisted batch high-water mark: a dropped-and-
+        recreated sequence must restart, not resume (sequence.go drop)."""
+        if self.kv is None:
+            return
+        try:
+            txn = self.kv.begin()
+            txn.delete(self._meta_key())
+            txn.commit()
+        except Exception:
+            pass
+
     def _restore(self):
         if self.kv is None:
             return
@@ -964,6 +976,7 @@ class Catalog:
             raise CatalogError(f"unknown database {name!r}")
         del self.databases[name]
         for key in [k for k in self.sequences if k[0] == name]:
+            self.sequences[key]._purge_value_key()
             del self.sequences[key]
 
     def create_table(self, db: str, tbl: TableInfo, if_not_exists=False):
@@ -1025,6 +1038,7 @@ class Catalog:
             if if_exists:
                 return
             raise CatalogError(f"unknown sequence {name!r}")
+        self.sequences[(db, name)]._purge_value_key()
         del self.sequences[(db, name)]
 
     def get_sequence(self, db: str, name: str) -> "SequenceInfo":

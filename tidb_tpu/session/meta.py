@@ -117,7 +117,8 @@ class MetaStore:
         for t in tables:
             txn.delete(table_key(db, t.name if isinstance(t, TableInfo)
                                  else t))
-        # drop the database's sequence definitions + value keys too
+        # drop the database's sequence definitions (value keys are purged
+        # by Catalog.drop_database via SequenceInfo._purge_value_key)
         pre = M_SEQ + db.encode() + b"\x00"
         for k, _ in self.kv.scan(pre, pre + b"\xff", txn.start_ts):
             txn.delete(k)

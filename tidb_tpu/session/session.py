@@ -428,9 +428,18 @@ class Session:
             # the statement's resolved database
             from ..plugin import registry as _plugins
             out = self._dispatch_stmt(stmt)
-            _plugins.fire("on_ddl", type(stmt).__name__,
-                          getattr(stmt, "db", None) or self.db,
-                          self._cur_sql or "")
+            if isinstance(stmt, (A.CreateDatabase, A.DropDatabase)):
+                ev_dbs = [stmt.name]        # the db IS the target
+            elif isinstance(stmt, A.DropTable) and stmt.names:
+                # one event per distinct database a multi-table DROP
+                # touches, so per-schema plugins observe every change
+                ev_dbs = list(dict.fromkeys(
+                    db or self.db for db, _nm in stmt.names))
+            else:
+                ev_dbs = [getattr(stmt, "db", None) or self.db]
+            for ev_db in ev_dbs:
+                _plugins.fire("on_ddl", type(stmt).__name__, ev_db,
+                              self._cur_sql or "")
             return out
         return self._dispatch_stmt(stmt)
 
@@ -496,11 +505,11 @@ class Session:
                                               stmt.if_exists)
             return ResultSet()
         if isinstance(stmt, A.DropTable):
-            # names may be db-qualified ("db.name"); session temporary
-            # tables shadow permanent ones and drop without touching the
-            # shared catalog
+            # names are (db|None, name) tuples; session temporary tables
+            # shadow permanent ones and drop without touching the shared
+            # catalog
             def split(n):
-                db, _, nm = n.rpartition(".")
+                db, nm = n
                 return (db or self.db, nm)
 
             remaining = []
@@ -519,10 +528,13 @@ class Session:
                 # (MySQL semantics: unknown temp names are errors unless
                 # IF EXISTS)
                 if remaining and not stmt.if_exists:
+                    miss = ".".join(p for p in remaining[0] if p)
                     raise CatalogError(
-                        f"unknown temporary table {remaining[0]!r}")
+                        f"unknown temporary table {miss!r}")
                 return ResultSet()
-            bare = {split(n)[1] for n in remaining}
+            # qualified (db, name) pairs: a same-named table in another
+            # database must not suppress the FK guard
+            dropping = {split(n) for n in remaining}
             for n in remaining:
                 db, nm = split(n)
                 refs = [
@@ -530,7 +542,7 @@ class Session:
                     for t in self.domain.catalog.databases
                     .get(db, {}).values()
                     for fk in getattr(t, "foreign_keys", [])
-                    if fk.ref_table == nm and t.name not in bare]
+                    if fk.ref_table == nm and (db, t.name) not in dropping]
                 if refs:
                     raise CatalogError(
                         f"Cannot drop table {nm!r}: referenced by a "
@@ -712,17 +724,20 @@ class Session:
                 not isinstance(e, A.Lit)
                 for _c, e in getattr(stmt, "assignments", ()))
             if reads:
-                priv.require(self.user, "SELECT", self.db,
+                priv.require(self.user, "SELECT",
+                             getattr(stmt, "db", None) or self.db,
                              getattr(stmt, "table", ""))
         target = getattr(stmt, "table", None) or getattr(stmt, "name", "")
         if isinstance(stmt, A.DropTable):
-            for n in stmt.names:
-                db, _, nm = n.rpartition(".")
+            for db, nm in stmt.names:
                 priv.require(self.user, need, db or self.db, nm)
             return
         if isinstance(stmt, (A.CreateDatabase, A.DropDatabase)):
             return priv.require(self.user, need, stmt.name)
-        priv.require(self.user, need, self.db, target)
+        # db-qualified DDL/DML (CREATE INDEX db.t, ALTER TABLE db.t, ...)
+        # must check the QUALIFIED database, not the session one
+        db = getattr(stmt, "db", None) or self.db
+        priv.require(self.user, need, db, target)
 
     def _referenced_tables(self, node: A.Node) -> list[tuple]:
         """All (db, table) names a query reads — walks FROM clauses,
@@ -880,8 +895,15 @@ class Session:
         self._maybe_auto_analyze(built.plan)
         plan = optimize_plan(built.plan)
         self._note_predicate_columns(plan)
-        from ..planner.join_reorder import reorder_joins
-        plan = reorder_joins(plan, self.domain.stats)
+        if _flag_on(merged, "tidb_opt_skew_distinct_agg", default=False):
+            from ..planner.rules import rewrite_skew_distinct
+            plan = rewrite_skew_distinct(plan)
+        if _flag_on(merged, "tidb_enable_cascades_planner", default=False):
+            from ..planner.cascades import cascades_optimize
+            plan = cascades_optimize(plan, self.domain.stats)
+        else:
+            from ..planner.join_reorder import reorder_joins
+            plan = reorder_joins(plan, self.domain.stats)
         plan = apply_index_paths(plan, self.domain.stats)
         from ..executor.plan import STATS_HANDLE
         tok = STATS_HANDLE.set(self.domain.stats)

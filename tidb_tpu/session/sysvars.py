@@ -66,6 +66,8 @@ _VARS = [
     _v("tidb_mem_quota_query", -1, kind="int"),
     _v("tidb_enable_tmp_storage_on_oom", 1, kind="bool"),
     _v("tidb_enable_plan_cache", 1, kind="bool"),
+    _v("tidb_enable_cascades_planner", 0, kind="bool"),
+    _v("tidb_opt_skew_distinct_agg", 0, kind="bool"),
     _v("tidb_gc_life_time_sec", 600, kind="int", min=1),
     _v("tidb_gc_run_interval_sec", 60, kind="int", min=1),
     _v("tidb_ttl_job_interval_sec", 60, kind="int", min=1),

@@ -345,7 +345,9 @@ class DropView(Node):
 
 @dataclass
 class DropTable(Node):
-    names: list[str] = field(default_factory=list)
+    # (db | None, name) tuples — tuples, not dotted strings, so backtick
+    # identifiers containing dots round-trip
+    names: list[tuple] = field(default_factory=list)
     if_exists: bool = False
     temporary: bool = False      # DROP TEMPORARY TABLE: temp scope ONLY
 

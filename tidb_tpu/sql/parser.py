@@ -1222,10 +1222,12 @@ class Parser:
             ie = True
 
         def qname():
+            # (db | None, name) tuple: a dotted string would mis-split
+            # backtick identifiers that CONTAIN dots (`a.b`)
             n = self.ident()
             if self.accept_op("."):
-                return f"{n}.{self.ident()}"
-            return n
+                return (n, self.ident())
+            return (None, n)
 
         names = [qname()]
         while self.accept_op(","):

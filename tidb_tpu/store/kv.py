@@ -88,6 +88,11 @@ def _load_lib():
             ctypes.c_char_p, ctypes.c_int32, ctypes.c_uint64, ctypes.c_int32,
             ctypes.c_char_p, ctypes.c_int64,
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_uint8)]
+        lib.kv_versions.restype = ctypes.c_int32
+        lib.kv_versions.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_uint8)]
         lib.kv_gc.restype = ctypes.c_int64
         lib.kv_gc.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib.kv_num_keys.restype = ctypes.c_int64
@@ -262,6 +267,31 @@ class KVStore:
             if not trunc.value or last_key is None:
                 return
             cur = last_key + b"\x00"
+
+    def versions(self, key: bytes, max_versions: int = 64
+                 ) -> tuple[list[tuple[int, Optional[bytes]]], bool]:
+        """MVCC history of one key, newest-first: [(commit_ts, value or
+        None-for-delete)], plus a truncation flag.  Served straight from
+        the native version chains (memtable + runs) — the status API's
+        /mvcc handler reads this instead of probing every ts."""
+        key = self._pk(key)
+        buf = ctypes.create_string_buffer(1 << 20)
+        used = ctypes.c_int64()
+        trunc = ctypes.c_uint8()
+        n = int(self._lib.kv_versions(self._h, key, len(key), max_versions,
+                                      buf, len(buf), ctypes.byref(used),
+                                      ctypes.byref(trunc)))
+        out: list[tuple[int, Optional[bytes]]] = []
+        raw = buf.raw[:used.value]
+        off = 0
+        import struct as _struct
+        for _ in range(max(n, 0)):
+            ts, op, vlen = _struct.unpack_from("<QBi", raw, off)
+            off += 13
+            val = raw[off:off + vlen] if op == 0 else None
+            off += max(vlen, 0)
+            out.append((ts, val))
+        return out, bool(trunc.value)
 
     def gc(self, safepoint: int) -> int:
         return int(self._lib.kv_gc(self._h, safepoint))
