@@ -1,0 +1,141 @@
+"""VECTOR type + distance functions (reference: pkg/types VectorFloat32,
+chunk/column.go:60 vector appender, expression vec_* builtins)."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.session import Domain, Session
+
+
+@pytest.fixture()
+def sess():
+    dom = Domain()
+    s = Session(dom)
+    s.execute("create table emb (id bigint primary key, v vector(3))")
+    s.execute("insert into emb values (1, '[1,0,0]'), (2, '[0,1,0]'), "
+              "(3, '[0.5,0.5,0]'), (4, NULL), (5, '[3,4,0]')")
+    return s
+
+
+def test_roundtrip_and_dims(sess):
+    rows = sess.must_query("select id, v from emb order by id")
+    assert rows[0] == (1, "[1,0,0]")
+    assert rows[3] == (4, None)
+    assert sess.must_query(
+        "select id, vec_dims(v) from emb order by id")[0] == (1, 3)
+    assert sess.must_query(
+        "select vec_dims(v) from emb where id = 4") == [(None,)]
+
+
+def test_l2_and_l1_distance(sess):
+    got = sess.must_query(
+        "select id, vec_l2_distance(v, '[1,0,0]') from emb order by id")
+    assert got[0][1] == pytest.approx(0.0)
+    assert got[1][1] == pytest.approx(np.sqrt(2))
+    assert got[3][1] is None
+    got = sess.must_query(
+        "select vec_l1_distance(v, '[0,0,0]') from emb where id = 5")
+    assert got[0][0] == pytest.approx(7.0)
+
+
+def test_cosine_and_inner_product(sess):
+    got = dict(sess.must_query(
+        "select id, vec_cosine_distance(v, '[1,0,0]') from emb "
+        "where id in (1,2,3)"))
+    assert got[1] == pytest.approx(0.0)
+    assert got[2] == pytest.approx(1.0)
+    assert got[3] == pytest.approx(1 - 0.5 / (np.sqrt(0.5)))
+    got = sess.must_query(
+        "select vec_negative_inner_product(v, '[2,2,0]') from emb "
+        "where id = 3")
+    assert got[0][0] == pytest.approx(-2.0)
+    # zero-norm vector: cosine undefined -> NULL
+    sess.execute("insert into emb values (9, '[0,0,0]')")
+    assert sess.must_query(
+        "select vec_cosine_distance(v, '[1,0,0]') from emb "
+        "where id = 9") == [(None,)]
+
+
+def test_ann_topk_order_by_distance(sess):
+    rows = sess.must_query(
+        "select id from emb where v is not null "
+        "order by vec_l2_distance(v, '[0.9,0.1,0]') limit 2")
+    assert [r[0] for r in rows] == [1, 3]
+
+
+def test_norm_and_as_text(sess):
+    assert sess.must_query(
+        "select vec_l2_norm(v) from emb where id = 5")[0][0] == \
+        pytest.approx(5.0)
+    assert sess.must_query(
+        "select vec_as_text(v) from emb where id = 3") == \
+        [("[0.5,0.5,0]",)]
+    assert sess.must_query(
+        "select vec_l2_distance('[1,2]', '[1,2]')") == [(0.0,)]
+
+
+def test_dimension_validation(sess):
+    from tidb_tpu.planner.build import PlanError
+    with pytest.raises(Exception):
+        sess.execute("insert into emb values (10, '[1,2]')")  # dim 2 != 3
+    with pytest.raises((PlanError, ValueError, Exception)):
+        sess.must_query("select vec_l2_distance(v, '[1,2]') from emb "
+                        "where id = 1")
+
+
+def test_vector_aggregates_and_group(sess):
+    # count/count distinct over vector column (host path)
+    assert sess.must_query(
+        "select count(v) from emb")[0][0] == 4
+    # join carrying a vector column through
+    sess.execute("create table meta (id bigint, tag bigint)")
+    sess.execute("insert into meta values (1, 10), (2, 20), (5, 50)")
+    rows = sess.must_query(
+        "select meta.tag, vec_l2_norm(emb.v) from emb "
+        "join meta on emb.id = meta.id order by meta.tag")
+    assert rows[0] == (10, pytest.approx(1.0))
+    assert rows[2] == (50, pytest.approx(5.0))
+
+
+def test_mixed_dimension_unconstrained_column():
+    # dim -1 = per-value dimensions (review finding): unary functions and
+    # row-wise-matched binary functions work; a row PAIR that mismatches
+    # errors
+    dom = Domain()
+    s = Session(dom)
+    s.execute("create table u (id bigint, v vector)")
+    s.execute("insert into u values (1, '[3,4]'), (2, '[1,2,2]')")
+    got = dict(s.must_query("select id, vec_l2_norm(v) from u"))
+    assert got[1] == pytest.approx(5.0)
+    assert got[2] == pytest.approx(3.0)
+    assert dict(s.must_query("select id, vec_dims(v) from u")) == \
+        {1: 2, 2: 3}
+    # same-row pairing is fine even with mixed dims across rows
+    got = s.must_query("select vec_l2_distance(v, v) from u")
+    assert [r[0] for r in got] == [pytest.approx(0.0)] * 2
+    with pytest.raises(Exception):
+        s.must_query("select vec_l2_distance(v, '[1,0]') from u "
+                     "where id = 2")
+
+
+def test_text_roundtrip_preserves_float32():
+    # shortest-round-trip formatting (review finding): %g would truncate
+    dom = Domain()
+    s = Session(dom)
+    s.execute("create table rt (v vector(3))")
+    s.execute("insert into rt values ('[0.30000001192,1.4142135,3]')")
+    txt = s.must_query("select v from rt")[0][0]
+    back = np.array([float(x) for x in txt[1:-1].split(",")], np.float32)
+    want = np.array([0.30000001192, 1.4142135, 3], np.float32)
+    assert (back == want).all(), txt
+
+
+def test_kv_persistence_roundtrip(tmp_path):
+    dom = Domain()
+    s = Session(dom)
+    s.execute("create table ev (id bigint primary key, e vector)")
+    s.execute("insert into ev values (1, '[1.5,-2.25]')")
+    s.execute("update ev set e = '[4,5]' where id = 1")
+    assert s.must_query("select e from ev") == [("[4,5]",)]
+    s.execute("delete from ev where id = 1")
+    assert s.must_query("select count(*) from ev") == [(0,)]

@@ -98,6 +98,21 @@ class Column:
             d = dictionary or StringDict.build(values)
             codes, valid = d.encode_array(list(values))
             return cls(dtype, codes, valid, d)
+        if kind == dt.TypeKind.VECTOR:
+            out = np.empty(n, dtype=object)
+            for i, v in enumerate(values):
+                if v is None:
+                    continue
+                if isinstance(v, str):
+                    out[i] = dt.parse_vector_text(v, dtype.prec)
+                else:
+                    arr = np.asarray(v, dtype=np.float32)
+                    if dtype.prec > 0 and len(arr) != dtype.prec:
+                        raise ValueError(
+                            f"vector has {len(arr)} dimensions, "
+                            f"expected {dtype.prec}")
+                    out[i] = arr
+            return cls(dtype, out, valid)
         out = np.zeros(n, dtype=dtype.np_dtype())
         for i, v in enumerate(values):
             if v is None:
@@ -158,6 +173,8 @@ class Column:
                 out.append(",".join(
                     v for j, v in enumerate(self.dtype.members)
                     if m >> j & 1))
+            elif kind == dt.TypeKind.VECTOR:
+                out.append(dt.vector_to_text(self.data[i]))
             else:
                 out.append(int(self.data[i]))
         return out

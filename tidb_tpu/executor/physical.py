@@ -60,8 +60,8 @@ DEVICE_OPS = {
 
 
 def _device_supported(e: Expr) -> bool:
-    if e.dtype is not None and getattr(e.dtype, "is_wide_decimal", False):
-        return False     # 19-65 digit decimals are host object arrays
+    if e.dtype is not None and getattr(e.dtype, "is_host_object", False):
+        return False     # wide decimals / vectors are host object arrays
     if isinstance(e, Func):
         if e.op not in DEVICE_OPS:
             return False
@@ -610,8 +610,16 @@ def _eval_to_column(e: Expr, chunk: ResultChunk) -> Column:
     dicts = _chunk_dicts(chunk)
     e = lower_strings(e, dicts)
     v, m = eval_expr(np, e, chunk.col_pairs(), dicts)
-    v = np.broadcast_to(np.asarray(v), (n,)).copy() if np.ndim(v) == 0 \
-        else np.asarray(v)
+    if getattr(e.dtype, "is_vector", False):
+        v = np.asarray(v)
+        if v.dtype != object:       # one constant vector: replicate
+            single = v.astype(np.float32)
+            v = np.empty(n, object)
+            for i in range(n):
+                v[i] = single
+    else:
+        v = np.broadcast_to(np.asarray(v), (n,)).copy() if np.ndim(v) == 0 \
+            else np.asarray(v)
     if v.dtype == bool:
         v = v.astype(np.int64)
     if m is True:

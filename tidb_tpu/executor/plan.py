@@ -259,9 +259,9 @@ def _try_index_ordered_topn(p) -> Optional[PhysOp]:
 
 
 def _scan_device_ok(ds) -> bool:
-    """Wide (19-65 digit) decimal columns are host object arrays and can
-    never be stacked into device shards."""
-    return not any(getattr(c.dtype, "is_wide_decimal", False)
+    """Wide (19-65 digit) decimal and VECTOR columns are host object
+    arrays and can never be stacked into device shards."""
+    return not any(getattr(c.dtype, "is_host_object", False)
                    for c in ds.schema.cols)
 
 def _try_cop(p: LogicalPlan, no_device_join: bool = False) -> Optional[PhysOp]:

@@ -366,6 +366,123 @@ class Evaluator:
             v = _as_i64(self.xp, v)
         return self.xp.abs(v), m
 
+    # -- vector functions (host-only; types VectorFloat32 analog) -------- #
+
+    def _vec_mat(self, arg, cols, memo):
+        """(matrix (n|1, maxd) zero-padded, dims (n|1,), valid (n|1,),
+        is_column) for one vector arg.  Zero-padding to the column's max
+        dimension keeps norms/dots/distances exact per row, so an
+        unconstrained VECTOR column may hold mixed dimensions; binary
+        functions enforce per-ROW dimension equality (vector.go
+        CheckVectorDims semantics)."""
+        v, m = self.eval(arg, cols, memo)
+        if isinstance(v, np.ndarray) and v.dtype == object:
+            n = len(v)
+            valid = np.array(_mask_arr(np, m, v), bool).copy()
+            dims = np.zeros(n, np.int64)
+            for i in range(n):
+                if not valid[i] or v[i] is None:
+                    valid[i] = False
+                else:
+                    dims[i] = len(v[i])
+            maxd = int(dims.max()) if n else 0
+            mat = np.zeros((n, maxd), np.float32)
+            for i in range(n):
+                if valid[i]:
+                    mat[i, :dims[i]] = v[i]
+            return mat, dims, valid, True
+        if v is None or (not isinstance(v, np.ndarray) and m is False):
+            return (np.zeros((1, 0), np.float32), np.zeros(1, np.int64),
+                    np.array([False]), False)
+        arr = np.asarray(v, np.float32).reshape(1, -1)
+        return (arr, np.full(1, arr.shape[1], np.int64),
+                np.array([bool(m) if m in (True, False) else True]), False)
+
+    def _vec_binary(self, e, cols, memo, fn):
+        a, da, va, acol = self._vec_mat(e.args[0], cols, memo)
+        b, db, vb, bcol = self._vec_mat(e.args[1], cols, memo)
+        valid = va & vb
+        # per-row dimension check over the rows that actually pair up
+        nrows = max(len(da), len(db))
+        pa = np.broadcast_to(da, (nrows,))
+        pb = np.broadcast_to(db, (nrows,))
+        pv = np.broadcast_to(valid, (nrows,))
+        if bool(((pa != pb) & pv).any()):
+            raise ValueError("vectors have different dimensions")
+        d = max(a.shape[1], b.shape[1])
+        if a.shape[1] != d:
+            a = np.pad(a, ((0, 0), (0, d - a.shape[1])))
+        if b.shape[1] != d:
+            b = np.pad(b, ((0, 0), (0, d - b.shape[1])))
+        out = fn(a.astype(np.float64), b.astype(np.float64))
+        if not acol and not bcol:
+            return float(out[0]), bool(valid[0])
+        return out, valid
+
+    def op_vec_l2_distance(self, e, cols, memo):
+        return self._vec_binary(
+            e, cols, memo,
+            lambda a, b: np.sqrt(((a - b) ** 2).sum(axis=1)))
+
+    def op_vec_l1_distance(self, e, cols, memo):
+        return self._vec_binary(
+            e, cols, memo, lambda a, b: np.abs(a - b).sum(axis=1))
+
+    def op_vec_negative_inner_product(self, e, cols, memo):
+        return self._vec_binary(
+            e, cols, memo, lambda a, b: -(a * b).sum(axis=1))
+
+    def op_vec_cosine_distance(self, e, cols, memo):
+        def cos(a, b):
+            na = np.sqrt((a * a).sum(axis=1))
+            nb = np.sqrt((b * b).sum(axis=1))
+            denom = na * nb
+            with np.errstate(divide="ignore", invalid="ignore"):
+                out = 1.0 - (a * b).sum(axis=1) / denom
+            return np.where(denom == 0, np.nan, out)
+        v, m = self._vec_binary(e, cols, memo, cos)
+        # zero-norm input: NULL (undefined angle)
+        if isinstance(v, np.ndarray):
+            bad = np.isnan(v)
+            return np.where(bad, 0.0, v), _mask_arr(np, m, v) & ~bad
+        return (0.0, False) if v != v else (v, m)
+
+    def op_vec_dims(self, e, cols, memo):
+        v, m = self.eval(e.args[0], cols, memo)
+        if isinstance(v, np.ndarray) and v.dtype == object:
+            valid = np.array(_mask_arr(np, m, v), bool).copy()
+            out = np.zeros(len(v), np.int64)
+            for i, x in enumerate(v):
+                if valid[i] and x is not None:
+                    out[i] = len(x)
+                else:
+                    valid[i] = False
+            return out, valid
+        arr = np.asarray(v, np.float32).reshape(-1)
+        return np.int64(len(arr)), m
+
+    def op_vec_l2_norm(self, e, cols, memo):
+        mat, _dims, valid, col = self._vec_mat(e.args[0], cols, memo)
+        out = np.sqrt((mat.astype(np.float64) ** 2).sum(axis=1))
+        if not col:
+            return float(out[0]), bool(valid[0])
+        return out, valid
+
+    def op_vec_as_text(self, e, cols, memo):
+        from ..types.dtypes import vector_to_text
+        v, m = self.eval(e.args[0], cols, memo)
+        if isinstance(v, np.ndarray) and v.dtype == object:
+            valid = np.array(_mask_arr(np, m, v), bool).copy()
+            out = np.empty(len(v), object)
+            for i, x in enumerate(v):
+                if valid[i] and x is not None:
+                    out[i] = vector_to_text(x)
+                else:
+                    out[i] = ""
+                    valid[i] = False
+            return out, valid
+        return vector_to_text(np.asarray(v, np.float32).reshape(-1)), m
+
     # -- comparisons ----------------------------------------------------- #
 
     def _cmp(self, e, cols, memo, fn):

@@ -378,6 +378,24 @@ class ExprBuilder:
             if part is None:
                 raise PlanError(f"unsupported EXTRACT unit {unit}")
             return B.temporal_part(part, args[1])
+        if name in ("VEC_COSINE_DISTANCE", "VEC_L2_DISTANCE",
+                    "VEC_L1_DISTANCE", "VEC_NEGATIVE_INNER_PRODUCT"):
+            # vector similarity (reference: types VectorFloat32 +
+            # expression vec builtins); args coerce from '[..]' text
+            return Func(dt.double(True), name.lower(),
+                        (self._vec_arg(args[0], name),
+                         self._vec_arg(args[1], name)))
+        if name == "VEC_DIMS":
+            return Func(dt.bigint(True), "vec_dims",
+                        (self._vec_arg(args[0], name),))
+        if name == "VEC_L2_NORM":
+            return Func(dt.double(True), "vec_l2_norm",
+                        (self._vec_arg(args[0], name),))
+        if name == "VEC_FROM_TEXT":
+            return self._vec_arg(args[0], name)
+        if name == "VEC_AS_TEXT":
+            return Func(dt.varchar(True), "vec_as_text",
+                        (self._vec_arg(args[0], name),))
         if name == "ABS":
             return Func(args[0].dtype, "abs", tuple(args))
         if name in ("CEIL", "CEILING"):
@@ -881,6 +899,23 @@ class ExprBuilder:
             return months
         per = 3 if unit == "QUARTER" else 12
         return Func(bt, "intdiv", (months, Const(dt.bigint(False), per)))
+
+    def _vec_arg(self, a: Expr, fname: str) -> Expr:
+        """Coerce one vector-function argument: vector expressions pass
+        through; constant '[..]' text parses at plan time (the implicit
+        string->VECTOR cast of types/vector.go)."""
+        if a.dtype is not None and getattr(a.dtype, "is_vector", False):
+            return a
+        if isinstance(a, Const) and isinstance(a.value, str):
+            try:
+                arr = dt.parse_vector_text(a.value)
+            except ValueError as e:
+                raise PlanError(str(e))
+            return Const(dt.vector(len(arr), nullable=False), arr)
+        if isinstance(a, Const) and a.value is None:
+            return Const(dt.vector(), None)
+        raise PlanError(f"{fname} expects a VECTOR column or a constant "
+                        "'[...]' literal")
 
     def _str_func(self, op: str, *args: Expr) -> Expr:
         """String function with plan-time constant folding and a

@@ -82,6 +82,10 @@ def type_from_sql(name: str, prec: int, scale: int, not_null: bool,
             raise CatalogError(str(e))
     if base == "BIT":
         return dt.bit(prec if prec > 0 else 1, nullable=not not_null)
+    if base == "VECTOR":
+        if prec > 16000:
+            raise CatalogError("vector dimension cannot exceed 16000")
+        return dt.vector(prec if prec > 0 else -1, nullable=not not_null)
     fn = TYPE_MAP.get(base)
     if fn is None:
         raise CatalogError(f"unsupported column type {name}")
@@ -879,15 +883,14 @@ class SequenceInfo:
 
     def _purge_value_key(self):
         """Delete the persisted batch high-water mark: a dropped-and-
-        recreated sequence must restart, not resume (sequence.go drop)."""
+        recreated sequence must restart, not resume (sequence.go drop).
+        Failures propagate — a silent miss would re-enable stale
+        resumption with no diagnostic."""
         if self.kv is None:
             return
-        try:
-            txn = self.kv.begin()
-            txn.delete(self._meta_key())
-            txn.commit()
-        except Exception:
-            pass
+        txn = self.kv.begin()
+        txn.delete(self._meta_key())
+        txn.commit()
 
     def _restore(self):
         if self.kv is None:

@@ -15,6 +15,8 @@ from __future__ import annotations
 import struct
 from typing import Any, Optional, Sequence
 
+import numpy as np
+
 from ..types import dtypes as dt
 from ..types import decimal as dec
 from ..types import temporal as tmp
@@ -207,6 +209,13 @@ def encode_row(values: Sequence[Any], types: Sequence[dt.DataType]) -> bytes:
         elif k == K.BIT:
             out.append(7)
             out += struct.pack("<Q", int(v))
+        elif k == K.VECTOR:
+            # [u16 dim][f32 x dim] (types VectorFloat32 serialization)
+            arr = (dt.parse_vector_text(v, t.prec) if isinstance(v, str)
+                   else np.asarray(v, dtype=np.float32))
+            out.append(9)
+            out += struct.pack("<H", len(arr))
+            out += arr.tobytes()
         else:
             raise ValueError(f"cannot encode {t}")
     return bytes(out)
@@ -265,6 +274,11 @@ def decode_row(data: bytes, types: Sequence[dt.DataType]) -> list[Any]:
             (v,) = struct.unpack_from("<Q", data, off)
             off += 8
             out.append(int(v))
+        elif tag == 9:
+            (dim,) = struct.unpack_from("<H", data, off)
+            off += 2
+            out.append(np.frombuffer(data, np.float32, dim, off).copy())
+            off += 4 * dim
         else:
             raise ValueError(f"bad tag {tag}")
     return out

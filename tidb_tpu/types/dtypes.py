@@ -49,6 +49,7 @@ class TypeKind(enum.Enum):
     ENUM = "enum"      # 1-based member index (pkg/types/enum.go)
     SET = "set"        # member bitmask (pkg/types/set.go)
     BIT = "bit"        # BIT(n): uint64 bit value (pkg/types/binary_literal.go)
+    VECTOR = "vector"  # VECTOR(d): float32[d] embedding (types VectorFloat32)
     NULL = "null"  # type of the NULL literal before inference
 
 
@@ -125,12 +126,26 @@ class DataType:
         return (self.kind == TypeKind.DECIMAL
                 and self.prec > DECIMAL64_MAX_PRECISION)
 
+    @property
+    def is_vector(self) -> bool:
+        return self.kind == TypeKind.VECTOR
+
+    @property
+    def is_host_object(self) -> bool:
+        """Object-array host representation: never stacked into device
+        shards (wide decimals, float32 vectors)."""
+        return self.is_wide_decimal or self.kind == TypeKind.VECTOR
+
     def np_dtype(self) -> np.dtype:
         """numpy dtype of the dense host/device representation."""
         if (self.kind == TypeKind.DECIMAL
                 and self.prec > DECIMAL64_MAX_PRECISION):
             # wide decimal: host-only representation as python ints (exact);
             # never shipped to device — produced by aggregation finalize
+            return np.dtype(object)
+        if self.kind == TypeKind.VECTOR:
+            # one float32[d] ndarray per row (object array on the host;
+            # distance kernels stack to an (N, d) matrix)
             return np.dtype(object)
         return np.dtype(_NP_DTYPES[self.kind])
 
@@ -140,6 +155,8 @@ class DataType:
     def __str__(self) -> str:
         if self.kind == TypeKind.DECIMAL:
             return f"decimal({self.prec},{self.scale})"
+        if self.kind == TypeKind.VECTOR and self.prec > 0:
+            return f"vector({self.prec})"
         return self.kind.value
 
 
@@ -156,6 +173,7 @@ _NP_DTYPES = {
     TypeKind.ENUM: np.int32,
     TypeKind.SET: np.int64,
     TypeKind.BIT: np.uint64,
+    TypeKind.VECTOR: object,
     TypeKind.NULL: np.int64,
 }
 
@@ -215,6 +233,38 @@ def set_type(members, nullable: bool = True) -> DataType:
 
 def bit(width: int = 1, nullable: bool = True) -> DataType:
     return DataType(TypeKind.BIT, nullable, prec=max(width, 1))
+
+
+def vector(dim: int = -1, nullable: bool = True) -> DataType:
+    """VECTOR(d) float32 embedding column (reference: types
+    VectorFloat32, chunk/column.go:60 appender).  dim -1 = unconstrained
+    (any dimension; per-value)."""
+    return DataType(TypeKind.VECTOR, nullable, prec=dim)
+
+
+def parse_vector_text(s: str, dim: int = -1) -> np.ndarray:
+    """'[1,2,3]' -> float32 array, validating the declared dimension
+    (types/vector.go ParseVectorFloat32 analog)."""
+    txt = s.strip()
+    if not (txt.startswith("[") and txt.endswith("]")):
+        raise ValueError(f"invalid vector text: {s!r}")
+    body = txt[1:-1].strip()
+    vals = [float(x) for x in body.split(",")] if body else []
+    arr = np.asarray(vals, dtype=np.float32)
+    if not np.isfinite(arr).all():
+        raise ValueError("vector values must be finite")
+    if dim > 0 and len(arr) != dim:
+        raise ValueError(f"vector has {len(arr)} dimensions, "
+                         f"expected {dim}")
+    return arr
+
+
+def vector_to_text(v: np.ndarray) -> str:
+    # shortest repr that round-trips float32 (vector.go String analog);
+    # %g would truncate to 6 significant digits and corrupt embeddings
+    return "[" + ",".join(
+        np.format_float_positional(np.float32(x), unique=True, trim="-")
+        for x in v) + "]"
 
 
 def enum_index(t: DataType, s: str) -> int:
