@@ -132,3 +132,100 @@ def test_ci_pushes_to_device(sess):
     plan = "\n".join(r[0] for r in sess.must_query(
         "explain select count(*) from t where name = 'apple'"))
     assert "CopTask[agg]" in plan, plan
+
+
+def test_collation_matrix_semantics():
+    """Registry semantics per collation (util/collate matrix analog)."""
+    from tidb_tpu.utils.collate import sortkey
+
+    # general_ci: per-char weights — ß equals s, NOT ss
+    assert sortkey("ß", "utf8mb4_general_ci") == \
+        sortkey("s", "utf8mb4_general_ci")
+    assert sortkey("ß", "utf8mb4_general_ci") != \
+        sortkey("ss", "utf8mb4_general_ci")
+    # unicode_ci / 0900_ai_ci: full expansion — ß equals ss
+    for coll in ("utf8mb4_unicode_ci", "utf8mb4_0900_ai_ci"):
+        assert sortkey("ß", coll) == sortkey("ss", coll), coll
+    # accents: ai collations fold, as_ci keeps
+    assert sortkey("é", "utf8mb4_0900_ai_ci") == \
+        sortkey("e", "utf8mb4_0900_ai_ci")
+    assert sortkey("é", "utf8mb4_0900_as_ci") != \
+        sortkey("e", "utf8mb4_0900_as_ci")
+    assert sortkey("É", "utf8mb4_0900_as_ci") == \
+        sortkey("é", "utf8mb4_0900_as_ci")
+    # pad: PAD SPACE collations ignore trailing spaces; 0900 do not
+    assert sortkey("a ", "utf8mb4_general_ci") == \
+        sortkey("a", "utf8mb4_general_ci")
+    assert sortkey("a ", "utf8mb4_0900_ai_ci") != \
+        sortkey("a", "utf8mb4_0900_ai_ci")
+
+
+def test_show_collation_and_charset():
+    from tidb_tpu.session import Domain, Session
+    s = Session(Domain())
+    rows = s.must_query("show collation")
+    names = [r[0] for r in rows]
+    assert "utf8mb4_bin" in names and "utf8mb4_0900_ai_ci" in names
+    pad = dict((r[0], r[6]) for r in rows)
+    assert pad["utf8mb4_general_ci"] == "PAD SPACE"
+    assert pad["utf8mb4_0900_ai_ci"] == "NO PAD"
+    assert s.must_query("show collation like 'utf8mb4_gen%'") == [
+        r for r in rows if r[0].startswith("utf8mb4_gen")]
+    charsets = [r[0] for r in s.must_query("show character set")]
+    assert "utf8mb4" in charsets and "latin1" in charsets
+    isc = s.must_query(
+        "select collation_name, pad_attribute from "
+        "information_schema.collations where collation_name like "
+        "'utf8mb4_0900%'")
+    assert ("utf8mb4_0900_ai_ci", "NO PAD") in isc
+
+
+def test_per_collation_column_behavior():
+    from tidb_tpu.session import Domain, Session
+    s = Session(Domain())
+    s.execute("create table cg (a varchar(20) collate utf8mb4_general_ci,"
+              " b varchar(20) collate utf8mb4_0900_as_ci)")
+    s.execute("insert into cg values ('straße', 'résumé'), "
+              "('STRASSE', 'resume')")
+    # general_ci: ß weighs as one 's' — 'straße' (6 ch) matches 'strase'
+    # but never 'strasse'/'STRASSE' (7 ch); MySQL's documented quirk
+    assert s.must_query(
+        "select count(*) from cg where a = 'strase'") == [(1,)]
+    # 'strasse' matches only the STRASSE row, not straße
+    assert s.must_query(
+        "select count(*) from cg where a = 'strasse'") == [(1,)]
+    assert s.must_query(
+        "select count(*) from cg where a = 'straße'") == [(1,)]
+    # as_ci: case-insensitive, accent-SENSITIVE
+    assert s.must_query(
+        "select count(*) from cg where b = 'RÉSUMÉ'") == [(1,)]
+    assert s.must_query(
+        "select count(*) from cg where b = 'resume'") == [(1,)]
+
+
+def test_weight_string():
+    from tidb_tpu.session import Domain, Session
+    s = Session(Domain())
+    s.execute("create table w (a varchar(20) collate utf8mb4_general_ci)")
+    s.execute("insert into w values ('Apple'), ('APPLE '), ('banana')")
+    got = s.must_query("select weight_string(a) from w")
+    vals = [r[0] for r in got]
+    assert vals[0] == vals[1]            # case+pad fold to equal weights
+    assert vals[2] != vals[0]
+
+
+def test_weight_string_non_string_is_null():
+    from tidb_tpu.session import Domain, Session
+    s = Session(Domain())
+    assert s.must_query("select weight_string(123)") == [(None,)]
+
+
+def test_charset_maxlen():
+    from tidb_tpu.session import Domain, Session
+    s = Session(Domain())
+    ml = dict((r[0], r[3]) for r in s.must_query("show character set"))
+    assert ml["utf8mb4"] == 4 and ml["latin1"] == 1
+    isc = dict(s.must_query(
+        "select character_set_name, maxlen from "
+        "information_schema.character_sets"))
+    assert isc["latin1"] == 1 and isc["utf8mb4"] == 4

@@ -1,0 +1,70 @@
+"""Global CPU-aware pool manager (pkg/resourcemanager analog)."""
+
+import time
+
+from tidb_tpu.session import Domain, Session
+from tidb_tpu.utils.poolmgr import PoolManager
+
+
+def test_shared_pool_and_stats():
+    m = PoolManager(cpu=4)
+    ex1 = m.pool("x")
+    ex2 = m.pool("x")
+    assert ex1 is ex2                     # shared, not per-caller
+    futs = [m.submit("x", lambda v=i: v * 2) for i in range(10)]
+    assert sorted(f.result() for f in futs) == [v * 2 for v in range(10)]
+    rows = m.stats_rows()
+    (name, workers, sub, done, busy, wait_ms, run_ms), = rows
+    assert name == "x" and workers == 4
+    assert sub == 10 and done == 10 and busy == 0
+
+
+def test_weight_and_resize():
+    m = PoolManager(cpu=8)
+    m.pool("half", weight=0.5)
+    assert m.stats_rows()[0][1] == 4
+    m.resize("half", 2)
+    assert m.stats_rows()[0][1] == 2
+    assert m.submit("half", lambda: 7).result() == 7
+
+
+def test_executor_rides_manager_pool():
+    from tidb_tpu.utils.poolmgr import MANAGER
+    dom = Domain()
+    s = Session(dom)
+    s.execute("create table p (a bigint, b bigint)")
+    s.execute("insert into p values " +
+              ",".join(f"({i},{i*2})" for i in range(500)))
+    before = dict((r[0], r[2]) for r in MANAGER.stats_rows())
+    # a parallel host projection path: join forces host operators
+    s.must_query("select p1.a + p2.b from p p1 join p p2 on p1.a = p2.a "
+                 "where p1.b > 10")
+    after = dict((r[0], r[2]) for r in MANAGER.stats_rows())
+    assert after.get("executor", 0) >= before.get("executor", 0)
+    rows = s.must_query("select name, workers from "
+                        "information_schema.thread_pools")
+    assert any(r[0] == "executor" for r in rows) or rows == []
+
+
+def test_nested_submission_does_not_deadlock():
+    # caller-runs policy (review finding): a task on pool 'n' submitting
+    # back to 'n' and waiting must complete even with ONE worker
+    m = PoolManager(cpu=1)
+
+    def inner():
+        return 42
+
+    def outer():
+        return m.submit("n", inner).result() + 1
+
+    assert m.submit("n", outer).result(timeout=10) == 43
+
+
+def test_resize_does_not_break_inflight_submitters():
+    m = PoolManager(cpu=2)
+    m.pool("r")
+    ex_old = m.pool("r")
+    m.resize("r", 4)
+    # a submitter that fetched the old executor must still work
+    assert ex_old.submit(lambda: 5).result() == 5
+    assert m.submit("r", lambda: 6).result() == 6

@@ -217,7 +217,6 @@ def _parallel_map_chunks(ctx, source, fn):
     vectorized per-chunk work scales across threads; output order is
     preserved and at most 2x concurrency chunks are in flight (bounded
     memory).  fn returning None drops the chunk."""
-    import concurrent.futures as cf
     import os
     from collections import deque
     try:
@@ -238,22 +237,27 @@ def _parallel_map_chunks(ctx, source, fn):
     import contextvars
 
     from ..copr.coordinator import check_killed
-    with cf.ThreadPoolExecutor(max_workers=n) as ex:
-        pending: deque = deque()
-        for ch in source:
-            check_killed()
-            # workers must see the submitter's contextvars (HOST_ONLY,
-            # SUBQUERY_EXECUTOR, OUTER_RESOLVER set by Apply/plan seams)
-            ctx_copy = contextvars.copy_context()
-            pending.append(ex.submit(ctx_copy.run, fn, ch))
-            if len(pending) >= 2 * n:
-                out = pending.popleft().result()
-                if out is not None:
-                    yield out
-        while pending:
+    from ..utils.poolmgr import MANAGER
+
+    # slots come from the global CPU-aware pool manager
+    # (pkg/resourcemanager analog) — shared across queries/operators;
+    # per-operator parallelism stays bounded by the 2n in-flight window
+    MANAGER.ensure("executor", n)
+    pending: deque = deque()
+    for ch in source:
+        check_killed()
+        # workers must see the submitter's contextvars (HOST_ONLY,
+        # SUBQUERY_EXECUTOR, OUTER_RESOLVER set by Apply/plan seams)
+        ctx_copy = contextvars.copy_context()
+        pending.append(MANAGER.submit("executor", ctx_copy.run, fn, ch))
+        if len(pending) >= 2 * n:
             out = pending.popleft().result()
             if out is not None:
                 yield out
+    while pending:
+        out = pending.popleft().result()
+        if out is not None:
+            yield out
 
 
 class PhysOp:
@@ -2046,14 +2050,15 @@ class HostApplyExec(PhysOp):
             self.last_inner_runs += len(items)
             workers = min(len(items), _os.cpu_count() or 1, 8)
             if workers > 1:
-                import concurrent.futures as cf
                 import contextvars as _cv
-                with cf.ThreadPoolExecutor(max_workers=workers) as ex:
-                    futs = [(key, ex.submit(_cv.copy_context().run,
-                                            run_row, row))
-                            for key, row in items]
-                    for key, f in futs:
-                        cache[key] = f.result()
+
+                from ..utils.poolmgr import MANAGER
+                futs = [(key, MANAGER.submit("apply",
+                                             _cv.copy_context().run,
+                                             run_row, row))
+                        for key, row in items]
+                for key, f in futs:
+                    cache[key] = f.result()
             else:
                 for key, row in items:
                     cache[key] = run_row(row)

@@ -220,7 +220,8 @@ STRING_VALUED_FUNCS = {"upper", "lower", "trim", "ltrim", "rtrim", "reverse",
                        "md5", "sha1", "sha2", "hex", "soundex",
                        "json_extract", "json_unquote", "json_type",
                        "insert_str", "quote", "to_base64", "from_base64",
-                       "unhex", "regexp_substr", "regexp_replace", "conv"}
+                       "unhex", "regexp_substr", "regexp_replace", "conv",
+                       "weight_string"}
 STRING_INT_FUNCS = {"length", "char_length", "ascii", "locate", "instr",
                     "find_in_set", "crc32", "strcmp",
                     "json_valid", "json_length", "json_contains",

@@ -448,6 +448,17 @@ def _str_valued_impl(op: str, consts: list):
                 u //= base
             return "".join(reversed(out))
         return _conv
+    if op == "weight_string":
+        # the value's collation sortkey (util/collate codec.Key analog);
+        # the reference returns raw weight bytes — here the printable
+        # sortkey, which preserves the defining property (equal weight
+        # strings <=> collation-equal values, same order)
+        coll = str(consts[0]) if consts else "utf8mb4_bin"
+
+        def _wk(v, coll=coll):
+            from ..utils.collate import sortkey
+            return sortkey(v, coll)
+        return _wk
     if op == "soundex":
         def _soundex(v):
             codes = {**dict.fromkeys("BFPV", "1"),

@@ -52,6 +52,21 @@ def _schemata(dom):
             for db in sorted(dom.catalog.databases)]
 
 
+def _thread_pools(dom):
+    from ..utils.poolmgr import MANAGER
+    return MANAGER.stats_rows()
+
+
+def _collations(dom):
+    from ..utils.collate import collation_rows
+    return collation_rows()
+
+
+def _character_sets(dom):
+    from ..utils.collate import charset_rows
+    return charset_rows()
+
+
 def _tables(dom):
     rows = []
     for db in sorted(dom.catalog.databases):
@@ -260,6 +275,15 @@ _INFORMATION_SCHEMA = {
     "TABLES": ([("TABLE_CATALOG", S), ("TABLE_SCHEMA", S),
                 ("TABLE_NAME", S), ("TABLE_TYPE", S), ("ENGINE", S),
                 ("TABLE_ROWS", I), ("TIDB_TABLE_ID", I)], _tables),
+    "COLLATIONS": ([("COLLATION_NAME", S), ("CHARACTER_SET_NAME", S),
+                    ("ID", I), ("IS_DEFAULT", S), ("IS_COMPILED", S),
+                    ("SORTLEN", I), ("PAD_ATTRIBUTE", S)], _collations),
+    "CHARACTER_SETS": ([("CHARACTER_SET_NAME", S),
+                        ("DEFAULT_COLLATE_NAME", S), ("DESCRIPTION", S),
+                        ("MAXLEN", I)], _character_sets),
+    "THREAD_POOLS": ([("NAME", S), ("WORKERS", I), ("SUBMITTED", I),
+                      ("COMPLETED", I), ("BUSY", I), ("WAIT_MS", I),
+                      ("RUN_MS", I)], _thread_pools),
     "COLUMNS": ([("TABLE_CATALOG", S), ("TABLE_SCHEMA", S),
                  ("TABLE_NAME", S), ("COLUMN_NAME", S),
                  ("ORDINAL_POSITION", I), ("IS_NULLABLE", S),

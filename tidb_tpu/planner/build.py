@@ -449,6 +449,11 @@ class ExprBuilder:
             return self._str_func(name.lower(), *args)
         if name == "HEX" and args[0].dtype.kind == K.STRING:
             return self._str_func("hex", args[0])
+        if name == "WEIGHT_STRING":
+            if not args[0].dtype.is_string:
+                return B.lit(None)     # MySQL: non-string -> NULL
+            return self._str_func("weight_string", args[0],
+                                  B.lit(args[0].dtype.collation))
         if name == "SHA":
             return self._str_func("sha1", *args)
         if name in ("WEEK", "WEEKOFYEAR"):

@@ -47,6 +47,43 @@ class PrivilegeManager:
         root.global_privs = set(KNOWN_PRIVS) | {"ALL"}
         self.users[root.key()] = root
 
+    # ---------------- snapshot (watch-plane persistence) ------------- #
+
+    def snapshot(self) -> str:
+        """JSON of every user record — the mysql.user/db/tables_priv dump
+        the watch plane persists and remote domains reload."""
+        with self._mu:
+            out = []
+            for rec in self.users.values():
+                out.append({
+                    "user": rec.user, "host": rec.host,
+                    "auth": rec.auth_hash.hex(),
+                    "auth_plugin": getattr(rec, "auth_plugin", ""),
+                    "global": sorted(rec.global_privs),
+                    "db": {db: sorted(v)
+                           for db, v in rec.db_privs.items()},
+                    "table": {f"{db}\x00{tb}": sorted(v)
+                              for (db, tb), v in rec.table_privs.items()},
+                })
+        import json
+        return json.dumps(out)
+
+    def load_snapshot(self, blob: str) -> None:
+        import json
+        recs = json.loads(blob)
+        with self._mu:
+            self.users.clear()
+            for r in recs:
+                rec = UserRecord(r["user"], r["host"],
+                                 bytes.fromhex(r["auth"]))
+                if r.get("auth_plugin"):
+                    rec.auth_plugin = r["auth_plugin"]
+                rec.global_privs = set(r["global"])
+                rec.db_privs = {db: set(v) for db, v in r["db"].items()}
+                rec.table_privs = {tuple(k.split("\x00", 1)): set(v)
+                                   for k, v in r["table"].items()}
+                self.users[rec.key()] = rec
+
     # ---------------- account management ---------------- #
 
     def create_user(self, user: str, host: str, password: Optional[str],

@@ -87,6 +87,14 @@ class Domain:
         self.stats = StatsHandle()   # pkg/statistics/handle analog
         from ..privilege import PrivilegeManager
         self.privileges = PrivilegeManager()   # pkg/privilege Handle analog
+        # etcd-style watch plane (domain.go GlobalVarsWatcher analog):
+        # durable domains persist channel logs in the shared KV so other
+        # processes on the same store observe SET GLOBAL / GRANT without
+        # polling system tables; in-memory domains deliver in-process
+        from ..utils.watch import WatchHub
+        self.watch = WatchHub(self.kv if data_dir is not None else None)
+        self.watch.subscribe("sysvar", self._on_sysvar_event)
+        self.watch.subscribe("privilege", self._on_privilege_event)
         from ..planner.plan_cache import PlanCache
         self.plan_cache = PlanCache()          # instance plan cache
         self.schema_version = 1                # bumped per DDL transition
@@ -105,6 +113,8 @@ class Domain:
             self._next_table_id = 100
         from .sysvars import defaults as _sysvar_defaults
         self.sysvars: dict[str, Any] = _sysvar_defaults()
+        self._load_global_sysvars()      # durable SET GLOBALs survive restart
+        self._on_privilege_event({})     # durable users/grants reload
         from ..utils.resourcegroup import ResourceGroupManager
         self.resource_groups = ResourceGroupManager()
         from .autoid import AutoIDService
@@ -118,6 +128,59 @@ class Domain:
         # the statement summary, queryable via
         # information_schema.workload_repo_statements
         self.workload_repo: list = []
+
+    # ---------------- watch plane (etcd-channel analogs) ---------------- #
+
+    _GVAR_PREFIX = b"m\x00gvar\x00"
+    _PRIV_KEY = b"m\x00privsnap"
+
+    def set_global_sysvar(self, name: str, value) -> None:
+        """SET GLOBAL: apply locally, persist (durable mode), and
+        broadcast on the sysvar watch channel."""
+        self.sysvars[name] = value
+        if self.meta is not None:
+            import json as _json
+            txn = self.kv.begin()
+            txn.put(self._GVAR_PREFIX + name.encode(),
+                    _json.dumps(value, default=str).encode())
+            txn.commit()
+        self.watch.notify("sysvar", {"name": name, "value": value})
+
+    def _load_global_sysvars(self) -> None:
+        if getattr(self, "meta", None) is None:
+            return
+        import json as _json
+        pre = self._GVAR_PREFIX
+        for k, v in self.kv.scan(pre, pre + b"\xff", self.kv.alloc_ts()):
+            try:
+                self.sysvars[k[len(pre):].decode()] = _json.loads(v)
+            except ValueError:
+                pass
+
+    def _on_sysvar_event(self, p: dict) -> None:
+        name = p.get("name")
+        if name:
+            self.sysvars[name] = p.get("value")
+
+    def broadcast_privileges(self) -> None:
+        """After GRANT/REVOKE/CREATE USER...: persist the privilege
+        snapshot and nudge the watch channel (privilege cache
+        invalidation, privileges.Handle update channel analog)."""
+        if self.meta is not None:
+            txn = self.kv.begin()
+            txn.put(self._PRIV_KEY, self.privileges.snapshot().encode())
+            txn.commit()
+        self.watch.notify("privilege", {})
+
+    def _on_privilege_event(self, p: dict) -> None:
+        if self.meta is None:
+            return
+        blob = self.kv.get(self._PRIV_KEY, self.kv.alloc_ts())
+        if blob:
+            try:
+                self.privileges.load_snapshot(blob.decode())
+            except ValueError:
+                pass
 
     @property
     def mesh(self):
@@ -627,8 +690,11 @@ class Session:
                     v = validate_set(name.lower(), v, scope=stmt.scope)
                 except SysVarError as e:
                     raise PlanError(str(e))
-                (self.domain.sysvars if stmt.scope == "global"
-                 else self.vars)[name.lower()] = v
+                if stmt.scope == "global":
+                    # persist + broadcast on the watch plane
+                    self.domain.set_global_sysvar(name.lower(), v)
+                else:
+                    self.vars[name.lower()] = v
             for name, val in stmt.user_vars:
                 self.user_vars[name.lower()] = self._eval_scalar(val)
             return ResultSet()
@@ -796,6 +862,9 @@ class Session:
             for spec in stmt.users:
                 priv.revoke(stmt.privs, db, stmt.table, spec.user, spec.host)
         # FLUSH PRIVILEGES: no-op — the manager is authoritative
+        if not isinstance(stmt, A.FlushStmt):
+            # persist + broadcast the updated grant tables (watch plane)
+            self.domain.broadcast_privileges()
         return ResultSet()
 
     def _note_predicate_columns(self, plan) -> None:
@@ -2173,6 +2242,26 @@ class Session:
             return ResultSet([f"Grants for {user}@{host}"],
                              [(g,) for g in
                               self.domain.privileges.show_grants(user, host)])
+        if stmt.kind == "collation":
+            from ..utils.collate import collation_rows
+            rows = collation_rows()     # shared with infoschema
+            if stmt.like:
+                from ..expr.lower_strings import like_to_regex
+                rx = like_to_regex(stmt.like.lower())
+                rows = [r for r in rows if rx.match(r[0].lower())]
+            return ResultSet(["Collation", "Charset", "Id", "Default",
+                              "Compiled", "Sortlen", "Pad_attribute"],
+                             rows)
+        if stmt.kind == "charset":
+            from ..utils.collate import charset_rows
+            rows = [(cs, desc, dflt, ml)
+                    for cs, dflt, desc, ml in charset_rows()]
+            if stmt.like:
+                from ..expr.lower_strings import like_to_regex
+                rx = like_to_regex(stmt.like.lower())
+                rows = [r for r in rows if rx.match(r[0].lower())]
+            return ResultSet(["Charset", "Description",
+                              "Default collation", "Maxlen"], rows)
         if stmt.kind == "variables":
             from .sysvars import REGISTRY
             vs = {name: ent.default for name, ent in REGISTRY.items()}

@@ -1487,6 +1487,11 @@ class Parser:
                 spec = self._user_spec()
                 return A.ShowStmt("grants", f"{spec.user}@{spec.host}")
             return A.ShowStmt("grants")
+        if self.accept_kw("COLLATION") or self._accept_word("COLLATION"):
+            return self._show_like(A.ShowStmt("collation"))
+        if self._accept_word("CHARACTER") or self._accept_word("CHARSET"):
+            self._accept_word("SET")
+            return self._show_like(A.ShowStmt("charset"))
         if self.cur.kind == "ident" and self.cur.text.upper() in (
                 "STATS_META", "STATS_HISTOGRAMS", "STATS_TOPN",
                 "STATEMENTS_SUMMARY", "SLOW_QUERIES", "PROCESSLIST"):

@@ -6,27 +6,89 @@ strings are dictionary codes, so a collation becomes ONE host-side pass
 over the (small) dictionary producing an int rank LUT — device compares
 stay integer compares, exactly like the binary path (SURVEY.md §7).
 
-Supported: binary / utf8mb4_bin (raw code order, no LUT needed),
-utf8mb4_general_ci (case-insensitive), utf8mb4_unicode_ci and
-utf8mb4_0900_ai_ci (case- and accent-insensitive, NFKD approximation).
-Non-binary collations use MySQL PAD SPACE semantics (trailing spaces
-ignored); 0900 collations are NO PAD in MySQL, approximated the same way.
+The registry mirrors the reference's collation matrix
+(pkg/util/collate/collate.go newCollationEnabled set):
+
+============================  ====  ======  ======  ==========
+collation                     case  accent  pad     expansion
+============================  ====  ======  ======  ==========
+*_bin / binary                 yes   yes    PAD*     —
+utf8mb4_general_ci             no    no     PAD      per-char (ß='s')
+utf8mb4_unicode_ci / 520_ci    no    no     PAD      full (ß='ss')
+utf8mb4_0900_ai_ci             no    no     NO PAD   full (ß='ss')
+utf8mb4_0900_as_ci             no    yes    NO PAD   —
+utf8mb4_0900_as_cs/_bin        yes   yes    NO PAD   —
+latin1_swedish_ci etc.         no    no     PAD      per-char
+============================  ====  ======  ======  ==========
+
+(*) binary collations compare raw bytes; PAD is irrelevant.
 """
 
 from __future__ import annotations
 
 import unicodedata
 from bisect import bisect_left, bisect_right
+from dataclasses import dataclass
 
 import numpy as np
 
 from ..chunk.column import StringDict
 
-BINARY = ("binary", "utf8mb4_bin", "utf8_bin", "latin1_bin", "ascii_bin")
+BINARY = ("binary", "utf8mb4_bin", "utf8_bin", "latin1_bin", "ascii_bin",
+          "utf8mb4_0900_bin", "utf8mb4_0900_as_cs")
+
+
+@dataclass(frozen=True)
+class CollationSpec:
+    name: str
+    charset: str
+    binary: bool = False       # raw code order (case+accent sensitive)
+    accent_ci: bool = True     # strip accents (NFKD, drop combining)
+    pad: bool = True           # PAD SPACE (trailing spaces ignored)
+    expand: bool = True        # full casefold (ß -> ss); else per-char
+    is_default: bool = False
+
+
+def _c(name, charset, **kw):
+    return CollationSpec(name, charset, **kw)
+
+
+COLLATIONS: dict[str, CollationSpec] = {c.name: c for c in [
+    _c("binary", "binary", binary=True, pad=False, is_default=True),
+    _c("utf8mb4_bin", "utf8mb4", binary=True, is_default=True),
+    _c("utf8_bin", "utf8", binary=True, is_default=True),
+    _c("latin1_bin", "latin1", binary=True),
+    _c("ascii_bin", "ascii", binary=True, is_default=True),
+    _c("utf8mb4_general_ci", "utf8mb4", expand=False),
+    _c("utf8_general_ci", "utf8", expand=False),
+    _c("utf8mb4_unicode_ci", "utf8mb4"),
+    _c("utf8_unicode_ci", "utf8"),
+    _c("utf8mb4_unicode_520_ci", "utf8mb4"),
+    _c("utf8mb4_0900_ai_ci", "utf8mb4", pad=False),
+    _c("utf8mb4_0900_as_ci", "utf8mb4", accent_ci=False, pad=False,
+       expand=False),
+    _c("utf8mb4_0900_as_cs", "utf8mb4", binary=True, pad=False),
+    _c("utf8mb4_0900_bin", "utf8mb4", binary=True, pad=False),
+    _c("latin1_swedish_ci", "latin1", expand=False, is_default=True),
+    _c("ascii_general_ci", "ascii", expand=False),
+    _c("gbk_bin", "gbk", binary=True),
+    _c("gbk_chinese_ci", "gbk", expand=False),
+]}
+
+
+def spec_of(name: str) -> CollationSpec:
+    got = COLLATIONS.get(name)
+    if got is not None:
+        return got
+    # unknown names: _bin/_cs suffixes behave binary, _ci case-fold —
+    # tolerant like the reference's fallback to binary collator
+    if name.endswith("_ci"):
+        return CollationSpec(name, "utf8mb4", expand=False)
+    return CollationSpec(name, "utf8mb4", binary=True)
 
 
 def is_binary(name: str) -> bool:
-    return name in BINARY or not name.endswith("_ci")
+    return spec_of(name).binary
 
 
 def _strip_accents(s: str) -> str:
@@ -34,15 +96,28 @@ def _strip_accents(s: str) -> str:
                    if not unicodedata.combining(c))
 
 
+def _fold_per_char(s: str) -> str:
+    """general_ci-style single-weight fold: each character maps to ONE
+    weight (the first char of its uppercase form), so 'ß' folds to 'S'
+    ('ß'='s' under general_ci, != 'ss' — MySQL's documented quirk)."""
+    out = []
+    for ch in s:
+        u = ch.upper()
+        out.append(u[0] if u else ch)
+    return "".join(out)
+
+
 def sortkey(s: str, collation: str) -> str:
     """Collation sort key: equal keys collate equal; key order == collation
     order (codec.Key analog, computed per dictionary value not per row)."""
-    if is_binary(collation):
+    spec = spec_of(collation)
+    if spec.binary:
         return s
-    s = s.rstrip(" ")                      # PAD SPACE
-    if "unicode" in collation or "_ai_" in collation or "0900" in collation:
+    if spec.pad:
+        s = s.rstrip(" ")                  # PAD SPACE
+    if spec.accent_ci:
         s = _strip_accents(s)
-    return s.casefold()
+    return s.casefold() if spec.expand else _fold_per_char(s).lower()
 
 
 class RankTable:
@@ -105,5 +180,36 @@ def merged_rank_maps(da: StringDict, db: StringDict, collation: str):
     return ma, mb
 
 
+CHARSET_MAXLEN = {"utf8mb4": 4, "utf8": 3, "latin1": 1, "ascii": 1,
+                  "binary": 1, "gbk": 2}
+
+
+def all_collations() -> list[CollationSpec]:
+    """SHOW COLLATION / information_schema.collations rows."""
+    return list(COLLATIONS.values())
+
+
+def collation_rows() -> list[tuple]:
+    """(name, charset, id, default, compiled, sortlen, pad) — the ONE
+    row builder behind SHOW COLLATION and information_schema.COLLATIONS."""
+    return [(c.name, c.charset, i + 1, "Yes" if c.is_default else "",
+             "Yes", 1, "PAD SPACE" if c.pad else "NO PAD")
+            for i, c in enumerate(sorted(all_collations(),
+                                         key=lambda c: c.name))]
+
+
+def charset_rows() -> list[tuple]:
+    """(charset, default_collation, description, maxlen) — behind SHOW
+    CHARACTER SET and information_schema.CHARACTER_SETS."""
+    seen: dict[str, str] = {}
+    for c in sorted(all_collations(), key=lambda c: c.name):
+        if c.charset not in seen or c.is_default:
+            seen[c.charset] = c.name
+    return [(cs, dflt, f"{cs} charset", CHARSET_MAXLEN.get(cs, 4))
+            for cs, dflt in sorted(seen.items())]
+
+
 __all__ = ["sortkey", "is_binary", "RankTable", "rank_table", "like_key",
-           "merged_rank_maps"]
+           "merged_rank_maps", "CollationSpec", "COLLATIONS", "spec_of",
+           "all_collations", "collation_rows", "charset_rows",
+           "CHARSET_MAXLEN"]
