@@ -603,11 +603,13 @@ def _bench_one_sf(sf, platform, n_chips, iters, mem_bw):
                                                     n_shards, iters)),
                     ("hndv", lambda: _rung_hndv(client, cols, ix, sf,
                                                 n_shards, iters))):
-        if platform == "tpu" and sf >= 10 and tag in ("rollup", "hndv"):
-            # observed live (round 5): both rungs OOM-crash the v5e
-            # worker at SF=10 (expand×4 / 2M-group scatter exceed HBM),
-            # and a dead worker forfeits the rest of the grant window —
-            # cap them to SF<=1 on real hardware until they stream
+        if platform == "tpu" and sf >= 10 and tag == "hndv":
+            # observed live (round 5): the 2M-group scatter OOM-crashed
+            # the v5e worker at SF=10, and a dead worker forfeits the
+            # rest of the grant window — cap to SF<=1 on real hardware.
+            # (rollup is uncapped again: the Expand levels×n
+            # materialization that crashed it now aggregates level by
+            # level — copr/exec.py agg_states)
             rec[f"{tag}_skipped"] = "sf>=10 crashes tpu worker (r5)"
             continue
         try:

@@ -1,0 +1,185 @@
+"""Round-5 builtin breadth: JSON modification/search family, period and
+time arithmetic, UUID/INET6/compress utilities (reference:
+pkg/expression builtin_json.go, builtin_time.go, builtin_miscellaneous.go)."""
+
+import pytest
+
+from tidb_tpu.session import Domain, Session
+
+
+@pytest.fixture()
+def s():
+    return Session(Domain())
+
+
+def test_json_modification_family(s):
+    assert s.must_query(
+        """select json_set('{"a":1}', '$.b', 2)""") == \
+        [('{"a": 1, "b": 2}',)]
+    assert s.must_query(
+        """select json_insert('{"a":1}', '$.a', 9, '$.c', 3)""") == \
+        [('{"a": 1, "c": 3}',)]
+    assert s.must_query(
+        """select json_replace('{"a":1}', '$.a', 9, '$.c', 3)""") == \
+        [('{"a": 9}',)]
+    assert s.must_query(
+        """select json_remove('{"a":1,"b":2}', '$.a')""") == [('{"b": 2}',)]
+    assert s.must_query(
+        """select json_array_append('{"l":[1]}', '$.l', 2)""") == \
+        [('{"l": [1, 2]}',)]
+
+
+def test_json_inspection_family(s):
+    assert s.must_query(
+        """select json_keys('{"a":1,"b":2}')""") == [('["a", "b"]',)]
+    assert s.must_query("select json_depth('[1,[2,3]]')") == [(3,)]
+    assert s.must_query("select json_depth('bad json')") == [(None,)]
+    assert s.must_query(
+        """select json_search('{"x":"abc"}', 'one', 'ab%')""") == \
+        [('"$.x"',)]
+    assert s.must_query(
+        """select json_contains_path('{"a":1}', 'one', '$.a', '$.z')""") \
+        == [(1,)]
+    assert s.must_query(
+        """select json_contains_path('{"a":1}', 'all', '$.a', '$.z')""") \
+        == [(0,)]
+    assert s.must_query(
+        """select json_overlaps('[1,2]', '[2,9]')""") == [(1,)]
+    assert s.must_query(
+        """select json_storage_size('{"a":1}')""") == [(7,)]
+    assert s.must_query("select json_quote('hi')") == [('"hi"',)]
+    assert s.must_query(
+        """select json_value('{"a":{"b":5}}', '$.a.b')""") == [("5",)]
+
+
+def test_json_merge_family(s):
+    assert s.must_query(
+        """select json_merge_patch('{"a":1}', '{"a":null,"b":2}')""") == \
+        [('{"b": 2}',)]
+    assert s.must_query(
+        """select json_merge_preserve('{"a":1}', '{"a":2}')""") == \
+        [('{"a": [1, 2]}',)]
+
+
+def test_json_constructors(s):
+    assert s.must_query("select json_array(1, 'x', 2.5)") == \
+        [('[1, "x", 2.5]',)]
+    assert s.must_query("select json_object('k', 1, 'j', 'v')") == \
+        [('{"k": 1, "j": "v"}',)]
+
+
+def test_json_over_column(s):
+    s.execute("create table j (doc varchar(100))")
+    s.execute("""insert into j values ('{"a":1}'), ('{"a":2,"b":1}'), """
+              "(NULL)")
+    got = s.must_query("select json_set(doc, '$.x', 9) from j")
+    assert got[0] == ('{"a": 1, "x": 9}',)
+    assert got[2] == (None,)
+    assert s.must_query(
+        "select count(*) from j where json_depth(doc) = 2") == [(2,)]
+
+
+def test_period_arithmetic(s):
+    assert s.must_query("select period_add(202312, 2)") == [(202402,)]
+    assert s.must_query("select period_add(202401, -1)") == [(202312,)]
+    assert s.must_query("select period_diff(202402, 202312)") == [(2,)]
+
+
+def test_time_arithmetic(s):
+    assert s.must_query("select sec_to_time(3661)") == [("01:01:01",)]
+    assert s.must_query(
+        "select time_to_sec(sec_to_time(86399))") == [(86399,)]
+    assert s.must_query("select maketime(2, 30, 15)") == [("02:30:15",)]
+    assert s.must_query(
+        "select addtime('2024-01-01 10:00:00', '01:30:00')") == \
+        [("2024-01-01 11:30:00",)]
+    assert s.must_query(
+        "select subtime('2024-01-01 10:00:00', '00:30:00')") == \
+        [("2024-01-01 09:30:00",)]
+    assert s.must_query(
+        "select timediff('2024-01-01 12:00:00', "
+        "'2024-01-01 10:30:00')") == [("01:30:00",)]
+    assert s.must_query("select to_days('2007-10-07')") == [(733321,)]
+    assert s.must_query("select to_seconds('2009-11-29')") == \
+        [(63426672000,)]
+    assert s.must_query("select get_format(date, 'usa')") == \
+        [("%m.%d.%Y",)]
+    assert s.must_query("select get_format(datetime, 'iso')") == \
+        [("%Y-%m-%d %H:%i:%s",)]
+
+
+def test_uuid_inet6_compress(s):
+    u = "6ccd780c-baba-1026-9564-5b8c656024db"
+    assert s.must_query(
+        f"select bin_to_uuid(uuid_to_bin('{u}'))") == [(u,)]
+    assert s.must_query("select is_uuid('not-a-uuid')") == [(0,)]
+    assert s.must_query("select is_uuid(uuid())") == [(1,)]
+    assert s.must_query(
+        "select inet6_ntoa(inet6_aton('2001:db8::1'))") == \
+        [("2001:db8::1",)]
+    assert s.must_query(
+        "select inet6_ntoa(inet6_aton('192.0.2.1'))") == [("192.0.2.1",)]
+    assert s.must_query(
+        "select uncompress(compress('hello world'))") == [("hello world",)]
+    assert s.must_query("select uncompress(compress(''))") == [("",)]
+
+
+def test_misc_scalars(s):
+    assert s.must_query("select name_const('x', 42)") == [(42,)]
+    assert s.must_query("select ord('€')") == [(14844588,)]
+    assert s.must_query("select ord('A')") == [(65,)]
+    assert s.must_query("select ord('')") == [(0,)]
+
+
+def test_json_arrayagg(s):
+    s.execute("create table ja (g bigint, v bigint, t varchar(10))")
+    s.execute("insert into ja values (1,10,'a'),(1,NULL,'b'),(2,30,NULL)")
+    assert s.must_query(
+        "select g, json_arrayagg(v) from ja group by g order by g") == \
+        [(1, "[10, null]"), (2, "[30]")]
+    assert s.must_query("select json_arrayagg(t) from ja") == \
+        [('["a", "b", null]',)]
+    assert s.must_query(
+        "select json_arrayagg(v) from ja where v > 99") == [(None,)]
+    from tidb_tpu.planner.build import PlanError
+    with pytest.raises(PlanError):
+        s.must_query("select json_arrayagg(distinct v) from ja")
+
+
+def test_row_count_found_rows(s):
+    s.execute("create table rc (a bigint)")
+    s.execute("insert into rc values (1), (2), (3)")
+    assert s.must_query("select row_count()") == [(3,)]
+    s.must_query("select * from rc where a > 1")
+    assert s.must_query("select found_rows()") == [(2,)]
+    assert s.must_query("select row_count()") == [(-1,)]
+    s.execute("update rc set a = a + 1 where a >= 2")
+    assert s.must_query("select row_count()") == [(2,)]
+
+
+def test_numeric_temporal_casts_parse_digits(s):
+    # review finding: user CAST parses digits (MySQL), never reinterprets
+    assert s.must_query("select cast(20250101120000 as datetime)") == \
+        [("2025-01-01 12:00:00",)]
+    assert s.must_query("select cast(20250101 as datetime)") == \
+        [("2025-01-01 00:00:00",)]
+    assert s.must_query("select cast(123 as time)") == [("00:01:23",)]
+    assert s.must_query("select cast(20251399000000 as datetime)") == \
+        [(None,)]                      # month 13 -> NULL
+
+
+def test_negative_time_literals(s):
+    assert s.must_query("select addtime('01:00:00','-00:30:00')") == \
+        [("00:30:00",)]
+    assert s.must_query("select timediff('-01:00:00','01:00:00')") == \
+        [("-02:00:00",)]
+
+
+def test_json_string_values_stay_strings(s):
+    # review finding: SQL strings store as JSON strings, not parsed docs
+    assert s.must_query("""select json_set('{}', '$.a', '[1,2]')""") == \
+        [('{"a": "[1,2]"}',)]
+    assert s.must_query("""select json_set('{}', '$.a', '123')""") == \
+        [('{"a": "123"}',)]
+    assert s.must_query("""select json_keys('{"a":1}', 'bad-path')""") \
+        == [(None,)]

@@ -136,3 +136,23 @@ def test_rollup_parse_error_without_rollup_word():
     s.execute("CREATE TABLE p (a INT)")
     with pytest.raises(ParseError):
         s.execute("SELECT a FROM p GROUP BY a WITH CUBE")
+
+
+def test_rollup_level_by_level_states_match(sess):
+    """The TPU per-level Expand aggregation (copr/exec.py agg_states)
+    must produce identical results to the fused materialized expand —
+    forced via the trace-platform knob under the CPU mesh."""
+    from tidb_tpu.copr import exec as X
+    q = ("SELECT a, b, COUNT(*), SUM(v), MIN(v), MAX(v) FROM t "
+         "GROUP BY a, b WITH ROLLUP")
+
+    def norm(rows):
+        return sorted((tuple((x is None, x) for x in r) for r in rows))
+    want = norm(sess.execute(q).rows)
+    X.set_trace_platform("tpu")
+    try:
+        s2 = Session(sess.domain)
+        got = norm(s2.execute(q).rows)
+    finally:
+        X.set_trace_platform(None)
+    assert got == want

@@ -175,6 +175,8 @@ class Column:
                     if m >> j & 1))
             elif kind == dt.TypeKind.VECTOR:
                 out.append(dt.vector_to_text(self.data[i]))
+            elif kind == dt.TypeKind.TIME:
+                out.append(tmp.duration_to_string(int(self.data[i])))
             else:
                 out.append(int(self.data[i]))
         return out

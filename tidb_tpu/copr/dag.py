@@ -39,6 +39,7 @@ class AggFunc(enum.Enum):
     BIT_XOR = "bit_xor"
     GROUP_CONCAT = "group_concat"
     ANY_VALUE = "any_value"
+    JSON_ARRAYAGG = "json_arrayagg"
 
 
 @dataclass(frozen=True)

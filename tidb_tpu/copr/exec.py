@@ -165,6 +165,71 @@ def _one_agg_state(a: D.AggDesc, av, am, sel, gids, num_groups, n) -> dict:
     raise NotImplementedError(a.func)
 
 
+def agg_states(agg: D.Aggregation, scan_cols, row_count, ev: Evaluator,
+               aux) -> tuple:
+    """Execute agg.child and build partial states.
+
+    An Expand child (WITH ROLLUP) aggregates LEVEL BY LEVEL over the
+    un-expanded batch instead of materializing the levels×n replication:
+    each grouping-set level synthesizes its key/gid columns over the SAME
+    n-row child batch, builds DENSE partial states, and merges them with
+    the shard-merge combiners — identical math, 1/levels the peak HBM
+    (the levels×n materialization OOM-crashed the v5e worker at SF=10).
+    Returns (states, child_batch-for-extras).
+
+    TPU-only: on CPU the materialized expand fuses into one pass and
+    measures slightly faster; on TPU the replication is what OOMs."""
+    ch = agg.child
+    if isinstance(ch, D.Expand) \
+            and agg.strategy == D.GroupStrategy.DENSE \
+            and trace_platform() == "tpu":
+        base = _exec_node(ch.child, scan_cols, row_count, ev, aux)
+        return _expand_level_states(agg, ch, base, ev), base
+    batch = _exec_node(ch, scan_cols, row_count, ev, aux)
+    return _agg_partial_states(agg, batch, ev, {}), batch
+
+
+def _expand_level_states(agg: D.Aggregation, exp: D.Expand,
+                         base: DeviceBatch, ev: Evaluator) -> dict:
+    from .aggregate import _MERGE
+    n = len(base.cols[0][0]) if base.cols else 0
+    L = len(exp.keys)
+    memo: dict = {}
+    child_cols = [(_ensure_array(v, n), m) for v, m in base.cols]
+    keyvals = []
+    for k in exp.keys:
+        v, m = ev.eval(k, base.cols, memo)
+        keyvals.append((_ensure_array(v, n), m))
+
+    def combine(name, a, b):
+        how = _MERGE[name]
+        if how == "sum":
+            return a + b
+        return jnp.minimum(a, b) if how == "min" else jnp.maximum(a, b)
+
+    merged: dict = {}
+    for lvl in range(exp.levels):
+        cols = list(child_cols)
+        for j, (v, m) in enumerate(keyvals):
+            if lvl + j < L:            # key j live on this level
+                cols.append((v, m))
+            else:                      # rolled: NULL for every row
+                cols.append((v, jnp.zeros(n, bool)))
+        cols.append((jnp.full(n, lvl, jnp.int64), True))
+        st = _agg_partial_states(
+            agg, DeviceBatch(cols, base.sel, base.extras), ev, {})
+        if not merged:
+            merged = st
+        else:
+            for k, v in st.items():
+                if isinstance(v, dict):
+                    merged[k] = {f: combine(f, merged[k][f], a)
+                                 for f, a in v.items()}
+                else:
+                    merged[k] = combine(k, merged[k], v)
+    return merged
+
+
 def _agg_partial_states(agg: D.Aggregation, batch: DeviceBatch, ev: Evaluator,
                         memo: dict):
     """Per-shard partial-state pytree for an Aggregation node.
@@ -523,9 +588,8 @@ class CopProgram:
             for grp in aux_cols)
         ev = Evaluator(jnp)
         if self.agg is not None:
-            batch = _exec_node(self.agg.child, scan_cols, row_count, ev,
-                               aux_cols)
-            states = _agg_partial_states(self.agg, batch, ev, {})
+            states, batch = agg_states(self.agg, scan_cols, row_count, ev,
+                                       aux_cols)
             return (states, batch.extras) if self.has_extras else states
         batch = _exec_node(self.root, scan_cols, row_count, ev, aux_cols)
         cols, cnt = compact(batch, self.row_capacity)

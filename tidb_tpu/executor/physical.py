@@ -1838,6 +1838,28 @@ class HostAgg(PhysOp):
                 parts[gi].append(sv)
             strs = [",".join(p) if p else None for p in parts]
             return Column.from_values(a.out_dtype, strs)
+        if a.func == D.AggFunc.JSON_ARRAYAGG:
+            # MySQL: one JSON array per group, NULL column values kept as
+            # JSON null, NULL result only for an empty group
+            import json as _json
+            vals = c.to_python()
+            items: list[list] = [[] for _ in range(g)]
+            seenrow = np.zeros(g, bool)
+            for row in range(n):
+                gi = int(inverse[row])
+                seenrow[gi] = True
+                if not valid[row]:
+                    items[gi].append(None)
+                    continue
+                v = vals[row]
+                if not isinstance(v, (int, float, bool, str)):
+                    v = str(v)      # dates/decimals render as strings
+                items[gi].append(v)
+            strs = [(_json.dumps(it, separators=(", ", ": "),
+                                 ensure_ascii=False, default=str)
+                     if seenrow[gi] else None)
+                    for gi, it in enumerate(items)]
+            return Column.from_values(a.out_dtype, strs)
         raise NotImplementedError(a.func)
 
 

@@ -193,6 +193,13 @@ def cast(a: Expr, to: dt.DataType) -> Expr:
     return Func(to.with_nullable(a.dtype.nullable), "cast", (a,))
 
 
+def reinterpret(a: Expr, to: dt.DataType) -> Expr:
+    """Raw int64 reinterpret between numeric and micros-encoded temporal
+    types — the internal composition seam for time arithmetic (user CAST
+    parses digits per MySQL instead)."""
+    return Func(to.with_nullable(a.dtype.nullable), "reinterp", (a,))
+
+
 def in_list(a: Expr, items: Sequence[Expr]) -> Func:
     nullable = a.dtype.nullable or any(i.dtype.nullable for i in items)
     return Func(dt.bigint(nullable), "in", (a, *items))
@@ -221,12 +228,21 @@ STRING_VALUED_FUNCS = {"upper", "lower", "trim", "ltrim", "rtrim", "reverse",
                        "json_extract", "json_unquote", "json_type",
                        "insert_str", "quote", "to_base64", "from_base64",
                        "unhex", "regexp_substr", "regexp_replace", "conv",
-                       "weight_string"}
+                       "weight_string", "json_set", "json_insert",
+                       "json_replace", "json_remove", "json_keys",
+                       "json_search", "json_merge_patch",
+                       "json_merge_preserve", "json_merge",
+                       "json_array_append", "json_pretty", "json_quote",
+                       "json_value", "uuid_to_bin", "bin_to_uuid",
+                       "inet6_ntoa", "inet6_aton", "compress",
+                       "uncompress"}
 STRING_INT_FUNCS = {"length", "char_length", "ascii", "locate", "instr",
                     "find_in_set", "crc32", "strcmp",
                     "json_valid", "json_length", "json_contains",
                     "bit_length", "inet_aton", "regexp_like",
-                    "regexp_instr"}
+                    "regexp_instr", "json_depth", "json_contains_path",
+                    "json_storage_size", "json_overlaps", "is_uuid",
+                    "ord"}
 
 
 def str_func(name: str, *args: Expr) -> Func:

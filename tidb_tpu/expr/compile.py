@@ -801,7 +801,9 @@ class Evaluator:
             return out, valid
         if e.op in STRING_VALUED_FUNCS or e.op in (
                 "length", "char_length", "ascii", "bit_length",
-                "inet_aton", "regexp_like", "regexp_instr"):
+                "inet_aton", "regexp_like", "regexp_instr",
+                "json_depth", "json_contains_path", "json_storage_size",
+                "json_overlaps", "is_uuid", "ord"):
             col_rows = arows[0]
             if col_rows is None:
                 return None
@@ -819,7 +821,9 @@ class Evaluator:
             elif e.op == "ascii":
                 fn = lambda v: ord(v[0]) if v else 0
             elif e.op in ("bit_length", "inet_aton", "regexp_like",
-                          "regexp_instr"):
+                          "regexp_instr", "json_depth",
+                          "json_contains_path", "json_storage_size",
+                          "json_overlaps", "is_uuid", "ord"):
                 from .lower_strings import _str_int_impl
                 fn = _str_int_impl(e.op, consts)
             else:
@@ -856,6 +860,15 @@ class Evaluator:
         op_unhex = op_regexp_substr = op_regexp_replace = op_conv = \
         op_bit_length = op_inet_aton = op_regexp_like = \
         op_regexp_instr = op_str_to_date = \
+        op_json_set = op_json_insert = op_json_replace = \
+        op_json_remove = op_json_keys = op_json_search = \
+        op_json_merge_patch = op_json_merge_preserve = op_json_merge = \
+        op_json_array_append = op_json_pretty = op_json_quote = \
+        op_json_value = op_json_depth = op_json_contains_path = \
+        op_json_storage_size = op_json_overlaps = op_is_uuid = \
+        op_ord = op_uuid_to_bin = op_bin_to_uuid = op_inet6_aton = \
+        op_inet6_ntoa = op_compress = op_uncompress = \
+        op_weight_string = \
         _op_string_unlowered
 
     def op_dict_lut(self, e, cols, memo):
@@ -1471,7 +1484,48 @@ class Evaluator:
         if dst.kind == K.DATE and src.kind == K.DATETIME:
             from ..types.temporal import MICROS_PER_DAY
             return xp.floor_divide(_as_i64(xp, v), MICROS_PER_DAY), m
+        if dst.kind == K.DATETIME and src.kind in (K.INT64, K.UINT64):
+            # MySQL numeric->DATETIME: digits read as [YYYYMMDD]HHMMSS
+            # (internal micros arithmetic uses the reinterp op instead)
+            iv = _as_i64(xp, v)
+            iv = xp.where(iv < 10 ** 8, iv * 10 ** 6, iv)  # date-only
+            y = iv // 10 ** 10
+            mo = iv // 10 ** 8 % 100
+            d = iv // 10 ** 6 % 100
+            h = iv // 10 ** 4 % 100
+            mi = iv // 100 % 100
+            sec = iv % 100
+            ok = ((mo >= 1) & (mo <= 12) & (d >= 1) & (d <= 31)
+                  & (h < 24) & (mi < 60) & (sec < 60))
+            from ..types.temporal import days_from_civil
+            days = days_from_civil(xp, y, mo, d)
+            micros = (days * 86_400 + h * 3600 + mi * 60 + sec) * 1_000_000
+            mm = ok if m is True else _mask_arr(xp, m, micros) & ok
+            return xp.where(ok, micros, 0), mm
+        if dst.kind == K.TIME and src.kind in (K.INT64, K.UINT64):
+            # MySQL numeric->TIME: digits read as [H]HMMSS
+            iv = _as_i64(xp, v)
+            neg = iv < 0
+            av2 = xp.abs(iv)
+            h = av2 // 10 ** 4
+            mi = av2 // 100 % 100
+            sec = av2 % 100
+            ok = (mi < 60) & (sec < 60)
+            us = (h * 3600 + mi * 60 + sec) * 1_000_000
+            us = xp.where(neg, -us, us)
+            mm = ok if m is True else _mask_arr(xp, m, us) & ok
+            return xp.where(ok, us, 0), mm
+        if dst.kind in (K.TIME, K.DATETIME) and src.kind in (K.DATETIME,
+                                                            K.TIME):
+            return _as_i64(xp, v), m
         raise NotImplementedError(f"cast {src} -> {dst}")
+
+    def op_reinterp(self, e, cols, memo):
+        """Raw int64-micros reinterpret between numeric and temporal —
+        the INTERNAL seam SEC_TO_TIME/MAKETIME/ADDTIME/TIMEDIFF compose
+        through (user CASTs parse digits instead)."""
+        v, m = self.eval(e.args[0], cols, memo)
+        return _as_i64(self.xp, v), m
 
 
 # ---------------------------------------------------------------------- #

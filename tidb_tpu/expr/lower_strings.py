@@ -299,6 +299,98 @@ def _str_valued_impl(op: str, consts: list):
     if op == "json_type":
         from ..utils.jsonfns import jtype
         return jtype
+    if op in ("json_set", "json_insert", "json_replace"):
+        from ..utils.jsonfns import modify
+        mode = op[5:]
+        return lambda v: modify(v, mode, *consts)
+    if op == "json_remove":
+        from ..utils.jsonfns import remove
+        return lambda v: remove(v, *consts)
+    if op == "json_keys":
+        from ..utils.jsonfns import keys
+        path = str(consts[0]) if consts else "$"
+        return lambda v: keys(v, path)
+    if op == "json_search":
+        from ..utils.jsonfns import search
+        one_all, target = str(consts[0]), str(consts[1])
+        return lambda v: search(v, one_all, target)
+    if op == "json_merge_patch":
+        from ..utils.jsonfns import merge_patch
+        return lambda v: merge_patch(v, *consts)
+    if op in ("json_merge_preserve", "json_merge"):
+        from ..utils.jsonfns import merge_preserve
+        return lambda v: merge_preserve(v, *consts)
+    if op == "json_array_append":
+        from ..utils.jsonfns import array_append
+        return lambda v: array_append(v, *consts)
+    if op == "json_pretty":
+        from ..utils.jsonfns import pretty
+        return pretty
+    if op == "json_quote":
+        from ..utils.jsonfns import quote
+        return quote
+    if op == "json_value":
+        from ..utils.jsonfns import value_at
+        path = str(consts[0])
+        return lambda v: value_at(v, path)
+    if op == "uuid_to_bin":
+        import uuid as _uuid
+
+        def _u2b(v):
+            try:
+                return _uuid.UUID(v).bytes.hex()
+            except ValueError:
+                return None
+        return _u2b
+    if op == "bin_to_uuid":
+        import uuid as _uuid
+
+        def _b2u(v):
+            try:
+                return str(_uuid.UUID(bytes=bytes.fromhex(v)))
+            except ValueError:
+                return None
+        return _b2u
+    if op == "inet6_ntoa":
+        import ipaddress
+
+        def _i6n(v):
+            try:
+                return str(ipaddress.ip_address(bytes.fromhex(v)))
+            except ValueError:
+                return None
+        return _i6n
+    if op == "inet6_aton":
+        import ipaddress
+
+        def _i6a(v):
+            try:
+                return ipaddress.ip_address(v).packed.hex()
+            except ValueError:
+                return None
+        return _i6a
+    if op == "compress":
+        import zlib
+
+        def _cmp(v):
+            import struct as _st
+            b = v.encode()
+            if not b:
+                return ""
+            return (_st.pack("<I", len(b)) + zlib.compress(b)).hex()
+        return _cmp
+    if op == "uncompress":
+        import zlib
+
+        def _unc(v):
+            if v == "":
+                return ""
+            try:
+                raw = bytes.fromhex(v)
+                return zlib.decompress(raw[4:]).decode()
+            except (ValueError, zlib.error):
+                return None
+        return _unc
     if op == "substring":
         pos = consts[0]
         length = consts[1] if len(consts) > 1 else None
@@ -562,7 +654,9 @@ def fold_string_func(e: Expr) -> Optional[Const]:
                 return Const(e.dtype.with_nullable(True), None)
             return Const(e.dtype, int(r))
         if e.op in ("bit_length", "inet_aton", "regexp_like",
-                    "regexp_instr"):
+                    "regexp_instr", "json_depth", "json_contains_path",
+                    "json_storage_size", "json_overlaps", "is_uuid",
+                    "ord"):
             fn = _str_int_impl(e.op, vals[1:])
             r = fn(str(vals[0])) if fn else None
             if r is None:
@@ -1037,6 +1131,41 @@ def _str_int_impl(op: str, consts: list):
             return lambda v, rx=rx: 1 if rx.search(v) else 0
         return lambda v, rx=rx: (
             (m.start() + 1) if (m := rx.search(v)) else 0)
+    if op == "json_depth":
+        from ..utils.jsonfns import depth
+        return depth
+    if op == "json_contains_path":
+        from ..utils.jsonfns import contains_path
+        one_all = str(consts[0]) if consts else "one"
+        paths = [str(c) for c in consts[1:]]
+        return lambda v: contains_path(v, one_all, *paths)
+    if op == "json_storage_size":
+        from ..utils.jsonfns import storage_size
+        return storage_size
+    if op == "json_overlaps":
+        from ..utils.jsonfns import overlaps
+        other = str(consts[0]) if consts else "null"
+        return lambda v: overlaps(v, other)
+    if op == "is_uuid":
+        import uuid as _uuid
+
+        def _isu(v):
+            try:
+                _uuid.UUID(v)
+                return 1
+            except ValueError:
+                return 0
+        return _isu
+    if op == "ord":
+        def _ord(v):
+            if not v:
+                return 0
+            b = v[0].encode("utf-8")
+            acc = 0
+            for x in b:
+                acc = acc * 256 + x
+            return acc
+        return _ord
     return None
 
 
@@ -1116,7 +1245,8 @@ def _lower_str_int(e: Func, args, dicts) -> Optional[Expr]:
                                np.asarray(lut or [0], np.int64), e.dtype)
         return None
     if e.op in ("bit_length", "inet_aton", "regexp_like",
-                "regexp_instr"):
+                "regexp_instr", "json_depth", "json_contains_path",
+                "json_storage_size", "json_overlaps", "is_uuid", "ord"):
         col = args[0]
         d = _dict_for(col, dicts)
         if d is None:

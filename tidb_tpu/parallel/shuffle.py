@@ -30,7 +30,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..copr import dag as D
 from ..copr.exec import (DeviceBatch, _agg_partial_states, _ensure_array,
-                         _exec_node, _sel_array, compact)
+                         _exec_node, _sel_array, agg_states, compact)
 from ..copr.join import gather_expand, match_ranges
 from ..expr.compile import Evaluator
 from ..ops.sortkeys import INT64_MAX
@@ -157,8 +157,8 @@ class ShardedShuffleJoinProgram:
                   "join_total": jnp.asarray(joined.extras["join_total"])[None]}
 
         if self.agg is not None:
-            batch = _exec_node(self.agg.child, joined.cols, sel_mask, ev, aux)
-            states = _agg_partial_states(self.agg, batch, ev, {})
+            states, batch = agg_states(self.agg, joined.cols, sel_mask, ev,
+                                       aux)
             if self.host_merge:
                 out = jax.tree_util.tree_map(lambda a: a[None], states)
             else:

@@ -27,7 +27,8 @@ from jax.sharding import PartitionSpec as P
 
 from ..copr import dag as D
 from ..copr.aggregate import _MERGE
-from ..copr.exec import DeviceBatch, _agg_partial_states, _exec_node, compact
+from ..copr.exec import (DeviceBatch, _agg_partial_states, _exec_node,
+                         agg_states, compact)
 from ..expr.compile import Evaluator
 from .mesh import SHARD_AXIS
 
@@ -132,8 +133,7 @@ class ShardedCopProgram:
                     for grp in aux)
         ev = Evaluator(jnp)
         if self.agg is not None:
-            batch = _exec_node(self.agg.child, flat, base_sel, ev, aux)
-            states = _agg_partial_states(self.agg, batch, ev, {})
+            states, batch = agg_states(self.agg, flat, base_sel, ev, aux)
             if self.host_merge:
                 # add a leading per-device axis; host reduces across it
                 out = jax.tree_util.tree_map(lambda a: a[None], states)

@@ -36,7 +36,7 @@ AGG_FUNCS = {"SUM", "COUNT", "AVG", "MIN", "MAX",
              "VARIANCE", "VAR_POP", "VAR_SAMP",
              "BIT_AND", "BIT_OR", "BIT_XOR",
              "GROUP_CONCAT", "ANY_VALUE", "APPROX_COUNT_DISTINCT",
-             "GROUPING"}
+             "JSON_ARRAYAGG", "GROUPING"}
 
 _CMP = {"=": "eq", "<>": "ne", "<": "lt", "<=": "le", ">": "gt", ">=": "ge"}
 _ARITH = {"+": "add", "-": "sub", "*": "mul", "/": "div", "DIV": "intdiv",
@@ -280,6 +280,10 @@ class ExprBuilder:
             to = dt.date()
         elif tn in ("DATETIME", "TIMESTAMP"):
             to = dt.datetime()
+        elif tn == "TIME":
+            if isinstance(a, Const) and isinstance(a.value, str):
+                return _time_literal(a)
+            to = dt.time()
         elif tn in ("CHAR", "VARCHAR", "NCHAR", "BINARY"):
             # CAST(x AS CHAR[(n)]): string targets route non-string
             # sources to the host cast_char producer; string sources
@@ -351,12 +355,31 @@ class ExprBuilder:
                     raise PlanError("TIMESTAMPADD amount must be constant")
                 return B.date_add(base, B.lit(int(rest[0].value)), unit)
             return self._timestampdiff(unit, rest[0], rest[1])
+        if name == "GET_FORMAT":
+            # first argument is a type keyword (DATE/TIME/DATETIME/...)
+            if len(n.args) != 2:
+                raise PlanError("GET_FORMAT takes (type, standard)")
+            kind = (n.args[0].parts[-1].upper()
+                    if isinstance(n.args[0], A.Ident)
+                    else str(getattr(n.args[0], "value", "")).upper())
+            std_e = self.build(n.args[1])
+            std = (str(std_e.value).upper()
+                   if isinstance(std_e, Const) else "")
+            fmt = _GET_FORMATS.get((kind, std))
+            return B.lit(fmt) if fmt is not None else B.lit(None)
         args = [self.build(a) for a in n.args
                 if not isinstance(a, A.Star)]
         if name in ("YEAR", "MONTH", "QUARTER", "DAYOFWEEK", "WEEKDAY",
                     "DAYOFYEAR", "HOUR", "MINUTE", "SECOND", "MICROSECOND",
                     "TO_DAYS", "UNIX_TIMESTAMP"):
-            return B.temporal_part(name.lower(), args[0])
+            base = args[0]
+            if base.dtype.is_string:
+                to = (dt.datetime() if name in ("HOUR", "MINUTE", "SECOND",
+                                                "MICROSECOND",
+                                                "UNIX_TIMESTAMP")
+                      else dt.date())
+                base = _coerce_to(to, base)
+            return B.temporal_part(name.lower(), base)
         if name == "FROM_DAYS":
             return Func(dt.date(args[0].dtype.nullable), "from_days",
                         (args[0],))
@@ -655,6 +678,100 @@ class ExprBuilder:
                 except JSONPathError as e:
                     raise PlanError(str(e))
             return self._str_func(name.lower(), *args)
+        if name in ("JSON_SET", "JSON_INSERT", "JSON_REPLACE",
+                    "JSON_REMOVE", "JSON_KEYS", "JSON_SEARCH",
+                    "JSON_MERGE_PATCH", "JSON_MERGE_PRESERVE",
+                    "JSON_MERGE", "JSON_ARRAY_APPEND", "JSON_PRETTY",
+                    "JSON_QUOTE", "JSON_VALUE", "JSON_DEPTH",
+                    "JSON_CONTAINS_PATH", "JSON_STORAGE_SIZE",
+                    "JSON_OVERLAPS"):
+            return self._str_func(name.lower(), *args)
+        if name in ("JSON_ARRAY", "JSON_OBJECT"):
+            # constant construction folds at plan time (the common form);
+            # column args would need a per-row JSON composer
+            vals = []
+            for a in args:
+                if not isinstance(a, Const):
+                    raise PlanError(f"{name} supports constant arguments")
+                vals.append(a)
+            if name == "JSON_ARRAY":
+                from ..utils.jsonfns import _dump
+                return B.lit(_dump([_jval(v) for v in vals]))
+            if len(vals) % 2:
+                raise PlanError("JSON_OBJECT needs key/value pairs")
+            from ..utils.jsonfns import _dump
+            obj = {str(vals[i].value): _jval(vals[i + 1])
+                   for i in range(0, len(vals), 2)}
+            return B.lit(_dump(obj))
+        if name in ("UUID_TO_BIN", "BIN_TO_UUID", "INET6_ATON",
+                    "INET6_NTOA", "COMPRESS", "UNCOMPRESS", "IS_UUID",
+                    "ORD"):
+            return self._str_func(name.lower(), *args)
+        if name == "NAME_CONST":
+            if len(args) != 2 or not isinstance(args[0], Const):
+                raise PlanError("NAME_CONST needs a constant name")
+            return args[1]
+        if name == "SEC_TO_TIME":
+            return B.reinterpret(B.arith("mul", args[0], B.lit(1_000_000)),
+                                 dt.time(args[0].dtype.nullable))
+        if name == "TIME_TO_SEC":
+            return B.arith("intdiv",
+                           B.reinterpret(args[0], dt.bigint()),
+                           B.lit(1_000_000))
+        if name == "MAKETIME":
+            s = B.arith("add",
+                        B.arith("mul", args[0], B.lit(3600)),
+                        B.arith("add", B.arith("mul", args[1], B.lit(60)),
+                                args[2]))
+            return B.reinterpret(B.arith("mul", s, B.lit(1_000_000)),
+                                 dt.time(True))
+        if name in ("PERIOD_ADD", "PERIOD_DIFF"):
+            # pure integer algebra over YYYYMM periods — device-fusable
+            def months(p):
+                return B.arith(
+                    "add", B.arith("mul",
+                                   B.arith("intdiv", p, B.lit(100)),
+                                   B.lit(12)),
+                    B.arith("mod", p, B.lit(100)))
+            if name == "PERIOD_DIFF":
+                return B.arith("sub", months(args[0]), months(args[1]))
+            ym = B.arith("add", B.arith("sub", months(args[0]), B.lit(1)),
+                         args[1])
+            return B.arith(
+                "add", B.arith("mul", B.arith("intdiv", ym, B.lit(12)),
+                               B.lit(100)),
+                B.arith("add", B.arith("mod", ym, B.lit(12)), B.lit(1)))
+        if name == "TO_SECONDS":
+            base = args[0]
+            if base.dtype.is_string:
+                base = _coerce_to(dt.datetime(), base)
+            if base.dtype.kind == K.DATE:
+                days = B.arith("add",
+                               B.datediff(base, B.lit(0, dt.date())),
+                               B.lit(719_528))
+                return B.arith("mul", days, B.lit(86_400))
+            secs = B.arith("intdiv", B.cast(base, dt.bigint()),
+                           B.lit(1_000_000))
+            return B.arith("add", secs, B.lit(719_528 * 86_400))
+        if name in ("ADDTIME", "SUBTIME", "TIMEDIFF"):
+            def temporal_arg(x):
+                if not x.dtype.is_string:
+                    return x
+                # datetime-shaped literals parse as DATETIME; a LEADING
+                # '-' is a negative TIME, not a date separator
+                if isinstance(x, Const) and isinstance(x.value, str) \
+                        and "-" in x.value.lstrip()[1:]:
+                    return _coerce_to(dt.datetime(), x)
+                return _time_literal(x)
+            a, b = temporal_arg(args[0]), temporal_arg(args[1])
+            if a.dtype.kind == K.NULL or b.dtype.kind == K.NULL:
+                return B.lit(None)
+            out_t = (dt.time(True) if name == "TIMEDIFF"
+                     else a.dtype.with_nullable(True))
+            op = "sub" if name in ("SUBTIME", "TIMEDIFF") else "add"
+            return B.reinterpret(
+                B.arith(op, B.reinterpret(a, dt.bigint()),
+                        B.reinterpret(b, dt.bigint())), out_t)
         if name == "IF":
             return B.if_(args[0], args[1], args[2])
         if name == "IFNULL":
@@ -669,7 +786,7 @@ class ExprBuilder:
             return Const(dt.varchar(False), "8.0.11-tidb-tpu")
         if name in ("USER", "CURRENT_USER", "SESSION_USER", "SYSTEM_USER",
                     "DATABASE", "SCHEMA", "CONNECTION_ID",
-                    "LAST_INSERT_ID"):
+                    "LAST_INSERT_ID", "ROW_COUNT", "FOUND_ROWS"):
             info = SESSION_INFO.get() or {}
             _taint_plan("session")       # identity varies per connection
             if name in ("DATABASE", "SCHEMA"):
@@ -681,6 +798,12 @@ class ExprBuilder:
             if name == "LAST_INSERT_ID":
                 return Const(dt.bigint(False),
                              int(info.get("last_insert_id", 0)))
+            if name == "ROW_COUNT":
+                return Const(dt.bigint(False), int(info.get("row_count",
+                                                            -1)))
+            if name == "FOUND_ROWS":
+                return Const(dt.bigint(False),
+                             int(info.get("found_rows", 0)))
             return Const(dt.varchar(False),
                          f"{info.get('user', 'root')}@%")
         if name == "UUID":
@@ -1066,6 +1189,60 @@ def _fold_const_str_cast(s: str, tn: str, n: "A.CastExpr") -> Optional[Expr]:
         ln = n.prec if n.prec > 0 else None
         return Const(dt.varchar(False), s if ln is None else s[:ln])
     return None
+
+
+def _jval(c: Const):
+    """Const -> JSON-ready python value (decimal consts decode)."""
+    if c.value is None:
+        return None
+    if c.dtype.kind == K.DECIMAL:
+        from ..types import decimal as _dec
+        return float(_dec.decode(c.value, c.dtype.scale))
+    return c.value
+
+
+def _time_literal(e: Expr) -> Expr:
+    """'[-]HH:MM:SS[.ffffff]' string const -> TIME (micros) const."""
+    if not (isinstance(e, Const) and isinstance(e.value, str)):
+        return B.cast(e, dt.time(True))
+    s = e.value.strip()
+    neg = s.startswith("-")
+    if neg:
+        s = s[1:]
+    parts = s.split(":")
+    try:
+        if len(parts) == 3:
+            h, m = int(parts[0]), int(parts[1])
+            sec = float(parts[2])
+        elif len(parts) == 2:
+            h, m, sec = 0, int(parts[0]), float(parts[1])
+        else:
+            h, m, sec = 0, 0, float(parts[0])
+    except ValueError:
+        return Const(dt.null_type(), None)
+    us = int(round((h * 3600 + m * 60 + sec) * 1e6))
+    return Const(dt.time(False), -us if neg else us)
+
+
+# GET_FORMAT(type, standard) result strings (builtin_time.go getFormat)
+_GET_FORMATS = {
+    ("DATE", "USA"): "%m.%d.%Y", ("DATE", "JIS"): "%Y-%m-%d",
+    ("DATE", "ISO"): "%Y-%m-%d", ("DATE", "EUR"): "%d.%m.%Y",
+    ("DATE", "INTERNAL"): "%Y%m%d",
+    ("DATETIME", "USA"): "%Y-%m-%d %H.%i.%s",
+    ("DATETIME", "JIS"): "%Y-%m-%d %H:%i:%s",
+    ("DATETIME", "ISO"): "%Y-%m-%d %H:%i:%s",
+    ("DATETIME", "EUR"): "%Y-%m-%d %H.%i.%s",
+    ("DATETIME", "INTERNAL"): "%Y%m%d%H%i%s",
+    ("TIMESTAMP", "USA"): "%Y-%m-%d %H.%i.%s",
+    ("TIMESTAMP", "JIS"): "%Y-%m-%d %H:%i:%s",
+    ("TIMESTAMP", "ISO"): "%Y-%m-%d %H:%i:%s",
+    ("TIMESTAMP", "EUR"): "%Y-%m-%d %H.%i.%s",
+    ("TIMESTAMP", "INTERNAL"): "%Y%m%d%H%i%s",
+    ("TIME", "USA"): "%h:%i:%s %p", ("TIME", "JIS"): "%H:%i:%s",
+    ("TIME", "ISO"): "%H:%i:%s", ("TIME", "EUR"): "%H.%i.%s",
+    ("TIME", "INTERNAL"): "%H%i%s",
+}
 
 
 def _coerce_to(target: dt.DataType, e: Expr) -> Expr:
@@ -1685,11 +1862,13 @@ def _build_agg_select(sel: A.SelectStmt, items, child) -> tuple[LogicalPlan, lis
                  "BIT_AND": AggFunc.BIT_AND, "BIT_OR": AggFunc.BIT_OR,
                  "BIT_XOR": AggFunc.BIT_XOR,
                  "GROUP_CONCAT": AggFunc.GROUP_CONCAT,
-                 "ANY_VALUE": AggFunc.ANY_VALUE}[name]
+                 "ANY_VALUE": AggFunc.ANY_VALUE,
+                 "JSON_ARRAYAGG": AggFunc.JSON_ARRAYAGG}[name]
             if arg is None:
                 raise PlanError(f"{name} needs an argument")
             if fc.distinct and f in (AggFunc.BIT_AND, AggFunc.BIT_OR,
-                                     AggFunc.BIT_XOR, AggFunc.ANY_VALUE):
+                                     AggFunc.BIT_XOR, AggFunc.ANY_VALUE,
+                                     AggFunc.JSON_ARRAYAGG):
                 raise PlanError(f"DISTINCT not supported for {name}")
             i = _add_agg(agg_items, f, arg, fc.distinct)
             out = _AggRef(i, agg_items[i].out_dtype)
@@ -1822,7 +2001,7 @@ def _add_agg(agg_items: list[AggItem], func: AggFunc, arg, distinct: bool) -> in
         out_t = sum_out_dtype(arg.dtype)
     elif func in (AggFunc.BIT_AND, AggFunc.BIT_OR, AggFunc.BIT_XOR):
         out_t = dt.ubigint(False)      # MySQL: unsigned 64-bit, never NULL
-    elif func == AggFunc.GROUP_CONCAT:
+    elif func in (AggFunc.GROUP_CONCAT, AggFunc.JSON_ARRAYAGG):
         out_t = dt.varchar(True)
     else:
         out_t = arg.dtype

@@ -379,6 +379,8 @@ class Session:
                 "db": self.db, "user": self.user,
                 "conn_id": self.conn_id,
                 "last_insert_id": getattr(self, "last_insert_id", 0),
+                "row_count": getattr(self, "_row_count", -1),
+                "found_rows": getattr(self, "_found_rows", 0),
                 "getvar": _getvar,
                 "getuservar":
                     lambda name, _s="": self.user_vars.get(name)})
@@ -419,6 +421,14 @@ class Session:
                 raise
             _plugins.fire("on_stmt_end", self, text, None, dt_ns / 1e9,
                           len(out.rows) + out.affected)
+            # ROW_COUNT()/FOUND_ROWS() state (executor/adapter.go
+            # affectedRows analogs): ROW_COUNT is -1 for result-set
+            # statements, FOUND_ROWS is the last result-set size
+            if out.names:
+                self._found_rows = len(out.rows)
+                self._row_count = -1
+            else:
+                self._row_count = out.affected
         return out
 
     def _exec_kill(self, stmt) -> ResultSet:
@@ -1776,10 +1786,13 @@ class Session:
             v = v & np.broadcast_to(np.asarray(m), (n,))
         return v
 
-    def _retry_write_conflict(self, fn, attempts: int = 8):
+    def _retry_write_conflict(self, fn, attempts: int = 14):
         """Re-run an autocommit DML on optimistic write conflict / lock
         (session doCommitWithRetry analog, session.go:798): the statement
-        recomputes against a fresh snapshot each attempt."""
+        recomputes against a fresh snapshot each attempt.  Capped
+        exponential backoff: a DDL backfill batch on a loaded host can
+        hold its locks for >100ms, which the old 72ms linear budget
+        couldn't ride out."""
         import time as _t
         from ..store.kv import KVError
         for a in range(attempts):
@@ -1788,7 +1801,7 @@ class Session:
             except KVError as e:
                 if e.code not in (1, 2) or a == attempts - 1:
                     raise
-                _t.sleep(0.002 * (a + 1))
+                _t.sleep(min(0.002 * (2 ** a), 0.1))
 
     def _exec_update(self, stmt: A.Update) -> ResultSet:
         return self._retry_write_conflict(lambda: self._do_update(stmt))

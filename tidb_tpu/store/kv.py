@@ -135,6 +135,10 @@ class KVStore:
         self._lib = _load_lib()
         self.path = path
         self._ts_samples: list = []    # (wallclock, ts) for stale reads
+        # close() runs these FIRST (watch pollers etc. join their
+        # threads) so no background caller holds the native handle when
+        # it frees — a poller racing kv_close segfaulted in the C lib
+        self._closers: list = []
         # keyspace (pkg/keyspace analog): a tenant prefix transparently
         # applied to every key, so tenants sharing one physical store
         # cannot observe each other's keys.  "" = the null keyspace.
@@ -160,14 +164,24 @@ class KVStore:
 
     def close(self):
         if self._h:
+            for cb in list(self._closers):
+                try:
+                    cb()
+                except Exception:
+                    pass
             self._lib.kv_close(self._h)
             self._h = None
+
+    def _require_open(self):
+        if not self._h:
+            raise KVError(-98, "store closed")
 
     def alloc_ts(self) -> int:
         """TSO allocation (PD analog).  Samples a coarse wallclock->ts
         index so stale reads (AS OF TIMESTAMP, sessiontxn/staleread) can
         map a datetime back to a logical snapshot ts."""
         import time as _time
+        self._require_open()
         ts = int(self._lib.kv_alloc_ts(self._h))
         self._ts_samples.append((_time.time(), ts))
         if len(self._ts_samples) > 200_000:
@@ -221,6 +235,7 @@ class KVStore:
     # -- snapshot reads ------------------------------------------------ #
 
     def get(self, key: bytes, ts: int) -> Optional[bytes]:
+        self._require_open()
         key = self._pk(key)
         out = ctypes.c_char_p()
         out_len = ctypes.c_int32()
@@ -236,6 +251,7 @@ class KVStore:
              limit: int = 1 << 30, page_bytes: int = 1 << 20
              ) -> Iterator[tuple[bytes, bytes]]:
         """Paged snapshot scan (the kv paging analog, SURVEY.md §5.7)."""
+        self._require_open()
         buf = ctypes.create_string_buffer(page_bytes)
         cur = self._pk(start)
         end = self._pk(end) if end else (self._ks_end() if self._ks else end)

@@ -122,3 +122,28 @@ __all__ = [
     "date_to_days", "parse_date", "days_to_date", "date_to_string",
     "parse_datetime", "datetime_to_string", "year_month_day_np",
 ]
+
+
+def duration_to_string(micros: int) -> str:
+    """TIME text: '[-]H:MM:SS[.ffffff]' (types/duration String analog)."""
+    sign = "-" if micros < 0 else ""
+    us = abs(int(micros))
+    s, frac = divmod(us, 1_000_000)
+    h, rem = divmod(s, 3600)
+    m, sec = divmod(rem, 60)
+    out = f"{sign}{h:02d}:{m:02d}:{sec:02d}"
+    if frac:
+        out += f".{frac:06d}".rstrip("0")
+    return out
+
+
+def days_from_civil(xp, y, m, d):
+    """Vectorized days-since-epoch from (y, m, d) — the inverse of
+    civil_from_days (Howard Hinnant's algorithm), shared by the device
+    expression compiler's numeric->DATETIME cast."""
+    y = y - (m <= 2)
+    era = xp.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400
+    doy = (153 * (m + xp.where(m > 2, -3, 9)) + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468

@@ -163,3 +163,257 @@ def contains(text: str, candidate: str, path: str = "$") -> Optional[int]:
 
 __all__ = ["extract", "unquote", "jtype", "valid", "jlength", "contains",
            "parse_path", "JSONPathError"]
+
+
+# ------------------------------------------------------------------ #
+# modification + search family (reference: pkg/types/json_binary_functions.go)
+
+def _set_at(doc, steps, value, mode: str):
+    """Set value at path; mode 'set'|'insert'|'replace'.  Returns doc."""
+    if not steps:
+        return value if mode in ("set", "replace") else doc
+    cur = doc
+    for i, s in enumerate(steps[:-1]):
+        ok, nxt = _walk(cur, [s])
+        if not ok:
+            return doc           # intermediate missing: no-op (MySQL)
+        cur = nxt
+    last = steps[-1]
+    if isinstance(last, int):
+        if isinstance(cur, list):
+            if 0 <= last < len(cur):
+                if mode in ("set", "replace"):
+                    cur[last] = value
+            elif mode in ("set", "insert"):
+                cur.append(value)
+        return doc
+    if isinstance(cur, dict):
+        if last in cur:
+            if mode in ("set", "replace"):
+                cur[last] = value
+        elif mode in ("set", "insert"):
+            cur[last] = value
+    return doc
+
+
+def _parse_value(v):
+    """A const argument as a JSON value.  SQL strings stay JSON STRINGS
+    (MySQL: JSON_SET('{}','$.a','[1,2]') stores the text, not an array);
+    non-string scalars pass through."""
+    if isinstance(v, (int, float, bool)) or v is None:
+        return v
+    return str(v)
+
+
+def modify(text: str, mode: str, *pairs) -> Optional[str]:
+    """JSON_SET/INSERT/REPLACE: pairs = (path, value, path, value...)."""
+    try:
+        doc = _loads(text)
+    except ValueError:
+        return None
+    for i in range(0, len(pairs) - 1, 2):
+        try:
+            steps = parse_path(str(pairs[i]))
+        except JSONPathError:
+            return None
+        doc = _set_at(doc, steps, _parse_value(pairs[i + 1]), mode)
+    return _dump(doc)
+
+
+def remove(text: str, *paths) -> Optional[str]:
+    try:
+        doc = _loads(text)
+    except ValueError:
+        return None
+    for p in paths:
+        try:
+            steps = parse_path(str(p))
+        except JSONPathError:
+            return None
+        if not steps:
+            return None          # MySQL errors on '$'; NULL here
+        ok, parent = _walk(doc, steps[:-1])
+        if not ok:
+            continue
+        last = steps[-1]
+        if isinstance(last, int) and isinstance(parent, list) \
+                and 0 <= last < len(parent):
+            del parent[last]
+        elif isinstance(last, str) and isinstance(parent, dict) \
+                and last in parent:
+            del parent[last]
+    return _dump(doc)
+
+
+def keys(text: str, path: str = "$") -> Optional[str]:
+    try:
+        doc = _loads(text)
+        ok, v = _walk(doc, parse_path(path))
+    except (ValueError, JSONPathError):
+        return None
+    if not ok or not isinstance(v, dict):
+        return None
+    return _dump(list(v.keys()))
+
+
+def depth(text: str) -> Optional[int]:
+    try:
+        doc = _loads(text)
+    except ValueError:
+        return None
+
+    def d(v):
+        if isinstance(v, dict):
+            return 1 + max((d(x) for x in v.values()), default=0)
+        if isinstance(v, list):
+            return 1 + max((d(x) for x in v), default=0)
+        return 1
+    return d(doc)
+
+
+def search(text: str, one_or_all: str, target: str) -> Optional[str]:
+    """JSON_SEARCH with % / _ wildcards; returns path string(s)."""
+    import re as _re
+    try:
+        doc = _loads(text)
+    except ValueError:
+        return None
+    rx = _re.compile("^" + "".join(
+        ".*" if c == "%" else "." if c == "_" else _re.escape(c)
+        for c in target) + "$", _re.S)
+    hits: list[str] = []
+
+    def walk(v, path):
+        if isinstance(v, str) and rx.match(v):
+            hits.append(path)
+        elif isinstance(v, dict):
+            for k, x in v.items():
+                walk(x, f'{path}."{k}"' if _re.search(r"\W", k)
+                     else f"{path}.{k}")
+        elif isinstance(v, list):
+            for i, x in enumerate(v):
+                walk(x, f"{path}[{i}]")
+    walk(doc, "$")
+    if not hits:
+        return None
+    if one_or_all.lower() == "one":
+        return _dump(hits[0])
+    return _dump(hits[0] if len(hits) == 1 else hits)
+
+
+def merge_patch(text: str, *others) -> Optional[str]:
+    def patch(a, b):
+        if not isinstance(b, dict):
+            return b
+        if not isinstance(a, dict):
+            a = {}
+        for k, v in b.items():
+            if v is None:
+                a.pop(k, None)
+            else:
+                a[k] = patch(a.get(k), v)
+        return a
+    try:
+        doc = _loads(text)
+        for o in others:
+            doc = patch(doc, _loads(str(o)))
+    except ValueError:
+        return None
+    return _dump(doc)
+
+
+def merge_preserve(text: str, *others) -> Optional[str]:
+    def merge(a, b):
+        if isinstance(a, dict) and isinstance(b, dict):
+            for k, v in b.items():
+                a[k] = merge(a[k], v) if k in a else v
+            return a
+        la = a if isinstance(a, list) else [a]
+        lb = b if isinstance(b, list) else [b]
+        return la + lb
+    try:
+        doc = _loads(text)
+        for o in others:
+            doc = merge(doc, _loads(str(o)))
+    except ValueError:
+        return None
+    return _dump(doc)
+
+
+def array_append(text: str, *pairs) -> Optional[str]:
+    try:
+        doc = _loads(text)
+    except ValueError:
+        return None
+    for i in range(0, len(pairs) - 1, 2):
+        try:
+            steps = parse_path(str(pairs[i]))
+        except JSONPathError:
+            return None
+        ok, v = _walk(doc, steps)
+        if not ok:
+            continue
+        val = _parse_value(pairs[i + 1])
+        if isinstance(v, list):
+            v.append(val)
+        elif not steps:
+            doc = [doc, val]
+        else:
+            _set_at(doc, steps, [v, val], "replace")
+    return _dump(doc)
+
+
+def contains_path(text: str, one_or_all: str, *paths) -> Optional[int]:
+    try:
+        doc = _loads(text)
+    except ValueError:
+        return None
+    hits = 0
+    for p in paths:
+        try:
+            ok, _v = _walk(doc, parse_path(str(p)))
+        except JSONPathError:
+            return None
+        hits += bool(ok)
+    return int(hits == len(paths) if one_or_all.lower() == "all"
+               else hits > 0)
+
+
+def pretty(text: str) -> Optional[str]:
+    try:
+        return json.dumps(_loads(text), indent=2, ensure_ascii=False)
+    except ValueError:
+        return None
+
+
+def storage_size(text: str) -> Optional[int]:
+    try:
+        _loads(text)
+    except ValueError:
+        return None
+    return len(text.encode())
+
+
+def quote(text: str) -> str:
+    return json.dumps(text, ensure_ascii=False)
+
+
+def overlaps(text: str, other: str) -> Optional[int]:
+    try:
+        a, b = _loads(text), _loads(str(other))
+    except ValueError:
+        return None
+    la = a if isinstance(a, list) else [a]
+    lb = b if isinstance(b, list) else [b]
+    if isinstance(a, dict) and isinstance(b, dict):
+        return int(any(k in b and b[k] == v for k, v in a.items()))
+    return int(any(x in lb for x in la))
+
+
+def value_at(text: str, path: str) -> Optional[str]:
+    """JSON_VALUE default (RETURNING omitted): unquoted scalar text."""
+    try:
+        got = extract(text, path)
+    except JSONPathError:
+        return None
+    return None if got is None else unquote(got)

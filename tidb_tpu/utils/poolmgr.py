@@ -102,12 +102,12 @@ class PoolManager:
 
     def submit(self, name: str, fn, /, *args, weight: float = 1.0,
                **kw) -> cf.Future:
-        # caller-runs on nested submission: a task running ON this pool
-        # that submits back to it and waits would deadlock once every
-        # worker holds a blocked outer task (nested correlated
-        # subqueries / nested parallel operators).  Worker threads carry
-        # the pool name, so detection is a prefix check.
-        if threading.current_thread().name.startswith(f"pool-{name}"):
+        # caller-runs on nested submission: a task running on ANY managed
+        # pool that submits and waits would deadlock once every worker
+        # holds a blocked outer task — including CROSS-pool cycles
+        # (executor task -> apply task -> executor task).  Worker threads
+        # carry the pool- prefix, so detection is a prefix check.
+        if threading.current_thread().name.startswith("pool-"):
             f: cf.Future = cf.Future()
             try:
                 f.set_result(fn(*args, **kw))

@@ -124,9 +124,23 @@ class WatchHub:
                                             daemon=True,
                                             name="watch-poller")
             self._poller.start()
+            # the store joins this poller BEFORE freeing its native
+            # handle (KVStore.close closers), preventing use-after-free
+            closers = getattr(self.kv, "_closers", None)
+            if closers is not None and self._shutdown not in closers:
+                closers.append(self._shutdown)
+
+    def _shutdown(self) -> None:
+        self._stop.set()
+        p = self._poller
+        if p is not None and p.is_alive() \
+                and p is not threading.current_thread():
+            p.join(timeout=5.0)
 
     def _poll_loop(self) -> None:
         while not self._stop.wait(self.poll_interval):
+            if not getattr(self.kv, "_h", None):
+                return                 # store closed under us
             with self._mu:
                 channels = list(self._subs)
             for ch in channels:
