@@ -447,7 +447,7 @@ def np_q19(cols, ix):
     return int((price[m].astype(np.int64) * (100 - disc[m])).sum()), int(m.sum())
 
 
-def _rollup_dag(cols, ix):
+def _rollup_dag(cols, ix, dense=False):
     from tidb_tpu import copr
     from tidb_tpu.copr import dag as D
     from tidb_tpu.expr import ColumnRef
@@ -462,13 +462,27 @@ def _rollup_dag(cols, ix):
     krf = ColumnRef(rf.dtype.with_nullable(True), n_base, "rf")
     kls = ColumnRef(ls.dtype.with_nullable(True), n_base + 1, "ls")
     gid = ColumnRef(dt.bigint(False), n_base + 2, "gid")
-    agg = D.Aggregation(ex, (krf, kls, gid),
-                        (copr.AggDesc(copr.AggFunc.SUM, qty,
-                                      copr.sum_out_dtype(qty.dtype)),
-                         copr.AggDesc(copr.AggFunc.COUNT, None,
-                                      dt.bigint(False))),
-                        D.GroupStrategy.SORT, group_capacity=64)
+    aggs = (copr.AggDesc(copr.AggFunc.SUM, qty,
+                         copr.sum_out_dtype(qty.dtype)),
+            copr.AggDesc(copr.AggFunc.COUNT, None, dt.bigint(False)))
     from tidb_tpu.copr.aggregate import GroupKeyMeta
+    if dense:
+        # DENSE + bounded gid: the shape the TPU per-level Expand
+        # execution keys on (copr/exec.py agg_states) — never
+        # materializes levels×n, which OOM-crashed the v5e at SF=10
+        drf = cols[ix["l_returnflag"]].dictionary
+        dls = cols[ix["l_linestatus"]].dictionary
+        sizes = (len(drf) + 1, len(dls) + 1, 3)
+        agg = D.Aggregation(ex, (krf, kls, gid), aggs,
+                            D.GroupStrategy.DENSE, domain_sizes=sizes)
+        meta = [GroupKeyMeta(krf.dtype, sizes[0], drf),
+                GroupKeyMeta(kls.dtype, sizes[1], dls),
+                GroupKeyMeta(gid.dtype, sizes[2])]
+        return agg, meta
+    # SORT measures faster on the virtual CPU mesh (host-side merge
+    # avoids the 8-device psum dispatch overhead, a harness artifact)
+    agg = D.Aggregation(ex, (krf, kls, gid), aggs,
+                        D.GroupStrategy.SORT, group_capacity=64)
     meta = [GroupKeyMeta(krf.dtype, 0, cols[ix["l_returnflag"]].dictionary),
             GroupKeyMeta(kls.dtype, 0, cols[ix["l_linestatus"]].dictionary),
             GroupKeyMeta(gid.dtype, 0)]
@@ -599,24 +613,27 @@ def _bench_one_sf(sf, platform, n_chips, iters, mem_bw):
                                             mem_bw)),
                     ("q19", lambda: _rung_q19(client, cols, ix, n_shards,
                                               iters)),
-                    ("rollup", lambda: _rung_rollup(client, cols, ix,
-                                                    n_shards, iters)),
+                    ("rollup", lambda: _rung_rollup(
+                        client, cols, ix, n_shards, iters,
+                        dense=(platform == "tpu"))),
                     ("hndv", lambda: _rung_hndv(client, cols, ix, sf,
                                                 n_shards, iters))):
-        if platform == "tpu" and sf >= 10 and tag == "hndv":
-            # observed live (round 5): the 2M-group scatter OOM-crashed
-            # the v5e worker at SF=10, and a dead worker forfeits the
-            # rest of the grant window — cap to SF<=1 on real hardware.
-            # (rollup is uncapped again: the Expand levels×n
-            # materialization that crashed it now aggregates level by
-            # level — copr/exec.py agg_states)
-            rec[f"{tag}_skipped"] = "sf>=10 crashes tpu worker (r5)"
-            continue
+        cap_stream = (platform == "tpu" and sf >= 10 and tag == "hndv")
+        if cap_stream:
+            # the resident 60M-row multi-key sort OOM-crashed the v5e
+            # worker (round 5, first window); stream it through HBM in
+            # bounded batches instead — _stream_sort_agg merges the
+            # per-batch group tables host-side
+            prev_cap = client.device_mem_cap
+            client.device_mem_cap = 64 << 20
         try:
             rec.update(fn())
         except Exception as e:      # noqa: BLE001 - rung isolation
             log(f"{tag} rung FAILED: {type(e).__name__}: {e}")
             rec[f"{tag}_error"] = f"{type(e).__name__}: {e}"[:200]
+        finally:
+            if cap_stream:
+                client.device_mem_cap = prev_cap
     _record(rec)
     log(f"SF {sf:g} result recorded")
 
@@ -662,13 +679,13 @@ def _rung_q19(client, cols, ix, n_shards, iters):
             "q19_vs_numpy": round(b19 / q19_t, 2)}
 
 
-def _rung_rollup(client, cols, ix, n_shards, iters):
+def _rung_rollup(client, cols, ix, n_shards, iters, dense=False):
     from tidb_tpu.store import snapshot_from_columns
     ru_names = ["l_returnflag", "l_linestatus", "l_quantity"]
     ru_cols = [cols[ix[n]] for n in ru_names]
     ixr = {n: i for i, n in enumerate(ru_names)}
     snapr = snapshot_from_columns(ru_names, ru_cols, n_shards=n_shards)
-    ragg, rmeta = _rollup_dag(ru_cols, ixr)
+    ragg, rmeta = _rollup_dag(ru_cols, ixr, dense=dense)
     resr = client.execute_agg(ragg, snapr, rmeta)
     expr_ = np_rollup(ru_cols, ixr)
     got = {}

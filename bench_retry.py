@@ -106,7 +106,13 @@ def main():
                      rc=rc, bench_s=round(time.time() - t, 1),
                      tpu_rungs=len(tpu))
         if tpu:
-            best = max(tpu, key=lambda r: r.get("sf", 0))
+            # prefer the biggest scale, then rungs with NO failed/skipped
+            # side rungs, then the best headline ratio
+            def _score(r):
+                clean = not any(k.endswith("_error")
+                                or k.endswith("_skipped") for k in r)
+                return (r.get("sf", 0), clean, r.get("vs_baseline", 0))
+            best = max(tpu, key=_score)
             prior = None
             try:
                 with open(OUT) as f:

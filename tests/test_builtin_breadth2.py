@@ -183,3 +183,33 @@ def test_json_string_values_stay_strings(s):
         [('{"a": "123"}',)]
     assert s.must_query("""select json_keys('{"a":1}', 'bad-path')""") \
         == [(None,)]
+
+
+def test_datetime_time_cast_semantics(s):
+    # review findings: time-of-day extraction, calendar validation,
+    # MySQL abbreviated-time rules, string-column TIME casts
+    assert s.must_query(
+        "select cast(cast('2024-01-01 10:30:00' as datetime) as time)"
+    ) == [("10:30:00",)]
+    assert s.must_query("select cast(20250231000000 as datetime)") == \
+        [(None,)]                      # Feb 31 -> NULL, never rolls over
+    assert s.must_query("select addtime('01:00:00','01:30')") == \
+        [("02:30:00",)]                # 'HH:MM' means HH:MM:00
+    assert s.must_query("select addtime('10:00:00','130')") == \
+        [("10:01:30",)]                # digits group as MMSS
+    s.execute("create table tc (x varchar(20))")
+    s.execute("insert into tc values ('10:30:00'), ('bad'), (NULL)")
+    assert s.must_query("select cast(x as time) from tc") == \
+        [("10:30:00",), (None,), (None,)]
+
+
+def test_json_search_escape_and_scope(s):
+    assert s.must_query(
+        """select json_search('{"a":"abc","b":{"c":"abc"}}', 'all',"""
+        """ 'abc', NULL, '$.b')""") == [('"$.b.c"',)]
+    # custom escape char makes a literal % searchable
+    assert s.must_query(
+        """select json_search('{"x":"10%"}', 'one', '10|%', '|')""") == \
+        [('"$.x"',)]
+    assert s.must_query(
+        """select json_search('{"x":"abc"}', 'one', 'zz%')""") == [(None,)]

@@ -451,3 +451,19 @@ def test_tpch_q15_view(tpch):
     finally:
         ours.execute("drop view revenue0")
         lite.execute("drop view revenue0")
+
+
+@pytest.mark.parametrize("qn", sorted(Q))
+def test_tpch_query_cascades(tpch, qn):
+    """All 22 queries again under the cascades/memo planner — the memo
+    search must agree with sqlite (and hence with the heuristic path)."""
+    ours, lite = tpch
+    ours.execute("set tidb_enable_cascades_planner=1")
+    try:
+        got = ours.must_query(Q[qn])
+    finally:
+        ours.execute("set tidb_enable_cascades_planner=0")
+    exp = lite.execute(Q[qn]).fetchall()
+    assert rows_equal(got, exp), (
+        f"\nTPC-H Q{qn} (cascades)\nours ({len(got)}): {got[:8]}\n"
+        f"sqlite ({len(exp)}): {exp[:8]}")

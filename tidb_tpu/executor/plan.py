@@ -383,8 +383,14 @@ def _try_cop(p: LogicalPlan, no_device_join: bool = False) -> Optional[PhysOp]:
         # schema; a Projection in the chain remaps indices (review r3) —
         # drop the seed there and let the client regrow from observed
         has_proj = any(isinstance(m, LogicalProjection) for m in mids)
+        bounded = None
+        if expand_l is not None:
+            # the Expand's gid column has domain [0, levels)
+            gid_ix = len(out_dtypes) - 1
+            bounded = {gid_ix: expand_l.levels}
         agg_node = _bind_agg(top, node, cur_dicts, key_meta, agg_dicts,
-                              ds=None if has_proj else ds)
+                              ds=None if has_proj else ds,
+                              bounded_ints=bounded)
         if agg_node is None:
             # aggregation itself not pushable: fuse the scan part only and
             # aggregate on host
@@ -1142,9 +1148,14 @@ def _chain_output_dicts(plan: LogicalPlan) -> dict:
 
 def _bind_agg(agg: LogicalAggregate, child: D.CopNode, dicts,
               key_meta_out: list, agg_dicts_out: dict,
-              ds=None) -> Optional[D.Aggregation]:
+              ds=None, bounded_ints=None) -> Optional[D.Aggregation]:
     """Bind a LogicalAggregate to a device Aggregation (DENSE/SCALAR), or
-    None if it must stay on host (generic keys / distinct)."""
+    None if it must stay on host (generic keys / distinct).
+
+    `bounded_ints` maps schema index -> finite domain size for planner-
+    bounded integer keys (the rollup Expand's gid column), letting
+    ROLLUP aggregations take the DENSE strategy — which is also what the
+    TPU per-level Expand execution (copr/exec.py agg_states) keys on."""
     if any(a.distinct for a in agg.aggs):
         return None
     from ..utils.collate import is_binary
@@ -1171,16 +1182,28 @@ def _bind_agg(agg: LogicalAggregate, child: D.CopNode, dicts,
     if not agg.group_exprs:
         return D.Aggregation(child, (), tuple(descs), D.GroupStrategy.SCALAR)
 
-    # DENSE when every key is a small-domain dict-encoded string: the psum
-    # seam merges aligned state vectors in-program (SURVEY.md §2.10 P2)
-    if all(isinstance(g, ColumnRef) and g.dtype.is_string and g.index in dicts
-           for g in agg.group_exprs):
+    # DENSE when every key has a known finite domain — small dict-encoded
+    # strings, or planner-bounded ints (rollup gid): the psum seam merges
+    # aligned state vectors in-program (SURVEY.md §2.10 P2)
+    bounded_ints = bounded_ints or {}
+
+    def _key_domain(g):
+        if not isinstance(g, ColumnRef):
+            return None, None
+        if g.dtype.is_string and g.index in dicts:
+            d = dicts[g.index]
+            return max(len(d) + (1 if g.dtype.nullable else 0), 1), d
+        if g.index in bounded_ints and not g.dtype.is_string:
+            return max(bounded_ints[g.index]
+                       + (1 if g.dtype.nullable else 0), 1), None
+        return None, None
+
+    domains = [_key_domain(g) for g in agg.group_exprs]
+    if all(size is not None for size, _d in domains):
         sizes = []
         metas = []
         total = 1
-        for g in agg.group_exprs:
-            d = dicts[g.index]
-            size = max(len(d) + (1 if g.dtype.nullable else 0), 1)
+        for g, (size, d) in zip(agg.group_exprs, domains):
             sizes.append(size)
             metas.append(GroupKeyMeta(g.dtype, size, d))
             total *= size

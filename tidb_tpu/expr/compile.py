@@ -1497,8 +1497,11 @@ class Evaluator:
             sec = iv % 100
             ok = ((mo >= 1) & (mo <= 12) & (d >= 1) & (d <= 31)
                   & (h < 24) & (mi < 60) & (sec < 60))
-            from ..types.temporal import days_from_civil
+            from ..types.temporal import civil_from_days, days_from_civil
             days = days_from_civil(xp, y, mo, d)
+            # calendar validation: Feb 31 etc. must be NULL, not rolled
+            y2, m2, d2 = civil_from_days(xp, days)
+            ok = ok & (y2 == y) & (m2 == mo) & (d2 == d)
             micros = (days * 86_400 + h * 3600 + mi * 60 + sec) * 1_000_000
             mm = ok if m is True else _mask_arr(xp, m, micros) & ok
             return xp.where(ok, micros, 0), mm
@@ -1515,8 +1518,11 @@ class Evaluator:
             us = xp.where(neg, -us, us)
             mm = ok if m is True else _mask_arr(xp, m, us) & ok
             return xp.where(ok, us, 0), mm
-        if dst.kind in (K.TIME, K.DATETIME) and src.kind in (K.DATETIME,
-                                                            K.TIME):
+        if dst.kind == K.TIME and src.kind == K.DATETIME:
+            # time-of-day component (MySQL CAST(datetime AS TIME))
+            from ..types.temporal import MICROS_PER_DAY
+            return _as_i64(xp, v) % MICROS_PER_DAY, m
+        if dst.kind == src.kind:
             return _as_i64(xp, v), m
         raise NotImplementedError(f"cast {src} -> {dst}")
 

@@ -313,7 +313,8 @@ def _str_valued_impl(op: str, consts: list):
     if op == "json_search":
         from ..utils.jsonfns import search
         one_all, target = str(consts[0]), str(consts[1])
-        return lambda v: search(v, one_all, target)
+        rest = consts[2:]              # [escape[, path...]]
+        return lambda v: search(v, one_all, target, *rest)
     if op == "json_merge_patch":
         from ..utils.jsonfns import merge_patch
         return lambda v: merge_patch(v, *consts)
@@ -935,6 +936,10 @@ def _lower_cast_strings(e: Func, args, dicts) -> Optional[Expr]:
         return _derived_ilut_nullable(dst, src, vals)
     if dst.kind == K.DATETIME:
         vals = [_str_to_micros(v) for v in d.values]
+        return _derived_ilut_nullable(dst, src, vals)
+    if dst.kind == K.TIME:
+        from ..types.temporal import parse_time
+        vals = [parse_time(v) for v in d.values]
         return _derived_ilut_nullable(dst, src, vals)
     if dst.kind in (K.INT64, K.UINT64):
         lut = []

@@ -685,6 +685,12 @@ class ExprBuilder:
                     "JSON_QUOTE", "JSON_VALUE", "JSON_DEPTH",
                     "JSON_CONTAINS_PATH", "JSON_STORAGE_SIZE",
                     "JSON_OVERLAPS"):
+            if name == "JSON_SEARCH" and len(args) >= 4 \
+                    and isinstance(args[3], Const) \
+                    and args[3].value is None:
+                # NULL escape means "default escape", not a NULL result
+                args = list(args)
+                args[3] = B.lit("")
             return self._str_func(name.lower(), *args)
         if name in ("JSON_ARRAY", "JSON_OBJECT"):
             # constant construction folds at plan time (the common form);
@@ -1202,26 +1208,14 @@ def _jval(c: Const):
 
 
 def _time_literal(e: Expr) -> Expr:
-    """'[-]HH:MM:SS[.ffffff]' string const -> TIME (micros) const."""
+    """TIME string const -> micros const (tmp.parse_time abbreviation
+    rules: 'HH:MM' = HH:MM:00, bare digits group as [H]HMMSS)."""
     if not (isinstance(e, Const) and isinstance(e.value, str)):
         return B.cast(e, dt.time(True))
-    s = e.value.strip()
-    neg = s.startswith("-")
-    if neg:
-        s = s[1:]
-    parts = s.split(":")
-    try:
-        if len(parts) == 3:
-            h, m = int(parts[0]), int(parts[1])
-            sec = float(parts[2])
-        elif len(parts) == 2:
-            h, m, sec = 0, int(parts[0]), float(parts[1])
-        else:
-            h, m, sec = 0, 0, float(parts[0])
-    except ValueError:
+    us = tmp.parse_time(e.value)
+    if us is None:
         return Const(dt.null_type(), None)
-    us = int(round((h * 3600 + m * 60 + sec) * 1e6))
-    return Const(dt.time(False), -us if neg else us)
+    return Const(dt.time(False), us)
 
 
 # GET_FORMAT(type, standard) result strings (builtin_time.go getFormat)

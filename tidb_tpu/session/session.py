@@ -1786,7 +1786,7 @@ class Session:
             v = v & np.broadcast_to(np.asarray(m), (n,))
         return v
 
-    def _retry_write_conflict(self, fn, attempts: int = 14):
+    def _retry_write_conflict(self, fn, attempts: int = 18):
         """Re-run an autocommit DML on optimistic write conflict / lock
         (session doCommitWithRetry analog, session.go:798): the statement
         recomputes against a fresh snapshot each attempt.  Capped
@@ -1801,7 +1801,7 @@ class Session:
             except KVError as e:
                 if e.code not in (1, 2) or a == attempts - 1:
                     raise
-                _t.sleep(min(0.002 * (2 ** a), 0.1))
+                _t.sleep(min(0.002 * (2 ** a), 0.3))
 
     def _exec_update(self, stmt: A.Update) -> ResultSet:
         return self._retry_write_conflict(lambda: self._do_update(stmt))

@@ -147,3 +147,37 @@ def days_from_civil(xp, y, m, d):
     doy = (153 * (m + xp.where(m > 2, -3, 9)) + 2) // 5 + d - 1
     doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
     return era * 146097 + doe - 719468
+
+
+def parse_time(s: str):
+    """'[-][D ]HH:MM:SS[.frac]' / 'HH:MM' / bare digits ([H]H[MM][SS])
+    -> signed micros, or None (MySQL abbreviated-TIME rules:
+    '11:12' = 11:12:00; digits group from the right as [H]HMMSS)."""
+    txt = s.strip()
+    neg = txt.startswith("-")
+    if neg:
+        txt = txt[1:]
+    try:
+        if ":" in txt:
+            parts = txt.split(":")
+            if len(parts) == 3:
+                h, m = int(parts[0]), int(parts[1])
+                sec = float(parts[2])
+            elif len(parts) == 2:
+                # MySQL: 'HH:MM' means HH:MM:00, NOT MM:SS
+                h, m, sec = int(parts[0]), int(parts[1]), 0.0
+            else:
+                return None
+        else:
+            v = float(txt)
+            iv = int(v)
+            frac = v - iv
+            sec = iv % 100 + frac
+            m = iv // 100 % 100
+            h = iv // 10_000
+        if m >= 60 or sec >= 60:
+            return None
+        us = int(round((h * 3600 + m * 60 + sec) * 1e6))
+        return -us if neg else us
+    except ValueError:
+        return None

@@ -271,29 +271,62 @@ def depth(text: str) -> Optional[int]:
     return d(doc)
 
 
-def search(text: str, one_or_all: str, target: str) -> Optional[str]:
-    """JSON_SEARCH with % / _ wildcards; returns path string(s)."""
+def search(text: str, one_or_all: str, target: str,
+           escape=None, *paths) -> Optional[str]:
+    """JSON_SEARCH(doc, one|all, pattern[, escape[, path...]]): % / _
+    wildcards with an optional escape char, scoped to `paths`."""
     import re as _re
     try:
         doc = _loads(text)
     except ValueError:
         return None
-    rx = _re.compile("^" + "".join(
-        ".*" if c == "%" else "." if c == "_" else _re.escape(c)
-        for c in target) + "$", _re.S)
+    esc = str(escape) if escape not in (None, "") else "\\"
+    out = []
+    i = 0
+    while i < len(target):
+        c = target[i]
+        if c == esc and i + 1 < len(target):
+            out.append(_re.escape(target[i + 1]))
+            i += 2
+            continue
+        out.append(".*" if c == "%" else "." if c == "_"
+                   else _re.escape(c))
+        i += 1
+    rx = _re.compile("^" + "".join(out) + "$", _re.S)
+    scopes = None
+    if paths:
+        try:
+            scopes = [parse_path(str(p)) for p in paths]
+        except JSONPathError:
+            return None
     hits: list[str] = []
 
-    def walk(v, path):
-        if isinstance(v, str) and rx.match(v):
-            hits.append(path)
+    def in_scope(steps) -> bool:
+        if scopes is None:
+            return True
+        return any(steps[:len(sc)] == sc for sc in scopes)
+
+    def render(steps) -> str:
+        out = "$"
+        for s in steps:
+            if isinstance(s, int):
+                out += f"[{s}]"
+            elif _re.search(r"\W", s):
+                out += f'."{s}"'
+            else:
+                out += f".{s}"
+        return out
+
+    def walk(v, steps):
+        if isinstance(v, str) and rx.match(v) and in_scope(steps):
+            hits.append(render(steps))
         elif isinstance(v, dict):
             for k, x in v.items():
-                walk(x, f'{path}."{k}"' if _re.search(r"\W", k)
-                     else f"{path}.{k}")
+                walk(x, steps + [k])
         elif isinstance(v, list):
-            for i, x in enumerate(v):
-                walk(x, f"{path}[{i}]")
-    walk(doc, "$")
+            for i2, x in enumerate(v):
+                walk(x, steps + [i2])
+    walk(doc, [])
     if not hits:
         return None
     if one_or_all.lower() == "one":

@@ -39,6 +39,7 @@ class PoolStats:
 class _Pool:
     executor: cf.ThreadPoolExecutor
     stats: PoolStats
+    rank: int = 0                  # creation order (nesting DAG order)
     mu: threading.Lock = field(default_factory=threading.Lock)
 
 
@@ -70,7 +71,8 @@ class PoolManager:
                 ex = cf.ThreadPoolExecutor(
                     max_workers=n, thread_name_prefix=f"pool-{name}")
                 p = self._pools[name] = _Pool(
-                    ex, PoolStats(name, n, weight))
+                    ex, PoolStats(name, n, weight),
+                    rank=len(self._pools))
             return p.executor
 
     def ensure(self, name: str, min_workers: int) -> None:
@@ -102,18 +104,25 @@ class PoolManager:
 
     def submit(self, name: str, fn, /, *args, weight: float = 1.0,
                **kw) -> cf.Future:
-        # caller-runs on nested submission: a task running on ANY managed
-        # pool that submits and waits would deadlock once every worker
-        # holds a blocked outer task — including CROSS-pool cycles
-        # (executor task -> apply task -> executor task).  Worker threads
-        # carry the pool- prefix, so detection is a prefix check.
-        if threading.current_thread().name.startswith("pool-"):
-            f: cf.Future = cf.Future()
-            try:
-                f.set_result(fn(*args, **kw))
-            except BaseException as e:   # noqa: BLE001 - future contract
-                f.set_exception(e)
-            return f
+        # deadlock-free nesting rule: a pool worker's submission QUEUES
+        # only when the target pool ranks strictly higher (creation
+        # order) — queued-and-awaited edges then form a DAG, so no
+        # worker cycle (executor -> apply -> executor) can ever block on
+        # itself; same-pool and downhill submissions run caller-inline.
+        cur = threading.current_thread().name
+        if cur.startswith("pool-"):
+            cur_pool = cur[5:].rsplit("_", 1)[0]
+            p_cur = self._pools.get(cur_pool)
+            p_tgt = self._pools.get(name)
+            uphill = (p_cur is not None and p_tgt is not None
+                      and p_tgt.rank > p_cur.rank)
+            if not uphill:
+                f: cf.Future = cf.Future()
+                try:
+                    f.set_result(fn(*args, **kw))
+                except BaseException as e:  # noqa: BLE001 future contract
+                    f.set_exception(e)
+                return f
         ex = self.pool(name, weight)
         p = self._pools[name]
         t0 = time.monotonic()
