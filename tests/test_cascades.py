@@ -116,9 +116,12 @@ def test_merge_join_wins_on_fanout_with_order(rng):
     out = _searched(dom, sql)
     txt = explain_logical(out)
     assert "LogicalSort" not in txt, txt
-    assert any(isinstance(n, type(out)) or True for n in [out])
+    # the extracted plan is a well-formed tree over both base tables
+    from tidb_tpu.planner.logical import DataSource, LogicalJoin, walk_plan
+    srcs = {n.table.name for n in walk_plan(out)
+            if isinstance(n, DataSource)}
+    assert srcs == {"probe", "dim"}, txt
     # the chosen join rides the merge hint
-    from tidb_tpu.planner.logical import LogicalJoin, walk_plan
     joins = [n for n in walk_plan(out) if isinstance(n, LogicalJoin)]
     assert joins and joins[0].hint_method == "merge", txt
     # end-to-end correctness incl. the dropped sort

@@ -195,12 +195,11 @@ def test_exchange_all_to_all_and_broadcast():
     """The MPP exchange primitives over the 8-device mesh."""
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     from tidb_tpu.parallel.exchange import (all_to_all_exchange,
                                             broadcast_gather)
-    from tidb_tpu.parallel.mesh import SHARD_AXIS, get_mesh
+    from tidb_tpu.parallel.mesh import SHARD_AXIS, get_mesh, shard_map
 
     mesh = get_mesh()
     n_dev = 8
@@ -226,7 +225,7 @@ def test_exchange_all_to_all_and_broadcast():
 
     f = jax.jit(shard_map(
         fn, mesh=mesh, in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
-        out_specs=(P(SHARD_AXIS),) * 4, check_vma=False))
+        out_specs=(P(SHARD_AXIS),) * 4))
     ok, n_recv, checksum, overflow = f(
         keys.reshape(n_dev, n_per), vals.reshape(n_dev, n_per))
     assert np.asarray(ok).all()
@@ -241,7 +240,7 @@ def test_exchange_all_to_all_and_broadcast():
         return jnp.sum(gk)[None]
 
     g = jax.jit(shard_map(bf, mesh=mesh, in_specs=(P(SHARD_AXIS),),
-                          out_specs=P(SHARD_AXIS), check_vma=False))
+                          out_specs=P(SHARD_AXIS)))
     sums = g(keys.reshape(n_dev, n_per))
     # every device received ALL rows
     assert all(int(x) == int(keys.sum()) for x in np.asarray(sums))

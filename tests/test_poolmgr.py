@@ -68,3 +68,19 @@ def test_resize_does_not_break_inflight_submitters():
     # a submitter that fetched the old executor must still work
     assert ex_old.submit(lambda: 5).result() == 5
     assert m.submit("r", lambda: 6).result() == 6
+
+
+def test_resize_reaps_retired_executor():
+    # ADVICE r5: retired executors must drain and release their threads,
+    # not be retained forever
+    m = PoolManager(cpu=2, retire_grace_s=0.05)
+    m.pool("leak")
+    ex_old = m.pool("leak")
+    m.resize("leak", 3)
+    deadline = time.monotonic() + 2.0
+    while time.monotonic() < deadline and not ex_old._shutdown:
+        time.sleep(0.02)
+    assert ex_old._shutdown, "retired executor never reaped"
+    assert ex_old not in m._retired
+    # the live pool keeps serving across the reap
+    assert m.submit("leak", lambda: 1).result() == 1

@@ -65,6 +65,11 @@ def engines():
     ours = Session()
     ours.execute("create table t (a bigint, b bigint, c varchar(10), d bigint)")
     lite = sqlite3.connect(":memory:")
+    # sqlite < 3.35 has no built-in sign(); polyfill so the corpus runs
+    # on any host sqlite
+    lite.create_function(
+        "sign", 1,
+        lambda v: None if v is None else (v > 0) - (v < 0))
     lite.execute("create table t (a bigint, b bigint, c varchar(10), d bigint)")
     vals = []
     for i in range(n):

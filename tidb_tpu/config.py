@@ -22,7 +22,10 @@ validation.
 
 from __future__ import annotations
 
-import tomllib
+try:
+    import tomllib                 # py >= 3.11
+except ImportError:                # py 3.10: the identical-API backport
+    import tomli as tomllib
 from dataclasses import dataclass, field
 from typing import Any, Optional
 

@@ -40,9 +40,13 @@ def check_killed() -> None:
 
 
 class QueryHandle:
-    """One statement's registration: live fragments for observability."""
+    """One statement's registration: live fragments for observability,
+    plus device-scheduler accounting (queue wait / coalesced launches)
+    surfaced in EXPLAIN ANALYZE as `schedWait` and in the statement
+    summary."""
 
-    __slots__ = ("conn_id", "sql", "started", "fragments", "_mu")
+    __slots__ = ("conn_id", "sql", "started", "fragments", "_mu",
+                 "sched_wait_ns", "sched_tasks", "sched_coalesced")
 
     def __init__(self, conn_id: int, sql: str):
         self.conn_id = conn_id
@@ -50,10 +54,20 @@ class QueryHandle:
         self.started = time.time()
         self.fragments: list = []
         self._mu = threading.Lock()
+        self.sched_wait_ns = 0     # admission-queue wait, all cop tasks
+        self.sched_tasks = 0       # device launches admitted
+        self.sched_coalesced = 0   # tasks that rode a shared launch
 
     def note_fragment(self, desc: str) -> None:
         with self._mu:
             self.fragments.append((desc, time.time()))
+
+    def note_sched(self, wait_ns: int, coalesced: int) -> None:
+        with self._mu:
+            self.sched_wait_ns += int(wait_ns)
+            self.sched_tasks += 1
+            if coalesced > 1:
+                self.sched_coalesced += 1
 
 
 class Coordinator:

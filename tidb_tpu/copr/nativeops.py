@@ -41,7 +41,14 @@ def _load() -> Optional[ctypes.CDLL]:
                 subprocess.run(["make", "-C", _NATIVE_DIR,
                                 "libtpuhostops.so"],
                                check=True, capture_output=True)
-            lib = ctypes.CDLL(_LIB_PATH)
+            try:
+                lib = ctypes.CDLL(_LIB_PATH)
+            except OSError:
+                # ABI mismatch (built on a newer glibc): rebuild locally
+                subprocess.run(["make", "-B", "-C", _NATIVE_DIR,
+                                "libtpuhostops.so"],
+                               check=True, capture_output=True)
+                lib = ctypes.CDLL(_LIB_PATH)
             I64, I32P, I64P = (ctypes.c_int64,
                                ctypes.POINTER(ctypes.c_int32),
                                ctypes.POINTER(ctypes.c_int64))

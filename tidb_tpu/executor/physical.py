@@ -335,6 +335,7 @@ class CopTaskExec(PhysOp):
         handle = QUERY_HANDLE.get()
         if handle is not None:
             handle.note_fragment(self.describe())
+        sched_w0 = handle.sched_wait_ns if handle is not None else 0
         if self.as_of_ts is not None:
             snap = self.as_of_snap
             if snap is None:
@@ -362,6 +363,11 @@ class CopTaskExec(PhysOp):
         # columns are the device-resident data plane (HBM residency is the
         # TPU analog of the reference's paging, SURVEY.md §5.7); the quota
         # governs host-side operator working memory.
+        if handle is not None:
+            # admission-queue wait this cop task paid, for EXPLAIN
+            # ANALYZE (select_result.go copr execution-info analog)
+            dw = handle.sched_wait_ns - sched_w0
+            self._rt_detail = f"schedWait: {dw / 1e6:.3f}ms"
         return ResultChunk(list(self.out_names), cols)
 
 

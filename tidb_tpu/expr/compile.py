@@ -1488,7 +1488,12 @@ class Evaluator:
             # MySQL numeric->DATETIME: digits read as [YYYYMMDD]HHMMSS
             # (internal micros arithmetic uses the reinterp op instead)
             iv = _as_i64(xp, v)
-            iv = xp.where(iv < 10 ** 8, iv * 10 ** 6, iv)  # date-only
+            # date-only digits scale to [YYYYMMDD]000000; zero the other
+            # lane BEFORE the multiply — 14-digit inputs times 10^6 wrap
+            # int64 in the discarded lane otherwise (ADVICE r5)
+            date_only = iv < 10 ** 8
+            iv = xp.where(date_only, iv, 0) * 10 ** 6 \
+                + xp.where(date_only, 0, iv)
             y = iv // 10 ** 10
             mo = iv // 10 ** 8 % 100
             d = iv // 10 ** 6 % 100

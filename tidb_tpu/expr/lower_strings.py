@@ -336,19 +336,29 @@ def _str_valued_impl(op: str, consts: list):
         return lambda v: value_at(v, path)
     if op == "uuid_to_bin":
         import uuid as _uuid
+        # MySQL swap_flag: store time-high + time-mid + time-low first so
+        # v1 UUIDs index chronologically (builtin_miscellaneous.go)
+        swap = bool(consts and consts[0])
 
         def _u2b(v):
             try:
-                return _uuid.UUID(v).bytes.hex()
+                b = _uuid.UUID(v).bytes
             except ValueError:
                 return None
+            if swap:
+                b = b[6:8] + b[4:6] + b[0:4] + b[8:]
+            return b.hex()
         return _u2b
     if op == "bin_to_uuid":
         import uuid as _uuid
+        swap = bool(consts and consts[0])
 
         def _b2u(v):
             try:
-                return str(_uuid.UUID(bytes=bytes.fromhex(v)))
+                b = bytes.fromhex(v)
+                if swap:            # undo the time-swapped storage order
+                    b = b[4:8] + b[2:4] + b[0:2] + b[8:]
+                return str(_uuid.UUID(bytes=b))
             except ValueError:
                 return None
         return _b2u

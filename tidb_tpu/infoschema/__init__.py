@@ -304,7 +304,8 @@ _INFORMATION_SCHEMA = {
                     ("ROWS_SENT", I)], _slow_query),
     "STATEMENTS_SUMMARY": ([("DIGEST_TEXT", S), ("EXEC_COUNT", I),
                             ("AVG_LATENCY_MS", F), ("MAX_LATENCY_MS", F),
-                            ("SUM_ROWS", I), ("QUERY_SAMPLE_TEXT", S)],
+                            ("SUM_ROWS", I), ("QUERY_SAMPLE_TEXT", S),
+                            ("AVG_SCHED_WAIT_MS", F)],
                            _stmt_summary),
     "VIEWS": ([("TABLE_CATALOG", S), ("TABLE_SCHEMA", S),
                ("TABLE_NAME", S), ("VIEW_DEFINITION", S),
@@ -351,7 +352,8 @@ _INFORMATION_SCHEMA = {
                      _cluster_info),
     "RESOURCE_GROUPS": ([("NAME", S), ("RU_PER_SEC", I), ("BURSTABLE", S),
                          ("EXEC_ELAPSED_SEC", F), ("RUNAWAY_ACTION", S),
-                         ("RUNAWAY_COUNT", I)], _resource_groups),
+                         ("RUNAWAY_COUNT", I), ("PRIORITY", S)],
+                        _resource_groups),
     "DIST_TASKS": ([("TASK_ID", I), ("TYPE", S), ("STATE", S),
                     ("SUBTASKS_DONE", I), ("SUBTASKS_TOTAL", I),
                     ("ERROR", S)], _dist_tasks),

@@ -23,6 +23,22 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 SHARD_AXIS = "shard"
 
+try:                                    # jax >= 0.5: public API
+    from jax import shard_map as _shard_map
+except ImportError:                     # 0.4.x: experimental namespace
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def shard_map(f, *, mesh: Mesh, in_specs, out_specs):
+    """Version-compat shard_map with replication checking off: the
+    public API spells the flag check_vma, 0.4.x spells it check_rep."""
+    try:
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=False)
+    except TypeError:
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=False)
+
 
 @functools.lru_cache(maxsize=8)
 def get_mesh(n_devices: Optional[int] = None) -> Mesh:
@@ -46,4 +62,5 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-__all__ = ["SHARD_AXIS", "get_mesh", "shard_spec", "sharded", "replicated"]
+__all__ = ["SHARD_AXIS", "get_mesh", "shard_spec", "sharded", "replicated",
+           "shard_map"]

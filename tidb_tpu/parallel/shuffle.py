@@ -25,7 +25,7 @@ from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..copr import dag as D
@@ -35,7 +35,7 @@ from ..copr.join import gather_expand, match_ranges
 from ..expr.compile import Evaluator
 from ..ops.sortkeys import INT64_MAX
 from .exchange import all_to_all_exchange
-from .mesh import SHARD_AXIS
+from .mesh import SHARD_AXIS, shard_map
 from .spmd import _collective_merge, _flatten_block
 
 
@@ -86,7 +86,7 @@ class ShardedShuffleJoinProgram:
             out_specs = (P(SHARD_AXIS), P(SHARD_AXIS))
         self._fn = jax.jit(shard_map(
             self._device_fn, mesh=mesh, in_specs=in_specs,
-            out_specs=(out_specs, P(SHARD_AXIS)), check_vma=False))
+            out_specs=(out_specs, P(SHARD_AXIS))))
 
     # ------------------------------------------------------------- #
 

@@ -22,7 +22,6 @@ from typing import Any, Optional, Sequence
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..copr import dag as D
@@ -30,7 +29,7 @@ from ..copr.aggregate import _MERGE
 from ..copr.exec import (DeviceBatch, _agg_partial_states, _exec_node,
                          agg_states, compact)
 from ..expr.compile import Evaluator
-from .mesh import SHARD_AXIS
+from .mesh import SHARD_AXIS, shard_map
 
 
 def _psum_gather(arr, axis: str, n_dev: int):
@@ -121,7 +120,7 @@ class ShardedCopProgram:
 
         self._fn = jax.jit(shard_map(
             self._device_fn, mesh=mesh, in_specs=in_specs,
-            out_specs=out_specs, check_vma=False))
+            out_specs=out_specs))
 
     def _device_fn(self, cols, counts, aux):
         from ..copr.exec import set_trace_platform
@@ -173,4 +172,65 @@ def get_sharded_program(dag_root: D.CopNode, mesh,
     return _cached(dag_root, mesh, row_capacity)
 
 
-__all__ = ["ShardedCopProgram", "get_sharded_program"]
+class BatchedCopProgram:
+    """K compatible dense-agg cop tasks as ONE vmapped SPMD launch.
+
+    The admission scheduler (sched/) coalesces concurrent tasks that
+    compile to the same program but carry distinct inputs: their stacked
+    (S, C) column arrays stack again along a batch-slot dim -> (S, K, C),
+    the base program's device fn runs under jax.vmap over that dim inside
+    one shard_map, and the replicated merged states split back per slot.
+    Only programs whose whole merge happens in-program qualify (kind
+    'agg', no host merge, no extras) — vmapping a psum batches the
+    collective, it does not mix slots."""
+
+    def __init__(self, dag_root: D.CopNode, mesh, n_slots: int):
+        self.base = get_sharded_program(dag_root, mesh)
+        if self.base.kind != "agg" or self.base.host_merge \
+                or self.base.has_extras:
+            raise ValueError("only fully in-program agg plans batch")
+        self.n_slots = n_slots
+        in_specs = (P(SHARD_AXIS), P(SHARD_AXIS), P())
+        fn = jax.vmap(self.base._device_fn, in_axes=(1, 1, None),
+                      out_axes=0)
+        self._fn = jax.jit(shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                     out_specs=P()))
+
+    def __call__(self, cols_list: Sequence, counts_list: Sequence) -> list:
+        k = len(cols_list)
+        if self.base._psum_limb_fence and cols_list[0]:
+            s, c = cols_list[0][0][0].shape[:2]
+            if s * c >= 2 ** 31:
+                raise OverflowError(
+                    f"global capacity {s}x{c} exceeds the 2^31 limb-exact "
+                    "SUM bound for in-program psum merge")
+        # pad short batches by repeating the last slot: one compiled
+        # program per pow2 slot count instead of one per K
+        pads = list(cols_list) + [cols_list[-1]] * (self.n_slots - k)
+        cnts = list(counts_list) + [counts_list[-1]] * (self.n_slots - k)
+        ncols = len(pads[0])
+        stacked = []
+        for j in range(ncols):
+            v = jnp.stack([c[j][0] for c in pads], axis=1)
+            m = None if pads[0][j][1] is None else \
+                jnp.stack([c[j][1] for c in pads], axis=1)
+            stacked.append((v, m))
+        counts = jnp.stack(list(cnts), axis=1)
+        out = self._fn(tuple(stacked), counts, ())
+        return [jax.tree_util.tree_map(lambda a, i=i: a[i], out)
+                for i in range(k)]
+
+
+@functools.lru_cache(maxsize=32)
+def _cached_batched(dag_root, mesh, n_slots):
+    return BatchedCopProgram(dag_root, mesh, n_slots)
+
+
+def get_batched_program(dag_root: D.CopNode, mesh,
+                        n_slots: int) -> BatchedCopProgram:
+    n_slots = max(2, 1 << (n_slots - 1).bit_length())   # pow2 slot counts
+    return _cached_batched(dag_root, mesh, n_slots)
+
+
+__all__ = ["ShardedCopProgram", "get_sharded_program",
+           "BatchedCopProgram", "get_batched_program"]

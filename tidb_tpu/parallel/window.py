@@ -25,7 +25,7 @@ from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..copr import dag as D
@@ -34,7 +34,7 @@ from ..copr.exec import (Evaluator, _ensure_array, _exec_node, _sel_array,
 from ..ops.sortkeys import sortable_int64
 from ..types import dtypes as dt
 from .exchange import all_to_all_exchange
-from .mesh import SHARD_AXIS
+from .mesh import SHARD_AXIS, shard_map
 from .spmd import _flatten_block
 
 K = dt.TypeKind
@@ -74,7 +74,7 @@ class ShardedWindowProgram:
         out_specs = ((P(SHARD_AXIS), P(SHARD_AXIS)), P(SHARD_AXIS))
         self._fn = jax.jit(shard_map(
             self._device_fn, mesh=mesh, in_specs=in_specs,
-            out_specs=out_specs, check_vma=False))
+            out_specs=out_specs))
 
     # -- device program ------------------------------------------------ #
 

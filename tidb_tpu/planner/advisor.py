@@ -29,7 +29,7 @@ def _eq_cols(node, out: list) -> None:
 def recommend_indexes(domain, db: str) -> list[tuple]:
     """[(table, columns, est_benefit_execs, sample_sql)] recommendations."""
     scores: dict[tuple, dict] = {}
-    for digest, execs, _avg, _mx, _rows, sample in \
+    for digest, execs, _avg, _mx, _rows, sample, *_extra in \
             domain.stmt_summary.summary_rows():
         try:
             stmts = parse_sql(sample)

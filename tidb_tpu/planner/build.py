@@ -760,20 +760,45 @@ class ExprBuilder:
                            B.lit(1_000_000))
             return B.arith("add", secs, B.lit(719_528 * 86_400))
         if name in ("ADDTIME", "SUBTIME", "TIMEDIFF"):
-            def temporal_arg(x):
+            dual_base = [False]
+
+            def temporal_arg(x, base):
                 if not x.dtype.is_string:
                     return x
                 # datetime-shaped literals parse as DATETIME; a LEADING
                 # '-' is a negative TIME, not a date separator
-                if isinstance(x, Const) and isinstance(x.value, str) \
-                        and "-" in x.value.lstrip()[1:]:
-                    return _coerce_to(dt.datetime(), x)
+                if isinstance(x, Const) and isinstance(x.value, str):
+                    if "-" in x.value.lstrip()[1:]:
+                        return _coerce_to(dt.datetime(), x)
+                    return _time_literal(x)
+                if base:
+                    # non-const string: MySQL decides datetime-vs-time
+                    # per VALUE.  Try the datetime parse first and fall
+                    # back to TIME (ADVICE r5) — both casts lower to
+                    # per-dictionary-value parse LUTs, so datetime-shaped
+                    # columns no longer NULL out through CAST(.. AS TIME)
+                    dual_base[0] = True
+                    dtv = Func(dt.datetime(True), "cast", (x,))
+                    tv = Func(dt.time(True), "cast", (x,))
+                    return B.ifnull(
+                        B.reinterpret(dtv, dt.bigint(True)),
+                        B.reinterpret(tv, dt.bigint(True)))
                 return _time_literal(x)
-            a, b = temporal_arg(args[0]), temporal_arg(args[1])
+            # the base of ADDTIME/SUBTIME (and both TIMEDIFF sides) may
+            # be datetime-shaped; ADDTIME's second arg is always a TIME
+            a = temporal_arg(args[0], True)
+            b = temporal_arg(args[1], name == "TIMEDIFF")
             if a.dtype.kind == K.NULL or b.dtype.kind == K.NULL:
                 return B.lit(None)
-            out_t = (dt.time(True) if name == "TIMEDIFF"
-                     else a.dtype.with_nullable(True))
+            if name == "TIMEDIFF":
+                out_t = dt.time(True)
+            elif dual_base[0]:
+                # dual-parsed string base: type follows the dominant
+                # datetime reading (MySQL returns a string and formats
+                # per value; a static engine type must pick one)
+                out_t = dt.datetime(True)
+            else:
+                out_t = a.dtype.with_nullable(True)
             op = "sub" if name in ("SUBTIME", "TIMEDIFF") else "add"
             return B.reinterpret(
                 B.arith(op, B.reinterpret(a, dt.bigint()),

@@ -1,0 +1,11 @@
+"""Device admission scheduler: continuous micro-batching of concurrent
+cop tasks (tikv unified-read-pool + inference continuous-batching
+analog).  See scheduler.py for the design."""
+
+from .scheduler import (DEFAULT_MAX_COALESCE, DEFAULT_QUEUE_DEPTH,
+                        DeviceScheduler, scheduler_for)
+from .task import SCHED_GROUP, CopTask, ServerBusyError, current_group
+
+__all__ = ["DeviceScheduler", "scheduler_for", "CopTask",
+           "ServerBusyError", "SCHED_GROUP", "current_group",
+           "DEFAULT_QUEUE_DEPTH", "DEFAULT_MAX_COALESCE"]

@@ -1,0 +1,354 @@
+"""Per-mesh device admission scheduler: continuous micro-batching of
+concurrent cop tasks.
+
+Reference analog: tikv's unified read pool (resource-group-aware
+priority queue in front of the storage threads) combined with the
+continuous-batching admission loop of inference servers.  One scheduler
+owns all launches onto one jax mesh:
+
+- CopClient dispatch no longer calls the device directly; it submits
+  `CopTask`s to a BOUNDED admission queue tagged by (program digest,
+  capacity shape, resource group).  Overflow raises the MySQL-compatible
+  "server is busy" error instead of growing memory without bound.
+- A drain loop serves queues in weighted-fair order (stride scheduling
+  over per-resource-group virtual time, weights from the group's
+  PRIORITY — utils/resourcegroup.py).
+- Compatible tasks COALESCE into one launch: identical inputs (same
+  snapshot epoch residents) share a single program execution; distinct
+  inputs of the same dense-agg program stack along a batch-slot dim and
+  run as ONE vmapped program (parallel/spmd.get_batched_program), with
+  partial-agg states split back per task.
+- Queue-wait / launch / coalesce stats feed utils/metrics (scraped at
+  /metrics), the /sched status route, per-statement execdetails
+  (`schedWait` in EXPLAIN ANALYZE), and per-group RU accounting.
+
+The drain thread starts lazily on first submit and exits after an idle
+period, so embedders that never touch the device pay nothing.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from .task import CopTask, ServerBusyError
+
+DEFAULT_QUEUE_DEPTH = 256
+DEFAULT_MAX_COALESCE = 8
+IDLE_EXIT_S = 5.0
+
+
+class _GroupQ:
+    """One resource group's FIFO + stride-scheduler state."""
+
+    __slots__ = ("name", "weight", "vtime", "seq", "queue",
+                 "tasks", "wait_ns", "rus")
+
+    def __init__(self, name: str, weight: float, seq: int,
+                 vtime: float = 0.0):
+        self.name = name
+        self.weight = max(weight, 1e-6)
+        self.vtime = vtime        # accumulated service / weight
+        self.seq = seq            # tie-break: registration order
+        self.queue: deque = deque()
+        self.tasks = 0            # served (lifetime)
+        self.wait_ns = 0
+        self.rus = 0.0
+
+
+class DeviceScheduler:
+    """Admission queue + weighted-fair drain loop for one device mesh."""
+
+    def __init__(self, max_depth: int = DEFAULT_QUEUE_DEPTH,
+                 max_coalesce: int = DEFAULT_MAX_COALESCE):
+        self.max_depth = max_depth
+        self.max_coalesce = max_coalesce
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self._groups: dict[str, _GroupQ] = {}
+        self._depth = 0
+        self._gvt = 0.0           # global virtual time (newcomer floor)
+        self._thread: Optional[threading.Thread] = None
+        self._paused = False
+        # lifetime counters (read by /sched, tests, metrics mirror them)
+        self.launches = 0
+        self.coalesced_launches = 0       # launches serving >= 2 tasks
+        self.coalesced_tasks = 0          # tasks that rode a shared launch
+        self.batched_launches = 0         # stacked-slot vmap launches
+        self.busy_rejects = 0
+        self.tasks_done = 0
+        from ..utils.metrics import global_registry
+        reg = global_registry()
+        self._m_depth = reg.gauge("tidb_tpu_sched_queue_depth",
+                                  "device admission queue depth")
+        self._m_tasks = reg.counter("tidb_tpu_sched_tasks_total",
+                                    "cop tasks admitted", labels=("group",))
+        self._m_busy = reg.counter("tidb_tpu_sched_busy_total",
+                                   "admission rejections (queue full)")
+        self._m_launch = reg.counter("tidb_tpu_sched_launch_total",
+                                     "device launches", labels=("mode",))
+        self._m_coal = reg.counter("tidb_tpu_sched_coalesced_tasks_total",
+                                   "tasks served by a shared launch")
+        self._m_wait = reg.histogram("tidb_tpu_sched_wait_seconds",
+                                     "admission queue wait")
+        self._m_ru = reg.counter("tidb_tpu_sched_ru_total",
+                                 "request units launched", labels=("group",))
+
+    # ------------------------------------------------------------- #
+    # admission
+    # ------------------------------------------------------------- #
+
+    def configure(self, max_depth: Optional[int] = None,
+                  max_coalesce: Optional[int] = None) -> None:
+        """Apply sysvar knobs; negative/None = keep current."""
+        if max_depth is not None and max_depth > 0:
+            self.max_depth = max_depth
+        if max_coalesce is not None and max_coalesce > 0:
+            self.max_coalesce = max_coalesce
+
+    def submit(self, task: CopTask) -> CopTask:
+        """Enqueue; raises ServerBusyError when the bounded queue is
+        full (backpressure instead of unbounded buffering)."""
+        with self._cv:
+            if self._depth >= self.max_depth:
+                self.busy_rejects += 1
+                self._m_busy.inc()
+                raise ServerBusyError(self.max_depth)
+            g = self._groups.get(task.group)
+            if g is None:
+                g = self._groups[task.group] = _GroupQ(
+                    task.group, task.weight, len(self._groups),
+                    vtime=self._gvt)
+            else:
+                g.weight = max(task.weight, 1e-6)
+                if not g.queue:
+                    # re-activating group: forfeit banked idle time so it
+                    # cannot starve others (stride newcomer rule)
+                    g.vtime = max(g.vtime, self._gvt)
+            g.queue.append(task)
+            self._depth += 1
+            self._m_depth.set(self._depth)
+            self._m_tasks.inc(group=task.group)
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._loop, name="sched-drain", daemon=True)
+                self._thread.start()
+            self._cv.notify_all()
+        return task
+
+    def pause(self) -> None:
+        """Hold the drain loop (tests / maintenance); submits still queue."""
+        with self._cv:
+            self._paused = True
+
+    def resume(self) -> None:
+        with self._cv:
+            self._paused = False
+            self._cv.notify_all()
+
+    # ------------------------------------------------------------- #
+    # drain loop
+    # ------------------------------------------------------------- #
+
+    def _pick(self) -> Optional[_GroupQ]:
+        best = None
+        for g in self._groups.values():
+            if not g.queue:
+                continue
+            if best is None or (g.vtime, g.seq) < (best.vtime, best.seq):
+                best = g
+        return best
+
+    def _take_batch(self) -> list:
+        """Pop the fair-ordered head task plus every compatible queued
+        task (same program digest + capacity shape + equal DAG), across
+        ALL groups — coalescing is cross-session by design.  Each rider
+        charges its own group's virtual time."""
+        g = self._pick()
+        if g is None:
+            return []
+        lead = g.queue.popleft()
+        self._depth -= 1
+        g.vtime += 1.0 / g.weight
+        self._gvt = g.vtime
+        g.tasks += 1
+        if lead.cancelled:
+            self._m_depth.set(self._depth)
+            lead.fail(RuntimeError("cancelled"))
+            return [None]          # sentinel: retry pick
+        batch = [lead]
+        if lead.key is not None:
+            for og in self._groups.values():
+                if len(batch) >= self.max_coalesce:
+                    break
+                kept: deque = deque()
+                while og.queue:
+                    t = og.queue.popleft()
+                    if (len(batch) < self.max_coalesce
+                            and not t.cancelled and t.key == lead.key
+                            and t.mesh is lead.mesh
+                            and (t.dag is lead.dag or t.dag == lead.dag)):
+                        batch.append(t)
+                        self._depth -= 1
+                        og.vtime += 1.0 / og.weight
+                        og.tasks += 1
+                    else:
+                        kept.append(t)
+                og.queue = kept
+        self._m_depth.set(self._depth)
+        return batch
+
+    def _loop(self) -> None:
+        idle_since = time.monotonic()
+        while True:
+            with self._cv:
+                while self._paused or self._depth == 0:
+                    if self._depth == 0 and not self._paused and \
+                            time.monotonic() - idle_since > IDLE_EXIT_S:
+                        self._thread = None
+                        return
+                    self._cv.wait(timeout=0.5)
+                    if not self._paused and self._depth == 0:
+                        continue
+                batch = self._take_batch()
+            idle_since = time.monotonic()
+            if not batch or batch == [None]:
+                continue
+            now = time.perf_counter_ns()
+            for t in batch:
+                t.start_ns = now
+                t.wait_ns = now - t.submit_ns
+            try:
+                self._serve(batch)
+            except BaseException as e:  # noqa: BLE001 future-style contract
+                for t in batch:
+                    t.fail(e)
+            self._account(batch)
+
+    # ------------------------------------------------------------- #
+    # launch
+    # ------------------------------------------------------------- #
+
+    def _serve(self, batch: list) -> None:
+        lead = batch[0]
+        if lead.fn is not None:                     # opaque launch
+            try:
+                lead.finish(lead.fn())
+            except BaseException as e:  # noqa: BLE001
+                lead.fail(e)
+            self.launches += 1
+            self._m_launch.inc(mode="single")
+            return
+        from ..parallel.spmd import get_batched_program, get_sharded_program
+        prog = get_sharded_program(lead.dag, lead.mesh, lead.row_capacity)
+        # group riders by input identity: same-token tasks share ONE
+        # program execution (in-flight dedup)
+        slots: list[list] = []
+        by_token: dict = {}
+        for t in batch:
+            s = by_token.get(t.input_token)
+            if s is None:
+                s = by_token[t.input_token] = []
+                slots.append(s)
+            s.append(t)
+        mode = "single"
+        if len(slots) > 1 and prog.kind == "agg" and not prog.host_merge \
+                and not prog.has_extras \
+                and all(s[0].aux == () for s in slots):
+            # distinct inputs, one dense-agg program: stack along the
+            # batch-slot dim, ONE vmapped launch, split states per task
+            try:
+                bprog = get_batched_program(lead.dag, lead.mesh, len(slots))
+                outs = bprog([s[0].cols for s in slots],
+                             [s[0].counts for s in slots])
+                for s, out in zip(slots, outs):
+                    for t in s:
+                        t.finish((prog, out))
+                self.launches += 1
+                self.batched_launches += 1
+                self._m_launch.inc(mode="batched")
+                self._note_coalesce(batch)
+                return
+            except Exception:
+                pass        # op not vmappable on this backend: launch apart
+        for s in slots:
+            out = prog(s[0].cols, s[0].counts, s[0].aux)
+            for t in s:
+                t.finish((prog, out))
+            self.launches += 1
+            self._m_launch.inc(
+                mode="coalesced" if len(s) > 1 else mode)
+        self._note_coalesce(batch)
+
+    def _note_coalesce(self, batch: list) -> None:
+        if len(batch) > 1:
+            self.coalesced_launches += 1
+            self.coalesced_tasks += len(batch)
+            self._m_coal.inc(len(batch))
+            for t in batch:
+                t.coalesced = len(batch)
+
+    def _account(self, batch: list) -> None:
+        with self._mu:
+            for t in batch:
+                self.tasks_done += 1
+                g = self._groups.get(t.group)
+                rus = t.est_rows / 100.0 + 1.0
+                if g is not None:
+                    g.wait_ns += t.wait_ns
+                    g.rus += rus
+                self._m_wait.observe(t.wait_ns / 1e9)
+                self._m_ru.inc(rus, group=t.group)
+
+    # ------------------------------------------------------------- #
+    # introspection
+    # ------------------------------------------------------------- #
+
+    @property
+    def depth(self) -> int:
+        return self._depth
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {
+                "queue_depth": self._depth,
+                "max_depth": self.max_depth,
+                "max_coalesce": self.max_coalesce,
+                "launches": self.launches,
+                "coalesced_launches": self.coalesced_launches,
+                "coalesced_tasks": self.coalesced_tasks,
+                "batched_launches": self.batched_launches,
+                "busy_rejects": self.busy_rejects,
+                "tasks_done": self.tasks_done,
+                "groups": {
+                    g.name: {"weight": g.weight, "tasks": g.tasks,
+                             "queued": len(g.queue),
+                             "wait_ms": round(g.wait_ns / 1e6, 3),
+                             "rus": round(g.rus, 2)}
+                    for g in self._groups.values()},
+            }
+
+
+# --------------------------------------------------------------------- #
+# per-mesh registry: the scheduler is the mesh's single device executor
+# --------------------------------------------------------------------- #
+
+_REGISTRY: dict[int, DeviceScheduler] = {}
+_REG_MU = threading.Lock()
+
+
+def scheduler_for(mesh) -> DeviceScheduler:
+    """The (process-wide) scheduler owning launches onto `mesh`.  Keyed
+    by mesh identity: every Domain sharing a mesh shares its admission
+    queue — device capacity is global, so admission must be too."""
+    with _REG_MU:
+        s = _REGISTRY.get(id(mesh))
+        if s is None:
+            s = _REGISTRY[id(mesh)] = DeviceScheduler()
+        return s
+
+
+__all__ = ["DeviceScheduler", "scheduler_for", "DEFAULT_QUEUE_DEPTH",
+           "DEFAULT_MAX_COALESCE"]

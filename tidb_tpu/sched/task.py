@@ -1,0 +1,159 @@
+"""CopTask: one admission unit of device work.
+
+Reference analog: the request level of tikv's unified read pool +
+tidb's copr task queue — every coprocessor launch becomes a queued,
+taggable unit instead of an ad-hoc device call.  A task is either
+
+- *structured*: carries (dag, mesh, row_capacity, device inputs) so the
+  scheduler itself resolves the compiled program (parallel/spmd cache)
+  and may COALESCE it with compatible tasks from other sessions — the
+  continuous-batching admission unit, or
+- *opaque*: a zero-arg launch closure (shuffle/window programs whose
+  signatures differ); still admission-controlled and fair-ordered, never
+  coalesced.
+
+The task key tags (program digest, capacity shape, mesh) — the same key
+`spmd.get_sharded_program` caches compiled programs on — so the
+scheduler can recognize "same program in flight" across sessions.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+import time
+from typing import Any, Callable, Optional
+
+# the submitting statement's (resource group name, fair-share weight) —
+# bound by Session.execute around each statement; travels into worker
+# threads via contextvars.copy_context like KILL_EVENT does
+SCHED_GROUP: contextvars.ContextVar = contextvars.ContextVar(
+    "sched_group", default=None)
+
+DEFAULT_GROUP = "default"
+DEFAULT_WEIGHT = 8.0
+
+
+class ServerBusyError(RuntimeError):
+    """Admission queue overflow: the MySQL-compatible "server is busy"
+    backpressure error (TiDB error space 9003, ErrTiKVServerBusy) — the
+    client should back off and retry instead of piling work onto an
+    already-saturated device."""
+
+    errno = 9003
+
+    def __init__(self, depth: int):
+        super().__init__(
+            f"TiKV server is busy (device admission queue full, "
+            f"depth={depth}); retry later")
+
+
+def current_group() -> tuple[str, float]:
+    """(group name, weight) of the calling statement context."""
+    g = SCHED_GROUP.get()
+    if not g:
+        return DEFAULT_GROUP, DEFAULT_WEIGHT
+    return g
+
+
+def _shape_sig(cols, counts) -> tuple:
+    """Capacity-shape signature of the stacked device inputs: coalescing
+    requires byte-identical program input shapes (the capacity half of
+    the compile-cache key)."""
+    sig = []
+    for v, m in cols:
+        sig.append((tuple(v.shape), str(v.dtype), m is None))
+    return tuple(sig) + ((tuple(counts.shape),) if counts is not None
+                         else ())
+
+
+class CopTask:
+    """One queued device launch; resolved to (program, out) on wait()."""
+
+    __slots__ = ("key", "dag", "mesh", "row_capacity", "cols", "counts",
+                 "aux", "input_token", "fn", "group", "weight",
+                 "submit_ns", "start_ns", "wait_ns", "coalesced",
+                 "cancelled", "_done", "_value", "_exc", "est_rows")
+
+    def __init__(self, *, key=None, dag=None, mesh=None, row_capacity=0,
+                 cols=None, counts=None, aux=(), input_token=None,
+                 fn: Optional[Callable[[], Any]] = None,
+                 group: Optional[str] = None,
+                 weight: Optional[float] = None, est_rows: int = 0):
+        if group is None:
+            group, gw = current_group()
+            if weight is None:
+                weight = gw
+        self.key = key
+        self.dag = dag
+        self.mesh = mesh
+        self.row_capacity = row_capacity
+        self.cols = cols
+        self.counts = counts
+        self.aux = aux
+        self.input_token = input_token
+        self.fn = fn
+        self.group = group
+        self.weight = float(weight or DEFAULT_WEIGHT)
+        self.est_rows = est_rows
+        self.submit_ns = time.perf_counter_ns()
+        self.start_ns = 0
+        self.wait_ns = 0
+        self.coalesced = 1        # tasks served by this task's launch
+        self.cancelled = False
+        self._done = threading.Event()
+        self._value = None
+        self._exc = None
+
+    # -------- factory helpers -------- #
+
+    @classmethod
+    def structured(cls, dag, mesh, row_capacity, cols, counts, aux,
+                   est_rows: int = 0) -> "CopTask":
+        from ..copr.dag import dag_digest
+        key = (dag_digest(dag), id(mesh), int(row_capacity),
+               _shape_sig(cols, counts))
+        # input identity for in-flight dedup: the snapshot's resident
+        # device cache returns the SAME array objects per epoch, so two
+        # sessions over one snapshot share ids; the task pins the refs
+        token = (id(cols), id(counts), id(aux))
+        return cls(key=key, dag=dag, mesh=mesh, row_capacity=row_capacity,
+                   cols=cols, counts=counts, aux=aux, input_token=token,
+                   est_rows=est_rows)
+
+    @classmethod
+    def opaque(cls, fn: Callable[[], Any], est_rows: int = 0) -> "CopTask":
+        return cls(fn=fn, est_rows=est_rows)
+
+    # -------- completion -------- #
+
+    def finish(self, value) -> None:
+        if self._done.is_set():
+            return
+        self._value = value
+        self._done.set()
+
+    def fail(self, exc: BaseException) -> None:
+        if self._done.is_set():      # a served task keeps its result
+            return
+        self._exc = exc
+        self._done.set()
+
+    def wait(self):
+        """Block until the scheduler serves this task.  Cooperative with
+        KILL QUERY: polls the caller's kill event between waits; a killed
+        waiter marks itself cancelled so the drain loop skips it."""
+        from ..copr.coordinator import QueryInterrupted, check_killed
+        while not self._done.wait(0.05):
+            try:
+                check_killed()
+            except QueryInterrupted:
+                self.cancelled = True
+                raise
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+
+__all__ = ["CopTask", "ServerBusyError", "SCHED_GROUP", "current_group",
+           "DEFAULT_GROUP", "DEFAULT_WEIGHT"]

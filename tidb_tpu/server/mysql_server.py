@@ -399,6 +399,11 @@ def _make_ssl_context(cert: Optional[str],
 
 
 def _errno_for(e: Exception) -> int:
+    # typed errors carry their MySQL/TiDB error number (e.g. the
+    # admission scheduler's ServerBusyError = 9003, TiKV-server-is-busy)
+    code = getattr(e, "errno", None)
+    if isinstance(code, int) and 1000 <= code <= 65535:
+        return code
     name = type(e).__name__
     if "Duplicate" in name or "Duplicate entry" in str(e):
         return ER_DUP_ENTRY

@@ -110,6 +110,11 @@ class StatusServer:
         if path == "/metrics":
             from ..utils.metrics import global_registry
             return global_registry().prometheus_text(), "text/plain"
+        if path == "/sched":
+            # device admission scheduler: queue depth, per-group
+            # fair-share + RU accounting, coalesce/launch counters
+            return json.dumps(self.domain.client.sched_stats()), \
+                "application/json"
         if path == "/settings":
             # handler/settings analog: live global sysvars
             return json.dumps(dict(sorted(

@@ -361,10 +361,18 @@ class Session:
             # dispatch/chunk checkpoint via contextvar
             from ..copr.coordinator import KILL_EVENT, QUERY_HANDLE
             from ..planner.build import SESSION_INFO
+            from ..sched.task import SCHED_GROUP
             self._kill_event.clear()
             handle = self.domain.coordinator.begin(self.conn_id, text)
             ktok = KILL_EVENT.set(self._kill_event)
             htok = QUERY_HANDLE.set(handle)
+            # tag device cop tasks with the statement's resource group so
+            # the admission scheduler orders them weighted-fair
+            gname = self.vars.get("tidb_resource_group") or \
+                self.domain.sysvars.get("tidb_resource_group", "default")
+            grp = self.domain.resource_groups.get(gname)
+            gtok = SCHED_GROUP.set(
+                (gname, grp.sched_weight if grp is not None else 8.0))
             def _getvar(name, scope=""):
                 if scope == "global":
                     return self.domain.sysvars.get(name)
@@ -400,6 +408,7 @@ class Session:
                 TEMP_TABLES.reset(ttok)
                 SEQUENCE_RESOLVER.reset(qtok)
                 SESSION_INFO.reset(stok)
+                SCHED_GROUP.reset(gtok)
                 QUERY_HANDLE.reset(htok)
                 KILL_EVENT.reset(ktok)
                 self.domain.coordinator.end(self.conn_id)
@@ -410,7 +419,8 @@ class Session:
             self.domain.stmt_summary.record(
                 text, dt_ns, len(out.rows),
                 cpu_ns=time.thread_time_ns() - cpu0,
-                plan_text=self._last_plan_text)
+                plan_text=self._last_plan_text,
+                sched_wait_ns=handle.sched_wait_ns)
             try:
                 # runaway KILL must fire before the success audit hook:
                 # a killed statement is an error to the client
@@ -529,12 +539,14 @@ class Session:
                 if stmt.replace:      # ALTER: merge named options only
                     self.domain.resource_groups.alter(
                         stmt.name, stmt.ru_per_sec, stmt.burstable,
-                        stmt.exec_elapsed_sec, stmt.action)
+                        stmt.exec_elapsed_sec, stmt.action,
+                        priority=stmt.priority)
                 else:
                     self.domain.resource_groups.create(
                         stmt.name, stmt.ru_per_sec, stmt.burstable,
                         stmt.exec_elapsed_sec, stmt.action,
-                        if_not_exists=stmt.if_not_exists)
+                        if_not_exists=stmt.if_not_exists,
+                        priority=stmt.priority)
             except ValueError as e:
                 raise PlanError(str(e))
             return ResultSet()
@@ -1080,6 +1092,16 @@ class Session:
         rc = -1 if v1 is None or v1 == "" else int(v1)
         if rc >= 0:
             client._result_cache_cap = rc
+        # device admission scheduler knobs (sched/): 0 queue depth
+        # bypasses admission entirely
+        v2 = merged.get("tidb_tpu_sched_queue_depth")
+        qd = -1 if v2 is None or v2 == "" else int(v2)
+        if qd >= 0:
+            client.sched_queue_depth = qd
+        v3 = merged.get("tidb_tpu_sched_max_coalesce")
+        mc = -1 if v3 is None or v3 == "" else int(v3)
+        if mc > 0:
+            client.sched_max_coalesce = mc
         return ExecContext(client, merged,
                            mem_tracker=Tracker("query", quota))
 
@@ -2232,7 +2254,8 @@ class Session:
         if stmt.kind == "statements_summary":
             return ResultSet(
                 ["Digest_text", "Exec_count", "Avg_latency_ms",
-                 "Max_latency_ms", "Sum_rows", "Sample_sql"],
+                 "Max_latency_ms", "Sum_rows", "Sample_sql",
+                 "Avg_sched_wait_ms"],
                 self.domain.stmt_summary.summary_rows())
         if stmt.kind == "slow_queries":
             return ResultSet(["Query", "Latency_ms", "Rows"],

@@ -49,6 +49,13 @@ _VARS = [
        max=1 << 20),
     _v("tidb_tpu_result_cache_entries", -1, kind="int", min=-1,
        max=4096, scope=SCOPE_GLOBAL),
+    # device admission scheduler (sched/): bounded queue depth (0 =
+    # bypass admission, dispatch direct) and the max tasks one launch
+    # may coalesce
+    _v("tidb_tpu_sched_queue_depth", -1, kind="int", min=-1,
+       max=1 << 16, scope=SCOPE_GLOBAL),
+    _v("tidb_tpu_sched_max_coalesce", -1, kind="int", min=-1, max=64,
+       scope=SCOPE_GLOBAL),
     _v("tidb_distsql_scan_concurrency", 15, kind="int", min=1, max=256),
     _v("tidb_max_chunk_size", 1024, kind="int", min=32, max=65536),
     _v("tidb_enable_vectorized_expression", 1, kind="bool"),

@@ -564,6 +564,7 @@ class CreateResourceGroup(Node):
     burstable: Optional[bool] = None
     exec_elapsed_sec: Optional[float] = None
     action: Optional[str] = None
+    priority: Optional[str] = None  # low | medium | high (sched weight)
     if_not_exists: bool = False
     replace: bool = False          # ALTER form
 

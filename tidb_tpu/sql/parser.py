@@ -1313,6 +1313,15 @@ class Parser:
             elif opt == "BURSTABLE":
                 self.advance()
                 rg.burstable = True
+            elif opt == "PRIORITY":
+                self.advance()
+                self.expect_op("=")
+                tok = self.cur
+                pr = self.advance().text.lower()
+                if pr not in ("low", "medium", "high"):
+                    raise ParseError("PRIORITY must be LOW|MEDIUM|HIGH",
+                                     tok)
+                rg.priority = pr
             elif opt == "QUERY_LIMIT":
                 self.advance()
                 self.expect_op("=")

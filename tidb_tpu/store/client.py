@@ -101,6 +101,15 @@ class CopClient:
         self._rc_mu = threading.Lock()
         self.result_cache_hits = 0
         self.result_cache_misses = 0
+        # device admission scheduler (sched/): every launch onto the mesh
+        # goes through a bounded weighted-fair queue that coalesces
+        # concurrent compatible tasks.  -1 = scheduler defaults; queue
+        # depth 0 (or TIDB_TPU_SCHED_DISABLE=1) bypasses admission.
+        self.sched_enable = os.environ.get(
+            "TIDB_TPU_SCHED_DISABLE", "") != "1"
+        self.sched_queue_depth = -1
+        self.sched_max_coalesce = -1
+        self._sched_obj = None
 
     @property
     def mesh(self):
@@ -160,6 +169,73 @@ class CopClient:
                     if healed:
                         self.last_heals += 1
                 retries += 1
+
+    # ------------------------------------------------------------- #
+    # device launch seam: admission scheduler (sched/)
+    # ------------------------------------------------------------- #
+
+    def _scheduler(self):
+        """This mesh's admission scheduler; None = direct dispatch."""
+        if not self.sched_enable or self.sched_queue_depth == 0:
+            return None
+        s = self._sched_obj
+        if s is None:
+            from ..sched import scheduler_for
+            s = self._sched_obj = scheduler_for(self.mesh)
+        s.configure(
+            self.sched_queue_depth if self.sched_queue_depth > 0 else None,
+            self.sched_max_coalesce if self.sched_max_coalesce > 0
+            else None)
+        return s
+
+    def sched_stats(self) -> dict:
+        """Status-API introspection; never resolves a pending mesh."""
+        if self._sched_obj is None:
+            return {"enabled": self.sched_enable, "started": False}
+        return {"enabled": self.sched_enable, "started": True,
+                **self._sched_obj.stats()}
+
+    def _note_sched(self, task) -> None:
+        from ..copr.coordinator import QUERY_HANDLE
+        h = QUERY_HANDLE.get()
+        if h is not None:
+            h.note_sched(task.wait_ns, task.coalesced)
+
+    def _launch(self, dag, cols, counts, aux, row_capacity: int = 0):
+        """One device launch of a sharded cop program, routed through the
+        admission queue: the scheduler resolves the compiled program (so
+        concurrent identical tasks share ONE compile + launch) and may
+        coalesce this task with compatible ones from other sessions.
+        Returns (program, out)."""
+        sched = self._scheduler()
+        if sched is None:
+            prog = get_sharded_program(dag, self.mesh, row_capacity)
+            return prog, prog(cols, counts, aux)
+        from ..sched import CopTask
+        est = 0
+        if cols:
+            s, c = cols[0][0].shape[:2]
+            est = s * c
+        t = sched.submit(CopTask.structured(
+            dag, self.mesh, row_capacity, cols, counts, tuple(aux),
+            est_rows=est))
+        try:
+            return t.wait()
+        finally:
+            self._note_sched(t)
+
+    def _launch_opaque(self, fn, est_rows: int = 0):
+        """Admission-controlled launch of a program with a non-standard
+        signature (shuffle/window): fair-ordered, never coalesced."""
+        sched = self._scheduler()
+        if sched is None:
+            return fn()
+        from ..sched import CopTask
+        t = sched.submit(CopTask.opaque(fn, est_rows=est_rows))
+        try:
+            return t.wait()
+        finally:
+            self._note_sched(t)
 
     # ------------------------------------------------------------- #
 
@@ -232,8 +308,7 @@ class CopClient:
             return self._stream_dense_agg(agg, batches, key_meta)
         cols, counts = snap.device_cols(self.mesh)
         for _ in range(8):
-            prog = get_sharded_program(agg, self.mesh)
-            out = prog(cols, counts, aux_cols)
+            prog, out = self._launch(agg, cols, counts, tuple(aux_cols))
             if prog.has_extras:
                 out, extras = out
                 grown = self._grown_join_dag(agg, extras)
@@ -278,11 +353,11 @@ class CopClient:
         from ..copr.coordinator import check_killed
         outs = []
         nxt = batches[0].device_put_uncached(self.mesh)
-        prog = get_sharded_program(agg, self.mesh)
         for i in range(len(batches)):
             check_killed()   # cancellation between streamed HBM batches
             cols, counts = nxt
-            outs.append(prog(cols, counts, ()))
+            _prog, out = self._launch(agg, cols, counts, ())
+            outs.append(out)
             if i + 1 < len(batches):
                 nxt = batches[i + 1].device_put_uncached(self.mesh)
             del cols, counts     # free the batch once its program consumed it
@@ -302,8 +377,8 @@ class CopClient:
             cols, counts = b.device_put_uncached(self.mesh)
             for _ in range(10):
                 sized = dataclasses.replace(agg, group_capacity=cap)
-                prog = get_sharded_program(sized, self.mesh)
-                states = jax.device_get(prog(cols, counts, ()))
+                _prog, out = self._launch(sized, cols, counts, ())
+                states = jax.device_get(out)
                 true_ng = int(np.max(np.asarray(states["__ngroups__"])))
                 if true_ng <= cap:
                     break
@@ -365,8 +440,7 @@ class CopClient:
         cap = agg.group_capacity or DEFAULT_GROUP_CAPACITY
         for _ in range(10):
             sized = dataclasses.replace(agg, group_capacity=cap)
-            prog = get_sharded_program(sized, self.mesh)
-            out = prog(cols, counts, aux_cols)
+            prog, out = self._launch(sized, cols, counts, tuple(aux_cols))
             if prog.has_extras:
                 out, extras = out
                 grown = self._grown_join_dag(sized, extras)
@@ -420,7 +494,9 @@ class CopClient:
                 agg, group_capacity=DEFAULT_GROUP_CAPACITY))
         for _ in range(12):
             prog = get_shuffle_program(spec, self.mesh, caps)
-            out, extras = prog(lcols, lcounts, rcols, rcounts, aux_cols)
+            out, extras = self._launch_opaque(
+                lambda p=prog: p(lcols, lcounts, rcols, rcounts, aux_cols),
+                est_rows=lsnap.num_rows + rsnap.num_rows)
             extras = {k: np.asarray(jax.device_get(v))
                       for k, v in extras.items()}
             grew = False
@@ -477,7 +553,9 @@ class CopClient:
             max(2 * snap.num_rows // max(n_dev * n_dev, 1) + 1, 1024))
         for _ in range(10):
             prog = get_window_program(spec, self.mesh, cap)
-            (out_cols, out_counts), extras = prog(cols, counts, aux_cols)
+            (out_cols, out_counts), extras = self._launch_opaque(
+                lambda p=prog: p(cols, counts, aux_cols),
+                est_rows=snap.num_rows)
             need = int(np.max(np.asarray(jax.device_get(extras["wmax"]))))
             if need <= cap:
                 break
@@ -591,8 +669,8 @@ class CopClient:
         self.last_page_iters = 0
         for _ in range(10):  # paging: grow until fits
             self.last_page_iters += 1
-            prog = get_sharded_program(root, self.mesh, row_capacity=cap)
-            out = prog(cols, counts, aux_cols)
+            prog, out = self._launch(root, cols, counts, tuple(aux_cols),
+                                     row_capacity=cap)
             if prog.has_extras:
                 out, extras = out
                 grown = self._grown_join_dag(root, extras)

@@ -60,7 +60,15 @@ def _load_lib():
                 or os.path.getmtime(_LIB_PATH) < os.path.getmtime(src)):
             subprocess.run(["make", "-C", os.path.abspath(_NATIVE_DIR)],
                            check=True, capture_output=True)
-        lib = ctypes.CDLL(_LIB_PATH)
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            # ABI mismatch: the checked-in .so was built against a newer
+            # glibc than this host's — force a local rebuild and retry
+            subprocess.run(["make", "-B", "-C",
+                            os.path.abspath(_NATIVE_DIR)],
+                           check=True, capture_output=True)
+            lib = ctypes.CDLL(_LIB_PATH)
         lib.kv_open.restype = ctypes.c_void_p
         lib.kv_close.argtypes = [ctypes.c_void_p]
         lib.kv_alloc_ts.restype = ctypes.c_uint64

@@ -21,6 +21,10 @@ class OpStats:
     time_ns: int = 0        # inclusive wall time (children included)
     rows: int = 0
     loops: int = 0
+    # free-form execution detail an operator annotates itself with (e.g.
+    # CopTask's `schedWait: ...` — the cop-task execution-info analog of
+    # the reference's copr_cache/scan_detail strings)
+    detail: str = ""
 
     @property
     def time_ms(self) -> float:
@@ -48,12 +52,15 @@ def instrument_tree(root, coll: RuntimeStatsColl) -> None:
         coll.stats[op_id] = st
         orig = op.execute     # bound method (class-level)
 
-        def timed(ctx, _orig=orig, _st=st):
+        def timed(ctx, _orig=orig, _st=st, _op=op):
             t0 = time.perf_counter_ns()
             chunk = _orig(ctx)
             _st.time_ns += time.perf_counter_ns() - t0
             _st.loops += 1
             _st.rows += chunk.num_rows
+            d = getattr(_op, "_rt_detail", "")
+            if d:
+                _st.detail = d
             return chunk
 
         op.execute = timed
@@ -75,8 +82,10 @@ def explain_analyze_text(root, coll: RuntimeStatsColl) -> list[tuple]:
         else:
             # re-describe at RENDER time: execution may have annotated the
             # operator (cop-cache hit, runtime join strategy, ...)
-            out.append((pad + op.describe(), st.rows,
-                        f"{st.time_ms:.3f}ms", st.loops))
+            label = pad + op.describe()
+            if st.detail:
+                label += f" [{st.detail}]"
+            out.append((label, st.rows, f"{st.time_ms:.3f}ms", st.loops))
         for c in getattr(op, "children", []):
             visit(c, depth + 1)
 

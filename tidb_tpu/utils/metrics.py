@@ -37,6 +37,9 @@ class Gauge(Counter):
         with self._mu:
             self.values[key] = v
 
+    def dec(self, n: float = 1, **labels):
+        self.inc(-n, **labels)
+
 
 class Histogram:
     DEFAULT_BUCKETS = (0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5,

@@ -46,11 +46,15 @@ class _Pool:
 class PoolManager:
     """Process singleton owning every named worker pool."""
 
-    def __init__(self, cpu: Optional[int] = None):
+    def __init__(self, cpu: Optional[int] = None,
+                 retire_grace_s: float = 5.0):
         self.cpu = cpu or os.cpu_count() or 1
         self._pools: dict[str, _Pool] = {}
-        self._retired: list = []       # resized-away executors (kept alive)
+        self._retired: list = []       # resized-away executors (draining)
         self._mu = threading.Lock()
+        # how long a replaced executor stays submittable before its idle
+        # threads are released (covers submit() callers racing a resize)
+        self.retire_grace_s = retire_grace_s
 
     # ---------------- pool lifecycle ---------------- #
 
@@ -86,19 +90,33 @@ class PoolManager:
 
     def resize(self, name: str, workers: int) -> None:
         """Live resize (the reference's pool.Tune): swap in a new
-        executor.  The old one is RETAINED, not shut down — a concurrent
-        submit() that fetched it must not hit 'cannot schedule new
-        futures after shutdown'; its idle threads are the (small, rare)
-        price of a race-free swap."""
+        executor.  The old one stays submittable for a grace window — a
+        concurrent submit() that fetched it must not hit 'cannot
+        schedule new futures after shutdown' — then a reaper drains it
+        with shutdown(wait=False), which lets already-queued work finish
+        while releasing the idle worker threads (ADVICE r5: the previous
+        retain-forever policy leaked a full thread set per resize)."""
         workers = max(1, workers)
         with self._mu:
             p = self._pools.get(name)
             if p is None:
                 return
-            self._retired.append(p.executor)
+            old = p.executor
+            self._retired.append(old)
             p.executor = cf.ThreadPoolExecutor(
                 max_workers=workers, thread_name_prefix=f"pool-{name}")
             p.stats.workers = workers
+
+        def _reap(ex=old, grace=self.retire_grace_s):
+            time.sleep(grace)
+            ex.shutdown(wait=False)
+            with self._mu:
+                try:
+                    self._retired.remove(ex)
+                except ValueError:
+                    pass
+        threading.Thread(target=_reap, name=f"pool-reap-{name}",
+                         daemon=True).start()
 
     # ---------------- instrumented submission ---------------- #
 
@@ -141,7 +159,12 @@ class PoolManager:
                     p.stats.busy -= 1
                     p.stats.completed += 1
                     p.stats.total_run_s += time.monotonic() - t1
-        return ex.submit(run)
+        try:
+            return ex.submit(run)
+        except RuntimeError:
+            # raced a resize past the retire grace: the fetched executor
+            # was reaped; the swapped-in one accepts the work
+            return self._pools[name].executor.submit(run)
 
     # ---------------- introspection ---------------- #
 

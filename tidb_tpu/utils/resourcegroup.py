@@ -24,6 +24,12 @@ class RunawayError(RuntimeError):
     ACTION=KILL (runaway detector)."""
 
 
+# PRIORITY -> device-scheduler fair-share weight (stride scheduling in
+# sched/scheduler.py; the reference's resource-group PRIORITY feeds
+# tikv's unified read pool the same way)
+PRIORITY_WEIGHTS = {"low": 1.0, "medium": 8.0, "high": 16.0}
+
+
 @dataclass
 class ResourceGroup:
     name: str
@@ -31,6 +37,7 @@ class ResourceGroup:
     burstable: bool = False
     exec_elapsed_sec: float = 0.0  # 0 = no runaway watch
     runaway_action: str = "kill"   # kill | cooldown
+    priority: str = "medium"       # low | medium | high (sched weight)
     # token bucket state (guarded by _mu: the server is thread-per-
     # connection and every session in the group shares this bucket)
     tokens: float = 0.0
@@ -47,6 +54,10 @@ class ResourceGroup:
             cap *= 10
         self.tokens = min(self.tokens + dt * self.ru_per_sec, cap)
         self.last_refill = now
+
+    @property
+    def sched_weight(self) -> float:
+        return PRIORITY_WEIGHTS.get(self.priority, 8.0)
 
     def note_runaway(self) -> None:
         with self._mu:
@@ -88,22 +99,29 @@ class ResourceGroupManager:
                burstable: Optional[bool] = None,
                exec_elapsed_sec: Optional[float] = None,
                action: Optional[str] = None,
-               if_not_exists: bool = False) -> ResourceGroup:
+               if_not_exists: bool = False,
+               priority: Optional[str] = None) -> ResourceGroup:
+        if priority is not None and priority not in PRIORITY_WEIGHTS:
+            raise ValueError(f"bad PRIORITY {priority!r}")
         with self._lock:
             if name in self._groups:
                 if if_not_exists:
                     return self._groups[name]    # no-op, keep the group
                 raise ValueError(f"resource group {name!r} exists")
             g = ResourceGroup(name, ru_per_sec or 0, bool(burstable),
-                              exec_elapsed_sec or 0.0, action or "kill")
+                              exec_elapsed_sec or 0.0, action or "kill",
+                              priority or "medium")
             self._groups[name] = g
             return g
 
     def alter(self, name: str, ru_per_sec: Optional[int],
               burstable: Optional[bool], exec_elapsed_sec: Optional[float],
-              action: Optional[str]) -> ResourceGroup:
+              action: Optional[str],
+              priority: Optional[str] = None) -> ResourceGroup:
         """Merge only the options named in the statement; state
         (bucket/runaway counters) is preserved."""
+        if priority is not None and priority not in PRIORITY_WEIGHTS:
+            raise ValueError(f"bad PRIORITY {priority!r}")
         with self._lock:
             g = self._groups.get(name)
             if g is None:
@@ -116,6 +134,8 @@ class ResourceGroupManager:
                 g.exec_elapsed_sec = exec_elapsed_sec
             if action is not None:
                 g.runaway_action = action
+            if priority is not None:
+                g.priority = priority
             return g
 
     def drop(self, name: str, if_exists: bool = False) -> None:
@@ -137,7 +157,7 @@ class ResourceGroupManager:
             return [(g.name, g.ru_per_sec or None,
                      "YES" if g.burstable else "NO",
                      g.exec_elapsed_sec or None, g.runaway_action.upper(),
-                     g.runaway_count)
+                     g.runaway_count, g.priority.upper())
                     for g in self._groups.values()]
 
 
@@ -157,4 +177,4 @@ def charge_statement(group: ResourceGroup, rows_touched: int,
 
 
 __all__ = ["ResourceGroup", "ResourceGroupManager", "RunawayError",
-           "charge_statement"]
+           "charge_statement", "PRIORITY_WEIGHTS"]

@@ -87,10 +87,17 @@ class StmtStats:
     sum_cpu_ns: int = 0
     plan_digest: str = ""
     sample_plan: str = ""
+    # device-scheduler admission wait (sched/): how long this digest's
+    # cop tasks queued before launching
+    sum_sched_wait_ns: int = 0
 
     @property
     def avg_latency_ms(self) -> float:
         return self.sum_latency_ns / max(self.exec_count, 1) / 1e6
+
+    @property
+    def avg_sched_wait_ms(self) -> float:
+        return self.sum_sched_wait_ns / max(self.exec_count, 1) / 1e6
 
 
 @dataclass
@@ -112,7 +119,8 @@ class StmtSummary:
         self.max_slow = max_slow
 
     def record(self, sql: str, latency_ns: int, rows: int,
-               cpu_ns: int = 0, plan_text: str = ""):
+               cpu_ns: int = 0, plan_text: str = "",
+               sched_wait_ns: int = 0):
         digest = normalize_sql(sql)
         now = time.time()
         with self._lock:
@@ -126,6 +134,7 @@ class StmtSummary:
             st.sum_rows += rows
             st.last_seen = now
             st.sum_cpu_ns += int(cpu_ns)
+            st.sum_sched_wait_ns += int(sched_wait_ns)
             if plan_text:
                 import hashlib
                 st.plan_digest = hashlib.sha256(
@@ -140,7 +149,7 @@ class StmtSummary:
         with self._lock:
             return [(s.digest, s.exec_count, round(s.avg_latency_ms, 3),
                      round(s.max_latency_ns / 1e6, 3), s.sum_rows,
-                     s.sample_sql)
+                     s.sample_sql, round(s.avg_sched_wait_ms, 3))
                     for s in sorted(self._stats.values(),
                                     key=lambda x: -x.sum_latency_ns)]
 
