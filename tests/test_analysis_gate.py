@@ -1,0 +1,24 @@
+"""The CI gate itself: ``python -m tidb_tpu.analysis`` must exit 0 on
+the tree (zero NEW lint findings, all TPC-H corpus plans contract-clean).
+Run as a subprocess exactly the way CI and the verify recipe invoke it,
+so the tier-1 flow carries the gate."""
+
+import os
+import subprocess
+import sys
+
+import tidb_tpu
+
+
+def test_analysis_gate_exits_zero():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(
+        tidb_tpu.__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("TIDB_TPU_VERIFY_PLAN", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "tidb_tpu.analysis"],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "analysis gate: ok" in proc.stdout, proc.stdout
+    assert "0 violations" in proc.stdout, proc.stdout

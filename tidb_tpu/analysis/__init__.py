@@ -1,0 +1,30 @@
+"""Static analysis gate: plan-contract verifier + TPU-hygiene linter.
+
+Two passes, both wired into CI as a zero-findings gate
+(``python -m tidb_tpu.analysis``):
+
+- contracts: every physical operator declares a contract (output dtypes,
+  row-capacity shape, sharding, traceable-dense vs host locality); the
+  verifier walks built plans edge-by-edge and rejects inconsistent ones
+  with a structured PlanContractError BEFORE any jit/trace happens.
+  Hooked into the session plan path, the sched admission path
+  (verify_task), and EXPLAIN (verified plans report ``contract: ok``).
+- lint: an AST linter over tidb_tpu/ with repo-specific TPU-hygiene
+  rules (tracer leaks, digest instability, host transfers in hot paths,
+  broad exception handlers, lock-order hazards).  Pre-existing accepted
+  findings live in analysis/baseline.txt; anything new fails the gate.
+
+The motivation is the compiler-first failure mode: with XLA-compiled cop
+programs a bad plan no longer fails with a type error at build time — it
+fails deep inside tracing (shape mismatch, silent dtype promotion,
+surprise recompile) or returns wrong rows.  Compiler-first engines
+(Flare, LAQP) verify a typed IR before codegen; this package is that
+gate between planner/build and jit.
+"""
+
+from .contracts import (PlanContractError, verify_dag, verify_plan,
+                        verify_task)
+from .lint import Finding, lint_source, lint_tree, load_baseline
+
+__all__ = ["PlanContractError", "verify_plan", "verify_dag", "verify_task",
+           "Finding", "lint_tree", "lint_source", "load_baseline"]

@@ -1,0 +1,100 @@
+"""CI gate: ``python -m tidb_tpu.analysis``.
+
+Runs both static passes and exits non-zero on any NEW finding:
+
+1. TPU-hygiene lint over the whole tidb_tpu/ tree, diffed against the
+   accepted-findings allowlist (analysis/baseline.txt) — pre-existing
+   accepted findings pass, new ones fail.
+2. Plan-contract verification over the TPC-H plan corpus
+   (testing/tpch.TPCH_PLAN_QUERIES): every statement is planned (never
+   executed — no trace, no compile, no device) and walked by
+   analysis.verify_plan; any PlanContractError fails the gate.
+
+Flags:
+    --lint-only / --contracts-only   run one pass
+    --update-baseline                rewrite baseline.txt from the
+                                     current findings (reviewed use only)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# plan building never needs a device, but imports touch jax; pin the CPU
+# backend so the gate runs identically on dev boxes, CI, and TPU hosts
+# (and never blocks on TPU acquisition)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def _run_lint(update_baseline: bool) -> int:
+    from .lint import lint_tree, load_baseline, new_findings
+    findings = lint_tree()
+    base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "baseline.txt")
+    if update_baseline:
+        keys = sorted({f.key() for f in findings})
+        with open(base_path, "w", encoding="utf-8") as f:
+            f.write("# planlint accepted findings (RULE path::symbol); "
+                    "regenerate with\n# python -m tidb_tpu.analysis "
+                    "--update-baseline, review the diff.\n")
+            for k in keys:
+                f.write(k + "\n")
+        print(f"planlint: baseline rewritten with {len(keys)} keys")
+        return 0
+    baseline = load_baseline(base_path)
+    fresh = new_findings(findings, baseline)
+    for f in fresh:
+        print(f"NEW {f}")
+    stale = baseline - {f.key() for f in findings}
+    if stale:
+        print(f"planlint: note: {len(stale)} baseline entries no longer "
+              "fire (safe to prune)")
+    print(f"planlint: {len(findings)} findings "
+          f"({len(findings) - len(fresh)} baselined, {len(fresh)} new)")
+    return 1 if fresh else 0
+
+
+def _run_contracts() -> int:
+    from ..testing.tpch import (TPCH_PLAN_QUERIES, TPCH_SHUFFLE_QUERIES,
+                                built_tpch_plans, tpch_plan_session)
+    from .contracts import PlanContractError, verify_plan
+    session = tpch_plan_session()
+    total = len(TPCH_PLAN_QUERIES) + len(TPCH_SHUFFLE_QUERIES)
+    bad = 0
+    checked_ops = 0
+    n = 0
+    for sql, phys in built_tpch_plans(session):
+        n += 1
+        try:
+            checked_ops += verify_plan(phys)
+        except PlanContractError as e:
+            bad += 1
+            one_line = " ".join(sql.split())
+            print(f"CONTRACT {one_line[:72]}...\n  {e}")
+    print(f"plan contracts: {n}/{total} corpus plans verified, "
+          f"{checked_ops} operators checked, {bad} violations")
+    return 1 if bad else 0
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    lint_only = "--lint-only" in argv
+    contracts_only = "--contracts-only" in argv
+    update = "--update-baseline" in argv
+    rc = 0
+    if not contracts_only:
+        rc |= _run_lint(update)
+    if not lint_only and not update:
+        rc |= _run_contracts()
+    if rc == 0:
+        print("analysis gate: ok")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

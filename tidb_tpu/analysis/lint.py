@@ -1,0 +1,488 @@
+"""TPU-hygiene linter: AST rules for the failure modes a compiled
+coprocessor engine actually hits.
+
+General-purpose linters don't know that `int(x)` inside a traced device
+function forces a host sync (ConcretizationTypeError at best, a silent
+recompile-per-value at worst), that `id(...)` inside a cache-key builder
+makes program dedup keys die with the process, or that the admission
+scheduler's drain loop must never invert the lock order the pool manager
+uses.  These rules do; they are scoped to the modules where each hazard
+is real, and every pre-existing accepted finding lives in
+analysis/baseline.txt so only NEW findings fail the gate.
+
+Rules
+-----
+- TPU-TRACE-LEAK   float()/int()/bool()/np.asarray() on non-literal
+                   values inside modules whose code is traced wholesale
+                   into device programs (copr/exec, copr/join,
+                   parallel/spmd|shuffle|window|exchange).  These force
+                   tracer concretization / host round-trips.
+- TPU-DIGEST       id(...) or unordered dict iteration inside a digest
+                   context (a function or assignment target whose name
+                   contains key/digest/token/fingerprint/signature):
+                   process-local or order-unstable values poison
+                   program/task cache keys across mesh rebuilds.
+- TPU-HOST-SYNC    jax.device_get(...) / .item() in hot-path modules
+                   (traced modules + sched/): a host sync inside the
+                   admission/launch path serializes the device pipeline.
+- TPU-BROAD-EXCEPT bare `except:` or `except Exception/BaseException:`
+                   whose handler does not re-raise: swallows real codec/
+                   arith/driver errors.  Waived by `# noqa: BLE001` with
+                   a justification or a `planlint: ok` comment.
+- TPU-LOCK-ORDER   across sched/scheduler.py, utils/poolmgr.py,
+                   utils/rwlock.py, store/client.py: nested acquisition
+                   of the same non-reentrant lock (self-deadlock, incl.
+                   Condition(lock) aliasing) and inverted acquisition
+                   order between two locks observed in the same class.
+
+Inline waiver: any rule is suppressed by a `# planlint: ok` comment on
+the offending line (give a reason after it).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+from typing import Iterable, Optional
+
+# modules (tidb_tpu-relative, /-separated) whose function bodies are
+# traced into device programs wholesale — concretization calls there are
+# tracer leaks.  expr/compile.py is deliberately NOT listed: it is the
+# dual-backend (np|jnp) evaluator and its host-object op implementations
+# legitimately concretize when xp is numpy.
+TRACED_MODULES = {
+    "copr/exec.py", "copr/join.py",
+    "parallel/spmd.py", "parallel/shuffle.py", "parallel/window.py",
+    "parallel/exchange.py",
+}
+
+# hot-path modules where a host sync stalls the launch pipeline
+HOT_PATH_MODULES = TRACED_MODULES | {
+    "sched/scheduler.py", "sched/task.py",
+}
+
+# modules participating in the cross-layer lock-order contract
+LOCK_MODULES = {
+    "sched/scheduler.py", "utils/poolmgr.py", "utils/rwlock.py",
+    "store/client.py",
+}
+
+_DIGEST_NAME = re.compile(r"key|digest|token|fingerprint|signature",
+                          re.IGNORECASE)
+_WAIVER = re.compile(r"planlint:\s*ok")
+_BLE_WAIVER = re.compile(r"noqa:.*BLE001|planlint:\s*ok")
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str        # tidb_tpu-relative, /-separated
+    line: int
+    symbol: str      # enclosing Class.function qualname ('' = module)
+    message: str
+
+    def key(self) -> str:
+        """Baseline identity: rule + file + enclosing symbol.  Line
+        numbers are deliberately excluded so accepted findings survive
+        unrelated edits to the same file."""
+        return f"{self.rule} {self.path}::{self.symbol}"
+
+    def __str__(self) -> str:
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{self.path}:{self.line}: {self.rule}{sym} {self.message}"
+
+
+# --------------------------------------------------------------------- #
+# helpers
+# --------------------------------------------------------------------- #
+
+def _is_np_attr(node: ast.AST, names: Iterable[str]) -> Optional[str]:
+    """node is np.<name> / numpy.<name> for name in names -> name."""
+    if (isinstance(node, ast.Attribute) and node.attr in names
+            and isinstance(node.value, ast.Name)
+            and node.value.id in ("np", "numpy")):
+        return node.attr
+    return None
+
+
+def _call_name(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def _names_in(node: ast.AST) -> set:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+class _Scoped(ast.NodeVisitor):
+    """Visitor tracking the enclosing Class.function qualname and the
+    per-line waiver set."""
+
+    def __init__(self, rel: str, lines: list):
+        self.rel = rel
+        self.lines = lines
+        self.scope: list = []
+        self.findings: list = []
+
+    def symbol(self) -> str:
+        return ".".join(self.scope)
+
+    def waived(self, lineno: int, pat=_WAIVER) -> bool:
+        if 1 <= lineno <= len(self.lines):
+            return bool(pat.search(self.lines[lineno - 1]))
+        return False
+
+    def add(self, rule: str, node: ast.AST, msg: str,
+            pat=_WAIVER) -> None:
+        if not self.waived(node.lineno, pat):
+            self.findings.append(
+                Finding(rule, self.rel, node.lineno, self.symbol(), msg))
+
+    def visit_ClassDef(self, node):
+        self.scope.append(node.name)
+        self.generic_visit(node)
+        self.scope.pop()
+
+    def visit_FunctionDef(self, node):
+        self.scope.append(node.name)
+        self.generic_visit(node)
+        self.scope.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+# --------------------------------------------------------------------- #
+# rules 1-4: expression-level
+# --------------------------------------------------------------------- #
+
+class _ExprRules(_Scoped):
+    def __init__(self, rel, lines):
+        super().__init__(rel, lines)
+        self.traced = rel in TRACED_MODULES
+        self.hot = rel in HOT_PATH_MODULES
+        self._digest_fn = 0     # depth of digest-context functions
+        self._sorted_ok: set = set()   # dict-iter calls under sorted()
+
+    def visit_FunctionDef(self, node):
+        # plain collection accessors named `keys`/`values`/`items` are
+        # not digest builders even though the substring matches
+        bump = bool(_DIGEST_NAME.search(node.name)
+                    and node.name not in ("keys", "values", "items"))
+        self._digest_fn += bump
+        super().visit_FunctionDef(node)
+        self._digest_fn -= bump
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    # -- digest contexts also arise from `key = (...)` assignments ---- #
+    def visit_Assign(self, node):
+        if self._digest_fn == 0 and any(
+                isinstance(t, ast.Name) and _DIGEST_NAME.search(t.id)
+                for t in node.targets):
+            self._scan_digest_value(node.value)
+        self.generic_visit(node)
+
+    def _note_sorted(self, node: ast.Call) -> None:
+        """sorted(d.items()) neutralizes iteration order — remember the
+        wrapped call so the digest rule skips it."""
+        if _call_name(node) == "sorted" and isinstance(node.func, ast.Name):
+            for a in node.args:
+                if isinstance(a, ast.Call):
+                    self._sorted_ok.add(id(a))
+
+    def _scan_digest_value(self, value: ast.AST) -> None:
+        for sub in ast.walk(value):
+            if isinstance(sub, ast.Call):
+                self._note_sorted(sub)
+        for sub in ast.walk(value):
+            if isinstance(sub, ast.Call):
+                self._check_digest_call(sub)
+
+    def _check_digest_call(self, node: ast.Call) -> None:
+        name = _call_name(node)
+        if isinstance(node.func, ast.Name) and name == "id":
+            self.add("TPU-DIGEST", node,
+                     "id(...) feeds a cache key/digest: process-local "
+                     "identity does not survive object rebuilds — use a "
+                     "stable fingerprint of the value instead")
+        elif (isinstance(node.func, ast.Attribute)
+              and name in ("items", "keys", "values") and not node.args
+              # AST-node memo, not key material  # planlint: ok
+              and id(node) not in self._sorted_ok):
+            self.add("TPU-DIGEST", node,
+                     f".{name}() iteration feeds a digest: wrap in "
+                     "sorted(...) so insertion order cannot change the key")
+
+    def visit_Call(self, node):
+        name = _call_name(node)
+        self._note_sorted(node)    # parents visit before children
+        # TPU-TRACE-LEAK: concretization in traced modules
+        if self.traced:
+            if (isinstance(node.func, ast.Name)
+                    and name in ("int", "float", "bool") and node.args
+                    and not isinstance(node.args[0], ast.Constant)):
+                self.add("TPU-TRACE-LEAK", node,
+                         f"{name}(...) on a non-literal inside a traced "
+                         "module concretizes the tracer (host sync / "
+                         "ConcretizationTypeError); keep values as jnp "
+                         "arrays or hoist to program-build time")
+            if _is_np_attr(node.func, ("asarray", "array")):
+                self.add("TPU-TRACE-LEAK", node,
+                         "np.asarray/np.array on a traced value pulls it "
+                         "to host; use jnp inside device functions")
+        # TPU-HOST-SYNC
+        if self.hot:
+            if name == "device_get" and isinstance(node.func,
+                                                   ast.Attribute):
+                self.add("TPU-HOST-SYNC", node,
+                         "jax.device_get in a hot launch path blocks on "
+                         "the device; move the sync to the result seam")
+            elif (name == "item" and isinstance(node.func, ast.Attribute)
+                  and not node.args):
+                self.add("TPU-HOST-SYNC", node,
+                         ".item() forces a device->host transfer in a "
+                         "hot path")
+        # TPU-DIGEST inside digest-named functions
+        if self._digest_fn > 0:
+            self._check_digest_call(node)
+        self.generic_visit(node)
+
+    def visit_ExceptHandler(self, node):
+        broad = node.type is None
+        if isinstance(node.type, ast.Name):
+            broad = node.type.id in ("Exception", "BaseException")
+        elif isinstance(node.type, ast.Tuple):
+            broad = any(isinstance(e, ast.Name)
+                        and e.id in ("Exception", "BaseException")
+                        for e in node.type.elts)
+        if broad and not self._reraises(node):
+            what = "bare except" if node.type is None else \
+                f"except {ast.unparse(node.type)}"
+            self.add("TPU-BROAD-EXCEPT", node,
+                     f"{what} without re-raise swallows unexpected "
+                     "errors (driver faults, codec bugs); catch the "
+                     "specific exceptions and re-raise the rest",
+                     pat=_BLE_WAIVER)
+        self.generic_visit(node)
+
+    @staticmethod
+    def _reraises(handler: ast.ExceptHandler) -> bool:
+        """Handler re-raises (bare `raise`, or raises a new error built
+        from the caught one) somewhere in its body."""
+        for sub in ast.walk(handler):
+            if isinstance(sub, ast.Raise):
+                return True
+        return False
+
+
+# --------------------------------------------------------------------- #
+# rule 5: lock acquisition order
+# --------------------------------------------------------------------- #
+
+class _LockRules(_Scoped):
+    """Per-class lock-order analysis.
+
+    Collects lock attributes (threading.Lock/RLock/Condition assigned to
+    self._x in any method), resolves Condition(self._y) aliasing, then
+    walks each function recording `with self._x:` nesting — directly and
+    one call level deep within the class (with self._a: self.meth() where
+    meth acquires self._b counts as a->b).  Findings: nested acquisition
+    of one underlying non-reentrant lock, and any (a,b) order observed
+    together with (b,a)."""
+
+    def __init__(self, rel, lines, tree):
+        super().__init__(rel, lines)
+        self.tree = tree
+
+    def run(self) -> list:
+        for cls in [n for n in ast.walk(self.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            self._check_class(cls)
+        return self.findings
+
+    def _check_class(self, cls: ast.ClassDef) -> None:
+        locks: dict = {}     # attr -> canonical (aliased) attr
+        reentrant: set = set()
+        for sub in ast.walk(cls):
+            if not (isinstance(sub, ast.Assign)
+                    and isinstance(sub.value, ast.Call)):
+                continue
+            kind = _call_name(sub.value)
+            if kind not in ("Lock", "RLock", "Condition"):
+                continue
+            for t in sub.targets:
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    canon = t.attr
+                    if kind == "Condition" and sub.value.args:
+                        a0 = sub.value.args[0]
+                        if (isinstance(a0, ast.Attribute)
+                                and isinstance(a0.value, ast.Name)
+                                and a0.value.id == "self"):
+                            canon = a0.attr   # Condition wraps that lock
+                    locks[t.attr] = canon
+                    if kind == "RLock":
+                        reentrant.add(canon)
+        if not locks:
+            return
+        # per-method: ordered list of (outer-lock-stack, acquired lock)
+        per_method: dict = {}
+        for fn in [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]:
+            per_method[fn.name] = self._acquisitions(fn, locks)
+        edges: dict = {}     # (a, b) -> lineno of first observation
+        for fname, acqs in per_method.items():
+            for held, lock, node in acqs:
+                for h in held:
+                    if h == lock and h not in reentrant:
+                        self.add(
+                            "TPU-LOCK-ORDER", node,
+                            f"{cls.name}.{fname} re-acquires "
+                            f"self.{lock} while already holding it "
+                            "(non-reentrant: self-deadlock)")
+                    elif h != lock:
+                        edges.setdefault((h, lock), node)
+                # one call level deep: self.meth() under a held lock
+                for sub in ast.walk(node):
+                    if (isinstance(sub, ast.Call)
+                            and isinstance(sub.func, ast.Attribute)
+                            and isinstance(sub.func.value, ast.Name)
+                            and sub.func.value.id == "self"
+                            and sub.func.attr in per_method):
+                        for _h2, l2, _n2 in per_method[sub.func.attr]:
+                            if lock == l2 and l2 not in reentrant:
+                                self.add(
+                                    "TPU-LOCK-ORDER", sub,
+                                    f"{cls.name}.{fname} holds "
+                                    f"self.{lock} and calls "
+                                    f"self.{sub.func.attr}() which "
+                                    "re-acquires it (self-deadlock)")
+                            elif lock != l2:
+                                edges.setdefault((lock, l2), sub)
+        for (a, b), node in edges.items():
+            if (b, a) in edges and a < b:    # report each cycle once
+                self.add("TPU-LOCK-ORDER", node,
+                         f"{cls.name} acquires self.{a} before self.{b} "
+                         f"here but self.{b} before self.{a} at line "
+                         f"{edges[(b, a)].lineno}: lock-order inversion")
+
+    def _acquisitions(self, fn, locks) -> list:
+        """All lock acquisitions in fn as (held-before, lock, with-node),
+        via the with-statement nesting structure."""
+        out: list = []
+
+        def lock_of(item) -> Optional[str]:
+            e = item.context_expr
+            if isinstance(e, ast.Call):       # .acquire() is not a ctx mgr
+                return None
+            if (isinstance(e, ast.Attribute)
+                    and isinstance(e.value, ast.Name)
+                    and e.value.id == "self" and e.attr in locks):
+                return locks[e.attr]
+            return None
+
+        def walk(stmts, held):
+            for node in stmts:
+                if isinstance(node, ast.With):
+                    acquired = []
+                    for item in node.items:
+                        lk = lock_of(item)
+                        if lk is not None:
+                            out.append((tuple(held + acquired), lk, node))
+                            acquired.append(lk)
+                    walk(node.body, held + acquired)
+                    continue
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    continue                 # nested defs run elsewhere
+                for field in ("body", "orelse", "finalbody"):
+                    sub = getattr(node, field, None)
+                    if isinstance(sub, list):
+                        walk(sub, held)
+                for h in getattr(node, "handlers", None) or []:
+                    walk(h.body, held)
+
+        walk(fn.body, [])
+        return out
+
+
+# --------------------------------------------------------------------- #
+# entry points
+# --------------------------------------------------------------------- #
+
+def lint_source(src: str, rel: str) -> list:
+    """Lint one module's source; `rel` is its tidb_tpu-relative path
+    (/-separated) — rules scope on it."""
+    tree = ast.parse(src)
+    lines = src.splitlines()
+    v = _ExprRules(rel, lines)
+    v.visit(tree)
+    findings = v.findings
+    if rel in LOCK_MODULES:
+        findings += _LockRules(rel, lines, tree).run()
+    # collapse repeats on one line (e.g. three id() calls in one tuple)
+    seen, out = set(), []
+    for f in findings:
+        k = (f.rule, f.path, f.line)
+        if k not in seen:
+            seen.add(k)
+            out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out
+
+
+def lint_tree(root: Optional[str] = None) -> list:
+    """Lint every .py file under the tidb_tpu package."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    findings: list = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d not in ("__pycache__", "native"))
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, fname)
+            rel = os.path.relpath(full, root).replace(os.sep, "/")
+            with open(full, encoding="utf-8") as f:
+                try:
+                    findings += lint_source(f.read(), rel)
+                except SyntaxError as e:
+                    findings.append(Finding(
+                        "TPU-SYNTAX", rel, e.lineno or 0, "",
+                        f"file does not parse: {e.msg}"))
+    return findings
+
+
+def load_baseline(path: Optional[str] = None) -> set:
+    """Accepted-findings allowlist: one `RULE path::symbol` key per line
+    (comments with #).  Pre-existing findings listed here pass the gate;
+    new ones fail it."""
+    if path is None:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "baseline.txt")
+    keys = set()
+    if os.path.exists(path):
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.split("#", 1)[0].strip()
+                if line:
+                    keys.add(line)
+    return keys
+
+
+def new_findings(findings: list, baseline: set) -> list:
+    return [f for f in findings if f.key() not in baseline]
+
+
+__all__ = ["Finding", "lint_source", "lint_tree", "load_baseline",
+           "new_findings", "TRACED_MODULES", "HOT_PATH_MODULES",
+           "LOCK_MODULES"]

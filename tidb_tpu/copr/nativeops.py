@@ -57,7 +57,11 @@ def _load() -> Optional[ctypes.CDLL]:
             lib.hops_gather_i32.argtypes = [I32P, I64, I64, I32P, I64P]
             lib.hops_gather_i64.argtypes = [I64P, I64, I64, I32P, I64P]
             _lib = lib
-        except Exception:
+        except (OSError, subprocess.CalledProcessError, AttributeError):
+            # only the expected degradations fall back to numpy: no
+            # toolchain / failed build (CalledProcessError), unloadable
+            # .so (OSError), stale library missing a symbol
+            # (AttributeError).  Anything else is a real bug and raises.
             _lib = None
         return _lib
 

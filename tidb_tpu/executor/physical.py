@@ -270,6 +270,24 @@ class PhysOp:
     out_names: list[str]
     out_dtypes: list[dt.DataType]
 
+    # contract declaration (analysis/contracts verifier input): host ops
+    # run over numpy chunks; Cop* ops override with "device" — their DAG
+    # must be traceable-dense (static shapes, no host objects)
+    locality = "host"
+    sharding = ""          # device ops: "shard" (stacked columns) etc.
+
+    def contract(self) -> dict:
+        """Declared operator contract: output schema + locality +
+        sharding, checked edge-by-edge by analysis.verify_plan BEFORE
+        tracing.  Plain dict so the executor layer stays import-light."""
+        return {
+            "op": type(self).__name__,
+            "out_names": tuple(getattr(self, "out_names", ()) or ()),
+            "out_dtypes": tuple(getattr(self, "out_dtypes", ()) or ()),
+            "locality": self.locality,
+            "sharding": self.sharding,
+        }
+
     def execute(self, ctx: ExecContext) -> ResultChunk:
         if type(self).chunks is PhysOp.chunks:
             raise NotImplementedError(type(self).__name__)
@@ -300,6 +318,8 @@ class PhysOp:
 class CopTaskExec(PhysOp):
     """Fan one fused DAG out over the table's shards (TableReader analog,
     executor/table_reader.go + distsql fan-out collapsed into SPMD)."""
+    locality = "device"
+    sharding = "shard"
     dag: D.CopNode
     table: Any
     out_names: list[str] = field(default_factory=list)
@@ -407,6 +427,8 @@ class CopJoinTaskExec(PhysOp):
     rewritten to the expanding multi-match strategy (copr/join.py) and the
     m:n join still runs on device; the host fallback remains only for the
     empty-build edge."""
+    locality = "device"
+    sharding = "shard+replicated-build"
     dag: Any
     table: Any                     # probe-side TableInfo
     build_exec: PhysOp = None
@@ -572,6 +594,8 @@ class CopShuffleJoinExec(PhysOp):
     HashPartition-exchange join analog
     (physicalop/physical_exchange_sender.go:109, executor/shuffle.go:86):
     chosen when the build side is too big to broadcast."""
+    locality = "device"
+    sharding = "all_to_all"
     spec: Any                      # D.ShuffleJoinSpec
     left_table: Any
     right_table: Any
@@ -2128,6 +2152,8 @@ class CopWindowExec(PhysOp):
     hash-repartition by PARTITION BY over the mesh, each device sorts its
     partitions once and computes every window item with segment ops —
     one fused shard_map program (parallel/window.py)."""
+    locality = "device"
+    sharding = "all_to_all"
     spec: Any                      # D.WindowShuffleSpec
     table: Any
     out_names: list = field(default_factory=list)

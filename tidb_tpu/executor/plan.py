@@ -1255,8 +1255,8 @@ def _ndv_capacity(agg, ds) -> int:
             return 0
         try:
             name = ds.schema.cols[g.index].name.lower()
-        except Exception:
-            return 0
+        except (IndexError, AttributeError):
+            return 0     # pruned/derived column: no stats to consult
         cs = st.col(name)
         if cs is None or not getattr(cs, "ndv", 0):
             return 0

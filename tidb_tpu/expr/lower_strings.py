@@ -488,7 +488,7 @@ def _str_valued_impl(op: str, consts: list):
             try:
                 return base64.b64decode(v, validate=True).decode(
                     "utf-8", errors="replace")
-            except Exception:
+            except ValueError:       # binascii.Error: invalid codec input
                 return None          # MySQL: invalid input -> NULL
         return _fb64
     if op == "unhex":

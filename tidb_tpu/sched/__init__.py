@@ -4,8 +4,10 @@ analog).  See scheduler.py for the design."""
 
 from .scheduler import (DEFAULT_MAX_COALESCE, DEFAULT_QUEUE_DEPTH,
                         DeviceScheduler, scheduler_for)
-from .task import SCHED_GROUP, CopTask, ServerBusyError, current_group
+from .task import (SCHED_GROUP, CopTask, ServerBusyError, current_group,
+                   mesh_fingerprint)
 
 __all__ = ["DeviceScheduler", "scheduler_for", "CopTask",
            "ServerBusyError", "SCHED_GROUP", "current_group",
-           "DEFAULT_QUEUE_DEPTH", "DEFAULT_MAX_COALESCE"]
+           "DEFAULT_QUEUE_DEPTH", "DEFAULT_MAX_COALESCE",
+           "mesh_fingerprint"]

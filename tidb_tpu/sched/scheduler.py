@@ -28,6 +28,7 @@ period, so embedders that never touch the device pay nothing.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import deque
@@ -38,6 +39,12 @@ from .task import CopTask, ServerBusyError
 DEFAULT_QUEUE_DEPTH = 256
 DEFAULT_MAX_COALESCE = 8
 IDLE_EXIT_S = 5.0
+
+
+def _verify_enabled() -> bool:
+    """Admission-time plan-contract verification (analysis/contracts):
+    on by default, TIDB_TPU_VERIFY_PLAN=0 disables (bisecting aid)."""
+    return os.environ.get("TIDB_TPU_VERIFY_PLAN", "") != "0"
 
 
 class _GroupQ:
@@ -110,7 +117,14 @@ class DeviceScheduler:
 
     def submit(self, task: CopTask) -> CopTask:
         """Enqueue; raises ServerBusyError when the bounded queue is
-        full (backpressure instead of unbounded buffering)."""
+        full (backpressure instead of unbounded buffering).  Structured
+        tasks are contract-verified on admission — a malformed task
+        (capacity-shape drift, stale mesh key, invalid DAG) is rejected
+        with PlanContractError HERE, in the submitting thread, before
+        the drain loop would trace/compile anything."""
+        if task.key is not None and _verify_enabled():
+            from ..analysis.contracts import verify_task
+            verify_task(task)
         with self._cv:
             if self._depth >= self.max_depth:
                 self.busy_rejects += 1
@@ -271,8 +285,9 @@ class DeviceScheduler:
                 self._m_launch.inc(mode="batched")
                 self._note_coalesce(batch)
                 return
-            except Exception:
-                pass        # op not vmappable on this backend: launch apart
+            except Exception:   # planlint: ok - vmap capability probe;
+                pass        # op not vmappable on this backend: launch
+                            # apart below (same results, no batching win)
         for s in slots:
             out = prog(s[0].cols, s[0].counts, s[0].aux)
             for t in s:

@@ -67,6 +67,26 @@ def _shape_sig(cols, counts) -> tuple:
                          else ())
 
 
+# id(mesh) -> fingerprint memo: id() here is only a transient cache slot
+# for a live object we hold a reference to, never part of the key itself
+_FP_CACHE: dict = {}
+
+
+def mesh_fingerprint(mesh) -> tuple:
+    """Stable identity of a device mesh: axis names, axis shape, and the
+    global device ids.  Two Mesh objects over the same chips fingerprint
+    identically, so task dedup/coalescing keys survive mesh rebuilds
+    (a Domain re-creating its mesh after reconfig) — id(mesh) does not."""
+    fp = _FP_CACHE.get(id(mesh))          # planlint: ok - memo slot only
+    if fp is None:
+        fp = (tuple(mesh.axis_names), tuple(mesh.devices.shape),
+              tuple(int(d.id) for d in mesh.devices.reshape(-1)))
+        if len(_FP_CACHE) > 16:           # meshes are few; stay tiny
+            _FP_CACHE.clear()
+        _FP_CACHE[id(mesh)] = fp          # planlint: ok - memo slot only
+    return fp
+
+
 class CopTask:
     """One queued device launch; resolved to (program, out) on wait()."""
 
@@ -111,12 +131,14 @@ class CopTask:
     def structured(cls, dag, mesh, row_capacity, cols, counts, aux,
                    est_rows: int = 0) -> "CopTask":
         from ..copr.dag import dag_digest
-        key = (dag_digest(dag), id(mesh), int(row_capacity),
+        key = (dag_digest(dag), mesh_fingerprint(mesh), int(row_capacity),
                _shape_sig(cols, counts))
         # input identity for in-flight dedup: the snapshot's resident
         # device cache returns the SAME array objects per epoch, so two
-        # sessions over one snapshot share ids; the task pins the refs
-        token = (id(cols), id(counts), id(aux))
+        # sessions over one snapshot share ids; the task pins the refs.
+        # Identity is the POINT here (same buffers = one launch serves
+        # both), so id() is correct, unlike in the persistent key above.
+        token = (id(cols), id(counts), id(aux))    # planlint: ok - see above
         return cls(key=key, dag=dag, mesh=mesh, row_capacity=row_capacity,
                    cols=cols, counts=counts, aux=aux, input_token=token,
                    est_rows=est_rows)
@@ -156,4 +178,4 @@ class CopTask:
 
 
 __all__ = ["CopTask", "ServerBusyError", "SCHED_GROUP", "current_group",
-           "DEFAULT_GROUP", "DEFAULT_WEIGHT"]
+           "DEFAULT_GROUP", "DEFAULT_WEIGHT", "mesh_fingerprint"]

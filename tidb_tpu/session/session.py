@@ -1006,6 +1006,15 @@ class Session:
             self._last_plan_text = phys.explain()
         except Exception:
             pass
+        # static plan-contract gate (analysis/contracts): reject a plan
+        # whose operator contracts disagree BEFORE any trace/compile —
+        # the typed-IR verification seam of compiler-first engines.
+        # PlanContractError is a PlanError, so it surfaces like any
+        # planner rejection.  tidb_tpu_verify_plan=0 opts out.
+        if _flag_on(merged, "tidb_tpu_verify_plan", default=True):
+            from ..analysis.contracts import verify_plan
+            verify_plan(phys)
+            phys._contract_ok = True
         use_cache = use_cache and not ran_subquery
         if use_cache and _plan_cacheable(phys):
             keys = {}
@@ -1134,7 +1143,13 @@ class Session:
             return ResultSet(["operator", "actRows", "time", "loops"],
                              explain_analyze_text(phys, coll))
         text = phys.explain()
-        return ResultSet(["plan"], [(line,) for line in text.split("\n")])
+        rows = [(line,) for line in text.split("\n")]
+        if getattr(phys, "_contract_ok", False):
+            # the static gate verified this plan's operator contracts
+            # (analysis/contracts.verify_plan) — surfaced like the
+            # reference's EXPLAIN diagnostics footer
+            rows.append(("contract: ok",))
+        return ResultSet(["plan"], rows)
 
     def _exec_plan_replayer(self, stmt: A.PlanReplayerDump) -> ResultSet:
         """PLAN REPLAYER DUMP EXPLAIN <sql> (executor/plan_replayer.go):

@@ -158,5 +158,126 @@ def gen_orders_mini(n: int = 1024, seed: int = 7) -> tuple[list[str], list[Colum
     return ORDERS_MINI_NAMES, cols
 
 
+# ------------------------------------------------------------------ #
+# plan corpus: the TPC-H-shaped statements every static-analysis gate
+# run and tests/test_analysis.py push through analysis.verify_plan.
+# Shapes covered: dense scalar/keyed agg, SORT (high-NDV) agg, rollup,
+# TopN/Limit, row-returning projections, broadcast lookup join (rows +
+# agg + multi-level), semi/anti join, host sort/setop, device window.
+# ------------------------------------------------------------------ #
+
+TPCH_PLAN_QUERIES = [
+    # Q6: dense scalar aggregation over scan+filter
+    """select sum(l_extendedprice * l_discount) as revenue from lineitem
+       where l_shipdate >= date '1994-01-01'
+         and l_shipdate < date '1995-01-01'
+         and l_discount between 0.05 and 0.07 and l_quantity < 24""",
+    # Q1: dense keyed aggregation (dict-coded group keys)
+    """select l_returnflag, l_linestatus, sum(l_quantity),
+              sum(l_extendedprice), avg(l_discount), count(*)
+       from lineitem where l_shipdate <= date '1998-09-02'
+       group by l_returnflag, l_linestatus
+       order by l_returnflag, l_linestatus""",
+    # high-NDV group-by: SORT-strategy aggregation
+    """select l_orderkey, sum(l_extendedprice) from lineitem
+       group by l_orderkey""",
+    # rollup: Expand + grouping sets
+    """select l_returnflag, l_linestatus, sum(l_quantity) from lineitem
+       group by l_returnflag, l_linestatus with rollup""",
+    # device TopN (multi-key) and plain Limit
+    """select l_orderkey, l_extendedprice from lineitem
+       order by l_extendedprice desc, l_orderkey limit 10""",
+    "select l_partkey from lineitem limit 5",
+    # row-returning scan chain with projection arithmetic
+    """select l_orderkey, l_extendedprice * (1 - l_discount)
+       from lineitem where l_quantity < 5""",
+    # broadcast lookup join, aggregated (Q19 shape without OR-chains)
+    """select p_brand, sum(l_extendedprice) from lineitem, part
+       where l_partkey = p_partkey and l_quantity < 10
+       group by p_brand""",
+    # broadcast lookup join, row-returning
+    """select l_orderkey, p_brand from lineitem, part
+       where l_partkey = p_partkey and p_size > 40 limit 20""",
+    # semi join (IN subquery)
+    """select l_orderkey from lineitem
+       where l_partkey in (select p_partkey from part where p_size > 45)
+       limit 10""",
+    # anti join (NOT IN subquery)
+    """select count(*) from lineitem
+       where l_suppkey not in (select o_custkey from orders)""",
+    # multi-table chain: lineitem x orders x part
+    """select o_totalprice, p_brand, l_quantity from lineitem, orders, part
+       where l_orderkey = o_orderkey and l_partkey = p_partkey
+       limit 10""",
+    # host sort over join output
+    """select o_orderkey, sum(l_extendedprice) as rev from lineitem, orders
+       where l_orderkey = o_orderkey
+       group by o_orderkey order by rev desc limit 5""",
+    # set operation
+    """select l_partkey from lineitem where l_quantity < 2
+       union select p_partkey from part where p_size = 1""",
+    # window function over the sharded table
+    """select l_orderkey,
+              row_number() over (partition by l_returnflag
+                                 order by l_extendedprice desc) as rn
+       from lineitem limit 10""",
+    # scalar-subquery-free HAVING residue (host filter over agg)
+    """select l_returnflag, count(*) as c from lineitem
+       group by l_returnflag having count(*) > 1""",
+]
+
+
+def tpch_plan_session(sf: float = 0.001, n_orders: int = 512):
+    """In-memory Domain+Session with lineitem/part/orders registered from
+    the generators above — the fixture both the analysis gate and the
+    verifier tests plan TPCH_PLAN_QUERIES against."""
+    from ..session import Domain, Session
+    from ..session.catalog import TableInfo
+    dom = Domain()
+    for name, (names, cols) in (
+            ("lineitem", gen_lineitem(sf=sf, seed=42)),
+            ("part", gen_part(sf=max(sf * 10, 0.005), seed=7)),
+            ("orders", gen_orders_mini(n_orders))):
+        t = TableInfo(name, list(names), [c.dtype for c in cols])
+        t.register_columns(list(cols))
+        dom.catalog.create_table("test", t)
+    return Session(dom)
+
+
+# planned with the broadcast threshold forced to 0 so the repartition
+# (all_to_all shuffle) join path is exercised by the gate too
+TPCH_SHUFFLE_QUERIES = [
+    """select count(*), sum(l_quantity + o_totalprice) from lineitem
+       join orders on l_orderkey = o_orderkey""",
+    """select o_custkey, sum(l_quantity) from lineitem join orders
+       on l_orderkey = o_orderkey group by o_custkey""",
+]
+
+
+def built_tpch_plans(session, queries=None):
+    """Plan (without executing) each corpus statement; yields
+    (sql, physical plan) pairs for analysis.verify_plan.  With the
+    default corpus, also plans TPCH_SHUFFLE_QUERIES under a zeroed
+    broadcast threshold to cover the exchange (shuffle-join) path."""
+    from ..sql.parser import parse_one
+
+    def plan(sql):
+        _built, phys = session._plan_select(parse_one(sql))
+        return phys
+
+    for sql in (queries if queries is not None else TPCH_PLAN_QUERIES):
+        yield sql, plan(sql)
+    if queries is None:
+        from ..executor import plan as planmod
+        saved = planmod.BROADCAST_BUILD_MAX_ROWS
+        planmod.BROADCAST_BUILD_MAX_ROWS = 0
+        try:
+            for sql in TPCH_SHUFFLE_QUERIES:
+                yield sql, plan(sql)
+        finally:
+            planmod.BROADCAST_BUILD_MAX_ROWS = saved
+
+
 __all__ = ["gen_lineitem", "gen_part", "gen_orders_mini", "LINEITEM_NAMES",
-           "PART_NAMES", "DEC2"]
+           "PART_NAMES", "DEC2", "TPCH_PLAN_QUERIES",
+           "TPCH_SHUFFLE_QUERIES", "tpch_plan_session", "built_tpch_plans"]
