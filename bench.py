@@ -51,6 +51,7 @@ RESULTS_PATH = os.path.join(DATA_DIR, "results.jsonl")
 CACHE_DIR = os.path.join(DATA_DIR, "jax_cache")
 ATTEMPTS_PATH = os.path.join(HERE, "TPU_ATTEMPTS.jsonl")
 DAEMON_TPU_PATH = os.path.join(HERE, "BENCH_TPU.json")
+SCHED_PATH = os.path.join(DATA_DIR, "sched_concurrent.json")
 COLS_NEEDED = ["l_quantity", "l_extendedprice", "l_discount", "l_tax",
                "l_returnflag", "l_linestatus", "l_shipdate", "l_partkey",
                "l_shipmode", "l_shipinstruct"]
@@ -126,6 +127,18 @@ def orchestrate():
     if rc != 0:
         log("datagen child failed; children will generate inline")
 
+    # 1b. scheduler scenario (CPU child): open-loop concurrent sessions
+    # through the admission scheduler — coalesce/fusion rates + p50/p99
+    # schedWait, the tracked perf numbers for cross-query fusion
+    try:
+        os.remove(SCHED_PATH)
+    except OSError:
+        pass
+    rc, _ = _run_child({"BENCH_MODE": "sched", "JAX_PLATFORMS": "cpu"},
+                       420, "sched-concurrent")
+    if rc != 0:
+        log("sched-concurrent child failed; omitting scenario")
+
     best_tpu = None
     if not cpu_only:
         probe_t = min(float(os.environ.get("BENCH_PROBE_TIMEOUT", "300")),
@@ -175,6 +188,11 @@ def orchestrate():
     sf100 = _sf100_result()
     if sf100 is not None:
         best["sf100_q6"] = sf100
+    try:
+        with open(SCHED_PATH) as f:
+            best["sched_concurrent"] = json.load(f)
+    except (OSError, ValueError):
+        pass
     best["tpu_attempts"] = _attempts_summary()
     best.pop("platform_kept", None)
     print(json.dumps(best))
@@ -352,6 +370,102 @@ def mode_bench():
             _bench_sf100(platform, mem_bw)
         else:
             log(f"skipping SF=100 rung ({budget:.0f}s left < 1300s)")
+
+
+def mode_sched():
+    """Open-loop concurrent-sessions scenario: N statement arrivals at a
+    fixed rate (arrivals don't wait for completions — the "millions of
+    users" shape) over ONE shared table, mixing identical and different
+    aggregates, all through the device admission scheduler.  Reports
+    coalesce rate, cross-query fusion rate, and p50/p99 schedWait."""
+    import threading
+
+    from tidb_tpu.session import Domain, Session
+
+    n_stmts = int(os.environ.get("BENCH_SCHED_STMTS", "240"))
+    rate = float(os.environ.get("BENCH_SCHED_RATE", "400"))  # stmts/s
+    rng = np.random.default_rng(7)
+    n = 200_000
+    dom = Domain()
+    s = Session(dom)
+    s.execute("create table lineitem (l_quantity bigint, l_discount "
+              "bigint, l_extendedprice bigint, l_shipdays bigint)")
+    q = rng.integers(1, 50, n)
+    d = rng.integers(0, 10, n)
+    p = rng.integers(100, 10_000, n)
+    sd = rng.integers(0, 2000, n)
+    step = 20_000
+    for lo in range(0, n, step):
+        s.execute("insert into lineitem values " + ",".join(
+            f"({a},{b},{c},{e})" for a, b, c, e in
+            zip(q[lo:lo + step], d[lo:lo + step], p[lo:lo + step],
+                sd[lo:lo + step])))
+    # no result-cache short circuit, device launch path pinned open
+    s.execute("set global tidb_tpu_result_cache_entries = 0")
+    dom.client._platform = lambda: "tpu"
+    queries = [
+        "select sum(l_extendedprice * l_discount) from lineitem "
+        "where l_shipdays >= 730 and l_shipdays < 1095",
+        "select count(*) from lineitem where l_discount >= 5",
+        "select min(l_extendedprice) from lineitem where l_quantity > 10",
+        "select max(l_extendedprice) from lineitem where l_discount < 8",
+    ]
+    for qq in queries:              # warm: compile once per program
+        s.must_query(qq)
+    sched = dom.client._sched_obj
+    if sched is None:
+        log("scheduler did not engage; aborting scenario")
+        return
+    base = {k: sched.stats()[k] for k in
+            ("launches", "coalesced_tasks", "fused_tasks", "tasks_done")}
+    # open loop: arrival times are exponential(rate), pre-drawn; each
+    # arrival runs on its own session thread regardless of prior
+    # completions
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, n_stmts))
+    picks = rng.integers(0, len(queries), n_stmts)
+    errors: list = []
+    t0 = time.monotonic()
+
+    def run(i):
+        delay = t0 + arrivals[i] - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        try:
+            Session(dom).must_query(queries[picks[i]])
+        except Exception as e:
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=run, args=(i,))
+               for i in range(n_stmts)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    elapsed = time.monotonic() - t0
+    st = sched.stats()
+    tasks = st["tasks_done"] - base["tasks_done"]
+    launches = st["launches"] - base["launches"]
+    out = {
+        "stmts": n_stmts,
+        "arrival_rate_per_s": rate,
+        "elapsed_s": round(elapsed, 3),
+        "errors": len(errors),
+        "tasks": tasks,
+        "launches": launches,
+        "coalesce_rate": round(
+            (st["coalesced_tasks"] - base["coalesced_tasks"])
+            / max(tasks, 1), 4),
+        "fusion_rate": round(
+            (st["fused_tasks"] - base["fused_tasks"]) / max(tasks, 1), 4),
+        "launch_reduction": round(1.0 - launches / max(tasks, 1), 4),
+        "sched_wait_p50_ms": st["wait_p50_ms"],
+        "sched_wait_p99_ms": st["wait_p99_ms"],
+        "window_waits": st["window_waits"],
+    }
+    log("sched-concurrent:", json.dumps(out))
+    os.makedirs(DATA_DIR, exist_ok=True)
+    with open(SCHED_PATH, "w") as f:
+        json.dump(out, f)
 
 
 def _median_times(fn, iters):
@@ -822,6 +936,8 @@ if __name__ == "__main__":
         mode_probe()
     elif mode == "bench":
         mode_bench()
+    elif mode == "sched":
+        mode_sched()
     elif os.environ.get("BENCH_INNER"):  # legacy entry
         mode_bench()
     else:

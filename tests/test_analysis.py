@@ -315,6 +315,50 @@ def test_lint_broad_except():
     assert _rules(src4, "copr/hostagg.py") == ["TPU-BROAD-EXCEPT"]
 
 
+def test_lint_psum_fence():
+    unfenced = ("from jax import lax\n\n"
+                "def merge(states, axis):\n"
+                "    return lax.psum(states, axis)\n")
+    assert _rules(unfenced, "parallel/shuffle.py") == ["TPU-PSUM-FENCE"]
+    # same code outside a traced module: silent
+    assert _rules(unfenced, "store/client.py") == []
+    # the fence idiom (guard attribute + OverflowError raise anywhere in
+    # the module) clears every psum in it
+    fenced = (
+        "from jax import lax\n\n"
+        "def merge(states, axis):\n"
+        "    return lax.psum(states, axis)\n\n"
+        "class Prog:\n"
+        "    def __call__(self, cols):\n"
+        "        if self._psum_limb_fence and cols[0].size >= 2 ** 31:\n"
+        "            raise OverflowError('limb-exact SUM bound')\n"
+        "        return merge(cols, 'shard')\n")
+    assert _rules(fenced, "parallel/shuffle.py") == []
+    # a guard without the raise (or vice versa) is not a fence
+    half = (
+        "from jax import lax\n\n"
+        "class Prog:\n"
+        "    def __call__(self, cols):\n"
+        "        if self._psum_limb_fence:\n"
+        "            cols = cols[:1]\n"
+        "        return lax.psum(cols, 'shard')\n")
+    assert _rules(half, "parallel/shuffle.py") == ["TPU-PSUM-FENCE"]
+    # inline waiver works like every other rule
+    waived = ("from jax import lax\n\n"
+              "def merge(s, axis):\n"
+              "    return lax.psum(s, axis)  # planlint: ok - bool mask\n")
+    assert _rules(waived, "parallel/shuffle.py") == []
+    # the real traced modules carry their fences (regression: spmd's
+    # ShardedCopProgram/FusedCopProgram and shuffle's program all fence)
+    import os
+    import tidb_tpu
+    root = os.path.dirname(tidb_tpu.__file__)
+    for rel in ("parallel/spmd.py", "parallel/shuffle.py"):
+        with open(os.path.join(root, rel), encoding="utf-8") as f:
+            assert not [r for r in _rules(f.read(), rel)
+                        if r == "TPU-PSUM-FENCE"], rel
+
+
 def test_lint_waivers():
     src = ("def f(x):\n"
            "    return int(x)  # planlint: ok - build-time constant\n")

@@ -476,4 +476,72 @@ def verify_task(task) -> None:
                   "shard axis")
 
 
-__all__ = ["PlanContractError", "verify_plan", "verify_dag", "verify_task"]
+# --------------------------------------------------------------------- #
+# cross-query fusion verification (the scheduler's fusion-group seam)
+# --------------------------------------------------------------------- #
+
+def fusion_signature(dag: D.CopNode) -> Optional[tuple]:
+    """Contract-level fusion class of a pushed cop DAG, or None when the
+    plan cannot join a cross-query fusion group.  Structural only — no
+    trace, no jax import: this is exactly the "checkable without tracing"
+    substrate PR 2's contracts were built for.
+
+    Fusable class: the root is an Aggregation whose whole merge happens
+    in-program (SCALAR/DENSE strategy — SORT group tables merge host-side
+    with per-device leading axes that a fused leaf could not carry), the
+    chain contains no expanding join (extras drive a per-task regrow
+    loop), and the DAG verifies clean.  The returned tuple is the
+    fusion-key component: all members of one group share it."""
+    if not isinstance(dag, D.Aggregation):
+        return None
+    if dag.strategy == D.GroupStrategy.SORT:
+        return None
+    if D.find_expand_join(dag) is not None:
+        return None
+    try:
+        verify_dag(dag)
+    except PlanContractError:
+        return None
+    return ("inprog-agg",)
+
+
+def verify_fusion_group(tasks: Sequence) -> None:
+    """Pre-launch contract check of a fusion group: every member must be
+    individually fusable and all members must agree on mesh fingerprint,
+    capacity signature (stacked input shapes + dtypes), shared scan
+    inputs, and empty aux — the preconditions for computing N payloads
+    from one scan pass to be shape-safe AND bit-identical to N solo
+    runs.  Raises PlanContractError; the scheduler falls back to
+    unfused per-program launches on refusal."""
+    p = ("sched", "FusedDag")
+    if len(tasks) < 2:
+        _fail("fusion-group", p, "fusion group needs >= 2 members")
+    lead = tasks[0]
+    for t in tasks:
+        if t.key is None or t.dag is None:
+            _fail("fusion-group", p, "opaque task in a fusion group")
+        if fusion_signature(t.dag) is None:
+            _fail("fusion-class", p,
+                  f"member {type(t.dag).__name__} is not a fully "
+                  "in-program aggregation chain")
+        if t.key[1] != lead.key[1]:
+            _fail("mesh-mismatch", p,
+                  "fusion group members were keyed against different "
+                  "meshes")
+        if t.key[3] != lead.key[3]:
+            _fail("capacity-shape", p,
+                  f"member capacity signature {t.key[3]} disagrees with "
+                  f"the group's {lead.key[3]} (shapes/dtypes must be "
+                  "byte-identical to share one scan)")
+        if t.input_token != lead.input_token:
+            _fail("fusion-input", p,
+                  "members read different snapshot residents — a fused "
+                  "program computes every payload from ONE scan")
+        if t.aux != ():
+            _fail("fusion-input", p,
+                  "host-materialized aux inputs (join builds) do not "
+                  "fuse across queries")
+
+
+__all__ = ["PlanContractError", "verify_plan", "verify_dag", "verify_task",
+           "fusion_signature", "verify_fusion_group"]

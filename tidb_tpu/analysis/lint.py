@@ -34,6 +34,12 @@ Rules
                    of the same non-reentrant lock (self-deadlock, incl.
                    Condition(lock) aliasing) and inverted acquisition
                    order between two locks observed in the same class.
+- TPU-PSUM-FENCE   lax.psum in a traced module whose module does not
+                   also implement the 2^31 limb-exactness fence (a
+                   `*psum_limb_fence*` guard plus an OverflowError
+                   raise): int/decimal SUM (hi, lo) limb states merged
+                   by an UNFENCED in-program psum silently wrap past
+                   2^31 contributing rows — wrong answers, no error.
 
 Inline waiver: any rule is suppressed by a `# planlint: ok` comment on
 the offending line (give a reason after it).
@@ -161,11 +167,34 @@ class _Scoped(ast.NodeVisitor):
 # rules 1-4: expression-level
 # --------------------------------------------------------------------- #
 
+def _module_has_limb_fence(tree: ast.AST) -> bool:
+    """The module implements the psum limb-exactness fence: somewhere it
+    consults a `*psum_limb_fence*` guard AND raises OverflowError (the
+    pre-launch capacity check of parallel/spmd.ShardedCopProgram)."""
+    has_guard = False
+    has_raise = False
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Attribute, ast.Name)):
+            name = node.attr if isinstance(node, ast.Attribute) else node.id
+            if "psum_limb_fence" in name:
+                has_guard = True
+        elif isinstance(node, ast.Raise) and node.exc is not None:
+            exc = node.exc
+            callee = exc.func if isinstance(exc, ast.Call) else exc
+            if isinstance(callee, ast.Name) and \
+                    callee.id == "OverflowError":
+                has_raise = True
+        if has_guard and has_raise:
+            return True
+    return False
+
+
 class _ExprRules(_Scoped):
-    def __init__(self, rel, lines):
+    def __init__(self, rel, lines, psum_fenced: bool = True):
         super().__init__(rel, lines)
         self.traced = rel in TRACED_MODULES
         self.hot = rel in HOT_PATH_MODULES
+        self.psum_fenced = psum_fenced
         self._digest_fn = 0     # depth of digest-context functions
         self._sorted_ok: set = set()   # dict-iter calls under sorted()
 
@@ -236,6 +265,14 @@ class _ExprRules(_Scoped):
                 self.add("TPU-TRACE-LEAK", node,
                          "np.asarray/np.array on a traced value pulls it "
                          "to host; use jnp inside device functions")
+            # TPU-PSUM-FENCE: unfenced in-program limb merges
+            if name == "psum" and not self.psum_fenced:
+                self.add("TPU-PSUM-FENCE", node,
+                         "lax.psum in a traced module without the 2^31 "
+                         "limb-exactness fence: (hi, lo) SUM limb states "
+                         "wrap silently past 2^31 contributing rows — "
+                         "add a *_psum_limb_fence capacity check that "
+                         "raises OverflowError before launch")
         # TPU-HOST-SYNC
         if self.hot:
             if name == "device_get" and isinstance(node.func,
@@ -423,7 +460,8 @@ def lint_source(src: str, rel: str) -> list:
     (/-separated) — rules scope on it."""
     tree = ast.parse(src)
     lines = src.splitlines()
-    v = _ExprRules(rel, lines)
+    fenced = rel not in TRACED_MODULES or _module_has_limb_fence(tree)
+    v = _ExprRules(rel, lines, psum_fenced=fenced)
     v.visit(tree)
     findings = v.findings
     if rel in LOCK_MODULES:

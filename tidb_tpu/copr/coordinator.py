@@ -46,7 +46,8 @@ class QueryHandle:
     summary."""
 
     __slots__ = ("conn_id", "sql", "started", "fragments", "_mu",
-                 "sched_wait_ns", "sched_tasks", "sched_coalesced")
+                 "sched_wait_ns", "sched_tasks", "sched_coalesced",
+                 "sched_fused")
 
     def __init__(self, conn_id: int, sql: str):
         self.conn_id = conn_id
@@ -57,17 +58,22 @@ class QueryHandle:
         self.sched_wait_ns = 0     # admission-queue wait, all cop tasks
         self.sched_tasks = 0       # device launches admitted
         self.sched_coalesced = 0   # tasks that rode a shared launch
+        self.sched_fused = 0       # tasks served by a cross-query
+                                   # fused launch (EXPLAIN `fused`)
 
     def note_fragment(self, desc: str) -> None:
         with self._mu:
             self.fragments.append((desc, time.time()))
 
-    def note_sched(self, wait_ns: int, coalesced: int) -> None:
+    def note_sched(self, wait_ns: int, coalesced: int,
+                   fused: int = 0) -> None:
         with self._mu:
             self.sched_wait_ns += int(wait_ns)
             self.sched_tasks += 1
             if coalesced > 1:
                 self.sched_coalesced += 1
+            if fused > 1:
+                self.sched_fused += 1
 
 
 class Coordinator:

@@ -208,6 +208,29 @@ class LookupJoin(CopNode):
 
 
 @dataclass(frozen=True)
+class FusedDag(CopNode):
+    """Multi-payload device program root: N member chains sharing one scan.
+
+    Reference analog: shared-scan / multi-query optimization in compiled
+    engines (Flare compiles shared work into one native kernel instead of
+    re-executing it per query).  The admission scheduler groups queued
+    cop tasks whose chains read the SAME snapshot scan (identical stacked
+    device inputs, same mesh) but differ in filters/aggregates, and fuses
+    them into ONE program whose output is a tuple with one leaf per
+    member — the scan's HBM pass is paid once and XLA CSEs the shared
+    subtrees (flatten, masks, common predicates) across members.
+
+    Members must each be fully in-program aggregation chains (the
+    contract class checked by analysis.contracts.fusion_signature); the
+    node is frozen so the fused program caches on its digest exactly
+    like any other cop DAG."""
+    members: Tuple[CopNode, ...] = ()
+
+    def children(self):
+        return self.members
+
+
+@dataclass(frozen=True)
 class WindowShuffleSpec:
     """Device window-function program spec.
 
@@ -276,6 +299,10 @@ def output_dtypes(node: CopNode) -> Tuple[dt.DataType, ...]:
         if node.kind in ("semi", "anti"):
             return output_dtypes(node.child)
         return output_dtypes(node.child) + node.build_dtypes
+    if isinstance(node, FusedDag):
+        # one payload per member; the scheduler demuxes leaves, nothing
+        # downstream consumes a concatenated schema
+        return tuple(t for m in node.members for t in output_dtypes(m))
     raise TypeError(node)
 
 
@@ -379,7 +406,7 @@ def dag_digest(node: CopNode) -> int:
 __all__ = [
     "AggFunc", "AggDesc", "CopNode", "TableScan", "Selection", "Projection",
     "Expand", "GroupStrategy", "Aggregation", "TopN", "Limit", "LookupJoin",
-    "ShuffleJoinSpec", "output_dtypes", "dag_digest", "find_expand_join",
-    "rewrite_lookup", "drop_lookup", "chain_str",
+    "FusedDag", "ShuffleJoinSpec", "output_dtypes", "dag_digest",
+    "find_expand_join", "rewrite_lookup", "drop_lookup", "chain_str",
     "rewrite_expand_capacity",
 ]

@@ -356,6 +356,7 @@ class CopTaskExec(PhysOp):
         if handle is not None:
             handle.note_fragment(self.describe())
         sched_w0 = handle.sched_wait_ns if handle is not None else 0
+        sched_f0 = handle.sched_fused if handle is not None else 0
         if self.as_of_ts is not None:
             snap = self.as_of_snap
             if snap is None:
@@ -385,9 +386,12 @@ class CopTaskExec(PhysOp):
         # governs host-side operator working memory.
         if handle is not None:
             # admission-queue wait this cop task paid, for EXPLAIN
-            # ANALYZE (select_result.go copr execution-info analog)
+            # ANALYZE (select_result.go copr execution-info analog),
+            # plus how many of its launches were cross-query fused
             dw = handle.sched_wait_ns - sched_w0
-            self._rt_detail = f"schedWait: {dw / 1e6:.3f}ms"
+            df = handle.sched_fused - sched_f0
+            self._rt_detail = (f"schedWait: {dw / 1e6:.3f}ms, "
+                               f"fused: {df}")
         return ResultChunk(list(self.out_names), cols)
 
 

@@ -172,6 +172,87 @@ def get_sharded_program(dag_root: D.CopNode, mesh,
     return _cached(dag_root, mesh, row_capacity)
 
 
+class FusedCopProgram:
+    """N compatible cop chains over ONE shared scan as a single launch.
+
+    The admission scheduler (sched/) groups queued tasks whose chains
+    read the SAME stacked device inputs (one snapshot scan, one mesh) but
+    differ in filters/aggregates — the cross-query fusion seam ROADMAP
+    names.  Each member chain is traced over the shared inputs inside one
+    shard_map; XLA CSEs the scan loads, live-row masks, and any common
+    predicate subtrees across members, so the table's HBM pass is paid
+    once and every member's merged states come back as a separate output
+    leaf, demultiplexed to its waiter by the scheduler.
+
+    Only fully in-program agg members qualify (kind 'agg', no host
+    merge, no extras — the contract class of
+    analysis.contracts.fusion_signature): their outputs are replicated
+    post-psum, so leaves never interact."""
+
+    def __init__(self, fused: D.FusedDag, mesh):
+        if len(fused.members) < 2:
+            raise ValueError("fusion needs at least two member chains")
+        self.fused = fused
+        self.mesh = mesh
+        self.members = tuple(get_sharded_program(m, mesh)
+                             for m in fused.members)
+        for p in self.members:
+            if p.kind != "agg" or p.host_merge or p.has_extras:
+                raise ValueError(
+                    "only fully in-program agg chains fuse (member "
+                    f"{type(p.root).__name__} is {p.kind}"
+                    f"{'+host-merge' if p.host_merge else ''}"
+                    f"{'+extras' if p.has_extras else ''})")
+        # the fence is the OR of the members': same capacity inputs, so
+        # one limb-overflow bound covers every leaf
+        self._psum_limb_fence = any(p._psum_limb_fence
+                                    for p in self.members)
+        in_specs = (P(SHARD_AXIS), P(SHARD_AXIS), P())
+        self._fn = jax.jit(shard_map(
+            self._device_fn, mesh=mesh, in_specs=in_specs,
+            out_specs=P()))
+
+    def _device_fn(self, cols, counts, aux):
+        # each member re-traces its chain over the SAME input refs; XLA
+        # common-subexpression-eliminates the shared scan/flatten work
+        return tuple(p._device_fn(cols, counts, aux)
+                     for p in self.members)
+
+    def __call__(self, stacked_cols: Sequence, counts, aux_cols=()):
+        if self._psum_limb_fence and stacked_cols:
+            s, c = stacked_cols[0][0].shape[:2]
+            if s * c >= 2 ** 31:
+                raise OverflowError(
+                    f"global capacity {s}x{c} exceeds the 2^31 limb-exact "
+                    "SUM bound for in-program psum merge")
+        return self._fn(tuple(stacked_cols), counts, tuple(aux_cols))
+
+
+@functools.lru_cache(maxsize=64)
+def _cached_fused(fused, mesh):
+    return FusedCopProgram(fused, mesh)
+
+
+def get_fused_program(fused: D.FusedDag, mesh) -> FusedCopProgram:
+    return _cached_fused(fused, mesh)
+
+
+def _stack_slots(cols_list, counts_list, n_slots):
+    """Stack K tasks' (S, C) inputs along a batch-slot dim -> (S, K, C),
+    padding short batches by repeating the last slot: one compiled
+    program per pow2 slot count instead of one per K."""
+    k = len(cols_list)
+    pads = list(cols_list) + [cols_list[-1]] * (n_slots - k)
+    cnts = list(counts_list) + [counts_list[-1]] * (n_slots - k)
+    stacked = []
+    for j in range(len(pads[0])):
+        v = jnp.stack([c[j][0] for c in pads], axis=1)
+        m = None if pads[0][j][1] is None else \
+            jnp.stack([c[j][1] for c in pads], axis=1)
+        stacked.append((v, m))
+    return stacked, jnp.stack(list(cnts), axis=1)
+
+
 class BatchedCopProgram:
     """K compatible dense-agg cop tasks as ONE vmapped SPMD launch.
 
@@ -204,18 +285,7 @@ class BatchedCopProgram:
                 raise OverflowError(
                     f"global capacity {s}x{c} exceeds the 2^31 limb-exact "
                     "SUM bound for in-program psum merge")
-        # pad short batches by repeating the last slot: one compiled
-        # program per pow2 slot count instead of one per K
-        pads = list(cols_list) + [cols_list[-1]] * (self.n_slots - k)
-        cnts = list(counts_list) + [counts_list[-1]] * (self.n_slots - k)
-        ncols = len(pads[0])
-        stacked = []
-        for j in range(ncols):
-            v = jnp.stack([c[j][0] for c in pads], axis=1)
-            m = None if pads[0][j][1] is None else \
-                jnp.stack([c[j][1] for c in pads], axis=1)
-            stacked.append((v, m))
-        counts = jnp.stack(list(cnts), axis=1)
+        stacked, counts = _stack_slots(cols_list, counts_list, self.n_slots)
         out = self._fn(tuple(stacked), counts, ())
         return [jax.tree_util.tree_map(lambda a, i=i: a[i], out)
                 for i in range(k)]
@@ -232,5 +302,55 @@ def get_batched_program(dag_root: D.CopNode, mesh,
     return _cached_batched(dag_root, mesh, n_slots)
 
 
+class BatchedRowsProgram:
+    """K same-program ROW-returning cop tasks as ONE vmapped launch.
+
+    Closes the ROADMAP launch-shape gap: compacted row outputs carry a
+    per-device (1, capacity) buffer + live count, so stacking them needs
+    per-slot capacity handling — the vmapped device fn keeps each slot's
+    own cumsum-compaction and count, the slot axis rides BEHIND the
+    device axis (out_axes=1) so the shard out_specs still shard axis 0,
+    and the demux hands every task its own (cols, counts) pair with the
+    counts it needs for the paging (regrow-on-overflow) loop.  Tasks in
+    one batch share a task key, hence one dag digest and one row
+    capacity; only extras-free plans qualify (an expanding join's regrow
+    loop re-runs programs per task)."""
+
+    def __init__(self, dag_root: D.CopNode, mesh, row_capacity: int,
+                 n_slots: int):
+        self.base = get_sharded_program(dag_root, mesh, row_capacity)
+        if self.base.kind != "rows" or self.base.has_extras:
+            raise ValueError("only extras-free row plans batch")
+        self.n_slots = n_slots
+        in_specs = (P(SHARD_AXIS), P(SHARD_AXIS), P())
+        # slot axis at position 1: per-device leading axis stays axis 0
+        fn = jax.vmap(self.base._device_fn, in_axes=(1, 1, None),
+                      out_axes=1)
+        self._fn = jax.jit(shard_map(
+            fn, mesh=mesh, in_specs=in_specs,
+            out_specs=(P(SHARD_AXIS), P(SHARD_AXIS))))
+
+    def __call__(self, cols_list: Sequence, counts_list: Sequence) -> list:
+        k = len(cols_list)
+        stacked, counts = _stack_slots(cols_list, counts_list, self.n_slots)
+        out_cols, out_counts = self._fn(tuple(stacked), counts, ())
+        # leaves: (D, K, cap) values / (D, K) counts -> per-slot (D, cap)
+        return [([(v[:, i], m[:, i]) for v, m in out_cols],
+                 out_counts[:, i]) for i in range(k)]
+
+
+@functools.lru_cache(maxsize=32)
+def _cached_batched_rows(dag_root, mesh, row_capacity, n_slots):
+    return BatchedRowsProgram(dag_root, mesh, row_capacity, n_slots)
+
+
+def get_batched_rows_program(dag_root: D.CopNode, mesh, row_capacity: int,
+                             n_slots: int) -> BatchedRowsProgram:
+    n_slots = max(2, 1 << (n_slots - 1).bit_length())   # pow2 slot counts
+    return _cached_batched_rows(dag_root, mesh, row_capacity, n_slots)
+
+
 __all__ = ["ShardedCopProgram", "get_sharded_program",
-           "BatchedCopProgram", "get_batched_program"]
+           "BatchedCopProgram", "get_batched_program",
+           "BatchedRowsProgram", "get_batched_rows_program",
+           "FusedCopProgram", "get_fused_program"]

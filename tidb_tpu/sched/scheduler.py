@@ -15,12 +15,25 @@ owns all launches onto one jax mesh:
   PRIORITY — utils/resourcegroup.py).
 - Compatible tasks COALESCE into one launch: identical inputs (same
   snapshot epoch residents) share a single program execution; distinct
-  inputs of the same dense-agg program stack along a batch-slot dim and
-  run as ONE vmapped program (parallel/spmd.get_batched_program), with
-  partial-agg states split back per task.
-- Queue-wait / launch / coalesce stats feed utils/metrics (scraped at
-  /metrics), the /sched status route, per-statement execdetails
-  (`schedWait` in EXPLAIN ANALYZE), and per-group RU accounting.
+  inputs of the same program stack along a batch-slot dim and run as ONE
+  vmapped program (spmd.get_batched_program for dense aggs,
+  spmd.get_batched_rows_program for compacted row outputs), with
+  states/rows split back per task.
+- Compatible-but-NON-identical tasks FUSE into one program: queued
+  tasks sharing a contract-aware fusion key (one snapshot scan, one
+  mesh, one capacity signature — analysis.contracts.fusion_signature,
+  no tracing) but differing in filters/aggregates run as ONE
+  FusedCopProgram computing every member's payload from a single scan
+  pass; results demux back to each waiter (cross-query kernel fusion,
+  the Flare shared-scan argument).
+- An adaptive micro-batch WINDOW holds the drain briefly for
+  stragglers: per fusion key, an EWMA of arrival gaps predicts whether
+  a matching task is about to arrive; under bursty open-loop load the
+  sub-millisecond wait raises coalesce/fusion rates sharply.
+- Queue-wait / launch / coalesce / fusion stats feed utils/metrics
+  (scraped at /metrics), the /sched status route, per-statement
+  execdetails (`schedWait`/`fused` in EXPLAIN ANALYZE), and per-group
+  RU accounting.
 
 The drain thread starts lazily on first submit and exits after an idle
 period, so embedders that never touch the device pay nothing.
@@ -39,6 +52,14 @@ from .task import CopTask, ServerBusyError
 DEFAULT_QUEUE_DEPTH = 256
 DEFAULT_MAX_COALESCE = 8
 IDLE_EXIT_S = 5.0
+# adaptive micro-batch window: never hold a launch longer than this, and
+# only hold at all when the key's EWMA arrival gap predicts a straggler
+# inside the cap (2 * gap <= cap)
+WINDOW_CAP_US = 1000
+# arrival gaps beyond this clamp before feeding the EWMA so one long lull
+# cannot poison the estimate forever (it recovers in a few arrivals)
+WINDOW_GAP_CLAMP_NS = 50_000_000
+WAIT_SAMPLES = 2048              # ring of recent task waits (p50/p99)
 
 
 def _verify_enabled() -> bool:
@@ -72,6 +93,9 @@ class DeviceScheduler:
                  max_coalesce: int = DEFAULT_MAX_COALESCE):
         self.max_depth = max_depth
         self.max_coalesce = max_coalesce
+        self.fusion_enable = True         # tidb_tpu_sched_fusion
+        self.window_us = -1               # tidb_tpu_sched_window_us
+                                          # (-1 adaptive, 0 off, >0 fixed)
         self._mu = threading.Lock()
         self._cv = threading.Condition(self._mu)
         self._groups: dict[str, _GroupQ] = {}
@@ -79,11 +103,21 @@ class DeviceScheduler:
         self._gvt = 0.0           # global virtual time (newcomer floor)
         self._thread: Optional[threading.Thread] = None
         self._paused = False
+        # micro-batch window bookkeeping: fusion key -> last arrival ns /
+        # EWMA arrival gap ns (tiny dicts, cleared when they grow)
+        self._fk_last: dict = {}
+        self._fk_gap: dict = {}
+        # recent task waits, for p50/p99 on /sched and in bench
+        self._wait_ring: deque = deque(maxlen=WAIT_SAMPLES)
         # lifetime counters (read by /sched, tests, metrics mirror them)
         self.launches = 0
         self.coalesced_launches = 0       # launches serving >= 2 tasks
         self.coalesced_tasks = 0          # tasks that rode a shared launch
         self.batched_launches = 0         # stacked-slot vmap launches
+        self.batched_rows_launches = 0    # rows-kind stacked launches
+        self.fused_launches = 0           # cross-query fused launches
+        self.fused_tasks = 0              # tasks served by a fused launch
+        self.window_waits = 0             # drains that held for stragglers
         self.busy_rejects = 0
         self.tasks_done = 0
         from ..utils.metrics import global_registry
@@ -98,6 +132,9 @@ class DeviceScheduler:
                                      "device launches", labels=("mode",))
         self._m_coal = reg.counter("tidb_tpu_sched_coalesced_tasks_total",
                                    "tasks served by a shared launch")
+        self._m_fused = reg.counter("tidb_tpu_sched_fused_tasks_total",
+                                    "tasks served by a cross-query "
+                                    "fused launch")
         self._m_wait = reg.histogram("tidb_tpu_sched_wait_seconds",
                                      "admission queue wait")
         self._m_ru = reg.counter("tidb_tpu_sched_ru_total",
@@ -108,12 +145,19 @@ class DeviceScheduler:
     # ------------------------------------------------------------- #
 
     def configure(self, max_depth: Optional[int] = None,
-                  max_coalesce: Optional[int] = None) -> None:
-        """Apply sysvar knobs; negative/None = keep current."""
+                  max_coalesce: Optional[int] = None,
+                  fusion: Optional[bool] = None,
+                  window_us: Optional[int] = None) -> None:
+        """Apply sysvar knobs; negative/None = keep current (window_us
+        is the exception: -1 means adaptive, 0 disables the hold)."""
         if max_depth is not None and max_depth > 0:
             self.max_depth = max_depth
         if max_coalesce is not None and max_coalesce > 0:
             self.max_coalesce = max_coalesce
+        if fusion is not None:
+            self.fusion_enable = bool(fusion)
+        if window_us is not None and window_us >= -1:
+            self.window_us = int(window_us)
 
     def submit(self, task: CopTask) -> CopTask:
         """Enqueue; raises ServerBusyError when the bounded queue is
@@ -143,6 +187,7 @@ class DeviceScheduler:
                     g.vtime = max(g.vtime, self._gvt)
             g.queue.append(task)
             self._depth += 1
+            self._note_arrival(task)
             self._m_depth.set(self._depth)
             self._m_tasks.inc(group=task.group)
             if self._thread is None:
@@ -175,11 +220,85 @@ class DeviceScheduler:
                 best = g
         return best
 
+    # ---- adaptive micro-batch window (EWMA of arrival gaps) --------- #
+
+    def _note_arrival(self, task) -> None:
+        """Track per-fusion-key arrival gaps (called with _cv held).
+        Plain coalescing benefits from the window too, so keyed tasks
+        without a fusion key track under their task key."""
+        fk = task.fusion_key if task.fusion_key is not None else task.key
+        if fk is None:
+            return
+        if len(self._fk_last) > 256:      # hot keys are few; stay tiny
+            self._fk_last.clear()
+            self._fk_gap.clear()
+        last = self._fk_last.get(fk)
+        self._fk_last[fk] = task.submit_ns
+        if last is None:
+            return
+        gap = min(task.submit_ns - last, WINDOW_GAP_CLAMP_NS)
+        prev = self._fk_gap.get(fk)
+        self._fk_gap[fk] = gap if prev is None else \
+            0.7 * prev + 0.3 * gap
+
+    def _window_ns(self, lead) -> int:
+        """How long the drain may hold `lead` waiting for stragglers.
+        Fixed when the sysvar pins it; adaptive (-1) holds 2x the key's
+        EWMA arrival gap, and only when that fits the cap — a key whose
+        matches arrive slowly never delays its own launch."""
+        if lead.key is None:
+            return 0
+        if self.window_us == 0:
+            return 0
+        if self.window_us > 0:
+            return self.window_us * 1000
+        fk = lead.fusion_key if lead.fusion_key is not None else lead.key
+        gap = self._fk_gap.get(fk)
+        if gap is None:
+            return 0
+        w = int(2 * gap)
+        return w if w <= WINDOW_CAP_US * 1000 else 0
+
+    # ---- batch assembly --------------------------------------------- #
+
+    def _rides(self, t, lead) -> bool:
+        """May `t` share lead's launch?  Same program (in-flight dedup /
+        batch-slot stacking) or same fusion key with a different digest
+        (cross-query fusion: one scan, many payloads)."""
+        if t.cancelled:
+            return False
+        if (t.key == lead.key and t.mesh is lead.mesh
+                and (t.dag is lead.dag or t.dag == lead.dag)):
+            return True
+        return (self.fusion_enable
+                and lead.fusion_key is not None
+                and t.fusion_key == lead.fusion_key
+                and t.mesh is lead.mesh)
+
+    def _collect_riders(self, lead, batch: list) -> None:
+        """Pop every queued rider across ALL groups — coalescing and
+        fusion are cross-session by design.  Each rider charges its own
+        group's virtual time."""
+        for og in self._groups.values():
+            if len(batch) >= self.max_coalesce:
+                break
+            kept: deque = deque()
+            while og.queue:
+                t = og.queue.popleft()
+                if len(batch) < self.max_coalesce and self._rides(t, lead):
+                    batch.append(t)
+                    self._depth -= 1
+                    og.vtime += 1.0 / og.weight
+                    og.tasks += 1
+                else:
+                    kept.append(t)
+            og.queue = kept
+
     def _take_batch(self) -> list:
         """Pop the fair-ordered head task plus every compatible queued
-        task (same program digest + capacity shape + equal DAG), across
-        ALL groups — coalescing is cross-session by design.  Each rider
-        charges its own group's virtual time."""
+        rider; optionally hold inside the micro-batch window so
+        stragglers that are statistically about to arrive (EWMA of the
+        key's arrival gaps) coalesce/fuse instead of launching apart."""
         g = self._pick()
         if g is None:
             return []
@@ -194,23 +313,19 @@ class DeviceScheduler:
             return [None]          # sentinel: retry pick
         batch = [lead]
         if lead.key is not None:
-            for og in self._groups.values():
-                if len(batch) >= self.max_coalesce:
-                    break
-                kept: deque = deque()
-                while og.queue:
-                    t = og.queue.popleft()
-                    if (len(batch) < self.max_coalesce
-                            and not t.cancelled and t.key == lead.key
-                            and t.mesh is lead.mesh
-                            and (t.dag is lead.dag or t.dag == lead.dag)):
-                        batch.append(t)
-                        self._depth -= 1
-                        og.vtime += 1.0 / og.weight
-                        og.tasks += 1
-                    else:
-                        kept.append(t)
-                og.queue = kept
+            self._collect_riders(lead, batch)
+            w_ns = self._window_ns(lead)
+            if w_ns > 0 and len(batch) < self.max_coalesce:
+                # wait-for-stragglers: _cv.wait releases the lock, so
+                # submits land and notify; re-collect after each wake
+                deadline = time.perf_counter_ns() + w_ns
+                self.window_waits += 1
+                while len(batch) < self.max_coalesce:
+                    rem_ns = deadline - time.perf_counter_ns()
+                    if rem_ns <= 0:
+                        break
+                    self._cv.wait(rem_ns / 1e9)
+                    self._collect_riders(lead, batch)
         self._m_depth.set(self._depth)
         return batch
 
@@ -255,7 +370,65 @@ class DeviceScheduler:
             self.launches += 1
             self._m_launch.inc(mode="single")
             return
-        from ..parallel.spmd import get_batched_program, get_sharded_program
+        # partition by task key: a fusion batch carries several distinct
+        # programs over one shared scan
+        programs: list[list] = []
+        by_key: dict = {}
+        for t in batch:
+            grp = by_key.get(t.key)
+            if grp is None:
+                grp = by_key[t.key] = []
+                programs.append(grp)
+            grp.append(t)
+        if len(programs) > 1 and self._serve_fused(programs):
+            return
+        for grp in programs:
+            self._serve_program(grp)
+            self._note_coalesce(grp)
+
+    def _serve_fused(self, programs: list) -> bool:
+        """ONE launch computing every member program's payload from the
+        shared scan; False = refused (contract violation / backend
+        can't), caller falls back to per-program launches."""
+        from ..copr import dag as D
+        from ..parallel.spmd import get_fused_program, get_sharded_program
+        members = [grp[0] for grp in programs]
+        lead = members[0]
+        try:
+            from ..analysis.contracts import verify_fusion_group
+            # EVERY task (riders too): a same-key rider carrying a
+            # different input token must refuse the fused scan — its
+            # result would come from the wrong snapshot residents
+            verify_fusion_group([t for grp in programs for t in grp])
+            fused = D.FusedDag(tuple(t.dag for t in members))
+            fprog = get_fused_program(fused, lead.mesh)
+            outs = fprog(lead.cols, lead.counts)
+        except Exception:   # noqa: BLE001 - fusion capability probe:
+            return False    # refused groups launch apart below (same
+                            # results, no fusion win)
+        total = sum(len(grp) for grp in programs)
+        for grp, out in zip(programs, outs):
+            sprog = get_sharded_program(grp[0].dag, grp[0].mesh,
+                                        grp[0].row_capacity)
+            for t in grp:
+                t.finish((sprog, out))
+                t.fused = len(programs)
+                t.coalesced = total
+        self.launches += 1
+        self.fused_launches += 1
+        self.fused_tasks += total
+        self._m_launch.inc(mode="fused")
+        self._m_fused.inc(total)
+        return True
+
+    def _serve_program(self, batch: list) -> None:
+        """Launch ONE program's tasks: in-flight dedup by input token,
+        batch-slot vmap stacking for distinct inputs (dense aggs AND
+        compacted row outputs), per-slot launches otherwise."""
+        lead = batch[0]
+        from ..parallel.spmd import (get_batched_program,
+                                     get_batched_rows_program,
+                                     get_sharded_program)
         prog = get_sharded_program(lead.dag, lead.mesh, lead.row_capacity)
         # group riders by input identity: same-token tasks share ONE
         # program execution (in-flight dedup)
@@ -267,14 +440,17 @@ class DeviceScheduler:
                 s = by_token[t.input_token] = []
                 slots.append(s)
             s.append(t)
-        mode = "single"
-        if len(slots) > 1 and prog.kind == "agg" and not prog.host_merge \
-                and not prog.has_extras \
+        if len(slots) > 1 and not prog.host_merge and not prog.has_extras \
                 and all(s[0].aux == () for s in slots):
-            # distinct inputs, one dense-agg program: stack along the
-            # batch-slot dim, ONE vmapped launch, split states per task
+            # distinct inputs, one program: stack along the batch-slot
+            # dim, ONE vmapped launch, split states/rows per task
             try:
-                bprog = get_batched_program(lead.dag, lead.mesh, len(slots))
+                if prog.kind == "agg":
+                    bprog = get_batched_program(lead.dag, lead.mesh,
+                                                len(slots))
+                else:
+                    bprog = get_batched_rows_program(
+                        lead.dag, lead.mesh, lead.row_capacity, len(slots))
                 outs = bprog([s[0].cols for s in slots],
                              [s[0].counts for s in slots])
                 for s, out in zip(slots, outs):
@@ -282,8 +458,9 @@ class DeviceScheduler:
                         t.finish((prog, out))
                 self.launches += 1
                 self.batched_launches += 1
+                if prog.kind == "rows":
+                    self.batched_rows_launches += 1
                 self._m_launch.inc(mode="batched")
-                self._note_coalesce(batch)
                 return
             except Exception:   # planlint: ok - vmap capability probe;
                 pass        # op not vmappable on this backend: launch
@@ -294,8 +471,7 @@ class DeviceScheduler:
                 t.finish((prog, out))
             self.launches += 1
             self._m_launch.inc(
-                mode="coalesced" if len(s) > 1 else mode)
-        self._note_coalesce(batch)
+                mode="coalesced" if len(s) > 1 else "single")
 
     def _note_coalesce(self, batch: list) -> None:
         if len(batch) > 1:
@@ -314,6 +490,7 @@ class DeviceScheduler:
                 if g is not None:
                     g.wait_ns += t.wait_ns
                     g.rus += rus
+                self._wait_ring.append(t.wait_ns)
                 self._m_wait.observe(t.wait_ns / 1e9)
                 self._m_ru.inc(rus, group=t.group)
 
@@ -325,18 +502,34 @@ class DeviceScheduler:
     def depth(self) -> int:
         return self._depth
 
+    @staticmethod
+    def _pct(samples: list, q: float) -> float:
+        if not samples:
+            return 0.0
+        i = min(int(q * len(samples)), len(samples) - 1)
+        return samples[i]
+
     def stats(self) -> dict:
         with self._mu:
+            waits = sorted(self._wait_ring)
             return {
                 "queue_depth": self._depth,
                 "max_depth": self.max_depth,
                 "max_coalesce": self.max_coalesce,
+                "fusion": self.fusion_enable,
+                "window_us": self.window_us,
                 "launches": self.launches,
                 "coalesced_launches": self.coalesced_launches,
                 "coalesced_tasks": self.coalesced_tasks,
                 "batched_launches": self.batched_launches,
+                "batched_rows_launches": self.batched_rows_launches,
+                "fused_launches": self.fused_launches,
+                "fused_tasks": self.fused_tasks,
+                "window_waits": self.window_waits,
                 "busy_rejects": self.busy_rejects,
                 "tasks_done": self.tasks_done,
+                "wait_p50_ms": round(self._pct(waits, 0.50) / 1e6, 3),
+                "wait_p99_ms": round(self._pct(waits, 0.99) / 1e6, 3),
                 "groups": {
                     g.name: {"weight": g.weight, "tasks": g.tasks,
                              "queued": len(g.queue),
@@ -350,20 +543,25 @@ class DeviceScheduler:
 # per-mesh registry: the scheduler is the mesh's single device executor
 # --------------------------------------------------------------------- #
 
-_REGISTRY: dict[int, DeviceScheduler] = {}
+_REGISTRY: dict = {}
 _REG_MU = threading.Lock()
 
 
 def scheduler_for(mesh) -> DeviceScheduler:
     """The (process-wide) scheduler owning launches onto `mesh`.  Keyed
-    by mesh identity: every Domain sharing a mesh shares its admission
-    queue — device capacity is global, so admission must be too."""
+    by the mesh FINGERPRINT (axis names + shape + device ids), not
+    id(mesh): device capacity belongs to the chips, so every Domain —
+    and every rebuilt Mesh object over the same chips — must share one
+    admission queue, and an id() key could false-hit when the allocator
+    reuses a dead mesh's address (the columnar device-cache bug)."""
+    from .task import mesh_fingerprint
+    fp = mesh_fingerprint(mesh)
     with _REG_MU:
-        s = _REGISTRY.get(id(mesh))
+        s = _REGISTRY.get(fp)
         if s is None:
-            s = _REGISTRY[id(mesh)] = DeviceScheduler()
+            s = _REGISTRY[fp] = DeviceScheduler()
         return s
 
 
 __all__ = ["DeviceScheduler", "scheduler_for", "DEFAULT_QUEUE_DEPTH",
-           "DEFAULT_MAX_COALESCE"]
+           "DEFAULT_MAX_COALESCE", "WINDOW_CAP_US"]

@@ -92,12 +92,13 @@ class CopTask:
 
     __slots__ = ("key", "dag", "mesh", "row_capacity", "cols", "counts",
                  "aux", "input_token", "fn", "group", "weight",
-                 "submit_ns", "start_ns", "wait_ns", "coalesced",
-                 "cancelled", "_done", "_value", "_exc", "est_rows")
+                 "submit_ns", "start_ns", "wait_ns", "coalesced", "fused",
+                 "fusion_key", "cancelled", "_done", "_value", "_exc",
+                 "est_rows")
 
     def __init__(self, *, key=None, dag=None, mesh=None, row_capacity=0,
                  cols=None, counts=None, aux=(), input_token=None,
-                 fn: Optional[Callable[[], Any]] = None,
+                 fusion_key=None, fn: Optional[Callable[[], Any]] = None,
                  group: Optional[str] = None,
                  weight: Optional[float] = None, est_rows: int = 0):
         if group is None:
@@ -112,6 +113,7 @@ class CopTask:
         self.counts = counts
         self.aux = aux
         self.input_token = input_token
+        self.fusion_key = fusion_key
         self.fn = fn
         self.group = group
         self.weight = float(weight or DEFAULT_WEIGHT)
@@ -120,6 +122,7 @@ class CopTask:
         self.start_ns = 0
         self.wait_ns = 0
         self.coalesced = 1        # tasks served by this task's launch
+        self.fused = 0            # member programs in this task's launch
         self.cancelled = False
         self._done = threading.Event()
         self._value = None
@@ -131,17 +134,29 @@ class CopTask:
     def structured(cls, dag, mesh, row_capacity, cols, counts, aux,
                    est_rows: int = 0) -> "CopTask":
         from ..copr.dag import dag_digest
-        key = (dag_digest(dag), mesh_fingerprint(mesh), int(row_capacity),
-               _shape_sig(cols, counts))
+        fp = mesh_fingerprint(mesh)
+        sig = _shape_sig(cols, counts)
+        key = (dag_digest(dag), fp, int(row_capacity), sig)
         # input identity for in-flight dedup: the snapshot's resident
         # device cache returns the SAME array objects per epoch, so two
         # sessions over one snapshot share ids; the task pins the refs.
         # Identity is the POINT here (same buffers = one launch serves
         # both), so id() is correct, unlike in the persistent key above.
         token = (id(cols), id(counts), id(aux))    # planlint: ok - see above
+        # cross-query fusion key (contract-aware, NO tracing): tasks
+        # sharing one snapshot scan (same resident arrays = same epoch),
+        # one mesh, and one capacity signature, whose chains are in the
+        # fusable contract class, may compute their payloads in ONE
+        # program even when their digests differ.
+        fusion_key = None
+        if aux == ():
+            from ..analysis.contracts import fusion_signature
+            fsig = fusion_signature(dag)
+            if fsig is not None:
+                fusion_key = (token, fp, sig, fsig)
         return cls(key=key, dag=dag, mesh=mesh, row_capacity=row_capacity,
                    cols=cols, counts=counts, aux=aux, input_token=token,
-                   est_rows=est_rows)
+                   fusion_key=fusion_key, est_rows=est_rows)
 
     @classmethod
     def opaque(cls, fn: Callable[[], Any], est_rows: int = 0) -> "CopTask":

@@ -112,7 +112,9 @@ class StatusServer:
             return global_registry().prometheus_text(), "text/plain"
         if path == "/sched":
             # device admission scheduler: queue depth, per-group
-            # fair-share + RU accounting, coalesce/launch counters
+            # fair-share + RU accounting, coalesce/batch/fusion launch
+            # counters, micro-batch window state, wait p50/p99, and the
+            # shared CopClient's cache/retry/paging counters ("client")
             return json.dumps(self.domain.client.sched_stats()), \
                 "application/json"
         if path == "/settings":

@@ -1111,6 +1111,12 @@ class Session:
         mc = -1 if v3 is None or v3 == "" else int(v3)
         if mc > 0:
             client.sched_max_coalesce = mc
+        v4 = merged.get("tidb_tpu_sched_fusion")
+        if v4 is not None and v4 != "":
+            client.sched_fusion = bool(int(v4))
+        v5 = merged.get("tidb_tpu_sched_window_us")
+        if v5 is not None and v5 != "" and int(v5) >= -1:
+            client.sched_window_us = int(v5)
         return ExecContext(client, merged,
                            mem_tracker=Tracker("query", quota))
 

@@ -56,6 +56,12 @@ _VARS = [
        max=1 << 16, scope=SCOPE_GLOBAL),
     _v("tidb_tpu_sched_max_coalesce", -1, kind="int", min=-1, max=64,
        scope=SCOPE_GLOBAL),
+    # cross-query kernel fusion (one scan, many payloads) and the
+    # adaptive micro-batch window: -1 = EWMA-tuned wait-for-stragglers,
+    # 0 = never hold a launch, >0 = fixed window in microseconds
+    _v("tidb_tpu_sched_fusion", 1, kind="bool", scope=SCOPE_GLOBAL),
+    _v("tidb_tpu_sched_window_us", -1, kind="int", min=-1, max=100_000,
+       scope=SCOPE_GLOBAL),
     _v("tidb_distsql_scan_concurrency", 15, kind="int", min=1, max=256),
     _v("tidb_max_chunk_size", 1024, kind="int", min=32, max=65536),
     _v("tidb_enable_vectorized_expression", 1, kind="bool"),

@@ -67,6 +67,10 @@ class CopClient:
         self._page_feedback: OrderedDict[int, float] = OrderedDict()
         self._page_feedback_cap = 512
         self.last_page_iters = 0       # observability: regrow passes
+        # counters above double as status-route payload (sched_stats
+        # "client" section): assignments happen under _stat_mu so
+        # concurrent connection threads never lose updates
+        self._stat_mu = _threading.Lock()
         # failure detection/recovery (copIterator backoff-and-retry):
         # transient dispatch errors retry under a typed backoff budget
         self.retry_budget_ms = 5000.0
@@ -109,6 +113,11 @@ class CopClient:
             "TIDB_TPU_SCHED_DISABLE", "") != "1"
         self.sched_queue_depth = -1
         self.sched_max_coalesce = -1
+        # cross-query fusion + adaptive micro-batch window knobs
+        # (tidb_tpu_sched_fusion / tidb_tpu_sched_window_us); None =
+        # scheduler defaults (fusion on, adaptive window)
+        self.sched_fusion = None
+        self.sched_window_us = None
         self._sched_obj = None
 
     @property
@@ -160,14 +169,16 @@ class CopClient:
                 fp = self._next_failpoint()
                 if fp is not None:
                     raise fp
-                self.last_retries = retries
+                with self._stat_mu:
+                    self.last_retries = retries
                 return fn()
             except RegionError as e:
                 bo.backoff(e.kind, e)
                 if snap is not None and snap.placement is not None:
                     healed = snap.placement.heal(e)
                     if healed:
-                        self.last_heals += 1
+                        with self._stat_mu:
+                            self.last_heals += 1
                 retries += 1
 
     # ------------------------------------------------------------- #
@@ -185,21 +196,34 @@ class CopClient:
         s.configure(
             self.sched_queue_depth if self.sched_queue_depth > 0 else None,
             self.sched_max_coalesce if self.sched_max_coalesce > 0
-            else None)
+            else None,
+            fusion=self.sched_fusion,
+            window_us=self.sched_window_us)
         return s
+
+    def _client_stats(self) -> dict:
+        with self._stat_mu:
+            return {"last_page_iters": self.last_page_iters,
+                    "last_retries": self.last_retries,
+                    "last_heals": self.last_heals}
 
     def sched_stats(self) -> dict:
         """Status-API introspection; never resolves a pending mesh."""
+        with self._rc_mu:
+            rc = {"result_cache_hits": self.result_cache_hits,
+                  "result_cache_misses": self.result_cache_misses}
+        client = {**self._client_stats(), **rc}
         if self._sched_obj is None:
-            return {"enabled": self.sched_enable, "started": False}
+            return {"enabled": self.sched_enable, "started": False,
+                    "client": client}
         return {"enabled": self.sched_enable, "started": True,
-                **self._sched_obj.stats()}
+                "client": client, **self._sched_obj.stats()}
 
     def _note_sched(self, task) -> None:
         from ..copr.coordinator import QUERY_HANDLE
         h = QUERY_HANDLE.get()
         if h is not None:
-            h.note_sched(task.wait_ns, task.coalesced)
+            h.note_sched(task.wait_ns, task.coalesced, task.fused)
 
     def _launch(self, dag, cols, counts, aux, row_capacity: int = 0):
         """One device launch of a sharded cop program, routed through the
@@ -265,7 +289,10 @@ class CopClient:
                 self._result_cache.move_to_end(key)
                 self.result_cache_hits += 1
                 return ent[1]
-        self.result_cache_misses += 1
+            # miss counter bumps under the same lock: the client is
+            # shared by every connection thread and an unguarded
+            # read-modify-write here loses updates under load
+            self.result_cache_misses += 1
         return None
 
     def _rc_put(self, key, snap, res: CopResult) -> None:
@@ -666,9 +693,9 @@ class CopClient:
                     max(per_shard // INITIAL_SELECTIVITY, 1)), 1024)
 
         cols, counts = snap.device_cols(self.mesh)
-        self.last_page_iters = 0
+        page_iters = 0       # published once, under _stat_mu, at the end
         for _ in range(10):  # paging: grow until fits
-            self.last_page_iters += 1
+            page_iters += 1
             prog, out = self._launch(root, cols, counts, tuple(aux_cols),
                                      row_capacity=cap)
             if prog.has_extras:
@@ -684,6 +711,8 @@ class CopClient:
             cap = _pow2_at_least(int(out_counts.max()))
         else:
             raise RuntimeError("paging loop did not converge")
+        with self._stat_mu:
+            self.last_page_iters = page_iters
 
         if not (is_topn or is_limit) and per_shard > 0:
             frac = float(out_counts.max()) / per_shard

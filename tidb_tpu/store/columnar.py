@@ -154,8 +154,14 @@ class ColumnarSnapshot:
         return dev, dev_counts
 
     def device_cols(self, mesh) -> tuple[list, Any]:
+        # keyed on the mesh's stable FINGERPRINT (axis names + shape +
+        # device ids), not id(mesh): the resident cache must survive a
+        # Domain rebuilding its Mesh object over the same chips, and an
+        # id() key could false-hit when the allocator reuses a dead
+        # mesh's address (the same bug PR 2 fixed for sched task keys)
+        from ..sched.task import mesh_fingerprint
         p_epoch = self.placement.epoch if self.placement is not None else -1
-        key = (id(mesh), self.epoch, p_epoch)
+        key = (mesh_fingerprint(mesh), self.epoch, p_epoch)
         if key in self._device_cache:
             return self._device_cache[key]
         put = self._put(mesh)
