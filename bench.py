@@ -461,6 +461,12 @@ def mode_sched():
         "sched_wait_p50_ms": st["wait_p50_ms"],
         "sched_wait_p99_ms": st["wait_p99_ms"],
         "window_waits": st["window_waits"],
+        # window feedback + HBM-budget admission (analysis/copcost):
+        # hold hit-rate and the static footprint of the last launch,
+        # for cross-run comparison against --cost-report predictions
+        "window_hits": st.get("window_hits", 0),
+        "budget_deferrals": st.get("budget_deferrals", 0),
+        "last_launch_bytes": st.get("last_launch_bytes", 0),
     }
     log("sched-concurrent:", json.dumps(out))
     os.makedirs(DATA_DIR, exist_ok=True)

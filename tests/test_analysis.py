@@ -89,7 +89,10 @@ def test_explain_reports_contract_ok(corpus):
     s, _plans = corpus
     rows = s.must_query(
         "explain select count(*) from lineitem where l_quantity < 5")
-    assert rows[-1][0] == "contract: ok", rows
+    # footer order: contract verdict, then the static cost estimate
+    assert rows[-2][0] == "contract: ok", rows
+    assert rows[-1][0].startswith("est. device bytes: "), rows
+    assert "padding" in rows[-1][0], rows
 
 
 # ------------------------------------------------------------------ #
@@ -357,6 +360,62 @@ def test_lint_psum_fence():
         with open(os.path.join(root, rel), encoding="utf-8") as f:
             assert not [r for r in _rules(f.read(), rel)
                         if r == "TPU-PSUM-FENCE"], rel
+
+
+def test_lint_dtype_x64():
+    """Weak-typed jnp creation in traced modules is x64-flag-dependent:
+    int64 today only because tidb_tpu enables jax_enable_x64."""
+    src = "import jax.numpy as jnp\n\ndef f(n):\n    return jnp.arange(n)\n"
+    assert _rules(src, "copr/exec.py") == ["TPU-DTYPE-X64"]
+    # same code outside a traced module: silent
+    assert _rules(src, "store/client.py") == []
+    # an explicit dtype (keyword or positional slot) clears it
+    ok = ("import jax.numpy as jnp\n\n"
+          "def f(n):\n"
+          "    a = jnp.arange(n, dtype=jnp.int64)\n"
+          "    b = jnp.zeros(n, jnp.int32)\n"
+          "    return a + b\n")
+    assert _rules(ok, "copr/exec.py") == []
+    # 64-bit scalar constructors silently narrow when x64 is off
+    s64 = ("import jax.numpy as jnp\n\ndef f():\n    return jnp.uint64(7)\n")
+    assert _rules(s64, "parallel/window.py") == ["TPU-DTYPE-X64"]
+    # inline waiver works like every other rule
+    waived = ("import jax.numpy as jnp\n\n"
+              "def f(n):\n"
+              "    return jnp.arange(n)  # planlint: ok - mask index\n")
+    assert _rules(waived, "copr/exec.py") == []
+    # regression: the traced modules are pinned (only the baselined
+    # 64-bit scalar constructors remain)
+    import os
+
+    import tidb_tpu
+    from tidb_tpu.analysis.lint import TRACED_MODULES
+    root = os.path.dirname(tidb_tpu.__file__)
+    for rel in sorted(TRACED_MODULES):
+        with open(os.path.join(root, rel), encoding="utf-8") as f:
+            found = [r for r in _rules(f.read(), rel)
+                     if r == "TPU-DTYPE-X64"]
+        assert len(found) <= 2, (rel, found)
+
+
+def test_stale_baseline_detection():
+    """--check-baseline fails on waiver rot: baseline entries that no
+    current finding matches; partial runs only judge their own rule
+    family."""
+    from tidb_tpu.analysis.__main__ import _stale_keys
+    from tidb_tpu.analysis.lint import Finding
+    findings = [Finding("TPU-DIGEST", "a.py", 1, "f", "m"),
+                Finding("COST-PAD-WASTE", "corpus/q01", 0, "scan", "m")]
+    baseline = {"TPU-DIGEST a.py::f", "COST-PAD-WASTE corpus/q01::scan",
+                "TPU-DIGEST gone.py::g", "COST-CAP-BLOWUP corpus/q99::j"}
+    assert _stale_keys(findings, baseline, False, False) == {
+        "TPU-DIGEST gone.py::g", "COST-CAP-BLOWUP corpus/q99::j"}
+    # --lint-only must not misreport COST waivers as rotten (no cost
+    # pass ran), and --contracts-only the reverse
+    assert _stale_keys(findings, baseline, True, False) == {
+        "TPU-DIGEST gone.py::g"}
+    assert _stale_keys(findings, baseline, False, True) == {
+        "COST-CAP-BLOWUP corpus/q99::j"}
 
 
 def test_lint_waivers():

@@ -10,15 +10,28 @@ import sys
 import tidb_tpu
 
 
-def test_analysis_gate_exits_zero():
+def _run_gate(*flags):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(
         tidb_tpu.__file__)))
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("TIDB_TPU_VERIFY_PLAN", None)
-    proc = subprocess.run(
-        [sys.executable, "-m", "tidb_tpu.analysis"],
+    return subprocess.run(
+        [sys.executable, "-m", "tidb_tpu.analysis", *flags],
         cwd=repo, env=env, capture_output=True, text=True, timeout=420)
+
+
+def test_analysis_gate_exits_zero():
+    proc = _run_gate()
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "analysis gate: ok" in proc.stdout, proc.stdout
     assert "0 violations" in proc.stdout, proc.stdout
+
+
+def test_check_baseline_passes():
+    """Baseline hygiene (ISSUE 4 satellite): every accepted-findings
+    entry must still match a current finding, so waivers cannot rot
+    silently."""
+    proc = _run_gate("--check-baseline")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "baseline clean" in proc.stdout, proc.stdout

@@ -1,6 +1,7 @@
-"""Static analysis gate: plan-contract verifier + TPU-hygiene linter.
+"""Static analysis gate: plan-contract verifier + TPU-hygiene linter +
+shape/memory cost model.
 
-Two passes, both wired into CI as a zero-findings gate
+Three passes, all wired into CI as a zero-findings gate
 (``python -m tidb_tpu.analysis``):
 
 - contracts: every physical operator declares a contract (output dtypes,
@@ -11,8 +12,17 @@ Two passes, both wired into CI as a zero-findings gate
   (verify_task), and EXPLAIN (verified plans report ``contract: ok``).
 - lint: an AST linter over tidb_tpu/ with repo-specific TPU-hygiene
   rules (tracer leaks, digest instability, host transfers in hot paths,
-  broad exception handlers, lock-order hazards).  Pre-existing accepted
-  findings live in analysis/baseline.txt; anything new fails the gate.
+  broad exception handlers, lock-order hazards, x64-flag-dependent
+  dtypes).  Pre-existing accepted findings live in analysis/baseline.txt;
+  anything new fails the gate.
+- copcost: a static shape/memory abstract interpreter that walks built
+  cop DAGs using only contracts (padded device shapes from DENSE
+  domain_sizes / SORT capacities, physical dtype widths, per-shard
+  extents under the mesh) and rolls up a per-launch LaunchCost
+  (peak HBM bytes, transfer bytes, flops, padding waste).  Gate rules
+  COST-PAD-WASTE / COST-CAP-BLOWUP / COST-UNBOUNDED ride the corpus;
+  sched admission enforces peak_hbm_bytes against a per-mesh budget
+  (CostError, pre-trace) and EXPLAIN surfaces the estimate.
 
 The motivation is the compiler-first failure mode: with XLA-compiled cop
 programs a bad plan no longer fails with a type error at build time — it
@@ -24,7 +34,9 @@ gate between planner/build and jit.
 
 from .contracts import (PlanContractError, verify_dag, verify_plan,
                         verify_task)
+from .copcost import CostError, LaunchCost, plan_cost, task_cost
 from .lint import Finding, lint_source, lint_tree, load_baseline
 
 __all__ = ["PlanContractError", "verify_plan", "verify_dag", "verify_task",
+           "CostError", "LaunchCost", "plan_cost", "task_cost",
            "Finding", "lint_tree", "lint_source", "load_baseline"]

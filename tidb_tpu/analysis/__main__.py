@@ -1,19 +1,28 @@
 """CI gate: ``python -m tidb_tpu.analysis``.
 
-Runs both static passes and exits non-zero on any NEW finding:
+Runs three static passes and exits non-zero on any NEW finding:
 
 1. TPU-hygiene lint over the whole tidb_tpu/ tree, diffed against the
    accepted-findings allowlist (analysis/baseline.txt) — pre-existing
    accepted findings pass, new ones fail.
-2. Plan-contract verification over the TPC-H plan corpus
-   (testing/tpch.TPCH_PLAN_QUERIES): every statement is planned (never
-   executed — no trace, no compile, no device) and walked by
-   analysis.verify_plan; any PlanContractError fails the gate.
+2. Cost analysis (analysis/copcost) over the TPC-H plan corpus: every
+   statement is planned (never executed — no trace, no compile, no
+   device) and its static device footprint rolled up; COST-PAD-WASTE /
+   COST-CAP-BLOWUP / COST-UNBOUNDED findings baseline exactly like lint
+   findings.
+3. Plan-contract verification over the same corpus plans
+   (analysis.verify_plan); any PlanContractError fails the gate.
 
 Flags:
     --lint-only / --contracts-only   run one pass
     --update-baseline                rewrite baseline.txt from the
-                                     current findings (reviewed use only)
+                                     current lint+cost findings
+                                     (reviewed use only)
+    --check-baseline                 fail when baseline.txt contains
+                                     entries no current finding matches
+                                     (waiver-rot hygiene)
+    --cost-report                    print the per-corpus-query cost
+                                     table (bytes/flops/padding) and exit
 """
 
 from __future__ import annotations
@@ -23,52 +32,93 @@ import sys
 
 # plan building never needs a device, but imports touch jax; pin the CPU
 # backend so the gate runs identically on dev boxes, CI, and TPU hosts
-# (and never blocks on TPU acquisition)
+# (and never blocks on TPU acquisition).  8 virtual devices = the mesh
+# the cost model's corpus predictions are validated against.
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
+GATE_DEVICES = 8
 
-def _run_lint(update_baseline: bool) -> int:
-    from .lint import lint_tree, load_baseline, new_findings
-    findings = lint_tree()
-    base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             "baseline.txt")
-    if update_baseline:
-        keys = sorted({f.key() for f in findings})
-        with open(base_path, "w", encoding="utf-8") as f:
-            f.write("# planlint accepted findings (RULE path::symbol); "
-                    "regenerate with\n# python -m tidb_tpu.analysis "
-                    "--update-baseline, review the diff.\n")
-            for k in keys:
-                f.write(k + "\n")
-        print(f"planlint: baseline rewritten with {len(keys)} keys")
-        return 0
-    baseline = load_baseline(base_path)
+
+def _baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.txt")
+
+
+def _corpus_plans() -> list:
+    from ..testing.tpch import built_tpch_plans, tpch_plan_session
+    return list(built_tpch_plans(tpch_plan_session()))
+
+
+def _gather_findings(lint_only: bool, contracts_only: bool):
+    """(findings, plans): the baseline-diffable findings of the selected
+    passes plus the corpus plans (reused by the contracts pass so the
+    corpus is planned once per gate run)."""
+    findings: list = []
+    plans = None
+    if not contracts_only:
+        from .lint import lint_tree
+        findings += lint_tree()
+    if not lint_only:
+        from .copcost import cost_findings
+        plans = _corpus_plans()
+        findings += cost_findings(plans, n_devices=GATE_DEVICES)
+    return findings, plans
+
+
+def _write_baseline(findings) -> int:
+    keys = sorted({f.key() for f in findings})
+    with open(_baseline_path(), "w", encoding="utf-8") as f:
+        f.write("# planlint accepted findings (RULE path::symbol); "
+                "regenerate with\n# python -m tidb_tpu.analysis "
+                "--update-baseline, review the diff.\n")
+        for k in keys:
+            f.write(k + "\n")
+    print(f"planlint: baseline rewritten with {len(keys)} keys")
+    return 0
+
+
+def _stale_keys(findings, baseline, lint_only: bool,
+                contracts_only: bool) -> set:
+    """Baseline entries no current finding matches.  Partial runs only
+    judge the rule families they actually computed, so --lint-only
+    cannot misreport COST-* waivers as rotten (and vice versa)."""
+    current = {f.key() for f in findings}
+    stale = set()
+    for k in baseline - current:
+        is_cost = k.startswith("COST-")
+        if lint_only and is_cost:
+            continue
+        if contracts_only and not is_cost:
+            continue
+        stale.add(k)
+    return stale
+
+
+def _run_findings(findings, baseline, stale) -> int:
+    from .lint import new_findings
     fresh = new_findings(findings, baseline)
     for f in fresh:
         print(f"NEW {f}")
-    stale = baseline - {f.key() for f in findings}
     if stale:
-        print(f"planlint: note: {len(stale)} baseline entries no longer "
-              "fire (safe to prune)")
+        print(f"planlint: WARNING: {len(stale)} baseline entries no "
+              "longer fire (prune them; --check-baseline enforces)")
     print(f"planlint: {len(findings)} findings "
           f"({len(findings) - len(fresh)} baselined, {len(fresh)} new)")
     return 1 if fresh else 0
 
 
-def _run_contracts() -> int:
-    from ..testing.tpch import (TPCH_PLAN_QUERIES, TPCH_SHUFFLE_QUERIES,
-                                built_tpch_plans, tpch_plan_session)
+def _run_contracts(plans) -> int:
+    from ..testing.tpch import TPCH_PLAN_QUERIES, TPCH_SHUFFLE_QUERIES
     from .contracts import PlanContractError, verify_plan
-    session = tpch_plan_session()
     total = len(TPCH_PLAN_QUERIES) + len(TPCH_SHUFFLE_QUERIES)
     bad = 0
     checked_ops = 0
     n = 0
-    for sql, phys in built_tpch_plans(session):
+    for sql, phys in plans:
         n += 1
         try:
             checked_ops += verify_plan(phys)
@@ -86,11 +136,32 @@ def main(argv=None) -> int:
     lint_only = "--lint-only" in argv
     contracts_only = "--contracts-only" in argv
     update = "--update-baseline" in argv
-    rc = 0
-    if not contracts_only:
-        rc |= _run_lint(update)
-    if not lint_only and not update:
-        rc |= _run_contracts()
+    check_baseline = "--check-baseline" in argv
+    if "--cost-report" in argv:
+        from .copcost import cost_report
+        print(cost_report(_corpus_plans(), n_devices=GATE_DEVICES))
+        return 0
+    if check_baseline:
+        # hygiene pass: waivers must not rot silently — every baseline
+        # entry must still match a current finding (full gather, so the
+        # verdict covers both rule families)
+        lint_only = contracts_only = False
+    findings, plans = _gather_findings(lint_only, contracts_only)
+    if update:
+        return _write_baseline(findings)
+    from .lint import load_baseline
+    baseline = load_baseline(_baseline_path())
+    stale = _stale_keys(findings, baseline, lint_only, contracts_only)
+    if check_baseline:
+        for k in sorted(stale):
+            print(f"STALE {k}")
+        print(f"planlint: baseline {'rotten' if stale else 'clean'}: "
+              f"{len(stale)} of {len(baseline)} entries match no "
+              "current finding")
+        return 1 if stale else 0
+    rc = _run_findings(findings, baseline, stale)
+    if not lint_only:
+        rc |= _run_contracts(plans)
     if rc == 0:
         print("analysis gate: ok")
     return rc

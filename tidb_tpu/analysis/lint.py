@@ -40,6 +40,15 @@ Rules
                    raise): int/decimal SUM (hi, lo) limb states merged
                    by an UNFENCED in-program psum silently wrap past
                    2^31 contributing rows — wrong answers, no error.
+- TPU-DTYPE-X64    weak-typed jnp array creation in a traced module
+                   (jnp.arange/zeros/ones/full/linspace/eye with no
+                   dtype, or a jnp.int64/uint64/float64 scalar
+                   constructor): these produce 64-bit values only
+                   because tidb_tpu/__init__ turns jax_enable_x64 on.
+                   An embedder that leaves JAX's x64-disabled default in
+                   place gets silently truncated int32/float32 lanes on
+                   TPU — wrong join keys and sums, green CPU tests.
+                   Pin dtype= explicitly.
 
 Inline waiver: any rule is suppressed by a `# planlint: ok` comment on
 the offending line (give a reason after it).
@@ -77,6 +86,15 @@ LOCK_MODULES = {
 
 _DIGEST_NAME = re.compile(r"key|digest|token|fingerprint|signature",
                           re.IGNORECASE)
+
+# jnp creation calls whose result dtype rides the x64 flag when no dtype
+# is given, and the positional slot (0-based) a dtype may occupy.  -1 =
+# dtype only arrives by keyword (arange's positionals are start/stop/
+# step; linspace's are start/stop/num).
+_X64_CREATORS = {"arange": -1, "zeros": 1, "ones": 1, "empty": 1,
+                 "full": 2, "linspace": -1, "eye": -1}
+# 64-bit scalar constructors: silently 32-bit when x64 is off
+_X64_SCALARS = {"int64", "uint64", "float64"}
 _WAIVER = re.compile(r"planlint:\s*ok")
 _BLE_WAIVER = re.compile(r"noqa:.*BLE001|planlint:\s*ok")
 
@@ -273,6 +291,8 @@ class _ExprRules(_Scoped):
                          "wrap silently past 2^31 contributing rows — "
                          "add a *_psum_limb_fence capacity check that "
                          "raises OverflowError before launch")
+            # TPU-DTYPE-X64: dtype decided by the x64 flag, not the code
+            self._check_x64(node, name)
         # TPU-HOST-SYNC
         if self.hot:
             if name == "device_get" and isinstance(node.func,
@@ -289,6 +309,35 @@ class _ExprRules(_Scoped):
         if self._digest_fn > 0:
             self._check_digest_call(node)
         self.generic_visit(node)
+
+    def _check_x64(self, node: ast.Call, name: str) -> None:
+        """Weak-typed jnp creation in a traced module: the value is
+        int64/float64 only while jax_enable_x64 stays on (tidb_tpu
+        enables it at import); under JAX's default it silently narrows
+        to 32 bits on TPU while CPU tests (same flag) stay green."""
+        f = node.func
+        if not (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+                and f.value.id == "jnp"):
+            return
+        if name in _X64_SCALARS:
+            self.add("TPU-DTYPE-X64", node,
+                     f"jnp.{name}(...) yields a 32-bit value when "
+                     "jax_enable_x64 is off — construct via jnp.asarray"
+                     "(x, dtype=...) with an explicit np dtype")
+            return
+        slot = _X64_CREATORS.get(name)
+        if slot is None:
+            return
+        if any(kw.arg == "dtype" for kw in node.keywords):
+            return
+        if 0 <= slot < len(node.args):
+            return                      # dtype passed positionally
+        self.add("TPU-DTYPE-X64", node,
+                 f"jnp.{name}(...) without an explicit dtype is "
+                 "x64-flag-dependent: int64/float64 only because "
+                 "tidb_tpu enables jax_enable_x64 — pin dtype= so an "
+                 "embedder's x64-off default cannot silently narrow "
+                 "device lanes to 32 bits")
 
     def visit_ExceptHandler(self, node):
         broad = node.type is None

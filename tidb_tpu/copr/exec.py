@@ -58,7 +58,7 @@ class DeviceBatch:
 def _ensure_array(v, n):
     if hasattr(v, "shape") and v.shape:
         return v
-    return jnp.full((n,), v)
+    return jnp.full((n,), v)  # planlint: ok - dtype follows the operand
 
 
 def _sel_array(sel, n):
@@ -301,12 +301,12 @@ def _agg_sort_states(agg: D.Aggregation, batch: DeviceBatch, ev: Evaluator,
     ops: list = [dead]
     for _vz, _m, nullf, code in keyinfo:
         ops += [nullf, code]
-    ops.append(jnp.arange(n))
+    ops.append(jnp.arange(n, dtype=jnp.int64))
     *sorted_keys, idx = lax.sort(tuple(ops), num_keys=1 + 2 * len(keyinfo))
     sel_s = sel[idx]
 
     # group boundary: live row whose key tuple differs from the previous
-    diff = jnp.arange(n) == 0
+    diff = jnp.arange(n, dtype=jnp.int64) == 0
     for j in range(len(keyinfo)):
         nf_s, cd_s = sorted_keys[1 + 2 * j], sorted_keys[2 + 2 * j]
         diff = diff | (nf_s != jnp.roll(nf_s, 1)) | (cd_s != jnp.roll(cd_s, 1))
@@ -390,7 +390,7 @@ def _exec_node(node: D.CopNode, scan_cols: Sequence, row_count, ev: Evaluator,
         cols = [scan_cols[off] for off in node.col_offsets]
         n = len(cols[0][0]) if cols else 0
         if getattr(row_count, "ndim", 0) == 0:
-            sel = jnp.arange(n) < row_count
+            sel = jnp.arange(n, dtype=jnp.int64) < row_count
         else:
             # caller supplied a precomputed live-row mask (e.g. several
             # flattened shards with per-shard row counts, parallel/spmd.py)
@@ -541,11 +541,12 @@ def _exec_topn(node: D.TopN, batch: DeviceBatch, ev: Evaluator) -> DeviceBatch:
                 else jnp.where(m, 0, 1).astype(jnp.int32)
         operands += [nullflag, key]
     nk = len(operands)
-    *_, idx = lax.sort(tuple(operands) + (jnp.arange(n),), num_keys=nk)
+    *_, idx = lax.sort(tuple(operands)
+                       + (jnp.arange(n, dtype=jnp.int64),), num_keys=nk)
     k = min(node.limit, n)
     idx = idx[:k]
     live = jnp.sum(sel)
-    out_sel = jnp.arange(k) < jnp.minimum(live, k)
+    out_sel = jnp.arange(k, dtype=jnp.int64) < jnp.minimum(live, k)
     cols = []
     for cv, cm in batch.cols:
         cv = _ensure_array(cv, n)

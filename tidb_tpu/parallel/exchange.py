@@ -54,7 +54,7 @@ def all_to_all_exchange(cols: Sequence, valid, keys, n_dev: int,
     pid = hash_partition_ids(keys, n_dev)
     pid = jnp.where(valid, pid, n_dev)           # dead rows -> dropped
     # position of each row within its destination bucket
-    onehot = pid[:, None] == jnp.arange(n_dev)[None, :]
+    onehot = pid[:, None] == jnp.arange(n_dev, dtype=jnp.int64)[None, :]
     pos_in_bucket = jnp.cumsum(onehot, axis=0) - 1
     pos = jnp.take_along_axis(pos_in_bucket,
                               jnp.clip(pid, 0, n_dev - 1)[:, None],

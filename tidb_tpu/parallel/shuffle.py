@@ -132,9 +132,10 @@ class ShardedShuffleJoinProgram:
         nb = bkeys.shape[0]
         bdead = (~(bvalid & bkey_ok)).astype(jnp.int32)
         _sdead, skey, perm = lax.sort(
-            (bdead, bkeys, jnp.arange(nb)), num_keys=2)
+            (bdead, bkeys, jnp.arange(nb, dtype=jnp.int64)), num_keys=2)
         n_live = jnp.sum(1 - bdead)
-        skey = jnp.where(jnp.arange(nb) < n_live, skey, INT64_MAX)
+        skey = jnp.where(jnp.arange(nb, dtype=jnp.int64) < n_live,
+                         skey, INT64_MAX)
 
         probe_ok = pvalid & pkey_ok
         lo, _hi, cnt = match_ranges(skey, n_live, pkeys, probe_ok)

@@ -66,7 +66,8 @@ def _collective_merge(states: dict, axis: str, n_dev: int) -> dict:
 def _flatten_block(cols, counts):
     """(S_local, C) blocks -> one (S_local*C,) batch + live-row mask."""
     s, c = cols[0][0].shape
-    base_sel = (jnp.arange(c)[None, :] < counts[:, None]).reshape(-1)
+    base_sel = (jnp.arange(c, dtype=jnp.int64)[None, :]
+                < counts[:, None]).reshape(-1)
     flat = [(v.reshape(-1), None if m is None else m.reshape(-1))
             for v, m in cols]
     return flat, base_sel

@@ -147,10 +147,11 @@ class ShardedWindowProgram:
         ok_ops = _key_operands(r_ok, [d for _e, d in spec.order_keys])
         operands = [dead] + pk_ops + ok_ops
         nk = len(operands)
-        *_, order = lax.sort(tuple(operands) + (jnp.arange(m_rows),),
-                             num_keys=nk)
+        *_, order = lax.sort(
+            tuple(operands) + (jnp.arange(m_rows, dtype=jnp.int64),),
+            num_keys=nk)
         valid_s = rvalid[order]
-        iota = jnp.arange(m_rows)
+        iota = jnp.arange(m_rows, dtype=jnp.int64)
 
         def changed(ops):
             """Row differs from its predecessor on any sorted operand."""

@@ -60,6 +60,13 @@ WINDOW_CAP_US = 1000
 # cannot poison the estimate forever (it recovers in a few arrivals)
 WINDOW_GAP_CLAMP_NS = 50_000_000
 WAIT_SAMPLES = 2048              # ring of recent task waits (p50/p99)
+# window FEEDBACK (ROADMAP item): per-key EWMA of whether a hold actually
+# yielded riders.  A key whose holds rarely pay decays its window toward
+# zero (scale = min(1, hit/0.5)); below the floor the hold is skipped
+# outright until a hit recovers the estimate.
+WINDOW_HIT_INIT = 0.5            # optimistic prior: full window at start
+WINDOW_HIT_ALPHA = 0.25          # EWMA step per observed hold outcome
+WINDOW_HIT_FLOOR = 0.05          # scale cutoff: ~10 straight misses
 
 
 def _verify_enabled() -> bool:
@@ -96,6 +103,11 @@ class DeviceScheduler:
         self.fusion_enable = True         # tidb_tpu_sched_fusion
         self.window_us = -1               # tidb_tpu_sched_window_us
                                           # (-1 adaptive, 0 off, >0 fixed)
+        # per-mesh HBM admission budget (tidb_tpu_sched_hbm_budget):
+        # -1 = derive from device memory stats on first structured
+        # submit (CPU fallback constant), 0 = unlimited, >0 = bytes
+        self.hbm_budget = -1
+        self._auto_budget: Optional[int] = None
         self._mu = threading.Lock()
         self._cv = threading.Condition(self._mu)
         self._groups: dict[str, _GroupQ] = {}
@@ -104,9 +116,11 @@ class DeviceScheduler:
         self._thread: Optional[threading.Thread] = None
         self._paused = False
         # micro-batch window bookkeeping: fusion key -> last arrival ns /
-        # EWMA arrival gap ns (tiny dicts, cleared when they grow)
+        # EWMA arrival gap ns / EWMA hold hit-rate (tiny dicts, cleared
+        # when they grow)
         self._fk_last: dict = {}
         self._fk_gap: dict = {}
+        self._fk_hit: dict = {}
         # recent task waits, for p50/p99 on /sched and in bench
         self._wait_ring: deque = deque(maxlen=WAIT_SAMPLES)
         # lifetime counters (read by /sched, tests, metrics mirror them)
@@ -118,7 +132,13 @@ class DeviceScheduler:
         self.fused_launches = 0           # cross-query fused launches
         self.fused_tasks = 0              # tasks served by a fused launch
         self.window_waits = 0             # drains that held for stragglers
+        self.window_hits = 0              # holds that actually gained riders
         self.busy_rejects = 0
+        # HBM-budget admission accounting (analysis/copcost LaunchCost)
+        self.budget_admitted = 0          # structured tasks costed + admitted
+        self.budget_rejects = 0           # solo programs over budget (CostError)
+        self.budget_deferrals = 0         # riders left queued by footprint cap
+        self.last_launch_bytes = 0        # footprint of the last served batch
         self.tasks_done = 0
         from ..utils.metrics import global_registry
         reg = global_registry()
@@ -139,6 +159,20 @@ class DeviceScheduler:
                                      "admission queue wait")
         self._m_ru = reg.counter("tidb_tpu_sched_ru_total",
                                  "request units launched", labels=("group",))
+        self._m_budget = reg.gauge("tidb_tpu_sched_hbm_budget_bytes",
+                                   "per-mesh HBM admission budget")
+        self._m_launch_bytes = reg.gauge(
+            "tidb_tpu_sched_launch_bytes",
+            "estimated device bytes of the last served launch")
+        self._m_badmit = reg.counter(
+            "tidb_tpu_sched_budget_admitted_total",
+            "structured tasks admitted under the HBM budget")
+        self._m_brej = reg.counter(
+            "tidb_tpu_sched_budget_rejects_total",
+            "tasks rejected pre-trace: footprint over the HBM budget")
+        self._m_bdefer = reg.counter(
+            "tidb_tpu_sched_budget_deferrals_total",
+            "riders deferred from a launch by the summed-footprint cap")
 
     # ------------------------------------------------------------- #
     # admission
@@ -147,9 +181,11 @@ class DeviceScheduler:
     def configure(self, max_depth: Optional[int] = None,
                   max_coalesce: Optional[int] = None,
                   fusion: Optional[bool] = None,
-                  window_us: Optional[int] = None) -> None:
+                  window_us: Optional[int] = None,
+                  hbm_budget: Optional[int] = None) -> None:
         """Apply sysvar knobs; negative/None = keep current (window_us
-        is the exception: -1 means adaptive, 0 disables the hold)."""
+        and hbm_budget are the exceptions: -1 means adaptive/auto,
+        0 disables the hold / the budget)."""
         if max_depth is not None and max_depth > 0:
             self.max_depth = max_depth
         if max_coalesce is not None and max_coalesce > 0:
@@ -158,17 +194,80 @@ class DeviceScheduler:
             self.fusion_enable = bool(fusion)
         if window_us is not None and window_us >= -1:
             self.window_us = int(window_us)
+        if hbm_budget is not None and hbm_budget >= -1:
+            self.hbm_budget = int(hbm_budget)
+
+    # ---- HBM-budget admission (analysis/copcost) -------------------- #
+
+    def effective_budget(self, mesh=None) -> int:
+        """Resolved per-mesh budget in bytes; 0 = unlimited.  -1 (auto)
+        derives from the mesh's device memory stats once, with a host
+        fallback on backends that report none (CPU meshes)."""
+        b = self.hbm_budget
+        if b >= 0:
+            return b
+        if self._auto_budget is None:
+            if mesh is None:
+                return 0          # nothing to derive from yet
+            from ..analysis.copcost import mesh_hbm_budget
+            self._auto_budget = mesh_hbm_budget(mesh)
+            self._m_budget.set(self._auto_budget)
+        return self._auto_budget
+
+    def _admit_cost(self, task: CopTask) -> None:
+        """Static-footprint gate, run in the submitting thread BEFORE
+        the drain loop could trace/compile anything: the task's
+        LaunchCost (abstract shape/bytes walk, array metadata only) must
+        fit the per-mesh budget, and every device node must have a
+        statically derivable bound."""
+        from ..analysis.copcost import CostError, format_bytes, task_cost
+        cost = task.cost = task_cost(task)
+        if cost is None:
+            return
+        p = ("sched", type(task.dag).__name__)
+        if cost.unbounded:
+            raise CostError(
+                "cost-unbounded", p,
+                "no static device-footprint bound derivable for "
+                f"{', '.join(cost.unbounded)}")
+        budget = self.effective_budget(task.mesh)
+        if budget > 0 and cost.peak_hbm_bytes > budget:
+            with self._mu:
+                self.budget_rejects += 1
+            self._m_brej.inc()
+            raise CostError(
+                "hbm-budget", p,
+                f"estimated peak device bytes "
+                f"{format_bytes(cost.peak_hbm_bytes)} exceed the mesh "
+                f"admission budget {format_bytes(budget)} "
+                "(tidb_tpu_sched_hbm_budget)")
+        with self._mu:
+            self.budget_admitted += 1
+        self._m_badmit.inc()
+
+    @staticmethod
+    def _marginal_bytes(t: CopTask, lead: CopTask) -> int:
+        """Bytes a rider ADDS to lead's launch: its payload only when it
+        shares lead's resident scan (fusion / in-flight dedup), its full
+        footprint when it brings distinct inputs (batch-slot stacking)."""
+        if t.cost is None:
+            return 0
+        if t.input_token == lead.input_token:
+            return t.cost.peak_hbm_bytes - t.cost.input_bytes
+        return t.cost.peak_hbm_bytes
 
     def submit(self, task: CopTask) -> CopTask:
         """Enqueue; raises ServerBusyError when the bounded queue is
         full (backpressure instead of unbounded buffering).  Structured
-        tasks are contract-verified on admission — a malformed task
-        (capacity-shape drift, stale mesh key, invalid DAG) is rejected
-        with PlanContractError HERE, in the submitting thread, before
-        the drain loop would trace/compile anything."""
+        tasks are contract-verified AND cost-gated on admission — a
+        malformed task (capacity-shape drift, stale mesh key, invalid
+        DAG) or an over-budget program is rejected with a structured
+        PlanContractError/CostError HERE, in the submitting thread,
+        before the drain loop would trace/compile anything."""
         if task.key is not None and _verify_enabled():
             from ..analysis.contracts import verify_task
             verify_task(task)
+            self._admit_cost(task)
         with self._cv:
             if self._depth >= self.max_depth:
                 self.busy_rejects += 1
@@ -232,6 +331,7 @@ class DeviceScheduler:
         if len(self._fk_last) > 256:      # hot keys are few; stay tiny
             self._fk_last.clear()
             self._fk_gap.clear()
+            self._fk_hit.clear()
         last = self._fk_last.get(fk)
         self._fk_last[fk] = task.submit_ns
         if last is None:
@@ -244,8 +344,11 @@ class DeviceScheduler:
     def _window_ns(self, lead) -> int:
         """How long the drain may hold `lead` waiting for stragglers.
         Fixed when the sysvar pins it; adaptive (-1) holds 2x the key's
-        EWMA arrival gap, and only when that fits the cap — a key whose
-        matches arrive slowly never delays its own launch."""
+        EWMA arrival gap SCALED by the key's observed hold hit-rate
+        (window feedback: a key whose holds rarely yield riders decays
+        its window toward zero and stops paying the hold at all), and
+        only when the base window fits the cap — a key whose matches
+        arrive slowly never delays its own launch."""
         if lead.key is None:
             return 0
         if self.window_us == 0:
@@ -257,7 +360,25 @@ class DeviceScheduler:
         if gap is None:
             return 0
         w = int(2 * gap)
-        return w if w <= WINDOW_CAP_US * 1000 else 0
+        if w > WINDOW_CAP_US * 1000:
+            return 0
+        scale = min(1.0, self._fk_hit.get(fk, WINDOW_HIT_INIT)
+                    / WINDOW_HIT_INIT)
+        if scale < WINDOW_HIT_FLOOR:
+            return 0
+        return int(w * scale)
+
+    def _note_window_outcome(self, lead, hit: bool) -> None:
+        """Feed one hold's outcome back into the key's hit-rate EWMA
+        (called with _cv held, right after the hold resolves)."""
+        fk = lead.fusion_key if lead.fusion_key is not None else lead.key
+        if fk is None:
+            return
+        prev = self._fk_hit.get(fk, WINDOW_HIT_INIT)
+        self._fk_hit[fk] = ((1.0 - WINDOW_HIT_ALPHA) * prev
+                            + WINDOW_HIT_ALPHA * (1.0 if hit else 0.0))
+        if hit:
+            self.window_hits += 1
 
     # ---- batch assembly --------------------------------------------- #
 
@@ -278,7 +399,13 @@ class DeviceScheduler:
     def _collect_riders(self, lead, batch: list) -> None:
         """Pop every queued rider across ALL groups — coalescing and
         fusion are cross-session by design.  Each rider charges its own
-        group's virtual time."""
+        group's virtual time.  Group size is capped by SUMMED static
+        footprint (analysis/copcost LaunchCost) against the mesh budget
+        — the scan is paid once, but every distinct payload/input adds
+        HBM, so a fused group must fit as a whole — with the member
+        count cap (tidb_tpu_sched_max_coalesce) still the outer bound."""
+        budget = self.effective_budget(lead.mesh)
+        footprint = lead.cost.peak_hbm_bytes if lead.cost is not None else 0
         for og in self._groups.values():
             if len(batch) >= self.max_coalesce:
                 break
@@ -286,6 +413,16 @@ class DeviceScheduler:
             while og.queue:
                 t = og.queue.popleft()
                 if len(batch) < self.max_coalesce and self._rides(t, lead):
+                    add = self._marginal_bytes(t, lead)
+                    if budget > 0 and footprint and \
+                            footprint + add > budget:
+                        # over the summed-footprint cap: defer — the
+                        # rider stays queued and leads a later launch
+                        self.budget_deferrals += 1
+                        self._m_bdefer.inc()
+                        kept.append(t)
+                        continue
+                    footprint += add
                     batch.append(t)
                     self._depth -= 1
                     og.vtime += 1.0 / og.weight
@@ -320,12 +457,15 @@ class DeviceScheduler:
                 # submits land and notify; re-collect after each wake
                 deadline = time.perf_counter_ns() + w_ns
                 self.window_waits += 1
+                held_at = len(batch)
                 while len(batch) < self.max_coalesce:
                     rem_ns = deadline - time.perf_counter_ns()
                     if rem_ns <= 0:
                         break
                     self._cv.wait(rem_ns / 1e9)
                     self._collect_riders(lead, batch)
+                # window feedback: did the hold actually gain riders?
+                self._note_window_outcome(lead, len(batch) > held_at)
         self._m_depth.set(self._depth)
         return batch
 
@@ -349,6 +489,7 @@ class DeviceScheduler:
             for t in batch:
                 t.start_ns = now
                 t.wait_ns = now - t.submit_ns
+            self._note_launch_bytes(batch)
             try:
                 self._serve(batch)
             except BaseException as e:  # noqa: BLE001 future-style contract
@@ -359,6 +500,18 @@ class DeviceScheduler:
     # ------------------------------------------------------------- #
     # launch
     # ------------------------------------------------------------- #
+
+    def _note_launch_bytes(self, batch: list) -> None:
+        """Static footprint of the batch about to launch (scan counted
+        once per distinct input, payloads summed) — the bytes gauge the
+        budget admission reasons in."""
+        lead = batch[0]
+        if lead.cost is None:
+            return
+        est = lead.cost.peak_hbm_bytes + sum(
+            self._marginal_bytes(t, lead) for t in batch[1:])
+        self.last_launch_bytes = est
+        self._m_launch_bytes.set(est)
 
     def _serve(self, batch: list) -> None:
         lead = batch[0]
@@ -526,7 +679,13 @@ class DeviceScheduler:
                 "fused_launches": self.fused_launches,
                 "fused_tasks": self.fused_tasks,
                 "window_waits": self.window_waits,
+                "window_hits": self.window_hits,
                 "busy_rejects": self.busy_rejects,
+                "hbm_budget": self.effective_budget(),
+                "budget_admitted": self.budget_admitted,
+                "budget_rejects": self.budget_rejects,
+                "budget_deferrals": self.budget_deferrals,
+                "last_launch_bytes": self.last_launch_bytes,
                 "tasks_done": self.tasks_done,
                 "wait_p50_ms": round(self._pct(waits, 0.50) / 1e6, 3),
                 "wait_p99_ms": round(self._pct(waits, 0.99) / 1e6, 3),

@@ -94,7 +94,7 @@ class CopTask:
                  "aux", "input_token", "fn", "group", "weight",
                  "submit_ns", "start_ns", "wait_ns", "coalesced", "fused",
                  "fusion_key", "cancelled", "_done", "_value", "_exc",
-                 "est_rows")
+                 "est_rows", "cost")
 
     def __init__(self, *, key=None, dag=None, mesh=None, row_capacity=0,
                  cols=None, counts=None, aux=(), input_token=None,
@@ -123,6 +123,7 @@ class CopTask:
         self.wait_ns = 0
         self.coalesced = 1        # tasks served by this task's launch
         self.fused = 0            # member programs in this task's launch
+        self.cost = None          # LaunchCost set at admission (copcost)
         self.cancelled = False
         self._done = threading.Event()
         self._value = None

@@ -113,8 +113,11 @@ class StatusServer:
         if path == "/sched":
             # device admission scheduler: queue depth, per-group
             # fair-share + RU accounting, coalesce/batch/fusion launch
-            # counters, micro-batch window state, wait p50/p99, and the
-            # shared CopClient's cache/retry/paging counters ("client")
+            # counters, micro-batch window state (incl. hit-rate
+            # feedback), HBM-budget admission (hbm_budget bytes,
+            # budget_admitted/rejects/deferrals, last_launch_bytes —
+            # analysis/copcost), wait p50/p99, and the shared
+            # CopClient's cache/retry/paging counters ("client")
             return json.dumps(self.domain.client.sched_stats()), \
                 "application/json"
         if path == "/settings":

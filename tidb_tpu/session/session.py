@@ -1117,6 +1117,9 @@ class Session:
         v5 = merged.get("tidb_tpu_sched_window_us")
         if v5 is not None and v5 != "" and int(v5) >= -1:
             client.sched_window_us = int(v5)
+        v6 = merged.get("tidb_tpu_sched_hbm_budget")
+        if v6 is not None and v6 != "" and int(v6) >= -1:
+            client.sched_hbm_budget = int(v6)
         return ExecContext(client, merged,
                            mem_tracker=Tracker("query", quota))
 
@@ -1155,7 +1158,31 @@ class Session:
             # (analysis/contracts.verify_plan) — surfaced like the
             # reference's EXPLAIN diagnostics footer
             rows.append(("contract: ok",))
+            footer = self._cost_footer(phys)
+            if footer is not None:
+                rows.append((footer,))
         return ResultSet(["plan"], rows)
+
+    def _cost_footer(self, phys) -> Optional[str]:
+        """EXPLAIN cost footer from the static shape/memory model
+        (analysis/copcost): estimated peak device bytes, host<->device
+        transfer, and the padded/live ratio of the scan inputs.  None
+        for host-only plans or shapes the model cannot walk — the
+        footer must never break EXPLAIN."""
+        try:
+            from ..analysis.copcost import format_bytes, plan_cost
+            mesh = self.domain.client._mesh     # never force device init
+            n_dev = int(mesh.devices.size) if mesh is not None else 8
+            cost = plan_cost(phys, n_dev)
+            if not cost.transfer_bytes:
+                return None
+            return (f"est. device bytes: "
+                    f"{format_bytes(cost.peak_hbm_bytes)} peak / "
+                    f"{format_bytes(cost.transfer_bytes)} transfer, "
+                    f"padding {cost.padding_waste:.1f}x")
+        except (AttributeError, TypeError, KeyError, ValueError,
+                ImportError):
+            return None
 
     def _exec_plan_replayer(self, stmt: A.PlanReplayerDump) -> ResultSet:
         """PLAN REPLAYER DUMP EXPLAIN <sql> (executor/plan_replayer.go):

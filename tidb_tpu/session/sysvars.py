@@ -62,6 +62,12 @@ _VARS = [
     _v("tidb_tpu_sched_fusion", 1, kind="bool", scope=SCOPE_GLOBAL),
     _v("tidb_tpu_sched_window_us", -1, kind="int", min=-1, max=100_000,
        scope=SCOPE_GLOBAL),
+    # per-mesh HBM admission budget for the static cost gate
+    # (analysis/copcost): -1 = auto from device memory stats (CPU
+    # fallback constant), 0 = unlimited, >0 = bytes.  Launches whose
+    # LaunchCost.peak_hbm_bytes exceed it are rejected pre-trace.
+    _v("tidb_tpu_sched_hbm_budget", -1, kind="int", min=-1,
+       scope=SCOPE_GLOBAL),
     _v("tidb_distsql_scan_concurrency", 15, kind="int", min=1, max=256),
     _v("tidb_max_chunk_size", 1024, kind="int", min=32, max=65536),
     _v("tidb_enable_vectorized_expression", 1, kind="bool"),

@@ -118,6 +118,10 @@ class CopClient:
         # scheduler defaults (fusion on, adaptive window)
         self.sched_fusion = None
         self.sched_window_us = None
+        # per-mesh HBM admission budget (tidb_tpu_sched_hbm_budget):
+        # None = keep scheduler state, -1 = auto from device memory
+        # stats, 0 = unlimited, >0 = bytes (analysis/copcost gate)
+        self.sched_hbm_budget = None
         self._sched_obj = None
 
     @property
@@ -198,7 +202,8 @@ class CopClient:
             self.sched_max_coalesce if self.sched_max_coalesce > 0
             else None,
             fusion=self.sched_fusion,
-            window_us=self.sched_window_us)
+            window_us=self.sched_window_us,
+            hbm_budget=self.sched_hbm_budget)
         return s
 
     def _client_stats(self) -> dict:
