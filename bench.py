@@ -468,10 +468,68 @@ def mode_sched():
         "budget_deferrals": st.get("budget_deferrals", 0),
         "last_launch_bytes": st.get("last_launch_bytes", 0),
     }
+    out["rc"] = _sched_rc_scenario(dom, s, sched, queries[0])
     log("sched-concurrent:", json.dumps(out))
     os.makedirs(DATA_DIR, exist_ok=True)
     with open(SCHED_PATH, "w") as f:
         json.dump(out, f)
+
+
+def _sched_rc_scenario(dom, s, sched, query):
+    """Resource-control isolation scenario (rc/): one RU-exhausted
+    group and one unlimited group submit the same query concurrently;
+    admission-time enforcement must let the unlimited group's launches
+    proceed while the starved group's tasks hold at the drain.  Reports
+    per-group launch counts and the isolation ratio."""
+    import threading
+
+    from tidb_tpu.session import Session
+
+    n_each = int(os.environ.get("BENCH_RC_STMTS", "16"))
+    s.execute("create resource group bench_starved RU_PER_SEC = 1")
+    s.execute("create resource group bench_free RU_PER_SEC = 0")
+    starved = dom.resource_groups.get("bench_starved")
+    starved.bucket.force_debit(1e9)     # exhausted for the whole run
+    saved_deadline = sched.rc_max_queue_s
+    sched.rc_max_queue_s = 3.0          # fail starved waiters quickly
+    base = {g: dict(st) for g, st in sched.stats()["groups"].items()}
+    results = {"bench_starved": [], "bench_free": []}
+
+    def run(group):
+        sess = Session(dom)
+        sess.execute(f"set resource group {group}")
+        try:
+            sess.must_query(query)
+            results[group].append("ok")
+        except Exception as e:
+            results[group].append(type(e).__name__)
+
+    threads = [threading.Thread(target=run, args=(g,))
+               for g in ("bench_starved", "bench_free")
+               for _ in range(n_each)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    sched.rc_max_queue_s = saved_deadline
+    groups = sched.stats()["groups"]
+
+    def served(name):
+        b = base.get(name, {}).get("tasks", 0)
+        return groups.get(name, {}).get("tasks", 0) - b
+
+    starved_n, free_n = served("bench_starved"), served("bench_free")
+    return {
+        "stmts_per_group": n_each,
+        "starved_launches": starved_n,
+        "free_launches": free_n,
+        "isolation_ratio": round(free_n / max(starved_n, 1), 2),
+        "starved_outcomes": {o: results["bench_starved"].count(o)
+                             for o in set(results["bench_starved"])},
+        "free_ok": results["bench_free"].count("ok"),
+        "throttled": groups.get("bench_starved", {}).get("throttled", 0),
+        "rc_exhausted": sched.stats().get("rc_exhausted", 0),
+    }
 
 
 def _median_times(fn, iters):

@@ -28,6 +28,18 @@ def test_analysis_gate_exits_zero():
     assert "0 violations" in proc.stdout, proc.stdout
 
 
+def test_gate_prices_every_corpus_plan():
+    """ISSUE 5 satellite: the gate asserts every TPC-H corpus plan
+    prices to a finite nonzero RU (rc/pricing over the cost model) —
+    guards pricing-model rot the way --check-baseline guards waiver
+    rot.  Covered by the same full-gate subprocess run."""
+    proc = _run_gate()
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "rc pricing:" in proc.stdout, proc.stdout
+    assert "0 violations" in proc.stdout.split("rc pricing:")[1], \
+        proc.stdout
+
+
 def test_check_baseline_passes():
     """Baseline hygiene (ISSUE 4 satellite): every accepted-findings
     entry must still match a current finding, so waivers cannot rot

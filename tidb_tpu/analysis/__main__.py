@@ -12,6 +12,11 @@ Runs three static passes and exits non-zero on any NEW finding:
    findings.
 3. Plan-contract verification over the same corpus plans
    (analysis.verify_plan); any PlanContractError fails the gate.
+4. RU pricing over the same corpus (rc/pricing over the cost model's
+   rollup): every device-bearing TPC-H plan must price to a finite
+   RU value strictly above the per-task floor — guards pricing-model
+   rot (a weight edit that zeroes or NaNs the terms) the same way
+   --check-baseline guards waiver rot.
 
 Flags:
     --lint-only / --contracts-only   run one pass
@@ -111,6 +116,36 @@ def _run_findings(findings, baseline, stale) -> int:
     return 1 if fresh else 0
 
 
+def _run_pricing(plans) -> int:
+    """Every corpus plan must price to finite, nonzero RUs; device-
+    bearing plans (transfer bytes > 0) must price strictly above the
+    MIN_TASK_RU floor — i.e. the bytes/flops terms actually
+    contribute, so a pricing-model regression cannot silently admit
+    all work for free."""
+    import math
+
+    from ..rc.pricing import MIN_TASK_RU, cost_rus
+    from .copcost import plan_cost
+    bad = 0
+    priced = 0
+    for sql, phys in plans:
+        cost = plan_cost(phys, n_devices=GATE_DEVICES)
+        rus = cost_rus(cost)
+        ok = math.isfinite(rus) and rus > 0
+        if ok and cost.transfer_bytes > 0:
+            ok = rus > MIN_TASK_RU
+        if not ok:
+            bad += 1
+            one_line = " ".join(sql.split())
+            print(f"PRICING {one_line[:72]}...\n  priced to {rus!r} "
+                  f"(transfer {cost.transfer_bytes}B)")
+        else:
+            priced += 1
+    print(f"rc pricing: {priced}/{len(plans)} corpus plans priced "
+          f"finite+nonzero, {bad} violations")
+    return 1 if bad else 0
+
+
 def _run_contracts(plans) -> int:
     from ..testing.tpch import TPCH_PLAN_QUERIES, TPCH_SHUFFLE_QUERIES
     from .contracts import PlanContractError, verify_plan
@@ -162,6 +197,7 @@ def main(argv=None) -> int:
     rc = _run_findings(findings, baseline, stale)
     if not lint_only:
         rc |= _run_contracts(plans)
+        rc |= _run_pricing(plans)
     if rc == 0:
         print("analysis gate: ok")
     return rc

@@ -78,10 +78,15 @@ HOT_PATH_MODULES = TRACED_MODULES | {
     "sched/scheduler.py", "sched/task.py",
 }
 
-# modules participating in the cross-layer lock-order contract
+# modules participating in the cross-layer lock-order contract.  The
+# rc/ control plane is included (ISSUE 5 satellite): its group-map
+# lock, per-group bucket leaf locks, and the runaway ring all run under
+# the drain's condition lock, so a nested/inverted acquisition there is
+# a real deadlock against the scheduler.
 LOCK_MODULES = {
     "sched/scheduler.py", "utils/poolmgr.py", "utils/rwlock.py",
-    "store/client.py",
+    "store/client.py", "rc/bucket.py", "rc/controller.py",
+    "rc/runaway.py", "utils/resourcegroup.py",
 }
 
 _DIGEST_NAME = re.compile(r"key|digest|token|fingerprint|signature",

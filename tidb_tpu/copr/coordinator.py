@@ -47,7 +47,7 @@ class QueryHandle:
 
     __slots__ = ("conn_id", "sql", "started", "fragments", "_mu",
                  "sched_wait_ns", "sched_tasks", "sched_coalesced",
-                 "sched_fused")
+                 "sched_fused", "sched_rus")
 
     def __init__(self, conn_id: int, sql: str):
         self.conn_id = conn_id
@@ -60,13 +60,15 @@ class QueryHandle:
         self.sched_coalesced = 0   # tasks that rode a shared launch
         self.sched_fused = 0       # tasks served by a cross-query
                                    # fused launch (EXPLAIN `fused`)
+        self.sched_rus = 0.0       # priced RUs debited for this
+                                   # statement's device work (rc/)
 
     def note_fragment(self, desc: str) -> None:
         with self._mu:
             self.fragments.append((desc, time.time()))
 
     def note_sched(self, wait_ns: int, coalesced: int,
-                   fused: int = 0) -> None:
+                   fused: int = 0, rus: float = 0.0) -> None:
         with self._mu:
             self.sched_wait_ns += int(wait_ns)
             self.sched_tasks += 1
@@ -74,6 +76,7 @@ class QueryHandle:
                 self.sched_coalesced += 1
             if fused > 1:
                 self.sched_fused += 1
+            self.sched_rus += float(rus)
 
 
 class Coordinator:

@@ -357,6 +357,7 @@ class CopTaskExec(PhysOp):
             handle.note_fragment(self.describe())
         sched_w0 = handle.sched_wait_ns if handle is not None else 0
         sched_f0 = handle.sched_fused if handle is not None else 0
+        sched_r0 = handle.sched_rus if handle is not None else 0.0
         if self.as_of_ts is not None:
             snap = self.as_of_snap
             if snap is None:
@@ -390,8 +391,9 @@ class CopTaskExec(PhysOp):
             # plus how many of its launches were cross-query fused
             dw = handle.sched_wait_ns - sched_w0
             df = handle.sched_fused - sched_f0
+            dr = handle.sched_rus - sched_r0
             self._rt_detail = (f"schedWait: {dw / 1e6:.3f}ms, "
-                               f"fused: {df}")
+                               f"fused: {df}, ru: {dr:.1f}")
         return ResultChunk(list(self.out_names), cols)
 
 

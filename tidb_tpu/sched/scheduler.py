@@ -30,10 +30,22 @@ owns all launches onto one jax mesh:
   stragglers: per fusion key, an EWMA of arrival gaps predicts whether
   a matching task is about to arrive; under bursty open-loop load the
   sub-millisecond wait raises coalesce/fusion rates sharply.
+- The drain ENFORCES resource-group RU budgets (rc/): every task is
+  priced from its static LaunchCost at submit, and a group whose token
+  bucket (plus bounded overdraft) cannot cover its head task's RUs is
+  SKIPPED — the exhausted group queues while other groups keep
+  launching (no head-of-line blocking across groups), riders from an
+  exhausted group may not hitch onto another group's launch, debits
+  happen pre-launch at batch admission (fused groups pay the shared
+  scan once, riders their marginal bytes), and a throttled task that
+  overstays the max-queue deadline fails its waiter with the
+  MySQL-compatible ResourceExhaustedError (8252).
 - Queue-wait / launch / coalesce / fusion stats feed utils/metrics
   (scraped at /metrics), the /sched status route, per-statement
-  execdetails (`schedWait`/`fused` in EXPLAIN ANALYZE), and per-group
-  RU accounting.
+  execdetails (`schedWait`/`fused`/`ru` in EXPLAIN ANALYZE), priced
+  per-group RU accounting, and measured launch wall time attributed
+  per member (shared scan split by marginal bytes) and per program
+  digest.
 
 The drain thread starts lazily on first submit and exits after an idle
 period, so embedders that never touch the device pay nothing.
@@ -47,6 +59,9 @@ import time
 from collections import deque
 from typing import Optional
 
+from ..rc.controller import (DEFAULT_MAX_QUEUE_S, DEFAULT_OVERDRAFT_RU,
+                             ResourceExhaustedError)
+from ..rc.pricing import split_device_time, task_rus
 from .task import CopTask, ServerBusyError
 
 DEFAULT_QUEUE_DEPTH = 256
@@ -67,6 +82,12 @@ WAIT_SAMPLES = 2048              # ring of recent task waits (p50/p99)
 WINDOW_HIT_INIT = 0.5            # optimistic prior: full window at start
 WINDOW_HIT_ALPHA = 0.25          # EWMA step per observed hold outcome
 WINDOW_HIT_FLOOR = 0.05          # scale cutoff: ~10 straight misses
+# while every queued group is RU-throttled the drain sleeps this long
+# between cover re-checks (bucket refill is time-driven; submits still
+# notify the condition immediately)
+RC_RETRY_S = 0.01
+# per-program-digest device-time attribution map stays tiny
+RC_DIGEST_CAP = 64
 
 
 def _verify_enabled() -> bool:
@@ -79,7 +100,7 @@ class _GroupQ:
     """One resource group's FIFO + stride-scheduler state."""
 
     __slots__ = ("name", "weight", "vtime", "seq", "queue",
-                 "tasks", "wait_ns", "rus")
+                 "tasks", "wait_ns", "rus", "throttled", "device_ns")
 
     def __init__(self, name: str, weight: float, seq: int,
                  vtime: float = 0.0):
@@ -90,7 +111,9 @@ class _GroupQ:
         self.queue: deque = deque()
         self.tasks = 0            # served (lifetime)
         self.wait_ns = 0
-        self.rus = 0.0
+        self.rus = 0.0            # priced RUs launched (rc/pricing)
+        self.throttled = 0        # drain passes that skipped this group
+        self.device_ns = 0        # attributed launch wall time
 
 
 class DeviceScheduler:
@@ -108,6 +131,12 @@ class DeviceScheduler:
         # submit (CPU fallback constant), 0 = unlimited, >0 = bytes
         self.hbm_budget = -1
         self._auto_budget: Optional[int] = None
+        # resource control (rc/): RU-bucket enforcement at the drain
+        # (tidb_tpu_rc_enable / tidb_tpu_rc_overdraft_ru sysvars); the
+        # max-queue deadline bounds how long a throttled waiter queues
+        self.rc_enable = True
+        self.rc_overdraft_ru = DEFAULT_OVERDRAFT_RU
+        self.rc_max_queue_s = DEFAULT_MAX_QUEUE_S
         self._mu = threading.Lock()
         self._cv = threading.Condition(self._mu)
         self._groups: dict[str, _GroupQ] = {}
@@ -139,6 +168,11 @@ class DeviceScheduler:
         self.budget_rejects = 0           # solo programs over budget (CostError)
         self.budget_deferrals = 0         # riders left queued by footprint cap
         self.last_launch_bytes = 0        # footprint of the last served batch
+        # rc enforcement accounting (rc/controller)
+        self.rc_throttled = 0             # drain passes that skipped a group
+        self.rc_exhausted = 0             # waiters failed at the deadline
+        self.rc_debited_ru = 0.0          # priced RUs debited pre-launch
+        self._digest_ns: dict = {}        # program digest -> device ns
         self.tasks_done = 0
         from ..utils.metrics import global_registry
         reg = global_registry()
@@ -173,6 +207,22 @@ class DeviceScheduler:
         self._m_bdefer = reg.counter(
             "tidb_tpu_sched_budget_deferrals_total",
             "riders deferred from a launch by the summed-footprint cap")
+        # resource control plane (rc/): admission-side RU enforcement
+        self._m_rc_throttle = reg.counter(
+            "tidb_tpu_rc_throttled_total",
+            "drain passes that skipped an RU-exhausted group",
+            labels=("group",))
+        self._m_rc_exhaust = reg.counter(
+            "tidb_tpu_rc_exhausted_total",
+            "waiters failed at the rc max-queue deadline",
+            labels=("group",))
+        self._m_rc_debit = reg.counter(
+            "tidb_tpu_rc_ru_debited_total",
+            "priced RUs debited pre-launch", labels=("group",))
+        self._m_rc_overdraft = reg.gauge(
+            "tidb_tpu_rc_overdraft_ru",
+            "bounded RU overdraft the drain tolerates per group")
+        self._m_rc_overdraft.set(self.rc_overdraft_ru)
 
     # ------------------------------------------------------------- #
     # admission
@@ -182,7 +232,9 @@ class DeviceScheduler:
                   max_coalesce: Optional[int] = None,
                   fusion: Optional[bool] = None,
                   window_us: Optional[int] = None,
-                  hbm_budget: Optional[int] = None) -> None:
+                  hbm_budget: Optional[int] = None,
+                  rc_enable: Optional[bool] = None,
+                  rc_overdraft: Optional[float] = None) -> None:
         """Apply sysvar knobs; negative/None = keep current (window_us
         and hbm_budget are the exceptions: -1 means adaptive/auto,
         0 disables the hold / the budget)."""
@@ -196,6 +248,11 @@ class DeviceScheduler:
             self.window_us = int(window_us)
         if hbm_budget is not None and hbm_budget >= -1:
             self.hbm_budget = int(hbm_budget)
+        if rc_enable is not None:
+            self.rc_enable = bool(rc_enable)
+        if rc_overdraft is not None and rc_overdraft >= 0:
+            self.rc_overdraft_ru = float(rc_overdraft)
+            self._m_rc_overdraft.set(self.rc_overdraft_ru)
 
     # ---- HBM-budget admission (analysis/copcost) -------------------- #
 
@@ -268,6 +325,15 @@ class DeviceScheduler:
             from ..analysis.contracts import verify_task
             verify_task(task)
             self._admit_cost(task)
+        # rc pricing happens HERE, in the submitting thread: structured
+        # tasks price from the LaunchCost the admission gate just
+        # computed, opaque tasks from their row estimate — the drain
+        # only compares/debits, never prices
+        task.rus = task_rus(task)
+        if self.rc_enable and task.rc_group is not None \
+                and task.rc_group.limited:
+            task.deadline_ns = task.submit_ns + \
+                int(self.rc_max_queue_s * 1e9)
         with self._cv:
             if self._depth >= self.max_depth:
                 self.busy_rejects += 1
@@ -315,9 +381,87 @@ class DeviceScheduler:
         for g in self._groups.values():
             if not g.queue:
                 continue
+            if self._rc_blocked(g):
+                continue
             if best is None or (g.vtime, g.seq) < (best.vtime, best.seq):
                 best = g
         return best
+
+    # ---- resource-control enforcement (rc/: priced RU admission) ---- #
+
+    def _task_bucket(self, t):
+        """The RU bucket governing task ``t``; None = not enforced
+        (rc disabled, no group attached, or the group is unlimited)."""
+        if not self.rc_enable or t.rc_group is None:
+            return None
+        return t.rc_group.bucket if t.rc_group.limited else None
+
+    def _rc_blocked(self, g: _GroupQ) -> bool:
+        """May this group's HEAD task launch under its RU budget?  A
+        blocked group is skipped by the fair pick — it queues while
+        sibling groups keep launching (tikv unified-read-pool deadline
+        behavior); cancelled heads always pass so the drain can fail
+        them out of the queue."""
+        head = g.queue[0]
+        if head.cancelled:
+            return False
+        b = self._task_bucket(head)
+        if b is None or b.can_cover(head.rus, self.rc_overdraft_ru):
+            return False
+        g.throttled += 1
+        self.rc_throttled += 1
+        self._m_rc_throttle.inc(group=g.name)
+        return True
+
+    def _rc_covers(self, t, lead) -> bool:
+        """May ``t`` ride lead's launch under t's OWN group budget?  A
+        rider from an exhausted group must stay queued even when the
+        launch itself is free capacity — otherwise fusion would be an
+        RU-bypass."""
+        b = self._task_bucket(t)
+        return b is None or b.can_cover(task_rus(t, lead),
+                                        self.rc_overdraft_ru)
+
+    def _rc_debit(self, t, lead=None) -> None:
+        """Pre-launch debit at batch admission: the task's priced RUs
+        (marginal when it shares lead's resident scan) leave its
+        group's bucket BEFORE anything traces or launches.  The check
+        ran in _rc_blocked/_rc_covers on this same drain thread, so
+        check-then-debit cannot interleave with itself."""
+        rus = task_rus(t, lead)
+        t.rus_charged = rus
+        b = self._task_bucket(t)
+        if b is not None:
+            b.debit(rus)
+            self.rc_debited_ru += rus
+            self._m_rc_debit.inc(rus, group=t.group)
+
+    def _rc_expire_locked(self) -> None:
+        """Fail throttled waiters that overstayed the max-queue
+        deadline with the MySQL-compatible resource-exhausted error
+        (called with _cv held).  Only tasks whose bucket STILL cannot
+        cover them expire — a covered task merely queued behind load
+        keeps waiting for the fair drain."""
+        now = time.perf_counter_ns()
+        expired = False
+        for g in self._groups.values():
+            if not g.queue:
+                continue
+            for t in list(g.queue):
+                if not t.deadline_ns or now <= t.deadline_ns:
+                    continue
+                b = self._task_bucket(t)
+                if b is not None and not b.can_cover(
+                        t.rus, self.rc_overdraft_ru):
+                    g.queue.remove(t)
+                    self._depth -= 1
+                    self.rc_exhausted += 1
+                    self._m_rc_exhaust.inc(group=g.name)
+                    t.fail(ResourceExhaustedError(
+                        t.group, (now - t.submit_ns) / 1e9, t.rus))
+                    expired = True
+        if expired:
+            self._m_depth.set(self._depth)
 
     # ---- adaptive micro-batch window (EWMA of arrival gaps) --------- #
 
@@ -412,7 +556,9 @@ class DeviceScheduler:
             kept: deque = deque()
             while og.queue:
                 t = og.queue.popleft()
-                if len(batch) < self.max_coalesce and self._rides(t, lead):
+                if len(batch) < self.max_coalesce \
+                        and self._rides(t, lead) \
+                        and self._rc_covers(t, lead):
                     add = self._marginal_bytes(t, lead)
                     if budget > 0 and footprint and \
                             footprint + add > budget:
@@ -423,6 +569,7 @@ class DeviceScheduler:
                         kept.append(t)
                         continue
                     footprint += add
+                    self._rc_debit(t, lead)
                     batch.append(t)
                     self._depth -= 1
                     og.vtime += 1.0 / og.weight
@@ -436,6 +583,7 @@ class DeviceScheduler:
         rider; optionally hold inside the micro-batch window so
         stragglers that are statistically about to arrive (EWMA of the
         key's arrival gaps) coalesce/fuse instead of launching apart."""
+        self._rc_expire_locked()
         g = self._pick()
         if g is None:
             return []
@@ -448,6 +596,7 @@ class DeviceScheduler:
             self._m_depth.set(self._depth)
             lead.fail(RuntimeError("cancelled"))
             return [None]          # sentinel: retry pick
+        self._rc_debit(lead)
         batch = [lead]
         if lead.key is not None:
             self._collect_riders(lead, batch)
@@ -482,6 +631,13 @@ class DeviceScheduler:
                     if not self._paused and self._depth == 0:
                         continue
                 batch = self._take_batch()
+                if not batch and self._depth > 0:
+                    # every queued group is RU-throttled: their waiters
+                    # stay queued until a bucket refill covers a head
+                    # task or the max-queue deadline expires them
+                    # (_rc_expire_locked ran inside _take_batch); sleep
+                    # briefly — submits still notify the condition
+                    self._cv.wait(timeout=RC_RETRY_S)
             idle_since = time.monotonic()
             if not batch or batch == [None]:
                 continue
@@ -495,6 +651,8 @@ class DeviceScheduler:
             except BaseException as e:  # noqa: BLE001 future-style contract
                 for t in batch:
                     t.fail(e)
+            self._attribute_launch(batch,
+                                   time.perf_counter_ns() - now)
             self._account(batch)
 
     # ------------------------------------------------------------- #
@@ -634,18 +792,42 @@ class DeviceScheduler:
             for t in batch:
                 t.coalesced = len(batch)
 
+    def _attribute_launch(self, batch: list, wall_ns: int) -> None:
+        """Split one launch's measured wall time across its members by
+        marginal bytes — the shared scan belongs to the lead, each
+        rider weighs what it ADDED — so per-group and per-digest device
+        time stays honest under fusion/coalescing instead of landing
+        wholesale on whichever member's group drained the batch."""
+        lead = batch[0]
+        weights = [lead.cost.peak_hbm_bytes if lead.cost is not None
+                   else 0]
+        weights += [self._marginal_bytes(t, lead) for t in batch[1:]]
+        for t, ns in zip(batch, split_device_time(weights, wall_ns)):
+            t.device_ns = ns
+
     def _account(self, batch: list) -> None:
+        """Post-launch bookkeeping.  RUs were PRICED at submit and
+        DEBITED at batch admission (t.rus_charged — rc/pricing from the
+        static LaunchCost; the old est_rows/100+1 post-hoc charge is
+        retired); this only mirrors them into the per-group stat and
+        the tidb_tpu_sched_ru_total counter /sched consumers read."""
         with self._mu:
             for t in batch:
                 self.tasks_done += 1
                 g = self._groups.get(t.group)
-                rus = t.est_rows / 100.0 + 1.0
                 if g is not None:
                     g.wait_ns += t.wait_ns
-                    g.rus += rus
+                    g.rus += t.rus_charged
+                    g.device_ns += t.device_ns
+                if t.key is not None and t.device_ns:
+                    if len(self._digest_ns) > RC_DIGEST_CAP:
+                        self._digest_ns.clear()
+                    dk = f"{t.key[0] & 0xffffffffffffffff:016x}"
+                    self._digest_ns[dk] = \
+                        self._digest_ns.get(dk, 0) + t.device_ns
                 self._wait_ring.append(t.wait_ns)
                 self._m_wait.observe(t.wait_ns / 1e9)
-                self._m_ru.inc(rus, group=t.group)
+                self._m_ru.inc(t.rus_charged, group=t.group)
 
     # ------------------------------------------------------------- #
     # introspection
@@ -686,6 +868,15 @@ class DeviceScheduler:
                 "budget_rejects": self.budget_rejects,
                 "budget_deferrals": self.budget_deferrals,
                 "last_launch_bytes": self.last_launch_bytes,
+                "rc_enable": self.rc_enable,
+                "rc_overdraft_ru": self.rc_overdraft_ru,
+                "rc_throttled": self.rc_throttled,
+                "rc_exhausted": self.rc_exhausted,
+                "rc_debited_ru": round(self.rc_debited_ru, 2),
+                "digest_device_ms": {
+                    dk: round(ns / 1e6, 3) for dk, ns in sorted(
+                        self._digest_ns.items(),
+                        key=lambda kv: -kv[1])[:8]},
                 "tasks_done": self.tasks_done,
                 "wait_p50_ms": round(self._pct(waits, 0.50) / 1e6, 3),
                 "wait_p99_ms": round(self._pct(waits, 0.99) / 1e6, 3),
@@ -693,7 +884,9 @@ class DeviceScheduler:
                     g.name: {"weight": g.weight, "tasks": g.tasks,
                              "queued": len(g.queue),
                              "wait_ms": round(g.wait_ns / 1e6, 3),
-                             "rus": round(g.rus, 2)}
+                             "rus": round(g.rus, 2),
+                             "throttled": g.throttled,
+                             "device_ms": round(g.device_ns / 1e6, 3)}
                     for g in self._groups.values()},
             }
 

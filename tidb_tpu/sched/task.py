@@ -24,9 +24,12 @@ import threading
 import time
 from typing import Any, Callable, Optional
 
-# the submitting statement's (resource group name, fair-share weight) —
-# bound by Session.execute around each statement; travels into worker
-# threads via contextvars.copy_context like KILL_EVENT does
+# the submitting statement's (resource group name, fair-share weight,
+# rc ResourceGroup-or-None) — bound by Session.execute around each
+# statement; travels into worker threads via contextvars.copy_context
+# like KILL_EVENT does.  The third element is the live group object so
+# the drain can consult the group's RU bucket (rc/controller) without a
+# registry lookup; pre-rc 2-tuples are still accepted.
 SCHED_GROUP: contextvars.ContextVar = contextvars.ContextVar(
     "sched_group", default=None)
 
@@ -48,11 +51,14 @@ class ServerBusyError(RuntimeError):
             f"depth={depth}); retry later")
 
 
-def current_group() -> tuple[str, float]:
-    """(group name, weight) of the calling statement context."""
+def current_group() -> tuple:
+    """(group name, weight, rc group-or-None) of the calling statement
+    context; 2-tuple bindings (pre-rc embedders) gain a None."""
     g = SCHED_GROUP.get()
     if not g:
-        return DEFAULT_GROUP, DEFAULT_WEIGHT
+        return DEFAULT_GROUP, DEFAULT_WEIGHT, None
+    if len(g) == 2:
+        return g[0], g[1], None
     return g
 
 
@@ -94,17 +100,21 @@ class CopTask:
                  "aux", "input_token", "fn", "group", "weight",
                  "submit_ns", "start_ns", "wait_ns", "coalesced", "fused",
                  "fusion_key", "cancelled", "_done", "_value", "_exc",
-                 "est_rows", "cost")
+                 "est_rows", "cost", "rc_group", "rus", "rus_charged",
+                 "device_ns", "deadline_ns")
 
     def __init__(self, *, key=None, dag=None, mesh=None, row_capacity=0,
                  cols=None, counts=None, aux=(), input_token=None,
                  fusion_key=None, fn: Optional[Callable[[], Any]] = None,
                  group: Optional[str] = None,
-                 weight: Optional[float] = None, est_rows: int = 0):
+                 weight: Optional[float] = None, est_rows: int = 0,
+                 rc_group=None):
         if group is None:
-            group, gw = current_group()
+            group, gw, rcg = current_group()
             if weight is None:
                 weight = gw
+            if rc_group is None:
+                rc_group = rcg
         self.key = key
         self.dag = dag
         self.mesh = mesh
@@ -124,6 +134,11 @@ class CopTask:
         self.coalesced = 1        # tasks served by this task's launch
         self.fused = 0            # member programs in this task's launch
         self.cost = None          # LaunchCost set at admission (copcost)
+        self.rc_group = rc_group  # live rc ResourceGroup (bucket owner)
+        self.rus = 1.0            # priced RUs, set at submit (rc/pricing)
+        self.rus_charged = 0.0    # RUs actually debited at the drain
+        self.device_ns = 0        # attributed share of launch wall time
+        self.deadline_ns = 0      # rc max-queue deadline (0 = none)
         self.cancelled = False
         self._done = threading.Event()
         self._value = None

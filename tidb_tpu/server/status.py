@@ -120,6 +120,37 @@ class StatusServer:
             # CopClient's cache/retry/paging counters ("client")
             return json.dumps(self.domain.client.sched_stats()), \
                 "application/json"
+        if path == "/resource":
+            # resource control plane (rc/): per-group RU budget state
+            # (balance/debt/debited), drain-side enforcement counters
+            # (throttled skips, deadline failures, priced debits),
+            # measured per-group + per-program-digest device-time
+            # attribution, and the bounded runaway-record ring
+            mgr = self.domain.resource_groups
+            groups = mgr.resource_stats()
+            sched = self.domain.client.sched_stats()
+            for name, gstats in (sched.get("groups") or {}).items():
+                ent = groups.setdefault(name, {})
+                ent.update({
+                    "tasks": gstats.get("tasks", 0),
+                    "queued": gstats.get("queued", 0),
+                    "rus": gstats.get("rus", 0.0),
+                    "throttled": gstats.get("throttled", 0),
+                    "device_ms": gstats.get("device_ms", 0.0),
+                })
+            return json.dumps({
+                "rc_enable": sched.get("rc_enable", True),
+                "rc_overdraft_ru": sched.get("rc_overdraft_ru"),
+                "rc_throttled": sched.get("rc_throttled", 0),
+                "rc_exhausted": sched.get("rc_exhausted", 0),
+                "rc_debited_ru": sched.get("rc_debited_ru", 0.0),
+                "digest_device_ms": sched.get("digest_device_ms", {}),
+                "groups": groups,
+                "runaway": {
+                    "total": mgr.runaway_ring.total,
+                    "records": mgr.runaway_ring.records(),
+                },
+            }), "application/json"
         if path == "/settings":
             # handler/settings analog: live global sysvars
             return json.dumps(dict(sorted(

@@ -367,12 +367,16 @@ class Session:
             ktok = KILL_EVENT.set(self._kill_event)
             htok = QUERY_HANDLE.set(handle)
             # tag device cop tasks with the statement's resource group so
-            # the admission scheduler orders them weighted-fair
+            # the admission scheduler orders them weighted-fair AND can
+            # enforce the group's RU bucket at the drain (rc/): the live
+            # group object rides the contextvar so every CopTask carries
+            # its bucket without a registry lookup
             gname = self.vars.get("tidb_resource_group") or \
                 self.domain.sysvars.get("tidb_resource_group", "default")
             grp = self.domain.resource_groups.get(gname)
             gtok = SCHED_GROUP.set(
-                (gname, grp.sched_weight if grp is not None else 8.0))
+                (gname, grp.sched_weight if grp is not None else 8.0,
+                 grp))
             def _getvar(name, scope=""):
                 if scope == "global":
                     return self.domain.sysvars.get(name)
@@ -420,11 +424,13 @@ class Session:
                 text, dt_ns, len(out.rows),
                 cpu_ns=time.thread_time_ns() - cpu0,
                 plan_text=self._last_plan_text,
-                sched_wait_ns=handle.sched_wait_ns)
+                sched_wait_ns=handle.sched_wait_ns,
+                rus=handle.sched_rus)
             try:
                 # runaway KILL must fire before the success audit hook:
                 # a killed statement is an error to the client
-                self._charge_resource_group(stmt, out, dt_ns / 1e9)
+                self._charge_resource_group(stmt, out, dt_ns / 1e9,
+                                            handle)
             except Exception as e:
                 _plugins.fire("on_stmt_end", self, text, str(e),
                               dt_ns / 1e9, 0)
@@ -461,22 +467,37 @@ class Session:
         return ResultSet()
 
     def _charge_resource_group(self, stmt, out: ResultSet,
-                               elapsed_sec: float) -> None:
-        """Post-paid RU accounting + runaway watch (resource control).
-        ACTION=KILL only raises for statements that did not mutate data:
-        the watch runs post-execution, and killing an already-committed
-        DML would report failure for persisted writes (the reference
-        aborts mid-execution; read-only raise is the safe analog)."""
+                               elapsed_sec: float, handle=None) -> None:
+        """Statement-boundary resource accounting (rc/controller).
+        Device work was priced from its LaunchCost and debited at the
+        scheduler drain BEFORE launching (handle.sched_rus reports it);
+        host-only statements still charge the row-count RU here.  The
+        runaway watch covers queue+execution wall time with actions
+        KILL / COOLDOWN / SWITCH_GROUP.  ACTION=KILL only raises for
+        statements that did not mutate data: the watch runs
+        post-execution, and killing an already-committed DML would
+        report failure for persisted writes (the reference aborts
+        mid-execution; read-only raise is the safe analog)."""
         gname = self.vars.get("tidb_resource_group") or \
             self.domain.sysvars.get("tidb_resource_group", "default")
         group = self.domain.resource_groups.get(gname)
         if group is None or (group.ru_per_sec <= 0
                              and not group.exec_elapsed_sec):
             return
-        from ..utils.resourcegroup import RunawayError, charge_statement
+        from ..rc.controller import charge_statement
+        from ..rc.runaway import RunawayError
+        rc_on = bool(int(self.domain.sysvars.get(
+            "tidb_tpu_rc_enable", 1) or 0))
+        device_rus = handle.sched_rus if (
+            handle is not None and rc_on) else 0.0
+        sched_wait = (handle.sched_wait_ns / 1e9
+                      if handle is not None else 0.0)
         try:
             charge_statement(group, len(out.rows) + out.affected,
-                             elapsed_sec)
+                             elapsed_sec, sched_wait_sec=sched_wait,
+                             device_rus=device_rus,
+                             manager=self.domain.resource_groups,
+                             sql=handle.sql if handle is not None else "")
         except RunawayError:
             if out.affected:
                 return           # counted as runaway, writes stand
@@ -540,13 +561,15 @@ class Session:
                     self.domain.resource_groups.alter(
                         stmt.name, stmt.ru_per_sec, stmt.burstable,
                         stmt.exec_elapsed_sec, stmt.action,
-                        priority=stmt.priority)
+                        priority=stmt.priority,
+                        switch_target=stmt.switch_target)
                 else:
                     self.domain.resource_groups.create(
                         stmt.name, stmt.ru_per_sec, stmt.burstable,
                         stmt.exec_elapsed_sec, stmt.action,
                         if_not_exists=stmt.if_not_exists,
-                        priority=stmt.priority)
+                        priority=stmt.priority,
+                        switch_target=stmt.switch_target)
             except ValueError as e:
                 raise PlanError(str(e))
             return ResultSet()
@@ -1120,6 +1143,14 @@ class Session:
         v6 = merged.get("tidb_tpu_sched_hbm_budget")
         if v6 is not None and v6 != "" and int(v6) >= -1:
             client.sched_hbm_budget = int(v6)
+        # resource control plane (rc/): drain-side RU enforcement on/off
+        # and the bounded overdraft (-1 = engine default)
+        v7 = merged.get("tidb_tpu_rc_enable")
+        if v7 is not None and v7 != "":
+            client.rc_enable = bool(int(v7))
+        v8 = merged.get("tidb_tpu_rc_overdraft_ru")
+        if v8 is not None and v8 != "" and int(v8) >= 0:
+            client.rc_overdraft = float(v8)
         return ExecContext(client, merged,
                            mem_tracker=Tracker("query", quota))
 
@@ -2303,7 +2334,7 @@ class Session:
             return ResultSet(
                 ["Digest_text", "Exec_count", "Avg_latency_ms",
                  "Max_latency_ms", "Sum_rows", "Sample_sql",
-                 "Avg_sched_wait_ms"],
+                 "Avg_sched_wait_ms", "Avg_ru"],
                 self.domain.stmt_summary.summary_rows())
         if stmt.kind == "slow_queries":
             return ResultSet(["Query", "Latency_ms", "Rows"],

@@ -68,6 +68,13 @@ _VARS = [
     # LaunchCost.peak_hbm_bytes exceed it are rejected pre-trace.
     _v("tidb_tpu_sched_hbm_budget", -1, kind="int", min=-1,
        scope=SCOPE_GLOBAL),
+    # resource control plane (rc/): RU-bucket enforcement at the drain.
+    # rc_enable=0 reverts to the legacy post-paid statement charge;
+    # overdraft is the bounded RU debt the drain tolerates per group
+    # (-1 = engine default, DEFAULT_OVERDRAFT_RU)
+    _v("tidb_tpu_rc_enable", 1, kind="bool", scope=SCOPE_GLOBAL),
+    _v("tidb_tpu_rc_overdraft_ru", -1, kind="int", min=-1,
+       max=1 << 20, scope=SCOPE_GLOBAL),
     _v("tidb_distsql_scan_concurrency", 15, kind="int", min=1, max=256),
     _v("tidb_max_chunk_size", 1024, kind="int", min=32, max=65536),
     _v("tidb_enable_vectorized_expression", 1, kind="bool"),

@@ -563,7 +563,8 @@ class CreateResourceGroup(Node):
     ru_per_sec: Optional[int] = None
     burstable: Optional[bool] = None
     exec_elapsed_sec: Optional[float] = None
-    action: Optional[str] = None
+    action: Optional[str] = None   # kill | cooldown | switch_group
+    switch_target: Optional[str] = None  # SWITCH_GROUP(<name>) target
     priority: Optional[str] = None  # low | medium | high (sched weight)
     if_not_exists: bool = False
     replace: bool = False          # ALTER form

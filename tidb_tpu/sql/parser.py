@@ -1351,9 +1351,16 @@ class Parser:
                     elif sub == "ACTION":
                         tok = self.cur
                         act = self.advance().text.lower()
-                        if act not in ("kill", "cooldown"):
+                        if act == "switch_group":
+                            # SWITCH_GROUP(<name>): runaway statements
+                            # re-price against the target group
+                            self.expect_op("(")
+                            rg.switch_target = self.ident().lower()
+                            self.expect_op(")")
+                        elif act not in ("kill", "cooldown"):
                             raise ParseError(
-                                "ACTION must be KILL or COOLDOWN", tok)
+                                "ACTION must be KILL, COOLDOWN or "
+                                "SWITCH_GROUP(<group>)", tok)
                         rg.action = act
                     else:
                         raise ParseError(f"unknown QUERY_LIMIT option "

@@ -122,6 +122,11 @@ class CopClient:
         # None = keep scheduler state, -1 = auto from device memory
         # stats, 0 = unlimited, >0 = bytes (analysis/copcost gate)
         self.sched_hbm_budget = None
+        # resource control plane (rc/): RU-bucket enforcement at the
+        # drain (tidb_tpu_rc_enable) and the bounded overdraft
+        # (tidb_tpu_rc_overdraft_ru); None = keep scheduler state
+        self.rc_enable = None
+        self.rc_overdraft = None
         self._sched_obj = None
 
     @property
@@ -203,7 +208,9 @@ class CopClient:
             else None,
             fusion=self.sched_fusion,
             window_us=self.sched_window_us,
-            hbm_budget=self.sched_hbm_budget)
+            hbm_budget=self.sched_hbm_budget,
+            rc_enable=self.rc_enable,
+            rc_overdraft=self.rc_overdraft)
         return s
 
     def _client_stats(self) -> dict:
@@ -228,7 +235,11 @@ class CopClient:
         from ..copr.coordinator import QUERY_HANDLE
         h = QUERY_HANDLE.get()
         if h is not None:
-            h.note_sched(task.wait_ns, task.coalesced, task.fused)
+            # rus_charged is set at batch admission (before finish), so
+            # the waiter always observes it; device_ns is attributed
+            # post-serve and stays a scheduler-side stat
+            h.note_sched(task.wait_ns, task.coalesced, task.fused,
+                         rus=task.rus_charged)
 
     def _launch(self, dag, cols, counts, aux, row_capacity: int = 0):
         """One device launch of a sharded cop program, routed through the

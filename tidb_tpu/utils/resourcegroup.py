@@ -1,180 +1,16 @@
-"""Resource control: resource groups with RU token buckets + runaway
-watch.
-
-Reference analog: pkg/resourcegroup + the TiKV-side RU limiter and
-pkg/resourcegroup/runaway (SURVEY §2.7).  A statement charges request
-units (RUs ~ rows touched / 100 + 1) against its session's group AFTER
-execution; when the bucket is empty the NEXT statement blocks until
-refill (post-paid debt, like the reference's token client).  A QUERY_LIMIT
-with EXEC_ELAPSED marks statements exceeding the wall-time budget as
-runaway: ACTION=KILL raises, ACTION=COOLDOWN demotes the charge priority
-(here: doubles the statement's RU cost).
-"""
+"""Back-compat shim: resource control moved to the ``tidb_tpu.rc``
+package (PR 5 — LaunchCost-priced RU admission, group isolation,
+runaway enforcement at the device drain).  Import from ``tidb_tpu.rc``
+in new code; this module re-exports the stable surface so existing
+importers (session, infoschema, tests) keep working."""
 
 from __future__ import annotations
 
-import threading
-import time
-from dataclasses import dataclass, field
-from typing import Optional
-
-
-class RunawayError(RuntimeError):
-    """Statement exceeded the group's EXEC_ELAPSED budget with
-    ACTION=KILL (runaway detector)."""
-
-
-# PRIORITY -> device-scheduler fair-share weight (stride scheduling in
-# sched/scheduler.py; the reference's resource-group PRIORITY feeds
-# tikv's unified read pool the same way)
-PRIORITY_WEIGHTS = {"low": 1.0, "medium": 8.0, "high": 16.0}
-
-
-@dataclass
-class ResourceGroup:
-    name: str
-    ru_per_sec: int = 0            # 0 = unlimited
-    burstable: bool = False
-    exec_elapsed_sec: float = 0.0  # 0 = no runaway watch
-    runaway_action: str = "kill"   # kill | cooldown
-    priority: str = "medium"       # low | medium | high (sched weight)
-    # token bucket state (guarded by _mu: the server is thread-per-
-    # connection and every session in the group shares this bucket)
-    tokens: float = 0.0
-    last_refill: float = field(default_factory=time.monotonic)
-    runaway_count: int = 0
-    _mu: threading.Lock = field(default_factory=threading.Lock)
-
-    def _refill(self, now: float) -> None:
-        if self.ru_per_sec <= 0:
-            return
-        dt = now - self.last_refill
-        cap = float(self.ru_per_sec)       # 1s burst capacity
-        if self.burstable:
-            cap *= 10
-        self.tokens = min(self.tokens + dt * self.ru_per_sec, cap)
-        self.last_refill = now
-
-    @property
-    def sched_weight(self) -> float:
-        return PRIORITY_WEIGHTS.get(self.priority, 8.0)
-
-    def note_runaway(self) -> None:
-        with self._mu:
-            self.runaway_count += 1
-
-    def consume(self, rus: float, max_wait_sec: float = 5.0) -> float:
-        """Charge `rus`; blocks (bounded) while the bucket is in debt.
-        Returns seconds slept — the throttle the reference applies via
-        its token client.  Sleeps happen OUTSIDE the lock."""
-        if self.ru_per_sec <= 0:
-            return 0.0
-        slept = 0.0
-        while True:
-            with self._mu:
-                now = time.monotonic()
-                self._refill(now)
-                if self.tokens > 0:
-                    self.tokens -= rus  # post-paid: may go negative (debt)
-                    return slept
-                need = min((-self.tokens + rus) / self.ru_per_sec,
-                           max_wait_sec - slept)
-                if need <= 0:
-                    self.tokens -= rus  # waited long enough; take the debt
-                    return slept
-            time.sleep(min(need, 0.05))
-            slept += min(need, 0.05)
-
-
-class ResourceGroupManager:
-    """Domain-level group registry (resource group meta + runaway
-    settings; infoschema RESOURCE_GROUPS analog)."""
-
-    def __init__(self):
-        self._groups: dict[str, ResourceGroup] = {
-            "default": ResourceGroup("default")}
-        self._lock = threading.Lock()
-
-    def create(self, name: str, ru_per_sec: Optional[int],
-               burstable: Optional[bool] = None,
-               exec_elapsed_sec: Optional[float] = None,
-               action: Optional[str] = None,
-               if_not_exists: bool = False,
-               priority: Optional[str] = None) -> ResourceGroup:
-        if priority is not None and priority not in PRIORITY_WEIGHTS:
-            raise ValueError(f"bad PRIORITY {priority!r}")
-        with self._lock:
-            if name in self._groups:
-                if if_not_exists:
-                    return self._groups[name]    # no-op, keep the group
-                raise ValueError(f"resource group {name!r} exists")
-            g = ResourceGroup(name, ru_per_sec or 0, bool(burstable),
-                              exec_elapsed_sec or 0.0, action or "kill",
-                              priority or "medium")
-            self._groups[name] = g
-            return g
-
-    def alter(self, name: str, ru_per_sec: Optional[int],
-              burstable: Optional[bool], exec_elapsed_sec: Optional[float],
-              action: Optional[str],
-              priority: Optional[str] = None) -> ResourceGroup:
-        """Merge only the options named in the statement; state
-        (bucket/runaway counters) is preserved."""
-        if priority is not None and priority not in PRIORITY_WEIGHTS:
-            raise ValueError(f"bad PRIORITY {priority!r}")
-        with self._lock:
-            g = self._groups.get(name)
-            if g is None:
-                raise ValueError(f"unknown resource group {name!r}")
-            if ru_per_sec is not None:
-                g.ru_per_sec = ru_per_sec
-            if burstable is not None:
-                g.burstable = burstable
-            if exec_elapsed_sec is not None:
-                g.exec_elapsed_sec = exec_elapsed_sec
-            if action is not None:
-                g.runaway_action = action
-            if priority is not None:
-                g.priority = priority
-            return g
-
-    def drop(self, name: str, if_exists: bool = False) -> None:
-        with self._lock:
-            if name == "default":
-                raise ValueError("cannot drop the default resource group")
-            if name not in self._groups:
-                if if_exists:
-                    return
-                raise ValueError(f"unknown resource group {name!r}")
-            del self._groups[name]
-
-    def get(self, name: str) -> Optional[ResourceGroup]:
-        with self._lock:
-            return self._groups.get(name)
-
-    def rows(self) -> list[tuple]:
-        with self._lock:
-            return [(g.name, g.ru_per_sec or None,
-                     "YES" if g.burstable else "NO",
-                     g.exec_elapsed_sec or None, g.runaway_action.upper(),
-                     g.runaway_count, g.priority.upper())
-                    for g in self._groups.values()]
-
-
-def charge_statement(group: ResourceGroup, rows_touched: int,
-                     elapsed_sec: float) -> None:
-    """Post-execution accounting: RU charge + runaway watch."""
-    rus = rows_touched / 100.0 + 1.0
-    if group.exec_elapsed_sec and elapsed_sec > group.exec_elapsed_sec:
-        group.note_runaway()
-        if group.runaway_action == "kill":
-            raise RunawayError(
-                f"query exceeded EXEC_ELAPSED "
-                f"{group.exec_elapsed_sec}s (resource group "
-                f"{group.name!r})")
-        rus *= 2.0                  # cooldown: demoted priority = pricier
-    group.consume(rus)
-
+from ..rc.controller import (PRIORITY_WEIGHTS, ResourceExhaustedError,
+                             ResourceGroup, ResourceGroupManager,
+                             charge_statement)
+from ..rc.runaway import RunawayError
 
 __all__ = ["ResourceGroup", "ResourceGroupManager", "RunawayError",
-           "charge_statement", "PRIORITY_WEIGHTS"]
+           "ResourceExhaustedError", "charge_statement",
+           "PRIORITY_WEIGHTS"]

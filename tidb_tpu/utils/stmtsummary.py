@@ -90,6 +90,9 @@ class StmtStats:
     # device-scheduler admission wait (sched/): how long this digest's
     # cop tasks queued before launching
     sum_sched_wait_ns: int = 0
+    # priced request units this digest's device work debited (rc/):
+    # fused launches attribute per member, shared scan priced once
+    sum_rus: float = 0.0
 
     @property
     def avg_latency_ms(self) -> float:
@@ -98,6 +101,10 @@ class StmtStats:
     @property
     def avg_sched_wait_ms(self) -> float:
         return self.sum_sched_wait_ns / max(self.exec_count, 1) / 1e6
+
+    @property
+    def avg_ru(self) -> float:
+        return self.sum_rus / max(self.exec_count, 1)
 
 
 @dataclass
@@ -120,7 +127,7 @@ class StmtSummary:
 
     def record(self, sql: str, latency_ns: int, rows: int,
                cpu_ns: int = 0, plan_text: str = "",
-               sched_wait_ns: int = 0):
+               sched_wait_ns: int = 0, rus: float = 0.0):
         digest = normalize_sql(sql)
         now = time.time()
         with self._lock:
@@ -135,6 +142,7 @@ class StmtSummary:
             st.last_seen = now
             st.sum_cpu_ns += int(cpu_ns)
             st.sum_sched_wait_ns += int(sched_wait_ns)
+            st.sum_rus += float(rus)
             if plan_text:
                 import hashlib
                 st.plan_digest = hashlib.sha256(
@@ -149,7 +157,8 @@ class StmtSummary:
         with self._lock:
             return [(s.digest, s.exec_count, round(s.avg_latency_ms, 3),
                      round(s.max_latency_ns / 1e6, 3), s.sum_rows,
-                     s.sample_sql, round(s.avg_sched_wait_ms, 3))
+                     s.sample_sql, round(s.avg_sched_wait_ms, 3),
+                     round(s.avg_ru, 2))
                     for s in sorted(self._stats.values(),
                                     key=lambda x: -x.sum_latency_ns)]
 
