@@ -14,7 +14,10 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
   row-group closure executor, closure_exec.go:468).
 - per-rung fields: q6/q19/rollup/high-NDV times + speedups, achieved
   physical GB/s for Q1+Q6 against a measured host copy-bandwidth roofline
-  (VERDICT r4 #1), and an SF=100 Q6 rung (VERDICT r4 #4).
+  (VERDICT r4 #1), and an SF=100 Q6 rung (VERDICT r4 #4).  The high-NDV
+  rung sweeps 20k/200k/2M groups under every strategy (hndv_sweep:
+  SEGMENT / SORT / DENSE / numpy oracle per NDV) so the former 1000x
+  cliff shows up as a curve (ISSUE 6).
 - tpu_attempts: summary of TPU_ATTEMPTS.jsonl — the round-long trail of
   TPU grant probes left by bench_retry.py (VERDICT r4 #9).
 
@@ -796,22 +799,15 @@ def _bench_one_sf(sf, platform, n_chips, iters, mem_bw):
                         dense=(platform == "tpu"))),
                     ("hndv", lambda: _rung_hndv(client, cols, ix, sf,
                                                 n_shards, iters))):
-        cap_stream = (platform == "tpu" and sf >= 10 and tag == "hndv")
-        if cap_stream:
-            # the resident 60M-row multi-key sort OOM-crashed the v5e
-            # worker (round 5, first window); stream it through HBM in
-            # bounded batches instead — _stream_sort_agg merges the
-            # per-batch group tables host-side
-            prev_cap = client.device_mem_cap
-            client.device_mem_cap = 64 << 20
+        # (the former sf>=10 hndv cap_stream special-case is gone: the
+        # SEGMENT strategy's single-key partition replaces the resident
+        # multi-key sort that OOM-crashed the v5e worker, and copcost
+        # admission rejects the degenerate DENSE plan pre-trace)
         try:
             rec.update(fn())
         except Exception as e:      # noqa: BLE001 - rung isolation
             log(f"{tag} rung FAILED: {type(e).__name__}: {e}")
             rec[f"{tag}_error"] = f"{type(e).__name__}: {e}"[:200]
-        finally:
-            if cap_stream:
-                client.device_mem_cap = prev_cap
     _record(rec)
     log(f"SF {sf:g} result recorded")
 
@@ -885,8 +881,22 @@ def _rung_rollup(client, cols, ix, n_shards, iters, dense=False):
             "rollup_vs_numpy": round(bru / ru_t, 2)}
 
 
+HNDV_SWEEP = (20_000, 200_000, 2_000_000)
+
+
 def _rung_hndv(client, cols, ix, sf, n_shards, iters):
+    """High-NDV group-by rung (ISSUE 6): per-strategy NDV sweep.
+
+    For each NDV the group key is l_partkey folded into [0, ndv) so one
+    dataset yields a 20k/200k/2M-group curve, measured under every
+    applicable strategy — SEGMENT (the radix-partitioned high-NDV path),
+    SORT (the multi-key comparator it replaces), DENSE (the degenerate
+    large-domain plan: admission may reject it pre-trace with CostError,
+    recorded as its error string instead of a device fault) — plus the
+    single-core numpy oracle.  Headline hndv_* fields report SEGMENT at
+    the largest NDV that actually has that many distinct keys."""
     from tidb_tpu import copr
+    from tidb_tpu.chunk.column import Column
     from tidb_tpu.copr import dag as D
     from tidb_tpu.copr.aggregate import GroupKeyMeta
     from tidb_tpu.expr import ColumnRef
@@ -894,30 +904,73 @@ def _rung_hndv(client, cols, ix, sf, n_shards, iters):
     from tidb_tpu.types import dtypes as dt
     pk = cols[ix["l_partkey"]]
     n_rows = len(pk.data)
-    hsnap = snapshot_from_columns(["l_partkey"], [pk], n_shards=n_shards)
-    pk_ref = ColumnRef(pk.dtype, 0, "l_partkey")
-    ndv_est = int(min(sf * 200_000, n_rows)) or 1
-    hagg = D.Aggregation(
-        D.TableScan((0,), (pk.dtype,)), (pk_ref,),
-        (copr.AggDesc(copr.AggFunc.COUNT, None, dt.bigint(False)),),
-        D.GroupStrategy.SORT,
-        group_capacity=max(1024, 1 << (ndv_est - 1).bit_length()))
-    resh = client.execute_agg(hagg, hsnap, [GroupKeyMeta(pk.dtype, 0)])
-    hndv_t = _median_times(
-        lambda: client.execute_agg(hagg, hsnap, [GroupKeyMeta(pk.dtype, 0)]),
-        max(iters // 2, 1))
-    t = time.time()
-    uk, ucnt = np.unique(pk.data, return_counts=True)
-    np_ndv_t = time.time() - t
-    assert len(resh.key_columns[0]) == len(uk), "high-NDV group mismatch"
-    assert int(np.asarray(
-        [int(c) for c in resh.columns[0].data]).sum()) == int(ucnt.sum())
-    log(f"high-NDV group-by ({len(uk)} groups): {hndv_t*1e3:.1f} ms "
-        f"({n_rows/hndv_t/1e6:.1f} M rows/s)  numpy oracle: "
-        f"{np_ndv_t*1e3:.1f} ms  speedup {np_ndv_t/hndv_t:.2f}x")
-    return {"hndv_ms": round(hndv_t * 1e3, 1),
-            "hndv_vs_numpy": round(np_ndv_t / hndv_t, 2),
-            "hndv_groups": int(len(uk))}
+    kt = dt.bigint(False)
+    sweep: dict = {}
+    headline = None
+
+    for ndv in HNDV_SWEEP:
+        key = (pk.data.astype(np.int64) * 1_000_003) % ndv
+        kcol = Column(kt, key, np.ones(n_rows, bool))
+        ksnap = snapshot_from_columns(["k"], [kcol], n_shards=n_shards)
+        kref = ColumnRef(kt, 0, "k")
+        count = (copr.AggDesc(copr.AggFunc.COUNT, None, dt.bigint(False)),)
+        scan = D.TableScan((0,), (kt,))
+        cap = max(1024, 1 << (int(ndv * 1.25) - 1).bit_length())
+        strategies = {
+            "segment": D.Aggregation(scan, (kref,), count,
+                                     D.GroupStrategy.SEGMENT,
+                                     num_buckets=cap),
+            "sort": D.Aggregation(scan, (kref,), count,
+                                  D.GroupStrategy.SORT,
+                                  group_capacity=cap),
+            "dense": D.Aggregation(scan, (kref,), count,
+                                   D.GroupStrategy.DENSE,
+                                   domain_sizes=(ndv,)),
+        }
+        t = time.time()
+        uk, ucnt = np.unique(key, return_counts=True)
+        np_t = time.time() - t
+        entry: dict = {"numpy_ms": round(np_t * 1e3, 1),
+                       "groups": int(len(uk))}
+        for name, hagg in strategies.items():
+            meta = [GroupKeyMeta(kt, 0)] if name != "dense" \
+                else [GroupKeyMeta(kt, ndv)]
+            try:
+                resh = client.execute_agg(hagg, ksnap, meta)
+                assert len(resh.key_columns[0]) == len(uk), \
+                    f"{name} group-count mismatch"
+                assert int(np.asarray(
+                    [int(c) for c in resh.columns[0].data]).sum()) \
+                    == int(ucnt.sum()), f"{name} count-total mismatch"
+                st = _median_times(
+                    lambda: client.execute_agg(hagg, ksnap, meta),
+                    max(iters // 2, 1))
+                entry[f"{name}_ms"] = round(st * 1e3, 1)
+                entry[f"{name}_vs_numpy"] = round(np_t / st, 2)
+            except Exception as e:     # noqa: BLE001 - strategy isolation:
+                # a rejected strategy (e.g. DENSE CostError pre-trace at
+                # degenerate NDV) degrades to its error, never the rung
+                entry[f"{name}_error"] = f"{type(e).__name__}: {e}"[:120]
+        log(f"high-NDV sweep ndv={ndv} ({entry['groups']} groups): " +
+            "  ".join(f"{k[:-3]}={v}ms" for k, v in entry.items()
+                      if k.endswith("_ms")))
+        sweep[str(ndv)] = entry
+        if "segment_ms" in entry and entry["groups"] >= min(ndv, n_rows) // 2:
+            headline = entry
+        del ksnap, kcol, key
+
+    out = {"hndv_sweep": sweep}
+    if headline is not None:
+        seg_t = headline["segment_ms"]
+        out.update({
+            "hndv_ms": seg_t,
+            "hndv_vs_numpy": round(
+                headline["numpy_ms"] / max(seg_t, 1e-6), 2),
+            "hndv_groups": headline["groups"]})
+        log(f"high-NDV headline (segment, {headline['groups']} groups): "
+            f"{seg_t:.1f} ms  ({n_rows / seg_t / 1e3:.1f} M rows/s)  "
+            f"speedup vs numpy {out['hndv_vs_numpy']}x")
+    return out
 
 
 def _bench_sf100(platform, mem_bw):

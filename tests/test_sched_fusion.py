@@ -166,14 +166,42 @@ class _FakeTask:
 
 
 def test_fusion_signature_contract_class():
-    """Structurally fusable = fully in-program agg chain; SORT aggs
-    (host merge) and row plans are out."""
-    assert fusion_signature(_mk_agg_dag()) is not None
+    """Fusable classes: in-program agg chains ('inprog-agg'), SEGMENT
+    aggs keyed by bucket shape ('segment-agg', B), and extras-free rows
+    chains ('rows' — fusion-breadth follow-on).  SORT aggs (regrow-sized
+    host merge) stay out."""
+    assert fusion_signature(_mk_agg_dag()) == ("inprog-agg",)
     assert fusion_signature(
         _mk_agg_dag(strategy=D.GroupStrategy.SORT)) is None
     scan = D.TableScan((0,), (dt.bigint(False),))
-    assert fusion_signature(D.Limit(scan, 5)) is None   # rows kind
-    assert fusion_signature(scan) is None
+    # rows chains fuse now, with per-member output capacities
+    assert fusion_signature(D.Limit(scan, 5)) == ("rows",)
+    assert fusion_signature(scan) == ("rows",)
+    seg = D.Aggregation(
+        child=scan, group_by=(ColumnRef(dt.bigint(False), 0),),
+        aggs=(D.AggDesc(D.AggFunc.COUNT, None, dt.bigint(False)),),
+        strategy=D.GroupStrategy.SEGMENT, num_buckets=4096)
+    assert fusion_signature(seg) == ("segment-agg", 4096)
+
+
+def test_rows_plans_sharing_scan_fuse_with_per_member_capacities():
+    """Fusion-breadth follow-on (ROADMAP): two DIFFERENT row-returning
+    plans over ONE table share the scan in a single FusedRowsProgram,
+    each keeping its own output capacity (a TopN's limit-sized buffer
+    next to a selection's paging capacity), results exact."""
+    dom, s, _data = _fusion_domain()
+    qa = "select p from t where d = 3"
+    qb = "select q from t order by q desc, p desc limit 7"
+    solo = [sorted(Session(dom).must_query(qa)),
+            Session(dom).must_query(qb)]
+    sched = dom.client._sched_obj
+    f0, l0 = sched.fused_launches, sched.launches
+    t0 = sched.tasks_done
+    out = _run_concurrent(dom, sched, [qa, qb])
+    assert sorted(out[0]) == solo[0]
+    assert out[1] == solo[1]
+    assert sched.fused_launches > f0
+    assert sched.launches - l0 < sched.tasks_done - t0
 
 
 def test_fusion_refused_for_contract_incompatible_pairs():

@@ -1,0 +1,490 @@
+"""SEGMENT-strategy device group-by (radix-partitioned high-NDV
+aggregation, ISSUE 6).
+
+Layers under test:
+
+- kernel exactness: the SEGMENT device program is bit-identical to the
+  DENSE program and the numpy oracle on the 8-vdev CPU mesh for
+  COUNT/SUM/MIN/MAX (AVG = SUM+COUNT, split by the planner), including
+  NULL keys, multi-column keys, decimal sums near the (hi, lo) limb
+  fence, and the 2M-distinct-group acceptance shape,
+- strategy selection: stats NDV above SEGMENT_MIN_NDV plans SEGMENT
+  (EXPLAIN `agg strategy:` tag), below stays SORT,
+- capacity discipline: the client regrows num_buckets from observed
+  __ngroups__ (paging analog),
+- contracts/copcost: malformed bucket counts are rejected pre-trace
+  with structured errors; the degenerate large-NDV DENSE plan is
+  rejected at sched admission with CostError (dense-blowup) before
+  anything traces,
+- fusion: a SEGMENT task's fusion signature carries its bucket shape —
+  incompatible bucket spaces refuse fusion loudly instead of silently
+  degrading; identical spaces fuse into one shared-scan launch.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from tidb_tpu import copr
+from tidb_tpu.analysis.contracts import (PlanContractError,
+                                         fusion_signature, verify_dag,
+                                         verify_fusion_group)
+from tidb_tpu.analysis.copcost import (DENSE_BLOWUP_MIN_GROUPS, CostError,
+                                       cost_findings, task_cost)
+from tidb_tpu.chunk.column import Column
+from tidb_tpu.copr import dag as D
+from tidb_tpu.copr.aggregate import (GroupKeyMeta, finalize,
+                                     finalize_sorted, merge_sorted_states,
+                                     merge_states)
+from tidb_tpu.expr.ir import ColumnRef
+from tidb_tpu.parallel.mesh import get_mesh
+from tidb_tpu.parallel.spmd import get_sharded_program
+from tidb_tpu.session import Domain, Session
+from tidb_tpu.session.catalog import TableInfo
+from tidb_tpu.store import snapshot_from_columns
+from tidb_tpu.types import dtypes as dt
+
+N_DEV = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return get_mesh()
+
+
+def _snap(names, cols, n_shards=8):
+    return snapshot_from_columns(names, cols, n_shards=n_shards)
+
+
+def _run_host_merged(agg, snap, key_meta, mesh):
+    """Run a SORT/SEGMENT device program and host-merge the per-device
+    group tables — the CopClient path without its CPU host fallback."""
+    prog = get_sharded_program(agg, mesh)
+    assert prog.host_merge
+    cols, counts = snap.device_cols(mesh)
+    states = jax.device_get(prog(cols, counts))
+    per_dev = [jax.tree_util.tree_map(lambda a, d=d: np.asarray(a)[d],
+                                      states) for d in range(N_DEV)]
+    merged = merge_sorted_states(agg, per_dev)
+    key_cols, agg_cols = finalize_sorted(agg, merged, key_meta)
+    return key_cols, agg_cols
+
+
+def _run_dense(agg, snap, key_meta, mesh):
+    prog = get_sharded_program(agg, mesh)
+    assert not prog.host_merge
+    cols, counts = snap.device_cols(mesh)
+    states = jax.device_get(prog(cols, counts))
+    merged = merge_states([states])
+    return finalize(agg, merged, key_meta)
+
+
+def _as_map(key_cols, agg_cols):
+    out = {}
+    n = len(agg_cols[0]) if agg_cols else 0
+    for i in range(n):
+        key = tuple((int(kc.data[i]) if kc.validity[i] else None)
+                    for kc in key_cols)
+        out[key] = tuple(
+            (int(c.data[i]) if c.validity[i] else None) for c in agg_cols)
+    return out
+
+
+# ------------------------------------------------------------------ #
+# kernel exactness: SEGMENT vs DENSE vs numpy
+# ------------------------------------------------------------------ #
+
+def test_segment_bit_identical_to_dense_and_numpy(mesh):
+    """COUNT/SUM/MIN/MAX (and hence AVG = SUM/COUNT) over a small-domain
+    key: the SEGMENT program's groups/values equal the DENSE program's
+    and the numpy oracle's, bit for bit."""
+    rng = np.random.default_rng(11)
+    n = 120_000
+    dom = 500
+    k = rng.integers(0, dom, n).astype(np.int64)
+    v = rng.integers(-10_000, 10_000, n).astype(np.int64)
+    snap = _snap(["k", "v"], [
+        Column(dt.bigint(False), k, np.ones(n, bool)),
+        Column(dt.bigint(False), v, np.ones(n, bool))])
+    kref = ColumnRef(dt.bigint(False), 0, "k")
+    vref = ColumnRef(dt.bigint(False), 1, "v")
+    aggs = (copr.AggDesc(copr.AggFunc.COUNT, None, dt.bigint(False)),
+            copr.AggDesc(copr.AggFunc.SUM, vref,
+                         copr.sum_out_dtype(vref.dtype)),
+            copr.AggDesc(copr.AggFunc.MIN, vref, dt.bigint()),
+            copr.AggDesc(copr.AggFunc.MAX, vref, dt.bigint()))
+    scan = D.TableScan((0, 1), (dt.bigint(False), dt.bigint(False)))
+
+    seg = D.Aggregation(scan, (kref,), aggs, D.GroupStrategy.SEGMENT,
+                        num_buckets=1024)
+    den = D.Aggregation(scan, (kref,), aggs, D.GroupStrategy.DENSE,
+                        domain_sizes=(dom,))
+    m_seg = _as_map(*_run_host_merged(
+        seg, snap, [GroupKeyMeta(dt.bigint(False), 0)], mesh))
+    m_den = _as_map(*_run_dense(
+        den, snap, [GroupKeyMeta(dt.bigint(False), dom)], mesh))
+    assert m_seg == m_den
+
+    exp = {}
+    for u in np.unique(k):
+        m = k == u
+        exp[(int(u),)] = (int(m.sum()), int(v[m].sum()),
+                          int(v[m].min()), int(v[m].max()))
+    assert m_seg == exp
+    # AVG rides SUM+COUNT exactly (the planner's split): identical
+    # states imply identical averages
+    for key, (cnt, s, _mn, _mx) in m_seg.items():
+        assert s / cnt == exp[key][1] / exp[key][0]
+
+
+def test_segment_null_and_multicolumn_keys(mesh):
+    """NULL keys form their own group (distinct from 0), multi-column
+    keys group by the tuple — vs the SORT program AND a python oracle."""
+    rng = np.random.default_rng(13)
+    n = 50_000
+    a = rng.integers(0, 40, n).astype(np.int64)
+    av = rng.random(n) < 0.9            # ~10% NULL keys
+    b = rng.integers(-5, 5, n).astype(np.int64)
+    v = rng.integers(0, 1000, n).astype(np.int64)
+    snap = _snap(["a", "b", "v"], [
+        Column(dt.bigint(), a, av),
+        Column(dt.bigint(False), b, np.ones(n, bool)),
+        Column(dt.bigint(False), v, np.ones(n, bool))])
+    aref = ColumnRef(dt.bigint(), 0, "a")
+    bref = ColumnRef(dt.bigint(False), 1, "b")
+    vref = ColumnRef(dt.bigint(False), 2, "v")
+    aggs = (copr.AggDesc(copr.AggFunc.COUNT, None, dt.bigint(False)),
+            copr.AggDesc(copr.AggFunc.SUM, vref,
+                         copr.sum_out_dtype(vref.dtype)))
+    scan = D.TableScan((0, 1, 2),
+                       (dt.bigint(), dt.bigint(False), dt.bigint(False)))
+    meta = [GroupKeyMeta(dt.bigint(), 0), GroupKeyMeta(dt.bigint(False), 0)]
+
+    seg = D.Aggregation(scan, (aref, bref), aggs,
+                        D.GroupStrategy.SEGMENT, num_buckets=2048)
+    srt = D.Aggregation(scan, (aref, bref), aggs,
+                        D.GroupStrategy.SORT, group_capacity=2048)
+    m_seg = _as_map(*_run_host_merged(seg, snap, meta, mesh))
+    m_srt = _as_map(*_run_host_merged(srt, snap, meta, mesh))
+    assert m_seg == m_srt
+
+    exp: dict = {}
+    for i in range(n):
+        key = (int(a[i]) if av[i] else None, int(b[i]))
+        c, s = exp.get(key, (0, 0))
+        exp[key] = (c + 1, s + int(v[i]))
+    assert m_seg == exp
+    assert any(key[0] is None for key in m_seg)   # NULL group exists
+
+
+def test_segment_decimal_sum_near_limb_fence(mesh):
+    """Decimal SUMs whose per-row scaled ints carry nonzero hi limbs and
+    whose group totals overflow int64 still recombine exactly (object
+    ints through the host merge)."""
+    rng = np.random.default_rng(17)
+    n = 40_000
+    k = rng.integers(0, 4, n).astype(np.int64)
+    # scaled decimal(18,2) values around 2^40: per-row hi limb != 0,
+    # per-group totals ~ 2^40 * 2500 ≈ 2^51... pushed near the int64
+    # edge by the 1000x multiplier below
+    base = rng.integers(1 << 40, (1 << 40) + (1 << 20), n)
+    val = (base * 1000).astype(np.int64)
+    dec_t = dt.decimal(18, 2)
+    snap = _snap(["k", "d"], [
+        Column(dt.bigint(False), k, np.ones(n, bool)),
+        Column(dec_t, val, np.ones(n, bool))])
+    kref = ColumnRef(dt.bigint(False), 0, "k")
+    dref = ColumnRef(dec_t, 1, "d")
+    out_t = copr.sum_out_dtype(dec_t)
+    aggs = (copr.AggDesc(copr.AggFunc.SUM, dref, out_t),
+            copr.AggDesc(copr.AggFunc.COUNT, None, dt.bigint(False)))
+    scan = D.TableScan((0, 1), (dt.bigint(False), dec_t))
+    seg = D.Aggregation(scan, (kref,), aggs, D.GroupStrategy.SEGMENT,
+                        num_buckets=1024)
+    key_cols, agg_cols = _run_host_merged(
+        seg, snap, [GroupKeyMeta(dt.bigint(False), 0)], mesh)
+    got = {int(key_cols[0].data[i]): int(agg_cols[0].data[i])
+           for i in range(len(key_cols[0]))}
+    exp = {}
+    for u in np.unique(k):
+        exp[int(u)] = int(val[k == u].astype(object).sum())
+    assert got == exp
+    assert max(abs(t) for t in exp.values()) > 2 ** 63  # past int64
+
+
+def test_segment_two_million_groups_bit_identical(mesh):
+    """Acceptance shape: 2M synthetic distinct groups through the
+    SEGMENT device program on the CPU mesh, bit-identical to the numpy
+    oracle (every key distinct, COUNT + SUM exact)."""
+    rng = np.random.default_rng(7)
+    n = 2_000_000
+    k = rng.permutation(n).astype(np.int64)
+    v = rng.integers(0, 1000, n).astype(np.int64)
+    snap = _snap(["k", "v"], [
+        Column(dt.bigint(False), k, np.ones(n, bool)),
+        Column(dt.bigint(False), v, np.ones(n, bool))])
+    kref = ColumnRef(dt.bigint(False), 0, "k")
+    vref = ColumnRef(dt.bigint(False), 1, "v")
+    aggs = (copr.AggDesc(copr.AggFunc.COUNT, None, dt.bigint(False)),
+            copr.AggDesc(copr.AggFunc.SUM, vref,
+                         copr.sum_out_dtype(vref.dtype)))
+    scan = D.TableScan((0, 1), (dt.bigint(False), dt.bigint(False)))
+    seg = D.Aggregation(scan, (kref,), aggs, D.GroupStrategy.SEGMENT,
+                        num_buckets=1 << 19)
+    key_cols, agg_cols = _run_host_merged(
+        seg, snap, [GroupKeyMeta(dt.bigint(False), 0)], mesh)
+    assert len(key_cols[0]) == n                 # every group distinct
+    order = np.argsort(key_cols[0].data)
+    assert (key_cols[0].data[order] == np.arange(n)).all()
+    cnt = np.asarray([int(c) for c in agg_cols[0].data], dtype=np.int64)
+    assert (cnt == 1).all()
+    got = np.asarray([int(x) for x in agg_cols[1].data], dtype=np.int64)
+    exp = np.zeros(n, np.int64)
+    exp[k] = v
+    assert (got[order] == exp).all()
+
+
+# ------------------------------------------------------------------ #
+# strategy selection + EXPLAIN tag + regrow
+# ------------------------------------------------------------------ #
+
+def _register(dom, name, cols):
+    names = [c[0] for c in cols]
+    columns = [c[1] for c in cols]
+    ti = TableInfo(name, names, [c.dtype for c in columns])
+    ti.register_columns(columns)
+    dom.catalog.create_table("test", ti)
+    return ti
+
+
+def test_segment_auto_selected_above_ndv_threshold():
+    """Stats NDV above SEGMENT_MIN_NDV -> the planner picks SEGMENT
+    (EXPLAIN agg strategy tag + chain tag), results exact; a small-NDV
+    key on the same session stays SORT."""
+    dom = Domain()
+    sess = Session(dom)
+    rng = np.random.default_rng(3)
+    n = 60_000
+    big = rng.permutation(100_000)[:n].astype(np.int64)   # NDV ~ 60k
+    small = rng.integers(0, 3_000, n).astype(np.int64)
+    v = rng.integers(0, 50, n).astype(np.int64)
+    _register(dom, "hi", [
+        ("k", Column(dt.bigint(False), big, np.ones(n, bool))),
+        ("s", Column(dt.bigint(False), small, np.ones(n, bool))),
+        ("v", Column(dt.bigint(False), v, np.ones(n, bool)))])
+    sess.execute("analyze table hi")
+
+    plan = "\n".join(r[0] for r in sess.must_query(
+        "explain select k, count(*), sum(v) from hi group by k"))
+    assert "Aggregation[segment]" in plan, plan
+    assert "agg strategy: segment (" in plan, plan
+
+    plan_small = "\n".join(r[0] for r in sess.must_query(
+        "explain select s, count(*) from hi group by s"))
+    assert "Aggregation[sort]" in plan_small, plan_small
+    assert "agg strategy: sort" in plan_small, plan_small
+
+    rows = sess.must_query("select k, count(*), sum(v) from hi group by k")
+    uk, inv = np.unique(big, return_inverse=True)
+    assert len(rows) == len(uk)
+    cnt = np.bincount(inv)
+    sv = np.bincount(inv, weights=v).astype(np.int64)
+    exp = {int(u): (int(c), int(s)) for u, c, s in zip(uk, cnt, sv)}
+    for rk, rc, rs in rows:
+        assert exp[rk] == (rc, int(rs))
+
+
+def test_segment_bucket_regrow_from_observed_groups(mesh):
+    """More distinct groups than num_buckets: the client regrows the
+    bucket space from __ngroups__ (paging analog) and still returns
+    every group — device path pinned open (host fallback disabled)."""
+    from tidb_tpu.store import CopClient
+    n = 30_000
+    k = np.arange(n, dtype=np.int64)           # all distinct
+    snap = _snap(["k"], [Column(dt.bigint(False), k, np.ones(n, bool))])
+    kref = ColumnRef(dt.bigint(False), 0, "k")
+    seg = D.Aggregation(
+        D.TableScan((0,), (dt.bigint(False),)), (kref,),
+        (copr.AggDesc(copr.AggFunc.COUNT, None, dt.bigint(False)),),
+        D.GroupStrategy.SEGMENT, num_buckets=1024)   # far too small
+    client = CopClient(mesh)
+    client._host_sort_agg = lambda *a, **kw: None    # force device path
+    res = client.execute_agg(seg, snap, [GroupKeyMeta(dt.bigint(False), 0)])
+    assert len(res.key_columns[0]) == n
+    assert all(int(c) == 1 for c in res.columns[0].data)
+
+
+# ------------------------------------------------------------------ #
+# contracts / copcost: malformed shapes rejected pre-trace
+# ------------------------------------------------------------------ #
+
+def _seg_dag(num_buckets, keys=True):
+    scan = D.TableScan((0,), (dt.bigint(False),))
+    return D.Aggregation(
+        scan,
+        (ColumnRef(dt.bigint(False), 0),) if keys else (),
+        (D.AggDesc(D.AggFunc.COUNT, None, dt.bigint(False)),),
+        D.GroupStrategy.SEGMENT, num_buckets=num_buckets)
+
+
+def test_malformed_bucket_counts_rejected_by_contracts():
+    verify_dag(_seg_dag(4096))                       # well-formed passes
+    for bad in (0, -8, 3, 1000):                     # zero/neg/non-pow2
+        with pytest.raises(PlanContractError) as ei:
+            verify_dag(_seg_dag(bad))
+        assert ei.value.rule == "capacity-shape", bad
+    with pytest.raises(PlanContractError) as ei:
+        verify_dag(_seg_dag(4096, keys=False))
+    assert ei.value.rule == "capacity-shape"
+
+
+def test_degenerate_dense_rejected_at_admission(mesh, monkeypatch):
+    """The large-NDV DENSE plan (the sf>=10 TPU-worker crash shape) is
+    priced as a dense-blowup and rejected with CostError at submit,
+    BEFORE anything traces — selection's fallback is SEGMENT."""
+    import tidb_tpu.parallel.spmd as spmd
+    from tidb_tpu.sched import CopTask, DeviceScheduler
+
+    n = 4096
+    k = np.arange(n, dtype=np.int64)
+    snap = _snap(["k"], [Column(dt.bigint(False), k, np.ones(n, bool))])
+    cols, counts = snap.device_cols(mesh)
+    # past BOTH fences: the planner's dense ceiling AND the
+    # states-vs-rows ratio (states >> rows)
+    dom_size = 2 * DENSE_BLOWUP_MIN_GROUPS
+    dense = D.Aggregation(
+        D.TableScan((0,), (dt.bigint(False),)),
+        (ColumnRef(dt.bigint(False), 0),),
+        (D.AggDesc(D.AggFunc.COUNT, None, dt.bigint(False)),),
+        D.GroupStrategy.DENSE, domain_sizes=(dom_size,))
+
+    def boom(*_a, **_k):
+        raise AssertionError("reached tracing/compilation")
+    monkeypatch.setattr(spmd, "get_sharded_program", boom)
+    monkeypatch.setattr(spmd, "get_batched_program", boom)
+    monkeypatch.setattr(spmd, "get_fused_program", boom)
+
+    sched = DeviceScheduler()
+    task = CopTask.structured(dense, mesh, 0, cols, counts, ())
+    r0 = sched.budget_rejects
+    with pytest.raises(CostError) as ei:
+        sched.submit(task)
+    assert ei.value.rule == "dense-blowup"
+    assert sched.budget_rejects == r0 + 1
+    # the cost model itself flags it too (gate-finding twin)
+    cost = task_cost(task)
+    assert cost.dense_blowups
+    # the equivalent SEGMENT plan prices clean and admits
+    seg = _seg_dag(1 << (dom_size - 1).bit_length())
+    seg_cost = task_cost(CopTask.structured(seg, mesh, 0, cols, counts, ()))
+    assert not seg_cost.dense_blowups and not seg_cost.unbounded
+    assert seg_cost.peak_hbm_bytes > 0
+
+
+def test_dense_blowup_gate_finding():
+    """cost_findings reports COST-DENSE-BLOWUP for a degenerate dense
+    corpus plan (seeded via a fake physical op)."""
+    n = 1024
+    snap = _snap(["k"], [Column(
+        dt.bigint(False), np.arange(n, dtype=np.int64),
+        np.ones(n, bool))])
+
+    class _FakeExec:
+        table = type("T", (), {"snapshot": staticmethod(lambda: snap)})()
+        children = ()
+        dag = D.Aggregation(
+            D.TableScan((0,), (dt.bigint(False),)),
+            (ColumnRef(dt.bigint(False), 0),),
+            (D.AggDesc(D.AggFunc.COUNT, None, dt.bigint(False)),),
+            D.GroupStrategy.DENSE,
+            domain_sizes=(4 * DENSE_BLOWUP_MIN_GROUPS,))
+    _FakeExec.__name__ = "CopTaskExec"
+
+    finds = cost_findings([("select 1", _FakeExec())], n_devices=N_DEV)
+    assert any(f.rule == "COST-DENSE-BLOWUP" for f in finds), finds
+
+
+# ------------------------------------------------------------------ #
+# fusion: bucket-shape agreement is part of the signature
+# ------------------------------------------------------------------ #
+
+class _FakeTask:
+    """Just enough of CopTask for verify_fusion_group."""
+
+    def __init__(self, dag, fp=("x",), sig=(("s", "i8"),),
+                 token=(1, 2, 3), aux=()):
+        self.key = (D.dag_digest(dag), fp, 0, sig)
+        self.dag = dag
+        self.input_token = token
+        self.aux = aux
+
+
+def test_segment_fusion_signature_refuses_incompatible_buckets():
+    """Regression (ISSUE 6 satellite): a SEGMENT task's fusion signature
+    carries its bucket shape, so tasks with incompatible bucket spaces
+    never share a fusion key — and a hand-assembled mixed group is
+    REFUSED by verify_fusion_group with a structured error rather than
+    silently degrading to solo launches at serve time."""
+    a = _seg_dag(4096)
+    b = _seg_dag(8192)
+    sig_a, sig_b = fusion_signature(a), fusion_signature(b)
+    assert sig_a == ("segment-agg", 4096)
+    assert sig_b == ("segment-agg", 8192)
+    assert sig_a != sig_b                       # never one fusion key
+    with pytest.raises(PlanContractError) as ei:
+        verify_fusion_group([_FakeTask(a), _FakeTask(b)])
+    assert ei.value.rule == "fusion-class"
+    assert "bucket" in ei.value.detail
+
+    # identical bucket spaces (different aggregates) DO form a group
+    c = D.Aggregation(
+        D.TableScan((0,), (dt.bigint(False),)),
+        (ColumnRef(dt.bigint(False), 0),),
+        (D.AggDesc(D.AggFunc.SUM, ColumnRef(dt.bigint(False), 0),
+                   copr.sum_out_dtype(dt.bigint(False))),),
+        D.GroupStrategy.SEGMENT, num_buckets=4096)
+    verify_fusion_group([_FakeTask(a), _FakeTask(c)])
+
+    # a SEGMENT member never groups with an in-program agg either
+    scalar = D.Aggregation(
+        D.TableScan((0,), (dt.bigint(False),)), (),
+        (D.AggDesc(D.AggFunc.COUNT, None, dt.bigint(False)),),
+        D.GroupStrategy.SCALAR)
+    with pytest.raises(PlanContractError) as ei:
+        verify_fusion_group([_FakeTask(scalar), _FakeTask(a)])
+    assert ei.value.rule == "fusion-class"
+
+
+def test_same_bucket_segment_tasks_fuse_into_one_launch(mesh):
+    """Two SEGMENT aggregations (same bucket space, different payloads)
+    over one scan run as ONE fused launch with host-merged per-member
+    leaves, each bit-identical to its solo run."""
+    from tidb_tpu.copr.dag import FusedDag
+    from tidb_tpu.parallel.spmd import get_fused_program
+
+    rng = np.random.default_rng(23)
+    n = 20_000
+    k = rng.integers(0, 5_000, n).astype(np.int64)
+    v = rng.integers(0, 100, n).astype(np.int64)
+    snap = _snap(["k", "v"], [
+        Column(dt.bigint(False), k, np.ones(n, bool)),
+        Column(dt.bigint(False), v, np.ones(n, bool))])
+    kref = ColumnRef(dt.bigint(False), 0, "k")
+    vref = ColumnRef(dt.bigint(False), 1, "v")
+    scan = D.TableScan((0, 1), (dt.bigint(False), dt.bigint(False)))
+    a = D.Aggregation(scan, (kref,),
+                      (copr.AggDesc(copr.AggFunc.COUNT, None,
+                                    dt.bigint(False)),),
+                      D.GroupStrategy.SEGMENT, num_buckets=8192)
+    b = D.Aggregation(scan, (kref,),
+                      (copr.AggDesc(copr.AggFunc.MAX, vref, dt.bigint()),),
+                      D.GroupStrategy.SEGMENT, num_buckets=8192)
+    cols, counts = snap.device_cols(mesh)
+    fprog = get_fused_program(FusedDag((a, b)), mesh)
+    out_a, out_b = jax.device_get(fprog(cols, counts))
+    for agg, out in ((a, out_a), (b, out_b)):
+        solo = jax.device_get(get_sharded_program(agg, mesh)(cols, counts))
+        flat_f, _ = jax.tree_util.tree_flatten(out)
+        flat_s, _ = jax.tree_util.tree_flatten(solo)
+        assert all((np.asarray(x) == np.asarray(y)).all()
+                   for x, y in zip(flat_f, flat_s))

@@ -17,10 +17,12 @@ Three passes, all wired into CI as a zero-findings gate
   anything new fails the gate.
 - copcost: a static shape/memory abstract interpreter that walks built
   cop DAGs using only contracts (padded device shapes from DENSE
-  domain_sizes / SORT capacities, physical dtype widths, per-shard
+  domain_sizes / SORT capacities / SEGMENT bucket spaces, physical
+  dtype widths, per-shard
   extents under the mesh) and rolls up a per-launch LaunchCost
   (peak HBM bytes, transfer bytes, flops, padding waste).  Gate rules
-  COST-PAD-WASTE / COST-CAP-BLOWUP / COST-UNBOUNDED ride the corpus;
+  COST-PAD-WASTE / COST-CAP-BLOWUP / COST-DENSE-BLOWUP /
+  COST-UNBOUNDED ride the corpus;
   sched admission enforces peak_hbm_bytes against a per-mesh budget
   (CostError, pre-trace) and EXPLAIN surfaces the estimate.
 

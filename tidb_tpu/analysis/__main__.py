@@ -8,8 +8,8 @@ Runs three static passes and exits non-zero on any NEW finding:
 2. Cost analysis (analysis/copcost) over the TPC-H plan corpus: every
    statement is planned (never executed — no trace, no compile, no
    device) and its static device footprint rolled up; COST-PAD-WASTE /
-   COST-CAP-BLOWUP / COST-UNBOUNDED findings baseline exactly like lint
-   findings.
+   COST-CAP-BLOWUP / COST-DENSE-BLOWUP / COST-UNBOUNDED findings
+   baseline exactly like lint findings.
 3. Plan-contract verification over the same corpus plans
    (analysis.verify_plan); any PlanContractError fails the gate.
 4. RU pricing over the same corpus (rc/pricing over the cost model's

@@ -253,6 +253,18 @@ def _verify_dag(node: D.CopNode, path) -> None:
             if node.group_capacity < 0:
                 _fail("capacity-shape", p,
                       f"negative group capacity {node.group_capacity}")
+        elif node.strategy == D.GroupStrategy.SEGMENT:
+            if not node.group_by:
+                _fail("capacity-shape", p,
+                      "SEGMENT aggregation without keys")
+            b = node.num_buckets
+            if b <= 0 or (b & (b - 1)) != 0:
+                # the radix partition masks the top log2(B) hash bits and
+                # the state table is (B,): a malformed bucket count would
+                # trace a garbage-shaped program
+                _fail("capacity-shape", p,
+                      f"SEGMENT num_buckets {b} is not a positive power "
+                      "of two")
     elif isinstance(node, D.TopN):
         keys = node.sort_keys or (((node.sort_key, node.desc),)
                                   if node.sort_key is not None else ())
@@ -480,20 +492,50 @@ def verify_task(task) -> None:
 # cross-query fusion verification (the scheduler's fusion-group seam)
 # --------------------------------------------------------------------- #
 
+# rows-chain node kinds that may join a rows fusion group: pure scan
+# chains only — joins bring aux inputs / extras the fused launch cannot
+# carry per member
+_ROWS_FUSABLE_NODES = (D.TableScan, D.Selection, D.Projection, D.Expand,
+                       D.TopN, D.Limit)
+
+
+def _rows_fusable(node: D.CopNode) -> bool:
+    if not isinstance(node, _ROWS_FUSABLE_NODES):
+        return False
+    return all(_rows_fusable(c) for c in node.children())
+
+
 def fusion_signature(dag: D.CopNode) -> Optional[tuple]:
     """Contract-level fusion class of a pushed cop DAG, or None when the
     plan cannot join a cross-query fusion group.  Structural only — no
     trace, no jax import: this is exactly the "checkable without tracing"
     substrate PR 2's contracts were built for.
 
-    Fusable class: the root is an Aggregation whose whole merge happens
-    in-program (SCALAR/DENSE strategy — SORT group tables merge host-side
-    with per-device leading axes that a fused leaf could not carry), the
-    chain contains no expanding join (extras drive a per-task regrow
-    loop), and the DAG verifies clean.  The returned tuple is the
-    fusion-key component: all members of one group share it."""
+    Fusable classes (all members of one group share the returned tuple):
+
+    - ``('inprog-agg',)`` — an Aggregation whose whole merge happens
+      in-program (SCALAR/DENSE) with no expanding join in the chain
+      (extras drive a per-task regrow loop).
+    - ``('segment-agg', num_buckets)`` — a SEGMENT (radix-partitioned
+      high-NDV) aggregation: host-merged group tables fuse via a
+      per-member sharded out_spec, but ONLY among identical bucket
+      spaces — the bucket count is part of the signature, so tasks with
+      incompatible bucket shapes refuse to group at the key level
+      instead of silently degrading to per-program launches.
+    - ``('rows',)`` — an extras-free pure scan chain returning rows
+      (fusion-breadth follow-on): members fuse with per-member output
+      capacities (spmd.FusedRowsProgram).
+
+    SORT aggregations stay unfusable: their group-table capacity is
+    regrow-sized per task, so no static shape class exists to share."""
     if not isinstance(dag, D.Aggregation):
-        return None
+        if not _rows_fusable(dag):
+            return None
+        try:
+            verify_dag(dag)
+        except PlanContractError:
+            return None
+        return ("rows",)
     if dag.strategy == D.GroupStrategy.SORT:
         return None
     if D.find_expand_join(dag) is not None:
@@ -502,6 +544,8 @@ def fusion_signature(dag: D.CopNode) -> Optional[tuple]:
         verify_dag(dag)
     except PlanContractError:
         return None
+    if dag.strategy == D.GroupStrategy.SEGMENT:
+        return ("segment-agg", dag.num_buckets)
     return ("inprog-agg",)
 
 
@@ -517,13 +561,22 @@ def verify_fusion_group(tasks: Sequence) -> None:
     if len(tasks) < 2:
         _fail("fusion-group", p, "fusion group needs >= 2 members")
     lead = tasks[0]
+    lead_sig = fusion_signature(lead.dag) if lead.dag is not None else None
     for t in tasks:
         if t.key is None or t.dag is None:
             _fail("fusion-group", p, "opaque task in a fusion group")
-        if fusion_signature(t.dag) is None:
+        sig = fusion_signature(t.dag)
+        if sig is None:
             _fail("fusion-class", p,
-                  f"member {type(t.dag).__name__} is not a fully "
-                  "in-program aggregation chain")
+                  f"member {type(t.dag).__name__} is not in a fusable "
+                  "contract class")
+        if sig != lead_sig:
+            # e.g. a SEGMENT member whose bucket space differs from the
+            # group's: refuse loudly instead of silently degrading
+            _fail("fusion-class", p,
+                  f"member fusion signature {sig} disagrees with the "
+                  f"group's {lead_sig} (incompatible strategy or bucket "
+                  "shape)")
         if t.key[1] != lead.key[1]:
             _fail("mesh-mismatch", p,
                   "fusion group members were keyed against different "

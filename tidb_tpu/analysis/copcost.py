@@ -64,6 +64,17 @@ PAD_WASTE_MAX = 64.0
 # COST-CAP-BLOWUP: an expanding join whose out_capacity exceeds this
 # multiple of its per-device probe rows is a capacity-product blow-up.
 CAP_BLOWUP_MAX = 64.0
+# COST-DENSE-BLOWUP: a DENSE aggregation whose group-state rows exceed
+# this multiple of its per-device input rows AND the planner's dense
+# ceiling (DENSE_BLOWUP_MIN_GROUPS mirrors executor/plan
+# MAX_DENSE_GROUPS, so a planner-selected DENSE plan can never trip
+# the rule) is the degenerate large-NDV dense plan — state vectors
+# dwarf the data; the strategy that 1000x-cliffed and then crashed the
+# real-TPU hndv rung at sf>=10.  A gate finding on corpus plans and a
+# CostError at sched admission, so selection falls back to the SEGMENT
+# strategy instead of faulting the device.
+DENSE_BLOWUP_MAX = 16.0
+DENSE_BLOWUP_MIN_GROUPS = 1_000_000
 # Validated prediction band: on the 8-vdev CPU mesh, peak_hbm_bytes
 # stays within this factor of (measured resident input buffers + D x
 # compiled per-device output+temp sizes); measured ratios on the corpus
@@ -128,6 +139,9 @@ class LaunchCost:
     live_cells: int = 0
     # ((path, out_capacity, probe_rows_per_device), ...) per expanding join
     expanding_joins: tuple = ()
+    # ((path, num_groups, rows_per_device), ...) per degenerate DENSE agg
+    # (group states > DENSE_BLOWUP_MAX x the per-device input rows)
+    dense_blowups: tuple = ()
     # node paths for which no static bound could be derived
     unbounded: tuple = ()
     # ((label, bytes), ...) largest-first, for reports/EXPLAIN
@@ -157,6 +171,7 @@ class LaunchCost:
             self.padded_cells + other.padded_cells,
             self.live_cells + other.live_cells,
             self.expanding_joins + other.expanding_joins,
+            self.dense_blowups + other.dense_blowups,
             self.unbounded + other.unbounded,
             self.breakdown + other.breakdown)
 
@@ -237,12 +252,14 @@ def _expr_flops(e: Optional[Expr]) -> int:
 class _Acc:
     """Per-device walk accumulator; totals multiply by D at rollup."""
 
-    __slots__ = ("inter", "flops", "joins", "unbounded", "breakdown")
+    __slots__ = ("inter", "flops", "joins", "dense_blowups", "unbounded",
+                 "breakdown")
 
     def __init__(self):
         self.inter = 0
         self.flops = 0
         self.joins = []         # (path, out_capacity, probe_rows)
+        self.dense_blowups = []  # (path, num_groups, rows)
         self.unbounded = []
         self.breakdown = []     # (label, per-device bytes)
 
@@ -264,15 +281,16 @@ def _agg_state_width(a: D.AggDesc) -> int:
 
 
 def _agg_groups(agg: D.Aggregation, rows: int) -> int:
-    """Static bound on the per-device group-state rows.  SORT capacity 0
-    means "client starts at its default and regrows" — the static bound
-    is the per-device row count itself (distinct groups cannot exceed
-    contributing rows), so every corpus shape stays boundable."""
+    """Static bound on the per-device group-state rows.  SORT/SEGMENT
+    capacity 0 means "client starts at its default and regrows" — the
+    static bound is the per-device row count itself (distinct groups
+    cannot exceed contributing rows), so every corpus shape stays
+    boundable."""
     if agg.strategy == D.GroupStrategy.SCALAR:
         return 1
     if agg.strategy == D.GroupStrategy.DENSE:
         return max(agg.num_groups, 1)
-    cap = agg.group_capacity
+    cap = agg.state_capacity
     return cap if cap > 0 else max(min(rows, _default_group_capacity()), 1)
 
 
@@ -337,13 +355,27 @@ def _walk(node: D.CopNode, path: tuple, rows: int, layout: Layout,
             acc.flops += (_expr_flops(a.arg) + 1) * rows_in
         if node.strategy == D.GroupStrategy.SORT:
             swidth += len(node.group_by) * 8 + 8       # keys + __ngroups__
-            # device sort of (keys.., payload-index)
+            # device sort of (keys.., payload-index): the comparator
+            # carries 1 + 2*k lanes
             acc.buf("/".join(p) + ":sort",
                     rows_in * (len(node.group_by) + 1) * 8)
             acc.flops += rows_in * _log2(rows_in) * max(
                 len(node.group_by), 1)
+        elif node.strategy == D.GroupStrategy.SEGMENT:
+            swidth += len(node.group_by) * 8 + 8       # keys + __ngroups__
+            # avalanche hash (constant lanes per key) + ONE single-key
+            # radix partition pass of (hash, payload-index)
+            acc.buf("/".join(p) + ":radix", rows_in * 2 * 8)
+            acc.flops += rows_in * (6 * max(len(node.group_by), 1)
+                                    + _log2(rows_in))
         acc.buf("/".join(p) + ":states", groups * swidth)
-        if node.strategy != D.GroupStrategy.SORT:
+        if node.strategy == D.GroupStrategy.DENSE \
+                and groups > DENSE_BLOWUP_MIN_GROUPS \
+                and groups > DENSE_BLOWUP_MAX * max(rows_in, 1):
+            # degenerate dense domain: the state vector dwarfs the data
+            # it aggregates — large-NDV keys must take SEGMENT instead
+            acc.dense_blowups.append(("/".join(p), groups, rows_in))
+        if node.strategy not in D.HOST_MERGE_STRATEGIES:
             # psum-merged states come back replicated; MIN/MAX ride the
             # psum-gather trick whose slot array is Dx the state
             acc.buf("/".join(p) + ":merged", groups * swidth)
@@ -403,7 +435,8 @@ def _dag_walk_cached(dag: D.CopNode, layout: Layout,
     # flatten preamble: the live-row mask every program materializes
     acc.buf("flatten:base_sel", rows0 * _VALIDITY_BYTES)
     rows_out, w_out = _walk(dag, (), rows0, layout, widths, acc)
-    return (acc.inter, acc.flops, tuple(acc.joins), tuple(acc.unbounded),
+    return (acc.inter, acc.flops, tuple(acc.joins),
+            tuple(acc.dense_blowups), tuple(acc.unbounded),
             tuple(acc.breakdown), rows_out, w_out)
 
 
@@ -433,12 +466,12 @@ def dag_cost(dag: D.CopNode, layout: Layout,
     materialized replicated inputs PER DEVICE COPY (totals multiply by
     the mesh size here)."""
     d = max(layout.n_devices, 1)
-    inter_pd, flops_pd, joins, unbounded, breakdown, rows_out, w_out = \
-        _dag_walk_cached(dag, layout, widths)
+    (inter_pd, flops_pd, joins, dense_blowups, unbounded, breakdown,
+     rows_out, w_out) = _dag_walk_cached(dag, layout, widths)
     root = dag.members[-1] if isinstance(dag, D.FusedDag) and dag.members \
         else dag
     if isinstance(root, D.Aggregation):
-        if root.strategy == D.GroupStrategy.SORT:
+        if root.strategy in D.HOST_MERGE_STRATEGIES:
             out_bytes = d * rows_out * w_out      # per-device host merge
         else:
             out_bytes = rows_out * w_out          # replicated, one D2H copy
@@ -455,6 +488,7 @@ def dag_cost(dag: D.CopNode, layout: Layout,
         live_cells=min(layout.live_rows, layout.padded_rows)
         or layout.padded_rows,
         expanding_joins=joins,
+        dense_blowups=dense_blowups,
         unbounded=unbounded,
         breakdown=tuple(sorted(breakdown, key=lambda kv: -kv[1])[:8]))
 
@@ -678,6 +712,14 @@ def cost_findings(plans, n_devices: int = 8) -> list:
                     f"expanding join out_capacity {cap} is "
                     f"{cap / max(rows, 1):.0f}x its per-device probe rows "
                     f"({one_line})"))
+        for path, groups, rows in cost.dense_blowups:
+            out.append(Finding(
+                "COST-DENSE-BLOWUP", qid, 0, path.split("/")[-1],
+                f"DENSE aggregation holds {groups} group states for "
+                f"{rows} per-device rows "
+                f"({groups / max(rows, 1):.0f}x > "
+                f"{DENSE_BLOWUP_MAX:.0f}x): degenerate large-NDV dense "
+                f"domain, use the SEGMENT strategy ({one_line})"))
         for path in cost.unbounded:
             out.append(Finding(
                 "COST-UNBOUNDED", qid, 0, path.split("/")[-1],
@@ -705,5 +747,5 @@ __all__ = ["CostError", "LaunchCost", "Layout", "dag_cost", "task_cost",
            "plan_cost", "cost_findings", "cost_report", "format_bytes",
            "mesh_hbm_budget", "snapshot_layout", "snapshot_scan_widths",
            "snapshot_input_bytes", "PAD_WASTE_MAX", "CAP_BLOWUP_MAX",
-           "COST_TOLERANCE", "DEFAULT_CPU_HBM_BUDGET",
-           "HBM_BUDGET_FRACTION"]
+           "DENSE_BLOWUP_MAX", "DENSE_BLOWUP_MIN_GROUPS", "COST_TOLERANCE",
+           "DEFAULT_CPU_HBM_BUDGET", "HBM_BUDGET_FRACTION"]

@@ -68,7 +68,7 @@ from typing import Iterable, Optional
 # dual-backend (np|jnp) evaluator and its host-object op implementations
 # legitimately concretize when xp is numpy.
 TRACED_MODULES = {
-    "copr/exec.py", "copr/join.py",
+    "copr/exec.py", "copr/join.py", "copr/segment.py",
     "parallel/spmd.py", "parallel/shuffle.py", "parallel/window.py",
     "parallel/exchange.py",
 }
@@ -87,6 +87,9 @@ LOCK_MODULES = {
     "sched/scheduler.py", "utils/poolmgr.py", "utils/rwlock.py",
     "store/client.py", "rc/bucket.py", "rc/controller.py",
     "rc/runaway.py", "utils/resourcegroup.py",
+    # SEGMENT-strategy kernel (ISSUE 6): lock-free today, listed so any
+    # future lock grown there joins the cross-layer order contract
+    "copr/segment.py",
 }
 
 _DIGEST_NAME = re.compile(r"key|digest|token|fingerprint|signature",

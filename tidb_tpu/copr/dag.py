@@ -109,7 +109,17 @@ class Expand(CopNode):
 class GroupStrategy(enum.Enum):
     SCALAR = "scalar"    # no GROUP BY: one output row
     DENSE = "dense"      # small known key domain -> dense group ids
-    SORT = "sort"        # device sort + segment reduce (high NDV)
+    SORT = "sort"        # device multi-key sort + segment reduce
+    SEGMENT = "segment"  # hash -> radix bucket partition + segment reduce
+                         # (high NDV: one single-key sort regardless of key
+                         # arity, bucket count from stats/copcost)
+
+
+# strategies whose per-device group tables merge HOST-side (per-device
+# group sets are not aligned, so there is no elementwise collective
+# merge); consumers: spmd/shuffle host_merge policy, the client's
+# regrow loop, contracts/fusion classes
+HOST_MERGE_STRATEGIES = (GroupStrategy.SORT, GroupStrategy.SEGMENT)
 
 
 @dataclass(frozen=True)
@@ -120,8 +130,14 @@ class Aggregation(CopNode):
     (dict-encoded string column, or planner-bounded int).  `domain_sizes[i]`
     is that size **including** a NULL slot when nullable; the fused kernel
     reduces into a dense (prod(domain_sizes),) state vector — the psum seam.
-    SORT strategy handles unbounded domains via sort+segment-reduce into a
-    fixed-capacity group table.
+    SORT strategy handles unbounded domains via multi-key sort +
+    segment-reduce into a fixed-capacity group table.
+    SEGMENT strategy is the high-NDV device path: group keys avalanche-hash
+    to a power-of-two `num_buckets` radix space whose top bits are the
+    bucket id, ONE single-key partition pass orders rows bucket-major
+    (residual hash ordering inside each bucket comes free), and each
+    bucket's runs segment-reduce into a (num_buckets,) state table
+    (copr/segment.py).
     """
     child: CopNode = None  # type: ignore[assignment]
     group_by: Tuple[Expr, ...] = ()
@@ -129,6 +145,8 @@ class Aggregation(CopNode):
     strategy: GroupStrategy = GroupStrategy.SCALAR
     domain_sizes: Tuple[int, ...] = ()   # DENSE only, aligned with group_by
     group_capacity: int = 0              # SORT only: max distinct groups/shard
+    num_buckets: int = 0                 # SEGMENT only: pow2 radix space =
+                                         # state-table capacity per device
 
     def children(self):
         return (self.child,)
@@ -139,6 +157,13 @@ class Aggregation(CopNode):
         for s in self.domain_sizes:
             n *= s
         return n
+
+    @property
+    def state_capacity(self) -> int:
+        """Per-device group-table capacity of a host-merged strategy."""
+        return (self.num_buckets
+                if self.strategy is GroupStrategy.SEGMENT
+                else self.group_capacity)
 
 
 @dataclass(frozen=True)
@@ -405,7 +430,8 @@ def dag_digest(node: CopNode) -> int:
 
 __all__ = [
     "AggFunc", "AggDesc", "CopNode", "TableScan", "Selection", "Projection",
-    "Expand", "GroupStrategy", "Aggregation", "TopN", "Limit", "LookupJoin",
+    "Expand", "GroupStrategy", "HOST_MERGE_STRATEGIES", "Aggregation",
+    "TopN", "Limit", "LookupJoin",
     "FusedDag", "ShuffleJoinSpec", "output_dtypes", "dag_digest",
     "find_expand_join", "rewrite_lookup", "drop_lookup", "chain_str",
     "rewrite_expand_capacity",

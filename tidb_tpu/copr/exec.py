@@ -235,13 +235,19 @@ def _agg_partial_states(agg: D.Aggregation, batch: DeviceBatch, ev: Evaluator,
     """Per-shard partial-state pytree for an Aggregation node.
 
     SCALAR/DENSE: fixed group domain, psum-mergeable across shards.
-    SORT: unbounded key domain via sort + segment-reduce into a
-    fixed-capacity group table (host merge across shards) — the TPU
+    SORT: unbounded key domain via multi-key sort + segment-reduce into
+    a fixed-capacity group table (host merge across shards) — the TPU
     answer to the reference's high-NDV parallel HashAgg
     (pkg/executor/aggregate/agg_hash_executor.go:94); hash tables lose to
     sort+segment ops on TPU (SURVEY.md §7 hard part 4).
+    SEGMENT: the high-NDV refinement — keys avalanche-hash into one
+    uint64 radix space, a SINGLE-key partition pass buckets rows, and
+    each bucket's runs segment-reduce (copr/segment.py).
     Adds '__rows__' (COUNT(*) per group) for occupancy.
     """
+    if agg.strategy == D.GroupStrategy.SEGMENT:
+        from .segment import agg_segment_states
+        return agg_segment_states(agg, batch, ev, memo)
     if agg.strategy == D.GroupStrategy.SORT:
         return _agg_sort_states(agg, batch, ev, memo)
 
@@ -265,6 +271,30 @@ def _agg_partial_states(agg: D.Aggregation, batch: DeviceBatch, ev: Evaluator,
     return states
 
 
+def group_keyinfo(agg: D.Aggregation, batch: DeviceBatch, ev: Evaluator,
+                  memo: dict, n: int) -> list:
+    """Canonical per-group-key (zeroed value, mask, null flag, order-
+    preserving int64 code) tuples — the shared key representation of the
+    SORT and SEGMENT strategies.  NULL values are zeroed so all NULLs
+    share one group; -0.0 groups with +0.0 (SQL equality, not bit
+    equality)."""
+    keyinfo = []
+    for e in agg.group_by:
+        v, m = ev.eval(e, batch.cols, memo)
+        v = _ensure_array(v, n)
+        if v.dtype == bool:
+            v = v.astype(jnp.int64)
+        nullf = (jnp.zeros(n, jnp.int32) if m is True
+                 else (~m).astype(jnp.int32))
+        vz = v if m is True else jnp.where(m, v, jnp.zeros((), v.dtype))
+        if e.dtype.is_float:
+            vz = jnp.where(vz == 0, jnp.zeros((), vz.dtype), vz)
+        code = sortable_int64(jnp, vz, e.dtype.is_float,
+                              e.dtype.kind == K.UINT64)
+        keyinfo.append((vz, m, nullf, code))
+    return keyinfo
+
+
 def _agg_sort_states(agg: D.Aggregation, batch: DeviceBatch, ev: Evaluator,
                      memo: dict):
     """SORT-strategy grouped aggregation: one multi-key lax.sort, segment
@@ -281,21 +311,7 @@ def _agg_sort_states(agg: D.Aggregation, batch: DeviceBatch, ev: Evaluator,
     n = len(batch.cols[0][0]) if batch.cols else 0
     sel = _sel_array(batch.sel, n)
 
-    keyinfo = []
-    for e in agg.group_by:
-        v, m = ev.eval(e, batch.cols, memo)
-        v = _ensure_array(v, n)
-        if v.dtype == bool:
-            v = v.astype(jnp.int64)
-        nullf = (jnp.zeros(n, jnp.int32) if m is True
-                 else (~m).astype(jnp.int32))
-        vz = v if m is True else jnp.where(m, v, jnp.zeros((), v.dtype))
-        if e.dtype.is_float:
-            # -0.0 must group with +0.0 (SQL equality, not bit equality)
-            vz = jnp.where(vz == 0, jnp.zeros((), vz.dtype), vz)
-        code = sortable_int64(jnp, vz, e.dtype.is_float,
-                              e.dtype.kind == K.UINT64)
-        keyinfo.append((vz, m, nullf, code))
+    keyinfo = group_keyinfo(agg, batch, ev, memo, n)
 
     dead = (~sel).astype(jnp.int32)
     ops: list = [dead]
@@ -619,4 +635,5 @@ def get_program(dag_root: D.CopNode, row_capacity: int = 0) -> CopProgram:
     return _cached_program(dag_root, row_capacity)
 
 
-__all__ = ["DeviceBatch", "CopProgram", "get_program", "compact"]
+__all__ = ["DeviceBatch", "CopProgram", "get_program", "compact",
+           "group_keyinfo"]

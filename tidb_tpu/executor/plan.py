@@ -34,6 +34,14 @@ K = dt.TypeKind
 
 MAX_DENSE_GROUPS = 1_000_000
 
+# NDV threshold between the two unbounded-domain device strategies: at or
+# above this estimated distinct-group capacity the planner picks SEGMENT
+# (hash -> radix bucket partition, ONE single-key sort lane, copcost-
+# derived pow2 bucket space) over SORT (multi-key comparator, 1 + 2*k
+# lanes) — the multi-operand sort is what turned the real-TPU 2M-group
+# bench rung into a 1000x cliff (BENCH_TPU.json hndv_vs_numpy 0.05x).
+SEGMENT_MIN_NDV = 1 << 15
+
 # stats handle for the CURRENT planning pass (set by the session around
 # to_physical — the SUBQUERY_EXECUTOR contextvar precedent); consumers:
 # SORT-agg group-table capacity from column NDV, so fresh auto-analyze
@@ -1199,6 +1207,7 @@ def _bind_agg(agg: LogicalAggregate, child: D.CopNode, dicts,
         return None, None
 
     domains = [_key_domain(g) for g in agg.group_exprs]
+    known_total = 0
     if all(size is not None for size, _d in domains):
         sizes = []
         metas = []
@@ -1212,11 +1221,17 @@ def _bind_agg(agg: LogicalAggregate, child: D.CopNode, dicts,
             return D.Aggregation(child, tuple(agg.group_exprs), tuple(descs),
                                  D.GroupStrategy.DENSE,
                                  domain_sizes=tuple(sizes))
+        # dense fell through on domain size: the known key-domain product
+        # still bounds NDV when stats are absent
+        known_total = total
 
-    # SORT for everything else orderable: device sort + segment-reduce
-    # handles arbitrary NDV (the reference's high-NDV parallel HashAgg,
-    # agg_hash_executor.go:94, re-designed for TPU — SURVEY.md §7 hard
-    # part 4: sort-based group-by beats hashing on TPU)
+    # SORT / SEGMENT for everything else orderable: device partition +
+    # segment-reduce handles arbitrary NDV (the reference's high-NDV
+    # parallel HashAgg, agg_hash_executor.go:94, re-designed for TPU —
+    # SURVEY.md §7 hard part 4: sort-based group-by beats hashing on TPU).
+    # Above SEGMENT_MIN_NDV estimated groups the radix-partitioned
+    # SEGMENT strategy wins: one single-key partition lane instead of the
+    # SORT comparator's 1 + 2*k.
     metas = []
     lowered = []
     for g in agg.group_exprs:
@@ -1233,16 +1248,31 @@ def _bind_agg(agg: LogicalAggregate, child: D.CopNode, dicts,
         metas.append(GroupKeyMeta(g.dtype, 0, d))
         lowered.append(lg)
     key_meta_out.extend(metas)
+    cap = _ndv_capacity(agg, ds)
+    if cap == 0 and known_total:
+        cap = _cap_pow2(known_total)
+    if cap >= SEGMENT_MIN_NDV:
+        return D.Aggregation(child, tuple(lowered), tuple(descs),
+                             D.GroupStrategy.SEGMENT, num_buckets=cap)
     return D.Aggregation(child, tuple(lowered), tuple(descs),
                          D.GroupStrategy.SORT,
-                         group_capacity=_ndv_capacity(agg, ds))
+                         group_capacity=cap)
+
+
+def _cap_pow2(total: int) -> int:
+    """25% headroom, pow2-rounded, bounded to [1024, 2^22] — the shape
+    every group-table capacity / bucket count takes."""
+    cap = 1 << (int(total * 1.25) - 1).bit_length()
+    return max(1024, min(cap, 1 << 22))
 
 
 def _ndv_capacity(agg, ds) -> int:
-    """Initial SORT group-table capacity from stats NDV (the consumer half
-    of auto-analyze, VERDICT r2 #8): product of per-key NDVs with 25%
-    headroom, pow2-rounded, bounded — 0 when stats are absent (the client
-    then starts at its default and regrows from observed __ngroups__)."""
+    """Initial SORT/SEGMENT group-table capacity from stats NDV (the
+    consumer half of auto-analyze, VERDICT r2 #8): product of per-key
+    NDVs with 25% headroom, pow2-rounded, bounded — 0 when stats are
+    absent (the client then starts at its default and regrows from
+    observed __ngroups__).  Doubles as the strategy-selection NDV
+    estimate (SEGMENT above SEGMENT_MIN_NDV)."""
     handle = STATS_HANDLE.get()
     if handle is None or ds is None:
         return 0
@@ -1263,8 +1293,7 @@ def _ndv_capacity(agg, ds) -> int:
         total *= max(int(cs.ndv), 1)
         if total > MAX_DENSE_GROUPS:
             break
-    cap = 1 << (int(total * 1.25) - 1).bit_length()
-    return max(1024, min(cap, 1 << 22))
+    return _cap_pow2(total)
 
 
 __all__ = ["to_physical"]

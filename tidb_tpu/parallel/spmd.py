@@ -89,12 +89,12 @@ class ShardedCopProgram:
         self.kind = "agg" if self.agg is not None else "rows"
         # MIN/MAX merge IN-PROGRAM via _psum_gather (psum-only all_gather +
         # reduce), so runtimes that lower only Sum all-reduce still keep
-        # the whole merge on device.  Only SORT-strategy group tables merge
-        # host-side: per-device group sets aren't aligned, so there is no
-        # elementwise collective merge (the repartition-exchange path is
-        # the in-program alternative).
-        self.host_merge = (self.agg is not None
-                           and self.agg.strategy == D.GroupStrategy.SORT)
+        # the whole merge on device.  Only SORT/SEGMENT-strategy group
+        # tables merge host-side: per-device group sets aren't aligned, so
+        # there is no elementwise collective merge (the repartition-
+        # exchange path is the in-program alternative).
+        self.host_merge = (self.agg is not None and self.agg.strategy
+                           in D.HOST_MERGE_STRATEGIES)
         # int/decimal SUMs produce (hi, lo) limb states whose in-program
         # psum is int64-exact only below 2^31 global rows; float sums,
         # counts, and host-merged (object-int) programs are exempt
@@ -185,10 +185,15 @@ class FusedCopProgram:
     once and every member's merged states come back as a separate output
     leaf, demultiplexed to its waiter by the scheduler.
 
-    Only fully in-program agg members qualify (kind 'agg', no host
-    merge, no extras — the contract class of
-    analysis.contracts.fusion_signature): their outputs are replicated
-    post-psum, so leaves never interact."""
+    Agg members qualify when they are extras-free (an expanding join's
+    regrow loop re-runs programs per task — the contract class of
+    analysis.contracts.fusion_signature).  In-program members
+    (SCALAR/DENSE) come back replicated post-psum; host-merge members
+    (SEGMENT group tables) keep their per-device leading axis via a
+    per-member out_spec, so fused leaves never interact either way.
+    SEGMENT members additionally share one bucket shape — the fusion
+    signature carries num_buckets, so incompatible bucket spaces never
+    reach this constructor."""
 
     def __init__(self, fused: D.FusedDag, mesh):
         if len(fused.members) < 2:
@@ -198,20 +203,23 @@ class FusedCopProgram:
         self.members = tuple(get_sharded_program(m, mesh)
                              for m in fused.members)
         for p in self.members:
-            if p.kind != "agg" or p.host_merge or p.has_extras:
+            if p.kind != "agg" or p.has_extras:
                 raise ValueError(
-                    "only fully in-program agg chains fuse (member "
+                    "only extras-free agg chains fuse (member "
                     f"{type(p.root).__name__} is {p.kind}"
-                    f"{'+host-merge' if p.host_merge else ''}"
                     f"{'+extras' if p.has_extras else ''})")
         # the fence is the OR of the members': same capacity inputs, so
         # one limb-overflow bound covers every leaf
         self._psum_limb_fence = any(p._psum_limb_fence
                                     for p in self.members)
         in_specs = (P(SHARD_AXIS), P(SHARD_AXIS), P())
+        # per-member out_specs: a host-merge member's states carry a
+        # per-device leading axis, an in-program member's are replicated
+        out_specs = tuple(P(SHARD_AXIS) if p.host_merge else P()
+                          for p in self.members)
         self._fn = jax.jit(shard_map(
             self._device_fn, mesh=mesh, in_specs=in_specs,
-            out_specs=P()))
+            out_specs=out_specs))
 
     def _device_fn(self, cols, counts, aux):
         # each member re-traces its chain over the SAME input refs; XLA
@@ -236,6 +244,57 @@ def _cached_fused(fused, mesh):
 
 def get_fused_program(fused: D.FusedDag, mesh) -> FusedCopProgram:
     return _cached_fused(fused, mesh)
+
+
+class FusedRowsProgram:
+    """N compatible ROW-returning cop chains over ONE shared scan
+    (ROADMAP fusion-breadth follow-on): rows-kind plans reading the same
+    snapshot residents fuse into one launch with PER-MEMBER output
+    capacities — each member keeps its own cumsum-compaction buffer and
+    live count, so every waiter's paging (regrow-on-overflow) loop still
+    sees its own counts.  Only extras-free chains qualify (an expanding
+    join re-runs programs per task); XLA CSEs the shared scan loads and
+    masks across members exactly as in the agg fusion."""
+
+    def __init__(self, fused: D.FusedDag, mesh, row_capacities: tuple):
+        if len(fused.members) < 2:
+            raise ValueError("fusion needs at least two member chains")
+        if len(row_capacities) != len(fused.members):
+            raise ValueError("one row capacity per member chain")
+        self.fused = fused
+        self.mesh = mesh
+        self.members = tuple(
+            get_sharded_program(m, mesh, cap)
+            for m, cap in zip(fused.members, row_capacities))
+        for p in self.members:
+            if p.kind != "rows" or p.has_extras:
+                raise ValueError(
+                    "only extras-free row chains fuse (member "
+                    f"{type(p.root).__name__} is {p.kind}"
+                    f"{'+extras' if p.has_extras else ''})")
+        in_specs = (P(SHARD_AXIS), P(SHARD_AXIS), P())
+        out_specs = tuple((P(SHARD_AXIS), P(SHARD_AXIS))
+                          for _ in self.members)
+        self._fn = jax.jit(shard_map(
+            self._device_fn, mesh=mesh, in_specs=in_specs,
+            out_specs=out_specs))
+
+    def _device_fn(self, cols, counts, aux):
+        return tuple(p._device_fn(cols, counts, aux)
+                     for p in self.members)
+
+    def __call__(self, stacked_cols: Sequence, counts, aux_cols=()):
+        return self._fn(tuple(stacked_cols), counts, tuple(aux_cols))
+
+
+@functools.lru_cache(maxsize=64)
+def _cached_fused_rows(fused, mesh, row_capacities):
+    return FusedRowsProgram(fused, mesh, row_capacities)
+
+
+def get_fused_rows_program(fused: D.FusedDag, mesh,
+                           row_capacities: tuple) -> FusedRowsProgram:
+    return _cached_fused_rows(fused, mesh, tuple(row_capacities))
 
 
 def _stack_slots(cols_list, counts_list, n_slots):
@@ -354,4 +413,5 @@ def get_batched_rows_program(dag_root: D.CopNode, mesh, row_capacity: int,
 __all__ = ["ShardedCopProgram", "get_sharded_program",
            "BatchedCopProgram", "get_batched_program",
            "BatchedRowsProgram", "get_batched_rows_program",
-           "FusedCopProgram", "get_fused_program"]
+           "FusedCopProgram", "get_fused_program",
+           "FusedRowsProgram", "get_fused_rows_program"]

@@ -287,6 +287,19 @@ class DeviceScheduler:
                 "cost-unbounded", p,
                 "no static device-footprint bound derivable for "
                 f"{', '.join(cost.unbounded)}")
+        if cost.dense_blowups:
+            # degenerate DENSE at large NDV: the plan that 1000x-cliffed
+            # (and at sf>=10 crashed) the real-TPU hndv rung — reject
+            # pre-trace so selection falls back to the SEGMENT strategy
+            path, groups, rows = cost.dense_blowups[0]
+            with self._mu:
+                self.budget_rejects += 1
+            self._m_brej.inc()
+            raise CostError(
+                "dense-blowup", p,
+                f"DENSE aggregation at {path} holds {groups} group "
+                f"states for {rows} per-device rows — degenerate "
+                "large-NDV dense domain; use GroupStrategy.SEGMENT")
         budget = self.effective_budget(task.mesh)
         if budget > 0 and cost.peak_hbm_bytes > budget:
             with self._mu:
@@ -700,9 +713,14 @@ class DeviceScheduler:
     def _serve_fused(self, programs: list) -> bool:
         """ONE launch computing every member program's payload from the
         shared scan; False = refused (contract violation / backend
-        can't), caller falls back to per-program launches."""
+        can't), caller falls back to per-program launches.  Agg member
+        groups run as a FusedCopProgram; rows-kind groups (fusion-breadth
+        follow-on) run as a FusedRowsProgram with per-member output
+        capacities."""
         from ..copr import dag as D
-        from ..parallel.spmd import get_fused_program, get_sharded_program
+        from ..parallel.spmd import (get_fused_program,
+                                     get_fused_rows_program,
+                                     get_sharded_program)
         members = [grp[0] for grp in programs]
         lead = members[0]
         try:
@@ -712,7 +730,12 @@ class DeviceScheduler:
             # result would come from the wrong snapshot residents
             verify_fusion_group([t for grp in programs for t in grp])
             fused = D.FusedDag(tuple(t.dag for t in members))
-            fprog = get_fused_program(fused, lead.mesh)
+            if isinstance(lead.dag, D.Aggregation):
+                fprog = get_fused_program(fused, lead.mesh)
+            else:
+                fprog = get_fused_rows_program(
+                    fused, lead.mesh,
+                    tuple(t.row_capacity for t in members))
             outs = fprog(lead.cols, lead.counts)
         except Exception:   # noqa: BLE001 - fusion capability probe:
             return False    # refused groups launch apart below (same

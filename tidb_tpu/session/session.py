@@ -1192,6 +1192,9 @@ class Session:
             footer = self._cost_footer(phys)
             if footer is not None:
                 rows.append((footer,))
+            strat = self._agg_strategy_footer(phys)
+            if strat is not None:
+                rows.append((strat,))
         return ResultSet(["plan"], rows)
 
     def _cost_footer(self, phys) -> Optional[str]:
@@ -1214,6 +1217,36 @@ class Session:
         except (AttributeError, TypeError, KeyError, ValueError,
                 ImportError):
             return None
+
+    def _agg_strategy_footer(self, phys) -> Optional[str]:
+        """EXPLAIN ``agg strategy:`` tag: which device group-by strategy
+        the pushed aggregation takes, with its capacity knob — dense
+        (domain product), sort (regrow capacity), or segment (radix
+        bucket space).  None for scalar/host-only plans; must never
+        break EXPLAIN."""
+        try:
+            from ..copr import dag as Dg
+            stack = [phys]
+            while stack:
+                op = stack.pop()
+                dag = getattr(op, "dag", None)
+                if dag is None:
+                    dag = getattr(getattr(op, "spec", None), "top", None)
+                if isinstance(dag, Dg.Aggregation) and dag.group_by:
+                    if dag.strategy is Dg.GroupStrategy.SEGMENT:
+                        return (f"agg strategy: segment "
+                                f"({dag.num_buckets} buckets)")
+                    if dag.strategy is Dg.GroupStrategy.SORT:
+                        return (f"agg strategy: sort (capacity "
+                                f"{dag.group_capacity or 'auto'})")
+                    return (f"agg strategy: dense "
+                            f"({dag.num_groups} groups)")
+                for c in getattr(op, "children", []) or []:
+                    if c is not None:
+                        stack.append(c)
+        except (AttributeError, TypeError):
+            return None
+        return None
 
     def _exec_plan_replayer(self, stmt: A.PlanReplayerDump) -> ResultSet:
         """PLAN REPLAYER DUMP EXPLAIN <sql> (executor/plan_replayer.go):

@@ -326,7 +326,10 @@ class CopClient:
     def _execute_agg_once(self, agg: D.Aggregation, snap: ColumnarSnapshot,
                           key_meta: list[GroupKeyMeta],
                           aux_cols=()) -> CopResult:
-        if agg.strategy == D.GroupStrategy.SORT:
+        if agg.strategy in D.HOST_MERGE_STRATEGIES:
+            # SORT and SEGMENT share one dispatch path: per-device group
+            # tables, host final merge, capacity regrow (the SEGMENT
+            # knob is its pow2 bucket space instead of group_capacity)
             if not aux_cols and self._platform() == "cpu":
                 res = self._host_sort_agg(agg, snap, key_meta)
                 if res is not None:
@@ -412,14 +415,24 @@ class CopClient:
         key_cols, agg_cols = finalize(agg, merged, key_meta)
         return CopResult(agg_cols, key_cols)
 
-    def _stream_sort_agg(self, agg, batches, key_meta) -> CopResult:
+    @staticmethod
+    def _with_capacity(agg: D.Aggregation, cap: int) -> D.Aggregation:
+        """Rebuild a host-merged aggregation with a new per-device group
+        table capacity: SORT sizes group_capacity directly, SEGMENT its
+        power-of-two radix bucket space (the regrow knob)."""
         import dataclasses
-        cap = agg.group_capacity or DEFAULT_GROUP_CAPACITY
+        if agg.strategy == D.GroupStrategy.SEGMENT:
+            return dataclasses.replace(agg,
+                                       num_buckets=_pow2_at_least(cap))
+        return dataclasses.replace(agg, group_capacity=cap)
+
+    def _stream_sort_agg(self, agg, batches, key_meta) -> CopResult:
+        cap = agg.state_capacity or DEFAULT_GROUP_CAPACITY
         per_dev_all = []
         for b in batches:
             cols, counts = b.device_put_uncached(self.mesh)
             for _ in range(10):
-                sized = dataclasses.replace(agg, group_capacity=cap)
+                sized = self._with_capacity(agg, cap)
                 _prog, out = self._launch(sized, cols, counts, ())
                 states = jax.device_get(out)
                 true_ng = int(np.max(np.asarray(states["__ngroups__"])))
@@ -430,7 +443,7 @@ class CopClient:
                 raise RuntimeError("group-capacity regrow did not converge")
             per_dev_all.extend(self._split_devices(states))
             del cols, counts
-        sized = dataclasses.replace(agg, group_capacity=cap)
+        sized = self._with_capacity(agg, cap)
         merged = merge_sorted_states(sized, per_dev_all)
         key_cols, agg_cols = finalize_sorted(sized, merged, key_meta)
         return CopResult(agg_cols, key_cols)
@@ -476,13 +489,13 @@ class CopClient:
 
     def _execute_sort_agg(self, agg, cols, counts, key_meta,
                           aux_cols) -> CopResult:
-        """High-NDV group-by: per-device sort+segment-reduce group tables,
-        regrown when a device sees more distinct groups than capacity
-        (the paging grow-from-min analog), then host final merge."""
-        import dataclasses
-        cap = agg.group_capacity or DEFAULT_GROUP_CAPACITY
+        """High-NDV group-by (SORT / SEGMENT): per-device partition +
+        segment-reduce group tables, regrown when a device sees more
+        distinct groups than capacity (the paging grow-from-min analog),
+        then host final merge."""
+        cap = agg.state_capacity or DEFAULT_GROUP_CAPACITY
         for _ in range(10):
-            sized = dataclasses.replace(agg, group_capacity=cap)
+            sized = self._with_capacity(agg, cap)
             prog, out = self._launch(sized, cols, counts, tuple(aux_cols))
             if prog.has_extras:
                 out, extras = out
@@ -493,7 +506,7 @@ class CopClient:
             states = jax.device_get(out)
             true_ng = int(np.max(np.asarray(states["__ngroups__"])))
             if true_ng <= cap:
-                sized = dataclasses.replace(agg, group_capacity=cap)
+                sized = self._with_capacity(agg, cap)
                 break
             cap = _pow2_at_least(true_ng)
         else:
@@ -531,10 +544,10 @@ class CopClient:
         rcols, rcounts = rsnap.device_cols(self.mesh)
         caps = self._shuffle_initial_caps(lsnap, rsnap, row_cap)
         agg = spec.top if isinstance(spec.top, D.Aggregation) else None
-        if agg is not None and agg.strategy == D.GroupStrategy.SORT \
-                and not agg.group_capacity:
-            spec = dataclasses.replace(spec, top=dataclasses.replace(
-                agg, group_capacity=DEFAULT_GROUP_CAPACITY))
+        if agg is not None and agg.strategy in D.HOST_MERGE_STRATEGIES \
+                and not agg.state_capacity:
+            spec = dataclasses.replace(spec, top=self._with_capacity(
+                agg, DEFAULT_GROUP_CAPACITY))
         for _ in range(12):
             prog = get_shuffle_program(spec, self.mesh, caps)
             out, extras = self._launch_opaque(
@@ -560,12 +573,12 @@ class CopClient:
             if grew:
                 continue
             agg = spec.top if isinstance(spec.top, D.Aggregation) else None
-            if agg is not None and agg.strategy == D.GroupStrategy.SORT:
+            if agg is not None and agg.strategy in D.HOST_MERGE_STRATEGIES:
                 true_ng = int(np.max(np.asarray(
                     jax.device_get(out["__ngroups__"]))))
-                if true_ng > agg.group_capacity:
-                    spec = dataclasses.replace(spec, top=dataclasses.replace(
-                        agg, group_capacity=_pow2_at_least(true_ng)))
+                if true_ng > agg.state_capacity:
+                    spec = dataclasses.replace(spec, top=self._with_capacity(
+                        agg, _pow2_at_least(true_ng)))
                     continue
             if agg is None:
                 _cols, counts = out
@@ -621,7 +634,7 @@ class CopClient:
         states = jax.device_get(out)
         if prog.host_merge:
             per_dev = self._split_devices(states)
-            if agg.strategy == D.GroupStrategy.SORT:
+            if agg.strategy in D.HOST_MERGE_STRATEGIES:
                 merged = merge_sorted_states(agg, per_dev)
                 key_cols, agg_cols = finalize_sorted(agg, merged, key_meta)
                 return CopResult(agg_cols, key_cols)

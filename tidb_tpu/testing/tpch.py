@@ -178,9 +178,19 @@ TPCH_PLAN_QUERIES = [
        from lineitem where l_shipdate <= date '1998-09-02'
        group by l_returnflag, l_linestatus
        order by l_returnflag, l_linestatus""",
-    # high-NDV group-by: SORT-strategy aggregation
+    # high-NDV group-by: SORT-strategy aggregation (single key: stats NDV
+    # stays below the SEGMENT threshold at corpus scale)
     """select l_orderkey, sum(l_extendedprice) from lineitem
        group by l_orderkey""",
+    # very-high-NDV group-bys: the per-key stats NDV PRODUCT crosses
+    # SEGMENT_MIN_NDV, so these plan as the radix-partitioned SEGMENT
+    # strategy (tpch_plan_session ANALYZEs lineitem so the estimates
+    # exist at plan time) — the gate keeps them contract-clean and
+    # rc-pricing-finite like every other corpus shape
+    """select l_orderkey, l_partkey, count(*), sum(l_quantity)
+       from lineitem group by l_orderkey, l_partkey""",
+    """select l_orderkey, l_suppkey, max(l_extendedprice) from lineitem
+       where l_quantity < 45 group by l_orderkey, l_suppkey""",
     # rollup: Expand + grouping sets
     """select l_returnflag, l_linestatus, sum(l_quantity) from lineitem
        group by l_returnflag, l_linestatus with rollup""",
@@ -241,7 +251,12 @@ def tpch_plan_session(sf: float = 0.001, n_orders: int = 512):
         t = TableInfo(name, list(names), [c.dtype for c in cols])
         t.register_columns(list(cols))
         dom.catalog.create_table("test", t)
-    return Session(dom)
+    sess = Session(dom)
+    # stats NDV feeds SORT-vs-SEGMENT strategy selection and the
+    # group-table capacity seed (executor/plan._ndv_capacity): the
+    # corpus' high-NDV queries must plan as SEGMENT
+    sess.execute("analyze table lineitem")
+    return sess
 
 
 # planned with the broadcast threshold forced to 0 so the repartition
