@@ -470,6 +470,10 @@ def mode_sched():
         "window_hits": st.get("window_hits", 0),
         "budget_deferrals": st.get("budget_deferrals", 0),
         "last_launch_bytes": st.get("last_launch_bytes", 0),
+        # buffer donation (analysis/lifetime): batched-stack and
+        # streamed-batch launches that aliased inputs into outputs
+        "donated_launches": st.get("donated_launches", 0),
+        "donated_bytes": st.get("donated_bytes", 0),
     }
     out["rc"] = _sched_rc_scenario(dom, s, sched, queries[0])
     log("sched-concurrent:", json.dumps(out))

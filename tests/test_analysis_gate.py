@@ -41,9 +41,21 @@ def test_gate_prices_every_corpus_plan():
 
 
 def test_check_baseline_passes():
-    """Baseline hygiene (ISSUE 4 satellite): every accepted-findings
-    entry must still match a current finding, so waivers cannot rot
-    silently."""
+    """Baseline hygiene (ISSUE 4 satellite, re-pinned by ISSUE 7):
+    every accepted-findings entry must still match a current finding,
+    so waivers cannot rot silently."""
     proc = _run_gate("--check-baseline")
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "baseline clean" in proc.stdout, proc.stdout
+
+
+def test_donation_report_covers_whole_corpus():
+    """ISSUE 7 satellite: ``--donation-report`` prints the per-corpus-
+    query buffer-lifetime table and every TPC-H corpus query gets a
+    finite DonationPlan (the gate run above already asserts zero
+    DONATE-* findings ride tier-1)."""
+    proc = _run_gate("--donation-report")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "donation: 20/20 corpus plans planned finite" in proc.stdout, \
+        proc.stdout
+    assert "ephemeral" in proc.stdout and "loop-carried" in proc.stdout

@@ -105,3 +105,36 @@ def test_string_in_with_null_item():
     # AIR -> TRUE; SHIP -> NULL (because of the NULL item)
     assert bool(np.asarray(valid)[0]) and bool(np.asarray(val)[0])
     assert not bool(np.asarray(valid)[1])
+
+
+def test_decimal_scalar_overflow_raises_not_wraps():
+    """ISSUE 7 satellite (expr/builders.py gap): a host-evaluated
+    DECIMAL scalar op whose scaled-int64 encoding overflows must raise
+    OverflowError — wrapped digits read back as a plausible wrong
+    decimal with no error.  Device (jnp) lanes stay unguarded (a traced
+    program cannot raise data-dependently); the builders comment now
+    names exactly that."""
+    import pytest
+    t = dt.decimal(18, 2)
+    a, b = ColumnRef(t, 0), ColumnRef(t, 1)
+    big = Column(t, np.array([999_999_999_999_999_999, 150], np.int64),
+                 np.ones(2, bool))
+    cols = [pair(big), pair(big)]
+    with pytest.raises(OverflowError, match="out of range"):
+        eval_expr(np, B.arith("mul", a, b), cols)
+    # add overflows int64 only past ~9.2e18 scaled
+    near = Column(t, np.array([2 ** 62, 100], np.int64), np.ones(2, bool))
+    cols2 = [pair(near), pair(near)]
+    with pytest.raises(OverflowError, match="out of range"):
+        eval_expr(np, B.arith("add", a, b), cols2)
+    with pytest.raises(OverflowError, match="out of range"):
+        eval_expr(np, B.arith("sub", a, B.neg(b)), cols2)
+    # in-range values are untouched, and garbage on INVALID lanes
+    # never raises (validity masks the guard)
+    small = Column(t, np.array([150, 225], np.int64), np.ones(2, bool))
+    v, _m = eval_expr(np, B.arith("mul", a, b), [pair(small), pair(small)])
+    assert list(np.asarray(v)) == [22500, 50625]
+    masked = Column(t, np.array([2 ** 62, 10], np.int64),
+                    np.array([False, True]))
+    v2, m2 = eval_expr(np, B.arith("mul", a, b), [pair(masked), pair(masked)])
+    assert bool(np.asarray(m2)[1]) and int(np.asarray(v2)[1]) == 100

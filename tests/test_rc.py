@@ -209,10 +209,10 @@ def test_rc_exhausted_group_never_traced_and_deadline(monkeypatch):
     monkeypatch.setattr(sched, "submit", submit_spy)
     real_get = spmd.get_sharded_program
 
-    def guarded(dag, mesh, row_capacity=0):
+    def guarded(dag, mesh, row_capacity=0, donate=False):
         assert dag_digest(dag) not in forbidden, \
             "RU-exhausted group's dag reached trace/compile"
-        return real_get(dag, mesh, row_capacity)
+        return real_get(dag, mesh, row_capacity, donate)
 
     monkeypatch.setattr(spmd, "get_sharded_program", guarded)
 

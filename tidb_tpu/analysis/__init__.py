@@ -25,6 +25,15 @@ Three passes, all wired into CI as a zero-findings gate
   COST-UNBOUNDED ride the corpus;
   sched admission enforces peak_hbm_bytes against a per-mesh budget
   (CostError, pre-trace) and EXPLAIN surfaces the estimate.
+- coplife (analysis/lifetime): a buffer-lifetime pass over the same
+  contract DAGs classifying every device-program input slot as
+  PERSISTENT (snapshot-cache residents) / LOOP-CARRIED (paging and
+  regrow state the client re-feeds) / EPHEMERAL (dead after the
+  launch), and emitting the per-program-shape DonationPlan the spmd
+  builders derive ``donate_argnums`` from.  DONATE-UNSAFE /
+  DONATE-MISSED gate rules ride the corpus; sched admission rejects a
+  donating task over a live resident pre-trace, and donated bytes
+  tighten LaunchCost.peak_hbm_bytes.
 
 The motivation is the compiler-first failure mode: with XLA-compiled cop
 programs a bad plan no longer fails with a type error at build time — it
@@ -37,8 +46,12 @@ gate between planner/build and jit.
 from .contracts import (PlanContractError, verify_dag, verify_plan,
                         verify_task)
 from .copcost import CostError, LaunchCost, plan_cost, task_cost
+from .lifetime import (BufferClass, DonationError, DonationPlan,
+                       donation_plan, verify_donation)
 from .lint import Finding, lint_source, lint_tree, load_baseline
 
 __all__ = ["PlanContractError", "verify_plan", "verify_dag", "verify_task",
            "CostError", "LaunchCost", "plan_cost", "task_cost",
+           "BufferClass", "DonationError", "DonationPlan",
+           "donation_plan", "verify_donation",
            "Finding", "lint_tree", "lint_source", "load_baseline"]

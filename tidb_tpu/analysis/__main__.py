@@ -9,7 +9,10 @@ Runs three static passes and exits non-zero on any NEW finding:
    statement is planned (never executed — no trace, no compile, no
    device) and its static device footprint rolled up; COST-PAD-WASTE /
    COST-CAP-BLOWUP / COST-DENSE-BLOWUP / COST-UNBOUNDED findings
-   baseline exactly like lint findings.
+   baseline exactly like lint findings.  The buffer-lifetime pass
+   (analysis/lifetime) rides the same corpus walk: DONATE-UNSAFE (a
+   derived plan would donate a PERSISTENT/LOOP-CARRIED slot) and
+   DONATE-MISSED (a large EPHEMERAL slot left undonated) findings.
 3. Plan-contract verification over the same corpus plans
    (analysis.verify_plan); any PlanContractError fails the gate.
 4. RU pricing over the same corpus (rc/pricing over the cost model's
@@ -28,6 +31,8 @@ Flags:
                                      (waiver-rot hygiene)
     --cost-report                    print the per-corpus-query cost
                                      table (bytes/flops/padding) and exit
+    --donation-report                print the per-corpus-query buffer
+                                     lifetime / donation table and exit
 """
 
 from __future__ import annotations
@@ -69,8 +74,10 @@ def _gather_findings(lint_only: bool, contracts_only: bool):
         findings += lint_tree()
     if not lint_only:
         from .copcost import cost_findings
+        from .lifetime import donation_findings
         plans = _corpus_plans()
         findings += cost_findings(plans, n_devices=GATE_DEVICES)
+        findings += donation_findings(plans, n_devices=GATE_DEVICES)
     return findings, plans
 
 
@@ -94,7 +101,8 @@ def _stale_keys(findings, baseline, lint_only: bool,
     current = {f.key() for f in findings}
     stale = set()
     for k in baseline - current:
-        is_cost = k.startswith("COST-")
+        # corpus-walk rule families (computed only on full/cost runs)
+        is_cost = k.startswith(("COST-", "DONATE-"))
         if lint_only and is_cost:
             continue
         if contracts_only and not is_cost:
@@ -175,6 +183,10 @@ def main(argv=None) -> int:
     if "--cost-report" in argv:
         from .copcost import cost_report
         print(cost_report(_corpus_plans(), n_devices=GATE_DEVICES))
+        return 0
+    if "--donation-report" in argv:
+        from .lifetime import donation_report
+        print(donation_report(_corpus_plans(), n_devices=GATE_DEVICES))
         return 0
     if check_baseline:
         # hygiene pass: waivers must not rot silently — every baseline

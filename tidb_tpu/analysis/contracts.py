@@ -486,6 +486,12 @@ def verify_task(task) -> None:
             _fail("capacity-shape", p,
                   f"{s} shards do not divide over {n_dev} devices on the "
                   "shard axis")
+    if getattr(task, "donate", False):
+        # donation-safety handshake (analysis/lifetime): a donating
+        # task must be in an EPHEMERAL program class and its inputs
+        # must not be live snapshot-cache residents
+        from .lifetime import verify_task_donation
+        verify_task_donation(task)
 
 
 # --------------------------------------------------------------------- #

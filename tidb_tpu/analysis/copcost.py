@@ -128,7 +128,10 @@ class LaunchCost:
 
     ``peak_hbm_bytes`` = resident inputs + replicated aux + intermediate
     high-water (a no-fusion upper bound: every operator output counted)
-    + output leaves.  ``transfer_bytes`` = H2D inputs/aux + D2H outputs.
+    + output leaves, minus ``donated_bytes`` when a DonationPlan
+    (analysis/lifetime) lets the launch alias its ephemeral inputs into
+    outputs — in+out+temp drops toward max(in, out)+temp.
+    ``transfer_bytes`` = H2D inputs/aux + D2H outputs.
     ``padding_waste`` = padded/live row ratio of the scan inputs."""
     input_bytes: int = 0
     aux_bytes: int = 0
@@ -146,11 +149,15 @@ class LaunchCost:
     unbounded: tuple = ()
     # ((label, bytes), ...) largest-first, for reports/EXPLAIN
     breakdown: tuple = ()
+    # bytes a DonationPlan lets this launch alias input->output
+    # (min(donated inputs, outputs): the donated buffer backs the
+    # output instead of coexisting with it)
+    donated_bytes: int = 0
 
     @property
     def peak_hbm_bytes(self) -> int:
         return (self.input_bytes + self.aux_bytes + self.inter_bytes
-                + self.output_bytes)
+                + self.output_bytes - self.donated_bytes)
 
     @property
     def transfer_bytes(self) -> int:
@@ -173,7 +180,8 @@ class LaunchCost:
             self.expanding_joins + other.expanding_joins,
             self.dense_blowups + other.dense_blowups,
             self.unbounded + other.unbounded,
-            self.breakdown + other.breakdown)
+            self.breakdown + other.breakdown,
+            self.donated_bytes + other.donated_bytes)
 
 
 def format_bytes(n: int) -> str:
@@ -458,13 +466,16 @@ def _rows_kind_capacity(dag: D.CopNode, layout: Layout,
 
 def dag_cost(dag: D.CopNode, layout: Layout,
              widths: Optional[tuple] = None, *, input_bytes: int = 0,
-             aux_bytes: int = 0, row_capacity: int = 0) -> LaunchCost:
+             aux_bytes: int = 0, row_capacity: int = 0,
+             donation=None) -> LaunchCost:
     """LaunchCost of one program over one stacked scan input.
 
     ``input_bytes`` is the resident upload (exact at admission, modeled
     via snapshot_input_bytes at plan time); ``aux_bytes`` the host-
     materialized replicated inputs PER DEVICE COPY (totals multiply by
-    the mesh size here)."""
+    the mesh size here).  ``donation`` is an optional
+    ``analysis.lifetime.DonationPlan``: donated input bytes alias into
+    the output allocation, so the peak drops by min(donated, output)."""
     d = max(layout.n_devices, 1)
     (inter_pd, flops_pd, joins, dense_blowups, unbounded, breakdown,
      rows_out, w_out) = _dag_walk_cached(dag, layout, widths)
@@ -478,9 +489,19 @@ def dag_cost(dag: D.CopNode, layout: Layout,
     else:
         cap = _rows_kind_capacity(root, layout, row_capacity)
         out_bytes = d * (cap * (w_out + _VALIDITY_BYTES) + 8)
+    aux_total = int(aux_bytes) * d
+    donated = 0
+    if donation is not None and donation.donate_argnums:
+        from .lifetime import ARG_AUX, ARG_COLS
+        donatable = 0
+        if ARG_COLS in donation.donate_argnums:
+            donatable += int(input_bytes)         # cols + counts upload
+        if ARG_AUX in donation.donate_argnums:
+            donatable += aux_total
+        donated = min(donatable, int(out_bytes))
     return LaunchCost(
         input_bytes=int(input_bytes),
-        aux_bytes=int(aux_bytes) * d,
+        aux_bytes=aux_total,
         inter_bytes=inter_pd * d,
         output_bytes=int(out_bytes),
         flops=flops_pd * d,
@@ -490,7 +511,8 @@ def dag_cost(dag: D.CopNode, layout: Layout,
         expanding_joins=joins,
         dense_blowups=dense_blowups,
         unbounded=unbounded,
-        breakdown=tuple(sorted(breakdown, key=lambda kv: -kv[1])[:8]))
+        breakdown=tuple(sorted(breakdown, key=lambda kv: -kv[1])[:8]),
+        donated_bytes=donated)
 
 
 # ------------------------------------------------------------------ #
@@ -526,9 +548,15 @@ def task_cost(task) -> Optional[LaunchCost]:
     # live rows are a device-resident count; the padded extent is the
     # honest static bound (waste reads 1.0x at admission by design)
     layout = Layout(s or 1, c or 1, n_dev, (s or 1) * (c or 1))
+    donation = None
+    if getattr(task, "donate", False):
+        # donating task: the lifetime plan's aliasing tightens the
+        # admission bound (verify_task_donation already vetted safety)
+        from .lifetime import donation_plan
+        donation = donation_plan(task.dag, "solo")
     return dag_cost(task.dag, layout, tuple(widths),
                     input_bytes=input_bytes, aux_bytes=aux_bytes,
-                    row_capacity=task.row_capacity)
+                    row_capacity=task.row_capacity, donation=donation)
 
 
 def mesh_hbm_budget(mesh) -> int:
@@ -579,7 +607,7 @@ def _op_snapshot(op):
     return tbl.snapshot()
 
 
-def _cop_exec_cost(op, n_devices: int) -> LaunchCost:
+def _cop_exec_cost(op, n_devices: int, donation=None) -> LaunchCost:
     snap = _op_snapshot(op)
     layout = snapshot_layout(snap, n_devices)
     widths = snapshot_scan_widths(snap)
@@ -604,7 +632,7 @@ def _cop_exec_cost(op, n_devices: int) -> LaunchCost:
             bw = _schema_width(j.build_dtypes) if j is not None else 8
             aux += rows * (16 + bw)       # sorted keys + perm + columns
     return dag_cost(dag, layout, widths, input_bytes=input_bytes,
-                    aux_bytes=aux)
+                    aux_bytes=aux, donation=donation)
 
 
 def _exchange_cost(rows_side: int, width: int, layout: Layout) -> int:

@@ -49,6 +49,15 @@ Rules
                    place gets silently truncated int32/float32 lanes on
                    TPU — wrong join keys and sums, green CPU tests.
                    Pin dtype= explicitly.
+- TPU-DONATE       a ``donate_argnums=``/``donate_argnames=`` keyword in
+                   a traced module whose value is a non-empty literal,
+                   or an expression that does not reference a
+                   DonationPlan-derived symbol (a name/attribute
+                   containing ``donat``): donation deletes the caller's
+                   arrays, so the ONLY legitimate source of argnums is
+                   the statically verified analysis/lifetime
+                   DonationPlan — a hand-written literal silently
+                   deletes snapshot residents or regrow inputs.
 
 Inline waiver: any rule is suppressed by a `# planlint: ok` comment on
 the offending line (give a reason after it).
@@ -301,6 +310,8 @@ class _ExprRules(_Scoped):
                          "raises OverflowError before launch")
             # TPU-DTYPE-X64: dtype decided by the x64 flag, not the code
             self._check_x64(node, name)
+            # TPU-DONATE: donation argnums must come from a DonationPlan
+            self._check_donate(node)
         # TPU-HOST-SYNC
         if self.hot:
             if name == "device_get" and isinstance(node.func,
@@ -346,6 +357,39 @@ class _ExprRules(_Scoped):
                  "tidb_tpu enables jax_enable_x64 — pin dtype= so an "
                  "embedder's x64-off default cannot silently narrow "
                  "device lanes to 32 bits")
+
+    def _check_donate(self, node: ast.Call) -> None:
+        """donate_argnums/donate_argnames in a traced module: jax bakes
+        the aliasing into the executable and DELETES the caller's
+        arrays, so the value must be derived from the statically
+        verified DonationPlan (analysis/lifetime) — a literal (or any
+        expression not referencing a donation-plan symbol) is a
+        hand-rolled lifetime claim the gate refuses."""
+        for kw in node.keywords:
+            if kw.arg not in ("donate_argnums", "donate_argnames"):
+                continue
+            v = kw.value
+            if isinstance(v, (ast.Tuple, ast.List)) and not v.elts:
+                continue          # donating nothing is always safe
+            literal = isinstance(v, ast.Constant) or (
+                isinstance(v, (ast.Tuple, ast.List))
+                and all(isinstance(e, ast.Constant) for e in v.elts))
+            if literal:
+                self.add("TPU-DONATE", node,
+                         f"literal {kw.arg}= in a traced module: "
+                         "donation argnums must come from a verified "
+                         "analysis/lifetime DonationPlan, not a "
+                         "hand-written position list")
+                continue
+            names = {n.id for n in ast.walk(v) if isinstance(n, ast.Name)}
+            names |= {a.attr for a in ast.walk(v)
+                      if isinstance(a, ast.Attribute)}
+            if not any("donat" in s for s in names):
+                self.add("TPU-DONATE", node,
+                         f"{kw.arg}= value does not reference a "
+                         "DonationPlan-derived symbol; route donation "
+                         "through analysis/lifetime so the slot "
+                         "lifetimes are verified pre-trace")
 
     def visit_ExceptHandler(self, node):
         broad = node.type is None

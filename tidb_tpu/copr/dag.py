@@ -331,6 +331,17 @@ def output_dtypes(node: CopNode) -> Tuple[dt.DataType, ...]:
     raise TypeError(node)
 
 
+def iter_nodes(node: CopNode):
+    """Every node of a pushed DAG, root first (pre-order).  The static
+    passes (analysis/contracts, copcost, lifetime) walk DAGs constantly;
+    one shared iterator keeps their traversal order identical."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        stack.extend(n.children())
+
+
 def find_expand_join(node: CopNode):
     """The (at most one) non-unique LookupJoin in a pushed DAG, or None —
     programs containing one report true join output size via extras."""
@@ -433,6 +444,6 @@ __all__ = [
     "Expand", "GroupStrategy", "HOST_MERGE_STRATEGIES", "Aggregation",
     "TopN", "Limit", "LookupJoin",
     "FusedDag", "ShuffleJoinSpec", "output_dtypes", "dag_digest",
-    "find_expand_join", "rewrite_lookup", "drop_lookup", "chain_str",
-    "rewrite_expand_capacity",
+    "iter_nodes", "find_expand_join", "rewrite_lookup", "drop_lookup",
+    "chain_str", "rewrite_expand_capacity",
 ]

@@ -283,13 +283,61 @@ class Evaluator:
         return {"add": lambda: va + vb, "subtract": lambda: va - vb,
                 "multiply": lambda: va * vb}[op]()
 
+    _I64_MIN = -2 ** 63
+
+    def _guard_dec_overflow(self, op: str, va, vb, r, m) -> None:
+        """int64 scalar-op overflow guard for DECIMAL arithmetic (the
+        gap expr/builders._arith_result_type documents): a scaled-int64
+        result that wrapped past 2^63 reads back as a wrong decimal with
+        no error.  Host (numpy) evaluation detects the wrap on VALID
+        lanes and raises — MySQL's "value is out of range" discipline —
+        instead of returning wrapped digits.  Device (jnp) lanes cannot
+        raise data-dependently inside a traced program and stay
+        unguarded (the builders comment narrows to exactly that).
+
+        The multiply check divides the wrapped product back: exact for
+        two's-complement wrap (q != a whenever a*b left int64, plus the
+        (INT64_MIN, -1) floor-division special case)."""
+        if self.xp is not np or not isinstance(r, np.ndarray) \
+                or r.dtype.kind != "i":
+            return            # device lanes / object-int (exact) / scalar
+        a, b = np.asarray(va), np.asarray(vb)
+        if a.dtype.kind not in "iu" or b.dtype.kind not in "iu":
+            return
+        a = a.astype(np.int64, copy=False)
+        b = b.astype(np.int64, copy=False)
+        if op == "add":
+            bad = ((b > 0) & (r < a)) | ((b < 0) & (r > a))
+        elif op == "subtract":
+            bad = ((b < 0) & (r < a)) | ((b > 0) & (r > a))
+        else:
+            nz = b != 0
+            with np.errstate(over="ignore"):
+                q = np.floor_divide(r, np.where(nz, b, 1))
+            bad = (nz & (q != a)) \
+                | ((a == self._I64_MIN) & (b == -1))
+        if m is not True:
+            bad = bad & m
+        if np.any(bad):
+            raise OverflowError(
+                "DECIMAL value is out of range: scaled int64 "
+                f"{'+' if op == 'add' else '-' if op == 'subtract' else '*'}"
+                " overflowed 18 digits (narrow the operands or cast to "
+                "DOUBLE)")
+
     def op_add(self, e, cols, memo):
         va, ma, vb, mb, t = self._to_common(e, cols, memo)
-        return self._arith("add", va, vb, t), vand(ma, mb)
+        r, m = self._arith("add", va, vb, t), vand(ma, mb)
+        if t.kind == K.DECIMAL:
+            self._guard_dec_overflow("add", va, vb, r, m)
+        return r, m
 
     def op_sub(self, e, cols, memo):
         va, ma, vb, mb, t = self._to_common(e, cols, memo)
-        return self._arith("subtract", va, vb, t), vand(ma, mb)
+        r, m = self._arith("subtract", va, vb, t), vand(ma, mb)
+        if t.kind == K.DECIMAL:
+            self._guard_dec_overflow("subtract", va, vb, r, m)
+        return r, m
 
     def op_mul(self, e, cols, memo):
         a, b = e.args
@@ -297,7 +345,9 @@ class Evaluator:
             # scales add: no rescale needed before the integer multiply
             va, ma = self._num(a, cols, memo)
             vb, mb = self._num(b, cols, memo)
-            return self._arith("multiply", va, vb, e.dtype), vand(ma, mb)
+            r, m = self._arith("multiply", va, vb, e.dtype), vand(ma, mb)
+            self._guard_dec_overflow("multiply", va, vb, r, m)
+            return r, m
         va, ma, vb, mb, t = self._to_common(e, cols, memo)
         return self._arith("multiply", va, vb, t), vand(ma, mb)
 

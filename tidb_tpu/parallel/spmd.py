@@ -24,12 +24,29 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ..analysis.lifetime import donation_plan, verify_donation
 from ..copr import dag as D
 from ..copr.aggregate import _MERGE
 from ..copr.exec import (DeviceBatch, _agg_partial_states, _exec_node,
                          agg_states, compact)
 from ..expr.compile import Evaluator
 from .mesh import SHARD_AXIS, shard_map
+
+
+def _donation_argnums(dag, program: str, donate: bool,
+                      override) -> tuple:
+    """The builder-side donation seam: ``donate_argnums`` comes ONLY
+    from the DAG's DonationPlan (analysis/lifetime) — literals in
+    traced modules fail the TPU-DONATE lint rule — and any explicit
+    override is re-verified pre-trace, so a seeded unsafe plan raises
+    DonationError before jax.jit could bake the aliasing in."""
+    if override is not None:
+        argnums = tuple(override)
+        verify_donation(dag, argnums, program)
+        return argnums
+    if not donate:
+        return ()
+    return donation_plan(dag, program).donate_argnums
 
 
 def _psum_gather(arr, axis: str, n_dev: int):
@@ -81,10 +98,18 @@ class ShardedCopProgram:
                    axis (host concatenates; TopN re-merged at root)
     """
 
-    def __init__(self, dag_root: D.CopNode, mesh, row_capacity: int = 0):
+    def __init__(self, dag_root: D.CopNode, mesh, row_capacity: int = 0,
+                 donate: bool = False, donate_argnums=None):
         self.root = dag_root
         self.mesh = mesh
         self.row_capacity = row_capacity
+        # buffer donation (analysis/lifetime): the donating variant is
+        # requested only for launch-unique inputs (streamed HBM batches);
+        # the plan forbids donation outright for loop-carried regrow
+        # state, and overrides are verified pre-trace
+        self.donation = donation_plan(dag_root, "solo")
+        self._donate_argnums = _donation_argnums(
+            dag_root, "solo", donate, donate_argnums)
         self.agg = dag_root if isinstance(dag_root, D.Aggregation) else None
         self.kind = "agg" if self.agg is not None else "rows"
         # MIN/MAX merge IN-PROGRAM via _psum_gather (psum-only all_gather +
@@ -121,7 +146,7 @@ class ShardedCopProgram:
 
         self._fn = jax.jit(shard_map(
             self._device_fn, mesh=mesh, in_specs=in_specs,
-            out_specs=out_specs))
+            out_specs=out_specs), donate_argnums=self._donate_argnums)
 
     def _device_fn(self, cols, counts, aux):
         from ..copr.exec import set_trace_platform
@@ -164,13 +189,15 @@ class ShardedCopProgram:
 
 
 @functools.lru_cache(maxsize=256)
-def _cached(dag_root, mesh, row_capacity):
-    return ShardedCopProgram(dag_root, mesh, row_capacity)
+def _cached(dag_root, mesh, row_capacity, donate):
+    return ShardedCopProgram(dag_root, mesh, row_capacity, donate)
 
 
-def get_sharded_program(dag_root: D.CopNode, mesh,
-                        row_capacity: int = 0) -> ShardedCopProgram:
-    return _cached(dag_root, mesh, row_capacity)
+def get_sharded_program(dag_root: D.CopNode, mesh, row_capacity: int = 0,
+                        donate: bool = False) -> ShardedCopProgram:
+    # the donating variant caches apart: donation is baked into the
+    # jitted executable's input aliasing
+    return _cached(dag_root, mesh, row_capacity, True if donate else False)
 
 
 class FusedCopProgram:
@@ -195,11 +222,20 @@ class FusedCopProgram:
     signature carries num_buckets, so incompatible bucket spaces never
     reach this constructor."""
 
-    def __init__(self, fused: D.FusedDag, mesh):
+    def __init__(self, fused: D.FusedDag, mesh, donate: bool = False,
+                 donate_argnums=None):
         if len(fused.members) < 2:
             raise ValueError("fusion needs at least two member chains")
         self.fused = fused
         self.mesh = mesh
+        # donation over the FUSED dag: the plan re-derives from every
+        # member (one loop-carried member forbids the group) and the
+        # shared-aux rule (a slot two members read must survive the
+        # unfused fallback) — see analysis/lifetime.aux_lifetime;
+        # verified before any member program builds
+        self.donation = donation_plan(fused, "fused")
+        self._donate_argnums = _donation_argnums(
+            fused, "fused", donate, donate_argnums)
         self.members = tuple(get_sharded_program(m, mesh)
                              for m in fused.members)
         for p in self.members:
@@ -219,7 +255,7 @@ class FusedCopProgram:
                           for p in self.members)
         self._fn = jax.jit(shard_map(
             self._device_fn, mesh=mesh, in_specs=in_specs,
-            out_specs=out_specs))
+            out_specs=out_specs), donate_argnums=self._donate_argnums)
 
     def _device_fn(self, cols, counts, aux):
         # each member re-traces its chain over the SAME input refs; XLA
@@ -238,12 +274,13 @@ class FusedCopProgram:
 
 
 @functools.lru_cache(maxsize=64)
-def _cached_fused(fused, mesh):
-    return FusedCopProgram(fused, mesh)
+def _cached_fused(fused, mesh, donate):
+    return FusedCopProgram(fused, mesh, donate)
 
 
-def get_fused_program(fused: D.FusedDag, mesh) -> FusedCopProgram:
-    return _cached_fused(fused, mesh)
+def get_fused_program(fused: D.FusedDag, mesh,
+                      donate: bool = False) -> FusedCopProgram:
+    return _cached_fused(fused, mesh, True if donate else False)
 
 
 class FusedRowsProgram:
@@ -256,13 +293,21 @@ class FusedRowsProgram:
     join re-runs programs per task); XLA CSEs the shared scan loads and
     masks across members exactly as in the agg fusion."""
 
-    def __init__(self, fused: D.FusedDag, mesh, row_capacities: tuple):
+    def __init__(self, fused: D.FusedDag, mesh, row_capacities: tuple,
+                 donate_argnums=None):
         if len(fused.members) < 2:
             raise ValueError("fusion needs at least two member chains")
         if len(row_capacities) != len(fused.members):
             raise ValueError("one row capacity per member chain")
         self.fused = fused
         self.mesh = mesh
+        # rows members keep per-member paging loops: the plan is
+        # loop-carried across the board, so the derived argnums are
+        # always empty — the parameter exists so a seeded override is
+        # still verified (and rejected) before ANY member program builds
+        self.donation = donation_plan(fused, "fused-rows")
+        self._donate_argnums = _donation_argnums(
+            fused, "fused-rows", False, donate_argnums)
         self.members = tuple(
             get_sharded_program(m, mesh, cap)
             for m, cap in zip(fused.members, row_capacities))
@@ -277,7 +322,7 @@ class FusedRowsProgram:
                           for _ in self.members)
         self._fn = jax.jit(shard_map(
             self._device_fn, mesh=mesh, in_specs=in_specs,
-            out_specs=out_specs))
+            out_specs=out_specs), donate_argnums=self._donate_argnums)
 
     def _device_fn(self, cols, counts, aux):
         return tuple(p._device_fn(cols, counts, aux)
@@ -325,17 +370,26 @@ class BatchedCopProgram:
     'agg', no host merge, no extras) — vmapping a psum batches the
     collective, it does not mix slots."""
 
-    def __init__(self, dag_root: D.CopNode, mesh, n_slots: int):
+    def __init__(self, dag_root: D.CopNode, mesh, n_slots: int,
+                 donate: bool = True):
         self.base = get_sharded_program(dag_root, mesh)
         if self.base.kind != "agg" or self.base.host_merge \
                 or self.base.has_extras:
             raise ValueError("only fully in-program agg plans batch")
         self.n_slots = n_slots
+        # the stacked (S, K, C) inputs are FRESH copies _stack_slots
+        # builds per launch (jnp.stack of the member arrays), so the
+        # lifetime plan donates them unconditionally: K tasks' worth of
+        # stacked input stops coexisting with the outputs
+        self.donation = donation_plan(dag_root, "batched")
+        self._donate_argnums = _donation_argnums(
+            dag_root, "batched", donate, None)
         in_specs = (P(SHARD_AXIS), P(SHARD_AXIS), P())
         fn = jax.vmap(self.base._device_fn, in_axes=(1, 1, None),
                       out_axes=0)
         self._fn = jax.jit(shard_map(fn, mesh=mesh, in_specs=in_specs,
-                                     out_specs=P()))
+                                     out_specs=P()),
+                           donate_argnums=self._donate_argnums)
 
     def __call__(self, cols_list: Sequence, counts_list: Sequence) -> list:
         k = len(cols_list)
@@ -353,7 +407,7 @@ class BatchedCopProgram:
 
 @functools.lru_cache(maxsize=32)
 def _cached_batched(dag_root, mesh, n_slots):
-    return BatchedCopProgram(dag_root, mesh, n_slots)
+    return BatchedCopProgram(dag_root, mesh, n_slots)  # donates stacks
 
 
 def get_batched_program(dag_root: D.CopNode, mesh,
@@ -377,18 +431,25 @@ class BatchedRowsProgram:
     loop re-runs programs per task)."""
 
     def __init__(self, dag_root: D.CopNode, mesh, row_capacity: int,
-                 n_slots: int):
+                 n_slots: int, donate: bool = True):
         self.base = get_sharded_program(dag_root, mesh, row_capacity)
         if self.base.kind != "rows" or self.base.has_extras:
             raise ValueError("only extras-free row plans batch")
         self.n_slots = n_slots
+        # per-launch stacked copies: ephemeral by construction, exactly
+        # as in BatchedCopProgram — each waiter's paging loop resubmits
+        # with a NEW stack, never re-reading a donated one
+        self.donation = donation_plan(dag_root, "batched-rows")
+        self._donate_argnums = _donation_argnums(
+            dag_root, "batched-rows", donate, None)
         in_specs = (P(SHARD_AXIS), P(SHARD_AXIS), P())
         # slot axis at position 1: per-device leading axis stays axis 0
         fn = jax.vmap(self.base._device_fn, in_axes=(1, 1, None),
                       out_axes=1)
         self._fn = jax.jit(shard_map(
             fn, mesh=mesh, in_specs=in_specs,
-            out_specs=(P(SHARD_AXIS), P(SHARD_AXIS))))
+            out_specs=(P(SHARD_AXIS), P(SHARD_AXIS))),
+            donate_argnums=self._donate_argnums)
 
     def __call__(self, cols_list: Sequence, counts_list: Sequence) -> list:
         k = len(cols_list)

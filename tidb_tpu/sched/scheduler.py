@@ -168,6 +168,10 @@ class DeviceScheduler:
         self.budget_rejects = 0           # solo programs over budget (CostError)
         self.budget_deferrals = 0         # riders left queued by footprint cap
         self.last_launch_bytes = 0        # footprint of the last served batch
+        # buffer-donation accounting (analysis/lifetime DonationPlan)
+        self.donated_launches = 0         # launches with donated inputs
+        self.donated_tasks = 0            # tasks that requested donation
+        self.donated_bytes = 0            # priced input bytes aliased out
         # rc enforcement accounting (rc/controller)
         self.rc_throttled = 0             # drain passes that skipped a group
         self.rc_exhausted = 0             # waiters failed at the deadline
@@ -207,6 +211,9 @@ class DeviceScheduler:
         self._m_bdefer = reg.counter(
             "tidb_tpu_sched_budget_deferrals_total",
             "riders deferred from a launch by the summed-footprint cap")
+        self._m_donated = reg.counter(
+            "tidb_tpu_sched_donated_bytes_total",
+            "input bytes aliased into outputs by buffer donation")
         # resource control plane (rc/): admission-side RU enforcement
         self._m_rc_throttle = reg.counter(
             "tidb_tpu_rc_throttled_total",
@@ -731,7 +738,8 @@ class DeviceScheduler:
             verify_fusion_group([t for grp in programs for t in grp])
             fused = D.FusedDag(tuple(t.dag for t in members))
             if isinstance(lead.dag, D.Aggregation):
-                fprog = get_fused_program(fused, lead.mesh)
+                fprog = get_fused_program(fused, lead.mesh,
+                                          donate=lead.donate)
             else:
                 fprog = get_fused_rows_program(
                     fused, lead.mesh,
@@ -749,6 +757,8 @@ class DeviceScheduler:
                 t.fused = len(programs)
                 t.coalesced = total
         self.launches += 1
+        if fprog._donate_argnums:
+            self.donated_launches += 1
         self.fused_launches += 1
         self.fused_tasks += total
         self._m_launch.inc(mode="fused")
@@ -763,7 +773,8 @@ class DeviceScheduler:
         from ..parallel.spmd import (get_batched_program,
                                      get_batched_rows_program,
                                      get_sharded_program)
-        prog = get_sharded_program(lead.dag, lead.mesh, lead.row_capacity)
+        prog = get_sharded_program(lead.dag, lead.mesh, lead.row_capacity,
+                                   donate=lead.donate)
         # group riders by input identity: same-token tasks share ONE
         # program execution (in-flight dedup)
         slots: list[list] = []
@@ -791,6 +802,11 @@ class DeviceScheduler:
                     for t in s:
                         t.finish((prog, out))
                 self.launches += 1
+                if bprog._donate_argnums:
+                    # the per-launch stacked copies were donated (the
+                    # lifetime plan's batched class), whatever the
+                    # member arrays' own lifetime
+                    self.donated_launches += 1
                 self.batched_launches += 1
                 if prog.kind == "rows":
                     self.batched_rows_launches += 1
@@ -804,6 +820,8 @@ class DeviceScheduler:
             for t in s:
                 t.finish((prog, out))
             self.launches += 1
+            if prog._donate_argnums:
+                self.donated_launches += 1
             self._m_launch.inc(
                 mode="coalesced" if len(s) > 1 else "single")
 
@@ -837,6 +855,13 @@ class DeviceScheduler:
         with self._mu:
             for t in batch:
                 self.tasks_done += 1
+                if t.donate:
+                    self.donated_tasks += 1
+                    saved = t.cost.donated_bytes if t.cost is not None \
+                        else 0
+                    self.donated_bytes += saved
+                    if saved:
+                        self._m_donated.inc(saved)
                 g = self._groups.get(t.group)
                 if g is not None:
                     g.wait_ns += t.wait_ns
@@ -891,6 +916,9 @@ class DeviceScheduler:
                 "budget_rejects": self.budget_rejects,
                 "budget_deferrals": self.budget_deferrals,
                 "last_launch_bytes": self.last_launch_bytes,
+                "donated_launches": self.donated_launches,
+                "donated_tasks": self.donated_tasks,
+                "donated_bytes": self.donated_bytes,
                 "rc_enable": self.rc_enable,
                 "rc_overdraft_ru": self.rc_overdraft_ru,
                 "rc_throttled": self.rc_throttled,

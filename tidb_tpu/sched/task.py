@@ -101,14 +101,14 @@ class CopTask:
                  "submit_ns", "start_ns", "wait_ns", "coalesced", "fused",
                  "fusion_key", "cancelled", "_done", "_value", "_exc",
                  "est_rows", "cost", "rc_group", "rus", "rus_charged",
-                 "device_ns", "deadline_ns")
+                 "device_ns", "deadline_ns", "donate")
 
     def __init__(self, *, key=None, dag=None, mesh=None, row_capacity=0,
                  cols=None, counts=None, aux=(), input_token=None,
                  fusion_key=None, fn: Optional[Callable[[], Any]] = None,
                  group: Optional[str] = None,
                  weight: Optional[float] = None, est_rows: int = 0,
-                 rc_group=None):
+                 rc_group=None, donate: bool = False):
         if group is None:
             group, gw, rcg = current_group()
             if weight is None:
@@ -139,6 +139,7 @@ class CopTask:
         self.rus_charged = 0.0    # RUs actually debited at the drain
         self.device_ns = 0        # attributed share of launch wall time
         self.deadline_ns = 0      # rc max-queue deadline (0 = none)
+        self.donate = bool(donate)  # launch-unique inputs: donate them
         self.cancelled = False
         self._done = threading.Event()
         self._value = None
@@ -148,11 +149,14 @@ class CopTask:
 
     @classmethod
     def structured(cls, dag, mesh, row_capacity, cols, counts, aux,
-                   est_rows: int = 0) -> "CopTask":
+                   est_rows: int = 0, donate: bool = False) -> "CopTask":
         from ..copr.dag import dag_digest
         fp = mesh_fingerprint(mesh)
         sig = _shape_sig(cols, counts)
-        key = (dag_digest(dag), fp, int(row_capacity), sig)
+        # donation is baked into the compiled executable's input
+        # aliasing, so the donating variant keys (and fuses) apart —
+        # a donating and a non-donating task must never dedup together
+        key = (dag_digest(dag), fp, int(row_capacity), sig, bool(donate))
         # input identity for in-flight dedup: the snapshot's resident
         # device cache returns the SAME array objects per epoch, so two
         # sessions over one snapshot share ids; the task pins the refs.
@@ -169,10 +173,11 @@ class CopTask:
             from ..analysis.contracts import fusion_signature
             fsig = fusion_signature(dag)
             if fsig is not None:
-                fusion_key = (token, fp, sig, fsig)
+                fusion_key = (token, fp, sig, fsig, bool(donate))
         return cls(key=key, dag=dag, mesh=mesh, row_capacity=row_capacity,
                    cols=cols, counts=counts, aux=aux, input_token=token,
-                   fusion_key=fusion_key, est_rows=est_rows)
+                   fusion_key=fusion_key, est_rows=est_rows,
+                   donate=donate)
 
     @classmethod
     def opaque(cls, fn: Callable[[], Any], est_rows: int = 0) -> "CopTask":

@@ -1210,10 +1210,19 @@ class Session:
             cost = plan_cost(phys, n_dev)
             if not cost.transfer_bytes:
                 return None
-            return (f"est. device bytes: "
-                    f"{format_bytes(cost.peak_hbm_bytes)} peak / "
-                    f"{format_bytes(cost.transfer_bytes)} transfer, "
-                    f"padding {cost.padding_waste:.1f}x")
+            footer = (f"est. device bytes: "
+                      f"{format_bytes(cost.peak_hbm_bytes)} peak / "
+                      f"{format_bytes(cost.transfer_bytes)} transfer, "
+                      f"padding {cost.padding_waste:.1f}x")
+            # buffer-lifetime verdict (analysis/lifetime): how many
+            # input buffers / bytes a donation-eligible launch aliases
+            # into outputs on the streamed (launch-unique) path
+            from ..analysis.lifetime import plan_donation
+            bufs, saved = plan_donation(phys, n_dev)
+            if bufs:
+                footer += (f", donate: {bufs} bufs / "
+                           f"{format_bytes(saved)}")
+            return footer
         except (AttributeError, TypeError, KeyError, ValueError,
                 ImportError):
             return None

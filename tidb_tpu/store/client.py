@@ -241,15 +241,20 @@ class CopClient:
             h.note_sched(task.wait_ns, task.coalesced, task.fused,
                          rus=task.rus_charged)
 
-    def _launch(self, dag, cols, counts, aux, row_capacity: int = 0):
+    def _launch(self, dag, cols, counts, aux, row_capacity: int = 0,
+                donate: bool = False):
         """One device launch of a sharded cop program, routed through the
         admission queue: the scheduler resolves the compiled program (so
         concurrent identical tasks share ONE compile + launch) and may
         coalesce this task with compatible ones from other sessions.
-        Returns (program, out)."""
+        ``donate=True`` marks the inputs launch-unique (streamed HBM
+        batches): the DonationPlan-derived program variant aliases them
+        into outputs (analysis/lifetime) — never set it for snapshot
+        residents or regrow-loop inputs.  Returns (program, out)."""
         sched = self._scheduler()
         if sched is None:
-            prog = get_sharded_program(dag, self.mesh, row_capacity)
+            prog = get_sharded_program(dag, self.mesh, row_capacity,
+                                       donate=donate)
             return prog, prog(cols, counts, aux)
         from ..sched import CopTask
         est = 0
@@ -258,7 +263,7 @@ class CopClient:
             est = s * c
         t = sched.submit(CopTask.structured(
             dag, self.mesh, row_capacity, cols, counts, tuple(aux),
-            est_rows=est))
+            est_rows=est, donate=donate))
         try:
             return t.wait()
         finally:
@@ -402,7 +407,11 @@ class CopClient:
         for i in range(len(batches)):
             check_killed()   # cancellation between streamed HBM batches
             cols, counts = nxt
-            _prog, out = self._launch(agg, cols, counts, ())
+            # uncached batch, launched exactly once: EPHEMERAL in the
+            # lifetime taxonomy — the donating program variant lets XLA
+            # alias the batch into its outputs, so the steady-state
+            # paging loop stops holding input + output + temp at once
+            _prog, out = self._launch(agg, cols, counts, (), donate=True)
             outs.append(out)
             if i + 1 < len(batches):
                 nxt = batches[i + 1].device_put_uncached(self.mesh)

@@ -167,6 +167,11 @@ class ColumnarSnapshot:
         put = self._put(mesh)
         self._device_cache.clear()     # one epoch resident at a time
         self._device_cache[key] = put
+        # lifetime contract (analysis/lifetime): these arrays are
+        # PERSISTENT — reused across queries and pages — so a donating
+        # launch over them is rejected at sched admission pre-trace
+        from ..analysis.lifetime import register_resident
+        register_resident(put[1])
         return self._device_cache[key]
 
     def device_put_uncached(self, mesh) -> tuple[list, Any]:
