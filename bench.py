@@ -104,11 +104,15 @@ def _attempts_summary():
     except OSError:
         return {"attempts": 0}
     grants = [a for a in lines if a.get("outcome") == "granted"]
+    # the retry daemon's last recorded per-digest breaker view
+    # (faultline): which programs the most recent probe found tripped
+    breakers = [a["breaker"] for a in lines if "breaker" in a]
     return {"attempts": len([a for a in lines
                              if a.get("outcome") in ("no-grant", "granted")]),
             "grants": len(grants),
             "first_ts": lines[0].get("ts") if lines else None,
-            "last_ts": lines[-1].get("ts") if lines else None}
+            "last_ts": lines[-1].get("ts") if lines else None,
+            "last_breaker": breakers[-1] if breakers else None}
 
 
 def orchestrate():
@@ -288,6 +292,14 @@ def mode_probe():
     y = (x @ x).block_until_ready()
     log(f"probe: matmul ok ({float(y[0, 0])})")
     print(f"platform={d[0].platform} n={len(d)}")
+    # per-digest circuit-breaker view (faultline): the retry daemon
+    # records this with the attempt so TPU_ATTEMPTS.jsonl shows which
+    # programs the last probe found quarantined (empty on a cold probe)
+    try:
+        from tidb_tpu.sched import breaker_snapshot_all
+        print("breaker=" + json.dumps(breaker_snapshot_all()))
+    except Exception as e:   # noqa: BLE001 probe must stay hang-proof
+        log(f"probe: breaker view unavailable ({e})")
 
 
 def _load_data(sf):
@@ -476,6 +488,7 @@ def mode_sched():
         "donated_bytes": st.get("donated_bytes", 0),
     }
     out["rc"] = _sched_rc_scenario(dom, s, sched, queries[0])
+    out["chaos"] = _sched_chaos_scenario(dom, s, sched, queries)
     log("sched-concurrent:", json.dumps(out))
     os.makedirs(DATA_DIR, exist_ok=True)
     with open(SCHED_PATH, "w") as f:
@@ -537,6 +550,111 @@ def _sched_rc_scenario(dom, s, sched, query):
         "throttled": groups.get("bench_starved", {}).get("throttled", 0),
         "rc_exhausted": sched.stats().get("rc_exhausted", 0),
     }
+
+
+def _sched_chaos_scenario(dom, s, sched, queries):
+    """Chaos rung (faultline): sweep injected transient launch-fault
+    rates through the supervised drain and record completion rate, p99
+    sched wait, recovery counters, and correctness (ZERO wrong results
+    is the invariant) per rung — then one targeted poison rung proving
+    the breaker quarantine + host-oracle degradation end to end."""
+    import threading
+
+    from tidb_tpu import faults
+    from tidb_tpu.faults import FaultPlan, FaultRule
+    from tidb_tpu.session import Session
+
+    n_stmts = int(os.environ.get("BENCH_CHAOS_STMTS", "36"))
+    rates = [float(r) for r in os.environ.get(
+        "BENCH_CHAOS_RATES", "0.05,0.2").split(",")]
+    expected = {q: sorted(map(repr, s.must_query(q))) for q in queries}
+    mu = threading.Lock()
+
+    def run_round(n):
+        counts = {"ok": 0, "wrong": 0, "failed": 0}
+
+        def run(i):
+            q = queries[i % len(queries)]
+            try:
+                got = sorted(map(repr, Session(dom).must_query(q)))
+            except Exception:   # noqa: BLE001 counted, not raised
+                with mu:
+                    counts["failed"] += 1
+                return
+            with mu:
+                counts["ok" if got == expected[q] else "wrong"] += 1
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        return counts
+
+    rungs = []
+    try:
+        for rate in rates:
+            faults.install(FaultPlan.parse(
+                f"seed=7,launch:transient:{rate}"))
+            base = sched.stats()
+            t0 = time.monotonic()
+            counts = run_round(n_stmts)
+            st = sched.stats()
+            rungs.append({
+                "fault_rate": rate,
+                "stmts": n_stmts,
+                "elapsed_s": round(time.monotonic() - t0, 3),
+                "completion_rate": round(counts["ok"] / n_stmts, 4),
+                "wrong_results": counts["wrong"],
+                "failed": counts["failed"],
+                "injected": (st["faults"] or {}).get("total_injected", 0),
+                "retried_launches": st["retried_launches"]
+                - base["retried_launches"],
+                "sched_wait_p99_ms": st["wait_p99_ms"],
+            })
+            faults.clear()
+
+        # targeted poison rung: one query's digest fails forever; the
+        # breaker must open and the host oracle must keep serving it
+        sched._digest_ns.clear()
+        Session(dom).must_query(queries[0])
+        dig = next(iter(sched._digest_ns), None)
+        poison = {"skipped": "no digest observed"}
+        if dig is not None:
+            faults.install(FaultPlan(
+                [FaultRule("launch", "poison", match=dig)], seed=7))
+            base = sched.stats()
+            d0 = dom.client.degraded
+            # sequential: each statement observes the breaker state the
+            # previous one left — N failures trip it OPEN, then every
+            # subsequent identical statement degrades to the host oracle
+            counts = {"ok": 0, "wrong": 0, "failed": 0}
+            for _ in range(12):
+                try:
+                    got = sorted(map(repr,
+                                     Session(dom).must_query(queries[0])))
+                except Exception:   # noqa: BLE001 counted, not raised
+                    counts["failed"] += 1
+                    continue
+                counts["ok" if got == expected[queries[0]]
+                       else "wrong"] += 1
+            st = sched.stats()
+            poison = {
+                "stmts": 12,
+                "ok": counts["ok"],
+                "wrong_results": counts["wrong"],
+                "failed": counts["failed"],
+                "quarantined": st["quarantined"] - base["quarantined"],
+                "bisected": st["bisected_launches"]
+                - base["bisected_launches"],
+                "degraded": dom.client.degraded - d0,
+                "breaker": (st["breaker"] or {}).get(dig, {}),
+            }
+        return {"rates": rungs, "poison": poison}
+    finally:
+        faults.clear()
+        sched.breaker.reset()
 
 
 def _median_times(fn, iters):

@@ -87,9 +87,23 @@ def main():
             log(f"no grant (rc={rc}); sleeping {sleep_s:.0f}s")
             time.sleep(sleep_s)
             continue
+        probe_txt = out.decode().strip()
+        # faultline: the probe child prints its per-digest breaker view
+        # ("breaker={...}") — keep it on the attempt record so the
+        # round artifact shows which programs were quarantined
+        breaker = None
+        lines_out = []
+        for ln in probe_txt.splitlines():
+            if ln.startswith("breaker="):
+                try:
+                    breaker = json.loads(ln[len("breaker="):])
+                except ValueError:
+                    pass
+            else:
+                lines_out.append(ln)
         note_attempt(attempt=attempt, outcome="granted",
                      probe_s=round(time.time() - t, 1),
-                     probe=out.decode().strip())
+                     probe=" ".join(lines_out), breaker=breaker or {})
         log("TPU GRANTED:", out.decode().strip(), "— running bench ladder")
         bench_t = float(os.environ.get("BENCH_TPU_BUDGET", "3000"))
         t = time.time()

@@ -362,6 +362,59 @@ def test_lint_psum_fence():
                         if r == "TPU-PSUM-FENCE"], rel
 
 
+def test_lint_retry_budget():
+    """TPU-RETRY-BUDGET: a `while True:` re-dispatch loop in sched/ or
+    store/ that sleeps without consulting a Backoffer budget fails the
+    gate; Backoffer-routed sleeps, bounded loops, and modules outside
+    the dispatch layers pass."""
+    blind = ("import time\n\ndef f():\n    while True:\n"
+             "        try:\n            return g()\n"
+             "        except ValueError:\n            time.sleep(0.1)\n")
+    assert _rules(blind, "store/remote.py") == ["TPU-RETRY-BUDGET"]
+    assert _rules(blind, "sched/scheduler.py") == ["TPU-RETRY-BUDGET"]
+    # outside the dispatch layers: silent
+    assert _rules(blind, "utils/poolmgr.py") == []
+    # consulting a Backoffer budget passes (the backoff call sleeps)
+    budgeted = ("def f(bo):\n    while True:\n"
+                "        try:\n            return g()\n"
+                "        except ValueError as e:\n"
+                "            bo.backoff(KIND, e)\n")
+    assert _rules(budgeted, "store/remote.py") == []
+    # ...including when the loop constructs the Backoffer itself
+    ctor = ("from .backoff import Backoffer\n\ndef f():\n"
+            "    while True:\n"
+            "        bo = Backoffer()\n"
+            "        time.sleep(0.1)\n")
+    assert _rules(ctor, "store/remote.py") == []
+    # bounded loops (explicit attempt count) are not retry-forever
+    bounded = ("import time\n\ndef f():\n    for _ in range(3):\n"
+               "        time.sleep(0.1)\n")
+    assert _rules(bounded, "store/remote.py") == []
+    # condition waits are event-driven, not blind sleeps
+    cv = ("def f(self):\n    while True:\n"
+          "        self._cv.wait(timeout=0.5)\n")
+    assert _rules(cv, "sched/scheduler.py") == []
+    # inline waiver works like every other rule
+    waived = blind.replace("time.sleep(0.1)",
+                           "time.sleep(0.1)  # planlint: ok - poll")
+    assert _rules(waived, "store/remote.py") == []
+    # repo sweep: the dispatch layers are clean (every retry loop in
+    # sched/ + store/ routes its sleep through a Backoffer)
+    import os
+
+    import tidb_tpu
+    root = os.path.dirname(tidb_tpu.__file__)
+    for sub in ("sched", "store"):
+        for fname in sorted(os.listdir(os.path.join(root, sub))):
+            if not fname.endswith(".py"):
+                continue
+            rel = f"{sub}/{fname}"
+            with open(os.path.join(root, rel), encoding="utf-8") as f:
+                found = [r for r in _rules(f.read(), rel)
+                         if r == "TPU-RETRY-BUDGET"]
+            assert not found, (rel, found)
+
+
 def test_lint_dtype_x64():
     """Weak-typed jnp creation in traced modules is x64-flag-dependent:
     int64 today only because tidb_tpu enables jax_enable_x64."""

@@ -1,10 +1,23 @@
 """Failure detection & recovery: typed backoff budgets, failpoint-injected
 dispatch errors, region split (pkg/store/copr backoff loop, client-go
-retry.Backoffer, failpoint analogs)."""
+retry.Backoffer, failpoint analogs) — plus faultline launch supervision:
+the seeded deterministic FaultPlan, transient retry at the drain, the
+per-digest circuit breaker, fused blast-radius bisection, and the
+host-oracle fallback for quarantined digests."""
+
+import random
+import threading
+import time
 
 import numpy as np
 import pytest
 
+from tidb_tpu import faults
+from tidb_tpu.faults import (CLOSED, HALF_OPEN, OPEN, CircuitBreaker,
+                             FaultPlan, FaultRule,
+                             LaunchQuarantinedError, PoisonFault,
+                             TransientFault)
+from tidb_tpu.sched import CopTask, TaskCancelledError
 from tidb_tpu.session import Domain, Session
 from tidb_tpu.store.backoff import (DEVICE_BUSY, STALE_EPOCH,
                                     STORE_UNAVAILABLE, Backoffer,
@@ -84,3 +97,365 @@ def test_split_table_regions(sess):
         "select b, sum(a) from t group by b")) == exp
     with pytest.raises(Exception):
         sess.execute("split table t regions 0")
+
+
+# ------------------------------------------------------------------ #
+# faultline satellites: seeded Backoffer jitter, typed cancellation
+# ------------------------------------------------------------------ #
+
+def test_backoffer_seeded_rng_reproducible():
+    """Injecting a seeded rng makes retry histories replay
+    bit-identically (the sleep_fn twin seam); different seeds differ."""
+    def history(seed):
+        sleeps = []
+        bo = Backoffer(max_sleep_ms=100_000, rng=random.Random(seed),
+                       sleep_fn=lambda s: sleeps.append(s))
+        for _ in range(8):
+            bo.backoff(STALE_EPOCH, RegionError(STALE_EPOCH))
+        return sleeps
+    assert history(42) == history(42)
+    assert history(42) != history(43)
+
+
+def test_cancelled_task_fails_typed():
+    """A waiter killed while queued fails with TaskCancelledError — the
+    retry layer (and clients) can tell cancellation from device failure
+    and never retries it."""
+    from tidb_tpu.sched.scheduler import DeviceScheduler
+    sched = DeviceScheduler()
+    sched.pause()
+    try:
+        t = sched.submit(CopTask.opaque(lambda: 1))
+        t.cancelled = True
+    finally:
+        sched.resume()
+    deadline = time.monotonic() + 10
+    while not t.done and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert t.done
+    assert isinstance(t._exc, TaskCancelledError)
+
+
+# ------------------------------------------------------------------ #
+# faultline: deterministic FaultPlan
+# ------------------------------------------------------------------ #
+
+def test_faultplan_parse_and_determinism():
+    p = FaultPlan.parse(
+        "seed=42,launch:transient:0.5,build:poison:1:match=ab12:times=3")
+    assert p.seed == 42 and len(p.rules) == 2
+    assert p.rules[1] == FaultRule("build", "poison", 1.0, "ab12", 3)
+    assert FaultPlan.parse("") is None
+    with pytest.raises(ValueError):
+        FaultPlan.parse("warp:transient:0.5")
+    with pytest.raises(ValueError):
+        FaultPlan.parse("launch:sideways:0.5")
+
+    # poison is deterministic PER KEY: the same digest fails on every
+    # attempt (retrying never helps), other digests never fire
+    p2 = FaultPlan([FaultRule("launch", "poison", rate=0.5)], seed=7)
+    outcomes = {}
+    for key in range(32):
+        fired = []
+        for _attempt in range(4):
+            try:
+                p2.check("launch", key)
+                fired.append(False)
+            except PoisonFault:
+                fired.append(True)
+        assert len(set(fired)) == 1, "poison must be stable per key"
+        outcomes[key] = fired[0]
+    assert any(outcomes.values()) and not all(outcomes.values())
+    # a fresh plan with the same seed replays the exact same outcomes
+    p3 = FaultPlan([FaultRule("launch", "poison", rate=0.5)], seed=7)
+    for key, want in outcomes.items():
+        got = False
+        try:
+            p3.check("launch", key)
+        except PoisonFault:
+            got = True
+        assert got is want
+
+    # times caps injections (n-shot failpoint idiom)
+    p4 = FaultPlan([FaultRule("drain", "transient", times=2)])
+    fires = 0
+    for _ in range(5):
+        try:
+            p4.check("drain")
+        except TransientFault:
+            fires += 1
+    assert fires == 2
+    assert p4.stats()["injected"] == {"drain:transient": 2}
+
+
+def test_faultplan_install_spec_does_not_clobber_programmatic():
+    """The sysvar seam's empty default must not disarm a plan a test
+    installed programmatically."""
+    plan = FaultPlan([FaultRule("drain", "transient", times=1)])
+    faults.install(plan)
+    try:
+        faults.install_spec("")
+        assert faults.active() is plan
+    finally:
+        faults.clear()
+
+
+# ------------------------------------------------------------------ #
+# faultline: circuit breaker state machine
+# ------------------------------------------------------------------ #
+
+def test_breaker_state_machine_closed_open_halfopen_closed():
+    now = [0.0]
+    b = CircuitBreaker(threshold=3, window_s=10.0, cooldown_s=1.0,
+                       clock=lambda: now[0])
+    dig = 0xabc
+    assert b.state(dig) == CLOSED
+    b.record_failure(dig)
+    b.record_failure(dig)
+    assert b.state(dig) == CLOSED     # below threshold
+    b.admit(dig)                      # CLOSED admits freely
+    b.record_failure(dig)
+    assert b.state(dig) == OPEN       # tripped
+    with pytest.raises(LaunchQuarantinedError) as ei:
+        b.admit(dig)
+    assert ei.value.digest == dig and ei.value.failures == 3
+    now[0] = 1.5                      # cooldown elapsed
+    b.admit(dig)                      # the single HALF_OPEN probe
+    assert b.state(dig) == HALF_OPEN
+    with pytest.raises(LaunchQuarantinedError):
+        b.admit(dig)                  # second probe refused
+    b.record_failure(dig)             # probe failed -> OPEN again
+    assert b.state(dig) == OPEN
+    with pytest.raises(LaunchQuarantinedError):
+        b.admit(dig)
+    now[0] = 3.0
+    b.admit(dig)                      # probe again
+    b.record_success(dig)             # probe healed the circuit
+    assert b.state(dig) == CLOSED
+    b.admit(dig)                      # closed again: admits freely
+
+
+def test_breaker_window_prunes_stale_failures():
+    now = [0.0]
+    b = CircuitBreaker(threshold=3, window_s=5.0, cooldown_s=1.0,
+                       clock=lambda: now[0])
+    b.record_failure(1)
+    b.record_failure(1)
+    now[0] = 20.0                     # both outside the window now
+    b.record_failure(1)
+    assert b.state(1) == CLOSED       # 1 failure in-window, no trip
+    assert b.snapshot()["0000000000000001"]["failures"] == 3
+
+
+def test_breaker_abort_probe_releases_slot():
+    now = [0.0]
+    b = CircuitBreaker(threshold=1, cooldown_s=1.0, clock=lambda: now[0])
+    b.record_failure(5)
+    now[0] = 2.0
+    b.admit(5)                        # probe admitted
+    b.abort_probe(5)                  # never launched (queue overflow)
+    b.admit(5)                        # slot free again
+
+
+# ------------------------------------------------------------------ #
+# faultline end-to-end: supervised launches on the CPU mesh
+# ------------------------------------------------------------------ #
+
+FLT_QUERIES = [
+    "select count(*) from flt where d >= 5",
+    "select sum(p * d) from flt where q < 24",
+    "select min(p) from flt where q > 10",
+]
+
+
+@pytest.fixture()
+def fdom():
+    """Domain with the device launch path pinned open, fast drain
+    retries, and full faultline state restoration on teardown (the
+    scheduler is process-wide per mesh fingerprint)."""
+    dom = Domain()
+    s = Session(dom)
+    rng = np.random.default_rng(0)
+    n = 3000
+    q = rng.integers(1, 50, n)
+    d = rng.integers(0, 10, n)
+    p = rng.integers(100, 10_000, n)
+    s.execute("create table flt (q bigint, d bigint, p bigint)")
+    s.execute("insert into flt values "
+              + ",".join(f"({a},{b},{c})" for a, b, c in zip(q, d, p)))
+    s.execute("set global tidb_tpu_result_cache_entries = 0")
+    s.execute("set global tidb_tpu_sched_max_coalesce = 8")
+    s.execute("set global tidb_tpu_sched_fusion = 1")
+    dom.client._platform = lambda: "tpu"
+    s.must_query("select count(*) from flt")   # start the scheduler
+    sched = dom.client._sched_obj
+    assert sched is not None
+    saved = (sched._retry_sleep, sched.launch_retry_ms)
+    sched._retry_sleep = lambda sec: None
+    try:
+        yield dom, s, sched
+    finally:
+        sched._retry_sleep, sched.launch_retry_ms = saved
+        sched.breaker.reset()
+        faults.clear()
+
+
+def _digest_of(dom, sched, query) -> str:
+    """Hex program digest of `query`'s device launch (the key the
+    breaker, the device-time map, and FaultRule.match all share)."""
+    sched._digest_ns.clear()
+    Session(dom).must_query(query)
+    digs = list(sched._digest_ns)
+    assert len(digs) == 1, digs
+    return digs[0]
+
+
+def test_transient_launch_fault_retried_to_success(fdom):
+    """A transient launch failure retries through the DEVICE_FAILED
+    backoff budget inside the drain: the waiter sees only the correct
+    result, and the retry is visible in counters + EXPLAIN ANALYZE."""
+    dom, s, sched = fdom
+    solo = s.must_query(FLT_QUERIES[1])
+    r0, rt0 = sched.retried_launches, sched.retried_tasks
+    faults.install(FaultPlan(
+        [FaultRule("launch", "transient", times=2)], seed=1))
+    assert s.must_query(FLT_QUERIES[1]) == solo
+    assert sched.retried_launches - r0 == 2
+    assert sched.retried_tasks - rt0 >= 2
+    st = sched.stats()
+    assert st["faults"]["injected"] == {"launch:transient": 2}
+    # EXPLAIN ANALYZE notes the re-launches on the cop task
+    faults.install(FaultPlan(
+        [FaultRule("launch", "transient", times=1)], seed=1))
+    rows = s.must_query("explain analyze " + FLT_QUERIES[1])
+    text = "\n".join(str(r) for r in rows)
+    assert "retried: 1" in text, text
+
+
+def test_transient_dispatch_fault_rides_backoff(fdom):
+    """The store-dispatch seam recovers through the client's typed
+    backoff loop (DEVICE_FAILED kind), like a RegionError failpoint."""
+    dom, s, sched = fdom
+    solo = s.must_query(FLT_QUERIES[0])
+    faults.install(FaultPlan(
+        [FaultRule("dispatch", "transient", times=2)], seed=1))
+    assert s.must_query(FLT_QUERIES[0]) == solo
+
+
+def test_fused_blast_radius_and_host_fallback(fdom):
+    """Acceptance: FaultPlan poisons ONE member of a 3-member fused
+    launch — the two innocent riders return bit-identical results to
+    their solo runs, the poisoned digest's breaker opens after N
+    failures, a subsequent identical statement is served by the host
+    oracle with correct results, and all of it shows on /sched."""
+    dom, s, sched = fdom
+    solo = [Session(dom).must_query(qq) for qq in FLT_QUERIES]
+    digs = [_digest_of(dom, sched, qq) for qq in FLT_QUERIES]
+    assert len(set(digs)) == 3
+    poison = digs[1]
+    faults.install(FaultPlan(
+        [FaultRule("launch", "poison", match=poison)], seed=3))
+
+    out, errs = {}, {}
+
+    def run(i, qq):
+        try:
+            out[i] = Session(dom).must_query(qq)
+        except Exception as e:   # noqa: BLE001 asserted below
+            errs[i] = e
+
+    b0 = sched.bisected_launches
+    sched.pause()
+    try:
+        threads = [threading.Thread(target=run, args=(i, qq))
+                   for i, qq in enumerate(FLT_QUERIES)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and sched.depth < 3:
+            time.sleep(0.01)
+        assert sched.depth >= 3, "tasks did not queue"
+    finally:
+        sched.resume()
+    for t in threads:
+        t.join(timeout=60)
+
+    # innocent riders completed bit-identically to their solo runs;
+    # only the poisoned member failed, and failed typed
+    assert out[0] == solo[0] and out[2] == solo[2]
+    assert set(errs) == {1} and isinstance(errs[1], PoisonFault)
+    assert sched.bisected_launches > b0, "group failure did not demux"
+    assert sched.breaker.snapshot()[poison]["failures"] >= 1
+
+    # repeat the poisoned statement until its breaker trips OPEN
+    for _ in range(sched.breaker.threshold):
+        if sched.breaker.snapshot()[poison]["state"] == OPEN:
+            break
+        with pytest.raises(PoisonFault):
+            Session(dom).must_query(FLT_QUERIES[1])
+    assert sched.breaker.snapshot()[poison]["state"] == OPEN
+
+    # quarantined digest: the next identical statement degrades to the
+    # host oracle — same answer, no device launch, EXPLAIN notes it
+    q0, d0 = sched.quarantined, dom.client.degraded
+    assert Session(dom).must_query(FLT_QUERIES[1]) == solo[1]
+    assert sched.quarantined > q0
+    assert dom.client.degraded == d0 + 1
+    rows = s.must_query("explain analyze " + FLT_QUERIES[1])
+    assert "degraded" in "\n".join(str(r) for r in rows)
+
+    # ...and the whole story is visible on /sched
+    import json
+    import urllib.request
+    from tidb_tpu.server.status import StatusServer
+    srv = StatusServer(dom)
+    port = srv.start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/sched", timeout=5).read()
+    finally:
+        srv.close()
+    st = json.loads(body)
+    assert st["breaker"][poison]["state"] == OPEN
+    assert st["quarantined"] >= 1 and st["bisected_launches"] >= 1
+    assert st["client"]["degraded"] >= 1
+    assert st["faults"]["total_injected"] >= 1
+
+
+def test_host_fallback_matches_device_for_group_by(fdom):
+    """Host-oracle fallback correctness on a group-by plan: the
+    degraded result is identical to the device result."""
+    dom, s, sched = fdom
+    query = "select d, sum(p), count(*) from flt group by d"
+    device = sorted(Session(dom).must_query(query))
+    dig = _digest_of(dom, sched, query)
+    faults.install(FaultPlan(
+        [FaultRule("launch", "poison", match=dig)], seed=5))
+    for _ in range(sched.breaker.threshold + 2):
+        if sched.breaker.snapshot().get(dig, {}).get("state") == OPEN:
+            break
+        with pytest.raises(PoisonFault):
+            Session(dom).must_query(query)
+    assert sched.breaker.snapshot()[dig]["state"] == OPEN
+    assert sorted(Session(dom).must_query(query)) == device
+    assert dom.client.degraded >= 1
+
+
+def test_host_fallback_disabled_surfaces_quarantine(fdom):
+    """tidb_tpu_sched_host_fallback=0: an OPEN breaker surfaces the
+    structured LaunchQuarantinedError instead of degrading."""
+    dom, s, sched = fdom
+    dig = _digest_of(dom, sched, FLT_QUERIES[2])
+    faults.install(FaultPlan(
+        [FaultRule("launch", "poison", match=dig)], seed=9))
+    s.execute("set global tidb_tpu_sched_host_fallback = 0")
+    try:
+        for _ in range(sched.breaker.threshold + 2):
+            if sched.breaker.snapshot().get(dig, {}).get("state") == OPEN:
+                break
+            with pytest.raises(PoisonFault):
+                Session(dom).must_query(FLT_QUERIES[2])
+        with pytest.raises(LaunchQuarantinedError):
+            Session(dom).must_query(FLT_QUERIES[2])
+    finally:
+        s.execute("set global tidb_tpu_sched_host_fallback = 1")

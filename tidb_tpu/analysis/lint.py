@@ -49,6 +49,13 @@ Rules
                    place gets silently truncated int32/float32 lanes on
                    TPU — wrong join keys and sums, green CPU tests.
                    Pin dtype= explicitly.
+- TPU-RETRY-BUDGET an unconditional retry loop (`while True:`) in a
+                   sched/ or store/ module that SLEEPS (time.sleep or
+                   any *sleep* callable) without consulting a Backoffer
+                   budget: a blind sleep-and-redispatch loop retries
+                   forever with no typed budget, no attempt history and
+                   no RetryBudgetExceeded surfacing — route every
+                   re-dispatch sleep through store/backoff.Backoffer.
 - TPU-DONATE       a ``donate_argnums=``/``donate_argnames=`` keyword in
                    a traced module whose value is a non-empty literal,
                    or an expression that does not reference a
@@ -99,7 +106,15 @@ LOCK_MODULES = {
     # SEGMENT-strategy kernel (ISSUE 6): lock-free today, listed so any
     # future lock grown there joins the cross-layer order contract
     "copr/segment.py",
+    # faultline (ISSUE 8): the breaker/plan leaf locks run under the
+    # drain's condition lock and the submit path, so nested/inverted
+    # acquisition there would deadlock against the scheduler
+    "faults/breaker.py", "faults/plan.py",
 }
+
+# modules whose retry/re-dispatch loops must spend a typed Backoffer
+# budget (TPU-RETRY-BUDGET): the device dispatch + scheduler layers
+RETRY_MODULE_PREFIXES = ("sched/", "store/")
 
 _DIGEST_NAME = re.compile(r"key|digest|token|fingerprint|signature",
                           re.IGNORECASE)
@@ -229,6 +244,7 @@ class _ExprRules(_Scoped):
         super().__init__(rel, lines)
         self.traced = rel in TRACED_MODULES
         self.hot = rel in HOT_PATH_MODULES
+        self.retry_scope = rel.startswith(RETRY_MODULE_PREFIXES)
         self.psum_fenced = psum_fenced
         self._digest_fn = 0     # depth of digest-context functions
         self._sorted_ok: set = set()   # dict-iter calls under sorted()
@@ -390,6 +406,39 @@ class _ExprRules(_Scoped):
                          "DonationPlan-derived symbol; route donation "
                          "through analysis/lifetime so the slot "
                          "lifetimes are verified pre-trace")
+
+    def visit_While(self, node):
+        # TPU-RETRY-BUDGET: a `while True:` re-dispatch loop in the
+        # sched/store layers that sleeps blind retries forever; the
+        # Backoffer is the only sanctioned sleep (typed curve, total
+        # budget, attempt history, RetryBudgetExceeded surfacing)
+        if self.retry_scope and isinstance(node.test, ast.Constant) \
+                and bool(node.test.value):
+            self._check_retry_budget(node)
+        self.generic_visit(node)
+
+    def _check_retry_budget(self, node: ast.While) -> None:
+        sleep_call = None
+        consults_budget = False
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                f = sub.func
+                nm = f.attr if isinstance(f, ast.Attribute) else \
+                    (f.id if isinstance(f, ast.Name) else "")
+                if "sleep" in nm and sleep_call is None:
+                    sleep_call = sub
+            if isinstance(sub, ast.Name) and "backoff" in sub.id.lower():
+                consults_budget = True
+            elif isinstance(sub, ast.Attribute) \
+                    and "backoff" in sub.attr.lower():
+                consults_budget = True
+        if sleep_call is not None and not consults_budget:
+            self.add("TPU-RETRY-BUDGET", sleep_call,
+                     "unbounded retry loop sleeps without a Backoffer "
+                     "budget: blind sleep-and-redispatch retries "
+                     "forever — back off through store/backoff."
+                     "Backoffer so the attempt history and total sleep "
+                     "budget are enforced")
 
     def visit_ExceptHandler(self, node):
         broad = node.type is None
@@ -624,4 +673,4 @@ def new_findings(findings: list, baseline: set) -> list:
 
 __all__ = ["Finding", "lint_source", "lint_tree", "load_baseline",
            "new_findings", "TRACED_MODULES", "HOT_PATH_MODULES",
-           "LOCK_MODULES"]
+           "LOCK_MODULES", "RETRY_MODULE_PREFIXES"]

@@ -47,7 +47,7 @@ class QueryHandle:
 
     __slots__ = ("conn_id", "sql", "started", "fragments", "_mu",
                  "sched_wait_ns", "sched_tasks", "sched_coalesced",
-                 "sched_fused", "sched_rus")
+                 "sched_fused", "sched_rus", "sched_retried", "degraded")
 
     def __init__(self, conn_id: int, sql: str):
         self.conn_id = conn_id
@@ -62,13 +62,19 @@ class QueryHandle:
                                    # fused launch (EXPLAIN `fused`)
         self.sched_rus = 0.0       # priced RUs debited for this
                                    # statement's device work (rc/)
+        self.sched_retried = 0     # transient-failure re-launches the
+                                   # drain spent on this statement's
+                                   # tasks (EXPLAIN `retried`)
+        self.degraded = 0          # cop dispatches served by the host
+                                   # oracle after a launch quarantine
 
     def note_fragment(self, desc: str) -> None:
         with self._mu:
             self.fragments.append((desc, time.time()))
 
     def note_sched(self, wait_ns: int, coalesced: int,
-                   fused: int = 0, rus: float = 0.0) -> None:
+                   fused: int = 0, rus: float = 0.0,
+                   retried: int = 0) -> None:
         with self._mu:
             self.sched_wait_ns += int(wait_ns)
             self.sched_tasks += 1
@@ -77,6 +83,11 @@ class QueryHandle:
             if fused > 1:
                 self.sched_fused += 1
             self.sched_rus += float(rus)
+            self.sched_retried += int(retried)
+
+    def note_degraded(self) -> None:
+        with self._mu:
+            self.degraded += 1
 
 
 class Coordinator:

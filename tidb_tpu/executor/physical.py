@@ -358,6 +358,8 @@ class CopTaskExec(PhysOp):
         sched_w0 = handle.sched_wait_ns if handle is not None else 0
         sched_f0 = handle.sched_fused if handle is not None else 0
         sched_r0 = handle.sched_rus if handle is not None else 0.0
+        sched_t0 = handle.sched_retried if handle is not None else 0
+        sched_d0 = handle.degraded if handle is not None else 0
         if self.as_of_ts is not None:
             snap = self.as_of_snap
             if snap is None:
@@ -394,6 +396,14 @@ class CopTaskExec(PhysOp):
             dr = handle.sched_rus - sched_r0
             self._rt_detail = (f"schedWait: {dw / 1e6:.3f}ms, "
                                f"fused: {df}, ru: {dr:.1f}")
+            # launch supervision (faultline): transient re-launches the
+            # drain paid, and whether the host oracle served this task
+            # after a quarantine — only noted when they happened
+            dt = handle.sched_retried - sched_t0
+            if dt:
+                self._rt_detail += f", retried: {dt}"
+            if handle.degraded - sched_d0:
+                self._rt_detail += ", degraded"
         return ResultChunk(list(self.out_names), cols)
 
 

@@ -1,0 +1,16 @@
+"""faultline: deterministic fault injection + launch supervision
+primitives (seeded FaultPlan seams, per-digest circuit breaker).  The
+scheduler drain and CopClient consult these; see plan.py / breaker.py
+for the design."""
+
+from .breaker import (CLOSED, HALF_OPEN, OPEN, CircuitBreaker,
+                      LaunchQuarantinedError, digest_hex)
+from .plan import (SEAMS, FaultPlan, FaultRule, InjectedFault,
+                   PoisonFault, TransientFault, active, check, clear,
+                   install, install_spec, stats)
+
+__all__ = ["FaultPlan", "FaultRule", "InjectedFault", "TransientFault",
+           "PoisonFault", "SEAMS", "install", "install_spec", "clear",
+           "active", "check", "stats", "CircuitBreaker",
+           "LaunchQuarantinedError", "digest_hex", "CLOSED", "OPEN",
+           "HALF_OPEN"]

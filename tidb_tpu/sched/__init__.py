@@ -3,11 +3,12 @@ cop tasks (tikv unified-read-pool + inference continuous-batching
 analog).  See scheduler.py for the design."""
 
 from .scheduler import (DEFAULT_MAX_COALESCE, DEFAULT_QUEUE_DEPTH,
-                        DeviceScheduler, scheduler_for)
-from .task import (SCHED_GROUP, CopTask, ServerBusyError, current_group,
-                   mesh_fingerprint)
+                        DeviceScheduler, breaker_snapshot_all,
+                        scheduler_for)
+from .task import (SCHED_GROUP, CopTask, ServerBusyError,
+                   TaskCancelledError, current_group, mesh_fingerprint)
 
-__all__ = ["DeviceScheduler", "scheduler_for", "CopTask",
-           "ServerBusyError", "SCHED_GROUP", "current_group",
-           "DEFAULT_QUEUE_DEPTH", "DEFAULT_MAX_COALESCE",
-           "mesh_fingerprint"]
+__all__ = ["DeviceScheduler", "scheduler_for", "breaker_snapshot_all",
+           "CopTask", "ServerBusyError", "TaskCancelledError",
+           "SCHED_GROUP", "current_group", "DEFAULT_QUEUE_DEPTH",
+           "DEFAULT_MAX_COALESCE", "mesh_fingerprint"]

@@ -40,6 +40,17 @@ owns all launches onto one jax mesh:
   scan once, riders their marginal bytes), and a throttled task that
   overstays the max-queue deadline fails its waiter with the
   MySQL-compatible ResourceExhaustedError (8252).
+- Launches are SUPERVISED (faultline): a transient launch failure
+  retries through the store Backoffer's DEVICE_FAILED budget instead of
+  failing the waiter; a failing fused/batched launch is DEMUXED and its
+  members retried solo so one poisoned plan cannot take down innocent
+  riders (fusion never widens a failure domain); a per-program-digest
+  circuit breaker (CLOSED -> OPEN -> HALF_OPEN probe) makes repeat
+  offenders fail fast at submit with LaunchQuarantinedError — which the
+  CopClient degrades to the host oracle path where the plan shape
+  allows.  The seeded FaultPlan (faults/plan.py) injects deterministic
+  transient/poison faults at the build/launch/drain seams so every one
+  of these paths is exercisable on a CPU mesh.
 - Queue-wait / launch / coalesce / fusion stats feed utils/metrics
   (scraped at /metrics), the /sched status route, per-statement
   execdetails (`schedWait`/`fused`/`ru` in EXPLAIN ANALYZE), priced
@@ -54,15 +65,18 @@ period, so embedders that never touch the device pay nothing.
 from __future__ import annotations
 
 import os
+import random
 import threading
 import time
 from collections import deque
 from typing import Optional
 
+from ..faults import plan as _faults
+from ..faults.breaker import CircuitBreaker, LaunchQuarantinedError
 from ..rc.controller import (DEFAULT_MAX_QUEUE_S, DEFAULT_OVERDRAFT_RU,
                              ResourceExhaustedError)
 from ..rc.pricing import split_device_time, task_rus
-from .task import CopTask, ServerBusyError
+from .task import CopTask, ServerBusyError, TaskCancelledError
 
 DEFAULT_QUEUE_DEPTH = 256
 DEFAULT_MAX_COALESCE = 8
@@ -88,6 +102,13 @@ WINDOW_HIT_FLOOR = 0.05          # scale cutoff: ~10 straight misses
 RC_RETRY_S = 0.01
 # per-program-digest device-time attribution map stays tiny
 RC_DIGEST_CAP = 64
+# supervised-launch transient retry: total Backoffer sleep budget the
+# drain will spend re-launching one batch before classifying the
+# failure as persistent (DEVICE_FAILED curve, store/backoff.py)
+DEFAULT_LAUNCH_RETRY_MS = 2000.0
+# seeded jitter for the drain's Backoffer when no FaultPlan is armed:
+# retry histories stay reproducible either way
+RETRY_JITTER_SEED = 0x5EED
 
 
 def _verify_enabled() -> bool:
@@ -137,6 +158,12 @@ class DeviceScheduler:
         self.rc_enable = True
         self.rc_overdraft_ru = DEFAULT_OVERDRAFT_RU
         self.rc_max_queue_s = DEFAULT_MAX_QUEUE_S
+        # launch supervision (faultline): per-digest circuit breaker
+        # consulted at submit, transient-retry budget spent at the
+        # drain; _retry_sleep is the Backoffer sleep seam (tests)
+        self.breaker = CircuitBreaker()
+        self.launch_retry_ms = DEFAULT_LAUNCH_RETRY_MS
+        self._retry_sleep = time.sleep
         self._mu = threading.Lock()
         self._cv = threading.Condition(self._mu)
         self._groups: dict[str, _GroupQ] = {}
@@ -172,6 +199,14 @@ class DeviceScheduler:
         self.donated_launches = 0         # launches with donated inputs
         self.donated_tasks = 0            # tasks that requested donation
         self.donated_bytes = 0            # priced input bytes aliased out
+        # supervised-launch accounting (faultline)
+        self.retried_launches = 0         # serve attempts re-run after a
+                                          # transient launch failure
+        self.retried_tasks = 0            # member tasks those retries span
+        self.bisected_launches = 0        # failed group launches demuxed
+                                          # for blast-radius isolation
+        self.quarantined = 0              # submits failed fast by an OPEN
+                                          # breaker (LaunchQuarantinedError)
         # rc enforcement accounting (rc/controller)
         self.rc_throttled = 0             # drain passes that skipped a group
         self.rc_exhausted = 0             # waiters failed at the deadline
@@ -214,6 +249,15 @@ class DeviceScheduler:
         self._m_donated = reg.counter(
             "tidb_tpu_sched_donated_bytes_total",
             "input bytes aliased into outputs by buffer donation")
+        self._m_retried = reg.counter(
+            "tidb_tpu_sched_retried_total",
+            "tasks re-launched after a transient device failure")
+        self._m_quar = reg.counter(
+            "tidb_tpu_sched_quarantined_total",
+            "submits failed fast by an OPEN program circuit breaker")
+        self._m_bisect = reg.counter(
+            "tidb_tpu_sched_bisected_total",
+            "failed group launches demuxed for blast-radius isolation")
         # resource control plane (rc/): admission-side RU enforcement
         self._m_rc_throttle = reg.counter(
             "tidb_tpu_rc_throttled_total",
@@ -345,6 +389,18 @@ class DeviceScheduler:
             from ..analysis.contracts import verify_task
             verify_task(task)
             self._admit_cost(task)
+        if task.key is not None:
+            # circuit breaker: a digest whose launches keep failing is
+            # quarantined HERE, in the submitting thread — fail fast
+            # with the structured error the client's host fallback
+            # understands, instead of re-crashing the device
+            try:
+                self.breaker.admit(task.key[0])
+            except LaunchQuarantinedError:
+                with self._mu:
+                    self.quarantined += 1
+                self._m_quar.inc()
+                raise
         # rc pricing happens HERE, in the submitting thread: structured
         # tasks price from the LaunchCost the admission gate just
         # computed, opaque tasks from their row estimate — the drain
@@ -358,6 +414,10 @@ class DeviceScheduler:
             if self._depth >= self.max_depth:
                 self.busy_rejects += 1
                 self._m_busy.inc()
+                if task.key is not None:
+                    # an admitted HALF_OPEN probe that never queues must
+                    # release its slot or no probe could ever run
+                    self.breaker.abort_probe(task.key[0])
                 raise ServerBusyError(self.max_depth)
             g = self._groups.get(task.group)
             if g is None:
@@ -614,7 +674,7 @@ class DeviceScheduler:
         g.tasks += 1
         if lead.cancelled:
             self._m_depth.set(self._depth)
-            lead.fail(RuntimeError("cancelled"))
+            lead.fail(TaskCancelledError())
             return [None]          # sentinel: retry pick
         self._rc_debit(lead)
         batch = [lead]
@@ -667,13 +727,127 @@ class DeviceScheduler:
                 t.wait_ns = now - t.submit_ns
             self._note_launch_bytes(batch)
             try:
-                self._serve(batch)
-            except BaseException as e:  # noqa: BLE001 future-style contract
-                for t in batch:
+                self._serve_supervised(batch)
+            except BaseException as e:  # noqa: BLE001 supervisor safety
+                for t in batch:         # net: the drain must never die
                     t.fail(e)
             self._attribute_launch(batch,
                                    time.perf_counter_ns() - now)
             self._account(batch)
+
+    # ------------------------------------------------------------- #
+    # launch supervision (faultline)
+    # ------------------------------------------------------------- #
+
+    @staticmethod
+    def _digests(tasks: list) -> set:
+        return {t.key[0] for t in tasks if t.key is not None}
+
+    @staticmethod
+    def _is_transient(e: BaseException) -> bool:
+        """Retry-worthy launch failures: injected transient faults and
+        typed retryable dispatch errors.  Everything else — compile
+        errors, device crashes, contract violations — is treated as
+        persistent: retrying an identical program would re-crash the
+        device, so it fails (and charges the breaker) instead."""
+        from ..store.backoff import RegionError
+        return isinstance(e, (_faults.TransientFault, RegionError))
+
+    @staticmethod
+    def _is_fatal(e: BaseException) -> bool:
+        """Never retried, never breaker-charged: cancellation and
+        interpreter teardown."""
+        from ..copr.coordinator import QueryInterrupted
+        return isinstance(e, (TaskCancelledError, QueryInterrupted,
+                              KeyboardInterrupt, SystemExit))
+
+    def _launch_backoffer(self):
+        from ..store.backoff import Backoffer
+        fp = _faults.active()
+        rng = fp.backoff_rng() if fp is not None \
+            else random.Random(RETRY_JITTER_SEED)
+        return Backoffer(max_sleep_ms=self.launch_retry_ms,
+                         sleep_fn=self._retry_sleep, rng=rng)
+
+    def _serve_supervised(self, batch: list) -> None:
+        """Serve a batch under the retry/breaker contract: transient
+        failures re-launch through the DEVICE_FAILED backoff budget
+        (already-finished members are never re-run — finish() is
+        idempotent and the live filter drops them), persistent failures
+        go to blast-radius isolation, cancelled waiters fail typed and
+        are never retried.  Successful launches clear their digests'
+        breaker state."""
+        from ..store.backoff import DEVICE_FAILED, RetryBudgetExceeded
+        bo = None
+        while True:
+            live = []
+            for t in batch:
+                if t.done:
+                    continue
+                if t.cancelled:
+                    t.fail(TaskCancelledError())
+                    continue
+                live.append(t)
+            if not live:
+                return
+            try:
+                _faults.check("drain")
+                self._serve(live)
+            except BaseException as e:  # noqa: BLE001 classified below
+                if self._is_fatal(e):
+                    for t in live:
+                        t.fail(e)
+                    return
+                if self._is_transient(e):
+                    if bo is None:
+                        bo = self._launch_backoffer()
+                    try:
+                        bo.backoff(DEVICE_FAILED, e)
+                    except RetryBudgetExceeded as budget:
+                        self._isolate(
+                            [t for t in batch if not t.done], budget)
+                        return
+                    self.retried_launches += 1
+                    self.retried_tasks += len(live)
+                    self._m_retried.inc(len(live))
+                    for t in live:
+                        t.retries += 1
+                    continue
+                self._isolate([t for t in batch if not t.done], e)
+                return
+            else:
+                for d in self._digests(live):
+                    self.breaker.record_success(d)
+                return
+
+    def _isolate(self, live: list, err: BaseException) -> None:
+        """Blast-radius isolation: a failed GROUP launch (fused members
+        and/or batched slots) is demuxed into its (program, input)
+        members and each retried SOLO — innocent riders complete, only
+        the poisoned member fails its waiter and charges its digest's
+        breaker.  Fusion must never widen a failure domain.  A launch
+        that was already solo is the bisection base case: fail + charge."""
+        subs: list = []
+        by_member: dict = {}
+        for t in live:
+            k = (t.key, t.input_token)
+            g = by_member.get(k)
+            if g is None:
+                g = by_member[k] = []
+                subs.append(g)
+            g.append(t)
+        if len(subs) <= 1:
+            for d in self._digests(live):
+                self.breaker.record_failure(d)
+            for t in live:
+                t.fail(err)
+            return
+        self.bisected_launches += 1
+        self._m_bisect.inc()
+        for sub in subs:
+            # recursion bottoms out: a solo member that fails again
+            # lands in the len(subs) <= 1 branch above
+            self._serve_supervised(sub)
 
     # ------------------------------------------------------------- #
     # launch
@@ -694,10 +868,11 @@ class DeviceScheduler:
     def _serve(self, batch: list) -> None:
         lead = batch[0]
         if lead.fn is not None:                     # opaque launch
-            try:
-                lead.finish(lead.fn())
-            except BaseException as e:  # noqa: BLE001
-                lead.fail(e)
+            # failures PROPAGATE so the supervisor classifies them
+            # (transient retry vs fail) instead of failing the waiter
+            # on the first error
+            _faults.check("launch")
+            lead.finish(lead.fn())
             self.launches += 1
             self._m_launch.inc(mode="single")
             return
@@ -731,6 +906,13 @@ class DeviceScheduler:
         members = [grp[0] for grp in programs]
         lead = members[0]
         try:
+            # the launch seam is consulted once PER MEMBER digest: a
+            # poisoned member refuses the fused launch (caught below),
+            # demuxing to per-program launches where the guilty member
+            # fails ALONE — injected faults exercise exactly the
+            # blast-radius contract real failures follow
+            for m in members:
+                _faults.check("launch", m.key[0])
             from ..analysis.contracts import verify_fusion_group
             # EVERY task (riders too): a same-key rider carrying a
             # different input token must refuse the fused scan — its
@@ -773,8 +955,11 @@ class DeviceScheduler:
         from ..parallel.spmd import (get_batched_program,
                                      get_batched_rows_program,
                                      get_sharded_program)
+        digest = lead.key[0] if lead.key is not None else None
+        _faults.check("build", digest)
         prog = get_sharded_program(lead.dag, lead.mesh, lead.row_capacity,
                                    donate=lead.donate)
+        _faults.check("launch", digest)
         # group riders by input identity: same-token tasks share ONE
         # program execution (in-flight dedup)
         slots: list[list] = []
@@ -919,6 +1104,13 @@ class DeviceScheduler:
                 "donated_launches": self.donated_launches,
                 "donated_tasks": self.donated_tasks,
                 "donated_bytes": self.donated_bytes,
+                # launch supervision (faultline): retry/bisect/breaker
+                "retried_launches": self.retried_launches,
+                "retried_tasks": self.retried_tasks,
+                "bisected_launches": self.bisected_launches,
+                "quarantined": self.quarantined,
+                "breaker": self.breaker.snapshot(),
+                "faults": _faults.stats(),   # None when unarmed
                 "rc_enable": self.rc_enable,
                 "rc_overdraft_ru": self.rc_overdraft_ru,
                 "rc_throttled": self.rc_throttled,
@@ -966,5 +1158,17 @@ def scheduler_for(mesh) -> DeviceScheduler:
         return s
 
 
-__all__ = ["DeviceScheduler", "scheduler_for", "DEFAULT_QUEUE_DEPTH",
-           "DEFAULT_MAX_COALESCE", "WINDOW_CAP_US"]
+def breaker_snapshot_all() -> dict:
+    """Merged breaker view across every registered scheduler (the
+    retry-daemon's last-probe summary and /sched aggregation seam)."""
+    with _REG_MU:
+        scheds = list(_REGISTRY.values())
+    out: dict = {}
+    for s in scheds:
+        out.update(s.breaker.snapshot())
+    return out
+
+
+__all__ = ["DeviceScheduler", "scheduler_for", "breaker_snapshot_all",
+           "DEFAULT_QUEUE_DEPTH", "DEFAULT_MAX_COALESCE",
+           "WINDOW_CAP_US", "DEFAULT_LAUNCH_RETRY_MS"]

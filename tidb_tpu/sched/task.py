@@ -51,6 +51,17 @@ class ServerBusyError(RuntimeError):
             f"depth={depth}); retry later")
 
 
+class TaskCancelledError(RuntimeError):
+    """The waiter was killed (KILL QUERY / connection teardown) while
+    its task queued: the drain fails the lead with THIS typed error so
+    the supervised retry layer — and clients — can tell cancellation
+    from device failure.  Cancellation is never retried and never
+    charges the program's circuit breaker."""
+
+    def __init__(self):
+        super().__init__("cop task cancelled before launch")
+
+
 def current_group() -> tuple:
     """(group name, weight, rc group-or-None) of the calling statement
     context; 2-tuple bindings (pre-rc embedders) gain a None."""
@@ -101,7 +112,7 @@ class CopTask:
                  "submit_ns", "start_ns", "wait_ns", "coalesced", "fused",
                  "fusion_key", "cancelled", "_done", "_value", "_exc",
                  "est_rows", "cost", "rc_group", "rus", "rus_charged",
-                 "device_ns", "deadline_ns", "donate")
+                 "device_ns", "deadline_ns", "donate", "retries")
 
     def __init__(self, *, key=None, dag=None, mesh=None, row_capacity=0,
                  cols=None, counts=None, aux=(), input_token=None,
@@ -140,6 +151,7 @@ class CopTask:
         self.device_ns = 0        # attributed share of launch wall time
         self.deadline_ns = 0      # rc max-queue deadline (0 = none)
         self.donate = bool(donate)  # launch-unique inputs: donate them
+        self.retries = 0          # transient-failure re-launches (drain)
         self.cancelled = False
         self._done = threading.Event()
         self._value = None
@@ -185,6 +197,12 @@ class CopTask:
 
     # -------- completion -------- #
 
+    @property
+    def done(self) -> bool:
+        """Resolved (served or failed) — the supervised drain filters
+        already-finished members out of a retried batch."""
+        return self._done.is_set()
+
     def finish(self, value) -> None:
         if self._done.is_set():
             return
@@ -213,5 +231,6 @@ class CopTask:
         return self._value
 
 
-__all__ = ["CopTask", "ServerBusyError", "SCHED_GROUP", "current_group",
-           "DEFAULT_GROUP", "DEFAULT_WEIGHT", "mesh_fingerprint"]
+__all__ = ["CopTask", "ServerBusyError", "TaskCancelledError",
+           "SCHED_GROUP", "current_group", "DEFAULT_GROUP",
+           "DEFAULT_WEIGHT", "mesh_fingerprint"]

@@ -116,8 +116,11 @@ class StatusServer:
             # counters, micro-batch window state (incl. hit-rate
             # feedback), HBM-budget admission (hbm_budget bytes,
             # budget_admitted/rejects/deferrals, last_launch_bytes —
-            # analysis/copcost), wait p50/p99, and the shared
-            # CopClient's cache/retry/paging counters ("client")
+            # analysis/copcost), launch supervision (faultline:
+            # retried/bisected/quarantined counters, per-digest
+            # "breaker" states, armed FaultPlan "faults" injection
+            # stats), wait p50/p99, and the shared CopClient's
+            # cache/retry/paging/degraded counters ("client")
             return json.dumps(self.domain.client.sched_stats()), \
                 "application/json"
         if path == "/resource":

@@ -1151,6 +1151,15 @@ class Session:
         v8 = merged.get("tidb_tpu_rc_overdraft_ru")
         if v8 is not None and v8 != "" and int(v8) >= 0:
             client.rc_overdraft = float(v8)
+        # launch supervision (faultline): host-oracle fallback for
+        # quarantined digests, and the fault-injection plane spec
+        v9 = merged.get("tidb_tpu_sched_host_fallback")
+        if v9 is not None and v9 != "":
+            client.host_fallback = bool(int(v9))
+        v10 = merged.get("tidb_tpu_faults")
+        if v10 is not None:
+            from ..faults import install_spec
+            install_spec(str(v10))
         return ExecContext(client, merged,
                            mem_tracker=Tracker("query", quota))
 

@@ -75,6 +75,14 @@ _VARS = [
     _v("tidb_tpu_rc_enable", 1, kind="bool", scope=SCOPE_GLOBAL),
     _v("tidb_tpu_rc_overdraft_ru", -1, kind="int", min=-1,
        max=1 << 20, scope=SCOPE_GLOBAL),
+    # launch supervision (faultline): host-oracle fallback for
+    # breaker-quarantined program digests (default on — a broken device
+    # kernel degrades to slow-but-correct instead of unavailable), and
+    # the fault-injection plane spec (seam:kind[:rate][:match=..]
+    # [:times=..] rules, comma-separated, optional seed=N; empty = off)
+    _v("tidb_tpu_sched_host_fallback", 1, kind="bool",
+       scope=SCOPE_GLOBAL),
+    _v("tidb_tpu_faults", "", kind="str", scope=SCOPE_GLOBAL),
     _v("tidb_distsql_scan_concurrency", 15, kind="int", min=1, max=256),
     _v("tidb_max_chunk_size", 1024, kind="int", min=32, max=65536),
     _v("tidb_enable_vectorized_expression", 1, kind="bool"),

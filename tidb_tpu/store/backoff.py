@@ -37,6 +37,10 @@ STALE_EPOCH = BackoffKind("staleEpoch", 2, 500)
 STORE_UNAVAILABLE = BackoffKind("storeUnavailable", 100, 2000)
 DEVICE_BUSY = BackoffKind("deviceBusy", 20, 1000)
 TXN_LOCK = BackoffKind("txnLock", 10, 1000)
+# transient device-launch failure (faultline supervised drain): a
+# compiled program's launch died in a retryable way — back off and
+# re-launch under the statement budget (copIterator rpc-error analog)
+DEVICE_FAILED = BackoffKind("deviceFailed", 10, 500)
 
 
 @dataclass
@@ -47,6 +51,10 @@ class Backoffer:
     attempts: dict = field(default_factory=dict)   # kind name -> count
     history: list = field(default_factory=list)
     sleep_fn: object = time.sleep      # test seam
+    # jitter source: the global random module by default; inject a
+    # seeded random.Random so retry histories replay bit-identically in
+    # tests and under an armed FaultPlan (sleep_fn's twin seam)
+    rng: object = random
 
     def backoff(self, kind: BackoffKind, err: Exception) -> None:
         """Sleep per the kind's curve, or raise RetryBudgetExceeded."""
@@ -54,7 +62,7 @@ class Backoffer:
         self.attempts[kind.name] = n + 1
         # exponential with equal-jitter, capped
         raw = min(kind.base_ms * (2 ** n), kind.cap_ms)
-        ms = raw / 2 + random.uniform(0, raw / 2)
+        ms = raw / 2 + self.rng.uniform(0, raw / 2)
         self.history.append((kind.name, round(ms, 2), str(err)))
         if self.slept_ms + ms > self.max_sleep_ms:
             raise RetryBudgetExceeded(self.history, err)
@@ -73,4 +81,5 @@ class RegionError(RuntimeError):
 
 __all__ = ["Backoffer", "BackoffKind", "RegionError",
            "RetryBudgetExceeded", "REGION_MISS", "STALE_EPOCH",
-           "STORE_UNAVAILABLE", "DEVICE_BUSY", "TXN_LOCK"]
+           "STORE_UNAVAILABLE", "DEVICE_BUSY", "DEVICE_FAILED",
+           "TXN_LOCK"]

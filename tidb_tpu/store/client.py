@@ -25,6 +25,8 @@ from ..chunk.column import Column
 from ..copr import dag as D
 from ..copr.aggregate import (GroupKeyMeta, finalize, finalize_sorted,
                               merge_sorted_states, merge_states)
+from ..faults import plan as _faults
+from ..faults.breaker import LaunchQuarantinedError
 from ..parallel.spmd import get_sharded_program
 from .columnar import ColumnarSnapshot, _pow2_at_least
 
@@ -128,6 +130,13 @@ class CopClient:
         self.rc_enable = None
         self.rc_overdraft = None
         self._sched_obj = None
+        # graceful degradation (faultline; tidb_tpu_sched_host_fallback):
+        # a digest quarantined by the launch circuit breaker falls back
+        # to the host oracle path when the plan has a host-executable
+        # shape — slow-but-correct instead of unavailable (the Flare
+        # unsupported-path degradation pattern)
+        self.host_fallback = True
+        self.degraded = 0      # statements served by that fallback
 
     @property
     def mesh(self):
@@ -169,7 +178,7 @@ class CopClient:
         copr handleTask re-split discipline (coprocessor.go:337,:1308),
         not an identical re-run."""
         from ..copr.coordinator import check_killed
-        from .backoff import Backoffer, RegionError
+        from .backoff import DEVICE_FAILED, Backoffer, RegionError
         bo = Backoffer(max_sleep_ms=self.retry_budget_ms)
         retries = 0
         while True:
@@ -178,6 +187,7 @@ class CopClient:
                 fp = self._next_failpoint()
                 if fp is not None:
                     raise fp
+                _faults.check("dispatch")   # faultline store-dispatch seam
                 with self._stat_mu:
                     self.last_retries = retries
                 return fn()
@@ -188,6 +198,11 @@ class CopClient:
                     if healed:
                         with self._stat_mu:
                             self.last_heals += 1
+                retries += 1
+            except _faults.TransientFault as e:
+                # injected retryable dispatch/transfer fault: same typed
+                # budget, DEVICE_FAILED curve; poison faults propagate
+                bo.backoff(DEVICE_FAILED, e)
                 retries += 1
 
     # ------------------------------------------------------------- #
@@ -217,7 +232,9 @@ class CopClient:
         with self._stat_mu:
             return {"last_page_iters": self.last_page_iters,
                     "last_retries": self.last_retries,
-                    "last_heals": self.last_heals}
+                    "last_heals": self.last_heals,
+                    "degraded": self.degraded,
+                    "host_fallback": self.host_fallback}
 
     def sched_stats(self) -> dict:
         """Status-API introspection; never resolves a pending mesh."""
@@ -239,7 +256,7 @@ class CopClient:
             # the waiter always observes it; device_ns is attributed
             # post-serve and stays a scheduler-side stat
             h.note_sched(task.wait_ns, task.coalesced, task.fused,
-                         rus=task.rus_charged)
+                         rus=task.rus_charged, retried=task.retries)
 
     def _launch(self, dag, cols, counts, aux, row_capacity: int = 0,
                 donate: bool = False):
@@ -292,10 +309,49 @@ class CopClient:
             hit = self._rc_get(key, snap)
             if hit is not None:
                 return hit
-        res = self._retry(lambda: self._execute_agg_once(
-            agg, snap, key_meta, aux_cols), snap=snap)
+        try:
+            res = self._retry(lambda: self._execute_agg_once(
+                agg, snap, key_meta, aux_cols), snap=snap)
+        except LaunchQuarantinedError as err:
+            # OPEN breaker: the device program keeps failing — degrade
+            # to the host oracle where the plan shape allows it
+            res = self._degraded_agg(agg, snap, key_meta, aux_cols, err)
         if key is not None:
             self._rc_put(key, snap, res)
+        return res
+
+    def _degraded_agg(self, agg: D.Aggregation, snap: ColumnarSnapshot,
+                      key_meta, aux_cols, err) -> CopResult:
+        """Graceful degradation for a quarantined program digest
+        (faultline): serve the aggregation from the host oracle path
+        (copr/hostagg) — slow-but-correct instead of unavailable, the
+        Flare compiled-path-falls-back-to-interpreter pattern.  Plans
+        without a host-executable shape re-raise the quarantine error
+        so the client sees the structured failure."""
+        res = None
+        if self.host_fallback and not aux_cols:
+            if agg.strategy in D.HOST_MERGE_STRATEGIES:
+                res = self._host_sort_agg(agg, snap, key_meta)
+            else:
+                from ..copr.hostagg import host_dense_agg
+                states = host_dense_agg(agg, snap)
+                if states is not None:
+                    merged = merge_states([states])
+                    key_cols, agg_cols = finalize(agg, merged, key_meta)
+                    res = CopResult(agg_cols, key_cols)
+        if res is None:
+            raise err
+        with self._stat_mu:
+            self.degraded += 1
+        from ..utils.metrics import global_registry
+        global_registry().counter(
+            "tidb_tpu_sched_degraded_total",
+            "statements served by the host oracle after a launch "
+            "quarantine").inc()
+        from ..copr.coordinator import QUERY_HANDLE
+        h = QUERY_HANDLE.get()
+        if h is not None:
+            h.note_degraded()
         return res
 
     def _rc_key(self, dag, snap: ColumnarSnapshot):
@@ -367,6 +423,8 @@ class CopClient:
                     agg = grown
                     continue
             states = jax.device_get(out)
+            # faultline transfer/host-merge seam, keyed by the digest
+            _faults.check("transfer", D.dag_digest(agg))
             break
         else:
             raise RuntimeError("join-capacity regrow did not converge")
@@ -676,6 +734,7 @@ class CopClient:
     def _assemble_rows(self, out_cols, out_counts, cap, out_dtypes,
                        dictionaries) -> list[Column]:
         """Concatenate per-device compacted outputs into host Columns."""
+        _faults.check("transfer")   # faultline device->host seam
         n_dev = len(self.mesh.devices.reshape(-1))
         out_counts = np.asarray(jax.device_get(out_counts))
         out_cols = jax.device_get(out_cols)
