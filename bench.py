@@ -489,6 +489,7 @@ def mode_sched():
     }
     out["rc"] = _sched_rc_scenario(dom, s, sched, queries[0])
     out["chaos"] = _sched_chaos_scenario(dom, s, sched, queries)
+    out["coldwarm"] = _sched_coldwarm_scenario(dom, sched)
     log("sched-concurrent:", json.dumps(out))
     os.makedirs(DATA_DIR, exist_ok=True)
     with open(SCHED_PATH, "w") as f:
@@ -651,10 +652,69 @@ def _sched_chaos_scenario(dom, s, sched, queries):
                 "degraded": dom.client.degraded - d0,
                 "breaker": (st["breaker"] or {}).get(dig, {}),
             }
+            # copforge: a poisoned digest's breaker state must NOT be
+            # persisted into the warm manifest — quarantine laundering
+            # through a restart would re-crash a healthy process.
+            # laundered == 0 is the invariant.
+            from tidb_tpu.compilecache import compile_cache
+            poison["quarantine"] = compile_cache().quarantine_report()
         return {"rates": rungs, "poison": poison}
     finally:
         faults.clear()
         sched.breaker.reset()
+
+
+def _sched_coldwarm_scenario(dom, sched):
+    """coldwarm rung (copforge, ISSUE 9): cold-start vs warm-start
+    first-query latency as FIRST-CLASS numbers.  Cold = fresh cache dir
+    + simulated fresh process (builder memos and warm pool cleared);
+    warm = the same simulated restart, but the persisted cache replayed
+    into the warm pool first.  The warm rung's compile count MUST be
+    zero — a restarted server serves its first corpus-shaped query
+    without compiling."""
+    import shutil
+    import tempfile
+
+    from tidb_tpu.compilecache import (compile_cache, configure,
+                                       simulate_restart, warm_start)
+    from tidb_tpu.session import Session
+
+    cc = compile_cache()
+    old_dir, old_enable = cc.cache_dir, cc.enable
+    tmp = tempfile.mkdtemp(prefix="copforge-bench-")
+    # a digest no earlier rung compiled: the cold number is honest
+    q = ("select sum(l_extendedprice), min(l_quantity) from lineitem "
+         "where l_discount >= 4 and l_shipdays < 1500")
+    try:
+        configure(enable=True, cache_dir=tmp)
+        simulate_restart()
+        st0 = cc.stats()
+        t0 = time.monotonic()
+        Session(dom).must_query(q)
+        cold_s = time.monotonic() - t0
+        st1 = cc.stats()
+        # second simulated restart: this process finds a populated
+        # cache dir and replays the manifest BEFORE the query lands
+        simulate_restart()
+        warmed = warm_start(dom.client, wait=True)
+        st2 = cc.stats()
+        t0 = time.monotonic()
+        Session(dom).must_query(q)
+        warm_s = time.monotonic() - t0
+        st3 = cc.stats()
+        return {
+            "cold_first_ms": round(cold_s * 1e3, 3),
+            "warm_first_ms": round(warm_s * 1e3, 3),
+            "cold_compiles": st1["misses"] - st0["misses"],
+            "warm_compiles": st3["misses"] - st2["misses"],
+            "warmed_entries": warmed,
+            "warm_loaded": st2["warm_loaded"] - st1["warm_loaded"],
+            "persist_supported": st3.get("persist_supported"),
+            "speedup": round(cold_s / max(warm_s, 1e-9), 2),
+        }
+    finally:
+        configure(enable=old_enable, cache_dir=old_dir)
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def _median_times(fn, iters):

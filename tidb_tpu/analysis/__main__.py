@@ -33,6 +33,9 @@ Flags:
                                      table (bytes/flops/padding) and exit
     --donation-report                print the per-corpus-query buffer
                                      lifetime / donation table and exit
+    --cache-report                   print the per-corpus-query compile
+                                     cache key/variant/bytes table
+                                     (analysis/compilekey) and exit
 """
 
 from __future__ import annotations
@@ -187,6 +190,10 @@ def main(argv=None) -> int:
     if "--donation-report" in argv:
         from .lifetime import donation_report
         print(donation_report(_corpus_plans(), n_devices=GATE_DEVICES))
+        return 0
+    if "--cache-report" in argv:
+        from .compilekey import cache_report
+        print(cache_report(_corpus_plans(), n_devices=GATE_DEVICES))
         return 0
     if check_baseline:
         # hygiene pass: waivers must not rot silently — every baseline

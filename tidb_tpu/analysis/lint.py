@@ -65,6 +65,15 @@ Rules
                    the statically verified analysis/lifetime
                    DonationPlan — a hand-written literal silently
                    deletes snapshot residents or regrow inputs.
+- TPU-COMPILE-KEY  a serialize/deserialize/cache-write seam in
+                   compilecache/ whose enclosing function does not
+                   reference the persistent-key triple — a ``digest``
+                   symbol, a mesh fingerprint (``mesh``/``fingerprint``)
+                   and the donation plan (``donat``): an executable
+                   persisted (or loaded) without the full key anatomy
+                   can silently deserialize a stale or wrong-variant
+                   program after a restart (mirrors TPU-DIGEST for the
+                   on-disk half of the program cache).
 
 Inline waiver: any rule is suppressed by a `# planlint: ok` comment on
 the offending line (give a reason after it).
@@ -110,11 +119,27 @@ LOCK_MODULES = {
     # drain's condition lock and the submit path, so nested/inverted
     # acquisition there would deadlock against the scheduler
     "faults/breaker.py", "faults/plan.py",
+    # copforge (ISSUE 9): the cache/manifest leaf locks run under the
+    # drain (resolve at launch) and the submit path (fusion prediction)
+    "compilecache/cache.py", "compilecache/manifest.py",
 }
 
 # modules whose retry/re-dispatch loops must spend a typed Backoffer
 # budget (TPU-RETRY-BUDGET): the device dispatch + scheduler layers
 RETRY_MODULE_PREFIXES = ("sched/", "store/")
+
+# the AOT program cache (copforge): every seam where executable bytes
+# hit or leave disk must carry the digest + mesh-fingerprint +
+# donation-plan triple (TPU-COMPILE-KEY)
+COMPILECACHE_PREFIX = "compilecache/"
+# call names that ARE such seams (jax.experimental.serialize_executable
+# entry points plus any persist_* helper grown later)
+_CACHE_WRITE_CALLS = re.compile(
+    r"^(serialize|deserialize_and_load|persist\w*|_persist\w*|"
+    r"write_entry\w*)$")
+_KEY_TRIPLE = (("digest", re.compile(r"digest")),
+               ("mesh fingerprint", re.compile(r"mesh|fingerprint")),
+               ("donation plan", re.compile(r"donat")))
 
 _DIGEST_NAME = re.compile(r"key|digest|token|fingerprint|signature",
                           re.IGNORECASE)
@@ -469,6 +494,60 @@ class _ExprRules(_Scoped):
 
 
 # --------------------------------------------------------------------- #
+# rule: TPU-COMPILE-KEY (compilecache/ persistence seams)
+# --------------------------------------------------------------------- #
+
+class _CompileKeyRules(_Scoped):
+    """Every serialize/deserialize/persist call in compilecache/ must
+    sit in a function that references the persistent-key triple: a
+    digest, a mesh fingerprint, and the donation plan.  Identifier
+    check covers names, attributes, AND string constants (the header
+    field names the loader re-verifies count as references)."""
+
+    def __init__(self, rel, lines):
+        super().__init__(rel, lines)
+        self._fn_nodes: list = []
+
+    def visit_FunctionDef(self, node):
+        self._fn_nodes.append(node)
+        super().visit_FunctionDef(node)
+        self._fn_nodes.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node):
+        name = _call_name(node)
+        if _CACHE_WRITE_CALLS.match(name) and self._fn_nodes:
+            fn = self._fn_nodes[-1]
+            blob = " ".join(self._identifiers(fn)).lower()
+            missing = [lbl for lbl, pat in _KEY_TRIPLE
+                       if not pat.search(blob)]
+            if missing:
+                self.add("TPU-COMPILE-KEY", node,
+                         f"{name}(...) in a cache-write seam whose "
+                         "enclosing function never references "
+                         f"{' / '.join(missing)}: a persisted "
+                         "executable keyed without the full digest + "
+                         "mesh-fingerprint + donation-plan triple can "
+                         "silently deserialize the wrong program "
+                         "variant after a restart")
+        self.generic_visit(node)
+
+    @staticmethod
+    def _identifiers(fn: ast.AST) -> set:
+        out = set()
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Name):
+                out.add(sub.id)
+            elif isinstance(sub, ast.Attribute):
+                out.add(sub.attr)
+            elif isinstance(sub, ast.Constant) and \
+                    isinstance(sub.value, str):
+                out.add(sub.value)
+        return out
+
+
+# --------------------------------------------------------------------- #
 # rule 5: lock acquisition order
 # --------------------------------------------------------------------- #
 
@@ -614,6 +693,10 @@ def lint_source(src: str, rel: str) -> list:
     v = _ExprRules(rel, lines, psum_fenced=fenced)
     v.visit(tree)
     findings = v.findings
+    if rel.startswith(COMPILECACHE_PREFIX):
+        ck = _CompileKeyRules(rel, lines)
+        ck.visit(tree)
+        findings += ck.findings
     if rel in LOCK_MODULES:
         findings += _LockRules(rel, lines, tree).run()
     # collapse repeats on one line (e.g. three id() calls in one tuple)
@@ -673,4 +756,5 @@ def new_findings(findings: list, baseline: set) -> list:
 
 __all__ = ["Finding", "lint_source", "lint_tree", "load_baseline",
            "new_findings", "TRACED_MODULES", "HOT_PATH_MODULES",
-           "LOCK_MODULES", "RETRY_MODULE_PREFIXES"]
+           "LOCK_MODULES", "RETRY_MODULE_PREFIXES",
+           "COMPILECACHE_PREFIX"]

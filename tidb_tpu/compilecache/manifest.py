@@ -1,0 +1,170 @@
+"""Warm-pool manifest: the persisted record of hot compiled programs.
+
+Reference analog: the plan-cache eviction bookkeeping of the reference
+(pkg/planner/core/plan_cache_lru.go) applied to persisted executables.
+One JSON file per cache directory lists every persisted entry with its
+key anatomy and measured compile/load times; a restarted server replays
+it MRU-first to pre-warm the corpus shape before the first query lands
+(compilecache/warmup.py), and the measured per-digest times are the
+feed the ROADMAP's measured-calibration item will consume next.
+
+Two hard rules:
+
+- bounded by BYTES, LRU-evicted (``tidb_tpu_compile_warm_pool`` caps
+  it): evicting a manifest entry also deletes its ``.copforge`` file,
+  so the disk footprint tracks the cap too.
+- a QUARANTINED digest is never recorded and is purged on quarantine:
+  a program the circuit breaker opened on must not launder its way back
+  through a restart's warm replay (the chaos bench rung asserts this).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+MANIFEST_NAME = "manifest.json"
+MANIFEST_VERSION = 1
+
+# default byte bound when the sysvar leaves -1 in place
+DEFAULT_CAP_BYTES = 256 << 20
+
+
+class WarmManifest:
+    """Thread-safe manifest of one cache directory (leaf lock only)."""
+
+    def __init__(self, cache_dir: str, cap_bytes: int = DEFAULT_CAP_BYTES):
+        self.cache_dir = cache_dir
+        self.cap_bytes = cap_bytes
+        self._mu = threading.Lock()
+        self._entries: dict[str, dict] = {}       # entry_hex -> meta
+        self.evictions = 0
+        self._load()
+
+    # ---- persistence ------------------------------------------------ #
+
+    def _path(self) -> str:
+        return os.path.join(self.cache_dir, MANIFEST_NAME)
+
+    def _load(self) -> None:
+        try:
+            with open(self._path(), encoding="utf-8") as f:
+                doc = json.load(f)
+            if doc.get("version") == MANIFEST_VERSION:
+                self._entries = dict(doc.get("entries", {}))
+        except (OSError, ValueError):
+            self._entries = {}
+
+    def _save_locked(self) -> None:
+        try:
+            os.makedirs(self.cache_dir, exist_ok=True)
+            tmp = self._path() + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({"version": MANIFEST_VERSION,
+                           "entries": self._entries}, f)
+            os.replace(tmp, self._path())
+        except OSError:
+            pass          # manifest is an optimization, never a failure
+
+    # ---- recording -------------------------------------------------- #
+
+    def record(self, entry_hex: str, key_parts: dict, nbytes: int,
+               compile_ms: float, quarantined: bool = False) -> None:
+        """One persisted executable: key anatomy + measured compile
+        time.  Quarantined digests are refused — see module doc."""
+        if quarantined:
+            return
+        with self._mu:
+            self._entries[entry_hex] = {
+                "digest": key_parts.get("digest", ""),
+                "family": key_parts.get("family", ""),
+                "mesh_fp": key_parts.get("mesh_fp", ""),
+                "capacity": key_parts.get("capacity", 0),
+                "bytes": int(nbytes),
+                "compile_ms": round(float(compile_ms), 3),
+                "load_ms": 0.0,
+                "hits": 0,
+                "last_used": time.time(),
+            }
+            self._evict_locked()
+            self._save_locked()
+
+    def touch(self, entry_hex: str, load_ms: float = 0.0) -> None:
+        with self._mu:
+            e = self._entries.get(entry_hex)
+            if e is not None:
+                e["hits"] = e.get("hits", 0) + 1
+                e["last_used"] = time.time()
+                if load_ms:
+                    e["load_ms"] = round(float(load_ms), 3)
+
+    def purge_digest(self, digest: str) -> int:
+        """Drop (and unlink) every entry of a quarantined digest."""
+        with self._mu:
+            doomed = [hx for hx, e in sorted(self._entries.items())
+                      if e.get("digest") == digest]
+            for hx in doomed:
+                self._drop_locked(hx)
+            if doomed:
+                self._save_locked()
+            return len(doomed)
+
+    def _drop_locked(self, entry_hex: str) -> None:
+        self._entries.pop(entry_hex, None)
+        try:
+            os.unlink(os.path.join(self.cache_dir,
+                                   entry_hex + ".copforge"))
+        except OSError:
+            pass
+
+    def _evict_locked(self) -> None:
+        """LRU by bytes: oldest-used entries (and their files) go first
+        until the manifest fits the cap.  cap_bytes 0 = unbounded."""
+        if self.cap_bytes <= 0:
+            return
+        total = sum(e.get("bytes", 0) for e in self._entries.values())
+        while total > self.cap_bytes and len(self._entries) > 1:
+            lru = min(sorted(self._entries.items()),
+                      key=lambda kv: kv[1].get("last_used", 0.0))
+            total -= lru[1].get("bytes", 0)
+            self._drop_locked(lru[0])
+            self.evictions += 1
+
+    # ---- introspection ---------------------------------------------- #
+
+    def entries_mru(self) -> list:
+        """(entry_hex, meta) pairs, most-recently-used first — the warm
+        replay order (hottest programs load before the long tail)."""
+        with self._mu:
+            return sorted(self._entries.items(),
+                          key=lambda kv: -kv[1].get("last_used", 0.0))
+
+    def has_program(self, digest: str) -> bool:
+        """Is any entry of this (stable) dag digest warm-replayable?"""
+        with self._mu:
+            return any(e.get("digest") == digest
+                       for e in self._entries.values())
+
+    def capacities_for(self, family: str) -> list:
+        """Recorded regrow capacities of one plan family, ascending —
+        the client's warm-capacity pick reads this on regrow re-entry."""
+        with self._mu:
+            caps = {int(e.get("capacity", 0))
+                    for e in self._entries.values()
+                    if e.get("family") == family and e.get("capacity")}
+        return sorted(caps)
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {"entries": len(self._entries),
+                    "bytes": sum(e.get("bytes", 0)
+                                 for e in self._entries.values()),
+                    "cap_bytes": self.cap_bytes,
+                    "evictions": self.evictions}
+
+
+__all__ = ["WarmManifest", "MANIFEST_NAME", "MANIFEST_VERSION",
+           "DEFAULT_CAP_BYTES"]

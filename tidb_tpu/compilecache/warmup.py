@@ -1,0 +1,114 @@
+"""Boot-time warm program pool: replay the manifest before traffic.
+
+A restarted server holding a populated cache directory should serve its
+first TPC-H-shaped query WITHOUT compiling: this module deserializes
+every manifest entry (MRU-first, so the hottest programs warm first)
+into the in-process pool.  The loads run OFF the serving thread and
+THROUGH the existing admission queue at LOW priority (weight 1.0, the
+resource-group LOW weight), so a fat manifest can never starve live
+traffic — a live statement's tasks outweigh warmup 8:1 in the
+weighted-fair drain, and warmup tasks never coalesce or fuse with
+anything (opaque tasks by construction).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .cache import compile_cache
+
+# the warm replay's resource-group identity: weight 1.0 == PRIORITY LOW
+# (rc/controller.PRIORITY_WEIGHTS), distinct name so /sched shows the
+# replay as its own group
+WARM_GROUP = "copforge-warm"
+WARM_WEIGHT = 1.0
+
+# one replay per (process, cache_dir): reconfiguring to a new dir warms
+# again, re-running a statement does not
+_WARMED: set = set()
+_WARM_MU = threading.Lock()
+
+
+def warm_start(client=None, wait: bool = False) -> int:
+    """Replay the manifest into the warm pool.  ``client`` (a CopClient)
+    provides the admission queue; None = load inline (tests, tools).
+    ``wait=True`` blocks until every entry loaded (bench/tests); the
+    serving path uses the default fire-and-forget thread.  Returns the
+    number of entries scheduled (or loaded, when waiting)."""
+    cache = compile_cache()
+    m = cache.manifest
+    if not cache.enable or m is None:
+        return 0
+    entries = [hx for hx, _meta in m.entries_mru()]
+    if not entries:
+        return 0
+
+    sched = None
+    if client is not None:
+        try:
+            sched = client._scheduler()
+        except Exception:   # noqa: BLE001 - warmup must never take down
+            sched = None    # boot; a mesh that cannot resolve loads inline
+
+    def load_all() -> int:
+        n = 0
+        for hx in entries:
+            if sched is not None:
+                from ..sched import CopTask
+                t = CopTask(fn=lambda hx=hx: cache.load_warm(hx),
+                            group=WARM_GROUP, weight=WARM_WEIGHT)
+                try:
+                    sched.submit(t)
+                    n += bool(t.wait())
+                except Exception:   # noqa: BLE001 - a full queue or a
+                    # stale entry skips that entry; warmup is best-effort
+                    continue
+            else:
+                n += bool(cache.load_warm(hx))
+        return n
+
+    if wait:
+        return load_all()
+    threading.Thread(target=load_all, name="copforge-warmup",
+                     daemon=True).start()
+    return len(entries)
+
+
+def maybe_warm_start(client) -> None:
+    """Idempotent boot hook (called from the session's sysvar plumb):
+    first statement after a cache directory is configured kicks the
+    background replay exactly once per (process, dir)."""
+    cache = compile_cache()
+    if not cache.enable or not cache.cache_dir:
+        return
+    with _WARM_MU:
+        if cache.cache_dir in _WARMED:
+            return
+        _WARMED.add(cache.cache_dir)
+    warm_start(client)
+
+
+def reset_warmed() -> None:
+    """Test/bench seam: forget which directories already replayed."""
+    with _WARM_MU:
+        _WARMED.clear()
+
+
+def simulate_restart() -> None:
+    """Restart-simulation seam (tests + the bench coldwarm rung): model
+    a process death without exiting — drop every in-process compiled
+    program (the spmd builder memos AND the warm executable pool) while
+    the cache directory survives.  A query served after this with zero
+    compiles proves the persisted path end to end."""
+    from ..parallel import spmd
+    spmd._cached.cache_clear()
+    spmd._cached_fused.cache_clear()
+    spmd._cached_fused_rows.cache_clear()
+    spmd._cached_batched.cache_clear()
+    spmd._cached_batched_rows.cache_clear()
+    compile_cache().clear_pool()
+    reset_warmed()
+
+
+__all__ = ["warm_start", "maybe_warm_start", "reset_warmed",
+           "simulate_restart", "WARM_GROUP", "WARM_WEIGHT"]

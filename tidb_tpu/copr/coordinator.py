@@ -47,7 +47,8 @@ class QueryHandle:
 
     __slots__ = ("conn_id", "sql", "started", "fragments", "_mu",
                  "sched_wait_ns", "sched_tasks", "sched_coalesced",
-                 "sched_fused", "sched_rus", "sched_retried", "degraded")
+                 "sched_fused", "sched_rus", "sched_retried", "degraded",
+                 "compile_ns", "compile_misses")
 
     def __init__(self, conn_id: int, sql: str):
         self.conn_id = conn_id
@@ -67,6 +68,11 @@ class QueryHandle:
                                    # tasks (EXPLAIN `retried`)
         self.degraded = 0          # cop dispatches served by the host
                                    # oracle after a launch quarantine
+        self.compile_ns = 0        # program resolve/compile time this
+                                   # statement's launches paid (copforge
+                                   # compile cache; the compile_wait_ms
+                                   # split out of schedWait)
+        self.compile_misses = 0    # launches that compiled (vs warm hit)
 
     def note_fragment(self, desc: str) -> None:
         with self._mu:
@@ -74,7 +80,8 @@ class QueryHandle:
 
     def note_sched(self, wait_ns: int, coalesced: int,
                    fused: int = 0, rus: float = 0.0,
-                   retried: int = 0) -> None:
+                   retried: int = 0, compile_ns: int = 0,
+                   compile_miss: bool = False) -> None:
         with self._mu:
             self.sched_wait_ns += int(wait_ns)
             self.sched_tasks += 1
@@ -84,6 +91,9 @@ class QueryHandle:
                 self.sched_fused += 1
             self.sched_rus += float(rus)
             self.sched_retried += int(retried)
+            self.compile_ns += int(compile_ns)
+            if compile_miss:
+                self.compile_misses += 1
 
     def note_degraded(self) -> None:
         with self._mu:

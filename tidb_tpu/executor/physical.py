@@ -360,6 +360,8 @@ class CopTaskExec(PhysOp):
         sched_r0 = handle.sched_rus if handle is not None else 0.0
         sched_t0 = handle.sched_retried if handle is not None else 0
         sched_d0 = handle.degraded if handle is not None else 0
+        sched_c0 = handle.compile_ns if handle is not None else 0
+        sched_m0 = handle.compile_misses if handle is not None else 0
         if self.as_of_ts is not None:
             snap = self.as_of_snap
             if snap is None:
@@ -394,7 +396,15 @@ class CopTaskExec(PhysOp):
             dw = handle.sched_wait_ns - sched_w0
             df = handle.sched_fused - sched_f0
             dr = handle.sched_rus - sched_r0
+            # copforge: where the schedWait went — a cold digest shows
+            # `compile: miss Nms`, a warm-pool/persisted-executable
+            # serve shows `compile: hit 0.000ms` (cache wins visible
+            # per statement, not just in /sched counters)
+            dc = handle.compile_ns - sched_c0
+            dm = handle.compile_misses - sched_m0
             self._rt_detail = (f"schedWait: {dw / 1e6:.3f}ms, "
+                               f"compile: {'miss' if dm else 'hit'} "
+                               f"{dc / 1e6:.3f}ms, "
                                f"fused: {df}, ru: {dr:.1f}")
             # launch supervision (faultline): transient re-launches the
             # drain paid, and whether the host oracle served this task

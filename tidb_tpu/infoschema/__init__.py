@@ -305,7 +305,8 @@ _INFORMATION_SCHEMA = {
     "STATEMENTS_SUMMARY": ([("DIGEST_TEXT", S), ("EXEC_COUNT", I),
                             ("AVG_LATENCY_MS", F), ("MAX_LATENCY_MS", F),
                             ("SUM_ROWS", I), ("QUERY_SAMPLE_TEXT", S),
-                            ("AVG_SCHED_WAIT_MS", F), ("AVG_RU", F)],
+                            ("AVG_SCHED_WAIT_MS", F),
+                            ("AVG_COMPILE_MS", F), ("AVG_RU", F)],
                            _stmt_summary),
     "VIEWS": ([("TABLE_CATALOG", S), ("TABLE_SCHEMA", S),
                ("TABLE_NAME", S), ("VIEW_DEFINITION", S),

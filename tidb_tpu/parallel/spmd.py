@@ -25,6 +25,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..analysis.lifetime import donation_plan, verify_donation
+from ..compilecache import cached_call
 from ..copr import dag as D
 from ..copr.aggregate import _MERGE
 from ..copr.exec import (DeviceBatch, _agg_partial_states, _exec_node,
@@ -147,6 +148,13 @@ class ShardedCopProgram:
         self._fn = jax.jit(shard_map(
             self._device_fn, mesh=mesh, in_specs=in_specs,
             out_specs=out_specs), donate_argnums=self._donate_argnums)
+        # copforge (compilecache): calls resolve through the AOT program
+        # cache — warm-pool/persisted executables serve without tracing,
+        # misses stage via jit.lower(...).compile() and persist.  The
+        # raw jit object stays on _fn for AOT introspection.
+        self._cached = cached_call(self._fn, dag_root, mesh, "solo",
+                                   row_capacity=row_capacity,
+                                   donate_argnums=self._donate_argnums)
 
     def _device_fn(self, cols, counts, aux):
         from ..copr.exec import set_trace_platform
@@ -185,7 +193,7 @@ class ShardedCopProgram:
                 raise OverflowError(
                     f"global capacity {s}x{c} exceeds the 2^31 limb-exact "
                     "SUM bound for in-program psum merge")
-        return self._fn(tuple(stacked_cols), counts, tuple(aux_cols))
+        return self._cached(tuple(stacked_cols), counts, tuple(aux_cols))
 
 
 @functools.lru_cache(maxsize=256)
@@ -256,6 +264,8 @@ class FusedCopProgram:
         self._fn = jax.jit(shard_map(
             self._device_fn, mesh=mesh, in_specs=in_specs,
             out_specs=out_specs), donate_argnums=self._donate_argnums)
+        self._cached = cached_call(self._fn, fused, mesh, "fused",
+                                   donate_argnums=self._donate_argnums)
 
     def _device_fn(self, cols, counts, aux):
         # each member re-traces its chain over the SAME input refs; XLA
@@ -270,7 +280,7 @@ class FusedCopProgram:
                 raise OverflowError(
                     f"global capacity {s}x{c} exceeds the 2^31 limb-exact "
                     "SUM bound for in-program psum merge")
-        return self._fn(tuple(stacked_cols), counts, tuple(aux_cols))
+        return self._cached(tuple(stacked_cols), counts, tuple(aux_cols))
 
 
 @functools.lru_cache(maxsize=64)
@@ -323,13 +333,18 @@ class FusedRowsProgram:
         self._fn = jax.jit(shard_map(
             self._device_fn, mesh=mesh, in_specs=in_specs,
             out_specs=out_specs), donate_argnums=self._donate_argnums)
+        # member output capacities live OUTSIDE the fused dag: they ride
+        # the key's extra slot so capacity variants never collide
+        self._cached = cached_call(self._fn, fused, mesh, "fused-rows",
+                                   donate_argnums=self._donate_argnums,
+                                   extra=tuple(row_capacities))
 
     def _device_fn(self, cols, counts, aux):
         return tuple(p._device_fn(cols, counts, aux)
                      for p in self.members)
 
     def __call__(self, stacked_cols: Sequence, counts, aux_cols=()):
-        return self._fn(tuple(stacked_cols), counts, tuple(aux_cols))
+        return self._cached(tuple(stacked_cols), counts, tuple(aux_cols))
 
 
 @functools.lru_cache(maxsize=64)
@@ -390,6 +405,9 @@ class BatchedCopProgram:
         self._fn = jax.jit(shard_map(fn, mesh=mesh, in_specs=in_specs,
                                      out_specs=P()),
                            donate_argnums=self._donate_argnums)
+        self._cached = cached_call(self._fn, dag_root, mesh, "batched",
+                                   n_slots=n_slots,
+                                   donate_argnums=self._donate_argnums)
 
     def __call__(self, cols_list: Sequence, counts_list: Sequence) -> list:
         k = len(cols_list)
@@ -400,7 +418,7 @@ class BatchedCopProgram:
                     f"global capacity {s}x{c} exceeds the 2^31 limb-exact "
                     "SUM bound for in-program psum merge")
         stacked, counts = _stack_slots(cols_list, counts_list, self.n_slots)
-        out = self._fn(tuple(stacked), counts, ())
+        out = self._cached(tuple(stacked), counts, ())
         return [jax.tree_util.tree_map(lambda a, i=i: a[i], out)
                 for i in range(k)]
 
@@ -450,11 +468,15 @@ class BatchedRowsProgram:
             fn, mesh=mesh, in_specs=in_specs,
             out_specs=(P(SHARD_AXIS), P(SHARD_AXIS))),
             donate_argnums=self._donate_argnums)
+        self._cached = cached_call(
+            self._fn, dag_root, mesh, "batched-rows",
+            row_capacity=row_capacity, n_slots=n_slots,
+            donate_argnums=self._donate_argnums)
 
     def __call__(self, cols_list: Sequence, counts_list: Sequence) -> list:
         k = len(cols_list)
         stacked, counts = _stack_slots(cols_list, counts_list, self.n_slots)
-        out_cols, out_counts = self._fn(tuple(stacked), counts, ())
+        out_cols, out_counts = self._cached(tuple(stacked), counts, ())
         # leaves: (D, K, cap) values / (D, K) counts -> per-slot (D, cap)
         return [([(v[:, i], m[:, i]) for v, m in out_cols],
                  out_counts[:, i]) for i in range(k)]

@@ -199,6 +199,16 @@ class DeviceScheduler:
         self.donated_launches = 0         # launches with donated inputs
         self.donated_tasks = 0            # tasks that requested donation
         self.donated_bytes = 0            # priced input bytes aliased out
+        # copforge compile-cache accounting (compilecache/): program
+        # resolve/compile time the drain paid, split out of schedWait
+        self.compile_ns_total = 0         # summed per-launch resolve time
+        self.warm_predicted = 0           # background fused-variant warms
+        self.warm_failures = 0            # predictions that failed to
+                                          # compile (never surfaced)
+        self._warm_alive = 0              # in-flight prediction threads
+        self._fusion_seen: dict = {}      # fusion key -> digest -> (dag,
+                                          # sds-args) for prediction
+        self._fusion_warmed: set = set()  # member-digest combos warmed
         # supervised-launch accounting (faultline)
         self.retried_launches = 0         # serve attempts re-run after a
                                           # transient launch failure
@@ -440,6 +450,10 @@ class DeviceScheduler:
                     target=self._loop, name="sched-drain", daemon=True)
                 self._thread.start()
             self._cv.notify_all()
+        if task.fusion_key is not None and task.key is not None:
+            # copforge: a second digest joining this fusion key predicts
+            # the fused variant — warm it off-thread (lock released)
+            self._predict_fusion(task)
         return task
 
     def pause(self) -> None:
@@ -736,6 +750,88 @@ class DeviceScheduler:
             self._account(batch)
 
     # ------------------------------------------------------------- #
+    # copforge (compilecache/): compile attribution + fusion warmup
+    # ------------------------------------------------------------- #
+
+    @staticmethod
+    def _cc_mark() -> tuple:
+        """Drain-thread snapshot of the compile cache's per-thread
+        resolve totals (ns, misses, hits) — deltas around a launch are
+        THIS launch's compile bill, uncontaminated by other threads."""
+        from ..compilecache import compile_cache
+        return compile_cache().thread_snapshot()
+
+    def _cc_note(self, tasks: list, mark: tuple) -> None:
+        """Attribute the resolve/compile time since ``mark`` to every
+        task of the launch BEFORE it finishes, so waiters always observe
+        it: this is the ``compile_wait_ms`` split out of schedWait — a
+        deduped rider that queued while the lead traced sees WHERE its
+        wait went (satellite: Avg_compile_ms in statements_summary)."""
+        from ..compilecache import compile_cache
+        ns, misses, _hits = compile_cache().thread_snapshot()
+        dns, dmiss = ns - mark[0], misses - mark[1]
+        if dns <= 0 and dmiss <= 0:
+            return
+        self.compile_ns_total += dns
+        for t in tasks:
+            t.compile_ns += dns
+            if dmiss:
+                t.compile_miss = True
+
+    def _predict_fusion(self, task) -> None:
+        """Async background warmup of predicted fusion variants: when a
+        second distinct program digest joins a fusion key, the fused
+        program for the combined member set is probably about to be
+        needed — compile it into the warm pool on a background thread
+        (bounded) so the first real fused arrival pays a pool hit, not
+        a trace.  Never on the drain thread, never surfaced on failure."""
+        from ..compilecache import compile_cache
+        if not self.fusion_enable or not compile_cache().enable:
+            return
+        from ..copr import dag as D
+        if not isinstance(task.dag, D.Aggregation):
+            return          # rows fusion capacities are waiter-owned
+        import jax
+        sds = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(
+                a.shape, a.dtype, sharding=getattr(a, "sharding", None)),
+            (tuple(task.cols), task.counts, ()))
+        with self._mu:
+            if len(self._fusion_seen) > 64:
+                self._fusion_seen.clear()
+            seen = self._fusion_seen.setdefault(task.fusion_key, {})
+            seen[task.key[0]] = (task.dag, sds)
+            if len(seen) < 2 or len(self._fusion_warmed) > 32 \
+                    or self._warm_alive >= 2:
+                return
+            combo = (task.fusion_key, frozenset(seen))
+            if combo in self._fusion_warmed:
+                return
+            self._fusion_warmed.add(combo)
+            members = [dag for dag, _s in seen.values()]
+            lead_sds = next(iter(seen.values()))[1]
+            self._warm_alive += 1
+        mesh = task.mesh
+
+        def warm():
+            try:
+                from ..parallel.spmd import get_fused_program
+                fused = D.FusedDag(tuple(members))
+                prog = get_fused_program(fused, mesh)
+                prog._cached.warm(lead_sds)
+                self.warm_predicted += 1
+            except Exception:   # noqa: BLE001 - prediction is a pure
+                # optimization: an unfusable combo or a backend refusal
+                # just means the real arrival compiles as before
+                self.warm_failures += 1
+            finally:
+                with self._mu:
+                    self._warm_alive -= 1
+
+        threading.Thread(target=warm, name="copforge-predict",
+                         daemon=True).start()
+
+    # ------------------------------------------------------------- #
     # launch supervision (faultline)
     # ------------------------------------------------------------- #
 
@@ -839,6 +935,11 @@ class DeviceScheduler:
         if len(subs) <= 1:
             for d in self._digests(live):
                 self.breaker.record_failure(d)
+                if self.breaker.state(d) == "OPEN":
+                    # copforge: an OPEN breaker must not warm-replay
+                    # after a restart — purge the digest's manifest
+                    # entries (no quarantine laundering)
+                    self._cc_quarantine(d, live)
             for t in live:
                 t.fail(err)
             return
@@ -848,6 +949,17 @@ class DeviceScheduler:
             # recursion bottoms out: a solo member that fails again
             # lands in the len(subs) <= 1 branch above
             self._serve_supervised(sub)
+
+    def _cc_quarantine(self, digest: int, live: list) -> None:
+        """Map the breaker's process-local digest to the restart-stable
+        one and purge it from the compile cache's warm manifest."""
+        from ..analysis.compilekey import stable_digest
+        from ..compilecache import compile_cache
+        for t in live:
+            if t.key is not None and t.key[0] == digest \
+                    and t.dag is not None:
+                compile_cache().quarantine(stable_digest(t.dag))
+                return
 
     # ------------------------------------------------------------- #
     # launch
@@ -905,6 +1017,7 @@ class DeviceScheduler:
                                      get_sharded_program)
         members = [grp[0] for grp in programs]
         lead = members[0]
+        cc0 = self._cc_mark()
         try:
             # the launch seam is consulted once PER MEMBER digest: a
             # poisoned member refuses the fused launch (caught below),
@@ -931,6 +1044,7 @@ class DeviceScheduler:
             return False    # refused groups launch apart below (same
                             # results, no fusion win)
         total = sum(len(grp) for grp in programs)
+        self._cc_note([t for grp in programs for t in grp], cc0)
         for grp, out in zip(programs, outs):
             sprog = get_sharded_program(grp[0].dag, grp[0].mesh,
                                         grp[0].row_capacity)
@@ -956,6 +1070,7 @@ class DeviceScheduler:
                                      get_batched_rows_program,
                                      get_sharded_program)
         digest = lead.key[0] if lead.key is not None else None
+        cc0 = self._cc_mark()
         _faults.check("build", digest)
         prog = get_sharded_program(lead.dag, lead.mesh, lead.row_capacity,
                                    donate=lead.donate)
@@ -983,6 +1098,7 @@ class DeviceScheduler:
                         lead.dag, lead.mesh, lead.row_capacity, len(slots))
                 outs = bprog([s[0].cols for s in slots],
                              [s[0].counts for s in slots])
+                self._cc_note(batch, cc0)
                 for s, out in zip(slots, outs):
                     for t in s:
                         t.finish((prog, out))
@@ -1002,6 +1118,9 @@ class DeviceScheduler:
                             # apart below (same results, no batching win)
         for s in slots:
             out = prog(s[0].cols, s[0].counts, s[0].aux)
+            # cumulative from the group's entry: a later slot DID wait
+            # on the earlier slots' (and the lead's) resolve/compile
+            self._cc_note(s, cc0)
             for t in s:
                 t.finish((prog, out))
             self.launches += 1
@@ -1104,6 +1223,11 @@ class DeviceScheduler:
                 "donated_launches": self.donated_launches,
                 "donated_tasks": self.donated_tasks,
                 "donated_bytes": self.donated_bytes,
+                # copforge (compilecache/): drain-paid resolve time +
+                # predicted-fusion background warms
+                "compile_ms_total": round(self.compile_ns_total / 1e6, 3),
+                "warm_predicted": self.warm_predicted,
+                "warm_failures": self.warm_failures,
                 # launch supervision (faultline): retry/bisect/breaker
                 "retried_launches": self.retried_launches,
                 "retried_tasks": self.retried_tasks,

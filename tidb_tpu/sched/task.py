@@ -112,7 +112,8 @@ class CopTask:
                  "submit_ns", "start_ns", "wait_ns", "coalesced", "fused",
                  "fusion_key", "cancelled", "_done", "_value", "_exc",
                  "est_rows", "cost", "rc_group", "rus", "rus_charged",
-                 "device_ns", "deadline_ns", "donate", "retries")
+                 "device_ns", "deadline_ns", "donate", "retries",
+                 "compile_ns", "compile_miss")
 
     def __init__(self, *, key=None, dag=None, mesh=None, row_capacity=0,
                  cols=None, counts=None, aux=(), input_token=None,
@@ -152,6 +153,9 @@ class CopTask:
         self.deadline_ns = 0      # rc max-queue deadline (0 = none)
         self.donate = bool(donate)  # launch-unique inputs: donate them
         self.retries = 0          # transient-failure re-launches (drain)
+        self.compile_ns = 0       # program resolve/compile time this
+                                  # task's launch paid (copforge; 0 = warm)
+        self.compile_miss = False  # launch compiled (vs warm-pool hit)
         self.cancelled = False
         self._done = threading.Event()
         self._value = None

@@ -425,7 +425,8 @@ class Session:
                 cpu_ns=time.thread_time_ns() - cpu0,
                 plan_text=self._last_plan_text,
                 sched_wait_ns=handle.sched_wait_ns,
-                rus=handle.sched_rus)
+                rus=handle.sched_rus,
+                compile_ns=handle.compile_ns)
             try:
                 # runaway KILL must fire before the success audit hook:
                 # a killed statement is an error to the client
@@ -1160,6 +1161,21 @@ class Session:
         if v10 is not None:
             from ..faults import install_spec
             install_spec(str(v10))
+        # copforge AOT compile cache (compilecache/): enable/dir/pool
+        # knobs, then the idempotent boot warm-start hook — the first
+        # statement after a cache dir lands kicks the background
+        # manifest replay through the admission queue at LOW priority
+        v11 = merged.get("tidb_tpu_compile_cache")
+        v12 = merged.get("tidb_tpu_compile_cache_dir")
+        v13 = merged.get("tidb_tpu_compile_warm_pool")
+        from ..compilecache import configure as cc_configure
+        from ..compilecache import maybe_warm_start
+        cc_configure(
+            enable=None if v11 is None or v11 == "" else bool(int(v11)),
+            cache_dir=None if v12 is None or v12 == "" else str(v12),
+            pool_bytes=None if v13 is None or v13 == "" or int(v13) < 0
+            else int(v13))
+        maybe_warm_start(client)
         return ExecContext(client, merged,
                            mem_tracker=Tracker("query", quota))
 
@@ -2385,7 +2401,7 @@ class Session:
             return ResultSet(
                 ["Digest_text", "Exec_count", "Avg_latency_ms",
                  "Max_latency_ms", "Sum_rows", "Sample_sql",
-                 "Avg_sched_wait_ms", "Avg_ru"],
+                 "Avg_sched_wait_ms", "Avg_compile_ms", "Avg_ru"],
                 self.domain.stmt_summary.summary_rows())
         if stmt.kind == "slow_queries":
             return ResultSet(["Query", "Latency_ms", "Rows"],

@@ -83,6 +83,16 @@ _VARS = [
     _v("tidb_tpu_sched_host_fallback", 1, kind="bool",
        scope=SCOPE_GLOBAL),
     _v("tidb_tpu_faults", "", kind="str", scope=SCOPE_GLOBAL),
+    # copforge AOT compile cache (compilecache/): cacheable device
+    # programs resolve through a warm executable pool; with a cache dir
+    # set, compiled executables persist across restarts (digest + mesh
+    # fingerprint + donation-plan keyed) and the boot warm pool replays
+    # the hot-program manifest at LOW priority.  warm_pool caps the
+    # pool/manifest in BYTES (-1 = engine default, 0 = unbounded).
+    _v("tidb_tpu_compile_cache", 1, kind="bool", scope=SCOPE_GLOBAL),
+    _v("tidb_tpu_compile_cache_dir", "", kind="str", scope=SCOPE_GLOBAL),
+    _v("tidb_tpu_compile_warm_pool", -1, kind="int", min=-1,
+       scope=SCOPE_GLOBAL),
     _v("tidb_distsql_scan_concurrency", 15, kind="int", min=1, max=256),
     _v("tidb_max_chunk_size", 1024, kind="int", min=32, max=65536),
     _v("tidb_enable_vectorized_expression", 1, kind="bool"),

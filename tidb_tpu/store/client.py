@@ -242,21 +242,26 @@ class CopClient:
             rc = {"result_cache_hits": self.result_cache_hits,
                   "result_cache_misses": self.result_cache_misses}
         client = {**self._client_stats(), **rc}
+        from ..compilecache import compile_cache
+        cc = {"compile_cache": compile_cache().stats()}
         if self._sched_obj is None:
             return {"enabled": self.sched_enable, "started": False,
-                    "client": client}
+                    "client": client, **cc}
         return {"enabled": self.sched_enable, "started": True,
-                "client": client, **self._sched_obj.stats()}
+                "client": client, **cc, **self._sched_obj.stats()}
 
     def _note_sched(self, task) -> None:
         from ..copr.coordinator import QUERY_HANDLE
         h = QUERY_HANDLE.get()
         if h is not None:
-            # rus_charged is set at batch admission (before finish), so
-            # the waiter always observes it; device_ns is attributed
-            # post-serve and stays a scheduler-side stat
+            # rus_charged is set at batch admission (before finish) and
+            # compile_ns/compile_miss before finish too, so the waiter
+            # always observes them; device_ns is attributed post-serve
+            # and stays a scheduler-side stat
             h.note_sched(task.wait_ns, task.coalesced, task.fused,
-                         rus=task.rus_charged, retried=task.retries)
+                         rus=task.rus_charged, retried=task.retries,
+                         compile_ns=task.compile_ns,
+                         compile_miss=task.compile_miss)
 
     def _launch(self, dag, cols, counts, aux, row_capacity: int = 0,
                 donate: bool = False):
@@ -483,6 +488,18 @@ class CopClient:
         return CopResult(agg_cols, key_cols)
 
     @staticmethod
+    def _warm_cap(dag, needed: int) -> int:
+        """copforge regrow/paging re-entry seam: prefer a capacity the
+        warm program pool (or the persisted manifest) already compiled
+        for this plan FAMILY over the minimal pow2 step — re-entering
+        at a warm capacity serves from the pool instead of re-tracing.
+        Bounded (<= 4x need) so a warm-but-huge buffer never wins."""
+        from ..analysis.compilekey import family_digest
+        from ..compilecache import compile_cache
+        warm = compile_cache().warm_capacity(family_digest(dag), needed)
+        return warm if warm is not None else needed
+
+    @staticmethod
     def _with_capacity(agg: D.Aggregation, cap: int) -> D.Aggregation:
         """Rebuild a host-merged aggregation with a new per-device group
         table capacity: SORT sizes group_capacity directly, SEGMENT its
@@ -494,7 +511,8 @@ class CopClient:
         return dataclasses.replace(agg, group_capacity=cap)
 
     def _stream_sort_agg(self, agg, batches, key_meta) -> CopResult:
-        cap = agg.state_capacity or DEFAULT_GROUP_CAPACITY
+        cap = self._warm_cap(agg, agg.state_capacity
+                             or DEFAULT_GROUP_CAPACITY)
         per_dev_all = []
         for b in batches:
             cols, counts = b.device_put_uncached(self.mesh)
@@ -505,7 +523,7 @@ class CopClient:
                 true_ng = int(np.max(np.asarray(states["__ngroups__"])))
                 if true_ng <= cap:
                     break
-                cap = _pow2_at_least(true_ng)
+                cap = self._warm_cap(agg, _pow2_at_least(true_ng))
             else:
                 raise RuntimeError("group-capacity regrow did not converge")
             per_dev_all.extend(self._split_devices(states))
@@ -560,7 +578,8 @@ class CopClient:
         segment-reduce group tables, regrown when a device sees more
         distinct groups than capacity (the paging grow-from-min analog),
         then host final merge."""
-        cap = agg.state_capacity or DEFAULT_GROUP_CAPACITY
+        cap = self._warm_cap(agg, agg.state_capacity
+                             or DEFAULT_GROUP_CAPACITY)
         for _ in range(10):
             sized = self._with_capacity(agg, cap)
             prog, out = self._launch(sized, cols, counts, tuple(aux_cols))
@@ -575,7 +594,7 @@ class CopClient:
             if true_ng <= cap:
                 sized = self._with_capacity(agg, cap)
                 break
-            cap = _pow2_at_least(true_ng)
+            cap = self._warm_cap(agg, _pow2_at_least(true_ng))
         else:
             raise RuntimeError("group-capacity regrow did not converge")
         per_dev = self._split_devices(states)
@@ -788,6 +807,10 @@ class CopClient:
             else:
                 cap = max(_pow2_at_least(
                     max(per_shard // INITIAL_SELECTIVITY, 1)), 1024)
+            # copforge: a capacity the warm pool already compiled beats
+            # the feedback guess — the paging loop's first launch hits
+            # the pool instead of tracing a nearby-but-cold capacity
+            cap = self._warm_cap(root, cap)
 
         cols, counts = snap.device_cols(self.mesh)
         page_iters = 0       # published once, under _stat_mu, at the end
@@ -805,7 +828,7 @@ class CopClient:
             out_counts = np.asarray(jax.device_get(out_counts))
             if is_topn or is_limit or (out_counts <= cap).all():
                 break
-            cap = _pow2_at_least(int(out_counts.max()))
+            cap = self._warm_cap(root, _pow2_at_least(int(out_counts.max())))
         else:
             raise RuntimeError("paging loop did not converge")
         with self._stat_mu:

@@ -93,6 +93,11 @@ class StmtStats:
     # priced request units this digest's device work debited (rc/):
     # fused launches attribute per member, shared scan priced once
     sum_rus: float = 0.0
+    # program resolve/compile time the digest's launches paid (copforge
+    # compile cache): the compile_wait_ms split out of schedWait, so a
+    # cache win shows up as Avg_compile_ms -> ~0 while Avg_sched_wait_ms
+    # keeps the queueing story
+    sum_compile_ns: int = 0
 
     @property
     def avg_latency_ms(self) -> float:
@@ -105,6 +110,10 @@ class StmtStats:
     @property
     def avg_ru(self) -> float:
         return self.sum_rus / max(self.exec_count, 1)
+
+    @property
+    def avg_compile_ms(self) -> float:
+        return self.sum_compile_ns / max(self.exec_count, 1) / 1e6
 
 
 @dataclass
@@ -127,7 +136,8 @@ class StmtSummary:
 
     def record(self, sql: str, latency_ns: int, rows: int,
                cpu_ns: int = 0, plan_text: str = "",
-               sched_wait_ns: int = 0, rus: float = 0.0):
+               sched_wait_ns: int = 0, rus: float = 0.0,
+               compile_ns: int = 0):
         digest = normalize_sql(sql)
         now = time.time()
         with self._lock:
@@ -143,6 +153,7 @@ class StmtSummary:
             st.sum_cpu_ns += int(cpu_ns)
             st.sum_sched_wait_ns += int(sched_wait_ns)
             st.sum_rus += float(rus)
+            st.sum_compile_ns += int(compile_ns)
             if plan_text:
                 import hashlib
                 st.plan_digest = hashlib.sha256(
@@ -158,7 +169,7 @@ class StmtSummary:
             return [(s.digest, s.exec_count, round(s.avg_latency_ms, 3),
                      round(s.max_latency_ns / 1e6, 3), s.sum_rows,
                      s.sample_sql, round(s.avg_sched_wait_ms, 3),
-                     round(s.avg_ru, 2))
+                     round(s.avg_compile_ms, 3), round(s.avg_ru, 2))
                     for s in sorted(self._stats.values(),
                                     key=lambda x: -x.sum_latency_ns)]
 
