@@ -142,7 +142,7 @@ def orchestrate():
     except OSError:
         pass
     rc, _ = _run_child({"BENCH_MODE": "sched", "JAX_PLATFORMS": "cpu"},
-                       420, "sched-concurrent")
+                       900, "sched-concurrent")
     if rc != 0:
         log("sched-concurrent child failed; omitting scenario")
 
@@ -490,6 +490,7 @@ def mode_sched():
     out["rc"] = _sched_rc_scenario(dom, s, sched, queries[0])
     out["chaos"] = _sched_chaos_scenario(dom, s, sched, queries)
     out["coldwarm"] = _sched_coldwarm_scenario(dom, sched)
+    out["stress"] = _sched_stress_scenario()
     log("sched-concurrent:", json.dumps(out))
     os.makedirs(DATA_DIR, exist_ok=True)
     with open(SCHED_PATH, "w") as f:
@@ -662,6 +663,25 @@ def _sched_chaos_scenario(dom, s, sched, queries):
     finally:
         faults.clear()
         sched.breaker.reset()
+
+
+def _sched_stress_scenario():
+    """stress rung (copmeter, ISSUE 10): ~1k open-loop concurrent
+    sessions over a mixed corpus (dense/SORT/SEGMENT/rows/shuffle)
+    across 4 resource groups with the PR 8 chaos plane armed — p50/p99
+    sched wait, fusion rate, RU fairness (max/min per-group completion
+    ratio), completion rate, and calibrated-pricing error land as
+    first-class BENCH JSON metrics.  Own Domain/tables; the process-
+    wide per-mesh scheduler is shared with the rungs above, so deltas
+    are taken inside the harness."""
+    from tidb_tpu.testing.stress import (build_stress_domain,
+                                         run_stress_harness)
+    n = int(os.environ.get("BENCH_STRESS_SESSIONS", "1000"))
+    rate = float(os.environ.get("BENCH_STRESS_RATE", "400"))
+    dom, _s = build_stress_domain(n_rows=60_000)
+    out = run_stress_harness(dom, n_sessions=n, rate_per_s=rate)
+    log("stress:", json.dumps(out))
+    return out
 
 
 def _sched_coldwarm_scenario(dom, sched):

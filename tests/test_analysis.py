@@ -89,10 +89,12 @@ def test_explain_reports_contract_ok(corpus):
     s, _plans = corpus
     rows = s.must_query(
         "explain select count(*) from lineitem where l_quantity < 5")
-    # footer order: contract verdict, then the static cost estimate
-    assert rows[-2][0] == "contract: ok", rows
-    assert rows[-1][0].startswith("est. device bytes: "), rows
-    assert "padding" in rows[-1][0], rows
+    # footer order: contract verdict, the static cost estimate, then
+    # the calibration verdict (copmeter, ISSUE 10)
+    assert rows[-3][0] == "contract: ok", rows
+    assert rows[-2][0].startswith("est. device bytes: "), rows
+    assert "padding" in rows[-2][0], rows
+    assert rows[-1][0].startswith("cost: "), rows
 
 
 # ------------------------------------------------------------------ #

@@ -40,6 +40,26 @@ def test_gate_prices_every_corpus_plan():
         proc.stdout
 
 
+def test_gate_calibration_pass_converges():
+    """ISSUE 10 acceptance: the gate's calibration pass — a
+    deterministic closed-loop drift simulation over the real corpus
+    costs — must land EVERY device-bearing plan under the 25%
+    calibrated pricing error target."""
+    proc = _run_gate()
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "calibration:" in proc.stdout, proc.stdout
+    tail = proc.stdout.split("calibration:")[1]
+    assert "20/20 corpus plans calibrated under 25%" in tail, proc.stdout
+    assert "0 violations" in tail, proc.stdout
+
+
+def test_calibration_report_prints_per_query_table():
+    proc = _run_gate("--calibration-report")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "calibrated pricing error" in proc.stdout, proc.stdout
+    assert "drift" in proc.stdout and "calib" in proc.stdout
+
+
 def test_check_baseline_passes():
     """Baseline hygiene (ISSUE 4 satellite, re-pinned by ISSUE 7):
     every accepted-findings entry must still match a current finding,

@@ -58,6 +58,19 @@ def _scalar_agg(cutoff=3):
         D.GroupStrategy.SCALAR)
 
 
+def _drain_predictions(timeout_s=10.0):
+    """Wait out in-flight copforge-predict background compiles from
+    EARLIER tests: a late-arriving predicted-fusion warm would land
+    inside this test's miss-counter snapshot window."""
+    import time as _time
+
+    from tidb_tpu.sched.scheduler import _REGISTRY
+    deadline = _time.monotonic() + timeout_s
+    for sched in list(_REGISTRY.values()):
+        while sched._warm_alive and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+
+
 @pytest.fixture()
 def cache_dir(tmp_path):
     """Fresh cache dir for one test; restores the prior config after."""
@@ -66,6 +79,7 @@ def cache_dir(tmp_path):
     configure(enable=True, cache_dir=str(tmp_path),
               pool_bytes=None)
     reset_warmed()
+    _drain_predictions()
     yield str(tmp_path)
     simulate_restart()
     cc.configure(enable=old[0], cache_dir=old[1])

@@ -25,6 +25,14 @@ Three passes, all wired into CI as a zero-findings gate
   COST-UNBOUNDED ride the corpus;
   sched admission enforces peak_hbm_bytes against a per-mesh budget
   (CostError, pre-trace) and EXPLAIN surfaces the estimate.
+- copmeter (analysis/calibrate): the closed-loop half of the cost
+  model — a bounded per-digest EWMA correction store (clamped to
+  [1/8, 8], persisted through the copforge manifest) corrects
+  LaunchCost from measured launch times and OOM events; the scheduler
+  feeds corrected costs into RU pricing, HBM-budget admission, fusion
+  caps, the micro-batch window, and deadline-aware early shedding.
+  The gate grows a calibration pass (deterministic drift simulation,
+  < 25% corpus pricing error) and the TPU-CALIB-CLAMP lint rule.
 - coplife (analysis/lifetime): a buffer-lifetime pass over the same
   contract DAGs classifying every device-program input slot as
   PERSISTENT (snapshot-cache residents) / LOOP-CARRIED (paging and
@@ -43,6 +51,8 @@ surprise recompile) or returns wrong rows.  Compiler-first engines
 gate between planner/build and jit.
 """
 
+from .calibrate import (BoundedLRU, Correction, CorrectionStore,
+                        clamp_factor, correction_store)
 from .contracts import (PlanContractError, verify_dag, verify_plan,
                         verify_task)
 from .copcost import CostError, LaunchCost, plan_cost, task_cost
@@ -54,4 +64,6 @@ __all__ = ["PlanContractError", "verify_plan", "verify_dag", "verify_task",
            "CostError", "LaunchCost", "plan_cost", "task_cost",
            "BufferClass", "DonationError", "DonationPlan",
            "donation_plan", "verify_donation",
+           "BoundedLRU", "Correction", "CorrectionStore",
+           "correction_store", "clamp_factor",
            "Finding", "lint_tree", "lint_source", "load_baseline"]

@@ -20,6 +20,13 @@ Runs three static passes and exits non-zero on any NEW finding:
    RU value strictly above the per-task floor — guards pricing-model
    rot (a weight edit that zeroes or NaNs the terms) the same way
    --check-baseline guards waiver rot.
+5. Closed-loop calibration (analysis/calibrate) over the same corpus:
+   a deterministic simulation drifts each plan's true launch time
+   across the clamp range and feeds measurements back through a fresh
+   CorrectionStore; the calibrated model must land within
+   CALIB_TARGET_ERR (< 25%) of the drifted truth on EVERY plan —
+   guards the feedback loop (EWMA step, clamp, prediction terms) the
+   way the pricing pass guards the static weights.
 
 Flags:
     --lint-only / --contracts-only   run one pass
@@ -36,6 +43,11 @@ Flags:
     --cache-report                   print the per-corpus-query compile
                                      cache key/variant/bytes table
                                      (analysis/compilekey) and exit
+    --calibration-report             print the per-corpus-query
+                                     closed-loop calibration table
+                                     (static vs calibrated pricing
+                                     error, analysis/calibrate) and
+                                     exit
 """
 
 from __future__ import annotations
@@ -157,6 +169,28 @@ def _run_pricing(plans) -> int:
     return 1 if bad else 0
 
 
+def _run_calibration(plans) -> int:
+    """Closed-loop convergence gate (copmeter, ISSUE 10 acceptance):
+    after the deterministic drift simulation, EVERY device-bearing
+    corpus plan's calibrated pricing error must land under
+    CALIB_TARGET_ERR — a broken EWMA step, clamp, or prediction term
+    fails here before it misprices a real deployment."""
+    from .calibrate import CALIB_TARGET_ERR, simulate_corpus_calibration
+    rows = simulate_corpus_calibration(plans, n_devices=GATE_DEVICES)
+    bad = [(qid, sql, cerr) for qid, sql, _d, _s, cerr in rows
+           if cerr >= CALIB_TARGET_ERR]
+    for qid, sql, cerr in bad:
+        print(f"CALIBRATION {qid} error {cerr:.1%} >= "
+              f"{CALIB_TARGET_ERR:.0%} ({sql[:60]})")
+    mean = sum(r[4] for r in rows) / len(rows) if rows else 0.0
+    worst = max((r[4] for r in rows), default=0.0)
+    print(f"calibration: {len(rows) - len(bad)}/{len(rows)} corpus "
+          f"plans calibrated under {CALIB_TARGET_ERR:.0%} pricing "
+          f"error (mean {mean:.1%}, max {worst:.1%}), "
+          f"{len(bad)} violations")
+    return 1 if bad else 0
+
+
 def _run_contracts(plans) -> int:
     from ..testing.tpch import TPCH_PLAN_QUERIES, TPCH_SHUFFLE_QUERIES
     from .contracts import PlanContractError, verify_plan
@@ -195,6 +229,10 @@ def main(argv=None) -> int:
         from .compilekey import cache_report
         print(cache_report(_corpus_plans(), n_devices=GATE_DEVICES))
         return 0
+    if "--calibration-report" in argv:
+        from .calibrate import calibration_report
+        print(calibration_report(_corpus_plans(), n_devices=GATE_DEVICES))
+        return 0
     if check_baseline:
         # hygiene pass: waivers must not rot silently — every baseline
         # entry must still match a current finding (full gather, so the
@@ -217,6 +255,7 @@ def main(argv=None) -> int:
     if not lint_only:
         rc |= _run_contracts(plans)
         rc |= _run_pricing(plans)
+        rc |= _run_calibration(plans)
     if rc == 0:
         print("analysis gate: ok")
     return rc

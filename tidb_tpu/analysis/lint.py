@@ -65,6 +65,16 @@ Rules
                    the statically verified analysis/lifetime
                    DonationPlan — a hand-written literal silently
                    deletes snapshot residents or regrow inputs.
+- TPU-CALIB-CLAMP  a multiplication by a measured cost-correction
+                   factor (``time_factor`` / ``mem_factor`` /
+                   ``*correction_factor*``) in a function that never
+                   references the clamp (``clamp_factor`` /
+                   ``CALIB_CLAMP_*`` — analysis/calibrate): measured
+                   feedback may BEND the static LaunchCost model,
+                   never replace it — an unclamped factor lets one bad
+                   measurement starve or flood admission, pricing, and
+                   the HBM budget.  Applies repo-wide (any module may
+                   grow a calibration consumer).
 - TPU-COMPILE-KEY  a serialize/deserialize/cache-write seam in
                    compilecache/ whose enclosing function does not
                    reference the persistent-key triple — a ``digest``
@@ -122,6 +132,10 @@ LOCK_MODULES = {
     # copforge (ISSUE 9): the cache/manifest leaf locks run under the
     # drain (resolve at launch) and the submit path (fusion prediction)
     "compilecache/cache.py", "compilecache/manifest.py",
+    # copmeter (ISSUE 10): the correction store / BoundedLRU leaf locks
+    # run under the drain's condition lock (window + attribution) and
+    # the submit path (corrected admission, shedding)
+    "analysis/calibrate.py",
 }
 
 # modules whose retry/re-dispatch loops must spend a typed Backoffer
@@ -143,6 +157,13 @@ _KEY_TRIPLE = (("digest", re.compile(r"digest")),
 
 _DIGEST_NAME = re.compile(r"key|digest|token|fingerprint|signature",
                           re.IGNORECASE)
+
+# measured cost-correction factors (analysis/calibrate): multiplying a
+# LaunchCost term by one of these without referencing the clamp is
+# unbounded feedback (TPU-CALIB-CLAMP)
+_CALIB_FACTOR = re.compile(r"^(time_factor|mem_factor)$"
+                           r"|correction_factor")
+_CLAMP_REF = re.compile(r"clamp", re.IGNORECASE)
 
 # jnp creation calls whose result dtype rides the x64 flag when no dtype
 # is given, and the positional slot (0-based) a dtype may occupy.  -1 =
@@ -273,6 +294,7 @@ class _ExprRules(_Scoped):
         self.psum_fenced = psum_fenced
         self._digest_fn = 0     # depth of digest-context functions
         self._sorted_ok: set = set()   # dict-iter calls under sorted()
+        self._fn_nodes: list = []      # enclosing function AST nodes
 
     def visit_FunctionDef(self, node):
         # plain collection accessors named `keys`/`values`/`items` are
@@ -280,7 +302,9 @@ class _ExprRules(_Scoped):
         bump = bool(_DIGEST_NAME.search(node.name)
                     and node.name not in ("keys", "values", "items"))
         self._digest_fn += bump
+        self._fn_nodes.append(node)
         super().visit_FunctionDef(node)
+        self._fn_nodes.pop()
         self._digest_fn -= bump
 
     visit_AsyncFunctionDef = visit_FunctionDef
@@ -431,6 +455,49 @@ class _ExprRules(_Scoped):
                          "DonationPlan-derived symbol; route donation "
                          "through analysis/lifetime so the slot "
                          "lifetimes are verified pre-trace")
+
+    # -- TPU-CALIB-CLAMP: unclamped measured-correction feedback ------- #
+
+    @staticmethod
+    def _refs_calib_factor(node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and _CALIB_FACTOR.search(sub.id):
+                return True
+            if isinstance(sub, ast.Attribute) \
+                    and _CALIB_FACTOR.search(sub.attr):
+                return True
+        return False
+
+    def _check_calib_clamp(self, node: ast.AST) -> None:
+        scope = self._fn_nodes[-1] if self._fn_nodes else node
+        for sub in ast.walk(scope):
+            name = None
+            if isinstance(sub, ast.Name):
+                name = sub.id
+            elif isinstance(sub, ast.Attribute):
+                name = sub.attr
+            if name is not None and _CLAMP_REF.search(name):
+                return
+        self.add("TPU-CALIB-CLAMP", node,
+                 "multiplies by a measured cost-correction factor "
+                 "without referencing the clamp (clamp_factor / "
+                 "CALIB_CLAMP_MIN/MAX, analysis/calibrate): unclamped "
+                 "feedback lets one bad measurement starve or flood "
+                 "admission — clamp every factor to [1/8, 8]")
+
+    def visit_BinOp(self, node):
+        if isinstance(node.op, ast.Mult) and (
+                self._refs_calib_factor(node.left)
+                or self._refs_calib_factor(node.right)):
+            self._check_calib_clamp(node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        if isinstance(node.op, ast.Mult) and (
+                self._refs_calib_factor(node.value)
+                or self._refs_calib_factor(node.target)):
+            self._check_calib_clamp(node)
+        self.generic_visit(node)
 
     def visit_While(self, node):
         # TPU-RETRY-BUDGET: a `while True:` re-dispatch loop in the

@@ -189,12 +189,15 @@ class CompileCache:
         """The circuit breaker opened on this (stable) dag digest: purge
         its manifest entries and refuse new records, so a poisoned
         program cannot launder its quarantine through a restart's warm
-        replay."""
+        replay.  Its live cost corrections (analysis/calibrate) drop
+        too — the manifest purge removes the persisted twin."""
         with self._mu:
             self._quarantined.add(digest)
         m = self.manifest
         if m is not None:
             m.purge_digest(digest)
+        from ..analysis.calibrate import correction_store
+        correction_store().purge(digest)
 
     def quarantine_report(self) -> dict:
         """Chaos-rung assertion surface: quarantined digests must have

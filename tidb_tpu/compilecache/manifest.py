@@ -41,6 +41,10 @@ class WarmManifest:
         self.cap_bytes = cap_bytes
         self._mu = threading.Lock()
         self._entries: dict[str, dict] = {}       # entry_hex -> meta
+        # copmeter (analysis/calibrate): per-digest measured cost
+        # corrections ride the same file, so calibration survives
+        # restarts exactly as far as the programs it describes
+        self._calib: dict[str, dict] = {}         # stable digest -> payload
         self.evictions = 0
         self._load()
 
@@ -55,8 +59,10 @@ class WarmManifest:
                 doc = json.load(f)
             if doc.get("version") == MANIFEST_VERSION:
                 self._entries = dict(doc.get("entries", {}))
+                self._calib = dict(doc.get("calibration", {}))
         except (OSError, ValueError):
             self._entries = {}
+            self._calib = {}
 
     def _save_locked(self) -> None:
         try:
@@ -64,7 +70,8 @@ class WarmManifest:
             tmp = self._path() + ".tmp"
             with open(tmp, "w", encoding="utf-8") as f:
                 json.dump({"version": MANIFEST_VERSION,
-                           "entries": self._entries}, f)
+                           "entries": self._entries,
+                           "calibration": self._calib}, f)
             os.replace(tmp, self._path())
         except OSError:
             pass          # manifest is an optimization, never a failure
@@ -102,15 +109,34 @@ class WarmManifest:
                     e["load_ms"] = round(float(load_ms), 3)
 
     def purge_digest(self, digest: str) -> int:
-        """Drop (and unlink) every entry of a quarantined digest."""
+        """Drop (and unlink) every entry of a quarantined digest — and
+        its persisted cost corrections (analysis/calibrate): measured
+        feedback from a poisoned program must not launder through a
+        restart any more than its executable may."""
         with self._mu:
             doomed = [hx for hx, e in sorted(self._entries.items())
                       if e.get("digest") == digest]
             for hx in doomed:
                 self._drop_locked(hx)
-            if doomed:
+            purged_calib = self._calib.pop(digest, None) is not None
+            if doomed or purged_calib:
                 self._save_locked()
             return len(doomed)
+
+    # ---- calibration persistence (analysis/calibrate) ---------------- #
+
+    def save_calibration(self, entries: dict) -> None:
+        """Persist the correction store's per-digest payloads (keyed by
+        the restart-stable dag digest — the same digest field the
+        entries above carry and purge_digest matches on)."""
+        with self._mu:
+            self._calib = {str(d): dict(p)
+                           for d, p in sorted(entries.items())}
+            self._save_locked()
+
+    def load_calibration(self) -> dict:
+        with self._mu:
+            return {d: dict(p) for d, p in self._calib.items()}
 
     def _drop_locked(self, entry_hex: str) -> None:
         self._entries.pop(entry_hex, None)
@@ -163,7 +189,8 @@ class WarmManifest:
                     "bytes": sum(e.get("bytes", 0)
                                  for e in self._entries.values()),
                     "cap_bytes": self.cap_bytes,
-                    "evictions": self.evictions}
+                    "evictions": self.evictions,
+                    "calibration_entries": len(self._calib)}
 
 
 __all__ = ["WarmManifest", "MANIFEST_NAME", "MANIFEST_VERSION",

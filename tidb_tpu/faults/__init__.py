@@ -6,11 +6,12 @@ for the design."""
 from .breaker import (CLOSED, HALF_OPEN, OPEN, CircuitBreaker,
                       LaunchQuarantinedError, digest_hex)
 from .plan import (SEAMS, FaultPlan, FaultRule, InjectedFault,
-                   PoisonFault, TransientFault, active, check, clear,
-                   install, install_spec, stats)
+                   MemoryFault, PoisonFault, TransientFault, active,
+                   check, clear, install, install_spec, is_oom_error,
+                   stats)
 
 __all__ = ["FaultPlan", "FaultRule", "InjectedFault", "TransientFault",
-           "PoisonFault", "SEAMS", "install", "install_spec", "clear",
-           "active", "check", "stats", "CircuitBreaker",
-           "LaunchQuarantinedError", "digest_hex", "CLOSED", "OPEN",
-           "HALF_OPEN"]
+           "PoisonFault", "MemoryFault", "is_oom_error", "SEAMS",
+           "install", "install_spec", "clear", "active", "check",
+           "stats", "CircuitBreaker", "LaunchQuarantinedError",
+           "digest_hex", "CLOSED", "OPEN", "HALF_OPEN"]

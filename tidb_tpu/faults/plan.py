@@ -30,6 +30,17 @@ Fault kinds:
 - ``poison``    deterministic per (seed, seam, key): every retry of the
                 same program fails again — retrying never helps, the
                 per-digest circuit breaker is the only way out.
+- ``oom``       memory-exhaustion class (XLA RESOURCE_EXHAUSTED /
+                device OOM): decided per (seed, seam, key, attempt)
+                like ``transient`` — a re-sized or solo retry may fit —
+                but classified apart by the supervised drain: an OOM
+                bumps the digest's memory correction
+                (analysis/calibrate), demuxes fused launches to reduce
+                width, and NEVER charges the poison circuit breaker
+                (a healthy program that outgrew the budget is not a
+                broken kernel).  ``is_oom_error`` also classifies REAL
+                backend OOMs (RESOURCE_EXHAUSTED text) the same way,
+                so the recovery path is CPU-testable via this seam.
 """
 
 from __future__ import annotations
@@ -73,6 +84,44 @@ class PoisonFault(InjectedFault):
     """Deterministic injected failure (broken kernel / poisoned plan
     class): the same program fails on every retry."""
     transient = False
+
+
+class MemoryFault(InjectedFault):
+    """Injected device memory exhaustion (XLA RESOURCE_EXHAUSTED
+    class): the launch as sized did not fit.  Not retry-as-is worthy
+    (the identical launch would OOM again) but also NOT poison — the
+    supervised drain recovers it by shrinking the launch (fused-width
+    demux, streamed batching, host fallback) and bumping the digest's
+    memory correction, never by opening the circuit breaker."""
+    transient = False
+
+    @classmethod
+    def kind(cls) -> str:
+        return "oom"
+
+
+_KIND_EXC = {"transient": TransientFault, "poison": PoisonFault,
+             "oom": MemoryFault}
+
+# substrings that mark a REAL backend launch failure as memory
+# exhaustion (jaxlib XlaRuntimeError carries the XLA status name)
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory",
+                "Resource exhausted", "OOM when allocating")
+
+
+def is_oom_error(e: BaseException) -> bool:
+    """Classify a launch failure as device memory exhaustion: the
+    injected MemoryFault, or a real backend error whose text carries an
+    XLA RESOURCE_EXHAUSTED / OOM marker.  String-matching is the only
+    portable seam — jaxlib's XlaRuntimeError carries the status in its
+    message, and importing backend exception types here would bind
+    faultline to jax (this module stays jax-free)."""
+    if isinstance(e, MemoryFault):
+        return True
+    if isinstance(e, InjectedFault):
+        return False
+    text = f"{type(e).__name__}: {e}"
+    return any(m in text for m in _OOM_MARKERS)
 
 
 @dataclass(frozen=True)
@@ -141,8 +190,9 @@ class FaultPlan:
             if seam not in SEAMS and seam != "*":
                 raise ValueError(f"unknown fault seam {seam!r} "
                                  f"(one of {SEAMS} or '*')")
-            if kind not in ("transient", "poison"):
-                raise ValueError(f"unknown fault kind {kind!r}")
+            if kind not in _KIND_EXC:
+                raise ValueError(f"unknown fault kind {kind!r} "
+                                 f"(one of {tuple(sorted(_KIND_EXC))})")
             rate, match, times = 1.0, "", 0
             for extra in parts[2:]:
                 if extra.startswith("match="):
@@ -182,7 +232,9 @@ class FaultPlan:
                         # keyed-only dice: the SAME key fails forever
                         u = _mix(self.seed, _seam_id(seam), kv)
                     else:
-                        # attempt-counted dice: a retry rolls fresh
+                        # attempt-counted dice (transient AND oom): a
+                        # retry — or a re-sized/demuxed re-launch —
+                        # rolls fresh
                         u = _mix(self.seed, _seam_id(seam), kv, n)
                     if u / 2.0 ** 64 >= r.rate:
                         continue
@@ -190,9 +242,7 @@ class FaultPlan:
                     self._times_left[i] = left - 1
                 k = (seam, r.kind)
                 self._injected[k] = self._injected.get(k, 0) + 1
-                exc = TransientFault if r.kind == "transient" \
-                    else PoisonFault
-                fault = exc(seam, key)
+                fault = _KIND_EXC[r.kind](seam, key)
                 break
         if fault is not None:
             from ..utils.metrics import global_registry
@@ -293,5 +343,6 @@ def stats() -> Optional[dict]:
 
 
 __all__ = ["FaultPlan", "FaultRule", "InjectedFault", "TransientFault",
-           "PoisonFault", "SEAMS", "install", "install_spec", "clear",
-           "active", "check", "stats"]
+           "PoisonFault", "MemoryFault", "is_oom_error", "SEAMS",
+           "install", "install_spec", "clear", "active", "check",
+           "stats"]

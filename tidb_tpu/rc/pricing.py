@@ -17,6 +17,15 @@ marginal-bytes split the HBM-budget drain cap uses).
 
 Coefficients are module constants (not sysvars): they define the RU
 *unit* and changing them re-denominates every bucket in flight.
+
+Closed-loop calibration (copmeter, analysis/calibrate): with
+``tidb_tpu_cost_calibration`` on, the LaunchCost a task carries into
+``task_rus`` is the CORRECTED one — the scheduler replaces ``task.cost``
+at admission with the digest's clamped measured corrections (the static
+cost stays on ``task.cost_static``), so pricing self-tunes per digest
+without this module changing: the clamp bounds the swing to [1/8, 8]
+and ``MIN_TASK_RU`` still floors every task, so calibrated pricing can
+never undercut the per-request floor.
 """
 
 from __future__ import annotations

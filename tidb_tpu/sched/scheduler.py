@@ -100,8 +100,18 @@ WINDOW_HIT_FLOOR = 0.05          # scale cutoff: ~10 straight misses
 # between cover re-checks (bucket refill is time-driven; submits still
 # notify the condition immediately)
 RC_RETRY_S = 0.01
-# per-program-digest device-time attribution map stays tiny
+# per-program-digest device-time attribution map: bounded + LRU-evicted
+# (analysis/calibrate.BoundedLRU — the same eviction policy the
+# calibration correction store uses; the map previously grew per digest
+# for the life of the process)
 RC_DIGEST_CAP = 64
+# copmeter deadline-aware early shedding: a submit whose CORRECTED-cost
+# backlog (sum of the queue's measured expected service times) already
+# exceeds this is rejected 9003 at the queue head — and an rc-limited
+# waiter whose backlog exceeds its own max-queue deadline is rejected
+# 8252 — instead of timing out deep in queue.  Only measured digests
+# contribute to the backlog, so an uncalibrated process never sheds.
+SHED_MAX_BACKLOG_S = 30.0
 # supervised-launch transient retry: total Backoffer sleep budget the
 # drain will spend re-launching one batch before classifying the
 # failure as persistent (DEVICE_FAILED curve, store/backoff.py)
@@ -158,6 +168,12 @@ class DeviceScheduler:
         self.rc_enable = True
         self.rc_overdraft_ru = DEFAULT_OVERDRAFT_RU
         self.rc_max_queue_s = DEFAULT_MAX_QUEUE_S
+        # copmeter closed-loop calibration (analysis/calibrate;
+        # tidb_tpu_cost_calibration sysvar): corrected LaunchCost feeds
+        # RU pricing, budget admission, fusion caps, the micro-batch
+        # window, and deadline-aware shedding.  Off = the static model
+        # untouched, no feedback recorded.
+        self.calibration_enable = True
         # launch supervision (faultline): per-digest circuit breaker
         # consulted at submit, transient-retry budget spent at the
         # drain; _retry_sleep is the Backoffer sleep seam (tests)
@@ -221,7 +237,18 @@ class DeviceScheduler:
         self.rc_throttled = 0             # drain passes that skipped a group
         self.rc_exhausted = 0             # waiters failed at the deadline
         self.rc_debited_ru = 0.0          # priced RUs debited pre-launch
-        self._digest_ns: dict = {}        # program digest -> device ns
+        # program digest -> device ns, bounded + LRU (shared eviction
+        # policy with the calibration correction store)
+        from ..analysis.calibrate import BoundedLRU
+        self._digest_ns = BoundedLRU(RC_DIGEST_CAP)
+        # copmeter accounting (analysis/calibrate)
+        self.oom_faults = 0               # OOM-classified launch failures
+        self.oom_demuxed = 0              # OOM group launches retried at
+                                          # reduced fusion width
+        self.shed_rejects = 0             # submits shed at the queue head
+                                          # (corrected-cost backlog over
+                                          # the waiter's deadline)
+        self._backlog_ns = 0              # expected service ns queued
         self.tasks_done = 0
         from ..utils.metrics import global_registry
         reg = global_registry()
@@ -284,6 +311,15 @@ class DeviceScheduler:
             "tidb_tpu_rc_overdraft_ru",
             "bounded RU overdraft the drain tolerates per group")
         self._m_rc_overdraft.set(self.rc_overdraft_ru)
+        # copmeter (analysis/calibrate): OOM recovery + early shedding
+        self._m_oom = reg.counter(
+            "tidb_tpu_sched_oom_total",
+            "OOM-classified launch failures recovered without charging "
+            "the circuit breaker")
+        self._m_shed = reg.counter(
+            "tidb_tpu_sched_shed_total",
+            "submits shed at the queue head: corrected-cost backlog "
+            "already exceeded the waiter's deadline")
 
     # ------------------------------------------------------------- #
     # admission
@@ -295,7 +331,8 @@ class DeviceScheduler:
                   window_us: Optional[int] = None,
                   hbm_budget: Optional[int] = None,
                   rc_enable: Optional[bool] = None,
-                  rc_overdraft: Optional[float] = None) -> None:
+                  rc_overdraft: Optional[float] = None,
+                  calibration: Optional[bool] = None) -> None:
         """Apply sysvar knobs; negative/None = keep current (window_us
         and hbm_budget are the exceptions: -1 means adaptive/auto,
         0 disables the hold / the budget)."""
@@ -314,6 +351,8 @@ class DeviceScheduler:
         if rc_overdraft is not None and rc_overdraft >= 0:
             self.rc_overdraft_ru = float(rc_overdraft)
             self._m_rc_overdraft.set(self.rc_overdraft_ru)
+        if calibration is not None:
+            self.calibration_enable = bool(calibration)
 
     # ---- HBM-budget admission (analysis/copcost) -------------------- #
 
@@ -332,16 +371,53 @@ class DeviceScheduler:
             self._m_budget.set(self._auto_budget)
         return self._auto_budget
 
+    # ---- copmeter (analysis/calibrate): measured-cost correction ----- #
+
+    @staticmethod
+    def _stable_digest(task) -> Optional[str]:
+        """Restart-stable digest of a structured task's program — the
+        key the correction store, the copforge manifest, and the
+        quarantine purge all share.  None for opaque tasks."""
+        if task.dag is None:
+            return None
+        from ..analysis.compilekey import stable_digest
+        return stable_digest(task.dag)
+
+    def _calibrated_cost(self, task, cost):
+        """Corrected LaunchCost for admission/pricing (clamped EWMA
+        factors from the correction store); the static cost stays on
+        ``task.cost_static`` so feedback never compounds on itself."""
+        digest = self._stable_digest(task)
+        if digest is None:
+            return cost
+        from ..analysis.calibrate import correction_store
+        return correction_store().corrected_cost(digest, cost)
+
+    def _expected_ns(self, task) -> int:
+        """Measured expected service time of this task's program (EWMA,
+        ns; 0 = never measured) — the shedding backlog unit."""
+        if not self.calibration_enable:
+            return 0
+        digest = self._stable_digest(task)
+        if digest is None:
+            return 0
+        from ..analysis.calibrate import correction_store
+        return correction_store().expected_ns(digest)
+
     def _admit_cost(self, task: CopTask) -> None:
         """Static-footprint gate, run in the submitting thread BEFORE
         the drain loop could trace/compile anything: the task's
         LaunchCost (abstract shape/bytes walk, array metadata only) must
         fit the per-mesh budget, and every device node must have a
-        statically derivable bound."""
+        statically derivable bound.  With calibration on, the budget
+        comparison (and everything downstream: pricing, fusion caps,
+        attribution weights) uses the CORRECTED cost."""
         from ..analysis.copcost import CostError, format_bytes, task_cost
-        cost = task.cost = task_cost(task)
+        cost = task.cost_static = task.cost = task_cost(task)
         if cost is None:
             return
+        if self.calibration_enable:
+            cost = task.cost = self._calibrated_cost(task, cost)
         p = ("sched", type(task.dag).__name__)
         if cost.unbounded:
             raise CostError(
@@ -375,6 +451,41 @@ class DeviceScheduler:
         with self._mu:
             self.budget_admitted += 1
         self._m_badmit.inc()
+
+    def _shed_locked(self, task: CopTask) -> None:
+        """Deadline-aware early shedding (copmeter), called with _cv
+        held BEFORE the task queues: when the corrected-cost backlog —
+        the sum of measured expected service times already queued —
+        provably exceeds what this waiter can tolerate, fail it at the
+        queue HEAD (rc waiters with the MySQL-compatible 8252, others
+        with the 9003 busy error) instead of letting it time out deep
+        in queue.  Conservative by construction: only MEASURED digests
+        contribute to the backlog, so a cold process never sheds."""
+        if not self.calibration_enable or self._backlog_ns <= 0:
+            return
+        deadline_ns = None
+        if task.deadline_ns:
+            deadline_ns = int(self.rc_max_queue_s * 1e9)
+        elif self._backlog_ns > int(SHED_MAX_BACKLOG_S * 1e9):
+            deadline_ns = int(SHED_MAX_BACKLOG_S * 1e9)
+        if deadline_ns is None or self._backlog_ns <= deadline_ns:
+            return
+        self.shed_rejects += 1
+        self._m_shed.inc()
+        if task.key is not None:
+            # same slot hygiene as the busy path: a shed HALF_OPEN
+            # probe must release its probe slot
+            self.breaker.abort_probe(task.key[0])
+        if task.deadline_ns:
+            raise ResourceExhaustedError(
+                task.group, self._backlog_ns / 1e9, task.rus)
+        raise ServerBusyError(self.max_depth)
+
+    def _backlog_sub_locked(self, task: CopTask) -> None:
+        """A queued task left the queue (served, expired, cancelled):
+        release its expected-service contribution (called with _cv
+        held; clamped so bookkeeping drift can never wedge admission)."""
+        self._backlog_ns = max(self._backlog_ns - task.svc_ns, 0)
 
     @staticmethod
     def _marginal_bytes(t: CopTask, lead: CopTask) -> int:
@@ -420,6 +531,9 @@ class DeviceScheduler:
                 and task.rc_group.limited:
             task.deadline_ns = task.submit_ns + \
                 int(self.rc_max_queue_s * 1e9)
+        # copmeter: the task's measured expected service time (0 when
+        # the digest was never measured) — computed OUTSIDE the lock
+        task.svc_ns = self._expected_ns(task)
         with self._cv:
             if self._depth >= self.max_depth:
                 self.busy_rejects += 1
@@ -429,6 +543,7 @@ class DeviceScheduler:
                     # release its slot or no probe could ever run
                     self.breaker.abort_probe(task.key[0])
                 raise ServerBusyError(self.max_depth)
+            self._shed_locked(task)
             g = self._groups.get(task.group)
             if g is None:
                 g = self._groups[task.group] = _GroupQ(
@@ -442,6 +557,7 @@ class DeviceScheduler:
                     g.vtime = max(g.vtime, self._gvt)
             g.queue.append(task)
             self._depth += 1
+            self._backlog_ns += task.svc_ns
             self._note_arrival(task)
             self._m_depth.set(self._depth)
             self._m_tasks.inc(group=task.group)
@@ -549,6 +665,7 @@ class DeviceScheduler:
                         t.rus, self.rc_overdraft_ru):
                     g.queue.remove(t)
                     self._depth -= 1
+                    self._backlog_sub_locked(t)
                     self.rc_exhausted += 1
                     self._m_rc_exhaust.inc(group=g.name)
                     t.fail(ResourceExhaustedError(
@@ -604,7 +721,17 @@ class DeviceScheduler:
                     / WINDOW_HIT_INIT)
         if scale < WINDOW_HIT_FLOOR:
             return 0
-        return int(w * scale)
+        w = int(w * scale)
+        if self.calibration_enable:
+            # copmeter window feed: a hold only pays when it is small
+            # next to the launch it delays — cap the hold at a quarter
+            # of the digest's MEASURED launch time, so a program the
+            # calibration knows to be fast never waits longer than it
+            # would run
+            exp = self._expected_ns(lead)
+            if exp:
+                w = min(w, exp // 4)
+        return w
 
     def _note_window_outcome(self, lead, hit: bool) -> None:
         """Feed one hold's outcome back into the key's hit-rate EWMA
@@ -666,6 +793,7 @@ class DeviceScheduler:
                     self._rc_debit(t, lead)
                     batch.append(t)
                     self._depth -= 1
+                    self._backlog_sub_locked(t)
                     og.vtime += 1.0 / og.weight
                     og.tasks += 1
                 else:
@@ -683,6 +811,7 @@ class DeviceScheduler:
             return []
         lead = g.queue.popleft()
         self._depth -= 1
+        self._backlog_sub_locked(lead)
         g.vtime += 1.0 / g.weight
         self._gvt = g.vtime
         g.tasks += 1
@@ -894,6 +1023,13 @@ class DeviceScheduler:
                     for t in live:
                         t.fail(e)
                     return
+                if _faults.is_oom_error(e):
+                    # memory exhaustion is its own class (copmeter): a
+                    # healthy program outgrew the budget — recover by
+                    # shrinking the launch, never by charging the
+                    # poison breaker
+                    self._handle_oom([t for t in batch if not t.done], e)
+                    return
                 if self._is_transient(e):
                     if bo is None:
                         bo = self._launch_backoffer()
@@ -915,6 +1051,46 @@ class DeviceScheduler:
                 for d in self._digests(live):
                     self.breaker.record_success(d)
                 return
+
+    def _handle_oom(self, live: list, err: BaseException) -> None:
+        """OOM-classified launch failure (copmeter): RESOURCE_EXHAUSTED
+        / XLA-OOM — a healthy program whose modeled footprint was too
+        small, NOT a poisoned kernel.  Bump every member digest's
+        memory correction (so future admission sees the bigger
+        footprint: budget rejection into streaming, smaller fusion
+        groups), retry group launches at reduced fusion width (the
+        members relaunch solo), and fail a solo launch to its waiter —
+        whose CopClient recovers via streamed batching or the host
+        oracle.  The poison circuit breaker is NEVER charged: an OOM
+        must not quarantine a program that would fit when resized."""
+        self.oom_faults += 1
+        self._m_oom.inc()
+        if self.calibration_enable:
+            from ..analysis.calibrate import correction_store
+            store = correction_store()
+            for digest in sorted({d for d in map(self._stable_digest,
+                                                 live) if d is not None}):
+                store.observe_oom(digest)
+            store.sync_manifest()
+        subs: list = []
+        by_member: dict = {}
+        for t in live:
+            k = (t.key, t.input_token)
+            g = by_member.get(k)
+            if g is None:
+                g = by_member[k] = []
+                subs.append(g)
+            g.append(t)
+        if len(subs) <= 1:
+            for t in live:
+                t.fail(err)
+            return
+        self.oom_demuxed += 1
+        for sub in subs:
+            # reduced fusion width: each member relaunches alone; a
+            # member that STILL OOMs solo lands in the fail branch
+            # above and its waiter's client degrades (stream / host)
+            self._serve_supervised(sub)
 
     def _isolate(self, live: list, err: BaseException) -> None:
         """Blast-radius isolation: a failed GROUP launch (fused members
@@ -1149,6 +1325,33 @@ class DeviceScheduler:
         weights += [self._marginal_bytes(t, lead) for t in batch[1:]]
         for t, ns in zip(batch, split_device_time(weights, wall_ns)):
             t.device_ns = ns
+        if self.calibration_enable:
+            self._observe_launch(batch)
+
+    def _observe_launch(self, batch: list) -> None:
+        """copmeter feedback: each SERVED member's attributed wall time
+        EWMAs into its digest's correction against the STATIC cost
+        (cost_static, never the already-corrected one — feedback must
+        not compound on itself), then throttle-persists through the
+        copforge manifest so calibration survives restarts."""
+        from ..analysis.calibrate import correction_store
+        store = correction_store()
+        fed = False
+        for t in batch:
+            if t.failed or t.device_ns <= 0 or t.cost_static is None \
+                    or t.compile_miss:
+                # cold launches measure the COMPILER, not the program
+                # (compile_wait is already split out for EXPLAIN; the
+                # wall split here still contains the trace) — only
+                # warm launches feed the loop
+                continue
+            digest = self._stable_digest(t)
+            if digest is None:
+                continue
+            store.observe(digest, t.cost_static, t.device_ns)
+            fed = True
+        if fed:
+            store.sync_manifest()
 
     def _account(self, batch: list) -> None:
         """Post-launch bookkeeping.  RUs were PRICED at submit and
@@ -1172,11 +1375,11 @@ class DeviceScheduler:
                     g.rus += t.rus_charged
                     g.device_ns += t.device_ns
                 if t.key is not None and t.device_ns:
-                    if len(self._digest_ns) > RC_DIGEST_CAP:
-                        self._digest_ns.clear()
+                    # bounded + LRU (BoundedLRU, the calibration
+                    # store's eviction policy) — no more unbounded
+                    # per-digest growth, no more wholesale clear()
                     dk = f"{t.key[0] & 0xffffffffffffffff:016x}"
-                    self._digest_ns[dk] = \
-                        self._digest_ns.get(dk, 0) + t.device_ns
+                    self._digest_ns.bump(dk, t.device_ns)
                 self._wait_ring.append(t.wait_ns)
                 self._m_wait.observe(t.wait_ns / 1e9)
                 self._m_ru.inc(t.rus_charged, group=t.group)
@@ -1188,6 +1391,11 @@ class DeviceScheduler:
     @property
     def depth(self) -> int:
         return self._depth
+
+    def _calibration_stats(self) -> dict:
+        from ..analysis.calibrate import correction_store
+        return {"enabled": self.calibration_enable,
+                **correction_store().stats()}
 
     @staticmethod
     def _pct(samples: list, q: float) -> float:
@@ -1240,6 +1448,12 @@ class DeviceScheduler:
                 "rc_throttled": self.rc_throttled,
                 "rc_exhausted": self.rc_exhausted,
                 "rc_debited_ru": round(self.rc_debited_ru, 2),
+                # copmeter (analysis/calibrate): closed-loop state
+                "calibration": self._calibration_stats(),
+                "oom_faults": self.oom_faults,
+                "oom_demuxed": self.oom_demuxed,
+                "shed_rejects": self.shed_rejects,
+                "backlog_ms": round(self._backlog_ns / 1e6, 3),
                 "digest_device_ms": {
                     dk: round(ns / 1e6, 3) for dk, ns in sorted(
                         self._digest_ns.items(),

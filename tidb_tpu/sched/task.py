@@ -111,9 +111,9 @@ class CopTask:
                  "aux", "input_token", "fn", "group", "weight",
                  "submit_ns", "start_ns", "wait_ns", "coalesced", "fused",
                  "fusion_key", "cancelled", "_done", "_value", "_exc",
-                 "est_rows", "cost", "rc_group", "rus", "rus_charged",
-                 "device_ns", "deadline_ns", "donate", "retries",
-                 "compile_ns", "compile_miss")
+                 "est_rows", "cost", "cost_static", "rc_group", "rus",
+                 "rus_charged", "device_ns", "deadline_ns", "svc_ns",
+                 "donate", "retries", "compile_ns", "compile_miss")
 
     def __init__(self, *, key=None, dag=None, mesh=None, row_capacity=0,
                  cols=None, counts=None, aux=(), input_token=None,
@@ -145,12 +145,18 @@ class CopTask:
         self.wait_ns = 0
         self.coalesced = 1        # tasks served by this task's launch
         self.fused = 0            # member programs in this task's launch
-        self.cost = None          # LaunchCost set at admission (copcost)
+        self.cost = None          # LaunchCost set at admission (copcost;
+                                  # calibration-corrected when enabled)
+        self.cost_static = None   # the uncorrected LaunchCost — the
+                                  # calibration feedback baseline
+                                  # (copmeter; never fed back on itself)
         self.rc_group = rc_group  # live rc ResourceGroup (bucket owner)
         self.rus = 1.0            # priced RUs, set at submit (rc/pricing)
         self.rus_charged = 0.0    # RUs actually debited at the drain
         self.device_ns = 0        # attributed share of launch wall time
         self.deadline_ns = 0      # rc max-queue deadline (0 = none)
+        self.svc_ns = 0           # measured expected service time the
+                                  # shedding backlog accounts (copmeter)
         self.donate = bool(donate)  # launch-unique inputs: donate them
         self.retries = 0          # transient-failure re-launches (drain)
         self.compile_ns = 0       # program resolve/compile time this
@@ -206,6 +212,12 @@ class CopTask:
         """Resolved (served or failed) — the supervised drain filters
         already-finished members out of a retried batch."""
         return self._done.is_set()
+
+    @property
+    def failed(self) -> bool:
+        """Resolved WITH an error — failed launches must not feed the
+        calibration loop (their wall time measures the failure path)."""
+        return self._done.is_set() and self._exc is not None
 
     def finish(self, value) -> None:
         if self._done.is_set():

@@ -148,6 +148,12 @@ class StatusServer:
                 "rc_exhausted": sched.get("rc_exhausted", 0),
                 "rc_debited_ru": sched.get("rc_debited_ru", 0.0),
                 "digest_device_ms": sched.get("digest_device_ms", {}),
+                # copmeter (analysis/calibrate): closed-loop cost
+                # calibration state + OOM recovery / early shedding
+                "calibration": sched.get("calibration"),
+                "oom_faults": sched.get("oom_faults", 0),
+                "shed_rejects": sched.get("shed_rejects", 0),
+                "backlog_ms": sched.get("backlog_ms", 0.0),
                 "groups": groups,
                 "runaway": {
                     "total": mgr.runaway_ring.total,

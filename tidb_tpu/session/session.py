@@ -1161,6 +1161,11 @@ class Session:
         if v10 is not None:
             from ..faults import install_spec
             install_spec(str(v10))
+        # copmeter closed-loop calibration (analysis/calibrate): on by
+        # default; off leaves the static cost model untouched
+        v14 = merged.get("tidb_tpu_cost_calibration")
+        if v14 is not None and v14 != "":
+            client.calibration = bool(int(v14))
         # copforge AOT compile cache (compilecache/): enable/dir/pool
         # knobs, then the idempotent boot warm-start hook — the first
         # statement after a cache dir lands kicks the background
@@ -1217,6 +1222,9 @@ class Session:
             footer = self._cost_footer(phys)
             if footer is not None:
                 rows.append((footer,))
+                calib = self._calibration_footer(phys)
+                if calib is not None:
+                    rows.append((calib,))
             strat = self._agg_strategy_footer(phys)
             if strat is not None:
                 rows.append((strat,))
@@ -1250,6 +1258,41 @@ class Session:
             return footer
         except (AttributeError, TypeError, KeyError, ValueError,
                 ImportError):
+            return None
+
+    def _calibration_footer(self, phys) -> Optional[str]:
+        """EXPLAIN ``cost:`` verdict (copmeter, analysis/calibrate):
+        ``cost: calibrated (err N%)`` when the plan's device program
+        has measured corrections, ``cost: static`` otherwise (or when
+        tidb_tpu_cost_calibration is off).  None for plans without a
+        device dag; must never break EXPLAIN."""
+        try:
+            from ..copr import dag as Dg
+            dag = None
+            stack = [phys]
+            while stack and dag is None:
+                op = stack.pop()
+                d = getattr(op, "dag", None)
+                if isinstance(d, Dg.CopNode):
+                    dag = d
+                    break
+                for c in getattr(op, "children", []) or []:
+                    if c is not None:
+                        stack.append(c)
+            if dag is None:
+                return None
+            merged = {**self.domain.sysvars, **self.vars}
+            v = merged.get("tidb_tpu_cost_calibration")
+            enabled = True if v is None or v == "" else bool(int(v))
+            if not enabled:
+                return "cost: static"
+            from ..analysis.calibrate import correction_store
+            from ..analysis.compilekey import stable_digest
+            ent = correction_store().get(stable_digest(dag))
+            if ent is None or not ent.samples:
+                return "cost: static"
+            return f"cost: calibrated (err {ent.err * 100:.0f}%)"
+        except (AttributeError, TypeError, ValueError, ImportError):
             return None
 
     def _agg_strategy_footer(self, phys) -> Optional[str]:

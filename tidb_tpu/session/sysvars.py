@@ -93,6 +93,12 @@ _VARS = [
     _v("tidb_tpu_compile_cache_dir", "", kind="str", scope=SCOPE_GLOBAL),
     _v("tidb_tpu_compile_warm_pool", -1, kind="int", min=-1,
        scope=SCOPE_GLOBAL),
+    # copmeter closed-loop cost calibration (analysis/calibrate):
+    # measured per-digest launch times correct the static LaunchCost
+    # terms feeding RU pricing, HBM-budget admission, fusion caps, the
+    # micro-batch window, and deadline-aware early shedding.  Off = the
+    # static model untouched, no feedback recorded.
+    _v("tidb_tpu_cost_calibration", 1, kind="bool", scope=SCOPE_GLOBAL),
     _v("tidb_distsql_scan_concurrency", 15, kind="int", min=1, max=256),
     _v("tidb_max_chunk_size", 1024, kind="int", min=32, max=65536),
     _v("tidb_enable_vectorized_expression", 1, kind="bool"),

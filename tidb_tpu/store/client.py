@@ -129,6 +129,9 @@ class CopClient:
         # (tidb_tpu_rc_overdraft_ru); None = keep scheduler state
         self.rc_enable = None
         self.rc_overdraft = None
+        # copmeter closed-loop cost calibration
+        # (tidb_tpu_cost_calibration): None = keep scheduler state
+        self.calibration = None
         self._sched_obj = None
         # graceful degradation (faultline; tidb_tpu_sched_host_fallback):
         # a digest quarantined by the launch circuit breaker falls back
@@ -137,6 +140,11 @@ class CopClient:
         # unsupported-path degradation pattern)
         self.host_fallback = True
         self.degraded = 0      # statements served by that fallback
+        # copmeter OOM recovery (faults.is_oom_error): a launch that
+        # exhausted device memory retries through the recovery ladder —
+        # streamed half-size batches, then the host oracle — instead of
+        # failing the statement or charging the poison breaker
+        self.oom_recovered = 0
 
     @property
     def mesh(self):
@@ -225,7 +233,8 @@ class CopClient:
             window_us=self.sched_window_us,
             hbm_budget=self.sched_hbm_budget,
             rc_enable=self.rc_enable,
-            rc_overdraft=self.rc_overdraft)
+            rc_overdraft=self.rc_overdraft,
+            calibration=self.calibration)
         return s
 
     def _client_stats(self) -> dict:
@@ -234,6 +243,7 @@ class CopClient:
                     "last_retries": self.last_retries,
                     "last_heals": self.last_heals,
                     "degraded": self.degraded,
+                    "oom_recovered": self.oom_recovered,
                     "host_fallback": self.host_fallback}
 
     def sched_stats(self) -> dict:
@@ -321,8 +331,48 @@ class CopClient:
             # OPEN breaker: the device program keeps failing — degrade
             # to the host oracle where the plan shape allows it
             res = self._degraded_agg(agg, snap, key_meta, aux_cols, err)
+        except Exception as err:
+            # copmeter OOM recovery: a launch that exhausted device
+            # memory (injected MemoryFault or a real RESOURCE_EXHAUSTED)
+            # walks the recovery ladder; everything else re-raises
+            if not _faults.is_oom_error(err):
+                raise
+            res = self._oom_degraded_agg(agg, snap, key_meta, aux_cols,
+                                         err)
         if key is not None:
             self._rc_put(key, snap, res)
+        return res
+
+    def _oom_degraded_agg(self, agg: D.Aggregation, snap: ColumnarSnapshot,
+                          key_meta, aux_cols, err) -> CopResult:
+        """OOM recovery ladder (copmeter): the scheduler already bumped
+        the digest's memory correction and demuxed any fused launch;
+        a SOLO launch that still did not fit lands here.  Try streamed
+        half-size batches first (the launch that OOM'd resident runs
+        as >= 2 HBM-streamed batches), then the host oracle — results
+        stay bit-identical to the uncontended run on every rung.  Plans
+        with neither shape re-raise the original error."""
+        if not aux_cols:
+            half = max(snap.device_bytes() // 2, 1)
+            batches = snap.row_batches(half)
+            if batches and len(batches) >= 2:
+                try:
+                    if agg.strategy in D.HOST_MERGE_STRATEGIES:
+                        res = self._stream_sort_agg(agg, batches, key_meta)
+                    else:
+                        res = self._stream_dense_agg(agg, batches, key_meta)
+                    with self._stat_mu:
+                        self.oom_recovered += 1
+                    return res
+                except Exception as e:  # noqa: BLE001 - recovery ladder:
+                    # a half-size stream may STILL exhaust memory (or
+                    # trip the same injected fault) — fall through to
+                    # the host oracle; anything non-OOM re-raises
+                    if not _faults.is_oom_error(e):
+                        raise
+        res = self._degraded_agg(agg, snap, key_meta, aux_cols, err)
+        with self._stat_mu:
+            self.oom_recovered += 1
         return res
 
     def _degraded_agg(self, agg: D.Aggregation, snap: ColumnarSnapshot,
