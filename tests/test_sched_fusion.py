@@ -167,12 +167,23 @@ class _FakeTask:
 
 def test_fusion_signature_contract_class():
     """Fusable classes: in-program agg chains ('inprog-agg'), SEGMENT
-    aggs keyed by bucket shape ('segment-agg', B), and extras-free rows
-    chains ('rows' — fusion-breadth follow-on).  SORT aggs (regrow-sized
-    host merge) stay out."""
+    aggs keyed by bucket shape ('segment-agg', B), extras-free rows
+    chains ('rows'), and — the ISSUE 11 fusion-breadth satellite —
+    SORT aggs with a concrete pow2 capacity ('sort-agg', cap); a SORT
+    agg the planner left unsized (capacity 0: the client owns sizing)
+    still has no static shape class."""
     assert fusion_signature(_mk_agg_dag()) == ("inprog-agg",)
+    # capacity-bucketed SORT shape class (pow2 capacities, which is all
+    # the planner/regrow discipline ever produces)
     assert fusion_signature(
-        _mk_agg_dag(strategy=D.GroupStrategy.SORT)) is None
+        _mk_agg_dag(strategy=D.GroupStrategy.SORT)) == ("sort-agg", 64)
+    import dataclasses
+    unsized = dataclasses.replace(
+        _mk_agg_dag(strategy=D.GroupStrategy.SORT), group_capacity=0)
+    assert fusion_signature(unsized) is None
+    lopsided = dataclasses.replace(
+        _mk_agg_dag(strategy=D.GroupStrategy.SORT), group_capacity=100)
+    assert fusion_signature(lopsided) is None      # non-pow2: no class
     scan = D.TableScan((0,), (dt.bigint(False),))
     # rows chains fuse now, with per-member output capacities
     assert fusion_signature(D.Limit(scan, 5)) == ("rows",)

@@ -253,18 +253,37 @@ def _verify_dag(node: D.CopNode, path) -> None:
             if node.group_capacity < 0:
                 _fail("capacity-shape", p,
                       f"negative group capacity {node.group_capacity}")
-        elif node.strategy == D.GroupStrategy.SEGMENT:
+        elif node.strategy in D.RADIX_STRATEGIES:
+            sname = node.strategy.value.upper()
             if not node.group_by:
                 _fail("capacity-shape", p,
-                      "SEGMENT aggregation without keys")
+                      f"{sname} aggregation without keys")
             b = node.num_buckets
             if b <= 0 or (b & (b - 1)) != 0:
                 # the radix partition masks the top log2(B) hash bits and
                 # the state table is (B,): a malformed bucket count would
                 # trace a garbage-shaped program
                 _fail("capacity-shape", p,
-                      f"SEGMENT num_buckets {b} is not a positive power "
+                      f"{sname} num_buckets {b} is not a positive power "
                       "of two")
+            if node.strategy is D.GroupStrategy.SCATTER \
+                    and D.radix_passes(b) > D.MAX_RADIX_PASSES:
+                # pass well-formedness: each pass is a full-data
+                # reorder, so a bucket space whose bit span prices more
+                # than MAX_RADIX_PASSES passes would cost more data
+                # movement than the comparator sort it replaces
+                _fail("capacity-shape", p,
+                      f"SCATTER num_buckets {b} prices "
+                      f"{D.radix_passes(b)} radix passes "
+                      f"(> {D.MAX_RADIX_PASSES}): malformed bucket "
+                      "space")
+            if node.prehashed:
+                _verify_prehashed(node, schema, p)
+        if node.prehashed and node.strategy not in D.RADIX_STRATEGIES:
+            _fail("capacity-shape", p,
+                  f"prehashed set on a {node.strategy.value} "
+                  "aggregation: only the radix strategies "
+                  "(SEGMENT/SCATTER) read a hoisted hash column")
     elif isinstance(node, D.TopN):
         keys = node.sort_keys or (((node.sort_key, node.desc),)
                                   if node.sort_key is not None else ())
@@ -292,6 +311,41 @@ def _verify_dag(node: D.CopNode, path) -> None:
                 if t.is_host_object:
                     _fail("host-object-on-device", p,
                           f"broadcast build column of type {t}")
+
+
+def _verify_prehashed(node: D.Aggregation, schema, p) -> None:
+    """Contract of the prehash hoist (store/client + copr/radix): the
+    LAST scan column is the hoisted int64 key hash, the chain below the
+    aggregation is a plain TableScan(+Selection) (anything reshaping
+    the batch would strand the appended column), and no group key may
+    read the hash column itself."""
+    cur = node.child
+    while isinstance(cur, D.Selection):
+        cur = cur.child
+    if not isinstance(cur, D.TableScan):
+        _fail("capacity-shape", p,
+              "prehashed aggregation over a non-scan chain: the hoisted "
+              "hash column only rides a TableScan(+Selection) batch")
+    if not schema or _family(schema[-1]) != "int":
+        _fail("dtype-mismatch", p,
+              "prehashed aggregation whose last scan column is not an "
+              "int64-family hash lane")
+    hash_idx = len(schema) - 1
+    for g in node.group_by:
+        for ref in (x for x in _walk_refs(g)):
+            if ref.index == hash_idx:
+                _fail("column-ref", p,
+                      "group key reads the hoisted hash column "
+                      f"(index {hash_idx}) — keys must read data "
+                      "columns only")
+
+
+def _walk_refs(e: Expr):
+    if isinstance(e, ColumnRef):
+        yield e
+    elif isinstance(e, Func):
+        for a in e.args:
+            yield from _walk_refs(a)
 
 
 # --------------------------------------------------------------------- #
@@ -528,12 +582,20 @@ def fusion_signature(dag: D.CopNode) -> Optional[tuple]:
       spaces — the bucket count is part of the signature, so tasks with
       incompatible bucket shapes refuse to group at the key level
       instead of silently degrading to per-program launches.
+    - ``('scatter-agg', num_buckets, passes)`` — the SCATTER (multi-
+      pass scatter radix partition) twin: bucket space AND priced pass
+      count are both part of the class, so members always agree on the
+      partition program shape (a regrown bucket space changes both).
+    - ``('sort-agg', group_capacity)`` — a SORT aggregation whose
+      group-table capacity is a concrete power of two (the capacity-
+      bucketed shape classes of the fusion-breadth follow-on: the
+      client's regrow discipline only ever produces pow2 capacities,
+      so regrow-sized tasks land in shared classes instead of none).
+      Capacity 0 (planner left sizing to the client) or a non-pow2
+      capacity has no static shape class and stays unfusable.
     - ``('rows',)`` — an extras-free pure scan chain returning rows
       (fusion-breadth follow-on): members fuse with per-member output
-      capacities (spmd.FusedRowsProgram).
-
-    SORT aggregations stay unfusable: their group-table capacity is
-    regrow-sized per task, so no static shape class exists to share."""
+      capacities (spmd.FusedRowsProgram)."""
     if not isinstance(dag, D.Aggregation):
         if not _rows_fusable(dag):
             return None
@@ -542,14 +604,21 @@ def fusion_signature(dag: D.CopNode) -> Optional[tuple]:
         except PlanContractError:
             return None
         return ("rows",)
-    if dag.strategy == D.GroupStrategy.SORT:
-        return None
     if D.find_expand_join(dag) is not None:
         return None
+    if dag.strategy == D.GroupStrategy.SORT:
+        cap = dag.group_capacity
+        if cap <= 0 or (cap & (cap - 1)) != 0:
+            return None     # no static shape class to share
     try:
         verify_dag(dag)
     except PlanContractError:
         return None
+    if dag.strategy == D.GroupStrategy.SORT:
+        return ("sort-agg", dag.group_capacity)
+    if dag.strategy == D.GroupStrategy.SCATTER:
+        return ("scatter-agg", dag.num_buckets,
+                D.radix_passes(dag.num_buckets))
     if dag.strategy == D.GroupStrategy.SEGMENT:
         return ("segment-agg", dag.num_buckets)
     return ("inprog-agg",)

@@ -145,6 +145,9 @@ class LaunchCost:
     # ((path, num_groups, rows_per_device), ...) per degenerate DENSE agg
     # (group states > DENSE_BLOWUP_MAX x the per-device input rows)
     dense_blowups: tuple = ()
+    # ((path, passes, num_buckets), ...) per SCATTER agg whose priced
+    # radix pass count exceeds MAX_RADIX_PASSES (COST-RADIX-PASSES)
+    radix_blowups: tuple = ()
     # node paths for which no static bound could be derived
     unbounded: tuple = ()
     # ((label, bytes), ...) largest-first, for reports/EXPLAIN
@@ -179,6 +182,7 @@ class LaunchCost:
             self.live_cells + other.live_cells,
             self.expanding_joins + other.expanding_joins,
             self.dense_blowups + other.dense_blowups,
+            self.radix_blowups + other.radix_blowups,
             self.unbounded + other.unbounded,
             self.breakdown + other.breakdown,
             self.donated_bytes + other.donated_bytes)
@@ -260,14 +264,15 @@ def _expr_flops(e: Optional[Expr]) -> int:
 class _Acc:
     """Per-device walk accumulator; totals multiply by D at rollup."""
 
-    __slots__ = ("inter", "flops", "joins", "dense_blowups", "unbounded",
-                 "breakdown")
+    __slots__ = ("inter", "flops", "joins", "dense_blowups",
+                 "radix_blowups", "unbounded", "breakdown")
 
     def __init__(self):
         self.inter = 0
         self.flops = 0
         self.joins = []         # (path, out_capacity, probe_rows)
         self.dense_blowups = []  # (path, num_groups, rows)
+        self.radix_blowups = []  # (path, passes, num_buckets)
         self.unbounded = []
         self.breakdown = []     # (label, per-device bytes)
 
@@ -363,12 +368,14 @@ def _walk(node: D.CopNode, path: tuple, rows: int, layout: Layout,
             acc.flops += (_expr_flops(a.arg) + 1) * rows_in
         if node.strategy == D.GroupStrategy.SORT:
             swidth += len(node.group_by) * 8 + 8       # keys + __ngroups__
-            # device sort of (keys.., payload-index): the comparator
-            # carries 1 + 2*k lanes
+            # device sort of (dead, nullflag/code per key, payload
+            # index): the comparator carries 1 + 2*k lanes, and every
+            # lane rides every compare-exchange stage — the cost the
+            # radix strategies exist to shed (SURVEY.md §7)
             acc.buf("/".join(p) + ":sort",
                     rows_in * (len(node.group_by) + 1) * 8)
-            acc.flops += rows_in * _log2(rows_in) * max(
-                len(node.group_by), 1)
+            acc.flops += rows_in * _log2(rows_in) * (
+                1 + 2 * len(node.group_by))
         elif node.strategy == D.GroupStrategy.SEGMENT:
             swidth += len(node.group_by) * 8 + 8       # keys + __ngroups__
             # avalanche hash (constant lanes per key) + ONE single-key
@@ -376,6 +383,33 @@ def _walk(node: D.CopNode, path: tuple, rows: int, layout: Layout,
             acc.buf("/".join(p) + ":radix", rows_in * 2 * 8)
             acc.flops += rows_in * (6 * max(len(node.group_by), 1)
                                     + _log2(rows_in))
+        elif node.strategy == D.GroupStrategy.SCATTER:
+            swidth += len(node.group_by) * 8 + 8       # keys + __ngroups__
+            passes = D.radix_passes(node.num_buckets
+                                    or max(rows_in, 2))
+            n_digits = 1 << D.RADIX_BITS
+            n_tiles = max(rows_in // D.RADIX_TILE, 1)
+            # per pass: a per-tile digit histogram, the tiny exclusive
+            # cumsum of bucket offsets, and the gather/scatter reorder
+            # of the int32 index permutation — O(passes * n) streaming
+            # data movement, NO comparator lanes.  Buffers (reused
+            # across passes, priced once): per-tile histograms +
+            # offsets + the int32 permutation ping-pong — under half of
+            # SEGMENT's (hash, index) int64 sort operands per row, the
+            # bytes half of the acceptance comparison (flops being the
+            # other: 3*passes streaming ops vs n*log2(n) comparator
+            # stages).
+            acc.buf("/".join(p) + ":radix-hist", n_tiles * n_digits * 4)
+            acc.buf("/".join(p) + ":radix-cumsum",
+                    n_tiles * n_digits * 4)
+            acc.buf("/".join(p) + ":radix-scatter", rows_in * 2 * 4)
+            # hash (6 lanes/key, as SEGMENT) + per pass: digit extract,
+            # histogram add, scatter store (3 ops/row)
+            acc.flops += rows_in * (6 * max(len(node.group_by), 1)
+                                    + 3 * passes)
+            if passes > D.MAX_RADIX_PASSES:
+                acc.radix_blowups.append(
+                    ("/".join(p), passes, node.num_buckets))
         acc.buf("/".join(p) + ":states", groups * swidth)
         if node.strategy == D.GroupStrategy.DENSE \
                 and groups > DENSE_BLOWUP_MIN_GROUPS \
@@ -444,8 +478,8 @@ def _dag_walk_cached(dag: D.CopNode, layout: Layout,
     acc.buf("flatten:base_sel", rows0 * _VALIDITY_BYTES)
     rows_out, w_out = _walk(dag, (), rows0, layout, widths, acc)
     return (acc.inter, acc.flops, tuple(acc.joins),
-            tuple(acc.dense_blowups), tuple(acc.unbounded),
-            tuple(acc.breakdown), rows_out, w_out)
+            tuple(acc.dense_blowups), tuple(acc.radix_blowups),
+            tuple(acc.unbounded), tuple(acc.breakdown), rows_out, w_out)
 
 
 def _rows_kind_capacity(dag: D.CopNode, layout: Layout,
@@ -477,8 +511,8 @@ def dag_cost(dag: D.CopNode, layout: Layout,
     ``analysis.lifetime.DonationPlan``: donated input bytes alias into
     the output allocation, so the peak drops by min(donated, output)."""
     d = max(layout.n_devices, 1)
-    (inter_pd, flops_pd, joins, dense_blowups, unbounded, breakdown,
-     rows_out, w_out) = _dag_walk_cached(dag, layout, widths)
+    (inter_pd, flops_pd, joins, dense_blowups, radix_blowups, unbounded,
+     breakdown, rows_out, w_out) = _dag_walk_cached(dag, layout, widths)
     root = dag.members[-1] if isinstance(dag, D.FusedDag) and dag.members \
         else dag
     if isinstance(root, D.Aggregation):
@@ -510,6 +544,7 @@ def dag_cost(dag: D.CopNode, layout: Layout,
         or layout.padded_rows,
         expanding_joins=joins,
         dense_blowups=dense_blowups,
+        radix_blowups=radix_blowups,
         unbounded=unbounded,
         breakdown=tuple(sorted(breakdown, key=lambda kv: -kv[1])[:8]),
         donated_bytes=donated)
@@ -747,7 +782,15 @@ def cost_findings(plans, n_devices: int = 8) -> list:
                 f"{rows} per-device rows "
                 f"({groups / max(rows, 1):.0f}x > "
                 f"{DENSE_BLOWUP_MAX:.0f}x): degenerate large-NDV dense "
-                f"domain, use the SEGMENT strategy ({one_line})"))
+                f"domain, use a radix strategy ({one_line})"))
+        for path, passes, buckets in cost.radix_blowups:
+            out.append(Finding(
+                "COST-RADIX-PASSES", qid, 0, path.split("/")[-1],
+                f"SCATTER aggregation over {buckets} buckets prices "
+                f"{passes} radix passes (> {D.MAX_RADIX_PASSES}): each "
+                "pass is a full-data reorder — a malformed bucket space "
+                f"costs more movement than the sort it replaces "
+                f"({one_line})"))
         for path in cost.unbounded:
             out.append(Finding(
                 "COST-UNBOUNDED", qid, 0, path.split("/")[-1],

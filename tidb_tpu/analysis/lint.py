@@ -84,6 +84,17 @@ Rules
                    can silently deserialize a stale or wrong-variant
                    program after a restart (mirrors TPU-DIGEST for the
                    on-disk half of the program cache).
+- TPU-PALLAS-SHAPE in copr/pallas/ (the hand-written TPU kernel
+                   package): a ``pallas_call`` whose ``grid=`` or a
+                   ``BlockSpec`` whose block shape contains a
+                   non-static expression (any call besides the
+                   shape-arithmetic allowlist cdiv/len/min/max), or
+                   ANY host-callback use (pure_callback / io_callback /
+                   host_callback / debug_callback).  A traced-value
+                   grid recompiles per shape (or fails Mosaic
+                   outright); a host callback inside a kernel stalls
+                   the TPU pipeline on the host — both destroy exactly
+                   the performance a hand-written kernel exists for.
 
 Inline waiver: any rule is suppressed by a `# planlint: ok` comment on
 the offending line (give a reason after it).
@@ -103,7 +114,8 @@ from typing import Iterable, Optional
 # dual-backend (np|jnp) evaluator and its host-object op implementations
 # legitimately concretize when xp is numpy.
 TRACED_MODULES = {
-    "copr/exec.py", "copr/join.py", "copr/segment.py",
+    "copr/exec.py", "copr/join.py", "copr/segment.py", "copr/radix.py",
+    "copr/pallas/radix_kernel.py",
     "parallel/spmd.py", "parallel/shuffle.py", "parallel/window.py",
     "parallel/exchange.py",
 }
@@ -122,9 +134,10 @@ LOCK_MODULES = {
     "sched/scheduler.py", "utils/poolmgr.py", "utils/rwlock.py",
     "store/client.py", "rc/bucket.py", "rc/controller.py",
     "rc/runaway.py", "utils/resourcegroup.py",
-    # SEGMENT-strategy kernel (ISSUE 6): lock-free today, listed so any
-    # future lock grown there joins the cross-layer order contract
-    "copr/segment.py",
+    # SEGMENT/SCATTER-strategy kernels (ISSUE 6/11): lock-free today,
+    # listed so any future lock grown there joins the cross-layer order
+    # contract
+    "copr/segment.py", "copr/radix.py",
     # faultline (ISSUE 8): the breaker/plan leaf locks run under the
     # drain's condition lock and the submit path, so nested/inverted
     # acquisition there would deadlock against the scheduler
@@ -561,6 +574,57 @@ class _ExprRules(_Scoped):
 
 
 # --------------------------------------------------------------------- #
+# rule: TPU-PALLAS-SHAPE (copr/pallas/ kernel hygiene)
+# --------------------------------------------------------------------- #
+
+# the hand-written TPU kernel package: every Pallas kernel lives here
+PALLAS_PREFIX = "copr/pallas/"
+# host-callback entry points that must never appear in a kernel module
+_HOST_CALLBACKS = frozenset({
+    "pure_callback", "io_callback", "host_callback", "debug_callback",
+    "call_host",
+})
+# calls allowed inside a static grid/block-shape expression: pure shape
+# arithmetic over module constants
+_SHAPE_CALL_ALLOW = frozenset({"cdiv", "len", "min", "max"})
+
+
+class _PallasRules(_Scoped):
+    """Kernel-module hygiene for copr/pallas/: static grids/blocks and
+    no host callbacks (see the rule table in the module docstring)."""
+
+    def visit_Call(self, node):
+        name = _call_name(node)
+        if name in _HOST_CALLBACKS:
+            self.add("TPU-PALLAS-SHAPE", node,
+                     f"{name}(...) in a Pallas kernel module: a host "
+                     "callback inside (or feeding) a TPU kernel stalls "
+                     "the device pipeline on the host — keep kernel "
+                     "modules callback-free")
+        elif name == "pallas_call":
+            for kw in node.keywords:
+                if kw.arg == "grid":
+                    self._check_static(kw.value, node, "grid")
+        elif name == "BlockSpec" and node.args:
+            self._check_static(node.args[0], node, "block shape")
+        self.generic_visit(node)
+
+    def _check_static(self, expr, node, what: str) -> None:
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Call):
+                sub_name = _call_name(sub)
+                if sub_name not in _SHAPE_CALL_ALLOW:
+                    self.add(
+                        "TPU-PALLAS-SHAPE", node,
+                        f"non-static {what} in pallas_call: "
+                        f"{sub_name}(...) is not shape arithmetic — a "
+                        "runtime-derived grid/block shape recompiles "
+                        "per value (or fails Mosaic); derive shapes "
+                        "from static module constants")
+                    return
+
+
+# --------------------------------------------------------------------- #
 # rule: TPU-COMPILE-KEY (compilecache/ persistence seams)
 # --------------------------------------------------------------------- #
 
@@ -764,6 +828,10 @@ def lint_source(src: str, rel: str) -> list:
         ck = _CompileKeyRules(rel, lines)
         ck.visit(tree)
         findings += ck.findings
+    if rel.startswith(PALLAS_PREFIX):
+        pr = _PallasRules(rel, lines)
+        pr.visit(tree)
+        findings += pr.findings
     if rel in LOCK_MODULES:
         findings += _LockRules(rel, lines, tree).run()
     # collapse repeats on one line (e.g. three id() calls in one tuple)
@@ -824,4 +892,4 @@ def new_findings(findings: list, baseline: set) -> list:
 __all__ = ["Finding", "lint_source", "lint_tree", "load_baseline",
            "new_findings", "TRACED_MODULES", "HOT_PATH_MODULES",
            "LOCK_MODULES", "RETRY_MODULE_PREFIXES",
-           "COMPILECACHE_PREFIX"]
+           "COMPILECACHE_PREFIX", "PALLAS_PREFIX"]

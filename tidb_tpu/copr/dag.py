@@ -113,13 +113,70 @@ class GroupStrategy(enum.Enum):
     SEGMENT = "segment"  # hash -> radix bucket partition + segment reduce
                          # (high NDV: one single-key sort regardless of key
                          # arity, bucket count from stats/copcost)
+    SCATTER = "scatter"  # hash -> MULTI-PASS scatter radix partition +
+                         # segment reduce (copr/radix.py): per-pass bucket
+                         # histogram + exclusive-cumsum offsets + stable
+                         # gather/scatter reorder, O(passes*n) data
+                         # movement instead of lax.sort's O(n log n)
+                         # comparator lanes; optional Pallas TPU kernel
+                         # for the fused histogram+scatter inner loop
 
 
 # strategies whose per-device group tables merge HOST-side (per-device
 # group sets are not aligned, so there is no elementwise collective
 # merge); consumers: spmd/shuffle host_merge policy, the client's
 # regrow loop, contracts/fusion classes
-HOST_MERGE_STRATEGIES = (GroupStrategy.SORT, GroupStrategy.SEGMENT)
+HOST_MERGE_STRATEGIES = (GroupStrategy.SORT, GroupStrategy.SEGMENT,
+                         GroupStrategy.SCATTER)
+
+# strategies whose per-device group table is a pow2 `num_buckets` radix
+# space regrown from observed __ngroups__ (the hash-partitioned pair)
+RADIX_STRATEGIES = (GroupStrategy.SEGMENT, GroupStrategy.SCATTER)
+
+# SCATTER radix geometry (jax-free so contracts/copcost can price passes
+# without importing the kernel module): each pass orders RADIX_BITS of
+# the partition key — the Pallas kernel as one 2^RADIX_BITS-digit
+# histogram+scatter counting sort, the XLA lowering as RADIX_BITS 1-bit
+# stable partition subpasses (identical stable permutation either way).
+RADIX_BITS = 8
+# residual hash bits ordered BELOW the log2(B) bucket bits: two groups
+# colliding in the bucket bits alone would interleave into per-run
+# duplicate segments (the table overflows toward O(rows) at modest
+# NDV); eight residual bits cut that collision space 256x for under one
+# extra pass, so observed __ngroups__ stays ~NDV like SEGMENT's
+# full-hash ordering.  Remaining collisions are the usual duplicates,
+# merged host-side by true key equality.
+RADIX_RESIDUAL_BITS = 8
+# the partition key must fit int32 (kernel lanes): bucket + residual
+# bits clamp to 30, plus one dead-row tail bit above them
+RADIX_KEY_BITS_MAX = 30
+# rows per kernel grid step (copr/pallas/radix_kernel.TILE reads this):
+# sizes the per-tile histogram/offset arrays both on device and in the
+# copcost pricing, so the model and the kernel agree by construction
+RADIX_TILE = 512
+# contract ceiling on the pass count: above this the partition does more
+# full-data passes than the comparator sort it replaces would ever pay —
+# a malformed (astronomically regrown) bucket space, rejected pre-trace
+# and surfaced as a COST-RADIX-PASSES gate finding
+MAX_RADIX_PASSES = 8
+
+
+def radix_key_bits(num_buckets: int) -> int:
+    """Ordered partition-key bits for a pow2 bucket space: log2(B)
+    bucket bits + residual bits (int32-clamped) + the dead-row tail
+    bit.  Shared by the kernels, copcost pricing, and contracts."""
+    log2b = max(int(num_buckets - 1).bit_length(), 0)
+    return min(log2b + RADIX_RESIDUAL_BITS, RADIX_KEY_BITS_MAX) + 1
+
+
+def radix_passes(num_buckets: int) -> int:
+    """Scatter-partition pass count, RADIX_BITS digit bits per pass.
+    The copcost pricing, the contract ceiling, the fusion signature,
+    and the kernels all share this one formula.  Computed from the raw
+    (unclamped) bit span so an absurd bucket space PRICES absurd —
+    the COST-RADIX-PASSES / capacity-shape seam."""
+    log2b = max(int(num_buckets - 1).bit_length(), 0)
+    return -(-(log2b + RADIX_RESIDUAL_BITS + 1) // RADIX_BITS)
 
 
 @dataclass(frozen=True)
@@ -138,6 +195,13 @@ class Aggregation(CopNode):
     (residual hash ordering inside each bucket comes free), and each
     bucket's runs segment-reduce into a (num_buckets,) state table
     (copr/segment.py).
+    SCATTER strategy replaces that single giant sort with a multi-pass
+    scatter radix partition (copr/radix.py): radix_passes(num_buckets)
+    stable counting-sort passes (histogram + exclusive cumsum + scatter
+    reorder) order rows bucket-major in O(passes*n) data movement.
+    `prehashed` (SEGMENT/SCATTER): the LAST scan column carries the
+    precomputed per-row key hash, so bucket-space regrow re-entries skip
+    re-hashing the key tuple (store/client hoists it once per statement).
     """
     child: CopNode = None  # type: ignore[assignment]
     group_by: Tuple[Expr, ...] = ()
@@ -145,8 +209,10 @@ class Aggregation(CopNode):
     strategy: GroupStrategy = GroupStrategy.SCALAR
     domain_sizes: Tuple[int, ...] = ()   # DENSE only, aligned with group_by
     group_capacity: int = 0              # SORT only: max distinct groups/shard
-    num_buckets: int = 0                 # SEGMENT only: pow2 radix space =
-                                         # state-table capacity per device
+    num_buckets: int = 0                 # SEGMENT/SCATTER: pow2 radix space
+                                         # = state-table capacity per device
+    prehashed: bool = False              # SEGMENT/SCATTER: last scan column
+                                         # is the hoisted int64 key hash
 
     def children(self):
         return (self.child,)
@@ -162,7 +228,7 @@ class Aggregation(CopNode):
     def state_capacity(self) -> int:
         """Per-device group-table capacity of a host-merged strategy."""
         return (self.num_buckets
-                if self.strategy is GroupStrategy.SEGMENT
+                if self.strategy in RADIX_STRATEGIES
                 else self.group_capacity)
 
 
@@ -441,7 +507,9 @@ def dag_digest(node: CopNode) -> int:
 
 __all__ = [
     "AggFunc", "AggDesc", "CopNode", "TableScan", "Selection", "Projection",
-    "Expand", "GroupStrategy", "HOST_MERGE_STRATEGIES", "Aggregation",
+    "Expand", "GroupStrategy", "HOST_MERGE_STRATEGIES", "RADIX_STRATEGIES",
+    "RADIX_BITS", "RADIX_RESIDUAL_BITS", "MAX_RADIX_PASSES",
+    "radix_passes", "radix_key_bits", "Aggregation",
     "TopN", "Limit", "LookupJoin",
     "FusedDag", "ShuffleJoinSpec", "output_dtypes", "dag_digest",
     "iter_nodes", "find_expand_join", "rewrite_lookup", "drop_lookup",

@@ -243,8 +243,15 @@ def _agg_partial_states(agg: D.Aggregation, batch: DeviceBatch, ev: Evaluator,
     SEGMENT: the high-NDV refinement — keys avalanche-hash into one
     uint64 radix space, a SINGLE-key partition pass buckets rows, and
     each bucket's runs segment-reduce (copr/segment.py).
+    SCATTER: SEGMENT with the giant sort replaced by a multi-pass
+    scatter radix partition — histogram + exclusive cumsum + stable
+    scatter reorder per pass, O(passes*n) data movement, optionally a
+    Pallas TPU kernel for the inner loop (copr/radix.py).
     Adds '__rows__' (COUNT(*) per group) for occupancy.
     """
+    if agg.strategy == D.GroupStrategy.SCATTER:
+        from .radix import agg_scatter_states
+        return agg_scatter_states(agg, batch, ev, memo)
     if agg.strategy == D.GroupStrategy.SEGMENT:
         from .segment import agg_segment_states
         return agg_segment_states(agg, batch, ev, memo)
@@ -625,14 +632,19 @@ def _find_agg(node: D.CopNode) -> Optional[D.Aggregation]:
 
 
 @functools.lru_cache(maxsize=256)
-def _cached_program(dag_root: D.CopNode, row_capacity: int) -> CopProgram:
+def _cached_program(dag_root: D.CopNode, row_capacity: int,
+                    radix_token: str) -> CopProgram:
+    del radix_token          # key component only (Pallas-gate variant)
     return CopProgram(dag_root, row_capacity)
 
 
 def get_program(dag_root: D.CopNode, row_capacity: int = 0) -> CopProgram:
     """jit-program cache keyed on (dag digest, capacity) — the analog of the
-    coprocessor cache + plan-digest jit cache (SURVEY.md §A.6)."""
-    return _cached_program(dag_root, row_capacity)
+    coprocessor cache + plan-digest jit cache (SURVEY.md §A.6).  SCATTER
+    programs additionally key on the Pallas-gate mode: the lowering is
+    baked in at trace time, so a sysvar flip must build a fresh program."""
+    from .radix import cache_token
+    return _cached_program(dag_root, row_capacity, cache_token(dag_root))
 
 
 __all__ = ["DeviceBatch", "CopProgram", "get_program", "compact",

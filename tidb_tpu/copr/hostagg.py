@@ -1,4 +1,5 @@
-"""Host (CPU) execution of SORT/SEGMENT-strategy group-by aggregation.
+"""Host (CPU) execution of SORT/SEGMENT/SCATTER-strategy group-by
+aggregation.
 
 Per-platform engine choice (VERDICT r2 #2): the reference aggregates
 high-NDV group-by with a CPU hash table (parallel HashAgg,

@@ -1,0 +1,14 @@
+"""Hand-written Pallas TPU kernels for coprocessor hot paths.
+
+This package holds the repo's Pallas kernels — established by the
+SCATTER radix-partition kernel (radix_kernel.py) and gated module-wide
+by the TPU-PALLAS-SHAPE lint rule (analysis/lint.py): kernel bodies
+here must keep static grid/block shapes and never reach for host
+callbacks, the two patterns that silently destroy TPU kernel
+performance or portability.  Every kernel must be exercisable through
+Pallas INTERPRET mode so tier-1 covers the kernel path on the CPU mesh.
+"""
+
+from .radix_kernel import TILE, counting_sort_pass
+
+__all__ = ["TILE", "counting_sort_pass"]

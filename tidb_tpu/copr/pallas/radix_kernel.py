@@ -1,0 +1,117 @@
+"""Pallas TPU kernels for the scatter radix partition inner loop.
+
+The repo's FIRST Pallas kernels — the pattern-setter for every future
+hot-path kernel (ROADMAP: "hand-write the histogram/scatter inner loop
+as a Pallas TPU kernel").  One priced radix pass = one stable counting
+sort over a 2^RADIX_BITS-digit space, split into the two kernels below
+(see /opt accelerator guide: VMEM-tiled, VPU-shaped one-hot compute,
+static grids):
+
+- ``_hist_kernel``: per-tile digit histogram.  Each grid step loads one
+  (TILE,) digit block into VMEM and reduces a (TILE, N_DIGITS) one-hot
+  compare along the tile axis — pure VPU work, no scatter.
+- ``_scatter_kernel``: the FUSED histogram+scatter inner loop.  Each
+  grid step recomputes its tile's one-hot (cheaper in-register than a
+  second HBM round-trip), turns the running cumsum into stable
+  within-tile ranks, adds the tile's exclusive digit base offsets, and
+  stores the permutation values at their final positions.
+
+Between the kernels sits one exclusive cumsum over the tiny
+(N_DIGITS * n_tiles,) histogram — digit-major so tile t's digit-d rows
+land after every earlier tile's digit-d rows: stability across tiles,
+which is what makes the multi-pass LSD composition a true sort and
+keeps the result bit-identical to the XLA 1-bit lowering
+(copr/radix._partition_xla).
+
+Interpret mode (``interpret=True``) runs the SAME kernel bodies through
+the Pallas interpreter — tier-1 exercises this path on the CPU mesh, so
+the kernels are tested without TPU hardware; compiled mode is the
+real-TPU hardware-window follow-up recorded in TPU_ATTEMPTS.jsonl.
+
+Shape discipline (TPU-PALLAS-SHAPE gate rule): every grid and block
+shape below is static — derived from the padded row count and the
+module constants, never from traced values — and nothing here may call
+back into the host.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ..dag import RADIX_BITS, RADIX_TILE
+
+# rows per grid step: one VMEM-resident block of digits/values.  At 512
+# the (TILE, N_DIGITS) one-hot is 512KiB of int32 lanes — comfortably
+# inside VMEM next to the value block — while amortizing grid overhead;
+# the constant lives in copr/dag so copcost prices the same tiling.
+TILE = RADIX_TILE
+N_DIGITS = 1 << RADIX_BITS
+
+
+def _hist_kernel(dig_ref, hist_ref):
+    """Per-tile digit histogram: (TILE,) digits -> (1, N_DIGITS) counts
+    via a one-hot compare + tile-axis sum (VPU-shaped, no scatter)."""
+    digs = dig_ref[:]
+    onehot = digs[:, None] == jax.lax.broadcasted_iota(
+        jnp.int32, (TILE, N_DIGITS), 1)
+    hist_ref[0, :] = jnp.sum(onehot, axis=0, dtype=jnp.int32)
+
+
+def _scatter_kernel(dig_ref, val_ref, off_ref, out_ref):
+    """Fused histogram+scatter: recompute the tile's one-hot, derive
+    stable within-tile ranks from its running cumsum, and store each
+    value at base_offset[digit] + rank — the reorder half of one
+    counting-sort pass."""
+    digs = dig_ref[:]
+    vals = val_ref[:]
+    base = off_ref[0, :]
+    onehot = digs[:, None] == jax.lax.broadcasted_iota(
+        jnp.int32, (TILE, N_DIGITS), 1)
+    oh = onehot.astype(jnp.int32)
+    rank = jnp.cumsum(oh, axis=0, dtype=jnp.int32) - oh  # exclusive/digit
+    within = jnp.sum(jnp.where(onehot, rank, 0), axis=1, dtype=jnp.int32)
+    pos = base[digs] + within
+
+    def body(i, carry):
+        out_ref[pos[i]] = vals[i]
+        return carry
+
+    jax.lax.fori_loop(0, TILE, body, 0)
+
+
+def counting_sort_pass(dig, val, interpret: bool = False):
+    """One stable counting-sort pass: reorder ``val`` by the N_DIGITS-
+    valued ``dig`` keys, preserving order within equal digits.  Row
+    count must be a TILE multiple (copr/radix pads with a tail key).
+    Returns the reordered values; composing passes LSB-digit-first
+    yields the stable LSD radix sort of the full bucket id."""
+    n = dig.shape[0]
+    n_tiles = n // TILE
+    hist = pl.pallas_call(
+        _hist_kernel,
+        grid=(n_tiles,),
+        in_specs=[pl.BlockSpec((TILE,), lambda t: (t,))],
+        out_specs=pl.BlockSpec((1, N_DIGITS), lambda t: (t, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_tiles, N_DIGITS), jnp.int32),
+        interpret=interpret,
+    )(dig)
+    # exclusive cumsum over (digit, tile)-major counts: digit d's tile t
+    # base = all smaller digits + digit d's earlier tiles (stability)
+    flat = hist.T.reshape(-1)
+    offs = (jnp.cumsum(flat, dtype=jnp.int32) - flat).reshape(
+        N_DIGITS, n_tiles).T
+    return pl.pallas_call(
+        _scatter_kernel,
+        grid=(n_tiles,),
+        in_specs=[pl.BlockSpec((TILE,), lambda t: (t,)),
+                  pl.BlockSpec((TILE,), lambda t: (t,)),
+                  pl.BlockSpec((1, N_DIGITS), lambda t: (t, 0))],
+        out_specs=pl.BlockSpec((n,), lambda t: (0,)),
+        out_shape=jax.ShapeDtypeStruct((n,), val.dtype),
+        interpret=interpret,
+    )(dig, val, offs)
+
+
+__all__ = ["TILE", "N_DIGITS", "counting_sort_pass"]

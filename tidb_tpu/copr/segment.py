@@ -21,7 +21,9 @@ Algorithm (per device, one traced program, static shapes throughout):
    id over the power-of-two bucket space the planner/copcost derived
    from stats NDV, so partitioning rows bucket-major and ordering each
    bucket's residual key space happen in a single single-key partition
-   pass — regardless of group-key arity.
+   pass — regardless of group-key arity.  A ``prehashed`` aggregation
+   reads the hash from its LAST scan column instead (the client hoists
+   hashing out of the bucket-space regrow loop, store/client).
 3. Segment boundaries fall where the hash or any true key code/null flag
    changes between adjacent live rows.  The code comparison makes a
    64-bit hash collision produce DUPLICATE partial groups, never merged
@@ -32,6 +34,11 @@ Algorithm (per device, one traced program, static shapes throughout):
    scatters) into a (num_buckets,) state table; ``__ngroups__`` reports
    the true distinct count so the dispatcher regrows ``num_buckets`` and
    re-runs on overflow — the paging analog (SURVEY.md §5.7).
+
+The partition-to-states suffix (boundary detect + scatter-reduce) is
+shared with the SCATTER strategy (copr/radix.py), which replaces the
+single giant ``lax.sort`` of step 2-3 with a multi-pass scatter radix
+partition — same state layout, same collision-to-duplicate contract.
 
 Like SORT, the per-device tables merge HOST-side with the stacked shard
 layout of parallel/spmd.py (per-device group sets are unaligned — no
@@ -57,6 +64,12 @@ _S30 = np.uint64(30)
 _S27 = np.uint64(27)
 _S31 = np.uint64(31)
 
+# trace-count observability for the prehash satellite: every TRACE of
+# the avalanche hash bumps this (tests pin that a bucket-space regrow
+# sequence hashes the key tuple exactly once — in the hoisted hash
+# program, not once per capacity re-entry)
+HASH_TRACES = [0]
+
 
 def _finalize64(z):
     """splitmix64 avalanche: every input bit reaches every output bit,
@@ -71,6 +84,7 @@ def key_hash(keyinfo, n):
     NULL flags fold in (a NULL key and a zero key must land in
     different buckets with overwhelming probability; exactness does not
     depend on it — boundary detection compares flags too)."""
+    HASH_TRACES[0] += 1
     h = jnp.full((n,), _GOLDEN, jnp.uint64)
     for _vz, m, nullf, code in keyinfo:
         cu = code.astype(jnp.uint64)
@@ -80,30 +94,29 @@ def key_hash(keyinfo, n):
     return h
 
 
-def agg_segment_states(agg: D.Aggregation, batch, ev, memo) -> dict:
-    """SEGMENT-strategy per-device partial states: radix-partition rows
-    by hash bucket, segment-reduce each bucket's key runs into a
-    (num_buckets,) group table.  Same state layout as the SORT path
-    (k{j} val/valid, a{i}, __rows__, __ngroups__) so merge/finalize and
-    the regrow loop stay one code path."""
-    from .exec import (_ensure_array, _one_agg_state, _reduce, _sel_array,
-                       group_keyinfo)
+def batch_hash(agg: D.Aggregation, batch, keyinfo, n):
+    """Per-row uint64 key hash: the hoisted LAST scan column when the
+    aggregation is ``prehashed`` (store/client computes it once per
+    statement so regrow re-entries skip the k-key avalanche chain),
+    else freshly avalanched from the canonical key tuple."""
+    if agg.prehashed:
+        hv = batch.cols[-1][0]
+        # stored as int64 (device column dtype); two's-complement cast
+        # restores the original uint64 bit pattern exactly
+        return hv.astype(jnp.uint64)
+    return key_hash(keyinfo, n)
+
+
+def states_from_partition(agg: D.Aggregation, batch, ev, keyinfo,
+                          hv_s, idx, sel_s, n) -> dict:
+    """Shared partition->states suffix of the SEGMENT and SCATTER
+    strategies: given rows permuted bucket-major by ``idx`` (with the
+    permuted hash ``hv_s`` and live mask ``sel_s``), detect segment
+    boundaries where the hash OR any true key code/null flag changes
+    (the collision-to-duplicate guarantee) and scatter-reduce each
+    segment into a (num_buckets,) state table."""
+    from .exec import _ensure_array, _one_agg_state, _reduce
     B = agg.num_buckets
-    assert B > 0 and (B & (B - 1)) == 0, \
-        "SEGMENT aggregation needs a power-of-two num_buckets"
-    n = len(batch.cols[0][0]) if batch.cols else 0
-    sel = _sel_array(batch.sel, n)
-
-    keyinfo = group_keyinfo(agg, batch, ev, memo, n)
-    hv = key_hash(keyinfo, n).astype(jnp.int64)
-    # dead rows park at the tail; a live row hashing to INT64_MAX merely
-    # interleaves with them, and its gids stay correct via sel_s below
-    hv = jnp.where(sel, hv, INT64_MAX)
-    # the radix partition pass: ONE single-key sort orders rows by
-    # (bucket id = top bits, residual hash = low bits) at once
-    hv_s, idx = lax.sort((hv, jnp.arange(n, dtype=jnp.int64)), num_keys=1)
-    sel_s = sel[idx]
-
     # segment boundary: live row whose hash OR any true key differs from
     # the previous row (the collision-to-duplicate guarantee)
     diff = jnp.arange(n, dtype=jnp.int64) == 0
@@ -141,4 +154,32 @@ def agg_segment_states(agg: D.Aggregation, batch, ev, memo) -> dict:
     return states
 
 
-__all__ = ["agg_segment_states", "key_hash"]
+def agg_segment_states(agg: D.Aggregation, batch, ev, memo) -> dict:
+    """SEGMENT-strategy per-device partial states: radix-partition rows
+    by hash bucket via ONE single-key ``lax.sort``, segment-reduce each
+    bucket's key runs into a (num_buckets,) group table.  Same state
+    layout as the SORT path (k{j} val/valid, a{i}, __rows__,
+    __ngroups__) so merge/finalize and the regrow loop stay one code
+    path."""
+    from .exec import _sel_array, group_keyinfo
+    B = agg.num_buckets
+    assert B > 0 and (B & (B - 1)) == 0, \
+        "SEGMENT aggregation needs a power-of-two num_buckets"
+    n = len(batch.cols[0][0]) if batch.cols else 0
+    sel = _sel_array(batch.sel, n)
+
+    keyinfo = group_keyinfo(agg, batch, ev, memo, n)
+    hv = batch_hash(agg, batch, keyinfo, n).astype(jnp.int64)
+    # dead rows park at the tail; a live row hashing to INT64_MAX merely
+    # interleaves with them, and its gids stay correct via sel_s below
+    hv = jnp.where(sel, hv, INT64_MAX)
+    # the radix partition pass: ONE single-key sort orders rows by
+    # (bucket id = top bits, residual hash = low bits) at once
+    hv_s, idx = lax.sort((hv, jnp.arange(n, dtype=jnp.int64)), num_keys=1)
+    sel_s = sel[idx]
+    return states_from_partition(agg, batch, ev, keyinfo, hv_s, idx,
+                                 sel_s, n)
+
+
+__all__ = ["agg_segment_states", "key_hash", "batch_hash",
+           "states_from_partition", "HASH_TRACES"]

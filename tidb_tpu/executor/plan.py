@@ -1225,13 +1225,17 @@ def _bind_agg(agg: LogicalAggregate, child: D.CopNode, dicts,
         # still bounds NDV when stats are absent
         known_total = total
 
-    # SORT / SEGMENT for everything else orderable: device partition +
-    # segment-reduce handles arbitrary NDV (the reference's high-NDV
-    # parallel HashAgg, agg_hash_executor.go:94, re-designed for TPU —
-    # SURVEY.md §7 hard part 4: sort-based group-by beats hashing on TPU).
-    # Above SEGMENT_MIN_NDV estimated groups the radix-partitioned
-    # SEGMENT strategy wins: one single-key partition lane instead of the
-    # SORT comparator's 1 + 2*k.
+    # SORT / SEGMENT / SCATTER for everything else orderable: device
+    # partition + segment-reduce handles arbitrary NDV (the reference's
+    # high-NDV parallel HashAgg, agg_hash_executor.go:94, re-designed for
+    # TPU — SURVEY.md §7 hard part 4: sort-based group-by beats hashing
+    # on TPU).  Above SEGMENT_MIN_NDV estimated groups the radix-
+    # partitioned strategies win (one single-key partition lane instead
+    # of the SORT comparator's 1 + 2*k); between them — and SORT —
+    # selection is ARBITRATED per digest: the static copcost model
+    # prices each candidate and PR 10's calibration store bends each
+    # prediction by its measured time_factor, so a digest measured fast
+    # on real hardware flips selection with no code change.
     metas = []
     lowered = []
     for g in agg.group_exprs:
@@ -1252,11 +1256,59 @@ def _bind_agg(agg: LogicalAggregate, child: D.CopNode, dicts,
     if cap == 0 and known_total:
         cap = _cap_pow2(known_total)
     if cap >= SEGMENT_MIN_NDV:
-        return D.Aggregation(child, tuple(lowered), tuple(descs),
-                             D.GroupStrategy.SEGMENT, num_buckets=cap)
+        candidates = (
+            D.Aggregation(child, tuple(lowered), tuple(descs),
+                          D.GroupStrategy.SCATTER, num_buckets=cap),
+            D.Aggregation(child, tuple(lowered), tuple(descs),
+                          D.GroupStrategy.SEGMENT, num_buckets=cap),
+            D.Aggregation(child, tuple(lowered), tuple(descs),
+                          D.GroupStrategy.SORT, group_capacity=cap),
+        )
+        return _arbitrate_strategy(candidates, ds)
     return D.Aggregation(child, tuple(lowered), tuple(descs),
                          D.GroupStrategy.SORT,
                          group_capacity=cap)
+
+
+# plan-time device count for strategy arbitration: the same 8-vdev
+# convention every plan-level copcost consumer uses (plan_cost default)
+_ARBITRATE_DEVICES = 8
+
+
+def _arbitrate_strategy(candidates, ds) -> D.Aggregation:
+    """Calibration-arbitrated high-NDV strategy choice: price every
+    candidate dag with the static copcost walk over the table's real
+    layout (a nominal one when stats/snapshot are unavailable), bend
+    each prediction by the candidate digest's MEASURED time_factor
+    (analysis/calibrate.arbitrated_ms, clamped), pick the cheapest —
+    first wins ties, so the declaration order (SCATTER, SEGMENT, SORT)
+    is the static preference.  Any pricing failure falls back to the
+    first candidate rather than failing the plan."""
+    try:
+        from ..analysis.calibrate import arbitrated_ms
+        from ..analysis.compilekey import stable_digest
+        from ..analysis.copcost import (Layout, dag_cost, snapshot_layout,
+                                        snapshot_scan_widths)
+        layout = widths = None
+        if ds is not None:
+            try:
+                snap = ds.table.snapshot()
+                layout = snapshot_layout(snap, _ARBITRATE_DEVICES)
+                widths = snapshot_scan_widths(snap)
+            except (AttributeError, TypeError, ValueError):
+                layout = widths = None
+        if layout is None:
+            layout = Layout(_ARBITRATE_DEVICES, 1 << 18,
+                            _ARBITRATE_DEVICES, 1 << 21)
+        best, best_ms = candidates[0], None
+        for dag in candidates:
+            ms = arbitrated_ms(stable_digest(dag),
+                               dag_cost(dag, layout, widths))
+            if best_ms is None or ms < best_ms:
+                best, best_ms = dag, ms
+        return best
+    except (ImportError, AttributeError, TypeError, ValueError):
+        return candidates[0]
 
 
 def _cap_pow2(total: int) -> int:

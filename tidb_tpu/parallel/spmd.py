@@ -30,6 +30,7 @@ from ..copr import dag as D
 from ..copr.aggregate import _MERGE
 from ..copr.exec import (DeviceBatch, _agg_partial_states, _exec_node,
                          agg_states, compact)
+from ..copr.radix import cache_token as _radix_token
 from ..expr.compile import Evaluator
 from .mesh import SHARD_AXIS, shard_map
 
@@ -151,10 +152,15 @@ class ShardedCopProgram:
         # copforge (compilecache): calls resolve through the AOT program
         # cache — warm-pool/persisted executables serve without tracing,
         # misses stage via jit.lower(...).compile() and persist.  The
-        # raw jit object stays on _fn for AOT introspection.
+        # raw jit object stays on _fn for AOT introspection.  SCATTER
+        # programs carry the Pallas-gate mode in their variant key: the
+        # lowering is baked in at trace time, so a sysvar flip must not
+        # serve the other lowering's executable.
+        tok = _radix_token(dag_root)
         self._cached = cached_call(self._fn, dag_root, mesh, "solo",
                                    row_capacity=row_capacity,
-                                   donate_argnums=self._donate_argnums)
+                                   donate_argnums=self._donate_argnums,
+                                   extra=(tok,) if tok else ())
 
     def _device_fn(self, cols, counts, aux):
         from ..copr.exec import set_trace_platform
@@ -197,15 +203,18 @@ class ShardedCopProgram:
 
 
 @functools.lru_cache(maxsize=256)
-def _cached(dag_root, mesh, row_capacity, donate):
+def _cached(dag_root, mesh, row_capacity, donate, radix_token):
+    del radix_token          # key component only (Pallas-gate variant)
     return ShardedCopProgram(dag_root, mesh, row_capacity, donate)
 
 
 def get_sharded_program(dag_root: D.CopNode, mesh, row_capacity: int = 0,
                         donate: bool = False) -> ShardedCopProgram:
     # the donating variant caches apart: donation is baked into the
-    # jitted executable's input aliasing
-    return _cached(dag_root, mesh, row_capacity, True if donate else False)
+    # jitted executable's input aliasing; SCATTER dags additionally key
+    # on the Pallas-gate mode (lowering baked in at trace time)
+    return _cached(dag_root, mesh, row_capacity, True if donate else False,
+                   _radix_token(dag_root))
 
 
 class FusedCopProgram:
@@ -264,8 +273,10 @@ class FusedCopProgram:
         self._fn = jax.jit(shard_map(
             self._device_fn, mesh=mesh, in_specs=in_specs,
             out_specs=out_specs), donate_argnums=self._donate_argnums)
+        tok = _radix_token(fused)
         self._cached = cached_call(self._fn, fused, mesh, "fused",
-                                   donate_argnums=self._donate_argnums)
+                                   donate_argnums=self._donate_argnums,
+                                   extra=(tok,) if tok else ())
 
     def _device_fn(self, cols, counts, aux):
         # each member re-traces its chain over the SAME input refs; XLA
@@ -284,13 +295,15 @@ class FusedCopProgram:
 
 
 @functools.lru_cache(maxsize=64)
-def _cached_fused(fused, mesh, donate):
+def _cached_fused(fused, mesh, donate, radix_token):
+    del radix_token          # key component only (Pallas-gate variant)
     return FusedCopProgram(fused, mesh, donate)
 
 
 def get_fused_program(fused: D.FusedDag, mesh,
                       donate: bool = False) -> FusedCopProgram:
-    return _cached_fused(fused, mesh, True if donate else False)
+    return _cached_fused(fused, mesh, True if donate else False,
+                         _radix_token(fused))
 
 
 class FusedRowsProgram:

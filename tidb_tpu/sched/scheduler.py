@@ -436,7 +436,8 @@ class DeviceScheduler:
                 "dense-blowup", p,
                 f"DENSE aggregation at {path} holds {groups} group "
                 f"states for {rows} per-device rows — degenerate "
-                "large-NDV dense domain; use GroupStrategy.SEGMENT")
+                "large-NDV dense domain; use a radix strategy "
+                "(GroupStrategy.SEGMENT/SCATTER)")
         budget = self.effective_budget(task.mesh)
         if budget > 0 and cost.peak_hbm_bytes > budget:
             with self._mu:

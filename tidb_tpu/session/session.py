@@ -1166,6 +1166,14 @@ class Session:
         v14 = merged.get("tidb_tpu_cost_calibration")
         if v14 is not None and v14 != "":
             client.calibration = bool(int(v14))
+        # SCATTER radix-partition Pallas gate (copr/radix): auto = the
+        # hand-written Pallas kernels on TPU backends, the XLA lowering
+        # elsewhere; on = Pallas everywhere (interpret mode off-TPU —
+        # the tier-1 kernel-path seam); off = XLA everywhere
+        v15 = merged.get("tidb_tpu_radix_pallas")
+        if v15 is not None and v15 != "":
+            from ..copr import radix as _radix
+            _radix.set_pallas_mode(str(v15))
         # copforge AOT compile cache (compilecache/): enable/dir/pool
         # knobs, then the idempotent boot warm-start hook — the first
         # statement after a cache dir lands kicks the background
@@ -1310,6 +1318,11 @@ class Session:
                 if dag is None:
                     dag = getattr(getattr(op, "spec", None), "top", None)
                 if isinstance(dag, Dg.Aggregation) and dag.group_by:
+                    if dag.strategy is Dg.GroupStrategy.SCATTER:
+                        return (f"agg strategy: scatter "
+                                f"({dag.num_buckets} buckets, "
+                                f"{Dg.radix_passes(dag.num_buckets)} "
+                                "passes)")
                     if dag.strategy is Dg.GroupStrategy.SEGMENT:
                         return (f"agg strategy: segment "
                                 f"({dag.num_buckets} buckets)")

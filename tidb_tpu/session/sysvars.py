@@ -99,6 +99,11 @@ _VARS = [
     # micro-batch window, and deadline-aware early shedding.  Off = the
     # static model untouched, no feedback recorded.
     _v("tidb_tpu_cost_calibration", 1, kind="bool", scope=SCOPE_GLOBAL),
+    # SCATTER radix-partition Pallas gate (copr/radix + copr/pallas):
+    # auto = hand-written Pallas kernels on TPU, XLA lowering elsewhere;
+    # on = Pallas everywhere (interpret mode off-TPU, the tier-1 kernel
+    # seam); off = XLA lowering everywhere
+    _v("tidb_tpu_radix_pallas", "auto", kind="str", scope=SCOPE_GLOBAL),
     _v("tidb_distsql_scan_concurrency", 15, kind="int", min=1, max=256),
     _v("tidb_max_chunk_size", 1024, kind="int", min=32, max=65536),
     _v("tidb_enable_vectorized_expression", 1, kind="bool"),

@@ -552,13 +552,15 @@ class CopClient:
     @staticmethod
     def _with_capacity(agg: D.Aggregation, cap: int) -> D.Aggregation:
         """Rebuild a host-merged aggregation with a new per-device group
-        table capacity: SORT sizes group_capacity directly, SEGMENT its
-        power-of-two radix bucket space (the regrow knob)."""
+        table capacity: SORT sizes group_capacity directly (pow2, so the
+        capacity lands in a shared fusion shape class), SEGMENT/SCATTER
+        their power-of-two radix bucket space (the regrow knob)."""
         import dataclasses
-        if agg.strategy == D.GroupStrategy.SEGMENT:
+        if agg.strategy in D.RADIX_STRATEGIES:
             return dataclasses.replace(agg,
                                        num_buckets=_pow2_at_least(cap))
-        return dataclasses.replace(agg, group_capacity=cap)
+        return dataclasses.replace(agg,
+                                   group_capacity=_pow2_at_least(cap))
 
     def _stream_sort_agg(self, agg, batches, key_meta) -> CopResult:
         cap = self._warm_cap(agg, agg.state_capacity
@@ -624,10 +626,27 @@ class CopClient:
 
     def _execute_sort_agg(self, agg, cols, counts, key_meta,
                           aux_cols) -> CopResult:
-        """High-NDV group-by (SORT / SEGMENT): per-device partition +
-        segment-reduce group tables, regrown when a device sees more
-        distinct groups than capacity (the paging grow-from-min analog),
-        then host final merge."""
+        """High-NDV group-by (SORT / SEGMENT / SCATTER): per-device
+        partition + segment-reduce group tables, regrown when a device
+        sees more distinct groups than capacity (the paging grow-from-
+        min analog), then host final merge."""
+        # prehash hoist (copr/radix): the avalanche key hash does not
+        # depend on the bucket space, so for radix strategies it is
+        # computed ONCE by a tiny sharded hash program and appended as
+        # an extra scan column — every regrow re-entry (a fresh program
+        # at a bigger num_buckets) reuses the hashed keys instead of
+        # re-hashing the key tuple per capacity
+        if agg.strategy in D.RADIX_STRATEGIES and not aux_cols \
+                and not agg.prehashed:
+            from ..copr import radix
+            pre = radix.prehash_plan(agg, len(cols))
+            if pre is not None:
+                hashed_dag, leaf_scan = pre
+                hprog = radix.get_hash_program(leaf_scan, agg.group_by,
+                                               self.mesh)
+                hv = self._launch_opaque(lambda: hprog(cols, counts))
+                cols = list(cols) + [(hv, None)]
+                agg = hashed_dag
         cap = self._warm_cap(agg, agg.state_capacity
                              or DEFAULT_GROUP_CAPACITY)
         for _ in range(10):
