@@ -395,6 +395,16 @@ def mode_sched():
     coalesce rate, cross-query fusion rate, and p50/p99 schedWait."""
     import threading
 
+    # the scenario models the 8-vdev mesh: request the virtual devices
+    # BEFORE the first jax/backend import (a 1-device CPU env would
+    # otherwise run the whole scenario — and its per-link transfer
+    # attribution, which needs chip peers to exist — on one chip)
+    if "xla_force_host_platform_device_count" \
+            not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8").strip()
+
     from tidb_tpu.session import Domain, Session
 
     n_stmts = int(os.environ.get("BENCH_SCHED_STMTS", "240"))
@@ -486,6 +496,14 @@ def mode_sched():
         # streamed-batch launches that aliased inputs into outputs
         "donated_launches": st.get("donated_launches", 0),
         "donated_bytes": st.get("donated_bytes", 0),
+        # per-link transfer attribution (shardflow, parallel/topology):
+        # statically-classified collective bytes of every served task —
+        # the ROADMAP multi-host success metric's static half (under the
+        # declared tidb_tpu_topology_hosts view; single-host => dci 0)
+        "transfer_breakdown": {
+            "ici": st.get("transfer_ici_bytes", 0),
+            "dci": st.get("transfer_dci_bytes", 0),
+        },
     }
     out["rc"] = _sched_rc_scenario(dom, s, sched, queries[0])
     out["chaos"] = _sched_chaos_scenario(dom, s, sched, queries)

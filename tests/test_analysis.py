@@ -89,11 +89,14 @@ def test_explain_reports_contract_ok(corpus):
     s, _plans = corpus
     rows = s.must_query(
         "explain select count(*) from lineitem where l_quantity < 5")
-    # footer order: contract verdict, the static cost estimate, then
-    # the calibration verdict (copmeter, ISSUE 10)
-    assert rows[-3][0] == "contract: ok", rows
-    assert rows[-2][0].startswith("est. device bytes: "), rows
-    assert "padding" in rows[-2][0], rows
+    # footer order: contract verdict, the static cost estimate, the
+    # per-link transfer line (shardflow, ISSUE 12), then the
+    # calibration verdict (copmeter, ISSUE 10)
+    assert rows[-4][0] == "contract: ok", rows
+    assert rows[-3][0].startswith("est. device bytes: "), rows
+    assert "padding" in rows[-3][0], rows
+    assert rows[-2][0].startswith("transfer: "), rows
+    assert "ici" in rows[-2][0] and "dci" in rows[-2][0], rows
     assert rows[-1][0].startswith("cost: "), rows
 
 
@@ -343,10 +346,10 @@ def test_lint_psum_fence():
     half = (
         "from jax import lax\n\n"
         "class Prog:\n"
-        "    def __call__(self, cols):\n"
+        "    def __call__(self, cols, axis):\n"
         "        if self._psum_limb_fence:\n"
         "            cols = cols[:1]\n"
-        "        return lax.psum(cols, 'shard')\n")
+        "        return lax.psum(cols, axis)\n")
     assert _rules(half, "parallel/shuffle.py") == ["TPU-PSUM-FENCE"]
     # inline waiver works like every other rule
     waived = ("from jax import lax\n\n"

@@ -28,6 +28,28 @@ def test_analysis_gate_exits_zero():
     assert "0 violations" in proc.stdout, proc.stdout
 
 
+def test_gate_shardflow_pass_covers_corpus_and_multichip():
+    """ISSUE 12 acceptance: the sharding-flow pass analyzes the TPC-H
+    corpus (incl. shuffle queries) PLUS the MULTICHIP dryrun plan
+    shapes clean under the single-host and host=2 views, with finite
+    per-link transfer bytes."""
+    proc = _run_gate()
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "shardflow:" in proc.stdout, proc.stdout
+    tail = proc.stdout.split("shardflow:")[1]
+    assert "20 corpus + 7 multichip" in tail, proc.stdout
+    assert "host=2" in tail and "0 violations" in tail, proc.stdout
+    assert "ici" in tail and "dci" in tail, proc.stdout
+
+
+def test_transfer_report_prints_per_link_table():
+    proc = _run_gate("--transfer-report")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "intra" in proc.stdout and "ici" in proc.stdout \
+        and "dci" in proc.stdout, proc.stdout
+    assert "host=2" in proc.stdout, proc.stdout
+
+
 def test_gate_prices_every_corpus_plan():
     """ISSUE 5 satellite: the gate asserts every TPC-H corpus plan
     prices to a finite nonzero RU (rc/pricing over the cost model) —

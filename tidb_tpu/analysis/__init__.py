@@ -33,6 +33,16 @@ Three passes, all wired into CI as a zero-findings gate
   caps, the micro-batch window, and deadline-aware early shedding.
   The gate grows a calibration pass (deterministic drift simulation,
   < 25% corpus pricing error) and the TPU-CALIB-CLAMP lint rule.
+- shardflow (analysis/shardflow + parallel/topology): a sharding-layout
+  & collective-transfer abstract interpreter — the mesh modeled as
+  typed links (intra-chip / same-host ICI / cross-host DCI from the
+  declared host view), every collective verified against it pre-trace
+  (implicit reshards, unknown axes, coordinator-routed host merges,
+  psum limb-fence bounds, DCI blow-ups), and transfer bytes rolled up
+  per link class into ``LaunchCost.transfer_breakdown`` so admission,
+  RU pricing (a 4x DCI rate), and fusion caps stay honest at pod
+  scale.  SHARD-*/COST-DCI-BLOWUP findings ride the corpus plus the
+  MULTICHIP dryrun plan shapes under a fake (host=2, device=4) view.
 - coplife (analysis/lifetime): a buffer-lifetime pass over the same
   contract DAGs classifying every device-program input slot as
   PERSISTENT (snapshot-cache residents) / LOOP-CARRIED (paging and
@@ -59,6 +69,8 @@ from .copcost import CostError, LaunchCost, plan_cost, task_cost
 from .lifetime import (BufferClass, DonationError, DonationPlan,
                        donation_plan, verify_donation)
 from .lint import Finding, lint_source, lint_tree, load_baseline
+from .shardflow import (plan_transfer, verify_dag_sharding,
+                        verify_plan_sharding, verify_task_sharding)
 
 __all__ = ["PlanContractError", "verify_plan", "verify_dag", "verify_task",
            "CostError", "LaunchCost", "plan_cost", "task_cost",
@@ -66,4 +78,6 @@ __all__ = ["PlanContractError", "verify_plan", "verify_dag", "verify_task",
            "donation_plan", "verify_donation",
            "BoundedLRU", "Correction", "CorrectionStore",
            "correction_store", "clamp_factor",
+           "plan_transfer", "verify_dag_sharding", "verify_plan_sharding",
+           "verify_task_sharding",
            "Finding", "lint_tree", "lint_source", "load_baseline"]

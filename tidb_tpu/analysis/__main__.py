@@ -27,6 +27,14 @@ Runs three static passes and exits non-zero on any NEW finding:
    CALIB_TARGET_ERR (< 25%) of the drifted truth on EVERY plan —
    guards the feedback loop (EWMA step, clamp, prediction terms) the
    way the pricing pass guards the static weights.
+6. Sharding-flow analysis (analysis/shardflow) over the TPC-H corpus
+   PLUS the MULTICHIP dryrun plan shapes: every device program's
+   layouts and collectives flow clean against both the native
+   single-host topology and the fake (host=2, device=4) multi-host
+   view of the 8-vdev mesh, with finite per-link transfer bytes
+   (intra / ici / dci).  SHARD-IMPLICIT-RESHARD / SHARD-AXIS-UNKNOWN /
+   SHARD-MERGE-COORDINATOR / COST-DCI-BLOWUP findings baseline like
+   every other corpus rule.
 
 Flags:
     --lint-only / --contracts-only   run one pass
@@ -48,6 +56,11 @@ Flags:
                                      (static vs calibrated pricing
                                      error, analysis/calibrate) and
                                      exit
+    --transfer-report                print the per-corpus-query
+                                     per-link transfer table
+                                     (intra/ici/dci bytes under the
+                                     host=2 view, analysis/shardflow)
+                                     and exit
 """
 
 from __future__ import annotations
@@ -90,9 +103,11 @@ def _gather_findings(lint_only: bool, contracts_only: bool):
     if not lint_only:
         from .copcost import cost_findings
         from .lifetime import donation_findings
+        from .shardflow import shard_findings
         plans = _corpus_plans()
         findings += cost_findings(plans, n_devices=GATE_DEVICES)
         findings += donation_findings(plans, n_devices=GATE_DEVICES)
+        findings += shard_findings(plans, n_devices=GATE_DEVICES)
     return findings, plans
 
 
@@ -116,8 +131,9 @@ def _stale_keys(findings, baseline, lint_only: bool,
     current = {f.key() for f in findings}
     stale = set()
     for k in baseline - current:
-        # corpus-walk rule families (computed only on full/cost runs)
-        is_cost = k.startswith(("COST-", "DONATE-"))
+        # corpus-walk rule families (computed only on full/cost runs);
+        # SHARD- joined with the shardflow pass (ISSUE 12)
+        is_cost = k.startswith(("COST-", "DONATE-", "SHARD-"))
         if lint_only and is_cost:
             continue
         if contracts_only and not is_cost:
@@ -191,6 +207,52 @@ def _run_calibration(plans) -> int:
     return 1 if bad else 0
 
 
+def _run_shardflow(plans) -> int:
+    """Sharding-flow pass (ISSUE 12 acceptance): the TPC-H corpus (incl.
+    the shuffle queries) PLUS the MULTICHIP dryrun plan shapes must
+    flow clean against both the single-host view and the fake
+    (host=2, device=4) view of the gate mesh, with finite per-link
+    transfer bytes — the static substrate the multi-host mesh work
+    stands on."""
+    from ..parallel.topology import MeshTopology, SHARD_AXIS
+    from ..testing.tpch import built_multichip_plans, tpch_plan_session
+    from .contracts import PlanContractError
+    from .copcost import format_bytes, plan_cost
+    from .shardflow import GATE_VIEW_HOSTS, verify_plan_sharding
+    multichip = list(built_multichip_plans(tpch_plan_session()))
+    topo1 = MeshTopology((SHARD_AXIS,), GATE_DEVICES, 1)
+    topo2 = MeshTopology((SHARD_AXIS,), GATE_DEVICES, GATE_VIEW_HOSTS)
+    bad = 0
+    flowed = 0
+    ici = dci = 0
+    labelled = [("corpus", sql, phys) for sql, phys in plans] + \
+        [("multichip", sql, phys) for sql, phys in multichip]
+    for src, sql, phys in labelled:
+        try:
+            for topo in (topo1, topo2):
+                flowed += verify_plan_sharding(phys, topo)
+        except PlanContractError as e:
+            bad += 1
+            one_line = " ".join(sql.split())
+            print(f"SHARDFLOW [{src}] {one_line[:64]}...\n  {e}")
+            continue
+        cost = plan_cost(phys, GATE_DEVICES, topology=topo2)
+        if cost.ici_bytes < 0 or cost.dci_bytes < 0:
+            bad += 1
+            print(f"SHARDFLOW [{src}] non-finite per-link bytes: "
+                  f"{cost.transfer_breakdown}")
+            continue
+        ici += cost.ici_bytes
+        dci += cost.dci_bytes
+    print(f"shardflow: {len(labelled) - bad}/{len(labelled)} plans "
+          f"({len(plans)} corpus + {len(multichip)} multichip) flow "
+          f"clean under 1-host and host={GATE_VIEW_HOSTS} views, "
+          f"{flowed} device programs flowed "
+          f"(ici {format_bytes(ici)} / dci {format_bytes(dci)} under "
+          f"host={GATE_VIEW_HOSTS}), {bad} violations")
+    return 1 if bad else 0
+
+
 def _run_contracts(plans) -> int:
     from ..testing.tpch import TPCH_PLAN_QUERIES, TPCH_SHUFFLE_QUERIES
     from .contracts import PlanContractError, verify_plan
@@ -233,6 +295,10 @@ def main(argv=None) -> int:
         from .calibrate import calibration_report
         print(calibration_report(_corpus_plans(), n_devices=GATE_DEVICES))
         return 0
+    if "--transfer-report" in argv:
+        from .shardflow import transfer_report
+        print(transfer_report(_corpus_plans(), n_devices=GATE_DEVICES))
+        return 0
     if check_baseline:
         # hygiene pass: waivers must not rot silently — every baseline
         # entry must still match a current finding (full gather, so the
@@ -256,6 +322,7 @@ def main(argv=None) -> int:
         rc |= _run_contracts(plans)
         rc |= _run_pricing(plans)
         rc |= _run_calibration(plans)
+        rc |= _run_shardflow(plans)
     if rc == 0:
         print("analysis gate: ok")
     return rc

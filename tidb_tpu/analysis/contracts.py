@@ -467,35 +467,19 @@ def _verify_op(op, path, X) -> int:
 def _verify_shuffle_spec(spec: D.ShuffleJoinSpec, path) -> int:
     """Exchange-boundary agreement: both sides' chains, their declared
     schemas, the key exprs, and the post-exchange `top` chain must all
-    describe the same columns — the mesh handshake of an MPP shuffle."""
+    describe the same columns — the mesh handshake of an MPP shuffle.
+    The schema/boundary half lives in analysis/shardflow (the single
+    source both this pass and the sharding-flow pass consume — thin
+    delegation so the two passes report the same rule and never
+    drift)."""
     p = path + ("ShuffleJoinSpec",)
     verify_dag(spec.left)
     verify_dag(spec.right)
     ls, rs = D.output_dtypes(spec.left), D.output_dtypes(spec.right)
-    if tuple(spec.left_dtypes) != tuple(ls):
-        _fail("exchange-mismatch", p,
-              f"declared left exchange schema ({len(spec.left_dtypes)} "
-              f"cols) != left chain output ({len(ls)} cols)")
-    if tuple(spec.right_dtypes) != tuple(rs):
-        _fail("exchange-mismatch", p,
-              f"declared right exchange schema ({len(spec.right_dtypes)} "
-              f"cols) != right chain output ({len(rs)} cols)")
+    from .shardflow import verify_shuffle_boundary
+    verify_shuffle_boundary(spec, path)
     _check_expr(spec.left_key, ls, p, device=True)
     _check_expr(spec.right_key, rs, p, device=True)
-    joined = ls + rs if spec.kind in ("inner", "left") else ls
-    top_leaf = spec.top
-    while top_leaf.children():
-        top_leaf = top_leaf.children()[0]
-    if isinstance(top_leaf, D.TableScan):
-        for off, t in zip(top_leaf.col_offsets, top_leaf.col_dtypes):
-            if off >= len(joined):
-                _fail("exchange-mismatch", p,
-                      f"post-join chain reads column {off} of a "
-                      f"{len(joined)}-column joined schema")
-            if not _compatible(t, joined[off]):
-                _fail("exchange-mismatch", p,
-                      f"post-join chain reads column {off} as {t} but "
-                      f"the exchange produces {joined[off]}")
     verify_dag(spec.top)
     return 1
 
@@ -540,6 +524,12 @@ def verify_task(task) -> None:
             _fail("capacity-shape", p,
                   f"{s} shards do not divide over {n_dev} devices on the "
                   "shard axis")
+    # sharding-flow handshake (analysis/shardflow): the task's mesh must
+    # carry the exchange axis and its DAG must flow clean against the
+    # mesh's typed-link topology (implicit reshards, merge routing,
+    # psum limb-fence bound) — still pre-trace, still memoized
+    from .shardflow import verify_task_sharding
+    verify_task_sharding(task)
     if getattr(task, "donate", False):
         # donation-safety handshake (analysis/lifetime): a donating
         # task must be in an EPHEMERAL program class and its inputs

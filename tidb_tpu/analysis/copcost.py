@@ -156,6 +156,13 @@ class LaunchCost:
     # (min(donated inputs, outputs): the donated buffer backs the
     # output instead of coexisting with it)
     donated_bytes: int = 0
+    # per-link-class bytes (intra, ici, dci) — parallel/topology's
+    # typed-link classification of this launch's traffic: intra carries
+    # the host<->device transfer plus on-chip copies, ici/dci the
+    # inter-chip collective payload (psum merges, all_to_all exchanges)
+    # split by whether each hop crosses a host boundary.  Single-host
+    # topologies price dci identically zero.
+    transfer_breakdown: tuple = (0, 0, 0)
 
     @property
     def peak_hbm_bytes(self) -> int:
@@ -167,11 +174,21 @@ class LaunchCost:
         return self.input_bytes + self.aux_bytes + self.output_bytes
 
     @property
+    def ici_bytes(self) -> int:
+        return self.transfer_breakdown[1] if self.transfer_breakdown else 0
+
+    @property
+    def dci_bytes(self) -> int:
+        return self.transfer_breakdown[2] if self.transfer_breakdown else 0
+
+    @property
     def padding_waste(self) -> float:
         return self.padded_cells / max(self.live_cells, 1)
 
     def combined(self, other: "LaunchCost") -> "LaunchCost":
         """Sum of two independent launches (plan-level rollup)."""
+        a, b = self.transfer_breakdown or (0, 0, 0), \
+            other.transfer_breakdown or (0, 0, 0)
         return LaunchCost(
             self.input_bytes + other.input_bytes,
             self.aux_bytes + other.aux_bytes,
@@ -185,7 +202,8 @@ class LaunchCost:
             self.radix_blowups + other.radix_blowups,
             self.unbounded + other.unbounded,
             self.breakdown + other.breakdown,
-            self.donated_bytes + other.donated_bytes)
+            self.donated_bytes + other.donated_bytes,
+            (a[0] + b[0], a[1] + b[1], a[2] + b[2]))
 
 
 def format_bytes(n: int) -> str:
@@ -482,6 +500,50 @@ def _dag_walk_cached(dag: D.CopNode, layout: Layout,
             tuple(acc.unbounded), tuple(acc.breakdown), rows_out, w_out)
 
 
+def chain_rows(dag: D.CopNode, layout: Layout,
+               widths: Optional[tuple] = None) -> Tuple[int, int]:
+    """(per-device output rows, output row width in bytes) of one cop
+    chain — the size half shardflow's exchange attribution reuses so
+    the verifier and the cost model cannot drift."""
+    out = _dag_walk_cached(dag, layout, widths)
+    return out[-2], out[-1]
+
+
+def _default_topology(n_devices: int):
+    from ..parallel.topology import single_host
+    return single_host(n_devices)
+
+
+def _collective_breakdown(dag: D.CopNode, layout: Layout,
+                          widths: Optional[tuple], topology,
+                          merge_route: str):
+    """Inter-chip bytes of a program's merge collectives, classified
+    per link (parallel/topology).  In-program psum merges (SCALAR/DENSE
+    incl. the psum-gather MIN/MAX trick, whose constant factor
+    calibration absorbs per digest) exchange each member's state table
+    across the mesh; host-merged group tables (SORT/SEGMENT/SCATTER)
+    leave the device over PCIe — their D2H bytes already ride
+    ``output_bytes``, so per-host routing adds nothing here, while the
+    coordinator anti-route is priced as DCI so reports can show what
+    SHARD-MERGE-COORDINATOR saves."""
+    from ..parallel import topology as T
+    bd = T.TransferBreakdown()
+    members = dag.members if isinstance(dag, D.FusedDag) else (dag,)
+    for m in members:
+        if not isinstance(m, D.Aggregation):
+            continue
+        rows_out, w_out = chain_rows(m, layout, widths)
+        state_bytes = rows_out * w_out
+        if m.strategy in D.HOST_MERGE_STRATEGIES:
+            if merge_route == T.MERGE_COORDINATOR and topology.multi_host:
+                bd = bd.combined(T.TransferBreakdown(
+                    dci=(topology.n_devices - topology.devices_per_host)
+                    * state_bytes))
+            continue
+        bd = bd.combined(topology.split_psum(state_bytes))
+    return bd
+
+
 def _rows_kind_capacity(dag: D.CopNode, layout: Layout,
                         row_capacity: int) -> int:
     """Per-device output capacity of a row-returning program: the
@@ -501,7 +563,8 @@ def _rows_kind_capacity(dag: D.CopNode, layout: Layout,
 def dag_cost(dag: D.CopNode, layout: Layout,
              widths: Optional[tuple] = None, *, input_bytes: int = 0,
              aux_bytes: int = 0, row_capacity: int = 0,
-             donation=None) -> LaunchCost:
+             donation=None, topology=None,
+             merge_route: str = "per_host") -> LaunchCost:
     """LaunchCost of one program over one stacked scan input.
 
     ``input_bytes`` is the resident upload (exact at admission, modeled
@@ -509,8 +572,14 @@ def dag_cost(dag: D.CopNode, layout: Layout,
     materialized replicated inputs PER DEVICE COPY (totals multiply by
     the mesh size here).  ``donation`` is an optional
     ``analysis.lifetime.DonationPlan``: donated input bytes alias into
-    the output allocation, so the peak drops by min(donated, output)."""
+    the output allocation, so the peak drops by min(donated, output).
+    ``topology`` (parallel/topology.MeshTopology, default the
+    single-host all-ICI view of the layout's mesh) classifies the
+    launch's merge-collective bytes per link into
+    ``transfer_breakdown`` — the seam that makes admission, pricing and
+    fusion caps topology-aware with no runtime change."""
     d = max(layout.n_devices, 1)
+    topo = topology if topology is not None else _default_topology(d)
     (inter_pd, flops_pd, joins, dense_blowups, radix_blowups, unbounded,
      breakdown, rows_out, w_out) = _dag_walk_cached(dag, layout, widths)
     root = dag.members[-1] if isinstance(dag, D.FusedDag) and dag.members \
@@ -533,6 +602,8 @@ def dag_cost(dag: D.CopNode, layout: Layout,
         if ARG_AUX in donation.donate_argnums:
             donatable += aux_total
         donated = min(donatable, int(out_bytes))
+    coll = _collective_breakdown(dag, layout, widths, topo, merge_route)
+    transfer = int(input_bytes) + aux_total + int(out_bytes)
     return LaunchCost(
         input_bytes=int(input_bytes),
         aux_bytes=aux_total,
@@ -547,7 +618,8 @@ def dag_cost(dag: D.CopNode, layout: Layout,
         radix_blowups=radix_blowups,
         unbounded=unbounded,
         breakdown=tuple(sorted(breakdown, key=lambda kv: -kv[1])[:8]),
-        donated_bytes=donated)
+        donated_bytes=donated,
+        transfer_breakdown=(transfer + coll.intra, coll.ici, coll.dci))
 
 
 # ------------------------------------------------------------------ #
@@ -589,9 +661,15 @@ def task_cost(task) -> Optional[LaunchCost]:
         # admission bound (verify_task_donation already vetted safety)
         from .lifetime import donation_plan
         donation = donation_plan(task.dag, "solo")
+    # typed-link classification of the merge collectives: the declared
+    # host view (tidb_tpu_topology_hosts) splits ici/dci here, making
+    # RU pricing and the HBM/fusion caps topology-aware at admission
+    from ..parallel.topology import topology_for
+    topo = topology_for(task.mesh) if task.mesh is not None else None
     return dag_cost(task.dag, layout, tuple(widths),
                     input_bytes=input_bytes, aux_bytes=aux_bytes,
-                    row_capacity=task.row_capacity, donation=donation)
+                    row_capacity=task.row_capacity, donation=donation,
+                    topology=topo)
 
 
 def mesh_hbm_budget(mesh) -> int:
@@ -642,7 +720,8 @@ def _op_snapshot(op):
     return tbl.snapshot()
 
 
-def _cop_exec_cost(op, n_devices: int, donation=None) -> LaunchCost:
+def _cop_exec_cost(op, n_devices: int, donation=None,
+                   topology=None) -> LaunchCost:
     snap = _op_snapshot(op)
     layout = snapshot_layout(snap, n_devices)
     widths = snapshot_scan_widths(snap)
@@ -667,29 +746,70 @@ def _cop_exec_cost(op, n_devices: int, donation=None) -> LaunchCost:
             bw = _schema_width(j.build_dtypes) if j is not None else 8
             aux += rows * (16 + bw)       # sorted keys + perm + columns
     return dag_cost(dag, layout, widths, input_bytes=input_bytes,
-                    aux_bytes=aux, donation=donation)
+                    aux_bytes=aux, donation=donation, topology=topology)
+
+
+def exchange_bucket_rows(rows_total: int, n_devices: int) -> int:
+    """Per-(device, destination) send-bucket row capacity of one
+    all_to_all exchange side — the client's initial formula (2x
+    headroom over a uniform hash, pow2; store/client
+    ``_shuffle_initial_caps``).  Shared with shardflow so the verifier's
+    per-link prediction and the runtime caps agree by construction."""
+    from ..store.columnar import _pow2_at_least
+    d = max(n_devices, 1)
+    return _pow2_at_least(max(2 * rows_total // max(d * d, 1) + 1, 1024))
 
 
 def _exchange_cost(rows_side: int, width: int, layout: Layout) -> int:
-    """Per-device all_to_all send-bucket bytes of one shuffle side,
-    using the client's initial capacity formula (2x headroom over a
-    uniform hash, pow2)."""
-    from ..store.columnar import _pow2_at_least
+    """Per-device all_to_all send-bucket bytes of one shuffle side."""
     d = max(layout.n_devices, 1)
-    cap = _pow2_at_least(max(2 * rows_side // max(d * d, 1) + 1, 1024))
+    cap = exchange_bucket_rows(rows_side, d)
     return d * cap * (width + _VALIDITY_BYTES)
 
 
-def _shuffle_exec_cost(op, n_devices: int) -> LaunchCost:
+def shuffle_exchange_buckets(spec, llayout: Layout, rlayout: Layout,
+                             lwidths, rwidths, n_devices: int) -> tuple:
+    """Per-(device, destination) send-bucket BYTES of each exchange
+    side of a shuffle join, from the CHAIN-output rows (an Expand in an
+    exchange chain multiplies what the scan read — the COST-DCI-BLOWUP
+    seam).  Row payload mirrors what _side actually ships: the chain's
+    columns, the int64 key lane, and the key-ok + valid mask lanes.
+    Shared by the plan cost model and shardflow's per-link attribution
+    so prediction and verification cannot drift."""
+    d = max(n_devices, 1)
+    lrows, lwidth = chain_rows(spec.left, llayout, lwidths)
+    rrows, rwidth = chain_rows(spec.right, rlayout, rwidths)
+    return (exchange_bucket_rows(lrows * d, d)
+            * (lwidth + 8 + 2 * _VALIDITY_BYTES),
+            exchange_bucket_rows(rrows * d, d)
+            * (rwidth + 8 + 2 * _VALIDITY_BYTES))
+
+
+def _with_exchange(cost: LaunchCost, topo, bucket_bytes_sides) -> tuple:
+    """Per-link split of one or more all_to_all exchange edges, summed
+    onto a cost's transfer_breakdown tuple."""
+    bd = cost.transfer_breakdown or (0, 0, 0)
+    intra, ici, dci = bd
+    for bucket_bytes in bucket_bytes_sides:
+        s = topo.split_all_to_all(bucket_bytes)
+        intra += s.intra
+        ici += s.ici
+        dci += s.dci
+    return (intra, ici, dci)
+
+
+def _shuffle_exec_cost(op, n_devices: int, topology=None) -> LaunchCost:
     spec = op.spec
+    topo = topology if topology is not None \
+        else _default_topology(n_devices)
     lsnap, rsnap = op.left_table.snapshot(), op.right_table.snapshot()
     llay = snapshot_layout(lsnap, n_devices)
     rlay = snapshot_layout(rsnap, n_devices)
     lw, rw = snapshot_scan_widths(lsnap), snapshot_scan_widths(rsnap)
-    cost = dag_cost(spec.left, llay, lw,
+    cost = dag_cost(spec.left, llay, lw, topology=topo,
                     input_bytes=snapshot_input_bytes(lsnap, llay, lw))
     cost = cost.combined(dag_cost(
-        spec.right, rlay, rw,
+        spec.right, rlay, rw, topology=topo,
         input_bytes=snapshot_input_bytes(rsnap, rlay, rw)))
     # exchange buckets + the joined partition the top chain consumes
     d = max(n_devices, 1)
@@ -701,45 +821,57 @@ def _shuffle_exec_cost(op, n_devices: int) -> LaunchCost:
             + _exchange_cost(rsnap.num_rows, wr, rlay)
             + ocap * (wl + wr))
     top_layout = Layout(d, ocap, d, min(lsnap.num_rows, d * ocap))
-    top = dag_cost(spec.top, top_layout, None)
-    return cost.combined(replace(top, input_bytes=0,
+    top = dag_cost(spec.top, top_layout, None, topology=topo)
+    cost = cost.combined(replace(top, input_bytes=0,
                                  inter_bytes=top.inter_bytes + exch * d,
                                  padded_cells=0, live_cells=0))
+    # per-link exchange attribution, from the shared bucket algebra
+    sides = shuffle_exchange_buckets(spec, llay, rlay, lw, rw, d)
+    return replace(cost,
+                   transfer_breakdown=_with_exchange(cost, topo, sides))
 
 
-def _window_exec_cost(op, n_devices: int) -> LaunchCost:
+def _window_exec_cost(op, n_devices: int, topology=None) -> LaunchCost:
     snap = op.table.snapshot()
+    topo = topology if topology is not None \
+        else _default_topology(n_devices)
     layout = snapshot_layout(snap, n_devices)
     widths = snapshot_scan_widths(snap)
     spec = op.spec
-    cost = dag_cost(spec.child, layout, widths,
+    cost = dag_cost(spec.child, layout, widths, topology=topo,
                     input_bytes=snapshot_input_bytes(snap, layout, widths))
-    from ..store.columnar import _pow2_at_least
     d = max(n_devices, 1)
-    wcap = _pow2_at_least(max(2 * snap.num_rows // max(d * d, 1) + 1, 1024))
+    wcap = exchange_bucket_rows(snap.num_rows, d)
     w_out = _schema_width(op.out_dtypes)
     # partition buckets + one multi-key sort + per-item segment tables
     extra = d * (d * wcap * w_out + d * wcap * 8 * 2
                  + d * wcap * 8 * max(len(spec.items), 1))
-    return replace(cost, inter_bytes=cost.inter_bytes + extra)
+    cost = replace(cost, inter_bytes=cost.inter_bytes + extra)
+    # the repartition ships child cols + partition/order/arg lanes
+    return replace(cost, transfer_breakdown=_with_exchange(
+        cost, topo, (wcap * (w_out + _VALIDITY_BYTES),)))
 
 
-def plan_cost(phys, n_devices: int = 8) -> LaunchCost:
+def plan_cost(phys, n_devices: int = 8, topology=None) -> LaunchCost:
     """Roll up the static device footprint of every launch a built
     physical plan implies.  Walks the operator tree (no execution, no
     trace); host operators contribute nothing — their working memory is
-    governed by the statement quota, not HBM."""
+    governed by the statement quota, not HBM.  ``topology`` classifies
+    transfer per link class (default: the single-host all-ICI view)."""
     total = LaunchCost()
     stack = [phys]
     while stack:
         op = stack.pop()
         name = type(op).__name__
         if name == "CopTaskExec" or name == "CopJoinTaskExec":
-            total = total.combined(_cop_exec_cost(op, n_devices))
+            total = total.combined(
+                _cop_exec_cost(op, n_devices, topology=topology))
         elif name == "CopShuffleJoinExec":
-            total = total.combined(_shuffle_exec_cost(op, n_devices))
+            total = total.combined(
+                _shuffle_exec_cost(op, n_devices, topology=topology))
         elif name == "CopWindowExec":
-            total = total.combined(_window_exec_cost(op, n_devices))
+            total = total.combined(
+                _window_exec_cost(op, n_devices, topology=topology))
         for c in getattr(op, "children", []) or []:
             if c is not None:
                 stack.append(c)
@@ -817,6 +949,8 @@ def cost_report(plans, n_devices: int = 8) -> str:
 __all__ = ["CostError", "LaunchCost", "Layout", "dag_cost", "task_cost",
            "plan_cost", "cost_findings", "cost_report", "format_bytes",
            "mesh_hbm_budget", "snapshot_layout", "snapshot_scan_widths",
-           "snapshot_input_bytes", "PAD_WASTE_MAX", "CAP_BLOWUP_MAX",
+           "snapshot_input_bytes", "chain_rows", "exchange_bucket_rows",
+           "shuffle_exchange_buckets",
+           "PAD_WASTE_MAX", "CAP_BLOWUP_MAX",
            "DENSE_BLOWUP_MAX", "DENSE_BLOWUP_MIN_GROUPS", "COST_TOLERANCE",
            "DEFAULT_CPU_HBM_BUDGET", "HBM_BUDGET_FRACTION"]

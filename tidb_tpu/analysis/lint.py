@@ -84,6 +84,15 @@ Rules
                    can silently deserialize a stale or wrong-variant
                    program after a restart (mirrors TPU-DIGEST for the
                    on-disk half of the program cache).
+- TPU-SHARD-CONST  a collective call (lax.all_to_all / all_gather /
+                   psum / pmin / pmax / ppermute / axis_index) or a
+                   PartitionSpec in a traced module whose mesh-axis
+                   argument is a raw string literal instead of a
+                   reference to the mesh/topology symbol
+                   (parallel/topology.SHARD_AXIS): a literal axis name
+                   desynchronizes silently when the topology model
+                   renames or factors an axis — the program traces fine
+                   and exchanges over the wrong (or a stale) axis.
 - TPU-PALLAS-SHAPE in copr/pallas/ (the hand-written TPU kernel
                    package): a ``pallas_call`` whose ``grid=`` or a
                    ``BlockSpec`` whose block shape contains a
@@ -118,6 +127,12 @@ TRACED_MODULES = {
     "copr/pallas/radix_kernel.py",
     "parallel/spmd.py", "parallel/shuffle.py", "parallel/window.py",
     "parallel/exchange.py",
+    # shardflow (ISSUE 12): the topology model and the sharding-flow
+    # interpreter define/consume the collective axis symbols traced
+    # programs bind — they obey the same hygiene rules (no stray
+    # concretization, no literal axis names) so the analysis side can
+    # never drift from the programs it verifies
+    "parallel/topology.py", "analysis/shardflow.py",
 }
 
 # hot-path modules where a host sync stalls the launch pipeline
@@ -149,6 +164,10 @@ LOCK_MODULES = {
     # run under the drain's condition lock (window + attribution) and
     # the submit path (corrected admission, shedding)
     "analysis/calibrate.py",
+    # shardflow (ISSUE 12): the topology host-view lock and any lock
+    # grown by the flow interpreter run under submit (verify_task) and
+    # the session plan path, so they join the cross-layer contract
+    "parallel/topology.py", "analysis/shardflow.py",
 }
 
 # modules whose retry/re-dispatch loops must spend a typed Backoffer
@@ -177,6 +196,16 @@ _DIGEST_NAME = re.compile(r"key|digest|token|fingerprint|signature",
 _CALIB_FACTOR = re.compile(r"^(time_factor|mem_factor)$"
                            r"|correction_factor")
 _CLAMP_REF = re.compile(r"clamp", re.IGNORECASE)
+
+# collective calls whose mesh-axis argument must reference the
+# mesh/topology symbol, never a raw string literal (TPU-SHARD-CONST):
+# call name -> 0-based positional slot the axis may occupy
+_COLLECTIVE_AXIS_CALLS = {
+    "all_to_all": 1, "all_gather": 1, "psum": 1, "pmin": 1, "pmax": 1,
+    "pmean": 1, "ppermute": 1, "psum_scatter": 1, "axis_index": 0,
+}
+# PartitionSpec constructors: every positional argument is an axis name
+_PSPEC_NAMES = ("P", "PartitionSpec")
 
 # jnp creation calls whose result dtype rides the x64 flag when no dtype
 # is given, and the positional slot (0-based) a dtype may occupy.  -1 =
@@ -390,6 +419,9 @@ class _ExprRules(_Scoped):
             self._check_x64(node, name)
             # TPU-DONATE: donation argnums must come from a DonationPlan
             self._check_donate(node)
+            # TPU-SHARD-CONST: collective axes must reference the
+            # mesh/topology symbol
+            self._check_shard_const(node, name)
         # TPU-HOST-SYNC
         if self.hot:
             if name == "device_get" and isinstance(node.func,
@@ -435,6 +467,38 @@ class _ExprRules(_Scoped):
                  "tidb_tpu enables jax_enable_x64 — pin dtype= so an "
                  "embedder's x64-off default cannot silently narrow "
                  "device lanes to 32 bits")
+
+    def _check_shard_const(self, node: ast.Call, name: str) -> None:
+        """A collective (or PartitionSpec) whose mesh-axis argument is a
+        raw string literal: the axis name must reference the topology
+        symbol (parallel/topology.SHARD_AXIS or a parameter derived
+        from it) so a topology rename/refactor cannot silently leave a
+        traced program exchanging over a stale axis."""
+        def flag(what):
+            self.add("TPU-SHARD-CONST", node,
+                     f"{what} in {name}(...): collective mesh-axis "
+                     "names must reference the mesh/topology symbol "
+                     "(parallel/topology.SHARD_AXIS), not a raw string "
+                     "literal — a topology rename would silently "
+                     "desynchronize this program from the analysis")
+
+        if name in _PSPEC_NAMES:
+            for a in node.args:
+                if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                    flag(f"literal axis {a.value!r}")
+                    return
+            return
+        slot = _COLLECTIVE_AXIS_CALLS.get(name)
+        if slot is None:
+            return
+        cand = None
+        if slot < len(node.args):
+            cand = node.args[slot]
+        for kw in node.keywords:
+            if kw.arg in ("axis_name", "axis"):
+                cand = kw.value
+        if isinstance(cand, ast.Constant) and isinstance(cand.value, str):
+            flag(f"literal axis {cand.value!r}")
 
     def _check_donate(self, node: ast.Call) -> None:
         """donate_argnums/donate_argnames in a traced module: jax bakes

@@ -30,6 +30,35 @@ from .mesh import SHARD_AXIS
 # Knuth multiplicative hashing over int64 keys (device-side hash partition)
 _HASH_MULT = jnp.uint64(0x9E3779B97F4A7C15)
 
+# --------------------------------------------------------------------- #
+# exchange-payload trace recording (shardflow validation seam): when
+# enabled, every all_to_all exchange TRACE records the concrete bytes of
+# the send buffers it swaps — shapes are static at trace time, so this
+# is pure host int arithmetic (no tracer values are read) and costs
+# nothing when disabled.  tests/test_shardflow.py pins the static
+# per-link prediction against these live buffer sizes, the copcost
+# exact-resident-bytes precedent.
+# --------------------------------------------------------------------- #
+
+_TRACE_RECORDS: list = []
+_RECORDING = False
+
+
+def record_exchange(enable: bool = True) -> list:
+    """Toggle trace-time payload recording; returns the (shared) record
+    list of (n_dev, capacity, payload_bytes) tuples, cleared on
+    enable."""
+    global _RECORDING
+    _RECORDING = True if enable else False
+    if enable:
+        _TRACE_RECORDS.clear()
+    return _TRACE_RECORDS
+
+
+def _note_payload(n_dev: int, capacity: int, nbytes: int) -> None:
+    if _RECORDING:
+        _TRACE_RECORDS.append((n_dev, capacity, nbytes))
+
 
 def hash_partition_ids(keys, n_parts: int):
     """keys: int64 array -> partition id in [0, n_parts)."""
@@ -74,18 +103,23 @@ def all_to_all_exchange(cols: Sequence, valid, keys, n_dev: int,
         sent, mode="drop").reshape(n_dev, capacity)
     recv_valid = lax.all_to_all(send_valid, axis, split_axis=0,
                                 concat_axis=0, tiled=False).reshape(-1)
+    payload = n_dev * capacity * send_valid.dtype.itemsize
     out_cols = []
     for v, m in cols:
-        rv = lax.all_to_all(scatter(v), axis, split_axis=0, concat_axis=0,
+        sv = scatter(v)
+        payload += n_dev * capacity * sv.dtype.itemsize
+        rv = lax.all_to_all(sv, axis, split_axis=0, concat_axis=0,
                             tiled=False)
         if m is True:
             rm = recv_valid      # reuse: identical to the send_valid swap
         else:
             sm = jnp.zeros((n_dev * capacity,), bool).at[flat_idx].set(
                 sent & m, mode="drop").reshape(n_dev, capacity)
+            payload += n_dev * capacity * sm.dtype.itemsize
             rm = lax.all_to_all(sm, axis, split_axis=0, concat_axis=0,
                                 tiled=False).reshape(-1)
         out_cols.append((rv.reshape(-1), rm))
+    _note_payload(n_dev, capacity, payload)
     return out_cols, recv_valid, overflow, max_count
 
 
@@ -102,4 +136,5 @@ def broadcast_gather(cols: Sequence, valid, axis: str = SHARD_AXIS):
     return out, gvalid
 
 
-__all__ = ["hash_partition_ids", "all_to_all_exchange", "broadcast_gather"]
+__all__ = ["hash_partition_ids", "all_to_all_exchange", "broadcast_gather",
+           "record_exchange"]

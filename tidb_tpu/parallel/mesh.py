@@ -21,7 +21,10 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-SHARD_AXIS = "shard"
+# the canonical axis name lives with the typed-link topology model
+# (parallel/topology, jax-free) so the static analyses and the traced
+# programs share one symbol — TPU-SHARD-CONST lints string literals
+from .topology import SHARD_AXIS
 
 try:                                    # jax >= 0.5: public API
     from jax import shard_map as _shard_map

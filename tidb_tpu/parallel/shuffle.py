@@ -170,6 +170,23 @@ class ShardedShuffleJoinProgram:
         out_cols, n = compact(batch, caps.rows)
         return ([(v[None], m[None]) for v, m in out_cols], n[None]), extras
 
+    def transfer_breakdown(self, topo=None):
+        """Per-link bytes of this compiled program's two exchange edges
+        from its static caps (parallel/topology.TransferBreakdown;
+        default topology: the mesh's declared host view) — the runtime
+        twin of shardflow's plan-time attribution, sized by the SAME
+        row-payload formula so the two can be compared directly."""
+        from ..analysis import copcost as C
+        from .topology import topology_for
+        if topo is None:
+            topo = topology_for(self.mesh)
+        lb = self.caps.left * (C._schema_width(self.spec.left_dtypes)
+                               + 8 + 2)          # cols + key + mask lanes
+        rb = self.caps.right * (C._schema_width(self.spec.right_dtypes)
+                                + 8 + 2)
+        return topo.split_all_to_all(lb).combined(
+            topo.split_all_to_all(rb))
+
     def __call__(self, lcols, lcounts, rcols, rcounts, aux_cols=()):
         if self._psum_limb_fence:
             # global joined-row bound: every device may emit caps.out rows

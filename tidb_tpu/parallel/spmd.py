@@ -136,6 +136,13 @@ class ShardedCopProgram:
         # extras dict (true join output size) for the dispatcher's regrow
         self.has_extras = D.find_expand_join(dag_root) is not None
 
+        # shardflow introspection: which collective the merge rides and
+        # over which axis — the layout facts the out_specs below encode,
+        # exposed so the static analyses/tests can pin them without
+        # re-deriving spec structure
+        self.collective_axis = SHARD_AXIS
+        self.merge_kind = "host" if self.host_merge else "psum"
+
         in_specs = (P(SHARD_AXIS), P(SHARD_AXIS), P())  # aux replicated
         if self.kind == "agg":
             # per-device states when min/max present; replicated post-psum

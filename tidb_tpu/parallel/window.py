@@ -242,6 +242,17 @@ class ShardedWindowProgram:
             extras
 
 
+    def transfer_breakdown(self, topo=None):
+        """Per-link bytes of this program's PARTITION BY repartition
+        from its static bucket capacity (parallel/topology; default:
+        the mesh's declared host view)."""
+        from ..analysis import copcost as C
+        from .topology import topology_for
+        if topo is None:
+            topo = topology_for(self.mesh)
+        w = C._schema_width(self.out_dtypes) + 1   # cols + valid lane
+        return topo.split_all_to_all(self.capacity * w)
+
     def __call__(self, cols, counts, aux_cols=()):
         return self._fn(tuple(cols), counts, tuple(aux_cols))
 

@@ -37,6 +37,15 @@ from typing import Optional
 # (tikv resource_control: ~64KiB/RU for reads); transfer is the scarce
 # PCIe/ICI resource a launch consumes exactly once.
 RU_PER_TRANSFER_BYTE = 1.0 / (64 << 10)
+# Per-link collective rates (shardflow, parallel/topology): same-host
+# ICI collective bytes price like any transfer; cross-host DCI bytes
+# are the pod's scarcest resource and price 4x — so admission and
+# fairness stay honest when the declared host view splits a mesh
+# (ROADMAP: "price cross-host transfer bytes separately from on-chip
+# ICI").  The multiplier is a unit definition, not a sysvar, for the
+# same re-denomination reason as the base coefficients.
+RU_PER_ICI_BYTE = RU_PER_TRANSFER_BYTE
+RU_PER_DCI_BYTE = 4.0 * RU_PER_TRANSFER_BYTE
 # Residency is cheaper than transfer: the bytes sit in HBM for the
 # launch but mostly alias the shared snapshot upload.  1 RU per MiB.
 RU_PER_RESIDENT_BYTE = 1.0 / (1 << 20)
@@ -57,8 +66,15 @@ def cost_rus(cost, *, shared_scan: bool = False) -> float:
     if shared_scan:
         resident = max(resident - cost.input_bytes, 0)
         transfer = max(transfer - cost.input_bytes, 0)
+    # per-link collective terms (shardflow): a rider's merge/exchange
+    # collectives are its OWN payload, never part of the shared scan,
+    # so they price unscaled either way
+    ici = getattr(cost, "ici_bytes", 0)
+    dci = getattr(cost, "dci_bytes", 0)
     rus = (resident * RU_PER_RESIDENT_BYTE
            + transfer * RU_PER_TRANSFER_BYTE
+           + ici * RU_PER_ICI_BYTE
+           + dci * RU_PER_DCI_BYTE
            + cost.flops * RU_PER_FLOP)
     if not math.isfinite(rus):
         return float(MIN_TASK_RU)
@@ -119,4 +135,5 @@ def plan_rus(cost) -> Optional[float]:
 
 __all__ = ["cost_rus", "task_rus", "statement_rus", "split_device_time",
            "plan_rus", "RU_PER_TRANSFER_BYTE", "RU_PER_RESIDENT_BYTE",
+           "RU_PER_ICI_BYTE", "RU_PER_DCI_BYTE",
            "RU_PER_FLOP", "MIN_TASK_RU"]

@@ -211,6 +211,12 @@ class DeviceScheduler:
         self.budget_rejects = 0           # solo programs over budget (CostError)
         self.budget_deferrals = 0         # riders left queued by footprint cap
         self.last_launch_bytes = 0        # footprint of the last served batch
+        # per-link transfer attribution (shardflow, parallel/topology):
+        # statically-priced collective bytes of served tasks, split by
+        # link class under the declared host view — the ROADMAP
+        # multi-host success metric's static half
+        self.transfer_ici_bytes = 0
+        self.transfer_dci_bytes = 0
         # buffer-donation accounting (analysis/lifetime DonationPlan)
         self.donated_launches = 0         # launches with donated inputs
         self.donated_tasks = 0            # tasks that requested donation
@@ -283,6 +289,14 @@ class DeviceScheduler:
         self._m_bdefer = reg.counter(
             "tidb_tpu_sched_budget_deferrals_total",
             "riders deferred from a launch by the summed-footprint cap")
+        self._m_ici = reg.counter(
+            "tidb_tpu_sched_transfer_ici_bytes_total",
+            "statically-priced same-host inter-chip collective bytes "
+            "of served tasks (shardflow link attribution)")
+        self._m_dci = reg.counter(
+            "tidb_tpu_sched_transfer_dci_bytes_total",
+            "statically-priced cross-host collective bytes of served "
+            "tasks under the declared host view")
         self._m_donated = reg.counter(
             "tidb_tpu_sched_donated_bytes_total",
             "input bytes aliased into outputs by buffer donation")
@@ -1363,6 +1377,17 @@ class DeviceScheduler:
         with self._mu:
             for t in batch:
                 self.tasks_done += 1
+                if t.cost is not None:
+                    # per-link attribution: each task's own collective
+                    # payload (merge psums, exchanges) — riders pay
+                    # theirs, the shared scan's H2D stays intra
+                    ici, dci = t.cost.ici_bytes, t.cost.dci_bytes
+                    self.transfer_ici_bytes += ici
+                    self.transfer_dci_bytes += dci
+                    if ici:
+                        self._m_ici.inc(ici)
+                    if dci:
+                        self._m_dci.inc(dci)
                 if t.donate:
                     self.donated_tasks += 1
                     saved = t.cost.donated_bytes if t.cost is not None \
@@ -1429,6 +1454,8 @@ class DeviceScheduler:
                 "budget_rejects": self.budget_rejects,
                 "budget_deferrals": self.budget_deferrals,
                 "last_launch_bytes": self.last_launch_bytes,
+                "transfer_ici_bytes": self.transfer_ici_bytes,
+                "transfer_dci_bytes": self.transfer_dci_bytes,
                 "donated_launches": self.donated_launches,
                 "donated_tasks": self.donated_tasks,
                 "donated_bytes": self.donated_bytes,

@@ -119,7 +119,10 @@ class StatusServer:
             # analysis/copcost), launch supervision (faultline:
             # retried/bisected/quarantined counters, per-digest
             # "breaker" states, armed FaultPlan "faults" injection
-            # stats), wait p50/p99, and the shared CopClient's
+            # stats), per-link transfer attribution
+            # (transfer_{ici,dci}_bytes — shardflow's typed-link
+            # classification under the declared host view), wait
+            # p50/p99, and the shared CopClient's
             # cache/retry/paging/degraded counters ("client")
             return json.dumps(self.domain.client.sched_stats()), \
                 "application/json"

@@ -1038,6 +1038,14 @@ class Session:
         if _flag_on(merged, "tidb_tpu_verify_plan", default=True):
             from ..analysis.contracts import verify_plan
             verify_plan(phys)
+            # sharding-flow pass (analysis/shardflow): layouts and
+            # collectives of every device program flowed against the
+            # mesh's typed-link topology (declared host view included)
+            # — implicit reshards, unknown axes, coordinator-routed
+            # merges, and DCI blow-ups reject HERE, pre-trace, like
+            # any other contract violation
+            from ..analysis.shardflow import verify_plan_sharding
+            verify_plan_sharding(phys, self._topology(merged))
             phys._contract_ok = True
         use_cache = use_cache and not ran_subquery
         if use_cache and _plan_cacheable(phys):
@@ -1166,6 +1174,13 @@ class Session:
         v14 = merged.get("tidb_tpu_cost_calibration")
         if v14 is not None and v14 != "":
             client.calibration = bool(int(v14))
+        # shardflow topology view (parallel/topology): declared host
+        # factorization for per-link transfer classification; -1/unset
+        # derives from device process indices
+        v16 = merged.get("tidb_tpu_topology_hosts")
+        if v16 is not None and v16 != "":
+            from ..parallel.topology import set_host_view
+            set_host_view(None if int(v16) <= 0 else int(v16))
         # SCATTER radix-partition Pallas gate (copr/radix): auto = the
         # hand-written Pallas kernels on TPU backends, the XLA lowering
         # elsewhere; on = Pallas everywhere (interpret mode off-TPU —
@@ -1230,6 +1245,9 @@ class Session:
             footer = self._cost_footer(phys)
             if footer is not None:
                 rows.append((footer,))
+                transfer = self._transfer_footer(phys)
+                if transfer is not None:
+                    rows.append((transfer,))
                 calib = self._calibration_footer(phys)
                 if calib is not None:
                     rows.append((calib,))
@@ -1264,6 +1282,39 @@ class Session:
                 footer += (f", donate: {bufs} bufs / "
                            f"{format_bytes(saved)}")
             return footer
+        except (AttributeError, TypeError, KeyError, ValueError,
+                ImportError):
+            return None
+
+    def _topology(self, merged=None):
+        """The mesh's typed-link topology under the declared host view
+        (tidb_tpu_topology_hosts) — the analysis seam the plan-path
+        shardflow verification and the EXPLAIN transfer footer share.
+        Never forces device init."""
+        from ..parallel.topology import set_host_view, topology_for
+        if merged is None:
+            merged = {**self.domain.sysvars, **self.vars}
+        v = merged.get("tidb_tpu_topology_hosts")
+        if v is not None and v != "":
+            set_host_view(None if int(v) <= 0 else int(v))
+        mesh = self.domain.client._mesh
+        n_dev = int(mesh.devices.size) if mesh is not None else 8
+        return topology_for(mesh, n_devices=n_dev)
+
+    def _transfer_footer(self, phys) -> Optional[str]:
+        """EXPLAIN per-link transfer footer (analysis/shardflow):
+        ``transfer: X ici / Y dci`` — the plan's statically-classified
+        collective bytes under the declared host view
+        (tidb_tpu_topology_hosts).  None for plans without collective
+        traffic; must never break EXPLAIN."""
+        try:
+            from ..analysis.copcost import format_bytes
+            from ..analysis.shardflow import plan_transfer
+            bd = plan_transfer(phys, self._topology())
+            if not bd.collective:
+                return None
+            return (f"transfer: {format_bytes(bd.ici)} ici / "
+                    f"{format_bytes(bd.dci)} dci")
         except (AttributeError, TypeError, KeyError, ValueError,
                 ImportError):
             return None

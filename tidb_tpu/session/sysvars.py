@@ -99,6 +99,14 @@ _VARS = [
     # micro-batch window, and deadline-aware early shedding.  Off = the
     # static model untouched, no feedback recorded.
     _v("tidb_tpu_cost_calibration", 1, kind="bool", scope=SCOPE_GLOBAL),
+    # shardflow typed-link topology view (parallel/topology): the host
+    # factorization analysis assumes when classifying collective bytes
+    # as same-host ICI vs cross-host DCI.  -1 = derive from the mesh's
+    # device process indices (single-host on one machine); >0 declares
+    # a (host=N, device=D/N) view — how tier-1 exercises the DCI tier
+    # on the 8-vdev CPU mesh
+    _v("tidb_tpu_topology_hosts", -1, kind="int", min=-1, max=4096,
+       scope=SCOPE_GLOBAL),
     # SCATTER radix-partition Pallas gate (copr/radix + copr/pallas):
     # auto = hand-written Pallas kernels on TPU, XLA lowering elsewhere;
     # on = Pallas everywhere (interpret mode off-TPU, the tier-1 kernel

@@ -269,6 +269,54 @@ TPCH_SHUFFLE_QUERIES = [
 ]
 
 
+# the MULTICHIP dryrun's plan shapes (__graft_entry__.dryrun_multichip):
+# every distributed step the dry run executes on the 8-vdev mesh, as
+# plannable SQL — the shardflow gate pass must analyze each clean with
+# finite per-link transfer bytes (the pod-scale exchange shapes the
+# multi-host runtime PR will inherit)
+MULTICHIP_PLAN_QUERIES = [
+    # Q1 psum step: dense keyed agg merged in-program
+    """select l_returnflag, l_linestatus, sum(l_quantity), count(*)
+       from lineitem where l_shipdate <= date '1998-09-02'
+       group by l_returnflag, l_linestatus""",
+    # TopN shard-merge step
+    """select l_extendedprice from lineitem
+       order by l_extendedprice desc limit 5""",
+    # broadcast-join step (LookupJoin + psum agg)
+    """select count(*), sum(l_extendedprice) from lineitem, part
+       where p_partkey = l_partkey and p_size < 25""",
+    # rollup Expand fragment
+    """select l_returnflag, l_linestatus, count(*) from lineitem
+       group by l_returnflag, l_linestatus with rollup""",
+    # window repartition (all_to_all on PARTITION BY)
+    """select l_linestatus, row_number() over
+       (partition by l_linestatus order by l_extendedprice desc)
+       from lineitem""",
+    # window-over-join fragment
+    """select l_linestatus, row_number() over
+       (partition by l_linestatus order by l_extendedprice desc)
+       from lineitem, part
+       where p_partkey = l_partkey and p_size < 25""",
+]
+
+
+def built_multichip_plans(session):
+    """Plan the MULTICHIP dryrun shapes: the broadcast forms above plus
+    the same join re-planned as a repartition shuffle (threshold 0) —
+    the all_to_all exchange step of the dry run."""
+    yield from built_tpch_plans(session, MULTICHIP_PLAN_QUERIES)
+    from ..executor import plan as planmod
+    saved = planmod.BROADCAST_BUILD_MAX_ROWS
+    planmod.BROADCAST_BUILD_MAX_ROWS = 0
+    try:
+        yield from built_tpch_plans(
+            session, ["""select count(*), sum(l_extendedprice)
+                         from lineitem, part
+                         where p_partkey = l_partkey and p_size < 25"""])
+    finally:
+        planmod.BROADCAST_BUILD_MAX_ROWS = saved
+
+
 def built_tpch_plans(session, queries=None):
     """Plan (without executing) each corpus statement; yields
     (sql, physical plan) pairs for analysis.verify_plan.  With the
@@ -295,4 +343,6 @@ def built_tpch_plans(session, queries=None):
 
 __all__ = ["gen_lineitem", "gen_part", "gen_orders_mini", "LINEITEM_NAMES",
            "PART_NAMES", "DEC2", "TPCH_PLAN_QUERIES",
-           "TPCH_SHUFFLE_QUERIES", "tpch_plan_session", "built_tpch_plans"]
+           "TPCH_SHUFFLE_QUERIES", "MULTICHIP_PLAN_QUERIES",
+           "tpch_plan_session", "built_tpch_plans",
+           "built_multichip_plans"]
