@@ -470,6 +470,12 @@ def mode_sched():
     st = sched.stats()
     tasks = st["tasks_done"] - base["tasks_done"]
     launches = st["launches"] - base["launches"]
+    # copscope: p50/p99 now come from the prometheus-text latency
+    # histograms (tidb_tpu_sched_wait_ms / _launch_ms) instead of the
+    # scheduler's ad-hoc wait ring — same numbers every scrape sees
+    from tidb_tpu.utils.metrics import global_registry
+    wait_h = global_registry().histogram("tidb_tpu_sched_wait_ms")
+    launch_h = global_registry().histogram("tidb_tpu_sched_launch_ms")
     out = {
         "stmts": n_stmts,
         "arrival_rate_per_s": rate,
@@ -483,8 +489,10 @@ def mode_sched():
         "fusion_rate": round(
             (st["fused_tasks"] - base["fused_tasks"]) / max(tasks, 1), 4),
         "launch_reduction": round(1.0 - launches / max(tasks, 1), 4),
-        "sched_wait_p50_ms": st["wait_p50_ms"],
-        "sched_wait_p99_ms": st["wait_p99_ms"],
+        "sched_wait_p50_ms": round(wait_h.quantile(0.50), 3),
+        "sched_wait_p99_ms": round(wait_h.quantile(0.99), 3),
+        "launch_p50_ms": round(launch_h.quantile(0.50), 3),
+        "launch_p99_ms": round(launch_h.quantile(0.99), 3),
         "window_waits": st["window_waits"],
         # window feedback + HBM-budget admission (analysis/copcost):
         # hold hit-rate and the static footprint of the last launch,
@@ -505,6 +513,9 @@ def mode_sched():
             "dci": st.get("transfer_dci_bytes", 0),
         },
     }
+    out["trace_overhead"] = _sched_trace_overhead_scenario(dom, s, queries)
+    out["trace_overhead_pct"] = \
+        out["trace_overhead"]["trace_overhead_pct"]
     out["rc"] = _sched_rc_scenario(dom, s, sched, queries[0])
     out["chaos"] = _sched_chaos_scenario(dom, s, sched, queries)
     out["coldwarm"] = _sched_coldwarm_scenario(dom, sched)
@@ -513,6 +524,35 @@ def mode_sched():
     os.makedirs(DATA_DIR, exist_ok=True)
     with open(SCHED_PATH, "w") as f:
         json.dump(out, f)
+
+
+def _sched_trace_overhead_scenario(dom, s, queries, n=60, rounds=3):
+    """copscope overhead guard: the same sequential statement loop with
+    tracing OFF vs ON (tidb_tpu_trace), best-of-rounds to shed noise.
+    The acceptance bound on this scenario is trace_overhead_pct <= 5 —
+    span recording is a tuple append under a leaf lock, so anything
+    above noise means a regression on the hot path."""
+    def run_loop():
+        t0 = time.monotonic()
+        for i in range(n):
+            s.must_query(queries[i % len(queries)])
+        return time.monotonic() - t0
+
+    s.execute("set global tidb_tpu_trace = 0")
+    run_loop()                              # warm both code paths
+    off = min(run_loop() for _ in range(rounds))
+    s.execute("set global tidb_tpu_trace = 1")
+    run_loop()
+    on = min(run_loop() for _ in range(rounds))
+    pct = (on - off) / max(off, 1e-9) * 100.0
+    return {
+        "stmts_per_round": n,
+        "off_s": round(off, 4),
+        "on_s": round(on, 4),
+        "trace_overhead_pct": round(pct, 2),
+        # flight-recorder retention state after the traced rounds
+        "recorder": dom.flight_recorder.stats(),
+    }
 
 
 def _sched_rc_scenario(dom, s, sched, query):

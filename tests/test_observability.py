@@ -61,10 +61,15 @@ def test_statement_summary_aggregates():
 
 def test_slow_query_log_threshold():
     s = make_session()
-    s.domain.stmt_summary.slow_threshold_ms = 0.0   # everything is slow
+    # the threshold is sysvar state since copscope (ISSUE 13):
+    # tidb_tpu_slow_threshold_ms plumbs session -> Domain per record
+    s.execute("set global tidb_tpu_slow_threshold_ms = 0")
     s.must_query("select count(*) from t")
     slow = s.must_query("show slow_queries")
     assert any("count(*)" in r[0] for r in slow)
+    # each entry carries the copscope evidence fields + trace id
+    row = next(r for r in slow if "count(*)" in r[0])
+    assert len(row) == 8
 
 
 def test_normalize_sql():

@@ -93,6 +93,16 @@ Rules
                    desynchronizes silently when the topology model
                    renames or factors an axis — the program traces fine
                    and exchanges over the wrong (or a stale) axis.
+- TPU-SPAN-LEAK   a time.perf_counter[_ns]() latency measurement in
+                   sched/, copr/, or compilecache/ whose enclosing
+                   function feeds a latency counter (an augmented
+                   ``+=`` into a ``*_ns``/``*_ms``/``*_us``/``*_total``
+                   /``*_seconds`` target) WITHOUT recording through the
+                   copscope obs API (a span/trace reference or a
+                   histogram ``observe``): a latency number that only
+                   lands in an ad-hoc counter is invisible to TRACE,
+                   the flight recorder, and the latency histograms —
+                   route every measured duration through obs/.
 - TPU-PALLAS-SHAPE in copr/pallas/ (the hand-written TPU kernel
                    package): a ``pallas_call`` whose ``grid=`` or a
                    ``BlockSpec`` whose block shape contains a
@@ -168,11 +178,27 @@ LOCK_MODULES = {
     # grown by the flow interpreter run under submit (verify_task) and
     # the session plan path, so they join the cross-layer contract
     "parallel/topology.py", "analysis/shardflow.py",
+    # copscope (ISSUE 13): the span-tree and flight-recorder leaf locks
+    # are taken from the drain thread (span recording) and every
+    # statement thread (render/record), so they join the contract
+    "obs/trace.py", "obs/recorder.py",
 }
 
 # modules whose retry/re-dispatch loops must spend a typed Backoffer
 # budget (TPU-RETRY-BUDGET): the device dispatch + scheduler layers
 RETRY_MODULE_PREFIXES = ("sched/", "store/")
+
+# modules whose latency measurements must flow through the copscope
+# obs span/histogram API (TPU-SPAN-LEAK): the launch-path layers whose
+# timings TRACE and the flight recorder attribute
+SPAN_MODULE_PREFIXES = ("sched/", "copr/", "compilecache/")
+# counter targets that smell like a latency/total accumulator
+_LAT_COUNTER = re.compile(r"(_ns|_ms|_us|_total|_seconds)$")
+_PERF_CALL = re.compile(r"^perf_counter(_ns)?$")
+# the obs API surface: span trees / TraceCtx references or a histogram
+# observe — any of these in the function means the measurement is
+# recorded where TRACE/recorder/histograms can see it
+_OBS_REF = re.compile(r"observe|span|trace", re.IGNORECASE)
 
 # the AOT program cache (copforge): every seam where executable bytes
 # hit or leave disk must carry the digest + mesh-fingerprint +
@@ -638,6 +664,60 @@ class _ExprRules(_Scoped):
 
 
 # --------------------------------------------------------------------- #
+# rule: TPU-SPAN-LEAK (latency measurements must reach the obs API)
+# --------------------------------------------------------------------- #
+
+class _SpanLeakRules(_Scoped):
+    """Per-function analysis: a function that measures wall time with
+    time.perf_counter[_ns]() AND feeds a latency counter (``+=`` into
+    a *_ns/*_ms/*_us/*_total/*_seconds target) must also reference the
+    obs span/trace surface or a histogram ``observe`` — otherwise the
+    measurement is invisible to TRACE, the flight recorder, and the
+    latency histograms (copscope, ISSUE 13)."""
+
+    def visit_FunctionDef(self, node):
+        self.scope.append(node.name)
+        self._check_fn(node)
+        self.generic_visit(node)
+        self.scope.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _check_fn(self, fn) -> None:
+        has_perf = False
+        obs_ref = False
+        feeds: list = []
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Call) and \
+                    _PERF_CALL.match(_call_name(sub)):
+                has_perf = True
+            name = None
+            if isinstance(sub, ast.Name):
+                name = sub.id
+            elif isinstance(sub, ast.Attribute):
+                name = sub.attr
+            if name is not None and _OBS_REF.search(name):
+                obs_ref = True
+            if isinstance(sub, ast.AugAssign) \
+                    and isinstance(sub.op, ast.Add):
+                t = sub.target
+                tn = t.attr if isinstance(t, ast.Attribute) else \
+                    (t.id if isinstance(t, ast.Name) else "")
+                if tn and _LAT_COUNTER.search(tn):
+                    feeds.append((sub, tn))
+        if not has_perf or obs_ref:
+            return
+        for node, tn in feeds:
+            self.add("TPU-SPAN-LEAK", node,
+                     f"perf_counter latency measurement feeds `{tn}` "
+                     "without recording through the obs span/histogram "
+                     "API: the duration is invisible to TRACE, the "
+                     "flight recorder, and the latency histograms — "
+                     "record a span (obs.trace) or observe() a "
+                     "histogram next to the counter")
+
+
+# --------------------------------------------------------------------- #
 # rule: TPU-PALLAS-SHAPE (copr/pallas/ kernel hygiene)
 # --------------------------------------------------------------------- #
 
@@ -896,6 +976,10 @@ def lint_source(src: str, rel: str) -> list:
         pr = _PallasRules(rel, lines)
         pr.visit(tree)
         findings += pr.findings
+    if rel.startswith(SPAN_MODULE_PREFIXES):
+        sl = _SpanLeakRules(rel, lines)
+        sl.visit(tree)
+        findings += sl.findings
     if rel in LOCK_MODULES:
         findings += _LockRules(rel, lines, tree).run()
     # collapse repeats on one line (e.g. three id() calls in one tuple)
@@ -956,4 +1040,5 @@ def new_findings(findings: list, baseline: set) -> list:
 __all__ = ["Finding", "lint_source", "lint_tree", "load_baseline",
            "new_findings", "TRACED_MODULES", "HOT_PATH_MODULES",
            "LOCK_MODULES", "RETRY_MODULE_PREFIXES",
-           "COMPILECACHE_PREFIX", "PALLAS_PREFIX"]
+           "COMPILECACHE_PREFIX", "PALLAS_PREFIX",
+           "SPAN_MODULE_PREFIXES"]

@@ -108,6 +108,14 @@ class CompileCache:
                                    "persisted executables")
         self._m_bytes = reg.gauge("tidb_tpu_compile_cache_bytes",
                                   "warm program pool resident bytes")
+        # copscope (obs/): resolve latency histogram by outcome — every
+        # perf_counter_ns measurement in this module records through
+        # the obs histogram API (TPU-SPAN-LEAK contract)
+        from ..utils.metrics import Histogram
+        self._m_resolve_ms = reg.histogram(
+            "tidb_tpu_compile_resolve_ms",
+            "program resolve latency by outcome (load/compile/warm)",
+            buckets=Histogram.MS_BUCKETS, labels=("outcome",))
 
     # ---- knobs (sysvars ride through session._exec_ctx) -------------- #
 
@@ -317,6 +325,7 @@ class CompileCache:
                 self._note_caps(key)
                 self._m_hits.inc()
                 self._m_load.inc(dt_ns / 1e6)
+                self._m_resolve_ms.observe(dt_ns / 1e6, outcome="load")
                 m = self.manifest
                 if m is not None:
                     m.touch(entry_hex, dt_ns / 1e6)
@@ -339,6 +348,7 @@ class CompileCache:
             self._tl.misses += 1
             self._tl.compiled_ns += dt_ns
         self._m_miss.inc()
+        self._m_resolve_ms.observe(dt_ns / 1e6, outcome="compile")
         nbytes = self._persist(entry_hex, key, exe) or NOMINAL_EXE_BYTES
         with self._mu:
             self._pool_put_locked(entry_hex, exe, nbytes)
@@ -375,6 +385,7 @@ class CompileCache:
             self.warm_loaded += 1
             self.load_ms_total += dt_ns / 1e6
         self._m_load.inc(dt_ns / 1e6)
+        self._m_resolve_ms.observe(dt_ns / 1e6, outcome="warm")
         m = self.manifest
         if m is not None:
             m.touch(entry_hex, dt_ns / 1e6)

@@ -82,6 +82,17 @@ class QueryHandle:
                    fused: int = 0, rus: float = 0.0,
                    retried: int = 0, compile_ns: int = 0,
                    compile_miss: bool = False) -> None:
+        """Call seam contract (audited, ISSUE 13): ``fused`` is the
+        MEMBER COUNT of the launch that served this task (scheduler
+        ``_serve_fused`` sets ``task.fused = len(programs)``), so any
+        real fusion — 2 members included — satisfies ``fused > 1`` and
+        counts the task once.  The scheduler must set ``task.fused`` /
+        ``task.coalesced`` BEFORE ``task.finish()``: this method runs
+        on the waiter thread right after ``wait()`` returns, and a
+        post-finish assignment raced it (the historical undercount).
+        ``sched_tasks``/``sched_fused`` flow unchanged into EXPLAIN
+        ANALYZE (``tasks:``/``fused:``) and statements_summary
+        (Sum_sched_tasks/Sum_fused) so the two surfaces agree."""
         with self._mu:
             self.sched_wait_ns += int(wait_ns)
             self.sched_tasks += 1

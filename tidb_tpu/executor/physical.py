@@ -356,6 +356,7 @@ class CopTaskExec(PhysOp):
         if handle is not None:
             handle.note_fragment(self.describe())
         sched_w0 = handle.sched_wait_ns if handle is not None else 0
+        sched_n0 = handle.sched_tasks if handle is not None else 0
         sched_f0 = handle.sched_fused if handle is not None else 0
         sched_r0 = handle.sched_rus if handle is not None else 0.0
         sched_t0 = handle.sched_retried if handle is not None else 0
@@ -394,6 +395,7 @@ class CopTaskExec(PhysOp):
             # ANALYZE (select_result.go copr execution-info analog),
             # plus how many of its launches were cross-query fused
             dw = handle.sched_wait_ns - sched_w0
+            dn = handle.sched_tasks - sched_n0
             df = handle.sched_fused - sched_f0
             dr = handle.sched_rus - sched_r0
             # copforge: where the schedWait went — a cold digest shows
@@ -402,10 +404,13 @@ class CopTaskExec(PhysOp):
             # per statement, not just in /sched counters)
             dc = handle.compile_ns - sched_c0
             dm = handle.compile_misses - sched_m0
+            # tasks/fused ride the same handle counters the statement
+            # summary aggregates (copscope satellite: one consistent
+            # story across EXPLAIN ANALYZE and statements_summary)
             self._rt_detail = (f"schedWait: {dw / 1e6:.3f}ms, "
                                f"compile: {'miss' if dm else 'hit'} "
                                f"{dc / 1e6:.3f}ms, "
-                               f"fused: {df}, ru: {dr:.1f}")
+                               f"tasks: {dn}, fused: {df}, ru: {dr:.1f}")
             # launch supervision (faultline): transient re-launches the
             # drain paid, and whether the host oracle served this task
             # after a quarantine — only noted when they happened

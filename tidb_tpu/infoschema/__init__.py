@@ -132,8 +132,10 @@ def _processlist(dom):
 
 
 def _slow_query(dom):
-    return [(sql, ms / 1000.0, rows)
-            for sql, ms, rows in dom.stmt_summary.slow_rows()]
+    return [(sql, ms / 1000.0, rows, wait_ms, compile_ms, ru, retried,
+             trace_id)
+            for sql, ms, rows, wait_ms, compile_ms, ru, retried, trace_id
+            in dom.stmt_summary.slow_rows()]
 
 
 def _stmt_summary(dom):
@@ -301,12 +303,16 @@ _INFORMATION_SCHEMA = {
                      ("COMMAND", S), ("TIME", I), ("STATE", S),
                      ("INFO", S)], _processlist),
     "SLOW_QUERY": ([("QUERY", S), ("QUERY_TIME", F),
-                    ("ROWS_SENT", I)], _slow_query),
+                    ("ROWS_SENT", I), ("SCHED_WAIT_MS", F),
+                    ("COMPILE_MS", F), ("RU", F), ("RETRIED", I),
+                    ("TRACE_ID", S)], _slow_query),
     "STATEMENTS_SUMMARY": ([("DIGEST_TEXT", S), ("EXEC_COUNT", I),
                             ("AVG_LATENCY_MS", F), ("MAX_LATENCY_MS", F),
                             ("SUM_ROWS", I), ("QUERY_SAMPLE_TEXT", S),
                             ("AVG_SCHED_WAIT_MS", F),
-                            ("AVG_COMPILE_MS", F), ("AVG_RU", F)],
+                            ("AVG_COMPILE_MS", F),
+                            ("SUM_SCHED_TASKS", I), ("SUM_FUSED", I),
+                            ("AVG_RU", F)],
                            _stmt_summary),
     "VIEWS": ([("TABLE_CATALOG", S), ("TABLE_SCHEMA", S),
                ("TABLE_NAME", S), ("VIEW_DEFINITION", S),

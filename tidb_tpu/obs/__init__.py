@@ -1,0 +1,23 @@
+"""copscope (ISSUE 13): end-to-end observability for the async serving
+stack.
+
+- ``trace``: cross-thread trace propagation (``TraceCtx`` stamped onto
+  CopTask at submit) + lock-protected per-statement span trees with
+  explicit parent ids — the scheduler drain, copforge resolve, and
+  client transfer/merge seams record real spans from their own threads.
+- ``recorder``: bounded flight-recorder ring of completed query traces
+  (failed/degraded/quarantined/retried/slow always kept, the rest
+  sampled), served at ``/trace`` + ``/trace/<id>`` with Chrome
+  trace-event export (``?fmt=chrome``).
+
+Latency histograms ride ``utils/metrics`` (label-aware prometheus-text
+histograms) — ``tidb_tpu_sched_{wait,launch,compile}_ms`` and the
+per-strategy agg launch histogram are wired at the scheduler drain.
+"""
+
+from .recorder import FlightRecorder
+from .trace import (TRACE_CTX, Span, SpanTree, TraceCtx, annotate,
+                    current, flag, new_trace_id, span)
+
+__all__ = ["Span", "SpanTree", "TraceCtx", "TRACE_CTX", "current",
+           "span", "flag", "annotate", "new_trace_id", "FlightRecorder"]

@@ -51,6 +51,14 @@ owns all launches onto one jax mesh:
   allows.  The seeded FaultPlan (faults/plan.py) injects deterministic
   transient/poison faults at the build/launch/drain seams so every one
   of these paths is exercisable on a CPU mesh.
+- Every task carries its statement's copscope TraceCtx (obs/): the
+  drain records REAL spans from its own thread — queue wait (rc debit
+  riding as an attr), copforge compile (hit/miss), launch (predicted
+  vs measured ms, per-link transfer bytes), fusion assembly with
+  per-member attributed share, transient-retry backoff, OOM/bisect/
+  quarantine markers — into the statement's lock-protected span tree
+  BEFORE the waiting task finishes, so TRACE and the flight recorder
+  always see the scheduler-side story.  Untraced tasks skip it all.
 - Queue-wait / launch / coalesce / fusion stats feed utils/metrics
   (scraped at /metrics), the /sched status route, per-statement
   execdetails (`schedWait`/`fused`/`ru` in EXPLAIN ANALYZE), priced
@@ -334,6 +342,25 @@ class DeviceScheduler:
             "tidb_tpu_sched_shed_total",
             "submits shed at the queue head: corrected-cost backlog "
             "already exceeded the waiter's deadline")
+        # copscope (obs/): millisecond latency histograms — the
+        # prometheus-scrapeable successors of the ad-hoc p50/p99 wait
+        # ring (which /sched keeps for back-compat); bench pulls its
+        # percentiles from these
+        from ..utils.metrics import Histogram
+        ms = Histogram.MS_BUCKETS
+        self._m_wait_ms = reg.histogram(
+            "tidb_tpu_sched_wait_ms",
+            "admission queue wait per task (ms)", buckets=ms)
+        self._m_launch_ms = reg.histogram(
+            "tidb_tpu_sched_launch_ms",
+            "device launch wall time per launch (ms)", buckets=ms)
+        self._m_compile_ms = reg.histogram(
+            "tidb_tpu_sched_compile_ms",
+            "program resolve/compile time per launch (ms)", buckets=ms)
+        self._m_agg_ms = reg.histogram(
+            "tidb_tpu_agg_launch_ms",
+            "agg launch wall time by group strategy (ms)", buckets=ms,
+            labels=("strategy",))
 
     # ------------------------------------------------------------- #
     # admission
@@ -536,6 +563,10 @@ class DeviceScheduler:
                 with self._mu:
                     self.quarantined += 1
                 self._m_quar.inc()
+                self._trace_mark(task, "sched.quarantine",
+                                 digest=f"{task.key[0] & ((1 << 64) - 1):016x}")
+                if task.trace is not None:
+                    task.trace.tree.flag("quarantined")
                 raise
         # rc pricing happens HERE, in the submitting thread: structured
         # tasks price from the LaunchCost the admission gate just
@@ -683,6 +714,11 @@ class DeviceScheduler:
                     self._backlog_sub_locked(t)
                     self.rc_exhausted += 1
                     self._m_rc_exhaust.inc(group=g.name)
+                    if t.trace is not None:
+                        # the waiter never launched: its whole life was
+                        # queue wait — record it with the expiry marked
+                        t.trace.add("sched.queue", t.submit_ns, now,
+                                    group=g.name, expired=True)
                     t.fail(ResourceExhaustedError(
                         t.group, (now - t.submit_ns) / 1e9, t.rus))
                     expired = True
@@ -917,6 +953,10 @@ class DeviceScheduler:
         if dns <= 0 and dmiss <= 0:
             return
         self.compile_ns_total += dns
+        if dns > 0:
+            # copscope: resolve/compile latency histogram (the span
+            # twin is recorded per launch in _trace_launch)
+            self._m_compile_ms.observe(dns / 1e6)
         for t in tasks:
             t.compile_ns += dns
             if dmiss:
@@ -974,6 +1014,99 @@ class DeviceScheduler:
 
         threading.Thread(target=warm, name="copforge-predict",
                          daemon=True).start()
+
+    # ------------------------------------------------------------- #
+    # copscope span recording (obs/): the drain's side of the trace
+    # ------------------------------------------------------------- #
+
+    @staticmethod
+    def _err_label(e: BaseException) -> str:
+        return f"{type(e).__name__}: {str(e)[:80]}"
+
+    @staticmethod
+    def _strategy_of(dag) -> Optional[str]:
+        s = getattr(dag, "strategy", None)
+        return getattr(s, "value", None) if s is not None else None
+
+    @staticmethod
+    def _trace_mark(t, name: str, **attrs) -> None:
+        """Zero-duration marker span on one task's trace (oom / bisect
+        / quarantine / fail seams); no-op when untraced."""
+        if t.trace is not None:
+            now = time.perf_counter_ns()
+            t.trace.add(name, now, now, **attrs)
+
+    def _trace_launch(self, tasks: list, start_ns: int, end_ns: int,
+                      mode: str, fused: int = 0) -> None:
+        """Record one physical launch's scheduler-side span tree +
+        latency histograms, on the DRAIN thread BEFORE the tasks
+        finish — a waiter rendering its trace right after wait()
+        always sees these spans (no post-finish race).
+
+        Per traced task: a ``sched.queue`` span (submit -> drain
+        pickup; rc debit rides it as the ``ru`` attr) and a
+        ``sched.launch`` span (resolve + device execution) carrying
+        predicted_ms (calibrated LaunchCost via copmeter's predict_ms)
+        vs measured_ms, the shardflow per-link transfer breakdown,
+        and — as children — the copforge ``sched.compile`` span
+        (hit/miss) and the ``sched.fusion`` assembly span with the
+        member count and this member's attributed share."""
+        wall_ms = (end_ns - start_ns) / 1e6
+        self._m_launch_ms.observe(wall_ms)
+        for strat in {self._strategy_of(t.dag) for t in tasks} - {None}:
+            self._m_agg_ms.observe(wall_ms, strategy=strat)
+        if all(t.trace is None for t in tasks):
+            return
+        lead = tasks[0]
+        shares = None
+        if fused > 1 or len(tasks) > 1:
+            weights = [lead.cost.peak_hbm_bytes
+                       if lead.cost is not None else 0]
+            weights += [self._marginal_bytes(t, lead) for t in tasks[1:]]
+            shares = split_device_time(weights, end_ns - start_ns)
+        from ..analysis.calibrate import predict_ms
+        for i, t in enumerate(tasks):
+            ctx = t.trace
+            if ctx is None:
+                continue
+            attrs = {"mode": mode, "measured_ms": round(wall_ms, 3)}
+            if t.cost is not None:
+                attrs["predicted_ms"] = round(predict_ms(t.cost), 3)
+                bd = t.cost.transfer_breakdown or (0, 0, 0)
+                if bd[1] or bd[2]:
+                    attrs["ici_bytes"], attrs["dci_bytes"] = bd[1], bd[2]
+            strat = self._strategy_of(t.dag)
+            if strat is not None:
+                attrs["strategy"] = strat
+            if t.retries:
+                attrs["retries"] = t.retries
+            items = [
+                ("sched.queue", t.submit_ns, t.start_ns, ctx.span_id,
+                 {"group": t.group, "ru": round(t.rus_charged, 2)}),
+                ("sched.launch", start_ns, end_ns, ctx.span_id, attrs),
+            ]
+            if t.compile_ns:
+                items.append((
+                    "sched.compile", start_ns, start_ns + t.compile_ns,
+                    ("rel", 1),
+                    {"result": "miss" if t.compile_miss else "hit"}))
+            if fused > 1:
+                fat = {"members": fused}
+                if shares is not None:
+                    fat["share_ms"] = round(shares[i] / 1e6, 3)
+                items.append(("sched.fusion", t.start_ns, start_ns,
+                              ("rel", 1), fat))
+            ctx.tree.add_batch(items)
+
+    def _trace_retry(self, tasks: list, err: BaseException,
+                     start_ns: int, end_ns: int) -> None:
+        """One transient-failure backoff cycle: a real span covering
+        the retry sleep, per affected waiter."""
+        label = self._err_label(err)
+        for t in tasks:
+            if t.trace is not None:
+                t.trace.add("sched.retry", start_ns, end_ns,
+                            attempt=t.retries, error=label)
 
     # ------------------------------------------------------------- #
     # launch supervision (faultline)
@@ -1048,6 +1181,7 @@ class DeviceScheduler:
                 if self._is_transient(e):
                     if bo is None:
                         bo = self._launch_backoffer()
+                    retry_t0 = time.perf_counter_ns()
                     try:
                         bo.backoff(DEVICE_FAILED, e)
                     except RetryBudgetExceeded as budget:
@@ -1059,6 +1193,8 @@ class DeviceScheduler:
                     self._m_retried.inc(len(live))
                     for t in live:
                         t.retries += 1
+                    self._trace_retry(live, e, retry_t0,
+                                      time.perf_counter_ns())
                     continue
                 self._isolate([t for t in batch if not t.done], e)
                 return
@@ -1080,6 +1216,10 @@ class DeviceScheduler:
         must not quarantine a program that would fit when resized."""
         self.oom_faults += 1
         self._m_oom.inc()
+        for t in live:
+            self._trace_mark(t, "sched.oom", error=self._err_label(err))
+            if t.trace is not None:
+                t.trace.tree.flag("oom")
         if self.calibration_enable:
             from ..analysis.calibrate import correction_store
             store = correction_store()
@@ -1132,10 +1272,14 @@ class DeviceScheduler:
                     # entries (no quarantine laundering)
                     self._cc_quarantine(d, live)
             for t in live:
+                self._trace_mark(t, "sched.fail",
+                                 error=self._err_label(err))
                 t.fail(err)
             return
         self.bisected_launches += 1
         self._m_bisect.inc()
+        for t in live:
+            self._trace_mark(t, "sched.bisect", members=len(subs))
         for sub in subs:
             # recursion bottoms out: a solo member that fails again
             # lands in the len(subs) <= 1 branch above
@@ -1175,7 +1319,11 @@ class DeviceScheduler:
             # (transient retry vs fail) instead of failing the waiter
             # on the first error
             _faults.check("launch")
-            lead.finish(lead.fn())
+            t_l0 = time.perf_counter_ns()
+            val = lead.fn()
+            self._trace_launch([lead], t_l0, time.perf_counter_ns(),
+                               "opaque")
+            lead.finish(val)
             self.launches += 1
             self._m_launch.inc(mode="single")
             return
@@ -1209,6 +1357,7 @@ class DeviceScheduler:
         members = [grp[0] for grp in programs]
         lead = members[0]
         cc0 = self._cc_mark()
+        t_l0 = time.perf_counter_ns()     # launch span covers resolve
         try:
             # the launch seam is consulted once PER MEMBER digest: a
             # poisoned member refuses the fused launch (caught below),
@@ -1235,14 +1384,23 @@ class DeviceScheduler:
             return False    # refused groups launch apart below (same
                             # results, no fusion win)
         total = sum(len(grp) for grp in programs)
-        self._cc_note([t for grp in programs for t in grp], cc0)
+        all_tasks = [t for grp in programs for t in grp]
+        self._cc_note(all_tasks, cc0)
+        # fused/coalesced attrs + spans are set BEFORE finish(): the
+        # waiter's _note_sched reads task.fused right after wait()
+        # returns, so setting them after finish raced the waiter and
+        # undercounted `fused`/`coalesced` in EXPLAIN ANALYZE and
+        # statements_summary (copscope satellite: the note_sched seam)
+        for t in all_tasks:
+            t.fused = len(programs)
+            t.coalesced = total
+        self._trace_launch(all_tasks, t_l0, time.perf_counter_ns(),
+                           "fused", fused=len(programs))
         for grp, out in zip(programs, outs):
             sprog = get_sharded_program(grp[0].dag, grp[0].mesh,
                                         grp[0].row_capacity)
             for t in grp:
                 t.finish((sprog, out))
-                t.fused = len(programs)
-                t.coalesced = total
         self.launches += 1
         if fprog._donate_argnums:
             self.donated_launches += 1
@@ -1262,6 +1420,7 @@ class DeviceScheduler:
                                      get_sharded_program)
         digest = lead.key[0] if lead.key is not None else None
         cc0 = self._cc_mark()
+        t_l0 = time.perf_counter_ns()     # launch span covers resolve
         _faults.check("build", digest)
         prog = get_sharded_program(lead.dag, lead.mesh, lead.row_capacity,
                                    donate=lead.donate)
@@ -1290,6 +1449,12 @@ class DeviceScheduler:
                 outs = bprog([s[0].cols for s in slots],
                              [s[0].counts for s in slots])
                 self._cc_note(batch, cc0)
+                # coalesced attr + spans BEFORE finish (waiter race,
+                # see _serve_fused)
+                for t in batch:
+                    t.coalesced = len(batch)
+                self._trace_launch(batch, t_l0,
+                                   time.perf_counter_ns(), "batched")
                 for s, out in zip(slots, outs):
                     for t in s:
                         t.finish((prog, out))
@@ -1307,11 +1472,20 @@ class DeviceScheduler:
             except Exception:   # planlint: ok - vmap capability probe;
                 pass        # op not vmappable on this backend: launch
                             # apart below (same results, no batching win)
+        first = True
         for s in slots:
+            t_s0 = t_l0 if first else time.perf_counter_ns()
+            first = False
             out = prog(s[0].cols, s[0].counts, s[0].aux)
             # cumulative from the group's entry: a later slot DID wait
             # on the earlier slots' (and the lead's) resolve/compile
             self._cc_note(s, cc0)
+            if len(batch) > 1:
+                # BEFORE finish (waiter race, see _serve_fused)
+                for t in s:
+                    t.coalesced = len(batch)
+            self._trace_launch(s, t_s0, time.perf_counter_ns(),
+                               "coalesced" if len(s) > 1 else "single")
             for t in s:
                 t.finish((prog, out))
             self.launches += 1
@@ -1408,6 +1582,7 @@ class DeviceScheduler:
                     self._digest_ns.bump(dk, t.device_ns)
                 self._wait_ring.append(t.wait_ns)
                 self._m_wait.observe(t.wait_ns / 1e9)
+                self._m_wait_ms.observe(t.wait_ns / 1e6)
                 self._m_ru.inc(t.rus_charged, group=t.group)
 
     # ------------------------------------------------------------- #

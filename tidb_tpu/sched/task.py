@@ -24,6 +24,8 @@ import threading
 import time
 from typing import Any, Callable, Optional
 
+from ..obs.trace import TRACE_CTX as _TRACE_CTX
+
 # the submitting statement's (resource group name, fair-share weight,
 # rc ResourceGroup-or-None) — bound by Session.execute around each
 # statement; travels into worker threads via contextvars.copy_context
@@ -113,7 +115,8 @@ class CopTask:
                  "fusion_key", "cancelled", "_done", "_value", "_exc",
                  "est_rows", "cost", "cost_static", "rc_group", "rus",
                  "rus_charged", "device_ns", "deadline_ns", "svc_ns",
-                 "donate", "retries", "compile_ns", "compile_miss")
+                 "donate", "retries", "compile_ns", "compile_miss",
+                 "trace")
 
     def __init__(self, *, key=None, dag=None, mesh=None, row_capacity=0,
                  cols=None, counts=None, aux=(), input_token=None,
@@ -162,6 +165,11 @@ class CopTask:
         self.compile_ns = 0       # program resolve/compile time this
                                   # task's launch paid (copforge; 0 = warm)
         self.compile_miss = False  # launch compiled (vs warm-pool hit)
+        # copscope trace propagation (obs/): the submitting statement's
+        # TraceCtx rides the task like SCHED_GROUP does, so the drain
+        # thread records queue/compile/launch/retry spans under the
+        # statement's dispatch span — None = untraced, zero overhead
+        self.trace = _TRACE_CTX.get()
         self.cancelled = False
         self._done = threading.Event()
         self._value = None

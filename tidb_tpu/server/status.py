@@ -67,7 +67,9 @@ class StatusServer:
         return self._route(path)
 
     def _route(self, path: str) -> tuple[str, str]:
-        path = path.split("?")[0].rstrip("/") or "/status"
+        path, _, qs = path.partition("?")
+        query = dict(p.split("=", 1) for p in qs.split("&") if "=" in p)
+        path = path.rstrip("/") or "/status"
         if path == "/status":
             from .mysql_server import SERVER_VERSION
             return json.dumps({
@@ -163,6 +165,24 @@ class StatusServer:
                     "records": mgr.runaway_ring.records(),
                 },
             }), "application/json"
+        if path == "/trace":
+            # copscope flight recorder (obs/): newest-first index of
+            # retained statement traces (failed/degraded/quarantined/
+            # retried/slow always kept, the rest sampled) + ring stats
+            fr = self.domain.flight_recorder
+            return json.dumps({"stats": fr.stats(),
+                               "traces": fr.index()}), "application/json"
+        if path.startswith("/trace/"):
+            # one statement's full span tree; ?fmt=chrome exports the
+            # Chrome trace-event / Perfetto JSON (load in ui.perfetto.dev
+            # or chrome://tracing)
+            trace_id = path.split("/")[2]
+            tree = self.domain.flight_recorder.get(trace_id)
+            if tree is None:
+                raise KeyError(trace_id)
+            if query.get("fmt") == "chrome":
+                return json.dumps(tree.chrome_trace()), "application/json"
+            return json.dumps(tree.to_dict()), "application/json"
         if path == "/settings":
             # handler/settings analog: live global sysvars
             return json.dumps(dict(sorted(

@@ -7,6 +7,7 @@ catalog + mesh + cop client per process) is session.domain.Domain.
 
 from __future__ import annotations
 
+import sys
 import time
 from dataclasses import dataclass, field
 from typing import Any, Optional
@@ -107,6 +108,11 @@ class Domain:
         self._ddl_mu = threading.Lock()
         from ..utils.stmtsummary import StmtSummary
         self.stmt_summary = StmtSummary()   # util/stmtsummary analog
+        # copscope flight recorder (obs/): bounded ring of completed
+        # statement traces — failed/degraded/quarantined/slow always
+        # kept, the rest sampled; served at /trace on the StatusServer
+        from ..obs import FlightRecorder
+        self.flight_recorder = FlightRecorder()
         from ..planner.bindinfo import BindManager
         self.bindings = BindManager()       # GLOBAL plan bindings
         if not hasattr(self, "_next_table_id"):   # durable mode recovered it
@@ -387,6 +393,22 @@ class Session:
                 ent = REGISTRY.get(name)
                 return ent.default if ent is not None else None
 
+            # copscope statement trace (obs/): one span tree per
+            # statement, rooted here; the TraceCtx contextvar carries it
+            # into every dispatch so scheduler threads stitch their
+            # spans under it.  Off (tidb_tpu_trace=0) = no tree, no
+            # contextvar, zero recording anywhere.
+            from ..obs import trace as _obs_trace
+            _merged_obs = {**self.domain.sysvars, **self.vars}
+            trace_tree = None
+            trace_root = None
+            obs_tok = None
+            if _flag_on(_merged_obs, "tidb_tpu_trace", True):
+                trace_tree = _obs_trace.SpanTree(sql=text,
+                                                 conn_id=self.conn_id)
+                trace_root = trace_tree.begin("session.ExecuteStmt")
+                obs_tok = _obs_trace.TRACE_CTX.set(
+                    _obs_trace.TraceCtx(trace_tree, trace_root))
             stok = SESSION_INFO.set({
                 "db": self.db, "user": self.user,
                 "conn_id": self.conn_id,
@@ -415,18 +437,53 @@ class Session:
                 SCHED_GROUP.reset(gtok)
                 QUERY_HANDLE.reset(htok)
                 KILL_EVENT.reset(ktok)
+                if obs_tok is not None:
+                    _obs_trace.TRACE_CTX.reset(obs_tok)
+                    trace_tree.end(trace_root)
+                    trace_tree.latency_ms = \
+                        (time.perf_counter_ns() - t0) / 1e6
+                    if handle.degraded:
+                        trace_tree.flag("degraded")
+                    if handle.sched_retried:
+                        trace_tree.flag("retried")
+                    if sys.exc_info()[0] is not None:
+                        # failed statements ALWAYS reach the recorder —
+                        # the success path records after the slow-log
+                        # verdict below
+                        trace_tree.flag("failed")
+                        self.domain.flight_recorder.record(trace_tree)
                 self.domain.coordinator.end(self.conn_id)
                 self._cur_sql = None
             dt_ns = time.perf_counter_ns() - t0
             qcnt.inc(type=type(stmt).__name__)
             qdur.observe(dt_ns / 1e9)
-            self.domain.stmt_summary.record(
+            # slow-log threshold is live sysvar state (session scope
+            # shadows global), plumbed session -> Domain on each record
+            try:
+                self.domain.stmt_summary.slow_threshold_ms = float(
+                    _merged_obs.get("tidb_tpu_slow_threshold_ms", 300)
+                    or 0)
+                self.domain.flight_recorder.sample_every = max(int(
+                    _merged_obs.get("tidb_tpu_trace_sample", 16) or 16),
+                    1)
+            except (TypeError, ValueError):
+                pass
+            was_slow = self.domain.stmt_summary.record(
                 text, dt_ns, len(out.rows),
                 cpu_ns=time.thread_time_ns() - cpu0,
                 plan_text=self._last_plan_text,
                 sched_wait_ns=handle.sched_wait_ns,
                 rus=handle.sched_rus,
-                compile_ns=handle.compile_ns)
+                compile_ns=handle.compile_ns,
+                sched_tasks=handle.sched_tasks,
+                fused=handle.sched_fused,
+                retried=handle.sched_retried,
+                trace_id=trace_tree.trace_id
+                if trace_tree is not None else "")
+            if trace_tree is not None:
+                if was_slow:
+                    trace_tree.flag("slow")
+                self.domain.flight_recorder.record(trace_tree)
             try:
                 # runaway KILL must fire before the success audit hook:
                 # a killed statement is an error to the client
@@ -2508,10 +2565,13 @@ class Session:
             return ResultSet(
                 ["Digest_text", "Exec_count", "Avg_latency_ms",
                  "Max_latency_ms", "Sum_rows", "Sample_sql",
-                 "Avg_sched_wait_ms", "Avg_compile_ms", "Avg_ru"],
+                 "Avg_sched_wait_ms", "Avg_compile_ms",
+                 "Sum_sched_tasks", "Sum_fused", "Avg_ru"],
                 self.domain.stmt_summary.summary_rows())
         if stmt.kind == "slow_queries":
-            return ResultSet(["Query", "Latency_ms", "Rows"],
+            return ResultSet(["Query", "Latency_ms", "Rows",
+                              "Sched_wait_ms", "Compile_ms", "Ru",
+                              "Retried", "Trace_id"],
                              self.domain.stmt_summary.slow_rows())
         if stmt.kind == "processlist":
             # without PROCESS, only the caller's own sessions are visible

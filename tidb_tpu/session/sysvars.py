@@ -112,6 +112,19 @@ _VARS = [
     # on = Pallas everywhere (interpret mode off-TPU, the tier-1 kernel
     # seam); off = XLA lowering everywhere
     _v("tidb_tpu_radix_pallas", "auto", kind="str", scope=SCOPE_GLOBAL),
+    # copscope (obs/): per-statement span trees with cross-thread trace
+    # propagation + the flight-recorder ring.  tidb_tpu_trace off =
+    # no tree is built, no span is recorded anywhere (the overhead
+    # guard's baseline); tidb_tpu_trace_sample = keep 1-in-N ordinary
+    # traces (failed/degraded/quarantined/retried/slow always kept)
+    _v("tidb_tpu_trace", 1, kind="bool"),
+    _v("tidb_tpu_trace_sample", 16, kind="int", min=1, max=65536,
+       scope=SCOPE_GLOBAL),
+    # slow-query log threshold (ms), session -> Domain plumb — replaces
+    # the constructor-only threshold in utils/stmtsummary; slow entries
+    # carry schedWait/compile/ru/retried/trace-id fields
+    _v("tidb_tpu_slow_threshold_ms", 300, kind="int", min=0,
+       max=86_400_000),
     _v("tidb_distsql_scan_concurrency", 15, kind="int", min=1, max=256),
     _v("tidb_max_chunk_size", 1024, kind="int", min=32, max=65536),
     _v("tidb_enable_vectorized_expression", 1, kind="bool"),

@@ -27,6 +27,8 @@ from ..copr.aggregate import (GroupKeyMeta, finalize, finalize_sorted,
                               merge_sorted_states, merge_states)
 from ..faults import plan as _faults
 from ..faults.breaker import LaunchQuarantinedError
+from ..obs.trace import flag as _obs_flag
+from ..obs.trace import span as _obs_span
 from ..parallel.spmd import get_sharded_program
 from .columnar import ColumnarSnapshot, _pow2_at_least
 
@@ -145,6 +147,11 @@ class CopClient:
         # streamed half-size batches, then the host oracle — instead of
         # failing the statement or charging the poison breaker
         self.oom_recovered = 0
+        # copscope (obs/): the last launch's per-link transfer
+        # breakdown, stashed per STATEMENT THREAD by _note_sched so the
+        # device->host transfer span that follows the launch can carry
+        # the shardflow {intra, ici, dci} attribution without re-costing
+        self._obs_tl = threading.local()
 
     @property
     def mesh(self):
@@ -260,7 +267,20 @@ class CopClient:
         return {"enabled": self.sched_enable, "started": True,
                 "client": client, **cc, **self._sched_obj.stats()}
 
+    def _transfer_attrs(self) -> dict:
+        """Per-link attrs for the NEXT transfer span on this statement
+        thread (stashed by _note_sched from the served task's
+        calibrated LaunchCost — shardflow's typed-link split)."""
+        bd = getattr(self._obs_tl, "breakdown", None)
+        self._obs_tl.breakdown = None
+        if not bd or not (bd[0] or bd[1] or bd[2]):
+            return {}
+        return {"intra_bytes": bd[0], "ici_bytes": bd[1],
+                "dci_bytes": bd[2]}
+
     def _note_sched(self, task) -> None:
+        if task.cost is not None:
+            self._obs_tl.breakdown = task.cost.transfer_breakdown
         from ..copr.coordinator import QUERY_HANDLE
         h = QUERY_HANDLE.get()
         if h is not None:
@@ -293,13 +313,17 @@ class CopClient:
         if cols:
             s, c = cols[0][0].shape[:2]
             est = s * c
-        t = sched.submit(CopTask.structured(
-            dag, self.mesh, row_capacity, cols, counts, tuple(aux),
-            est_rows=est, donate=donate))
-        try:
-            return t.wait()
-        finally:
-            self._note_sched(t)
+        # copscope: the dispatch span is the parent every scheduler-
+        # thread span (queue/compile/launch/retry) stitches under — the
+        # CopTask captures the child TraceCtx at construction
+        with _obs_span("cop.dispatch"):
+            t = sched.submit(CopTask.structured(
+                dag, self.mesh, row_capacity, cols, counts, tuple(aux),
+                est_rows=est, donate=donate))
+            try:
+                return t.wait()
+            finally:
+                self._note_sched(t)
 
     def _launch_opaque(self, fn, est_rows: int = 0):
         """Admission-controlled launch of a program with a non-standard
@@ -308,11 +332,12 @@ class CopClient:
         if sched is None:
             return fn()
         from ..sched import CopTask
-        t = sched.submit(CopTask.opaque(fn, est_rows=est_rows))
-        try:
-            return t.wait()
-        finally:
-            self._note_sched(t)
+        with _obs_span("cop.dispatch", opaque=True):
+            t = sched.submit(CopTask.opaque(fn, est_rows=est_rows))
+            try:
+                return t.wait()
+            finally:
+                self._note_sched(t)
 
     # ------------------------------------------------------------- #
 
@@ -330,6 +355,7 @@ class CopClient:
         except LaunchQuarantinedError as err:
             # OPEN breaker: the device program keeps failing — degrade
             # to the host oracle where the plan shape allows it
+            _obs_flag("quarantined")
             res = self._degraded_agg(agg, snap, key_meta, aux_cols, err)
         except Exception as err:
             # copmeter OOM recovery: a launch that exhausted device
@@ -352,6 +378,7 @@ class CopClient:
         as >= 2 HBM-streamed batches), then the host oracle — results
         stay bit-identical to the uncontended run on every rung.  Plans
         with neither shape re-raise the original error."""
+        _obs_flag("oom")
         if not aux_cols:
             half = max(snap.device_bytes() // 2, 1)
             batches = snap.row_batches(half)
@@ -396,6 +423,7 @@ class CopClient:
                     res = CopResult(agg_cols, key_cols)
         if res is None:
             raise err
+        _obs_flag("degraded")
         with self._stat_mu:
             self.degraded += 1
         from ..utils.metrics import global_registry
@@ -477,20 +505,23 @@ class CopClient:
                 if grown is not None:
                     agg = grown
                     continue
-            states = jax.device_get(out)
+            with _obs_span("cop.transfer", **self._transfer_attrs()):
+                states = jax.device_get(out)
             # faultline transfer/host-merge seam, keyed by the digest
             _faults.check("transfer", D.dag_digest(agg))
             break
         else:
             raise RuntimeError("join-capacity regrow did not converge")
-        if prog.host_merge:
-            # min/max partials come back per-device (leading axis); the
-            # final merge is the host's root-worker role
-            per_dev = self._split_devices(states)
-            merged = merge_states(per_dev)
-        else:
-            merged = merge_states([states])
-        key_cols, agg_cols = finalize(agg, merged, key_meta)
+        with _obs_span("cop.host_merge",
+                       kind="per-device" if prog.host_merge else "root"):
+            if prog.host_merge:
+                # min/max partials come back per-device (leading axis);
+                # the final merge is the host's root-worker role
+                per_dev = self._split_devices(states)
+                merged = merge_states(per_dev)
+            else:
+                merged = merge_states([states])
+            key_cols, agg_cols = finalize(agg, merged, key_meta)
         return CopResult(agg_cols, key_cols)
 
     def _platform(self) -> str:
@@ -529,7 +560,8 @@ class CopClient:
             if i + 1 < len(batches):
                 nxt = batches[i + 1].device_put_uncached(self.mesh)
             del cols, counts     # free the batch once its program consumed it
-        return [jax.device_get(o) for o in outs]
+        with _obs_span("cop.transfer", batches=len(outs)):
+            return [jax.device_get(o) for o in outs]
 
     def _stream_dense_agg(self, agg, batches, key_meta) -> CopResult:
         states_list = self._stream_states(agg, batches)
@@ -658,7 +690,8 @@ class CopClient:
                 if grown is not None:
                     agg = grown
                     continue
-            states = jax.device_get(out)
+            with _obs_span("cop.transfer", **self._transfer_attrs()):
+                states = jax.device_get(out)
             true_ng = int(np.max(np.asarray(states["__ngroups__"])))
             if true_ng <= cap:
                 sized = self._with_capacity(agg, cap)
@@ -666,9 +699,10 @@ class CopClient:
             cap = self._warm_cap(agg, _pow2_at_least(true_ng))
         else:
             raise RuntimeError("group-capacity regrow did not converge")
-        per_dev = self._split_devices(states)
-        merged = merge_sorted_states(sized, per_dev)
-        key_cols, agg_cols = finalize_sorted(sized, merged, key_meta)
+        with _obs_span("cop.host_merge", kind="sorted"):
+            per_dev = self._split_devices(states)
+            merged = merge_sorted_states(sized, per_dev)
+            key_cols, agg_cols = finalize_sorted(sized, merged, key_meta)
         return CopResult(agg_cols, key_cols)
 
     # ------------------------------------------------------------- #

@@ -98,6 +98,11 @@ class StmtStats:
     # cache win shows up as Avg_compile_ms -> ~0 while Avg_sched_wait_ms
     # keeps the queueing story
     sum_compile_ns: int = 0
+    # copscope: device tasks this digest admitted and how many of them
+    # rode a cross-query fused launch — surfaced next to the wait/RU
+    # columns so EXPLAIN ANALYZE and statements_summary tell one story
+    sum_sched_tasks: int = 0
+    sum_fused: int = 0
 
     @property
     def avg_latency_ms(self) -> float:
@@ -122,12 +127,28 @@ class SlowQuery:
     latency_ms: float
     ts: float
     rows: int
+    # copscope (ISSUE 13): per-entry evidence — where the latency went
+    # (admission wait, compile), what it cost (RUs), whether it was
+    # retried, and the flight-recorder trace id so the slow-log line
+    # links straight to its span tree at /trace/<id>
+    sched_wait_ms: float = 0.0
+    compile_ms: float = 0.0
+    ru: float = 0.0
+    retried: int = 0
+    trace_id: str = ""
 
 
 class StmtSummary:
-    """Per-Domain workload summary + slow log ring."""
+    """Per-Domain workload summary + slow log ring.
 
-    def __init__(self, slow_threshold_ms: float = 300.0, max_slow: int = 256):
+    ``slow_threshold_ms`` is live state plumbed from the
+    ``tidb_tpu_slow_threshold_ms`` sysvar (session -> Domain) — the
+    constructor default only seeds it."""
+
+    DEFAULT_SLOW_THRESHOLD_MS = 300.0
+
+    def __init__(self, slow_threshold_ms: float = DEFAULT_SLOW_THRESHOLD_MS,
+                 max_slow: int = 256):
         self._stats: dict[str, StmtStats] = {}
         self._slow: list[SlowQuery] = []
         self._lock = threading.Lock()
@@ -137,7 +158,11 @@ class StmtSummary:
     def record(self, sql: str, latency_ns: int, rows: int,
                cpu_ns: int = 0, plan_text: str = "",
                sched_wait_ns: int = 0, rus: float = 0.0,
-               compile_ns: int = 0):
+               compile_ns: int = 0, sched_tasks: int = 0,
+               fused: int = 0, retried: int = 0,
+               trace_id: str = "") -> bool:
+        """Returns True when the statement crossed the slow threshold
+        (the caller flags its trace ``slow`` for the flight recorder)."""
         digest = normalize_sql(sql)
         now = time.time()
         with self._lock:
@@ -154,22 +179,31 @@ class StmtSummary:
             st.sum_sched_wait_ns += int(sched_wait_ns)
             st.sum_rus += float(rus)
             st.sum_compile_ns += int(compile_ns)
+            st.sum_sched_tasks += int(sched_tasks)
+            st.sum_fused += int(fused)
             if plan_text:
                 import hashlib
                 st.plan_digest = hashlib.sha256(
                     plan_text.encode()).hexdigest()[:16]
                 st.sample_plan = plan_text
-            if latency_ns / 1e6 >= self.slow_threshold_ms:
-                self._slow.append(SlowQuery(sql, latency_ns / 1e6, now, rows))
+            slow = latency_ns / 1e6 >= self.slow_threshold_ms
+            if slow:
+                self._slow.append(SlowQuery(
+                    sql, latency_ns / 1e6, now, rows,
+                    sched_wait_ms=sched_wait_ns / 1e6,
+                    compile_ms=compile_ns / 1e6, ru=float(rus),
+                    retried=int(retried), trace_id=trace_id))
                 if len(self._slow) > self.max_slow:
                     self._slow.pop(0)
+            return slow
 
     def summary_rows(self) -> list[tuple]:
         with self._lock:
             return [(s.digest, s.exec_count, round(s.avg_latency_ms, 3),
                      round(s.max_latency_ns / 1e6, 3), s.sum_rows,
                      s.sample_sql, round(s.avg_sched_wait_ms, 3),
-                     round(s.avg_compile_ms, 3), round(s.avg_ru, 2))
+                     round(s.avg_compile_ms, 3), s.sum_sched_tasks,
+                     s.sum_fused, round(s.avg_ru, 2))
                     for s in sorted(self._stats.values(),
                                     key=lambda x: -x.sum_latency_ns)]
 
@@ -189,5 +223,7 @@ class StmtSummary:
 
     def slow_rows(self) -> list[tuple]:
         with self._lock:
-            return [(q.sql, round(q.latency_ms, 3), q.rows)
+            return [(q.sql, round(q.latency_ms, 3), q.rows,
+                     round(q.sched_wait_ms, 3), round(q.compile_ms, 3),
+                     round(q.ru, 2), q.retried, q.trace_id)
                     for q in self._slow]

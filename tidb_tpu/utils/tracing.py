@@ -3,18 +3,29 @@
 Reference analog: pkg/util/tracing (StartRegionEx wrapping opentracing
 spans at every major phase — session.go:2114, adapter, copr) and the
 TRACE statement renderer (executor/trace.go).
+
+Since copscope (ISSUE 13) this module is a compatibility shim over
+``tidb_tpu.obs``: the old depth-counter model is gone — regions carry
+EXPLICIT parent span ids on a lock-protected ``obs.SpanTree``, and
+``region`` re-points ``obs.TRACE_CTX`` for its dynamic extent so device
+work dispatched inside (scheduler drain, copforge resolve, transfer)
+records real spans into the same tree from other threads.  The old
+surface (``Tracer.region`` / ``Tracer.spans`` with ``.depth`` /
+``Tracer.rows``) keeps working.
 """
 
 from __future__ import annotations
 
-import time
 from contextlib import contextmanager
-from dataclasses import dataclass, field
-from typing import Optional
+from dataclasses import dataclass
+
+from ..obs.trace import TRACE_CTX, SpanTree, TraceCtx
 
 
 @dataclass
 class Span:
+    """Back-compat render view (the live spans are ``obs.Span``)."""
+
     name: str
     start_ns: int
     end_ns: int = 0
@@ -26,28 +37,41 @@ class Span:
 
 
 class Tracer:
-    """Per-statement span collector.  Regions nest via a depth counter —
-    single-threaded statement execution, so no context propagation needed."""
+    """Per-statement span collector over an ``obs.SpanTree``.
 
-    def __init__(self):
-        self.spans: list[Span] = []
-        self._depth = 0
-        self._t0 = time.perf_counter_ns()
+    Regions nest via explicit parent ids (cross-thread safe); the
+    legacy depth-counter API is preserved as a derived view."""
+
+    def __init__(self, tree: SpanTree | None = None):
+        self.tree = tree or SpanTree()
+        self._t0 = self.tree.t0
 
     @contextmanager
-    def region(self, name: str):
-        sp = Span(name, time.perf_counter_ns(), depth=self._depth)
-        self.spans.append(sp)
-        self._depth += 1
+    def region(self, name: str, **attrs):
+        """Open a child region under the innermost active region and
+        bind it as the thread's active trace context, so any device
+        work dispatched inside stitches under it."""
+        ctx = TRACE_CTX.get()
+        parent = ctx.span_id if ctx is not None \
+            and ctx.tree is self.tree else None
+        sid = self.tree.begin(name, parent_id=parent, **attrs)
+        tok = TRACE_CTX.set(TraceCtx(self.tree, sid))
         try:
-            yield sp
+            yield sid
         finally:
-            self._depth -= 1
-            sp.end_ns = time.perf_counter_ns()
+            TRACE_CTX.reset(tok)
+            self.tree.end(sid)
+
+    @property
+    def spans(self) -> list[Span]:
+        """Depth-annotated spans in tree (render) order — the legacy
+        shape tests and embedders consume."""
+        return [Span(sp.name, sp.start_ns, sp.end_ns, depth)
+                for sp, depth in self.tree.ordered()]
 
     def rows(self) -> list[tuple]:
         """(span, start_us_rel, duration_us) rows, indented by depth."""
-        return [("  " * sp.depth + sp.name,
-                 round((sp.start_ns - self._t0) / 1e3, 1),
-                 round(sp.duration_us, 1))
-                for sp in self.spans]
+        return self.tree.rows()
+
+
+__all__ = ["Span", "Tracer"]
