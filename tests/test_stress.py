@@ -60,3 +60,50 @@ def test_stress_full_1k_sessions():
     assert out["wrong_results"] == 0, out
     assert out["completion_rate"] >= 0.98, out
     assert out["ru_fairness"] is not None and out["ru_fairness"] < 1.5
+
+
+def test_stress_ledger_conserves_under_concurrency():
+    """copgauge invariant (ISSUE 14 satellite): run the mixed-corpus
+    smoke with the HBM ledger armed and assert it CONSERVES — launch
+    bytes drain back out (no in-flight residue, no negative balances),
+    residency returns to its post-warm baseline after a second wave,
+    and the watermark dominates every per-launch measured peak."""
+    import time
+
+    dom, _s = build_stress_domain(n_rows=20_000)
+    sched = dom.client._scheduler()
+    assert sched is not None and sched.hbm_enable
+    saved_sleep = sched._retry_sleep
+    sched._retry_sleep = lambda sec: None
+    try:
+        out = _harness_out = run_stress_harness(dom, n_sessions=32,
+                                                rate_per_s=400.0)
+        assert out["wrong_results"] == 0, out
+        led = sched._ledger_obj
+        assert led is not None, "ledger never engaged"
+        deadline = time.monotonic() + 10.0
+        while led.inflight_bytes and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert led.inflight_bytes == 0            # drained launch bytes
+        assert led.negative_events == 0           # no negative balances
+        baseline = led.persistent_bytes           # post-warm residency
+        assert baseline > 0
+        out2 = run_stress_harness(dom, n_sessions=16, rate_per_s=400.0)
+        assert out2["wrong_results"] == 0, out2
+        deadline = time.monotonic() + 10.0
+        while led.inflight_bytes and time.monotonic() < deadline:
+            time.sleep(0.02)
+        # conservation: the second wave adds NO residency — the same
+        # snapshot residents serve it, launch bytes all returned
+        assert led.inflight_bytes == 0
+        assert led.persistent_bytes == baseline
+        assert led.negative_events == 0
+        # the watermark dominates every measured launch peak
+        assert led.watermark_bytes >= led.max_measured_bytes
+        assert led.watermark_bytes >= led.persistent_bytes
+        assert led.measured_launches > 0
+        del _harness_out
+    finally:
+        sched._retry_sleep = saved_sleep
+        sched.breaker.reset()
+        correction_store().reset()

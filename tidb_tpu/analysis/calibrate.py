@@ -171,19 +171,26 @@ class BoundedLRU:
 class Correction:
     """One digest's measured corrections (all EWMA, all clamped)."""
     time_factor: float = 1.0     # measured / predicted launch time
-    mem_factor: float = 1.0      # OOM-driven footprint correction
+    mem_factor: float = 1.0      # measured-watermark footprint
+                                 # correction (copgauge; the OOM x2
+                                 # bump is its fast path)
     err: float = 0.0             # EWMA relative error of the
                                  # CALIBRATED prediction (EXPLAIN's N%)
+    mem_err: float = 0.0         # EWMA relative error of the
+                                 # calibrated HBM-peak prediction
     ewma_ms: float = 0.0         # EWMA measured launch wall time
     samples: int = 0
+    mem_samples: int = 0         # measured-watermark observations
     oom_bumps: int = 0
 
     def payload(self) -> dict:
         return {"time_factor": round(self.time_factor, 4),
                 "mem_factor": round(self.mem_factor, 4),
                 "err": round(self.err, 4),
+                "mem_err": round(self.mem_err, 4),
                 "ewma_ms": round(self.ewma_ms, 4),
                 "samples": self.samples,
+                "mem_samples": self.mem_samples,
                 "oom_bumps": self.oom_bumps}
 
     @classmethod
@@ -192,8 +199,10 @@ class Correction:
             time_factor=clamp_factor(d.get("time_factor", 1.0)),
             mem_factor=clamp_factor(d.get("mem_factor", 1.0)),
             err=max(float(d.get("err", 0.0)), 0.0),
+            mem_err=max(float(d.get("mem_err", 0.0)), 0.0),
             ewma_ms=max(float(d.get("ewma_ms", 0.0)), 0.0),
             samples=max(int(d.get("samples", 0)), 0),
+            mem_samples=max(int(d.get("mem_samples", 0)), 0),
             oom_bumps=max(int(d.get("oom_bumps", 0)), 0))
 
 
@@ -213,6 +222,7 @@ class CorrectionStore:
         self._last_persist = 0.0
         self._restored_dirs: set = set()
         self.observed = 0            # launches fed back (lifetime)
+        self.mem_observed = 0        # measured watermarks fed back
         self.oom_events = 0          # OOM bumps recorded (lifetime)
 
     # ---- feedback ---------------------------------------------------- #
@@ -245,6 +255,40 @@ class CorrectionStore:
             self.observed += 1
             self._dirty = True
 
+    def observe_mem(self, digest: str, cost, measured_bytes: int) -> None:
+        """Measured launch watermark feedback (copgauge): EWMA the
+        digest's ``mem_factor`` toward the clamped factor that would
+        make the modeled (non-exact) HBM terms — inter_bytes +
+        output_bytes, exactly what ``corrected_cost`` scales — match
+        the measured peak.  The exact resident-input term is never
+        corrected (copcost pins it byte-for-byte), so the target solves
+        ``exact + f * modeled == measured`` for f.  This is the
+        continuous twin of ``observe_oom``'s x2 bump: admission
+        headroom now tightens AND loosens from evidence instead of
+        waiting for a device fault."""
+        if cost is None or measured_bytes <= 0:
+            return
+        modeled = int(cost.inter_bytes) + int(cost.output_bytes)
+        if modeled <= 0:
+            return
+        exact = cost.peak_hbm_bytes - modeled
+        target = clamp_factor((measured_bytes - exact) / modeled)
+        with self._mu:
+            ent = self._entries.get(digest)
+            if ent is None:
+                ent = Correction()
+                self._entries.put(digest, ent)
+            # error of the memory model as it stood BEFORE this update
+            pred = exact + modeled * clamp_factor(ent.mem_factor)
+            rel = abs(pred - measured_bytes) / max(measured_bytes, 1)
+            ent.mem_err = rel if ent.mem_samples == 0 else \
+                (1.0 - CALIB_ALPHA) * ent.mem_err + CALIB_ALPHA * rel
+            ent.mem_factor = clamp_factor(
+                ent.mem_factor + CALIB_ALPHA * (target - ent.mem_factor))
+            ent.mem_samples += 1
+            self.mem_observed += 1
+            self._dirty = True
+
     def observe_oom(self, digest: str) -> None:
         """An OOM-classified launch failure: the modeled footprint was
         too small — bump the digest's memory correction (clamped) so
@@ -275,7 +319,8 @@ class CorrectionStore:
         Unknown digests return ``cost`` unchanged (the static model)."""
         with self._mu:
             ent = self._entries.get(digest)
-            if ent is None or (ent.samples == 0 and ent.oom_bumps == 0):
+            if ent is None or (ent.samples == 0 and ent.oom_bumps == 0
+                               and ent.mem_samples == 0):
                 return cost
             tf = clamp_factor(ent.time_factor)
             mf = clamp_factor(ent.mem_factor)
@@ -357,13 +402,18 @@ class CorrectionStore:
         with self._mu:
             items = self._entries.items()
             errs = [e.err for _d, e in items if e.samples > 0]
+            merrs = [e.mem_err for _d, e in items if e.mem_samples > 0]
             return {
                 "entries": len(items),
                 "observed": self.observed,
+                "mem_observed": self.mem_observed,
                 "oom_events": self.oom_events,
                 "evictions": self._entries.evictions,
                 "mean_err_pct": round(100.0 * sum(errs) / len(errs), 2)
                 if errs else None,
+                "mean_mem_err_pct": round(
+                    100.0 * sum(merrs) / len(merrs), 2)
+                if merrs else None,
                 "digests": {
                     d: e.payload() for d, e in sorted(
                         items, key=lambda kv: -kv[1].samples)[:8]},

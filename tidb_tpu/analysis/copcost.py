@@ -675,12 +675,11 @@ def task_cost(task) -> Optional[LaunchCost]:
 def mesh_hbm_budget(mesh) -> int:
     """Default per-mesh HBM admission budget: a fraction of the
     device-reported memory limit times the mesh size, with a host-memory
-    fallback when the backend exposes no stats (CPU meshes)."""
-    try:
-        dev = mesh.devices.reshape(-1)[0]
-        stats = dev.memory_stats()
-    except (AttributeError, IndexError, NotImplementedError, RuntimeError):
-        stats = None
+    fallback when the backend exposes no stats (CPU meshes).  The raw
+    poll routes through obs/hbm — the single sanctioned memory_stats
+    seam (TPU-MEM-SOURCE)."""
+    from ..obs.hbm import device_memory_stats
+    stats = device_memory_stats(mesh)
     limit = int((stats or {}).get("bytes_limit", 0) or 0)
     n_dev = int(mesh.devices.size)
     if limit > 0:

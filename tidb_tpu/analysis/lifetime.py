@@ -244,20 +244,43 @@ _RESIDENT: dict = {}
 _RESIDENT_CAP = 128
 
 
-def register_resident(counts) -> None:
+def _sweep_residents() -> None:
+    """Drop dead refs.  Runs on EVERY registration (copgauge satellite:
+    the registry used to prune only when a donation check happened to
+    walk it, so the ledger and ``/hbm`` could count dead entries
+    between donations)."""
+    dead = [k for k, r in _RESIDENT.items() if r() is None]
+    for k in dead:
+        del _RESIDENT[k]
+
+
+def register_resident(counts, nbytes: int = 0,
+                      fingerprint=None) -> None:
     """Mark one snapshot's device-resident counts array as PERSISTENT
-    (called by ``ColumnarSnapshot.device_cols`` on cache fill)."""
+    (called by ``ColumnarSnapshot.device_cols`` on cache fill).  With
+    ``nbytes``/``fingerprint`` the registration also credits the live
+    HBM ledger (obs/hbm): the weakref registry is the ledger's
+    register/unregister event source — the ledger's own weakref death
+    callback is the unregister half."""
     if counts is None:
         return
     try:
         ref = weakref.ref(counts)
     except TypeError:
         return
-    if len(_RESIDENT) > _RESIDENT_CAP:
-        dead = [k for k, r in _RESIDENT.items() if r() is None]
-        for k in dead:
-            del _RESIDENT[k]
+    _sweep_residents()
     _RESIDENT[id(counts)] = ref       # planlint: ok - weakref-guarded slot
+    if nbytes > 0 and fingerprint is not None:
+        from ..obs.hbm import ledger_for
+        ledger_for(fingerprint).add_resident(counts, nbytes)
+
+
+def residents() -> list:
+    """The LIVE registered resident arrays (dead refs swept first) —
+    the view the ledger and ``/hbm`` consume; never returns an entry
+    whose array was collected."""
+    _sweep_residents()
+    return [r() for r in _RESIDENT.values() if r() is not None]
 
 
 def is_resident(counts) -> bool:
@@ -420,6 +443,7 @@ def donation_report(plans, n_devices: int = 8) -> str:
 __all__ = ["BufferClass", "DonationError", "DonationPlan", "SlotLife",
            "donation_plan", "scan_lifetime", "aux_lifetime",
            "verify_donation", "verify_task_donation",
-           "register_resident", "is_resident", "donation_findings",
+           "register_resident", "residents", "is_resident",
+           "donation_findings",
            "donation_report", "plan_donation",
            "DONATE_MISSED_MIN_BYTES", "ARG_COLS", "ARG_COUNTS", "ARG_AUX"]

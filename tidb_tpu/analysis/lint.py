@@ -182,6 +182,10 @@ LOCK_MODULES = {
     # are taken from the drain thread (span recording) and every
     # statement thread (render/record), so they join the contract
     "obs/trace.py", "obs/recorder.py",
+    # copgauge (ISSUE 14): the ledger/roofline leaf locks run under the
+    # drain loop (launch begin/finish, measured feed), weakref death
+    # callbacks, and the status routes, so they join the contract
+    "obs/hbm.py", "obs/roofline.py",
 }
 
 # modules whose retry/re-dispatch loops must spend a typed Backoffer
@@ -204,6 +208,16 @@ _OBS_REF = re.compile(r"observe|span|trace", re.IGNORECASE)
 # hit or leave disk must carry the digest + mesh-fingerprint +
 # donation-plan triple (TPU-COMPILE-KEY)
 COMPILECACHE_PREFIX = "compilecache/"
+
+# copgauge (TPU-MEM-SOURCE): modules allowed to call the raw device
+# memory introspection APIs.  obs/hbm.py owns the single sanctioned
+# memory_stats poll (the ledger's reconcile + the copcost auto budget
+# route through it) and compilecache/ owns the compiled
+# memory_analysis of served executables (the measured-watermark seam);
+# a call anywhere else forks the source of memory truth away from the
+# ledger.
+MEM_SOURCE_MODULES = ("obs/hbm.py",)
+_MEM_SOURCE_CALLS = ("memory_stats", "memory_analysis")
 # call names that ARE such seams (jax.experimental.serialize_executable
 # entry points plus any persist_* helper grown later)
 _CACHE_WRITE_CALLS = re.compile(
@@ -359,6 +373,8 @@ class _ExprRules(_Scoped):
         self.traced = rel in TRACED_MODULES
         self.hot = rel in HOT_PATH_MODULES
         self.retry_scope = rel.startswith(RETRY_MODULE_PREFIXES)
+        self.mem_source_ok = (rel in MEM_SOURCE_MODULES
+                              or rel.startswith(COMPILECACHE_PREFIX))
         self.psum_fenced = psum_fenced
         self._digest_fn = 0     # depth of digest-context functions
         self._sorted_ok: set = set()   # dict-iter calls under sorted()
@@ -460,6 +476,17 @@ class _ExprRules(_Scoped):
                 self.add("TPU-HOST-SYNC", node,
                          ".item() forces a device->host transfer in a "
                          "hot path")
+        # TPU-MEM-SOURCE: raw device-memory introspection outside the
+        # ledger (obs/hbm) + compile cache forks the memory truth
+        if (not self.mem_source_ok and name in _MEM_SOURCE_CALLS
+                and isinstance(node.func, ast.Attribute)):
+            self.add("TPU-MEM-SOURCE", node,
+                     f"{name}() outside obs/hbm.py + compilecache/: "
+                     "the HBM ledger is the single source of device-"
+                     "memory truth — route polls through "
+                     "obs.hbm.device_memory_stats and measured "
+                     "watermarks through the compile cache's "
+                     "memory seam")
         # TPU-DIGEST inside digest-named functions
         if self._digest_fn > 0:
             self._check_digest_call(node)
@@ -1041,4 +1068,4 @@ __all__ = ["Finding", "lint_source", "lint_tree", "load_baseline",
            "new_findings", "TRACED_MODULES", "HOT_PATH_MODULES",
            "LOCK_MODULES", "RETRY_MODULE_PREFIXES",
            "COMPILECACHE_PREFIX", "PALLAS_PREFIX",
-           "SPAN_MODULE_PREFIXES"]
+           "SPAN_MODULE_PREFIXES", "MEM_SOURCE_MODULES"]

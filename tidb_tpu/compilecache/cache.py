@@ -62,6 +62,11 @@ class _Counters(threading.local):
         self.loaded_ns = 0
         self.misses = 0
         self.hits = 0
+        # copgauge: largest per-device (argument+output+temp) bytes of
+        # an executable resolved on THIS thread since the drain's mark
+        # — the measured-watermark source where the backend reports no
+        # live memory_stats (the CPU mesh, so tier-1 exercises it)
+        self.mem_peak = 0
 
 
 class CompileCache:
@@ -77,6 +82,10 @@ class CompileCache:
         self._mu = threading.Lock()
         self._pool: OrderedDict[str, tuple] = OrderedDict()  # hex -> (exe, nbytes)
         self._pool_bytes = 0
+        # copgauge: entry hex -> per-device executable memory bytes
+        # (argument+output+temp from Compiled.memory_analysis; 0 =
+        # backend reports none) — memoized next to the pool
+        self._mem_info: dict[str, int] = {}
         self._bad_entries: set = set()     # rejected on disk; don't re-read
         self._caps: dict[str, set] = {}    # family -> warm capacities
         self._quarantined: set = set()     # stable digests the breaker opened
@@ -150,6 +159,51 @@ class CompileCache:
     def thread_snapshot(self) -> tuple:
         t = self._tl
         return (t.compiled_ns + t.loaded_ns, t.misses, t.hits)
+
+    # ---- measured-watermark seam (copgauge, obs/hbm) ----------------- #
+
+    def thread_mem_mark(self) -> None:
+        """Reset this thread's per-launch executable-memory high-water;
+        the drain marks before a serve and takes after it."""
+        self._tl.mem_peak = 0
+
+    def thread_mem_take(self) -> int:
+        """Largest per-device (argument + output + temp) bytes among
+        the executables resolved on this thread since the mark — the
+        compiled ``memory_analysis`` of the ACTUALLY-SERVED program, so
+        the measured watermark reflects the executable that ran, not a
+        re-lowered twin."""
+        return self._tl.mem_peak
+
+    def _entry_mem_bytes(self, entry_hex: str, exe) -> int:
+        """Per-device (argument + output + temp) bytes of one pooled
+        executable, from ``Compiled.memory_analysis`` — computed once
+        per entry and memoized (the analysis walks the whole HLO
+        module; doing it per launch would tax the drain)."""
+        with self._mu:
+            n = self._mem_info.get(entry_hex)
+        if n is not None:
+            return n
+        n = 0
+        try:
+            ma = exe.memory_analysis()
+            if ma is not None:
+                n = (int(ma.argument_size_in_bytes)
+                     + int(ma.output_size_in_bytes)
+                     + int(ma.temp_size_in_bytes))
+        except Exception:   # noqa: BLE001 - backend capability probe:
+            # deserialized or exotic executables may expose no memory
+            # analysis; the ledger then runs on its own accounting
+            n = 0
+        n = max(n, 0)
+        with self._mu:
+            self._mem_info[entry_hex] = n
+        return n
+
+    def _note_mem(self, entry_hex: str, exe) -> None:
+        n = self._entry_mem_bytes(entry_hex, exe)
+        if n > self._tl.mem_peak:
+            self._tl.mem_peak = n
 
     # ---- pool ------------------------------------------------------- #
 
@@ -306,9 +360,11 @@ class CompileCache:
                 self._pool.move_to_end(entry_hex)
                 self.hits += 1
                 self._tl.hits += 1
-                self._m_hits.inc()
-                return hit[0]
             bad = entry_hex in self._bad_entries
+        if hit is not None:
+            self._m_hits.inc()
+            self._note_mem(entry_hex, hit[0])
+            return hit[0]
         if self.cache_dir and not bad:
             t0 = time.perf_counter_ns()
             loaded = self._load_entry(entry_hex, key.parts())
@@ -323,6 +379,7 @@ class CompileCache:
                     self._tl.hits += 1
                     self._tl.loaded_ns += dt_ns
                 self._note_caps(key)
+                self._note_mem(entry_hex, exe)
                 self._m_hits.inc()
                 self._m_load.inc(dt_ns / 1e6)
                 self._m_resolve_ms.observe(dt_ns / 1e6, outcome="load")
@@ -353,6 +410,7 @@ class CompileCache:
         with self._mu:
             self._pool_put_locked(entry_hex, exe, nbytes)
         self._note_caps(key)
+        self._note_mem(entry_hex, exe)
         m = self.manifest
         if m is not None:
             with self._mu:
@@ -399,6 +457,7 @@ class CompileCache:
             self._pool.clear()
             self._pool_bytes = 0
             self._caps.clear()
+            self._mem_info.clear()
             self._m_bytes.set(0)
 
     def stats(self) -> dict:

@@ -48,7 +48,8 @@ class QueryHandle:
     __slots__ = ("conn_id", "sql", "started", "fragments", "_mu",
                  "sched_wait_ns", "sched_tasks", "sched_coalesced",
                  "sched_fused", "sched_rus", "sched_retried", "degraded",
-                 "compile_ns", "compile_misses")
+                 "compile_ns", "compile_misses",
+                 "hbm_predicted", "hbm_measured")
 
     def __init__(self, conn_id: int, sql: str):
         self.conn_id = conn_id
@@ -73,6 +74,10 @@ class QueryHandle:
                                    # compile cache; the compile_wait_ms
                                    # split out of schedWait)
         self.compile_misses = 0    # launches that compiled (vs warm hit)
+        self.hbm_predicted = 0     # summed admission HBM predictions of
+                                   # this statement's cop tasks (copgauge)
+        self.hbm_measured = 0      # summed measured launch peaks (0 =
+                                   # backend reported none / ledger off)
 
     def note_fragment(self, desc: str) -> None:
         with self._mu:
@@ -81,7 +86,9 @@ class QueryHandle:
     def note_sched(self, wait_ns: int, coalesced: int,
                    fused: int = 0, rus: float = 0.0,
                    retried: int = 0, compile_ns: int = 0,
-                   compile_miss: bool = False) -> None:
+                   compile_miss: bool = False,
+                   hbm_predicted: int = 0,
+                   hbm_measured: int = 0) -> None:
         """Call seam contract (audited, ISSUE 13): ``fused`` is the
         MEMBER COUNT of the launch that served this task (scheduler
         ``_serve_fused`` sets ``task.fused = len(programs)``), so any
@@ -105,6 +112,8 @@ class QueryHandle:
             self.compile_ns += int(compile_ns)
             if compile_miss:
                 self.compile_misses += 1
+            self.hbm_predicted += int(hbm_predicted)
+            self.hbm_measured += int(hbm_measured)
 
     def note_degraded(self) -> None:
         with self._mu:

@@ -363,6 +363,8 @@ class CopTaskExec(PhysOp):
         sched_d0 = handle.degraded if handle is not None else 0
         sched_c0 = handle.compile_ns if handle is not None else 0
         sched_m0 = handle.compile_misses if handle is not None else 0
+        sched_hp0 = handle.hbm_predicted if handle is not None else 0
+        sched_hm0 = handle.hbm_measured if handle is not None else 0
         if self.as_of_ts is not None:
             snap = self.as_of_snap
             if snap is None:
@@ -419,6 +421,16 @@ class CopTaskExec(PhysOp):
                 self._rt_detail += f", retried: {dt}"
             if handle.degraded - sched_d0:
                 self._rt_detail += ", degraded"
+            # copgauge: the memory axis — the measured launch peak next
+            # to the admission prediction (only when a launch actually
+            # measured one; the detail stays byte-identical otherwise)
+            dhm = handle.hbm_measured - sched_hm0
+            dhp = handle.hbm_predicted - sched_hp0
+            if dhm > 0:
+                from ..analysis.copcost import format_bytes
+                self._rt_detail += (
+                    f", hbm: {format_bytes(dhm)} measured / "
+                    f"{format_bytes(dhp)} predicted")
         return ResultChunk(list(self.out_names), cols)
 
 

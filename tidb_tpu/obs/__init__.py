@@ -13,11 +13,28 @@ stack.
 Latency histograms ride ``utils/metrics`` (label-aware prometheus-text
 histograms) — ``tidb_tpu_sched_{wait,launch,compile}_ms`` and the
 per-strategy agg launch histogram are wired at the scheduler drain.
+
+copgauge (ISSUE 14) adds the memory/throughput axis:
+
+- ``hbm``: the live per-mesh HBM ledger (persistent residents through
+  the PR 7 weakref registry, launch-scoped bytes at admission/finish),
+  measured launch watermarks, bounded device ``memory_stats``
+  reconciliation, and the on-demand ``/profile`` capture gate.
+- ``roofline``: per-program-digest achieved-vs-peak bytes/s and
+  FLOPs/s attribution (memory-/compute-/launch-bound) against a
+  declared per-backend peak table (CPU: boot-time microbench).
 """
 
+from .hbm import (HbmLedger, all_ledgers, device_memory_stats,
+                  hbm_status, ledger_for, profiler_gate)
 from .recorder import FlightRecorder
+from .roofline import (backend_peaks, peaks_for_mesh, roofline_status,
+                       roofline_store)
 from .trace import (TRACE_CTX, Span, SpanTree, TraceCtx, annotate,
                     current, flag, new_trace_id, span)
 
 __all__ = ["Span", "SpanTree", "TraceCtx", "TRACE_CTX", "current",
-           "span", "flag", "annotate", "new_trace_id", "FlightRecorder"]
+           "span", "flag", "annotate", "new_trace_id", "FlightRecorder",
+           "HbmLedger", "ledger_for", "all_ledgers", "hbm_status",
+           "device_memory_stats", "profiler_gate", "roofline_store",
+           "roofline_status", "backend_peaks", "peaks_for_mesh"]

@@ -1,0 +1,381 @@
+"""copgauge: live HBM ledger + measured memory watermarks.
+
+Reference analog: the executor memory tracker tree of the reference
+engine (util/memory Tracker feeding quota actions, PAPER.md) — per-query
+memory is TRACKED while it is resident, not predicted once and
+forgotten.  copcost (PR 4) predicts ``peak_hbm_bytes`` and admission
+enforces a budget against the prediction, but until this module nothing
+ever measured what a launch actually held resident: ``mem_factor``
+calibration (PR 10) could only learn by crashing into OOM.
+
+The ledger is a per-mesh accounting structure fed by the existing
+lifetime classes (analysis/lifetime, PR 7):
+
+- PERSISTENT residents register through the PR 7 weakref registry
+  (``ColumnarSnapshot.device_cols`` -> ``lifetime.register_resident``)
+  and UNREGISTER through the weakref's death callback — the ledger can
+  never count a dead entry, and a dropped snapshot is debited the
+  moment the garbage collector reclaims its arrays.
+- EPHEMERAL / LOOP-CARRIED bytes enter at launch admission (the drain's
+  ``launch_begin``) and leave at finish (``launch_end``); donated bytes
+  (DonationPlan) are credited at dispatch because
+  ``LaunchCost.peak_hbm_bytes`` already subtracts ``donated_bytes``.
+- The ledger is reconciled against ``device.memory_stats()`` where the
+  backend provides it — polled at a BOUNDED interval
+  (``RECONCILE_MIN_S``), never on the launch path.  The CPU mesh
+  reports no stats and runs on the ledger alone, so tier-1 exercises
+  every accounting path.
+
+``device_memory_stats`` below is the ONLY sanctioned raw device memory
+poll in the tree (lint rule TPU-MEM-SOURCE): every other module —
+copcost's auto budget included — routes through it, so the ledger stays
+the single source of memory truth.
+
+The module also owns the on-demand ``jax.profiler`` capture gate behind
+``/profile?ms=N`` (sysvar ``tidb_tpu_profile``; refused while a capture
+is active — two overlapping traces corrupt each other's xplane dirs).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Optional
+
+# reconcile against device memory_stats at most this often — the poll
+# is a backend RPC on real hardware and must NEVER ride the launch path
+RECONCILE_MIN_S = 0.5
+# recent per-launch measured peaks kept for /hbm (bounded ring)
+MEASURED_RING = 64
+# /profile capture bounds (ms): a zero-length capture is useless, an
+# unbounded one fills the disk with xplane events
+PROFILE_MIN_MS = 10
+PROFILE_MAX_MS = 60_000
+
+
+def device_memory_stats(mesh) -> Optional[dict]:
+    """Raw device memory stats of one chip of ``mesh`` (None where the
+    backend reports nothing — CPU meshes).  The single sanctioned
+    ``memory_stats()`` call site (TPU-MEM-SOURCE): the ledger, the
+    copcost auto budget, and any future consumer all read through
+    here so the memory-truth seam stays one function wide."""
+    try:
+        dev = mesh.devices.reshape(-1)[0]
+        stats = dev.memory_stats()
+    except (AttributeError, IndexError, NotImplementedError,
+            RuntimeError, TypeError):
+        return None
+    if not stats:
+        return None
+    return dict(stats)
+
+
+class HbmLedger:
+    """Live device-memory account of ONE mesh (keyed by the mesh
+    fingerprint, like the scheduler registry).
+
+    All mutation takes the leaf lock ``_mu``; the weakref death
+    callback runs under it too (CPython fires callbacks outside any
+    other ledger frame, so this cannot self-deadlock).  Balances are
+    clamped at zero with a ``negative_events`` counter — bookkeeping
+    drift must surface as a diagnostic, never as a wedged account."""
+
+    def __init__(self, fingerprint=None):
+        self.fingerprint = fingerprint
+        self._mu = threading.Lock()
+        # id(weakref) -> (weakref, nbytes); the ref's death callback
+        # debits the account.  Keyed by the REF's id, not the referent:
+        # a weakref hashes through its referent, and device arrays are
+        # unhashable — and a ref id can never be recycled while the
+        # entry holds the ref alive.
+        self._residents: dict = {}
+        self.persistent_bytes = 0      # live snapshot-cache residents
+        self.inflight_bytes = 0        # launches currently holding HBM
+        self.watermark_bytes = 0       # high-water of resident + measured
+        self.max_measured_bytes = 0    # largest per-launch measured peak
+        self.last_measured_bytes = 0
+        self.launches = 0              # launch_begin events (lifetime)
+        self.measured_launches = 0     # launches with a measured peak
+        self.registered = 0            # resident registrations (lifetime)
+        self.unregistered = 0          # weakref deaths debited
+        self.negative_events = 0       # clamped would-be-negative balances
+        self._measured_ring: deque = deque(maxlen=MEASURED_RING)
+        self._recon_ts = 0.0
+        self._recon: Optional[dict] = None
+        from ..utils.metrics import global_registry
+        reg = global_registry()
+        self._m_resident = reg.gauge(
+            "tidb_tpu_hbm_resident_bytes",
+            "ledger-tracked live device bytes (persistent residents "
+            "+ in-flight launch footprints)")
+        self._m_watermark = reg.gauge(
+            "tidb_tpu_hbm_watermark_bytes",
+            "high-water of ledger residency and measured launch peaks")
+
+    # ---- persistent residents (PR 7 weakref registry events) -------- #
+
+    def add_resident(self, token, nbytes: int) -> None:
+        """One snapshot's device-resident arrays entered the cache:
+        credit ``nbytes`` against a weakref on ``token`` (the counts
+        array — the same registry token lifetime.register_resident
+        uses) whose death callback debits the account."""
+        if token is None or nbytes <= 0:
+            return
+        with self._mu:
+            for r, _n in self._residents.values():
+                if r() is token:
+                    return          # same live object re-registered
+            try:
+                ref = weakref.ref(token, self._resident_dead)
+            except TypeError:
+                return
+            self._residents[id(ref)] = (ref, int(nbytes))  # planlint: ok - ref held, id stable
+            self.persistent_bytes += int(nbytes)
+            self.registered += 1
+            self._bump_watermark_locked()
+        self._publish()
+
+    def _resident_dead(self, ref) -> None:
+        """Weakref death callback: the resident arrays were collected —
+        the unregister half of the registry contract."""
+        with self._mu:
+            ent = self._residents.pop(id(ref), None)  # planlint: ok - ref held, id stable
+            if ent is None:
+                return
+            self.persistent_bytes -= ent[1]
+            self.unregistered += 1
+            if self.persistent_bytes < 0:
+                self.negative_events += 1
+                self.persistent_bytes = 0
+        self._publish()
+
+    # ---- launch-scoped bytes (admission enter, finish leave) -------- #
+
+    def launch_begin(self, nbytes: int) -> None:
+        with self._mu:
+            self.launches += 1
+            if nbytes > 0:
+                self.inflight_bytes += int(nbytes)
+            self._bump_watermark_locked()
+        self._publish()
+
+    def launch_end(self, nbytes: int) -> None:
+        with self._mu:
+            if nbytes > 0:
+                self.inflight_bytes -= int(nbytes)
+                if self.inflight_bytes < 0:
+                    self.negative_events += 1
+                    self.inflight_bytes = 0
+        self._publish()
+
+    def note_measured(self, nbytes: int) -> None:
+        """One launch's measured peak (memory_stats delta where the
+        backend provides it, else the compiled memory analysis of the
+        actually-served executable): feeds the watermark so it
+        dominates every per-launch measurement by construction."""
+        if nbytes <= 0:
+            return
+        with self._mu:
+            self.measured_launches += 1
+            self.last_measured_bytes = int(nbytes)
+            self._measured_ring.append(int(nbytes))
+            if nbytes > self.max_measured_bytes:
+                self.max_measured_bytes = int(nbytes)
+            if nbytes > self.watermark_bytes:
+                self.watermark_bytes = int(nbytes)
+        self._publish()
+
+    def _bump_watermark_locked(self) -> None:
+        live = self.persistent_bytes + self.inflight_bytes
+        if live > self.watermark_bytes:
+            self.watermark_bytes = live
+
+    def _publish(self) -> None:
+        self._m_resident.set(self.persistent_bytes + self.inflight_bytes)
+        self._m_watermark.set(self.watermark_bytes)
+
+    # ---- reconciliation (bounded poll, never the launch path) ------- #
+
+    def reconcile(self, mesh, force: bool = False) -> Optional[dict]:
+        """Compare the ledger against the backend's own view where one
+        exists.  Rate-limited to RECONCILE_MIN_S; called from status
+        routes and stats(), NEVER from the drain.  Returns the last
+        reconciliation record (None on backends without stats)."""
+        now = time.monotonic()
+        with self._mu:
+            due = force or (now - self._recon_ts >= RECONCILE_MIN_S)
+            if due:
+                self._recon_ts = now
+        if due and mesh is not None:
+            stats = device_memory_stats(mesh)
+            if stats is not None:
+                n_dev = int(mesh.devices.size)
+                in_use = int(stats.get("bytes_in_use", 0) or 0) * n_dev
+                with self._mu:
+                    self._recon = {
+                        "device_bytes_in_use": in_use,
+                        "ledger_bytes": self.persistent_bytes
+                        + self.inflight_bytes,
+                        "drift_bytes": in_use - (self.persistent_bytes
+                                                 + self.inflight_bytes),
+                        "peak_bytes_in_use": int(
+                            stats.get("peak_bytes_in_use", 0) or 0)
+                        * n_dev,
+                    }
+        with self._mu:
+            return dict(self._recon) if self._recon is not None else None
+
+    # ---- introspection ---------------------------------------------- #
+
+    @property
+    def resident_bytes(self) -> int:
+        with self._mu:
+            return self.persistent_bytes + self.inflight_bytes
+
+    def residents(self) -> list:
+        """[(nbytes, alive)] of tracked resident entries (diagnostics;
+        dead entries cannot appear — the callback removed them)."""
+        with self._mu:
+            return [(n, r() is not None)
+                    for r, n in self._residents.values()]
+
+    def stats(self) -> dict:
+        with self._mu:
+            ring = list(self._measured_ring)
+            return {
+                "persistent_bytes": self.persistent_bytes,
+                "inflight_bytes": self.inflight_bytes,
+                "resident_bytes": self.persistent_bytes
+                + self.inflight_bytes,
+                "watermark_bytes": self.watermark_bytes,
+                "max_measured_bytes": self.max_measured_bytes,
+                "last_measured_bytes": self.last_measured_bytes,
+                "residents": len(self._residents),
+                "registered": self.registered,
+                "unregistered": self.unregistered,
+                "launches": self.launches,
+                "measured_launches": self.measured_launches,
+                "negative_events": self.negative_events,
+                "measured_recent": ring[-8:],
+                "reconciled": self._recon,
+            }
+
+
+# ------------------------------------------------------------------ #
+# per-mesh registry (the scheduler_for discipline)
+# ------------------------------------------------------------------ #
+
+_LEDGERS: dict = {}
+_LED_MU = threading.Lock()
+
+
+def ledger_for(fingerprint) -> HbmLedger:
+    """The (process-wide) ledger accounting one mesh's device memory,
+    keyed by the mesh FINGERPRINT exactly like scheduler_for — every
+    Domain over the same chips shares one account."""
+    with _LED_MU:
+        led = _LEDGERS.get(fingerprint)
+        if led is None:
+            led = _LEDGERS[fingerprint] = HbmLedger(fingerprint)
+        return led
+
+
+def all_ledgers() -> list:
+    with _LED_MU:
+        return list(_LEDGERS.values())
+
+
+def hbm_status() -> dict:
+    """The ledger half of the ``/hbm`` status route."""
+    leds = all_ledgers()
+    return {
+        "ledgers": [led.stats() for led in leds],
+        "resident_bytes": sum(led.resident_bytes for led in leds),
+        "watermark_bytes": max(
+            (led.watermark_bytes for led in leds), default=0),
+    }
+
+
+# ------------------------------------------------------------------ #
+# on-demand profiler capture (/profile?ms=N)
+# ------------------------------------------------------------------ #
+
+class ProfilerGate:
+    """One-at-a-time ``jax.profiler`` trace capture.  ``start`` refuses
+    while a capture is active (overlapping traces corrupt each other's
+    xplane output); a daemon timer stops the trace after ``ms``."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.active = False
+        self.dir = ""
+        self.captures = 0
+        self.last_error = ""
+
+    def start(self, ms: int, base_dir: str = "") -> dict:
+        ms = min(max(int(ms), PROFILE_MIN_MS), PROFILE_MAX_MS)
+        with self._mu:
+            if self.active:
+                return {"refused": "a profiler capture is already "
+                                   "active", "dir": self.dir}
+            self.active = True
+        try:
+            import tempfile
+
+            import jax
+            d = base_dir or tempfile.mkdtemp(prefix="tidb-tpu-profile-")
+            os.makedirs(d, exist_ok=True)
+            jax.profiler.start_trace(d)
+        except Exception as e:  # noqa: BLE001 - capability probe: some
+            # backends/builds ship no profiler; the route must answer,
+            # not 500
+            with self._mu:
+                self.active = False
+                self.last_error = f"{type(e).__name__}: {e}"
+            return {"refused": f"profiler unavailable: "
+                               f"{self.last_error}"}
+        with self._mu:
+            self.dir = d
+            self.captures += 1
+        t = threading.Timer(ms / 1000.0, self._stop)
+        t.daemon = True
+        t.start()
+        return {"started": True, "dir": d, "ms": ms}
+
+    def _stop(self) -> None:
+        try:
+            import jax
+            jax.profiler.stop_trace()
+        except Exception as e:  # noqa: BLE001 - a failed stop must
+            # still release the gate or no capture could ever run again
+            with self._mu:
+                self.last_error = f"{type(e).__name__}: {e}"
+        finally:
+            with self._mu:
+                self.active = False
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {"active": self.active, "dir": self.dir,
+                    "captures": self.captures,
+                    "last_error": self.last_error}
+
+
+_GATE: Optional[ProfilerGate] = None
+_GATE_MU = threading.Lock()
+
+
+def profiler_gate() -> ProfilerGate:
+    global _GATE
+    with _GATE_MU:
+        if _GATE is None:
+            _GATE = ProfilerGate()
+        return _GATE
+
+
+__all__ = ["HbmLedger", "ledger_for", "all_ledgers", "hbm_status",
+           "device_memory_stats", "ProfilerGate", "profiler_gate",
+           "RECONCILE_MIN_S", "MEASURED_RING"]

@@ -182,6 +182,13 @@ class DeviceScheduler:
         # window, and deadline-aware shedding.  Off = the static model
         # untouched, no feedback recorded.
         self.calibration_enable = True
+        # copgauge (obs/hbm, tidb_tpu_hbm_ledger sysvar): live HBM
+        # ledger accounting at launch begin/finish, measured launch
+        # watermarks feeding mem_factor calibration, and per-digest
+        # roofline attribution.  Off = the static model byte-identical
+        # to the pre-copgauge behavior (mem_factor moves only on OOM).
+        self.hbm_enable = True
+        self._ledger_obj = None
         # launch supervision (faultline): per-digest circuit breaker
         # consulted at submit, transient-retry budget spent at the
         # drain; _retry_sleep is the Backoffer sleep seam (tests)
@@ -361,6 +368,13 @@ class DeviceScheduler:
             "tidb_tpu_agg_launch_ms",
             "agg launch wall time by group strategy (ms)", buckets=ms,
             labels=("strategy",))
+        # copgauge (obs/hbm): the admission budget mirrored into the
+        # tidb_tpu_hbm_* gauge family next to the ledger's
+        # resident/watermark gauges
+        self._m_hbm_budget = reg.gauge(
+            "tidb_tpu_hbm_budget_bytes",
+            "per-mesh HBM admission budget (copgauge gauge family "
+            "twin of tidb_tpu_sched_hbm_budget_bytes)")
 
     # ------------------------------------------------------------- #
     # admission
@@ -373,7 +387,8 @@ class DeviceScheduler:
                   hbm_budget: Optional[int] = None,
                   rc_enable: Optional[bool] = None,
                   rc_overdraft: Optional[float] = None,
-                  calibration: Optional[bool] = None) -> None:
+                  calibration: Optional[bool] = None,
+                  hbm_ledger: Optional[bool] = None) -> None:
         """Apply sysvar knobs; negative/None = keep current (window_us
         and hbm_budget are the exceptions: -1 means adaptive/auto,
         0 disables the hold / the budget)."""
@@ -394,6 +409,8 @@ class DeviceScheduler:
             self._m_rc_overdraft.set(self.rc_overdraft_ru)
         if calibration is not None:
             self.calibration_enable = bool(calibration)
+        if hbm_ledger is not None:
+            self.hbm_enable = bool(hbm_ledger)
 
     # ---- HBM-budget admission (analysis/copcost) -------------------- #
 
@@ -480,6 +497,11 @@ class DeviceScheduler:
                 "large-NDV dense domain; use a radix strategy "
                 "(GroupStrategy.SEGMENT/SCATTER)")
         budget = self.effective_budget(task.mesh)
+        # copgauge: the prediction the budget gate enforces — surfaced
+        # on the launch span (hbm_predicted) and in EXPLAIN ANALYZE
+        # next to the measured peak
+        task.hbm_predicted = cost.peak_hbm_bytes
+        self._m_hbm_budget.set(budget)
         if budget > 0 and cost.peak_hbm_bytes > budget:
             with self._mu:
                 self.budget_rejects += 1
@@ -920,14 +942,88 @@ class DeviceScheduler:
                 t.start_ns = now
                 t.wait_ns = now - t.submit_ns
             self._note_launch_bytes(batch)
+            # copgauge: launch-scoped bytes enter the ledger at
+            # admission and leave at finish; the measured watermark
+            # (stamped by _mem_note inside the serve) feeds it after
+            led = self._ledger(batch[0].mesh)
+            eph = self._launch_ephemeral_bytes(batch) \
+                if led is not None else 0
+            if led is not None:
+                led.launch_begin(eph)
+                self._mem_mark()
             try:
                 self._serve_supervised(batch)
             except BaseException as e:  # noqa: BLE001 supervisor safety
                 for t in batch:         # net: the drain must never die
                     t.fail(e)
+            finally:
+                if led is not None:
+                    led.launch_end(eph)
+                    measured = max(
+                        (t.hbm_measured for t in batch), default=0)
+                    if measured > 0:
+                        led.note_measured(measured)
             self._attribute_launch(batch,
                                    time.perf_counter_ns() - now)
             self._account(batch)
+
+    # ------------------------------------------------------------- #
+    # copgauge (obs/hbm): live ledger + measured launch watermarks
+    # ------------------------------------------------------------- #
+
+    def _ledger(self, mesh):
+        """This mesh's live HBM ledger; None when copgauge is off."""
+        if not self.hbm_enable or mesh is None:
+            return None
+        led = self._ledger_obj
+        if led is None:
+            from ..obs.hbm import ledger_for
+            from .task import mesh_fingerprint
+            led = self._ledger_obj = ledger_for(mesh_fingerprint(mesh))
+        return led
+
+    def _launch_ephemeral_bytes(self, batch: list) -> int:
+        """EPHEMERAL/LOOP-CARRIED bytes this launch adds ON TOP of the
+        persistent residents: the lead's peak minus its resident scan
+        (live snapshot-cache inputs are already on the ledger's
+        persistent side), plus each rider's marginal bytes.  Donated
+        bytes are credited at dispatch by construction —
+        ``peak_hbm_bytes`` already subtracts ``donated_bytes``."""
+        lead = batch[0]
+        if lead.cost is None:
+            return 0
+        n = lead.cost.peak_hbm_bytes
+        from ..analysis.lifetime import is_resident
+        if is_resident(lead.counts):
+            n -= lead.cost.input_bytes
+        n += sum(self._marginal_bytes(t, lead) for t in batch[1:])
+        return max(n, 0)
+
+    @staticmethod
+    def _mem_mark() -> None:
+        """Reset the drain thread's executable-memory high-water before
+        a serve (the copforge measured-watermark seam)."""
+        from ..compilecache import compile_cache
+        compile_cache().thread_mem_mark()
+
+    def _mem_note(self, tasks: list, mesh) -> int:
+        """Measured peak of the launch that just ran on this thread:
+        the compiled memory analysis of the ACTUALLY-SERVED executable
+        (per-device, scaled by mesh size), stamped onto every task
+        BEFORE finish so waiters/EXPLAIN observe it.  Live memory_stats
+        never rides here — the ledger's bounded ``reconcile`` owns that
+        poll, off the launch path.  0 = backend reports nothing."""
+        if not self.hbm_enable:
+            return 0
+        from ..compilecache import compile_cache
+        per_dev = compile_cache().thread_mem_take()
+        if per_dev <= 0:
+            return 0
+        n_dev = int(mesh.devices.size) if mesh is not None else 1
+        measured = per_dev * n_dev
+        for t in tasks:
+            t.hbm_measured = measured
+        return measured
 
     # ------------------------------------------------------------- #
     # copforge (compilecache/): compile attribution + fusion warmup
@@ -1075,6 +1171,12 @@ class DeviceScheduler:
                 bd = t.cost.transfer_breakdown or (0, 0, 0)
                 if bd[1] or bd[2]:
                     attrs["ici_bytes"], attrs["dci_bytes"] = bd[1], bd[2]
+            # copgauge: the memory axis of the launch span — the
+            # admission prediction next to the measured executable peak
+            if t.hbm_predicted:
+                attrs["hbm_predicted"] = t.hbm_predicted
+            if t.hbm_measured:
+                attrs["hbm_measured"] = t.hbm_measured
             strat = self._strategy_of(t.dag)
             if strat is not None:
                 attrs["strategy"] = strat
@@ -1321,6 +1423,7 @@ class DeviceScheduler:
             _faults.check("launch")
             t_l0 = time.perf_counter_ns()
             val = lead.fn()
+            self._mem_note([lead], lead.mesh)
             self._trace_launch([lead], t_l0, time.perf_counter_ns(),
                                "opaque")
             lead.finish(val)
@@ -1394,6 +1497,7 @@ class DeviceScheduler:
         for t in all_tasks:
             t.fused = len(programs)
             t.coalesced = total
+        self._mem_note(all_tasks, lead.mesh)
         self._trace_launch(all_tasks, t_l0, time.perf_counter_ns(),
                            "fused", fused=len(programs))
         for grp, out in zip(programs, outs):
@@ -1453,6 +1557,7 @@ class DeviceScheduler:
                 # see _serve_fused)
                 for t in batch:
                     t.coalesced = len(batch)
+                self._mem_note(batch, lead.mesh)
                 self._trace_launch(batch, t_l0,
                                    time.perf_counter_ns(), "batched")
                 for s, out in zip(slots, outs):
@@ -1484,6 +1589,7 @@ class DeviceScheduler:
                 # BEFORE finish (waiter race, see _serve_fused)
                 for t in s:
                     t.coalesced = len(batch)
+            self._mem_note(s, lead.mesh)
             self._trace_launch(s, t_s0, time.perf_counter_ns(),
                                "coalesced" if len(s) > 1 else "single")
             for t in s:
@@ -1516,6 +1622,13 @@ class DeviceScheduler:
             t.device_ns = ns
         if self.calibration_enable:
             self._observe_launch(batch)
+        if self.hbm_enable:
+            try:
+                self._observe_roofline(batch)
+            except Exception:   # noqa: BLE001 - pure observability: a
+                # failed attribution (exotic backend, microbench
+                # refusal) must never kill the drain thread
+                pass
 
     def _observe_launch(self, batch: list) -> None:
         """copmeter feedback: each SERVED member's attributed wall time
@@ -1539,8 +1652,40 @@ class DeviceScheduler:
                 continue
             store.observe(digest, t.cost_static, t.device_ns)
             fed = True
+        # copgauge: the measured launch watermark EWMAs the digest's
+        # mem_factor (clamped, exactly like time_factor) — only for
+        # single-program launches, where the measured executable IS the
+        # digest's program (a fused measure would mis-attribute every
+        # member); riders share the lead's key, so one feed per launch
+        lead = batch[0]
+        if self.hbm_enable and lead.hbm_measured \
+                and not lead.failed and lead.cost_static is not None \
+                and all(t.key == lead.key for t in batch):
+            digest = self._stable_digest(lead)
+            if digest is not None:
+                store.observe_mem(digest, lead.cost_static,
+                                  lead.hbm_measured)
+                fed = True
         if fed:
             store.sync_manifest()
+
+    def _observe_roofline(self, batch: list) -> None:
+        """copgauge roofline feedback: each warm measured member's
+        attributed wall time + static work terms land in the per-digest
+        utilization store (obs/roofline), classifying the digest
+        memory-/compute-/launch-bound against the backend peak table."""
+        from ..obs.roofline import peaks_for_mesh, roofline_store
+        roof = roofline_store()
+        for t in batch:
+            if t.failed or t.device_ns <= 0 or t.cost_static is None \
+                    or t.compile_miss:
+                continue
+            digest = self._stable_digest(t)
+            if digest is None:
+                continue
+            roof.observe(digest, t.cost_static, t.device_ns,
+                         peaks_for_mesh(t.mesh),
+                         measured_hbm=t.hbm_measured)
 
     def _account(self, batch: list) -> None:
         """Post-launch bookkeeping.  RUs were PRICED at submit and
@@ -1598,6 +1743,13 @@ class DeviceScheduler:
         return {"enabled": self.calibration_enable,
                 **correction_store().stats()}
 
+    def _hbm_stats(self) -> dict:
+        out = {"enabled": self.hbm_enable}
+        led = self._ledger_obj
+        if led is not None:
+            out.update(led.stats())
+        return out
+
     @staticmethod
     def _pct(samples: list, q: float) -> float:
         if not samples:
@@ -1653,6 +1805,8 @@ class DeviceScheduler:
                 "rc_debited_ru": round(self.rc_debited_ru, 2),
                 # copmeter (analysis/calibrate): closed-loop state
                 "calibration": self._calibration_stats(),
+                # copgauge (obs/hbm): the live device-memory ledger
+                "hbm": self._hbm_stats(),
                 "oom_faults": self.oom_faults,
                 "oom_demuxed": self.oom_demuxed,
                 "shed_rejects": self.shed_rejects,

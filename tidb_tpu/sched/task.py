@@ -116,7 +116,7 @@ class CopTask:
                  "est_rows", "cost", "cost_static", "rc_group", "rus",
                  "rus_charged", "device_ns", "deadline_ns", "svc_ns",
                  "donate", "retries", "compile_ns", "compile_miss",
-                 "trace")
+                 "hbm_predicted", "hbm_measured", "trace")
 
     def __init__(self, *, key=None, dag=None, mesh=None, row_capacity=0,
                  cols=None, counts=None, aux=(), input_token=None,
@@ -165,6 +165,13 @@ class CopTask:
         self.compile_ns = 0       # program resolve/compile time this
                                   # task's launch paid (copforge; 0 = warm)
         self.compile_miss = False  # launch compiled (vs warm-pool hit)
+        self.hbm_predicted = 0    # admission HBM prediction (copgauge:
+                                  # the calibrated peak_hbm_bytes the
+                                  # budget gate enforced)
+        self.hbm_measured = 0     # measured launch peak bytes, set by
+                                  # the drain BEFORE finish (memory
+                                  # stats delta / compiled analysis of
+                                  # the served executable; 0 = none)
         # copscope trace propagation (obs/): the submitting statement's
         # TraceCtx rides the task like SCHED_GROUP does, so the drain
         # thread records queue/compile/launch/retry spans under the

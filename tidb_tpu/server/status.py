@@ -165,6 +165,56 @@ class StatusServer:
                     "records": mgr.runaway_ring.records(),
                 },
             }), "application/json"
+        if path == "/hbm":
+            # copgauge (obs/hbm + obs/roofline): the device-memory and
+            # utilization plane — live ledger balances (persistent
+            # residents + in-flight launch bytes), measured watermarks,
+            # bounded device memory_stats reconciliation, per-digest
+            # HBM prediction error (mem_factor calibration state), and
+            # the roofline attribution tables (top-N digests by
+            # residency and by gap, memory-/compute-/launch-bound)
+            from ..analysis.calibrate import correction_store
+            from ..obs.hbm import hbm_status, profiler_gate
+            from ..obs.roofline import roofline_status
+            sched = self.domain.client.sched_stats()
+            ledgers = hbm_status()
+            mesh = self.domain.client._mesh     # never force device init
+            if mesh is not None:
+                from ..obs.hbm import all_ledgers
+                for led in all_ledgers():
+                    led.reconcile(mesh)
+                ledgers = hbm_status()
+            cal = correction_store().stats()
+            return json.dumps({
+                "enabled": (sched.get("hbm") or {}).get("enabled", True),
+                "budget_bytes": sched.get("hbm_budget", 0),
+                "last_launch_bytes": sched.get("last_launch_bytes", 0),
+                "budget_admitted": sched.get("budget_admitted", 0),
+                "budget_rejects": sched.get("budget_rejects", 0),
+                **ledgers,
+                "calibration": {
+                    "mem_observed": cal.get("mem_observed", 0),
+                    "mean_mem_err_pct": cal.get("mean_mem_err_pct"),
+                    "oom_events": cal.get("oom_events", 0),
+                },
+                "roofline": roofline_status(),
+                "profiler": profiler_gate().stats(),
+            }), "application/json"
+        if path == "/profile":
+            # on-demand jax.profiler capture (?ms=N): gated by the
+            # tidb_tpu_profile sysvar, refused while one is active —
+            # the trace dir lands on disk for ui.perfetto.dev
+            from ..obs.hbm import profiler_gate
+            enabled = bool(int(
+                self.domain.sysvars.get("tidb_tpu_profile", 0) or 0))
+            if not enabled:
+                return json.dumps({
+                    "refused": "profiling disabled; "
+                               "SET GLOBAL tidb_tpu_profile = 1"}), \
+                    "application/json"
+            ms = int(query.get("ms", "1000"))
+            return json.dumps(profiler_gate().start(ms)), \
+                "application/json"
         if path == "/trace":
             # copscope flight recorder (obs/): newest-first index of
             # retained statement traces (failed/degraded/quarantined/

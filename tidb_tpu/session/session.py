@@ -1231,6 +1231,12 @@ class Session:
         v14 = merged.get("tidb_tpu_cost_calibration")
         if v14 is not None and v14 != "":
             client.calibration = bool(int(v14))
+        # copgauge live HBM ledger + measured watermarks + roofline
+        # (obs/hbm): off = the static memory model byte-identical to
+        # the pre-copgauge engine
+        v17 = merged.get("tidb_tpu_hbm_ledger")
+        if v17 is not None and v17 != "":
+            client.hbm_ledger = bool(int(v17))
         # shardflow topology view (parallel/topology): declared host
         # factorization for per-link transfer classification; -1/unset
         # derives from device process indices

@@ -120,6 +120,17 @@ _VARS = [
     _v("tidb_tpu_trace", 1, kind="bool"),
     _v("tidb_tpu_trace_sample", 16, kind="int", min=1, max=65536,
        scope=SCOPE_GLOBAL),
+    # copgauge (obs/hbm + obs/roofline): the live HBM ledger, measured
+    # launch watermarks feeding continuous mem_factor calibration, and
+    # per-digest roofline attribution.  Off = no ledger accounting, no
+    # measured watermarks, no roofline feed — the static cost model
+    # behaves byte-identically to the pre-copgauge engine (mem_factor
+    # moves only on OOM).
+    _v("tidb_tpu_hbm_ledger", 1, kind="bool", scope=SCOPE_GLOBAL),
+    # on-demand jax.profiler capture gate (/profile?ms=N): off by
+    # default — a trace capture writes xplane dirs to disk and costs
+    # real overhead, so an operator must opt in
+    _v("tidb_tpu_profile", 0, kind="bool", scope=SCOPE_GLOBAL),
     # slow-query log threshold (ms), session -> Domain plumb — replaces
     # the constructor-only threshold in utils/stmtsummary; slow entries
     # carry schedWait/compile/ru/retried/trace-id fields

@@ -134,6 +134,9 @@ class CopClient:
         # copmeter closed-loop cost calibration
         # (tidb_tpu_cost_calibration): None = keep scheduler state
         self.calibration = None
+        # copgauge live HBM ledger + measured watermarks + roofline
+        # (tidb_tpu_hbm_ledger): None = keep scheduler state
+        self.hbm_ledger = None
         self._sched_obj = None
         # graceful degradation (faultline; tidb_tpu_sched_host_fallback):
         # a digest quarantined by the launch circuit breaker falls back
@@ -241,7 +244,8 @@ class CopClient:
             hbm_budget=self.sched_hbm_budget,
             rc_enable=self.rc_enable,
             rc_overdraft=self.rc_overdraft,
-            calibration=self.calibration)
+            calibration=self.calibration,
+            hbm_ledger=self.hbm_ledger)
         return s
 
     def _client_stats(self) -> dict:
@@ -291,7 +295,9 @@ class CopClient:
             h.note_sched(task.wait_ns, task.coalesced, task.fused,
                          rus=task.rus_charged, retried=task.retries,
                          compile_ns=task.compile_ns,
-                         compile_miss=task.compile_miss)
+                         compile_miss=task.compile_miss,
+                         hbm_predicted=task.hbm_predicted,
+                         hbm_measured=task.hbm_measured)
 
     def _launch(self, dag, cols, counts, aux, row_capacity: int = 0,
                 donate: bool = False):

@@ -169,9 +169,16 @@ class ColumnarSnapshot:
         self._device_cache[key] = put
         # lifetime contract (analysis/lifetime): these arrays are
         # PERSISTENT — reused across queries and pages — so a donating
-        # launch over them is rejected at sched admission pre-trace
+        # launch over them is rejected at sched admission pre-trace.
+        # The registration also credits the live HBM ledger (obs/hbm,
+        # copgauge) with the resident footprint — array METADATA only,
+        # never a device sync — and the ledger's weakref death callback
+        # debits it when the cache entry is collected.
         from ..analysis.lifetime import register_resident
-        register_resident(put[1])
+        nbytes = sum(
+            int(d.nbytes) + (int(v.nbytes) if v is not None else 0)
+            for d, v in put[0]) + int(put[1].nbytes)
+        register_resident(put[1], nbytes=nbytes, fingerprint=key[0])
         return self._device_cache[key]
 
     def device_put_uncached(self, mesh) -> tuple[list, Any]:

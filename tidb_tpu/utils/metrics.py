@@ -148,6 +148,16 @@ class Histogram:
                     for key, s in sorted(self._series.items())]
 
 
+def escape_label(v) -> str:
+    """Prometheus exposition-format label-value escaping: backslash,
+    double quote, and newline MUST be escaped (in that order — the
+    backslash first, or the other escapes double up) or the scrape
+    line is invalid.  A program digest or strategy label containing
+    ``"`` / ``\\`` previously emitted a broken exposition line."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 class Registry:
     def __init__(self):
         self._lock = threading.Lock()
@@ -184,7 +194,8 @@ class Registry:
                 if not series:
                     series = [((), [0] * (len(m.buckets) + 1), 0.0, 0)]
                 for key, counts, total, n in series:
-                    base = ",".join(f'{ln}="{kv}"' for ln, kv
+                    base = ",".join(f'{ln}="{escape_label(kv)}"'
+                                    for ln, kv
                                     in zip(m.label_names, key))
                     sep = "," if base else ""
                     acc = 0
@@ -205,7 +216,8 @@ class Registry:
                     out.append(f"{name} 0")
                 for key, v in sorted(values.items()):
                     if m.label_names:
-                        lbl = ",".join(f'{ln}="{kv}"' for ln, kv
+                        lbl = ",".join(f'{ln}="{escape_label(kv)}"'
+                                       for ln, kv
                                        in zip(m.label_names, key))
                         out.append(f"{name}{{{lbl}}} {v}")
                     else:
@@ -223,4 +235,5 @@ def global_registry() -> Registry:
     return _global
 
 
-__all__ = ["Registry", "Counter", "Gauge", "Histogram", "global_registry"]
+__all__ = ["Registry", "Counter", "Gauge", "Histogram",
+           "global_registry", "escape_label"]
