@@ -521,6 +521,7 @@ def mode_sched():
     out["chaos"] = _sched_chaos_scenario(dom, s, sched, queries)
     out["coldwarm"] = _sched_coldwarm_scenario(dom, sched)
     out["stress"] = _sched_stress_scenario()
+    out["podshare"] = _sched_podshare_scenario(sched)
     log("sched-concurrent:", json.dumps(out))
     os.makedirs(DATA_DIR, exist_ok=True)
     with open(SCHED_PATH, "w") as f:
@@ -803,6 +804,144 @@ def _sched_stress_scenario():
     dom, _s = build_stress_domain(n_rows=60_000)
     out = run_stress_harness(dom, n_sessions=n, rate_per_s=rate)
     log("stress:", json.dumps(out))
+    return out
+
+
+def _sched_podshare_scenario(sched):
+    """podshare rung (coplace, ISSUE 16): two in-process Domains — the
+    tier-1 model of two server processes — join one coordination store
+    and share ONE RU_PER_SEC.  Reports the combined admitted RU rate of
+    the limited group against the declared budget (the acceptance bound
+    is 1.25x), the cross-process compile picture (claims won/denied,
+    peer warm-pool adoptions), calibrated-pricing error after the
+    traffic, and a mid-run store-kill sub-check: every in-flight
+    statement completes, zero failures, both members degrade to local
+    slices and rejoin."""
+    import threading
+
+    from tidb_tpu.pd import reset_pd
+    from tidb_tpu.session import Domain, Session
+
+    budget = float(os.environ.get("BENCH_POD_RU_PER_S", "600"))
+    t_run = float(os.environ.get("BENCH_POD_SECONDS", "4"))
+    n_rows = 50_000
+    rng = np.random.default_rng(16)
+    reset_pd()                       # fresh plane for the rung
+
+    def make_domain():
+        dom = Domain()
+        s = Session(dom)
+        s.execute("create table pod_t (a bigint, b bigint)")
+        a = rng.integers(1, 50, n_rows)
+        b = rng.integers(0, 10, n_rows)
+        step = 10_000
+        for lo in range(0, n_rows, step):
+            s.execute("insert into pod_t values " + ",".join(
+                f"({x},{y})" for x, y in
+                zip(a[lo:lo + step], b[lo:lo + step])))
+        s.execute(f"create resource group bench_pod "
+                  f"RU_PER_SEC = {int(budget)}")
+        s.execute("set resource group bench_pod")
+        s.execute("set global tidb_tpu_result_cache_entries = 0")
+        s.execute("set global tidb_tpu_pd = 1")
+        dom.client._platform = lambda: "tpu"
+        return dom, s
+
+    dom_a, s_a = make_domain()
+    dom_b, s_b = make_domain()
+    q = "select sum(a*b), count(*) from pod_t where b < 7"
+    s_a.must_query(q)                # warm both programs + attach pd
+    s_b.must_query(q)
+    ca, cb = dom_a.pd, dom_b.pd
+    for c in (ca, cb):
+        c.tick(force=True)
+    ca.tick(force=True)              # a folds b's quota report back in
+    # drain the initial burst allowance so the measured window is
+    # steady-state refill, not stored tokens
+    for dom in (dom_a, dom_b):
+        bkt = dom.resource_groups.get("bench_pod").bucket
+        bal = bkt.balance
+        if bal > 0:
+            bkt.force_debit(bal)
+    base_rus = sched.stats()["groups"].get("bench_pod", {}).get("rus", 0.0)
+    counts = {"a": 0, "b": 0}
+    errors: list = []
+    stop = time.monotonic() + t_run
+
+    def run(name, dom):
+        sess = Session(dom)
+        sess.execute("set resource group bench_pod")
+        while time.monotonic() < stop:
+            try:
+                sess.must_query(q)
+                counts[name] += 1
+            except Exception as e:
+                errors.append(repr(e))
+
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=run, args=("a", dom_a)),
+               threading.Thread(target=run, args=("b", dom_b))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    elapsed = time.monotonic() - t0
+    rus = sched.stats()["groups"].get("bench_pod", {}).get("rus", 0.0) \
+        - base_rus
+    combined = rus / max(elapsed, 1e-9)
+    # calibrated-pricing error of the rung's digests (copmeter feedback
+    # accumulated during the traffic above)
+    from tidb_tpu.analysis.calibrate import correction_store
+    calib_err = correction_store().stats()["mean_err_pct"]
+    # ---- store-kill sub-check (acceptance d) --------------------- #
+    degraded_before = ca.member.degraded_total + cb.member.degraded_total
+    ca.store.backend.down = True
+    kill_failures = 0
+    kill_stmts = 0
+    for sess in (s_a, s_b):
+        for _ in range(3):
+            kill_stmts += 1
+            try:
+                sess.must_query(q)
+            except Exception:
+                kill_failures += 1
+    for c in (ca, cb):
+        c.tick(force=True)
+    degraded = (ca.member.degraded, cb.member.degraded)
+    ca.store.backend.down = False
+    for c in (ca, cb):
+        c.tick(force=True)
+    rejoined = ca.member.rejoins + cb.member.rejoins
+    out = {
+        "budget_ru_per_s": budget,
+        "combined_ru_per_s": round(combined, 1),
+        "budget_ratio": round(combined / max(budget, 1e-9), 3),
+        "within_1_25x": combined <= 1.25 * budget,
+        "stmts": dict(counts),
+        "errors": len(errors),
+        "quota_shares": {"a": ca.quota.shares.get("bench_pod", 0.0),
+                         "b": cb.quota.shares.get("bench_pod", 0.0)},
+        "claims": ca.registry.claims + cb.registry.claims,
+        "claim_denials": ca.registry.claim_denials
+        + cb.registry.claim_denials,
+        "peer_warm": ca.registry.peer_warm + cb.registry.peer_warm,
+        "calib_err_pct": calib_err,
+        "storekill": {
+            "stmts": kill_stmts,
+            "failures": kill_failures,
+            "degraded": list(degraded),
+            "degraded_total_delta":
+                ca.member.degraded_total + cb.member.degraded_total
+                - degraded_before,
+            "rejoins": rejoined,
+        },
+    }
+    # detach the rung's members so later rungs see a quiet plane
+    for s in (s_a, s_b):
+        s.execute("set global tidb_tpu_pd = 0")
+        s.must_query(q)
+    reset_pd()
+    log("podshare:", json.dumps(out))
     return out
 
 

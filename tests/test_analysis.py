@@ -531,6 +531,37 @@ def test_lint_lock_order():
     assert "TPU-LOCK-ORDER" not in _rules(src3, "utils/poolmgr.py")
 
 
+def test_lint_pd_epoch():
+    """TPU-PD-EPOCH (coplace, ISSUE 16): shared-store mutations in pd/
+    must reference the lease epoch that fences dead writers."""
+    bad = ("def push(store, key, doc):\n"
+           "    store.cas(key, 3, doc)\n")
+    assert _rules(bad, "pd/quota.py") == ["TPU-PD-EPOCH"]
+    # epoch threaded through the CAS: passes
+    good = ("def push(store, key, doc, epoch):\n"
+            "    store.cas(key, 3, doc, epoch=epoch)\n")
+    assert _rules(good, "pd/quota.py") == []
+    # an attribute reference (self.member.epoch) counts
+    good2 = ("def push(self, key, doc):\n"
+             "    self.store.txn_update(key, lambda d: doc,\n"
+             "                          epoch=self.member.epoch)\n")
+    assert _rules(good2, "pd/registry.py") == []
+    # lock discipline is not a store write
+    lock = ("def tick(self):\n"
+            "    self._tick_mu.release()\n")
+    assert _rules(lock, "pd/coordinator.py") == []
+    # scoped to pd/ only — the same call elsewhere is silent
+    assert _rules(bad, "session/session.py") == []
+    # the pd modules are wired into the cross-layer lists
+    from tidb_tpu.analysis.lint import (LOCK_MODULES,
+                                        SPAN_MODULE_PREFIXES,
+                                        TRACED_MODULES)
+    for rel in ("pd/store.py", "pd/lease.py", "pd/quota.py",
+                "pd/registry.py", "pd/coordinator.py"):
+        assert rel in LOCK_MODULES and rel in TRACED_MODULES
+    assert "pd/" in SPAN_MODULE_PREFIXES
+
+
 def test_repo_tree_is_lint_clean_against_baseline():
     from tidb_tpu.analysis.lint import lint_tree
     fresh = new_findings(lint_tree(), load_baseline())

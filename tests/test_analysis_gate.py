@@ -101,3 +101,27 @@ def test_donation_report_covers_whole_corpus():
     assert "donation: 20/20 corpus plans planned finite" in proc.stdout, \
         proc.stdout
     assert "ephemeral" in proc.stdout and "loop-carried" in proc.stdout
+
+
+def test_gate_pd_pass_verifies_schema():
+    """ISSUE 16 satellite: the gate's pd pass verifies every shared-
+    store key family (owner + TTL + epoch rule) and the live fence."""
+    proc = _run_gate()
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "pd: 6 key families verified (owner+ttl+epoch)" \
+        in proc.stdout, proc.stdout
+    tail = proc.stdout.split("pd:")[1]
+    assert "dead-epoch writes fenced" in tail, proc.stdout
+    assert "0 violations" in tail, proc.stdout
+
+
+def test_pd_report_prints_schema_table():
+    """ISSUE 16 satellite: ``--pd-report`` prints the shared-store
+    schema — every key family with owner, TTL, and epoch rule."""
+    proc = _run_gate("--pd-report")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    for prefix in ("lease/", "quota/", "program/", "claim/",
+                   "quarantine/", "calib"):
+        assert prefix in proc.stdout, proc.stdout
+    assert "epoch" in proc.stdout and "ttl" in proc.stdout, proc.stdout
+    assert "0 violations" in proc.stdout, proc.stdout

@@ -305,6 +305,76 @@ def test_manifest_lru_evicts_by_bytes(tmp_path):
     assert len(left) == st["entries"]
 
 
+def test_manifest_concurrent_writers_never_clobber(tmp_path):
+    """coplace (ISSUE 16 satellite): two manifests over one shared
+    cache dir — each save is a locked read-MERGE-write, so interleaved
+    writers keep each other's entries instead of last-writer-wins."""
+    from tidb_tpu.compilecache.manifest import WarmManifest
+    d = str(tmp_path)
+    ma = WarmManifest(d, cap_bytes=1 << 20)
+    mb = WarmManifest(d, cap_bytes=1 << 20)
+    parts = {"digest": "dx", "family": "f", "mesh_fp": "m",
+             "donation_sig": "s", "capacity": 0}
+
+    def rec(m, i):
+        m.record(f"{i:032x}", dict(parts, digest=f"d{i}"),
+                 nbytes=10, compile_ms=1.0)
+    # interleave: a and b each record entries the other never saw
+    rec(ma, 1)
+    rec(mb, 2)       # b's save merges a's entry from disk first
+    rec(ma, 3)       # a's save merges b's entry back
+    fresh = WarmManifest(d, cap_bytes=1 << 20)
+    hexes = {hx for hx, _ in fresh.entries_mru()}
+    assert hexes == {f"{i:032x}" for i in (1, 2, 3)}
+    # refresh() folds peers' later writes into a live manifest without
+    # writing anything itself
+    rec(mb, 4)
+    assert ma.refresh() >= 1
+    assert f"{4:032x}" in {hx for hx, _ in ma.entries_mru()}
+    # a locally-dropped entry is fenced: the merge must not resurrect
+    # it from the other writer's earlier snapshot
+    ma.purge_digest("d1")
+    rec(ma, 5)       # triggers a's locked merge+save
+    hexes_a = {hx for hx, _ in ma.entries_mru()}
+    assert f"{1:032x}" not in hexes_a
+    final = WarmManifest(d, cap_bytes=1 << 20)
+    assert f"{1:032x}" not in {hx for hx, _ in final.entries_mru()}
+
+
+def test_manifest_concurrent_writer_threads(tmp_path):
+    """Hammer the same directory from two manifests on two threads:
+    every recorded entry must survive into a fresh load (crash-safe
+    lock + merge + atomic rename under real interleaving)."""
+    import threading
+    from tidb_tpu.compilecache.manifest import WarmManifest
+    d = str(tmp_path)
+    mans = [WarmManifest(d, cap_bytes=1 << 20) for _ in range(2)]
+    errors: list = []
+
+    def writer(m, base):
+        try:
+            for i in range(base, base + 20):
+                m.record(f"{i:032x}",
+                         {"digest": f"d{i}", "family": "f",
+                          "mesh_fp": "m", "donation_sig": "s",
+                          "capacity": 0},
+                         nbytes=10, compile_ms=1.0)
+        except Exception as e:       # noqa: BLE001 - surfaced below
+            errors.append(e)
+    ts = [threading.Thread(target=writer, args=(m, 100 * k))
+          for k, m in enumerate(mans)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert errors == []
+    fresh = WarmManifest(d, cap_bytes=1 << 20)
+    hexes = {hx for hx, _ in fresh.entries_mru()}
+    want = {f"{i:032x}" for i in range(0, 20)} | \
+        {f"{i:032x}" for i in range(100, 120)}
+    assert hexes == want
+
+
 def test_quarantined_digest_never_persists_into_manifest(cache_dir):
     """Chaos invariant: a digest the breaker opened on is purged from
     the manifest and refused on re-record — no quarantine laundering

@@ -35,6 +35,11 @@ Runs three static passes and exits non-zero on any NEW finding:
    (intra / ici / dci).  SHARD-IMPLICIT-RESHARD / SHARD-AXIS-UNKNOWN /
    SHARD-MERGE-COORDINATOR / COST-DCI-BLOWUP findings baseline like
    every other corpus rule.
+7. Coordination-plane schema (pd/store, coplace): every shared-store
+   key family must declare an owner module, a positive TTL, and an
+   epoch-fencing rule, and a live in-memory store must refuse writes
+   from a released (dead) lease epoch — guards the schema the same
+   way the pricing pass guards the static weights.
 
 Flags:
     --lint-only / --contracts-only   run one pass
@@ -61,6 +66,10 @@ Flags:
                                      (intra/ici/dci bytes under the
                                      host=2 view, analysis/shardflow)
                                      and exit
+    --pd-report                      print the coplace shared-store
+                                     schema (key family -> owner, TTL,
+                                     epoch rule; pd/store) with the
+                                     live fence check and exit
 """
 
 from __future__ import annotations
@@ -253,6 +262,32 @@ def _run_shardflow(plans) -> int:
     return 1 if bad else 0
 
 
+def _run_pd() -> int:
+    """Coordination-plane schema gate (coplace, ISSUE 16): every shared
+    key family carries owner + TTL + epoch rule, and the in-memory
+    store's fence refuses a dead epoch — the report's verdict line IS
+    the gate (its violation count must be zero)."""
+    from ..pd.store import KEY_FAMILIES, verify_key_families
+    from ..pd.store import MemoryBackend, PdLeaseExpired, PdStore
+    bad = list(verify_key_families())
+    store = PdStore(MemoryBackend())
+    epoch = store.grant("gate")
+    if not store.cas("quota/gate", 0, {"v": 1}, epoch=epoch):
+        bad.append("fresh epoch-carrying CAS refused")
+    store.release("gate", epoch)
+    try:
+        store.cas("quota/gate", 1, {"v": 2}, epoch=epoch)
+        bad.append("dead-epoch write accepted")
+    except PdLeaseExpired:
+        pass
+    for v in bad:
+        print(f"PD-SCHEMA {v}")
+    print(f"pd: {len(KEY_FAMILIES)} key families verified "
+          f"(owner+ttl+epoch), dead-epoch writes fenced, "
+          f"{len(bad)} violations")
+    return 1 if bad else 0
+
+
 def _run_contracts(plans) -> int:
     from ..testing.tpch import TPCH_PLAN_QUERIES, TPCH_SHUFFLE_QUERIES
     from .contracts import PlanContractError, verify_plan
@@ -299,6 +334,11 @@ def main(argv=None) -> int:
         from .shardflow import transfer_report
         print(transfer_report(_corpus_plans(), n_devices=GATE_DEVICES))
         return 0
+    if "--pd-report" in argv:
+        from ..pd.store import pd_report
+        out = pd_report()
+        print(out)
+        return 1 if "VIOLATION" in out else 0
     if check_baseline:
         # hygiene pass: waivers must not rot silently — every baseline
         # entry must still match a current finding (full gather, so the
@@ -323,6 +363,7 @@ def main(argv=None) -> int:
         rc |= _run_pricing(plans)
         rc |= _run_calibration(plans)
         rc |= _run_shardflow(plans)
+        rc |= _run_pd()
     if rc == 0:
         print("analysis gate: ok")
     return rc

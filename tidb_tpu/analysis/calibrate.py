@@ -206,6 +206,57 @@ class Correction:
             oom_bumps=max(int(d.get("oom_bumps", 0)), 0))
 
 
+def merge_correction_payloads(base: Optional[dict],
+                              other: dict) -> dict:
+    """coplace (pd/): observation-count-weighted merge of two
+    correction payloads — the cross-process twin of the in-process
+    EWMA.  The side with more observations dominates (w = n_other /
+    (n_base + n_other)), every factor re-passes ``clamp_factor`` so
+    the [CALIB_CLAMP_MIN, CALIB_CLAMP_MAX] invariant survives any
+    merge order, and sample counts take the MAX of the two sides —
+    summing would double-count the same launches on every sync round
+    and let a stale payload outvote live measurement forever.
+    Time and memory channels merge independently on their own counts;
+    ``oom_bumps`` takes the max (each bump already multiplied the
+    factor it describes)."""
+    if not base:
+        return dict(other)
+    out = dict(base)
+    n_b = max(base.get("samples", 0), 0)
+    n_o = max(other.get("samples", 0), 0)
+    if n_o > 0:
+        w = n_o / max(n_b + n_o, 1)
+        tf_b = base.get("time_factor", 1.0)
+        out["time_factor"] = round(clamp_factor(
+            tf_b + w * (other.get("time_factor", 1.0) - tf_b)), 4)
+        for field in ("err", "ewma_ms"):
+            v_b = max(base.get(field, 0.0), 0.0)
+            out[field] = round(v_b + w * (max(other.get(field, 0.0),
+                                              0.0) - v_b), 4)
+        out["samples"] = max(n_b, n_o)
+    m_b = max(base.get("mem_samples", 0), 0)
+    m_o = max(other.get("mem_samples", 0), 0)
+    if m_o > 0:
+        w = m_o / max(m_b + m_o, 1)
+        mf_b = base.get("mem_factor", 1.0)
+        out["mem_factor"] = round(clamp_factor(
+            mf_b + w * (other.get("mem_factor", 1.0) - mf_b)), 4)
+        me_b = max(base.get("mem_err", 0.0), 0.0)
+        out["mem_err"] = round(me_b + w * (max(other.get("mem_err",
+                                                         0.0),
+                                               0.0) - me_b), 4)
+        out["mem_samples"] = max(m_b, m_o)
+    out["oom_bumps"] = max(base.get("oom_bumps", 0),
+                           other.get("oom_bumps", 0))
+    if out["oom_bumps"] > base.get("oom_bumps", 0):
+        # a peer saw OOMs we did not: adopt the larger (clamped)
+        # memory correction outright — admission safety beats EWMA
+        out["mem_factor"] = round(clamp_factor(
+            max(out.get("mem_factor", 1.0),
+                other.get("mem_factor", 1.0))), 4)
+    return out
+
+
 class CorrectionStore:
     """Bounded per-digest EWMA correction store (the control path).
 
@@ -371,6 +422,36 @@ class CorrectionStore:
                     n += 1
         return n
 
+    def merge_payload(self, digest: str, payload: dict) -> bool:
+        """coplace (pd/ calibration sync): fold one shared payload
+        into this store — observation-count-weighted EWMA merge
+        (``merge_correction_payloads``), clamp preserved.  A digest
+        never seen locally adopts the peer's payload outright (a
+        digest measured hot in process A prices correctly in B before
+        B ever launches it).  Returns True when the local entry
+        actually moved — the pd sync counter's unit."""
+        with self._mu:
+            ent = self._entries.get(digest)
+            if ent is None:
+                fresh = Correction.from_payload(payload)
+                if fresh.samples == 0 and fresh.mem_samples == 0 \
+                        and fresh.oom_bumps == 0:
+                    return False       # nothing measured: not worth a slot
+                self._entries.put(digest, fresh)
+                self._dirty = True
+                return True
+            merged = Correction.from_payload(
+                merge_correction_payloads(ent.payload(), payload))
+            changed = (abs(merged.time_factor - ent.time_factor) > 1e-6
+                       or abs(merged.mem_factor - ent.mem_factor) > 1e-6
+                       or merged.samples != ent.samples
+                       or merged.mem_samples != ent.mem_samples
+                       or merged.oom_bumps != ent.oom_bumps)
+            if changed:
+                self._entries.put(digest, merged)
+                self._dirty = True
+            return changed
+
     def sync_manifest(self, force: bool = False) -> None:
         """Throttled restore+persist against the copforge manifest (a
         no-op without a cache dir).  First sync per directory restores
@@ -507,7 +588,7 @@ def calibration_report(plans, n_devices: int = 8) -> str:
 
 __all__ = ["CorrectionStore", "Correction", "BoundedLRU",
            "correction_store", "clamp_factor", "predict_ms",
-           "arbitrated_ms",
+           "arbitrated_ms", "merge_correction_payloads",
            "simulate_corpus_calibration", "calibration_report",
            "CALIB_CLAMP_MIN", "CALIB_CLAMP_MAX", "CALIB_ALPHA",
            "CALIB_STORE_CAP", "CALIB_OOM_BUMP", "CALIB_TARGET_ERR",

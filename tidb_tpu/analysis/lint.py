@@ -114,6 +114,15 @@ Rules
                    outright); a host callback inside a kernel stalls
                    the TPU pipeline on the host — both destroy exactly
                    the performance a hand-written kernel exists for.
+- TPU-PD-EPOCH     a shared-store write call (cas / txn_update /
+                   delete / grant / renew / release) in pd/ whose
+                   enclosing function never references the lease
+                   ``epoch``: the coplace store fences dead writers
+                   with lease epochs — every mutation of shared state
+                   must ride a CAS carrying the member's epoch, or a
+                   process whose lease expired (paused, partitioned,
+                   half-dead) can clobber state the survivors already
+                   repartitioned.
 
 Inline waiver: any rule is suppressed by a `# planlint: ok` comment on
 the offending line (give a reason after it).
@@ -143,6 +152,12 @@ TRACED_MODULES = {
     # concretization, no literal axis names) so the analysis side can
     # never drift from the programs it verifies
     "parallel/topology.py", "analysis/shardflow.py",
+    # coplace (ISSUE 16): the coordination plane runs on every
+    # statement's tick and its payloads (quota shares, calib factors)
+    # feed admission directly — same hygiene contract: no stray
+    # concretization, no silent host round-trips smuggled in later
+    "pd/store.py", "pd/lease.py", "pd/quota.py", "pd/registry.py",
+    "pd/coordinator.py",
 }
 
 # hot-path modules where a host sync stalls the launch pipeline
@@ -186,6 +201,12 @@ LOCK_MODULES = {
     # drain loop (launch begin/finish, measured feed), weakref death
     # callbacks, and the status routes, so they join the contract
     "obs/hbm.py", "obs/roofline.py",
+    # coplace (ISSUE 16): the store backend leaf lock and the
+    # coordinator's tick mutex are taken from every statement thread
+    # (the tick) while rc bucket / manifest / correction-store locks
+    # are held by the same call chains, so they join the contract
+    "pd/store.py", "pd/lease.py", "pd/quota.py", "pd/registry.py",
+    "pd/coordinator.py",
 }
 
 # modules whose retry/re-dispatch loops must spend a typed Backoffer
@@ -195,7 +216,7 @@ RETRY_MODULE_PREFIXES = ("sched/", "store/")
 # modules whose latency measurements must flow through the copscope
 # obs span/histogram API (TPU-SPAN-LEAK): the launch-path layers whose
 # timings TRACE and the flight recorder attribute
-SPAN_MODULE_PREFIXES = ("sched/", "copr/", "compilecache/")
+SPAN_MODULE_PREFIXES = ("sched/", "copr/", "compilecache/", "pd/")
 # counter targets that smell like a latency/total accumulator
 _LAT_COUNTER = re.compile(r"(_ns|_ms|_us|_total|_seconds)$")
 _PERF_CALL = re.compile(r"^perf_counter(_ns)?$")
@@ -208,6 +229,20 @@ _OBS_REF = re.compile(r"observe|span|trace", re.IGNORECASE)
 # hit or leave disk must carry the digest + mesh-fingerprint +
 # donation-plan triple (TPU-COMPILE-KEY)
 COMPILECACHE_PREFIX = "compilecache/"
+
+# the coplace coordination plane (TPU-PD-EPOCH): every shared-store
+# mutation in pd/ must sit in a function that references the lease
+# epoch — the CAS fence that refuses writes from members whose lease
+# lapsed.  Call names that ARE such mutations (PdStore's write surface;
+# bare `set`/`put` deliberately excluded — Gauge.set and dict puts are
+# not store writes).
+PD_PREFIX = "pd/"
+_PD_WRITE_CALLS = re.compile(
+    r"^(cas|txn_update|delete|grant|renew|release)$")
+_EPOCH_REF = re.compile(r"epoch")
+# receivers that are threading primitives, not the store — their
+# acquire/release is lock discipline (TPU-LOCK-ORDER's concern)
+_PD_LOCK_RECV = re.compile(r"mu$|mutex|lock|cond|sem", re.IGNORECASE)
 
 # copgauge (TPU-MEM-SOURCE): modules allowed to call the raw device
 # memory introspection APIs.  obs/hbm.py owns the single sanctioned
@@ -850,6 +885,60 @@ class _CompileKeyRules(_Scoped):
 
 
 # --------------------------------------------------------------------- #
+# rule: TPU-PD-EPOCH (pd/ shared-store mutation seams)
+# --------------------------------------------------------------------- #
+
+class _PdEpochRules(_Scoped):
+    """Every shared-store write call in pd/ must sit in a function that
+    references the lease epoch.  The coplace store's liveness contract
+    is epoch-fenced CAS: a mutation path that never mentions the epoch
+    is one a dead member (expired lease, paused process, partition
+    survivor) could drive — the store would have no way to refuse it.
+    Identifier check mirrors TPU-COMPILE-KEY: names, attributes, AND
+    string constants (the ``"epoch"`` doc fields the backends
+    round-trip count as references)."""
+
+    def __init__(self, rel, lines):
+        super().__init__(rel, lines)
+        self._fn_nodes: list = []
+
+    def visit_FunctionDef(self, node):
+        self._fn_nodes.append(node)
+        super().visit_FunctionDef(node)
+        self._fn_nodes.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    @staticmethod
+    def _lock_receiver(node: ast.Call) -> bool:
+        recv = node.func.value if isinstance(node.func, ast.Attribute) \
+            else None
+        if isinstance(recv, ast.Attribute):
+            return bool(_PD_LOCK_RECV.search(recv.attr))
+        if isinstance(recv, ast.Name):
+            return bool(_PD_LOCK_RECV.search(recv.id))
+        return False
+
+    def visit_Call(self, node):
+        name = _call_name(node)
+        if _PD_WRITE_CALLS.match(name) and self._fn_nodes \
+                and not self._lock_receiver(node):
+            fn = self._fn_nodes[-1]
+            blob = " ".join(
+                _CompileKeyRules._identifiers(fn)).lower()
+            if not _EPOCH_REF.search(blob):
+                self.add("TPU-PD-EPOCH", node,
+                         f"{name}(...) mutates the shared pd store "
+                         "from a function that never references the "
+                         "lease epoch: without the epoch-fenced CAS a "
+                         "member whose lease expired can clobber "
+                         "state the surviving members already "
+                         "repartitioned — thread the member epoch "
+                         "through every write path")
+        self.generic_visit(node)
+
+
+# --------------------------------------------------------------------- #
 # rule 5: lock acquisition order
 # --------------------------------------------------------------------- #
 
@@ -999,6 +1088,10 @@ def lint_source(src: str, rel: str) -> list:
         ck = _CompileKeyRules(rel, lines)
         ck.visit(tree)
         findings += ck.findings
+    if rel.startswith(PD_PREFIX):
+        pe = _PdEpochRules(rel, lines)
+        pe.visit(tree)
+        findings += pe.findings
     if rel.startswith(PALLAS_PREFIX):
         pr = _PallasRules(rel, lines)
         pr.visit(tree)
@@ -1067,5 +1160,5 @@ def new_findings(findings: list, baseline: set) -> list:
 __all__ = ["Finding", "lint_source", "lint_tree", "load_baseline",
            "new_findings", "TRACED_MODULES", "HOT_PATH_MODULES",
            "LOCK_MODULES", "RETRY_MODULE_PREFIXES",
-           "COMPILECACHE_PREFIX", "PALLAS_PREFIX",
+           "COMPILECACHE_PREFIX", "PALLAS_PREFIX", "PD_PREFIX",
            "SPAN_MODULE_PREFIXES", "MEM_SOURCE_MODULES"]

@@ -387,6 +387,22 @@ class CompileCache:
                 if m is not None:
                     m.touch(entry_hex, dt_ns / 1e6)
                 return exe
+        # coplace (pd/registry ISSUE 16): cross-process in-flight
+        # compile claims.  Before the expensive AOT compile, claim the
+        # entry on the coordination store; when a LIVE peer already
+        # holds the claim, poll the shared cache dir briefly for its
+        # persisted result instead of compiling the same program
+        # twice.  pd off/degraded => claim is None and nothing here
+        # changes; a timed-out poll falls through and compiles anyway
+        # (compile-once is an optimization, never a correctness gate).
+        claim = None
+        if self.cache_dir and self._persist_ok is not False:
+            from ..pd import try_compile_claim
+            claim = try_compile_claim(entry_hex)
+            if claim is False:
+                exe = self._wait_peer_entry(entry_hex, key)
+                if exe is not None:
+                    return exe
         # miss: explicit AOT staging so we HOLD the Compiled object —
         # calling the jit wrapper would compile the same program into a
         # cache we cannot serialize from
@@ -397,6 +413,9 @@ class CompileCache:
             # plain jit path serves programs the staging API refuses
             with self._mu:
                 self.uncacheable += 1
+            if claim is True:
+                from ..pd import release_compile_claim
+                release_compile_claim(entry_hex)
             return None
         dt_ns = time.perf_counter_ns() - t0
         with self._mu:
@@ -424,7 +443,48 @@ class CompileCache:
                       "donation_sig": key.donation_sig,
                       "capacity": key.capacity},
                      nbytes, dt_ns / 1e6, quarantined=quarantined)
+        if claim is True:
+            # persisted (or at least pooled): peers polling on our
+            # claim can stop early
+            from ..pd import release_compile_claim
+            release_compile_claim(entry_hex)
         return exe
+
+    def _wait_peer_entry(self, entry_hex: str, key: CompileKey,
+                         timeout_s: float = 1.5, poll_s: float = 0.05):
+        """Bounded poll for the claim winner's persisted entry in the
+        shared cache dir (coplace compile-once).  Returns the loaded
+        executable or None (give up and compile locally) — never
+        raises, never waits past ``timeout_s``."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            t0 = time.perf_counter_ns()
+            loaded = self._load_entry(entry_hex, key.parts())
+            if loaded is not None:
+                exe, nbytes = loaded
+                dt_ns = time.perf_counter_ns() - t0
+                with self._mu:
+                    self._pool_put_locked(entry_hex, exe, nbytes)
+                    self.disk_hits += 1
+                    self.hits += 1
+                    self.load_ms_total += dt_ns / 1e6
+                    self._tl.hits += 1
+                    self._tl.loaded_ns += dt_ns
+                self._note_caps(key)
+                self._note_mem(entry_hex, exe)
+                self._m_hits.inc()
+                self._m_load.inc(dt_ns / 1e6)
+                self._m_resolve_ms.observe(dt_ns / 1e6, outcome="load")
+                m = self.manifest
+                if m is not None:
+                    m.refresh()      # adopt the winner's record too
+                    m.touch(entry_hex, dt_ns / 1e6)
+                return exe
+            with self._mu:
+                if entry_hex in self._bad_entries:
+                    return None      # winner's entry is unreadable here
+            time.sleep(poll_s)
+        return None
 
     def load_warm(self, entry_hex: str) -> bool:
         """Boot warm pool: deserialize ONE manifest entry into the pool

@@ -16,6 +16,18 @@ Two hard rules:
 - a QUARANTINED digest is never recorded and is purged on quarantine:
   a program the circuit breaker opened on must not launder its way back
   through a restart's warm replay (the chaos bench rung asserts this).
+
+coplace (ISSUE 16) made saves safe under CONCURRENT WRITERS: N
+processes share one ``tidb_tpu_compile_cache_dir``, so every save is
+an advisory-locked read-MERGE-write (utils/filelock) committed by
+atomic temp-file + rename — a concurrent save folds the other
+process's entries in instead of clobbering them.  Locally dropped
+entries and purged digests are remembered so a merge can never
+resurrect what eviction or quarantine removed here; cross-process
+quarantine is the pd registry's tombstone job, not the manifest's.
+``refresh()`` folds peers' writes into the live view without writing
+(the pd sync tick calls it so adopted entries carry their measured
+times and capacities).
 """
 
 from __future__ import annotations
@@ -45,6 +57,10 @@ class WarmManifest:
         # corrections ride the same file, so calibration survives
         # restarts exactly as far as the programs it describes
         self._calib: dict[str, dict] = {}         # stable digest -> payload
+        # merge fences: what THIS process dropped must not come back
+        # via a concurrent writer's copy (see module doc)
+        self._dropped: set = set()                # entry hexes evicted here
+        self._purged: set = set()                 # digests quarantined here
         self.evictions = 0
         self._load()
 
@@ -64,17 +80,63 @@ class WarmManifest:
             self._entries = {}
             self._calib = {}
 
+    def _read_disk(self) -> dict:
+        try:
+            with open(self._path(), encoding="utf-8") as f:
+                doc = json.load(f)
+            if isinstance(doc, dict) and \
+                    doc.get("version") == MANIFEST_VERSION:
+                return doc
+        except (OSError, ValueError):
+            pass
+        return {}
+
+    def _merge_disk_locked(self, doc: dict) -> int:
+        """Fold a concurrent writer's document into the live view:
+        unknown entries adopt, conflicts keep OURS (our copy carries
+        this process's hits/last_used), and nothing this process
+        dropped or quarantined may resurrect.  Returns adoptions."""
+        n = 0
+        for hx, meta in sorted(doc.get("entries", {}).items()):
+            if hx in self._entries or hx in self._dropped:
+                continue
+            if meta.get("digest", "") in self._purged:
+                continue
+            self._entries[hx] = dict(meta)
+            n += 1
+        for d, payload in sorted(doc.get("calibration", {}).items()):
+            if d in self._calib or d in self._purged:
+                continue
+            self._calib[d] = dict(payload)
+        return n
+
     def _save_locked(self) -> None:
+        """Advisory-locked read-merge-write + atomic rename: safe
+        against concurrent writers sharing the cache dir (see module
+        doc).  Still never a failure — the manifest is an
+        optimization."""
+        from ..utils.filelock import locked_file
         try:
             os.makedirs(self.cache_dir, exist_ok=True)
-            tmp = self._path() + ".tmp"
-            with open(tmp, "w", encoding="utf-8") as f:
-                json.dump({"version": MANIFEST_VERSION,
-                           "entries": self._entries,
-                           "calibration": self._calib}, f)
-            os.replace(tmp, self._path())
+            with locked_file(self._path() + ".lock"):
+                self._merge_disk_locked(self._read_disk())
+                self._evict_locked()
+                tmp = self._path() + f".tmp{os.getpid()}"
+                with open(tmp, "w", encoding="utf-8") as f:
+                    json.dump({"version": MANIFEST_VERSION,
+                               "entries": self._entries,
+                               "calibration": self._calib}, f)
+                os.replace(tmp, self._path())
         except OSError:
             pass          # manifest is an optimization, never a failure
+
+    def refresh(self) -> int:
+        """Fold entries other processes persisted since our last save
+        into the live view WITHOUT writing — the pd sync tick's read
+        channel (peer adoption then sees measured compile/load times
+        and regrow capacities, not just entry names)."""
+        with self._mu:
+            return self._merge_disk_locked(self._read_disk())
 
     # ---- recording -------------------------------------------------- #
 
@@ -114,13 +176,14 @@ class WarmManifest:
         feedback from a poisoned program must not launder through a
         restart any more than its executable may."""
         with self._mu:
+            self._purged.add(digest)     # merge fence: never readopt
             doomed = [hx for hx, e in sorted(self._entries.items())
                       if e.get("digest") == digest]
             for hx in doomed:
                 self._drop_locked(hx)
-            purged_calib = self._calib.pop(digest, None) is not None
-            if doomed or purged_calib:
-                self._save_locked()
+            self._calib.pop(digest, None)
+            self._save_locked()          # persist the purge even when
+                                         # only the fence changed
             return len(doomed)
 
     # ---- calibration persistence (analysis/calibrate) ---------------- #
@@ -139,6 +202,7 @@ class WarmManifest:
             return {d: dict(p) for d, p in self._calib.items()}
 
     def _drop_locked(self, entry_hex: str) -> None:
+        self._dropped.add(entry_hex)     # merge fence: stay dropped
         self._entries.pop(entry_hex, None)
         try:
             os.unlink(os.path.join(self.cache_dir,

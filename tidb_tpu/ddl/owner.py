@@ -224,7 +224,7 @@ class DDLExecutor:
             done = 0
             for off in range(0, len(chunk), BATCH):
                 batch = chunk[off:off + BATCH]
-                for attempt in range(5):
+                for attempt in range(12):
                     txn = kv.begin()
                     written = 0
                     try:
@@ -254,11 +254,16 @@ class DDLExecutor:
                         raise
                     except KVError:
                         # write conflict with a concurrent DML txn: the
-                        # region-error/Backoffer retry analog
+                        # region-error/Backoffer retry analog.  Capped
+                        # exponential backoff, same discipline as the
+                        # session's _retry_write_conflict: a sustained
+                        # DML stream over the batch's range can keep
+                        # colliding for >20ms, which the old 5-attempt
+                        # linear budget couldn't ride out.
                         txn.rollback()
-                        if attempt == 4:
+                        if attempt == 11:
                             raise
-                        time.sleep(0.002 * (attempt + 1))
+                        time.sleep(min(0.002 * (2 ** attempt), 0.1))
                 done += written
                 with self._mu:
                     job.rows_backfilled += written

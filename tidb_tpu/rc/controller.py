@@ -211,6 +211,13 @@ class ResourceGroupManager:
         with self._lock:
             return self._groups.get(name)
 
+    def groups_snapshot(self) -> list:
+        """Stable-ordered snapshot of the live groups (coplace: the pd
+        quota pool iterates limited groups each renewal round without
+        holding the registry lock across bucket operations)."""
+        with self._lock:
+            return [self._groups[name] for name in sorted(self._groups)]
+
     def rows(self) -> list[tuple]:
         with self._lock:
             groups = list(self._groups.values())

@@ -189,6 +189,13 @@ class DeviceScheduler:
         # to the pre-copgauge behavior (mem_factor moves only on OOM).
         self.hbm_enable = True
         self._ledger_obj = None
+        # coplace (pd/, tidb_tpu_pd sysvar): cross-process coordination
+        # plane.  Off (default) = every path byte-identical to the
+        # pre-pd behavior; on = breaker quarantines broadcast to peers
+        # and /sched grows a "pd" section.  The coordinator itself is
+        # per-Domain (session plumbs it); this flag only gates the
+        # scheduler-side hooks.
+        self.pd_enable = False
         # launch supervision (faultline): per-digest circuit breaker
         # consulted at submit, transient-retry budget spent at the
         # drain; _retry_sleep is the Backoffer sleep seam (tests)
@@ -388,7 +395,8 @@ class DeviceScheduler:
                   rc_enable: Optional[bool] = None,
                   rc_overdraft: Optional[float] = None,
                   calibration: Optional[bool] = None,
-                  hbm_ledger: Optional[bool] = None) -> None:
+                  hbm_ledger: Optional[bool] = None,
+                  pd_enable: Optional[bool] = None) -> None:
         """Apply sysvar knobs; negative/None = keep current (window_us
         and hbm_budget are the exceptions: -1 means adaptive/auto,
         0 disables the hold / the budget)."""
@@ -411,6 +419,8 @@ class DeviceScheduler:
             self.calibration_enable = bool(calibration)
         if hbm_ledger is not None:
             self.hbm_enable = bool(hbm_ledger)
+        if pd_enable is not None:
+            self.pd_enable = bool(pd_enable)
 
     # ---- HBM-budget admission (analysis/copcost) -------------------- #
 
@@ -1395,7 +1405,14 @@ class DeviceScheduler:
         for t in live:
             if t.key is not None and t.key[0] == digest \
                     and t.dag is not None:
-                compile_cache().quarantine(stable_digest(t.dag))
+                sd = stable_digest(t.dag)
+                compile_cache().quarantine(sd)
+                if self.pd_enable:
+                    # coplace: tombstone the digest for every peer so
+                    # a breaker-opened program is not laundered back
+                    # through a peer's warm pool (pd/registry)
+                    from ..pd import broadcast_quarantine
+                    broadcast_quarantine(sd)
                 return
 
     # ------------------------------------------------------------- #
@@ -1750,6 +1767,27 @@ class DeviceScheduler:
             out.update(led.stats())
         return out
 
+    def _pd_stats(self) -> dict:
+        """coplace: the /sched ``pd`` section — membership + quota
+        shares per attached coordinator (the full store dump lives on
+        /pd).  Pure local state, no store I/O from the stats path."""
+        if not self.pd_enable:
+            return {"enabled": False}
+        from ..pd import coordinators
+        out = {"enabled": True, "members": []}
+        for c in coordinators():
+            out["members"].append({
+                "member_id": c.member.member_id,
+                "epoch": c.member.epoch,
+                "degraded": c.member.degraded,
+                "degraded_total": c.member.degraded_total,
+                "sync_total": c.sync_total,
+                "quota_shares": dict(sorted(c.quota.shares.items())),
+                "peer_warm": c.registry.peer_warm,
+                "claim_denials": c.registry.claim_denials,
+            })
+        return out
+
     @staticmethod
     def _pct(samples: list, q: float) -> float:
         if not samples:
@@ -1807,6 +1845,8 @@ class DeviceScheduler:
                 "calibration": self._calibration_stats(),
                 # copgauge (obs/hbm): the live device-memory ledger
                 "hbm": self._hbm_stats(),
+                # coplace (pd/): coordination-plane membership
+                "pd": self._pd_stats(),
                 "oom_faults": self.oom_faults,
                 "oom_demuxed": self.oom_demuxed,
                 "shed_rejects": self.shed_rejects,

@@ -165,6 +165,20 @@ class StatusServer:
                     "records": mgr.runaway_ring.records(),
                 },
             }), "application/json"
+        if path == "/pd":
+            # coplace (pd/): coordination-plane status — this Domain's
+            # membership (lease epoch, degraded state, quota shares,
+            # registry gossip counters) plus the cross-coordinator view
+            # and a bounded dump of the shared store (leases, key
+            # census per family, versions)
+            from ..pd import pd_status
+            out = {"status": pd_status()}
+            coord = getattr(self.domain, "pd", None)
+            if coord is None:
+                out["this_domain"] = {"enabled": False}
+            else:
+                out["this_domain"] = coord.stats()
+            return json.dumps(out), "application/json"
         if path == "/hbm":
             # copgauge (obs/hbm + obs/roofline): the device-memory and
             # utilization plane — live ledger balances (persistent

@@ -113,6 +113,10 @@ class Domain:
         # kept, the rest sampled; served at /trace on the StatusServer
         from ..obs import FlightRecorder
         self.flight_recorder = FlightRecorder()
+        # coplace coordination plane (pd/): the Domain's PdCoordinator
+        # slot — attached by pd.configure_domain when tidb_tpu_pd = 1
+        # (this Domain then models ONE server process of the fleet)
+        self.pd = None
         from ..planner.bindinfo import BindManager
         self.bindings = BindManager()       # GLOBAL plan bindings
         if not hasattr(self, "_next_table_id"):   # durable mode recovered it
@@ -1267,6 +1271,22 @@ class Session:
             pool_bytes=None if v13 is None or v13 == "" or int(v13) < 0
             else int(v13))
         maybe_warm_start(client)
+        # coplace coordination plane (pd/): attach/detach the Domain's
+        # coordinator from the sysvars, arm the scheduler-side hooks,
+        # and tick the statement-driven heartbeat (internally
+        # throttled; a degraded store costs one failed grant per tick,
+        # never a statement)
+        v18 = merged.get("tidb_tpu_pd")
+        v19 = merged.get("tidb_tpu_pd_dir")
+        pd_on = bool(int(v18)) if v18 is not None and v18 != "" \
+            else False
+        client.pd_enable = pd_on
+        from ..pd import configure_domain
+        coord = configure_domain(
+            self.domain, pd_on,
+            "" if v19 is None else str(v19))
+        if coord is not None:
+            coord.tick()
         return ExecContext(client, merged,
                            mem_tracker=Tracker("query", quota))
 

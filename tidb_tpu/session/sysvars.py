@@ -93,6 +93,17 @@ _VARS = [
     _v("tidb_tpu_compile_cache_dir", "", kind="str", scope=SCOPE_GLOBAL),
     _v("tidb_tpu_compile_warm_pool", -1, kind="int", min=-1,
        scope=SCOPE_GLOBAL),
+    # coplace PD-style coordination plane (pd/): N server processes
+    # share one RU budget per resource group (debt-weighted refill
+    # shares), one compile-artifact registry (compile-once claims +
+    # peer warm-pool adoption + cross-process quarantine), and merged
+    # cost calibration.  Default OFF — a single process needs no
+    # coordination and stays byte-identical to the pre-pd behavior.
+    # pd_dir empty = in-process shared store (N Domains in one
+    # interpreter); set = file-backed store shared by real processes
+    # (advisory locks + atomic rename, one host).
+    _v("tidb_tpu_pd", 0, kind="bool", scope=SCOPE_GLOBAL),
+    _v("tidb_tpu_pd_dir", "", kind="str", scope=SCOPE_GLOBAL),
     # copmeter closed-loop cost calibration (analysis/calibrate):
     # measured per-digest launch times correct the static LaunchCost
     # terms feeding RU pricing, HBM-budget admission, fusion caps, the

@@ -137,6 +137,10 @@ class CopClient:
         # copgauge live HBM ledger + measured watermarks + roofline
         # (tidb_tpu_hbm_ledger): None = keep scheduler state
         self.hbm_ledger = None
+        # coplace coordination plane (tidb_tpu_pd): None = keep
+        # scheduler state (the per-Domain coordinator rides session/;
+        # this knob only arms the scheduler-side pd hooks)
+        self.pd_enable = None
         self._sched_obj = None
         # graceful degradation (faultline; tidb_tpu_sched_host_fallback):
         # a digest quarantined by the launch circuit breaker falls back
@@ -245,7 +249,8 @@ class CopClient:
             rc_enable=self.rc_enable,
             rc_overdraft=self.rc_overdraft,
             calibration=self.calibration,
-            hbm_ledger=self.hbm_ledger)
+            hbm_ledger=self.hbm_ledger,
+            pd_enable=self.pd_enable)
         return s
 
     def _client_stats(self) -> dict:
