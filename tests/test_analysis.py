@@ -552,13 +552,24 @@ def test_lint_pd_epoch():
     assert _rules(lock, "pd/coordinator.py") == []
     # scoped to pd/ only — the same call elsewhere is silent
     assert _rules(bad, "session/session.py") == []
-    # the pd modules are wired into the cross-layer lists
-    from tidb_tpu.analysis.lint import (LOCK_MODULES,
+    # the pd modules are wired into the cross-layer lists; the lock
+    # contract is auto-discovered now (ISSUE 17 retired LOCK_MODULES) —
+    # every pd module that imports threading is in it by construction
+    from tidb_tpu.analysis.lint import (LOCK_EXCLUDES,
                                         SPAN_MODULE_PREFIXES,
                                         TRACED_MODULES)
+    from tidb_tpu.analysis.concurrency import discover_threaded_modules
+    threaded, _excl, _rels = discover_threaded_modules()
     for rel in ("pd/store.py", "pd/lease.py", "pd/quota.py",
                 "pd/registry.py", "pd/coordinator.py"):
-        assert rel in LOCK_MODULES and rel in TRACED_MODULES
+        assert rel in TRACED_MODULES
+        assert rel not in LOCK_EXCLUDES
+    assert "pd/store.py" in threaded and "pd/coordinator.py" in threaded
+    # the six modules that had drifted out of the hand list are in
+    for rel in ("ddl/owner.py", "ddl/election.py", "ddl/mdl.py",
+                "planner/plan_cache.py", "stats/handle.py",
+                "session/catalog.py"):
+        assert rel in threaded, rel
     assert "pd/" in SPAN_MODULE_PREFIXES
 
 

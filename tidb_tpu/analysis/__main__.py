@@ -40,9 +40,24 @@ Runs three static passes and exits non-zero on any NEW finding:
    epoch-fencing rule, and a live in-memory store must refuse writes
    from a released (dead) lease epoch — guards the schema the same
    way the pricing pass guards the static weights.
+8. Whole-program concurrency model (analysis/concurrency, copsan):
+   every module importing threading is auto-discovered (no hand
+   list), its lock allocation sites become named nodes, with/acquire
+   nesting becomes a global acquisition graph, and per-class guard
+   inference checks every shared-attribute write's lockset.
+   RACE-UNGUARDED-WRITE / RACE-GUARD-MIX / LOCK-ORDER-CYCLE /
+   LOCK-BLOCKING-HELD / LOCK-CV-PREDICATE findings baseline like
+   every other family; utils/locksan validates the same edge set at
+   runtime (sysvar tidb_tpu_lock_sanitizer).
 
 Flags:
     --lint-only / --contracts-only   run one pass
+    --concurrency-only               run just the copsan concurrency
+                                     pass (RACE-/LOCK- families)
+    --race-report                    print the per-module concurrency
+                                     model table (locks, acquisition
+                                     edges, thread roots, findings)
+                                     and exit
     --update-baseline                rewrite baseline.txt from the
                                      current lint+cost findings
                                      (reviewed use only)
@@ -100,15 +115,21 @@ def _corpus_plans() -> list:
     return list(built_tpch_plans(tpch_plan_session()))
 
 
-def _gather_findings(lint_only: bool, contracts_only: bool):
+def _gather_findings(lint_only: bool, contracts_only: bool,
+                     concurrency_only: bool = False):
     """(findings, plans): the baseline-diffable findings of the selected
     passes plus the corpus plans (reused by the contracts pass so the
     corpus is planned once per gate run)."""
     findings: list = []
     plans = None
+    if concurrency_only:
+        from .concurrency import concurrency_findings
+        return list(concurrency_findings()), None
     if not contracts_only:
+        from .concurrency import concurrency_findings
         from .lint import lint_tree
         findings += lint_tree()
+        findings += concurrency_findings()
     if not lint_only:
         from .copcost import cost_findings
         from .lifetime import donation_findings
@@ -133,7 +154,8 @@ def _write_baseline(findings) -> int:
 
 
 def _stale_keys(findings, baseline, lint_only: bool,
-                contracts_only: bool) -> set:
+                contracts_only: bool,
+                concurrency_only: bool = False) -> set:
     """Baseline entries no current finding matches.  Partial runs only
     judge the rule families they actually computed, so --lint-only
     cannot misreport COST-* waivers as rotten (and vice versa)."""
@@ -141,8 +163,12 @@ def _stale_keys(findings, baseline, lint_only: bool,
     stale = set()
     for k in baseline - current:
         # corpus-walk rule families (computed only on full/cost runs);
-        # SHARD- joined with the shardflow pass (ISSUE 12)
+        # SHARD- joined with the shardflow pass (ISSUE 12), RACE-/LOCK-
+        # with the copsan concurrency pass (ISSUE 17, lint-side runs)
         is_cost = k.startswith(("COST-", "DONATE-", "SHARD-"))
+        is_conc = k.startswith(("RACE-", "LOCK-"))
+        if concurrency_only and not is_conc:
+            continue
         if lint_only and is_cost:
             continue
         if contracts_only and not is_cost:
@@ -262,6 +288,23 @@ def _run_shardflow(plans) -> int:
     return 1 if bad else 0
 
 
+def _run_concurrency(findings, baseline) -> int:
+    """Whole-program concurrency verdict (copsan, ISSUE 17): the model
+    must cover every threading-importing module with zero unbaselined
+    RACE-/LOCK- findings.  The findings already rode _run_findings;
+    this line is the per-pass verdict the gate tests pin."""
+    from .concurrency import CONCURRENCY_RULES, cached_model
+    s = cached_model().summary()
+    fresh = [f for f in findings
+             if f.rule in CONCURRENCY_RULES and f.key() not in baseline]
+    print(f"concurrency: {s['modules']} threading modules "
+          f"auto-discovered ({s['excluded']} excluded), "
+          f"{s['locks']} locks, {s['edges']} acquisition edges, "
+          f"{s['roots']} thread roots, {s['findings']} findings, "
+          f"{len(fresh)} violations")
+    return 1 if fresh else 0
+
+
 def _run_pd() -> int:
     """Coordination-plane schema gate (coplace, ISSUE 16): every shared
     key family carries owner + TTL + epoch rule, and the in-memory
@@ -312,8 +355,13 @@ def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     lint_only = "--lint-only" in argv
     contracts_only = "--contracts-only" in argv
+    concurrency_only = "--concurrency-only" in argv
     update = "--update-baseline" in argv
     check_baseline = "--check-baseline" in argv
+    if "--race-report" in argv:
+        from .concurrency import race_report
+        print(race_report())
+        return 0
     if "--cost-report" in argv:
         from .copcost import cost_report
         print(cost_report(_corpus_plans(), n_devices=GATE_DEVICES))
@@ -342,14 +390,16 @@ def main(argv=None) -> int:
     if check_baseline:
         # hygiene pass: waivers must not rot silently — every baseline
         # entry must still match a current finding (full gather, so the
-        # verdict covers both rule families)
-        lint_only = contracts_only = False
-    findings, plans = _gather_findings(lint_only, contracts_only)
+        # verdict covers every rule family, RACE-/LOCK- included)
+        lint_only = contracts_only = concurrency_only = False
+    findings, plans = _gather_findings(lint_only, contracts_only,
+                                       concurrency_only)
     if update:
         return _write_baseline(findings)
     from .lint import load_baseline
     baseline = load_baseline(_baseline_path())
-    stale = _stale_keys(findings, baseline, lint_only, contracts_only)
+    stale = _stale_keys(findings, baseline, lint_only, contracts_only,
+                        concurrency_only)
     if check_baseline:
         for k in sorted(stale):
             print(f"STALE {k}")
@@ -358,7 +408,9 @@ def main(argv=None) -> int:
               "current finding")
         return 1 if stale else 0
     rc = _run_findings(findings, baseline, stale)
-    if not lint_only:
+    if not contracts_only:
+        rc |= _run_concurrency(findings, baseline)
+    if not lint_only and not concurrency_only:
         rc |= _run_contracts(plans)
         rc |= _run_pricing(plans)
         rc |= _run_calibration(plans)

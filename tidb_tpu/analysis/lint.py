@@ -165,49 +165,37 @@ HOT_PATH_MODULES = TRACED_MODULES | {
     "sched/scheduler.py", "sched/task.py",
 }
 
-# modules participating in the cross-layer lock-order contract.  The
-# rc/ control plane is included (ISSUE 5 satellite): its group-map
-# lock, per-group bucket leaf locks, and the runaway ring all run under
-# the drain's condition lock, so a nested/inverted acquisition there is
-# a real deadlock against the scheduler.
-LOCK_MODULES = {
-    "sched/scheduler.py", "utils/poolmgr.py", "utils/rwlock.py",
-    "store/client.py", "rc/bucket.py", "rc/controller.py",
-    "rc/runaway.py", "utils/resourcegroup.py",
-    # SEGMENT/SCATTER-strategy kernels (ISSUE 6/11): lock-free today,
-    # listed so any future lock grown there joins the cross-layer order
-    # contract
-    "copr/segment.py", "copr/radix.py",
-    # faultline (ISSUE 8): the breaker/plan leaf locks run under the
-    # drain's condition lock and the submit path, so nested/inverted
-    # acquisition there would deadlock against the scheduler
-    "faults/breaker.py", "faults/plan.py",
-    # copforge (ISSUE 9): the cache/manifest leaf locks run under the
-    # drain (resolve at launch) and the submit path (fusion prediction)
-    "compilecache/cache.py", "compilecache/manifest.py",
-    # copmeter (ISSUE 10): the correction store / BoundedLRU leaf locks
-    # run under the drain's condition lock (window + attribution) and
-    # the submit path (corrected admission, shedding)
-    "analysis/calibrate.py",
-    # shardflow (ISSUE 12): the topology host-view lock and any lock
-    # grown by the flow interpreter run under submit (verify_task) and
-    # the session plan path, so they join the cross-layer contract
-    "parallel/topology.py", "analysis/shardflow.py",
-    # copscope (ISSUE 13): the span-tree and flight-recorder leaf locks
-    # are taken from the drain thread (span recording) and every
-    # statement thread (render/record), so they join the contract
-    "obs/trace.py", "obs/recorder.py",
-    # copgauge (ISSUE 14): the ledger/roofline leaf locks run under the
-    # drain loop (launch begin/finish, measured feed), weakref death
-    # callbacks, and the status routes, so they join the contract
-    "obs/hbm.py", "obs/roofline.py",
-    # coplace (ISSUE 16): the store backend leaf lock and the
-    # coordinator's tick mutex are taken from every statement thread
-    # (the tick) while rc bucket / manifest / correction-store locks
-    # are held by the same call chains, so they join the contract
-    "pd/store.py", "pd/lease.py", "pd/quota.py", "pd/registry.py",
-    "pd/coordinator.py",
+# copsan (ISSUE 17): the cross-layer lock-order contract is no longer
+# a hand-curated module list — ANY module importing threading joins it
+# automatically (module_imports_threading below; the whole-program
+# model in analysis/concurrency.py consumes the same predicate).  The
+# only opt-out is an explicit, justified entry here.
+LOCK_EXCLUDES: dict = {
+    # Add `"rel/path.py": "reason"` only when a module's thread model
+    # is genuinely out of scope for the AST analysis, and say why.
+    "utils/locksan.py": (
+        "the sanitizer itself: it aliases the real threading factories "
+        "(_REAL_LOCK = threading.Lock) and monkeypatches threading, so "
+        "the AST model cannot see its _mu as a lock; its telemetry "
+        "counters are deliberately approximate to keep per-acquire "
+        "overhead inside the 5% budget"
+    ),
 }
+
+
+def module_imports_threading(tree) -> bool:
+    """True when the module imports threading (any form) — the auto-
+    discovery predicate that retired the hand-maintained LOCK_MODULES
+    set.  Importing threading IS joining the concurrency contract."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            if any(a.name == "threading" or
+                   a.name.startswith("threading.") for a in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            if (node.module or "").split(".")[0] == "threading":
+                return True
+    return False
 
 # modules whose retry/re-dispatch loops must spend a typed Backoffer
 # budget (TPU-RETRY-BUDGET): the device dispatch + scheduler layers
@@ -1100,7 +1088,7 @@ def lint_source(src: str, rel: str) -> list:
         sl = _SpanLeakRules(rel, lines)
         sl.visit(tree)
         findings += sl.findings
-    if rel in LOCK_MODULES:
+    if rel not in LOCK_EXCLUDES and module_imports_threading(tree):
         findings += _LockRules(rel, lines, tree).run()
     # collapse repeats on one line (e.g. three id() calls in one tuple)
     seen, out = set(), []
@@ -1159,6 +1147,7 @@ def new_findings(findings: list, baseline: set) -> list:
 
 __all__ = ["Finding", "lint_source", "lint_tree", "load_baseline",
            "new_findings", "TRACED_MODULES", "HOT_PATH_MODULES",
-           "LOCK_MODULES", "RETRY_MODULE_PREFIXES",
+           "LOCK_EXCLUDES", "module_imports_threading",
+           "RETRY_MODULE_PREFIXES",
            "COMPILECACHE_PREFIX", "PALLAS_PREFIX", "PD_PREFIX",
            "SPAN_MODULE_PREFIXES", "MEM_SOURCE_MODULES"]

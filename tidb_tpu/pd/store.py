@@ -190,7 +190,9 @@ class FileBackend:
                     with open(tmp, "w", encoding="utf-8") as f:
                         json.dump(state, f)
                     os.replace(tmp, self._path)
-                self.transactions += 1
+                # flock excludes same-process threads too (each call
+                # opens its own fd), so this += never runs concurrently
+                self.transactions += 1  # planlint: ok - flock-serialized
                 return out
         except OSError as e:
             raise PdUnavailable(f"pd store I/O: {e}") from e

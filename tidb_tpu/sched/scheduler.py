@@ -1104,19 +1104,26 @@ class DeviceScheduler:
         mesh = task.mesh
 
         def warm():
+            ok = False
             try:
                 from ..parallel.spmd import get_fused_program
                 fused = D.FusedDag(tuple(members))
                 prog = get_fused_program(fused, mesh)
                 prog._cached.warm(lead_sds)
-                self.warm_predicted += 1
+                ok = True
             except Exception:   # noqa: BLE001 - prediction is a pure
                 # optimization: an unfusable combo or a backend refusal
                 # just means the real arrival compiles as before
-                self.warm_failures += 1
+                pass
             finally:
+                # counters under _mu: up to two warm threads run
+                # concurrently, so a bare += here loses updates
                 with self._mu:
                     self._warm_alive -= 1
+                    if ok:
+                        self.warm_predicted += 1
+                    else:
+                        self.warm_failures += 1
 
         threading.Thread(target=warm, name="copforge-predict",
                          daemon=True).start()

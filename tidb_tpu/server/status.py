@@ -214,6 +214,17 @@ class StatusServer:
                 "roofline": roofline_status(),
                 "profiler": profiler_gate().stats(),
             }), "application/json"
+        if path == "/locksan":
+            # copsan (utils/locksan): runtime lock-sanitizer state —
+            # armed flag, instrumented-lock/acquisition counters,
+            # observed acquisition edges vs the static graph, and any
+            # novel-edge/cycle reports (each one is a model drift or a
+            # live lock-order inversion)
+            from ..utils import locksan
+            return json.dumps({
+                **locksan.stats(),
+                "reports": locksan.reports(),
+            }), "application/json"
         if path == "/profile":
             # on-demand jax.profiler capture (?ms=N): gated by the
             # tidb_tpu_profile sysvar, refused while one is active —

@@ -106,6 +106,12 @@ class Domain:
         self._ddl = None
         import threading
         self._ddl_mu = threading.Lock()
+        # leaf lock for the domain's id allocators (table ids, conn
+        # ids): CREATE TABLE and new connections arrive on concurrent
+        # statement threads, and a bare += there loses allocations
+        self._id_mu = threading.Lock()
+        self._sessions = None           # WeakValueDictionary, lazy
+        self._next_conn_id = 0
         from ..utils.stmtsummary import StmtSummary
         self.stmt_summary = StmtSummary()   # util/stmtsummary analog
         # copscope flight recorder (obs/): bounded ring of completed
@@ -270,8 +276,9 @@ class Domain:
             self._ddl.close()
 
     def alloc_table_id(self) -> int:
-        self._next_table_id += 1
-        return self._next_table_id
+        with self._id_mu:
+            self._next_table_id += 1
+            return self._next_table_id
 
     def query_metrics(self):
         """Cached (counter, histogram) pair for the statement hot path."""
@@ -290,17 +297,18 @@ class Domain:
         """Connection registry for SHOW PROCESSLIST (server's
         SessionManager analog)."""
         import weakref
-        if not hasattr(self, "_sessions"):
-            self._sessions = weakref.WeakValueDictionary()
-            self._next_conn_id = 0
-        self._next_conn_id += 1
-        self._sessions[self._next_conn_id] = sess
-        return self._next_conn_id
+        with self._id_mu:
+            if self._sessions is None:
+                self._sessions = weakref.WeakValueDictionary()
+            self._next_conn_id += 1
+            self._sessions[self._next_conn_id] = sess
+            return self._next_conn_id
 
     def sessions(self):
-        if not hasattr(self, "_sessions"):
-            return []
-        return sorted(self._sessions.items())
+        with self._id_mu:
+            if self._sessions is None:
+                return []
+            return sorted(self._sessions.items())
 
 
 class Session:
@@ -1241,6 +1249,16 @@ class Session:
         v17 = merged.get("tidb_tpu_hbm_ledger")
         if v17 is not None and v17 != "":
             client.hbm_ledger = bool(int(v17))
+        # copsan runtime lock sanitizer (utils/locksan): arming only
+        # instruments locks allocated after the flip, so operators set
+        # it before the domain's threaded machinery is built
+        v20 = merged.get("tidb_tpu_lock_sanitizer")
+        if v20 is not None and v20 != "":
+            from ..utils import locksan
+            if bool(int(v20)):
+                locksan.arm()
+            else:
+                locksan.disarm()
         # shardflow topology view (parallel/topology): declared host
         # factorization for per-link transfer classification; -1/unset
         # derives from device process indices

@@ -142,6 +142,12 @@ _VARS = [
     # default — a trace capture writes xplane dirs to disk and costs
     # real overhead, so an operator must opt in
     _v("tidb_tpu_profile", 0, kind="bool", scope=SCOPE_GLOBAL),
+    # copsan runtime lock sanitizer (utils/locksan): instrumented lock
+    # wrappers verify every observed acquisition edge against the
+    # static concurrency model (analysis/concurrency).  Off by default
+    # — arming only affects locks allocated AFTER it, so flip it
+    # before building the domain (the stress smoke and bench do).
+    _v("tidb_tpu_lock_sanitizer", 0, kind="bool", scope=SCOPE_GLOBAL),
     # slow-query log threshold (ms), session -> Domain plumb — replaces
     # the constructor-only threshold in utils/stmtsummary; slow entries
     # carry schedWait/compile/ru/retried/trace-id fields

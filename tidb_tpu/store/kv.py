@@ -143,6 +143,10 @@ class KVStore:
         self._lib = _load_lib()
         self.path = path
         self._ts_samples: list = []    # (wallclock, ts) for stale reads
+        # leaf lock for the sample index: alloc_ts runs on every
+        # statement thread, and the thinning pass is a read-modify-write
+        # that would drop concurrent appends without it
+        self._ts_mu = threading.Lock()
         # close() runs these FIRST (watch pollers etc. join their
         # threads) so no background caller holds the native handle when
         # it frees — a poller racing kv_close segfaulted in the C lib
@@ -191,12 +195,13 @@ class KVStore:
         import time as _time
         self._require_open()
         ts = int(self._lib.kv_alloc_ts(self._h))
-        self._ts_samples.append((_time.time(), ts))
-        if len(self._ts_samples) > 200_000:
-            # keep recency exact, thin the old half (staleness windows
-            # that far back only need coarse resolution)
-            old = self._ts_samples[:100_000:2]
-            self._ts_samples = old + self._ts_samples[100_000:]
+        with self._ts_mu:
+            self._ts_samples.append((_time.time(), ts))
+            if len(self._ts_samples) > 200_000:
+                # keep recency exact, thin the old half (staleness
+                # windows that far back only need coarse resolution)
+                old = self._ts_samples[:100_000:2]
+                self._ts_samples = old + self._ts_samples[100_000:]
         return ts
 
     def ts_at_time(self, epoch_seconds: float) -> int:
@@ -207,11 +212,13 @@ class KVStore:
         store, datetime staleness spans only the current process's
         lifetime (raw integer ts literals always work)."""
         import bisect
-        i = bisect.bisect_right(self._ts_samples,
-                                (epoch_seconds, float("inf")))
-        if i == 0:
-            raise KVError(0, "requested staleness predates the store")
-        return self._ts_samples[i - 1][1]
+        with self._ts_mu:
+            i = bisect.bisect_right(self._ts_samples,
+                                    (epoch_seconds, float("inf")))
+            if i == 0:
+                raise KVError(0,
+                              "requested staleness predates the store")
+            return self._ts_samples[i - 1][1]
 
     def begin(self, pessimistic: bool = False) -> "Txn":
         return Txn(self, self.alloc_ts(), pessimistic=pessimistic)

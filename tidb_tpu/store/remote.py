@@ -195,7 +195,8 @@ class RemoteCopClient:
                 snap, lambda ent, rc: self._agg_remote(agg, snap, ent,
                                                        key_meta, rc))
         except _Unsupported:
-            self.local_fallbacks += 1
+            with self._mu:
+                self.local_fallbacks += 1
             return self.inner.execute_agg(agg, snap, key_meta, aux_cols)
 
     def execute_rows(self, root: D.CopNode, snap, out_dtypes,
@@ -209,7 +210,8 @@ class RemoteCopClient:
                                                         out_dtypes,
                                                         dictionaries, rc))
         except _Unsupported:
-            self.local_fallbacks += 1
+            with self._mu:
+                self.local_fallbacks += 1
             return self.inner.execute_rows(root, snap, out_dtypes,
                                            dictionaries, aux_cols)
 
@@ -278,7 +280,8 @@ class RemoteCopClient:
             if round_cache is not None:
                 round_cache[key] = resp[1]
             return resp[1]
-        self.remote_dispatches += 1
+        with self._mu:
+            self.remote_dispatches += 1
         items = sorted(by_store.items())
         if len(items) == 1:
             return [one(*items[0])]

@@ -1,0 +1,346 @@
+"""locksan: runtime lock sanitizer (copsan's live half, ISSUE 17).
+
+The static model (analysis/concurrency) predicts every acquisition
+edge the program can take; this module checks the prediction against
+reality.  While armed, ``threading.Lock/RLock/Condition`` allocations
+from tidb_tpu code return instrumented wrappers that record per-thread
+acquisition stacks.  On every acquire of B with A held, the edge A→B
+is checked against the static graph: a novel edge between mapped nodes
+means the model's seam tables have drifted (or a thread is taking
+locks the analysis never predicted — the exact precondition of an
+unseen deadlock); a cycle in the *observed* graph is an actual
+lock-order inversion caught live.
+
+Wiring: sysvar ``tidb_tpu_lock_sanitizer`` (global, default off) arms
+it; the 32-session stress smoke and the bench ``stress`` rung run with
+it armed and assert zero reports at ≤5% overhead.  Locks allocated
+while disarmed are real primitives — arming only affects allocations
+made after it (build the domain AFTER arm()), so production code pays
+nothing when off.
+
+Allocation sites are mapped to static node names by caller frame
+(file, line); sites the model does not know (locals, test scaffolding)
+still get instrumented stacks but are exempt from novel-edge reports —
+they count in ``stats()['unmapped']`` instead.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+__all__ = ["LockSanitizer", "arm", "disarm", "sanitizer", "reports",
+           "stats"]
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+_REAL_CONDITION = threading.Condition
+
+
+class _SanLock:
+    """Instrumented lock: forwards to the real primitive, records the
+    per-thread holder stack, and checks each new edge against the
+    static graph.  Recursion on an RLock records the first acquire
+    only, so re-entry never fabricates self-edges."""
+
+    __slots__ = ("_real", "node", "san", "_reentrant")
+
+    def __init__(self, real, node: str, san: "LockSanitizer",
+                 reentrant: bool):
+        self._real = real
+        self.node = node
+        self.san = san
+        self._reentrant = reentrant
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._real.acquire(blocking, timeout)
+        if got:
+            self.san._on_acquire(self)
+        return got
+
+    def release(self):
+        self.san._on_release(self)
+        self._real.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._real.locked()
+
+    # Condition support: a real Condition wrapping a _SanLock calls
+    # these.  _release_save drops the whole holder record (wait sleeps
+    # without the lock); _acquire_restore re-records, re-checking edges
+    # (the re-acquire edges exist statically — the with-statement that
+    # holds the cv produced them).
+    def _release_save(self):
+        self.san._on_release(self, all_depths=True)
+        if hasattr(self._real, "_release_save"):
+            return self._real._release_save()
+        self._real.release()
+        return None
+
+    def _acquire_restore(self, state):
+        if hasattr(self._real, "_acquire_restore"):
+            self._real._acquire_restore(state)
+        else:
+            self._real.acquire()
+        self.san._on_acquire(self)
+
+    def _is_owned(self):
+        if hasattr(self._real, "_is_owned"):
+            return self._real._is_owned()
+        return self.san._held_depth(self) > 0
+
+    def __repr__(self):
+        return f"<SanLock {self.node} wrapping {self._real!r}>"
+
+
+class LockSanitizer:
+    def __init__(self, static_edges: Optional[Set[Tuple[str, str]]] = None,
+                 alloc_index: Optional[Dict[Tuple[str, int], str]] = None):
+        self._tls = threading.local()
+        self._mu = _REAL_LOCK()           # guards the shared maps below
+        self.static_edges: Set[Tuple[str, str]] = set(static_edges or ())
+        self.alloc_index: Dict[Tuple[str, int], str] = \
+            dict(alloc_index or {})
+        self.static_nodes: Set[str] = \
+            {n for e in self.static_edges for n in e} | \
+            set(self.alloc_index.values())
+        self.observed: Set[Tuple[str, str]] = set()
+        self._adj: Dict[str, Set[str]] = {}
+        self._reports: List[dict] = []
+        self._reported: Set[Tuple[str, str, str]] = set()
+        self.armed = False
+        self.n_locks = 0
+        self.n_acquires = 0
+        self.n_unmapped = 0
+
+    # ------------------------------------------------------------- #
+    # factory patching
+    # ------------------------------------------------------------- #
+    def _alloc_node(self) -> Optional[str]:
+        """Map the allocation site (caller of the patched factory) to a
+        static node name; None for non-tidb_tpu allocations."""
+        frame = sys._getframe(2)
+        fname = frame.f_code.co_filename
+        try:
+            rel = os.path.relpath(fname, _PKG_ROOT)
+        except ValueError:
+            return None
+        if rel.startswith(".."):
+            return None
+        rel = rel.replace(os.sep, "/")
+        node = self.alloc_index.get((rel, frame.f_lineno))
+        if node is None:
+            node = f"{rel}:{frame.f_lineno}"   # unmapped: exempt
+        return node
+
+    def _make_lock(self):
+        node = self._alloc_node()
+        if node is None or not self.armed:
+            return _REAL_LOCK()
+        self.n_locks += 1
+        return _SanLock(_REAL_LOCK(), node, self, False)
+
+    def _make_rlock(self):
+        node = self._alloc_node()
+        if node is None or not self.armed:
+            return _REAL_RLOCK()
+        self.n_locks += 1
+        return _SanLock(_REAL_RLOCK(), node, self, True)
+
+    def _make_condition(self, lock=None):
+        node = self._alloc_node()
+        if node is None or not self.armed:
+            return _REAL_CONDITION(lock)
+        if lock is None:
+            # bare Condition() wraps an RLock; give the wrapper this
+            # allocation site's node so waits/notifies are attributed
+            self.n_locks += 1
+            lock = _SanLock(_REAL_RLOCK(), node, self, True)
+        return _REAL_CONDITION(lock)
+
+    def arm(self) -> None:
+        with self._mu:
+            if self.armed:
+                return
+            self.armed = True
+        threading.Lock = self._make_lock
+        threading.RLock = self._make_rlock
+        threading.Condition = _PatchedCondition(self)
+
+    def disarm(self) -> None:
+        with self._mu:
+            self.armed = False
+        threading.Lock = _REAL_LOCK
+        threading.RLock = _REAL_RLOCK
+        threading.Condition = _REAL_CONDITION
+
+    # ------------------------------------------------------------- #
+    # holder stacks + edge checking
+    # ------------------------------------------------------------- #
+    def _stack(self) -> List[_SanLock]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def _held_depth(self, lk: _SanLock) -> int:
+        return sum(1 for h in self._stack() if h is lk)
+
+    def _on_acquire(self, lk: _SanLock) -> None:
+        st = self._stack()
+        if not self.armed:
+            st.append(lk)
+            return
+        self.n_acquires += 1
+        if lk._reentrant and any(h is lk for h in st):
+            st.append(lk)   # recursion: no new edge
+            return
+        held_nodes = []
+        seen = set()
+        for h in st:
+            if h.node not in seen and h is not lk:
+                seen.add(h.node)
+                held_nodes.append(h.node)
+        st.append(lk)
+        if not held_nodes:
+            return
+        with self._mu:
+            for hn in held_nodes:
+                if hn == lk.node:
+                    continue   # two instances sharing an alloc site
+                edge = (hn, lk.node)
+                if edge in self.observed:
+                    continue
+                self.observed.add(edge)
+                self._adj.setdefault(hn, set()).add(lk.node)
+                mapped = hn in self.static_nodes and \
+                    lk.node in self.static_nodes
+                if not mapped:
+                    self.n_unmapped += 1
+                elif edge not in self.static_edges:
+                    self._report("novel-edge", hn, lk.node)
+                # a cycle in the observed graph is a live inversion
+                # regardless of mapping
+                if self._reaches(lk.node, hn):
+                    self._report("cycle", hn, lk.node)
+
+    def _on_release(self, lk: _SanLock, all_depths: bool = False) -> None:
+        st = self._stack()
+        for i in range(len(st) - 1, -1, -1):
+            if st[i] is lk:
+                del st[i]
+                if not all_depths:
+                    return
+
+    def _reaches(self, src: str, dst: str) -> bool:
+        """Observed-graph reachability src→dst (caller holds _mu)."""
+        seen = {src}
+        work = [src]
+        while work:
+            n = work.pop()
+            for m in self._adj.get(n, ()):
+                if m == dst:
+                    return True
+                if m not in seen:
+                    seen.add(m)
+                    work.append(m)
+        return False
+
+    def _report(self, kind: str, src: str, dst: str) -> None:
+        key = (kind, src, dst)
+        if key in self._reported:
+            return
+        self._reported.add(key)
+        self._reports.append({
+            "kind": kind, "src": src, "dst": dst,
+            "thread": threading.current_thread().name,
+        })
+
+    # ------------------------------------------------------------- #
+    # results
+    # ------------------------------------------------------------- #
+    def reports(self) -> List[dict]:
+        with self._mu:
+            return list(self._reports)
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {
+                "armed": self.armed,
+                "locks_instrumented": self.n_locks,
+                "acquisitions": self.n_acquires,
+                "edges_observed": len(self.observed),
+                "unmapped_edges": self.n_unmapped,
+                "reports": len(self._reports),
+            }
+
+
+class _PatchedCondition:
+    """Callable standing in for threading.Condition while armed; also
+    passes isinstance checks via __instancecheck__-free duck typing
+    (nothing in-tree isinstance-checks Condition)."""
+
+    def __init__(self, san: LockSanitizer):
+        self._san = san
+
+    def __call__(self, lock=None):
+        return self._san._make_condition(lock)
+
+
+_SAN: Optional[LockSanitizer] = None
+_SAN_MU = _REAL_LOCK()
+
+
+def sanitizer() -> Optional[LockSanitizer]:
+    return _SAN
+
+
+def arm(static_edges: Optional[Set[Tuple[str, str]]] = None,
+        alloc_index: Optional[Dict[Tuple[str, int], str]] = None,
+        ) -> LockSanitizer:
+    """Arm the global sanitizer.  With no arguments the static graph is
+    built from the whole-program model (analysis/concurrency); tests
+    pass explicit edge sets to seed violations."""
+    global _SAN
+    with _SAN_MU:
+        if _SAN is not None and _SAN.armed:
+            return _SAN
+        if static_edges is None or alloc_index is None:
+            from ..analysis.concurrency import cached_model
+            model = cached_model()
+            if static_edges is None:
+                static_edges = set(model.edges)
+            if alloc_index is None:
+                alloc_index = dict(model.alloc_index)
+        _SAN = LockSanitizer(static_edges, alloc_index)
+        _SAN.arm()
+        return _SAN
+
+
+def disarm() -> Optional[LockSanitizer]:
+    """Disarm and restore the real threading factories.  Locks already
+    instrumented keep working (their wrappers just stop judging)."""
+    with _SAN_MU:
+        if _SAN is not None:
+            _SAN.disarm()
+        return _SAN
+
+
+def reports() -> List[dict]:
+    return _SAN.reports() if _SAN is not None else []
+
+
+def stats() -> dict:
+    return _SAN.stats() if _SAN is not None else {"armed": False}
