@@ -1339,6 +1339,8 @@ def _bench_one_sf(sf, platform, n_chips, iters, mem_bw):
                     ("rollup", lambda: _rung_rollup(
                         client, cols, ix, n_shards, iters,
                         dense=(platform == "tpu"))),
+                    ("narrowagg", lambda: _rung_narrowagg(
+                        client, cols, ix, n_shards, iters)),
                     ("hndv", lambda: _rung_hndv(client, cols, ix, sf,
                                                 n_shards, iters))):
         # (the former sf>=10 hndv cap_stream special-case is gone: the
@@ -1421,6 +1423,51 @@ def _rung_rollup(client, cols, ix, n_shards, iters, dense=False):
         f"ratio {bru/ru_t:.2f}x")
     return {"rollup_ms": round(ru_t * 1e3, 1),
             "rollup_vs_numpy": round(bru / ru_t, 2)}
+
+
+def _rung_narrowagg(client, cols, ix, n_shards, iters):
+    """Proven-narrow SUM rung (ISSUE 19): the same scalar decimal SUM
+    executed with the single-word int64 state vs the (hi, lo) limb
+    pair.  Results must be bit-identical (two's complement exactness);
+    the record carries both wall times and the per-state widths copcost
+    prices the fusion classes with."""
+    import dataclasses
+
+    from tidb_tpu import copr
+    from tidb_tpu.analysis.copcost import _agg_state_width
+    from tidb_tpu.copr import dag as D
+    from tidb_tpu.expr import ColumnRef
+    from tidb_tpu.store import snapshot_from_columns
+    from tidb_tpu.types import dtypes as dt
+
+    qcol = cols[ix["l_quantity"]]
+    snapq = snapshot_from_columns(["l_quantity"], [qcol],
+                                  n_shards=n_shards)
+    ref = ColumnRef(qcol.dtype, 0, "l_quantity")
+    limb = D.Aggregation(
+        D.TableScan((0,), (qcol.dtype,)), (),
+        (D.AggDesc(D.AggFunc.SUM, ref, copr.sum_out_dtype(qcol.dtype)),
+         D.AggDesc(D.AggFunc.COUNT, None, dt.bigint(False))),
+        D.GroupStrategy.SCALAR)
+    narrow = dataclasses.replace(limb, narrow_sums=(0,))
+
+    res_l = client.execute_agg(limb, snapq, [])
+    res_n = client.execute_agg(narrow, snapq, [])
+    sums = (res_l.columns[0].to_python()[0], res_n.columns[0].to_python()[0])
+    assert sums[0] == sums[1], f"narrow SUM diverged: {sums}"
+    assert int(res_l.columns[1].data[0]) == int(res_n.columns[1].data[0])
+
+    it = max(iters // 2, 2)
+    t_l = _median_times(lambda: client.execute_agg(limb, snapq, []), it)
+    t_n = _median_times(lambda: client.execute_agg(narrow, snapq, []), it)
+    wl = _agg_state_width(limb.aggs[0], narrow=False)
+    wn = _agg_state_width(limb.aggs[0], narrow=True)
+    log(f"NARROWAGG: narrow {t_n*1e3:.1f} ms ({wn} B/state)  limb "
+        f"{t_l*1e3:.1f} ms ({wl} B/state)  bit-identical sum={sums[0]}")
+    return {"narrowagg_narrow_ms": round(t_n * 1e3, 3),
+            "narrowagg_limb_ms": round(t_l * 1e3, 3),
+            "narrowagg_state_bytes": {"narrow": wn, "limb": wl},
+            "narrowagg_identical": True}
 
 
 HNDV_SWEEP = (20_000, 200_000, 2_000_000)

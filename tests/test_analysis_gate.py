@@ -170,3 +170,39 @@ def test_pd_report_prints_schema_table():
         assert prefix in proc.stdout, proc.stdout
     assert "epoch" in proc.stdout and "ttl" in proc.stdout, proc.stdout
     assert "0 violations" in proc.stdout, proc.stdout
+
+
+def test_gate_valueflow_pass_proves_corpus_and_narrow_states():
+    """ISSUE 19 acceptance: the value-range pass flows the full corpus
+    plus the MULTICHIP shapes clean (0 NUM-* findings) and proves at
+    least one corpus SUM narrow."""
+    proc = _run_gate()
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "values:" in proc.stdout, proc.stdout
+    line = next(ln for ln in proc.stdout.splitlines()
+                if ln.startswith("values:"))
+    assert "plans proven" in line and "0 findings" in line, line
+    import re
+    m = re.search(r"(\d+) narrow states", line)
+    assert m is not None and int(m.group(1)) >= 1, line
+
+
+def test_value_only_flag():
+    proc = _run_gate("--value-only")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "values:" in proc.stdout, proc.stdout
+    assert "analysis gate: ok" in proc.stdout, proc.stdout
+    # other corpus passes are skipped in value-only mode
+    assert "rc pricing:" not in proc.stdout, proc.stdout
+    assert "calibration:" not in proc.stdout, proc.stdout
+
+
+def test_value_report_prints_per_query_table():
+    """ISSUE 19 satellite: ``--value-report`` prints the per-query
+    interval-flow table — ops flowed, narrow states, verdict."""
+    proc = _run_gate("--value-report")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "value-range flow" in proc.stdout, proc.stdout
+    assert "narrow" in proc.stdout and "proven" in proc.stdout, \
+        proc.stdout
+    assert "q00" in proc.stdout and "q19" in proc.stdout, proc.stdout

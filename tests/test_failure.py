@@ -261,9 +261,13 @@ def test_breaker_abort_probe_releases_slot():
 # faultline end-to-end: supervised launches on the CPU mesh
 # ------------------------------------------------------------------ #
 
+# the cubed p keeps the SUM's proven bound past the copnum narrow
+# ceiling, so it stays in the limb fusion class and the 3-member group
+# fuses as ONE launch (the narrow-class split is covered in
+# test_sched_fusion / test_valueflow)
 FLT_QUERIES = [
     "select count(*) from flt where d >= 5",
-    "select sum(p * d) from flt where q < 24",
+    "select sum(p * p * p * d) from flt where q < 24",
     "select min(p) from flt where q > 10",
 ]
 

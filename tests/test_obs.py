@@ -228,9 +228,13 @@ def test_histogram_labels_and_prometheus_text():
 # end-to-end: cross-thread stitching on the device path
 # ------------------------------------------------------------------ #
 
+# the cubed p keeps the SUM's proven bound past the copnum narrow
+# ceiling, so it stays in the limb fusion class and the 3-member group
+# fuses as ONE launch (the narrow-class split is covered in
+# test_sched_fusion / test_valueflow)
 OBS_QUERIES = [
     "select count(*) from obs_t where d >= 5",
-    "select sum(p * d) from obs_t where q < 24",
+    "select sum(p * p * p * d) from obs_t where q < 24",
     "select min(p) from obs_t where q > 10",
 ]
 
@@ -284,7 +288,7 @@ def test_cross_thread_stitching_single_statement(odom):
     dom, s, sched = odom
     s2 = Session(dom)
     s2.must_query(OBS_QUERIES[1])
-    tree = _trace_of(dom, "sum(p * d)")
+    tree = _trace_of(dom, "sum(p * p * p * d)")
     assert tree is not None
     by_name = {}
     for sp, _d in tree.ordered():
@@ -343,7 +347,7 @@ def test_trace_fused_retried_compile_missed_statement(odom):
     assert not errors, errors
     assert sched.fused_launches > f0, "queries did not fuse"
 
-    tree = _trace_of(dom, "sum(p * d)")
+    tree = _trace_of(dom, "sum(p * p * p * d)")
     assert tree is not None
     names = {sp.name for sp in tree.spans}
     assert {"sched.queue", "sched.fusion", "sched.compile",
@@ -444,7 +448,7 @@ def test_degraded_statement_flagged_and_kept(odom):
             Session(dom).must_query(target)
     faults.clear()
     assert Session(dom).must_query(target) == solo
-    tree = _trace_of(dom, "sum(p * d)")
+    tree = _trace_of(dom, "sum(p * p * p * d)")
     assert tree is not None
     assert {"quarantined", "degraded"} <= tree.flags, tree.flags
     # the quarantine marker span rode the submitting thread's trace

@@ -52,12 +52,16 @@ def _mk_table(s: Session, name: str = "t", n: int = 4000, seed: int = 0):
 
 
 # one query per device aggregate op kind (COUNT / SUM / MIN / MAX), all
-# over one shared scan, each with its own filter
+# over one shared scan, each with its own filter.  The SUMs prove
+# narrow under copnum (single-word int64 states) and fuse under their
+# own ('agg-narrow', ...) class, apart from the limb aggs — two SUMs so
+# that class also gets a real (>=2 member) fused launch.
 FUSION_QUERIES = [
     "select count(*) from t where d >= 5",
     "select sum(p * d) from t where q < 24",
     "select min(p) from t where q > 10",
     "select max(p) from t where d < 8",
+    "select sum(p) from t where q > 5",
 ]
 
 
@@ -102,9 +106,10 @@ def _run_concurrent(dom, sched, queries):
 
 
 def test_different_aggregates_fuse_into_one_launch():
-    """N sessions x N DIFFERENT aggregates over one table: ONE fused
-    device launch serves all of them (fewer launches than tasks,
-    fused > 0), no new solo-program compiles, answers exact."""
+    """N sessions x N DIFFERENT aggregates over one table: the limb
+    aggs fuse into one device launch and the proven-narrow SUMs into a
+    second (fewer launches than tasks, every member fused), no new
+    solo-program compiles, answers exact."""
     dom, s, _data = _fusion_domain()
     # warm-up: compiles each member program once, starts the scheduler
     solo = [Session(dom).must_query(q) for q in FUSION_QUERIES]
@@ -118,7 +123,8 @@ def test_different_aggregates_fuse_into_one_launch():
 
     # every session got the same answer a solo run produces...
     assert [out[i] for i in range(len(FUSION_QUERIES))] == solo
-    # ...the group fused: fewer launches than tasks, fused launches seen
+    # ...both classes fused: fewer launches than tasks, fused launches
+    # seen, and EVERY member (limb and narrow alike) rode a fusion
     dl = sched.launches - l0
     dtasks = sched.tasks_done - t0
     assert sched.fused_launches > f0

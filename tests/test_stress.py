@@ -47,6 +47,9 @@ def test_stress_smoke_64_sessions_completion_and_zero_wrong():
     assert out["calibration_entries"] > 0
     assert out["calibration_observed"] >= 0
     assert out["launches"] <= out["tasks"]
+    # copnum watermark check ran at every sched admit: the declared
+    # ANALYZE intervals contain everything the harness actually scanned
+    assert out["value_drifts"] == 0, out
 
 
 @pytest.mark.slow

@@ -1,0 +1,400 @@
+"""copnum value-range abstract interpreter: interval algebra, stats-seeded
+poison rejections per NUM-* family, the plan->sched proof registry replay,
+watermark drift surfacing, and narrow-vs-limb SUM bit-identity.
+
+Covers the ISSUE-19 acceptance behaviors: a stats-poisoned plan is
+rejected with a structured PlanContractError BEFORE any trace/compile at
+BOTH seams (session _plan_select and scheduler submit, monkeypatch-
+proven), proven-narrow single-word SUM states are bit-identical to the
+(hi, lo) limb path at INT64-extreme and NULL-heavy inputs, and ANALYZE
+watermark drift is surfaced (never fatal) at admission.
+"""
+
+import dataclasses
+import decimal as pydec
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tidb_tpu import copr
+from tidb_tpu.analysis import PlanContractError, verify_task
+from tidb_tpu.analysis import valueflow as V
+from tidb_tpu.chunk import Column
+from tidb_tpu.copr import dag as D
+from tidb_tpu.expr import builders as B
+from tidb_tpu.expr.compile import Evaluator
+from tidb_tpu.expr.ir import ColumnRef, Func
+from tidb_tpu.parallel.mesh import get_mesh
+from tidb_tpu.sched.task import CopTask
+from tidb_tpu.session import Domain, Session
+from tidb_tpu.session.catalog import TableInfo
+from tidb_tpu.sql.parser import parse_one
+from tidb_tpu.store import CopClient, snapshot_from_columns
+from tidb_tpu.types import dtypes as dt
+
+I64_MAX = 2 ** 63 - 1
+I64_MIN = -2 ** 63
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    """Digest-keyed verdicts are content-addressed: a rejection leaked
+    from a poison test would shadow an identical dag elsewhere."""
+    V.clear_registry()
+    yield
+    V.clear_registry()
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) == 8
+    return get_mesh()
+
+
+def _mini_session():
+    """Domain+Session over t(a bigint, d decimal(8,2)), analyzed —
+    every value below is a device-scaled int, stats attained."""
+    dom = Domain()
+    s = Session(dom)
+    a = Column.from_numpy(dt.bigint(), np.arange(1, 257, dtype=np.int64))
+    d = Column.from_numpy(dt.decimal(8, 2),
+                          np.arange(100, 356, dtype=np.int64))
+    tbl = TableInfo("t", ["a", "d"], [a.dtype, d.dtype])
+    tbl.register_columns([a, d])
+    dom.catalog.create_table("test", tbl)
+    s.execute("analyze table t")
+    return s, tbl
+
+
+def _cop_of(phys):
+    stack = [phys]
+    while stack:
+        op = stack.pop()
+        if type(op).__name__ == "CopTaskExec":
+            return op
+        stack.extend(c for c in getattr(op, "children", []) or []
+                     if c is not None)
+    raise AssertionError("no CopTaskExec in plan")
+
+
+def _no_trace(monkeypatch):
+    import tidb_tpu.parallel.spmd as spmd
+
+    def boom(*_a, **_k):
+        raise AssertionError("reached tracing/compilation")
+    monkeypatch.setattr(spmd, "get_sharded_program", boom)
+    monkeypatch.setattr(spmd, "get_batched_program", boom)
+
+
+def _task_for(dag, mesh):
+    cols = [(jnp.zeros((8, 16), jnp.int64), None)]
+    counts = jnp.full((8,), 16, jnp.int64)
+    return CopTask.structured(dag, mesh, 0, cols, counts, ())
+
+
+# ------------------------------------------------------------------ #
+# interval algebra + expression lowering
+# ------------------------------------------------------------------ #
+
+def test_interval_union_and_magnitude():
+    a = V.Interval(-3, 10, True)
+    b = V.Interval(5, 20, True)
+    u = a.union(b)
+    assert (u.lo, u.hi, u.proven) == (-3, 20, True)
+    assert a.union(V.Interval(0, 1, False)).proven is False
+    assert V.Interval(-8, 5).mag == 8
+
+
+def test_type_domains():
+    assert V.type_domain(dt.bigint()) == V.Interval(I64_MIN, I64_MAX)
+    d = V.type_domain(dt.decimal(8, 2))
+    assert (d.lo, d.hi) == (-(10 ** 8 - 1), 10 ** 8 - 1)
+    assert V.type_domain(dt.double()) is None          # float: untracked
+    assert V.type_domain(dt.decimal(30, 10)) is None   # wide: host ints
+    assert V.type_domain(dt.date()).hi == np.iinfo(np.int32).max
+
+
+def test_expr_arith_proven_propagation():
+    ref = ColumnRef(dt.bigint(), 0)
+    env = (V.Interval(2, 10, True),)
+    mul = B.arith("mul", ref, B.lit(3, dt.bigint(False)))
+    iv = V.expr_interval(mul, env, ())
+    assert (iv.lo, iv.hi, iv.proven) == (6, 30, True)
+    # unproven input: result interval is sound but never a finding
+    iv = V.expr_interval(mul, (V.Interval(2, 10, False),), ())
+    assert iv.proven is False
+
+
+def test_unproven_escape_clamps_instead_of_raising():
+    """Type-domain-wide inputs may escape int64 through arithmetic; the
+    result clamps (sound) — only PROVEN escapes are findings."""
+    ref = ColumnRef(dt.bigint(), 0)
+    sq = B.arith("mul", ref, ref)
+    iv = V.expr_interval(sq, (V.type_domain(dt.bigint()),), ())
+    assert iv is not None and iv.proven is False
+    assert iv.lo >= I64_MIN and iv.hi <= I64_MAX
+
+
+def test_filter_tightening():
+    ref = ColumnRef(dt.bigint(), 0)
+    env = (V.Interval(0, 1000, True),)
+    cond = B.compare("lt", ref, B.lit(10, dt.bigint(False)))
+    tightened = V._tighten(env, cond)
+    assert (tightened[0].lo, tightened[0].hi) == (0, 9)
+    assert tightened[0].proven is True      # intersection stays attained
+    # const-on-the-left flips the comparison
+    cond = B.compare("ge", B.lit(100, dt.bigint(False)), ref)
+    assert V._tighten(env, cond)[0].hi == 100
+
+
+# ------------------------------------------------------------------ #
+# satellite 1: the host div pre-scale guard (expr/compile.op_div)
+# ------------------------------------------------------------------ #
+
+def test_host_div_prescale_guard_fires_at_int64_boundary():
+    """The pow10 pre-scaling multiply inside decimal division now runs
+    through _guard_dec_overflow on host lanes: a dividend whose scaled
+    intermediate escapes int64 raises instead of wrapping."""
+    ev = Evaluator(np)
+    a = ColumnRef(dt.decimal(15, 2), 0)
+    expr = B.arith("div", a, B.decimal_lit("3.0"))
+    cols = [(np.array([2 ** 62], np.int64), True)]
+    with pytest.raises(OverflowError):
+        ev.eval(expr, cols, {})
+    # ordinary magnitudes divide unharmed (6.00 / 3.0 = 2)
+    v, m = ev.eval(expr, [(np.array([600], np.int64), True)], {})
+    assert expr.dtype.kind == dt.TypeKind.DECIMAL
+    assert int(v[0]) == 2 * 10 ** expr.dtype.scale
+
+
+# ------------------------------------------------------------------ #
+# the narrow proof (planner seam)
+# ------------------------------------------------------------------ #
+
+def test_prove_narrow_sums_from_stats():
+    s, tbl = _mini_session()
+    scan = D.TableScan((0,), (dt.bigint(),))
+    agg = D.Aggregation(
+        scan, (), (D.AggDesc(D.AggFunc.SUM, ColumnRef(dt.bigint(), 0),
+                             copr.sum_out_dtype(dt.bigint())),),
+        D.GroupStrategy.SCALAR)
+    assert V.prove_narrow_sums(agg, tbl, s.domain.stats) == (0,)
+    # no stats -> the proof never speculates
+    assert V.prove_narrow_sums(agg, tbl, None) == ()
+
+
+def test_planner_stamps_narrow_and_registers_ok():
+    s, tbl = _mini_session()
+    phys = s._plan_select(parse_one("select sum(a) from t"))[1]
+    cop = _cop_of(phys)
+    assert cop.dag.narrow_sums == (0,)
+    rec = V.registry_verdict(cop.dag)
+    assert rec is not None and rec[0] == "ok"
+    # the ok verdict carries the declared intervals the proof assumed
+    assert any(name == "a" and (lo, hi) == (1, 256)
+               for _tk, name, lo, hi in rec[1])
+    # a stamped plan re-proves strictly under the same seeding
+    scan = V._scan_of(cop.dag)
+    seed = V.scan_stats_env(scan, tbl, s.domain.stats)
+    V.verify_dag_values(cop.dag, seed, rows=256, strict=True)
+
+
+# ------------------------------------------------------------------ #
+# seeded poison: every NUM-* family rejected pre-trace at _plan_select
+# ------------------------------------------------------------------ #
+
+def test_poisoned_overflow_rejected_at_plan_select(monkeypatch):
+    _no_trace(monkeypatch)
+    s, tbl = _mini_session()
+    ca = s.domain.stats.get(tbl).col("a")
+    ca.hist.min_val = -(2 ** 61)
+    ca.hist.bounds[-1] = 2 ** 61
+    with pytest.raises(PlanContractError) as ei:
+        s._plan_select(parse_one("select sum(a * 16) from t"))
+    assert ei.value.rule == "NUM-OVERFLOW-DEVICE"
+    assert "Aggregation" in ei.value.path
+
+
+def test_poisoned_div_prescale_rejected_at_plan_select(monkeypatch):
+    _no_trace(monkeypatch)
+    s, tbl = _mini_session()
+    cd = s.domain.stats.get(tbl).col("d")
+    cd.hist.bounds[-1] = 10 ** 14       # scaled int near the device rail
+    with pytest.raises(PlanContractError) as ei:
+        s._plan_select(parse_one("select sum(d / 2.5) from t"))
+    assert ei.value.rule == "NUM-DIV-PRESCALE"
+
+
+def test_poisoned_precision_loss_on_f32_cast():
+    f32 = dt.DataType(dt.TypeKind.FLOAT32)
+    cast = Func(f32, "cast", (ColumnRef(dt.bigint(), 0, "a"),))
+    with pytest.raises(PlanContractError) as ei:
+        V.expr_interval(cast, (V.Interval(0, 2 ** 30, True),), ("t",))
+    assert ei.value.rule == "NUM-PRECISION-LOSS"
+    # below the 2^24 exact-int rail, or unproven: no finding
+    assert V.expr_interval(
+        cast, (V.Interval(0, 2 ** 20, True),), ("t",)) is None
+    assert V.expr_interval(
+        cast, (V.Interval(0, 2 ** 30, False),), ("t",)) is None
+
+
+def test_poisoned_fence_rejected_at_both_seams(monkeypatch):
+    """The flagship double-seam proof: poisoned stats break the narrow
+    claim's re-proof at verify_plan_values, the rejection lands in the
+    proof registry, and scheduler.submit replays it — with every trace
+    entrypoint monkeypatched to fail on touch."""
+    _no_trace(monkeypatch)
+    s, tbl = _mini_session()
+    phys = s._plan_select(parse_one("select sum(a) from t"))[1]
+    cop = _cop_of(phys)
+    assert cop.dag.narrow_sums == (0,)
+
+    ts = s.domain.stats.get(tbl)
+    ts.count = 2 ** 55              # 2^55 rows x mag 256 >> 2^62
+    V.clear_registry()
+    with pytest.raises(PlanContractError) as ei:
+        V.verify_plan_values(cop, s.domain.stats)
+    assert ei.value.rule == "NUM-FENCE-UNPROVEN"
+    rec = V.registry_verdict(cop.dag)
+    assert rec is not None and rec[0] == "rejected"
+
+    # seam 2: admission replays the recorded rejection BEFORE the drain
+    # could resolve (trace) a program
+    from tidb_tpu.sched import scheduler_for
+    mesh = get_mesh()
+    task = _task_for(cop.dag, mesh)
+    with pytest.raises(PlanContractError) as ei:
+        scheduler_for(mesh).submit(task)
+    assert ei.value.rule == "NUM-FENCE-UNPROVEN"
+    assert ei.value.path[0] == "sched"
+
+
+def test_registry_miss_flows_nonstrict_and_admits(mesh):
+    """A direct-built dag the session never verified flows from type
+    domains at admission — sound, never spuriously rejected."""
+    scan = D.TableScan((0,), (dt.bigint(),))
+    agg = D.Aggregation(
+        scan, (), (D.AggDesc(D.AggFunc.SUM, ColumnRef(dt.bigint(), 0),
+                             copr.sum_out_dtype(dt.bigint())),),
+        D.GroupStrategy.SCALAR)
+    assert V.registry_verdict(agg) is None
+    verify_task(_task_for(agg, mesh))   # full contract chain, no raise
+
+
+# ------------------------------------------------------------------ #
+# watermark drift (the runtime half): surfaced, never fatal
+# ------------------------------------------------------------------ #
+
+def test_watermark_drift_flagged_not_fatal(mesh):
+    s, tbl = _mini_session()
+    phys = s._plan_select(parse_one("select sum(a) from t"))[1]
+    cop = _cop_of(phys)
+    assert V.registry_verdict(cop.dag)[0] == "ok"
+
+    # the data moves past the declared interval; a fresh ANALYZE stamps
+    # the new observed watermarks
+    big = Column.from_numpy(dt.bigint(),
+                            np.arange(10_000, 10_256, dtype=np.int64))
+    d = Column.from_numpy(dt.decimal(8, 2),
+                          np.arange(100, 356, dtype=np.int64))
+    tbl.register_columns([big, d])
+    s.domain.stats.analyze_table(tbl)
+
+    task = _task_for(cop.dag, mesh)
+    before = V.drift_count()
+    V.verify_task_values(task)          # flags, does NOT raise
+    assert task.value_drift >= 1
+    assert V.drift_count() == before + task.value_drift
+
+
+def test_watermark_inside_declared_is_quiet(mesh):
+    s, _tbl = _mini_session()
+    phys = s._plan_select(parse_one("select sum(a) from t"))[1]
+    cop = _cop_of(phys)
+    task = _task_for(cop.dag, mesh)
+    V.verify_task_values(task)
+    assert task.value_drift == 0
+
+
+# ------------------------------------------------------------------ #
+# narrow vs limb SUM: bit-identical by construction
+# ------------------------------------------------------------------ #
+
+def _sum_dag(t, narrow):
+    scan = D.TableScan((0,), (t,))
+    return D.Aggregation(
+        scan, (), (D.AggDesc(D.AggFunc.SUM, ColumnRef(t, 0),
+                             copr.sum_out_dtype(t)),
+                   D.AggDesc(D.AggFunc.COUNT, None, dt.bigint(False))),
+        D.GroupStrategy.SCALAR, narrow_sums=(0,) if narrow else ())
+
+
+def _run_single(agg, col, n):
+    prog = copr.get_program(agg)
+    m = None if col.validity.all() else jnp.asarray(col.validity)
+    states = prog([(jnp.asarray(col.data), m)], jnp.int64(n))
+    merged = copr.merge_states([states])
+    _, aggs = copr.finalize(agg, merged, [])
+    return aggs[0].to_python()[0], int(aggs[1].data[0])
+
+
+def test_narrow_bit_identical_at_int64_extremes():
+    """Two's complement makes the single-word state exact whenever the
+    true sum fits in int64 — even when running partials wrap at
+    INT64_MIN/MAX-adjacent inputs."""
+    vals = np.array([I64_MAX, I64_MIN + 1, 7, -(2 ** 62), 2 ** 62 - 12345,
+                     2 ** 61, -(2 ** 61) + 999], np.int64)
+    col = Column.from_numpy(dt.bigint(), vals)
+    oracle = int(vals.astype(object).sum())
+    limb = _run_single(_sum_dag(dt.bigint(), False), col, len(vals))
+    narrow = _run_single(_sum_dag(dt.bigint(), True), col, len(vals))
+    assert limb == narrow == (oracle, len(vals))
+
+
+def test_narrow_bit_identical_null_heavy_8shard_psum(mesh):
+    """NULL-heavy decimal column over the 8-device mesh: the narrow
+    single-word psum merge must match the limb path bit-for-bit."""
+    rng = np.random.default_rng(5)
+    n = 4096
+    dv = rng.integers(-10 ** 6, 10 ** 6, n)
+    col = Column.from_numpy(dt.decimal(12, 2), dv)
+    col.validity[rng.random(n) < 0.9] = False
+    oracle = int(dv.astype(object)[col.validity].sum())
+
+    client = CopClient(mesh)
+    outs = []
+    for narrow in (False, True):
+        agg = _sum_dag(dt.decimal(12, 2), narrow)
+        snap = snapshot_from_columns(["d"], [col], n_shards=8,
+                                     min_capacity=64)
+        res = client.execute_agg(agg, snap, [])
+        outs.append((res.columns[0].to_python()[0],
+                     int(res.columns[1].data[0])))
+    assert outs[0] == outs[1]
+    assert outs[0][0] == pydec.Decimal(oracle).scaleb(-2)
+    assert outs[0][1] == n                  # COUNT(*) counts null rows
+
+
+def test_narrow_and_limb_programs_cache_apart():
+    """narrow_sums participates in the frozen-dag digest and the fusion
+    class: the two representations can never share a compiled program
+    or a fusion batch."""
+    from tidb_tpu.analysis.contracts import fusion_signature
+    limb, narrow = _sum_dag(dt.bigint(), False), _sum_dag(dt.bigint(), True)
+    assert D.dag_digest(limb) != D.dag_digest(narrow)
+    assert fusion_signature(narrow) != fusion_signature(limb)
+    assert fusion_signature(narrow) == ("agg-narrow", (0,))
+    assert V.narrow_sum_count(narrow) == 1
+    assert V.narrow_sum_count(limb) == 0
+
+
+def test_narrow_state_priced_single_word():
+    """copcost prices the narrow state at one 8-byte word vs the 16-byte
+    (hi, lo) limb pair — the payoff the fusion class exists for."""
+    from tidb_tpu.analysis.copcost import _agg_state_width
+    a = D.AggDesc(D.AggFunc.SUM, ColumnRef(dt.bigint(), 0),
+                  copr.sum_out_dtype(dt.bigint()))
+    assert _agg_state_width(a, narrow=True) == 8
+    assert _agg_state_width(a, narrow=False) == 16

@@ -40,7 +40,15 @@ Runs three static passes and exits non-zero on any NEW finding:
    epoch-fencing rule, and a live in-memory store must refuse writes
    from a released (dead) lease epoch — guards the schema the same
    way the pricing pass guards the static weights.
-8. Whole-program concurrency model (analysis/concurrency, copsan):
+8. Value-range flow (analysis/valueflow, copnum): every corpus plan's
+   device programs flow through the whole-plan abstract interpreter —
+   per-column integer intervals seeded from ANALYZE stats (type
+   domains when absent) carried through expression lowering, filters,
+   joins and aggregation states.  NUM-OVERFLOW-DEVICE /
+   NUM-FENCE-UNPROVEN / NUM-PRECISION-LOSS / NUM-DIV-PRESCALE findings
+   baseline like every other corpus family; the verdict counts
+   stats-proven plans and proven-narrow single-word SUM states.
+9. Whole-program concurrency model (analysis/concurrency, copsan):
    every module importing threading is auto-discovered (no hand
    list), its lock allocation sites become named nodes, with/acquire
    nesting becomes a global acquisition graph, and per-class guard
@@ -54,6 +62,8 @@ Flags:
     --lint-only / --contracts-only   run one pass
     --concurrency-only               run just the copsan concurrency
                                      pass (RACE-/LOCK- families)
+    --value-only                     run just the copnum value-range
+                                     pass (NUM- family)
     --race-report                    print the per-module concurrency
                                      model table (locks, acquisition
                                      edges, thread roots, findings)
@@ -85,6 +95,11 @@ Flags:
                                      schema (key family -> owner, TTL,
                                      epoch rule; pd/store) with the
                                      live fence check and exit
+    --value-report                   print the per-corpus-query
+                                     value-range flow table (device ops
+                                     flowed, proven-narrow SUM states,
+                                     verdict; analysis/valueflow) and
+                                     exit
 """
 
 from __future__ import annotations
@@ -110,21 +125,35 @@ def _baseline_path() -> str:
                         "baseline.txt")
 
 
-def _corpus_plans() -> list:
+def _corpus_plans(with_stats: bool = False):
+    """Built corpus plans; ``with_stats=True`` also returns the plan
+    session's stats handle (valueflow seeds its intervals from it)."""
     from ..testing.tpch import built_tpch_plans, tpch_plan_session
-    return list(built_tpch_plans(tpch_plan_session()))
+    session = tpch_plan_session()
+    plans = list(built_tpch_plans(session))
+    if with_stats:
+        return plans, session.domain.stats
+    return plans
 
 
 def _gather_findings(lint_only: bool, contracts_only: bool,
-                     concurrency_only: bool = False):
-    """(findings, plans): the baseline-diffable findings of the selected
-    passes plus the corpus plans (reused by the contracts pass so the
-    corpus is planned once per gate run)."""
+                     concurrency_only: bool = False,
+                     value_only: bool = False):
+    """(findings, plans, stats): the baseline-diffable findings of the
+    selected passes plus the corpus plans and the corpus stats handle
+    (reused by the contracts/valueflow passes so the corpus is planned
+    once per gate run)."""
     findings: list = []
-    plans = None
+    plans = stats = None
     if concurrency_only:
         from .concurrency import concurrency_findings
-        return list(concurrency_findings()), None
+        return list(concurrency_findings()), None, None
+    if value_only:
+        from .valueflow import value_findings
+        plans, stats = _corpus_plans(with_stats=True)
+        return (list(value_findings(plans, stats,
+                                    n_devices=GATE_DEVICES)),
+                plans, stats)
     if not contracts_only:
         from .concurrency import concurrency_findings
         from .lint import lint_tree
@@ -134,11 +163,13 @@ def _gather_findings(lint_only: bool, contracts_only: bool,
         from .copcost import cost_findings
         from .lifetime import donation_findings
         from .shardflow import shard_findings
-        plans = _corpus_plans()
+        from .valueflow import value_findings
+        plans, stats = _corpus_plans(with_stats=True)
         findings += cost_findings(plans, n_devices=GATE_DEVICES)
         findings += donation_findings(plans, n_devices=GATE_DEVICES)
         findings += shard_findings(plans, n_devices=GATE_DEVICES)
-    return findings, plans
+        findings += value_findings(plans, stats, n_devices=GATE_DEVICES)
+    return findings, plans, stats
 
 
 def _write_baseline(findings) -> int:
@@ -155,7 +186,8 @@ def _write_baseline(findings) -> int:
 
 def _stale_keys(findings, baseline, lint_only: bool,
                 contracts_only: bool,
-                concurrency_only: bool = False) -> set:
+                concurrency_only: bool = False,
+                value_only: bool = False) -> set:
     """Baseline entries no current finding matches.  Partial runs only
     judge the rule families they actually computed, so --lint-only
     cannot misreport COST-* waivers as rotten (and vice versa)."""
@@ -164,9 +196,13 @@ def _stale_keys(findings, baseline, lint_only: bool,
     for k in baseline - current:
         # corpus-walk rule families (computed only on full/cost runs);
         # SHARD- joined with the shardflow pass (ISSUE 12), RACE-/LOCK-
-        # with the copsan concurrency pass (ISSUE 17, lint-side runs)
-        is_cost = k.startswith(("COST-", "DONATE-", "SHARD-"))
+        # with the copsan concurrency pass (ISSUE 17, lint-side runs),
+        # NUM- with the copnum valueflow pass (ISSUE 19)
+        is_cost = k.startswith(("COST-", "DONATE-", "SHARD-", "NUM-"))
+        is_value = k.startswith("NUM-")
         is_conc = k.startswith(("RACE-", "LOCK-"))
+        if value_only and not is_value:
+            continue
         if concurrency_only and not is_conc:
             continue
         if lint_only and is_cost:
@@ -288,6 +324,37 @@ def _run_shardflow(plans) -> int:
     return 1 if bad else 0
 
 
+def _run_valueflow(plans, stats, findings, baseline) -> int:
+    """Value-range verdict (copnum, ISSUE 19): every corpus plan must
+    flow clean through the abstract interpreter with finite intervals
+    and zero unbaselined NUM- findings; the verdict also counts the
+    proven-narrow single-word SUM states (the perf payoff the proofs
+    license).  The NUM- findings already rode _run_findings; this line
+    is the per-pass verdict the gate tests pin."""
+    from ..testing.tpch import built_multichip_plans, tpch_plan_session
+    from .contracts import PlanContractError
+    from .valueflow import plan_narrow_states, verify_plan_values
+    multichip = list(built_multichip_plans(tpch_plan_session()))
+    proven = 0
+    narrow = 0
+    bad = 0
+    for src, group in (("corpus", plans), ("multichip", multichip)):
+        for sql, phys in group:
+            try:
+                verify_plan_values(phys, stats)
+                proven += 1
+                narrow += plan_narrow_states(phys)
+            except PlanContractError as e:
+                bad += 1    # corpus ones already rode value_findings
+                one_line = " ".join(sql.split())
+                print(f"VALUEFLOW [{src}] {one_line[:64]}...\n  {e}")
+    fresh = [f for f in findings
+             if f.rule.startswith("NUM-") and f.key() not in baseline]
+    print(f"values: {proven} plans proven, {narrow} narrow states, "
+          f"{len(fresh)} findings")
+    return 1 if fresh or bad else 0
+
+
 def _run_concurrency(findings, baseline) -> int:
     """Whole-program concurrency verdict (copsan, ISSUE 17): the model
     must cover every threading-importing module with zero unbaselined
@@ -356,6 +423,7 @@ def main(argv=None) -> int:
     lint_only = "--lint-only" in argv
     contracts_only = "--contracts-only" in argv
     concurrency_only = "--concurrency-only" in argv
+    value_only = "--value-only" in argv
     update = "--update-baseline" in argv
     check_baseline = "--check-baseline" in argv
     if "--race-report" in argv:
@@ -387,19 +455,25 @@ def main(argv=None) -> int:
         out = pd_report()
         print(out)
         return 1 if "VIOLATION" in out else 0
+    if "--value-report" in argv:
+        from .valueflow import value_report
+        plans, stats = _corpus_plans(with_stats=True)
+        print(value_report(plans, stats))
+        return 0
     if check_baseline:
         # hygiene pass: waivers must not rot silently — every baseline
         # entry must still match a current finding (full gather, so the
         # verdict covers every rule family, RACE-/LOCK- included)
-        lint_only = contracts_only = concurrency_only = False
-    findings, plans = _gather_findings(lint_only, contracts_only,
-                                       concurrency_only)
+        lint_only = contracts_only = concurrency_only = value_only = False
+    findings, plans, stats = _gather_findings(lint_only, contracts_only,
+                                              concurrency_only,
+                                              value_only)
     if update:
         return _write_baseline(findings)
     from .lint import load_baseline
     baseline = load_baseline(_baseline_path())
     stale = _stale_keys(findings, baseline, lint_only, contracts_only,
-                        concurrency_only)
+                        concurrency_only, value_only)
     if check_baseline:
         for k in sorted(stale):
             print(f"STALE {k}")
@@ -408,6 +482,11 @@ def main(argv=None) -> int:
               "current finding")
         return 1 if stale else 0
     rc = _run_findings(findings, baseline, stale)
+    if value_only:
+        rc |= _run_valueflow(plans, stats, findings, baseline)
+        if rc == 0:
+            print("analysis gate: ok")
+        return rc
     if not contracts_only:
         rc |= _run_concurrency(findings, baseline)
     if not lint_only and not concurrency_only:
@@ -415,6 +494,7 @@ def main(argv=None) -> int:
         rc |= _run_pricing(plans)
         rc |= _run_calibration(plans)
         rc |= _run_shardflow(plans)
+        rc |= _run_valueflow(plans, stats, findings, baseline)
         rc |= _run_pd()
     if rc == 0:
         print("analysis gate: ok")

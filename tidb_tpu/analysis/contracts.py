@@ -284,6 +284,32 @@ def _verify_dag(node: D.CopNode, path) -> None:
                   f"prehashed set on a {node.strategy.value} "
                   "aggregation: only the radix strategies "
                   "(SEGMENT/SCATTER) read a hoisted hash column")
+        if node.narrow_sums:
+            # valueflow-proven single-word SUM states: only in-program
+            # (psum-merged) strategies carry them, and only int/decimal
+            # SUM slots qualify — a narrow float or COUNT slot would
+            # trace a program whose state layout disagrees with the
+            # merge/finalize contract
+            if node.strategy not in (D.GroupStrategy.SCALAR,
+                                     D.GroupStrategy.DENSE):
+                _fail("capacity-shape", p,
+                      f"narrow_sums on a {node.strategy.value} "
+                      "aggregation: only SCALAR/DENSE (in-program psum) "
+                      "states take the single-word layout")
+            from ..types.dtypes import TypeKind as _K
+            for i in node.narrow_sums:
+                if i < 0 or i >= len(node.aggs):
+                    _fail("capacity-shape", p,
+                          f"narrow_sums index {i} out of range for "
+                          f"{len(node.aggs)} aggregates")
+                a = node.aggs[i]
+                if a.func != D.AggFunc.SUM or a.arg is None \
+                        or a.arg.dtype is None \
+                        or a.arg.dtype.kind in (_K.FLOAT64, _K.FLOAT32):
+                    _fail("capacity-shape", p,
+                          f"narrow_sums index {i} is not an int/decimal "
+                          "SUM: only limb-split SUM states have a narrow "
+                          "twin")
     elif isinstance(node, D.TopN):
         keys = node.sort_keys or (((node.sort_key, node.desc),)
                                   if node.sort_key is not None else ())
@@ -530,6 +556,12 @@ def verify_task(task) -> None:
     # psum limb-fence bound) — still pre-trace, still memoized
     from .shardflow import verify_task_sharding
     verify_task_sharding(task)
+    # value-range handshake (analysis/valueflow): the task's DAG must
+    # flow finite, int64-safe intervals — a digest the session proved at
+    # plan time is a registry hit; an unknown digest re-flows from type
+    # domains.  Still pre-trace, still memoized.
+    from .valueflow import verify_task_values
+    verify_task_values(task)
     if getattr(task, "donate", False):
         # donation-safety handshake (analysis/lifetime): a donating
         # task must be in an EPHEMERAL program class and its inputs
@@ -611,6 +643,11 @@ def fusion_signature(dag: D.CopNode) -> Optional[tuple]:
                 D.radix_passes(dag.num_buckets))
     if dag.strategy == D.GroupStrategy.SEGMENT:
         return ("segment-agg", dag.num_buckets)
+    if dag.narrow_sums:
+        # proven-narrow members only fuse with members proving the SAME
+        # slots narrow: the fused leaves' state layouts (single word vs
+        # limb pair) are baked into the traced program
+        return ("agg-narrow", dag.narrow_sums)
     return ("inprog-agg",)
 
 

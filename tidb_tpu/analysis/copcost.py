@@ -301,11 +301,14 @@ class _Acc:
 
 
 # per-agg accumulator state width in bytes (the (hi, lo) limb split of
-# int/decimal SUM doubles its state; MIN/MAX/FIRST carry a valid lane)
-def _agg_state_width(a: D.AggDesc) -> int:
+# int/decimal SUM doubles its state; a valueflow-proven narrow SUM keeps
+# a single int64 word; MIN/MAX/FIRST carry a valid lane)
+def _agg_state_width(a: D.AggDesc, narrow: bool = False) -> int:
     if a.func == D.AggFunc.SUM:
         k = a.arg.dtype.kind if a.arg is not None and a.arg.dtype else None
-        return 8 if k in (dt.TypeKind.FLOAT64, dt.TypeKind.FLOAT32) else 16
+        if k in (dt.TypeKind.FLOAT64, dt.TypeKind.FLOAT32):
+            return 8
+        return 8 if narrow else 16
     if a.func == D.AggFunc.COUNT:
         return 8
     return 8 + _VALIDITY_BYTES      # MIN / MAX / FIRST: value + valid
@@ -377,7 +380,8 @@ def _walk(node: D.CopNode, path: tuple, rows: int, layout: Layout,
 
     if isinstance(node, D.Aggregation):
         groups = _agg_groups(node, rows_in)
-        swidth = sum(_agg_state_width(a) for a in node.aggs)
+        swidth = sum(_agg_state_width(a, narrow=(i in node.narrow_sums))
+                     for i, a in enumerate(node.aggs))
         has_minmax = any(a.func in (D.AggFunc.MIN, D.AggFunc.MAX,
                                     D.AggFunc.FIRST) for a in node.aggs)
         for g in node.group_by:

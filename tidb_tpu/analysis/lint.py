@@ -114,6 +114,17 @@ Rules
                    outright); a host callback inside a kernel stalls
                    the TPU pipeline on the host — both destroy exactly
                    the performance a hand-written kernel exists for.
+- TPU-NARROW-CAST  a bit-narrowing ``.astype(...)`` (int8/16/32,
+                   uint8/16/32, float16/bfloat16/float32 target) in a
+                   traced module: a traced cast cannot raise on values
+                   that do not fit — high bits (or mantissa digits)
+                   vanish silently on device.  Every narrowing cast
+                   must carry a ``# valueflow: ok - <why>`` proof
+                   reference (the value-range argument that the lane's
+                   interval fits the target, analysis/valueflow
+                   discipline) or an explicit ``# planlint: ok``
+                   waiver.  Widening casts (int64/uint64/float64) and
+                   bool masks are exempt.
 - TPU-PD-EPOCH     a shared-store write call (cas / txn_update /
                    delete / grant / renew / release) in pd/ whose
                    enclosing function never references the lease
@@ -158,6 +169,12 @@ TRACED_MODULES = {
     # concretization, no silent host round-trips smuggled in later
     "pd/store.py", "pd/lease.py", "pd/quota.py", "pd/registry.py",
     "pd/coordinator.py",
+    # copnum (ISSUE 19): the value-range interpreter defines the
+    # numeric-safety contracts traced lanes rely on (narrow SUM proofs,
+    # overflow fences) — same hygiene rules as shardflow, for the same
+    # reason: the analysis side must never drift from the programs it
+    # verifies
+    "analysis/valueflow.py",
 }
 
 # hot-path modules where a host sync stalls the launch pipeline
@@ -280,6 +297,23 @@ _X64_CREATORS = {"arange": -1, "zeros": 1, "ones": 1, "empty": 1,
 _X64_SCALARS = {"int64", "uint64", "float64"}
 _WAIVER = re.compile(r"planlint:\s*ok")
 _BLE_WAIVER = re.compile(r"noqa:.*BLE001|planlint:\s*ok")
+# TPU-NARROW-CAST: targets that lose bits from an int64/f64 lane, and
+# the proof-reference comment that clears them (a value-range argument
+# in the analysis/valueflow discipline); the generic waiver also works
+_NARROW_CAST_TARGETS = {"int8", "int16", "int32", "uint8", "uint16",
+                        "uint32", "float16", "bfloat16", "float32"}
+_NARROW_CAST_OK = re.compile(r"valueflow:\s*ok|planlint:\s*ok")
+
+
+def _cast_target_name(arg: ast.AST) -> str:
+    """Dtype spelled as jnp.int32 / np.int32 / int32 / 'int32'."""
+    if isinstance(arg, ast.Attribute):
+        return arg.attr
+    if isinstance(arg, ast.Name):
+        return arg.id
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    return ""
 
 
 @dataclass
@@ -480,6 +514,20 @@ class _ExprRules(_Scoped):
                          "wrap silently past 2^31 contributing rows — "
                          "add a *_psum_limb_fence capacity check that "
                          "raises OverflowError before launch")
+            # TPU-NARROW-CAST: a traced cast cannot raise on values
+            # that do not fit — bit-narrowing needs a value-range proof
+            if (isinstance(node.func, ast.Attribute) and name == "astype"
+                    and node.args):
+                tgt = _cast_target_name(node.args[0])
+                if tgt in _NARROW_CAST_TARGETS:
+                    self.add(
+                        "TPU-NARROW-CAST", node,
+                        f".astype({tgt}) in a traced module narrows "
+                        "silently on device (no data-dependent raise); "
+                        "state the value-range proof in a "
+                        "'# valueflow: ok - <why>' comment or waive "
+                        "with '# planlint: ok'",
+                        pat=_NARROW_CAST_OK)
             # TPU-DTYPE-X64: dtype decided by the x64 flag, not the code
             self._check_x64(node, name)
             # TPU-DONATE: donation argnums must come from a DonationPlan

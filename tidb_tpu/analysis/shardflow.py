@@ -139,14 +139,17 @@ def _layout_str(layout: Layout) -> str:
 def _agg_needs_limb_fence(agg: D.Aggregation) -> bool:
     """Mirror of the spmd/shuffle program predicate: an in-program psum
     of (hi, lo) SUM limb states needs the 2^31 global-capacity fence;
-    float sums, counts, and host-merged programs are exempt."""
+    float sums, counts, host-merged programs, and valueflow-proven
+    narrow SUMs (whole-table no-wrap proof subsumes the row fence) are
+    exempt."""
     if agg.strategy in D.HOST_MERGE_STRATEGIES:
         return False
     K = dt.TypeKind
     return any(a.func == D.AggFunc.SUM and a.arg is not None
                and a.arg.dtype is not None
                and a.arg.dtype.kind not in (K.FLOAT64, K.FLOAT32)
-               for a in agg.aggs)
+               and i not in agg.narrow_sums
+               for i, a in enumerate(agg.aggs))
 
 
 def _flow(node: D.CopNode, topo: MeshTopology, path: tuple,

@@ -202,6 +202,11 @@ class Aggregation(CopNode):
     `prehashed` (SEGMENT/SCATTER): the LAST scan column carries the
     precomputed per-row key hash, so bucket-space regrow re-entries skip
     re-hashing the key tuple (store/client hoists it once per statement).
+    `narrow_sums` (SCALAR/DENSE): agg indexes whose int/decimal SUM the
+    planner PROVED (analysis/valueflow, from ANALYZEd column stats) can
+    never escape int64 across the whole table — those states accumulate
+    a single int64 word instead of (hi, lo) limbs.  Part of the frozen
+    hash, so narrow and limb programs key, cache, and fuse apart.
     """
     child: CopNode = None  # type: ignore[assignment]
     group_by: Tuple[Expr, ...] = ()
@@ -213,6 +218,8 @@ class Aggregation(CopNode):
                                          # = state-table capacity per device
     prehashed: bool = False              # SEGMENT/SCATTER: last scan column
                                          # is the hoisted int64 key hash
+    narrow_sums: Tuple[int, ...] = ()    # SCALAR/DENSE: agg indexes with a
+                                         # valueflow-proven single-word SUM
 
     def children(self):
         return (self.child,)

@@ -122,14 +122,17 @@ def _min_of(dtype):
     return jnp.iinfo(dtype).min
 
 
-def _one_agg_state(a: D.AggDesc, av, am, sel, gids, num_groups, n) -> dict:
+def _one_agg_state(a: D.AggDesc, av, am, sel, gids, num_groups, n,
+                   narrow: bool = False) -> dict:
     """Partial state for one AggDesc over (possibly grouped) rows.
 
     Layout (all named arrays so psum/pmin/pmax merges are mechanical —
     see parallel/collectives.py MERGE_SPECS):
       count -> {count}
       sum   -> decimal/int: {hi, lo, cnt} (int64 limb split, exact when
-               recombined host-side); float: {sum, cnt}
+               recombined host-side); proven-narrow decimal/int
+               (analysis/valueflow): {sum, cnt} single int64 word;
+               float: {sum, cnt}
       min   -> {min, cnt};  max -> {max, cnt}
     """
     av = _ensure_array(av, n)
@@ -142,6 +145,14 @@ def _one_agg_state(a: D.AggDesc, av, am, sel, gids, num_groups, n) -> dict:
         kind = a.arg.dtype.kind
         if kind in (K.FLOAT64, K.FLOAT32):
             return {"sum": _reduce(av.astype(jnp.float64), mask, gids,
+                                   num_groups, "sum"), "cnt": cnt}
+        if narrow:
+            # valueflow proved Σv over the WHOLE table (all shards, all
+            # batches, with headroom) stays inside int64, so the per-batch
+            # sum and every psum/host partial can't wrap either: one int64
+            # word, half the state bytes, no limb fence.  Bit-identical to
+            # the limb path (Σhi<<32 + Σlo == Σv in two's complement).
+            return {"sum": _reduce(av.astype(jnp.int64), mask, gids,
                                    num_groups, "sum"), "cnt": cnt}
         # decimal AND integer sums accumulate as (hi, lo) int64 limbs.
         # Exactness argument (types/decimal.py): per row |hi| < 2^32 and
@@ -274,7 +285,8 @@ def _agg_partial_states(agg: D.Aggregation, batch: DeviceBatch, ev: Evaluator,
             states[f"a{i}"] = {"count": states["__rows__"]}
             continue
         av, am = ev.eval(a.arg, batch.cols, memo)
-        states[f"a{i}"] = _one_agg_state(a, av, am, sel, gids, num_groups, n)
+        states[f"a{i}"] = _one_agg_state(a, av, am, sel, gids, num_groups, n,
+                                         narrow=(i in agg.narrow_sums))
     return states
 
 
@@ -292,7 +304,7 @@ def group_keyinfo(agg: D.Aggregation, batch: DeviceBatch, ev: Evaluator,
         if v.dtype == bool:
             v = v.astype(jnp.int64)
         nullf = (jnp.zeros(n, jnp.int32) if m is True
-                 else (~m).astype(jnp.int32))
+                 else (~m).astype(jnp.int32))  # valueflow: ok - bool lane, [0, 1]
         vz = v if m is True else jnp.where(m, v, jnp.zeros((), v.dtype))
         if e.dtype.is_float:
             vz = jnp.where(vz == 0, jnp.zeros((), vz.dtype), vz)
@@ -320,7 +332,7 @@ def _agg_sort_states(agg: D.Aggregation, batch: DeviceBatch, ev: Evaluator,
 
     keyinfo = group_keyinfo(agg, batch, ev, memo, n)
 
-    dead = (~sel).astype(jnp.int32)
+    dead = (~sel).astype(jnp.int32)  # valueflow: ok - bool lane, [0, 1]
     ops: list = [dead]
     for _vz, _m, nullf, code in keyinfo:
         ops += [nullf, code]
@@ -369,7 +381,7 @@ def _dense_group_ids(agg: D.Aggregation, batch: DeviceBatch, ev: Evaluator,
     gid = jnp.zeros((n,), jnp.int32)
     for e, size in zip(agg.group_by, agg.domain_sizes):
         v, m = ev.eval(e, batch.cols, memo)
-        v = _ensure_array(v, n).astype(jnp.int32)
+        v = _ensure_array(v, n).astype(jnp.int32)  # valueflow: ok - DENSE key domain <= MAX_DENSE_GROUPS < 2^31
         if e.dtype.nullable:
             code = v + 1 if m is True else jnp.where(m, v + 1, 0)
         else:
@@ -547,7 +559,7 @@ def _exec_topn(node: D.TopN, batch: DeviceBatch, ev: Evaluator) -> DeviceBatch:
     memo: dict = {}
     n = len(batch.cols[0][0])
     sel = _sel_array(batch.sel, n)
-    dead = (~sel).astype(jnp.int32)
+    dead = (~sel).astype(jnp.int32)  # valueflow: ok - bool lane, [0, 1]
     operands = [dead]
     for e, desc in (node.sort_keys or ((node.sort_key, node.desc),)):
         v, m = ev.eval(e, batch.cols, memo)
@@ -560,8 +572,8 @@ def _exec_topn(node: D.TopN, batch: DeviceBatch, ev: Evaluator) -> DeviceBatch:
             nullflag = jnp.zeros(n, jnp.int32)
         else:
             # NULL sorts first in ASC, last in DESC
-            nullflag = jnp.where(m, 1, 0).astype(jnp.int32) if not desc \
-                else jnp.where(m, 0, 1).astype(jnp.int32)
+            flag = jnp.where(m, 1, 0) if not desc else jnp.where(m, 0, 1)
+            nullflag = flag.astype(jnp.int32)  # valueflow: ok - literal 0/1 lanes
         operands += [nullflag, key]
     nk = len(operands)
     *_, idx = lax.sort(tuple(operands)

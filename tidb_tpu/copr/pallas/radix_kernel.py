@@ -69,7 +69,7 @@ def _scatter_kernel(dig_ref, val_ref, off_ref, out_ref):
     base = off_ref[0, :]
     onehot = digs[:, None] == jax.lax.broadcasted_iota(
         jnp.int32, (TILE, N_DIGITS), 1)
-    oh = onehot.astype(jnp.int32)
+    oh = onehot.astype(jnp.int32)  # valueflow: ok - one-hot lane, [0, 1]
     rank = jnp.cumsum(oh, axis=0, dtype=jnp.int32) - oh  # exclusive/digit
     within = jnp.sum(jnp.where(onehot, rank, 0), axis=1, dtype=jnp.int32)
     pos = base[digs] + within

@@ -134,7 +134,7 @@ def _partition_xla(bid, bits: int, n: int):
     idx = jnp.arange(n, dtype=jnp.int32)
     pos_iota = jnp.arange(n, dtype=jnp.int32)
     for s in range(bits):
-        b = ((bid[idx] >> jnp.int32(s)) & jnp.int32(1)).astype(jnp.int32)
+        b = ((bid[idx] >> jnp.int32(s)) & jnp.int32(1)).astype(jnp.int32)  # valueflow: ok - masked to one bit
         zb = jnp.cumsum(jnp.int32(1) - b, dtype=jnp.int32)  # incl. zeros
         nz = zb[n - 1]
         # zeros keep order at offset 0; ones at offset total_zeros
@@ -158,7 +158,7 @@ def _partition_pallas(bid, bits: int, n: int, interpret: bool):
     digit_mask = jnp.int32((1 << D.RADIX_BITS) - 1)
     for p in range(-(-bits // D.RADIX_BITS)):
         dig = (bid[idx] >> jnp.int32(p * D.RADIX_BITS)) & digit_mask
-        idx = counting_sort_pass(dig.astype(jnp.int32), idx, interpret)
+        idx = counting_sort_pass(dig.astype(jnp.int32), idx, interpret)  # valueflow: ok - digit_mask bounds to RADIX_BITS bits
     return idx[:n]
 
 
@@ -174,7 +174,7 @@ def scatter_permutation(h, sel, num_buckets: int, n: int, platform: str):
     bits = D.radix_key_bits(num_buckets)
     key_bits = bits - 1                   # top bit = dead-row tail key
     # np scalar: stays 64-bit regardless of the embedder's x64 flag
-    key = (h >> np.uint64(64 - key_bits)).astype(jnp.int32)
+    key = (h >> np.uint64(64 - key_bits)).astype(jnp.int32)  # valueflow: ok - top key_bits <= 31 bits survive the shift
     key = jnp.where(sel, key, jnp.int32(1 << key_bits))
     use_pallas, interpret = _pallas_choice(platform)
     if use_pallas:

@@ -398,7 +398,10 @@ def _try_cop(p: LogicalPlan, no_device_join: bool = False) -> Optional[PhysOp]:
             bounded = {gid_ix: expand_l.levels}
         agg_node = _bind_agg(top, node, cur_dicts, key_meta, agg_dicts,
                               ds=None if has_proj else ds,
-                              bounded_ints=bounded)
+                              bounded_ints=bounded,
+                              # narrow proofs remap indices through any
+                              # Projection themselves — keep the table
+                              narrow_ds=ds)
         if agg_node is None:
             # aggregation itself not pushable: fuse the scan part only and
             # aggregate on host
@@ -1154,9 +1157,31 @@ def _chain_output_dicts(plan: LogicalPlan) -> dict:
     return dicts
 
 
+def _maybe_narrow(agg_node: D.Aggregation, ds) -> D.Aggregation:
+    """Stamp valueflow-proven single-word SUM slots onto a bound
+    SCALAR/DENSE aggregation.  The proof needs attained (ANALYZEd)
+    column intervals, so it only fires when the planning pass has a
+    stats handle and the scanned table is analyzed; the stamp changes
+    the frozen DAG's digest, so narrow and limb programs key, cache,
+    price and fuse apart automatically."""
+    if ds is None or agg_node is None or not agg_node.aggs:
+        return agg_node
+    handle = STATS_HANDLE.get()
+    table = getattr(ds, "table", None)
+    if handle is None or table is None:
+        return agg_node
+    from ..analysis import valueflow
+    ns = valueflow.prove_narrow_sums(agg_node, table, handle)
+    if not ns:
+        return agg_node
+    import dataclasses
+    return dataclasses.replace(agg_node, narrow_sums=ns)
+
+
 def _bind_agg(agg: LogicalAggregate, child: D.CopNode, dicts,
               key_meta_out: list, agg_dicts_out: dict,
-              ds=None, bounded_ints=None) -> Optional[D.Aggregation]:
+              ds=None, bounded_ints=None,
+              narrow_ds=None) -> Optional[D.Aggregation]:
     """Bind a LogicalAggregate to a device Aggregation (DENSE/SCALAR), or
     None if it must stay on host (generic keys / distinct).
 
@@ -1188,7 +1213,9 @@ def _bind_agg(agg: LogicalAggregate, child: D.CopNode, dicts,
         descs.append(D.AggDesc(a.func, arg, a.out_dtype))
 
     if not agg.group_exprs:
-        return D.Aggregation(child, (), tuple(descs), D.GroupStrategy.SCALAR)
+        return _maybe_narrow(
+            D.Aggregation(child, (), tuple(descs), D.GroupStrategy.SCALAR),
+            narrow_ds if narrow_ds is not None else ds)
 
     # DENSE when every key has a known finite domain — small dict-encoded
     # strings, or planner-bounded ints (rollup gid): the psum seam merges
@@ -1218,9 +1245,11 @@ def _bind_agg(agg: LogicalAggregate, child: D.CopNode, dicts,
             total *= size
         if total <= MAX_DENSE_GROUPS:
             key_meta_out.extend(metas)
-            return D.Aggregation(child, tuple(agg.group_exprs), tuple(descs),
-                                 D.GroupStrategy.DENSE,
-                                 domain_sizes=tuple(sizes))
+            return _maybe_narrow(
+                D.Aggregation(child, tuple(agg.group_exprs), tuple(descs),
+                              D.GroupStrategy.DENSE,
+                              domain_sizes=tuple(sizes)),
+                narrow_ds if narrow_ds is not None else ds)
         # dense fell through on domain size: the known key-domain product
         # still bounds NDV when stats are absent
         known_total = total

@@ -79,10 +79,12 @@ def _arith_result_type(op: str, a: dt.DataType, b: dt.DataType) -> dt.DataType:
     decimal64 contract: precision is capped at DECIMAL64_MAX_PRECISION; an
     operation whose true result needs more digits keeps its scale but may
     overflow int64 at runtime.  SUMs are overflow-proof via limb splitting,
-    and host-evaluated scalar add/sub/mul raise OverflowError instead of
-    wrapping (expr/compile.Evaluator._guard_dec_overflow).  Still unguarded:
+    and host-evaluated scalar add/sub/mul — and the div path's pow10
+    pre-scaling multiply — raise OverflowError instead of wrapping
+    (expr/compile.Evaluator._guard_dec_overflow).  Still unguarded:
     device-traced (jnp) lanes — a traced program cannot raise
-    data-dependently — and the div path's pow10 pre-scaling multiply."""
+    data-dependently — which is exactly what analysis/valueflow proves
+    safe pre-trace (NUM-OVERFLOW-DEVICE / NUM-DIV-PRESCALE)."""
     nullable = a.nullable or b.nullable or op in ("div", "intdiv", "mod")
     # arithmetic over a wide (aggregation-result) decimal stays wide: the
     # host object-int representation is exact past 18 digits

@@ -364,10 +364,18 @@ class Evaluator:
             # divisor instead — pow10 must stay integral to keep exactness.
             if k >= 0:
                 num = self._iwiden("multiply", va, dec.pow10(k), False)
+                # the pre-scaling multiply wraps exactly like any other
+                # scaled-int64 multiply — exact divide-back guard on
+                # host lanes (device lanes: valueflow NUM-DIV-PRESCALE
+                # proves the interval pre-trace)
+                self._guard_dec_overflow("multiply", va, dec.pow10(k),
+                                         num, vand(ma, mb))
                 den = _as_i64(xp, vb) if self._is_narrow(vb) else vb
             else:
                 num = _as_i64(xp, va) if self._is_narrow(va) else va
                 den = self._iwiden("multiply", vb, dec.pow10(-k), False)
+                self._guard_dec_overflow("multiply", vb, dec.pow10(-k),
+                                         den, vand(ma, mb))
             return (_round_div(xp, num, den), _div_valid(xp, ma, mb, vb))
         va, ma = self._num(a, cols, memo)
         vb, mb = self._num(b, cols, memo)

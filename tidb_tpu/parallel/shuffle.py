@@ -130,7 +130,7 @@ class ShardedShuffleJoinProgram:
         # sort build partition by key; dead rows park at the end with an
         # INT64_MAX fill so match_ranges' n_live clamp excludes them
         nb = bkeys.shape[0]
-        bdead = (~(bvalid & bkey_ok)).astype(jnp.int32)
+        bdead = (~(bvalid & bkey_ok)).astype(jnp.int32)  # valueflow: ok - bool lane, [0, 1]
         _sdead, skey, perm = lax.sort(
             (bdead, bkeys, jnp.arange(nb, dtype=jnp.int64)), num_keys=2)
         n_live = jnp.sum(1 - bdead)

@@ -124,13 +124,16 @@ class ShardedCopProgram:
                            in D.HOST_MERGE_STRATEGIES)
         # int/decimal SUMs produce (hi, lo) limb states whose in-program
         # psum is int64-exact only below 2^31 global rows; float sums,
-        # counts, and host-merged (object-int) programs are exempt
+        # counts, host-merged (object-int) programs, and valueflow-proven
+        # narrow SUMs (single int64 word, whole-table no-wrap proof — the
+        # row fence is subsumed by the value proof) are exempt
         from ..types.dtypes import TypeKind as _K
         self._psum_limb_fence = (
             self.agg is not None and not self.host_merge and any(
                 a.func == D.AggFunc.SUM and a.arg is not None
                 and a.arg.dtype.kind not in (_K.FLOAT64, _K.FLOAT32)
-                for a in self.agg.aggs))
+                and i not in self.agg.narrow_sums
+                for i, a in enumerate(self.agg.aggs)))
 
         # programs containing an expanding join also return a per-device
         # extras dict (true join output size) for the dispatcher's regrow

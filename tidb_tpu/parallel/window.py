@@ -56,8 +56,8 @@ def _key_operands(vals_masks, descs=None):
         if m is True:
             nf = jnp.zeros(v.shape[0], jnp.int32)
         else:
-            nf = (jnp.where(m, 1, 0) if not desc
-                  else jnp.where(m, 0, 1)).astype(jnp.int32)
+            flag = jnp.where(m, 1, 0) if not desc else jnp.where(m, 0, 1)
+            nf = flag.astype(jnp.int32)  # valueflow: ok - literal 0/1 lanes
         ops += [nf, key]
     return ops
 
@@ -142,7 +142,7 @@ class ShardedWindowProgram:
                 j += 1
 
         # ONE sort: dead rows last, then partitions, then order keys
-        dead = (~rvalid).astype(jnp.int32)
+        dead = (~rvalid).astype(jnp.int32)  # valueflow: ok - bool lane, [0, 1]
         pk_ops = _key_operands(r_pk)
         ok_ops = _key_operands(r_ok, [d for _e, d in spec.order_keys])
         operands = [dead] + pk_ops + ok_ops

@@ -261,6 +261,10 @@ class DeviceScheduler:
                                           # for blast-radius isolation
         self.quarantined = 0              # submits failed fast by an OPEN
                                           # breaker (LaunchQuarantinedError)
+        self.value_drifts = 0             # admitted tasks whose observed
+                                          # column watermarks escaped the
+                                          # plan's declared value interval
+                                          # (valueflow stats drift)
         # rc enforcement accounting (rc/controller)
         self.rc_throttled = 0             # drain passes that skipped a group
         self.rc_exhausted = 0             # waiters failed at the deadline
@@ -584,6 +588,13 @@ class DeviceScheduler:
             from ..analysis.contracts import verify_task
             verify_task(task)
             self._admit_cost(task)
+            if task.value_drift:
+                # valueflow watermark drift: the plan's declared value
+                # interval no longer contains the observed ANALYZE
+                # watermark — never wrong (proofs carry append
+                # headroom), but the operator should re-ANALYZE
+                with self._mu:
+                    self.value_drifts += task.value_drift
         if task.key is not None:
             # circuit breaker: a digest whose launches keep failing is
             # quarantined HERE, in the submitting thread — fail fast
@@ -1194,6 +1205,10 @@ class DeviceScheduler:
                 attrs["hbm_predicted"] = t.hbm_predicted
             if t.hbm_measured:
                 attrs["hbm_measured"] = t.hbm_measured
+            if t.value_drift:
+                # valueflow: declared interval no longer contains the
+                # observed watermark (stats drift, not a wrong result)
+                attrs["value_drift"] = t.value_drift
             strat = self._strategy_of(t.dag)
             if strat is not None:
                 attrs["strategy"] = strat
@@ -1841,6 +1856,7 @@ class DeviceScheduler:
                 "retried_tasks": self.retried_tasks,
                 "bisected_launches": self.bisected_launches,
                 "quarantined": self.quarantined,
+                "value_drifts": self.value_drifts,
                 "breaker": self.breaker.snapshot(),
                 "faults": _faults.stats(),   # None when unarmed
                 "rc_enable": self.rc_enable,

@@ -116,7 +116,7 @@ class CopTask:
                  "est_rows", "cost", "cost_static", "rc_group", "rus",
                  "rus_charged", "device_ns", "deadline_ns", "svc_ns",
                  "donate", "retries", "compile_ns", "compile_miss",
-                 "hbm_predicted", "hbm_measured", "trace")
+                 "hbm_predicted", "hbm_measured", "value_drift", "trace")
 
     def __init__(self, *, key=None, dag=None, mesh=None, row_capacity=0,
                  cols=None, counts=None, aux=(), input_token=None,
@@ -172,6 +172,10 @@ class CopTask:
                                   # the drain BEFORE finish (memory
                                   # stats delta / compiled analysis of
                                   # the served executable; 0 = none)
+        self.value_drift = 0      # columns whose observed ANALYZE
+                                  # watermark escaped the plan's
+                                  # declared value interval (valueflow
+                                  # stats drift — surfaced, never fatal)
         # copscope trace propagation (obs/): the submitting statement's
         # TraceCtx rides the task like SCHED_GROUP does, so the drain
         # thread records queue/compile/launch/retry spans under the

@@ -1115,6 +1115,14 @@ class Session:
             # any other contract violation
             from ..analysis.shardflow import verify_plan_sharding
             verify_plan_sharding(phys, self._topology(merged))
+            # value-range pass (analysis/valueflow): every device lane
+            # flowed over stats-seeded integer intervals — silent int64
+            # wraps, unprovable SUM fences, f32 precision cliffs and
+            # div pre-scale escapes reject HERE, pre-trace; each
+            # verified digest lands in the proof registry the sched
+            # admission seam replays
+            from ..analysis.valueflow import verify_plan_values
+            verify_plan_values(phys, self.domain.stats)
             phys._contract_ok = True
         use_cache = use_cache and not ran_subquery
         if use_cache and _plan_cacheable(phys):

@@ -245,6 +245,10 @@ def _run_stress_inner(dom, sched, queries, n_sessions, rate_per_s,
         - base.get("shed_rejects", 0),
         "rc_exhausted": st.get("rc_exhausted", 0)
         - base.get("rc_exhausted", 0),
+        # copnum: ANALYZE-stamped watermark drift observed at sched admit
+        # (declared stats interval failed to contain observed min/max)
+        "value_drifts": st.get("value_drifts", 0)
+        - base.get("value_drifts", 0),
         "calibration_entries": calib.get("entries", 0),
         "calibration_observed": calib.get("observed", 0)
         - (calib0.get("observed", 0) or 0),
